@@ -1,0 +1,54 @@
+"""ray_tpu: a TPU-native distributed execution framework.
+
+Task/actor/object core runtime (counterpart of the reference Ray core),
+plus a JAX/XLA-first ML stack: parallel (mesh/sharding/collectives),
+models, ops (Pallas kernels), data, train, tune, rl, serve.
+"""
+
+from ray_tpu._version import __version__
+from ray_tpu.core.api import (
+    available_resources,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    ActorError,
+    GetTimeoutError,
+    ObjectLostError,
+    RayTpuError,
+    TaskError,
+    WorkerCrashedError,
+)
+from ray_tpu.core.object_ref import ObjectRef
+
+__all__ = [
+    "__version__",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "get",
+    "put",
+    "wait",
+    "kill",
+    "get_actor",
+    "cluster_resources",
+    "available_resources",
+    "ObjectRef",
+    "RayTpuError",
+    "TaskError",
+    "ActorError",
+    "ActorDiedError",
+    "ObjectLostError",
+    "GetTimeoutError",
+    "WorkerCrashedError",
+]

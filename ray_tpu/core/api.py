@@ -1,0 +1,110 @@
+"""Public API: init / shutdown / remote / get / put / wait / actors.
+
+Counterpart of python/ray/_private/worker.py's public functions
+(ray.init :1225, ray.get :2576, ray.put :2691, ray.wait :2756,
+ray.remote :3149, ray.get_actor :2902).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, List, Optional, Sequence, Union
+
+from ray_tpu.core import runtime as _runtime_mod
+from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.driver import DriverRuntime
+from ray_tpu.core.exceptions import RayTpuError
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.remote_function import RemoteFunction
+
+
+def init(num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         resources: Optional[dict] = None,
+         namespace: str = "",
+         ignore_reinit_error: bool = True,
+         _system_config: Optional[dict] = None) -> DriverRuntime:
+    """Start the single-host runtime (control plane + worker pool)."""
+    rt = _runtime_mod._global_runtime
+    if rt is not None and getattr(rt, "is_initialized", False):
+        if ignore_reinit_error:
+            return rt
+        raise RayTpuError("ray_tpu.init() called twice")
+    return DriverRuntime(
+        num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+        namespace=namespace, _system_config=_system_config)
+
+
+def is_initialized() -> bool:
+    rt = _runtime_mod._global_runtime
+    return rt is not None and getattr(rt, "is_initialized", False)
+
+
+def shutdown():
+    rt = _runtime_mod._global_runtime
+    if rt is not None and hasattr(rt, "shutdown"):
+        rt.shutdown()
+
+
+def remote(*args, **kwargs):
+    """Decorator: @remote or @remote(num_cpus=..., num_tpus=..., ...)."""
+
+    def make(obj):
+        if inspect.isclass(obj):
+            valid = {"num_cpus", "num_tpus", "resources", "max_restarts",
+                     "max_concurrency", "name", "namespace", "lifetime",
+                     "runtime_env"}
+            opts = {k: v for k, v in kwargs.items() if k in valid}
+            return ActorClass(obj, **opts)
+        valid = {"num_returns", "num_cpus", "num_tpus", "resources",
+                 "max_retries", "runtime_env", "scheduling_strategy"}
+        opts = {k: v for k, v in kwargs.items() if k in valid}
+        return RemoteFunction(obj, **opts)
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return make(args[0])
+    if args:
+        raise TypeError("@remote takes only keyword arguments")
+    return make
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]],
+        timeout: Optional[float] = None):
+    rt = _runtime_mod.get_runtime()
+    if isinstance(refs, ObjectRef):
+        return rt.get([refs], timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects ObjectRef or list, got {type(refs)}")
+    return rt.get(list(refs), timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    return _runtime_mod.get_runtime().put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return _runtime_mod.get_runtime().wait(
+        list(refs), num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    _runtime_mod.get_runtime().kill_actor(
+        actor._actor_hex, no_restart=no_restart)
+
+
+def get_actor(name: str, namespace: str = "") -> ActorHandle:
+    info = _runtime_mod.get_runtime().get_named_actor(name, namespace)
+    if info is None:
+        raise ValueError(f"Failed to look up actor {name!r}")
+    return ActorHandle(info["actor"], info["class_id"].split(":")[0])
+
+
+def cluster_resources() -> dict:
+    return _runtime_mod.get_runtime().cluster_resources()
+
+
+def available_resources() -> dict:
+    return _runtime_mod.get_runtime().available_resources()

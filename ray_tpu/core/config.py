@@ -1,0 +1,89 @@
+"""Runtime configuration flags.
+
+Counterpart of the reference's RAY_CONFIG flag system
+(src/ray/common/ray_config_def.h): every knob has a typed default and can be
+overridden by an ``RAY_TPU_<NAME>`` environment variable or via
+``init(_system_config={...})``.  Kept deliberately small; grow as subsystems
+land.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, fields
+
+
+def _env_override(name: str, default):
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    t = type(default)
+    if t is bool:
+        return raw.lower() in ("1", "true", "yes")
+    return t(raw)
+
+
+@dataclass
+class Config:
+    # -- object store ---------------------------------------------------
+    # Objects at or below this size are stored inline in the object
+    # directory instead of a shared-memory segment (reference:
+    # max_direct_call_object_size, ray_config_def.h).
+    max_inline_object_size: int = 100 * 1024
+    # Shared-memory store capacity (bytes). 0 = unlimited (bounded by /dev/shm).
+    object_store_memory: int = 0
+    # Directory backing the shared-memory store.
+    shm_dir: str = "/dev/shm"
+
+    # -- scheduling -----------------------------------------------------
+    # Max worker processes started eagerly at init.
+    prestart_workers: int = 0
+    # Hard cap on worker processes per node.
+    max_workers_per_node: int = 64
+    # Seconds a leased idle worker is kept before being returned to pool.
+    worker_lease_timeout_s: float = 0.0
+    # Top-k random choice among feasible nodes (reference hybrid policy
+    # scheduling/policy/hybrid_scheduling_policy.h).
+    scheduler_top_k_fraction: float = 0.2
+
+    # -- fault tolerance ------------------------------------------------
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    health_check_period_s: float = 1.0
+    health_check_timeout_s: float = 10.0
+
+    # -- rpc ------------------------------------------------------------
+    rpc_connect_timeout_s: float = 10.0
+    rpc_max_message_bytes: int = 512 * 1024 * 1024
+
+    # -- logging --------------------------------------------------------
+    log_dir: str = ""
+
+    def __post_init__(self):
+        for f in fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+
+    def apply_overrides(self, overrides: dict | None):
+        if not overrides:
+            return self
+        valid = {f.name for f in fields(self)}
+        for k, v in overrides.items():
+            if k not in valid:
+                raise ValueError(f"Unknown system config key: {k}")
+            setattr(self, k, v)
+        return self
+
+
+_global_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config()
+    return _global_config
+
+
+def reset_config():
+    global _global_config
+    _global_config = None

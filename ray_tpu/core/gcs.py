@@ -1,0 +1,817 @@
+"""Control server: object directory, actor registry, KV, scheduler, worker pool.
+
+This is the single-node fusion of the reference's GCS server
+(src/ray/gcs/gcs_server/gcs_server.cc — actor/node/KV/pubsub managers) and
+raylet (src/ray/raylet/node_manager.cc — ClusterTaskManager / LocalTaskManager
+/ WorkerPool).  It runs as threads inside the head process and speaks the
+rpc.py framed protocol to driver and worker processes.
+
+Design deviations from the reference, deliberate for the TPU-first rebuild:
+  - Small objects live in the directory itself rather than in per-owner
+    memory stores; on a single node the directory IS the owner's metadata
+    table.  Multi-node ownership (owner-resident values + location lookups,
+    reference reference_count.h / ownership_based_object_directory.cc) is
+    layered on in the multi-host control plane.
+  - Scheduling is event-driven FIFO + resource fit over one node; the
+    hybrid pack/spread policy slot is where multi-node placement goes.
+  - TPU chips are scheduled like GPUs in the reference
+    (resource vector entries) but workers granted TPU get exclusive chip
+    visibility via TPU_VISIBLE_CHIPS/JAX_PLATFORMS env, because on TPU a
+    chip belongs to exactly one process (no MPS-style sharing).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import Config
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_store import ShmObjectStore
+from ray_tpu.core.resources import CPU, TPU, ResourceSet
+from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
+
+# Object states
+PENDING = "PENDING"
+READY = "READY"
+ERRORED = "ERRORED"
+
+# Actor states (mirrors reference gcs_actor_manager.h state machine)
+A_PENDING = "PENDING_CREATION"
+A_ALIVE = "ALIVE"
+A_RESTARTING = "RESTARTING"
+A_DEAD = "DEAD"
+
+
+@dataclass
+class ObjectEntry:
+    state: str = PENDING
+    size: int = 0
+    inline: Optional[bytes] = None
+    in_shm: bool = False
+    refcount: int = 1
+    is_error: bool = False
+    subscribers: List[rpc.Connection] = field(default_factory=list)
+    producing_task: Optional[str] = None  # task hex, lineage hook
+
+
+@dataclass
+class WorkerInfo:
+    worker_hex: str
+    conn: Optional[rpc.Connection] = None
+    pid: int = 0
+    address: str = ""  # worker's own rpc server (direct actor transport)
+    kind: str = "pool"  # pool | actor | driver
+    env_key: str = ""
+    state: str = "starting"  # starting | idle | busy | dead
+    current_task: Optional[str] = None
+    acquired: ResourceSet = field(default_factory=ResourceSet)
+    actor_hex: str = ""
+    proc: Optional[subprocess.Popen] = None
+
+
+@dataclass
+class ActorEntry:
+    spec: ActorCreationSpec
+    state: str = A_PENDING
+    worker_hex: str = ""
+    address: str = ""
+    death_reason: str = ""
+    subscribers: List[rpc.Connection] = field(default_factory=list)
+
+
+@dataclass
+class TaskRecord:
+    spec: TaskSpec
+    state: str = "PENDING"  # PENDING | RUNNING | FINISHED | FAILED
+    worker_hex: str = ""
+    submitted_at: float = 0.0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+
+
+_SITE_PACKAGES: Optional[str] = None
+
+
+def _site_packages() -> str:
+    """Site-package dirs joined for PYTHONPATH (cached)."""
+    global _SITE_PACKAGES
+    if _SITE_PACKAGES is None:
+        import site
+
+        paths = list(site.getsitepackages())
+        usp = site.getusersitepackages()
+        if isinstance(usp, str):
+            paths.append(usp)
+        _SITE_PACKAGES = os.pathsep.join(
+            p for p in paths if os.path.isdir(p))
+    return _SITE_PACKAGES
+
+
+class ControlServer:
+    def __init__(self, session_id: str, config: Config, resources: ResourceSet,
+                 session_dir: str, namespace: str = ""):
+        self.session_id = session_id
+        self.config = config
+        self.session_dir = session_dir
+        self.namespace = namespace
+        os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+
+        self.lock = threading.RLock()
+        self.objects: Dict[str, ObjectEntry] = {}
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.actors: Dict[str, ActorEntry] = {}
+        self.named_actors: Dict[tuple, str] = {}
+        self.kv: Dict[str, bytes] = {}
+        self.funcs: Dict[str, bytes] = {}
+        # In-flight actor-task return objects: actor hex -> pending obj
+        # hexes, and the reverse map. Used to fail callers' gets when an
+        # actor dies with tasks in its queue (the reference fails these via
+        # DirectActorTaskSubmitter::DisconnectActor).
+        self.actor_inflight: Dict[str, Set[str]] = {}
+        self.obj_actor: Dict[str, str] = {}
+        self.tasks: Dict[str, TaskRecord] = {}
+        self.pending_tasks: List[TaskSpec] = []
+        self.pending_actors: List[ActorCreationSpec] = []
+
+        self.total_resources = resources
+        self.available = resources
+        self.store = ShmObjectStore(session_id, config.shm_dir)
+
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        self.server = rpc.Server(self._handle, on_disconnect=self._on_disconnect)
+        self._sched_thread = threading.Thread(
+            target=self._schedule_loop, name="scheduler", daemon=True
+        )
+        self._sched_thread.start()
+
+    # ------------------------------------------------------------------
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def stop(self):
+        self._stopped.set()
+        self._wake.set()
+        with self.lock:
+            workers = list(self.workers.values())
+        for w in workers:
+            if w.conn is not None and w.kind != "driver":
+                try:
+                    w.conn.push({"op": "exit"})
+                except Exception:
+                    pass
+        procs = [w.proc for w in workers if w.proc is not None]
+        deadline = time.monotonic() + 1.0
+        while procs and time.monotonic() < deadline:
+            procs = [p for p in procs if p.poll() is None]
+            if procs:
+                time.sleep(0.02)
+        for p in procs:  # stragglers: escalate
+            try:
+                p.kill()
+            except OSError:
+                pass
+        self.server.stop()
+        self.store.cleanup()
+
+    # ------------------------------------------------------------------
+    # RPC dispatch
+    def _handle(self, conn: rpc.Connection, msg: dict):
+        op = msg["op"]
+        fn = getattr(self, f"_op_{op}", None)
+        if fn is None:
+            raise ValueError(f"unknown control op: {op}")
+        return fn(conn, msg)
+
+    def _on_disconnect(self, conn: rpc.Connection):
+        worker_hex = conn.meta.get("worker_hex")
+        if worker_hex is None:
+            return
+        with self.lock:
+            w = self.workers.get(worker_hex)
+            if w is None or w.state == "dead":
+                return
+            self._mark_worker_dead(w, "connection lost")
+        self._wake.set()
+
+    def _mark_worker_dead(self, w: WorkerInfo, reason: str):
+        """Called with lock held. Fail/retry its task, kill/restart its actor."""
+        w.state = "dead"
+        w.conn = None
+        self.available = self.available.add(w.acquired)
+        w.acquired = ResourceSet()
+        if w.current_task:
+            rec = self.tasks.get(w.current_task)
+            if rec is not None and rec.state == "RUNNING":
+                spec = rec.spec
+                if spec.retry_count < spec.max_retries:
+                    spec.retry_count += 1
+                    rec.state = "PENDING"
+                    rec.worker_hex = ""
+                    self.pending_tasks.append(spec)
+                else:
+                    rec.state = "FAILED"
+                    self._fail_task_returns(spec, f"worker died: {reason}")
+            w.current_task = None
+        if w.actor_hex:
+            entry = self.actors.get(w.actor_hex)
+            if entry is not None and entry.state not in (A_DEAD,):
+                spec = entry.spec
+                # Tasks already delivered to the dead process are lost either
+                # way; fail their return objects so callers' gets raise
+                # instead of hanging.
+                self._fail_actor_inflight(w.actor_hex, reason)
+                if spec.restart_count < spec.max_restarts:
+                    spec.restart_count += 1
+                    entry.state = A_RESTARTING
+                    entry.worker_hex = ""
+                    entry.address = ""
+                    self._push_actor_update(entry, w.actor_hex)
+                    self.pending_actors.append(spec)
+                else:
+                    entry.state = A_DEAD
+                    entry.death_reason = reason
+                    self._push_actor_update(entry, w.actor_hex)
+
+    def _fail_actor_inflight(self, actor_hex: str, reason: str):
+        """Lock held. Store ActorDiedError into every unfinished return
+        object of tasks already sent to this actor."""
+        from ray_tpu.core.exceptions import ActorDiedError
+        from ray_tpu.core.serialization import serialize
+
+        pending = self.actor_inflight.pop(actor_hex, None)
+        if not pending:
+            return
+        data = serialize(
+            ActorDiedError(actor_hex, f"worker died: {reason}")).to_bytes()
+        for obj_hex in list(pending):
+            self.obj_actor.pop(obj_hex, None)
+            entry = self.objects.get(obj_hex)
+            if entry is None or entry.state == PENDING:
+                self._store_object_locked(
+                    obj_hex, inline=data, size=len(data), is_error=True)
+
+    def _fail_task_returns(self, spec: TaskSpec, reason: str):
+        """Lock held. Store WorkerCrashedError in the task's return objects."""
+        from ray_tpu.core.exceptions import WorkerCrashedError
+        from ray_tpu.core.serialization import serialize
+
+        err = serialize(WorkerCrashedError(f"task {spec.name or spec.task_id.hex()}: {reason}"))
+        data = err.to_bytes()
+        for oid in spec.return_ids:
+            self._store_object_locked(oid.hex(), inline=data, size=len(data),
+                                      is_error=True)
+
+    # ------------------------------------------------------------------
+    # Registration
+    def _op_register(self, conn, msg):
+        worker_hex = msg["worker_hex"]
+        with self.lock:
+            w = self.workers.get(worker_hex)
+            if w is None:
+                w = WorkerInfo(worker_hex=worker_hex)
+                self.workers[worker_hex] = w
+            w.conn = conn
+            w.pid = msg.get("pid", 0)
+            w.address = msg.get("address", "")
+            w.kind = msg.get("kind", w.kind or "pool")
+            w.env_key = msg.get("env_key", w.env_key)
+            conn.meta["worker_hex"] = worker_hex
+            # Pool workers stay "starting" until they send worker_online
+            # (hooks installed); dispatching earlier races task delivery.
+            if w.kind == "driver":
+                w.state = "driver"
+        self._wake.set()
+        return {
+            "session_id": self.session_id,
+            "shm_dir": self.config.shm_dir,
+            "session_dir": self.session_dir,
+        }
+
+    # ------------------------------------------------------------------
+    # Objects
+    def _store_object_locked(self, obj_hex: str, *, inline, size, is_error,
+                             in_shm: bool = False):
+        entry = self.objects.get(obj_hex)
+        if entry is None:
+            entry = self.objects[obj_hex] = ObjectEntry()
+        entry.state = ERRORED if is_error else READY
+        entry.inline = inline
+        entry.size = size
+        entry.in_shm = in_shm
+        entry.is_error = is_error
+        actor_hex = self.obj_actor.pop(obj_hex, None)
+        if actor_hex is not None:
+            self.actor_inflight.get(actor_hex, set()).discard(obj_hex)
+        subs, entry.subscribers = entry.subscribers, []
+        push = self._object_ready_msg(obj_hex, entry)
+        for c in subs:
+            try:
+                c.push(push)
+            except Exception:
+                pass
+
+    def _object_ready_msg(self, obj_hex, entry):
+        return {
+            "op": "object_ready",
+            "obj": obj_hex,
+            "size": entry.size,
+            "inline": entry.inline,
+            "in_shm": entry.in_shm,
+            "is_error": entry.is_error,
+        }
+
+    def _op_put_object(self, conn, msg):
+        with self.lock:
+            self._store_object_locked(
+                msg["obj"],
+                inline=msg.get("inline"),
+                size=msg["size"],
+                is_error=msg.get("is_error", False),
+                in_shm=msg.get("in_shm", False),
+            )
+        self._wake.set()
+
+    def _op_subscribe_object(self, conn, msg):
+        obj_hex = msg["obj"]
+        with self.lock:
+            entry = self.objects.get(obj_hex)
+            if entry is None:
+                entry = self.objects[obj_hex] = ObjectEntry(refcount=0)
+            if entry.state in (READY, ERRORED):
+                conn.push(self._object_ready_msg(obj_hex, entry))
+            else:
+                entry.subscribers.append(conn)
+
+    def _op_incref(self, conn, msg):
+        with self.lock:
+            entry = self.objects.get(msg["obj"])
+            if entry is not None:
+                entry.refcount += msg.get("n", 1)
+
+    def _op_decref(self, conn, msg):
+        to_delete = []
+        with self.lock:
+            obj_hex = msg["obj"]
+            entry = self.objects.get(obj_hex)
+            if entry is None:
+                return
+            entry.refcount -= msg.get("n", 1)
+            if entry.refcount <= 0 and entry.state in (READY, ERRORED):
+                del self.objects[obj_hex]
+                if entry.in_shm:
+                    to_delete.append(obj_hex)
+        for obj_hex in to_delete:
+            self.store.delete(ObjectID.from_hex(obj_hex))
+
+    def _op_register_objects(self, conn, msg):
+        """Pre-register return objects of direct (actor) tasks with one ref
+        held by the submitter, mirroring TaskManager::AddPendingTask return
+        registration (reference core_worker.cc:2231).  When tied to an
+        actor, track them so actor death fails outstanding callers."""
+        actor_hex = msg.get("actor")
+        with self.lock:
+            for obj_hex in msg["objs"]:
+                self.objects.setdefault(obj_hex, ObjectEntry())
+                if actor_hex:
+                    self.actor_inflight.setdefault(
+                        actor_hex, set()).add(obj_hex)
+                    self.obj_actor[obj_hex] = actor_hex
+
+    def _op_free_objects(self, conn, msg):
+        with self.lock:
+            for obj_hex in msg["objs"]:
+                entry = self.objects.pop(obj_hex, None)
+                if entry is not None and entry.in_shm:
+                    self.store.delete(ObjectID.from_hex(obj_hex))
+
+    # ------------------------------------------------------------------
+    # Functions (counterpart of _private/function_manager.py export tables)
+    def _op_put_func(self, conn, msg):
+        with self.lock:
+            self.funcs.setdefault(msg["func_id"], msg["blob"])
+
+    def _op_get_func(self, conn, msg):
+        with self.lock:
+            return self.funcs.get(msg["func_id"])
+
+    # ------------------------------------------------------------------
+    # KV store (reference: gcs_kv_manager / experimental/internal_kv.py)
+    def _op_kv_put(self, conn, msg):
+        with self.lock:
+            key = msg["key"]
+            if msg.get("overwrite", True) or key not in self.kv:
+                self.kv[key] = msg["value"]
+                return True
+            return False
+
+    def _op_kv_get(self, conn, msg):
+        with self.lock:
+            return self.kv.get(msg["key"])
+
+    def _op_kv_del(self, conn, msg):
+        with self.lock:
+            return self.kv.pop(msg["key"], None) is not None
+
+    def _op_kv_keys(self, conn, msg):
+        prefix = msg.get("prefix", "")
+        with self.lock:
+            return [k for k in self.kv if k.startswith(prefix)]
+
+    def _op_kv_exists(self, conn, msg):
+        with self.lock:
+            return msg["key"] in self.kv
+
+    # ------------------------------------------------------------------
+    # Tasks
+    def _op_submit_task(self, conn, msg):
+        spec: TaskSpec = msg["spec"]
+        with self.lock:
+            for oid in spec.return_ids:
+                self.objects.setdefault(oid.hex(), ObjectEntry(
+                    producing_task=spec.task_id.hex()))
+            self.tasks[spec.task_id.hex()] = TaskRecord(
+                spec=spec, submitted_at=time.time())
+            self.pending_tasks.append(spec)
+        self._wake.set()
+
+    def _op_task_done(self, conn, msg):
+        with self.lock:
+            rec = self.tasks.get(msg["task_id"])
+            worker_hex = conn.meta.get("worker_hex")
+            w = self.workers.get(worker_hex) if worker_hex else None
+            if rec is not None:
+                rec.state = "FAILED" if msg.get("failed") else "FINISHED"
+                rec.finished_at = time.time()
+            if w is not None and w.kind == "pool":
+                w.state = "idle"
+                w.current_task = None
+                self.available = self.available.add(w.acquired)
+                w.acquired = ResourceSet()
+        self._wake.set()
+
+    # ------------------------------------------------------------------
+    # Actors
+    def _op_create_actor(self, conn, msg):
+        spec: ActorCreationSpec = msg["spec"]
+        with self.lock:
+            entry = ActorEntry(spec=spec)
+            self.actors[spec.actor_id.hex()] = entry
+            if spec.name:
+                key = (spec.namespace, spec.name)
+                if key in self.named_actors:
+                    entry.state = A_DEAD
+                    entry.death_reason = f"name {spec.name!r} already taken"
+                    self._push_actor_update(entry, spec.actor_id.hex())
+                    return
+                self.named_actors[key] = spec.actor_id.hex()
+            self.pending_actors.append(spec)
+        self._wake.set()
+
+    def _op_actor_ready(self, conn, msg):
+        actor_hex = msg["actor"]
+        with self.lock:
+            entry = self.actors.get(actor_hex)
+            if entry is None:
+                return
+            if entry.state == A_DEAD:
+                # Killed while the worker was still creating the instance —
+                # don't resurrect; tell the worker to exit (zombie would
+                # otherwise hold its resource allocation).
+                try:
+                    conn.push({"op": "exit"})
+                except Exception:
+                    pass
+                return
+            entry.state = A_ALIVE
+            entry.address = msg["address"]
+            self._push_actor_update(entry, actor_hex)
+
+    def _op_actor_creation_failed(self, conn, msg):
+        actor_hex = msg["actor"]
+        with self.lock:
+            entry = self.actors.get(actor_hex)
+            if entry is None:
+                return
+            entry.state = A_DEAD
+            entry.death_reason = msg.get("reason", "creation failed")
+            self._push_actor_update(entry, actor_hex)
+
+    def _op_subscribe_actor(self, conn, msg):
+        actor_hex = msg["actor"]
+        with self.lock:
+            entry = self.actors.get(actor_hex)
+            if entry is None:
+                conn.push({"op": "actor_update", "actor": actor_hex,
+                           "state": A_DEAD, "address": "",
+                           "reason": "no such actor"})
+                return
+            conn.push(self._actor_update_msg(entry, actor_hex))
+            if entry.state not in (A_DEAD,):
+                entry.subscribers.append(conn)
+
+    def _op_kill_actor(self, conn, msg):
+        actor_hex = msg["actor"]
+        no_restart = msg.get("no_restart", True)
+        with self.lock:
+            entry = self.actors.get(actor_hex)
+            if entry is None:
+                return
+            if no_restart:
+                entry.spec.max_restarts = entry.spec.restart_count
+            w = self.workers.get(entry.worker_hex)
+            if w is not None and w.conn is not None:
+                try:
+                    w.conn.push({"op": "exit"})
+                except Exception:
+                    pass
+            if entry.state == A_PENDING or (w is None and entry.state != A_DEAD):
+                entry.state = A_DEAD
+                entry.death_reason = "killed"
+                self.pending_actors = [
+                    s for s in self.pending_actors
+                    if s.actor_id.hex() != actor_hex
+                ]
+                self._fail_actor_inflight(actor_hex, "killed")
+                self._push_actor_update(entry, actor_hex)
+
+    def _actor_update_msg(self, entry: ActorEntry, actor_hex: str):
+        return {
+            "op": "actor_update",
+            "actor": actor_hex,
+            "state": entry.state,
+            "address": entry.address,
+            "reason": entry.death_reason,
+        }
+
+    def _push_actor_update(self, entry: ActorEntry, actor_hex: str):
+        msg = self._actor_update_msg(entry, actor_hex)
+        subs = list(entry.subscribers)
+        if entry.state == A_DEAD:
+            entry.subscribers = []
+        for c in subs:
+            try:
+                c.push(msg)
+            except Exception:
+                pass
+
+    def _op_get_named_actor(self, conn, msg):
+        key = (msg.get("namespace", ""), msg["name"])
+        with self.lock:
+            actor_hex = self.named_actors.get(key)
+            if actor_hex is None:
+                return None
+            entry = self.actors.get(actor_hex)
+            if entry is None or entry.state == A_DEAD:
+                return None
+            return {"actor": actor_hex, "class_id": entry.spec.class_id,
+                    "state": entry.state, "address": entry.address}
+
+    def _op_list_named_actors(self, conn, msg):
+        with self.lock:
+            out = []
+            for (ns, name), actor_hex in self.named_actors.items():
+                entry = self.actors.get(actor_hex)
+                if entry is not None and entry.state != A_DEAD:
+                    out.append({"name": name, "namespace": ns})
+            return out
+
+    # ------------------------------------------------------------------
+    # State API (reference: util/state — ray list tasks/actors/...)
+    def _op_cluster_resources(self, conn, msg):
+        return self.total_resources.to_dict()
+
+    def _op_available_resources(self, conn, msg):
+        with self.lock:
+            return self.available.to_dict()
+
+    def _op_list_tasks(self, conn, msg):
+        with self.lock:
+            return [
+                {"task_id": h, "name": r.spec.name, "state": r.state,
+                 "worker": r.worker_hex,
+                 "duration_s": (r.finished_at - r.started_at)
+                 if r.finished_at else None}
+                for h, r in self.tasks.items()
+            ]
+
+    def _op_list_actors(self, conn, msg):
+        with self.lock:
+            return [
+                {"actor_id": h, "state": e.state, "name": e.spec.name,
+                 "class": e.spec.class_id.split(":")[0],
+                 "pid": (self.workers.get(e.worker_hex).pid
+                         if e.worker_hex in self.workers else None)}
+                for h, e in self.actors.items()
+            ]
+
+    def _op_list_objects(self, conn, msg):
+        with self.lock:
+            return [
+                {"object_id": h, "state": e.state, "size": e.size,
+                 "refcount": e.refcount, "in_shm": e.in_shm}
+                for h, e in self.objects.items()
+            ]
+
+    def _op_list_workers(self, conn, msg):
+        with self.lock:
+            return [
+                {"worker_id": h, "kind": w.kind, "state": w.state,
+                 "pid": w.pid, "actor": w.actor_hex}
+                for h, w in self.workers.items()
+            ]
+
+    def _op_ping(self, conn, msg):
+        return "pong"
+
+    # ------------------------------------------------------------------
+    # Scheduler (counterpart of ClusterTaskManager::ScheduleAndDispatchTasks)
+    def _schedule_loop(self):
+        while not self._stopped.is_set():
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            if self._stopped.is_set():
+                return
+            try:
+                self._schedule_once()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def _deps_ready(self, spec: TaskSpec) -> bool:
+        for arg in spec.args:
+            if arg.is_ref:
+                entry = self.objects.get(arg.object_hex)
+                if entry is None or entry.state == PENDING:
+                    return False
+        return True
+
+    def _schedule_once(self):
+        with self.lock:
+            # 1. actors first (they need fresh workers)
+            still_pending_actors = []
+            to_spawn = []
+            for spec in self.pending_actors:
+                need = ResourceSet(spec.resources)
+                if need.is_subset_of(self.available):
+                    self.available = self.available.subtract(need)
+                    to_spawn.append((spec, need))
+                else:
+                    still_pending_actors.append(spec)
+            self.pending_actors = still_pending_actors
+
+            # 2. normal tasks to idle pool workers
+            dispatches = []
+            still_pending = []
+            idle = {
+                h: w for h, w in self.workers.items()
+                if w.kind == "pool" and w.state == "idle" and w.conn is not None
+            }
+            n_workers = sum(1 for w in self.workers.values()
+                            if w.kind == "pool" and w.state != "dead")
+            # Workers already starting, per env_key: spawn only the deficit
+            # (resource-feasible demand minus workers already on the way),
+            # mirroring WorkerPool prestart accounting (worker_pool.h:159).
+            starting: Dict[str, int] = {}
+            for w in self.workers.values():
+                if w.kind == "pool" and w.state == "starting":
+                    starting[w.env_key] = starting.get(w.env_key, 0) + 1
+            spawned_pool = 0
+            # Virtual availability: resources that *would* be in use if every
+            # dispatchable-but-workerless task had its worker already.
+            avail_virtual = self.available
+            for spec in self.pending_tasks:
+                if not self._deps_ready(spec):
+                    still_pending.append(spec)
+                    continue
+                need = ResourceSet(spec.resources)
+                if not need.is_subset_of(self.available):
+                    still_pending.append(spec)
+                    continue
+                env_key = self._env_key_for(spec.resources, spec.runtime_env)
+                worker = next(
+                    (w for w in idle.values() if w.env_key == env_key), None)
+                if worker is None:
+                    if need.is_subset_of(avail_virtual):
+                        avail_virtual = avail_virtual.subtract(need)
+                        if starting.get(env_key, 0) > 0:
+                            starting[env_key] -= 1  # one already on the way
+                        elif (n_workers + spawned_pool
+                                < self.config.max_workers_per_node):
+                            self._spawn_worker(env_key=env_key, kind="pool")
+                            spawned_pool += 1
+                    still_pending.append(spec)
+                    continue
+                del idle[worker.worker_hex]
+                self.available = self.available.subtract(need)
+                if need.is_subset_of(avail_virtual):
+                    avail_virtual = avail_virtual.subtract(need)
+                worker.acquired = need
+                worker.state = "busy"
+                worker.current_task = spec.task_id.hex()
+                rec = self.tasks.get(spec.task_id.hex())
+                if rec is not None:
+                    rec.state = "RUNNING"
+                    rec.worker_hex = worker.worker_hex
+                    rec.started_at = time.time()
+                dispatches.append((worker, spec))
+            self.pending_tasks = still_pending
+
+            for spec, need in to_spawn:
+                w = self._spawn_worker(
+                    env_key=self._env_key_for(spec.resources, spec.runtime_env),
+                    kind="actor")
+                w.acquired = need
+                w.actor_hex = spec.actor_id.hex()
+                entry = self.actors.get(spec.actor_id.hex())
+                if entry is not None:
+                    entry.worker_hex = w.worker_hex
+                # queue the creation spec; delivered when the worker registers
+                w.pending_create = spec  # type: ignore[attr-defined]
+
+        for worker, spec in dispatches:
+            try:
+                worker.conn.push({"op": "execute_task", "spec": spec})
+            except Exception:
+                with self.lock:
+                    self._mark_worker_dead(worker, "push failed")
+
+    def _env_key_for(self, resources: Dict[str, float],
+                     runtime_env: Optional[dict]) -> str:
+        tpu = resources.get(TPU, 0) if resources else 0
+        env_part = ""
+        if runtime_env:
+            import hashlib
+            import json
+
+            env_part = hashlib.sha1(
+                json.dumps(runtime_env, sort_keys=True).encode()).hexdigest()[:8]
+        return f"tpu{int(tpu)}-{env_part}"
+
+    # ------------------------------------------------------------------
+    # Worker pool (counterpart of raylet WorkerPool::StartWorkerProcess)
+    def _spawn_worker(self, env_key: str, kind: str) -> WorkerInfo:
+        """Lock held."""
+        worker_id = WorkerID.from_random()
+        w = WorkerInfo(worker_hex=worker_id.hex(), kind=kind, env_key=env_key,
+                       state="starting")
+        self.workers[worker_id.hex()] = w
+
+        env = dict(os.environ)
+        env["RAY_TPU_CONTROL_ADDR"] = self.address
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_SESSION_ID"] = self.session_id
+        env["RAY_TPU_WORKER_KIND"] = kind
+        env["RAY_TPU_ENV_KEY"] = env_key
+        env["RAY_TPU_NAMESPACE"] = self.namespace
+        cmd = [sys.executable, "-m", "ray_tpu.core.worker"]
+        if env_key.startswith("tpu0") or not env_key.startswith("tpu"):
+            # CPU-only worker: never let it grab the TPU runtime, and skip
+            # site initialization — the environment's sitecustomize imports
+            # jax (~1.7 s) into every interpreter, which a CPU pool worker
+            # doesn't need.  Site-packages go back on the path via PYTHONPATH.
+            env["JAX_PLATFORMS"] = "cpu"
+            extra = [p for p in (_site_packages(), env.get("PYTHONPATH"))
+                     if p]
+            if extra:
+                env["PYTHONPATH"] = os.pathsep.join(extra)
+            cmd = [sys.executable, "-S", "-m", "ray_tpu.core.worker"]
+        log_base = os.path.join(self.session_dir, "logs",
+                                f"worker-{worker_id.hex()[:8]}")
+        stdout = open(log_base + ".out", "ab")
+        stderr = open(log_base + ".err", "ab")
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=stdout, stderr=stderr,
+            cwd=os.getcwd(),
+        )
+        w.proc = proc
+        w.pid = proc.pid
+        return w
+
+    def deliver_pending_create(self, w: WorkerInfo):
+        spec = getattr(w, "pending_create", None)
+        if spec is not None and w.conn is not None:
+            w.pending_create = None  # type: ignore[attr-defined]
+            w.conn.push({"op": "create_actor_instance", "spec": spec})
+
+    def _op_worker_online(self, conn, msg):
+        """Worker is fully initialized: mark schedulable, deliver queued
+        actor creation."""
+        worker_hex = conn.meta.get("worker_hex")
+        with self.lock:
+            w = self.workers.get(worker_hex)
+            if w is None:
+                return
+            if w.kind == "pool" and w.state == "starting":
+                w.state = "idle"
+            self.deliver_pending_create(w)
+        self._wake.set()

@@ -1,0 +1,185 @@
+"""Shared-memory object store (plasma counterpart) + in-process memory store.
+
+Reference counterparts:
+  - plasma store embedded in the raylet (src/ray/object_manager/plasma/):
+    here a directory of mmap-able segment files under /dev/shm, one per
+    object, creatable by any worker process on the node and attachable
+    zero-copy by any other.
+  - CoreWorkerMemoryStore
+    (src/ray/core_worker/store_provider/memory_store/memory_store.h): the
+    in-process table of small/inline objects and pending futures.
+
+TPU-native notes: segments are page-aligned flat buffers, so a deserialized
+numpy array aliases shm and can be fed to jax.device_put without an extra
+host copy (dlpack-style zero copy is the round-2 fast path).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+from concurrent.futures import Future
+from typing import Any, Dict, Optional
+
+from ray_tpu.core.ids import ObjectID
+
+
+class ShmSegment:
+    """One mmap'ed object segment; read or write view over a /dev/shm file."""
+
+    __slots__ = ("name", "path", "size", "_mm", "_file", "writable")
+
+    def __init__(self, name: str, path: str, size: int, mm, file, writable: bool):
+        self.name = name
+        self.path = path
+        self.size = size
+        self._mm = mm
+        self._file = file
+        self.writable = writable
+
+    @property
+    def buf(self) -> memoryview:
+        return memoryview(self._mm)
+
+    def close(self):
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass  # exported views still alive; mmap closes on GC
+        try:
+            self._file.close()
+        except Exception:
+            pass
+
+
+class ShmObjectStore:
+    """Node-local store of shared-memory segments, one file per object.
+
+    The store itself is just a naming convention: segment files live at
+    ``{shm_dir}/{prefix}-{object_hex}``; creation happens in whichever
+    process produced the value, attachment in whichever consumes it.  The
+    object *directory* (who has what, sizes, inline values) lives in the
+    control store — this class only manages local segments.
+    """
+
+    def __init__(self, session_id: str, shm_dir: str = "/dev/shm"):
+        self.session_id = session_id
+        self.shm_dir = shm_dir
+        self._prefix = f"raytpu-{session_id}"
+        self._lock = threading.Lock()
+        self._open: Dict[str, ShmSegment] = {}
+
+    def _path(self, object_id: ObjectID) -> str:
+        return os.path.join(self.shm_dir, f"{self._prefix}-{object_id.hex()}")
+
+    def create(self, object_id: ObjectID, size: int) -> ShmSegment:
+        path = self._path(object_id)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
+        try:
+            os.ftruncate(fd, max(size, 1))
+            f = os.fdopen(fd, "r+b")
+        except Exception:
+            os.close(fd)
+            os.unlink(path)
+            raise
+        mm = mmap.mmap(f.fileno(), max(size, 1))
+        seg = ShmSegment(object_id.hex(), path, size, mm, f, writable=True)
+        with self._lock:
+            self._open[object_id.hex()] = seg
+        return seg
+
+    def attach(self, object_id: ObjectID, size: int) -> ShmSegment:
+        key = object_id.hex()
+        with self._lock:
+            seg = self._open.get(key)
+            if seg is not None:
+                return seg
+        path = self._path(object_id)
+        f = open(path, "rb")
+        mm = mmap.mmap(f.fileno(), max(size, 1), prot=mmap.PROT_READ)
+        seg = ShmSegment(key, path, size, mm, f, writable=False)
+        with self._lock:
+            self._open.setdefault(key, seg)
+        return seg
+
+    def contains(self, object_id: ObjectID) -> bool:
+        return os.path.exists(self._path(object_id))
+
+    def release(self, object_id: ObjectID):
+        """Close the local mapping (does not delete the file)."""
+        with self._lock:
+            seg = self._open.pop(object_id.hex(), None)
+        if seg is not None:
+            seg.close()
+
+    def delete(self, object_id: ObjectID):
+        self.release(object_id)
+        try:
+            os.unlink(self._path(object_id))
+        except FileNotFoundError:
+            pass
+
+    def cleanup(self):
+        with self._lock:
+            segs = list(self._open.values())
+            self._open.clear()
+        for seg in segs:
+            seg.close()
+        # best-effort sweep of this session's files
+        try:
+            for name in os.listdir(self.shm_dir):
+                if name.startswith(self._prefix):
+                    try:
+                        os.unlink(os.path.join(self.shm_dir, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass
+
+
+class InProcessStore:
+    """Per-process table of resolved values and pending futures."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values: Dict[ObjectID, Any] = {}
+        self._futures: Dict[ObjectID, list] = {}
+
+    def put(self, object_id: ObjectID, value: Any):
+        with self._lock:
+            self._values[object_id] = value
+            waiters = self._futures.pop(object_id, [])
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(value)
+
+    def get_future(self, object_id: ObjectID) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if object_id in self._values:
+                fut.set_result(self._values[object_id])
+                return fut
+            self._futures.setdefault(object_id, []).append(fut)
+        return fut
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._values
+
+    def peek(self, object_id: ObjectID):
+        with self._lock:
+            return self._values.get(object_id)
+
+    def pop(self, object_id: ObjectID):
+        with self._lock:
+            self._values.pop(object_id, None)
+            self._futures.pop(object_id, None)
+
+    def fail(self, object_id: ObjectID, exc: BaseException):
+        with self._lock:
+            self._values[object_id] = exc
+            waiters = self._futures.pop(object_id, [])
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(exc)

@@ -1,0 +1,90 @@
+"""@remote task API (counterpart of python/ray/remote_function.py)."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu.core.runtime import func_content_id, get_runtime
+
+
+class RemoteFunction:
+    def __init__(self, fn, *, num_returns: int = 1,
+                 num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[Dict[str, float]] = None,
+                 max_retries: int = 3,
+                 runtime_env: Optional[dict] = None,
+                 scheduling_strategy=None,
+                 name: str = ""):
+        self._fn = fn
+        self._num_returns = num_returns
+        self._num_cpus = 1.0 if num_cpus is None else num_cpus
+        self._num_tpus = num_tpus or 0.0
+        self._resources = dict(resources or {})
+        self._max_retries = max_retries
+        self._runtime_env = runtime_env
+        self._scheduling_strategy = scheduling_strategy
+        self._name = name or getattr(fn, "__qualname__", "anonymous_task")
+        self._blob: Optional[bytes] = None
+        self._func_id: Optional[str] = None
+        functools.update_wrapper(self, fn)
+
+    def _resource_demand(self) -> Dict[str, float]:
+        demand = dict(self._resources)
+        if self._num_cpus:
+            demand["CPU"] = self._num_cpus
+        if self._num_tpus:
+            demand["TPU"] = self._num_tpus
+        return demand
+
+    def _ensure_blob(self):
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._fn)
+            self._func_id = func_content_id(self._blob)
+        return self._func_id, self._blob
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function {self._name!r} cannot be called directly; "
+            f"use {self._name}.remote(...)")
+
+    def remote(self, *args, **kwargs):
+        from ray_tpu.core.task_spec import KwargsMarker
+
+        func_id, blob = self._ensure_blob()
+        call_args = list(args)
+        if kwargs:
+            call_args.append(KwargsMarker(kwargs))
+        refs = get_runtime().submit_task(
+            func_id, blob, call_args,
+            num_returns=self._num_returns,
+            resources=self._resource_demand(),
+            max_retries=self._max_retries,
+            name=self._name,
+            runtime_env=self._runtime_env,
+            scheduling_strategy=self._scheduling_strategy,
+        )
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def options(self, **overrides):
+        """Return a copy with overridden submit options."""
+        opts = {
+            "num_returns": self._num_returns,
+            "num_cpus": self._num_cpus,
+            "num_tpus": self._num_tpus,
+            "resources": self._resources,
+            "max_retries": self._max_retries,
+            "runtime_env": self._runtime_env,
+            "scheduling_strategy": self._scheduling_strategy,
+            "name": self._name,
+        }
+        opts.update(overrides)
+        clone = RemoteFunction(self._fn, **opts)
+        clone._blob = self._blob
+        clone._func_id = self._func_id
+        return clone

@@ -1,0 +1,298 @@
+"""Minimal TCP RPC: length-prefixed pickled messages, threaded server.
+
+Counterpart of the reference's gRPC substrate (src/ray/rpc/).  grpcio is not
+available in this environment, so the control plane speaks a tiny framed
+protocol over TCP sockets:
+
+    [1-byte kind][8-byte request id][4-byte len][pickle payload]
+
+kind: 0 = request (expects response), 1 = response, 2 = one-way.
+
+Server: thread per connection, handler invoked per message; handler may
+return a value (sent back as response) or None for one-way messages.
+Clients are thread-safe; concurrent calls are matched by request id.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Optional
+
+_FRAME = struct.Struct("<BQI")
+
+KIND_REQUEST = 0
+KIND_RESPONSE = 1
+KIND_ONEWAY = 2
+
+
+class RpcError(ConnectionError):
+    pass
+
+
+class _RemoteTraceback(Exception):
+    pass
+
+
+def _send_frame(sock: socket.socket, kind: int, req_id: int, payload: bytes):
+    header = _FRAME.pack(kind, req_id, len(payload))
+    sock.sendall(header + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 4 << 20))
+        if not chunk:
+            raise RpcError("connection closed")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket):
+    header = _recv_exact(sock, _FRAME.size)
+    kind, req_id, length = _FRAME.unpack(header)
+    payload = _recv_exact(sock, length) if length else b""
+    return kind, req_id, payload
+
+
+class Connection:
+    """Server-side handle to a connected peer; supports pushing messages."""
+
+    def __init__(self, sock: socket.socket, addr):
+        self.sock = sock
+        self.addr = addr
+        self.send_lock = threading.Lock()
+        self.meta: dict = {}
+        self.alive = True
+
+    def push(self, msg: Any):
+        """One-way server→client message."""
+        payload = pickle.dumps(msg, protocol=5)
+        with self.send_lock:
+            _send_frame(self.sock, KIND_ONEWAY, 0, payload)
+
+    def respond(self, req_id: int, msg: Any):
+        payload = pickle.dumps(msg, protocol=5)
+        with self.send_lock:
+            _send_frame(self.sock, KIND_RESPONSE, req_id, payload)
+
+    def close(self):
+        self.alive = False
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class Server:
+    """Threaded RPC server.
+
+    handler(conn, msg) -> response | None. Called on a per-connection thread;
+    long handlers should offload.  on_disconnect(conn) fires when a peer
+    drops — the raylet's worker-death detection hook.
+    """
+
+    def __init__(
+        self,
+        handler: Callable[[Connection, Any], Any],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        on_disconnect: Optional[Callable[[Connection], None]] = None,
+    ):
+        self._handler = handler
+        self._on_disconnect = on_disconnect
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(512)
+        self.host, self.port = self._sock.getsockname()
+        self._stopped = threading.Event()
+        self._conns: list[Connection] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="rpc-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self):
+        while not self._stopped.is_set():
+            try:
+                sock, addr = self._sock.accept()
+            except OSError:
+                return
+            if self._stopped.is_set():
+                # Raced with stop(): this fd may already belong to a NEW
+                # server (the kernel reuses fds); do not serve it here.
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = Connection(sock, addr)
+            self._conns.append(conn)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), name="rpc-conn", daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: Connection):
+        try:
+            while not self._stopped.is_set():
+                kind, req_id, payload = _recv_frame(conn.sock)
+                msg = pickle.loads(payload)
+                if kind == KIND_REQUEST:
+                    try:
+                        result = self._handler(conn, msg)
+                        conn.respond(req_id, ("ok", result))
+                    except Exception as e:  # noqa: BLE001
+                        conn.respond(req_id, ("err", e))
+                else:
+                    try:
+                        self._handler(conn, msg)
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+        except (RpcError, OSError, EOFError):
+            pass
+        finally:
+            conn.alive = False
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+            if self._on_disconnect is not None and not self._stopped.is_set():
+                try:
+                    self._on_disconnect(conn)
+                except Exception:
+                    pass
+
+    def stop(self):
+        self._stopped.set()
+        # shutdown() (not just close()) wakes the blocking accept(); a bare
+        # close() leaves the accept thread alive, and once the kernel reuses
+        # the fd that stale thread would steal another server's connections.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._accept_thread.join(timeout=2.0)
+        for conn in self._conns:
+            conn.close()
+
+
+class Client:
+    """Thread-safe RPC client with request/response matching and push inbox."""
+
+    def __init__(
+        self,
+        address: str,
+        on_push: Optional[Callable[[Any], None]] = None,
+        connect_timeout: float = 10.0,
+    ):
+        host, port = address.rsplit(":", 1)
+        deadline = time.monotonic() + connect_timeout
+        last_err: Exception | None = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, int(port)), timeout=5.0)
+                break
+            except OSError as e:
+                last_err = e
+                if time.monotonic() >= deadline:
+                    raise RpcError(f"cannot connect to {address}: {e}") from e
+                time.sleep(0.05)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.address = address
+        self._on_push = on_push
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, threading.Event] = {}
+        self._results: dict[int, Any] = {}
+        self._next_id = 1
+        self._id_lock = threading.Lock()
+        self._closed = False
+        self._recv_thread = threading.Thread(
+            target=self._recv_loop, name="rpc-client-recv", daemon=True
+        )
+        self._recv_thread.start()
+
+    def _recv_loop(self):
+        try:
+            while True:
+                kind, req_id, payload = _recv_frame(self._sock)
+                msg = pickle.loads(payload)
+                if kind == KIND_RESPONSE:
+                    ev = self._pending.get(req_id)
+                    if ev is not None:
+                        self._results[req_id] = msg
+                        ev.set()
+                elif kind == KIND_ONEWAY and self._on_push is not None:
+                    try:
+                        self._on_push(msg)
+                    except Exception:
+                        import traceback
+
+                        traceback.print_exc()
+        except (RpcError, OSError, EOFError):
+            self._closed = True
+            err = ("err", RpcError(f"connection to {self.address} lost"))
+            for req_id, ev in list(self._pending.items()):
+                self._results[req_id] = err
+                ev.set()
+
+    def call(self, msg: Any, timeout: Optional[float] = None) -> Any:
+        if self._closed:
+            raise RpcError(f"connection to {self.address} closed")
+        with self._id_lock:
+            req_id = self._next_id
+            self._next_id += 1
+        ev = threading.Event()
+        self._pending[req_id] = ev
+        payload = pickle.dumps(msg, protocol=5)
+        with self._send_lock:
+            _send_frame(self._sock, KIND_REQUEST, req_id, payload)
+        if not ev.wait(timeout):
+            self._pending.pop(req_id, None)
+            raise TimeoutError(f"rpc call timed out after {timeout}s")
+        self._pending.pop(req_id, None)
+        status, result = self._results.pop(req_id)
+        if status == "err":
+            raise result
+        return result
+
+    def send(self, msg: Any):
+        """One-way message."""
+        if self._closed:
+            raise RpcError(f"connection to {self.address} closed")
+        payload = pickle.dumps(msg, protocol=5)
+        with self._send_lock:
+            _send_frame(self._sock, KIND_ONEWAY, 0, payload)
+
+    def close(self):
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,426 @@
+"""Per-process core runtime: the counterpart of the reference's CoreWorker.
+
+Every participating process (driver or worker) holds a CoreClient that talks
+to the control server (gcs.py): object subscription/resolution, task and
+actor submission, reference counting, and the shared-memory store attachment.
+Reference call-stack parity: CoreWorker::SubmitTask / Put / Get
+(src/ray/core_worker/core_worker.cc:2166/:1241/:1552) and the direct actor
+transport (transport/direct_actor_task_submitter.cc — per-handle ordered
+submission over a dedicated connection).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence
+
+import cloudpickle
+
+from ray_tpu.core import rpc, serialization
+from ray_tpu.core.config import Config, get_config
+from ray_tpu.core.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskError,
+)
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.object_store import ShmObjectStore
+from ray_tpu.core.task_spec import ActorCreationSpec, TaskArg, TaskSpec
+
+_global_runtime = None
+_runtime_lock = threading.Lock()
+
+
+def get_runtime():
+    if _global_runtime is None:
+        raise RuntimeError(
+            "ray_tpu not initialized; call ray_tpu.init() first")
+    return _global_runtime
+
+
+def set_runtime(rt):
+    global _global_runtime
+    with _runtime_lock:
+        _global_runtime = rt
+
+
+class CoreClient:
+    """Client-side core: object futures, submission, refcounting."""
+
+    def __init__(self, control_addr: str, worker_hex: str, kind: str,
+                 address: str = "", env_key: str = "",
+                 config: Optional[Config] = None):
+        self.worker_hex = worker_hex
+        self.kind = kind
+        self.config = config or get_config()
+        # Hooks must exist before the rpc recv thread can deliver pushes.
+        self.on_execute_task = None
+        self.on_create_actor = None
+        self.on_exit = None
+        self.client = rpc.Client(control_addr, on_push=self._on_push)
+        reply = self.client.call({
+            "op": "register",
+            "worker_hex": worker_hex,
+            "pid": os.getpid(),
+            "kind": kind,
+            "address": address,
+            "env_key": env_key,
+        })
+        self.session_id = reply["session_id"]
+        self.session_dir = reply["session_dir"]
+        self.store = ShmObjectStore(self.session_id, reply["shm_dir"])
+
+        self._lock = threading.Lock()
+        self._object_futures: Dict[str, Future] = {}
+        self._subscribed: set[str] = set()
+        # actor state tracking
+        self._actor_state: Dict[str, dict] = {}
+        self._actor_cv = threading.Condition()
+        self._actor_conns: Dict[str, rpc.Client] = {}
+        self._actor_queues: Dict[str, List[TaskSpec]] = {}
+        self._sent_funcs: set[str] = set()
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    def _on_push(self, msg: dict):
+        op = msg.get("op")
+        if op == "object_ready":
+            with self._lock:
+                fut = self._object_futures.get(msg["obj"])
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+        elif op == "actor_update":
+            self._handle_actor_update(msg)
+        elif op == "execute_task" and self.on_execute_task is not None:
+            self.on_execute_task(msg["spec"])
+        elif op == "create_actor_instance" and self.on_create_actor is not None:
+            self.on_create_actor(msg["spec"])
+        elif op == "exit" and self.on_exit is not None:
+            self.on_exit()
+
+    def _handle_actor_update(self, msg: dict):
+        actor_hex = msg["actor"]
+        with self._actor_cv:
+            self._actor_state[actor_hex] = msg
+            self._actor_cv.notify_all()
+        if msg["state"] == "ALIVE":
+            self._flush_actor_queue(actor_hex, msg["address"])
+        elif msg["state"] == "DEAD":
+            self._fail_actor_queue(actor_hex, msg.get("reason", ""))
+
+    # ------------------------------------------------------------------
+    # Objects
+    def object_future(self, obj_hex: str) -> Future:
+        with self._lock:
+            fut = self._object_futures.get(obj_hex)
+            if fut is None:
+                fut = Future()
+                self._object_futures[obj_hex] = fut
+            if obj_hex not in self._subscribed:
+                self._subscribed.add(obj_hex)
+                self.client.send({"op": "subscribe_object", "obj": obj_hex})
+        return fut
+
+    def _load_object(self, obj_hex: str, info: dict) -> Any:
+        if info.get("inline") is not None:
+            data = info["inline"]
+        elif info.get("in_shm"):
+            seg = self.store.attach(ObjectID.from_hex(obj_hex), info["size"])
+            data = seg.buf[: info["size"]]
+        else:
+            raise RuntimeError(f"object {obj_hex} ready but has no payload")
+        value = serialization.deserialize(data, ref_deserializer=self._on_ref_deser)
+        if info.get("is_error"):
+            raise value
+        return value
+
+    def _on_ref_deser(self, ref: ObjectRef):
+        # A ref arrived inside a deserialized value: register a borrow so the
+        # owner keeps the object alive while this process holds the ref
+        # (reference borrowing protocol, reference_count.h).
+        try:
+            self.client.send({"op": "incref", "obj": ref.hex()})
+        except Exception:
+            pass
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None):
+        futs = [self.object_future(r.hex()) for r in refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        results = []
+        for r, fut in zip(refs, futs):
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                raise GetTimeoutError(f"get() timed out on {r}")
+            try:
+                info = fut.result(timeout=remaining)
+            except TimeoutError:
+                raise GetTimeoutError(f"get() timed out on {r}") from None
+            results.append(self._load_object(r.hex(), info))
+        return results
+
+    def put(self, value: Any) -> ObjectRef:
+        oid = ObjectID.from_random()
+        self._store_value(oid, value)
+        return ObjectRef(oid, owner=self.worker_hex)
+
+    def _store_value(self, oid: ObjectID, value: Any, is_error: bool = False):
+        ser = serialization.serialize(value)
+        size = ser.total_bytes
+        if size <= self.config.max_inline_object_size:
+            self.client.send({
+                "op": "put_object", "obj": oid.hex(), "size": size,
+                "inline": ser.to_bytes(), "is_error": is_error,
+            })
+        else:
+            seg = self.store.create(oid, size)
+            ser.write_into(seg.buf[:size])
+            self.client.send({
+                "op": "put_object", "obj": oid.hex(), "size": size,
+                "inline": None, "in_shm": True, "is_error": is_error,
+            })
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        futs = {r: self.object_future(r.hex()) for r in refs}
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: List[ObjectRef] = []
+        import concurrent.futures as cf
+
+        pending = dict(futs)
+        while len(ready) < num_returns and pending:
+            remaining = None if deadline is None else max(
+                0.0, deadline - time.monotonic())
+            done, _ = cf.wait(
+                list(pending.values()), timeout=remaining,
+                return_when=cf.FIRST_COMPLETED)
+            if not done:
+                break
+            for r in list(pending):
+                if pending[r].done():
+                    ready.append(r)
+                    del pending[r]
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+        ready = ready[:num_returns]
+        ready_set = set(ready)
+        not_ready = [r for r in refs if r not in ready_set]
+        return ready, not_ready
+
+    def on_ref_deleted(self, object_id: ObjectID):
+        if self._closed:
+            return
+        try:
+            self.client.send({"op": "decref", "obj": object_id.hex()})
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # Task submission
+    def _prepare_args(self, args: Sequence[Any], borrows: List[str]):
+        out: List[TaskArg] = []
+        for a in args:
+            if isinstance(a, ObjectRef):
+                borrows.append(a.hex())
+                self.client.send({"op": "incref", "obj": a.hex()})
+                out.append(TaskArg(is_ref=True, object_hex=a.hex()))
+            else:
+                ser = serialization.serialize(a)
+                for hex_id in ser.contained_refs:
+                    borrows.append(hex_id)
+                    self.client.send({"op": "incref", "obj": hex_id})
+                if ser.total_bytes > self.config.max_inline_object_size:
+                    ref = self.put(a)
+                    borrows.append(ref.hex())
+                    self.client.send({"op": "incref", "obj": ref.hex()})
+                    out.append(TaskArg(is_ref=True, object_hex=ref.hex()))
+                else:
+                    out.append(TaskArg(is_ref=False, data=ser.to_bytes()))
+        return out
+
+    def ensure_func(self, func_id: str, blob: bytes) -> Optional[bytes]:
+        """Upload the function blob once per session; return None if cached."""
+        if func_id in self._sent_funcs:
+            return None
+        self.client.send({"op": "put_func", "func_id": func_id, "blob": blob})
+        self._sent_funcs.add(func_id)
+        return None
+
+    def fetch_func(self, func_id: str) -> Optional[bytes]:
+        return self.client.call({"op": "get_func", "func_id": func_id})
+
+    def submit_task(self, func_id: str, func_blob: bytes, args: Sequence[Any],
+                    num_returns: int, resources: Dict[str, float],
+                    max_retries: int, name: str = "",
+                    runtime_env: Optional[dict] = None,
+                    scheduling_strategy=None) -> List[ObjectRef]:
+        borrows: List[str] = []
+        task_args = self._prepare_args(args, borrows)
+        self.ensure_func(func_id, func_blob)
+        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            func_id=func_id,
+            func_blob=None,
+            args=task_args,
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources=resources,
+            max_retries=max_retries,
+            name=name,
+            owner=self.worker_hex,
+            runtime_env=runtime_env,
+            scheduling_strategy=scheduling_strategy,
+            borrows=borrows,
+        )
+        self.client.send({"op": "submit_task", "spec": spec})
+        return [ObjectRef(oid, owner=self.worker_hex) for oid in return_ids]
+
+    # ------------------------------------------------------------------
+    # Actors
+    def create_actor(self, class_id: str, class_blob: bytes,
+                     args: Sequence[Any], resources: Dict[str, float],
+                     max_restarts: int, name: str, namespace: str,
+                     max_concurrency: int,
+                     runtime_env: Optional[dict] = None) -> ActorID:
+        borrows: List[str] = []
+        task_args = self._prepare_args(args, borrows)
+        self.ensure_func(class_id, class_blob)
+        actor_id = ActorID.from_random()
+        spec = ActorCreationSpec(
+            actor_id=actor_id,
+            class_id=class_id,
+            class_blob=None,
+            args=task_args,
+            resources=resources,
+            max_restarts=max_restarts,
+            name=name,
+            namespace=namespace,
+            max_concurrency=max_concurrency,
+            owner=self.worker_hex,
+            runtime_env=runtime_env,
+        )
+        self.client.send({"op": "create_actor", "spec": spec})
+        self.client.send({"op": "subscribe_actor", "actor": actor_id.hex()})
+        with self._actor_cv:
+            self._actor_queues.setdefault(actor_id.hex(), [])
+        return actor_id
+
+    def subscribe_actor(self, actor_hex: str):
+        with self._actor_cv:
+            if actor_hex not in self._actor_state:
+                self.client.send({"op": "subscribe_actor", "actor": actor_hex})
+                self._actor_queues.setdefault(actor_hex, [])
+
+    def actor_state(self, actor_hex: str) -> Optional[dict]:
+        with self._actor_cv:
+            return self._actor_state.get(actor_hex)
+
+    def wait_actor_alive(self, actor_hex: str, timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._actor_cv:
+            while True:
+                st = self._actor_state.get(actor_hex)
+                if st is not None and st["state"] in ("ALIVE", "DEAD"):
+                    return st
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(f"actor {actor_hex} not alive in time")
+                self._actor_cv.wait(timeout=remaining)
+
+    def submit_actor_task(self, actor_hex: str, method_name: str,
+                          args: Sequence[Any], num_returns: int,
+                          name: str = "") -> List[ObjectRef]:
+        borrows: List[str] = []
+        task_args = self._prepare_args(args, borrows)
+        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        self.client.send({
+            "op": "register_objects",
+            "objs": [oid.hex() for oid in return_ids],
+            "actor": actor_hex,
+        })
+        spec = TaskSpec(
+            task_id=TaskID.from_random(),
+            func_id="", func_blob=None,
+            args=task_args,
+            num_returns=num_returns,
+            return_ids=return_ids,
+            resources={},
+            owner=self.worker_hex,
+            actor_id=ActorID.from_hex(actor_hex),
+            method_name=method_name,
+            name=name or method_name,
+            borrows=borrows,
+        )
+        self._route_actor_task(actor_hex, spec)
+        return [ObjectRef(oid, owner=self.worker_hex) for oid in return_ids]
+
+    def _route_actor_task(self, actor_hex: str, spec: TaskSpec):
+        with self._actor_cv:
+            st = self._actor_state.get(actor_hex)
+            if st is None or st["state"] in ("PENDING_CREATION", "RESTARTING"):
+                self._actor_queues.setdefault(actor_hex, []).append(spec)
+                if st is None:
+                    self.client.send(
+                        {"op": "subscribe_actor", "actor": actor_hex})
+                return
+            if st["state"] == "DEAD":
+                self._fail_actor_task(spec, st.get("reason", "actor dead"))
+                return
+            address = st["address"]
+        self._send_actor_task(actor_hex, address, spec)
+
+    def _actor_conn(self, address: str) -> rpc.Client:
+        with self._lock:
+            conn = self._actor_conns.get(address)
+            if conn is None:
+                conn = rpc.Client(address)
+                self._actor_conns[address] = conn
+            return conn
+
+    def _send_actor_task(self, actor_hex: str, address: str, spec: TaskSpec):
+        try:
+            self._actor_conn(address).send({"op": "actor_task", "spec": spec})
+        except Exception as e:  # connection refused: actor just died
+            self._fail_actor_task(spec, f"cannot reach actor: {e}")
+
+    def _flush_actor_queue(self, actor_hex: str, address: str):
+        with self._actor_cv:
+            queue = self._actor_queues.get(actor_hex, [])
+            self._actor_queues[actor_hex] = []
+        for spec in queue:
+            self._send_actor_task(actor_hex, address, spec)
+
+    def _fail_actor_queue(self, actor_hex: str, reason: str):
+        with self._actor_cv:
+            queue = self._actor_queues.pop(actor_hex, [])
+        for spec in queue:
+            self._fail_actor_task(spec, reason)
+
+    def _fail_actor_task(self, spec: TaskSpec, reason: str):
+        err = ActorDiedError(spec.actor_id, reason)
+        for oid in spec.return_ids:
+            self._store_value(oid, err, is_error=True)
+
+    def kill_actor(self, actor_hex: str, no_restart: bool = True):
+        self.client.send({"op": "kill_actor", "actor": actor_hex,
+                          "no_restart": no_restart})
+
+    def get_named_actor(self, name: str, namespace: str = "") -> Optional[dict]:
+        return self.client.call({"op": "get_named_actor", "name": name,
+                                 "namespace": namespace})
+
+    # ------------------------------------------------------------------
+    def close(self):
+        self._closed = True
+        for conn in self._actor_conns.values():
+            conn.close()
+        self.client.close()
+
+
+def func_content_id(blob: bytes) -> str:
+    return hashlib.sha1(blob).hexdigest()

@@ -1,0 +1,135 @@
+"""Serialization: cloudpickle envelope with zero-copy out-of-band buffers.
+
+Capability counterpart of the reference's SerializationContext
+(python/ray/_private/serialization.py): cloudpickle for arbitrary Python,
+pickle protocol-5 out-of-band buffers so numpy / jax host arrays are written
+into the shared-memory object store without an extra copy, and ObjectRef
+capture hooks so refs nested inside values keep their identity (the borrowing
+protocol hook point).
+
+Wire layout of a serialized object:
+
+    [8-byte header length][msgpack header][payload][buf0][buf1]...
+
+header = {"pkl_len": int, "bufs": [int, ...], "refs": [hex, ...]}
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, Callable
+
+import cloudpickle
+import msgpack
+
+_HEADER_FMT = "<Q"
+_HEADER_LEN = struct.calcsize(_HEADER_FMT)
+
+
+class SerializedObject:
+    """A serialized value plus its out-of-band buffers (not yet concatenated)."""
+
+    __slots__ = ("header_bytes", "payload", "buffers", "contained_refs")
+
+    def __init__(self, header_bytes: bytes, payload: bytes, buffers, contained_refs):
+        self.header_bytes = header_bytes
+        self.payload = payload
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+
+    @property
+    def total_bytes(self) -> int:
+        return (
+            _HEADER_LEN
+            + len(self.header_bytes)
+            + len(self.payload)
+            + sum(len(b) for b in self.buffers)
+        )
+
+    def write_into(self, view: memoryview) -> None:
+        """Copy the object into a contiguous writable buffer (e.g. shm)."""
+        off = 0
+        view[off:off + _HEADER_LEN] = struct.pack(_HEADER_FMT, len(self.header_bytes))
+        off += _HEADER_LEN
+        view[off:off + len(self.header_bytes)] = self.header_bytes
+        off += len(self.header_bytes)
+        view[off:off + len(self.payload)] = self.payload
+        off += len(self.payload)
+        for b in self.buffers:
+            n = len(b)
+            view[off:off + n] = b.cast("B") if isinstance(b, memoryview) else memoryview(b).cast("B")
+            off += n
+
+    def to_bytes(self) -> bytes:
+        out = bytearray(self.total_bytes)
+        self.write_into(memoryview(out))
+        return bytes(out)
+
+
+def serialize(value: Any, ref_serializer: Callable | None = None) -> SerializedObject:
+    """Serialize ``value``.
+
+    ref_serializer(obj) -> hex string is invoked for every ObjectRef found
+    inside the value so the owner can track borrowed references.
+    """
+    buffers: list[memoryview] = []
+
+    def buffer_callback(buf):
+        buffers.append(buf.raw())
+        return False  # out-of-band
+
+    # ObjectRef.__reduce__ appends every ref pickled inside ``value`` to the
+    # thread-local capture list, so nested refs keep identity and the owner
+    # can track borrows (the reference's out-of-band ObjectRef capture,
+    # python/ray/_private/serialization.py).
+    contained: list[str] = []
+    from ray_tpu.core import object_ref as _orf
+
+    token = _orf._push_capture_list(contained)
+    try:
+        payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffer_callback)
+    finally:
+        _orf._pop_capture_list(token)
+
+    header = msgpack.packb(
+        {
+            "pkl_len": len(payload),
+            "bufs": [len(b) for b in buffers],
+            "refs": contained,
+        }
+    )
+    return SerializedObject(header, payload, buffers, contained)
+
+
+def deserialize(data, ref_deserializer: Callable | None = None) -> Any:
+    """Deserialize from a contiguous buffer (bytes or memoryview).
+
+    Buffers are reconstructed zero-copy as memoryviews into ``data`` — numpy
+    arrays deserialized from shm alias the store segment until copied.
+    """
+    view = memoryview(data)
+    (hlen,) = struct.unpack(_HEADER_FMT, view[:_HEADER_LEN])
+    off = _HEADER_LEN
+    header = msgpack.unpackb(view[off:off + hlen])
+    off += hlen
+    payload = view[off:off + header["pkl_len"]]
+    off += header["pkl_len"]
+    bufs = []
+    for blen in header["bufs"]:
+        bufs.append(pickle.PickleBuffer(view[off:off + blen]))
+        off += blen
+    from ray_tpu.core import object_ref as _orf
+
+    token = _orf._push_ref_resolver(ref_deserializer)
+    try:
+        return pickle.loads(payload, buffers=bufs)
+    finally:
+        _orf._pop_ref_resolver(token)
+
+
+def contained_refs(data) -> list[str]:
+    view = memoryview(data)
+    (hlen,) = struct.unpack(_HEADER_FMT, view[:_HEADER_LEN])
+    header = msgpack.unpackb(view[_HEADER_LEN:_HEADER_LEN + hlen])
+    return header.get("refs", [])

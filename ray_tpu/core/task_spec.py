@@ -1,0 +1,85 @@
+"""Task / actor specifications shipped over the control plane.
+
+Counterpart of the reference's TaskSpecification (src/ray/common/task/) and
+the proto TaskSpec (src/ray/protobuf/common.proto): a compact picklable
+record carrying identity, payload (function blob or cached function id),
+arguments, resource demand and retry policy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
+
+
+@dataclass
+class TaskArg:
+    """One task argument: either an inline serialized value or an ObjectRef."""
+
+    is_ref: bool
+    # for refs:
+    object_hex: str = ""
+    # for inline values: raw serialized bytes (serialization.py layout)
+    data: bytes = b""
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    func_id: str  # content hash of the function blob, for worker-side caching
+    func_blob: Optional[bytes]  # cloudpickled callable; None if cached
+    args: List[TaskArg]
+    num_returns: int
+    return_ids: List[ObjectID]
+    resources: Dict[str, float]
+    max_retries: int = 0
+    retry_count: int = 0
+    name: str = ""
+    owner: str = ""  # worker hex that submitted
+    # actor fields
+    actor_id: Optional[ActorID] = None
+    method_name: str = ""
+    seq_no: int = -1
+    # placement
+    placement_group_hex: str = ""
+    bundle_index: int = -1
+    scheduling_strategy: Optional[Any] = None
+    runtime_env: Optional[Dict[str, Any]] = None
+    # object hexes this task holds a reference on until it completes
+    # (top-level ref args + refs captured inside inline args); the executor
+    # decrefs them after the task finishes.
+    borrows: List[str] = field(default_factory=list)
+
+
+class KwargsMarker:
+    """Sentinel wrapper: kwargs dict shipped as the final positional arg.
+
+    Lives here (not worker.py) because worker.py runs as ``__main__`` in
+    worker processes — defining it there would create two distinct classes
+    and break isinstance checks on deserialized markers.
+    """
+
+    __slots__ = ("kwargs",)
+
+    def __init__(self, kwargs: dict):
+        self.kwargs = kwargs
+
+
+@dataclass
+class ActorCreationSpec:
+    actor_id: ActorID
+    class_id: str
+    class_blob: Optional[bytes]
+    args: List[TaskArg]
+    resources: Dict[str, float]
+    max_restarts: int = 0
+    name: str = ""
+    namespace: str = ""
+    max_concurrency: int = 1
+    owner: str = ""
+    placement_group_hex: str = ""
+    bundle_index: int = -1
+    runtime_env: Optional[Dict[str, Any]] = None
+    restart_count: int = 0

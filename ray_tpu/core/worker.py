@@ -1,0 +1,309 @@
+"""Worker process: task executor + actor host.
+
+Counterpart of the reference's default_worker.py + the executor half of
+CoreWorker (ExecuteTask, core_worker.cc:2906) and the executor-side actor
+scheduling queues (transport/actor_scheduling_queue.cc).  Each worker runs:
+
+  - a CoreClient connected to the control server (receives execute_task /
+    create_actor_instance pushes),
+  - its own rpc.Server so callers submit actor tasks DIRECTLY to this
+    process (the reference's peer-to-peer actor transport — GCS is not on
+    the actor hot path),
+  - an executor: single-slot for pool tasks, FIFO queue (or thread pool for
+    max_concurrency > 1) for actor methods.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import sys
+import threading
+import traceback
+from typing import Any, List, Optional
+
+import cloudpickle
+
+from ray_tpu.core import rpc, serialization
+from ray_tpu.core.exceptions import TaskError
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.runtime import CoreClient, set_runtime
+from ray_tpu.core.task_spec import ActorCreationSpec, KwargsMarker, TaskSpec
+
+
+class WorkerRuntime:
+    """The runtime facade inside a worker process (get/put/submit all work,
+    so tasks can launch nested tasks and hold actor handles)."""
+
+    def __init__(self, control_addr: str, worker_hex: str, kind: str,
+                 env_key: str):
+        self.namespace = os.environ.get("RAY_TPU_NAMESPACE", "")
+        self._exit_ev = threading.Event()
+        self.server = rpc.Server(self._handle_direct)
+        self.core = CoreClient(
+            control_addr, worker_hex, kind=kind,
+            address=self.server.address, env_key=env_key)
+        self.core.on_execute_task = self._on_execute_task
+        self.core.on_create_actor = self._on_create_actor
+        self.core.on_exit = self._on_exit
+        self._func_cache: dict[str, Any] = {}
+        self._actor_instance: Any = None
+        self._actor_hex: str = ""
+        self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
+        self._exec_pool: Optional[Any] = None
+        self.is_initialized = True
+        set_runtime(self)
+        self.core.client.send({"op": "worker_online"})
+
+    # -- runtime facade (same surface the driver runtime exposes) -------
+    def get(self, refs, timeout=None):
+        return self.core.get(refs, timeout)
+
+    def put(self, value):
+        return self.core.put(value)
+
+    def wait(self, refs, num_returns=1, timeout=None):
+        return self.core.wait(refs, num_returns, timeout)
+
+    def submit_task(self, *a, **kw):
+        return self.core.submit_task(*a, **kw)
+
+    def create_actor(self, *a, **kw):
+        if not kw.get("namespace"):
+            kw["namespace"] = self.namespace
+        return self.core.create_actor(*a, **kw)
+
+    def submit_actor_task(self, *a, **kw):
+        return self.core.submit_actor_task(*a, **kw)
+
+    def kill_actor(self, *a, **kw):
+        return self.core.kill_actor(*a, **kw)
+
+    def get_named_actor(self, name: str, namespace: str = ""):
+        return self.core.get_named_actor(name, namespace or self.namespace)
+
+    def subscribe_actor(self, *a, **kw):
+        return self.core.subscribe_actor(*a, **kw)
+
+    def wait_actor_alive(self, *a, **kw):
+        return self.core.wait_actor_alive(*a, **kw)
+
+    def on_ref_deleted(self, object_id: ObjectID):
+        self.core.on_ref_deleted(object_id)
+
+    def cluster_resources(self):
+        return self.core.client.call({"op": "cluster_resources"})
+
+    def available_resources(self):
+        return self.core.client.call({"op": "available_resources"})
+
+    def state_list(self, kind: str):
+        return self.core.client.call({"op": f"list_{kind}"})
+
+    def as_future(self, ref: ObjectRef):
+        import concurrent.futures
+
+        out: concurrent.futures.Future = concurrent.futures.Future()
+        inner = self.core.object_future(ref.hex())
+
+        def _chain(f):
+            try:
+                out.set_result(self.core._load_object(ref.hex(), f.result()))
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        inner.add_done_callback(_chain)
+        return out
+
+    def kv(self):
+        return self.core.client
+
+    # -- direct server (actor task submission path) ---------------------
+    def _handle_direct(self, conn, msg):
+        op = msg.get("op")
+        if op == "actor_task":
+            self._task_queue.put(msg["spec"])
+            return None
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown direct op {op}")
+
+    # -- execution ------------------------------------------------------
+    def _resolve_fn(self, spec: TaskSpec):
+        func_id = spec.func_id
+        fn = self._func_cache.get(func_id)
+        if fn is None:
+            blob = spec.func_blob or self.core.fetch_func(func_id)
+            if blob is None:
+                raise RuntimeError(f"function {func_id} not found in GCS")
+            fn = cloudpickle.loads(blob)
+            self._func_cache[func_id] = fn
+        return fn
+
+    def _resolve_args(self, spec: TaskSpec) -> List[Any]:
+        args = []
+        for a in spec.args:
+            if a.is_ref:
+                ref = ObjectRef(ObjectID.from_hex(a.object_hex))
+                args.append(self.core.get([ref])[0])
+            else:
+                args.append(serialization.deserialize(
+                    a.data, ref_deserializer=self.core._on_ref_deser))
+        return args
+
+    def _store_error(self, spec: TaskSpec, err: TaskError):
+        """Best-effort error store; must not raise (an unstorable error would
+        otherwise leave return objects PENDING and the worker wedged)."""
+        for oid in spec.return_ids:
+            try:
+                self.core._store_value(oid, err, is_error=True)
+            except BaseException:  # noqa: BLE001  e.g. unpicklable cause
+                fallback = TaskError(
+                    spec.name or spec.method_name, None,
+                    tb=err.traceback_str or str(err))
+                fallback.cause = None
+                self.core._store_value(oid, fallback, is_error=True)
+
+    def _store_returns(self, spec: TaskSpec, value: Any, failed: bool):
+        if failed:
+            self._store_error(spec, value)
+            return
+        if spec.num_returns == 1:
+            values = [value]
+        else:
+            try:
+                values = list(value)
+            except TypeError as e:
+                self._store_error(spec, TaskError(spec.name, e))
+                return
+            if len(values) != spec.num_returns:
+                self._store_error(spec, TaskError(
+                    spec.name,
+                    ValueError(
+                        f"task declared {spec.num_returns} returns, got "
+                        f"{len(values)}")))
+                return
+        for oid, v in zip(spec.return_ids, values):
+            try:
+                self.core._store_value(oid, v)
+            except BaseException as e:  # noqa: BLE001 serialization failure
+                self._store_error(spec, TaskError(spec.name, e))
+
+    def _finish(self, spec: TaskSpec, failed: bool):
+        for obj_hex in spec.borrows:
+            self.core.client.send({"op": "decref", "obj": obj_hex})
+        if spec.actor_id is None:
+            self.core.client.send({
+                "op": "task_done", "task_id": spec.task_id.hex(),
+                "failed": failed})
+
+    def _execute(self, spec: TaskSpec, target_fn=None):
+        failed = False
+        try:
+            args = self._resolve_args(spec)
+            # kwargs are shipped as a trailing dict arg marked by name
+            kwargs = {}
+            if args and isinstance(args[-1], KwargsMarker):
+                kwargs = args.pop().kwargs
+            fn = target_fn if target_fn is not None else self._resolve_fn(spec)
+            value = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            failed = True
+            value = TaskError(spec.name or spec.method_name, e)
+            traceback.print_exc()
+        try:
+            self._store_returns(spec, value, failed)
+        except BaseException:  # noqa: BLE001
+            failed = True
+            traceback.print_exc()
+        finally:
+            # Always release resources/borrows, even if storing returns blew
+            # up — a wedged-busy worker starves the whole pool.
+            self._finish(spec, failed)
+        return failed
+
+    def _on_execute_task(self, spec: TaskSpec):
+        # pool tasks: one at a time, run on a dedicated thread so the rpc
+        # receive thread stays responsive
+        threading.Thread(
+            target=self._execute, args=(spec,), name="task-exec", daemon=True
+        ).start()
+
+    # -- actor hosting --------------------------------------------------
+    def _on_create_actor(self, spec: ActorCreationSpec):
+        threading.Thread(
+            target=self._create_actor_instance, args=(spec,),
+            name="actor-init", daemon=True).start()
+
+    def _create_actor_instance(self, spec: ActorCreationSpec):
+        try:
+            blob = spec.class_blob or self.core.fetch_func(spec.class_id)
+            cls = cloudpickle.loads(blob)
+            fake_task = TaskSpec(
+                task_id=None, func_id="", func_blob=None, args=spec.args,
+                num_returns=0, return_ids=[], resources={},
+                borrows=[])
+            args = self._resolve_args(fake_task)
+            kwargs = {}
+            if args and isinstance(args[-1], KwargsMarker):
+                kwargs = args.pop().kwargs
+            self._actor_instance = cls(*args, **kwargs)
+            self._actor_hex = spec.actor_id.hex()
+            n = max(1, spec.max_concurrency)
+            for _ in range(n):
+                threading.Thread(target=self._actor_loop, name="actor-exec",
+                                 daemon=True).start()
+            self.core.client.send({
+                "op": "actor_ready", "actor": spec.actor_id.hex(),
+                "address": self.server.address})
+        except BaseException as e:  # noqa: BLE001
+            traceback.print_exc()
+            self.core.client.send({
+                "op": "actor_creation_failed", "actor": spec.actor_id.hex(),
+                "reason": "".join(traceback.format_exception(e))[-2000:]})
+
+    def _actor_loop(self):
+        while not self._exit_ev.is_set():
+            try:
+                spec = self._task_queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            method_name = spec.method_name
+            if method_name == "__ray_terminate__":
+                self._store_returns(spec, None, failed=False)
+                self._on_exit()
+                return
+            try:
+                method = getattr(self._actor_instance, method_name)
+            except AttributeError as e:
+                self._store_returns(
+                    spec, TaskError(method_name, e), failed=True)
+                self._finish(spec, failed=True)
+                continue
+            self._execute(spec, target_fn=method)
+
+    # -- lifecycle ------------------------------------------------------
+    def _on_exit(self):
+        self._exit_ev.set()
+
+    def run_forever(self):
+        self._exit_ev.wait()
+        try:
+            self.server.stop()
+            self.core.close()
+        finally:
+            os._exit(0)
+
+
+def main():
+    control_addr = os.environ["RAY_TPU_CONTROL_ADDR"]
+    worker_hex = os.environ["RAY_TPU_WORKER_ID"]
+    kind = os.environ.get("RAY_TPU_WORKER_KIND", "pool")
+    env_key = os.environ.get("RAY_TPU_ENV_KEY", "")
+    rt = WorkerRuntime(control_addr, worker_hex, kind=kind, env_key=env_key)
+    rt.run_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,275 @@
+"""Flagship decoder-only transformer (Llama family), TPU-first.
+
+Pure-JAX (no flax dependency in the hot path): params are plain pytrees with
+logical-axis annotations consumed by parallel/sharding.py.  Design choices
+that matter on TPU:
+
+  - scan-over-layers with `jax.checkpoint` (remat): one compiled layer body,
+    weights stacked on a leading "layers" axis → fast compiles, HBM-friendly.
+  - bfloat16 activations, fp32 RMSNorm accumulation and logits.
+  - GQA (num_kv_heads <= num_heads), RoPE, SwiGLU — the Llama recipe.
+  - every matmul annotated via with_logical_constraint so GSPMD places
+    DP/FSDP/TP/SP collectives (SURVEY.md §2.4 targets).
+
+Reference parity note: the reference (Ray) ships no model code — its LLM
+release tests wrap HF models (release/release_tests.yaml:842–1015).  Our
+framework is the model runtime too, so the flagship model lives in-tree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    with_logical_constraint,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: Optional[int] = None
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    scan_layers: bool = True
+    use_flash: bool = True  # ops.flash_attention pallas kernel when on TPU
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "TransformerConfig":
+        return cls(**{**dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_layers=32, num_heads=32, num_kv_heads=32, max_seq_len=4096,
+        ), **kw})
+
+    @classmethod
+    def tiny(cls, **kw) -> "TransformerConfig":
+        """Test-sized config: compiles in seconds on CPU."""
+        return cls(**{**dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128,
+        ), **kw})
+
+
+# ---------------------------------------------------------------------------
+# Param init.  Layout (scan_layers=True): block params stacked on axis 0.
+# ---------------------------------------------------------------------------
+
+def _dense_init(key, shape, dtype, fan_in):
+    return (jax.random.normal(key, shape) / math.sqrt(fan_in)).astype(dtype)
+
+
+def init_params(config: TransformerConfig, key) -> Dict[str, Any]:
+    c = config
+    hd = c.head_dim_
+    keys = jax.random.split(key, 8)
+    pd = c.param_dtype
+
+    def block_shape(shape):
+        return (c.num_layers, *shape) if c.scan_layers else shape
+
+    def init_block(k, shape, fan_in):
+        if c.scan_layers:
+            ks = jax.random.split(k, c.num_layers)
+            return jnp.stack([
+                _dense_init(ks[i], shape, pd, fan_in)
+                for i in range(c.num_layers)])
+        return _dense_init(k, shape, pd, fan_in)
+
+    h, m = c.hidden_size, c.intermediate_size
+    params = {
+        "tok_embed": _dense_init(keys[0], (c.vocab_size, h), pd, h),
+        "blocks": {
+            "attn_norm": jnp.ones(block_shape((h,)), pd),
+            "wq": init_block(keys[1], (h, c.num_heads * hd), h),
+            "wk": init_block(keys[2], (h, c.num_kv_heads * hd), h),
+            "wv": init_block(keys[3], (h, c.num_kv_heads * hd), h),
+            "wo": init_block(keys[4], (c.num_heads * hd, h), c.num_heads * hd),
+            "mlp_norm": jnp.ones(block_shape((h,)), pd),
+            "w_gate": init_block(keys[5], (h, m), h),
+            "w_up": init_block(keys[6], (h, m), h),
+            "w_down": init_block(keys[7], (m, h), m),
+        },
+        "final_norm": jnp.ones((h,), pd),
+    }
+    return params
+
+
+def logical_axes(config: TransformerConfig) -> Dict[str, Any]:
+    """Logical-axis tree matching init_params, for parallel.sharding rules."""
+    L = ("layers",) if config.scan_layers else ()
+    return {
+        "tok_embed": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": L + (None,),
+            "wq": L + ("embed", "heads"),
+            "wk": L + ("embed", "heads"),
+            "wv": L + ("embed", "heads"),
+            "wo": L + ("heads", "embed"),
+            "mlp_norm": L + (None,),
+            "w_gate": L + ("embed", "mlp"),
+            "w_up": L + ("embed", "mlp"),
+            "w_down": L + ("mlp", "embed"),
+        },
+        "final_norm": (None,),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def rms_norm(x, weight, eps):
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(dtype) * weight.astype(dtype)
+
+
+def rope_freqs(head_dim: int, max_len: int, theta: float):
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                           / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [max_len, head_dim//2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin, positions):
+    # x: [b, s, heads, hd]; cos/sin: [max_len, hd//2]; positions: [b, s]
+    c = cos[positions][:, :, None, :]  # [b, s, 1, hd//2]
+    s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, mask, config: TransformerConfig):
+    """q:[b,s,h,hd] k,v:[b,s,kv,hd] causal attention with GQA."""
+    b, s, h, hd = q.shape
+    kv = k.shape[2]
+    if kv != h:
+        rep = h // kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if config.use_flash:
+        from ray_tpu.ops.attention import flash_attention
+
+        return flash_attention(q, k, v, causal=True)
+    scale = 1.0 / math.sqrt(hd)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block(x, bp, cos, sin, positions, mask, config: TransformerConfig):
+    c = config
+    hd = c.head_dim_
+    b, s, h = x.shape
+
+    y = rms_norm(x, bp["attn_norm"], c.rms_eps)
+    y = with_logical_constraint(y, ("batch", "seq", "embed"))
+    q = (y @ bp["wq"].astype(c.dtype)).reshape(b, s, c.num_heads, hd)
+    k = (y @ bp["wk"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
+    v = (y @ bp["wv"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
+    q = with_logical_constraint(q, ("batch", "seq", "heads", None))
+    k = with_logical_constraint(k, ("batch", "seq", "heads", None))
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    attn = _attention(q, k, v, mask, c)
+    attn = attn.reshape(b, s, c.num_heads * hd)
+    x = x + (attn @ bp["wo"].astype(c.dtype))
+    x = with_logical_constraint(x, ("batch", "seq", "embed"))
+
+    y = rms_norm(x, bp["mlp_norm"], c.rms_eps)
+    gate = jax.nn.silu(y @ bp["w_gate"].astype(c.dtype))
+    up = y @ bp["w_up"].astype(c.dtype)
+    ffn = with_logical_constraint(gate * up, ("batch", "seq", "mlp"))
+    x = x + (ffn @ bp["w_down"].astype(c.dtype))
+    return with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+def forward(params: Dict[str, Any], tokens, config: TransformerConfig,
+            positions=None):
+    """tokens: [b, s] int32 → logits [b, s, vocab] (fp32)."""
+    c = config
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    x = params["tok_embed"].astype(c.dtype)[tokens]
+    x = with_logical_constraint(x, ("batch", "seq", "embed"))
+    cos, sin = rope_freqs(c.head_dim_, c.max_seq_len, c.rope_theta)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None, :, :]
+
+    block_fn = partial(_block, cos=cos, sin=sin, positions=positions,
+                       mask=mask, config=c)
+    if c.remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    if c.scan_layers:
+        def scan_body(carry, layer_params):
+            return block_fn(carry, layer_params), None
+
+        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    else:
+        x = block_fn(x, params["blocks"])
+
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    # weight-tied LM head (Llama ties off; tying keeps the flagship simple
+    # and MXU-heavy either way)
+    logits = jnp.einsum(
+        "bsh,vh->bsv", x.astype(jnp.float32),
+        params["tok_embed"].astype(jnp.float32))
+    return with_logical_constraint(logits, ("batch", "seq", "vocab"))
+
+
+def loss_fn(params, batch, config: TransformerConfig):
+    """Next-token cross-entropy. batch: {"tokens": [b, s+1] int32}."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, config)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
+
+
+def num_params(config: TransformerConfig) -> int:
+    c = config
+    hd = c.head_dim_
+    per_layer = (c.hidden_size * (c.num_heads * hd)
+                 + 2 * c.hidden_size * (c.num_kv_heads * hd)
+                 + (c.num_heads * hd) * c.hidden_size
+                 + 3 * c.hidden_size * c.intermediate_size
+                 + 2 * c.hidden_size)
+    return (c.vocab_size * c.hidden_size + c.num_layers * per_layer
+            + c.hidden_size)
+
+
+def flops_per_token(config: TransformerConfig, seq_len: int) -> float:
+    """Approximate forward+backward FLOPs/token (6ND + attention)."""
+    n = num_params(config) - config.vocab_size * config.hidden_size
+    attn = 12 * config.num_layers * config.hidden_size * seq_len
+    return 6 * n + attn

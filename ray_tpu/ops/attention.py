@@ -1,0 +1,228 @@
+"""Attention ops: Pallas TPU flash attention with a jnp reference fallback.
+
+The reference framework ships no attention kernels (SURVEY.md §5 — long-context
+machinery is absent in-tree); on TPU this is a core op.  Design:
+
+  - `flash_attention(q, k, v, causal=...)`: online-softmax tiled kernel
+    (Pallas, grid over (batch*heads, q-blocks), fori_loop over k-blocks) so
+    the s×s score matrix never materializes in HBM.
+  - CPU / odd-shape fallback: blockwise jnp reference with identical
+    semantics — used in unit tests (which compare the two in interpret mode)
+    and under the virtual CPU mesh.
+  - Backward: custom VJP recomputes attention blockwise using the saved
+    logsumexp (standard flash backward), in jnp — XLA fuses it; a Pallas
+    backward kernel is a later optimization.
+
+Layout convention: q, k, v are [batch, seq, heads, head_dim] (the models/
+convention); kernels internally fold batch×heads.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float(-1e30)
+
+
+def _interpret_mode() -> bool:
+    return os.environ.get("RAY_TPU_PALLAS_INTERPRET", "") in ("1", "true")
+
+
+def _platform() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:
+        return "cpu"
+
+
+def _can_use_pallas(seq_q: int, seq_k: int, head_dim: int,
+                    block_q: int, block_k: int) -> bool:
+    if _interpret_mode():
+        return seq_q % block_q == 0 and seq_k % block_k == 0
+    return (
+        _platform() == "tpu"
+        and seq_q % block_q == 0
+        and seq_k % block_k == 0
+        and head_dim % 64 == 0
+    )
+
+
+# ---------------------------------------------------------------------------
+# Reference (jnp) path — also the numerical ground truth in tests.
+# ---------------------------------------------------------------------------
+
+def attention_reference(q, k, v, causal: bool = True,
+                        sm_scale: Optional[float] = None):
+    """Plain attention. q:[b,s,h,d] k,v:[b,t,h,d] -> [b,s,h,d]."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        # Align ends: query i attends keys j where j - (sk - sq) <= i.
+        mask = (jnp.arange(sk)[None, :] - (sk - sq)
+                <= jnp.arange(sq)[:, None])
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
+                block_q: int, block_k: int, seq_k: int, sm_scale: float):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
+    d = q.shape[-1]
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+
+    num_k_blocks = seq_k // block_k
+    if causal:
+        # Last k-block any row of this q-block may attend to.
+        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, num_k_blocks)
+    else:
+        hi = num_k_blocks
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_k]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
+               block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    # fold batch*heads, put seq in the middle: [bh, s, d]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+
+    grid = (b * h, sq // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, causal=causal, block_q=block_q, block_k=block_k,
+        seq_k=sk, sm_scale=sm_scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        interpret=_interpret_mode(),
+    )(qf, kf, vf)
+    out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# custom VJP: forward saves logsumexp; backward recomputes blockwise in jnp.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, res, dout):
+    q, k, v, out, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    do = dout.astype(jnp.float32)
+    lse_ = lse.reshape(b, h, sq)
+
+    # p_ij = exp(q·k * scale - lse_i): exact probabilities, no re-softmax.
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        mask = (jnp.arange(sk)[None, :] - (sk - sq)
+                <= jnp.arange(sq)[:, None])
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jnp.exp(s - lse_[..., None])
+
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do, vf)
+    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)  # [b, sq, h]
+    ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * sm_scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Tiled attention. q:[b,s,h,d], k/v:[b,t,h,d] -> [b,s,h,d].
+
+    Uses the Pallas kernel on TPU (or in interpret mode for tests); falls
+    back to the jnp reference elsewhere.  Heads must already be expanded
+    (GQA repeat happens in the model).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    sq, sk, d = q.shape[1], k.shape[1], q.shape[-1]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    if _can_use_pallas(sq, sk, d, bq, bk):
+        return _flash(q, k, v, causal, sm_scale, bq, bk)
+    return attention_reference(q, k, v, causal, sm_scale)

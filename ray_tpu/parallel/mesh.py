@@ -1,0 +1,169 @@
+"""Device-mesh construction: the TPU-native substrate for every parallelism
+strategy (SURVEY.md §2.4).
+
+Where the reference wires NCCL process groups per strategy
+(python/ray/util/collective/collective.py, train/torch/config.py:65), on TPU a
+single `jax.sharding.Mesh` over named axes carries DP/FSDP/TP/SP/EP
+simultaneously: collectives are compiled into the XLA program, ride the ICI
+torus, and need no process-group bootstrap.  This module owns axis naming
+conventions and topology-aware device ordering; sharding.py maps logical array
+axes onto these mesh axes.
+
+Axis convention (order matters: outermost = slowest-varying = DCN-friendly):
+  data   - data parallel (gradient psum)
+  fsdp   - fully-sharded data parallel (param/optimizer shard axis)
+  seq    - sequence/context parallel (ring attention ppermute axis)
+  tensor - tensor/model parallel (activation all-reduce axis, keep on ICI)
+  expert - expert parallel (MoE all_to_all axis)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_ORDER: Tuple[str, ...] = ("data", "fsdp", "seq", "tensor", "expert")
+
+# Short aliases accepted in user-facing configs.
+_AXIS_ALIASES = {
+    "dp": "data",
+    "data": "data",
+    "fsdp": "fsdp",
+    "zero": "fsdp",
+    "sp": "seq",
+    "cp": "seq",
+    "seq": "seq",
+    "context": "seq",
+    "tp": "tensor",
+    "mp": "tensor",
+    "model": "tensor",
+    "tensor": "tensor",
+    "ep": "expert",
+    "expert": "expert",
+}
+
+
+def canonical_axis(name: str) -> str:
+    try:
+        return _AXIS_ALIASES[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown mesh axis {name!r}; expected one of {sorted(_AXIS_ALIASES)}"
+        ) from None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape.  ``-1`` on at most one axis means "use all
+    remaining devices" (like a reshape wildcard).
+
+    dcn_axes: axes whose communication crosses slices (DCN) in a multi-slice
+    deployment; they are laid out outermost so XLA's hybrid mesh keeps
+    high-traffic axes (tensor/seq) on ICI.
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    seq: int = 1
+    tensor: int = 1
+    expert: int = 1
+    dcn_axes: Tuple[str, ...] = ()
+
+    @classmethod
+    def from_dict(cls, axes: Dict[str, int],
+                  dcn_axes: Sequence[str] = ()) -> "MeshConfig":
+        out = {"data": 1, "fsdp": 1, "seq": 1, "tensor": 1, "expert": 1}
+        wildcard = None
+        for k, v in axes.items():
+            ck = canonical_axis(k)
+            if v == -1:
+                wildcard = ck
+            out[ck] = v
+        if wildcard is None and "data" not in {canonical_axis(k) for k in axes}:
+            out["data"] = -1
+        return cls(dcn_axes=tuple(canonical_axis(a) for a in dcn_axes), **out)
+
+    def sizes(self, n_devices: int) -> Dict[str, int]:
+        fixed = {a: getattr(self, a) for a in AXIS_ORDER}
+        wild = [a for a, v in fixed.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one wildcard (-1) axis, got {wild}")
+        known = math.prod(v for v in fixed.values() if v != -1)
+        if wild:
+            if n_devices % known:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product "
+                    f"{known} ({fixed})")
+            fixed[wild[0]] = n_devices // known
+        elif known != n_devices:
+            raise ValueError(
+                f"mesh {fixed} needs {known} devices, have {n_devices}")
+        return fixed
+
+
+def build_mesh(config: Optional[MeshConfig] = None,
+               devices: Optional[Sequence] = None,
+               axes: Optional[Dict[str, int]] = None,
+               dcn_axes: Sequence[str] = ()):
+    """Create a `jax.sharding.Mesh` with named axes over the device topology.
+
+    Uses `jax.experimental.mesh_utils.create_device_mesh` so the mesh axes map
+    onto the physical ICI torus (nearest-neighbor rings per axis) instead of
+    raw device enumeration order.  With `dcn_axes` and >1 slice, builds a
+    hybrid ICI+DCN mesh (`create_hybrid_device_mesh`).
+    """
+    import jax
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    if config is None:
+        config = MeshConfig.from_dict(axes or {}, dcn_axes=dcn_axes)
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = config.sizes(len(devices))
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+
+    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if config.dcn_axes and n_slices > 1:
+        dcn_shape = tuple(
+            sizes[a] if a in config.dcn_axes else 1 for a in AXIS_ORDER)
+        ici_shape = tuple(
+            1 if a in config.dcn_axes else sizes[a] for a in AXIS_ORDER)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=devices)
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        except (ValueError, AssertionError):
+            # Topology-aware layout can fail for odd shapes (e.g. virtual CPU
+            # devices); plain reshape preserves correctness, only locality is
+            # lost.
+            dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def single_axis_mesh(axis: str = "data", devices: Optional[Sequence] = None):
+    """All devices on one named axis — the pmap-style DP mesh."""
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    return build_mesh(axes={axis: len(devices)}, devices=devices)
+
+
+def mesh_shape(mesh) -> Dict[str, int]:
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def local_mesh_info(mesh) -> Dict[str, object]:
+    """Describe this host's slice of the mesh (for logs / state API)."""
+    import jax
+
+    return {
+        "axis_names": list(mesh.axis_names),
+        "shape": mesh_shape(mesh),
+        "n_devices": int(mesh.devices.size),
+        "process_index": jax.process_index(),
+        "local_devices": [str(d) for d in jax.local_devices()],
+    }

@@ -1,0 +1,47 @@
+"""Test fixtures.
+
+Mirrors the reference's conftest strategy (python/ray/tests/conftest.py
+ray_start_regular): a session-scoped runtime fixture plus per-test cluster
+fixtures.  TPU/mesh tests run on a virtual 8-device CPU mesh via XLA_FLAGS
+(SURVEY.md §4 testing blueprint) so multi-chip logic is tested without TPUs.
+"""
+
+import os
+
+# Must be set before jax backends initialize anywhere in the test process.
+# FORCE cpu (not setdefault): the dev environment exports
+# JAX_PLATFORMS=axon, whose PJRT plugin dials the TPU tunnel and blocks —
+# tests always run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RAY_TPU_CHIPS", "none")
+
+# The axon sitecustomize calls jax.config.update("jax_platforms",
+# "axon,cpu") at interpreter start, overriding the env var; force it back
+# so no test ever initializes the tunnel backend.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ray_start_shared():
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=8)
+    yield rt
+    ray_tpu.shutdown()

@@ -1,0 +1,143 @@
+"""Core task/object API tests (counterpart of python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+    assert ray_tpu.get(ref) == {"a": 1, "b": [1, 2, 3]}
+
+
+def test_put_get_large_numpy(ray_start_regular):
+    arr = np.random.default_rng(0).standard_normal((512, 512)).astype(np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(1, 2)) == 3
+
+
+def test_task_kwargs(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1, c=3)) == 14
+
+
+def test_task_with_ref_args(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    r1 = double.remote(10)
+    r2 = double.remote(r1)
+    assert ray_tpu.get(r2) == 40
+
+
+def test_many_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    refs = [square.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_multiple_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ray_tpu.TaskError, match="kaboom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 10
+
+    assert ray_tpu.get(outer.remote(1)) == 12
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=3)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(ray_tpu.GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.5)
+
+
+def test_large_arg_roundtrip(ray_start_regular):
+    arr = np.ones((1024, 1024), dtype=np.float32)  # 4 MB, forced to shm
+
+    @ray_tpu.remote
+    def total(x):
+        return float(x.sum())
+
+    assert ray_tpu.get(total.remote(arr)) == float(arr.sum())
+
+
+def test_ref_inside_container(ray_start_regular):
+    inner_ref = ray_tpu.put(41)
+
+    @ray_tpu.remote
+    def deref(d):
+        return ray_tpu.get(d["ref"]) + 1
+
+    assert ray_tpu.get(deref.remote({"ref": inner_ref})) == 42
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(num_returns=1).remote()) == 1
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0

@@ -1,0 +1,102 @@
+"""Flagship transformer: forward/loss sanity + sharded step on virtual mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import transformer as tfm
+from ray_tpu.parallel import mesh as mesh_lib
+from ray_tpu.parallel import sharding
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    return tfm.TransformerConfig.tiny(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(tiny):
+    return tfm.init_params(tiny, jax.random.PRNGKey(0))
+
+
+def test_forward_shapes(tiny, params):
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = tfm.forward(params, tokens, tiny)
+    assert logits.shape == (2, 16, tiny.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_loss_decreases_under_sgd(tiny, params):
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (4, 33), 0, tiny.vocab_size)
+    batch = {"tokens": tokens}
+
+    @jax.jit
+    def step(p):
+        loss, grads = jax.value_and_grad(tfm.loss_fn)(p, batch, tiny)
+        new_p = jax.tree.map(lambda w, g: w - 0.5 * g, p, grads)
+        return new_p, loss
+
+    p = params
+    losses = []
+    for _ in range(5):
+        p, loss = step(p)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_scan_matches_unrolled(tiny):
+    """scan-over-layers and unrolled layers compute the same function."""
+    cfg_scan = tiny
+    cfg_unroll = tfm.TransformerConfig.tiny(dtype=jnp.float32,
+                                            scan_layers=False, remat=False)
+    p_scan = tfm.init_params(cfg_scan, jax.random.PRNGKey(7))
+    # Restack scan params into per-layer for the unrolled config: for 1-layer
+    # comparison use num_layers=1 variants instead (cheaper).
+    cfg_s1 = tfm.TransformerConfig.tiny(dtype=jnp.float32, num_layers=1)
+    cfg_u1 = tfm.TransformerConfig.tiny(dtype=jnp.float32, num_layers=1,
+                                        scan_layers=False, remat=False)
+    p1 = tfm.init_params(cfg_s1, jax.random.PRNGKey(7))
+    p1_unroll = {
+        "tok_embed": p1["tok_embed"],
+        "blocks": jax.tree.map(lambda x: x[0], p1["blocks"]),
+        "final_norm": p1["final_norm"],
+    }
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 256)
+    out_s = tfm.forward(p1, tokens, cfg_s1)
+    out_u = tfm.forward(p1_unroll, tokens, cfg_u1)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_u),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_sharded_train_step_on_virtual_mesh(tiny, params):
+    """Full GSPMD train step over an 8-device mesh (dp=2, fsdp=2, tp=2)."""
+    mesh = mesh_lib.build_mesh(axes={"data": 2, "fsdp": 2, "tensor": 2})
+    assert mesh.devices.size == 8
+
+    logical = tfm.logical_axes(tiny)
+    sharded = sharding.shard_tree(params, mesh, logical_tree=logical)
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (8, 33), 0,
+                                tiny.vocab_size)
+    batch = {"tokens": jax.device_put(
+        tokens, sharding.data_sharding(mesh))}
+
+    @jax.jit
+    def step(p, b):
+        loss, grads = jax.value_and_grad(tfm.loss_fn)(p, b, tiny)
+        return jax.tree.map(lambda w, g: w - 0.1 * g, p, grads), loss
+
+    with jax.sharding.set_mesh(mesh):
+        new_p, loss = step(sharded, batch)
+    assert np.isfinite(float(loss))
+    # params keep their shardings
+    wq = new_p["blocks"]["wq"]
+    assert not wq.sharding.is_fully_replicated
+
+
+def test_param_count_formula(tiny, params):
+    actual = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+    assert actual == tfm.num_params(tiny)
