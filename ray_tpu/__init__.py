@@ -8,6 +8,7 @@ models, ops (Pallas kernels), data, train, tune, rl, serve.
 from ray_tpu._version import __version__
 from ray_tpu.core.api import (
     available_resources,
+    cancel,
     cluster_resources,
     get,
     get_actor,
@@ -25,7 +26,9 @@ from ray_tpu.core.exceptions import (
     GetTimeoutError,
     ObjectLostError,
     RayTpuError,
+    TaskCancelledError,
     TaskError,
+    TaskUnschedulableError,
     WorkerCrashedError,
 )
 from ray_tpu.core.object_ref import ObjectRef
@@ -40,6 +43,7 @@ __all__ = [
     "put",
     "wait",
     "kill",
+    "cancel",
     "get_actor",
     "cluster_resources",
     "available_resources",
@@ -50,5 +54,7 @@ __all__ = [
     "ActorDiedError",
     "ObjectLostError",
     "GetTimeoutError",
+    "TaskCancelledError",
+    "TaskUnschedulableError",
     "WorkerCrashedError",
 ]

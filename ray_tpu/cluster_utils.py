@@ -1,0 +1,65 @@
+"""Fake multi-node cluster for tests (counterpart of
+python/ray/cluster_utils.py:135 Cluster).
+
+The reference starts one real raylet process per fake node; here nodes are
+logical resource partitions inside the head control plane (worker processes
+are real either way), which is what scheduling/PG/fault-tolerance tests
+need.  remove_node() kills the node's worker processes, exercising the same
+death paths as a crashed host (chaos-testing hook, SURVEY.md §4 item 5).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ray_tpu.core import runtime as _runtime_mod
+from ray_tpu.core.driver import DriverRuntime
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[dict] = None):
+        self.runtime: Optional[DriverRuntime] = None
+        self._nodes: List[str] = []
+        if initialize_head:
+            args = dict(head_node_args or {})
+            self.runtime = DriverRuntime(**args)
+            self._nodes.append("head")
+
+    def _kv(self):
+        if self.runtime is None:
+            raise RuntimeError("cluster head not initialized")
+        return self.runtime.kv()
+
+    def add_node(self, num_cpus: float = 1, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 node_id: str = "", labels: Optional[Dict[str, str]] = None
+                 ) -> str:
+        amounts = dict(resources or {})
+        if num_cpus:
+            amounts["CPU"] = float(num_cpus)
+        if num_tpus:
+            amounts["TPU"] = float(num_tpus)
+        nid = self._kv().call({
+            "op": "add_node", "resources": amounts,
+            "node_id": node_id, "labels": labels})
+        self._nodes.append(nid)
+        return nid
+
+    def remove_node(self, node_id: str) -> bool:
+        ok = self._kv().call({"op": "remove_node", "node_id": node_id})
+        if ok and node_id in self._nodes:
+            self._nodes.remove(node_id)
+        return ok
+
+    def list_nodes(self) -> List[dict]:
+        return self._kv().call({"op": "list_nodes"})
+
+    @property
+    def node_ids(self) -> List[str]:
+        return list(self._nodes)
+
+    def shutdown(self):
+        if self.runtime is not None:
+            self.runtime.shutdown()
+            self.runtime = None

@@ -85,7 +85,8 @@ class ActorClass:
                  name: str = "",
                  namespace: str = "",
                  lifetime: str = "",
-                 runtime_env: Optional[dict] = None):
+                 runtime_env: Optional[dict] = None,
+                 scheduling_strategy=None):
         self._cls = cls
         self._num_cpus = 1.0 if num_cpus is None else num_cpus
         self._num_tpus = num_tpus or 0.0
@@ -95,6 +96,7 @@ class ActorClass:
         self._name = name
         self._namespace = namespace
         self._runtime_env = runtime_env
+        self._scheduling_strategy = scheduling_strategy
         self._blob: Optional[bytes] = None
         self._class_id: Optional[str] = None
 
@@ -133,6 +135,7 @@ class ActorClass:
             namespace=self._namespace,
             max_concurrency=self._max_concurrency,
             runtime_env=self._runtime_env,
+            scheduling_strategy=self._scheduling_strategy,
         )
         return ActorHandle(actor_id.hex(), self._cls.__name__)
 
@@ -146,6 +149,7 @@ class ActorClass:
             "name": self._name,
             "namespace": self._namespace,
             "runtime_env": self._runtime_env,
+            "scheduling_strategy": self._scheduling_strategy,
         }
         opts.update(overrides)
         opts.pop("lifetime", None)

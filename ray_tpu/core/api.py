@@ -53,7 +53,7 @@ def remote(*args, **kwargs):
         if inspect.isclass(obj):
             valid = {"num_cpus", "num_tpus", "resources", "max_restarts",
                      "max_concurrency", "name", "namespace", "lifetime",
-                     "runtime_env"}
+                     "runtime_env", "scheduling_strategy"}
             opts = {k: v for k, v in kwargs.items() if k in valid}
             return ActorClass(obj, **opts)
         valid = {"num_returns", "num_cpus", "num_tpus", "resources",
@@ -88,6 +88,14 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
         raise TypeError("wait() expects a list of ObjectRefs")
     return _runtime_mod.get_runtime().wait(
         list(refs), num_returns=num_returns, timeout=timeout)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
+    """Cancel the task producing ``ref``.  Pending tasks are always
+    cancellable; running tasks only with force=True (worker is killed)."""
+    rt = _runtime_mod.get_runtime()
+    return bool(rt.kv().call(
+        {"op": "cancel_object", "obj": ref.hex(), "force": force}))
 
 
 def kill(actor: ActorHandle, *, no_restart: bool = True):

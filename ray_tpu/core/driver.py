@@ -103,6 +103,9 @@ class DriverRuntime:
         inner.add_done_callback(_chain)
         return out
 
+    def kv(self):
+        return self.core.client
+
     # cluster info ------------------------------------------------------
     def cluster_resources(self):
         return self.core.client.call({"op": "cluster_resources"})

@@ -55,6 +55,14 @@ class WorkerCrashedError(RayTpuError):
     """Worker process died while executing a task."""
 
 
+class TaskCancelledError(RayTpuError):
+    """Task was cancelled before or during execution."""
+
+
+class TaskUnschedulableError(RayTpuError):
+    """Task can never be scheduled (e.g. its placement group was removed)."""
+
+
 class RuntimeEnvSetupError(RayTpuError):
     """Failed to set up the runtime environment for a worker."""
 

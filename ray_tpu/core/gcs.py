@@ -62,6 +62,49 @@ class ObjectEntry:
 
 
 @dataclass
+class NodeState:
+    """One logical node: a resource pool + its worker processes.
+
+    Counterpart of a raylet's local resource view (raylet/node_manager.h).
+    In-process ("fake cluster") nodes partition the head's control plane the
+    way the reference's cluster_utils.Cluster partitions one host into many
+    raylets (python/ray/cluster_utils.py:135); worker processes are real
+    either way.
+    """
+
+    node_id: str
+    total: ResourceSet
+    available: ResourceSet
+    alive: bool = True
+    is_head: bool = False
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Bundle:
+    """A placement-group bundle: resources reserved on one node."""
+
+    index: int
+    node_id: str
+    reserved: ResourceSet
+    available: ResourceSet
+
+
+@dataclass
+class PlacementGroupEntry:
+    """Counterpart of GcsPlacementGroupManager state
+    (gcs/gcs_server/gcs_placement_group_manager.h:230)."""
+
+    pg_hex: str
+    strategy: str  # PACK | SPREAD | STRICT_PACK | STRICT_SPREAD
+    bundle_specs: List[Dict[str, float]]
+    state: str = "PENDING"  # PENDING | CREATED | REMOVED | INFEASIBLE
+    bundles: List[Bundle] = field(default_factory=list)
+    ready_obj: str = ""  # object set when CREATED (PlacementGroup.ready())
+    name: str = ""
+
+
+@dataclass
 class WorkerInfo:
     worker_hex: str
     conn: Optional[rpc.Connection] = None
@@ -74,6 +117,10 @@ class WorkerInfo:
     acquired: ResourceSet = field(default_factory=ResourceSet)
     actor_hex: str = ""
     proc: Optional[subprocess.Popen] = None
+    node_id: str = ""
+    # where acquired resources were charged: ("node", node_id) or
+    # ("pg", pg_hex, bundle_index)
+    charge: tuple = ()
 
 
 @dataclass
@@ -94,6 +141,26 @@ class TaskRecord:
     submitted_at: float = 0.0
     started_at: float = 0.0
     finished_at: float = 0.0
+
+
+def _sum_bundles(bundle_specs: List[Dict[str, float]]) -> Dict[str, float]:
+    total: Dict[str, float] = {}
+    for b in bundle_specs:
+        for k, v in b.items():
+            total[k] = total.get(k, 0.0) + v
+    return total
+
+
+_TRUE_BYTES: Optional[bytes] = None
+
+
+def _serialized_true() -> bytes:
+    global _TRUE_BYTES
+    if _TRUE_BYTES is None:
+        from ray_tpu.core.serialization import serialize
+
+        _TRUE_BYTES = serialize(True).to_bytes()
+    return _TRUE_BYTES
 
 
 _SITE_PACKAGES: Optional[str] = None
@@ -140,8 +207,11 @@ class ControlServer:
         self.pending_tasks: List[TaskSpec] = []
         self.pending_actors: List[ActorCreationSpec] = []
 
-        self.total_resources = resources
-        self.available = resources
+        head = NodeState(node_id="head", total=resources,
+                         available=resources, is_head=True)
+        self.nodes: Dict[str, NodeState] = {"head": head}
+        self.placement_groups: Dict[str, PlacementGroupEntry] = {}
+        self._rr_counter = 0  # SPREAD round-robin cursor
         self.store = ShmObjectStore(session_id, config.shm_dir)
 
         self._wake = threading.Event()
@@ -206,8 +276,7 @@ class ControlServer:
         """Called with lock held. Fail/retry its task, kill/restart its actor."""
         w.state = "dead"
         w.conn = None
-        self.available = self.available.add(w.acquired)
-        w.acquired = ResourceSet()
+        self._release(w)
         if w.current_task:
             rec = self.tasks.get(w.current_task)
             if rec is not None and rec.state == "RUNNING":
@@ -261,14 +330,7 @@ class ControlServer:
 
     def _fail_task_returns(self, spec: TaskSpec, reason: str):
         """Lock held. Store WorkerCrashedError in the task's return objects."""
-        from ray_tpu.core.exceptions import WorkerCrashedError
-        from ray_tpu.core.serialization import serialize
-
-        err = serialize(WorkerCrashedError(f"task {spec.name or spec.task_id.hex()}: {reason}"))
-        data = err.to_bytes()
-        for oid in spec.return_ids:
-            self._store_object_locked(oid.hex(), inline=data, size=len(data),
-                                      is_error=True)
+        self._fail_task_returns_with(spec, reason)
 
     # ------------------------------------------------------------------
     # Registration
@@ -454,8 +516,7 @@ class ControlServer:
             if w is not None and w.kind == "pool":
                 w.state = "idle"
                 w.current_task = None
-                self.available = self.available.add(w.acquired)
-                w.acquired = ResourceSet()
+                self._release(w)
         self._wake.set()
 
     # ------------------------------------------------------------------
@@ -587,11 +648,21 @@ class ControlServer:
     # ------------------------------------------------------------------
     # State API (reference: util/state — ray list tasks/actors/...)
     def _op_cluster_resources(self, conn, msg):
-        return self.total_resources.to_dict()
+        with self.lock:
+            out = ResourceSet()
+            for n in self.nodes.values():
+                if n.alive:
+                    out = out.add(n.total)
+            return out.to_dict()
 
     def _op_available_resources(self, conn, msg):
         with self.lock:
-            return self.available.to_dict()
+            out = ResourceSet()
+            for n in self.nodes.values():
+                if n.alive:
+                    out = out.add(n.available)
+            # PG free reservations still count as available-to-PG-users
+            return out.to_dict()
 
     def _op_list_tasks(self, conn, msg):
         with self.lock:
@@ -633,6 +704,266 @@ class ControlServer:
         return "pong"
 
     # ------------------------------------------------------------------
+    # Nodes (fake-cluster API, counterpart of cluster_utils.Cluster
+    # add_node/remove_node, python/ray/cluster_utils.py:201/:279)
+    def _op_add_node(self, conn, msg):
+        res = ResourceSet(msg["resources"])
+        node_id = msg.get("node_id")
+        with self.lock:
+            if not node_id:
+                i = len(self.nodes)
+                while f"node-{i}" in self.nodes:
+                    i += 1
+                node_id = f"node-{i}"
+            if node_id in self.nodes:
+                raise ValueError(f"node {node_id} already exists")
+            self.nodes[node_id] = NodeState(
+                node_id=node_id, total=res, available=res,
+                labels=msg.get("labels") or {})
+        self._wake.set()
+        return node_id
+
+    def _op_remove_node(self, conn, msg):
+        """Simulated node failure: kill its workers, fail/retry their work.
+
+        The worker-death path handles task retry / actor restart exactly as
+        a real crash would (chaos-testing hook, reference RayletKiller
+        python/ray/_private/test_utils.py:1536)."""
+        node_id = msg["node_id"]
+        to_kill = []
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None:
+                return False
+            node.alive = False
+            node.available = ResourceSet()
+            for w in self.workers.values():
+                if w.node_id == node_id and w.state != "dead":
+                    to_kill.append(w)
+            # PGs with bundles on this node lose them
+            for pg in self.placement_groups.values():
+                if pg.state == "CREATED" and any(
+                        b.node_id == node_id for b in pg.bundles):
+                    self._teardown_pg(pg, reason=f"node {node_id} removed")
+        for w in to_kill:
+            if w.proc is not None:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+            # death is then observed via disconnect -> _mark_worker_dead
+        self._wake.set()
+        return True
+
+    def _op_list_nodes(self, conn, msg):
+        with self.lock:
+            return [
+                {"node_id": n.node_id, "alive": n.alive,
+                 "is_head": n.is_head, "resources": n.total.to_dict(),
+                 "available": n.available.to_dict(), "labels": n.labels}
+                for n in self.nodes.values()
+            ]
+
+    # ------------------------------------------------------------------
+    # Placement groups (counterpart of GcsPlacementGroupManager +
+    # 2PC bundle reservation, gcs_placement_group_manager.h:230; bundle
+    # policies scheduling/policy/bundle_scheduling_policy.h)
+    def _try_reserve_pg(self, pg: PlacementGroupEntry) -> bool:
+        """Lock held. Attempt to reserve all bundles atomically (the 2PC
+        prepare/commit collapses to one step inside the control plane)."""
+        alive = [n for n in self.nodes.values() if n.alive]
+        needs = [ResourceSet(b) for b in pg.bundle_specs]
+        placement: List[str] = []
+        # virtual availability during placement
+        virt = {n.node_id: n.available for n in alive}
+
+        def fits(node_id, need):
+            return need.is_subset_of(virt[node_id])
+
+        strategy = pg.strategy
+        if strategy in ("PACK", "STRICT_PACK"):
+            # try to put everything on one node (best = most utilized that
+            # fits all); PACK falls back to spreading the remainder.
+            for n in sorted(alive, key=self._utilization, reverse=True):
+                if all(ResourceSet(b).is_subset_of(n.available)
+                       for b in [_sum_bundles(pg.bundle_specs)]):
+                    placement = [n.node_id] * len(needs)
+                    break
+            if not placement:
+                if strategy == "STRICT_PACK":
+                    return False
+                placement = []
+                for need in needs:
+                    cand = next((n.node_id for n in sorted(
+                        alive, key=self._utilization, reverse=True)
+                        if fits(n.node_id, need)), None)
+                    if cand is None:
+                        return False
+                    placement.append(cand)
+                    virt[cand] = virt[cand].subtract(need)
+        elif strategy in ("SPREAD", "STRICT_SPREAD"):
+            used_nodes: Set[str] = set()
+            placement = []
+            for need in needs:
+                cands = [n for n in alive if fits(n.node_id, need)]
+                fresh = [n for n in cands if n.node_id not in used_nodes]
+                pool = fresh if fresh else (
+                    [] if strategy == "STRICT_SPREAD" else cands)
+                if not pool:
+                    return False
+                node = min(pool, key=self._utilization)
+                placement.append(node.node_id)
+                used_nodes.add(node.node_id)
+                virt[node.node_id] = virt[node.node_id].subtract(need)
+        else:
+            raise ValueError(f"unknown PG strategy {strategy}")
+
+        # commit
+        pg.bundles = []
+        for i, (need, node_id) in enumerate(zip(needs, placement)):
+            node = self.nodes[node_id]
+            node.available = node.available.subtract(need)
+            pg.bundles.append(Bundle(index=i, node_id=node_id,
+                                     reserved=need, available=need))
+        pg.state = "CREATED"
+        if pg.ready_obj:
+            self._store_object_locked(
+                pg.ready_obj,
+                inline=_serialized_true(), size=len(_serialized_true()),
+                is_error=False)
+        return True
+
+    def _teardown_pg(self, pg: PlacementGroupEntry, reason: str):
+        """Lock held. Return free bundle reservations; in-use portions come
+        back via worker release. Kill actors placed in the PG."""
+        for b in pg.bundles:
+            node = self.nodes.get(b.node_id)
+            if node is not None and node.alive:
+                node.available = node.available.add(b.available)
+        pg.state = "REMOVED"
+        pg.bundles = []
+        # exit workers charged against this PG
+        for w in self.workers.values():
+            if w.charge and w.charge[0] == "pg" and w.charge[1] == pg.pg_hex:
+                if w.conn is not None:
+                    try:
+                        w.conn.push({"op": "exit"})
+                    except Exception:
+                        pass
+
+    def _op_create_pg(self, conn, msg):
+        pg = PlacementGroupEntry(
+            pg_hex=msg["pg"], strategy=msg.get("strategy", "PACK"),
+            bundle_specs=msg["bundles"], ready_obj=msg.get("ready_obj", ""),
+            name=msg.get("name", ""))
+        with self.lock:
+            self.placement_groups[pg.pg_hex] = pg
+            if pg.ready_obj:
+                self.objects.setdefault(pg.ready_obj, ObjectEntry())
+            self._try_reserve_pg(pg)
+        self._wake.set()
+
+    def _op_remove_pg(self, conn, msg):
+        with self.lock:
+            pg = self.placement_groups.get(msg["pg"])
+            if pg is None:
+                return False
+            if pg.state == "CREATED":
+                self._teardown_pg(pg, "removed")
+            else:
+                pg.state = "REMOVED"
+        self._wake.set()
+        return True
+
+    def _op_pg_state(self, conn, msg):
+        with self.lock:
+            pg = self.placement_groups.get(msg["pg"])
+            if pg is None:
+                return None
+            return {
+                "state": pg.state, "strategy": pg.strategy,
+                "bundles": [
+                    {"index": b.index, "node_id": b.node_id,
+                     "reserved": b.reserved.to_dict(),
+                     "available": b.available.to_dict()}
+                    for b in pg.bundles],
+            }
+
+    def _op_list_placement_groups(self, conn, msg):
+        with self.lock:
+            return [
+                {"pg_id": h, "state": pg.state, "strategy": pg.strategy,
+                 "name": pg.name, "bundles": pg.bundle_specs}
+                for h, pg in self.placement_groups.items()
+            ]
+
+    def _op_cancel_object(self, conn, msg):
+        """Cancel the task producing this object (ray.cancel(ref))."""
+        with self.lock:
+            entry = self.objects.get(msg["obj"])
+            task_hex = entry.producing_task if entry is not None else None
+        if not task_hex:
+            return False
+        return self._op_cancel_task(conn, {"task_id": task_hex,
+                                           "force": msg.get("force", False)})
+
+    # ------------------------------------------------------------------
+    # Task cancel (counterpart of CoreWorker::CancelTask semantics)
+    def _op_cancel_task(self, conn, msg):
+        task_hex = msg["task_id"]
+        force = msg.get("force", False)
+        with self.lock:
+            rec = self.tasks.get(task_hex)
+            if rec is None:
+                return False
+            if rec.state == "PENDING":
+                self.pending_tasks = [
+                    s for s in self.pending_tasks
+                    if s.task_id.hex() != task_hex]
+                rec.state = "CANCELLED"
+                self._fail_task_returns_with(
+                    rec.spec, "task cancelled", kind="cancelled")
+                return True
+            if rec.state == "RUNNING" and force:
+                w = self.workers.get(rec.worker_hex)
+                if w is not None and w.proc is not None:
+                    rec.spec.max_retries = rec.spec.retry_count  # no retry
+                    rec.state = "CANCELLED"
+                    self._fail_task_returns_with(
+                        rec.spec, "task cancelled (force)", kind="cancelled")
+                    # Kill + mark dead under the lock: releasing first would
+                    # let the worker finish, grab another task, and eat the
+                    # SIGKILL meant for this one.  kill() is non-blocking.
+                    try:
+                        w.proc.kill()
+                    except OSError:
+                        pass
+                    self._mark_worker_dead(w, "task cancelled")
+                    return True
+            return False  # running w/o force, or already finished
+
+    def _fail_task_returns_with(self, spec: TaskSpec, reason: str,
+                                kind: str = "crashed"):
+        """Lock held. kind: crashed | cancelled | unschedulable."""
+        from ray_tpu.core.exceptions import (
+            TaskCancelledError,
+            TaskUnschedulableError,
+            WorkerCrashedError,
+        )
+        from ray_tpu.core.serialization import serialize
+
+        cls = {"cancelled": TaskCancelledError,
+               "unschedulable": TaskUnschedulableError}.get(
+                   kind, WorkerCrashedError)
+        data = serialize(cls(
+            f"task {spec.name or spec.task_id.hex()}: {reason}")).to_bytes()
+        for oid in spec.return_ids:
+            entry = self.objects.get(oid.hex())
+            if entry is None or entry.state == PENDING:
+                self._store_object_locked(
+                    oid.hex(), inline=data, size=len(data), is_error=True)
+
+    # ------------------------------------------------------------------
     # Scheduler (counterpart of ClusterTaskManager::ScheduleAndDispatchTasks)
     def _schedule_loop(self):
         while not self._stopped.is_set():
@@ -655,67 +986,227 @@ class ControlServer:
                     return False
         return True
 
+    # -- resource charge/release (node- or bundle-scoped) ---------------
+    def _release(self, w: WorkerInfo):
+        """Lock held. Return a worker's acquired resources to where they
+        were charged (PG bundle, else its node)."""
+        if w.acquired.is_empty():
+            w.charge = ()
+            return
+        ch = w.charge
+        acquired, w.acquired = w.acquired, ResourceSet()
+        w.charge = ()
+        if ch and ch[0] == "pg":
+            pg = self.placement_groups.get(ch[1])
+            if (pg is not None and pg.state == "CREATED"
+                    and ch[2] < len(pg.bundles)):
+                b = pg.bundles[ch[2]]
+                b.available = b.available.add(acquired)
+                return
+            # PG gone: its reservation was partially returned at removal;
+            # the in-use remainder goes back to the node now.
+        node = self.nodes.get(w.node_id)
+        if node is not None and node.alive:
+            node.available = node.available.add(acquired)
+
+    def _utilization(self, node: NodeState) -> float:
+        tot = node.total.to_dict()
+        avail = node.available.to_dict()
+        utils = [1.0 - avail.get(k, 0.0) / v for k, v in tot.items() if v > 0]
+        return max(utils, default=0.0)
+
+    def _pick_node(self, need: ResourceSet, spec) -> Optional[tuple]:
+        """Lock held. Choose a node (or PG bundle) for this task/actor.
+
+        Returns (node_id, charge_tuple) or None if nothing is feasible now.
+        Policy parity: hybrid pack-then-spread default
+        (scheduling/policy/hybrid_scheduling_policy.h:50), SPREAD
+        round-robin, node-affinity, PG bundles (bundle_pack/spread)."""
+        # Placement-group bundle placement
+        pg_hex = getattr(spec, "placement_group_hex", "")
+        if pg_hex:
+            pg = self.placement_groups.get(pg_hex)
+            if pg is None or pg.state != "CREATED":
+                return None
+            indices = ([spec.bundle_index] if spec.bundle_index >= 0
+                       else range(len(pg.bundles)))
+            for i in indices:
+                if i >= len(pg.bundles):
+                    return None
+                b = pg.bundles[i]
+                node = self.nodes.get(b.node_id)
+                if (node is not None and node.alive
+                        and need.is_subset_of(b.available)):
+                    return b.node_id, ("pg", pg_hex, i)
+            return None
+
+        st = getattr(spec, "scheduling_strategy", None)
+        alive = [n for n in self.nodes.values() if n.alive]
+        if st is not None and type(st).__name__ == "NodeAffinitySchedulingStrategy":
+            node = self.nodes.get(st.node_id)
+            if (node is not None and node.alive
+                    and need.is_subset_of(node.available)):
+                return node.node_id, ("node", node.node_id)
+            if not st.soft:
+                return None
+            # soft: fall through to default policy
+        feasible = [n for n in alive if need.is_subset_of(n.available)]
+        if not feasible:
+            return None
+        if st == "SPREAD":
+            # least-utilized first; round-robin among the tied minimum so
+            # zero-resource tasks still rotate across nodes
+            self._rr_counter += 1
+            feasible.sort(key=lambda n: (self._utilization(n), n.node_id))
+            lowest = self._utilization(feasible[0])
+            ties = [n for n in feasible if self._utilization(n) == lowest]
+            node = ties[self._rr_counter % len(ties)]
+            return node.node_id, ("node", node.node_id)
+        # hybrid default: pack onto the busiest node below the spread
+        # threshold; above it, spread to the least utilized.
+        threshold = 0.5
+        below = [n for n in feasible if self._utilization(n) < threshold]
+        if below:
+            node = max(below, key=lambda n: (self._utilization(n), n.is_head))
+        else:
+            node = min(feasible, key=lambda n: (self._utilization(n),
+                                                not n.is_head))
+        return node.node_id, ("node", node.node_id)
+
+    def _pg_is_gone(self, spec) -> bool:
+        """Lock held. True if the spec targets a PG that no longer exists —
+        the work can never schedule and must fail (reference fails these
+        with a scheduling error rather than pending forever)."""
+        pg_hex = getattr(spec, "placement_group_hex", "")
+        if not pg_hex:
+            return False
+        pg = self.placement_groups.get(pg_hex)
+        return pg is None or pg.state == "REMOVED"
+
+    def _charge_target_subtract(self, charge: tuple, need: ResourceSet):
+        """Lock held."""
+        if charge[0] == "pg":
+            b = self.placement_groups[charge[1]].bundles[charge[2]]
+            b.available = b.available.subtract(need)
+        else:
+            node = self.nodes[charge[1]]
+            node.available = node.available.subtract(need)
+
     def _schedule_once(self):
         with self.lock:
+            # 0. retry pending placement groups (resources may have freed or
+            # nodes joined — reference GcsPlacementGroupManager retry loop)
+            for pg in self.placement_groups.values():
+                if pg.state == "PENDING":
+                    self._try_reserve_pg(pg)
+
             # 1. actors first (they need fresh workers)
             still_pending_actors = []
             to_spawn = []
             for spec in self.pending_actors:
                 need = ResourceSet(spec.resources)
-                if need.is_subset_of(self.available):
-                    self.available = self.available.subtract(need)
-                    to_spawn.append((spec, need))
-                else:
+                if self._pg_is_gone(spec):
+                    entry = self.actors.get(spec.actor_id.hex())
+                    if entry is not None:
+                        entry.state = A_DEAD
+                        entry.death_reason = "placement group removed"
+                        self._push_actor_update(entry, spec.actor_id.hex())
+                        self._fail_actor_inflight(
+                            spec.actor_id.hex(), "placement group removed")
+                    continue
+                pick = self._pick_node(need, spec)
+                if pick is None:
                     still_pending_actors.append(spec)
+                    continue
+                node_id, charge = pick
+                self._charge_target_subtract(charge, need)
+                to_spawn.append((spec, need, node_id, charge))
             self.pending_actors = still_pending_actors
 
-            # 2. normal tasks to idle pool workers
+            # 2. normal tasks to idle pool workers on their chosen node
             dispatches = []
             still_pending = []
             idle = {
                 h: w for h, w in self.workers.items()
                 if w.kind == "pool" and w.state == "idle" and w.conn is not None
             }
-            n_workers = sum(1 for w in self.workers.values()
-                            if w.kind == "pool" and w.state != "dead")
-            # Workers already starting, per env_key: spawn only the deficit
-            # (resource-feasible demand minus workers already on the way),
-            # mirroring WorkerPool prestart accounting (worker_pool.h:159).
-            starting: Dict[str, int] = {}
+            # Per-node worker counts: max_workers_per_node caps each node's
+            # pool, not the cluster (a full head must not starve new nodes).
+            node_workers: Dict[str, int] = {}
+            # Workers already starting, per (node, env_key): spawn only the
+            # deficit (reference WorkerPool prestart accounting,
+            # worker_pool.h:159).
+            starting: Dict[tuple, int] = {}
             for w in self.workers.values():
-                if w.kind == "pool" and w.state == "starting":
-                    starting[w.env_key] = starting.get(w.env_key, 0) + 1
-            spawned_pool = 0
-            # Virtual availability: resources that *would* be in use if every
-            # dispatchable-but-workerless task had its worker already.
-            avail_virtual = self.available
+                if w.kind == "pool" and w.state != "dead":
+                    node_workers[w.node_id] = node_workers.get(
+                        w.node_id, 0) + 1
+                    if w.state == "starting":
+                        key = (w.node_id, w.env_key)
+                        starting[key] = starting.get(key, 0) + 1
+            # Virtual availability per charge target (node or PG bundle):
+            # resources that would be in use if every
+            # dispatchable-but-workerless task had its worker.
+            avail_virtual: Dict[tuple, ResourceSet] = {}
+
+            def virt_get(charge):
+                if charge not in avail_virtual:
+                    if charge[0] == "pg":
+                        pg = self.placement_groups.get(charge[1])
+                        avail_virtual[charge] = (
+                            pg.bundles[charge[2]].available
+                            if pg is not None and charge[2] < len(pg.bundles)
+                            else ResourceSet())
+                    else:
+                        node = self.nodes.get(charge[1])
+                        avail_virtual[charge] = (
+                            node.available if node is not None
+                            else ResourceSet())
+                return avail_virtual[charge]
             for spec in self.pending_tasks:
                 if not self._deps_ready(spec):
                     still_pending.append(spec)
                     continue
+                if self._pg_is_gone(spec):
+                    rec = self.tasks.get(spec.task_id.hex())
+                    if rec is not None:
+                        rec.state = "FAILED"
+                    self._fail_task_returns_with(
+                        spec, "placement group removed",
+                        kind="unschedulable")
+                    continue
                 need = ResourceSet(spec.resources)
-                if not need.is_subset_of(self.available):
+                pick = self._pick_node(need, spec)
+                if pick is None:
                     still_pending.append(spec)
                     continue
+                node_id, charge = pick
                 env_key = self._env_key_for(spec.resources, spec.runtime_env)
                 worker = next(
-                    (w for w in idle.values() if w.env_key == env_key), None)
+                    (w for w in idle.values()
+                     if w.env_key == env_key and w.node_id == node_id), None)
                 if worker is None:
-                    if need.is_subset_of(avail_virtual):
-                        avail_virtual = avail_virtual.subtract(need)
-                        if starting.get(env_key, 0) > 0:
-                            starting[env_key] -= 1  # one already on the way
-                        elif (n_workers + spawned_pool
+                    virt = virt_get(charge)
+                    if need.is_subset_of(virt):
+                        avail_virtual[charge] = virt.subtract(need)
+                        key = (node_id, env_key)
+                        if starting.get(key, 0) > 0:
+                            starting[key] -= 1  # one already on the way
+                        elif (node_workers.get(node_id, 0)
                                 < self.config.max_workers_per_node):
-                            self._spawn_worker(env_key=env_key, kind="pool")
-                            spawned_pool += 1
+                            self._spawn_worker(env_key=env_key, kind="pool",
+                                               node_id=node_id)
+                            node_workers[node_id] = node_workers.get(
+                                node_id, 0) + 1
                     still_pending.append(spec)
                     continue
                 del idle[worker.worker_hex]
-                self.available = self.available.subtract(need)
-                if need.is_subset_of(avail_virtual):
-                    avail_virtual = avail_virtual.subtract(need)
+                virt = virt_get(charge)  # snapshot BEFORE charging
+                self._charge_target_subtract(charge, need)
+                if need.is_subset_of(virt):
+                    avail_virtual[charge] = virt.subtract(need)
                 worker.acquired = need
+                worker.charge = charge
                 worker.state = "busy"
                 worker.current_task = spec.task_id.hex()
                 rec = self.tasks.get(spec.task_id.hex())
@@ -726,11 +1217,12 @@ class ControlServer:
                 dispatches.append((worker, spec))
             self.pending_tasks = still_pending
 
-            for spec, need in to_spawn:
+            for spec, need, node_id, charge in to_spawn:
                 w = self._spawn_worker(
                     env_key=self._env_key_for(spec.resources, spec.runtime_env),
-                    kind="actor")
+                    kind="actor", node_id=node_id)
                 w.acquired = need
+                w.charge = charge
                 w.actor_hex = spec.actor_id.hex()
                 entry = self.actors.get(spec.actor_id.hex())
                 if entry is not None:
@@ -759,11 +1251,12 @@ class ControlServer:
 
     # ------------------------------------------------------------------
     # Worker pool (counterpart of raylet WorkerPool::StartWorkerProcess)
-    def _spawn_worker(self, env_key: str, kind: str) -> WorkerInfo:
+    def _spawn_worker(self, env_key: str, kind: str,
+                      node_id: str = "head") -> WorkerInfo:
         """Lock held."""
         worker_id = WorkerID.from_random()
         w = WorkerInfo(worker_hex=worker_id.hex(), kind=kind, env_key=env_key,
-                       state="starting")
+                       state="starting", node_id=node_id)
         self.workers[worker_id.hex()] = w
 
         env = dict(os.environ)
@@ -773,6 +1266,7 @@ class ControlServer:
         env["RAY_TPU_WORKER_KIND"] = kind
         env["RAY_TPU_ENV_KEY"] = env_key
         env["RAY_TPU_NAMESPACE"] = self.namespace
+        env["RAY_TPU_NODE_ID"] = node_id
         cmd = [sys.executable, "-m", "ray_tpu.core.worker"]
         if env_key.startswith("tpu0") or not env_key.startswith("tpu"):
             # CPU-only worker: never let it grab the TPU runtime, and skip

@@ -253,6 +253,21 @@ class CoreClient:
     def fetch_func(self, func_id: str) -> Optional[bytes]:
         return self.client.call({"op": "get_func", "func_id": func_id})
 
+    @staticmethod
+    def _split_strategy(scheduling_strategy):
+        """Extract (pg_hex, bundle_index, residual_strategy).
+
+        PlacementGroupSchedulingStrategy becomes spec fields (the scheduler
+        keys on them); other strategies ship as-is."""
+        if scheduling_strategy is None:
+            return "", -1, None
+        if type(scheduling_strategy).__name__ == \
+                "PlacementGroupSchedulingStrategy":
+            pg = scheduling_strategy.placement_group
+            return (pg._pg_hex,
+                    scheduling_strategy.placement_group_bundle_index, None)
+        return "", -1, scheduling_strategy
+
     def submit_task(self, func_id: str, func_blob: bytes, args: Sequence[Any],
                     num_returns: int, resources: Dict[str, float],
                     max_retries: int, name: str = "",
@@ -262,6 +277,8 @@ class CoreClient:
         task_args = self._prepare_args(args, borrows)
         self.ensure_func(func_id, func_blob)
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        pg_hex, bundle_index, scheduling_strategy = self._split_strategy(
+            scheduling_strategy)
         spec = TaskSpec(
             task_id=TaskID.from_random(),
             func_id=func_id,
@@ -275,6 +292,8 @@ class CoreClient:
             owner=self.worker_hex,
             runtime_env=runtime_env,
             scheduling_strategy=scheduling_strategy,
+            placement_group_hex=pg_hex,
+            bundle_index=bundle_index,
             borrows=borrows,
         )
         self.client.send({"op": "submit_task", "spec": spec})
@@ -286,11 +305,14 @@ class CoreClient:
                      args: Sequence[Any], resources: Dict[str, float],
                      max_restarts: int, name: str, namespace: str,
                      max_concurrency: int,
-                     runtime_env: Optional[dict] = None) -> ActorID:
+                     runtime_env: Optional[dict] = None,
+                     scheduling_strategy=None) -> ActorID:
         borrows: List[str] = []
         task_args = self._prepare_args(args, borrows)
         self.ensure_func(class_id, class_blob)
         actor_id = ActorID.from_random()
+        pg_hex, bundle_index, scheduling_strategy = self._split_strategy(
+            scheduling_strategy)
         spec = ActorCreationSpec(
             actor_id=actor_id,
             class_id=class_id,
@@ -303,6 +325,9 @@ class CoreClient:
             max_concurrency=max_concurrency,
             owner=self.worker_hex,
             runtime_env=runtime_env,
+            scheduling_strategy=scheduling_strategy,
+            placement_group_hex=pg_hex,
+            bundle_index=bundle_index,
         )
         self.client.send({"op": "create_actor", "spec": spec})
         self.client.send({"op": "subscribe_actor", "actor": actor_id.hex()})

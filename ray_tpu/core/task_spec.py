@@ -81,5 +81,6 @@ class ActorCreationSpec:
     owner: str = ""
     placement_group_hex: str = ""
     bundle_index: int = -1
+    scheduling_strategy: Optional[Any] = None
     runtime_env: Optional[Dict[str, Any]] = None
     restart_count: int = 0

@@ -1,0 +1,33 @@
+"""Scheduling strategies (counterpart of python/ray/util/scheduling_strategies.py).
+
+Passed as ``scheduling_strategy=`` to @remote tasks/actors.  The control
+plane's scheduler (core/gcs.py _pick_node) interprets them; the default is
+the hybrid pack-then-spread policy mirroring the reference's
+HybridSchedulingPolicy (raylet/scheduling/policy/hybrid_scheduling_policy.h:50).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin to a node. soft=True allows fallback to any feasible node."""
+
+    node_id: str
+    soft: bool = False
+
+
+@dataclass
+class PlacementGroupSchedulingStrategy:
+    """Run inside a reserved placement-group bundle."""
+
+    placement_group: object  # PlacementGroup handle
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+SPREAD = "SPREAD"
+DEFAULT = "DEFAULT"

@@ -1,0 +1,70 @@
+"""End-to-end drive: multi-node cluster, placement groups, cancel."""
+import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("RAY_TPU_CHIPS", "none")
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import placement_group, remove_placement_group, \
+    PlacementGroupSchedulingStrategy, NodeAffinitySchedulingStrategy
+
+c = Cluster(head_node_args={"num_cpus": 2})
+print("[1] head up:", ray_tpu.cluster_resources())
+c.add_node(num_cpus=4, node_id="n2")
+print("[2] added n2:", ray_tpu.cluster_resources())
+
+# PG spanning both nodes
+pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_SPREAD")
+print("[3] strict-spread pg ready:", ray_tpu.get(pg.ready(), timeout=15))
+print("    bundles on:", sorted({b["node_id"] for b in pg.state()["bundles"]}))
+
+@ray_tpu.remote(num_cpus=2, scheduling_strategy=PlacementGroupSchedulingStrategy(placement_group=pg, placement_group_bundle_index=1))
+def in_bundle():
+    import os
+    return os.getpid()
+print("[4] task in bundle 1 pid:", ray_tpu.get(in_bundle.remote(), timeout=20))
+
+# cancel running
+@ray_tpu.remote
+def spin():
+    time.sleep(60)
+r = spin.remote(); time.sleep(0.7)
+print("[5] cancel running:", ray_tpu.cancel(r, force=True))
+try:
+    ray_tpu.get(r, timeout=10); print("[5] FAIL")
+except (ray_tpu.TaskCancelledError, ray_tpu.WorkerCrashedError) as e:
+    print("[5] raises", type(e).__name__)
+
+# node kill with actor restart
+@ray_tpu.remote(max_restarts=1, scheduling_strategy=NodeAffinitySchedulingStrategy(node_id="n2", soft=True))
+class S:
+    def __init__(self): self.v = 0
+    def bump(self): self.v += 1; return self.v
+a = S.remote()
+print("[6] actor on n2:", ray_tpu.get(a.bump.remote(), timeout=20))
+c.remove_node("n2")
+deadline = time.time() + 20
+while True:
+    try:
+        v = ray_tpu.get(a.bump.remote(), timeout=5); break
+    except ray_tpu.ActorError:
+        if time.time() > deadline: raise
+        time.sleep(0.2)
+print("[6] after node kill, restarted actor:", v)
+
+# PROBES
+try:
+    placement_group([{"CPU": 1}], strategy="BANANAS")
+except ValueError as e:
+    print("[P1] bad strategy -> ValueError")
+pg2 = placement_group([{"CPU": 99}])
+print("[P2] infeasible pg wait(0.3):", pg2.wait(0.3), "state:", pg2.state()["state"])
+remove_placement_group(pg2)
+print("[P3] remove pending pg ok; state:", pg2.state()["state"])
+print("[P4] remove same pg twice:", end=" ")
+remove_placement_group(pg2); print("no crash")
+print("[P5] cancel same ref twice:", ray_tpu.cancel(r, force=True))
+remove_placement_group(pg)
+print("[7] available after all removals:", ray_tpu.available_resources())
+c.shutdown()
+print("ALL OK")

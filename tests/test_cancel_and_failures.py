@@ -1,0 +1,81 @@
+"""Task cancel + failure semantics (counterpart of
+python/ray/tests/test_cancel.py, test_failure*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+
+
+def test_cancel_pending_task(ray_start_regular):
+    @ray_tpu.remote
+    def hog():
+        time.sleep(30)
+
+    @ray_tpu.remote
+    def queued():
+        return 1
+
+    # fill all 4 CPUs, then queue one more and cancel it while pending
+    hogs = [hog.remote() for _ in range(4)]
+    time.sleep(0.5)
+    victim = queued.remote()
+    assert ray_tpu.cancel(victim)
+    with pytest.raises(ray_tpu.TaskCancelledError):
+        ray_tpu.get(victim, timeout=5)
+    for h in hogs:
+        ray_tpu.cancel(h, force=True)
+
+
+def test_cancel_running_task_force(ray_start_regular):
+    @ray_tpu.remote
+    def forever():
+        time.sleep(60)
+
+    ref = forever.remote()
+    time.sleep(0.8)  # ensure running
+    assert ray_tpu.cancel(ref, force=True)
+    with pytest.raises((ray_tpu.TaskCancelledError,
+                        ray_tpu.WorkerCrashedError)):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_cancel_finished_task_noop(ray_start_regular):
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=10) == 7
+    assert not ray_tpu.cancel(ref)
+    assert ray_tpu.get(ref) == 7  # value untouched
+
+
+def test_task_retry_on_worker_crash(ray_start_regular):
+    """A task that kills its worker on first attempt succeeds via retry."""
+    marker = ray_tpu.put(0)  # shared flag via kv would be cleaner; use file
+
+    import tempfile, os
+    path = tempfile.mktemp()
+
+    @ray_tpu.remote(max_retries=2)
+    def die_once(p):
+        import os
+        if not os.path.exists(p):
+            open(p, "w").close()
+            os._exit(1)  # hard crash, no exception path
+        return "survived"
+
+    assert ray_tpu.get(die_once.remote(path), timeout=30) == "survived"
+    os.unlink(path)
+
+
+def test_no_retry_exhausted(ray_start_regular):
+    @ray_tpu.remote(max_retries=0)
+    def always_dies():
+        import os
+        os._exit(1)
+
+    with pytest.raises(ray_tpu.WorkerCrashedError):
+        ray_tpu.get(always_dies.remote(), timeout=30)

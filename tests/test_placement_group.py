@@ -1,0 +1,136 @@
+"""Placement groups: reservation strategies, scheduling into bundles,
+removal semantics (counterpart of python/ray/tests/test_placement_group*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (
+    PlacementGroupSchedulingStrategy,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+@pytest.fixture
+def cluster():
+    c = Cluster(head_node_args={"num_cpus": 2})
+    yield c
+    c.shutdown()
+
+
+def test_pg_ready_and_table(cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert ray_tpu.get(pg.ready(), timeout=10) is True
+    assert pg.wait(5)
+    table = placement_group_table()
+    assert any(e["pg_id"] == pg._pg_hex and e["state"] == "CREATED"
+               for e in table)
+
+
+def test_pg_infeasible_stays_pending(cluster):
+    pg = placement_group([{"CPU": 64}])
+    assert not pg.wait(0.4)
+    st = pg.state()
+    assert st["state"] == "PENDING"
+    # becomes feasible when a big node joins
+    cluster.add_node(num_cpus=64, node_id="big")
+    assert pg.wait(10)
+
+
+def test_strict_spread_needs_enough_nodes(cluster):
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert not pg.wait(0.4)
+    cluster.add_node(num_cpus=1, node_id="s1")
+    cluster.add_node(num_cpus=1, node_id="s2")
+    assert pg.wait(10)
+    nodes = {b["node_id"] for b in pg.state()["bundles"]}
+    assert len(nodes) == 3
+
+
+def test_strict_pack_single_node(cluster):
+    cluster.add_node(num_cpus=4, node_id="fat")
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_PACK")
+    assert pg.wait(10)
+    nodes = {b["node_id"] for b in pg.state()["bundles"]}
+    assert len(nodes) == 1
+
+
+def test_task_runs_in_bundle(cluster):
+    pg = placement_group([{"CPU": 2}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=2, scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0))
+    def inside():
+        return "in-bundle"
+
+    # head has only 2 CPUs, all reserved by the PG: the task can only run
+    # via the bundle reservation.
+    assert ray_tpu.get(inside.remote(), timeout=20) == "in-bundle"
+    st = pg.state()
+    assert st["bundles"][0]["reserved"]["CPU"] == 2.0
+
+
+def test_task_without_pg_blocked_by_reservation(cluster):
+    pg = placement_group([{"CPU": 2}])  # reserves ALL head CPUs
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    def outside():
+        return 1
+
+    ref = outside.remote()
+    ready, not_ready = ray_tpu.wait([ref], timeout=1.0)
+    assert not ready  # starved by the reservation
+    remove_placement_group(pg)
+    assert ray_tpu.get(ref, timeout=20) == 1  # released resources free it
+
+
+def test_actor_in_pg(cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg))
+    class A:
+        def hi(self):
+            return "hi"
+
+    a = A.remote()
+    assert ray_tpu.get(a.hi.remote(), timeout=20) == "hi"
+    # removing the PG kills its actors
+    remove_placement_group(pg)
+    with pytest.raises(ray_tpu.ActorError):
+        for _ in range(50):
+            ray_tpu.get(a.hi.remote(), timeout=10)
+            time.sleep(0.1)
+
+
+def test_pg_validation():
+    with pytest.raises(ValueError):
+        placement_group([], strategy="PACK")
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+
+
+def test_pending_task_fails_when_pg_removed(cluster):
+    pg = placement_group([{"CPU": 2}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=2, scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg))
+    def blocked():
+        return 1
+
+    # occupy the bundle so the second task stays pending
+    r1 = blocked.remote()
+    ray_tpu.get(r1, timeout=20)
+    hold = blocked.remote()  # may run; then a third waits
+    waiting = blocked.remote()
+    remove_placement_group(pg)
+    with pytest.raises((ray_tpu.TaskUnschedulableError, ray_tpu.RayTpuError)):
+        ray_tpu.get(waiting, timeout=15)
