@@ -1,0 +1,106 @@
+"""Headline benchmark: sharded transformer training throughput on TPU.
+
+Prints ONE JSON line:
+  {"metric": "train_mfu", "value": <fraction>, "unit": "MFU",
+   "vs_baseline": <value / 0.40>, ...}
+
+Baseline: the reference has no in-tree tokens/sec numbers (BASELINE.md —
+its LLM release tests are pass/fail); the north-star target recorded in
+BASELINE.json is >=40% MFU, so vs_baseline = measured_MFU / 0.40.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+# Peak bf16 FLOP/s per chip by device kind (public TPU specs).
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5": 459e12,    # v5p
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower().replace(" ", "")
+    for key in sorted(_PEAK_FLOPS, key=len, reverse=True):
+        if key in kind:
+            return _PEAK_FLOPS[key]
+    if device.platform == "cpu":
+        return 1e12  # nominal, so the CPU smoke run still prints a line
+    return 275e12
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.parallel.mesh import build_mesh
+    from ray_tpu.train.train_state import ShardedTrainStep, default_optimizer
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+
+    if on_tpu:
+        config = tfm.TransformerConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=6144,
+            num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=1024,
+        )
+        batch, seq, steps = 8, 1024, 20
+    else:  # CPU smoke mode — same code path, tiny shapes
+        config = tfm.TransformerConfig.tiny()
+        batch, seq, steps = 4, 64, 3
+
+    mesh = build_mesh(axes={"data": len(devices)}, devices=devices)
+    ts = ShardedTrainStep(
+        config, mesh,
+        optimizer=default_optimizer(warmup_steps=10, total_steps=1000))
+    state = ts.init(jax.random.key(0))
+
+    rng = np.random.default_rng(0)
+    batch_np = {
+        "tokens": jnp.asarray(
+            rng.integers(0, config.vocab_size, (batch, seq + 1)),
+            dtype=jnp.int32)
+    }
+
+    # warmup / compile.  NOTE: sync via scalar D2H fetch (float()), not
+    # block_until_ready — the latter is a no-op on some PJRT transports.
+    state, metrics = ts.step(state, batch_np)
+    float(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = ts.step(state, batch_np)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tok_per_sec = tokens / dt
+    flops_tok = tfm.flops_per_token(config, seq)
+    peak = _peak_flops(devices[0]) * len(devices)
+    mfu = tok_per_sec * flops_tok / peak
+
+    print(json.dumps({
+        "metric": "train_mfu",
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "tokens_per_sec_per_chip": round(tok_per_sec / len(devices), 1),
+        "model_params": tfm.num_params(config),
+        "device": getattr(devices[0], "device_kind", devices[0].platform),
+        "n_devices": len(devices),
+        "final_loss": round(final_loss, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

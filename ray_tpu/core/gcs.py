@@ -288,7 +288,8 @@ class ControlServer:
                     self.pending_tasks.append(spec)
                 else:
                     rec.state = "FAILED"
-                    self._fail_task_returns(spec, f"worker died: {reason}")
+                    self._fail_task_returns_with(
+                        spec, f"worker died: {reason}")
             w.current_task = None
         if w.actor_hex:
             entry = self.actors.get(w.actor_hex)
@@ -327,10 +328,6 @@ class ControlServer:
             if entry is None or entry.state == PENDING:
                 self._store_object_locked(
                     obj_hex, inline=data, size=len(data), is_error=True)
-
-    def _fail_task_returns(self, spec: TaskSpec, reason: str):
-        """Lock held. Store WorkerCrashedError in the task's return objects."""
-        self._fail_task_returns_with(spec, reason)
 
     # ------------------------------------------------------------------
     # Registration
@@ -737,9 +734,14 @@ class ControlServer:
                 return False
             node.alive = False
             node.available = ResourceSet()
-            for w in self.workers.values():
+            for w in list(self.workers.values()):
                 if w.node_id == node_id and w.state != "dead":
                     to_kill.append(w)
+                    if w.conn is None:
+                        # Never registered: no disconnect event will ever
+                        # fire, so observe the death here or its task/actor
+                        # hangs forever.
+                        self._mark_worker_dead(w, f"node {node_id} removed")
             # PGs with bundles on this node lose them
             for pg in self.placement_groups.values():
                 if pg.state == "CREATED" and any(
@@ -784,9 +786,9 @@ class ControlServer:
         if strategy in ("PACK", "STRICT_PACK"):
             # try to put everything on one node (best = most utilized that
             # fits all); PACK falls back to spreading the remainder.
+            total = ResourceSet(_sum_bundles(pg.bundle_specs))
             for n in sorted(alive, key=self._utilization, reverse=True):
-                if all(ResourceSet(b).is_subset_of(n.available)
-                       for b in [_sum_bundles(pg.bundle_specs)]):
+                if total.is_subset_of(n.available):
                     placement = [n.node_id] * len(needs)
                     break
             if not placement:
@@ -843,13 +845,26 @@ class ControlServer:
         pg.state = "REMOVED"
         pg.bundles = []
         # exit workers charged against this PG
-        for w in self.workers.values():
+        for w in list(self.workers.values()):
             if w.charge and w.charge[0] == "pg" and w.charge[1] == pg.pg_hex:
                 if w.conn is not None:
                     try:
                         w.conn.push({"op": "exit"})
                     except Exception:
                         pass
+                elif w.state == "starting":
+                    # Spawned but not yet registered: it can never receive
+                    # the exit push, so mark dead now (releases the charge;
+                    # an actor restart attempt then fails via
+                    # _unschedulable_reason) and reap the process.  Should
+                    # it still register, _op_worker_online sees state=dead
+                    # and tells it to exit.
+                    self._mark_worker_dead(w, reason)
+                    if w.proc is not None:
+                        try:
+                            w.proc.terminate()
+                        except Exception:
+                            pass
 
     def _op_create_pg(self, conn, msg):
         pg = PlacementGroupEntry(
@@ -1073,15 +1088,29 @@ class ControlServer:
                                                 not n.is_head))
         return node.node_id, ("node", node.node_id)
 
-    def _pg_is_gone(self, spec) -> bool:
-        """Lock held. True if the spec targets a PG that no longer exists —
-        the work can never schedule and must fail (reference fails these
-        with a scheduling error rather than pending forever)."""
+    def _unschedulable_reason(self, spec) -> Optional[str]:
+        """Lock held. Non-None if the spec can NEVER schedule — removed PG,
+        out-of-range bundle index, or hard node affinity to a dead/missing
+        node.  The reference fails these fast with a scheduling error
+        (TaskUnschedulableError) rather than pending forever."""
         pg_hex = getattr(spec, "placement_group_hex", "")
-        if not pg_hex:
-            return False
-        pg = self.placement_groups.get(pg_hex)
-        return pg is None or pg.state == "REMOVED"
+        if pg_hex:
+            pg = self.placement_groups.get(pg_hex)
+            if pg is None or pg.state == "REMOVED":
+                return "placement group removed"
+            bi = getattr(spec, "bundle_index", -1)
+            if bi >= len(pg.bundle_specs):
+                return (f"bundle index {bi} out of range "
+                        f"(placement group has {len(pg.bundle_specs)})")
+            return None
+        st = getattr(spec, "scheduling_strategy", None)
+        if (st is not None
+                and type(st).__name__ == "NodeAffinitySchedulingStrategy"
+                and not st.soft):
+            node = self.nodes.get(st.node_id)
+            if node is None or not node.alive:
+                return f"node {st.node_id} is dead or does not exist"
+        return None
 
     def _charge_target_subtract(self, charge: tuple, need: ResourceSet):
         """Lock held."""
@@ -1105,14 +1134,14 @@ class ControlServer:
             to_spawn = []
             for spec in self.pending_actors:
                 need = ResourceSet(spec.resources)
-                if self._pg_is_gone(spec):
+                why = self._unschedulable_reason(spec)
+                if why is not None:
                     entry = self.actors.get(spec.actor_id.hex())
                     if entry is not None:
                         entry.state = A_DEAD
-                        entry.death_reason = "placement group removed"
+                        entry.death_reason = why
                         self._push_actor_update(entry, spec.actor_id.hex())
-                        self._fail_actor_inflight(
-                            spec.actor_id.hex(), "placement group removed")
+                        self._fail_actor_inflight(spec.actor_id.hex(), why)
                     continue
                 pick = self._pick_node(need, spec)
                 if pick is None:
@@ -1167,13 +1196,13 @@ class ControlServer:
                 if not self._deps_ready(spec):
                     still_pending.append(spec)
                     continue
-                if self._pg_is_gone(spec):
+                why = self._unschedulable_reason(spec)
+                if why is not None:
                     rec = self.tasks.get(spec.task_id.hex())
                     if rec is not None:
                         rec.state = "FAILED"
                     self._fail_task_returns_with(
-                        spec, "placement group removed",
-                        kind="unschedulable")
+                        spec, why, kind="unschedulable")
                     continue
                 need = ResourceSet(spec.resources)
                 pick = self._pick_node(need, spec)
@@ -1304,6 +1333,14 @@ class ControlServer:
         with self.lock:
             w = self.workers.get(worker_hex)
             if w is None:
+                return
+            if w.state == "dead":
+                # Doomed while starting (e.g. its placement group was
+                # removed before it registered): tell it to exit.
+                try:
+                    conn.push({"op": "exit"})
+                except Exception:
+                    pass
                 return
             if w.kind == "pool" and w.state == "starting":
                 w.state = "idle"

@@ -123,7 +123,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # lse is logically [block_q]; stored broadcast over an 8-sublane axis so
+    # the block shape ends in (8, block_q) per Mosaic's tiling constraint.
+    lse_ref[0] = jnp.broadcast_to(
+        (m + jnp.log(l))[:, 0][None, :], (8, block_q))
 
 
 def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
@@ -151,16 +154,16 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, 8, block_q), lambda bh, i: (bh, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, 8, sq), jnp.float32),
         ],
         interpret=_interpret_mode(),
     )(qf, kf, vf)
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    return out, lse
+    return out, lse[:, 0, :]
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,152 @@
+"""Sharded train state + jitted training step for the flagship transformer.
+
+The reference's per-strategy process-group setup (train/torch/config.py:65
+`_setup_torch_process_group`, DDP wrap in train_loop_utils.py:158) collapses on
+TPU into ONE jitted function over a named mesh: GSPMD inserts the gradient
+psum on the `data`/`fsdp` axes, parameter all-gathers for FSDP, and tensor
+collectives for TP.  This module owns that step; trainers (train/),
+learners (rl/) and the bench harness all reuse it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import transformer as tfm
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    Rules,
+    data_sharding,
+    tree_shardings,
+)
+
+
+def default_optimizer(learning_rate: float = 3e-4,
+                      weight_decay: float = 0.1,
+                      warmup_steps: int = 100,
+                      total_steps: int = 10000,
+                      b1: float = 0.9, b2: float = 0.95,
+                      grad_clip: float = 1.0) -> optax.GradientTransformation:
+    """AdamW + cosine schedule + global-norm clip — the Llama recipe."""
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(sched, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def _constrain_like_params(tree: Any, params_treedef, param_shardings):
+    """Apply param shardings to every params-shaped sub-pytree (optax mu/nu).
+
+    Optimizer state is a nest of (named)tuples whose momentum terms mirror the
+    param tree; walking the nest and constraining matching subtrees keeps the
+    optimizer sharded FSDP-style with zero per-optimizer knowledge.
+    """
+
+    def rec(x):
+        try:
+            if jax.tree.structure(x) == params_treedef:
+                return jax.tree.map(
+                    jax.lax.with_sharding_constraint, x, param_shardings)
+        except Exception:
+            pass
+        if hasattr(x, "_fields"):  # NamedTuple
+            return type(x)(*[rec(v) for v in x])
+        if isinstance(x, tuple):
+            return tuple(rec(v) for v in x)
+        if isinstance(x, list):
+            return [rec(v) for v in x]
+        if isinstance(x, dict):
+            return {k: rec(v) for k, v in x.items()}
+        return x
+
+    return rec(tree)
+
+
+class ShardedTrainStep:
+    """Factory for sharded init/step functions on a mesh.
+
+    Usage:
+        ts = ShardedTrainStep(config, mesh)
+        state = ts.init(jax.random.key(0))
+        state, metrics = ts.step(state, batch)   # batch: {"tokens": [b, s+1]}
+    """
+
+    def __init__(self, config: tfm.TransformerConfig, mesh,
+                 optimizer: Optional[optax.GradientTransformation] = None,
+                 rules: Rules = DEFAULT_RULES,
+                 loss_fn: Optional[Callable] = None):
+        self.config = config
+        self.mesh = mesh
+        self.optimizer = optimizer or default_optimizer()
+        self.rules = rules
+        self.loss_fn = loss_fn or (
+            lambda p, b: tfm.loss_fn(p, b, config))
+        self.param_logical = tfm.logical_axes(config)
+        self.param_shardings = tree_shardings(
+            mesh, self.param_logical, rules)
+        self.batch_sharding = data_sharding(mesh)
+        self._params_treedef = jax.tree.structure(self.param_logical)
+
+        self._init = jax.jit(self._init_fn)
+        self._step = jax.jit(self._step_fn, donate_argnums=(0,))
+
+    # -- init ---------------------------------------------------------------
+    def _init_fn(self, rng):
+        params = tfm.init_params(self.config, rng)
+        params = jax.tree.map(
+            jax.lax.with_sharding_constraint, params, self.param_shardings)
+        opt_state = self.optimizer.init(params)
+        opt_state = _constrain_like_params(
+            opt_state, self._params_treedef, self.param_shardings)
+        return {"params": params, "opt_state": opt_state,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def init(self, rng):
+        with self.mesh:
+            return self._init(rng)
+
+    # -- step ---------------------------------------------------------------
+    def _step_fn(self, state, batch):
+        def loss(p):
+            return self.loss_fn(p, batch)
+
+        loss_val, grads = jax.value_and_grad(loss)(state["params"])
+        grads = jax.tree.map(
+            jax.lax.with_sharding_constraint, grads, self.param_shardings)
+        updates, opt_state = self.optimizer.update(
+            grads, state["opt_state"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        params = jax.tree.map(
+            jax.lax.with_sharding_constraint, params, self.param_shardings)
+        metrics = {
+            "loss": loss_val.astype(jnp.float32),
+            "grad_norm": optax.global_norm(grads).astype(jnp.float32),
+            "step": state["step"] + 1,
+        }
+        return {"params": params, "opt_state": opt_state,
+                "step": state["step"] + 1}, metrics
+
+    def step(self, state, batch):
+        batch = jax.device_put(batch, self.batch_sharding)
+        with self.mesh:
+            return self._step(state, batch)
+
+    # -- eval ----------------------------------------------------------------
+    @functools.cached_property
+    def _eval(self):
+        def eval_fn(params, batch):
+            return self.loss_fn(params, batch).astype(jnp.float32)
+
+        return jax.jit(eval_fn)
+
+    def eval_step(self, params, batch):
+        batch = jax.device_put(batch, self.batch_sharding)
+        with self.mesh:
+            return self._eval(params, batch)

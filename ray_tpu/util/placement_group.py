@@ -47,7 +47,10 @@ class PlacementGroup:
         """ObjectRef that resolves to True once all bundles are reserved."""
         return ObjectRef(ObjectID.from_hex(self._ready_obj_hex))
 
-    def wait(self, timeout_seconds: Optional[float] = None) -> bool:
+    def wait(self, timeout_seconds: Optional[float] = 30) -> bool:
+        """Block until all bundles are reserved. Defaults to a 30 s bound
+        (matching the reference util/placement_group.py wait); pass None to
+        wait indefinitely."""
         deadline = (None if timeout_seconds is None
                     else time.monotonic() + timeout_seconds)
         rt = get_runtime()

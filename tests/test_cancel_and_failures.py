@@ -54,8 +54,6 @@ def test_cancel_finished_task_noop(ray_start_regular):
 
 def test_task_retry_on_worker_crash(ray_start_regular):
     """A task that kills its worker on first attempt succeeds via retry."""
-    marker = ray_tpu.put(0)  # shared flag via kv would be cleaner; use file
-
     import tempfile, os
     path = tempfile.mktemp()
 

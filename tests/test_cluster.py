@@ -138,3 +138,27 @@ def test_spread_rotates_zero_cpu_tasks(cluster):
     # zero-resource SPREAD tasks must not all pile on one node
     nodes = set(ray_tpu.get([where.remote() for _ in range(8)], timeout=30))
     assert len(nodes) >= 2, nodes
+
+
+def test_hard_node_affinity_to_dead_node_fails_fast(cluster):
+    """Hard affinity to a dead/missing node must raise
+    TaskUnschedulableError, not pend forever (reference fails these with a
+    scheduling error)."""
+    cluster.add_node(num_cpus=1, node_id="gone")
+    cluster.remove_node("gone")
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id="gone"))
+    def f():
+        return 1
+
+    with pytest.raises(ray_tpu.TaskUnschedulableError):
+        ray_tpu.get(f.remote(), timeout=10)
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id="never-existed"))
+    def g():
+        return 1
+
+    with pytest.raises(ray_tpu.TaskUnschedulableError):
+        ray_tpu.get(g.remote(), timeout=10)
