@@ -1,0 +1,48 @@
+"""ray_tpu.train: distributed training library (reference: python/ray/train).
+
+JaxTrainer runs a user loop on a worker group of actors; the JAX backend
+joins them into one multi-process runtime (jax.distributed) so a single
+jitted, mesh-sharded train step spans all workers' devices.
+"""
+
+from ray_tpu.train.backend import Backend, BackendConfig, JaxBackendConfig
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    StorageContext,
+    load_pytree,
+    save_pytree,
+)
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    get_dataset_shard,
+    report,
+)
+from ray_tpu.train.train_loop_utils import (
+    get_mesh,
+    prepare_pytree,
+    shard_batch,
+)
+from ray_tpu.train.trainer import (
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+    TrainingFailedError,
+)
+
+__all__ = [
+    "Backend", "BackendConfig", "JaxBackendConfig",
+    "Checkpoint", "StorageContext", "save_pytree", "load_pytree",
+    "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "TrainContext", "report", "get_checkpoint", "get_context",
+    "get_dataset_shard",
+    "get_mesh", "prepare_pytree", "shard_batch",
+    "DataParallelTrainer", "JaxTrainer", "Result", "TrainingFailedError",
+]

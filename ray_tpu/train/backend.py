@@ -1,0 +1,135 @@
+"""Training backends: per-worker runtime setup before the user loop runs.
+
+Counterpart of the reference's train/backend.py `Backend` ABC (:32,
+on_start/on_training_start/on_shutdown) and train/torch/config.py
+(`_setup_torch_process_group` :65 — TCP-store rendezvous + NCCL).  The
+TPU-native backend swaps the NCCL process group for
+`jax.distributed.initialize`: after it, every worker sees the GLOBAL device
+set and one jitted program spans the whole mesh — no per-collective process
+groups exist to manage (SURVEY.md §3.4 swap point).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    def on_start(self, worker_group, backend_config: BackendConfig):
+        pass
+
+    def on_training_start(self, worker_group, backend_config: BackendConfig):
+        pass
+
+    def on_shutdown(self, worker_group, backend_config: BackendConfig):
+        pass
+
+
+@dataclasses.dataclass
+class JaxBackendConfig(BackendConfig):
+    """distributed_init: run jax.distributed.initialize across workers so
+    they form one multi-process JAX runtime (None = auto: only when
+    num_workers > 1).  host_device_count: force N virtual CPU devices per
+    worker (test mode — SURVEY.md §4 blueprint); platform: override
+    JAX_PLATFORMS in workers."""
+
+    distributed_init: Optional[bool] = None
+    coordinator_port: int = 0
+    platform: Optional[str] = None
+    host_device_count: Optional[int] = None
+
+    @property
+    def backend_cls(self):
+        return JaxBackend
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _jax_env(config: JaxBackendConfig) -> Dict[str, str]:
+    env: Dict[str, str] = {}
+    if config.platform:
+        env["JAX_PLATFORMS"] = config.platform
+    if config.host_device_count:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count="
+            f"{config.host_device_count}")
+    return env
+
+
+def _init_jax_distributed(coordinator: str, num_processes: int,
+                          process_id: int, platform: Optional[str]):
+    """Runs ON the train worker (before any other jax use there).
+
+    Env vars (JAX_PLATFORMS / XLA_FLAGS) were already applied by
+    TrainWorker.__init__ from _jax_env — the single authoritative path;
+    only the jax.config override is needed here because a sitecustomize
+    that imported jax first would ignore the env var."""
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id)
+    return {"process_id": jax.process_index(),
+            "global_devices": len(jax.devices()),
+            "local_devices": len(jax.local_devices())}
+
+
+def _shutdown_jax_distributed():
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+    return True
+
+
+class JaxBackend(Backend):
+    def on_start(self, worker_group, backend_config: JaxBackendConfig):
+        import ray_tpu
+
+        n = worker_group.num_workers
+        do_dist = backend_config.distributed_init
+        if do_dist is None:
+            do_dist = n > 1
+        if not do_dist:
+            return
+        port = backend_config.coordinator_port or _free_port()
+        coordinator = f"127.0.0.1:{port}"
+        # TODO multi-node: use rank-0 worker's node IP from node_info().
+        refs = [
+            w.run.remote(
+                _init_jax_distributed, coordinator, n, i,
+                backend_config.platform)
+            for i, w in enumerate(worker_group.workers)
+        ]
+        infos = ray_tpu.get(refs, timeout=120)
+        total = infos[0]["global_devices"]
+        for info in infos:
+            assert info["global_devices"] == total, infos
+
+    def on_shutdown(self, worker_group, backend_config: JaxBackendConfig):
+        import ray_tpu
+
+        try:
+            ray_tpu.get(
+                [w.run.remote(_shutdown_jax_distributed)
+                 for w in worker_group.workers], timeout=30)
+        except Exception:
+            pass

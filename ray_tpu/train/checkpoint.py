@@ -1,0 +1,147 @@
+"""Checkpoint handle + pytree save/restore (orbax-backed).
+
+Counterpart of the reference's train/_checkpoint.py `Checkpoint` (a directory
+handle moved through pyarrow.fs) and train/_internal/storage.py
+StorageContext.persist_current_checkpoint (:508).  TPU-native addition:
+first-class JAX pytree (de)serialization via orbax, including sharded arrays —
+restore takes an optional sharding tree so params land distributed, never
+gathered to one host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, Optional
+
+
+class Checkpoint:
+    """A directory of checkpoint data (framework-agnostic handle)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    # -- metrics sidecar ----------------------------------------------------
+    def update_metadata(self, meta: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(meta, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, ".metadata.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+
+# ---------------------------------------------------------------------------
+# JAX pytree persistence (orbax)
+# ---------------------------------------------------------------------------
+
+def save_pytree(tree: Any, directory: str, *, step: Optional[int] = None,
+                force: bool = True) -> str:
+    """Save a JAX pytree (sharded arrays fine) under `directory`."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"step_{step}") if step is not None \
+        else directory
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, tree, force=force)
+    return path
+
+
+def load_pytree(path: str, *, target: Any = None,
+                shardings: Any = None) -> Any:
+    """Restore a pytree. With `shardings` (a pytree of NamedSharding),
+    arrays are restored directly onto devices with that placement."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.PyTreeCheckpointer()
+    if shardings is not None:
+        import jax
+
+        def spec(s):
+            return ocp.ArrayRestoreArgs(sharding=s)
+
+        restore_args = jax.tree.map(spec, shardings)
+        return ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(
+                restore_args=restore_args))
+    if target is not None:
+        return ckptr.restore(path, item=target)
+    return ckptr.restore(path)
+
+
+# ---------------------------------------------------------------------------
+# Storage context: where run output lands (reference storage.py:352)
+# ---------------------------------------------------------------------------
+
+class StorageContext:
+    """Filesystem layout for one run: storage_path/run_name/checkpoint_NNN."""
+
+    def __init__(self, storage_path: Optional[str], name: Optional[str],
+                 num_to_keep: Optional[int] = None):
+        self.storage_path = os.path.abspath(
+            storage_path or os.path.join(
+                tempfile.gettempdir(), "ray_tpu_results"))
+        self.name = name or f"run_{int(time.time())}"
+        self.run_dir = os.path.join(self.storage_path, self.name)
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        # Resume-safe: continue numbering after any checkpoints already in
+        # the run dir (a restarted attempt must never overwrite them).
+        existing = self._list()
+        self._seq = (
+            int(os.path.basename(existing[-1]).split("_")[-1]) + 1
+            if existing else 0)
+
+    def persist_checkpoint(self, local_dir: str,
+                           metrics: Optional[Dict] = None) -> Checkpoint:
+        """Move a worker-local checkpoint dir into run storage."""
+        dest = os.path.join(self.run_dir, f"checkpoint_{self._seq:06d}")
+        self._seq += 1
+        shutil.copytree(local_dir, dest, dirs_exist_ok=True)
+        ckpt = Checkpoint(dest)
+        if metrics:
+            ckpt.update_metadata({"metrics": metrics, "time": time.time()})
+        self._gc()
+        return ckpt
+
+    def latest_checkpoint(self) -> Optional[Checkpoint]:
+        cks = self._list()
+        return Checkpoint(cks[-1]) if cks else None
+
+    def _list(self):
+        if not os.path.isdir(self.run_dir):
+            return []
+        return sorted(
+            os.path.join(self.run_dir, d) for d in os.listdir(self.run_dir)
+            if d.startswith("checkpoint_"))
+
+    def _gc(self):
+        if self.num_to_keep is None:
+            return
+        cks = self._list()
+        for old in cks[:max(0, len(cks) - self.num_to_keep)]:
+            shutil.rmtree(old, ignore_errors=True)

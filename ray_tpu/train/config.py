@@ -1,0 +1,60 @@
+"""Train/AIR config dataclasses.
+
+Counterparts of the reference's python/ray/air/config.py (ScalingConfig,
+RunConfig, FailureConfig, CheckpointConfig) with TPU-first fields: workers
+request TPU chips instead of GPUs, and mesh axes are declared here so the
+backend can build one global `jax.sharding.Mesh` across the worker group.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many training workers and what each needs.
+
+    num_workers: host-level workers (actors). On TPU one worker per host,
+    each driving its local chips through one jax runtime (the reference's
+    worker==GPU-process model becomes worker==host, SURVEY.md §7 step 5).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[Dict[str, float]] = None
+    tpu_chips_per_worker: int = 1  # chips reserved per worker
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = float(self.tpu_chips_per_worker)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: worker-group restarts before giving up (reference
+    FailureConfig air/config.py; restart logic backend_executor._restart)."""
+
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0  # library-driven ckpt every N reports (0=user)
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None  # default: /tmp/ray_tpu_results
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)

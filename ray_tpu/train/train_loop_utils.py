@@ -1,0 +1,47 @@
+"""In-loop helpers: the JAX equivalents of ray.train.torch.prepare_model /
+prepare_data_loader (reference train/torch/train_loop_utils.py:158/:200).
+
+On torch, "prepare" wraps the model in DDP and the loader in a distributed
+sampler.  On TPU/JAX, "prepare" means: build the global mesh once, device_put
+params with their GSPMD shardings, and shard each host batch onto the data
+axes — after which the jitted step needs no further distribution code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ray_tpu.parallel.mesh import build_mesh
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    Rules,
+    data_sharding,
+    shard_tree,
+)
+
+
+def get_mesh(axes: Optional[Dict[str, int]] = None,
+             dcn_axes=()) -> Any:
+    """Global mesh over every device in the (possibly multi-process) runtime.
+
+    Call after the JaxBackend ran jax.distributed.initialize: jax.devices()
+    is then the global device set, so the same mesh (and the same jitted
+    program) spans all train workers.
+    """
+    return build_mesh(axes=axes or {}, dcn_axes=dcn_axes)
+
+
+def prepare_pytree(params: Any, mesh=None, rules: Rules = DEFAULT_RULES,
+                   logical_axes: Any = None) -> Any:
+    """Shard a parameter pytree onto the mesh (prepare_model equivalent)."""
+    mesh = mesh if mesh is not None else get_mesh()
+    return shard_tree(params, mesh, rules, logical_axes)
+
+
+def shard_batch(batch: Any, mesh=None) -> Any:
+    """Place a host batch with its leading dim over the data axes
+    (prepare_data_loader equivalent — per-batch, iterator-agnostic)."""
+    import jax
+
+    mesh = mesh if mesh is not None else get_mesh()
+    return jax.device_put(batch, data_sharding(mesh))

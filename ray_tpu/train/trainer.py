@@ -1,0 +1,199 @@
+"""DataParallelTrainer / JaxTrainer: the Train-library driver.
+
+Counterpart of the reference's train/data_parallel_trainer.py (:25,
+training_loop :428) + train/_internal/backend_executor.py (:67; start :129
+creates PG + WorkerGroup, start_training :441 wires sessions,
+get_with_failure_handling :675 and _restart :736 for fault tolerance) +
+train/trainer.py TrainingIterator (:31).  Collapsed into one driver class:
+our worker group already runs sessions worker-side.
+
+JaxTrainer is to this what the reference's TorchTrainer is to
+DataParallelTrainer — the JAX backend is the default.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.backend import BackendConfig, JaxBackendConfig
+from ray_tpu.train.checkpoint import Checkpoint, StorageContext
+from ray_tpu.train.config import RunConfig, ScalingConfig
+
+
+class TrainingFailedError(RuntimeError):
+    """Training did not complete (worker failures exceeded max_failures, or
+    the training loop raised)."""
+
+
+@dataclasses.dataclass
+class Result:
+    """Counterpart of python/ray/air/result.py Result."""
+
+    metrics: Optional[Dict[str, Any]]
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[BaseException] = None
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def best_checkpoints(self):
+        return [self.checkpoint] if self.checkpoint else []
+
+
+def _shard_dataset(ds: Any, num_shards: int) -> List[Any]:
+    """Split one dataset into per-worker shards.
+
+    ray_tpu.data Datasets use streaming_split (locality-aware iterators,
+    reference dataset.py:1236); plain sequences/arrays are sliced; anything
+    else is replicated.
+    """
+    if hasattr(ds, "streaming_split"):
+        return ds.streaming_split(num_shards)
+    try:
+        n = len(ds)
+    except TypeError:
+        return [ds] * num_shards
+    per = (n + num_shards - 1) // num_shards
+    return [ds[i * per:(i + 1) * per] for i in range(num_shards)]
+
+
+class DataParallelTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        backend_config: Optional[BackendConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config or {}
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.datasets = datasets or {}
+        self.backend_config = backend_config or BackendConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    # ------------------------------------------------------------------
+    def fit(self) -> Result:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        cfg = self.run_config
+        storage = StorageContext(
+            cfg.storage_path, cfg.name,
+            num_to_keep=cfg.checkpoint_config.num_to_keep)
+        max_failures = cfg.failure_config.max_failures
+        failures = 0
+        latest_ckpt = self.resume_from_checkpoint
+        history: List[Dict[str, Any]] = []
+
+        while True:
+            try:
+                metrics = self._run_attempt(storage, latest_ckpt, history)
+                return Result(
+                    metrics=metrics,
+                    checkpoint=storage.latest_checkpoint(),
+                    path=storage.run_dir,
+                    metrics_history=history)
+            except TrainingFailedError:
+                raise
+            except Exception as e:
+                failures += 1
+                if max_failures >= 0 and failures > max_failures:
+                    if isinstance(e, _UserLoopError):
+                        raise TrainingFailedError(str(e)) from e
+                    raise TrainingFailedError(
+                        f"training failed after {failures} failure(s): "
+                        f"{e}") from e
+                # restart from the latest persisted checkpoint
+                latest_ckpt = storage.latest_checkpoint() or latest_ckpt
+
+    # ------------------------------------------------------------------
+    def _run_attempt(self, storage: StorageContext,
+                     checkpoint: Optional[Checkpoint],
+                     history: List[Dict[str, Any]]) -> Optional[Dict]:
+        from ray_tpu.train.worker_group import WorkerGroup
+        from ray_tpu.train.backend import _jax_env
+
+        sc = self.scaling_config
+        env = _jax_env(self.backend_config) \
+            if isinstance(self.backend_config, JaxBackendConfig) else None
+        group = WorkerGroup(
+            sc.num_workers, sc.worker_resources(), storage.run_dir,
+            placement_strategy=sc.placement_strategy, env=env,
+            num_to_keep=self.run_config.checkpoint_config.num_to_keep)
+        backend = self.backend_config.backend_cls()
+        try:
+            backend.on_start(group, self.backend_config)
+
+            shards: Dict[int, Dict[str, Any]] = {
+                i: {} for i in range(sc.num_workers)}
+            for name, ds in self.datasets.items():
+                for i, shard in enumerate(_shard_dataset(ds, sc.num_workers)):
+                    shards[i][name] = shard
+
+            backend.on_training_start(group, self.backend_config)
+            ray_tpu.get([
+                w.start_training.remote(
+                    self.train_loop_per_worker, self.train_loop_config,
+                    checkpoint.as_directory() if checkpoint else None,
+                    shards[i], storage.name)
+                for i, w in enumerate(group.workers)
+            ], timeout=120)
+
+            return self._poll_results(group, history)
+        finally:
+            try:
+                backend.on_shutdown(group, self.backend_config)
+            finally:
+                group.shutdown()
+
+    def _poll_results(self, group, history) -> Optional[Dict]:
+        finished = set()
+        last_rank0: Optional[Dict] = None
+        deadline_slack = 600.0  # no single poll may hang longer than this
+        while len(finished) < group.num_workers:
+            pending = [i for i in range(group.num_workers)
+                       if i not in finished]
+            refs = {i: group.workers[i].next_result.remote(2.0)
+                    for i in pending}
+            for i, ref in refs.items():
+                item = ray_tpu.get(ref, timeout=deadline_slack)
+                if item is None:
+                    continue
+                if item.get("finished"):
+                    finished.add(i)
+                    continue
+                if "error" in item:
+                    raise _UserLoopError(
+                        f"rank {i} train loop failed:\n{item['traceback']}")
+                if i == 0:
+                    last_rank0 = item.get("metrics")
+                    entry = dict(item.get("metrics") or {})
+                    if item.get("checkpoint_path"):
+                        entry["checkpoint_path"] = item["checkpoint_path"]
+                    history.append(entry)
+            time.sleep(0.01)
+        return last_rank0
+
+
+class _UserLoopError(RuntimeError):
+    """Training-loop exception (as opposed to infrastructure failure)."""
+
+
+class JaxTrainer(DataParallelTrainer):
+    """DataParallelTrainer with the JAX backend by default (reference
+    TorchTrainer ↔ DataParallelTrainer relationship, torch_trainer.py)."""
+
+    def __init__(self, train_loop_per_worker, *,
+                 backend_config: Optional[JaxBackendConfig] = None, **kw):
+        super().__init__(
+            train_loop_per_worker,
+            backend_config=backend_config or JaxBackendConfig(), **kw)
