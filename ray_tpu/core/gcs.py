@@ -1296,6 +1296,10 @@ class ControlServer:
         env["RAY_TPU_ENV_KEY"] = env_key
         env["RAY_TPU_NAMESPACE"] = self.namespace
         env["RAY_TPU_NODE_ID"] = node_id
+        # pyarrow's bundled jemalloc segfaults under this kernel (observed
+        # SIGSEGV inside table allocation paths); the system allocator is
+        # reliable and plenty fast for block-sized allocations.
+        env.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
         cmd = [sys.executable, "-m", "ray_tpu.core.worker"]
         if env_key.startswith("tpu0") or not env_key.startswith("tpu"):
             # CPU-only worker: never let it grab the TPU runtime, and skip

@@ -26,6 +26,12 @@ import msgpack
 _HEADER_FMT = "<Q"
 _HEADER_LEN = struct.calcsize(_HEADER_FMT)
 
+# Debug escape hatch: copy out-of-band buffers on deserialize instead of
+# aliasing the source (shm mmap / message bytes).
+import os as _os
+
+_COPY_BUFFERS = _os.environ.get("RAY_TPU_COPY_DESER_BUFFERS", "") == "1"
+
 
 class SerializedObject:
     """A serialized value plus its out-of-band buffers (not yet concatenated)."""
@@ -117,7 +123,10 @@ def deserialize(data, ref_deserializer: Callable | None = None) -> Any:
     off += header["pkl_len"]
     bufs = []
     for blen in header["bufs"]:
-        bufs.append(pickle.PickleBuffer(view[off:off + blen]))
+        if _COPY_BUFFERS:
+            bufs.append(pickle.PickleBuffer(bytes(view[off:off + blen])))
+        else:
+            bufs.append(pickle.PickleBuffer(view[off:off + blen]))
         off += blen
     from ray_tpu.core import object_ref as _orf
 

@@ -145,6 +145,11 @@ class WorkerRuntime:
         args = []
         for a in spec.args:
             if a.is_ref:
+                # Balance this temp ref's __del__ decref with an explicit
+                # incref: without it, concurrent tasks borrowing the same
+                # arg drove the owner's count negative and the object was
+                # freed under other tasks still resolving it.
+                self.core.client.send({"op": "incref", "obj": a.object_hex})
                 ref = ObjectRef(ObjectID.from_hex(a.object_hex))
                 args.append(self.core.get([ref])[0])
             else:
@@ -297,6 +302,9 @@ class WorkerRuntime:
 
 
 def main():
+    import faulthandler
+
+    faulthandler.enable()  # native-crash stacks land in the worker .err log
     control_addr = os.environ["RAY_TPU_CONTROL_ADDR"]
     worker_hex = os.environ["RAY_TPU_WORKER_ID"]
     kind = os.environ.get("RAY_TPU_WORKER_KIND", "pool")

@@ -1,0 +1,46 @@
+"""ray_tpu.data: distributed, streaming data processing for TPU pipelines.
+
+Counterpart of python/ray/data (SURVEY.md §2.3 L1): Arrow block model,
+lazy logical plans, a streaming executor with backpressure over ray_tpu
+tasks, and the device-feed path (`iter_device_batches`) that shards host
+batches onto a jax Mesh.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
+from ray_tpu.data.dataset import (
+    Dataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004
+    range_tensor,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+)
+from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "BlockMetadata",
+    "Dataset",
+    "DataIterator",
+    "Datasource",
+    "ReadTask",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_csv",
+    "read_datasource",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+]

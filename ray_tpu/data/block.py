@@ -1,0 +1,280 @@
+"""Block model: the unit of distributed data.
+
+Counterpart of the reference's Block abstraction (python/ray/data/block.py,
+python/ray/data/_internal/arrow_block.py, pandas_block.py): a Dataset is a
+list of object-store refs to Blocks; each Block is a columnar table.
+
+Design: a Block is always a ``pyarrow.Table`` at rest (one canonical
+representation instead of the reference's Arrow|pandas|list union — simpler
+ownership, zero-copy slicing, cheap size accounting).  Batches handed to user
+functions are converted on the fly to the requested ``batch_format``:
+"numpy" (dict of np.ndarray, the default — feeds jnp.asarray zero-copy for
+numeric dtypes), "pandas", or "pyarrow".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+# Must precede the first pyarrow import anywhere in the process: the bundled
+# jemalloc segfaults under this kernel (random SIGSEGV in allocation paths).
+os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+
+import pyarrow as pa
+
+# A Block at rest.
+Block = pa.Table
+
+# What user map functions may return / what builders accept.
+BatchLike = Union[pa.Table, Dict[str, Any], "pandas.DataFrame"]  # noqa: F821
+
+# Column name used when data has no natural schema (e.g. from_items on
+# scalars), mirroring the reference's TENSOR_COLUMN/"item" convention
+# (python/ray/data/_internal/util.py).
+ITEM_COLUMN = "item"
+
+VALID_BATCH_FORMATS = ("numpy", "pandas", "pyarrow", "default")
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockMetadata:
+    """Size/schema accounting carried next to each block ref.
+
+    Counterpart of python/ray/data/block.py BlockMetadata: lets the planner
+    and progress accounting work without fetching block payloads.
+    """
+
+    num_rows: int
+    size_bytes: int
+    schema_names: Optional[Sequence[str]] = None
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockMetadata":
+        return BlockMetadata(
+            num_rows=block.num_rows,
+            size_bytes=block.nbytes,
+            schema_names=tuple(block.schema.names),
+        )
+
+
+def _np_to_arrow_array(arr: np.ndarray) -> pa.Array:
+    arr = np.asarray(arr)
+    if arr.ndim <= 1:
+        return pa.array(arr)
+    # Multi-dim columns (images, token blocks) become fixed-size lists,
+    # flattened recursively — round-trips through to_numpy below.
+    flat = pa.array(arr.reshape(arr.shape[0], -1).tolist())
+    return flat
+
+
+def _column_to_arrow(values: Any) -> pa.Array:
+    if isinstance(values, pa.Array):
+        return values
+    if isinstance(values, pa.ChunkedArray):
+        return values.combine_chunks()
+    if isinstance(values, np.ndarray):
+        return _np_to_arrow_array(values)
+    return pa.array(values)
+
+
+def batch_to_block(batch: BatchLike) -> Block:
+    """Normalize any user-returned batch into a pyarrow Table."""
+    import pandas as pd
+
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, pd.DataFrame):
+        return pa.Table.from_pandas(batch, preserve_index=False)
+    if isinstance(batch, dict):
+        names, arrays = [], []
+        n_rows = None
+        for name, col in batch.items():
+            arr = _column_to_arrow(col)
+            if n_rows is None:
+                n_rows = len(arr)
+            elif len(arr) != n_rows:
+                raise ValueError(
+                    f"batch columns have unequal lengths: {name!r} has "
+                    f"{len(arr)}, expected {n_rows}")
+            names.append(name)
+            arrays.append(arr)
+        return pa.Table.from_arrays(arrays, names=names)
+    raise TypeError(
+        f"map function must return dict/pandas.DataFrame/pyarrow.Table, "
+        f"got {type(batch)}")
+
+
+def rows_to_block(rows: Sequence[Any]) -> Block:
+    """Build a block from a list of rows (dicts or scalars)."""
+    if rows and isinstance(rows[0], dict):
+        cols: Dict[str, List[Any]] = {}
+        for i, row in enumerate(rows):
+            if not isinstance(row, dict):
+                raise TypeError(
+                    f"row {i} is {type(row)}; all rows must be dicts once "
+                    f"the first row is a dict")
+            for k, v in row.items():
+                cols.setdefault(k, []).append(v)
+        n = len(rows)
+        for k, v in cols.items():
+            if len(v) != n:
+                raise ValueError(f"row column {k!r} missing in some rows")
+        return batch_to_block(
+            {k: np.asarray(v) if _is_numeric_list(v) else v
+             for k, v in cols.items()})
+    return batch_to_block({ITEM_COLUMN: list(rows)})
+
+
+def _is_numeric_list(values: List[Any]) -> bool:
+    return bool(values) and isinstance(
+        values[0], (int, float, bool, np.number, np.ndarray))
+
+
+def block_to_batch(block: Block, batch_format: str = "numpy") -> BatchLike:
+    if batch_format in ("numpy", "default"):
+        return {
+            name: _arrow_col_to_numpy(block.column(name))
+            for name in block.schema.names
+        }
+    if batch_format == "pandas":
+        return block.to_pandas()
+    if batch_format == "pyarrow":
+        return block
+    raise ValueError(
+        f"batch_format must be one of {VALID_BATCH_FORMATS}, "
+        f"got {batch_format!r}")
+
+
+def _arrow_col_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
+    try:
+        return col.combine_chunks().to_numpy(zero_copy_only=False)
+    except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+        return np.asarray(col.to_pylist(), dtype=object)
+
+
+class BlockAccessor:
+    """Uniform block operations (slice/take/iterate/size), counterpart of
+    python/ray/data/block.py BlockAccessor."""
+
+    def __init__(self, block: Block):
+        self._block = block
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        if not isinstance(block, pa.Table):
+            block = batch_to_block(block)
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        return self._block.num_rows
+
+    def size_bytes(self) -> int:
+        return self._block.nbytes
+
+    def schema(self) -> pa.Schema:
+        return self._block.schema
+
+    def slice(self, start: int, end: int) -> Block:
+        return self._block.slice(start, max(0, end - start))
+
+    def take(self, indices: Sequence[int]) -> Block:
+        return self._block.take(pa.array(indices, type=pa.int64()))
+
+    def to_batch(self, batch_format: str = "numpy") -> BatchLike:
+        return block_to_batch(self._block, batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for chunk_batch in self._block.to_batches():
+            cols = {
+                name: chunk_batch.column(i)
+                for i, name in enumerate(chunk_batch.schema.names)
+            }
+            for i in range(chunk_batch.num_rows):
+                yield {name: col[i].as_py() for name, col in cols.items()}
+
+    def select_columns(self, names: Sequence[str]) -> Block:
+        return self._block.select(list(names))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> Block:
+        new_names = [mapping.get(n, n) for n in self._block.schema.names]
+        return self._block.rename_columns(new_names)
+
+    def drop_columns(self, names: Sequence[str]) -> Block:
+        keep = [n for n in self._block.schema.names if n not in set(names)]
+        return self._block.select(keep)
+
+    def sort(self, key: Union[str, Sequence[str]],
+             descending: bool = False) -> Block:
+        keys = [key] if isinstance(key, str) else list(key)
+        order = "descending" if descending else "ascending"
+        return self._block.sort_by([(k, order) for k in keys])
+
+    def sample(self, n: int, seed: Optional[int] = None) -> Block:
+        rng = np.random.default_rng(seed)
+        n = min(n, self._block.num_rows)
+        idx = rng.choice(self._block.num_rows, size=n, replace=False)
+        return self.take(idx.tolist())
+
+
+class BlockBuilder:
+    """Accumulate rows/batches/blocks, emit a single combined Block.
+
+    Counterpart of the reference's DelegatingBlockBuilder
+    (python/ray/data/_internal/delegating_block_builder.py).
+    """
+
+    def __init__(self):
+        self._tables: List[pa.Table] = []
+        self._rows: List[Any] = []
+        self._approx_bytes = 0
+
+    def add_row(self, row: Any):
+        self._rows.append(row)
+        self._approx_bytes += 64  # rough; exact size computed on build
+
+    def add_batch(self, batch: BatchLike):
+        self.add_block(batch_to_block(batch))
+
+    def add_block(self, block: Block):
+        self._flush_rows()
+        self._tables.append(block)
+        self._approx_bytes += block.nbytes
+
+    def _flush_rows(self):
+        if self._rows:
+            self._tables.append(rows_to_block(self._rows))
+            self._rows = []
+
+    def num_rows(self) -> int:
+        return sum(t.num_rows for t in self._tables) + len(self._rows)
+
+    def size_bytes(self) -> int:
+        return self._approx_bytes
+
+    def build(self) -> Block:
+        self._flush_rows()
+        if not self._tables:
+            return pa.table({})
+        tables = _unify_tables(self._tables)
+        return pa.concat_tables(tables).combine_chunks()
+
+
+def _unify_tables(tables: List[pa.Table]) -> List[pa.Table]:
+    """Promote schemas so concat_tables succeeds across numeric widths."""
+    try:
+        schema = pa.unify_schemas(
+            [t.schema for t in tables], promote_options="permissive")
+        return [t.cast(schema) for t in tables]
+    except (pa.ArrowInvalid, pa.ArrowTypeError):
+        return tables
+
+
+def concat_blocks(blocks: Sequence[Block]) -> Block:
+    builder = BlockBuilder()
+    for b in blocks:
+        builder.add_block(b)
+    return builder.build()

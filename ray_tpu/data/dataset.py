@@ -1,0 +1,548 @@
+"""Dataset: the lazy, streaming, distributed data API.
+
+Counterpart of python/ray/data/dataset.py (Dataset :139) and read_api.py.
+A Dataset wraps a LogicalPlan; transforms append logical ops; consumption
+lowers to physical operators and drives the StreamingExecutor
+(execution.py).  `streaming_split` (dataset.py:1236 in the reference)
+serves N trainer workers from one coordinator actor.
+"""
+
+from __future__ import annotations
+
+import builtins
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    block_to_batch,
+    concat_blocks,
+)
+from ray_tpu.data.datasource import (
+    BlocksDatasource,
+    CSVDatasource,
+    Datasource,
+    ItemsDatasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    write_block_csv,
+    write_block_json,
+    write_block_parquet,
+)
+from ray_tpu.data.execution import RefBundle, StreamingExecutor
+from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data.planner import execute_plan
+
+
+class Dataset:
+    def __init__(self, terminal: L.LogicalOp):
+        self._terminal = terminal
+        self._materialized: Optional[List[RefBundle]] = None
+
+    # ------------------------------------------------------------------
+    # Transforms (lazy)
+    # ------------------------------------------------------------------
+    def _append(self, op: L.LogicalOp) -> "Dataset":
+        op.inputs = [self._terminal]
+        return Dataset(op)
+
+    def map_batches(self, fn=None, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    fn_constructor: Optional[Callable[[], Any]] = None,
+                    num_cpus: float = 1.0,
+                    concurrency: Optional[int] = None) -> "Dataset":
+        if fn is None and fn_constructor is None:
+            raise ValueError("map_batches requires fn or fn_constructor")
+        return self._append(L.MapBatches(
+            fn=fn, batch_size=batch_size, batch_format=batch_format,
+            fn_constructor=fn_constructor, num_cpus=num_cpus,
+            concurrency=concurrency))
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        return self._append(L.MapRows(fn=fn))
+
+    def flat_map(self, fn: Callable[[Dict], Sequence[Dict]]) -> "Dataset":
+        return self._append(L.FlatMapRows(fn=fn))
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        return self._append(L.FilterRows(fn=fn))
+
+    def add_column(self, name: str, fn: Callable[[Dict], Any]) -> "Dataset":
+        def _add(batch: Dict[str, np.ndarray]):
+            n = len(next(iter(batch.values()))) if batch else 0
+            rows = ({k: v[i] for k, v in batch.items()}
+                    for i in np.arange(n))
+            batch = dict(batch)
+            batch[name] = np.asarray([fn(r) for r in rows])
+            return batch
+
+        return self.map_batches(_add)
+
+    def select_columns(self, cols: Sequence[str]) -> "Dataset":
+        return self.map_batches(
+            lambda t: t.select(list(cols)), batch_format="pyarrow")
+
+    def drop_columns(self, cols: Sequence[str]) -> "Dataset":
+        drop = set(cols)
+
+        def _drop(t: pa.Table):
+            return t.select([n for n in t.schema.names if n not in drop])
+
+        return self.map_batches(_drop, batch_format="pyarrow")
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        return self.map_batches(
+            lambda t: BlockAccessor(t).rename_columns(mapping),
+            batch_format="pyarrow")
+
+    def limit(self, n: int) -> "Dataset":
+        return self._append(L.Limit(limit=n))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._append(L.Repartition(num_blocks=num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._append(L.RandomShuffle(seed=seed))
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        return self._append(L.Sort(key=key, descending=descending))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        op = L.Union()
+        op.inputs = [self._terminal] + [o._terminal for o in others]
+        return Dataset(op)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        op = L.Zip()
+        op.inputs = [self._terminal, other._terminal]
+        return Dataset(op)
+
+    def groupby(self, key: Optional[str]) -> "GroupedData":
+        return GroupedData(self, key)
+
+    def random_sample(self, fraction: float,
+                      seed: Optional[int] = None) -> "Dataset":
+        def _sample(batch: pa.Table, _seed=[seed]):
+            rng = np.random.default_rng(_seed[0])
+            if _seed[0] is not None:
+                _seed[0] += 1
+            mask = rng.random(batch.num_rows) < fraction
+            return BlockAccessor(batch).take(np.nonzero(mask)[0].tolist())
+
+        return self.map_batches(_sample, batch_format="pyarrow")
+
+    # ------------------------------------------------------------------
+    # Execution / consumption
+    # ------------------------------------------------------------------
+    def _plan(self) -> L.LogicalPlan:
+        if self._materialized is not None:
+            read = L.Read(datasource=_MaterializedSource(self._materialized))
+            return L.LogicalPlan(read)
+        return L.LogicalPlan(self._terminal)
+
+    def _execute(self) -> StreamingExecutor:
+        return execute_plan(self._plan())
+
+    def iter_internal_blocks(self) -> Iterator[Block]:
+        ex = self._execute()
+        for bundle in ex.output_bundles():
+            for block in ray_tpu.get(bundle.blocks_ref):
+                yield block
+
+    def iterator(self) -> DataIterator:
+        return DataIterator(self.iter_internal_blocks)
+
+    def iter_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_batches(**kw)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        return self.iterator().iter_rows()
+
+    def iter_device_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_device_batches(**kw)
+
+    def take(self, n: int = 20) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for row in self.limit(n).iter_rows():
+            out.append(row)
+            if len(out) >= n:
+                break
+        return out
+
+    def take_all(self) -> List[Dict[str, Any]]:
+        return list(self.iter_rows())
+
+    def take_batch(self, n: int = 20, batch_format: str = "numpy"):
+        block = concat_blocks(
+            list(self.limit(n).iter_internal_blocks()))
+        return block_to_batch(block, batch_format)
+
+    def count(self) -> int:
+        if self._materialized is not None:
+            return sum(b.num_rows for b in self._materialized)
+        # Fast path for pure reads with known cardinality.
+        if isinstance(self._terminal, L.Read):
+            n = self._terminal.datasource.num_rows()
+            if n is not None:
+                return n
+        ex = self._execute()
+        return sum(b.num_rows for b in ex.output_bundles())
+
+    def schema(self) -> Optional[pa.Schema]:
+        for block in self.limit(1).iter_internal_blocks():
+            return block.schema
+        return None
+
+    def columns(self) -> List[str]:
+        schema = self.schema()
+        return list(schema.names) if schema is not None else []
+
+    def materialize(self) -> "Dataset":
+        """Execute now; the result holds block refs and re-reads are free
+        (reference Dataset.materialize → MaterializedDataset)."""
+        ex = self._execute()
+        bundles = list(ex.output_bundles())
+        ds = Dataset(self._terminal)
+        ds._materialized = bundles
+        return ds
+
+    def stats(self) -> str:
+        if self._materialized is not None:
+            rows = sum(b.num_rows for b in self._materialized)
+            return f"Materialized: {len(self._materialized)} bundles, {rows} rows"
+        return "Lazy plan: " + self._plan().describe()
+
+    def num_blocks(self) -> Optional[int]:
+        if self._materialized is not None:
+            return len(self._materialized)
+        return None
+
+    # ------------------------------------------------------------------
+    # Splitting
+    # ------------------------------------------------------------------
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        """Materializing split into n datasets (reference Dataset.split)."""
+        mat = self if self._materialized is not None else self.materialize()
+        bundles = mat._materialized or []
+        blocks = [b for bundle in bundles
+                  for b in ray_tpu.get(bundle.blocks_ref)]
+        combined = concat_blocks(blocks) if blocks else pa.table({})
+        total = combined.num_rows
+        per = total // n if equal else -(-total // n)
+        acc = BlockAccessor(combined)
+        out = []
+        for i in builtins.range(n):
+            start = min(i * per, total)
+            end = min(start + per, total)
+            piece = acc.slice(start, end)
+            child = Dataset(self._terminal)
+            child._materialized = [RefBundle.from_blocks([piece])] \
+                if piece.num_rows else []
+            out.append(child)
+        return out
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None) -> List[DataIterator]:
+        """N iterators fed concurrently by one executing pipeline
+        (reference dataset.py:1236 + stream_split_iterator.py).  Used by
+        the trainer to feed per-worker shards."""
+        coordinator = _SplitCoordinator.options(
+            max_concurrency=max(2, 2 * n)).remote(
+                _PlanCapsule(self._terminal, self._materialized), n, equal)
+
+        def make_source(idx: int) -> Callable[[], Iterator[Block]]:
+            def source() -> Iterator[Block]:
+                epoch = ray_tpu.get(coordinator.start_epoch.remote(idx))
+                while True:
+                    blocks = ray_tpu.get(
+                        coordinator.get_next.remote(idx, epoch))
+                    if blocks is None:
+                        return
+                    yield from blocks
+
+            return source
+
+        return [DataIterator(make_source(i)) for i in builtins.range(n)]
+
+    # ------------------------------------------------------------------
+    # Writes
+    # ------------------------------------------------------------------
+    def _write(self, write_fn, path: str) -> List[str]:
+        op = L.Write(write_fn=write_fn, path=path)
+        op.inputs = [self._terminal]
+        ds = Dataset(op)
+        return [r["path"] for r in ds.take_all()]
+
+    def write_parquet(self, path: str) -> List[str]:
+        return self._write(write_block_parquet, path)
+
+    def write_csv(self, path: str) -> List[str]:
+        return self._write(write_block_csv, path)
+
+    def write_json(self, path: str) -> List[str]:
+        return self._write(write_block_json, path)
+
+    def to_pandas(self):
+        return concat_blocks(
+            list(self.iter_internal_blocks())).to_pandas()
+
+    def to_arrow(self) -> pa.Table:
+        return concat_blocks(list(self.iter_internal_blocks()))
+
+    def __repr__(self):
+        return f"Dataset(plan={self._plan().describe()})"
+
+
+class _MaterializedSource(Datasource):
+    """Re-serves already-executed bundles (zero-cost re-read)."""
+
+    def __init__(self, bundles: List[RefBundle]):
+        self._bundles = bundles
+
+    def num_rows(self) -> Optional[int]:
+        return sum(b.num_rows for b in self._bundles)
+
+    def get_read_tasks(self, parallelism: int):
+        from ray_tpu.data.block import BlockMetadata
+        from ray_tpu.data.datasource import ReadTask
+
+        tasks = []
+        for bundle in self._bundles:
+            ref = bundle.blocks_ref
+
+            def fn(ref=ref):
+                yield from ray_tpu.get(ref)
+
+            tasks.append(ReadTask(fn, BlockMetadata(
+                num_rows=bundle.num_rows, size_bytes=bundle.size_bytes)))
+        return tasks
+
+
+class _PlanCapsule:
+    """Pickles a logical plan (or materialized bundles) into the coordinator
+    actor."""
+
+    def __init__(self, terminal: L.LogicalOp,
+                 materialized: Optional[List[RefBundle]]):
+        self.terminal = terminal
+        self.materialized = materialized
+
+    def to_dataset(self) -> Dataset:
+        ds = Dataset(self.terminal)
+        ds._materialized = self.materialized
+        return ds
+
+
+@ray_tpu.remote
+class _SplitCoordinator:
+    """Runs the streaming executor once per epoch; consumers pull blocks
+    for their split index.  Round-robin bundle assignment approximates
+    equal row counts; with equal=True the surplus tail is truncated so all
+    splits yield exactly min_rows (the reference's equalization step)."""
+
+    def __init__(self, capsule: _PlanCapsule, n: int, equal: bool):
+        import collections
+        import threading
+
+        self._capsule = capsule
+        self._n = n
+        self._equal = equal
+        self._lock = threading.Lock()
+        self._epoch = -1
+        self._queues: List = []
+        self._done = False
+        self._thread = None
+        self._cond = threading.Condition(self._lock)
+
+    def start_epoch(self, idx: int) -> int:
+        """First caller of a new epoch kicks off execution; returns epoch id."""
+        import collections
+        import threading
+
+        with self._cond:
+            if self._thread is None or (self._done and all(
+                    not q for q in self._queues)):
+                self._epoch += 1
+                self._done = False
+                self._queues = [
+                    collections.deque() for _ in builtins.range(self._n)]
+                self._thread = threading.Thread(
+                    target=self._pump, daemon=True)
+                self._thread.start()
+            return self._epoch
+
+    def _pump(self):
+        import numpy as np
+
+        ds = self._capsule.to_dataset()
+        ex = ds._execute()
+        rows = [0] * self._n
+        try:
+            for bundle in ex.output_bundles():
+                blocks = ray_tpu.get(bundle.blocks_ref)
+                with self._cond:
+                    # least-loaded split gets the next bundle
+                    tgt = int(np.argmin(rows))
+                    rows[tgt] += bundle.num_rows
+                    self._queues[tgt].append(blocks)
+                    self._cond.notify_all()
+            if self._equal and self._n > 1:
+                self._equalize(rows)
+        finally:
+            with self._cond:
+                self._done = True
+                self._cond.notify_all()
+
+    def _equalize(self, rows: List[int]):
+        target = min(rows)
+        with self._cond:
+            for i in builtins.range(self._n):
+                surplus = rows[i] - target
+                while surplus > 0 and self._queues[i]:
+                    blocks = self._queues[i].pop()
+                    have = sum(b.num_rows for b in blocks)
+                    if have <= surplus:
+                        surplus -= have
+                        continue
+                    combined = concat_blocks(blocks)
+                    keep = combined.num_rows - surplus
+                    self._queues[i].append(
+                        [BlockAccessor(combined).slice(0, keep)])
+                    surplus = 0
+
+    def get_next(self, idx: int, epoch: int):
+        with self._cond:
+            while True:
+                if epoch != self._epoch:
+                    return None  # stale consumer
+                if self._queues[idx]:
+                    return self._queues[idx].popleft()
+                if self._done:
+                    return None
+                self._cond.wait(timeout=1.0)
+
+
+class GroupedData:
+    """Counterpart of python/ray/data/grouped_data.py."""
+
+    _KINDS = ("sum", "min", "max", "mean", "count", "std")
+
+    def __init__(self, ds: Dataset, key: Optional[str]):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, kind: str, on: Union[str, Sequence[str]]) -> Dataset:
+        cols = [on] if isinstance(on, str) else list(on)
+        aggs = [(kind, c, f"{kind}({c})") for c in cols]
+        op = L.GroupByAggregate(key=self._key, aggs=tuple(aggs))
+        op.inputs = [self._ds._terminal]
+        return Dataset(op)
+
+    def sum(self, on) -> Dataset:
+        return self._agg("sum", on)
+
+    def min(self, on) -> Dataset:
+        return self._agg("min", on)
+
+    def max(self, on) -> Dataset:
+        return self._agg("max", on)
+
+    def mean(self, on) -> Dataset:
+        return self._agg("mean", on)
+
+    def std(self, on) -> Dataset:
+        return self._agg("std", on)
+
+    def count(self) -> Dataset:
+        key = self._key
+        if key is None:
+            raise ValueError("count() requires a groupby key")
+        op = L.GroupByAggregate(
+            key=key, aggs=(("count", key, "count()"),))
+        op.inputs = [self._ds._terminal]
+        return Dataset(op)
+
+    def aggregate(self, *specs: Sequence[Any]) -> Dataset:
+        """specs: (kind, on_column[, out_name]) tuples."""
+        aggs = []
+        for spec in specs:
+            kind, on = spec[0], spec[1]
+            out_name = spec[2] if len(spec) > 2 else f"{kind}({on})"
+            if kind not in self._KINDS:
+                raise ValueError(f"unknown aggregate {kind!r}")
+            aggs.append((kind, on, out_name))
+        op = L.GroupByAggregate(key=self._key, aggs=tuple(aggs))
+        op.inputs = [self._ds._terminal]
+        return Dataset(op)
+
+
+# ---------------------------------------------------------------------------
+# Read API (counterpart of python/ray/data/read_api.py)
+# ---------------------------------------------------------------------------
+
+
+def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
+    return Dataset(L.Read(datasource=ds, parallelism=parallelism))
+
+
+def range(n: int, *, parallelism: int = -1) -> Dataset:  # noqa: A001
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
+
+
+def range_tensor(n: int, *, shape=(1,), parallelism: int = -1) -> Dataset:
+    return read_datasource(
+        RangeDatasource(n, tensor_shape=shape), parallelism=parallelism)
+
+
+def from_items(items: Sequence[Any], *, parallelism: int = -1) -> Dataset:
+    return read_datasource(ItemsDatasource(items), parallelism=parallelism)
+
+
+def from_arrow(tables: Union[pa.Table, Sequence[pa.Table]]) -> Dataset:
+    if isinstance(tables, pa.Table):
+        tables = [tables]
+    return read_datasource(BlocksDatasource(list(tables)))
+
+
+def from_pandas(dfs) -> Dataset:
+    import pandas as pd
+
+    if isinstance(dfs, pd.DataFrame):
+        dfs = [dfs]
+    return from_arrow(
+        [pa.Table.from_pandas(df, preserve_index=False) for df in dfs])
+
+
+def from_numpy(arrays, column: str = "data") -> Dataset:
+    from ray_tpu.data.block import batch_to_block
+
+    if isinstance(arrays, np.ndarray):
+        arrays = [arrays]
+    return from_arrow([batch_to_block({column: a}) for a in arrays])
+
+
+def read_parquet(paths, *, columns=None, parallelism: int = -1) -> Dataset:
+    return read_datasource(
+        ParquetDatasource(paths, columns=columns), parallelism=parallelism)
+
+
+def read_csv(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(CSVDatasource(paths), parallelism=parallelism)
+
+
+def read_json(paths, *, parallelism: int = -1) -> Dataset:
+    return read_datasource(JSONDatasource(paths), parallelism=parallelism)
+
+
+def read_numpy(paths, *, column: str = "data",
+               parallelism: int = -1) -> Dataset:
+    return read_datasource(
+        NumpyDatasource(paths, column=column), parallelism=parallelism)

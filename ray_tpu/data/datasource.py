@@ -1,0 +1,276 @@
+"""Datasources: pluggable readers/writers producing ReadTasks.
+
+Counterpart of python/ray/data/datasource/ (Datasource ABC, ReadTask) and
+read_api.py:324 read_datasource.  A ReadTask is a zero-arg callable executed
+remotely that yields Blocks; planning (file listing, splitting) happens on
+the driver so the executor can stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import os
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import (
+    Block,
+    BlockMetadata,
+    ITEM_COLUMN,
+    batch_to_block,
+    rows_to_block,
+)
+
+
+@dataclasses.dataclass
+class ReadTask:
+    """One unit of parallel read work (python/ray/data/datasource/datasource.py
+    ReadTask): ``fn`` runs on a worker and yields blocks; ``metadata`` is the
+    driver-side size estimate used for scheduling before execution."""
+
+    fn: Callable[[], Iterator[Block]]
+    metadata: BlockMetadata
+
+    def __call__(self) -> Iterator[Block]:
+        return self.fn()
+
+
+class Datasource:
+    """ABC. Subclasses implement get_read_tasks(parallelism)."""
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        raise NotImplementedError
+
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        return None
+
+    def num_rows(self) -> Optional[int]:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# In-memory sources
+# ---------------------------------------------------------------------------
+
+
+class RangeDatasource(Datasource):
+    def __init__(self, n: int, *, tensor_shape: Optional[Sequence[int]] = None):
+        self._n = n
+        self._tensor_shape = tuple(tensor_shape) if tensor_shape else None
+
+    def num_rows(self) -> Optional[int]:
+        return self._n
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        parallelism = max(1, min(parallelism, self._n or 1))
+        tasks: List[ReadTask] = []
+        chunk = -(-max(self._n, 1) // parallelism)  # ceil
+        for start in range(0, self._n, chunk):
+            end = min(start + chunk, self._n)
+            shape = self._tensor_shape
+
+            def fn(start=start, end=end, shape=shape) -> Iterator[Block]:
+                ids = np.arange(start, end, dtype=np.int64)
+                if shape:
+                    data = np.stack(
+                        [np.full(shape, i, dtype=np.int64) for i in ids]
+                    ) if ids.size else np.zeros((0, *shape), np.int64)
+                    yield batch_to_block({"data": data})
+                else:
+                    yield batch_to_block({"id": ids})
+
+            meta = BlockMetadata(
+                num_rows=end - start,
+                size_bytes=(end - start) * 8 * int(
+                    np.prod(shape) if shape else 1),
+                schema_names=("data",) if shape else ("id",),
+            )
+            tasks.append(ReadTask(fn, meta))
+        return tasks
+
+
+class ItemsDatasource(Datasource):
+    def __init__(self, items: Sequence[Any]):
+        self._items = list(items)
+
+    def num_rows(self) -> Optional[int]:
+        return len(self._items)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        n = len(self._items)
+        parallelism = max(1, min(parallelism, n or 1))
+        chunk = -(-max(n, 1) // parallelism)
+        tasks = []
+        for start in range(0, n, chunk):
+            part = self._items[start:start + chunk]
+
+            def fn(part=part) -> Iterator[Block]:
+                yield rows_to_block(part)
+
+            meta = BlockMetadata(num_rows=len(part), size_bytes=len(part) * 64)
+            tasks.append(ReadTask(fn, meta))
+        return tasks
+
+
+class BlocksDatasource(Datasource):
+    """Wraps already-materialized blocks (from_arrow/from_pandas/from_numpy)."""
+
+    def __init__(self, blocks: Sequence[Block]):
+        self._blocks = [b for b in blocks]
+
+    def num_rows(self) -> Optional[int]:
+        return sum(b.num_rows for b in self._blocks)
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        tasks = []
+        for block in self._blocks:
+            def fn(block=block) -> Iterator[Block]:
+                yield block
+
+            tasks.append(ReadTask(fn, BlockMetadata.for_block(block)))
+        return tasks
+
+
+# ---------------------------------------------------------------------------
+# File-based sources
+# ---------------------------------------------------------------------------
+
+
+def _expand_paths(paths) -> List[str]:
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files)
+                    if not f.startswith((".", "_")))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths!r}")
+    return out
+
+
+class FileDatasource(Datasource):
+    """Base for per-file readers; one ReadTask per group of files."""
+
+    def __init__(self, paths):
+        self._paths = _expand_paths(paths)
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        raise NotImplementedError
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        groups: List[List[str]] = [[] for _ in range(
+            max(1, min(parallelism, len(self._paths))))]
+        for i, p in enumerate(self._paths):
+            groups[i % len(groups)].append(p)
+        tasks = []
+        for group in groups:
+            if not group:
+                continue
+
+            def fn(group=group) -> Iterator[Block]:
+                for path in group:
+                    yield from self._read_file(path)
+
+            size = sum(os.path.getsize(p) for p in group
+                       if os.path.exists(p))
+            tasks.append(ReadTask(fn, BlockMetadata(
+                num_rows=0, size_bytes=size)))
+        return tasks
+
+
+class ParquetDatasource(FileDatasource):
+    def __init__(self, paths, columns: Optional[Sequence[str]] = None):
+        super().__init__(paths)
+        self._columns = list(columns) if columns else None
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        import pyarrow.parquet as pq
+
+        pf = pq.ParquetFile(path)
+        for batch in pf.iter_batches(columns=self._columns):
+            yield pa.Table.from_batches([batch])
+
+
+class CSVDatasource(FileDatasource):
+    def _read_file(self, path: str) -> Iterator[Block]:
+        import pyarrow.csv as pacsv
+
+        yield pacsv.read_csv(path)
+
+
+class JSONDatasource(FileDatasource):
+    """Newline-delimited JSON."""
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        import pyarrow.json as pajson
+
+        yield pajson.read_json(path)
+
+
+class NumpyDatasource(FileDatasource):
+    def __init__(self, paths, column: str = "data"):
+        super().__init__(paths)
+        self._column = column
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        arr = np.load(path)
+        yield batch_to_block({self._column: arr})
+
+
+# ---------------------------------------------------------------------------
+# Writers (executed as map tasks over blocks)
+# ---------------------------------------------------------------------------
+
+
+def write_block_parquet(block: Block, path: str, index: int) -> str:
+    import pyarrow.parquet as pq
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.parquet")
+    pq.write_table(block, out)
+    return out
+
+
+def write_block_csv(block: Block, path: str, index: int) -> str:
+    import pyarrow.csv as pacsv
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.csv")
+    pacsv.write_csv(block, out)
+    return out
+
+
+def write_block_json(block: Block, path: str, index: int) -> str:
+    import json
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.jsonl")
+    with open(out, "w") as f:
+        for row in BlockAccessor(block).iter_rows():
+            f.write(json.dumps(_json_safe(row)) + "\n")
+    return out
+
+
+def _json_safe(obj):
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    return obj

@@ -1,0 +1,571 @@
+"""Physical operators + streaming executor.
+
+Counterpart of python/ray/data/_internal/execution/: StreamingExecutor
+(streaming_executor.py:48, scheduling loop _scheduling_loop_step:262),
+TaskPoolMapOperator, InputDataBuffer, and the backpressure policies
+(backpressure_policy/, resource_manager.py).
+
+Execution model: blocks flow as RefBundles (an object-store ref to a
+List[Block] plus size metadata).  Map work runs as ray_tpu tasks from a
+task pool with per-operator concurrency caps; an executor thread drives a
+polling loop (dispatch → harvest → forward downstream → yield terminal
+output) with two backpressure levers:
+  - per-operator in-flight task caps (concurrency / cluster CPU budget)
+  - a bounded output queue: the consumer not draining stalls dispatch
+    upstream (streaming, bounded memory — the reference's
+    ConcurrencyCapBackpressurePolicy equivalent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    BlockBuilder,
+    BlockMetadata,
+    concat_blocks,
+)
+
+# Target max rows per output block from map tasks; keeps blocks streamable.
+DEFAULT_TARGET_MAX_BLOCK_BYTES = 128 * 1024 * 1024
+
+
+@dataclasses.dataclass
+class RefBundle:
+    """A ref to List[Block] plus driver-side accounting metadata.  ``seq``
+    is the source-order key (read-task index, propagated 1:1 through map
+    ops) used by order-sensitive consumers (zip)."""
+
+    blocks_ref: Any  # ObjectRef[List[Block]]
+    num_rows: int
+    size_bytes: int
+    seq: int = -1
+
+    @staticmethod
+    def from_blocks(blocks: List[Block], seq: int = -1) -> "RefBundle":
+        rows = sum(b.num_rows for b in blocks)
+        size = sum(b.nbytes for b in blocks)
+        return RefBundle(ray_tpu.put(blocks), rows, size, seq)
+
+
+# A transform maps an iterator of blocks to an iterator of blocks.
+BlockTransform = Callable[[Iterator[Block]], Iterator[Block]]
+
+
+def _run_transform_chain(chain: Sequence[BlockTransform],
+                         blocks: Iterator[Block]) -> Iterator[Block]:
+    it = blocks
+    for t in chain:
+        it = t(it)
+    return it
+
+
+def _map_task(chain: Sequence[BlockTransform],
+              *input_lists: List[Block]) -> Tuple[List[Block], dict]:
+    """Remote body for all fused map work.  Returns (blocks, summary)."""
+    def gen() -> Iterator[Block]:
+        for blocks in input_lists:
+            for b in blocks:
+                yield b
+
+    out = [b for b in _run_transform_chain(chain, gen()) if b.num_rows > 0]
+    summary = {
+        "num_rows": sum(b.num_rows for b in out),
+        "size_bytes": sum(b.nbytes for b in out),
+    }
+    return out, summary
+
+
+def _read_task_body(read_task,
+                    chain: Sequence[BlockTransform] = ()) -> Tuple[List[Block], dict]:
+    it: Iterator[Block] = iter(read_task())
+    if chain:
+        it = _run_transform_chain(chain, it)
+    out = [b for b in it if b.num_rows > 0]
+    return out, {
+        "num_rows": sum(b.num_rows for b in out),
+        "size_bytes": sum(b.nbytes for b in out),
+    }
+
+
+@dataclasses.dataclass
+class OpStats:
+    tasks_submitted: int = 0
+    tasks_finished: int = 0
+    rows_out: int = 0
+    bytes_out: int = 0
+    wall_start: float = 0.0
+    wall_end: float = 0.0
+
+
+class PhysicalOperator:
+    """Base physical operator; subclasses implement work dispatch."""
+
+    def __init__(self, name: str, num_inputs: int = 1):
+        self.name = name
+        self.input_queues: List[deque] = [deque() for _ in range(num_inputs)]
+        self.inputs_complete: List[bool] = [False] * num_inputs
+        self.output_queue: deque = deque()
+        self.stats = OpStats(wall_start=time.time())
+        # Fan-out: one output can feed several (op, input_index) consumers
+        # (e.g. ds.union(ds) wires the same upstream twice).
+        self.downstreams: List[Tuple["PhysicalOperator", int]] = []
+
+    # -- wiring --------------------------------------------------------
+    def add_input(self, bundle: RefBundle, index: int = 0):
+        self.input_queues[index].append(bundle)
+
+    def mark_input_done(self, index: int = 0):
+        self.inputs_complete[index] = True
+
+    def all_inputs_done(self) -> bool:
+        return all(self.inputs_complete)
+
+    # -- scheduling hooks ---------------------------------------------
+    def num_active_tasks(self) -> int:
+        return 0
+
+    def dispatch(self, budget: int) -> int:
+        """Submit up to ``budget`` new tasks; return number submitted."""
+        return 0
+
+    def poll(self):
+        """Harvest finished work into output_queue."""
+
+    def completed(self) -> bool:
+        return (self.all_inputs_done()
+                and not any(self.input_queues)
+                and self.num_active_tasks() == 0
+                and not self.output_queue)
+
+    def take_output(self) -> Optional[RefBundle]:
+        if self.output_queue:
+            out = self.output_queue.popleft()
+            self.stats.rows_out += out.num_rows
+            self.stats.bytes_out += out.size_bytes
+            return out
+        return None
+
+    def outstanding_refs(self) -> List[Any]:
+        return []
+
+
+class InputDataBuffer(PhysicalOperator):
+    """Source operator over pre-made bundles or ReadTasks
+    (python/ray/data/_internal/execution/operators/input_data_buffer.py)."""
+
+    def __init__(self, read_tasks=None, bundles: Optional[List[RefBundle]] = None,
+                 chain: Sequence[BlockTransform] = ()):
+        super().__init__("Input" if not chain else "ReadMap", num_inputs=0)
+        self._pending_reads = deque(
+            (i, rt) for i, rt in enumerate(read_tasks or []))
+        self._running: Dict[Any, Any] = {}  # meta_ref -> (blocks_ref, seq)
+        self._chain = list(chain)
+        if bundles:
+            self.output_queue.extend(bundles)
+        self._remote_read = ray_tpu.remote(num_returns=2)(_read_task_body)
+
+    def all_inputs_done(self) -> bool:
+        return True
+
+    def num_active_tasks(self) -> int:
+        return len(self._running)
+
+    def dispatch(self, budget: int) -> int:
+        n = 0
+        while self._pending_reads and n < budget:
+            seq, rt = self._pending_reads.popleft()
+            blocks_ref, meta_ref = self._remote_read.remote(rt, self._chain)
+            self._running[meta_ref] = (blocks_ref, seq)
+            self.stats.tasks_submitted += 1
+            n += 1
+        return n
+
+    def poll(self):
+        if not self._running:
+            return
+        ready, _ = ray_tpu.wait(
+            list(self._running), num_returns=len(self._running), timeout=0)
+        for meta_ref in ready:
+            blocks_ref, seq = self._running.pop(meta_ref)
+            summary = ray_tpu.get(meta_ref)
+            self.stats.tasks_finished += 1
+            if summary["num_rows"] > 0:
+                self.output_queue.append(RefBundle(
+                    blocks_ref, summary["num_rows"], summary["size_bytes"],
+                    seq))
+
+    def completed(self) -> bool:
+        return (not self._pending_reads and not self._running
+                and not self.output_queue)
+
+    def outstanding_refs(self):
+        return list(self._running)
+
+
+class TaskPoolMapOperator(PhysicalOperator):
+    """Fused map transforms over a pool of ray_tpu tasks
+    (…/operators/task_pool_map_operator.py)."""
+
+    def __init__(self, name: str, chain: Sequence[BlockTransform],
+                 num_cpus: float = 1.0, concurrency: Optional[int] = None,
+                 min_rows_per_task: int = 0):
+        super().__init__(name)
+        self._chain = list(chain)
+        self._concurrency = concurrency
+        self._running: Dict[Any, Any] = {}
+        self._remote = ray_tpu.remote(
+            num_returns=2, num_cpus=num_cpus)(_map_task)
+        self._min_rows_per_task = min_rows_per_task
+
+    def num_active_tasks(self) -> int:
+        return len(self._running)
+
+    def dispatch(self, budget: int) -> int:
+        if self._concurrency is not None:
+            budget = min(budget, self._concurrency - len(self._running))
+        n = 0
+        q = self.input_queues[0]
+        while q and n < budget:
+            bundle = q.popleft()
+            blocks_ref, meta_ref = self._remote.remote(
+                self._chain, bundle.blocks_ref)
+            self._running[meta_ref] = (blocks_ref, bundle.seq)
+            self.stats.tasks_submitted += 1
+            n += 1
+        return n
+
+    def poll(self):
+        if not self._running:
+            return
+        ready, _ = ray_tpu.wait(
+            list(self._running), num_returns=len(self._running), timeout=0)
+        for meta_ref in ready:
+            blocks_ref, seq = self._running.pop(meta_ref)
+            summary = ray_tpu.get(meta_ref)
+            self.stats.tasks_finished += 1
+            if summary["num_rows"] > 0:
+                self.output_queue.append(RefBundle(
+                    blocks_ref, summary["num_rows"], summary["size_bytes"],
+                    seq))
+
+    def outstanding_refs(self):
+        return list(self._running)
+
+
+class LimitOperator(PhysicalOperator):
+    """Truncate the stream after N rows; slices the boundary bundle."""
+
+    def __init__(self, limit: int):
+        super().__init__(f"Limit[{limit}]")
+        self._remaining = limit
+
+    def dispatch(self, budget: int) -> int:
+        q = self.input_queues[0]
+        while q:
+            bundle = q.popleft()
+            if self._remaining <= 0:
+                continue  # drop (upstream already dispatched it)
+            if bundle.num_rows <= self._remaining:
+                self._remaining -= bundle.num_rows
+                self.output_queue.append(bundle)
+            else:
+                blocks = ray_tpu.get(bundle.blocks_ref)
+                take = self._remaining
+                out: List[Block] = []
+                for b in blocks:
+                    if take <= 0:
+                        break
+                    acc = BlockAccessor(b)
+                    out.append(acc.slice(0, min(take, b.num_rows)))
+                    take -= out[-1].num_rows
+                self._remaining = 0
+                self.output_queue.append(RefBundle.from_blocks(out))
+        return 0
+
+    def truncated(self) -> bool:
+        return self._remaining <= 0
+
+    def completed(self) -> bool:
+        return super().completed() or (
+            self._remaining <= 0 and not self.output_queue)
+
+
+class UnionOperator(PhysicalOperator):
+    def __init__(self, num_inputs: int):
+        super().__init__("Union", num_inputs=num_inputs)
+
+    def dispatch(self, budget: int) -> int:
+        for q in self.input_queues:
+            while q:
+                self.output_queue.append(q.popleft())
+        return 0
+
+
+def _zip_task(left: List[Block], right: List[Block]) -> Tuple[List[Block], dict]:
+    import pyarrow as pa
+
+    lt, rt = concat_blocks(left), concat_blocks(right)
+    if lt.num_rows != rt.num_rows:
+        raise ValueError(
+            f"zip requires equal rows, got {lt.num_rows} vs {rt.num_rows}")
+    cols = {n: lt.column(n) for n in lt.schema.names}
+    for n in rt.schema.names:
+        name = n if n not in cols else n + "_1"
+        cols[name] = rt.column(n)
+    out = pa.Table.from_arrays(list(cols.values()), names=list(cols))
+    return [out], {"num_rows": out.num_rows, "size_bytes": out.nbytes}
+
+
+class ZipOperator(PhysicalOperator):
+    """Pairwise zip of two streams; repartitions the right stream to match
+    left bundle boundaries would be costly — we require equal bundle row
+    counts after materializing both sides (barrier, like the reference's
+    ZipOperator which is an all-to-all)."""
+
+    def __init__(self):
+        super().__init__("Zip", num_inputs=2)
+        self._running: Dict[Any, Any] = {}
+        self._remote = ray_tpu.remote(num_returns=2)(_zip_task)
+        self._dispatched = False
+
+    def num_active_tasks(self) -> int:
+        return len(self._running)
+
+    def dispatch(self, budget: int) -> int:
+        if self._dispatched or not self.all_inputs_done():
+            return 0
+        left = sorted(self.input_queues[0], key=lambda b: b.seq)
+        right = sorted(self.input_queues[1], key=lambda b: b.seq)
+        self.input_queues[0].clear()
+        self.input_queues[1].clear()
+        lrefs = [b.blocks_ref for b in left]
+        rrefs = [b.blocks_ref for b in right]
+        lblocks = [b for refs in ray_tpu.get(lrefs) for b in refs]
+        rblocks = [b for refs in ray_tpu.get(rrefs) for b in refs]
+        blocks_ref, meta_ref = self._remote.remote(lblocks, rblocks)
+        self._running[meta_ref] = blocks_ref
+        self.stats.tasks_submitted += 1
+        self._dispatched = True
+        return 1
+
+    def poll(self):
+        if not self._running:
+            return
+        ready, _ = ray_tpu.wait(
+            list(self._running), num_returns=len(self._running), timeout=0)
+        for meta_ref in ready:
+            blocks_ref = self._running.pop(meta_ref)
+            summary = ray_tpu.get(meta_ref)
+            self.stats.tasks_finished += 1
+            self.output_queue.append(RefBundle(
+                blocks_ref, summary["num_rows"], summary["size_bytes"]))
+
+    def outstanding_refs(self):
+        return list(self._running)
+
+
+class AllToAllOperator(PhysicalOperator):
+    """Barrier operator: collects every input bundle, then runs a bulk
+    function (shuffle/sort/repartition/groupby) that may launch its own
+    remote tasks.  Runs in a helper thread so the scheduling loop stays
+    live (the reference's AllToAllOperator + exchange task schedulers)."""
+
+    def __init__(self, name: str,
+                 bulk_fn: Callable[[List[RefBundle]], List[RefBundle]]):
+        super().__init__(name)
+        self._bulk_fn = bulk_fn
+        self._thread: Optional[threading.Thread] = None
+        self._result: Optional[List[RefBundle]] = None
+        self._error: Optional[BaseException] = None
+        self._done = False
+
+    def num_active_tasks(self) -> int:
+        return 1 if (self._thread and self._thread.is_alive()) else 0
+
+    def dispatch(self, budget: int) -> int:
+        if self._thread is not None or not self.all_inputs_done():
+            return 0
+        bundles = list(self.input_queues[0])
+        self.input_queues[0].clear()
+
+        def run():
+            try:
+                self._result = self._bulk_fn(bundles)
+            except BaseException as e:  # propagated by poll()
+                self._error = e
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return 1
+
+    def poll(self):
+        if self._thread and not self._thread.is_alive() and not self._done:
+            self._done = True
+            if self._error is not None:
+                raise self._error
+            for b in self._result or []:
+                self.output_queue.append(b)
+
+    def completed(self) -> bool:
+        return self._done and not self.output_queue
+
+
+class ExecutorError(RuntimeError):
+    pass
+
+
+class StreamingExecutor:
+    """Drives a topology of PhysicalOperators until the terminal op drains.
+
+    The loop (one thread, mirrors streaming_executor.py:262
+    _scheduling_loop_step):
+      1. poll every op (harvest finished tasks)
+      2. forward outputs downstream
+      3. dispatch new tasks within the global CPU budget, preferring
+         downstream ops (drain before fill — liveness under bounded memory)
+      4. push terminal outputs into a bounded queue consumed by the caller
+    """
+
+    def __init__(self, ops: List[PhysicalOperator],
+                 max_output_buffer: int = 8,
+                 max_inflight_tasks: Optional[int] = None):
+        self._ops = ops  # topological order, terminal last
+        self._terminal = ops[-1]
+        self._outq: "queue.Queue" = queue.Queue(maxsize=max_output_buffer)
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        if max_inflight_tasks is None:
+            try:
+                max_inflight_tasks = int(
+                    ray_tpu.cluster_resources().get("CPU", 4))
+            except Exception:
+                max_inflight_tasks = 4
+        self._max_inflight = max(2, max_inflight_tasks)
+        self._thread = threading.Thread(
+            target=self._run, name="StreamingExecutor", daemon=True)
+
+    # -- consumer API --------------------------------------------------
+    def start(self):
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self._stop.set()
+
+    def output_bundles(self) -> Iterator[RefBundle]:
+        self.start()
+        while True:
+            item = self._outq.get()
+            if item is _SENTINEL:
+                break
+            yield item
+        if self._error is not None:
+            raise self._error
+
+    # -- loop ----------------------------------------------------------
+    def _run(self):
+        try:
+            while not self._stop.is_set():
+                progressed = self._step()
+                if self._completed():
+                    break
+                if not progressed:
+                    self._block_on_outstanding()
+        except BaseException as e:
+            self._error = e
+        finally:
+            self._outq.put(_SENTINEL)
+
+    def _completed(self) -> bool:
+        return all(op.completed() for op in self._ops)
+
+    def _limit_truncated(self) -> bool:
+        return any(isinstance(op, LimitOperator) and op.truncated()
+                   for op in self._ops)
+
+    def _step(self) -> bool:
+        progressed = False
+        for op in self._ops:
+            op.poll()
+
+        # Forward outputs downstream; terminal to the consumer queue.
+        for op in self._ops:
+            while True:
+                if op is self._terminal:
+                    if not op.output_queue:
+                        break
+                    try:
+                        self._outq.put(op.take_output(), timeout=0.2)
+                        progressed = True
+                    except queue.Full:
+                        break
+                else:
+                    out = op.take_output()
+                    if out is None:
+                        break
+                    for ds_op, ds_idx in op.downstreams:
+                        ds_op.add_input(out, ds_idx)
+                    progressed = True
+            if op.completed():
+                for ds_op, ds_idx in op.downstreams:
+                    if not ds_op.inputs_complete[ds_idx]:
+                        ds_op.mark_input_done(ds_idx)
+                        progressed = True
+
+        # After a Limit truncates, upstream work is useless: cancel pending
+        # reads and unstick queued-but-undispatched inputs so completion
+        # can propagate (running tasks drain naturally; Limit drops them).
+        truncated = self._limit_truncated()
+        if truncated:
+            cut = next(i for i, op in enumerate(self._ops)
+                       if isinstance(op, LimitOperator) and op.truncated())
+            for op in self._ops[:cut]:
+                for q in op.input_queues:
+                    q.clear()
+                for i in range(len(op.inputs_complete)):
+                    op.inputs_complete[i] = True
+                if isinstance(op, InputDataBuffer):
+                    op._pending_reads.clear()
+
+        inflight = sum(op.num_active_tasks() for op in self._ops)
+        budget = self._max_inflight - inflight
+        # Consumer not draining → hold dispatch (global memory backpressure).
+        if self._outq.qsize() >= self._outq.maxsize - 1:
+            budget = 0
+        if budget > 0:
+            for op in reversed(self._ops):  # drain downstream first
+                if truncated and op is not self._terminal:
+                    continue
+                n = op.dispatch(budget)
+                budget -= n
+                progressed = progressed or n > 0
+                if budget <= 0:
+                    break
+        return progressed
+
+    def _block_on_outstanding(self):
+        refs = [r for op in self._ops for r in op.outstanding_refs()]
+        if refs:
+            ray_tpu.wait(refs, num_returns=1, timeout=0.5)
+        else:
+            time.sleep(0.002)
+
+    def stats(self) -> Dict[str, OpStats]:
+        return {op.name: op.stats for op in self._ops}
+
+
+_SENTINEL = object()
+
+
+def connect(upstream: PhysicalOperator, downstream: PhysicalOperator,
+            index: int = 0):
+    upstream.downstreams.append((downstream, index))

@@ -1,0 +1,147 @@
+"""DataIterator: batch/row iteration over an executing plan, including the
+device feed path for TPU meshes.
+
+Counterpart of python/ray/data/iterator.py (iter_batches/iter_rows/
+iter_torch_batches).  The TPU-first addition is `iter_device_batches`,
+which assembles host batches into sharded `jax.Array`s over a Mesh via
+`jax.make_array_from_process_local_data` — the host→device feed for
+pjit programs (no torch dataloader equivalent exists in the reference's
+form; this replaces it).
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor, BlockBuilder, block_to_batch
+
+
+class DataIterator:
+    """Iterates batches from a stream of blocks.  ``block_source`` is a
+    zero-arg callable returning a fresh Iterator[Block] (one epoch)."""
+
+    def __init__(self, block_source: Callable[[], Iterator[Block]]):
+        self._block_source = block_source
+
+    # -- core ----------------------------------------------------------
+    def iter_blocks(self) -> Iterator[Block]:
+        return self._block_source()
+
+    def iter_batches(self, *, batch_size: Optional[int] = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     local_shuffle_buffer_size: Optional[int] = None,
+                     local_shuffle_seed: Optional[int] = None) -> Iterator[Any]:
+        blocks = self.iter_blocks()
+        if local_shuffle_buffer_size:
+            blocks = _shuffling_block_iter(
+                blocks, local_shuffle_buffer_size, local_shuffle_seed)
+        builder = BlockBuilder()
+        for block in blocks:
+            builder.add_block(block)
+            while batch_size and builder.num_rows() >= batch_size:
+                combined = builder.build()
+                acc = BlockAccessor(combined)
+                yield block_to_batch(acc.slice(0, batch_size), batch_format)
+                builder = BlockBuilder()
+                rest = acc.slice(batch_size, combined.num_rows)
+                if rest.num_rows:
+                    builder.add_block(rest)
+            if batch_size is None and builder.num_rows() > 0:
+                yield block_to_batch(builder.build(), batch_format)
+                builder = BlockBuilder()
+        if builder.num_rows() > 0 and not drop_last:
+            yield block_to_batch(builder.build(), batch_format)
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        for block in self.iter_blocks():
+            yield from BlockAccessor(block).iter_rows()
+
+    # -- device feed (TPU-first) --------------------------------------
+    def iter_device_batches(self, *, mesh, batch_size: int,
+                            partition_spec=None,
+                            batch_format: str = "numpy",
+                            drop_last: bool = True,
+                            prefetch: int = 2) -> Iterator[Any]:
+        """Yield dict-of-jax.Array batches sharded over ``mesh``.
+
+        The global batch is split along its leading axis over the mesh's
+        data-like axes per ``partition_spec`` (default: shard dim 0 over
+        ("data", "fsdp") axes present in the mesh).
+        """
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if partition_spec is None:
+            axes = tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+            partition_spec = PartitionSpec(axes if axes else None)
+
+        def to_device(batch: Dict[str, np.ndarray]):
+            out = {}
+            for name, arr in batch.items():
+                sharding = NamedSharding(mesh, partition_spec)
+                out[name] = jax.make_array_from_process_local_data(
+                    sharding, np.asarray(arr))
+            return out
+
+        host_iter = self.iter_batches(
+            batch_size=batch_size, batch_format="numpy",
+            drop_last=drop_last)
+        yield from _prefetched(map(to_device, host_iter), prefetch)
+
+
+def _shuffling_block_iter(blocks: Iterator[Block], buffer_rows: int,
+                          seed: Optional[int]) -> Iterator[Block]:
+    """Local shuffle: accumulate ≥buffer_rows, emit random halves."""
+    rng = np.random.default_rng(seed)
+    builder = BlockBuilder()
+    for block in blocks:
+        builder.add_block(block)
+        if builder.num_rows() >= buffer_rows:
+            combined = builder.build()
+            acc = BlockAccessor(combined)
+            perm = rng.permutation(combined.num_rows)
+            half = combined.num_rows // 2
+            yield acc.take(perm[:half].tolist())
+            builder = BlockBuilder()
+            builder.add_block(acc.take(perm[half:].tolist()))
+    if builder.num_rows() > 0:
+        combined = builder.build()
+        perm = rng.permutation(combined.num_rows)
+        yield BlockAccessor(combined).take(perm.tolist())
+
+
+def _prefetched(it: Iterator[Any], depth: int) -> Iterator[Any]:
+    """Background-thread prefetch so host batch assembly overlaps device
+    compute (the double-buffering idiom for TPU input pipelines)."""
+    if depth <= 0:
+        yield from it
+        return
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    done = object()
+    err: list = []
+
+    def pump():
+        try:
+            for item in it:
+                q.put(item)
+        except BaseException as e:
+            err.append(e)
+        finally:
+            q.put(done)
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is done:
+            break
+        yield item
+    if err:
+        raise err[0]

@@ -1,0 +1,123 @@
+"""Logical plan: lazy operator DAG built by Dataset transforms.
+
+Counterpart of python/ray/data/_internal/logical/interfaces/logical_plan.py
+and logical/operations/.  The plan is a DAG of LogicalOp nodes (linear for
+most pipelines; Union/Zip fan in).  The planner (planner.py) fuses adjacent
+row/batch maps and lowers to physical operators.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from ray_tpu.data.datasource import Datasource
+
+
+@dataclasses.dataclass
+class LogicalOp:
+    inputs: List["LogicalOp"] = dataclasses.field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+@dataclasses.dataclass
+class Read(LogicalOp):
+    datasource: Optional[Datasource] = None
+    parallelism: int = -1  # -1: choose from task count / defaults
+
+
+@dataclasses.dataclass
+class MapBatches(LogicalOp):
+    """fn(batch)->batch, applied per block (or re-batched at batch_size)."""
+
+    fn: Optional[Callable] = None
+    batch_size: Optional[int] = None
+    batch_format: str = "numpy"
+    fn_constructor: Optional[Callable[[], Any]] = None  # actor/callable-class
+    num_cpus: float = 1.0
+    concurrency: Optional[int] = None
+
+
+@dataclasses.dataclass
+class MapRows(LogicalOp):
+    fn: Optional[Callable] = None
+
+
+@dataclasses.dataclass
+class FlatMapRows(LogicalOp):
+    fn: Optional[Callable] = None
+
+
+@dataclasses.dataclass
+class FilterRows(LogicalOp):
+    fn: Optional[Callable] = None
+
+
+@dataclasses.dataclass
+class Limit(LogicalOp):
+    limit: int = 0
+
+
+@dataclasses.dataclass
+class Repartition(LogicalOp):
+    num_blocks: int = 0
+    shuffle: bool = False
+
+
+@dataclasses.dataclass
+class RandomShuffle(LogicalOp):
+    seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class Sort(LogicalOp):
+    key: Any = None
+    descending: bool = False
+
+
+@dataclasses.dataclass
+class Union(LogicalOp):
+    pass
+
+
+@dataclasses.dataclass
+class Zip(LogicalOp):
+    pass
+
+
+@dataclasses.dataclass
+class GroupByAggregate(LogicalOp):
+    key: Optional[str] = None
+    aggs: Sequence[Tuple[str, str, str]] = ()  # (agg_kind, on_col, out_name)
+
+
+@dataclasses.dataclass
+class Write(LogicalOp):
+    write_fn: Optional[Callable] = None  # (block, path, index) -> path
+    path: str = ""
+
+
+class LogicalPlan:
+    def __init__(self, terminal: LogicalOp):
+        self.terminal = terminal
+
+    def ops_topological(self) -> List[LogicalOp]:
+        seen: set = set()
+        order: List[LogicalOp] = []
+
+        def visit(op: LogicalOp):
+            if id(op) in seen:
+                return
+            seen.add(id(op))
+            for dep in op.inputs:
+                visit(dep)
+            order.append(op)
+
+        visit(self.terminal)
+        return order
+
+    def describe(self) -> str:
+        return " -> ".join(op.name for op in self.ops_topological())

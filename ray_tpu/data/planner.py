@@ -1,0 +1,540 @@
+"""Planner: logical plan → fused physical operator topology.
+
+Counterpart of python/ray/data/_internal/logical/rules/ (operator fusion)
+and planner/plan_*_op.py.  Map-family ops (MapBatches/MapRows/FlatMap/
+Filter) compile to BlockTransforms and consecutive ones fuse into one
+TaskPoolMapOperator; a leading fused chain rides inside the read tasks
+themselves (read fusion).  All-to-all ops (shuffle/sort/repartition/
+groupby) become barrier AllToAllOperators with their own remote fan-out.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import logical as L
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    BlockBuilder,
+    batch_to_block,
+    block_to_batch,
+    concat_blocks,
+    rows_to_block,
+)
+from ray_tpu.data.execution import (
+    AllToAllOperator,
+    BlockTransform,
+    InputDataBuffer,
+    LimitOperator,
+    PhysicalOperator,
+    RefBundle,
+    StreamingExecutor,
+    TaskPoolMapOperator,
+    UnionOperator,
+    ZipOperator,
+    connect,
+)
+
+DEFAULT_READ_PARALLELISM = 16
+
+
+# ---------------------------------------------------------------------------
+# Logical map ops → BlockTransforms
+# ---------------------------------------------------------------------------
+
+
+def _rebatch(blocks: Iterator[Block], batch_size: Optional[int]) -> Iterator[Block]:
+    """Yield blocks of exactly batch_size rows (except the last)."""
+    if batch_size is None:
+        yield from blocks
+        return
+    builder = BlockBuilder()
+    for block in blocks:
+        builder.add_block(block)
+        while builder.num_rows() >= batch_size:
+            combined = builder.build()
+            acc = BlockAccessor(combined)
+            yield acc.slice(0, batch_size)
+            builder = BlockBuilder()
+            if combined.num_rows > batch_size:
+                builder.add_block(acc.slice(batch_size, combined.num_rows))
+    if builder.num_rows() > 0:
+        yield builder.build()
+
+
+def _map_batches_transform(op: L.MapBatches) -> BlockTransform:
+    fn = op.fn
+    fmt = op.batch_format
+    batch_size = op.batch_size
+    ctor = op.fn_constructor
+
+    def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+        callable_fn = fn
+        if ctor is not None:
+            # Callable-class UDF: constructed once per task (the reference
+            # uses actor pools; task-lifetime reuse gives the same
+            # amortization on our single-host pool).
+            callable_fn = ctor()
+        for block in _rebatch(blocks, batch_size):
+            out = callable_fn(block_to_batch(block, fmt))
+            if _is_iterator_of_batches(out):
+                for b in out:
+                    yield batch_to_block(b)
+            else:
+                yield batch_to_block(out)
+
+    return transform
+
+
+def _is_iterator_of_batches(out) -> bool:
+    import pyarrow as pa
+
+    import pandas as pd
+
+    return not isinstance(out, (dict, pa.Table, pd.DataFrame))
+
+
+def _map_rows_transform(op: L.MapRows) -> BlockTransform:
+    fn = op.fn
+
+    def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+        for block in blocks:
+            rows = [fn(row) for row in BlockAccessor(block).iter_rows()]
+            yield rows_to_block(rows)
+
+    return transform
+
+
+def _flat_map_transform(op: L.FlatMapRows) -> BlockTransform:
+    fn = op.fn
+
+    def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+        for block in blocks:
+            rows = [r for row in BlockAccessor(block).iter_rows()
+                    for r in fn(row)]
+            if rows:
+                yield rows_to_block(rows)
+
+    return transform
+
+
+def _filter_transform(op: L.FilterRows) -> BlockTransform:
+    fn = op.fn
+
+    def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+        for block in blocks:
+            keep = [i for i, row in enumerate(BlockAccessor(block).iter_rows())
+                    if fn(row)]
+            if keep:
+                yield BlockAccessor(block).take(keep)
+
+    return transform
+
+
+def _write_transform(op: L.Write) -> BlockTransform:
+    write_fn, path = op.write_fn, op.path
+
+    def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+        import uuid
+
+        for block in blocks:
+            # Part index must be globally unique across tasks (a worker
+            # reused for two write tasks must not overwrite its own parts).
+            idx = uuid.uuid4().int % 10**10
+            out_path = write_fn(block, path, idx)
+            yield rows_to_block([{"path": out_path,
+                                  "num_rows": block.num_rows}])
+
+    return transform
+
+
+_MAP_COMPILERS = {
+    L.MapBatches: _map_batches_transform,
+    L.MapRows: _map_rows_transform,
+    L.FlatMapRows: _flat_map_transform,
+    L.FilterRows: _filter_transform,
+    L.Write: _write_transform,
+}
+
+
+def _is_map_op(op: L.LogicalOp) -> bool:
+    return type(op) in _MAP_COMPILERS
+
+
+# ---------------------------------------------------------------------------
+# All-to-all implementations (run inside AllToAllOperator's thread)
+# ---------------------------------------------------------------------------
+
+
+def _fetch_all_blocks(bundles: List[RefBundle]) -> List[Block]:
+    lists = ray_tpu.get([b.blocks_ref for b in bundles])
+    return [blk for lst in lists for blk in lst]
+
+
+def _split_task(blocks: List[Block], k: int, seed) -> Tuple[List[Block], dict]:
+    """Map phase of random shuffle: scatter rows into k random piles."""
+    rng = np.random.default_rng(seed)
+    combined = concat_blocks(blocks)
+    n = combined.num_rows
+    assign = rng.integers(0, k, size=n)
+    acc = BlockAccessor(combined)
+    out = [acc.take(np.nonzero(assign == i)[0].tolist()) for i in range(k)]
+    return out, {"num_rows": n, "size_bytes": combined.nbytes}
+
+
+def _merge_shuffle_task(index: int, seed, *piles: List[Block]) \
+        -> Tuple[List[Block], dict]:
+    """Reduce phase: concat pile #index from every map output, shuffle rows.
+
+    ``piles`` are passed as separate top-level args because (as in the
+    reference) ObjectRefs nested inside containers are not resolved."""
+    rng = np.random.default_rng(None if seed is None else seed + index)
+    mine = [p[index] for p in piles if p[index].num_rows > 0]
+    if not mine:
+        return [], {"num_rows": 0, "size_bytes": 0}
+    combined = concat_blocks(mine)
+    perm = rng.permutation(combined.num_rows)
+    out = BlockAccessor(combined).take(perm.tolist())
+    return [out], {"num_rows": out.num_rows, "size_bytes": out.nbytes}
+
+
+def plan_random_shuffle(op: L.RandomShuffle):
+    seed = op.seed
+
+    def bulk(bundles: List[RefBundle]) -> List[RefBundle]:
+        if not bundles:
+            return []
+        k = max(1, len(bundles))
+        split = ray_tpu.remote(num_returns=2)(_split_task)
+        merge = ray_tpu.remote(num_returns=2)(_merge_shuffle_task)
+        pile_refs, metas = [], []
+        for i, b in enumerate(bundles):
+            blocks_ref, meta_ref = split.remote(
+                ray_tpu.get(b.blocks_ref),
+                k, None if seed is None else seed + i)
+            pile_refs.append(blocks_ref)
+            metas.append(meta_ref)
+        ray_tpu.get(metas)  # barrier: all piles materialized
+        out: List[RefBundle] = []
+        pending = []
+        for idx in range(k):
+            blocks_ref, meta_ref = merge.remote(idx, seed, *pile_refs)
+            pending.append((blocks_ref, meta_ref))
+        for blocks_ref, meta_ref in pending:
+            summary = ray_tpu.get(meta_ref)
+            if summary["num_rows"] > 0:
+                out.append(RefBundle(
+                    blocks_ref, summary["num_rows"], summary["size_bytes"]))
+        return out
+
+    return AllToAllOperator("RandomShuffle", bulk)
+
+
+def _concat_task(lists: List[List[Block]]) -> Tuple[List[Block], dict]:
+    blocks = [b for lst in lists for b in lst]
+    if not blocks:
+        return [], {"num_rows": 0, "size_bytes": 0}
+    out = concat_blocks(blocks)
+    return [out], {"num_rows": out.num_rows, "size_bytes": out.nbytes}
+
+
+def plan_repartition(op: L.Repartition):
+    num_blocks = op.num_blocks
+
+    def bulk(bundles: List[RefBundle]) -> List[RefBundle]:
+        blocks = _fetch_all_blocks(bundles)
+        total = sum(b.num_rows for b in blocks)
+        if total == 0 or num_blocks <= 0:
+            return []
+        combined = concat_blocks(blocks)
+        acc = BlockAccessor(combined)
+        per = -(-total // num_blocks)
+        out = []
+        for start in range(0, total, per):
+            piece = acc.slice(start, min(start + per, total))
+            out.append(RefBundle.from_blocks([piece]))
+        return out
+
+    return AllToAllOperator(f"Repartition[{num_blocks}]", bulk)
+
+
+def _sort_sample_boundaries(blocks: List[Block], key: str, k: int,
+                            descending: bool) -> List:
+    samples = []
+    for b in blocks:
+        col = b.column(key).to_numpy(zero_copy_only=False)
+        if len(col):
+            take = min(len(col), 64)
+            idx = np.linspace(0, len(col) - 1, take).astype(int)
+            samples.append(col[idx])
+    if not samples:
+        return []
+    allv = np.sort(np.concatenate(samples))
+    if descending:
+        allv = allv[::-1]
+    qs = np.linspace(0, len(allv) - 1, k + 1).astype(int)[1:-1]
+    return [allv[q] for q in qs]
+
+
+def _range_partition_task(blocks: List[Block], key: str, boundaries: List,
+                          descending: bool) -> Tuple[List[Block], dict]:
+    combined = concat_blocks(blocks)
+    col = combined.column(key).to_numpy(zero_copy_only=False)
+    if descending:
+        assign = len(boundaries) - np.searchsorted(
+            np.asarray(boundaries)[::-1], col, side="left")
+    else:
+        assign = np.searchsorted(np.asarray(boundaries), col, side="right")
+    acc = BlockAccessor(combined)
+    out = [acc.take(np.nonzero(assign == i)[0].tolist())
+           for i in range(len(boundaries) + 1)]
+    return out, {"num_rows": combined.num_rows, "size_bytes": combined.nbytes}
+
+
+def _merge_sorted_task(index: int, key: str, descending: bool,
+                       *piles: List[Block]) -> Tuple[List[Block], dict]:
+    mine = [p[index] for p in piles if p[index].num_rows > 0]
+    if not mine:
+        return [], {"num_rows": 0, "size_bytes": 0}
+    combined = concat_blocks(mine)
+    out = BlockAccessor(combined).sort(key, descending)
+    return [out], {"num_rows": out.num_rows, "size_bytes": out.nbytes}
+
+
+def plan_sort(op: L.Sort):
+    key, descending = op.key, op.descending
+
+    def bulk(bundles: List[RefBundle]) -> List[RefBundle]:
+        blocks = _fetch_all_blocks(bundles)
+        if not blocks:
+            return []
+        k = max(1, len(bundles))
+        boundaries = _sort_sample_boundaries(blocks, key, k, descending)
+        if not boundaries:  # single partition
+            combined = BlockAccessor(concat_blocks(blocks)).sort(
+                key, descending)
+            return [RefBundle.from_blocks([combined])]
+        part = ray_tpu.remote(num_returns=2)(_range_partition_task)
+        merge = ray_tpu.remote(num_returns=2)(_merge_sorted_task)
+        pile_refs, metas = [], []
+        for b in bundles:
+            blocks_ref, meta_ref = part.remote(
+                ray_tpu.get(b.blocks_ref), key, boundaries, descending)
+            pile_refs.append(blocks_ref)
+            metas.append(meta_ref)
+        ray_tpu.get(metas)
+        out = []
+        pending = [merge.remote(idx, key, descending, *pile_refs)
+                   for idx in range(len(boundaries) + 1)]
+        for blocks_ref, meta_ref in pending:
+            summary = ray_tpu.get(meta_ref)
+            if summary["num_rows"] > 0:
+                out.append(RefBundle(
+                    blocks_ref, summary["num_rows"], summary["size_bytes"]))
+        return out
+
+    return AllToAllOperator(f"Sort[{key}]", bulk)
+
+
+def _hash_partition_task(blocks: List[Block], key: str, k: int) \
+        -> Tuple[List[Block], dict]:
+    combined = concat_blocks(blocks)
+    col = combined.column(key).to_numpy(zero_copy_only=False)
+    hashes = np.asarray([hash(v) for v in col], dtype=np.int64)
+    assign = np.abs(hashes) % k
+    acc = BlockAccessor(combined)
+    out = [acc.take(np.nonzero(assign == i)[0].tolist()) for i in range(k)]
+    return out, {"num_rows": combined.num_rows, "size_bytes": combined.nbytes}
+
+
+def _group_agg_task(index: int, key: Optional[str],
+                    aggs: Sequence[Tuple[str, str, str]],
+                    *piles: List[Block]) -> Tuple[List[Block], dict]:
+    mine = [p[index] for p in piles if p[index].num_rows > 0]
+    if not mine:
+        return [], {"num_rows": 0, "size_bytes": 0}
+    df = concat_blocks(mine).to_pandas()
+    out = _pandas_aggregate(df, key, aggs)
+    block = batch_to_block(out)
+    return [block], {"num_rows": block.num_rows, "size_bytes": block.nbytes}
+
+
+_AGG_FNS = {"sum": "sum", "min": "min", "max": "max",
+            "mean": "mean", "count": "count", "std": "std"}
+
+
+def _pandas_aggregate(df, key: Optional[str],
+                      aggs: Sequence[Tuple[str, str, str]]):
+    import pandas as pd
+
+    if key is None:
+        row = {}
+        for kind, on, out_name in aggs:
+            series = df[on]
+            row[out_name] = getattr(series, _AGG_FNS[kind])()
+        return pd.DataFrame([row])
+    grouped = df.groupby(key, sort=True)
+    cols = {}
+    for kind, on, out_name in aggs:
+        cols[out_name] = getattr(grouped[on], _AGG_FNS[kind])()
+    out = pd.DataFrame(cols).reset_index()
+    return out
+
+
+def plan_groupby(op: L.GroupByAggregate):
+    key, aggs = op.key, list(op.aggs)
+
+    def bulk(bundles: List[RefBundle]) -> List[RefBundle]:
+        blocks = _fetch_all_blocks(bundles)
+        if not blocks:
+            return []
+        if key is None:  # global aggregate — single reduce
+            df = concat_blocks(blocks).to_pandas()
+            block = batch_to_block(_pandas_aggregate(df, None, aggs))
+            return [RefBundle.from_blocks([block])]
+        k = max(1, min(len(bundles), 16))
+        part = ray_tpu.remote(num_returns=2)(_hash_partition_task)
+        agg = ray_tpu.remote(num_returns=2)(_group_agg_task)
+        pile_refs, metas = [], []
+        for b in bundles:
+            blocks_ref, meta_ref = part.remote(
+                ray_tpu.get(b.blocks_ref), key, k)
+            pile_refs.append(blocks_ref)
+            metas.append(meta_ref)
+        ray_tpu.get(metas)
+        pending = [agg.remote(idx, key, aggs, *pile_refs) for idx in range(k)]
+        out = []
+        for blocks_ref, meta_ref in pending:
+            summary = ray_tpu.get(meta_ref)
+            if summary["num_rows"] > 0:
+                out.append(RefBundle(
+                    blocks_ref, summary["num_rows"], summary["size_bytes"]))
+        return out
+
+    return AllToAllOperator(f"GroupBy[{key}]", bulk)
+
+
+# ---------------------------------------------------------------------------
+# Plan → topology
+# ---------------------------------------------------------------------------
+
+
+def build_topology(plan: "L.LogicalPlan") -> List[PhysicalOperator]:
+    """Lower the logical DAG into a topological list of physical ops,
+    fusing map chains and read+map."""
+    phys_of: Dict[int, PhysicalOperator] = {}
+    topo: List[PhysicalOperator] = []
+
+    # Fusing through an op consumed by >1 downstream ops would duplicate
+    # its work — count consumers first.
+    consumers: Dict[int, int] = {}
+    for node in plan.ops_topological():
+        for dep in node.inputs:
+            consumers[id(dep)] = consumers.get(id(dep), 0) + 1
+
+    def emit(op: PhysicalOperator) -> PhysicalOperator:
+        topo.append(op)
+        return op
+
+    def lower(op: L.LogicalOp) -> PhysicalOperator:
+        if id(op) in phys_of:
+            return phys_of[id(op)]
+
+        if _is_map_op(op):
+            # Collect the maximal map chain ending at `op`.
+            chain_ops: List[L.LogicalOp] = []
+            cur = op
+            while _is_map_op(cur):
+                chain_ops.append(cur)
+                if len(cur.inputs) != 1:
+                    break
+                nxt = cur.inputs[0]
+                if not _is_map_op(nxt) or consumers.get(id(nxt), 0) > 1 \
+                        or id(nxt) in phys_of:
+                    cur = nxt
+                    break
+                cur = nxt
+            chain_ops.reverse()
+            transforms = [
+                _MAP_COMPILERS[type(c)](c) for c in chain_ops]
+            # Fusion constraints: uniform cpu request, min concurrency cap.
+            num_cpus = max([getattr(c, "num_cpus", 1.0) or 1.0
+                            for c in chain_ops])
+            concs = [c.concurrency for c in chain_ops
+                     if getattr(c, "concurrency", None)]
+            conc = min(concs) if concs else None
+            upstream = cur
+            if (isinstance(upstream, L.Read)
+                    and consumers.get(id(upstream), 0) <= 1
+                    and id(upstream) not in phys_of):
+                phys = emit(_lower_read(upstream, chain=transforms))
+                phys_of[id(upstream)] = phys
+            else:
+                up_phys = lower(upstream)
+                phys = emit(TaskPoolMapOperator(
+                    "+".join(c.name for c in chain_ops), transforms,
+                    num_cpus=num_cpus, concurrency=conc))
+                connect(up_phys, phys)
+            for c in chain_ops:
+                phys_of[id(c)] = phys
+            return phys
+
+        if isinstance(op, L.Read):
+            phys = emit(_lower_read(op))
+        elif isinstance(op, L.Limit):
+            up = lower(op.inputs[0])
+            phys = emit(LimitOperator(op.limit))
+            connect(up, phys)
+        elif isinstance(op, L.Union):
+            ups = [lower(i) for i in op.inputs]
+            phys = emit(UnionOperator(len(ups)))
+            for idx, up in enumerate(ups):
+                connect(up, phys, idx)
+        elif isinstance(op, L.Zip):
+            ups = [lower(i) for i in op.inputs]
+            phys = emit(ZipOperator())
+            for idx, up in enumerate(ups):
+                connect(up, phys, idx)
+        elif isinstance(op, L.RandomShuffle):
+            up = lower(op.inputs[0])
+            phys = emit(plan_random_shuffle(op))
+            connect(up, phys)
+        elif isinstance(op, L.Repartition):
+            up = lower(op.inputs[0])
+            phys = emit(plan_repartition(op))
+            connect(up, phys)
+        elif isinstance(op, L.Sort):
+            up = lower(op.inputs[0])
+            phys = emit(plan_sort(op))
+            connect(up, phys)
+        elif isinstance(op, L.GroupByAggregate):
+            up = lower(op.inputs[0])
+            phys = emit(plan_groupby(op))
+            connect(up, phys)
+        else:
+            raise NotImplementedError(f"cannot lower {op.name}")
+        phys_of[id(op)] = phys
+        return phys
+
+    lower(plan.terminal)
+    return topo
+
+
+def _lower_read(op: L.Read, chain: Sequence[BlockTransform] = ()) \
+        -> InputDataBuffer:
+    parallelism = op.parallelism
+    if parallelism in (-1, 0, None):
+        parallelism = DEFAULT_READ_PARALLELISM
+    tasks = op.datasource.get_read_tasks(parallelism)
+    return InputDataBuffer(read_tasks=tasks, chain=chain)
+
+
+def execute_plan(plan: "L.LogicalPlan",
+                 max_inflight_tasks: Optional[int] = None) -> StreamingExecutor:
+    topo = build_topology(plan)
+    return StreamingExecutor(topo, max_inflight_tasks=max_inflight_tasks)

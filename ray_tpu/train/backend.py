@@ -122,7 +122,10 @@ class JaxBackend(Backend):
         infos = ray_tpu.get(refs, timeout=120)
         total = infos[0]["global_devices"]
         for info in infos:
-            assert info["global_devices"] == total, infos
+            if info["global_devices"] != total:
+                raise RuntimeError(
+                    "workers disagree on the global device count after "
+                    f"jax.distributed init: {infos}")
 
     def on_shutdown(self, worker_group, backend_config: JaxBackendConfig):
         import ray_tpu

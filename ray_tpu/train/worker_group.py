@@ -34,8 +34,13 @@ class TrainWorker:
         self.thread: Optional[threading.Thread] = None
         for k, v in (env or {}).items():
             if k == "XLA_FLAGS" and os.environ.get(k):
-                if v not in os.environ[k]:
-                    os.environ[k] = f"{os.environ[k]} {v}"
+                # Append, replacing any existing setting of the same flag
+                # (a substring test would skip e.g. count=1 when count=12
+                # is already present).
+                flag_name = v.split("=", 1)[0]
+                kept = [f for f in os.environ[k].split()
+                        if f.split("=", 1)[0] != flag_name]
+                os.environ[k] = " ".join(kept + [v])
             else:
                 os.environ[k] = v
 
@@ -126,10 +131,11 @@ def _takes_config(fn: Callable) -> bool:
         sig = inspect.signature(fn)
     except (TypeError, ValueError):
         return True
-    required = [p for p in sig.parameters.values()
-                if p.default is p.empty
-                and p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)]
-    return len(sig.parameters) > 0 and len(required) <= 1
+    positional = [p for p in sig.parameters.values()
+                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                                p.VAR_POSITIONAL)]
+    # Keyword-only / **kwargs-only loops take no config positionally.
+    return len(positional) >= 1
 
 
 class WorkerGroup:
