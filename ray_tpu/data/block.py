@@ -65,10 +65,11 @@ def _np_to_arrow_array(arr: np.ndarray) -> pa.Array:
     arr = np.asarray(arr)
     if arr.ndim <= 1:
         return pa.array(arr)
-    # Multi-dim columns (images, token blocks) become fixed-size lists,
-    # flattened recursively — round-trips through to_numpy below.
-    flat = pa.array(arr.reshape(arr.shape[0], -1).tolist())
-    return flat
+    # Multi-dim columns (images, token blocks) use the Arrow tensor
+    # extension type so shape round-trips through slicing/concat/pickle
+    # (reference ArrowTensorArray, python/ray/air/util/tensor_extensions/).
+    return pa.FixedShapeTensorArray.from_numpy_ndarray(
+        np.ascontiguousarray(arr))
 
 
 def _column_to_arrow(values: Any) -> pa.Array:
@@ -150,8 +151,11 @@ def block_to_batch(block: Block, batch_format: str = "numpy") -> BatchLike:
 
 
 def _arrow_col_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
+    combined = col.combine_chunks()
+    if isinstance(combined.type, pa.FixedShapeTensorType):
+        return combined.to_numpy_ndarray()
     try:
-        return col.combine_chunks().to_numpy(zero_copy_only=False)
+        return combined.to_numpy(zero_copy_only=False)
     except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
         return np.asarray(col.to_pylist(), dtype=object)
 

@@ -344,9 +344,14 @@ class _PlanCapsule:
 @ray_tpu.remote
 class _SplitCoordinator:
     """Runs the streaming executor once per epoch; consumers pull blocks
-    for their split index.  Round-robin bundle assignment approximates
-    equal row counts; with equal=True the surplus tail is truncated so all
-    splits yield exactly min_rows (the reference's equalization step)."""
+    for their split index (reference stream_split_iterator.py).
+
+    Epoch protocol: each consumer's k-th start_epoch call requests epoch
+    k-1; the pump for an epoch starts only once EVERY consumer has
+    requested it (a barrier — prevents a fast consumer from observing a
+    stale epoch and silently skipping it).  equal=True stages the whole
+    epoch, truncates every split to the minimum row count, then releases —
+    consumers can never overconsume surplus rows mid-stream."""
 
     def __init__(self, capsule: _PlanCapsule, n: int, equal: bool):
         import collections
@@ -357,27 +362,42 @@ class _SplitCoordinator:
         self._equal = equal
         self._lock = threading.Lock()
         self._epoch = -1
-        self._queues: List = []
+        self._requests = [-1] * n  # highest epoch each consumer asked for
+        self._queues: List = [collections.deque()
+                              for _ in builtins.range(n)]
         self._done = False
         self._thread = None
         self._cond = threading.Condition(self._lock)
 
     def start_epoch(self, idx: int) -> int:
-        """First caller of a new epoch kicks off execution; returns epoch id."""
-        import collections
+        """Consumer idx requests its next epoch; blocks until the epoch is
+        live (all consumers arrived), then returns its id."""
         import threading
 
         with self._cond:
-            if self._thread is None or (self._done and all(
-                    not q for q in self._queues)):
-                self._epoch += 1
-                self._done = False
-                self._queues = [
-                    collections.deque() for _ in builtins.range(self._n)]
-                self._thread = threading.Thread(
-                    target=self._pump, daemon=True)
-                self._thread.start()
-            return self._epoch
+            self._requests[idx] += 1
+            want = self._requests[idx]
+            while self._epoch < want:
+                ready = (min(self._requests) >= want
+                         and (self._thread is None or self._done)
+                         and not any(self._queues))
+                if ready:
+                    self._advance(want)
+                    break
+                self._cond.wait(timeout=1.0)
+            return want
+
+    def _advance(self, epoch: int):
+        """Lock held: reset state and launch the pump for ``epoch``."""
+        import collections
+        import threading
+
+        self._epoch = epoch
+        self._done = False
+        self._queues = [collections.deque()
+                        for _ in builtins.range(self._n)]
+        self._thread = threading.Thread(target=self._pump, daemon=True)
+        self._thread.start()
 
     def _pump(self):
         import numpy as np
@@ -385,44 +405,49 @@ class _SplitCoordinator:
         ds = self._capsule.to_dataset()
         ex = ds._execute()
         rows = [0] * self._n
+        staged: List[List] = [[] for _ in builtins.range(self._n)]
         try:
             for bundle in ex.output_bundles():
                 blocks = ray_tpu.get(bundle.blocks_ref)
-                with self._cond:
-                    # least-loaded split gets the next bundle
-                    tgt = int(np.argmin(rows))
-                    rows[tgt] += bundle.num_rows
-                    self._queues[tgt].append(blocks)
-                    self._cond.notify_all()
+                tgt = int(np.argmin(rows))
+                rows[tgt] += bundle.num_rows
+                if self._equal and self._n > 1:
+                    staged[tgt].append(blocks)  # hold back until equalized
+                else:
+                    with self._cond:
+                        self._queues[tgt].append(blocks)
+                        self._cond.notify_all()
             if self._equal and self._n > 1:
-                self._equalize(rows)
+                self._release_equalized(staged, rows)
         finally:
             with self._cond:
                 self._done = True
                 self._cond.notify_all()
 
-    def _equalize(self, rows: List[int]):
+    def _release_equalized(self, staged: List[List], rows: List[int]):
         target = min(rows)
-        with self._cond:
-            for i in builtins.range(self._n):
-                surplus = rows[i] - target
-                while surplus > 0 and self._queues[i]:
-                    blocks = self._queues[i].pop()
-                    have = sum(b.num_rows for b in blocks)
-                    if have <= surplus:
-                        surplus -= have
-                        continue
-                    combined = concat_blocks(blocks)
-                    keep = combined.num_rows - surplus
-                    self._queues[i].append(
-                        [BlockAccessor(combined).slice(0, keep)])
-                    surplus = 0
+        for i in builtins.range(self._n):
+            surplus = rows[i] - target
+            out = list(staged[i])
+            while surplus > 0 and out:
+                blocks = out.pop()
+                have = sum(b.num_rows for b in blocks)
+                if have <= surplus:
+                    surplus -= have
+                    continue
+                combined = concat_blocks(blocks)
+                keep = combined.num_rows - surplus
+                out.append([BlockAccessor(combined).slice(0, keep)])
+                surplus = 0
+            with self._cond:
+                self._queues[i].extend(out)
+                self._cond.notify_all()
 
     def get_next(self, idx: int, epoch: int):
         with self._cond:
             while True:
                 if epoch != self._epoch:
-                    return None  # stale consumer
+                    return None  # stale consumer (pre-barrier epochs only)
                 if self._queues[idx]:
                     return self._queues[idx].popleft()
                 if self._done:

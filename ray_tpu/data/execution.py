@@ -503,11 +503,14 @@ class StreamingExecutor:
                 if op is self._terminal:
                     if not op.output_queue:
                         break
+                    # Peek-then-put: only pop the bundle once the queue
+                    # accepted it, else a slow consumer would drop rows.
                     try:
-                        self._outq.put(op.take_output(), timeout=0.2)
-                        progressed = True
+                        self._outq.put(op.output_queue[0], timeout=0.2)
                     except queue.Full:
                         break
+                    op.take_output()
+                    progressed = True
                 else:
                     out = op.take_output()
                     if out is None:

@@ -215,7 +215,7 @@ def plan_random_shuffle(op: L.RandomShuffle):
         pile_refs, metas = [], []
         for i, b in enumerate(bundles):
             blocks_ref, meta_ref = split.remote(
-                ray_tpu.get(b.blocks_ref),
+                b.blocks_ref,
                 k, None if seed is None else seed + i)
             pile_refs.append(blocks_ref)
             metas.append(meta_ref)
@@ -263,15 +263,20 @@ def plan_repartition(op: L.Repartition):
     return AllToAllOperator(f"Repartition[{num_blocks}]", bulk)
 
 
-def _sort_sample_boundaries(blocks: List[Block], key: str, k: int,
-                            descending: bool) -> List:
-    samples = []
-    for b in blocks:
-        col = b.column(key).to_numpy(zero_copy_only=False)
-        if len(col):
-            take = min(len(col), 64)
-            idx = np.linspace(0, len(col) - 1, take).astype(int)
-            samples.append(col[idx])
+def _sample_task(blocks: List[Block], key: str) -> np.ndarray:
+    """Per-bundle boundary sample (runs remotely; only ~64 values travel
+    back to the driver instead of the whole bundle)."""
+    col = concat_blocks(blocks).column(key).to_numpy(zero_copy_only=False)
+    if not len(col):
+        return np.array([])
+    take = min(len(col), 64)
+    idx = np.linspace(0, len(col) - 1, take).astype(int)
+    return col[idx]
+
+
+def _boundaries_from_samples(samples: List[np.ndarray], k: int,
+                             descending: bool) -> List:
+    samples = [s for s in samples if len(s)]
     if not samples:
         return []
     allv = np.sort(np.concatenate(samples))
@@ -310,21 +315,24 @@ def plan_sort(op: L.Sort):
     key, descending = op.key, op.descending
 
     def bulk(bundles: List[RefBundle]) -> List[RefBundle]:
-        blocks = _fetch_all_blocks(bundles)
-        if not blocks:
+        if not bundles:
             return []
         k = max(1, len(bundles))
-        boundaries = _sort_sample_boundaries(blocks, key, k, descending)
+        sampler = ray_tpu.remote(_sample_task)
+        samples = ray_tpu.get(
+            [sampler.remote(b.blocks_ref, key) for b in bundles])
+        boundaries = _boundaries_from_samples(samples, k, descending)
         if not boundaries:  # single partition
-            combined = BlockAccessor(concat_blocks(blocks)).sort(
-                key, descending)
+            combined = BlockAccessor(
+                concat_blocks(_fetch_all_blocks(bundles))).sort(
+                    key, descending)
             return [RefBundle.from_blocks([combined])]
         part = ray_tpu.remote(num_returns=2)(_range_partition_task)
         merge = ray_tpu.remote(num_returns=2)(_merge_sorted_task)
         pile_refs, metas = [], []
         for b in bundles:
             blocks_ref, meta_ref = part.remote(
-                ray_tpu.get(b.blocks_ref), key, boundaries, descending)
+                b.blocks_ref, key, boundaries, descending)
             pile_refs.append(blocks_ref)
             metas.append(meta_ref)
         ray_tpu.get(metas)
@@ -341,12 +349,21 @@ def plan_sort(op: L.Sort):
     return AllToAllOperator(f"Sort[{key}]", bulk)
 
 
+def _stable_hash(value) -> int:
+    """Process-stable hash (Python's str hash is per-process randomized,
+    which would scatter one key across piles on different workers)."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.md5(repr(value).encode()).digest()[:8], "little")
+
+
 def _hash_partition_task(blocks: List[Block], key: str, k: int) \
         -> Tuple[List[Block], dict]:
     combined = concat_blocks(blocks)
     col = combined.column(key).to_numpy(zero_copy_only=False)
-    hashes = np.asarray([hash(v) for v in col], dtype=np.int64)
-    assign = np.abs(hashes) % k
+    hashes = np.asarray([_stable_hash(v) for v in col], dtype=np.uint64)
+    assign = hashes % k
     acc = BlockAccessor(combined)
     out = [acc.take(np.nonzero(assign == i)[0].tolist()) for i in range(k)]
     return out, {"num_rows": combined.num_rows, "size_bytes": combined.nbytes}
@@ -390,11 +407,10 @@ def plan_groupby(op: L.GroupByAggregate):
     key, aggs = op.key, list(op.aggs)
 
     def bulk(bundles: List[RefBundle]) -> List[RefBundle]:
-        blocks = _fetch_all_blocks(bundles)
-        if not blocks:
+        if not bundles:
             return []
         if key is None:  # global aggregate — single reduce
-            df = concat_blocks(blocks).to_pandas()
+            df = concat_blocks(_fetch_all_blocks(bundles)).to_pandas()
             block = batch_to_block(_pandas_aggregate(df, None, aggs))
             return [RefBundle.from_blocks([block])]
         k = max(1, min(len(bundles), 16))
@@ -402,8 +418,7 @@ def plan_groupby(op: L.GroupByAggregate):
         agg = ray_tpu.remote(num_returns=2)(_group_agg_task)
         pile_refs, metas = [], []
         for b in bundles:
-            blocks_ref, meta_ref = part.remote(
-                ray_tpu.get(b.blocks_ref), key, k)
+            blocks_ref, meta_ref = part.remote(b.blocks_ref, key, k)
             pile_refs.append(blocks_ref)
             metas.append(meta_ref)
         ray_tpu.get(metas)

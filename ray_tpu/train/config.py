@@ -58,3 +58,6 @@ class RunConfig:
         default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig)
+    # Tune stop criterion: {"metric": threshold} (stop when >=) or a
+    # callable (trial_id, metrics) -> bool (reference air.RunConfig.stop).
+    stop: Optional[object] = None

@@ -1,0 +1,62 @@
+"""ray_tpu.tune: distributed hyperparameter tuning.
+
+Counterpart of python/ray/tune (SURVEY.md §2.3 L3): Tuner → TuneController
+event loop over trial actors, search spaces/algorithms, ASHA/median/PBT
+schedulers, experiment state on disk.
+"""
+
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    SearchAlgorithm,
+    choice,
+    grid_search,
+    lograndint,
+    loguniform,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import (
+    Trainable,
+    get_checkpoint,
+    get_trial_dir,
+    get_trial_id,
+    report,
+)
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
+
+__all__ = [
+    "Tuner",
+    "TuneConfig",
+    "ResultGrid",
+    "Trainable",
+    "report",
+    "get_checkpoint",
+    "get_trial_id",
+    "get_trial_dir",
+    "grid_search",
+    "choice",
+    "uniform",
+    "quniform",
+    "loguniform",
+    "randint",
+    "lograndint",
+    "randn",
+    "sample_from",
+    "SearchAlgorithm",
+    "BasicVariantGenerator",
+    "TrialScheduler",
+    "FIFOScheduler",
+    "AsyncHyperBandScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+]

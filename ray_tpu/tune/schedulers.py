@@ -1,0 +1,194 @@
+"""Trial schedulers: FIFO, ASHA, median stopping, PBT.
+
+Counterpart of python/ray/tune/schedulers/ (async_hyperband.py
+AsyncHyperBandScheduler, median_stopping_rule.py, pbt.py
+PopulationBasedTraining).  The controller calls on_trial_result for every
+result and acts on the returned decision.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+PAUSE = "PAUSE"
+
+
+class TrialScheduler:
+    def set_objective(self, metric: str, mode: str):
+        self._metric = metric
+        self._mode = mode
+
+    def _score(self, result: Dict[str, Any]) -> Optional[float]:
+        v = result.get(self._metric)
+        if v is None:
+            return None
+        return float(v) if self._mode == "max" else -float(v)
+
+    def on_trial_result(self, trial, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial, result: Optional[Dict[str, Any]]):
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (python/ray/tune/schedulers/async_hyperband.py): rungs at
+    grace_period * reduction_factor^k; a trial reaching a rung stops
+    unless its score is in the top 1/reduction_factor of that rung."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, reduction_factor: float = 3,
+                 max_t: int = 100):
+        self._time_attr = time_attr
+        self._grace = grace_period
+        self._rf = reduction_factor
+        self._max_t = max_t
+        self._rungs: Dict[int, List[float]] = defaultdict(list)
+
+    def _rung_levels(self) -> List[int]:
+        levels = []
+        t = self._grace
+        while t < self._max_t:
+            levels.append(int(t))
+            t *= self._rf
+        return levels
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self._time_attr)
+        score = self._score(result)
+        if t is None or score is None:
+            return CONTINUE
+        if t >= self._max_t:
+            return STOP
+        for level in self._rung_levels():
+            if t >= level and level not in trial.rungs_seen:
+                trial.rungs_seen[level] = score
+                self._rungs[level].append(score)
+        # A trial that joined a rung before it filled escapes the arrival
+        # check (async ASHA's optimistic promotion); re-check its recorded
+        # rung scores against the current cutoffs so stragglers still stop.
+        for level, my in sorted(trial.rungs_seen.items(), reverse=True):
+            rung = self._rungs[level]
+            if len(rung) >= self._rf:
+                cutoff = float(np.quantile(rung, 1.0 - 1.0 / self._rf))
+                if my < cutoff:
+                    return STOP
+                break  # passed its highest filled rung
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose best score is worse than the median of other
+    trials' running means at the same step
+    (python/ray/tune/schedulers/median_stopping_rule.py)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self._time_attr = time_attr
+        self._grace = grace_period
+        self._min_samples = min_samples_required
+        self._means: Dict[str, List[float]] = defaultdict(list)
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self._time_attr, 0)
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        self._means[trial.trial_id].append(score)
+        if t < self._grace:
+            return CONTINUE
+        others = [float(np.mean(v)) for tid, v in self._means.items()
+                  if tid != trial.trial_id and v]
+        if len(others) < self._min_samples:
+            return CONTINUE
+        my_best = max(self._means[trial.trial_id])
+        if my_best < float(np.median(others)):
+            return STOP
+        return CONTINUE
+
+
+@dataclasses.dataclass
+class _PbtState:
+    last_perturb_t: int = 0
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (python/ray/tune/schedulers/pbt.py): every
+    perturbation_interval, bottom-quantile trials exploit a top-quantile
+    trial's checkpoint and explore (perturb) its hyperparameters.  The
+    controller executes the returned exploit directive."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self._time_attr = time_attr
+        self._interval = perturbation_interval
+        self._mutations = hyperparam_mutations or {}
+        self._quantile = quantile_fraction
+        self._resample_p = resample_probability
+        self._rng = np.random.default_rng(seed)
+        self._state: Dict[str, _PbtState] = defaultdict(_PbtState)
+        self._latest: Dict[str, float] = {}
+        self._trials: Dict[str, Any] = {}
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self._time_attr, 0)
+        score = self._score(result)
+        if score is not None:
+            self._latest[trial.trial_id] = score
+            self._trials[trial.trial_id] = trial
+        st = self._state[trial.trial_id]
+        if t - st.last_perturb_t < self._interval or score is None:
+            return CONTINUE
+        st.last_perturb_t = t
+
+        scores = sorted(self._latest.items(), key=lambda kv: kv[1])
+        n = len(scores)
+        if n < 2:
+            return CONTINUE
+        k = max(1, int(math.ceil(n * self._quantile)))
+        bottom = {tid for tid, _ in scores[:k]}
+        top = [tid for tid, _ in scores[-k:]]
+        if trial.trial_id in bottom:
+            donor_id = top[int(self._rng.integers(0, len(top)))]
+            donor = self._trials.get(donor_id)
+            if donor is None or donor_id == trial.trial_id:
+                return CONTINUE
+            new_config = self._explore(dict(donor.config))
+            trial.exploit_directive = {
+                "donor": donor_id, "config": new_config}
+            return PAUSE  # controller restarts from donor checkpoint
+        return CONTINUE
+
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        from ray_tpu.tune.search import Domain
+
+        for key, mutation in self._mutations.items():
+            if self._rng.random() < self._resample_p or key not in config:
+                if isinstance(mutation, Domain):
+                    config[key] = mutation.sample(self._rng)
+                elif isinstance(mutation, list):
+                    config[key] = mutation[
+                        int(self._rng.integers(0, len(mutation)))]
+                elif callable(mutation):
+                    config[key] = mutation()
+            else:
+                cur = config[key]
+                if isinstance(cur, (int, float)):
+                    factor = 1.2 if self._rng.random() < 0.5 else 0.8
+                    config[key] = type(cur)(cur * factor)
+        return config

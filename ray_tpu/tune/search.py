@@ -1,0 +1,237 @@
+"""Search spaces + search algorithms.
+
+Counterpart of python/ray/tune/search/ (sample.py domains,
+basic_variant.py BasicVariantGenerator).  Grid axes are expanded as a
+cross-product repeated num_samples times; stochastic domains are sampled
+per trial (reference basic_variant semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import math
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Domains (python/ray/tune/search/sample.py)
+# ---------------------------------------------------------------------------
+
+
+class Domain:
+    def sample(self, rng: np.random.Generator) -> Any:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass
+class GridSearch:
+    values: Sequence[Any]
+
+
+@dataclasses.dataclass
+class Choice(Domain):
+    values: Sequence[Any]
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+
+@dataclasses.dataclass
+class Uniform(Domain):
+    low: float
+    high: float
+
+    def sample(self, rng):
+        return float(rng.uniform(self.low, self.high))
+
+
+@dataclasses.dataclass
+class QUniform(Domain):
+    low: float
+    high: float
+    q: float
+
+    def sample(self, rng):
+        v = rng.uniform(self.low, self.high)
+        return float(np.round(v / self.q) * self.q)
+
+
+@dataclasses.dataclass
+class LogUniform(Domain):
+    low: float
+    high: float
+    base: float = 10.0
+
+    def sample(self, rng):
+        lo = math.log(self.low, self.base)
+        hi = math.log(self.high, self.base)
+        return float(self.base ** rng.uniform(lo, hi))
+
+
+@dataclasses.dataclass
+class RandInt(Domain):
+    low: int
+    high: int
+
+    def sample(self, rng):
+        return int(rng.integers(self.low, self.high))
+
+
+@dataclasses.dataclass
+class LogRandInt(Domain):
+    low: int
+    high: int
+    base: float = 10.0
+
+    def sample(self, rng):
+        lo = math.log(self.low, self.base)
+        hi = math.log(self.high, self.base)
+        return int(round(self.base ** rng.uniform(lo, hi)))
+
+
+@dataclasses.dataclass
+class RandN(Domain):
+    mean: float = 0.0
+    sd: float = 1.0
+
+    def sample(self, rng):
+        return float(rng.normal(self.mean, self.sd))
+
+
+@dataclasses.dataclass
+class SampleFrom(Domain):
+    fn: Callable[[Dict[str, Any]], Any]  # receives the partial config
+
+
+# public constructors (mirror ray.tune module functions)
+def grid_search(values) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def choice(values) -> Choice:
+    return Choice(list(values))
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def quniform(low, high, q) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def loguniform(low, high, base: float = 10.0) -> LogUniform:
+    return LogUniform(low, high, base)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def lograndint(low, high, base: float = 10.0) -> LogRandInt:
+    return LogRandInt(low, high, base)
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> RandN:
+    return RandN(mean, sd)
+
+
+def sample_from(fn) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+# ---------------------------------------------------------------------------
+# Variant generation
+# ---------------------------------------------------------------------------
+
+
+def _walk(space: Any, path=()):
+    """Yield (path, leaf) for every leaf in a nested dict space."""
+    if isinstance(space, dict):
+        for k, v in space.items():
+            yield from _walk(v, path + (k,))
+    else:
+        yield path, space
+
+
+def _set_path(cfg: Dict, path, value):
+    cur = cfg
+    for key in path[:-1]:
+        cur = cur.setdefault(key, {})
+    cur[path[-1]] = value
+
+
+class SearchAlgorithm:
+    """Yields trial configs; informed of results for adaptive algorithms."""
+
+    def set_space(self, space: Dict[str, Any], metric: Optional[str],
+                  mode: str):
+        raise NotImplementedError
+
+    def next_configs(self, n: int) -> List[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[Dict],
+                          error: bool = False):
+        pass
+
+
+class BasicVariantGenerator(SearchAlgorithm):
+    """Grid cross-product × num_samples random draws
+    (python/ray/tune/search/basic_variant.py)."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = np.random.default_rng(seed)
+        self._space: Dict[str, Any] = {}
+        self._grid_axes: List = []
+        self._grid_iter = None
+
+    def set_space(self, space, metric, mode):
+        self._space = space or {}
+        self._grid_axes = [
+            (path, leaf.values) for path, leaf in _walk(self._space)
+            if isinstance(leaf, GridSearch)
+        ]
+
+    def grid_size(self) -> int:
+        n = 1
+        for _, values in self._grid_axes:
+            n *= max(1, len(values))
+        return n
+
+    def _one(self, grid_assignment) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        deferred: List = []
+        for path, leaf in _walk(self._space):
+            if isinstance(leaf, GridSearch):
+                continue
+            if isinstance(leaf, SampleFrom):
+                deferred.append((path, leaf))
+            elif isinstance(leaf, Domain):
+                _set_path(cfg, path, leaf.sample(self._rng))
+            else:
+                _set_path(cfg, path, leaf)
+        for (path, values), v in grid_assignment:
+            _set_path(cfg, path, v)
+        for path, leaf in deferred:  # may reference sampled values
+            _set_path(cfg, path, leaf.fn(cfg))
+        return cfg
+
+    def next_configs(self, n: int) -> List[Dict[str, Any]]:
+        out = []
+        for _ in range(n):
+            if self._grid_axes:
+                if self._grid_iter is None:
+                    self._grid_iter = itertools.cycle(
+                        itertools.product(*[
+                            [((path, values), v) for v in values]
+                            for path, values in self._grid_axes
+                        ]))
+                assignment = next(self._grid_iter)
+            else:
+                assignment = ()
+            out.append(self._one(assignment))
+        return out

@@ -1,0 +1,201 @@
+"""Trainables + the trial-runner actor.
+
+Counterpart of python/ray/tune/trainable/ (Trainable ABC, function
+trainables run in an actor with a result queue).  Both styles:
+
+  - function trainable: ``def train(config): ... tune.report(metrics)`` —
+    runs in a daemon thread inside the trial actor; ``tune.report`` blocks
+    on a maxsize-1 queue (lockstep with the controller, same flow as the
+    train session).
+  - class Trainable: subclass with setup/step/save_checkpoint/
+    load_checkpoint; the actor calls step() on demand.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Inside a function trainable: hand metrics (and optionally a
+    checkpoint) to the controller (reference ray.tune.report)."""
+    s = getattr(_local, "session", None)
+    if s is None:
+        raise RuntimeError("tune.report() called outside a tune trial")
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = getattr(_local, "session", None)
+    if s is None:
+        raise RuntimeError("tune.get_checkpoint() called outside a trial")
+    return s.loaded_checkpoint
+
+
+def get_trial_id() -> str:
+    s = getattr(_local, "session", None)
+    return s.trial_id if s is not None else ""
+
+
+def get_trial_dir() -> str:
+    s = getattr(_local, "session", None)
+    return s.trial_dir if s is not None else ""
+
+
+class _TuneSession:
+    def __init__(self, trial_id: str, trial_dir: str,
+                 loaded_checkpoint: Optional[Checkpoint]):
+        self.trial_id = trial_id
+        self.trial_dir = trial_dir
+        self.loaded_checkpoint = loaded_checkpoint
+        self.result_queue: "queue.Queue" = queue.Queue(maxsize=1)
+        self.finished = threading.Event()
+
+    def report(self, metrics, checkpoint):
+        self.result_queue.put(
+            {"metrics": dict(metrics), "checkpoint": checkpoint})
+
+
+class Trainable:
+    """Class trainable API (python/ray/tune/trainable/trainable.py):
+    setup(config) → repeated step() → save/load checkpoints."""
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        pass
+
+    def step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        pass
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        pass
+
+
+class TrialRunner:
+    """Hosts one trial (function or class trainable).
+
+    The controller drives it with next_result() pulls; for class
+    trainables each pull advances one step() (the reference's
+    train-result cadence)."""
+
+    def __init__(self, trainable, config: Dict[str, Any], trial_id: str,
+                 trial_dir: str, checkpoint_path: Optional[str] = None):
+        os.makedirs(trial_dir, exist_ok=True)
+        self._trainable = trainable
+        self._config = config
+        self._trial_id = trial_id
+        self._trial_dir = trial_dir
+        self._ckpt = Checkpoint(checkpoint_path) if checkpoint_path else None
+        self._error: Optional[str] = None
+        self._iteration = 0
+        self._ckpt_counter = 0
+
+        if isinstance(trainable, type) and issubclass(trainable, Trainable):
+            self._mode = "class"
+            self._instance = trainable()
+            try:
+                self._instance.setup(dict(config))
+                if self._ckpt is not None:
+                    self._instance.load_checkpoint(self._ckpt.as_directory())
+            except BaseException:
+                self._error = traceback.format_exc()
+        else:
+            self._mode = "function"
+            self._session = _TuneSession(trial_id, trial_dir, self._ckpt)
+            self._thread = threading.Thread(
+                target=self._run_function, daemon=True)
+            self._thread.start()
+
+    # -- function-mode loop -------------------------------------------------
+    def _run_function(self):
+        _local.session = self._session
+        try:
+            out = self._trainable(dict(self._config))
+            if isinstance(out, dict):
+                self._session.result_queue.put(
+                    {"metrics": out, "checkpoint": None})
+        except BaseException:
+            self._error = traceback.format_exc()
+        finally:
+            self._session.finished.set()
+
+    # -- controller surface -------------------------------------------------
+    def next_result(self, timeout: float = 1.0) -> Optional[Dict[str, Any]]:
+        if self._mode == "class":
+            return self._class_step()
+        if self._error is not None:
+            return {"error": True, "traceback": self._error}
+        try:
+            item = self._session.result_queue.get(timeout=timeout)
+        except queue.Empty:
+            if self._error is not None:
+                return {"error": True, "traceback": self._error}
+            if self._session.finished.is_set() \
+                    and self._session.result_queue.empty():
+                return {"finished": True}
+            return None
+        self._iteration += 1
+        return self._package(item)
+
+    def _class_step(self) -> Dict[str, Any]:
+        if self._error is not None:
+            return {"error": True, "traceback": self._error}
+        try:
+            metrics = self._instance.step()
+        except StopIteration:
+            return {"finished": True}
+        except BaseException:
+            return {"error": True, "traceback": traceback.format_exc()}
+        self._iteration += 1
+        ckpt = None
+        return self._package({"metrics": metrics or {}, "checkpoint": ckpt})
+
+    def _package(self, item: Dict[str, Any]) -> Dict[str, Any]:
+        metrics = dict(item.get("metrics") or {})
+        metrics.setdefault("training_iteration", self._iteration)
+        out = {"metrics": metrics}
+        ckpt = item.get("checkpoint")
+        if ckpt is not None:
+            out["checkpoint_path"] = self._persist(ckpt)
+        return out
+
+    def _persist(self, ckpt: Checkpoint) -> str:
+        self._ckpt_counter += 1
+        dest = os.path.join(
+            self._trial_dir, f"checkpoint_{self._ckpt_counter:06d}")
+        ckpt.to_directory(dest)
+        return dest
+
+    def save(self) -> Optional[str]:
+        """Checkpoint a class trainable on demand (scheduler pause/PBT)."""
+        if self._mode != "class":
+            return None
+        self._ckpt_counter += 1
+        dest = os.path.join(
+            self._trial_dir, f"checkpoint_{self._ckpt_counter:06d}")
+        os.makedirs(dest, exist_ok=True)
+        self._instance.save_checkpoint(dest)
+        return dest
+
+    def stop(self) -> bool:
+        if self._mode == "class":
+            try:
+                self._instance.cleanup()
+            except BaseException:
+                pass
+        return True

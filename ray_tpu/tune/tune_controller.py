@@ -1,0 +1,278 @@
+"""TuneController: the trial-driving event loop.
+
+Counterpart of python/ray/tune/execution/tune_controller.py (TuneController
+:68; step() :666 schedules trial actors :964, consumes results, applies
+scheduler decisions, checkpoints experiment state).  Trials run as
+TrialRunner actors; the loop polls next_result, feeds the scheduler, and
+executes STOP/PAUSE(+PBT exploit) decisions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.trainer import Result
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.schedulers import CONTINUE, PAUSE, STOP, TrialScheduler
+from ray_tpu.tune.search import SearchAlgorithm
+from ray_tpu.tune.trainable import TrialRunner
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+@dataclasses.dataclass
+class Trial:
+    trial_id: str
+    config: Dict[str, Any]
+    trial_dir: str
+    state: str = PENDING
+    runner: Any = None
+    last_result: Optional[Dict[str, Any]] = None
+    metrics_history: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+    last_checkpoint: Optional[str] = None
+    error: Optional[str] = None
+    num_failures: int = 0
+    rungs_seen: Dict[int, bool] = dataclasses.field(default_factory=dict)
+    exploit_directive: Optional[Dict[str, Any]] = None
+
+    def best_metric(self, metric: str, mode: str) -> Optional[float]:
+        vals = [r[metric] for r in self.metrics_history if metric in r]
+        if not vals:
+            return None
+        return max(vals) if mode == "max" else min(vals)
+
+
+class TuneController:
+    def __init__(self, trainable, *, search_alg: SearchAlgorithm,
+                 scheduler: TrialScheduler, num_samples: int,
+                 metric: Optional[str], mode: str,
+                 max_concurrent: int, run_dir: str,
+                 stop: Optional[Any] = None,
+                 max_failures: int = 0,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self._trainable = trainable
+        self._search = search_alg
+        self._scheduler = scheduler
+        self._scheduler.set_objective(metric or "_", mode)
+        self._metric = metric
+        self._mode = mode
+        self._max_concurrent = max(1, max_concurrent)
+        self._run_dir = run_dir
+        self._stop = stop
+        self._max_failures = max_failures
+        self._resources = resources_per_trial or {"num_cpus": 1.0}
+        os.makedirs(run_dir, exist_ok=True)
+
+        configs = search_alg.next_configs(num_samples)
+        self.trials: List[Trial] = [
+            Trial(trial_id=f"trial_{i:05d}", config=cfg,
+                  trial_dir=os.path.join(run_dir, f"trial_{i:05d}"))
+            for i, cfg in enumerate(configs)
+        ]
+
+    # ------------------------------------------------------------------
+    def run(self) -> List[Trial]:
+        try:
+            while any(t.state in (PENDING, RUNNING) for t in self.trials):
+                self._start_pending()
+                self._poll_running()
+                self._save_experiment_state()
+        finally:
+            for t in self.trials:
+                self._shutdown_runner(t)
+            self._save_experiment_state()
+        return self.trials
+
+    # ------------------------------------------------------------------
+    def _start_pending(self):
+        running = sum(1 for t in self.trials if t.state == RUNNING)
+        for t in self.trials:
+            if running >= self._max_concurrent:
+                break
+            if t.state != PENDING:
+                continue
+            self._start_trial(t)
+            running += 1
+
+    def _start_trial(self, t: Trial, checkpoint_path: Optional[str] = None):
+        opts: Dict[str, Any] = {"max_concurrency": 4}
+        if "num_cpus" in self._resources:
+            opts["num_cpus"] = self._resources["num_cpus"]
+        if "num_tpus" in self._resources:
+            opts["num_tpus"] = self._resources["num_tpus"]
+        # Wrap at the call site (module attr must stay the plain class so
+        # cloudpickle serializes it by reference, not by value).
+        runner_cls = ray_tpu.remote(**opts)(TrialRunner)
+        t.runner = runner_cls.remote(
+            self._trainable, t.config, t.trial_id, t.trial_dir,
+            checkpoint_path or t.last_checkpoint)
+        t.state = RUNNING
+
+    def _shutdown_runner(self, t: Trial):
+        if t.runner is not None:
+            try:
+                ray_tpu.get(t.runner.stop.remote(), timeout=5)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(t.runner)
+            except Exception:
+                pass
+            t.runner = None
+
+    # ------------------------------------------------------------------
+    def _poll_running(self):
+        running = [t for t in self.trials if t.state == RUNNING]
+        if not running:
+            return
+        refs = {t.trial_id: t.runner.next_result.remote(0.5)
+                for t in running}
+        for t in running:
+            try:
+                item = ray_tpu.get(refs[t.trial_id], timeout=600)
+            except Exception:
+                self._on_trial_error(t, traceback.format_exc())
+                continue
+            if item is None:
+                continue
+            if item.get("error"):
+                self._on_trial_error(t, item.get("traceback", ""))
+                continue
+            if item.get("finished"):
+                self._complete(t)
+                continue
+            self._on_result(t, item)
+
+    def _on_result(self, t: Trial, item: Dict[str, Any]):
+        metrics = item["metrics"]
+        if item.get("checkpoint_path"):
+            t.last_checkpoint = item["checkpoint_path"]
+            metrics = dict(metrics)
+            metrics["checkpoint_path"] = item["checkpoint_path"]
+        t.last_result = metrics
+        t.metrics_history.append(metrics)
+
+        if self._should_stop(t.trial_id, metrics):
+            self._complete(t)
+            return
+        decision = self._scheduler.on_trial_result(t, metrics)
+        if decision == STOP:
+            self._complete(t)
+        elif decision == PAUSE and t.exploit_directive:
+            self._exploit(t)
+
+    def _should_stop(self, trial_id: str, metrics: Dict[str, Any]) -> bool:
+        stop = self._stop
+        if stop is None:
+            return False
+        if callable(stop):
+            return bool(stop(trial_id, metrics))
+        if isinstance(stop, dict):
+            return any(k in metrics and metrics[k] >= v
+                       for k, v in stop.items())
+        return False
+
+    def _complete(self, t: Trial):
+        # Snapshot class trainables so the final state is recoverable.
+        if t.runner is not None:
+            try:
+                path = ray_tpu.get(t.runner.save.remote(), timeout=30)
+                if path:
+                    t.last_checkpoint = path
+            except Exception:
+                pass
+        self._shutdown_runner(t)
+        t.state = TERMINATED
+        self._search.on_trial_complete(t.trial_id, t.last_result)
+        self._scheduler.on_trial_complete(t, t.last_result)
+
+    def _on_trial_error(self, t: Trial, tb: str):
+        t.num_failures += 1
+        self._shutdown_runner(t)
+        if t.num_failures <= self._max_failures:
+            # retry from the last checkpoint (FailureConfig semantics)
+            self._start_trial(t)
+            return
+        t.error = tb
+        t.state = ERROR
+        self._search.on_trial_complete(t.trial_id, None, error=True)
+
+    def _exploit(self, t: Trial):
+        """PBT: restart this trial from the donor's checkpoint with the
+        explored config (pbt.py _exploit)."""
+        directive = t.exploit_directive or {}
+        t.exploit_directive = None
+        donor = next((d for d in self.trials
+                      if d.trial_id == directive.get("donor")), None)
+        if donor is None:
+            return
+        donor_ckpt = donor.last_checkpoint
+        if donor.runner is not None:
+            try:
+                path = ray_tpu.get(donor.runner.save.remote(), timeout=60)
+                if path:
+                    donor_ckpt = path
+                    donor.last_checkpoint = path
+            except Exception:
+                pass
+        if donor_ckpt is None:
+            return
+        self._shutdown_runner(t)
+        t.config = dict(directive.get("config") or t.config)
+        self._start_trial(t, checkpoint_path=donor_ckpt)
+
+    # ------------------------------------------------------------------
+    def _save_experiment_state(self):
+        state = {
+            "timestamp": time.time(),
+            "trials": [
+                {
+                    "trial_id": t.trial_id,
+                    "config": _json_safe(t.config),
+                    "state": t.state,
+                    "last_result": _json_safe(t.last_result),
+                    "last_checkpoint": t.last_checkpoint,
+                    "num_failures": t.num_failures,
+                    "error": t.error,
+                }
+                for t in self.trials
+            ],
+        }
+        tmp = os.path.join(self._run_dir, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(
+            self._run_dir, "experiment_state.json"))
+
+
+def _json_safe(obj):
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+def trials_to_results(trials: List[Trial]) -> List[Result]:
+    out = []
+    for t in trials:
+        out.append(Result(
+            metrics=t.last_result or {},
+            checkpoint=(Checkpoint(t.last_checkpoint)
+                        if t.last_checkpoint else None),
+            path=t.trial_dir,
+            metrics_history=t.metrics_history,
+            error=t.error,
+        ))
+    return out

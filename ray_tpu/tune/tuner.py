@@ -1,0 +1,155 @@
+"""Tuner: the user-facing tuning entry point.
+
+Counterpart of python/ray/tune/tuner.py (Tuner.fit :44/:344 →
+TunerInternal → TuneController) and result_grid.py ResultGrid.  Also
+wraps DataParallelTrainer instances as trainables the way the reference
+wraps trainers in a TrainTrainable (base_trainer.py:724).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.train.config import RunConfig
+from ray_tpu.train.trainer import DataParallelTrainer, Result
+from ray_tpu.tune.schedulers import FIFOScheduler, TrialScheduler
+from ray_tpu.tune.search import BasicVariantGenerator, SearchAlgorithm
+from ray_tpu.tune.tune_controller import (
+    TuneController,
+    trials_to_results,
+)
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    """python/ray/tune/tune_config.py."""
+
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: int = 4
+    search_alg: Optional[SearchAlgorithm] = None
+    scheduler: Optional[TrialScheduler] = None
+    seed: Optional[int] = None
+
+
+class ResultGrid:
+    """python/ray/tune/result_grid.py."""
+
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def errors(self):
+        return [r.error for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (none set in TuneConfig)")
+        scored = [r for r in self._results
+                  if r.metrics and metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(scored, key=key) if mode == "max" else min(scored, key=key)
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([r.metrics or {} for r in self._results])
+
+
+def _trainer_to_trainable(trainer: DataParallelTrainer):
+    """Run a trainer inside a trial, merging the trial config into
+    train_loop_config and re-reporting its results
+    (reference TrainTrainable, base_trainer.py:724)."""
+
+    def trainable(config: Dict[str, Any]):
+        from ray_tpu.tune.trainable import report
+
+        t = copy.copy(trainer)
+        t.train_loop_config = {**(trainer.train_loop_config or {}), **config}
+        # Each trial gets its own run dir under the trial sandbox.
+        from ray_tpu.tune.trainable import get_trial_dir, get_trial_id
+
+        t.run_config = copy.copy(trainer.run_config)
+        t.run_config.storage_path = get_trial_dir() or None
+        t.run_config.name = "train"
+        result = t.fit()
+        for entry in result.metrics_history:
+            report(dict(entry))
+        if not result.metrics_history and result.metrics:
+            report(dict(result.metrics))
+
+    return trainable
+
+
+class Tuner:
+    def __init__(self, trainable, *, param_space: Optional[Dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resources_per_trial: Optional[Dict[str, float]] = None):
+        self._user_trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config or RunConfig()
+        self.resources_per_trial = resources_per_trial
+
+    def fit(self) -> ResultGrid:
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self.tune_config
+        trainable = self._user_trainable
+        if isinstance(trainable, DataParallelTrainer):
+            trainable = _trainer_to_trainable(trainable)
+
+        search = tc.search_alg or BasicVariantGenerator(seed=tc.seed)
+        search.set_space(self.param_space, tc.metric, tc.mode)
+        scheduler = tc.scheduler or FIFOScheduler()
+
+        num_samples = tc.num_samples
+        if isinstance(search, BasicVariantGenerator):
+            # grid axes multiply the sample count (reference semantics:
+            # num_samples repeats of the full grid).
+            num_samples = tc.num_samples * search.grid_size()
+
+        run_dir = os.path.join(
+            self.run_config.storage_path or
+            os.path.expanduser("~/ray_tpu_results"),
+            self.run_config.name or "tune_run")
+        stop = getattr(self.run_config, "stop", None)
+        controller = TuneController(
+            trainable,
+            search_alg=search,
+            scheduler=scheduler,
+            num_samples=num_samples,
+            metric=tc.metric,
+            mode=tc.mode,
+            max_concurrent=tc.max_concurrent_trials,
+            run_dir=run_dir,
+            stop=stop,
+            max_failures=self.run_config.failure_config.max_failures,
+            resources_per_trial=self.resources_per_trial,
+        )
+        trials = controller.run()
+        return ResultGrid(trials_to_results(trials), tc.metric, tc.mode)
