@@ -49,6 +49,16 @@ class TransformerConfig:
     remat: bool = True
     scan_layers: bool = True
     use_flash: bool = True  # ops.flash_attention pallas kernel when on TPU
+    # Sequence/context parallelism: ring attention over the mesh "seq"
+    # axis (ops/ring_attention.py).  "auto" uses it iff the ambient mesh
+    # shards seq; True forces; False never.
+    ring_attention: Any = "auto"
+    # Mixture-of-experts: num_experts > 0 replaces the dense FFN with a
+    # top-k routed expert FFN (models/moe.py) on the "expert" mesh axis.
+    num_experts: int = 0
+    num_experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coef: float = 0.01
 
     @property
     def head_dim_(self) -> int:
@@ -96,19 +106,28 @@ def init_params(config: TransformerConfig, key) -> Dict[str, Any]:
         return _dense_init(k, shape, pd, fan_in)
 
     h, m = c.hidden_size, c.intermediate_size
+    blocks = {
+        "attn_norm": jnp.ones(block_shape((h,)), pd),
+        "wq": init_block(keys[1], (h, c.num_heads * hd), h),
+        "wk": init_block(keys[2], (h, c.num_kv_heads * hd), h),
+        "wv": init_block(keys[3], (h, c.num_kv_heads * hd), h),
+        "wo": init_block(keys[4], (c.num_heads * hd, h), c.num_heads * hd),
+        "mlp_norm": jnp.ones(block_shape((h,)), pd),
+    }
+    if c.num_experts > 0:
+        E = c.num_experts
+        blocks["router"] = init_block(keys[5], (h, E), h)
+        blocks["we_gate"] = init_block(keys[6], (E, h, m), h)
+        blocks["we_up"] = init_block(keys[7], (E, h, m), h)
+        blocks["we_down"] = init_block(
+            jax.random.fold_in(keys[7], 1), (E, m, h), m)
+    else:
+        blocks["w_gate"] = init_block(keys[5], (h, m), h)
+        blocks["w_up"] = init_block(keys[6], (h, m), h)
+        blocks["w_down"] = init_block(keys[7], (m, h), m)
     params = {
         "tok_embed": _dense_init(keys[0], (c.vocab_size, h), pd, h),
-        "blocks": {
-            "attn_norm": jnp.ones(block_shape((h,)), pd),
-            "wq": init_block(keys[1], (h, c.num_heads * hd), h),
-            "wk": init_block(keys[2], (h, c.num_kv_heads * hd), h),
-            "wv": init_block(keys[3], (h, c.num_kv_heads * hd), h),
-            "wo": init_block(keys[4], (c.num_heads * hd, h), c.num_heads * hd),
-            "mlp_norm": jnp.ones(block_shape((h,)), pd),
-            "w_gate": init_block(keys[5], (h, m), h),
-            "w_up": init_block(keys[6], (h, m), h),
-            "w_down": init_block(keys[7], (m, h), m),
-        },
+        "blocks": blocks,
         "final_norm": jnp.ones((h,), pd),
     }
     return params
@@ -117,19 +136,30 @@ def init_params(config: TransformerConfig, key) -> Dict[str, Any]:
 def logical_axes(config: TransformerConfig) -> Dict[str, Any]:
     """Logical-axis tree matching init_params, for parallel.sharding rules."""
     L = ("layers",) if config.scan_layers else ()
-    return {
-        "tok_embed": ("vocab", "embed"),
-        "blocks": {
-            "attn_norm": L + (None,),
-            "wq": L + ("embed", "heads"),
-            "wk": L + ("embed", "heads"),
-            "wv": L + ("embed", "heads"),
-            "wo": L + ("heads", "embed"),
-            "mlp_norm": L + (None,),
+    blocks = {
+        "attn_norm": L + (None,),
+        "wq": L + ("embed", "heads"),
+        "wk": L + ("embed", "heads"),
+        "wv": L + ("embed", "heads"),
+        "wo": L + ("heads", "embed"),
+        "mlp_norm": L + (None,),
+    }
+    if config.num_experts > 0:
+        blocks.update({
+            "router": L + ("embed", None),
+            "we_gate": L + ("expert", "embed", "mlp"),
+            "we_up": L + ("expert", "embed", "mlp"),
+            "we_down": L + ("expert", "mlp", "embed"),
+        })
+    else:
+        blocks.update({
             "w_gate": L + ("embed", "mlp"),
             "w_up": L + ("embed", "mlp"),
             "w_down": L + ("mlp", "embed"),
-        },
+        })
+    return {
+        "tok_embed": ("vocab", "embed"),
+        "blocks": blocks,
         "final_norm": (None,),
     }
 
@@ -162,6 +192,19 @@ def apply_rope(x, cos, sin, positions):
     return out.astype(x.dtype)
 
 
+def _use_ring(config: TransformerConfig) -> bool:
+    if config.ring_attention is True:
+        return True
+    if config.ring_attention == "auto":
+        import jax as _jax
+
+        mesh = _jax.sharding.get_abstract_mesh()
+        return (mesh is not None and not mesh.empty
+                and "seq" in mesh.axis_names
+                and mesh.shape.get("seq", 1) > 1)
+    return False
+
+
 def _attention(q, k, v, mask, config: TransformerConfig):
     """q:[b,s,h,hd] k,v:[b,s,kv,hd] causal attention with GQA."""
     b, s, h, hd = q.shape
@@ -170,6 +213,10 @@ def _attention(q, k, v, mask, config: TransformerConfig):
         rep = h // kv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+    if _use_ring(config):
+        from ray_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(q, k, v, causal=True)
     if config.use_flash:
         from ray_tpu.ops.attention import flash_attention
 
@@ -201,16 +248,30 @@ def _block(x, bp, cos, sin, positions, mask, config: TransformerConfig):
     x = with_logical_constraint(x, ("batch", "seq", "embed"))
 
     y = rms_norm(x, bp["mlp_norm"], c.rms_eps)
-    gate = jax.nn.silu(y @ bp["w_gate"].astype(c.dtype))
-    up = y @ bp["w_up"].astype(c.dtype)
-    ffn = with_logical_constraint(gate * up, ("batch", "seq", "mlp"))
-    x = x + (ffn @ bp["w_down"].astype(c.dtype))
-    return with_logical_constraint(x, ("batch", "seq", "embed"))
+    if c.num_experts > 0:
+        from ray_tpu.models.moe import moe_ffn
+
+        out2d, aux = moe_ffn(
+            y.reshape(b * s, h), bp["router"], bp["we_gate"],
+            bp["we_up"], bp["we_down"],
+            num_experts_per_token=c.num_experts_per_token,
+            capacity_factor=c.capacity_factor, dtype=c.dtype)
+        x = x + out2d.reshape(b, s, h)
+    else:
+        aux = jnp.zeros((), jnp.float32)
+        gate = jax.nn.silu(y @ bp["w_gate"].astype(c.dtype))
+        up = y @ bp["w_up"].astype(c.dtype)
+        ffn = with_logical_constraint(gate * up, ("batch", "seq", "mlp"))
+        x = x + (ffn @ bp["w_down"].astype(c.dtype))
+    return with_logical_constraint(x, ("batch", "seq", "embed")), aux
 
 
 def forward(params: Dict[str, Any], tokens, config: TransformerConfig,
-            positions=None):
-    """tokens: [b, s] int32 → logits [b, s, vocab] (fp32)."""
+            positions=None, return_aux: bool = False):
+    """tokens: [b, s] int32 → logits [b, s, vocab] (fp32).
+
+    With return_aux=True also returns the MoE router load-balance loss
+    (zero for dense models)."""
     c = config
     b, s = tokens.shape
     if positions is None:
@@ -225,13 +286,16 @@ def forward(params: Dict[str, Any], tokens, config: TransformerConfig,
     if c.remat:
         block_fn = jax.checkpoint(block_fn)
 
+    aux_total = jnp.zeros((), jnp.float32)
     if c.scan_layers:
         def scan_body(carry, layer_params):
-            return block_fn(carry, layer_params), None
+            y, aux = block_fn(carry[0], layer_params)
+            return (y, carry[1] + aux), None
 
-        x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+        (x, aux_total), _ = jax.lax.scan(
+            scan_body, (x, aux_total), params["blocks"])
     else:
-        x = block_fn(x, params["blocks"])
+        x, aux_total = block_fn(x, params["blocks"])
 
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     # weight-tied LM head (Llama ties off; tying keeps the flagship simple
@@ -239,21 +303,29 @@ def forward(params: Dict[str, Any], tokens, config: TransformerConfig,
     logits = jnp.einsum(
         "bsh,vh->bsv", x.astype(jnp.float32),
         params["tok_embed"].astype(jnp.float32))
-    return with_logical_constraint(logits, ("batch", "seq", "vocab"))
+    logits = with_logical_constraint(logits, ("batch", "seq", "vocab"))
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def loss_fn(params, batch, config: TransformerConfig):
-    """Next-token cross-entropy. batch: {"tokens": [b, s+1] int32}."""
+    """Next-token cross-entropy (+ router aux loss for MoE models).
+    batch: {"tokens": [b, s+1] int32}."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, config)
+    logits, aux = forward(params, inputs, config, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
     if mask is not None:
         mask = mask[:, 1:]
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
-    return jnp.mean(nll)
+        ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    else:
+        ce = jnp.mean(nll)
+    if config.num_experts > 0:
+        ce = ce + config.router_aux_coef * aux / config.num_layers
+    return ce
 
 
 def num_params(config: TransformerConfig) -> int:

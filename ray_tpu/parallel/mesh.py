@@ -25,7 +25,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-AXIS_ORDER: Tuple[str, ...] = ("data", "fsdp", "seq", "tensor", "expert")
+AXIS_ORDER: Tuple[str, ...] = (
+    "data", "stage", "fsdp", "seq", "tensor", "expert")
 
 # Short aliases accepted in user-facing configs.
 _AXIS_ALIASES = {
@@ -43,6 +44,9 @@ _AXIS_ALIASES = {
     "tensor": "tensor",
     "ep": "expert",
     "expert": "expert",
+    "pp": "stage",
+    "pipeline": "stage",
+    "stage": "stage",
 }
 
 
@@ -66,6 +70,7 @@ class MeshConfig:
     """
 
     data: int = -1
+    stage: int = 1
     fsdp: int = 1
     seq: int = 1
     tensor: int = 1
@@ -75,7 +80,8 @@ class MeshConfig:
     @classmethod
     def from_dict(cls, axes: Dict[str, int],
                   dcn_axes: Sequence[str] = ()) -> "MeshConfig":
-        out = {"data": 1, "fsdp": 1, "seq": 1, "tensor": 1, "expert": 1}
+        out = {a: 1 for a in AXIS_ORDER}
+        out["data"] = 1
         wildcard = None
         for k, v in axes.items():
             ck = canonical_axis(k)

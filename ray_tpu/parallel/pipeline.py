@@ -1,0 +1,118 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a mesh axis.
+
+Greenfield capability (SURVEY.md §2.4 — the reference has no in-tree
+pipeline parallelism; its ADAG/channel substrate is the GPU analogue).
+TPU-native design: the pipeline is ONE jitted program over a "stage" mesh
+axis.  Layers are sharded stage-wise (leading axis of stacked params);
+microbatch activations hop stage→stage via `jax.lax.ppermute` over ICI.
+The schedule is the classic GPipe fill-and-drain loop: with S stages and
+M microbatches, S+M-1 steps, each step running every stage's block on its
+in-flight microbatch (the bubble is the usual (S-1)/(S+M-1) fraction).
+
+  - `pipeline_sharded(stage_fn, params, micro, axis_name)`: collective
+    form, call inside shard_map (params = THIS stage's params).
+  - `pipeline_apply(stage_fn, stacked_params, x, mesh, num_microbatches)`:
+    jit-level wrapper; stacked params [S, ...] shard on "stage".
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_sharded(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                     stage_params: Any,
+                     micro: jax.Array,
+                     axis_name: str = "stage") -> jax.Array:
+    """GPipe schedule inside shard_map.
+
+    stage_params: this stage's params (already stage-local).
+    micro: [M, mb, ...] all microbatches (replicated; only stage 0 reads).
+    Returns [M, mb, ...] outputs (replicated across stages after a psum).
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    m = micro.shape[0]
+    is_first = (idx == 0)
+    is_last = (idx == n - 1)
+
+    # forward shift: stage i sends to stage i+1 (no wraparound)
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    received = jnp.zeros_like(micro[0])
+    outputs = []
+    for t in range(m + n - 1):
+        inp = micro[t] if t < m else jnp.zeros_like(micro[0])
+        state_in = jnp.where(is_first, inp, received)
+        y = stage_fn(stage_params, state_in)
+        out_idx = t - (n - 1)
+        if 0 <= out_idx < m:
+            outputs.append(jnp.where(is_last, y, 0.0))
+        if t != m + n - 2:
+            received = jax.lax.ppermute(y, axis_name, perm)
+    out = jnp.stack(outputs)                       # valid on last stage only
+    # broadcast the last stage's outputs to every stage (one psum over the
+    # stage axis — everything else contributed zeros)
+    return jax.lax.psum(out, axis_name)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stacked_params: Any,
+                   x: jax.Array,
+                   mesh=None,
+                   num_microbatches: int = None,  # noqa: RUF013
+                   axis_name: str = "stage") -> jax.Array:
+    """Run ``x`` [batch, ...] through S pipeline stages.
+
+    stacked_params: pytree with leading axis S (one slice per stage),
+    sharded on the "stage" mesh axis.  num_microbatches defaults to S
+    (minimum); more microbatches shrink the bubble.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is None or mesh.empty:
+            raise ValueError("pipeline_apply requires a mesh")
+    n_stages = mesh.shape[axis_name]
+    if num_microbatches is None:
+        num_microbatches = n_stages
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by num_microbatches={num_microbatches}")
+    micro = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
+
+    param_specs = jax.tree.map(
+        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stacked_params)
+
+    def inner(params, micro_in):
+        # shard_map gives us the stage-local slice with a leading axis of
+        # size 1 — drop it.
+        params = jax.tree.map(lambda p: p[0], params)
+        return pipeline_sharded(stage_fn, params, micro_in, axis_name)
+
+    out = shard_map(
+        inner, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_rep=False,
+    )(stacked_params, micro)
+    return out.reshape(b, *out.shape[2:])
+
+
+def stack_stage_params(layer_params: Any, n_stages: int) -> Any:
+    """Regroup per-layer stacked params [L, ...] into [S, L/S, ...] so each
+    stage holds a contiguous run of layers."""
+    def regroup(p):
+        L = p.shape[0]
+        if L % n_stages:
+            raise ValueError(f"{L} layers not divisible by {n_stages} stages")
+        return p.reshape(n_stages, L // n_stages, *p.shape[1:])
+
+    return jax.tree.map(regroup, layer_params)
