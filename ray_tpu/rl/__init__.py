@@ -1,0 +1,27 @@
+"""ray_tpu.rl: the RLlib-equivalent — env runners, JAX learners, algorithms.
+
+Counterpart of the reference's rllib/ new API stack: AlgorithmConfig →
+Algorithm (a Tune Trainable), EnvRunnerGroup of rollout actors, LearnerGroup
+of JAX learners whose update is one jitted step (SURVEY.md §2.3 L5, §3.5).
+"""
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.env_runner import SingleAgentEnvRunner
+from ray_tpu.rl.env_runner_group import EnvRunnerGroup
+from ray_tpu.rl.episode import SingleAgentEpisode, episodes_to_batch
+from ray_tpu.rl.learner import JaxLearner
+from ray_tpu.rl.learner_group import LearnerGroup
+from ray_tpu.rl.module import RLModuleSpec
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "SingleAgentEnvRunner",
+    "EnvRunnerGroup",
+    "SingleAgentEpisode",
+    "episodes_to_batch",
+    "JaxLearner",
+    "LearnerGroup",
+    "RLModuleSpec",
+]

@@ -1,0 +1,104 @@
+"""Algorithm: the top-level RL trainable.
+
+Counterpart of the reference's rllib/algorithms/algorithm.py (Algorithm is a
+Tune Trainable; :226, step() :906 → training_step() :1682).  Same shape
+here: Algorithm subclasses ray_tpu.tune.Trainable so `Tuner(PPO, ...)` can
+schedule it, but it also runs standalone via `config.build().train()`.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.env_runner_group import EnvRunnerGroup
+from ray_tpu.tune.trainable import Trainable
+
+
+class Algorithm(Trainable):
+    config_class = AlgorithmConfig
+
+    def __init__(self, config: Optional[AlgorithmConfig] = None):
+        # Standalone construction path (config.build()); the Tune path
+        # calls setup(config_dict) instead.
+        self.config = config
+        self.iteration = 0
+        self.env_runner_group: Optional[EnvRunnerGroup] = None
+        self.learner_group = None
+        self._setup_done = False
+        if config is not None:
+            self._setup_from_config(config)
+
+    # -- Tune Trainable API ------------------------------------------------
+    def setup(self, config: Dict[str, Any]) -> None:
+        if self._setup_done:
+            return
+        cfg = self.config_class()
+        for k, v in (config or {}).items():
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+        self._setup_from_config(cfg)
+
+    def _setup_from_config(self, config: AlgorithmConfig) -> None:
+        self.config = config
+        self.env_runner_group = EnvRunnerGroup(
+            config.make_env_fn(),
+            num_env_runners=config.num_env_runners,
+            num_envs_per_runner=config.num_envs_per_env_runner,
+            seed=config.seed,
+            restart_failed=config.restart_failed_env_runners,
+            num_cpus_per_runner=config.num_cpus_per_env_runner)
+        self.learner_group = self._build_learner_group(config)
+        # Runners start from the learner's weights.
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        self._setup_done = True
+
+    def _build_learner_group(self, config: AlgorithmConfig):
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self) -> Dict[str, Any]:
+        t0 = time.time()
+        results = self.training_step()
+        self.iteration += 1
+        results.update(self.env_runner_group.get_metrics())
+        results["training_iteration"] = self.iteration
+        results["time_this_iter_s"] = time.time() - t0
+        return results
+
+    def train(self) -> Dict[str, Any]:
+        """Standalone alias for step() (reference Algorithm.train)."""
+        return self.step()
+
+    # -- checkpointing (reference: Algorithm is Checkpointable) ------------
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        state = {
+            "iteration": self.iteration,
+            "learner": self.learner_group.get_state(),
+            "config": self.config.to_dict(),
+        }
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "wb") as f:
+            pickle.dump(state, f)
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.iteration = state["iteration"]
+        self.learner_group.set_state(state["learner"])
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+
+    def cleanup(self) -> None:
+        self.stop()
+
+    def stop(self) -> None:
+        if self.env_runner_group is not None:
+            self.env_runner_group.stop()
+        if self.learner_group is not None:
+            self.learner_group.stop()

@@ -1,0 +1,3 @@
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, PPOLearner
+
+__all__ = ["PPO", "PPOConfig", "PPOLearner"]

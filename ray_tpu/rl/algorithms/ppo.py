@@ -1,0 +1,175 @@
+"""PPO, TPU-first.
+
+Counterpart of the reference's rllib/algorithms/ppo/ (ppo.py
+`_training_step_new_api_stack`: synchronous_parallel_sample →
+learner_group.update_from_episodes → weight broadcast) and the PPO loss in
+ppo_torch_learner.py.  TPU-first shape discipline: every SGD step runs on a
+fixed [minibatch_size] flattened batch, so the whole run compiles the
+update exactly once; GAE is O(T) host bookkeeping done in numpy between
+sampling and SGD (it is sequential and tiny next to the matmuls).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import module as rl_module
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.episode import SingleAgentEpisode
+from ray_tpu.rl.learner import JaxLearner
+from ray_tpu.rl.learner_group import LearnerGroup
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = PPO
+        # PPO-specific training() knobs (reference ppo.py PPOConfig).
+        self.lambda_: float = 0.95
+        self.clip_param: float = 0.2
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.0
+        self.num_epochs: int = 10
+        self.minibatch_size: int = 128
+        self.normalize_advantages: bool = True
+
+
+class PPOLearner(JaxLearner):
+    def __init__(self, spec, *, clip_param: float = 0.2,
+                 vf_loss_coeff: float = 0.5, entropy_coeff: float = 0.0,
+                 **kwargs):
+        super().__init__(spec, **kwargs)
+        self.clip_param = clip_param
+        self.vf_loss_coeff = vf_loss_coeff
+        self.entropy_coeff = entropy_coeff
+
+    def loss(self, params, batch: Dict[str, jnp.ndarray], rng
+             ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        dist_inputs, values = rl_module.forward(params, batch["obs"])
+        dist = self.spec.dist(dist_inputs)
+        logp = dist.logp(batch["actions"])
+        mask = batch["mask"]
+        denom = jnp.maximum(mask.sum(), 1.0)
+
+        def mmean(x):
+            return (x * mask).sum() / denom
+
+        ratio = jnp.exp(logp - batch["logp"])
+        adv = batch["advantages"]
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - self.clip_param, 1 + self.clip_param) * adv)
+        policy_loss = -mmean(surrogate)
+        vf_loss = mmean((values - batch["value_targets"]) ** 2)
+        entropy = mmean(dist.entropy())
+        total = (policy_loss + self.vf_loss_coeff * vf_loss
+                 - self.entropy_coeff * entropy)
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_kl": mmean(batch["logp"] - logp),
+        }
+
+
+def compute_gae(episodes: List[SingleAgentEpisode], params, spec,
+                gamma: float, lam: float) -> List[Dict[str, np.ndarray]]:
+    """Per-episode GAE(λ) with value bootstrap for truncated/cut episodes.
+
+    Values come from the rollout (`values` extra); the bootstrap value of
+    each episode's final obs is evaluated in one batched forward pass.
+    """
+    finals = np.stack([np.asarray(e.obs[-1]).reshape(-1) for e in episodes])
+    _, boot = rl_module.forward(params, jnp.asarray(finals))
+    boot = np.asarray(boot)
+    out: List[Dict[str, np.ndarray]] = []
+    for i, ep in enumerate(episodes):
+        T = len(ep)
+        values = np.asarray(ep.extra["values"], dtype=np.float32)
+        v_next = np.empty(T, dtype=np.float32)
+        v_next[:-1] = values[1:]
+        v_next[-1] = 0.0 if ep.terminated else float(boot[i])
+        rewards = np.asarray(ep.rewards, dtype=np.float32)
+        deltas = rewards + gamma * v_next - values
+        adv = np.empty(T, dtype=np.float32)
+        acc = 0.0
+        for t in range(T - 1, -1, -1):
+            acc = deltas[t] + gamma * lam * acc
+            adv[t] = acc
+        obs = np.asarray(ep.obs[:-1]).reshape(T, -1)
+        out.append({
+            "obs": obs.astype(np.float32),
+            "actions": np.asarray(ep.actions),
+            "logp": np.asarray(ep.logp, dtype=np.float32),
+            "advantages": adv,
+            "value_targets": adv + values,
+        })
+    return out
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig
+
+    def _build_learner_group(self, config: PPOConfig) -> LearnerGroup:
+        return LearnerGroup(
+            PPOLearner,
+            dict(spec=self.env_runner_group.spec,
+                 clip_param=config.clip_param,
+                 vf_loss_coeff=config.vf_loss_coeff,
+                 entropy_coeff=config.entropy_coeff,
+                 learning_rate=config.lr,
+                 grad_clip=config.grad_clip,
+                 seed=config.seed,
+                 mesh_axes=config.mesh_axes),
+            num_learners=config.num_learners)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: PPOConfig = self.config
+        episodes = self.env_runner_group.sample(
+            num_env_steps=cfg.train_batch_size)
+        weights = self.learner_group.get_weights()
+        rows = compute_gae(episodes, weights, self.env_runner_group.spec,
+                           cfg.gamma, cfg.lambda_)
+        flat = {k: np.concatenate([r[k] for r in rows]) for k in rows[0]}
+        n = flat["obs"].shape[0]
+        # Pad/trim to exactly train_batch_size so every minibatch slice has
+        # one compiled shape for the whole run; padded rows carry mask=0.
+        target = cfg.train_batch_size
+        mask = np.ones(n, dtype=np.float32)
+        if n < target:
+            pad = target - n
+            flat = {k: np.concatenate([v, np.zeros((pad,) + v.shape[1:],
+                                                   dtype=v.dtype)])
+                    for k, v in flat.items()}
+            mask = np.concatenate([mask, np.zeros(pad, dtype=np.float32)])
+        else:
+            flat = {k: v[:target] for k, v in flat.items()}
+            mask = mask[:target]
+        flat["mask"] = mask
+        if cfg.normalize_advantages:
+            valid = mask > 0
+            mean = flat["advantages"][valid].mean()
+            std = flat["advantages"][valid].std() + 1e-8
+            flat["advantages"] = np.where(
+                valid, (flat["advantages"] - mean) / std, 0.0
+            ).astype(np.float32)
+
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        metrics: Dict[str, float] = {}
+        # Clamp so at least one SGD step always happens (a minibatch larger
+        # than the batch would otherwise silently skip every update).
+        mb = min(cfg.minibatch_size, target)
+        for _ in range(cfg.num_epochs):
+            perm = rng.permutation(target)
+            for start in range(0, target - mb + 1, mb):
+                idx = perm[start:start + mb]
+                metrics = self.learner_group.update_from_batch(
+                    {k: v[idx] for k, v in flat.items()})
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        metrics["num_env_steps_trained"] = int(n)
+        return dict(metrics)

@@ -1,0 +1,121 @@
+"""AlgorithmConfig: fluent builder for RL algorithms.
+
+Counterpart of the reference's rllib/algorithms/algorithm_config.py — the
+same chained-sections style (.environment().env_runners().training()
+.learners()) reduced to the knobs this stack actually has.  `.build()`
+returns the Algorithm instance.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional, Type
+
+
+class AlgorithmConfig:
+    algo_class: Optional[Type] = None
+
+    def __init__(self):
+        # environment()
+        self.env: Optional[str] = None
+        self.env_fn: Optional[Callable[[], Any]] = None
+        self.env_config: Dict[str, Any] = {}
+        # env_runners()
+        self.num_env_runners: int = 0
+        self.num_envs_per_env_runner: int = 1
+        self.rollout_fragment_length: int = 200
+        self.num_cpus_per_env_runner: float = 1.0
+        # training()
+        self.lr: float = 3e-4
+        self.gamma: float = 0.99
+        self.train_batch_size: int = 4000
+        self.grad_clip: float = 0.5
+        self.seed: int = 0
+        # learners()
+        self.num_learners: int = 0
+        self.mesh_axes: Optional[Dict[str, int]] = None
+        # fault_tolerance()
+        self.restart_failed_env_runners: bool = True
+
+    # -- sections (each returns self for chaining) -------------------------
+    def environment(self, env: Optional[str] = None, *,
+                    env_fn: Optional[Callable[[], Any]] = None,
+                    env_config: Optional[Dict[str, Any]] = None
+                    ) -> "AlgorithmConfig":
+        if env is not None:
+            self.env = env
+        if env_fn is not None:
+            self.env_fn = env_fn
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None,
+                    num_cpus_per_env_runner: Optional[float] = None
+                    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if num_cpus_per_env_runner is not None:
+            self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise AttributeError(f"unknown training config key: {k}")
+            setattr(self, k, v)
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None,
+                 mesh_axes: Optional[Dict[str, int]] = None
+                 ) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if mesh_axes is not None:
+            self.mesh_axes = mesh_axes
+        return self
+
+    def fault_tolerance(self, *,
+                        restart_failed_env_runners: Optional[bool] = None
+                        ) -> "AlgorithmConfig":
+        if restart_failed_env_runners is not None:
+            self.restart_failed_env_runners = restart_failed_env_runners
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    # -- helpers -----------------------------------------------------------
+    def make_env_fn(self) -> Callable[[], Any]:
+        if self.env_fn is not None:
+            return self.env_fn
+        if self.env is None:
+            raise ValueError("config.environment(env=...) not set")
+        env_id, env_config = self.env, dict(self.env_config)
+
+        def _make():
+            import gymnasium as gym
+            return gym.make(env_id, **env_config)
+
+        return _make
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: v for k, v in vars(self).items()
+                if not callable(v)}
+
+    def build(self):
+        if self.algo_class is None:
+            raise ValueError("base AlgorithmConfig cannot build; use a "
+                             "subclass like PPOConfig")
+        return self.algo_class(self.copy())

@@ -1,0 +1,179 @@
+"""EnvRunner: CPU rollout actor sampling episodes from gymnasium envs.
+
+Counterpart of the reference's rllib/env/single_agent_env_runner.py
+(SingleAgentEnvRunner :60; sample() :136 — gymnasium vector env step loop
+with the module's forward_exploration picking actions).  TPU-first detail:
+the action-selection step is ONE jitted function over the fixed [num_envs]
+batch (sample + logp + value in a single XLA program), so the hot rollout
+loop does no op-by-op dispatch.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import module as rl_module
+from ray_tpu.rl.episode import SingleAgentEpisode
+
+
+class SingleAgentEnvRunner:
+    """Samples episodes with the current policy weights.
+
+    Runs as a plain object (local mode) or inside a ray_tpu actor
+    (EnvRunnerGroup).  Not jit-traced end to end — the gym env is host
+    code — but the per-step policy math is.
+    """
+
+    def __init__(self, env_fn: Callable[[], Any], num_envs: int = 1,
+                 spec: Optional[rl_module.RLModuleSpec] = None,
+                 seed: int = 0, explore: bool = True,
+                 worker_index: int = 0):
+        import gymnasium as gym
+
+        self.num_envs = num_envs
+        # Pin NEXT_STEP autoreset explicitly (gymnasium >=1.0 default): the
+        # step that returns done=True carries the TRUE final obs; the next
+        # step() performs the reset (ignoring its action) and returns the
+        # new episode's first obs. The sample loop below depends on this.
+        self.env = gym.vector.SyncVectorEnv(
+            [env_fn for _ in range(num_envs)],
+            autoreset_mode=gym.vector.AutoresetMode.NEXT_STEP)
+        self.spec = spec or rl_module.spec_for_env(self.env)
+        self.explore = explore
+        self.worker_index = worker_index
+        self.seed = seed
+        self._rng = jax.random.key(seed * 10007 + worker_index)
+        self.params = rl_module.init_params(
+            self.spec, jax.random.key(seed))
+        self._obs: Optional[np.ndarray] = None
+        self._episodes: List[SingleAgentEpisode] = []
+        self._pending_reset = np.zeros(num_envs, dtype=bool)
+        self.metrics: Dict[str, Any] = {
+            "num_env_steps_sampled_lifetime": 0,
+            "episode_returns": [],  # rolling window of completed returns
+        }
+
+        @jax.jit
+        def _act(params, obs, key, explore_flag):
+            dist_inputs, value = rl_module.forward(params, obs)
+            dist = self.spec.dist(dist_inputs)
+            action = jax.lax.cond(
+                explore_flag,
+                lambda: dist.sample(key),
+                lambda: dist.deterministic())
+            return action, dist.logp(action), value
+
+        self._act = _act
+
+    # -- weight sync (reference: EnvRunner.set_state / get_state) ----------
+    def set_weights(self, params) -> None:
+        self.params = jax.device_put(params)
+
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self, *, num_env_steps: Optional[int] = None,
+               num_episodes: Optional[int] = None,
+               force_reset: bool = False) -> List[SingleAgentEpisode]:
+        """Collect experience; returns finalized + in-progress-cut episodes.
+
+        With `num_env_steps` (truncated sampling, PPO-style) ongoing
+        episodes are cut at the boundary and resumed next call; with
+        `num_episodes` only whole episodes are returned.
+        """
+        assert (num_env_steps is None) != (num_episodes is None)
+        if force_reset or self._obs is None:
+            obs, _ = self.env.reset(
+                seed=self.seed * 10007 + self.worker_index)
+            self._obs = obs
+            self._episodes = [
+                SingleAgentEpisode(id=uuid.uuid4().hex)
+                for _ in range(self.num_envs)]
+            for i in range(self.num_envs):
+                self._episodes[i].add_reset(obs[i])
+            self._pending_reset[:] = False
+
+        done_episodes: List[SingleAgentEpisode] = []
+        steps = 0
+        while True:
+            if num_env_steps is not None and steps >= num_env_steps:
+                break
+            if num_episodes is not None and len(done_episodes) >= num_episodes:
+                break
+            self._rng, key = jax.random.split(self._rng)
+            action, logp, value = self._act(
+                self.params, jnp.asarray(self._obs), key, self.explore)
+            action_np = np.asarray(action)
+            env_action = action_np
+            if not self.spec.discrete:
+                env_action = np.clip(
+                    action_np,
+                    self.env.single_action_space.low,
+                    self.env.single_action_space.high)
+            next_obs, rewards, terms, truncs, infos = self.env.step(env_action)
+            logp_np, value_np = np.asarray(logp), np.asarray(value)
+            for i in range(self.num_envs):
+                if self._pending_reset[i]:
+                    # NEXT_STEP autoreset: this step WAS the reset for env i
+                    # (action ignored, reward 0) — record nothing; next_obs[i]
+                    # is the new episode's first obs.
+                    self._episodes[i] = SingleAgentEpisode(id=uuid.uuid4().hex)
+                    self._episodes[i].add_reset(next_obs[i])
+                    self._pending_reset[i] = False
+                    continue
+                ep = self._episodes[i]
+                done = bool(terms[i] or truncs[i])
+                # NEXT_STEP autoreset: on done, next_obs[i] IS the true
+                # final obs (the env resets on the following step call).
+                ep.add_step(
+                    next_obs[i], action_np[i], float(rewards[i]),
+                    terminated=bool(terms[i]), truncated=bool(truncs[i]),
+                    logp=float(logp_np[i]),
+                    extra={"values": float(value_np[i])})
+                steps += 1
+                if done:
+                    self.metrics["episode_returns"].append(ep.total_reward)
+                    done_episodes.append(ep.finalize())
+                    self._pending_reset[i] = True
+                    # Placeholder until the reset step arrives — keeps the
+                    # tail-fragment loop below from re-shipping this episode.
+                    self._episodes[i] = SingleAgentEpisode(id=uuid.uuid4().hex)
+            self._obs = next_obs
+
+        out = list(done_episodes)
+        if num_env_steps is not None:
+            # Ship in-progress chunks too (PPO uses truncated fragments);
+            # keep the tail obs so the learner can bootstrap the value.
+            for i, ep in enumerate(self._episodes):
+                if len(ep) > 0:
+                    out.append(ep.finalize())
+                    cont = SingleAgentEpisode(id=ep.id)
+                    cont.add_reset(self._obs[i])
+                    self._episodes[i] = cont
+        self.metrics["num_env_steps_sampled_lifetime"] += sum(
+            len(e) for e in out)
+        self.metrics["episode_returns"] = \
+            self.metrics["episode_returns"][-100:]
+        return out
+
+    def get_metrics(self) -> Dict[str, Any]:
+        rets = self.metrics["episode_returns"]
+        return {
+            "num_env_steps_sampled_lifetime":
+                self.metrics["num_env_steps_sampled_lifetime"],
+            "episode_return_mean":
+                float(np.mean(rets)) if rets else float("nan"),
+            "num_episodes": len(rets),
+        }
+
+    def ping(self) -> str:
+        return "ok"
+
+    def stop(self) -> None:
+        self.env.close()

@@ -1,0 +1,140 @@
+"""EnvRunnerGroup: fault-tolerant fan-out over env-runner actors.
+
+Counterpart of the reference's rllib/env/env_runner_group.py (:72) plus the
+relevant slice of rllib/utils/actor_manager.py (FaultTolerantActorManager
+:196): broadcast weights, gather samples, mark-and-restore failed runners.
+A local runner (worker_index 0) always exists so `num_env_runners=0` works
+in-process, mirroring the reference's local-worker mode.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl.env_runner import SingleAgentEnvRunner
+
+
+class EnvRunnerGroup:
+    def __init__(self, env_fn: Callable[[], Any], *,
+                 num_env_runners: int = 0,
+                 num_envs_per_runner: int = 1,
+                 spec=None, seed: int = 0,
+                 restart_failed: bool = True,
+                 num_cpus_per_runner: float = 1.0):
+        self.env_fn = env_fn
+        self.num_envs_per_runner = num_envs_per_runner
+        self.seed = seed
+        self.spec = spec
+        self.restart_failed = restart_failed
+        self.num_cpus_per_runner = num_cpus_per_runner
+        # Local runner: source of truth for the module spec and a fallback
+        # sampler when there are no remote runners.
+        self.local_runner = SingleAgentEnvRunner(
+            env_fn, num_envs=num_envs_per_runner, spec=spec, seed=seed,
+            worker_index=0)
+        self.spec = self.local_runner.spec
+        self._actor_cls = ray_tpu.remote(SingleAgentEnvRunner)
+        self.remote_runners: List[Any] = []
+        for i in range(num_env_runners):
+            self.remote_runners.append(self._make_runner(i + 1))
+
+    def _make_runner(self, worker_index: int):
+        return self._actor_cls.options(
+            num_cpus=self.num_cpus_per_runner,
+            name=f"env_runner_{worker_index}_{id(self)}",
+        ).remote(self.env_fn, self.num_envs_per_runner, self.spec,
+                 self.seed, True, worker_index)
+
+    @property
+    def num_healthy(self) -> int:
+        return max(1, len(self.remote_runners))
+
+    # -- weight broadcast (reference: sync_weights via object store) -------
+    def sync_weights(self, params) -> None:
+        self.local_runner.set_weights(params)
+        if self.remote_runners:
+            # One put, N reads — broadcast through the object store rather
+            # than serializing params once per runner.
+            ref = ray_tpu.put(params)
+            refs = [r.set_weights.remote(ref) for r in self.remote_runners]
+            self._gather(refs, restart_indices=True)
+
+    # -- sampling ----------------------------------------------------------
+    def sample(self, *, num_env_steps: Optional[int] = None,
+               num_episodes: Optional[int] = None) -> List[Any]:
+        """Synchronous parallel sample across all runners
+        (reference: rllib/execution/rollout_ops.py:20
+        synchronous_parallel_sample)."""
+        if not self.remote_runners:
+            return self.local_runner.sample(
+                num_env_steps=num_env_steps, num_episodes=num_episodes)
+        n = len(self.remote_runners)
+        per_steps = (num_env_steps + n - 1) // n if num_env_steps else None
+        per_eps = (num_episodes + n - 1) // n if num_episodes else None
+        refs = [r.sample.remote(num_env_steps=per_steps,
+                                num_episodes=per_eps)
+                for r in self.remote_runners]
+        results = self._gather(refs, restart_indices=True)
+        episodes: List[Any] = []
+        for res in results:
+            if res is not None:
+                episodes.extend(res)
+        if not episodes:  # all runners died this round: fall back local
+            episodes = self.local_runner.sample(
+                num_env_steps=num_env_steps, num_episodes=num_episodes)
+        return episodes
+
+    def get_metrics(self) -> Dict[str, Any]:
+        if not self.remote_runners:
+            return self.local_runner.get_metrics()
+        results = [m for m in self._gather(
+            [r.get_metrics.remote() for r in self.remote_runners],
+            restart_indices=False) if m]
+        if not results:
+            return self.local_runner.get_metrics()
+        returns = [m["episode_return_mean"] for m in results
+                   if np.isfinite(m.get("episode_return_mean", float("nan")))]
+        return {
+            "num_env_steps_sampled_lifetime": sum(
+                m["num_env_steps_sampled_lifetime"] for m in results),
+            "episode_return_mean":
+                float(np.mean(returns)) if returns else float("nan"),
+            "num_episodes": sum(m["num_episodes"] for m in results),
+        }
+
+    # -- fault tolerance ---------------------------------------------------
+    def _gather(self, refs: List[Any], restart_indices: bool) -> List[Any]:
+        """ray.get each ref; on actor death, optionally restart that runner
+        and return None for its slot (FaultTolerantActorManager parity)."""
+        out: List[Any] = []
+        for i, ref in enumerate(refs):
+            try:
+                out.append(ray_tpu.get(ref, timeout=120))
+            except Exception:
+                out.append(None)
+                if restart_indices and self.restart_failed and \
+                        i < len(self.remote_runners):
+                    try:
+                        ray_tpu.kill(self.remote_runners[i])
+                    except Exception:
+                        pass
+                    self.remote_runners[i] = self._make_runner(i + 1)
+                    # Freshly restarted runner needs current weights.
+                    try:
+                        ray_tpu.get(self.remote_runners[i].set_weights.remote(
+                            self.local_runner.get_weights()), timeout=60)
+                    except Exception:
+                        pass
+        return out
+
+    def stop(self) -> None:
+        self.local_runner.stop()
+        for r in self.remote_runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.remote_runners = []

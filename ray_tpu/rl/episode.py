@@ -1,0 +1,108 @@
+"""Single-agent episodes: the sample container moved between env runners
+and learners.
+
+Counterpart of the reference's rllib/env/single_agent_episode.py (episodes as
+growing numpy buffers, finalized before shipping) — but TPU-first on the
+consumer side: `episodes_to_batch` pads/stacks a list of episodes into ONE
+fixed-shape batch dict (obs/actions/rewards/dones/logp/values + loss mask) so
+the learner's jitted update never sees ragged shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SingleAgentEpisode:
+    """One (possibly truncated) episode of experience.
+
+    Lengths: obs has T+1 entries (includes final obs); actions/rewards/
+    logp/values have T.
+    """
+
+    obs: List[np.ndarray] = dataclasses.field(default_factory=list)
+    actions: List[Any] = dataclasses.field(default_factory=list)
+    rewards: List[float] = dataclasses.field(default_factory=list)
+    logp: List[float] = dataclasses.field(default_factory=list)
+    # Extra per-step model outputs (e.g. value estimates).
+    extra: Dict[str, List[Any]] = dataclasses.field(default_factory=dict)
+    terminated: bool = False
+    truncated: bool = False
+    id: str = ""
+
+    def __len__(self) -> int:
+        return len(self.actions)
+
+    @property
+    def is_done(self) -> bool:
+        return self.terminated or self.truncated
+
+    def add_reset(self, obs: np.ndarray) -> None:
+        self.obs.append(np.asarray(obs))
+
+    def add_step(self, obs: np.ndarray, action, reward: float, *,
+                 terminated: bool = False, truncated: bool = False,
+                 logp: float = 0.0,
+                 extra: Optional[Dict[str, Any]] = None) -> None:
+        self.obs.append(np.asarray(obs))
+        self.actions.append(action)
+        self.rewards.append(float(reward))
+        self.logp.append(float(logp))
+        for k, v in (extra or {}).items():
+            self.extra.setdefault(k, []).append(v)
+        self.terminated = terminated
+        self.truncated = truncated
+
+    @property
+    def total_reward(self) -> float:
+        return float(sum(self.rewards))
+
+    def finalize(self) -> "SingleAgentEpisode":
+        """Convert list buffers to stacked numpy arrays (ship-ready)."""
+        self.obs = np.stack(self.obs) if isinstance(self.obs, list) else self.obs
+        self.actions = np.asarray(self.actions)
+        self.rewards = np.asarray(self.rewards, dtype=np.float32)
+        self.logp = np.asarray(self.logp, dtype=np.float32)
+        self.extra = {k: np.asarray(v) for k, v in self.extra.items()}
+        return self
+
+
+def episodes_to_batch(episodes: List[SingleAgentEpisode],
+                      max_len: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """Pad + stack episodes into one fixed-shape batch.
+
+    Returns dict with keys: obs [B, T+1, ...], actions [B, T, ...],
+    rewards/logp/mask [B, T], terminated/truncated [B], t [B] (true lengths),
+    plus any finalized `extra` arrays padded on the T axis.
+
+    Fixed `max_len` (e.g. the env's max episode length) keeps the learner's
+    jitted step at one compiled shape across iterations.
+    """
+    assert episodes, "episodes_to_batch needs at least one episode"
+    eps = [e.finalize() for e in episodes]
+    T = max_len or max(len(e) for e in eps)
+    B = len(eps)
+
+    def pad_t(x: np.ndarray, target: int) -> np.ndarray:
+        x = x[:target]  # clip over-long episodes rather than ValueError
+        pad = [(0, target - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(x, pad)
+
+    batch = {
+        "obs": np.stack([pad_t(e.obs, T + 1) for e in eps]),
+        "actions": np.stack([pad_t(e.actions, T) for e in eps]),
+        "rewards": np.stack([pad_t(e.rewards, T) for e in eps]),
+        "logp": np.stack([pad_t(e.logp, T) for e in eps]),
+        "mask": np.stack([
+            pad_t(np.ones(len(e), dtype=np.float32), T) for e in eps]),
+        "terminated": np.asarray([e.terminated for e in eps]),
+        "truncated": np.asarray([e.truncated for e in eps]),
+        "t": np.asarray([min(len(e), T) for e in eps], dtype=np.int32),
+    }
+    for k in eps[0].extra:
+        batch[k] = np.stack([pad_t(e.extra[k], T) for e in eps])
+    return batch

@@ -1,0 +1,143 @@
+"""JaxLearner: the gradient-update half of the RL stack.
+
+Counterpart of the reference's rllib/core/learner/learner.py (:114;
+update_from_batch/episodes :922/:974, gradient API :446–568) and
+torch_learner.py (:61).  Where the reference wraps the module in DDP and
+relies on NCCL hooks for the gradient all-reduce (:396), a JaxLearner's
+whole update is ONE jitted function over a `jax.sharding.Mesh`: batch
+sharded on the `data` axis, params replicated (or FSDP-sharded), and GSPMD
+inserts the gradient psum — no process groups, no hooks.
+
+Subclasses implement `loss(params, batch, rng)` returning (scalar_loss,
+metrics_dict); the base class owns optimizer state, the jitted update, and
+(de)serializable state for checkpointing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class JaxLearner:
+    def __init__(self, spec, *, optimizer: Optional[Any] = None,
+                 learning_rate: float = 3e-4, grad_clip: float = 0.5,
+                 seed: int = 0, mesh_axes: Optional[Dict[str, int]] = None,
+                 data_axis: str = "data"):
+        from ray_tpu.rl import module as rl_module
+
+        self.spec = spec
+        self.data_axis = data_axis
+        # Meshes hold device handles and cannot cross process boundaries;
+        # each learner builds its own from the axis-size spec (the remote
+        # learner's local devices are the right ones anyway).
+        self.mesh = None
+        if mesh_axes:
+            from ray_tpu.parallel.mesh import build_mesh
+            self.mesh = build_mesh(axes=mesh_axes)
+        self.rng = jax.random.key(seed)
+        self.params = rl_module.init_params(spec, jax.random.key(seed))
+        self.tx = optimizer or optax.chain(
+            optax.clip_by_global_norm(grad_clip),
+            optax.adam(learning_rate))
+        self.opt_state = self.tx.init(self.params)
+        self._jit_update = None
+        self._jit_grad = None
+        self._jit_apply = None
+        self.metrics: Dict[str, Any] = {}
+
+    # -- abstract ----------------------------------------------------------
+    def loss(self, params, batch: Dict[str, jnp.ndarray], rng
+             ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+        raise NotImplementedError
+
+    # -- update ------------------------------------------------------------
+    def _build_update(self):
+        def one_step(params, opt_state, batch, rng):
+            (loss_val, aux), grads = jax.value_and_grad(
+                self.loss, has_aux=True)(params, batch, rng)
+            updates, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            aux = dict(aux)
+            aux["total_loss"] = loss_val
+            aux["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, aux
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            mesh = self.mesh
+            replicated = NamedSharding(mesh, P())
+            batch_sharded = NamedSharding(mesh, P(self.data_axis))
+            one_step = jax.jit(
+                one_step,
+                in_shardings=(replicated, replicated, batch_sharded,
+                              replicated),
+                out_shardings=(replicated, replicated, replicated))
+        else:
+            one_step = jax.jit(one_step)
+        return one_step
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]
+                          ) -> Dict[str, float]:
+        """One gradient step on one fixed-shape batch."""
+        if self._jit_update is None:
+            self._jit_update = self._build_update()
+        self.rng, sub = jax.random.split(self.rng)
+        batch_j = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, aux = self._jit_update(
+            self.params, self.opt_state, batch_j, sub)
+        self.metrics = {k: float(v) for k, v in aux.items()}
+        return self.metrics
+
+    # -- split gradient API (reference learner.py:446–568) -----------------
+    # Used by LearnerGroup's host-level data parallelism: each learner
+    # computes grads on its batch shard, the group averages and applies.
+    def compute_gradients(self, batch: Dict[str, np.ndarray]
+                          ) -> Tuple[Any, Dict[str, float]]:
+        if self._jit_grad is None:
+            def grad_fn(params, batch, rng):
+                (loss_val, aux), grads = jax.value_and_grad(
+                    self.loss, has_aux=True)(params, batch, rng)
+                aux = dict(aux)
+                aux["total_loss"] = loss_val
+                return grads, aux
+            self._jit_grad = jax.jit(grad_fn)
+        self.rng, sub = jax.random.split(self.rng)
+        batch_j = {k: jnp.asarray(v) for k, v in batch.items()}
+        grads, aux = self._jit_grad(self.params, batch_j, sub)
+        return jax.device_get(grads), {k: float(v) for k, v in aux.items()}
+
+    def apply_gradients(self, grads) -> None:
+        if self._jit_apply is None:
+            def apply_fn(params, opt_state, grads):
+                updates, opt_state = self.tx.update(grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state
+            self._jit_apply = jax.jit(apply_fn)
+        self.params, self.opt_state = self._jit_apply(
+            self.params, self.opt_state, jax.device_put(grads))
+
+    # -- weights / checkpoint state ---------------------------------------
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, params) -> None:
+        self.params = jax.device_put(params)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+            "rng": jax.device_get(jax.random.key_data(self.rng)),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+        self.rng = jax.random.wrap_key_data(jnp.asarray(state["rng"]))
+
+    def ping(self) -> str:
+        return "ok"

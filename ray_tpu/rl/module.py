@@ -1,0 +1,154 @@
+"""RLModule: the policy/value network abstraction, pure-JAX.
+
+Counterpart of the reference's rllib/core/rl_module/rl_module.py — but
+instead of a torch nn.Module with forward_exploration/forward_train methods,
+an RLModule here is a frozen config + pure functions over a params pytree
+(matching models/transformer.py idiom), so the learner can jit the whole
+update and env runners can run the same functions on CPU.
+
+Action distributions: Categorical (Discrete spaces) and DiagGaussian (Box),
+implemented with jax ops only so sampling/logp/entropy live inside jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Action distributions
+# ---------------------------------------------------------------------------
+
+class Categorical:
+    """Distribution over Discrete(n); inputs = logits [..., n]."""
+
+    def __init__(self, logits: jnp.ndarray):
+        self.logits = logits
+
+    def sample(self, key) -> jnp.ndarray:
+        return jax.random.categorical(key, self.logits, axis=-1)
+
+    def logp(self, actions: jnp.ndarray) -> jnp.ndarray:
+        logp_all = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(
+            logp_all, actions[..., None].astype(jnp.int32), axis=-1
+        ).squeeze(-1)
+
+    def entropy(self) -> jnp.ndarray:
+        logp_all = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1)
+
+    def deterministic(self) -> jnp.ndarray:
+        return jnp.argmax(self.logits, axis=-1)
+
+
+class DiagGaussian:
+    """Distribution over Box; inputs = concat([mean, log_std], -1)."""
+
+    def __init__(self, inputs: jnp.ndarray):
+        self.mean, self.log_std = jnp.split(inputs, 2, axis=-1)
+
+    def sample(self, key) -> jnp.ndarray:
+        noise = jax.random.normal(key, self.mean.shape)
+        return self.mean + jnp.exp(self.log_std) * noise
+
+    def logp(self, actions: jnp.ndarray) -> jnp.ndarray:
+        var = jnp.exp(2 * self.log_std)
+        ll = -0.5 * (
+            (actions - self.mean) ** 2 / var
+            + 2 * self.log_std + jnp.log(2 * jnp.pi))
+        return jnp.sum(ll, axis=-1)
+
+    def entropy(self) -> jnp.ndarray:
+        return jnp.sum(self.log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e),
+                       axis=-1)
+
+    def deterministic(self) -> jnp.ndarray:
+        return self.mean
+
+
+# ---------------------------------------------------------------------------
+# MLP policy+value module
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RLModuleSpec:
+    """Config for the default MLP actor-critic module.
+
+    obs_dim/action_dim come from the env's spaces; `discrete` picks the
+    distribution class. Mirrors the role of the reference's
+    RLModuleSpec/catalog (rllib/core/rl_module/rl_module.py) without the
+    framework indirection.
+    """
+
+    obs_dim: int
+    action_dim: int
+    discrete: bool = True
+    hidden_sizes: Sequence[int] = (64, 64)
+
+    @property
+    def dist_inputs_dim(self) -> int:
+        return self.action_dim if self.discrete else 2 * self.action_dim
+
+    def dist(self, inputs: jnp.ndarray):
+        return Categorical(inputs) if self.discrete else DiagGaussian(inputs)
+
+
+def _init_mlp(key, sizes: Sequence[int], scale_last: float) -> Dict[str, Any]:
+    layers = []
+    for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        scale = scale_last if i == len(sizes) - 2 else jnp.sqrt(2.0 / din)
+        layers.append({
+            "w": jax.random.normal(sub, (din, dout)) * scale,
+            "b": jnp.zeros((dout,)),
+        })
+    return {"layers": layers}
+
+
+def _mlp(params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+    n = len(params["layers"])
+    for i, layer in enumerate(params["layers"]):
+        x = x @ layer["w"] + layer["b"]
+        if i < n - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+def init_params(spec: RLModuleSpec, key) -> Dict[str, Any]:
+    k_pi, k_v = jax.random.split(key)
+    pi_sizes = [spec.obs_dim, *spec.hidden_sizes, spec.dist_inputs_dim]
+    v_sizes = [spec.obs_dim, *spec.hidden_sizes, 1]
+    return {
+        "pi": _init_mlp(k_pi, pi_sizes, scale_last=0.01),
+        "vf": _init_mlp(k_v, v_sizes, scale_last=1.0),
+    }
+
+
+def forward(params: Dict[str, Any], obs: jnp.ndarray
+            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (dist_inputs, value). Pure; safe inside jit."""
+    obs = obs.astype(jnp.float32)
+    return _mlp(params["pi"], obs), _mlp(params["vf"], obs).squeeze(-1)
+
+
+def spec_for_env(env) -> RLModuleSpec:
+    """Build a spec from a gymnasium env's spaces."""
+    import gymnasium as gym
+
+    obs_space, act_space = env.observation_space, env.action_space
+    # Vector envs expose batched spaces; use the single-env ones.
+    obs_space = getattr(env, "single_observation_space", obs_space)
+    act_space = getattr(env, "single_action_space", act_space)
+    obs_dim = int(np.prod(obs_space.shape))
+    if isinstance(act_space, gym.spaces.Discrete):
+        return RLModuleSpec(obs_dim=obs_dim, action_dim=int(act_space.n),
+                            discrete=True)
+    return RLModuleSpec(obs_dim=obs_dim,
+                        action_dim=int(np.prod(act_space.shape)),
+                        discrete=False)

@@ -1,0 +1,88 @@
+"""End-to-end drive of the RL layer through the real runtime.
+
+Covers: PPO local mode learning on CartPole, remote env runners + remote
+learners (full multi-process path), runner kill + restart, checkpoint
+save/restore.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("RAY_TPU_CHIPS", "none")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import time  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+import ray_tpu  # noqa: E402
+from ray_tpu.rl.algorithms import PPOConfig  # noqa: E402
+
+
+def main():
+    t0 = time.time()
+
+    # [1] Local-mode PPO learns CartPole.
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_env_runner=8)
+              .training(train_batch_size=2048, lr=3e-4, minibatch_size=256,
+                        num_epochs=6, entropy_coeff=0.01)
+              .debugging(seed=0))
+    algo = config.build()
+    first = algo.step()["episode_return_mean"]
+    last = first
+    for _ in range(11):
+        last = algo.step()["episode_return_mean"]
+    assert last > first + 20, (first, last)
+    print(f"[1] local PPO learns: {first:.1f} -> {last:.1f} "
+          f"({time.time()-t0:.1f}s)")
+
+    # [2] checkpoint roundtrip.
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        algo.save_checkpoint(d)
+        algo2 = (PPOConfig().environment("CartPole-v1")
+                 .training(train_batch_size=256, minibatch_size=64,
+                           num_epochs=1)).build()
+        algo2.load_checkpoint(d)
+        w1 = algo.learner_group.get_weights()
+        w2 = algo2.learner_group.get_weights()
+        np.testing.assert_allclose(
+            np.asarray(w1["pi"]["layers"][0]["w"]),
+            np.asarray(w2["pi"]["layers"][0]["w"]))
+        algo2.stop()
+    algo.stop()
+    print(f"[2] checkpoint roundtrip ok ({time.time()-t0:.1f}s)")
+
+    # [3] Full multi-process path: remote runners + remote learners.
+    ray_tpu.init(num_cpus=6)
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=2)
+              .learners(num_learners=2)
+              .training(train_batch_size=512, minibatch_size=128,
+                        num_epochs=2))
+    algo = config.build()
+    r = algo.step()
+    assert r["num_env_steps_trained"] >= 256, r
+    print(f"[3] remote runners+learners step ok ({time.time()-t0:.1f}s)")
+
+    # [4] kill an env runner mid-run; group restarts it.
+    ray_tpu.kill(algo.env_runner_group.remote_runners[1])
+    r = algo.step()
+    assert r["num_env_steps_trained"] >= 256, r
+    print(f"[4] runner kill + restart ok ({time.time()-t0:.1f}s)")
+
+    algo.stop()
+    ray_tpu.shutdown()
+    print("RL DRIVE OK")
+
+
+if __name__ == "__main__":
+    main()
