@@ -615,6 +615,14 @@ class ControlServer:
         subs = list(entry.subscribers)
         if entry.state == A_DEAD:
             entry.subscribers = []
+            # Release the actor's name so it can be reused (the reference
+            # unregisters names on death, gcs_actor_manager.cc).  Guard on
+            # ownership: an actor that died *because* the name was taken
+            # must not free the live owner's registration.
+            if entry.spec.name:
+                key = (entry.spec.namespace, entry.spec.name)
+                if self.named_actors.get(key) == actor_hex:
+                    del self.named_actors[key]
         for c in subs:
             try:
                 c.push(msg)
@@ -1024,19 +1032,27 @@ class ControlServer:
         if node is not None and node.alive:
             node.available = node.available.add(acquired)
 
-    def _utilization(self, node: NodeState) -> float:
+    def _utilization(self, node: NodeState,
+                     avail: Optional[ResourceSet] = None) -> float:
         tot = node.total.to_dict()
-        avail = node.available.to_dict()
-        utils = [1.0 - avail.get(k, 0.0) / v for k, v in tot.items() if v > 0]
+        av = (node.available if avail is None else avail).to_dict()
+        utils = [1.0 - av.get(k, 0.0) / v for k, v in tot.items() if v > 0]
         return max(utils, default=0.0)
 
-    def _pick_node(self, need: ResourceSet, spec) -> Optional[tuple]:
+    def _pick_node(self, need: ResourceSet, spec,
+                   avail_of=None) -> Optional[tuple]:
         """Lock held. Choose a node (or PG bundle) for this task/actor.
 
         Returns (node_id, charge_tuple) or None if nothing is feasible now.
+        `avail_of(charge) -> ResourceSet` overrides the availability view —
+        the task loop passes its *virtual* view (actual minus claims of
+        still-pending tasks) so a saturated head spills work to other nodes
+        instead of queueing everything on the packed node.
         Policy parity: hybrid pack-then-spread default
         (scheduling/policy/hybrid_scheduling_policy.h:50), SPREAD
         round-robin, node-affinity, PG bundles (bundle_pack/spread)."""
+        if avail_of is None:
+            avail_of = self._charge_avail
         # Placement-group bundle placement
         pg_hex = getattr(spec, "placement_group_hex", "")
         if pg_hex:
@@ -1051,41 +1067,53 @@ class ControlServer:
                 b = pg.bundles[i]
                 node = self.nodes.get(b.node_id)
                 if (node is not None and node.alive
-                        and need.is_subset_of(b.available)):
+                        and need.is_subset_of(avail_of(("pg", pg_hex, i)))):
                     return b.node_id, ("pg", pg_hex, i)
             return None
+
+        def node_avail(n):
+            return avail_of(("node", n.node_id))
 
         st = getattr(spec, "scheduling_strategy", None)
         alive = [n for n in self.nodes.values() if n.alive]
         if st is not None and type(st).__name__ == "NodeAffinitySchedulingStrategy":
             node = self.nodes.get(st.node_id)
             if (node is not None and node.alive
-                    and need.is_subset_of(node.available)):
+                    and need.is_subset_of(node_avail(node))):
                 return node.node_id, ("node", node.node_id)
             if not st.soft:
                 return None
             # soft: fall through to default policy
-        feasible = [n for n in alive if need.is_subset_of(n.available)]
+        feasible = [n for n in alive if need.is_subset_of(node_avail(n))]
         if not feasible:
             return None
+
+        def util(n):
+            return self._utilization(n, node_avail(n))
+
         if st == "SPREAD":
-            # least-utilized first; round-robin among the tied minimum so
-            # zero-resource tasks still rotate across nodes
+            # least-utilized first; rotate among the tied minimum so
+            # zero-resource tasks still fan out across nodes.  The tie-break
+            # hashes the task id (not a global counter) so a task's target is
+            # stable across scheduling passes while it waits for a worker.
+            feasible.sort(key=lambda n: (util(n), n.node_id))
+            lowest = util(feasible[0])
+            ties = [n for n in feasible if util(n) == lowest]
+            tid = getattr(spec, "task_id", None) or getattr(
+                spec, "actor_id", None)
+            idx = (int(tid.hex()[:8], 16) if tid is not None
+                   else self._rr_counter)
             self._rr_counter += 1
-            feasible.sort(key=lambda n: (self._utilization(n), n.node_id))
-            lowest = self._utilization(feasible[0])
-            ties = [n for n in feasible if self._utilization(n) == lowest]
-            node = ties[self._rr_counter % len(ties)]
+            node = ties[idx % len(ties)]
             return node.node_id, ("node", node.node_id)
         # hybrid default: pack onto the busiest node below the spread
         # threshold; above it, spread to the least utilized.
         threshold = 0.5
-        below = [n for n in feasible if self._utilization(n) < threshold]
+        below = [n for n in feasible if util(n) < threshold]
         if below:
-            node = max(below, key=lambda n: (self._utilization(n), n.is_head))
+            node = max(below, key=lambda n: (util(n), n.is_head))
         else:
-            node = min(feasible, key=lambda n: (self._utilization(n),
-                                                not n.is_head))
+            node = min(feasible, key=lambda n: (util(n), not n.is_head))
         return node.node_id, ("node", node.node_id)
 
     def _unschedulable_reason(self, spec) -> Optional[str]:
@@ -1111,6 +1139,16 @@ class ControlServer:
             if node is None or not node.alive:
                 return f"node {st.node_id} is dead or does not exist"
         return None
+
+    def _charge_avail(self, charge: tuple) -> ResourceSet:
+        """Lock held. Resolve a charge tuple to its current availability."""
+        if charge[0] == "pg":
+            pg = self.placement_groups.get(charge[1])
+            return (pg.bundles[charge[2]].available
+                    if pg is not None and charge[2] < len(pg.bundles)
+                    else ResourceSet())
+        node = self.nodes.get(charge[1])
+        return node.available if node is not None else ResourceSet()
 
     def _charge_target_subtract(self, charge: tuple, need: ResourceSet):
         """Lock held."""
@@ -1180,17 +1218,7 @@ class ControlServer:
 
             def virt_get(charge):
                 if charge not in avail_virtual:
-                    if charge[0] == "pg":
-                        pg = self.placement_groups.get(charge[1])
-                        avail_virtual[charge] = (
-                            pg.bundles[charge[2]].available
-                            if pg is not None and charge[2] < len(pg.bundles)
-                            else ResourceSet())
-                    else:
-                        node = self.nodes.get(charge[1])
-                        avail_virtual[charge] = (
-                            node.available if node is not None
-                            else ResourceSet())
+                    avail_virtual[charge] = self._charge_avail(charge)
                 return avail_virtual[charge]
             for spec in self.pending_tasks:
                 if not self._deps_ready(spec):
@@ -1205,7 +1233,7 @@ class ControlServer:
                         spec, why, kind="unschedulable")
                     continue
                 need = ResourceSet(spec.resources)
-                pick = self._pick_node(need, spec)
+                pick = self._pick_node(need, spec, avail_of=virt_get)
                 if pick is None:
                     still_pending.append(spec)
                     continue

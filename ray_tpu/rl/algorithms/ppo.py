@@ -77,7 +77,7 @@ class PPOLearner(JaxLearner):
         }
 
 
-def compute_gae(episodes: List[SingleAgentEpisode], params, spec,
+def compute_gae(episodes: List[SingleAgentEpisode], params,
                 gamma: float, lam: float) -> List[Dict[str, np.ndarray]]:
     """Per-episode GAE(λ) with value bootstrap for truncated/cut episodes.
 
@@ -133,8 +133,7 @@ class PPO(Algorithm):
         episodes = self.env_runner_group.sample(
             num_env_steps=cfg.train_batch_size)
         weights = self.learner_group.get_weights()
-        rows = compute_gae(episodes, weights, self.env_runner_group.spec,
-                           cfg.gamma, cfg.lambda_)
+        rows = compute_gae(episodes, weights, cfg.gamma, cfg.lambda_)
         flat = {k: np.concatenate([r[k] for r in rows]) for k in rows[0]}
         n = flat["obs"].shape[0]
         # Pad/trim to exactly train_batch_size so every minibatch slice has

@@ -55,6 +55,7 @@ class SingleAgentEnvRunner:
         self._pending_reset = np.zeros(num_envs, dtype=bool)
         self.metrics: Dict[str, Any] = {
             "num_env_steps_sampled_lifetime": 0,
+            "num_episodes_lifetime": 0,
             "episode_returns": [],  # rolling window of completed returns
         }
 
@@ -138,6 +139,7 @@ class SingleAgentEnvRunner:
                     extra={"values": float(value_np[i])})
                 steps += 1
                 if done:
+                    self.metrics["num_episodes_lifetime"] += 1
                     self.metrics["episode_returns"].append(ep.total_reward)
                     done_episodes.append(ep.finalize())
                     self._pending_reset[i] = True
@@ -169,7 +171,7 @@ class SingleAgentEnvRunner:
                 self.metrics["num_env_steps_sampled_lifetime"],
             "episode_return_mean":
                 float(np.mean(rets)) if rets else float("nan"),
-            "num_episodes": len(rets),
+            "num_episodes": self.metrics["num_episodes_lifetime"],
         }
 
     def ping(self) -> str:

@@ -77,3 +77,24 @@ def test_no_retry_exhausted(ray_start_regular):
 
     with pytest.raises(ray_tpu.WorkerCrashedError):
         ray_tpu.get(always_dies.remote(), timeout=30)
+
+
+def test_named_actor_name_reusable_after_kill(ray_start_regular):
+    """Killing a named actor releases its name (reference frees names on
+    death in GcsActorManager); a replacement with the same name must come
+    up ALIVE, not die with 'name already taken'."""
+
+    @ray_tpu.remote
+    class Named:
+        def who(self):
+            import os
+            return os.getpid()
+
+    a = Named.options(name="reusable").remote()
+    pid1 = ray_tpu.get(a.who.remote(), timeout=30)
+    ray_tpu.kill(a)
+    time.sleep(0.3)
+    b = Named.options(name="reusable").remote()
+    pid2 = ray_tpu.get(b.who.remote(), timeout=30)
+    assert pid1 != pid2
+    ray_tpu.kill(b)

@@ -74,7 +74,7 @@ def test_gae_terminal_episode_matches_hand_calc():
                     extra={"values": values[t]})
     spec = rl_module.RLModuleSpec(obs_dim=2, action_dim=2)
     params = rl_module.init_params(spec, __import__("jax").random.key(0))
-    rows = compute_gae([ep], params, spec, gamma, lam)
+    rows = compute_gae([ep], params, gamma, lam)
     # delta1 = 1 + 0 - 0.4 = 0.6 ; adv1 = 0.6
     # delta0 = 1 + .9*.4 - .5 = 0.86 ; adv0 = 0.86 + .9*.8*.6 = 1.292
     np.testing.assert_allclose(rows[0]["advantages"], [1.292, 0.6],
