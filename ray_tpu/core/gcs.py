@@ -211,7 +211,6 @@ class ControlServer:
                          available=resources, is_head=True)
         self.nodes: Dict[str, NodeState] = {"head": head}
         self.placement_groups: Dict[str, PlacementGroupEntry] = {}
-        self._rr_counter = 0  # SPREAD round-robin cursor
         self.store = ShmObjectStore(session_id, config.shm_dir)
 
         self._wake = threading.Event()
@@ -1099,12 +1098,8 @@ class ControlServer:
             feasible.sort(key=lambda n: (util(n), n.node_id))
             lowest = util(feasible[0])
             ties = [n for n in feasible if util(n) == lowest]
-            tid = getattr(spec, "task_id", None) or getattr(
-                spec, "actor_id", None)
-            idx = (int(tid.hex()[:8], 16) if tid is not None
-                   else self._rr_counter)
-            self._rr_counter += 1
-            node = ties[idx % len(ties)]
+            tid = getattr(spec, "task_id", None) or spec.actor_id
+            node = ties[int(tid.hex()[:8], 16) % len(ties)]
             return node.node_id, ("node", node.node_id)
         # hybrid default: pack onto the busiest node below the spread
         # threshold; above it, spread to the least utilized.

@@ -1,0 +1,59 @@
+"""ray_tpu.serve: model serving on the ray_tpu actor runtime.
+
+Counterpart of Ray Serve (python/ray/serve/): deployments + applications,
+a controller actor reconciling replica actors, pow-2 routing, an HTTP
+ingress proxy, dynamic batching, model multiplexing, and queue-based
+replica autoscaling.  TPU-first: replicas are the unit that owns a chip
+(or a slice via placement groups), and @serve.batch keeps device batches
+full.
+"""
+
+from ray_tpu.serve.api import (
+    delete,
+    get_app_handle,
+    get_deployment_handle,
+    proxy_address,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import (
+    ApplicationStatus,
+    AutoscalingConfig,
+    DeploymentConfig,
+    DeploymentStatus,
+    HTTPOptions,
+)
+from ray_tpu.serve.deployment import Application, Deployment, deployment
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
+from ray_tpu.serve.proxy import Request
+from ray_tpu.serve.replica import get_replica_context
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "run",
+    "start",
+    "shutdown",
+    "delete",
+    "status",
+    "get_app_handle",
+    "get_deployment_handle",
+    "proxy_address",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "AutoscalingConfig",
+    "DeploymentConfig",
+    "HTTPOptions",
+    "ApplicationStatus",
+    "DeploymentStatus",
+    "batch",
+    "multiplexed",
+    "get_multiplexed_model_id",
+    "get_replica_context",
+    "Request",
+]

@@ -1,0 +1,136 @@
+"""DeploymentHandle / DeploymentResponse: the composition-and-calling API.
+
+Counterpart of python/ray/serve/handle.py (DeploymentHandle :714): a
+picklable handle that routes calls through the per-process Router and
+returns DeploymentResponse futures.  Responses can be passed as arguments
+to other handle calls (model composition) — the underlying ObjectRef is
+forwarded so the downstream replica awaits the value, not the caller.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.serve.router import Router
+
+MAX_DATA_PLANE_RETRIES = 3
+
+
+class DeploymentResponse:
+    def __init__(self, handle: "DeploymentHandle", method: str,
+                 args: tuple, kwargs: dict):
+        self._handle = handle
+        self._method = method
+        self._args = args
+        self._kwargs = kwargs
+        self._lock = threading.Lock()
+        self._ref: Optional[ObjectRef] = None
+        self._assigned_hex: Optional[str] = None
+        self._released = False
+        self._submit()
+
+    def _submit(self):
+        h = self._handle
+        hex_id, actor = h._router().assign_replica(
+            timeout_s=h._assign_timeout_s)
+        meta = {"multiplexed_model_id": h._multiplexed_model_id}
+        ref = getattr(actor, "handle_request").remote(
+            self._method, self._args, self._kwargs, meta)
+        with self._lock:
+            self._assigned_hex = hex_id
+            self._ref = ref
+            self._released = False
+        # release the in-flight slot when the result lands
+        from ray_tpu.core.runtime import get_runtime
+
+        fut = get_runtime().as_future(ref)
+        fut.add_done_callback(lambda _f: self._release())
+
+    def _release(self):
+        with self._lock:
+            if self._released or self._assigned_hex is None:
+                return
+            self._released = True
+            hex_id = self._assigned_hex
+        self._handle._router().release(hex_id)
+
+    def result(self, timeout_s: Optional[float] = 60.0) -> Any:
+        """Resolve; retries through another replica if the assigned one
+        died before/while executing (reference router retry semantics)."""
+        attempts = 0
+        while True:
+            with self._lock:
+                ref = self._ref
+            try:
+                return ray_tpu.get(ref, timeout=timeout_s)
+            except ray_tpu.ActorError:
+                self._release()
+                self._handle._router().drop_replica(self._assigned_hex)
+                attempts += 1
+                if attempts >= MAX_DATA_PLANE_RETRIES:
+                    raise
+                self._submit()
+
+    def _to_object_ref(self) -> ObjectRef:
+        with self._lock:
+            return self._ref
+
+    def __reduce__(self):
+        # Composition: ship the underlying ref; downstream resolves it.
+        return (_identity, (self._to_object_ref(),))
+
+
+def _identity(x):
+    return x
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__"):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._multiplexed_model_id = ""
+        self._assign_timeout_s = 30.0
+
+    def _router(self) -> Router:
+        from ray_tpu.serve.api import _get_controller
+
+        return Router.get_or_create(
+            self.app_name, self.deployment_name, _get_controller())
+
+    def options(self, *, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None,
+                assign_timeout_s: Optional[float] = None
+                ) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self.app_name,
+                             method_name or self._method_name)
+        h._multiplexed_model_id = (
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self._multiplexed_model_id)
+        if assign_timeout_s is not None:
+            h._assign_timeout_s = assign_timeout_s
+        return h
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        return DeploymentResponse(self, self._method_name, args, kwargs)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def __reduce__(self):
+        return (_rebuild_handle,
+                (self.deployment_name, self.app_name, self._method_name))
+
+    def __repr__(self):
+        return (f"DeploymentHandle(app={self.app_name!r}, "
+                f"deployment={self.deployment_name!r})")
+
+
+def _rebuild_handle(deployment_name, app_name, method_name):
+    return DeploymentHandle(deployment_name, app_name, method_name)

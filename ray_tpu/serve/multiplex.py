@@ -1,0 +1,95 @@
+"""@serve.multiplexed: per-replica LRU of loaded models.
+
+Counterpart of python/ray/serve/multiplex.py: a replica hosts up to
+num_models_per_replica models, loading on demand and evicting
+least-recently-used.  The model id for a request comes from
+handle.options(multiplexed_model_id=...) via the request context.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from collections import OrderedDict
+from typing import Any, Callable
+
+from ray_tpu.serve.replica import get_request_context
+
+
+class _ModelCache:
+    def __init__(self, loader: Callable, capacity: int):
+        self._loader = loader
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._models: "OrderedDict[str, Any]" = OrderedDict()
+
+    def get(self, instance, model_id: str) -> Any:
+        with self._lock:
+            if model_id in self._models:
+                self._models.move_to_end(model_id)
+                return self._models[model_id]
+        model = (self._loader(instance, model_id) if instance is not None
+                 else self._loader(model_id))
+        with self._lock:
+            self._models[model_id] = model
+            self._models.move_to_end(model_id)
+            while len(self._models) > self._capacity:
+                evicted_id, evicted = self._models.popitem(last=False)
+                unload = getattr(evicted, "__del__", None)
+                del evicted
+        return model
+
+    def loaded_ids(self):
+        with self._lock:
+            return list(self._models)
+
+
+# Caches are created lazily per (process, function) — a _ModelCache holds a
+# lock, which would make decorated classes unpicklable (same pattern as
+# batching._get_batcher).
+_registry_lock = threading.Lock()
+_registry: dict = {}
+
+
+def _get_cache(key, fn, capacity) -> _ModelCache:
+    with _registry_lock:
+        c = _registry.get(key)
+        if c is None:
+            c = _registry[key] = _ModelCache(fn, capacity)
+        return c
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorator on the replica's model-loading method; returns a getter
+    that resolves the current request's multiplexed model id."""
+
+    def wrap(fn):
+        import inspect
+
+        params = list(inspect.signature(fn).parameters)
+        is_method = params and params[0] == "self"
+        key = f"{fn.__module__}.{fn.__qualname__}"
+
+        @functools.wraps(fn)
+        def method(self, model_id: str = ""):
+            mid = model_id or get_request_context().multiplexed_model_id
+            cache = _get_cache(
+                (key, id(self)), fn, max_num_models_per_replica)
+            return cache.get(self, mid)
+
+        @functools.wraps(fn)
+        def func(model_id: str = ""):
+            mid = model_id or get_request_context().multiplexed_model_id
+            cache = _get_cache((key, None), fn, max_num_models_per_replica)
+            return cache.get(None, mid)
+
+        return method if is_method else func
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
+def get_multiplexed_model_id() -> str:
+    """Inside a replica: the model id requested by the caller."""
+    return get_request_context().multiplexed_model_id

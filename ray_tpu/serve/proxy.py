@@ -1,0 +1,174 @@
+"""HTTP ingress proxy.
+
+Counterpart of python/ray/serve/_private/proxy.py (HTTPProxy :761): an
+actor that runs a threaded HTTP server, longest-prefix-matches the request
+path against application route prefixes (kept fresh via the controller's
+long-poll 'routes' key), and forwards to the app's ingress deployment
+through a DeploymentHandle.  JSON in / JSON out — the stdlib server
+replaces uvicorn/starlette (no ASGI dependency in this build).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import ray_tpu
+
+LISTEN_TIMEOUT_S = 10.0
+
+
+class Request:
+    """Minimal request object handed to ingress callables."""
+
+    def __init__(self, method: str, path: str, query: Dict[str, list],
+                 body: bytes, headers: Dict[str, str]):
+        self.method = method
+        self.path = path
+        self.query = query
+        self.body = body
+        self.headers = headers
+
+    def json(self):
+        return json.loads(self.body) if self.body else None
+
+    def text(self):
+        return self.body.decode()
+
+    def __reduce__(self):
+        return (Request, (self.method, self.path, self.query, self.body,
+                          self.headers))
+
+
+class HTTPProxy:
+    """Actor: serves HTTP on (host, port); routes to ingress handles."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+        self._routes: Dict[str, Tuple[str, str]] = {}
+        self._routes_lock = threading.Lock()
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _dispatch(self):
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    status, payload = proxy._handle(
+                        self.command, self.path, body,
+                        dict(self.headers.items()))
+                except Exception:
+                    status, payload = 500, traceback.format_exc().encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_PUT = do_DELETE = _dispatch
+
+        # port=0 lets the OS pick; retry upward if a fixed port is taken
+        last_err = None
+        for attempt in range(20):
+            try:
+                self._server = ThreadingHTTPServer(
+                    (host, port + attempt if port else 0), Handler)
+                break
+            except OSError as e:
+                last_err = e
+        else:
+            raise last_err
+        self._addr = (f"http://{self._server.server_address[0]}:"
+                      f"{self._server.server_address[1]}")
+        threading.Thread(target=self._server.serve_forever,
+                         name="http-proxy", daemon=True).start()
+        self._stop = threading.Event()
+        threading.Thread(target=self._route_poll_loop,
+                         name="proxy-routes", daemon=True).start()
+
+    # -- control --------------------------------------------------------
+    def address(self) -> str:
+        return self._addr
+
+    def ping(self) -> str:
+        return "pong"
+
+    def _route_poll_loop(self):
+        from ray_tpu.serve.controller import (
+            CONTROLLER_NAME,
+            SERVE_NAMESPACE,
+        )
+
+        controller = None
+        known = {"routes": 0}
+        while not self._stop.is_set():
+            try:
+                if controller is None:
+                    controller = ray_tpu.get_actor(
+                        CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+                    with self._routes_lock:
+                        self._routes = ray_tpu.get(
+                            controller.get_routes.remote(), timeout=10)
+                changed = ray_tpu.get(
+                    controller.listen_for_change.remote(
+                        known, LISTEN_TIMEOUT_S),
+                    timeout=LISTEN_TIMEOUT_S + 5)
+                for key, (version, value) in (changed or {}).items():
+                    if key == "routes":
+                        known[key] = version
+                        with self._routes_lock:
+                            self._routes = value or {}
+            except Exception:
+                controller = None
+                time.sleep(0.5)
+
+    # -- data plane -----------------------------------------------------
+    def _match_route(self, path: str) -> Optional[Tuple[str, str, str]]:
+        with self._routes_lock:
+            routes = dict(self._routes)
+        best = None
+        for prefix, (app, ingress) in routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(
+                    norm if norm != "/" else "/"):
+                if norm != "/" and not (
+                        path == norm or path[len(norm):][:1] in ("/", "?")):
+                    continue
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, app, ingress)
+        return best
+
+    def _handle(self, method: str, raw_path: str, body: bytes,
+                headers: Dict[str, str]) -> Tuple[int, bytes]:
+        parsed = urlparse(raw_path)
+        path = parsed.path
+        if path == "/-/healthz":
+            return 200, b'"ok"'
+        if path == "/-/routes":
+            with self._routes_lock:
+                return 200, json.dumps(
+                    {k: list(v) for k, v in self._routes.items()}).encode()
+        match = self._match_route(path)
+        if match is None:
+            return 404, json.dumps(
+                {"error": f"no application at {path}"}).encode()
+        prefix, app, ingress = match
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        handle = DeploymentHandle(ingress, app)
+        req = Request(method, path, parse_qs(parsed.query), body, headers)
+        try:
+            result = handle.remote(req).result(timeout_s=60)
+        except Exception as e:
+            return 500, json.dumps({"error": str(e)}).encode()
+        try:
+            return 200, json.dumps(result).encode()
+        except TypeError:
+            return 200, json.dumps(str(result)).encode()

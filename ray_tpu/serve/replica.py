@@ -1,0 +1,138 @@
+"""Replica actor: hosts one copy of a deployment's user callable.
+
+Counterpart of python/ray/serve/_private/replica.py — wraps the user
+callable, counts ongoing requests (the router's pow-2 signal), exposes
+health checks and user_config reconfiguration, and drains gracefully.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu.serve.deployment import HandleMarker, make_callable
+
+_replica_context = threading.local()
+
+
+class ReplicaContext:
+    def __init__(self, app_name: str, deployment: str, replica_id: str):
+        self.app_name = app_name
+        self.deployment = deployment
+        self.replica_id = replica_id
+
+
+def get_replica_context() -> Optional[ReplicaContext]:
+    return getattr(_replica_context, "ctx", None)
+
+
+class RequestContext:
+    """Per-request metadata (thread-local inside the replica)."""
+
+    def __init__(self, multiplexed_model_id: str = "",
+                 route: str = ""):
+        self.multiplexed_model_id = multiplexed_model_id
+        self.route = route
+
+
+def get_request_context() -> RequestContext:
+    ctx = getattr(_replica_context, "request", None)
+    return ctx if ctx is not None else RequestContext()
+
+
+class Replica:
+    """The actor class the controller instantiates per replica.
+
+    max_concurrency on the actor is set to max_ongoing_requests, so up to
+    that many handle_request calls execute concurrently in threads.
+    """
+
+    def __init__(self, blob: bytes, app_name: str, deployment_name: str,
+                 replica_id: str, user_config: Any = None):
+        func_or_class, init_args, init_kwargs = cloudpickle.loads(blob)
+        init_args = tuple(self._resolve_marker(a) for a in init_args)
+        init_kwargs = {k: self._resolve_marker(v)
+                       for k, v in init_kwargs.items()}
+        _replica_context.ctx = ReplicaContext(
+            app_name, deployment_name, replica_id)
+        self._app_name = app_name
+        self._deployment_name = deployment_name
+        self._replica_id = replica_id
+        self._callable = make_callable(func_or_class, init_args, init_kwargs)
+        self._ongoing = 0
+        self._lock = threading.Lock()
+        self._draining = False
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    @staticmethod
+    def _resolve_marker(a: Any):
+        if isinstance(a, HandleMarker):
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            return DeploymentHandle(a.deployment_name, a.app_name)
+        return a
+
+    # -- data plane -----------------------------------------------------
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       request_meta: Optional[dict] = None) -> Any:
+        import ray_tpu
+        from ray_tpu.core.object_ref import ObjectRef
+
+        # Composition: upstream DeploymentResponses arrive as ObjectRefs
+        # (handle.py __reduce__); resolve them here so user code sees
+        # values (reference replica resolves handle-arg refs the same way).
+        args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
+                     for a in args)
+        kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef) else v
+                  for k, v in kwargs.items()}
+        with self._lock:
+            self._ongoing += 1
+        _replica_context.ctx = ReplicaContext(
+            self._app_name, self._deployment_name, self._replica_id)
+        _replica_context.request = RequestContext(
+            **(request_meta or {}))
+        try:
+            target = (self._callable if method == "__call__"
+                      else getattr(self._callable, method))
+            return target(*args, **kwargs)
+        finally:
+            with self._lock:
+                self._ongoing -= 1
+
+    # -- control plane --------------------------------------------------
+    def num_ongoing(self) -> int:
+        return self._ongoing
+
+    def health_check(self) -> str:
+        user_check = getattr(self._callable, "check_health", None)
+        if callable(user_check):
+            user_check()
+        return "ok"
+
+    def reconfigure(self, user_config: Any) -> None:
+        fn = getattr(self._callable, "reconfigure", None)
+        if callable(fn):
+            fn(user_config)
+
+    def metadata(self) -> Dict[str, Any]:
+        return {
+            "app": self._app_name,
+            "deployment": self._deployment_name,
+            "replica_id": self._replica_id,
+        }
+
+    def drain(self, timeout_s: float) -> bool:
+        """Stop accepting work (router already removed us) and wait for
+        in-flight requests; returns True when drained."""
+        self._draining = True
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._ongoing == 0:
+                    return True
+            time.sleep(0.02)
+        return self._ongoing == 0

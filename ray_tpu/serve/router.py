@@ -1,0 +1,155 @@
+"""Request router: replica-set tracking + power-of-two-choices scheduling.
+
+Counterpart of python/ray/serve/_private/router.py (Router :312,
+assign_request :518) and the PowerOfTwoChoicesReplicaScheduler
+(replica_scheduler/pow_2_scheduler.py:49): pick two random replicas and
+send to the one with the smaller queue.  Queue size here is the router's
+own in-flight count per replica (locality-aware variant) — no per-request
+probe RTT on the hot path.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.core.actor import ActorHandle
+
+LISTEN_TIMEOUT_S = 10.0
+
+
+class _ReplicaSet:
+    def __init__(self):
+        self.entries: List[dict] = []
+        self.handles: Dict[str, ActorHandle] = {}
+        self.inflight: Dict[str, int] = {}
+        self.version = 0
+        self.cv = threading.Condition()
+
+    def update(self, entries: List[dict], version: int):
+        with self.cv:
+            self.entries = entries or []
+            self.version = version
+            live = {e["actor_hex"] for e in self.entries}
+            for hex_id in list(self.handles):
+                if hex_id not in live:
+                    del self.handles[hex_id]
+                    self.inflight.pop(hex_id, None)
+            for e in self.entries:
+                h = e["actor_hex"]
+                if h not in self.handles:
+                    self.handles[h] = ActorHandle(h, "Replica")
+                    self.inflight.setdefault(h, 0)
+            self.cv.notify_all()
+
+
+class Router:
+    """One Router per (app, deployment) per process, shared by handles."""
+
+    _hub_lock = threading.Lock()
+    _hub: Dict[tuple, "Router"] = {}
+
+    def __init__(self, app_name: str, deployment: str, controller):
+        self.app_name = app_name
+        self.deployment = deployment
+        self._controller = controller
+        self._set = _ReplicaSet()
+        self._key = f"replicas::{app_name}::{deployment}"
+        # seed synchronously so the first request doesn't always wait a
+        # full long-poll round trip
+        try:
+            entries = ray_tpu.get(
+                controller.get_replicas.remote(app_name, deployment),
+                timeout=10)
+            self._set.update(entries, version=0)
+        except Exception:
+            pass
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._poll_loop, name=f"router-{deployment}", daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get_or_create(cls, app_name: str, deployment: str,
+                      controller) -> "Router":
+        key = (app_name, deployment)
+        with cls._hub_lock:
+            r = cls._hub.get(key)
+            if r is None:
+                r = cls._hub[key] = Router(app_name, deployment, controller)
+            return r
+
+    @classmethod
+    def reset_all(cls):
+        with cls._hub_lock:
+            for r in cls._hub.values():
+                r._stop.set()
+            cls._hub.clear()
+
+    def _poll_loop(self):
+        known = {self._key: 0}
+        while not self._stop.is_set():
+            try:
+                ref = self._controller.listen_for_change.remote(
+                    known, LISTEN_TIMEOUT_S)
+                changed = ray_tpu.get(ref, timeout=LISTEN_TIMEOUT_S + 5)
+            except Exception:
+                if self._stop.is_set():
+                    return
+                time.sleep(0.5)
+                continue
+            for key, (version, value) in (changed or {}).items():
+                if key == self._key:
+                    known[key] = version
+                    self._set.update(value, version)
+
+    # ------------------------------------------------------------------
+    def assign_replica(self, timeout_s: float = 30.0) -> tuple:
+        """Pick a replica (pow-2 by local in-flight), respecting
+        max_ongoing backpressure; returns (actor_hex, handle)."""
+        s = self._set
+        deadline = time.monotonic() + timeout_s
+        with s.cv:
+            while True:
+                candidates = []
+                for e in s.entries:
+                    h = e["actor_hex"]
+                    if s.inflight.get(h, 0) < e.get("max_ongoing", 8):
+                        candidates.append(e)
+                if candidates:
+                    if len(candidates) >= 2:
+                        a, b = random.sample(candidates, 2)
+                        pick = (a if s.inflight.get(a["actor_hex"], 0)
+                                <= s.inflight.get(b["actor_hex"], 0) else b)
+                    else:
+                        pick = candidates[0]
+                    hex_id = pick["actor_hex"]
+                    s.inflight[hex_id] = s.inflight.get(hex_id, 0) + 1
+                    return hex_id, s.handles[hex_id]
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"no available replica for "
+                        f"{self.app_name}/{self.deployment} "
+                        f"within {timeout_s}s")
+                s.cv.wait(timeout=min(remaining, 0.5))
+
+    def release(self, actor_hex: str):
+        s = self._set
+        with s.cv:
+            if actor_hex in s.inflight and s.inflight[actor_hex] > 0:
+                s.inflight[actor_hex] -= 1
+            s.cv.notify_all()
+
+    def drop_replica(self, actor_hex: str):
+        """Remove a replica the data plane found dead (controller will
+        also notice via health checks)."""
+        s = self._set
+        with s.cv:
+            s.entries = [e for e in s.entries
+                         if e["actor_hex"] != actor_hex]
+            s.handles.pop(actor_hex, None)
+            s.inflight.pop(actor_hex, None)

@@ -93,7 +93,13 @@ def test_named_actor_name_reusable_after_kill(ray_start_regular):
     a = Named.options(name="reusable").remote()
     pid1 = ray_tpu.get(a.who.remote(), timeout=30)
     ray_tpu.kill(a)
-    time.sleep(0.3)
+    # name release happens when the GCS notices the worker die; poll
+    from ray_tpu.core.runtime import get_runtime
+
+    deadline = time.time() + 30
+    while get_runtime().get_named_actor("reusable") is not None:
+        assert time.time() < deadline, "name never released"
+        time.sleep(0.05)
     b = Named.options(name="reusable").remote()
     pid2 = ray_tpu.get(b.who.remote(), timeout=30)
     assert pid1 != pid2

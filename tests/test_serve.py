@@ -1,0 +1,270 @@
+"""Serve library tests: deployments, routing, composition, batching,
+autoscaling, fault tolerance, HTTP proxy.
+
+Counterpart of the reference's python/ray/serve/tests/ (test_api.py,
+test_handle.py, test_batching.py, test_autoscaling_policy.py,
+test_proxy.py) at unit scale.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def serve_instance():
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _cleanup_apps(serve_instance):
+    yield
+    for app in list(serve.status()):
+        serve.delete(app)
+
+
+def test_basic_class_deployment(serve_instance):
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return {"echo": x}
+
+        def shout(self, x):
+            return str(x).upper()
+
+    handle = serve.run(Echo.bind(), name="echo", route_prefix=None)
+    assert handle.remote(42).result() == {"echo": 42}
+    assert handle.shout.remote("hi").result() == "HI"
+
+
+def test_function_deployment(serve_instance):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind(), name="fn", route_prefix=None)
+    assert handle.remote(21).result() == 42
+
+
+def test_num_replicas_and_routing(serve_instance):
+    @serve.deployment(num_replicas=3)
+    class Who:
+        def __call__(self):
+            return serve.get_replica_context().replica_id
+
+    handle = serve.run(Who.bind(), name="who", route_prefix=None)
+    seen = {handle.remote().result() for _ in range(30)}
+    assert len(seen) == 3, seen  # pow-2 eventually touches all replicas
+
+
+def test_composition(serve_instance):
+    @serve.deployment
+    class Adder:
+        def __init__(self, amount):
+            self.amount = amount
+
+        def __call__(self, x):
+            return x + self.amount
+
+    @serve.deployment
+    class Pipeline:
+        def __init__(self, a, b):
+            self.a = a
+            self.b = b
+
+        def __call__(self, x):
+            r1 = self.a.remote(x)       # DeploymentResponse
+            r2 = self.b.remote(r1)      # composed without resolving
+            return r2.result()
+
+    app = Pipeline.bind(Adder.bind(1), Adder.options(name="Adder2").bind(10))
+    handle = serve.run(app, name="pipe", route_prefix=None)
+    assert handle.remote(5).result() == 16
+
+
+def test_user_config_reconfigure(serve_instance):
+    @serve.deployment(user_config={"threshold": 1})
+    class Configurable:
+        def __init__(self):
+            self.threshold = None
+
+        def reconfigure(self, cfg):
+            self.threshold = cfg["threshold"]
+
+        def __call__(self):
+            return self.threshold
+
+    serve.run(Configurable.bind(), name="cfg", route_prefix=None)
+    h = serve.get_app_handle("cfg")
+    assert h.remote().result() == 1
+
+    serve.run(Configurable.options(user_config={"threshold": 7}).bind(),
+              name="cfg", route_prefix=None)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if h.remote().result() == 7:
+            break
+        time.sleep(0.2)
+    assert h.remote().result() == 7
+
+
+def test_scale_up_and_down_via_redeploy(serve_instance):
+    @serve.deployment(num_replicas=1)
+    class S:
+        def __call__(self):
+            return serve.get_replica_context().replica_id
+
+    serve.run(S.bind(), name="scale", route_prefix=None)
+    assert len(serve.status()["scale"].deployments["S"].replicas) == 1
+    serve.run(S.options(num_replicas=3).bind(), name="scale",
+              route_prefix=None)
+    st = serve.status()["scale"].deployments["S"]
+    running = [r for r in st.replicas if r.state == "RUNNING"]
+    assert len(running) == 3
+
+
+def test_replica_death_recovers(serve_instance):
+    @serve.deployment(num_replicas=2, health_check_period_s=0.2)
+    class Mortal:
+        def __call__(self):
+            return serve.get_replica_context().replica_id
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    handle = serve.run(Mortal.bind(), name="mortal", route_prefix=None)
+    assert handle.remote().result()
+    try:
+        handle.die.remote().result(timeout_s=5)
+    except Exception:
+        pass
+    # controller heals back to 2 RUNNING replicas; requests keep working
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = serve.status()["mortal"].deployments["Mortal"]
+        running = [r for r in st.replicas if r.state == "RUNNING"]
+        if len(running) == 2:
+            break
+        time.sleep(0.2)
+    assert len(running) == 2
+    assert handle.remote().result()
+
+
+def test_batching(serve_instance):
+    @serve.deployment
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        def handle_batch(self, xs):
+            # whole batch processed in one call
+            return [(x, len(xs)) for x in xs]
+
+        def __call__(self, x):
+            return self.handle_batch(x)
+
+    handle = serve.run(Batched.bind(), name="batched", route_prefix=None)
+    responses = [handle.remote(i) for i in range(4)]
+    results = [r.result() for r in responses]
+    values = {v for v, _ in results}
+    batch_sizes = {bs for _, bs in results}
+    assert values == {0, 1, 2, 3}
+    assert max(batch_sizes) > 1, "calls were never coalesced"
+
+
+def test_multiplexing(serve_instance):
+    @serve.deployment
+    class Multi:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            return {"model": model_id}
+
+        def __call__(self):
+            model = self.get_model()
+            return model["model"]
+
+    handle = serve.run(Multi.bind(), name="multi", route_prefix=None)
+    r = handle.options(multiplexed_model_id="m1").remote().result()
+    assert r == "m1"
+    r = handle.options(multiplexed_model_id="m2").remote().result()
+    assert r == "m2"
+
+
+def test_autoscaling_scales_up(serve_instance):
+    @serve.deployment(
+        autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1,
+            upscale_delay_s=0.0, downscale_delay_s=60.0),
+        max_ongoing_requests=2,
+    )
+    class Slow:
+        def __call__(self):
+            time.sleep(0.8)
+            return serve.get_replica_context().replica_id
+
+    handle = serve.run(Slow.bind(), name="auto", route_prefix=None)
+    responses = [handle.remote() for _ in range(8)]
+    deadline = time.time() + 30
+    peak = 1
+    while time.time() < deadline:
+        st = serve.status()["auto"].deployments["Slow"]
+        peak = max(peak, len([r for r in st.replicas
+                              if r.state == "RUNNING"]))
+        if peak >= 2:
+            break
+        time.sleep(0.2)
+    for r in responses:
+        r.result(timeout_s=60)
+    assert peak >= 2, "autoscaler never scaled past 1 replica"
+
+
+def test_http_proxy(serve_instance):
+    serve.start(proxy=True)
+
+    @serve.deployment
+    class Api:
+        def __call__(self, request: serve.Request):
+            body = request.json() or {}
+            return {"path": request.path, "x2": body.get("x", 0) * 2}
+
+    serve.run(Api.bind(), name="webapp", route_prefix="/webapp")
+    addr = serve.proxy_address()
+    assert addr
+    req = urllib.request.Request(
+        addr + "/webapp", data=json.dumps({"x": 5}).encode(),
+        headers={"Content-Type": "application/json"})
+    deadline = time.time() + 15
+    while True:
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                payload = json.loads(resp.read())
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.3)
+    assert payload == {"path": "/webapp", "x2": 10}
+    # health + routes endpoints
+    with urllib.request.urlopen(addr + "/-/healthz", timeout=5) as resp:
+        assert json.loads(resp.read()) == "ok"
+    with urllib.request.urlopen(addr + "/-/routes", timeout=5) as resp:
+        assert "/webapp" in json.loads(resp.read())
+
+
+def test_delete_application(serve_instance):
+    @serve.deployment
+    def f():
+        return 1
+
+    serve.run(f.bind(), name="togo", route_prefix=None)
+    assert "togo" in serve.status()
+    serve.delete("togo")
+    assert "togo" not in serve.status()
