@@ -270,6 +270,19 @@ class ControlServer:
                 return
             self._mark_worker_dead(w, "connection lost")
         self._wake.set()
+        self._sweep_store()
+
+    def _sweep_store(self):
+        """Drop shm-arena pins held by dead processes so their blocks can be
+        reclaimed (plasma's client-disconnect accounting)."""
+        with self.lock:
+            alive = [w.pid for w in self.workers.values()
+                     if w.state != "dead" and w.pid]
+        alive.append(os.getpid())
+        try:
+            self.store.sweep(alive)
+        except Exception:
+            pass
 
     def _mark_worker_dead(self, w: WorkerInfo, reason: str):
         """Called with lock held. Fail/retry its task, kill/restart its actor."""

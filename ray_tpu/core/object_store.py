@@ -18,11 +18,43 @@ from __future__ import annotations
 
 import mmap
 import os
+import shutil
 import threading
 from concurrent.futures import Future
 from typing import Any, Dict, Optional
 
 from ray_tpu.core.ids import ObjectID
+
+
+def _default_capacity(shm_dir: str) -> int:
+    """Arena size when unset: most of shm, sparse so it commits lazily."""
+    try:
+        free = shutil.disk_usage(shm_dir).free
+    except OSError:
+        free = 1 << 30
+    return max(64 << 20, min(int(free * 0.8), 8 << 30))
+
+
+class NativeSegment:
+    """View over one object's payload inside the native arena."""
+
+    __slots__ = ("name", "size", "_view", "writable")
+
+    def __init__(self, name: str, size: int, view, writable: bool):
+        self.name = name
+        self.size = size
+        self._view = view
+        self.writable = writable
+
+    @property
+    def buf(self):
+        return self._view
+
+    def close(self):
+        try:
+            self._view.release()
+        except (BufferError, AttributeError):
+            pass
 
 
 class ShmSegment:
@@ -63,17 +95,62 @@ class ShmObjectStore:
     control store — this class only manages local segments.
     """
 
-    def __init__(self, session_id: str, shm_dir: str = "/dev/shm"):
+    def __init__(self, session_id: str, shm_dir: str = "/dev/shm",
+                 capacity: int = 0):
         self.session_id = session_id
         self.shm_dir = shm_dir
         self._prefix = f"raytpu-{session_id}"
         self._lock = threading.Lock()
-        self._open: Dict[str, ShmSegment] = {}
+        self._open: Dict[str, object] = {}
+        self._arena = None
+        if os.environ.get("RAY_TPU_NATIVE_STORE", "1") != "0":
+            try:
+                from ray_tpu.native.store import NativeArena
+
+                self._arena = NativeArena(
+                    os.path.join(shm_dir, f"{self._prefix}-arena"),
+                    capacity or _default_capacity(shm_dir), create=True)
+            except Exception as e:
+                # g++ missing etc. — fall back to file-per-object segments.
+                # Loud, because a *partial* fallback (only some processes)
+                # would split object visibility across the node.
+                import logging
+
+                logging.getLogger("ray_tpu").warning(
+                    "native shm arena unavailable (%s); using "
+                    "file-per-object store", e)
+                self._arena = None
+
+    @property
+    def native(self) -> bool:
+        return self._arena is not None
 
     def _path(self, object_id: ObjectID) -> str:
         return os.path.join(self.shm_dir, f"{self._prefix}-{object_id.hex()}")
 
     def create(self, object_id: ObjectID, size: int) -> ShmSegment:
+        with self._lock:
+            if self._arena is not None:
+                from ray_tpu.native.store import (
+                    ArenaFullError,
+                    ObjectExistsError,
+                )
+
+                oid = object_id.binary()
+                try:
+                    try:
+                        view = self._arena.create(oid, size)
+                    except ObjectExistsError:
+                        # task retry re-storing the same return id: replace
+                        # (pinned old copies are orphaned by the C side)
+                        self._arena.delete(oid)
+                        view = self._arena.create(oid, size)
+                    seg = NativeSegment(
+                        object_id.hex(), size, view, writable=True)
+                    self._open[object_id.hex()] = seg
+                    return seg
+                except ArenaFullError:
+                    pass  # overflow: spill to a file-per-object segment
         path = self._path(object_id)
         fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_EXCL, 0o600)
         try:
@@ -89,12 +166,57 @@ class ShmObjectStore:
             self._open[object_id.hex()] = seg
         return seg
 
+    def seal(self, object_id: ObjectID):
+        """Publish a written object (native arena; no-op for file-backed)."""
+        with self._lock:
+            if self._arena is not None:
+                import errno
+
+                from ray_tpu.native.store import ArenaError
+
+                try:
+                    self._arena.seal(object_id.binary())
+                except ArenaError as e:
+                    # ENOENT: an overflow object living in a file segment
+                    if e.err != errno.ENOENT:
+                        raise
+
     def attach(self, object_id: ObjectID, size: int) -> ShmSegment:
         key = object_id.hex()
         with self._lock:
             seg = self._open.get(key)
             if seg is not None:
                 return seg
+        with self._lock:
+            if self._arena is not None:
+                import errno
+
+                from ray_tpu.native.store import ArenaError
+
+                try:
+                    view = self._arena.get(object_id.binary())
+                except ArenaError as e:
+                    if e.err != errno.EBUSY:
+                        raise
+                    # Pin-slot table full (many live reader processes):
+                    # degrade to a copied read — correct, just not zero-copy.
+                    data = self._arena.read_copy(object_id.binary())
+                    view = memoryview(bytearray(data)) if data is not None \
+                        else None
+                    if view is not None:
+                        seg = NativeSegment(key, len(view), view,
+                                            writable=False)
+                        self._open.setdefault(key, seg)
+                        return seg
+                if view is not None:
+                    # The pin taken by get() is held for this process's
+                    # lifetime: deserialized arrays may alias the buffer
+                    # (pickle5 zero copy), mirroring how the file-backed
+                    # path keeps the mmap open.
+                    seg = NativeSegment(key, len(view), view, writable=False)
+                    self._open.setdefault(key, seg)
+                    return seg
+                # else: overflow object — fall through to the file path
         path = self._path(object_id)
         f = open(path, "rb")
         mm = mmap.mmap(f.fileno(), max(size, 1), prot=mmap.PROT_READ)
@@ -104,28 +226,59 @@ class ShmObjectStore:
         return seg
 
     def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            if self._arena is not None and \
+                    self._arena.contains(object_id.binary()):
+                return True
         return os.path.exists(self._path(object_id))
 
     def release(self, object_id: ObjectID):
-        """Close the local mapping (does not delete the file)."""
+        """Close the local mapping (does not delete the object)."""
         with self._lock:
             seg = self._open.pop(object_id.hex(), None)
-        if seg is not None:
-            seg.close()
+            if seg is not None:
+                seg.close()
+                if self._arena is not None and not seg.writable:
+                    self._arena.release(object_id.binary())
 
     def delete(self, object_id: ObjectID):
-        self.release(object_id)
+        with self._lock:
+            seg = self._open.pop(object_id.hex(), None)
+            if seg is not None:
+                seg.close()
+            if self._arena is not None:
+                self._arena.delete(object_id.binary())
         try:
             os.unlink(self._path(object_id))
         except FileNotFoundError:
             pass
 
+    def sweep(self, alive_pids) -> int:
+        """Drop pins held by dead processes (node-daemon duty; native only)."""
+        with self._lock:
+            if self._arena is not None:
+                return self._arena.sweep(list(alive_pids))
+        return 0
+
+    def stats(self):
+        """(capacity, used, num_objects, evicted_bytes) — native arena only."""
+        with self._lock:
+            if self._arena is not None:
+                return self._arena.stats()
+        return (0, 0, 0, 0)
+
     def cleanup(self):
         with self._lock:
             segs = list(self._open.values())
             self._open.clear()
+            arena, self._arena = self._arena, None
         for seg in segs:
             seg.close()
+        if arena is not None:
+            try:
+                arena.close()
+            except Exception:
+                pass
         # best-effort sweep of this session's files
         try:
             for name in os.listdir(self.shm_dir):

@@ -179,6 +179,7 @@ class CoreClient:
         else:
             seg = self.store.create(oid, size)
             ser.write_into(seg.buf[:size])
+            self.store.seal(oid)
             self.client.send({
                 "op": "put_object", "obj": oid.hex(), "size": size,
                 "inline": None, "in_shm": True, "is_error": is_error,

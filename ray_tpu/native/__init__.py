@@ -1,0 +1,6 @@
+"""Native (C++) runtime components and their Python bindings.
+
+C++ sources live in ``src/`` at the repo root; compiled artifacts land in
+``ray_tpu/native/_lib/``. Libraries are (re)built on demand with g++ —
+see :mod:`ray_tpu.native.build`.
+"""

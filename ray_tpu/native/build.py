@@ -1,0 +1,64 @@
+"""On-demand builder for the native (C++) libraries.
+
+Compiles ``src/<name>/<name>.cc`` into ``ray_tpu/native/_lib/lib<name>.so``
+the first time it's needed and whenever the source changes (tracked by a
+content hash stamp). Keeps the package runnable from a plain git checkout
+with no separate build step, like the reference's bazel-built wheels but
+without the wheel.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_LIB_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_lib")
+_lock = threading.Lock()
+_built: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def build_library(name: str, source: str) -> str:
+    """Build (if stale) and return the path to ``lib<name>.so``.
+
+    Raises NativeBuildError if no compiler is available or the build fails.
+    """
+    with _lock:
+        if name in _built:
+            return _built[name]
+        src = source
+        if not os.path.exists(src):
+            raise NativeBuildError(f"native source not found: {src}")
+        os.makedirs(_LIB_DIR, exist_ok=True)
+        out = os.path.join(_LIB_DIR, f"lib{name}.so")
+        stamp = out + ".stamp"
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
+        if os.path.exists(out) and os.path.exists(stamp):
+            with open(stamp) as f:
+                if f.read().strip() == digest:
+                    _built[name] = out
+                    return out
+        cmd = [
+            os.environ.get("CXX", "g++"), "-O2", "-g", "-std=c++17",
+            "-fPIC", "-shared", "-Wall", "-o", out, src, "-lpthread",
+        ]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise NativeBuildError(f"compiler unavailable: {e}") from e
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"build of {name} failed:\n{proc.stderr[-4000:]}")
+        with open(stamp, "w") as f:
+            f.write(digest)
+        _built[name] = out
+        return out

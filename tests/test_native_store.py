@@ -1,0 +1,192 @@
+"""Unit tests for the native shm arena (src/store/tpustore.cc).
+
+Covers the plasma-equivalent lifecycle (create/seal/get/release/delete),
+allocator reuse/coalescing, LRU eviction, deferred deletes, cross-process
+attach, and the dead-pid sweep — reference behaviors from
+src/ray/object_manager/plasma/ (ObjectLifecycleManager, EvictionPolicy).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ray_tpu.native.store import (
+    ArenaFullError,
+    NativeArena,
+    ObjectExistsError,
+)
+
+
+@pytest.fixture
+def arena(tmp_path):
+    path = "/dev/shm/tps-unittest-%d" % os.getpid()
+    if os.path.exists(path):
+        os.unlink(path)
+    a = NativeArena(path, 8 * 1024 * 1024, create=True)
+    yield a
+    a.close()
+    if os.path.exists(path):
+        os.unlink(path)
+
+
+def test_create_seal_get_roundtrip(arena):
+    oid = os.urandom(14)
+    buf = arena.create(oid, 100)
+    buf[:3] = b"xyz"
+    assert not arena.contains(oid)  # unsealed objects are not visible
+    arena.seal(oid)
+    assert arena.contains(oid)
+    view = arena.get(oid)
+    assert bytes(view[:3]) == b"xyz"
+    assert len(view) == 100
+
+
+def test_duplicate_create_raises(arena):
+    oid = os.urandom(14)
+    arena.create(oid, 10)
+    with pytest.raises(ObjectExistsError):
+        arena.create(oid, 10)
+
+
+def test_get_missing_returns_none(arena):
+    assert arena.get(os.urandom(14)) is None
+
+
+def test_delete_frees_space(arena):
+    _, used0, n0, _ = arena.stats()
+    oid = os.urandom(14)
+    arena.create(oid, 1 << 20)
+    arena.seal(oid)
+    arena.delete(oid)
+    _, used1, n1, _ = arena.stats()
+    assert used1 == used0
+    assert n1 == n0
+
+
+def test_delete_deferred_while_pinned(arena):
+    oid = os.urandom(14)
+    arena.create(oid, 1000)
+    arena.seal(oid)
+    arena.get(oid)  # pin
+    arena.delete(oid)
+    assert not arena.contains(oid)  # hidden immediately
+    _, used, _, _ = arena.stats()
+    assert used > 0  # block not yet reclaimed
+    arena.release(oid)
+    _, used, _, _ = arena.stats()
+    assert used == 0  # last release applied the deferred delete
+
+
+def test_allocator_reuse_and_coalesce(arena):
+    # Fill with many small objects, delete all, then allocate one block
+    # nearly the size of the heap: only works if frees coalesced.
+    cap, _, _, _ = arena.stats()
+    oids = [os.urandom(14) for _ in range(64)]
+    for o in oids:
+        arena.create(o, 64 * 1024)
+        arena.seal(o)
+    for o in oids:
+        arena.delete(o)
+    big = os.urandom(14)
+    arena.create(big, cap - 4096)
+    arena.seal(big)
+    assert arena.contains(big)
+
+
+def test_arena_full_without_eviction(arena):
+    cap, _, _, _ = arena.stats()
+    keep = os.urandom(14)
+    arena.create(keep, cap // 2)
+    arena.seal(keep)
+    with pytest.raises(ArenaFullError):
+        arena.create(os.urandom(14), cap - 4096, evict_ok=False)
+
+
+def test_lru_eviction_order(arena):
+    cap, _, _, _ = arena.stats()
+    a, b = os.urandom(14), os.urandom(14)
+    arena.create(a, cap // 4); arena.seal(a)
+    arena.create(b, cap // 4); arena.seal(b)
+    arena.get(a)  # touch a -> b is now LRU
+    arena.release(a)
+    big = os.urandom(14)
+    arena.create(big, cap // 2, evict_ok=True)
+    arena.seal(big)
+    assert arena.contains(a)      # recently used: survived
+    assert not arena.contains(b)  # LRU victim
+
+
+def test_pinned_objects_never_evicted(arena):
+    cap, _, _, _ = arena.stats()
+    pinned = os.urandom(14)
+    arena.create(pinned, cap // 2)
+    arena.seal(pinned)
+    arena.get(pinned)  # pin
+    with pytest.raises(ArenaFullError):
+        arena.create(os.urandom(14), int(cap * 0.8), evict_ok=True)
+    assert arena.contains(pinned)
+
+
+def test_cross_process_read_and_dead_pid_sweep(arena):
+    oid = os.urandom(14)
+    buf = arena.create(oid, 64)
+    buf[:5] = b"12345"
+    arena.seal(oid)
+    # Child attaches the existing arena, reads, pins, and exits without
+    # releasing — simulating a worker crash while holding a pin.
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from ray_tpu.native.store import NativeArena\n"
+        "a = NativeArena(%r, 0, create=False)\n"
+        "v = a.get(bytes.fromhex(%r))\n"
+        "assert bytes(v[:5]) == b'12345', bytes(v[:5])\n"
+        % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+           arena.path, oid.hex())
+    )
+    subprocess.run([sys.executable, "-c", code], check=True)
+    arena.delete(oid)              # deferred: dead child's pin remains
+    _, used, _, _ = arena.stats()
+    assert used > 0
+    arena.sweep([os.getpid()])     # reap dead pid's pins
+    _, used, _, _ = arena.stats()
+    assert used == 0
+
+
+def test_unsealed_object_of_dead_creator_swept(arena):
+    code = (
+        "import sys, os; sys.path.insert(0, %r)\n"
+        "from ray_tpu.native.store import NativeArena\n"
+        "a = NativeArena(%r, 0, create=False)\n"
+        "a.create(os.urandom(14), 1000)\n"  # never sealed
+        % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+           arena.path)
+    )
+    subprocess.run([sys.executable, "-c", code], check=True)
+    _, used, n, _ = arena.stats()
+    assert n == 1
+    arena.sweep([os.getpid()])
+    _, used, n, _ = arena.stats()
+    assert n == 0 and used == 0
+
+
+def test_store_integration_uses_native(tmp_path):
+    from ray_tpu.core.ids import ObjectID
+    from ray_tpu.core.object_store import ShmObjectStore
+
+    store = ShmObjectStore("natstore-test-%d" % os.getpid())
+    try:
+        assert store.native
+        oid = ObjectID.from_random()
+        seg = store.create(oid, 128)
+        seg.buf[:4] = b"abcd"
+        store.seal(oid)
+        seg2 = store.attach(oid, 128)
+        assert bytes(seg2.buf[:4]) == b"abcd"
+        cap, used, n, _ = store.stats()
+        assert n == 1 and used > 0 and cap > 0
+        store.delete(oid)
+        assert not store.contains(oid)
+    finally:
+        store.cleanup()
