@@ -184,10 +184,12 @@ class ShmObjectStore:
     def attach(self, object_id: ObjectID, size: int) -> ShmSegment:
         key = object_id.hex()
         with self._lock:
+            # cache check and pin happen under one lock hold so two racing
+            # threads can't both pin (the loser's pin would never be
+            # released)
             seg = self._open.get(key)
             if seg is not None:
                 return seg
-        with self._lock:
             if self._arena is not None:
                 import errno
 

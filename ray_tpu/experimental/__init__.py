@@ -1,0 +1,1 @@
+"""Experimental utilities (counterpart of the reference's ray.experimental)."""

@@ -1,0 +1,43 @@
+"""Cluster-global key/value store client.
+
+Capability counterpart of the reference's ray.experimental.internal_kv
+(python/ray/experimental/internal_kv.py) backed by the GCS InternalKV
+service (src/ray/gcs/gcs_server/gcs_kv_manager.h). Here the store lives in
+the control server's ``kv`` table (ray_tpu/core/gcs.py _op_kv_*).
+
+Values are arbitrary bytes (or picklable objects — the wire is pickle
+either way); keys are strings.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ray_tpu.core.runtime import get_runtime
+
+
+def _client():
+    return get_runtime().core.client
+
+
+def kv_put(key: str, value, overwrite: bool = True) -> bool:
+    """Store ``value`` under ``key``. Returns True if written."""
+    return _client().call(
+        {"op": "kv_put", "key": key, "value": value, "overwrite": overwrite})
+
+
+def kv_get(key: str):
+    """Return the value for ``key`` or None."""
+    return _client().call({"op": "kv_get", "key": key})
+
+
+def kv_del(key: str) -> bool:
+    return _client().call({"op": "kv_del", "key": key})
+
+
+def kv_keys(prefix: str = "") -> List[str]:
+    return _client().call({"op": "kv_keys", "prefix": prefix})
+
+
+def kv_exists(key: str) -> bool:
+    return _client().call({"op": "kv_exists", "key": key})

@@ -1,0 +1,331 @@
+"""Host-level collective communication groups.
+
+Capability counterpart of the reference's ray.util.collective
+(python/ray/util/collective/collective.py — GroupManager :40,
+init_collective_group :120, declarative create_collective_group :151,
+allreduce/allgather/reducescatter/broadcast/send/recv/barrier :258–615).
+
+TPU-native split (SURVEY.md §2.4): the reference's NCCL tier — collectives
+*between accelerator buffers* — does not exist on TPU as a separate
+runtime: intra-slice collectives compile into the XLA program over the ICI
+mesh (jax.lax.psum/all_gather/ppermute inside pjit — see
+ray_tpu.parallel). What remains host-side is the DCN/gloo tier: processes
+(actors, trainers, env-runners) exchanging host arrays across the cluster.
+That tier is implemented here on the framework's own substrate — the
+shared-memory object store for payloads and the GCS KV for rendezvous —
+rather than a third-party transport like pygloo.
+
+Every op is bulk-synchronous within the group: payload refs are published
+under a per-op sequence number, consumers poll the KV, and a trailing
+ack-barrier lets the producer's refs be dropped safely.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from enum import Enum
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu.core.runtime import get_runtime
+from ray_tpu.experimental import internal_kv
+
+
+class ReduceOp(Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
+    ReduceOp.PRODUCT: lambda arrs: np.prod(arrs, axis=0),
+    ReduceOp.MIN: lambda arrs: np.min(arrs, axis=0),
+    ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
+}
+
+_POLL_S = 0.002
+_DEFAULT_TIMEOUT_S = 60.0
+
+
+class CollectiveGroupError(RuntimeError):
+    pass
+
+
+class HostCollectiveGroup:
+    """One process's membership in a named collective group."""
+
+    def __init__(self, group_name: str, world_size: int, rank: int,
+                 timeout_s: float = _DEFAULT_TIMEOUT_S):
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} outside world_size {world_size}")
+        self.group_name = group_name
+        self.world_size = world_size
+        self.rank = rank
+        self.timeout_s = timeout_s
+        self._seq: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- plumbing --------------------------------------------------------
+    def _next_seq(self, kind: str) -> int:
+        with self._lock:
+            n = self._seq.get(kind, 0)
+            self._seq[kind] = n + 1
+        return n
+
+    def _key(self, kind: str, seq: int, *suffix) -> str:
+        parts = ["col", self.group_name, kind, str(seq)] + [str(s) for s in suffix]
+        return "/".join(parts)
+
+    def _publish(self, key: str, value: np.ndarray):
+        ref = get_runtime().put(np.asarray(value))
+        internal_kv.kv_put(key, (ref.hex(), ref.owner))
+        return ref  # caller must keep it alive until the op's ack barrier
+
+    def _fetch(self, key: str) -> np.ndarray:
+        deadline = time.monotonic() + self.timeout_s
+        while True:
+            entry = internal_kv.kv_get(key)
+            if entry is not None:
+                break
+            if time.monotonic() > deadline:
+                raise CollectiveGroupError(
+                    f"collective op timed out waiting for {key} "
+                    f"(group={self.group_name}, rank={self.rank})")
+            time.sleep(_POLL_S)
+        obj_hex, owner = entry
+        # Adopting a ref from the KV: register a borrow first, because the
+        # ObjectRef's GC hook will decref when it goes out of scope
+        # (reference borrowing protocol, reference_count.h).
+        rt = get_runtime()
+        rt.core.client.send({"op": "incref", "obj": obj_hex})
+        ref = ObjectRef(ObjectID.from_hex(obj_hex), owner=owner)
+        return rt.get([ref])[0]
+
+    def _ack_barrier(self, kind: str, seq: int):
+        """All ranks check in; returns when everyone has."""
+        internal_kv.kv_put(self._key(kind, seq, "ack", self.rank), 1)
+        deadline = time.monotonic() + self.timeout_s
+        for r in range(self.world_size):
+            key = self._key(kind, seq, "ack", r)
+            while not internal_kv.kv_exists(key):
+                if time.monotonic() > deadline:
+                    raise CollectiveGroupError(
+                        f"barrier timed out waiting for rank {r} "
+                        f"(group={self.group_name})")
+                time.sleep(_POLL_S)
+        # Lagged GC: everyone has passed seq, so nobody can still be
+        # polling seq-2 — rank 0 deletes those keys to bound KV growth.
+        if self.rank == 0 and seq >= 2:
+            stale = self._key(kind, seq - 2)
+            for k in internal_kv.kv_keys(stale + "/") + (
+                    [stale] if internal_kv.kv_exists(stale) else []):
+                internal_kv.kv_del(k)
+
+    # -- collective ops --------------------------------------------------
+    def barrier(self):
+        self._ack_barrier("barrier", self._next_seq("barrier"))
+
+    def allgather(self, array) -> List[np.ndarray]:
+        seq = self._next_seq("allgather")
+        ref = self._publish(self._key("allgather", seq, self.rank), array)
+        out = [self._fetch(self._key("allgather", seq, r))
+               for r in range(self.world_size)]
+        self._ack_barrier("allgather", seq)
+        del ref
+        return out
+
+    def allreduce(self, array, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        parts = self.allgather(array)
+        return _REDUCERS[op](np.stack([np.asarray(p) for p in parts]))
+
+    def reducescatter(self, array, op: ReduceOp = ReduceOp.SUM) -> np.ndarray:
+        """Reduce across ranks, then return this rank's 1/world_size shard
+        (leading axis must divide evenly)."""
+        reduced = self.allreduce(array, op)
+        n = reduced.shape[0]
+        if n % self.world_size != 0:
+            raise ValueError(
+                f"leading dim {n} not divisible by world_size "
+                f"{self.world_size}")
+        shard = n // self.world_size
+        return reduced[self.rank * shard:(self.rank + 1) * shard]
+
+    def broadcast(self, array, src_rank: int = 0) -> np.ndarray:
+        seq = self._next_seq("broadcast")
+        key = self._key("broadcast", seq, src_rank)
+        ref = None
+        if self.rank == src_rank:
+            ref = self._publish(key, array)
+            out = np.asarray(array)
+        else:
+            out = self._fetch(key)
+        self._ack_barrier("broadcast", seq)
+        del ref
+        return out
+
+    def send(self, array, dst_rank: int):
+        if dst_rank == self.rank:
+            raise ValueError("cannot send to self")
+        seq = self._next_seq(f"p2p-{self.rank}-{dst_rank}")
+        key = self._key(f"p2p-{self.rank}-{dst_rank}", seq)
+        ref = self._publish(key, array)  # noqa: F841 — held until ack
+        ack = key + "/recv-ack"
+        deadline = time.monotonic() + self.timeout_s
+        while not internal_kv.kv_exists(ack):
+            if time.monotonic() > deadline:
+                raise CollectiveGroupError(f"send not acked: {key}")
+            time.sleep(_POLL_S)
+        internal_kv.kv_del(key)
+        internal_kv.kv_del(ack)
+
+    def recv(self, src_rank: int) -> np.ndarray:
+        if src_rank == self.rank:
+            raise ValueError("cannot recv from self")
+        seq = self._next_seq(f"p2p-{src_rank}-{self.rank}")
+        key = self._key(f"p2p-{src_rank}-{self.rank}", seq)
+        out = self._fetch(key)
+        internal_kv.kv_put(key + "/recv-ack", 1)
+        return out
+
+
+class GroupManager:
+    """Per-process registry of collective groups (reference
+    collective.py:40)."""
+
+    def __init__(self):
+        self._groups: Dict[str, HostCollectiveGroup] = {}
+        self._lock = threading.Lock()
+
+    def create(self, group_name: str, world_size: int, rank: int,
+               timeout_s: float = _DEFAULT_TIMEOUT_S) -> HostCollectiveGroup:
+        with self._lock:
+            if group_name in self._groups:
+                raise CollectiveGroupError(
+                    f"group {group_name!r} already initialized in this "
+                    "process")
+            g = HostCollectiveGroup(group_name, world_size, rank, timeout_s)
+            self._groups[group_name] = g
+            return g
+
+    def get(self, group_name: str) -> Optional[HostCollectiveGroup]:
+        with self._lock:
+            g = self._groups.get(group_name)
+        if g is not None:
+            return g
+        # Declarative path: the group may have been declared cluster-wide
+        # (create_collective_group); resolve this process's rank lazily.
+        decl = internal_kv.kv_get(f"col-decl/{group_name}")
+        if decl is None:
+            return None
+        me = _self_actor_hex()
+        if me and me in decl["actor_ranks"]:
+            return self.create(group_name, decl["world_size"],
+                               decl["actor_ranks"][me])
+        return None
+
+    def destroy(self, group_name: str):
+        with self._lock:
+            self._groups.pop(group_name, None)
+
+
+_manager = GroupManager()
+
+
+def _self_actor_hex() -> str:
+    return getattr(get_runtime(), "_actor_hex", "")
+
+
+# -- module-level API (reference collective.py signatures) ---------------
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "host",
+                          group_name: str = "default") -> None:
+    """Initialize this process's membership in a collective group.
+
+    ``backend`` accepts "host" (the shm/DCN tier implemented here). The
+    reference's "nccl"/"gloo" names are accepted as aliases for
+    compatibility but run the same host backend — on TPU the accelerator
+    tier lives inside jitted programs (see module docstring).
+    """
+    if backend not in ("host", "nccl", "gloo"):
+        raise ValueError(f"unknown collective backend {backend!r}")
+    _manager.create(group_name, world_size, rank)
+
+
+def create_collective_group(actors: Sequence, world_size: int,
+                            ranks: Sequence[int],
+                            backend: str = "host",
+                            group_name: str = "default") -> None:
+    """Declarative setup from the driver (reference collective.py:151):
+    record the group membership; each actor joins lazily on first use."""
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError("actors/ranks must both have world_size entries")
+    actor_ranks = {a._actor_hex: r for a, r in zip(actors, ranks)}
+    internal_kv.kv_put(
+        f"col-decl/{group_name}",
+        {"world_size": world_size, "actor_ranks": actor_ranks,
+         "backend": backend})
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    return _manager.get(group_name) is not None
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _manager.destroy(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _require(group_name)
+    return g.rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _require(group_name)
+    return g.world_size
+
+
+def _require(group_name: str) -> HostCollectiveGroup:
+    g = _manager.get(group_name)
+    if g is None:
+        raise CollectiveGroupError(
+            f"collective group {group_name!r} is not initialized in this "
+            "process; call init_collective_group or "
+            "create_collective_group first")
+    return g
+
+
+def allreduce(array, group_name: str = "default",
+              op: ReduceOp = ReduceOp.SUM):
+    return _require(group_name).allreduce(array, op)
+
+
+def allgather(array, group_name: str = "default"):
+    return _require(group_name).allgather(array)
+
+
+def reducescatter(array, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM):
+    return _require(group_name).reducescatter(array, op)
+
+
+def broadcast(array, src_rank: int = 0, group_name: str = "default"):
+    return _require(group_name).broadcast(array, src_rank)
+
+
+def send(array, dst_rank: int, group_name: str = "default"):
+    return _require(group_name).send(array, dst_rank)
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    return _require(group_name).recv(src_rank)
+
+
+def barrier(group_name: str = "default"):
+    _require(group_name).barrier()

@@ -1,0 +1,52 @@
+"""End-to-end drive of the host collective API through the real runtime."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("RAY_TPU_CHIPS", "none")
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.util import collective
+
+
+@ray_tpu.remote(num_cpus=0.1)
+class Rank:
+    def __init__(self, ws, r):
+        collective.init_collective_group(ws, r, group_name="vg")
+        self.r = r
+
+    def run_all(self):
+        out = {}
+        out["allreduce"] = collective.allreduce(
+            np.full(4, self.r + 1, np.float32), group_name="vg").tolist()
+        out["bcast"] = float(collective.broadcast(
+            np.float32(self.r * 11), src_rank=2, group_name="vg"))
+        gathered = collective.allgather(
+            np.float32(self.r), group_name="vg")
+        out["gather"] = [float(x) for x in gathered]
+        collective.barrier(group_name="vg")
+        return out
+
+
+def main():
+    ray_tpu.init(num_cpus=4)
+    ranks = [Rank.remote(3, r) for r in range(3)]
+    outs = ray_tpu.get([r.run_all.remote() for r in ranks], timeout=60)
+    for o in outs:
+        assert o["allreduce"] == [6.0] * 4, o
+        assert o["bcast"] == 22.0, o
+        assert o["gather"] == [0.0, 1.0, 2.0], o
+    print("[1] allreduce/broadcast/allgather/barrier across 3 actors ok")
+    # second round: same group, sequence counters advance
+    outs = ray_tpu.get([r.run_all.remote() for r in ranks], timeout=60)
+    assert all(o["allreduce"] == [6.0] * 4 for o in outs)
+    print("[2] second round over same group ok")
+    print("COLLECTIVE DRIVE OK")
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -39,7 +39,7 @@
 namespace {
 
 constexpr uint64_t kMagic = 0x7470757374307265ULL;  // "tpust0re"
-constexpr uint32_t kVersion = 2;
+constexpr uint32_t kVersion = 3;
 constexpr uint64_t kAlign = 64;        // payload alignment (cache line)
 constexpr uint64_t kBlockHdr = 64;     // block header size, keeps data aligned
 constexpr int kRefSlots = 24;          // distinct pids pinning one object
@@ -58,7 +58,7 @@ struct Entry {
   uint8_t state;  // see ST_* below
   uint8_t pending_delete;
   uint16_t pad0;
-  uint32_t pad1;
+  uint32_t creator_pid;  // pid that created (and may seal) this entry
   uint64_t offset;  // payload offset from arena base
   uint64_t size;    // user payload size
   int64_t lru_prev; // entry index, -1 = none (head side = most recent)
@@ -103,6 +103,7 @@ struct Header {
   int64_t free_head;    // arena offset of first free block, -1 = none
   uint64_t evicted_bytes;
   uint64_t evict_count;
+  uint64_t tomb_count;   // ST_TOMB slots; rehash resets to 0
   pthread_mutex_t mu;
 };
 
@@ -305,6 +306,7 @@ void entry_clear(Store* s, int64_t idx) {
   memset(&e, 0, sizeof(Entry));
   e.state = ST_TOMB;
   s->hdr()->nobjects--;
+  s->hdr()->tomb_count++;
 }
 
 // Free an object's block and table entry. Caller holds lock.
@@ -312,6 +314,79 @@ void drop_object(Store* s, int64_t idx) {
   Entry& e = s->table()[idx];
   if (e.offset > 0) free_block(s, e.offset - kBlockHdr);
   entry_clear(s, idx);
+}
+
+// Rebuild the object table in place when tombstones dominate, restoring
+// O(1) miss lookups (open addressing never un-tombs otherwise). Caller
+// holds the lock. LRU order is preserved.
+void rehash_table(Store* s) {
+  Header* h = s->hdr();
+  Entry* t = s->table();
+  uint64_t cap = h->table_cap;
+
+  // snapshot live entries + the LRU order (as positions into the snapshot)
+  uint64_t nlive = 0;
+  for (uint64_t i = 0; i < cap; ++i) {
+    if (t[i].state >= ST_CREATED) nlive++;
+  }
+  Entry* live = new (std::nothrow) Entry[nlive ? nlive : 1];
+  int64_t* old_to_live = new (std::nothrow) int64_t[cap];
+  if (!live || !old_to_live) {  // allocation failed: skip, try next time
+    delete[] live;
+    delete[] old_to_live;
+    return;
+  }
+  uint64_t n = 0;
+  for (uint64_t i = 0; i < cap; ++i) {
+    old_to_live[i] = -1;
+    if (t[i].state >= ST_CREATED) {
+      live[n] = t[i];
+      old_to_live[i] = static_cast<int64_t>(n);
+      n++;
+    }
+  }
+  // LRU chain as snapshot positions, head first
+  int64_t* lru_order = new (std::nothrow) int64_t[nlive ? nlive : 1];
+  uint64_t nlru = 0;
+  if (lru_order) {
+    for (int64_t idx = h->lru_head; idx >= 0; idx = t[idx].lru_next) {
+      lru_order[nlru++] = old_to_live[idx];
+    }
+  }
+
+  // clear only previously-used slots (a full memset would commit every
+  // sparse page of the table)
+  for (uint64_t i = 0; i < cap; ++i) {
+    if (t[i].state != ST_EMPTY) memset(&t[i], 0, sizeof(Entry));
+  }
+  h->tomb_count = 0;
+  h->lru_head = h->lru_tail = -1;
+
+  // reinsert at canonical probe positions
+  int64_t* live_to_new = old_to_live;  // reuse allocation, reindexed by live pos
+  uint64_t mask = cap - 1;
+  for (uint64_t k = 0; k < n; ++k) {
+    uint64_t i = id_hash(live[k].id) & mask;
+    while (t[i].state != ST_EMPTY) i = (i + 1) & mask;
+    t[i] = live[k];
+    t[i].lru_prev = t[i].lru_next = -1;
+    live_to_new[k] = static_cast<int64_t>(i);
+  }
+  // rebuild LRU links in the preserved order (head = most recent): push
+  // back-to-front so lru_push_front reconstructs the original chain
+  if (lru_order) {
+    for (uint64_t k = nlru; k > 0; --k) {
+      lru_push_front(s, live_to_new[lru_order[k - 1]]);
+    }
+  }
+  delete[] live;
+  delete[] old_to_live;
+  delete[] lru_order;
+}
+
+void maybe_rehash(Store* s) {
+  Header* h = s->hdr();
+  if (h->tomb_count > h->table_cap / 2) rehash_table(s);
 }
 
 // Evict the single least-recently-used sealed, unpinned object.
@@ -374,9 +449,19 @@ void* tps_open(const char* path, uint64_t capacity, int create) {
   if (fd < 0) {
     fd = open(path, O_RDWR);
     if (fd < 0) return nullptr;
+    // The creator truncates right after its O_EXCL open; wait out the
+    // window where the file still has size 0 so concurrent openers don't
+    // fail mmap and silently fall back to a different store layout.
     struct stat st;
-    if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
-    capacity = static_cast<uint64_t>(st.st_size);
+    uint64_t sz = 0;
+    for (int i = 0; i < 100000; ++i) {
+      if (fstat(fd, &st) != 0) { close(fd); return nullptr; }
+      sz = static_cast<uint64_t>(st.st_size);
+      if (sz > 0) break;
+      usleep(100);
+    }
+    if (sz == 0) { errno = EPROTO; close(fd); return nullptr; }
+    capacity = sz;
   }
   void* base = mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   if (base == MAP_FAILED) { close(fd); return nullptr; }
@@ -497,6 +582,7 @@ int tps_create(void* handle, const uint8_t* id, uint64_t size,
   e.size = size;
   e.lru_prev = e.lru_next = -1;
   // pin for the creating process so the writer's buffer can't be evicted
+  e.creator_pid = static_cast<uint32_t>(getpid());
   e.refs[0].pid = static_cast<int32_t>(getpid());
   e.refs[0].count = 1;
   s->hdr()->nobjects++;
@@ -513,8 +599,15 @@ int tps_seal(void* handle, const uint8_t* id) {
   if (idx < 0) { unlock(s); return -ENOENT; }
   Entry& e = s->table()[idx];
   if (e.state == ST_SEALED) { unlock(s); return 0; }
-  e.state = ST_SEALED;
   int32_t me = static_cast<int32_t>(getpid());
+  if (e.creator_pid != static_cast<uint32_t>(me)) {
+    // The id was re-created by another process (task retry orphaned our
+    // entry): their in-flight object is not ours to publish. Our own
+    // write went to the orphaned buffer and is simply dropped.
+    unlock(s);
+    return 0;
+  }
+  e.state = ST_SEALED;
   for (int i = 0; i < kRefSlots; ++i) {
     if (e.refs[i].pid == me && e.refs[i].count > 0) {
       if (--e.refs[i].count == 0) e.refs[i].pid = 0;
@@ -566,8 +659,34 @@ int64_t tps_read(void* handle, const uint8_t* id, uint8_t* dest,
   }
   Entry& e = s->table()[idx];
   if (e.size > dest_len) { unlock(s); return -ERANGE; }
-  memcpy(dest, s->base + e.offset, e.size);
+  uint64_t off = e.offset;
   int64_t n = static_cast<int64_t>(e.size);
+  int32_t me = static_cast<int32_t>(getpid());
+  int slot = find_ref_slot(e, me);
+  if (slot >= 0) {
+    // pin, copy outside the lock (a multi-GB memcpy must not stall the
+    // whole node), then unpin
+    e.refs[slot].pid = me;
+    e.refs[slot].count++;
+    unlock(s);
+    memcpy(dest, s->base + off, static_cast<size_t>(n));
+    if (lock(s) != 0) return n;  // copied fine; pin swept later
+    int64_t idx2 = table_find(s, id, false);
+    if (idx2 >= 0) {
+      Entry& e2 = s->table()[idx2];
+      for (int i = 0; i < kRefSlots; ++i) {
+        if (e2.refs[i].pid == me && e2.refs[i].count > 0) {
+          if (--e2.refs[i].count == 0) e2.refs[i].pid = 0;
+          break;
+        }
+      }
+      if (e2.pending_delete && total_refs(e2) == 0) drop_object(s, idx2);
+    }
+    unlock(s);
+    return n;
+  }
+  // no slot free (the very case this fallback serves): copy under lock
+  memcpy(dest, s->base + off, static_cast<size_t>(n));
   unlock(s);
   return n;
 }
@@ -598,6 +717,7 @@ int tps_release(void* handle, const uint8_t* id) {
     }
   }
   if (e.pending_delete && total_refs(e) == 0) drop_object(s, idx);
+  maybe_rehash(s);
   unlock(s);
   return 0;
 }
@@ -612,6 +732,7 @@ int tps_delete(void* handle, const uint8_t* id) {
   Entry& e = s->table()[idx];
   if (total_refs(e) == 0) drop_object(s, idx);
   else e.pending_delete = 1;
+  maybe_rehash(s);
   unlock(s);
   return 0;
 }
@@ -655,6 +776,7 @@ int tps_sweep(void* handle, const int32_t* alive, int n_alive) {
       freed++;
     }
   }
+  maybe_rehash(s);
   unlock(s);
   return freed;
 }

@@ -190,3 +190,53 @@ def test_store_integration_uses_native(tmp_path):
         assert not store.contains(oid)
     finally:
         store.cleanup()
+
+
+def test_tombstone_rehash_keeps_table_fast_and_correct(arena):
+    # Churn enough objects to trip the tombstone-majority rehash several
+    # times; survivors must stay findable and LRU eviction order intact.
+    survivors = []
+    for round_ in range(3):
+        batch = [os.urandom(14) for _ in range(2000)]
+        for o in batch:
+            arena.create(o, 64)
+            arena.seal(o)
+        keep = batch[0]
+        survivors.append(keep)
+        for o in batch[1:]:
+            arena.delete(o)
+    for o in survivors:
+        assert arena.contains(o), "survivor lost across rehash"
+    _, _, n, _ = arena.stats()
+    assert n == len(survivors)
+
+
+def test_seal_by_non_creator_is_ignored(arena):
+    # A child re-creates an id whose first copy is pinned+deleted here; our
+    # subsequent seal must not publish the child's in-flight entry.
+    oid = os.urandom(14)
+    arena.create(oid, 32)
+    arena.seal(oid)
+    arena.get(oid)      # pin so delete defers
+    arena.delete(oid)
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from ray_tpu.native.store import NativeArena\n"
+        "a = NativeArena(%r, 0, create=False)\n"
+        "a.create(bytes.fromhex(%r), 32)\n"  # orphans ours; never sealed
+        % (os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+           arena.path, oid.hex())
+    )
+    subprocess.run([sys.executable, "-c", code], check=True)
+    arena.seal(oid)     # we are not the creator of the live entry: no-op
+    assert not arena.contains(oid)
+
+
+def test_read_copy_matches_payload(arena):
+    oid = os.urandom(14)
+    buf = arena.create(oid, 3 << 20)
+    payload = os.urandom(3 << 20)
+    buf[:] = payload
+    arena.seal(oid)
+    assert arena.read_copy(oid) == payload
+    assert arena.read_copy(os.urandom(14)) is None
