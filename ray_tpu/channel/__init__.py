@@ -1,0 +1,14 @@
+"""Mutable shared-memory channels for compiled DAG execution.
+
+Capability counterpart of the reference's ray.experimental.channel
+(python/ray/experimental/channel/shared_memory_channel.py and the C++
+mutable-object manager, core_worker/experimental_mutable_object_manager.cc).
+"""
+
+from ray_tpu.channel.shared_memory_channel import (
+    Channel,
+    ChannelClosedError,
+    ChannelTimeoutError,
+)
+
+__all__ = ["Channel", "ChannelClosedError", "ChannelTimeoutError"]

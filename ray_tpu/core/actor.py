@@ -39,6 +39,13 @@ class ActorMethod:
     def options(self, num_returns: int = 1):
         return ActorMethod(self._handle, self._method_name, num_returns)
 
+    def bind(self, *args, **kwargs):
+        """Author a DAG node for this method call (reference
+        class_node.py; see ray_tpu.dag)."""
+        from ray_tpu.dag.dag_node import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._method_name, args, kwargs)
+
 
 class ActorHandle:
     def __init__(self, actor_hex: str, class_name: str = ""):

@@ -71,6 +71,13 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Author a DAG node for this task (reference function_node.py;
+        see ray_tpu.dag)."""
+        from ray_tpu.dag.dag_node import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def options(self, **overrides):
         """Return a copy with overridden submit options."""
         opts = {

@@ -279,6 +279,16 @@ class WorkerRuntime:
                 self._store_returns(spec, None, failed=False)
                 self._on_exit()
                 return
+            if method_name == "__ray_tpu_compiled_loop__":
+                # compiled-DAG pin: run the resident stage loop (blocks this
+                # actor thread until the DAG is torn down)
+                from ray_tpu.dag.compiled_dag import run_actor_loop
+
+                inst = self._actor_instance
+                self._execute(
+                    spec,
+                    target_fn=lambda desc: run_actor_loop(inst, desc))
+                continue
             try:
                 method = getattr(self._actor_instance, method_name)
             except AttributeError as e:

@@ -1,0 +1,23 @@
+"""DAG authoring + compiled execution.
+
+Capability counterpart of the reference's ray.dag (python/ray/dag/):
+``.bind()`` builds a static graph of function / actor-method nodes;
+``.execute()`` interprets it through normal task submission;
+``.experimental_compile()`` lowers actor-method graphs onto pinned actor
+loops connected by mutable shared-memory channels (ray_tpu.channel) — the
+low-latency pipeline path (vLLM-style stage handoff in the reference,
+compiled_dag_node.py:390).
+"""
+
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "DAGNode", "InputNode", "FunctionNode", "ClassMethodNode",
+    "MultiOutputNode",
+]

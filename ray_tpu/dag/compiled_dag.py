@@ -1,0 +1,317 @@
+"""Compiled DAG execution over mutable shm channels.
+
+Reference counterpart: python/ray/dag/compiled_dag_node.py (CompiledDAG
+:390) — a static graph of actor-method calls is pinned onto its actors:
+each actor runs a resident loop (read input channels → call method →
+write output channel) and stage handoff happens through
+ray_tpu.channel.Channel without touching the scheduler or object
+directory. Successive ``execute()`` calls pipeline: stage i works on item
+k while stage i+1 works on item k-1 (single-slot channel backpressure).
+
+TPU framing: stages are host-level units (e.g. one model shard's jitted
+step per actor); what flows through channels is host data or spilled
+object refs. On-device stage handoff inside one program belongs to XLA
+(ppermute/donation), not channels.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.channel import Channel, ChannelClosedError
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    DAGNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+_LOOP_METHOD = "__ray_tpu_compiled_loop__"
+
+
+class CompiledDAGRef:
+    """Handle to one in-flight execution's outputs (reference
+    CompiledDAGRef). ``get()`` blocks on the output channels."""
+
+    def __init__(self, dag: "CompiledDAG", idx: int):
+        self._dag = dag
+        self._idx = idx
+        self._value = None
+        self._done = False
+
+    def get(self, timeout: Optional[float] = None):
+        return self._dag._fetch_result(self, timeout)
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, buffer_size_bytes: int = 1 << 20):
+        self._root = root
+        self._buffer = buffer_size_bytes
+        self._nodes = root._toposort()
+        self._torn_down = False
+        self._exec_count = 0
+        self._next_result = 0
+        self._results: Dict[int, Any] = {}
+        self._results_cv = None  # set in _compile
+
+        self._input_node = None
+        multi = isinstance(root, MultiOutputNode)
+        self._output_nodes = root._outputs if multi else [root]
+        self._multi = multi
+
+        actor_nodes: List[ClassMethodNode] = []
+        for n in self._nodes:
+            if isinstance(n, InputNode):
+                if self._input_node is not None and n is not self._input_node:
+                    raise ValueError("compiled DAGs take exactly one InputNode")
+                self._input_node = n
+            elif isinstance(n, ClassMethodNode):
+                actor_nodes.append(n)
+            elif isinstance(n, MultiOutputNode):
+                if n is not root:
+                    raise ValueError(
+                        "MultiOutputNode must be the terminal node")
+            else:
+                raise ValueError(
+                    f"compiled DAGs support actor-method nodes only, got "
+                    f"{type(n).__name__} (use .execute() for interpreted "
+                    "graphs)")
+        if self._input_node is None:
+            raise ValueError("compiled DAG needs an InputNode")
+        for out in self._output_nodes:
+            if not isinstance(out, ClassMethodNode):
+                raise ValueError("DAG outputs must be actor-method nodes")
+
+        self._compile(actor_nodes)
+
+    # ------------------------------------------------------------------
+    def _compile(self, actor_nodes: List[ClassMethodNode]):
+        from ray_tpu.core.actor import ActorMethod
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        core = getattr(rt, "core", rt)
+        shm_dir = core.store.shm_dir
+        session = core.session_id
+        tag = uuid.uuid4().hex[:8]
+
+        # consumers per producer node (driver counts as a consumer of
+        # every output node)
+        consumers: Dict[int, List] = {}
+        for n in actor_nodes:
+            for u in n._upstream():
+                consumers.setdefault(u._uid, []).append(n)
+        for out in self._output_nodes:
+            consumers.setdefault(out._uid, []).append("driver")
+
+        def make_channel(producer_uid: int) -> Channel:
+            path = os.path.join(
+                shm_dir,
+                f"raytpu-{session}-chan-{tag}-{producer_uid}")
+            return Channel(path, capacity=self._buffer,
+                           num_readers=len(consumers[producer_uid]),
+                           create=True)
+
+        # one output channel per producer that has consumers
+        self._channels: Dict[int, Channel] = {
+            uid: make_channel(uid) for uid in consumers
+        }
+        # reader index assignment per (producer, consumer)
+        reader_idx: Dict[tuple, int] = {}
+        for uid, cons in consumers.items():
+            for i, c in enumerate(cons):
+                key = (uid, "driver" if c == "driver" else c._uid)
+                reader_idx[key] = i
+
+        # driver endpoints
+        self._input_writer = self._channels[self._input_node._uid]
+        self._output_readers = [
+            Channel(self._channels[out._uid].path,
+                    reader_idx=reader_idx[(out._uid, "driver")])
+            for out in self._output_nodes
+        ]
+
+        # Collector: drain output channels continuously so a deep pipeline
+        # of execute() calls never stalls on the single-slot driver-facing
+        # channels (the reference buffers results the same way when the
+        # caller hasn't consumed them yet).
+        import threading
+
+        self._results_cv = threading.Condition()
+        self._collector_err = None
+
+        def collect():
+            while True:
+                try:
+                    outs = [r.read() for r in self._output_readers]
+                except ChannelClosedError:
+                    with self._results_cv:
+                        self._results_cv.notify_all()
+                    return
+                except Exception as e:  # noqa: BLE001
+                    with self._results_cv:
+                        self._collector_err = e
+                        self._results_cv.notify_all()
+                    return
+                value = outs if self._multi else outs[0]
+                with self._results_cv:
+                    self._results[self._next_result] = value
+                    self._next_result += 1
+                    self._results_cv.notify_all()
+
+        self._collector = threading.Thread(
+            target=collect, daemon=True, name="dag-collector")
+
+        # pin each actor with its loop descriptor
+        self._loop_refs = []
+        self._actors = []
+        for n in actor_nodes:
+            arg_template = []
+            for a in n._bound_args:
+                if isinstance(a, DAGNode):
+                    arg_template.append(
+                        ("chan", Channel(self._channels[a._uid].path,
+                                         reader_idx=reader_idx[
+                                             (a._uid, n._uid)])))
+                else:
+                    arg_template.append(("const", a))
+            kwarg_template = {}
+            for k, v in n._bound_kwargs.items():
+                if isinstance(v, DAGNode):
+                    kwarg_template[k] = (
+                        "chan", Channel(self._channels[v._uid].path,
+                                        reader_idx=reader_idx[
+                                            (v._uid, n._uid)]))
+                else:
+                    kwarg_template[k] = ("const", v)
+            desc = {
+                "method": n._method_name,
+                "args": arg_template,
+                "kwargs": kwarg_template,
+                "output": Channel(self._channels[n._uid].path)
+                if n._uid in self._channels else None,
+            }
+            self._actors.append(n._actor)
+            self._loop_refs.append(
+                ActorMethod(n._actor, _LOOP_METHOD).remote(desc))
+        self._collector.start()
+
+    # ------------------------------------------------------------------
+    def execute(self, *args) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("compiled DAG has been torn down")
+        value = args[0] if len(args) == 1 else args
+        self._input_writer.write(value)
+        ref = CompiledDAGRef(self, self._exec_count)
+        self._exec_count += 1
+        return ref
+
+    def _fetch_result(self, ref: CompiledDAGRef, timeout: Optional[float]):
+        import time as _time
+
+        if ref._done:
+            return ref._value
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        with self._results_cv:
+            while ref._idx not in self._results:
+                if self._collector_err is not None:
+                    raise self._collector_err
+                if self._torn_down:
+                    raise RuntimeError("compiled DAG has been torn down")
+                remaining = None if deadline is None else \
+                    deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"compiled DAG result {ref._idx} not ready")
+                self._results_cv.wait(remaining)
+            ref._value = self._results.pop(ref._idx)
+        ref._done = True
+        errs = ref._value if isinstance(ref._value, list) else [ref._value]
+        for v in errs:
+            if isinstance(v, DagExecutionError):
+                v.raise_()
+        return ref._value
+
+    def teardown(self):
+        """Unpin the actors and destroy the channels."""
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for ch in self._channels.values():
+            ch.close()
+        for r in self._output_readers:
+            r.close()
+        if self._results_cv is not None:
+            with self._results_cv:
+                self._results_cv.notify_all()
+        # wait for loops to exit so actors accept regular tasks again
+        from ray_tpu.core import api
+
+        try:
+            api.get(self._loop_refs, timeout=5.0)
+        except Exception:
+            pass
+        for ch in self._channels.values():
+            ch.destroy()
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+class DagExecutionError:
+    """Error envelope forwarded through channels so a failing stage
+    surfaces at the driver instead of wedging the pipeline (reference:
+    RayTaskError propagation through CompiledDAGRef)."""
+
+    def __init__(self, stage: str, tb: str):
+        self.stage = stage
+        self.traceback_str = tb
+
+    def raise_(self):
+        from ray_tpu.core.exceptions import TaskError
+
+        err = TaskError(self.stage, None, tb=self.traceback_str)
+        raise err
+
+
+def run_actor_loop(instance, desc: dict) -> int:
+    """Resident stage loop executed inside the actor (worker hook
+    dispatches the special method name). Returns iterations completed."""
+    import traceback
+
+    method = getattr(instance, desc["method"])
+    out: Optional[Channel] = desc["output"]
+    count = 0
+    while True:
+        try:
+            args = [
+                v.read() if kind == "chan" else v
+                for kind, v in desc["args"]
+            ]
+            kwargs = {
+                k: (v.read() if kind == "chan" else v)
+                for k, (kind, v) in desc["kwargs"].items()
+            }
+            upstream_err = next(
+                (a for a in args if isinstance(a, DagExecutionError)), None
+            ) or next(
+                (v for v in kwargs.values()
+                 if isinstance(v, DagExecutionError)), None)
+            if upstream_err is not None:
+                result = upstream_err  # forward, don't execute
+            else:
+                try:
+                    result = method(*args, **kwargs)
+                except Exception:  # noqa: BLE001
+                    result = DagExecutionError(
+                        desc["method"], traceback.format_exc())
+            if out is not None:
+                out.write(result)
+            count += 1
+        except ChannelClosedError:
+            return count

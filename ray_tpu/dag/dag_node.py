@@ -1,0 +1,154 @@
+"""DAG node types and the interpreted execution path.
+
+Reference counterparts: python/ray/dag/dag_node.py (DAGNode, execute,
+experimental_compile :129), function_node.py, class_node.py,
+input_node.py, output_node.py. Binding is triggered from
+``RemoteFunction.bind`` / ``ActorMethod.bind`` (ray_tpu/core APIs).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional
+
+_node_counter = itertools.count()
+
+
+class DAGNode:
+    """Base: a node in a static task graph."""
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+        self._uid = next(_node_counter)
+
+    # -- graph helpers ---------------------------------------------------
+    def _upstream(self) -> List["DAGNode"]:
+        ups = [a for a in self._bound_args if isinstance(a, DAGNode)]
+        ups += [v for v in self._bound_kwargs.values()
+                if isinstance(v, DAGNode)]
+        return ups
+
+    def _toposort(self) -> List["DAGNode"]:
+        order: List[DAGNode] = []
+        seen = set()
+
+        def visit(n: DAGNode):
+            if n._uid in seen:
+                return
+            seen.add(n._uid)
+            for u in n._upstream():
+                visit(u)
+            order.append(n)
+
+        visit(self)
+        return order
+
+    # -- interpreted execution ------------------------------------------
+    def execute(self, *input_args, _timeout: Optional[float] = None):
+        """Run the graph through normal task/actor submission and return
+        the result (reference dag_node.py execute)."""
+        from ray_tpu.core import api
+
+        cache: Dict[int, Any] = {}
+        order = self._toposort()
+        for node in order:
+            cache[node._uid] = node._exec_one(cache, input_args)
+        out = cache[self._uid]
+        if isinstance(self, MultiOutputNode):
+            return api.get(out, timeout=_timeout)
+        return api.get([out], timeout=_timeout)[0] \
+            if _is_ref(out) else out
+
+    def _resolve(self, v, cache, input_args):
+        if isinstance(v, DAGNode):
+            return cache[v._uid]
+        return v
+
+    def _exec_one(self, cache, input_args):
+        raise NotImplementedError
+
+    # -- compiled execution ---------------------------------------------
+    def experimental_compile(self, buffer_size_bytes: int = 1 << 20):
+        from ray_tpu.dag.compiled_dag import CompiledDAG
+
+        return CompiledDAG(self, buffer_size_bytes=buffer_size_bytes)
+
+
+def _is_ref(v) -> bool:
+    from ray_tpu.core.object_ref import ObjectRef
+
+    return isinstance(v, ObjectRef)
+
+
+class InputNode(DAGNode):
+    """Placeholder for the driver-provided input (reference
+    input_node.py). Supports ``with InputNode() as inp:`` authoring."""
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _exec_one(self, cache, input_args):
+        if len(input_args) == 1:
+            return input_args[0]
+        return input_args
+
+
+class FunctionNode(DAGNode):
+    """A bound @remote function call (reference function_node.py)."""
+
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _exec_one(self, cache, input_args):
+        from ray_tpu.core import api
+
+        args = [self._materialize(self._resolve(a, cache, input_args))
+                for a in self._bound_args]
+        kwargs = {k: self._materialize(self._resolve(v, cache, input_args))
+                  for k, v in self._bound_kwargs.items()}
+        return self._remote_fn.remote(*args, **kwargs)
+
+    @staticmethod
+    def _materialize(v):
+        # upstream results may be ObjectRefs; pass them through (the task
+        # arg resolver fetches them) — plain values pass unchanged
+        return v
+
+
+class ClassMethodNode(DAGNode):
+    """A bound actor method call (reference class_node.py)."""
+
+    def __init__(self, actor_handle, method_name: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor = actor_handle
+        self._method_name = method_name
+
+    def _exec_one(self, cache, input_args):
+        from ray_tpu.core import api
+
+        args = [self._resolve(a, cache, input_args)
+                for a in self._bound_args]
+        kwargs = {k: self._resolve(v, cache, input_args)
+                  for k, v in self._bound_kwargs.items()}
+        method = getattr(self._actor, self._method_name)
+        return method.remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    """Terminal node aggregating several outputs (reference
+    output_node.py)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(tuple(outputs), {})
+        self._outputs = list(outputs)
+
+    def _exec_one(self, cache, input_args):
+        return [cache[o._uid] for o in self._outputs]

@@ -133,8 +133,10 @@ class HostCollectiveGroup:
 
     def allgather(self, array) -> List[np.ndarray]:
         seq = self._next_seq("allgather")
-        ref = self._publish(self._key("allgather", seq, self.rank), array)
-        out = [self._fetch(self._key("allgather", seq, r))
+        local = np.asarray(array)
+        ref = self._publish(self._key("allgather", seq, self.rank), local)
+        out = [local if r == self.rank
+               else self._fetch(self._key("allgather", seq, r))
                for r in range(self.world_size)]
         self._ack_barrier("allgather", seq)
         del ref
@@ -278,7 +280,17 @@ def is_group_initialized(group_name: str = "default") -> bool:
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
+    """Tear down this process's membership AND the cluster-wide state
+    (declarative decl + any leftover rendezvous/payload keys), so a
+    destroyed group can't lazily resurrect or collide with a re-created
+    one's restarted sequence numbers."""
     _manager.destroy(group_name)
+    try:
+        internal_kv.kv_del(f"col-decl/{group_name}")
+        for k in internal_kv.kv_keys(f"col/{group_name}/"):
+            internal_kv.kv_del(k)
+    except Exception:
+        pass  # best effort: runtime may already be shut down
 
 
 def get_rank(group_name: str = "default") -> int:

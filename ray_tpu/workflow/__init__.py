@@ -1,0 +1,31 @@
+"""Durable workflow execution.
+
+Capability counterpart of the reference's ray.workflow (python/ray/workflow/,
+SURVEY.md P23): a task DAG (authored with ``.bind()``, ray_tpu.dag) runs
+with every step's result checkpointed to persistent storage
+(workflow_storage.py counterpart), so a failed/interrupted workflow resumes
+from the last completed step instead of recomputing. Management runs in a
+named actor (workflow_access.py counterpart) so workflows outlive the
+submitting driver's call stack.
+
+API: run / run_async / resume / resume_async / get_status / get_output /
+list_all / cancel / delete — matching python/ray/workflow/api.py.
+"""
+
+from ray_tpu.workflow.api import (
+    WorkflowStatus,
+    cancel,
+    delete,
+    get_output,
+    get_status,
+    list_all,
+    resume,
+    resume_async,
+    run,
+    run_async,
+)
+
+__all__ = [
+    "WorkflowStatus", "run", "run_async", "resume", "resume_async",
+    "get_status", "get_output", "list_all", "cancel", "delete",
+]

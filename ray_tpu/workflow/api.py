@@ -1,0 +1,224 @@
+"""Workflow public API + management actor.
+
+Reference counterparts: python/ray/workflow/api.py (run/run_async/resume/
+get_status/get_output/list_all/cancel/delete) and workflow_access.py (the
+WorkflowManagementActor that owns running workflows). The management actor
+is a named actor so any driver in the cluster can query or resume
+workflows; durability across *cluster* restarts comes from storage — the
+serialized DAG and step checkpoints are on disk, so ``resume`` works in a
+fresh cluster too.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+import uuid
+from enum import Enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.workflow.executor import WorkflowCancelled, WorkflowExecutor
+from ray_tpu.workflow.storage import WorkflowStorage, storage_root
+
+_MANAGER_NAME = "__workflow_manager__"
+
+
+class WorkflowStatus(str, Enum):
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    CANCELED = "CANCELED"
+    RESUMABLE = "RESUMABLE"
+
+
+class _WorkflowManager:
+    """Actor owning workflow execution threads (workflow_access.py)."""
+
+    def __init__(self):
+        self._executors: Dict[str, WorkflowExecutor] = {}
+        self._threads: Dict[str, threading.Thread] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, workflow_id: str, dag, workflow_input,
+               root: Optional[str] = None) -> str:
+        storage = WorkflowStorage(workflow_id, root)
+        storage.save_dag((dag, workflow_input))
+        storage.save_meta({
+            "status": WorkflowStatus.RUNNING.value,
+            "created_at": time.time(),
+        })
+        return self._start(workflow_id, dag, workflow_input, storage)
+
+    def resume(self, workflow_id: str, root: Optional[str] = None) -> str:
+        storage = WorkflowStorage(workflow_id, root)
+        meta = storage.load_meta()
+        if meta is None:
+            raise ValueError(f"no workflow {workflow_id!r} in storage")
+        with self._lock:
+            if workflow_id in self._threads and \
+                    self._threads[workflow_id].is_alive():
+                return workflow_id  # already running
+        dag, workflow_input = storage.load_dag()
+        storage.save_meta({**meta, "status": WorkflowStatus.RUNNING.value})
+        return self._start(workflow_id, dag, workflow_input, storage)
+
+    def _start(self, workflow_id, dag, workflow_input, storage) -> str:
+        ex = WorkflowExecutor(workflow_id, storage)
+
+        def runner():
+            meta = storage.load_meta() or {}
+            try:
+                ex.run(dag, workflow_input)
+                meta["status"] = WorkflowStatus.SUCCESSFUL.value
+            except WorkflowCancelled:
+                meta["status"] = WorkflowStatus.CANCELED.value
+            except BaseException:  # noqa: BLE001
+                meta["status"] = WorkflowStatus.FAILED.value
+                meta["error"] = traceback.format_exc()[-4000:]
+            storage.save_meta(meta)
+
+        t = threading.Thread(target=runner, daemon=True,
+                             name=f"workflow-{workflow_id}")
+        with self._lock:
+            self._executors[workflow_id] = ex
+            self._threads[workflow_id] = t
+        t.start()
+        return workflow_id
+
+    def wait(self, workflow_id: str, timeout: Optional[float] = None,
+             root: Optional[str] = None) -> Tuple[str, Optional[str]]:
+        with self._lock:
+            t = self._threads.get(workflow_id)
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                return WorkflowStatus.RUNNING.value, None
+        meta = WorkflowStorage(workflow_id, root).load_meta() or {}
+        return meta.get("status", WorkflowStatus.RESUMABLE.value), \
+            meta.get("error")
+
+    def get_status(self, workflow_id: str,
+                   root: Optional[str] = None) -> str:
+        with self._lock:
+            t = self._threads.get(workflow_id)
+            if t is not None and t.is_alive():
+                return WorkflowStatus.RUNNING.value
+        meta = WorkflowStorage(workflow_id, root).load_meta()
+        if meta is None:
+            raise ValueError(f"no workflow {workflow_id!r}")
+        status = meta.get("status", WorkflowStatus.RESUMABLE.value)
+        if status == WorkflowStatus.RUNNING.value:
+            # recorded RUNNING but no live thread: interrupted -> resumable
+            return WorkflowStatus.RESUMABLE.value
+        return status
+
+    def cancel(self, workflow_id: str):
+        with self._lock:
+            ex = self._executors.get(workflow_id)
+        if ex is not None:
+            ex.cancel_ev.set()
+
+    def get_output(self, workflow_id: str, root: Optional[str] = None):
+        status, err = self.wait(workflow_id, root=root)
+        storage = WorkflowStorage(workflow_id, root)
+        if status == WorkflowStatus.SUCCESSFUL.value:
+            return ("ok", storage.load_result())
+        return ("err", f"workflow {workflow_id} status={status}: "
+                       f"{err or ''}")
+
+
+def _manager():
+    import ray_tpu
+    from ray_tpu.core.exceptions import RayTpuError
+
+    try:
+        return ray_tpu.get_actor(_MANAGER_NAME)
+    except (ValueError, RayTpuError):
+        cls = ray_tpu.remote(num_cpus=0.01)(_WorkflowManager)
+        try:
+            return cls.options(name=_MANAGER_NAME).remote()
+        except ValueError:
+            return ray_tpu.get_actor(_MANAGER_NAME)  # lost the create race
+
+
+# -- public API -------------------------------------------------------------
+
+def run_async(dag, workflow_id: Optional[str] = None,
+              workflow_input: Any = None) -> str:
+    """Start a workflow; returns its workflow_id immediately."""
+    import ray_tpu
+
+    wid = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
+    mgr = _manager()
+    ray_tpu.get([mgr.submit.remote(wid, dag, workflow_input,
+                                   storage_root())])
+    return wid
+
+
+def run(dag, workflow_id: Optional[str] = None, workflow_input: Any = None,
+        timeout: Optional[float] = None) -> Any:
+    """Run a workflow to completion and return its result."""
+    wid = run_async(dag, workflow_id, workflow_input)
+    return get_output(wid, timeout=timeout)
+
+
+def resume_async(workflow_id: str) -> str:
+    import ray_tpu
+
+    mgr = _manager()
+    ray_tpu.get([mgr.resume.remote(workflow_id, storage_root())])
+    return workflow_id
+
+
+def resume(workflow_id: str, timeout: Optional[float] = None) -> Any:
+    resume_async(workflow_id)
+    return get_output(workflow_id, timeout=timeout)
+
+
+def get_output(workflow_id: str, timeout: Optional[float] = None) -> Any:
+    import ray_tpu
+
+    mgr = _manager()
+    status, payload = ray_tpu.get(
+        [mgr.get_output.remote(workflow_id, storage_root())],
+        timeout=timeout)[0]
+    if status == "ok":
+        return payload
+    raise RuntimeError(payload)
+
+
+def get_status(workflow_id: str) -> WorkflowStatus:
+    import ray_tpu
+    from ray_tpu.core.exceptions import TaskError
+
+    mgr = _manager()
+    try:
+        return WorkflowStatus(
+            ray_tpu.get([mgr.get_status.remote(workflow_id,
+                                               storage_root())])[0])
+    except TaskError as e:
+        if isinstance(e.cause, ValueError) or "no workflow" in str(e):
+            raise ValueError(f"no workflow {workflow_id!r}") from None
+        raise
+
+
+def list_all() -> List[Tuple[str, WorkflowStatus]]:
+    out = []
+    for wid in WorkflowStorage.list_workflows():
+        try:
+            out.append((wid, get_status(wid)))
+        except ValueError:
+            continue
+    return out
+
+
+def cancel(workflow_id: str):
+    import ray_tpu
+
+    mgr = _manager()
+    ray_tpu.get([mgr.cancel.remote(workflow_id)])
+
+
+def delete(workflow_id: str):
+    WorkflowStorage(workflow_id).delete()

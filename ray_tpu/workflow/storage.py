@@ -1,0 +1,102 @@
+"""Filesystem-backed workflow storage.
+
+Reference counterpart: python/ray/workflow/workflow_storage.py — step
+results, workflow metadata and the serialized DAG persist under a storage
+root that outlives the cluster session. Any shared filesystem path works
+(NFS/GCS-fuse on a TPU pod); default is a local directory overridable via
+``RAY_TPU_WORKFLOW_STORAGE``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, List, Optional
+
+import cloudpickle
+
+
+def storage_root() -> str:
+    return os.environ.get(
+        "RAY_TPU_WORKFLOW_STORAGE",
+        os.path.join(tempfile.gettempdir(), "ray_tpu", "workflows"))
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str, root: Optional[str] = None):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(root or storage_root(), workflow_id)
+        self.steps_dir = os.path.join(self.dir, "steps")
+        os.makedirs(self.steps_dir, exist_ok=True)
+
+    # -- atomic file helpers --------------------------------------------
+    def _write_atomic(self, path: str, data: bytes):
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    # -- metadata --------------------------------------------------------
+    def save_meta(self, meta: dict):
+        self._write_atomic(
+            os.path.join(self.dir, "meta.json"),
+            json.dumps(meta).encode())
+
+    def load_meta(self) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.dir, "meta.json"), "rb") as f:
+                return json.loads(f.read())
+        except FileNotFoundError:
+            return None
+
+    def save_dag(self, dag):
+        self._write_atomic(
+            os.path.join(self.dir, "dag.pkl"), cloudpickle.dumps(dag))
+
+    def load_dag(self):
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    # -- step checkpoints ------------------------------------------------
+    def _step_path(self, key: str) -> str:
+        return os.path.join(self.steps_dir, f"{key}.pkl")
+
+    def has_step(self, key: str) -> bool:
+        return os.path.exists(self._step_path(key))
+
+    def save_step(self, key: str, value: Any):
+        self._write_atomic(self._step_path(key), cloudpickle.dumps(value))
+
+    def load_step(self, key: str) -> Any:
+        with open(self._step_path(key), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    # -- result ----------------------------------------------------------
+    def save_result(self, value: Any):
+        self._write_atomic(
+            os.path.join(self.dir, "result.pkl"), cloudpickle.dumps(value))
+
+    def load_result(self) -> Any:
+        with open(os.path.join(self.dir, "result.pkl"), "rb") as f:
+            return cloudpickle.loads(f.read())
+
+    def has_result(self) -> bool:
+        return os.path.exists(os.path.join(self.dir, "result.pkl"))
+
+    def delete(self):
+        import shutil
+
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+    @staticmethod
+    def list_workflows(root: Optional[str] = None) -> List[str]:
+        base = root or storage_root()
+        try:
+            return sorted(
+                d for d in os.listdir(base)
+                if os.path.isdir(os.path.join(base, d)))
+        except FileNotFoundError:
+            return []

@@ -316,6 +316,35 @@ void drop_object(Store* s, int64_t idx) {
   entry_clear(s, idx);
 }
 
+// Find an orphaned incarnation of `id` (linear scan; orphans are rare
+// and unfindable via probing by design). Returns index or -1.
+int64_t find_orphan(Store* s, const uint8_t* id) {
+  Header* h = s->hdr();
+  Entry* t = s->table();
+  for (uint64_t i = 0; i < h->table_cap; ++i) {
+    if (t[i].state == ST_ORPHAN && memcmp(t[i].id, id, kIdLen) == 0) {
+      return static_cast<int64_t>(i);
+    }
+  }
+  return -1;
+}
+
+// Drop one pin held by `pid` on entry idx; applies a deferred/orphan free
+// if that was the last pin. Caller holds the lock.
+void drop_pin(Store* s, int64_t idx, int32_t pid) {
+  Entry& e = s->table()[idx];
+  for (int i = 0; i < kRefSlots; ++i) {
+    if (e.refs[i].pid == pid && e.refs[i].count > 0) {
+      if (--e.refs[i].count == 0) e.refs[i].pid = 0;
+      break;
+    }
+  }
+  if (total_refs(e) == 0 &&
+      (e.pending_delete || e.state == ST_ORPHAN)) {
+    drop_object(s, idx);
+  }
+}
+
 // Rebuild the object table in place when tombstones dominate, restoring
 // O(1) miss lookups (open addressing never un-tombs otherwise). Caller
 // holds the lock. LRU order is preserved.
@@ -323,6 +352,7 @@ void rehash_table(Store* s) {
   Header* h = s->hdr();
   Entry* t = s->table();
   uint64_t cap = h->table_cap;
+  if (h->nobjects >= cap) return;  // no empty slot to reinsert into
 
   // snapshot live entries + the LRU order (as positions into the snapshot)
   uint64_t nlive = 0;
@@ -564,6 +594,7 @@ int tps_create(void* handle, const uint8_t* id, uint64_t size,
   }
   int64_t idx = table_find(s, id, true);
   if (idx < 0) { unlock(s); return -ENOSPC; }
+  if (s->table()[idx].state == ST_TOMB) s->hdr()->tomb_count--;
 
   uint64_t block = alloc_block(s, size);
   while (block == 0 && evict_ok) {
@@ -602,8 +633,13 @@ int tps_seal(void* handle, const uint8_t* id) {
   int32_t me = static_cast<int32_t>(getpid());
   if (e.creator_pid != static_cast<uint32_t>(me)) {
     // The id was re-created by another process (task retry orphaned our
-    // entry): their in-flight object is not ours to publish. Our own
-    // write went to the orphaned buffer and is simply dropped.
+    // entry): their in-flight object is not ours to publish. Drop our
+    // creation pin on the orphaned incarnation so its block can free.
+    int64_t orphan = find_orphan(s, id);
+    if (orphan >= 0 &&
+        s->table()[orphan].creator_pid == static_cast<uint32_t>(me)) {
+      drop_pin(s, orphan, me);
+    }
     unlock(s);
     return 0;
   }
@@ -672,15 +708,16 @@ int64_t tps_read(void* handle, const uint8_t* id, uint8_t* dest,
     memcpy(dest, s->base + off, static_cast<size_t>(n));
     if (lock(s) != 0) return n;  // copied fine; pin swept later
     int64_t idx2 = table_find(s, id, false);
-    if (idx2 >= 0) {
-      Entry& e2 = s->table()[idx2];
-      for (int i = 0; i < kRefSlots; ++i) {
-        if (e2.refs[i].pid == me && e2.refs[i].count > 0) {
-          if (--e2.refs[i].count == 0) e2.refs[i].pid = 0;
-          break;
-        }
+    if (idx2 >= 0 && s->table()[idx2].offset == off) {
+      drop_pin(s, idx2, me);
+    } else {
+      // the id was deleted+re-created while we copied: our pin lives on
+      // the orphaned incarnation (matched by payload offset), not on the
+      // new entry
+      int64_t orphan = find_orphan(s, id);
+      if (orphan >= 0 && s->table()[orphan].offset == off) {
+        drop_pin(s, orphan, me);
       }
-      if (e2.pending_delete && total_refs(e2) == 0) drop_object(s, idx2);
     }
     unlock(s);
     return n;

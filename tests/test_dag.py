@@ -1,0 +1,214 @@
+"""Tests for DAG authoring, interpreted execution, channels, and compiled
+DAG execution (reference: python/ray/dag tests + experimental/channel
+tests)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.channel import Channel, ChannelClosedError, ChannelTimeoutError
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+# ---------------------------------------------------------------------------
+# channel unit tests (no cluster)
+
+class TestChannel:
+    def _mk(self, tmp_path, **kw):
+        path = str(tmp_path / "chan")
+        w = Channel(path, capacity=4096, create=True, **kw)
+        r = Channel(path, reader_idx=0)
+        return w, r
+
+    def test_roundtrip(self, tmp_path):
+        w, r = self._mk(tmp_path)
+        w.write({"a": 1})
+        assert r.read() == {"a": 1}
+
+    def test_backpressure_blocks_second_write(self, tmp_path):
+        w, r = self._mk(tmp_path)
+        w.write(1)
+        with pytest.raises(ChannelTimeoutError):
+            w.write(2, timeout=0.05)
+        assert r.read() == 1
+        w.write(2, timeout=1.0)  # now the slot is free
+        assert r.read() == 2
+
+    def test_read_times_out_when_empty(self, tmp_path):
+        w, r = self._mk(tmp_path)
+        with pytest.raises(ChannelTimeoutError):
+            r.read(timeout=0.05)
+
+    def test_two_readers_each_see_every_value(self, tmp_path):
+        path = str(tmp_path / "chan2")
+        w = Channel(path, capacity=4096, num_readers=2, create=True)
+        r0 = Channel(path, reader_idx=0)
+        r1 = Channel(path, reader_idx=1)
+        w.write("x")
+        assert r0.read() == "x"
+        # writer blocked until BOTH readers ack
+        with pytest.raises(ChannelTimeoutError):
+            w.write("y", timeout=0.05)
+        assert r1.read() == "x"
+        w.write("y")
+        assert (r0.read(), r1.read()) == ("y", "y")
+
+    def test_close_unblocks_reader(self, tmp_path):
+        w, r = self._mk(tmp_path)
+        w.close()
+        with pytest.raises(ChannelClosedError):
+            r.read(timeout=1.0)
+
+    def test_numpy_payload(self, tmp_path):
+        w, r = self._mk(tmp_path)
+        arr = np.arange(100, dtype=np.float32)
+        w.write(arr)
+        np.testing.assert_array_equal(r.read(), arr)
+
+
+# ---------------------------------------------------------------------------
+# DAG authoring + interpreted execution
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def double(x):
+    return 2 * x
+
+
+@ray_tpu.remote(num_cpus=0.1)
+class Stage:
+    def __init__(self, scale=1):
+        self.scale = scale
+        self.calls = 0
+
+    def fwd(self, x):
+        self.calls = self.calls + 1
+        return self.scale * x
+
+    def fwd2(self, x, y):
+        return x + y
+
+    def boom(self, x):
+        raise RuntimeError("stage exploded")
+
+    def count(self):
+        return self.calls
+
+
+def test_interpreted_function_dag(ray_start_regular):
+    with InputNode() as inp:
+        d = double.bind(inp)
+        out = add.bind(d, 10)
+    assert out.execute(5) == 20
+
+
+def test_interpreted_actor_dag(ray_start_regular):
+    s = Stage.remote(scale=3)
+    with InputNode() as inp:
+        out = s.fwd.bind(inp)
+    assert out.execute(7) == 21
+    ray_tpu.kill(s)
+
+
+def test_interpreted_multi_output(ray_start_regular):
+    with InputNode() as inp:
+        a = double.bind(inp)
+        b = add.bind(inp, 1)
+        dag = MultiOutputNode([a, b])
+    assert dag.execute(4) == [8, 5]
+
+
+# ---------------------------------------------------------------------------
+# compiled DAG
+
+def test_compiled_linear_pipeline(ray_start_regular):
+    a, b = Stage.remote(scale=2), Stage.remote(scale=10)
+    with InputNode() as inp:
+        dag = b.fwd.bind(a.fwd.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(1).get(timeout=10) == 20
+        assert compiled.execute(3).get(timeout=10) == 60
+        # pipelined: submit several before reading
+        refs = [compiled.execute(i) for i in range(5)]
+        assert [r.get(timeout=10) for r in refs] == [0, 20, 40, 60, 80]
+    finally:
+        compiled.teardown()
+    # actors accept normal calls again after teardown
+    assert ray_tpu.get([a.count.remote()], timeout=10)[0] == 7
+    for s in (a, b):
+        ray_tpu.kill(s)
+
+
+def test_compiled_fan_out_fan_in(ray_start_regular):
+    a, b, c = Stage.remote(2), Stage.remote(3), Stage.remote()
+    with InputNode() as inp:
+        dag = c.fwd2.bind(a.fwd.bind(inp), b.fwd.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(1).get(timeout=10) == 5
+        assert compiled.execute(2).get(timeout=10) == 10
+    finally:
+        compiled.teardown()
+    for s in (a, b, c):
+        ray_tpu.kill(s)
+
+
+def test_compiled_multi_output(ray_start_regular):
+    a, b = Stage.remote(2), Stage.remote(5)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.fwd.bind(inp), b.fwd.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(3).get(timeout=10) == [6, 15]
+    finally:
+        compiled.teardown()
+    for s in (a, b):
+        ray_tpu.kill(s)
+
+
+def test_compiled_error_propagates_and_pipeline_survives(ray_start_regular):
+    a, b = Stage.remote(1), Stage.remote(1)
+    with InputNode() as inp:
+        dag = b.fwd.bind(a.boom.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        from ray_tpu.core.exceptions import TaskError
+
+        with pytest.raises(TaskError, match="boom|stage exploded"):
+            compiled.execute(1).get(timeout=10)
+        # next execution still works (loop did not die)
+        with pytest.raises(TaskError):
+            compiled.execute(2).get(timeout=10)
+    finally:
+        compiled.teardown()
+    for s in (a, b):
+        ray_tpu.kill(s)
+
+
+def test_compiled_large_payload_spills_to_object_store(ray_start_regular):
+    a = Stage.remote(1)
+    with InputNode() as inp:
+        dag = a.fwd.bind(inp)
+    compiled = dag.experimental_compile(buffer_size_bytes=1024)
+    try:
+        big = np.ones(100_000, dtype=np.float32)  # 400KB > 1KB slot
+        out = compiled.execute(big).get(timeout=20)
+        np.testing.assert_array_equal(out, big)
+    finally:
+        compiled.teardown()
+    ray_tpu.kill(a)
+
+
+def test_compiled_rejects_function_nodes(ray_start_regular):
+    with InputNode() as inp:
+        dag = double.bind(inp)
+    with pytest.raises(ValueError, match="actor-method"):
+        dag.experimental_compile()

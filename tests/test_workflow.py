@@ -1,0 +1,116 @@
+"""Workflow library tests (reference: python/ray/workflow/tests —
+basics, checkpoint/resume, continuations, management API)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode, MultiOutputNode
+from ray_tpu.workflow.storage import WorkflowStorage
+
+
+@pytest.fixture(autouse=True)
+def wf_storage(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path / "wf"))
+    yield str(tmp_path / "wf")
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def double(x):
+    return 2 * x
+
+
+@ray_tpu.remote
+def flaky_once(x, marker_dir):
+    """Fails the first time it ever runs (across workflow attempts)."""
+    marker = os.path.join(marker_dir, "ran")
+    if not os.path.exists(marker):
+        with open(marker, "w") as f:
+            f.write("1")
+        raise RuntimeError("transient failure")
+    return x + 100
+
+
+def test_run_simple_dag(ray_start_regular):
+    with InputNode() as inp:
+        dag = add.bind(double.bind(inp), 3)
+    assert workflow.run(dag, workflow_input=5, timeout=30) == 13
+
+
+def test_run_multi_output(ray_start_regular):
+    with InputNode() as inp:
+        dag = MultiOutputNode([double.bind(inp), add.bind(inp, 1)])
+    assert workflow.run(dag, workflow_input=4, timeout=30) == [8, 5]
+
+
+def test_status_and_list(ray_start_regular):
+    with InputNode() as inp:
+        dag = double.bind(inp)
+    wid = workflow.run_async(dag, workflow_id="wf-status", workflow_input=2)
+    assert workflow.get_output(wid, timeout=30) == 4
+    assert workflow.get_status(wid) == workflow.WorkflowStatus.SUCCESSFUL
+    assert ("wf-status", workflow.WorkflowStatus.SUCCESSFUL) in \
+        workflow.list_all()
+
+
+def test_failed_workflow_reports_error(ray_start_regular, tmp_path):
+    @ray_tpu.remote(max_retries=0)
+    def boom():
+        raise ValueError("nope")
+
+    with InputNode() as inp:
+        dag = add.bind(boom.bind(), inp)
+    wid = workflow.run_async(dag, workflow_id="wf-fail", workflow_input=1)
+    with pytest.raises(RuntimeError, match="FAILED"):
+        workflow.get_output(wid, timeout=30)
+    assert workflow.get_status(wid) == workflow.WorkflowStatus.FAILED
+
+
+def test_resume_skips_checkpointed_steps(ray_start_regular, tmp_path):
+    marker_dir = str(tmp_path / "markers")
+    os.makedirs(marker_dir, exist_ok=True)
+    with InputNode() as inp:
+        d = double.bind(inp)                      # completes first attempt
+        dag = flaky_once.options(max_retries=0).bind(d, marker_dir)
+    wid = workflow.run_async(dag, workflow_id="wf-resume", workflow_input=21)
+    with pytest.raises(RuntimeError):
+        workflow.get_output(wid, timeout=30)
+    assert workflow.get_status(wid) == workflow.WorkflowStatus.FAILED
+
+    # resume: double's checkpoint is reused; flaky_once now succeeds
+    assert workflow.resume(wid, timeout=30) == 142
+    assert workflow.get_status(wid) == workflow.WorkflowStatus.SUCCESSFUL
+
+    # the double step was NOT re-executed: its checkpoint predates resume
+    storage = WorkflowStorage(wid)
+    keys = os.listdir(storage.steps_dir)
+    assert any("double" in k for k in keys)
+
+
+def test_continuation_dynamic_workflow(ray_start_regular):
+    @ray_tpu.remote
+    def outer(x):
+        # returns a continuation DAG: reference's "workflow.continuation"
+        return double.bind(x)
+
+    with InputNode() as inp:
+        dag = outer.bind(inp)
+    assert workflow.run(dag, workflow_input=6, timeout=30) == 12
+
+
+def test_delete_removes_storage(ray_start_regular):
+    with InputNode() as inp:
+        dag = double.bind(inp)
+    wid = workflow.run_async(dag, workflow_id="wf-del", workflow_input=1)
+    workflow.get_output(wid, timeout=30)
+    workflow.delete(wid)
+    with pytest.raises(ValueError):
+        workflow.get_status(wid)
