@@ -1,0 +1,25 @@
+"""Autoscaler: reconciler-based node-count management.
+
+Capability counterpart of the reference's autoscaler v2
+(python/ray/autoscaler/v2/ — SURVEY.md P16): a monitor loop reads cluster
+load from the GCS (pending task/actor/PG demands + per-node utilization),
+a bin-packing demand scheduler maps unmet demand onto configured node
+types, and a reconciler drives a pluggable NodeProvider to launch or
+terminate nodes. The FakeMultiNodeProvider (counterpart of
+autoscaler/_private/fake_multi_node/node_provider.py) adds in-process
+nodes through cluster_utils for tests.
+
+TPU note: node types carry arbitrary resource dicts, so a slice-sized
+node type (e.g. {"TPU": 4, "CPU": 120} per v4-8 host) scales the same way
+CPU types do; slice-granular groups come from placement groups, not the
+autoscaler.
+"""
+
+from ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig, NodeTypeConfig
+from ray_tpu.autoscaler.node_provider import FakeMultiNodeProvider, NodeProvider
+from ray_tpu.autoscaler.resource_demand_scheduler import fit_demands
+
+__all__ = [
+    "Autoscaler", "AutoscalerConfig", "NodeTypeConfig",
+    "NodeProvider", "FakeMultiNodeProvider", "fit_demands",
+]

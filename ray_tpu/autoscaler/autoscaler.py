@@ -1,0 +1,140 @@
+"""The autoscaler reconciler + monitor loop.
+
+Reference counterparts: autoscaler/v2/autoscaler.py + scheduler.py +
+instance_manager (reconciler state machine) and the v1 StandardAutoscaler
+(autoscaler/_private/autoscaler.py) driven by monitor.py on the head
+node. One `step()` = read load → pack unmet demand → launch → retire
+idle nodes past the timeout. `run_forever` wraps it in the monitor loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.resource_demand_scheduler import fit_demands
+
+
+@dataclass
+class NodeTypeConfig:
+    """One scalable node type (reference: available_node_types in the
+    cluster YAML)."""
+
+    resources: Dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: Dict[str, NodeTypeConfig] = field(default_factory=dict)
+    idle_timeout_s: float = 60.0
+    upscaling_speed: float = 1.0  # max fraction growth per step (>=1 node)
+    interval_s: float = 1.0
+
+
+class Autoscaler:
+    def __init__(self, kv_call, provider: NodeProvider,
+                 config: AutoscalerConfig):
+        """kv_call: callable(msg_dict) -> reply (the GCS client call)."""
+        self._call = kv_call
+        self.provider = provider
+        self.config = config
+        self._idle_since: Dict[str, float] = {}
+        self._stopped = threading.Event()
+        self.last_infeasible: List[Dict[str, float]] = []
+
+    # -- one reconcile step ---------------------------------------------
+    def step(self) -> Dict[str, int]:
+        load = self._call({"op": "get_load"})
+        nodes = [n for n in load["nodes"] if n["alive"]]
+        managed = set(self.provider.non_terminated_nodes())
+
+        counts: Dict[str, int] = {}
+        for nid in managed:
+            t = self.provider.node_type_of(nid)
+            if t:
+                counts[t] = counts.get(t, 0) + 1
+
+        demands = list(load["demands"])
+        for pg in load["pg_demands"]:
+            demands.extend(pg["bundles"])
+
+        spare = [dict(n["available"]) for n in nodes]
+        max_per_type = {t: c.max_workers
+                        for t, c in self.config.node_types.items()}
+        node_resources = {t: c.resources
+                          for t, c in self.config.node_types.items()}
+
+        to_add, infeasible = fit_demands(
+            demands, spare, node_resources, max_per_type, counts)
+        self.last_infeasible = infeasible
+
+        # upscaling-speed cap on demand-driven growth (always allow at
+        # least one node per step)
+        total = sum(counts.values()) or 1
+        budget = max(1, int(total * self.config.upscaling_speed))
+        for t in list(to_add):
+            take = min(to_add[t], budget)
+            to_add[t] = take
+            budget -= take
+
+        # honor min_workers — a hard floor, never throttled by the cap
+        for t, cfg in self.config.node_types.items():
+            have = counts.get(t, 0) + to_add.get(t, 0)
+            if have < cfg.min_workers:
+                to_add[t] = to_add.get(t, 0) + (cfg.min_workers - have)
+
+        launched: Dict[str, int] = {}
+        for t, n in to_add.items():
+            for _ in range(n):
+                self.provider.create_node(
+                    t, self.config.node_types[t].resources)
+            if n:
+                launched[t] = n
+
+        self._scale_down(nodes, managed, counts)
+        return launched
+
+    def _scale_down(self, nodes, managed, counts):
+        now = time.monotonic()
+        for n in nodes:
+            nid = n["node_id"]
+            if n["is_head"] or nid not in managed:
+                continue
+            idle = n["available"] == n["total"]
+            if not idle:
+                self._idle_since.pop(nid, None)
+                continue
+            first = self._idle_since.setdefault(nid, now)
+            t = self.provider.node_type_of(nid)
+            min_workers = self.config.node_types.get(
+                t, NodeTypeConfig({})).min_workers if t else 0
+            if now - first >= self.config.idle_timeout_s and \
+                    counts.get(t, 0) > min_workers:
+                self.provider.terminate_node(nid)
+                self._idle_since.pop(nid, None)
+                counts[t] = counts.get(t, 0) - 1
+
+    # -- monitor loop ----------------------------------------------------
+    def run_forever(self):
+        while not self._stopped.is_set():
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 keep the monitor alive
+                import traceback
+
+                traceback.print_exc()
+            self._stopped.wait(self.config.interval_s)
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.run_forever, daemon=True,
+                             name="autoscaler-monitor")
+        t.start()
+        return t
+
+    def stop(self):
+        self._stopped.set()

@@ -1,0 +1,67 @@
+"""Node providers: the cloud-facing side of the autoscaler.
+
+Reference counterparts: python/ray/autoscaler/node_provider.py (the
+NodeProvider plugin ABC implemented by aws/gcp/azure/... in
+autoscaler/_private/) and the fake in-process provider
+(autoscaler/_private/fake_multi_node/node_provider.py) used by
+test_autoscaler_fakemultinode.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Launch/terminate nodes of a named node type."""
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float]) -> str:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+    def node_type_of(self, node_id: str) -> Optional[str]:
+        raise NotImplementedError
+
+
+class FakeMultiNodeProvider(NodeProvider):
+    """Adds logical nodes to the running control plane via cluster_utils —
+    real scheduling/worker processes, fake provisioning."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, str] = {}  # node_id -> node_type
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float]) -> str:
+        res = dict(resources)
+        cpus = res.pop("CPU", 0)
+        tpus = res.pop("TPU", 0)
+        node_id = f"{node_type}-{uuid.uuid4().hex[:6]}"
+        nid = self._cluster.add_node(
+            num_cpus=cpus, num_tpus=tpus, resources=res, node_id=node_id,
+            labels={"autoscaler-node-type": node_type})
+        with self._lock:
+            self._nodes[nid] = node_type
+        return nid
+
+    def terminate_node(self, node_id: str) -> bool:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+        return self._cluster.remove_node(node_id)
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def node_type_of(self, node_id: str) -> Optional[str]:
+        with self._lock:
+            return self._nodes.get(node_id)

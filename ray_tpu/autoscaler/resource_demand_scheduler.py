@@ -1,0 +1,79 @@
+"""Demand → node-type bin packing.
+
+Reference counterpart: autoscaler/_private/resource_demand_scheduler.py —
+given unmet resource demands and the configured node types (with per-type
+max counts), decide how many nodes of each type to add. First-fit
+decreasing onto existing spare capacity, then onto hypothetical new
+nodes, preferring the smallest feasible type (cost proxy: total resource
+volume).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+
+def _fits(demand: Dict[str, float], free: Dict[str, float]) -> bool:
+    return all(free.get(k, 0.0) >= v for k, v in demand.items() if v > 0)
+
+
+def _consume(demand: Dict[str, float], free: Dict[str, float]):
+    for k, v in demand.items():
+        free[k] = free.get(k, 0.0) - v
+
+
+def _volume(resources: Dict[str, float]) -> float:
+    # crude cost proxy; TPU chips weigh heavily so CPU fillers win for
+    # CPU-only demand
+    return sum(v * (100.0 if k == "TPU" else 1.0)
+               for k, v in resources.items())
+
+
+def fit_demands(
+    demands: List[Dict[str, float]],
+    spare_capacity: List[Dict[str, float]],
+    node_types: Dict[str, Dict[str, float]],
+    max_per_type: Dict[str, int],
+    current_counts: Dict[str, int],
+) -> Tuple[Dict[str, int], List[Dict[str, float]]]:
+    """Returns ({node_type: count_to_add}, infeasible_demands)."""
+    spare = [dict(s) for s in spare_capacity]
+    to_add: Dict[str, int] = {}
+    new_nodes: List[Tuple[str, Dict[str, float]]] = []
+    infeasible: List[Dict[str, float]] = []
+
+    # big demands first: classic FFD packs better
+    for demand in sorted(demands, key=_volume, reverse=True):
+        if not demand:
+            continue
+        placed = False
+        for free in spare:
+            if _fits(demand, free):
+                _consume(demand, free)
+                placed = True
+                break
+        if placed:
+            continue
+        for _, free in new_nodes:
+            if _fits(demand, free):
+                _consume(demand, free)
+                placed = True
+                break
+        if placed:
+            continue
+        # launch the cheapest feasible type with headroom
+        candidates = [
+            (t, res) for t, res in node_types.items()
+            if _fits(demand, dict(res))
+            and current_counts.get(t, 0) + to_add.get(t, 0)
+            < max_per_type.get(t, 0)
+        ]
+        if not candidates:
+            infeasible.append(demand)
+            continue
+        t, res = min(candidates, key=lambda c: _volume(c[1]))
+        free = dict(res)
+        _consume(demand, free)
+        new_nodes.append((t, free))
+        to_add[t] = to_add.get(t, 0) + 1
+    return to_add, infeasible

@@ -22,17 +22,42 @@ def init(num_cpus: Optional[float] = None,
          num_tpus: Optional[float] = None,
          resources: Optional[dict] = None,
          namespace: str = "",
+         address: Optional[str] = None,
          ignore_reinit_error: bool = True,
          _system_config: Optional[dict] = None) -> DriverRuntime:
-    """Start the single-host runtime (control plane + worker pool)."""
+    """Start the single-host runtime (control plane + worker pool), or —
+    with ``address=`` — connect this driver to a running cluster
+    ("auto" resolves the address file written by ``ray-tpu start``)."""
     rt = _runtime_mod._global_runtime
     if rt is not None and getattr(rt, "is_initialized", False):
         if ignore_reinit_error:
             return rt
         raise RayTpuError("ray_tpu.init() called twice")
+    if address == "auto":
+        address = _resolve_cluster_address()
     return DriverRuntime(
         num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
-        namespace=namespace, _system_config=_system_config)
+        namespace=namespace, address=address,
+        _system_config=_system_config)
+
+
+_ADDRESS_FILE = "/tmp/ray_tpu/cluster_address"
+
+
+def _resolve_cluster_address() -> str:
+    import os
+
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env and env != "auto":
+        return env
+    try:
+        with open(_ADDRESS_FILE) as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        raise RayTpuError(
+            "address='auto' but no running cluster found (no "
+            f"RAY_TPU_ADDRESS env var and no {_ADDRESS_FILE}); start one "
+            "with `ray-tpu start --head`") from None
 
 
 def is_initialized() -> bool:

@@ -27,24 +27,41 @@ class DriverRuntime:
                  num_tpus: Optional[float] = None,
                  resources: Optional[dict] = None,
                  _system_config: Optional[dict] = None,
-                 namespace: str = ""):
+                 namespace: str = "",
+                 address: Optional[str] = None):
+        """Head mode (default): start the control plane in-process.
+        Connect mode (``address=``): attach this driver to an existing
+        cluster's control server — counterpart of ray.init(address=...)
+        joining a running GCS (worker.py:1225 connect-only path)."""
         reset_config()
         self.config: Config = get_config().apply_overrides(_system_config)
-        session_id = uuid.uuid4().hex[:12]
-        self.session_dir = os.path.join(
-            "/tmp/ray_tpu", f"session-{session_id}")
-        os.makedirs(self.session_dir, exist_ok=True)
-        node_res = node_resources_from_env(num_cpus, num_tpus, resources)
-        self.control = ControlServer(
-            session_id, self.config, node_res, self.session_dir,
-            namespace=namespace)
+        if address:
+            self.control = None
+            control_addr = address
+        else:
+            session_id = uuid.uuid4().hex[:12]
+            self.session_dir = os.path.join(
+                "/tmp/ray_tpu", f"session-{session_id}")
+            os.makedirs(self.session_dir, exist_ok=True)
+            node_res = node_resources_from_env(num_cpus, num_tpus, resources)
+            self.control = ControlServer(
+                session_id, self.config, node_res, self.session_dir,
+                namespace=namespace)
+            control_addr = self.control.address
         self.core = CoreClient(
-            self.control.address, WorkerID.from_random().hex(),
+            control_addr, WorkerID.from_random().hex(),
             kind="driver", config=self.config)
+        if address:
+            self.session_dir = self.core.session_dir
         self.namespace = namespace
         self.is_initialized = True
         set_runtime(self)
         atexit.register(self._atexit)
+
+    @property
+    def address(self) -> str:
+        return self.control.address if self.control is not None \
+            else self.core.client.address
 
     def _atexit(self):
         try:
@@ -125,4 +142,5 @@ class DriverRuntime:
             self.core.close()
         except Exception:
             pass
-        self.control.stop()
+        if self.control is not None:
+            self.control.stop()

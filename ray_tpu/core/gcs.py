@@ -777,6 +777,36 @@ class ControlServer:
         self._wake.set()
         return True
 
+    def _op_shutdown_cluster(self, conn, msg):
+        """Remote shutdown (CLI `ray-tpu stop`). Stops off-thread so the
+        reply can flush first."""
+        threading.Thread(target=self.stop, daemon=True,
+                         name="cluster-shutdown").start()
+        return True
+
+    def _op_get_load(self, conn, msg):
+        """Cluster load snapshot for the autoscaler (counterpart of the
+        GCS AutoscalerStateService GetClusterResourceState,
+        autoscaler.proto:315 / gcs_autoscaler_state_manager.cc)."""
+        with self.lock:
+            demands = [dict(s.resources) for s in self.pending_tasks]
+            demands += [dict(s.resources) for s in self.pending_actors]
+            pg_demands = [
+                {"strategy": pg.strategy, "bundles": list(pg.bundle_specs)}
+                for pg in self.placement_groups.values()
+                if pg.state == "PENDING"
+            ]
+            nodes = [
+                {"node_id": n.node_id, "is_head": n.is_head,
+                 "alive": n.alive,
+                 "total": n.total.to_dict(),
+                 "available": n.available.to_dict(),
+                 "labels": dict(n.labels)}
+                for n in self.nodes.values()
+            ]
+        return {"demands": demands, "pg_demands": pg_demands,
+                "nodes": nodes}
+
     def _op_list_nodes(self, conn, msg):
         with self.lock:
             return [

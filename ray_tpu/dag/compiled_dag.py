@@ -97,40 +97,65 @@ class CompiledDAG:
         session = core.session_id
         tag = uuid.uuid4().hex[:8]
 
-        # consumers per producer node (driver counts as a consumer of
-        # every output node)
-        consumers: Dict[int, List] = {}
+        # One resident loop pins an actor's single exec thread, so an
+        # actor can host at most one node (the reference compiles
+        # multi-node actors into one loop; here we reject them loudly
+        # rather than deadlock silently).
+        seen_actors: Dict[str, str] = {}
         for n in actor_nodes:
-            for u in n._upstream():
-                consumers.setdefault(u._uid, []).append(n)
-        for out in self._output_nodes:
-            consumers.setdefault(out._uid, []).append("driver")
+            prev = seen_actors.get(n._actor._actor_hex)
+            if prev is not None:
+                raise ValueError(
+                    f"actor {n._actor} appears in two compiled-DAG nodes "
+                    f"({prev!r} and {n._method_name!r}); compiled DAGs "
+                    "support one resident method per actor — use separate "
+                    "actors per stage")
+            seen_actors[n._actor._actor_hex] = n._method_name
 
-        def make_channel(producer_uid: int) -> Channel:
-            path = os.path.join(
-                shm_dir,
-                f"raytpu-{session}-chan-{tag}-{producer_uid}")
-            return Channel(path, capacity=self._buffer,
-                           num_readers=len(consumers[producer_uid]),
-                           create=True)
+        # Reader slots are per EDGE ENDPOINT (a consumer taking the same
+        # upstream twice gets two distinct slots), allocated by walking
+        # exactly the same (args, kwargs, outputs) order used when
+        # building the templates below.
+        edge_counter: Dict[int, int] = {}   # producer uid -> slots so far
+
+        def alloc_slot(producer_uid: int) -> int:
+            i = edge_counter.get(producer_uid, 0)
+            edge_counter[producer_uid] = i + 1
+            return i
+
+        node_slots: Dict[int, dict] = {}    # consumer uid -> templates
+        for n in actor_nodes:
+            args_t = []
+            for a in n._bound_args:
+                if isinstance(a, DAGNode):
+                    args_t.append(("chan-slot", (a._uid, alloc_slot(a._uid))))
+                else:
+                    args_t.append(("const", a))
+            kwargs_t = {}
+            for k, v in n._bound_kwargs.items():
+                if isinstance(v, DAGNode):
+                    kwargs_t[k] = ("chan-slot", (v._uid, alloc_slot(v._uid)))
+                else:
+                    kwargs_t[k] = ("const", v)
+            node_slots[n._uid] = {"args": args_t, "kwargs": kwargs_t}
+        driver_slots = [alloc_slot(out._uid) for out in self._output_nodes]
+
+        def chan_path(producer_uid: int) -> str:
+            return os.path.join(
+                shm_dir, f"raytpu-{session}-chan-{tag}-{producer_uid}")
 
         # one output channel per producer that has consumers
         self._channels: Dict[int, Channel] = {
-            uid: make_channel(uid) for uid in consumers
+            uid: Channel(chan_path(uid), capacity=self._buffer,
+                         num_readers=nreaders, create=True)
+            for uid, nreaders in edge_counter.items()
         }
-        # reader index assignment per (producer, consumer)
-        reader_idx: Dict[tuple, int] = {}
-        for uid, cons in consumers.items():
-            for i, c in enumerate(cons):
-                key = (uid, "driver" if c == "driver" else c._uid)
-                reader_idx[key] = i
 
         # driver endpoints
         self._input_writer = self._channels[self._input_node._uid]
         self._output_readers = [
-            Channel(self._channels[out._uid].path,
-                    reader_idx=reader_idx[(out._uid, "driver")])
-            for out in self._output_nodes
+            Channel(chan_path(out._uid), reader_idx=slot)
+            for out, slot in zip(self._output_nodes, driver_slots)
         ]
 
         # Collector: drain output channels continuously so a deep pipeline
@@ -164,33 +189,28 @@ class CompiledDAG:
         self._collector = threading.Thread(
             target=collect, daemon=True, name="dag-collector")
 
-        # pin each actor with its loop descriptor
+        # Pin each actor with its loop descriptor. Channel endpoints are
+        # shipped as (path, reader_idx) SPECS and opened inside the actor
+        # — opening them here too would leak one fd+mmap per edge per
+        # compile on the driver.
         self._loop_refs = []
         self._actors = []
         for n in actor_nodes:
-            arg_template = []
-            for a in n._bound_args:
-                if isinstance(a, DAGNode):
-                    arg_template.append(
-                        ("chan", Channel(self._channels[a._uid].path,
-                                         reader_idx=reader_idx[
-                                             (a._uid, n._uid)])))
-                else:
-                    arg_template.append(("const", a))
-            kwarg_template = {}
-            for k, v in n._bound_kwargs.items():
-                if isinstance(v, DAGNode):
-                    kwarg_template[k] = (
-                        "chan", Channel(self._channels[v._uid].path,
-                                        reader_idx=reader_idx[
-                                            (v._uid, n._uid)]))
-                else:
-                    kwarg_template[k] = ("const", v)
+            slots = node_slots[n._uid]
+
+            def to_spec(entry):
+                kind, v = entry
+                if kind == "chan-slot":
+                    uid, slot = v
+                    return ("chan", (chan_path(uid), slot))
+                return entry
+
             desc = {
                 "method": n._method_name,
-                "args": arg_template,
-                "kwargs": kwarg_template,
-                "output": Channel(self._channels[n._uid].path)
+                "args": [to_spec(e) for e in slots["args"]],
+                "kwargs": {k: to_spec(e)
+                           for k, e in slots["kwargs"].items()},
+                "output": (chan_path(n._uid), None)
                 if n._uid in self._channels else None,
             }
             self._actors.append(n._actor)
@@ -212,6 +232,7 @@ class CompiledDAG:
         import time as _time
 
         if ref._done:
+            _raise_if_error(ref._value)
             return ref._value
         deadline = None if timeout is None else _time.monotonic() + timeout
         with self._results_cv:
@@ -228,10 +249,7 @@ class CompiledDAG:
                 self._results_cv.wait(remaining)
             ref._value = self._results.pop(ref._idx)
         ref._done = True
-        errs = ref._value if isinstance(ref._value, list) else [ref._value]
-        for v in errs:
-            if isinstance(v, DagExecutionError):
-                v.raise_()
+        _raise_if_error(ref._value)
         return ref._value
 
     def teardown(self):
@@ -263,6 +281,13 @@ class CompiledDAG:
             pass
 
 
+def _raise_if_error(value):
+    errs = value if isinstance(value, list) else [value]
+    for v in errs:
+        if isinstance(v, DagExecutionError):
+            v.raise_()
+
+
 class DagExecutionError:
     """Error envelope forwarded through channels so a failing stage
     surfaces at the driver instead of wedging the pipeline (reference:
@@ -285,17 +310,27 @@ def run_actor_loop(instance, desc: dict) -> int:
     import traceback
 
     method = getattr(instance, desc["method"])
-    out: Optional[Channel] = desc["output"]
+
+    def open_chan(spec):
+        path, reader_idx = spec
+        return Channel(path, reader_idx=reader_idx)
+
+    arg_tmpl = [(k, open_chan(v) if k == "chan" else v)
+                for k, v in desc["args"]]
+    kwarg_tmpl = {name: (k, open_chan(v) if k == "chan" else v)
+                  for name, (k, v) in desc["kwargs"].items()}
+    out: Optional[Channel] = (
+        open_chan(desc["output"]) if desc["output"] is not None else None)
     count = 0
     while True:
         try:
             args = [
                 v.read() if kind == "chan" else v
-                for kind, v in desc["args"]
+                for kind, v in arg_tmpl
             ]
             kwargs = {
                 k: (v.read() if kind == "chan" else v)
-                for k, (kind, v) in desc["kwargs"].items()
+                for k, (kind, v) in kwarg_tmpl.items()
             }
             upstream_err = next(
                 (a for a in args if isinstance(a, DagExecutionError)), None

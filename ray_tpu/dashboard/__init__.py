@@ -1,0 +1,15 @@
+"""Dashboard: HTTP JSON API over cluster state.
+
+Capability counterpart of the reference's dashboard head
+(python/ray/dashboard/head.py + http_server_head.py and the per-module
+routes in dashboard/modules/). The reference is an aiohttp app with a JS
+frontend; here it's a stdlib ThreadingHTTPServer serving the same
+information as JSON — nodes, tasks, actors, objects, placement groups,
+workers, jobs, cluster/available resources, object-store stats, and a
+health endpoint. The state SDK (ray_tpu.state) reads the control server
+directly; this is the remote/browser-facing view.
+"""
+
+from ray_tpu.dashboard.http_head import Dashboard
+
+__all__ = ["Dashboard"]

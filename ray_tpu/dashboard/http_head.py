@@ -1,0 +1,136 @@
+"""The dashboard HTTP head (reference dashboard/http_server_head.py).
+
+Routes (all GET unless noted):
+  /api/version             -> {"version": ...}
+  /api/healthz             -> "success"
+  /api/nodes               /api/tasks        /api/actors
+  /api/objects             /api/workers      /api/placement_groups
+  /api/cluster_resources   /api/available_resources
+  /api/object_store_stats
+  /api/jobs                (GET list; POST {"entrypoint": ...} submits)
+  /api/jobs/<id>           -> job info
+  /api/jobs/<id>/logs      -> text
+  /api/jobs/<id>/stop      (POST)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ray_tpu._version import __version__
+
+
+class Dashboard:
+    def __init__(self, runtime, host: str = "127.0.0.1", port: int = 0):
+        self._runtime = runtime
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, payload, code=200, raw=False):
+                body = payload.encode() if raw else json.dumps(
+                    payload, default=str).encode()
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain" if raw else "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    out = dashboard._route_get(self.path)
+                except KeyError:
+                    self._send({"error": f"no route {self.path}"}, 404)
+                except Exception as e:  # noqa: BLE001
+                    self._send({"error": str(e)}, 500)
+                else:
+                    if isinstance(out, str):
+                        self._send(out, raw=True)
+                    else:
+                        self._send(out)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b"{}"
+                try:
+                    payload = json.loads(body or b"{}")
+                    out = dashboard._route_post(self.path, payload)
+                except KeyError:
+                    self._send({"error": f"no route {self.path}"}, 404)
+                except Exception as e:  # noqa: BLE001
+                    self._send({"error": str(e)}, 500)
+                else:
+                    self._send(out)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dashboard-http")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------------
+    def _route_get(self, path: str):
+        rt = self._runtime
+        if path in ("/api/healthz", "/healthz"):
+            return "success"
+        if path == "/api/version":
+            return {"version": __version__}
+        simple = {
+            "/api/nodes": "nodes", "/api/tasks": "tasks",
+            "/api/actors": "actors", "/api/objects": "objects",
+            "/api/workers": "workers",
+            "/api/placement_groups": "placement_groups",
+        }
+        if path in simple:
+            return rt.state_list(simple[path])
+        if path == "/api/cluster_resources":
+            return rt.cluster_resources()
+        if path == "/api/available_resources":
+            return rt.available_resources()
+        if path == "/api/object_store_stats":
+            cap, used, n, evicted = rt.core.store.stats()
+            return {"capacity": cap, "used": used, "num_objects": n,
+                    "evicted_bytes": evicted,
+                    "native": rt.core.store.native}
+        if path == "/api/jobs":
+            return self._jobs().list_jobs()
+        if path.startswith("/api/jobs/"):
+            rest = path[len("/api/jobs/"):]
+            if rest.endswith("/logs"):
+                return self._jobs().get_job_logs(rest[:-len("/logs")])
+            return self._jobs().get_job_info(rest)
+        raise KeyError(path)
+
+    def _route_post(self, path: str, payload: dict):
+        if path == "/api/jobs":
+            job_id = self._jobs().submit_job(
+                entrypoint=payload["entrypoint"],
+                job_id=payload.get("job_id", ""),
+                runtime_env=payload.get("runtime_env"),
+                metadata=payload.get("metadata"))
+            return {"job_id": job_id}
+        if path.startswith("/api/jobs/") and path.endswith("/stop"):
+            job_id = path[len("/api/jobs/"):-len("/stop")]
+            return {"stopped": self._jobs().stop_job(job_id)}
+        raise KeyError(path)
+
+    def _jobs(self):
+        from ray_tpu.job import JobSubmissionClient
+
+        return JobSubmissionClient()

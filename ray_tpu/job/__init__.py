@@ -1,0 +1,6 @@
+"""Job submission (reference: dashboard/modules/job — JobSubmissionClient
+sdk.py:35, job_manager.py, JobSupervisor)."""
+
+from ray_tpu.job.job_manager import JobStatus, JobSubmissionClient
+
+__all__ = ["JobSubmissionClient", "JobStatus"]

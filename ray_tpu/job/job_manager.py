@@ -1,0 +1,199 @@
+"""Job manager: run driver entrypoints as supervised subprocesses.
+
+Reference counterparts: python/ray/dashboard/modules/job/job_manager.py
+(JobManager + JobSupervisor actor) and sdk.py:35 (JobSubmissionClient).
+The manager is a named actor; each submitted job is a subprocess whose
+stdout/stderr stream to a log file in the session dir and whose env gets
+``RAY_TPU_ADDRESS`` so `ray_tpu.init(address="auto")` inside the
+entrypoint joins this cluster.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+import uuid
+from enum import Enum
+from typing import Dict, List, Optional
+
+_MANAGER_NAME = "__job_manager__"
+
+
+class JobStatus(str, Enum):
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class _JobManager:
+    """Named actor owning job subprocesses (job_manager.py:JobSupervisor,
+    collapsed into one supervisor since subprocesses are cheap here)."""
+
+    def __init__(self):
+        from ray_tpu.core.runtime import get_runtime
+
+        rt = get_runtime()
+        self._address = rt.core.client.address
+        self._log_dir = os.path.join(rt.core.session_dir, "job-logs")
+        os.makedirs(self._log_dir, exist_ok=True)
+        self._jobs: Dict[str, dict] = {}
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, entrypoint: str, job_id: str = "",
+               env: Optional[Dict[str, str]] = None,
+               cwd: Optional[str] = None,
+               metadata: Optional[Dict[str, str]] = None) -> str:
+        job_id = job_id or f"job-{uuid.uuid4().hex[:10]}"
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id!r} already exists")
+            self._jobs[job_id] = {
+                "job_id": job_id, "entrypoint": entrypoint,
+                "status": JobStatus.PENDING.value,
+                "submitted_at": time.time(), "ended_at": None,
+                "returncode": None, "metadata": metadata or {},
+                "log_path": os.path.join(self._log_dir, f"{job_id}.log"),
+            }
+        threading.Thread(target=self._run, args=(job_id, entrypoint, env,
+                                                 cwd),
+                         daemon=True, name=f"job-{job_id}").start()
+        return job_id
+
+    def _run(self, job_id: str, entrypoint: str, env, cwd):
+        info = self._jobs[job_id]
+        child_env = dict(os.environ)
+        child_env.update(env or {})
+        child_env["RAY_TPU_ADDRESS"] = self._address
+        child_env["RAY_TPU_JOB_ID"] = job_id
+        try:
+            with open(info["log_path"], "wb") as log:
+                proc = subprocess.Popen(
+                    entrypoint, shell=True, stdout=log,
+                    stderr=subprocess.STDOUT, cwd=cwd, env=child_env,
+                    start_new_session=True)
+                with self._lock:
+                    self._procs[job_id] = proc
+                    info["status"] = JobStatus.RUNNING.value
+                rc = proc.wait()
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                info["status"] = JobStatus.FAILED.value
+                info["ended_at"] = time.time()
+                info["error"] = str(e)
+            return
+        with self._lock:
+            self._procs.pop(job_id, None)
+            info["returncode"] = rc
+            info["ended_at"] = time.time()
+            if info["status"] == JobStatus.STOPPED.value:
+                pass  # stop() already labelled it
+            elif rc == 0:
+                info["status"] = JobStatus.SUCCEEDED.value
+            else:
+                info["status"] = JobStatus.FAILED.value
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            info = self._jobs.get(job_id)
+            proc = self._procs.get(job_id)
+            if info is None:
+                raise ValueError(f"no job {job_id!r}")
+            if proc is None:
+                return False
+            info["status"] = JobStatus.STOPPED.value
+        try:
+            # signal the whole process group (entrypoint may spawn children)
+            os.killpg(proc.pid, 15)
+        except (ProcessLookupError, PermissionError):
+            pass
+        return True
+
+    def status(self, job_id: str) -> dict:
+        with self._lock:
+            info = self._jobs.get(job_id)
+            if info is None:
+                raise ValueError(f"no job {job_id!r}")
+            return dict(info)
+
+    def logs(self, job_id: str) -> str:
+        info = self.status(job_id)
+        try:
+            with open(info["log_path"], "r", errors="replace") as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return [dict(v) for v in self._jobs.values()]
+
+
+def _manager():
+    import ray_tpu
+    from ray_tpu.core.exceptions import RayTpuError
+
+    try:
+        return ray_tpu.get_actor(_MANAGER_NAME)
+    except (ValueError, RayTpuError):
+        cls = ray_tpu.remote(num_cpus=0.01)(_JobManager)
+        try:
+            return cls.options(name=_MANAGER_NAME).remote()
+        except ValueError:
+            return ray_tpu.get_actor(_MANAGER_NAME)
+
+
+class JobSubmissionClient:
+    """SDK facade (reference dashboard/modules/job/sdk.py:35). With no
+    address, uses the already-initialized runtime; with an address,
+    connects to that cluster first."""
+
+    def __init__(self, address: Optional[str] = None):
+        import ray_tpu
+
+        if address and not ray_tpu.is_initialized():
+            ray_tpu.init(address=address)
+        self._mgr = _manager()
+
+    def _get(self, ref, timeout=30.0):
+        import ray_tpu
+
+        return ray_tpu.get([ref], timeout=timeout)[0]
+
+    def submit_job(self, *, entrypoint: str, job_id: str = "",
+                   runtime_env: Optional[dict] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        env = dict((runtime_env or {}).get("env_vars", {}))
+        cwd = (runtime_env or {}).get("working_dir")
+        return self._get(self._mgr.submit.remote(
+            entrypoint, job_id, env, cwd, metadata))
+
+    def get_job_status(self, job_id: str) -> JobStatus:
+        return JobStatus(self._get(self._mgr.status.remote(job_id))["status"])
+
+    def get_job_info(self, job_id: str) -> dict:
+        return self._get(self._mgr.status.remote(job_id))
+
+    def get_job_logs(self, job_id: str) -> str:
+        return self._get(self._mgr.logs.remote(job_id))
+
+    def stop_job(self, job_id: str) -> bool:
+        return self._get(self._mgr.stop.remote(job_id))
+
+    def list_jobs(self) -> List[dict]:
+        return self._get(self._mgr.list.remote())
+
+    def wait_until_finished(self, job_id: str, timeout: float = 60.0
+                            ) -> JobStatus:
+        deadline = time.monotonic() + timeout
+        terminal = {JobStatus.SUCCEEDED, JobStatus.FAILED, JobStatus.STOPPED}
+        while time.monotonic() < deadline:
+            st = self.get_job_status(job_id)
+            if st in terminal:
+                return st
+            time.sleep(0.2)
+        raise TimeoutError(f"job {job_id} still {st.value} after {timeout}s")

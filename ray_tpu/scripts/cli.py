@@ -1,0 +1,268 @@
+"""Command-line interface.
+
+Capability counterpart of the reference's `ray` CLI
+(python/ray/scripts/scripts.py — start :571, stop :1047, status :1993,
+job submission CLI in dashboard/modules/job/cli.py, state CLI in
+util/state/state_cli.py). Run as ``python -m ray_tpu.scripts.cli`` or
+``python -m ray_tpu``.
+
+Commands:
+  start --head [--num-cpus N] [--num-tpus N] [--dashboard] [--block]
+  stop
+  status
+  list {tasks|actors|nodes|objects|workers|placement_groups}
+  summary {tasks|actors}
+  memory
+  job submit --working-dir D -- <entrypoint...>
+  job {status|logs|stop} <job-id>
+  job list
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+_ADDRESS_FILE = "/tmp/ray_tpu/cluster_address"
+_DASHBOARD_FILE = "/tmp/ray_tpu/dashboard_url"
+
+
+def _client():
+    """Bare control-plane client for read-only commands (no runtime)."""
+    from ray_tpu.core import rpc
+
+    try:
+        with open(_ADDRESS_FILE) as f:
+            addr = f.read().strip()
+    except FileNotFoundError:
+        print("no running cluster (did you `ray-tpu start --head`?)",
+              file=sys.stderr)
+        sys.exit(1)
+    try:
+        return rpc.Client(addr)
+    except OSError:
+        print(f"cluster address file points at {addr} but nothing is "
+              "listening; removing stale file", file=sys.stderr)
+        os.unlink(_ADDRESS_FILE)
+        sys.exit(1)
+
+
+def cmd_start(args):
+    import ray_tpu
+
+    if not args.head:
+        print("only --head is supported (workers join via cluster_utils "
+              "or the autoscaler)", file=sys.stderr)
+        return 1
+    rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+    os.makedirs(os.path.dirname(_ADDRESS_FILE), exist_ok=True)
+    with open(_ADDRESS_FILE, "w") as f:
+        f.write(rt.address)
+    print(f"ray_tpu head started at {rt.address}")
+    print(f"connect with ray_tpu.init(address='auto') or "
+          f"address='{rt.address}'")
+    if args.dashboard:
+        from ray_tpu.dashboard import Dashboard
+
+        dash = Dashboard(rt, port=args.dashboard_port)
+        with open(_DASHBOARD_FILE, "w") as f:
+            f.write(dash.url)
+        print(f"dashboard at {dash.url}")
+    if args.block:
+        stop = []
+        signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+        signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+        while not stop:
+            time.sleep(0.2)
+        ray_tpu.shutdown()
+    else:
+        print("running in background of this process; use --block to wait "
+              "(or keep this python process alive)")
+        signal.pause()
+    return 0
+
+
+def cmd_stop(args):
+    client = _client()
+    try:
+        client.call({"op": "shutdown_cluster"}, timeout=5)
+    except Exception:
+        pass  # server exits mid-reply
+    for path in (_ADDRESS_FILE, _DASHBOARD_FILE):
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+    print("cluster stopped")
+    return 0
+
+
+def _fmt_table(rows, columns):
+    if not rows:
+        print("(none)")
+        return
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in columns}
+    print("  ".join(c.ljust(widths[c]) for c in columns))
+    print("  ".join("-" * widths[c] for c in columns))
+    for r in rows:
+        print("  ".join(str(r.get(c, "")).ljust(widths[c])
+                        for c in columns))
+
+
+def cmd_status(args):
+    client = _client()
+    total = client.call({"op": "cluster_resources"})
+    avail = client.call({"op": "available_resources"})
+    nodes = client.call({"op": "list_nodes"})
+    alive = [n for n in nodes if n["alive"]]
+    print(f"nodes: {len(alive)} alive / {len(nodes)} total")
+    print("resources:")
+    for k in sorted(total):
+        print(f"  {avail.get(k, 0.0):g}/{total[k]:g} {k}")
+    load = client.call({"op": "get_load"})
+    if load["demands"]:
+        print(f"pending demands: {len(load['demands'])}")
+    if load["pg_demands"]:
+        print(f"pending placement groups: {len(load['pg_demands'])}")
+    return 0
+
+
+_LIST_COLUMNS = {
+    "tasks": ["task_id", "name", "state", "duration_s"],
+    "actors": ["actor_id", "class", "name", "state", "pid"],
+    "nodes": ["node_id", "alive", "is_head", "resources"],
+    "objects": ["object_id", "state", "size", "refcount", "in_shm"],
+    "workers": ["worker_id", "kind", "state", "pid"],
+    "placement_groups": ["pg_hex", "strategy", "state", "name"],
+}
+
+
+def cmd_list(args):
+    client = _client()
+    rows = client.call({"op": f"list_{args.kind}"})
+    if args.format == "json":
+        print(json.dumps(rows, default=str, indent=2))
+    else:
+        _fmt_table(rows, _LIST_COLUMNS[args.kind])
+    return 0
+
+
+def cmd_summary(args):
+    client = _client()
+    rows = client.call({"op": f"list_{args.kind}"})
+    from collections import Counter
+
+    by_state = Counter(r.get("state", "?") for r in rows)
+    print(f"{args.kind}: {len(rows)} total")
+    for state, n in sorted(by_state.items()):
+        print(f"  {state}: {n}")
+    return 0
+
+
+def cmd_memory(args):
+    client = _client()
+    rows = client.call({"op": "list_objects"})
+    total = sum(r["size"] or 0 for r in rows)
+    in_shm = sum(r["size"] or 0 for r in rows if r["in_shm"])
+    print(f"objects: {len(rows)}, {total} bytes total, {in_shm} in shm")
+    _fmt_table(sorted(rows, key=lambda r: -(r["size"] or 0))[:20],
+               _LIST_COLUMNS["objects"])
+    return 0
+
+
+def cmd_job(args):
+    import ray_tpu
+    from ray_tpu.job import JobSubmissionClient
+
+    ray_tpu.init(address="auto")
+    client = JobSubmissionClient()
+    if args.job_cmd == "submit":
+        parts = list(args.entrypoint)
+        if parts and parts[0] == "--":
+            parts = parts[1:]
+        import shlex
+
+        entrypoint = " ".join(shlex.quote(p) for p in parts)
+        runtime_env = {}
+        if args.working_dir:
+            runtime_env["working_dir"] = args.working_dir
+        job_id = client.submit_job(entrypoint=entrypoint,
+                                   runtime_env=runtime_env)
+        print(job_id)
+        if args.wait:
+            st = client.wait_until_finished(job_id, timeout=args.timeout)
+            print(st.value)
+            print(client.get_job_logs(job_id), end="")
+            return 0 if st.value == "SUCCEEDED" else 1
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.job_id).value)
+    elif args.job_cmd == "logs":
+        print(client.get_job_logs(args.job_id), end="")
+    elif args.job_cmd == "stop":
+        print(client.stop_job(args.job_id))
+    elif args.job_cmd == "list":
+        _fmt_table(client.list_jobs(),
+                   ["job_id", "status", "entrypoint", "returncode"])
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser("ray-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("start", help="start a cluster head")
+    sp.add_argument("--head", action="store_true")
+    sp.add_argument("--num-cpus", type=float, default=None)
+    sp.add_argument("--num-tpus", type=float, default=None)
+    sp.add_argument("--dashboard", action=argparse.BooleanOptionalAction,
+                    default=True)
+    sp.add_argument("--dashboard-port", type=int, default=0)
+    sp.add_argument("--block", action="store_true")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("stop", help="stop the running cluster")
+    sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("status", help="cluster resources + load")
+    sp.set_defaults(fn=cmd_status)
+
+    sp = sub.add_parser("list", help="list cluster entities")
+    sp.add_argument("kind", choices=sorted(_LIST_COLUMNS))
+    sp.add_argument("--format", choices=["table", "json"], default="table")
+    sp.set_defaults(fn=cmd_list)
+
+    sp = sub.add_parser("summary", help="counts by state")
+    sp.add_argument("kind", choices=["tasks", "actors"])
+    sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("memory", help="object store contents")
+    sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("job", help="job submission")
+    jsub = sp.add_subparsers(dest="job_cmd", required=True)
+    j = jsub.add_parser("submit")
+    j.add_argument("--working-dir", default=None)
+    j.add_argument("--wait", action="store_true")
+    j.add_argument("--timeout", type=float, default=300.0)
+    j.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        j = jsub.add_parser(name)
+        j.add_argument("job_id")
+    jsub.add_parser("list")
+    sp.set_defaults(fn=cmd_job)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args) or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

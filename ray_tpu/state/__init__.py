@@ -1,0 +1,25 @@
+"""State API: list/summarize live cluster entities.
+
+Capability counterpart of the reference's ray.util.state (SURVEY.md P9 —
+state_cli.py + api.py backed by the dashboard StateHead and
+GcsTaskManager). Here the control server is the single source of truth,
+so the SDK reads it directly; the dashboard (ray_tpu.dashboard) serves
+the same data over HTTP.
+"""
+
+from ray_tpu.state.api import (
+    list_actors,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_actors,
+    summarize_tasks,
+)
+
+__all__ = [
+    "list_tasks", "list_actors", "list_objects", "list_nodes",
+    "list_workers", "list_placement_groups", "summarize_tasks",
+    "summarize_actors",
+]

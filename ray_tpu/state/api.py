@@ -1,0 +1,85 @@
+"""State API SDK (reference: python/ray/util/state/api.py).
+
+Each ``list_*`` returns a list of plain dicts (the reference returns
+typed state dataclasses; dicts keep the wire format visible).  Filters
+are ``(key, "=", value)`` / ``(key, "!=", value)`` tuples, matching the
+reference's filter syntax.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu.core.runtime import get_runtime
+
+
+def _apply_filters(rows: List[dict],
+                   filters: Optional[Sequence[Tuple]] = None) -> List[dict]:
+    if not filters:
+        return rows
+    out = []
+    for row in rows:
+        ok = True
+        for key, op, value in filters:
+            have = row.get(key)
+            if op in ("=", "=="):
+                ok = str(have) == str(value)
+            elif op == "!=":
+                ok = str(have) != str(value)
+            else:
+                raise ValueError(f"unsupported filter op {op!r}")
+            if not ok:
+                break
+        if ok:
+            out.append(row)
+    return out
+
+
+def _list(kind: str, filters=None, limit: int = 10000) -> List[dict]:
+    rows = get_runtime().state_list(kind)
+    return _apply_filters(rows, filters)[:limit]
+
+
+def list_tasks(filters=None, limit: int = 10000) -> List[dict]:
+    return _list("tasks", filters, limit)
+
+
+def list_actors(filters=None, limit: int = 10000) -> List[dict]:
+    return _list("actors", filters, limit)
+
+
+def list_objects(filters=None, limit: int = 10000) -> List[dict]:
+    return _list("objects", filters, limit)
+
+
+def list_nodes(filters=None, limit: int = 10000) -> List[dict]:
+    return _list("nodes", filters, limit)
+
+
+def list_workers(filters=None, limit: int = 10000) -> List[dict]:
+    return _list("workers", filters, limit)
+
+
+def list_placement_groups(filters=None, limit: int = 10000) -> List[dict]:
+    return _list("placement_groups", filters, limit)
+
+
+def summarize_tasks() -> Dict[str, Any]:
+    """Counts by state and by function name (reference `ray summary
+    tasks`)."""
+    rows = list_tasks()
+    return {
+        "total": len(rows),
+        "by_state": dict(Counter(r.get("state", "?") for r in rows)),
+        "by_name": dict(Counter(r.get("name", "?") for r in rows)),
+    }
+
+
+def summarize_actors() -> Dict[str, Any]:
+    rows = list_actors()
+    return {
+        "total": len(rows),
+        "by_state": dict(Counter(r.get("state", "?") for r in rows)),
+        "by_class": dict(Counter(r.get("class", "?") for r in rows)),
+    }

@@ -133,7 +133,9 @@ class HostCollectiveGroup:
 
     def allgather(self, array) -> List[np.ndarray]:
         seq = self._next_seq("allgather")
-        local = np.asarray(array)
+        # own copy, not a view: every slot of the result is then an
+        # independent array (other ranks' slots are deserialized copies)
+        local = np.array(array)
         ref = self._publish(self._key("allgather", seq, self.rank), local)
         out = [local if r == self.rank
                else self._fetch(self._key("allgather", seq, r))

@@ -86,18 +86,6 @@ class _WorkflowManager:
         t.start()
         return workflow_id
 
-    def wait(self, workflow_id: str, timeout: Optional[float] = None,
-             root: Optional[str] = None) -> Tuple[str, Optional[str]]:
-        with self._lock:
-            t = self._threads.get(workflow_id)
-        if t is not None:
-            t.join(timeout)
-            if t.is_alive():
-                return WorkflowStatus.RUNNING.value, None
-        meta = WorkflowStorage(workflow_id, root).load_meta() or {}
-        return meta.get("status", WorkflowStatus.RESUMABLE.value), \
-            meta.get("error")
-
     def get_status(self, workflow_id: str,
                    root: Optional[str] = None) -> str:
         with self._lock:
@@ -120,12 +108,18 @@ class _WorkflowManager:
             ex.cancel_ev.set()
 
     def get_output(self, workflow_id: str, root: Optional[str] = None):
-        status, err = self.wait(workflow_id, root=root)
+        """Non-blocking: ("ok", result) | ("running", None) | ("err", msg).
+        Clients poll — a blocking join here would wedge the single-threaded
+        manager and make cancel() unreachable mid-run."""
+        status = self.get_status(workflow_id, root)
+        if status == WorkflowStatus.RUNNING.value:
+            return ("running", None)
         storage = WorkflowStorage(workflow_id, root)
         if status == WorkflowStatus.SUCCESSFUL.value:
             return ("ok", storage.load_result())
+        meta = storage.load_meta() or {}
         return ("err", f"workflow {workflow_id} status={status}: "
-                       f"{err or ''}")
+                       f"{meta.get('error') or ''}")
 
 
 def _manager():
@@ -177,15 +171,24 @@ def resume(workflow_id: str, timeout: Optional[float] = None) -> Any:
 
 
 def get_output(workflow_id: str, timeout: Optional[float] = None) -> Any:
+    import time as _time
+
     import ray_tpu
 
     mgr = _manager()
-    status, payload = ray_tpu.get(
-        [mgr.get_output.remote(workflow_id, storage_root())],
-        timeout=timeout)[0]
-    if status == "ok":
-        return payload
-    raise RuntimeError(payload)
+    deadline = None if timeout is None else _time.monotonic() + timeout
+    while True:
+        status, payload = ray_tpu.get(
+            [mgr.get_output.remote(workflow_id, storage_root())],
+            timeout=timeout)[0]
+        if status == "ok":
+            return payload
+        if status == "err":
+            raise RuntimeError(payload)
+        if deadline is not None and _time.monotonic() > deadline:
+            raise TimeoutError(
+                f"workflow {workflow_id} still running after {timeout}s")
+        _time.sleep(0.1)
 
 
 def get_status(workflow_id: str) -> WorkflowStatus:

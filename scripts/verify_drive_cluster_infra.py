@@ -1,0 +1,67 @@
+"""Drive: autoscaler + state API + jobs + dashboard + CLI address flow."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("RAY_TPU_CHIPS", "none")
+
+import json
+import time
+import urllib.request
+
+import ray_tpu
+
+
+def main():
+    from ray_tpu.autoscaler import (
+        Autoscaler, AutoscalerConfig, FakeMultiNodeProvider, NodeTypeConfig)
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+
+    @ray_tpu.remote(num_cpus=2)
+    def heavy():
+        return os.getpid()
+
+    provider = FakeMultiNodeProvider(cluster)
+    autoscaler = Autoscaler(
+        cluster.runtime.kv().call, provider,
+        AutoscalerConfig(node_types={
+            "cpu2": NodeTypeConfig({"CPU": 2}, max_workers=2)}))
+    ref = heavy.remote()
+    time.sleep(0.3)
+    launched = autoscaler.step()
+    assert launched == {"cpu2": 1}, launched
+    assert ray_tpu.get([ref], timeout=30)[0] > 0
+    print("[1] autoscaler scaled up for pending demand")
+
+    from ray_tpu import state
+
+    assert any(n["is_head"] for n in state.list_nodes())
+    assert state.summarize_tasks()["total"] >= 1
+    print("[2] state api ok")
+
+    from ray_tpu.dashboard import Dashboard
+    from ray_tpu.job import JobSubmissionClient, JobStatus
+
+    dash = Dashboard(cluster.runtime)
+    with urllib.request.urlopen(dash.url + "/api/nodes", timeout=10) as r:
+        nodes = json.loads(r.read())
+    assert len(nodes) >= 2  # head + autoscaled node
+    print("[3] dashboard ok:", dash.url)
+
+    client = JobSubmissionClient()
+    jid = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('drive job')\"")
+    assert client.wait_until_finished(jid, 60) == JobStatus.SUCCEEDED
+    assert "drive job" in client.get_job_logs(jid)
+    print("[4] job submission ok")
+
+    dash.stop()
+    cluster.shutdown()
+    print("CLUSTER INFRA DRIVE OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -594,7 +594,6 @@ int tps_create(void* handle, const uint8_t* id, uint64_t size,
   }
   int64_t idx = table_find(s, id, true);
   if (idx < 0) { unlock(s); return -ENOSPC; }
-  if (s->table()[idx].state == ST_TOMB) s->hdr()->tomb_count--;
 
   uint64_t block = alloc_block(s, size);
   while (block == 0 && evict_ok) {
@@ -605,6 +604,9 @@ int tps_create(void* handle, const uint8_t* id, uint64_t size,
   }
   if (block == 0) { unlock(s); return -ENOMEM; }
 
+  // only now is the slot actually consumed (an -ENOMEM above must leave
+  // the tombstone, and its count, untouched)
+  if (s->table()[idx].state == ST_TOMB) s->hdr()->tomb_count--;
   Entry& e = s->table()[idx];
   memset(&e, 0, sizeof(Entry));
   memcpy(e.id, id, kIdLen);

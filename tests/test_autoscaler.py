@@ -1,0 +1,176 @@
+"""Autoscaler tests (reference: python/ray/tests/test_autoscaler.py with
+MockProvider + test_autoscaler_fake_multinode.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    FakeMultiNodeProvider,
+    NodeTypeConfig,
+    fit_demands,
+)
+from ray_tpu.cluster_utils import Cluster
+
+
+# ---------------------------------------------------------------------------
+# pure bin-packing unit tests
+
+def test_fit_demands_uses_spare_capacity_first():
+    to_add, infeasible = fit_demands(
+        demands=[{"CPU": 1}, {"CPU": 1}],
+        spare_capacity=[{"CPU": 2}],
+        node_types={"cpu4": {"CPU": 4}},
+        max_per_type={"cpu4": 5},
+        current_counts={},
+    )
+    assert to_add == {} and infeasible == []
+
+
+def test_fit_demands_launches_cheapest_feasible_type():
+    to_add, infeasible = fit_demands(
+        demands=[{"CPU": 2}],
+        spare_capacity=[],
+        node_types={"big": {"CPU": 16, "TPU": 4}, "small": {"CPU": 4}},
+        max_per_type={"big": 5, "small": 5},
+        current_counts={},
+    )
+    assert to_add == {"small": 1} and infeasible == []
+
+
+def test_fit_demands_packs_multiple_onto_one_new_node():
+    to_add, _ = fit_demands(
+        demands=[{"CPU": 1}] * 4,
+        spare_capacity=[],
+        node_types={"cpu4": {"CPU": 4}},
+        max_per_type={"cpu4": 5},
+        current_counts={},
+    )
+    assert to_add == {"cpu4": 1}
+
+
+def test_fit_demands_respects_max_per_type():
+    to_add, infeasible = fit_demands(
+        demands=[{"CPU": 4}] * 3,
+        spare_capacity=[],
+        node_types={"cpu4": {"CPU": 4}},
+        max_per_type={"cpu4": 2},
+        current_counts={},
+    )
+    assert to_add == {"cpu4": 2}
+    assert len(infeasible) == 1
+
+
+def test_fit_demands_tpu_demand_picks_tpu_type():
+    to_add, _ = fit_demands(
+        demands=[{"TPU": 4}],
+        spare_capacity=[{"CPU": 64}],
+        node_types={"cpu": {"CPU": 64}, "v4-host": {"TPU": 4, "CPU": 120}},
+        max_per_type={"cpu": 5, "v4-host": 2},
+        current_counts={},
+    )
+    assert to_add == {"v4-host": 1}
+
+
+# ---------------------------------------------------------------------------
+# end-to-end with the fake provider on a live cluster
+
+@pytest.fixture
+def scaling_cluster():
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    import ray_tpu.core.runtime as rt_mod
+
+    yield cluster
+    cluster.shutdown()
+
+
+def _mk_autoscaler(cluster, **cfg_overrides):
+    provider = FakeMultiNodeProvider(cluster)
+    cfg = AutoscalerConfig(
+        node_types={"cpu2": NodeTypeConfig({"CPU": 2}, max_workers=3)},
+        idle_timeout_s=cfg_overrides.pop("idle_timeout_s", 60.0),
+        **cfg_overrides,
+    )
+    return Autoscaler(cluster.runtime.kv().call, provider, cfg)
+
+
+def test_scale_up_on_pending_demand(scaling_cluster):
+    cluster = scaling_cluster
+    autoscaler = _mk_autoscaler(cluster)
+
+    @ray_tpu.remote(num_cpus=2)
+    def heavy():
+        return "ok"
+
+    # head has 1 CPU; this task cannot run until a cpu2 node appears
+    ref = heavy.remote()
+    time.sleep(0.3)  # let the task reach the pending queue
+    launched = autoscaler.step()
+    assert launched == {"cpu2": 1}
+    assert ray_tpu.get([ref], timeout=30)[0] == "ok"
+
+
+def test_scale_up_capped_by_max_workers(scaling_cluster):
+    cluster = scaling_cluster
+    autoscaler = _mk_autoscaler(cluster)
+
+    @ray_tpu.remote(num_cpus=2)
+    def heavy(i):
+        time.sleep(0.5)
+        return i
+
+    refs = [heavy.remote(i) for i in range(8)]
+    time.sleep(0.3)
+    for _ in range(5):
+        autoscaler.step()
+    assert len(autoscaler.provider.non_terminated_nodes()) <= 3
+    assert sorted(ray_tpu.get(refs, timeout=60)) == list(range(8))
+
+
+def test_scale_down_idle_nodes(scaling_cluster):
+    cluster = scaling_cluster
+    autoscaler = _mk_autoscaler(cluster, idle_timeout_s=0.2)
+    nid = autoscaler.provider.create_node("cpu2", {"CPU": 2})
+    assert len(autoscaler.provider.non_terminated_nodes()) == 1
+    autoscaler.step()  # records idle_since
+    time.sleep(0.3)
+    autoscaler.step()  # past timeout: terminate
+    assert autoscaler.provider.non_terminated_nodes() == []
+    alive = [n for n in cluster.list_nodes() if n["alive"]]
+    assert all(n["node_id"] != nid for n in alive)
+
+
+def test_min_workers_maintained(scaling_cluster):
+    cluster = scaling_cluster
+    provider = FakeMultiNodeProvider(cluster)
+    cfg = AutoscalerConfig(
+        node_types={"cpu2": NodeTypeConfig({"CPU": 2}, min_workers=2,
+                                           max_workers=4)},
+        idle_timeout_s=0.01,
+    )
+    autoscaler = Autoscaler(cluster.runtime.kv().call, provider, cfg)
+    autoscaler.step()
+    assert len(provider.non_terminated_nodes()) == 2
+    # idle min_workers nodes are NOT scaled down
+    time.sleep(0.1)
+    autoscaler.step()
+    time.sleep(0.1)
+    autoscaler.step()
+    assert len(provider.non_terminated_nodes()) == 2
+
+
+def test_infeasible_demand_reported(scaling_cluster):
+    cluster = scaling_cluster
+    autoscaler = _mk_autoscaler(cluster)
+
+    @ray_tpu.remote(num_cpus=64)
+    def impossible():
+        return 1
+
+    ref = impossible.remote()  # noqa: F841 held pending forever
+    time.sleep(0.3)
+    autoscaler.step()
+    assert autoscaler.last_infeasible == [{"CPU": 64.0}]

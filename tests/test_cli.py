@@ -1,0 +1,81 @@
+"""CLI smoke tests (reference: python/ray/tests/test_cli.py).
+
+Drives `python -m ray_tpu start/status/list/stop` as real subprocesses
+against an isolated address file (monkeypatched paths).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, **kw):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("RAY_TPU_CHIPS", "none")
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli"] + args,
+        capture_output=True, text=True, timeout=kw.pop("timeout", 60),
+        env=env, **kw)
+
+
+@pytest.fixture
+def cluster_head():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("RAY_TPU_CHIPS", "none")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "start", "--head",
+         "--num-cpus", "2", "--block", "--no-dashboard"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    deadline = time.monotonic() + 30
+    while not os.path.exists("/tmp/ray_tpu/cluster_address"):
+        if time.monotonic() > deadline or proc.poll() is not None:
+            out = proc.stdout.read() if proc.stdout else ""
+            raise RuntimeError(f"head did not start: {out}")
+        time.sleep(0.1)
+    time.sleep(0.3)
+    yield proc
+    _run(["stop"])
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_cli_status_and_list(cluster_head):
+    out = _run(["status"])
+    assert out.returncode == 0, out.stderr
+    assert "nodes: 1 alive" in out.stdout
+    assert "CPU" in out.stdout
+
+    out = _run(["list", "nodes"])
+    assert out.returncode == 0, out.stderr
+    assert "head" in out.stdout
+
+    out = _run(["list", "nodes", "--format", "json"])
+    assert '"alive": true' in out.stdout
+
+
+def test_cli_job_submit_wait(cluster_head):
+    out = _run(["job", "submit", "--wait", "--",
+                sys.executable, "-c", "print('cli job ran')"],
+               timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "SUCCEEDED" in out.stdout
+    assert "cli job ran" in out.stdout
+
+
+def test_cli_stop_then_status_errors(cluster_head):
+    out = _run(["stop"])
+    assert "stopped" in out.stdout
+    out = _run(["status"])
+    assert out.returncode == 1

@@ -1,0 +1,162 @@
+"""Tests: state API SDK, job submission, dashboard HTTP API, CLI basics.
+
+Reference surfaces: ray.util.state (P9), dashboard job module
+(JobSubmissionClient), dashboard HTTP head (P17), scripts.py CLI (P14).
+"""
+
+import json
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import state
+from ray_tpu.job import JobStatus, JobSubmissionClient
+
+
+@ray_tpu.remote
+def tiny():
+    return 1
+
+
+@ray_tpu.remote(num_cpus=0.1)
+class Counter:
+    def inc(self):
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# state SDK
+
+def test_list_tasks_and_summary(ray_start_regular):
+    ray_tpu.get([tiny.remote() for _ in range(3)], timeout=30)
+    rows = state.list_tasks()
+    assert sum(1 for r in rows if r["name"].endswith("tiny")) >= 3
+    summ = state.summarize_tasks()
+    assert summ["total"] >= 3
+    assert "FINISHED" in summ["by_state"]
+
+
+def test_list_actors_with_filter(ray_start_regular):
+    c = Counter.remote()
+    ray_tpu.get([c.inc.remote()], timeout=30)
+    alive = state.list_actors(filters=[("state", "=", "ALIVE")])
+    assert any(r["class"] == "Counter" for r in alive)
+    ray_tpu.kill(c)
+
+
+def test_list_nodes_and_workers(ray_start_regular):
+    nodes = state.list_nodes()
+    assert any(n["is_head"] for n in nodes)
+    workers = state.list_workers()
+    assert len(workers) >= 1
+
+
+# ---------------------------------------------------------------------------
+# job submission
+
+def test_job_submit_and_logs(ray_start_regular):
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"print('hello from job')\"")
+    st = client.wait_until_finished(job_id, timeout=60)
+    assert st == JobStatus.SUCCEEDED
+    assert "hello from job" in client.get_job_logs(job_id)
+    info = client.get_job_info(job_id)
+    assert info["returncode"] == 0
+
+
+def test_job_failure_status(ray_start_regular):
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import sys; sys.exit(3)\"")
+    assert client.wait_until_finished(job_id, 60) == JobStatus.FAILED
+    assert client.get_job_info(job_id)["returncode"] == 3
+
+
+def test_job_stop(ray_start_regular):
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"import time; time.sleep(60)\"")
+    deadline = time.monotonic() + 10
+    while client.get_job_status(job_id) != JobStatus.RUNNING:
+        assert time.monotonic() < deadline
+        time.sleep(0.1)
+    assert client.stop_job(job_id)
+    assert client.wait_until_finished(job_id, 30) == JobStatus.STOPPED
+
+
+def test_job_entrypoint_joins_cluster(ray_start_regular):
+    """The submitted driver connects back via address='auto' and runs a
+    task on this cluster."""
+    script = (
+        "import ray_tpu; "
+        "ray_tpu.init(address='auto'); "
+        "print('nodes:', len(ray_tpu.cluster_resources()))"
+    )
+    client = JobSubmissionClient()
+    job_id = client.submit_job(
+        entrypoint=f"{sys.executable} -c \"{script}\"")
+    st = client.wait_until_finished(job_id, timeout=120)
+    logs = client.get_job_logs(job_id)
+    assert st == JobStatus.SUCCEEDED, logs
+    assert "nodes:" in logs
+
+
+# ---------------------------------------------------------------------------
+# dashboard
+
+@pytest.fixture
+def dashboard(ray_start_regular):
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(get_runtime())
+    yield dash
+    dash.stop()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_dashboard_endpoints(dashboard):
+    ray_tpu.get([tiny.remote()], timeout=30)
+    base = dashboard.url
+    assert _get_json(f"{base}/api/version")["version"]
+    nodes = _get_json(f"{base}/api/nodes")
+    assert any(n["is_head"] for n in nodes)
+    tasks = _get_json(f"{base}/api/tasks")
+    assert isinstance(tasks, list)
+    res = _get_json(f"{base}/api/cluster_resources")
+    assert "CPU" in res
+    stats = _get_json(f"{base}/api/object_store_stats")
+    assert "capacity" in stats
+    with urllib.request.urlopen(f"{base}/api/healthz", timeout=10) as r:
+        assert r.read() == b"success"
+
+
+def test_dashboard_job_routes(dashboard):
+    base = dashboard.url
+    req = urllib.request.Request(
+        f"{base}/api/jobs",
+        data=json.dumps({
+            "entrypoint": f"{sys.executable} -c \"print('via http')\"",
+        }).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=15) as resp:
+        job_id = json.loads(resp.read())["job_id"]
+    client = JobSubmissionClient()
+    assert client.wait_until_finished(job_id, 60) == JobStatus.SUCCEEDED
+    with urllib.request.urlopen(f"{base}/api/jobs/{job_id}/logs",
+                                timeout=10) as resp:
+        assert b"via http" in resp.read()
+
+
+def test_dashboard_404(dashboard):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{dashboard.url}/api/nope", timeout=10)
+    assert ei.value.code == 404
