@@ -71,6 +71,11 @@ class _JobManager:
         child_env["RAY_TPU_ADDRESS"] = self._address
         child_env["RAY_TPU_JOB_ID"] = job_id
         try:
+            with self._lock:
+                # stop() may have landed before the subprocess launched.
+                if info["status"] == JobStatus.STOPPED.value:
+                    info["ended_at"] = time.time()
+                    return
             with open(info["log_path"], "wb") as log:
                 proc = subprocess.Popen(
                     entrypoint, shell=True, stdout=log,
@@ -78,7 +83,15 @@ class _JobManager:
                     start_new_session=True)
                 with self._lock:
                     self._procs[job_id] = proc
-                    info["status"] = JobStatus.RUNNING.value
+                    if info["status"] == JobStatus.STOPPED.value:
+                        # stop() raced between the check above and Popen:
+                        # kill what we just started.
+                        try:
+                            os.killpg(proc.pid, 15)
+                        except (ProcessLookupError, PermissionError):
+                            pass
+                    else:
+                        info["status"] = JobStatus.RUNNING.value
                 rc = proc.wait()
         except Exception as e:  # noqa: BLE001
             with self._lock:
@@ -104,6 +117,11 @@ class _JobManager:
             if info is None:
                 raise ValueError(f"no job {job_id!r}")
             if proc is None:
+                # Not launched yet (PENDING window): record the stop
+                # intent; _run honors it before/right after Popen.
+                if info["status"] == JobStatus.PENDING.value:
+                    info["status"] = JobStatus.STOPPED.value
+                    return True
                 return False
             info["status"] = JobStatus.STOPPED.value
         try:

@@ -12,9 +12,14 @@ from ray_tpu.rl.env_runner_group import EnvRunnerGroup
 from ray_tpu.rl.episode import SingleAgentEpisode, episodes_to_batch
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
-from ray_tpu.rl.module import RLModuleSpec
+from ray_tpu.rl.module import QNetworkSpec, RLModuleSpec, SACModuleSpec
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
 
 __all__ = [
+    "PrioritizedReplayBuffer",
+    "QNetworkSpec",
+    "ReplayBuffer",
+    "SACModuleSpec",
     "Algorithm",
     "AlgorithmConfig",
     "SingleAgentEnvRunner",

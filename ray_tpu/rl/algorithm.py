@@ -13,6 +13,8 @@ import pickle
 import time
 from typing import Any, Dict, Optional
 
+import numpy as np
+
 from ray_tpu.rl.config import AlgorithmConfig
 from ray_tpu.rl.env_runner_group import EnvRunnerGroup
 from ray_tpu.tune.trainable import Trainable
@@ -48,6 +50,7 @@ class Algorithm(Trainable):
             config.make_env_fn(),
             num_env_runners=config.num_env_runners,
             num_envs_per_runner=config.num_envs_per_env_runner,
+            spec=self._make_runner_spec(),
             seed=config.seed,
             restart_failed=config.restart_failed_env_runners,
             num_cpus_per_runner=config.num_cpus_per_env_runner)
@@ -55,6 +58,11 @@ class Algorithm(Trainable):
         # Runners start from the learner's weights.
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         self._setup_done = True
+
+    def _make_runner_spec(self):
+        """Module spec for env runners; None → infer actor-critic spec
+        from the env (module.spec_for_env). DQN/SAC override."""
+        return None
 
     def _build_learner_group(self, config: AlgorithmConfig):
         raise NotImplementedError
@@ -74,6 +82,35 @@ class Algorithm(Trainable):
     def train(self) -> Dict[str, Any]:
         """Standalone alias for step() (reference Algorithm.train)."""
         return self.step()
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        """Greedy-policy evaluation on the local runner (reference:
+        Algorithm.evaluate / evaluation_config with explore=False).
+        Essential for eps-greedy algorithms like DQN, whose behavior-policy
+        returns understate the learned policy."""
+        runner = self.env_runner_group.local_runner
+        runner.set_weights(self.learner_group.get_weights())
+        was_exploring = runner.explore
+        runner.explore = False
+        # Evaluation must not leak into training state: snapshot the
+        # lifetime counters (they drive the epsilon schedule) and the
+        # rolling return window, and restore them afterward.
+        saved_metrics = {k: (list(v) if isinstance(v, list) else v)
+                         for k, v in runner.metrics.items()}
+        try:
+            episodes = runner.sample(num_episodes=num_episodes,
+                                     force_reset=True)
+        finally:
+            runner.explore = was_exploring
+            runner.metrics = saved_metrics
+            # Next training sample() starts from a clean reset rather than
+            # continuing evaluation episodes.
+            runner._obs = None
+        returns = [e.total_reward for e in episodes]
+        return {
+            "evaluation/episode_return_mean": float(np.mean(returns)),
+            "evaluation/num_episodes": len(returns),
+        }
 
     # -- checkpointing (reference: Algorithm is Checkpointable) ------------
     def save_checkpoint(self, checkpoint_dir: str) -> None:

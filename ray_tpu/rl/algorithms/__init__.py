@@ -1,3 +1,28 @@
+from ray_tpu.rl.algorithms.bc import (
+    BC,
+    BCConfig,
+    MARWIL,
+    MARWILConfig,
+    MARWILLearner,
+)
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rl.algorithms.impala import (
+    APPO,
+    APPOConfig,
+    APPOLearner,
+    IMPALA,
+    IMPALAConfig,
+    IMPALALearner,
+)
 from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig, PPOLearner
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig, SACLearner
 
-__all__ = ["PPO", "PPOConfig", "PPOLearner"]
+__all__ = [
+    "APPO", "APPOConfig", "APPOLearner",
+    "BC", "BCConfig",
+    "DQN", "DQNConfig", "DQNLearner",
+    "IMPALA", "IMPALAConfig", "IMPALALearner",
+    "MARWIL", "MARWILConfig", "MARWILLearner",
+    "PPO", "PPOConfig", "PPOLearner",
+    "SAC", "SACConfig", "SACLearner",
+]

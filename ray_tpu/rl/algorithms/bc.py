@@ -1,0 +1,151 @@
+"""Offline RL: MARWIL (advantage-weighted BC) and plain Behavior Cloning.
+
+Counterpart of the reference's rllib/algorithms/marwil/ (marwil.py; BC =
+MARWIL with beta=0, rllib/algorithms/bc/) and the offline-input slice of
+rllib/offline/. Offline data here is a list of SingleAgentEpisode (in
+memory, or a pickle file path) — the natural exchange format between the
+env runners and learners everywhere in this stack; Monte-Carlo returns are
+computed once at load, and every SGD step samples a fixed-shape transition
+batch from host numpy arrays (same shape discipline as ppo.py).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import module as rl_module
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.episode import SingleAgentEpisode
+from ray_tpu.rl.learner import JaxLearner
+from ray_tpu.rl.learner_group import LearnerGroup
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MARWIL
+        self.beta: float = 1.0          # 0 → pure behavior cloning
+        self.vf_coeff: float = 1.0
+        self.train_batch_size: int = 256
+        self.num_sgd_iter: int = 16     # SGD steps per training_step
+        self.lr: float = 1e-3
+        # offline_data()
+        self.input_episodes: Optional[List[SingleAgentEpisode]] = None
+        self.input_path: Optional[str] = None
+
+    def offline_data(self, *, input_episodes=None, input_path=None
+                     ) -> "MARWILConfig":
+        if input_episodes is not None:
+            self.input_episodes = input_episodes
+        if input_path is not None:
+            self.input_path = input_path
+        return self
+
+
+class BCConfig(MARWILConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = BC
+        self.beta = 0.0
+
+
+class MARWILLearner(JaxLearner):
+    def __init__(self, spec, *, beta: float = 1.0, vf_coeff: float = 1.0,
+                 **kwargs):
+        super().__init__(spec, **kwargs)
+        self.beta = beta
+        self.vf_coeff = vf_coeff
+
+    def loss(self, params, batch: Dict[str, jnp.ndarray], rng):
+        dist_inputs, values = rl_module.forward(params, batch["obs"])
+        dist = self.spec.dist(dist_inputs)
+        logp = dist.logp(batch["actions"])
+        if self.beta > 0.0:
+            adv = batch["returns"] - values
+            # In-batch RMS normalization of advantages (reference keeps a
+            # running MA of adv²; per-batch is the stateless equivalent).
+            adv_n = adv / (jnp.sqrt(jnp.mean(adv ** 2)) + 1e-8)
+            weights = jnp.exp(jnp.clip(self.beta
+                                       * jax.lax.stop_gradient(adv_n),
+                                       -10.0, 10.0))
+            policy_loss = -jnp.mean(weights * logp)
+            vf_loss = jnp.mean(adv ** 2)
+        else:
+            policy_loss = -jnp.mean(logp)
+            vf_loss = jnp.asarray(0.0)
+        total = policy_loss + self.vf_coeff * vf_loss
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "bc_logp": jnp.mean(logp),
+        }
+
+
+class MARWIL(Algorithm):
+    config_class = MARWILConfig
+    learner_class = MARWILLearner
+
+    def _setup_from_config(self, config: "MARWILConfig") -> None:
+        episodes = config.input_episodes
+        if episodes is None and config.input_path:
+            with open(config.input_path, "rb") as f:
+                episodes = pickle.load(f)
+        if not episodes:
+            raise ValueError(
+                "MARWIL/BC needs offline data: config.offline_data("
+                "input_episodes=...) or input_path=...")
+        self._dataset = self._episodes_to_rows(episodes, config.gamma)
+        self._np_rng = np.random.default_rng(config.seed)
+        super()._setup_from_config(config)
+
+    @staticmethod
+    def _episodes_to_rows(episodes: List[SingleAgentEpisode], gamma: float
+                          ) -> Dict[str, np.ndarray]:
+        obs, actions, returns = [], [], []
+        for ep in episodes:
+            ep = ep.finalize()
+            T = len(ep)
+            g = np.zeros(T, dtype=np.float32)
+            acc = 0.0
+            for t in range(T - 1, -1, -1):
+                acc = ep.rewards[t] + gamma * acc
+                g[t] = acc
+            obs.append(np.asarray(ep.obs[:-1]).reshape(T, -1))
+            actions.append(np.asarray(ep.actions))
+            returns.append(g)
+        return {
+            "obs": np.concatenate(obs).astype(np.float32),
+            "actions": np.concatenate(actions),
+            "returns": np.concatenate(returns),
+        }
+
+    def _build_learner_group(self, config: "MARWILConfig") -> LearnerGroup:
+        return LearnerGroup(
+            self.learner_class,
+            dict(spec=self.env_runner_group.spec, beta=config.beta,
+                 vf_coeff=config.vf_coeff, learning_rate=config.lr,
+                 grad_clip=config.grad_clip, seed=config.seed,
+                 mesh_axes=config.mesh_axes),
+            num_learners=config.num_learners)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: MARWILConfig = self.config
+        n = self._dataset["obs"].shape[0]
+        metrics: Dict[str, Any] = {}
+        for _ in range(cfg.num_sgd_iter):
+            idx = self._np_rng.integers(0, n, size=cfg.train_batch_size)
+            batch = {k: v[idx] for k, v in self._dataset.items()}
+            metrics.update(self.learner_group.update_from_batch(batch))
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        metrics["num_offline_rows"] = n
+        return metrics
+
+
+class BC(MARWIL):
+    config_class = BCConfig

@@ -1,0 +1,188 @@
+"""DQN (Rainbow-lite): double Q, dueling nets, n-step, prioritized replay.
+
+Counterpart of the reference's rllib/algorithms/dqn/ (dqn.py DQNConfig /
+`_training_step_new_api_stack`: sample → add to replay → K SGD rounds on
+sampled minibatches → periodic target-net sync → weight broadcast) with
+the torch learner's loss (dqn_rainbow_torch_learner.py) re-done as one
+jitted JAX update.
+
+TPU-first discipline: the replay buffer is host-side numpy (bookkeeping),
+while every SGD step runs on one fixed [train_batch_size] transition batch
+— a single compiled XLA program for the whole run. The target network
+rides inside the params pytree ({"online", "target"}, module.QNetworkSpec)
+so weight sync / checkpointing / learner-group fan-out need no special
+cases; `update_target` is a host-side tree copy every
+`target_network_update_freq` gradient steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import module as rl_module
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import JaxLearner
+from ray_tpu.rl.learner_group import LearnerGroup
+from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DQN
+        # training() knobs (reference dqn.py DQNConfig.training()).
+        self.train_batch_size: int = 32
+        self.lr: float = 5e-4
+        self.grad_clip: float = 40.0
+        self.double_q: bool = True
+        self.dueling: bool = True
+        self.hidden_sizes: Tuple[int, ...] = (256, 256)
+        self.n_step: int = 1
+        self.target_network_update_freq: int = 500  # in gradient steps
+        self.num_steps_sampled_before_learning_starts: int = 1000
+        self.rollout_fragment_length: int = 64
+        # ~training_intensity: gradient steps per env step sampled.
+        self.training_intensity: float = 1.0
+        # replay
+        self.replay_buffer_capacity: int = 100_000
+        self.prioritized_replay: bool = True
+        self.prioritized_replay_alpha: float = 0.6
+        self.prioritized_replay_beta: float = 0.4
+        # exploration
+        self.epsilon_initial: float = 1.0
+        self.epsilon_final: float = 0.05
+        self.epsilon_timesteps: int = 10_000
+
+
+class DQNLearner(JaxLearner):
+    def __init__(self, spec: rl_module.QNetworkSpec, *, gamma: float = 0.99,
+                 double_q: bool = True, **kwargs):
+        super().__init__(spec, **kwargs)
+        self.gamma = gamma
+        self.double_q = double_q
+
+    def loss(self, params, batch: Dict[str, jnp.ndarray], rng):
+        spec: rl_module.QNetworkSpec = self.spec
+        online, target = params["online"], jax.lax.stop_gradient(
+            jax.tree.map(lambda x: x, params["target"]))
+        q_all = spec.q_values(online, batch["obs"])
+        actions = batch["actions"].astype(jnp.int32)
+        q_taken = jnp.take_along_axis(
+            q_all, actions[:, None], axis=-1).squeeze(-1)
+
+        q_next_target = spec.q_values(target, batch["next_obs"])
+        if self.double_q:
+            # Action chosen by the online net, valued by the target net.
+            next_a = jnp.argmax(
+                spec.q_values(online, batch["next_obs"]), axis=-1)
+            q_next = jnp.take_along_axis(
+                q_next_target, next_a[:, None], axis=-1).squeeze(-1)
+        else:
+            q_next = jnp.max(q_next_target, axis=-1)
+        y = batch["rewards"] + batch["discounts"] * (
+            1.0 - batch["dones"]) * jax.lax.stop_gradient(q_next)
+        td = q_taken - y
+        # Huber loss, importance-weighted for prioritized replay.
+        huber = jnp.where(jnp.abs(td) < 1.0, 0.5 * td ** 2,
+                          jnp.abs(td) - 0.5)
+        loss = jnp.mean(batch["weights"] * huber)
+        return loss, {
+            "qf_loss": loss,
+            "qf_mean": jnp.mean(q_taken),
+            "td_abs": jnp.abs(td),  # per-sample: consumed by PER, not logged
+        }
+
+    def update_target(self) -> None:
+        """Hard target sync (reference: target_network_update_freq)."""
+        self.params = {
+            "online": self.params["online"],
+            "target": jax.tree.map(lambda x: x, self.params["online"]),
+        }
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+
+    def _setup_from_config(self, config: "DQNConfig") -> None:
+        # Build the Q-spec from the env before runners spin up, so every
+        # runner/learner shares one frozen spec.
+        env = config.make_env_fn()()
+        try:
+            obs_dim = int(np.prod(env.observation_space.shape))
+            assert isinstance(env.action_space, gym.spaces.Discrete), \
+                "DQN requires a Discrete action space"
+            n_actions = int(env.action_space.n)
+        finally:
+            env.close()
+        self._spec = rl_module.QNetworkSpec(
+            obs_dim=obs_dim, action_dim=n_actions,
+            hidden_sizes=tuple(config.hidden_sizes),
+            dueling=config.dueling,
+            epsilon_initial=config.epsilon_initial,
+            epsilon_final=config.epsilon_final,
+            epsilon_timesteps=config.epsilon_timesteps)
+        prioritized = config.prioritized_replay
+        if prioritized and config.num_learners > 0:
+            # Remote learners return only scalar aux (the per-sample TD
+            # errors PER needs stay on the learner actor), so priorities
+            # would silently never update — fall back to uniform replay
+            # loudly instead.
+            import warnings
+            warnings.warn(
+                "prioritized_replay requires a local learner "
+                "(num_learners=0); falling back to uniform replay")
+            prioritized = False
+        buffer_cls = PrioritizedReplayBuffer if prioritized else ReplayBuffer
+        kwargs: Dict[str, Any] = dict(
+            n_step=config.n_step, gamma=config.gamma, seed=config.seed)
+        if prioritized:
+            kwargs.update(alpha=config.prioritized_replay_alpha,
+                          beta=config.prioritized_replay_beta)
+        self.replay = buffer_cls(config.replay_buffer_capacity, **kwargs)
+        self._grad_steps = 0
+        super()._setup_from_config(config)
+
+    def _make_runner_spec(self):
+        return self._spec
+
+    def _build_learner_group(self, config: "DQNConfig") -> LearnerGroup:
+        return LearnerGroup(
+            DQNLearner,
+            dict(spec=self._spec, gamma=config.gamma,
+                 double_q=config.double_q, learning_rate=config.lr,
+                 grad_clip=config.grad_clip, seed=config.seed,
+                 mesh_axes=config.mesh_axes),
+            num_learners=config.num_learners)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: DQNConfig = self.config
+        episodes = self.env_runner_group.sample(
+            num_env_steps=cfg.rollout_fragment_length)
+        steps_added = self.replay.add_episodes(episodes)
+        metrics: Dict[str, Any] = {"num_env_steps_sampled": steps_added,
+                                   "replay_buffer_size": len(self.replay)}
+        if len(self.replay) < cfg.num_steps_sampled_before_learning_starts:
+            return metrics
+
+        num_updates = max(1, round(cfg.training_intensity * steps_added
+                                   / cfg.train_batch_size))
+        local = self.learner_group.local_learner
+        for _ in range(num_updates):
+            batch = self.replay.sample(cfg.train_batch_size)
+            metrics.update(self.learner_group.update_from_batch(batch))
+            if local is not None and "td_abs" in getattr(
+                    local, "last_aux", {}):
+                self.replay.update_priorities(
+                    batch["indices"], np.asarray(local.last_aux["td_abs"]))
+            self._grad_steps += 1
+            if self._grad_steps % cfg.target_network_update_freq == 0:
+                self.learner_group.foreach_learner("update_target")
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        metrics["num_grad_steps"] = self._grad_steps
+        return metrics

@@ -1,0 +1,301 @@
+"""IMPALA (+ APPO): asynchronous sampling with V-trace off-policy correction.
+
+Counterpart of the reference's rllib/algorithms/impala/ (impala.py — env
+runners sample continuously, a learner thread consumes a queue of batches,
+V-trace corrects the policy lag; rllib/execution/learner_thread.py) and
+rllib/algorithms/appo/ (IMPALA machinery + PPO surrogate clipping).
+
+Architecture here: env-runner actors run sample() requests that the driver
+keeps permanently in flight (submit → wait(num_returns=1) → consume →
+resubmit), so sampling overlaps learning without a dedicated thread; the
+latest weights are pushed to a runner asynchronously right before its next
+sample request (one object-store put per broadcast, N async reads —
+the reference's broadcast_interval). V-trace itself is O(T) sequential
+host numpy between sampling and SGD (like GAE in ppo.py); the SGD step is
+the usual single jitted program on a fixed [train_batch_size] batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rl import module as rl_module
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.episode import SingleAgentEpisode
+from ray_tpu.rl.learner import JaxLearner
+from ray_tpu.rl.learner_group import LearnerGroup
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = IMPALA
+        self.train_batch_size: int = 512
+        self.rollout_fragment_length: int = 64
+        self.lr: float = 5e-4
+        self.grad_clip: float = 40.0
+        self.vf_loss_coeff: float = 0.5
+        self.entropy_coeff: float = 0.01
+        self.vtrace_clip_rho_threshold: float = 1.0
+        self.vtrace_clip_c_threshold: float = 1.0
+        self.normalize_advantages: bool = True
+        # SGD passes over each consumed batch (reference: APPO's
+        # num_sgd_iter / minibatch reuse; keep 1 for pure IMPALA).
+        self.num_sgd_iter: int = 1
+        # Push fresh weights to a runner every N consumed sample batches.
+        self.broadcast_interval: int = 1
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_param: float = 0.2
+
+
+class IMPALALearner(JaxLearner):
+    def __init__(self, spec, *, vf_loss_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, **kwargs):
+        super().__init__(spec, **kwargs)
+        self.vf_loss_coeff = vf_loss_coeff
+        self.entropy_coeff = entropy_coeff
+
+    def policy_terms(self, ratio, logp, adv):
+        """Per-sample policy objective (to be mask-mean'd by the caller).
+        IMPALA: plain policy gradient on V-trace advantages (the rho
+        clipping already happened inside the advantage computation)."""
+        return -(logp * adv)
+
+    def loss(self, params, batch: Dict[str, jnp.ndarray], rng):
+        dist_inputs, values = rl_module.forward(params, batch["obs"])
+        dist = self.spec.dist(dist_inputs)
+        logp = dist.logp(batch["actions"])
+        mask = batch["mask"]
+        denom = jnp.maximum(mask.sum(), 1.0)
+
+        def mmean(x):
+            return (x * mask).sum() / denom
+
+        ratio = jnp.exp(logp - batch["logp"])
+        # Mask-normalize the policy term like vf/entropy so the loss
+        # balance is invariant to batch padding.
+        pg_loss = mmean(self.policy_terms(ratio, logp,
+                                          batch["advantages"]))
+        vf_loss = mmean((values - batch["value_targets"]) ** 2)
+        entropy = mmean(dist.entropy())
+        total = (pg_loss + self.vf_loss_coeff * vf_loss
+                 - self.entropy_coeff * entropy)
+        return total, {
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "mean_ratio": mmean(ratio),
+        }
+
+
+class APPOLearner(IMPALALearner):
+    def __init__(self, spec, *, clip_param: float = 0.2, **kwargs):
+        super().__init__(spec, **kwargs)
+        self.clip_param = clip_param
+
+    def policy_terms(self, ratio, logp, adv):
+        # APPO: PPO surrogate on the behavior/target ratio with V-trace
+        # advantages (reference appo_torch_learner.py).
+        surrogate = jnp.minimum(
+            ratio * adv,
+            jnp.clip(ratio, 1 - self.clip_param, 1 + self.clip_param) * adv)
+        return -surrogate
+
+
+def compute_vtrace(episodes: List[SingleAgentEpisode], params, spec,
+                   gamma: float, rho_clip: float = 1.0, c_clip: float = 1.0
+                   ) -> List[Dict[str, np.ndarray]]:
+    """V-trace targets/advantages (Espeholt et al. 2018) per episode.
+
+    One batched forward evaluates the CURRENT policy's values and logp on
+    every step of every episode (behavior logp rides in the episodes);
+    the backward recursion is O(T) host numpy.
+    """
+    obs_all = np.concatenate(
+        [np.asarray(e.obs).reshape(len(e.obs), -1) for e in episodes])
+    dist_inputs, values_all = rl_module.forward(params, jnp.asarray(obs_all))
+    dist_inputs = np.asarray(dist_inputs)
+    values_all = np.asarray(values_all)
+
+    out: List[Dict[str, np.ndarray]] = []
+    off = 0
+    for ep in episodes:
+        T = len(ep)
+        n = T + 1
+        di = dist_inputs[off:off + n]
+        v = values_all[off:off + n].astype(np.float32)
+        off += n
+        actions = np.asarray(ep.actions)
+        target_logp = np.asarray(
+            spec.dist(jnp.asarray(di[:T])).logp(jnp.asarray(actions)),
+            dtype=np.float32)
+        behavior_logp = np.asarray(ep.logp, dtype=np.float32)
+        rho = np.minimum(np.exp(target_logp - behavior_logp), rho_clip)
+        c = np.minimum(np.exp(target_logp - behavior_logp), c_clip)
+        rewards = np.asarray(ep.rewards, dtype=np.float32)
+        v_t = v[:T]
+        v_next = v[1:].copy()
+        if ep.terminated:
+            v_next[-1] = 0.0
+        deltas = rho * (rewards + gamma * v_next - v_t)
+        # vs[t] - v[t] accumulated backward.
+        vs_minus_v = np.zeros(T + 1, dtype=np.float32)
+        for t in range(T - 1, -1, -1):
+            nxt = vs_minus_v[t + 1] if t + 1 < T else 0.0
+            vs_minus_v[t] = deltas[t] + gamma * c[t] * nxt
+        vs = v_t + vs_minus_v[:T]
+        vs_next = np.empty(T, dtype=np.float32)
+        vs_next[:-1] = vs[1:]
+        vs_next[-1] = v_next[-1]
+        pg_adv = rho * (rewards + gamma * vs_next - v_t)
+        out.append({
+            "obs": np.asarray(ep.obs[:-1]).reshape(T, -1).astype(np.float32),
+            "actions": actions,
+            "logp": behavior_logp,
+            "advantages": pg_adv,
+            "value_targets": vs,
+        })
+    return out
+
+
+class IMPALA(Algorithm):
+    config_class = IMPALAConfig
+    learner_class = IMPALALearner
+
+    def _setup_from_config(self, config) -> None:
+        # (ObjectRef, runner_index) sample requests kept in flight.
+        self._inflight: List[Tuple[Any, int]] = []
+        self._weights_ref = None
+        self._batches_since_broadcast = 0
+        super()._setup_from_config(config)
+
+    def _learner_kwargs(self, config) -> Dict[str, Any]:
+        return dict(spec=self.env_runner_group.spec,
+                    vf_loss_coeff=config.vf_loss_coeff,
+                    entropy_coeff=config.entropy_coeff,
+                    learning_rate=config.lr, grad_clip=config.grad_clip,
+                    seed=config.seed, mesh_axes=config.mesh_axes)
+
+    def _build_learner_group(self, config) -> LearnerGroup:
+        return LearnerGroup(self.learner_class,
+                            self._learner_kwargs(config),
+                            num_learners=config.num_learners)
+
+    # -- async sampling ----------------------------------------------------
+    def _collect_episode_lists(self) -> List[List[SingleAgentEpisode]]:
+        cfg: IMPALAConfig = self.config
+        grp = self.env_runner_group
+        if not grp.remote_runners:
+            return [grp.local_runner.sample(
+                num_env_steps=cfg.rollout_fragment_length)]
+        if self._weights_ref is None:
+            self._weights_ref = ray_tpu.put(
+                self.learner_group.get_weights())
+        if not self._inflight:
+            for i, r in enumerate(grp.remote_runners):
+                self._inflight.append((r.sample.remote(
+                    num_env_steps=cfg.rollout_fragment_length), i))
+        ready, _ = ray_tpu.wait([ref for ref, _ in self._inflight],
+                                num_returns=1, timeout=120)
+        ready_set = set(ready)
+        collected: List[List[SingleAgentEpisode]] = []
+        next_inflight: List[Tuple[Any, int]] = []
+        for ref, i in self._inflight:
+            if ref not in ready_set:
+                next_inflight.append((ref, i))
+                continue
+            try:
+                res = ray_tpu.get(ref, timeout=60)
+                grp._lifetime_steps[i + 1] = (
+                    grp._lifetime_steps.get(i + 1, 0)
+                    + sum(len(e) for e in res))
+                collected.append(res)
+            except Exception:
+                # Runner died: replace it in the group (this is the only
+                # gather on the async path, so restart must happen here).
+                if grp.restart_failed and i < len(grp.remote_runners):
+                    try:
+                        ray_tpu.kill(grp.remote_runners[i])
+                    except Exception:
+                        pass
+                    grp.remote_runners[i] = grp._make_runner(i + 1)
+                    grp.remote_runners[i].set_lifetime_steps.remote(
+                        grp._lifetime_steps.get(i + 1, 0))
+            if i < len(grp.remote_runners):
+                r = grp.remote_runners[i]
+                # Fire-and-forget weight push, then the next sample request
+                # — the actor's ordered queue guarantees set_weights lands
+                # before sample starts.
+                r.set_weights.remote(self._weights_ref)
+                next_inflight.append((r.sample.remote(
+                    num_env_steps=cfg.rollout_fragment_length), i))
+        self._inflight = next_inflight
+        return collected
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: IMPALAConfig = self.config
+        episode_lists = self._collect_episode_lists()
+        metrics: Dict[str, Any] = {}
+        trained = 0
+        params = self.learner_group.get_weights()
+        for episodes in episode_lists:
+            if not episodes:
+                continue
+            rows = compute_vtrace(
+                episodes, params, self.env_runner_group.spec, cfg.gamma,
+                cfg.vtrace_clip_rho_threshold, cfg.vtrace_clip_c_threshold)
+            flat = {k: np.concatenate([r[k] for r in rows])
+                    for k in rows[0]}
+            n = flat["obs"].shape[0]
+            target = cfg.train_batch_size
+            mask = np.ones(n, dtype=np.float32)
+            if n < target:
+                pad = target - n
+                flat = {k: np.concatenate(
+                    [v, np.zeros((pad,) + v.shape[1:], dtype=v.dtype)])
+                    for k, v in flat.items()}
+                mask = np.concatenate([mask,
+                                       np.zeros(pad, dtype=np.float32)])
+            else:
+                flat = {k: v[:target] for k, v in flat.items()}
+                mask = mask[:target]
+            flat["mask"] = mask
+            if cfg.normalize_advantages:
+                valid = mask > 0
+                mean = flat["advantages"][valid].mean()
+                std = flat["advantages"][valid].std() + 1e-8
+                flat["advantages"] = np.where(
+                    valid, (flat["advantages"] - mean) / std, 0.0
+                ).astype(np.float32)
+            for _ in range(cfg.num_sgd_iter):
+                metrics.update(self.learner_group.update_from_batch(flat))
+            trained += min(n, target)
+            self._batches_since_broadcast += 1
+        if self._batches_since_broadcast >= cfg.broadcast_interval:
+            w = self.learner_group.get_weights()
+            self.env_runner_group.local_runner.set_weights(w)
+            self._weights_ref = ray_tpu.put(w) \
+                if self.env_runner_group.remote_runners else None
+            self._batches_since_broadcast = 0
+        metrics["num_env_steps_trained"] = trained
+        return metrics
+
+
+class APPO(IMPALA):
+    config_class = APPOConfig
+    learner_class = APPOLearner
+
+    def _learner_kwargs(self, config) -> Dict[str, Any]:
+        kw = super()._learner_kwargs(config)
+        kw["clip_param"] = config.clip_param
+        return kw

@@ -1,0 +1,162 @@
+"""Soft Actor-Critic for continuous control.
+
+Counterpart of the reference's rllib/algorithms/sac/ (sac.py SACConfig,
+sac_torch_learner.py: separate critic/actor/alpha optimizers with NCCL DDP)
+— re-done TPU-first as ONE jitted update: the combined loss computes the
+twin-critic TD loss, the reparameterized actor loss against
+stop-gradient'd critic params, and the automatic temperature loss in a
+single XLA program; the polyak target-network average rides the learner's
+`post_apply` hook so it happens inside the same compiled step. Replay and
+env stepping stay host-side (replay_buffer.py / env_runner.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import module as rl_module
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import JaxLearner
+from ray_tpu.rl.learner_group import LearnerGroup
+from ray_tpu.rl.replay_buffer import ReplayBuffer
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = SAC
+        self.train_batch_size: int = 256
+        self.lr: float = 3e-4
+        self.grad_clip: float = 40.0
+        self.tau: float = 0.005                 # polyak rate
+        self.target_entropy: Any = "auto"       # auto → -action_dim
+        self.n_step: int = 1
+        self.hidden_sizes: Tuple[int, ...] = (256, 256)
+        self.rollout_fragment_length: int = 64
+        self.training_intensity: float = 0.25   # grad steps per env step
+        self.num_steps_sampled_before_learning_starts: int = 1000
+        self.replay_buffer_capacity: int = 100_000
+
+
+class SACLearner(JaxLearner):
+    def __init__(self, spec: rl_module.SACModuleSpec, *,
+                 gamma: float = 0.99, tau: float = 0.005,
+                 target_entropy: float = -1.0, **kwargs):
+        super().__init__(spec, **kwargs)
+        self.gamma = gamma
+        self.tau = tau
+        self.target_entropy = target_entropy
+
+    def loss(self, params, batch: Dict[str, jnp.ndarray], rng):
+        spec: rl_module.SACModuleSpec = self.spec
+        sg = jax.lax.stop_gradient
+        k_next, k_new = jax.random.split(rng)
+        alpha = jnp.exp(params["log_alpha"])
+
+        # -- twin-critic TD loss ------------------------------------------
+        a_next, logp_next = spec.sample_action(
+            sg(params["actor"]), batch["next_obs"], k_next)
+        a_next, logp_next = sg(a_next), sg(logp_next)
+        q_next = jnp.minimum(
+            spec.q_value(params["target_q1"], batch["next_obs"], a_next),
+            spec.q_value(params["target_q2"], batch["next_obs"], a_next))
+        y = sg(batch["rewards"] + batch["discounts"]
+               * (1.0 - batch["dones"]) * (q_next - sg(alpha) * logp_next))
+        q1 = spec.q_value(params["q1"], batch["obs"], batch["actions"])
+        q2 = spec.q_value(params["q2"], batch["obs"], batch["actions"])
+        critic_loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+
+        # -- actor loss (critic params frozen via stop_gradient) ----------
+        a_new, logp_new = spec.sample_action(
+            params["actor"], batch["obs"], k_new)
+        q_new = jnp.minimum(
+            spec.q_value(sg(params["q1"]), batch["obs"], a_new),
+            spec.q_value(sg(params["q2"]), batch["obs"], a_new))
+        actor_loss = jnp.mean(sg(alpha) * logp_new - q_new)
+
+        # -- temperature loss (reference: automatic entropy tuning) -------
+        alpha_loss = -params["log_alpha"] * jnp.mean(
+            sg(logp_new) + self.target_entropy)
+
+        total = critic_loss + actor_loss + alpha_loss
+        return total, {
+            "critic_loss": critic_loss,
+            "actor_loss": actor_loss,
+            "alpha_loss": alpha_loss,
+            "alpha": alpha,
+            "entropy": -jnp.mean(logp_new),
+            "q1_mean": jnp.mean(q1),
+        }
+
+    def post_apply(self, params):
+        """Polyak target update, fused into the compiled optimizer step."""
+        tau = self.tau
+        mix = lambda t, o: (1.0 - tau) * t + tau * o  # noqa: E731
+        return {
+            **params,
+            "target_q1": jax.tree.map(mix, params["target_q1"],
+                                      params["q1"]),
+            "target_q2": jax.tree.map(mix, params["target_q2"],
+                                      params["q2"]),
+        }
+
+
+class SAC(Algorithm):
+    config_class = SACConfig
+
+    def _setup_from_config(self, config: "SACConfig") -> None:
+        env = config.make_env_fn()()
+        try:
+            assert isinstance(env.action_space, gym.spaces.Box), \
+                "SAC requires a Box (continuous) action space"
+            obs_dim = int(np.prod(env.observation_space.shape))
+            act_dim = int(np.prod(env.action_space.shape))
+            low = tuple(float(x) for x in env.action_space.low.ravel())
+            high = tuple(float(x) for x in env.action_space.high.ravel())
+        finally:
+            env.close()
+        self._spec = rl_module.SACModuleSpec(
+            obs_dim=obs_dim, action_dim=act_dim,
+            action_low=low, action_high=high,
+            hidden_sizes=tuple(config.hidden_sizes))
+        self._target_entropy = (
+            -float(act_dim) if config.target_entropy == "auto"
+            else float(config.target_entropy))
+        self.replay = ReplayBuffer(
+            config.replay_buffer_capacity, n_step=config.n_step,
+            gamma=config.gamma, seed=config.seed)
+        super()._setup_from_config(config)
+
+    def _make_runner_spec(self):
+        return self._spec
+
+    def _build_learner_group(self, config: "SACConfig") -> LearnerGroup:
+        return LearnerGroup(
+            SACLearner,
+            dict(spec=self._spec, gamma=config.gamma, tau=config.tau,
+                 target_entropy=self._target_entropy,
+                 learning_rate=config.lr, grad_clip=config.grad_clip,
+                 seed=config.seed, mesh_axes=config.mesh_axes),
+            num_learners=config.num_learners)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: SACConfig = self.config
+        episodes = self.env_runner_group.sample(
+            num_env_steps=cfg.rollout_fragment_length)
+        steps_added = self.replay.add_episodes(episodes)
+        metrics: Dict[str, Any] = {"num_env_steps_sampled": steps_added,
+                                   "replay_buffer_size": len(self.replay)}
+        if len(self.replay) < cfg.num_steps_sampled_before_learning_starts:
+            return metrics
+        num_updates = max(1, round(cfg.training_intensity * steps_added))
+        for _ in range(num_updates):
+            batch = self.replay.sample(cfg.train_batch_size)
+            metrics.update(self.learner_group.update_from_batch(batch))
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return metrics

@@ -59,21 +59,29 @@ class SingleAgentEnvRunner:
             "episode_returns": [],  # rolling window of completed returns
         }
 
+        spec = self.spec
+
         @jax.jit
         def _act(params, obs, key, explore_flag):
-            dist_inputs, value = rl_module.forward(params, obs)
-            dist = self.spec.dist(dist_inputs)
-            action = jax.lax.cond(
-                explore_flag,
-                lambda: dist.sample(key),
-                lambda: dist.deterministic())
-            return action, dist.logp(action), value
+            # Dispatch through the spec's module protocol (module.py) so
+            # Q-networks / SAC actors plug in without runner changes.
+            return spec.act(params, obs, key, explore_flag)
 
         self._act = _act
+        # Host-side epsilon-greedy (specs with an epsilon_timesteps
+        # schedule, e.g. QNetworkSpec): annealed as a pure function of
+        # lifetime env steps, so restarted runners resume the schedule.
+        self._np_rng = np.random.default_rng(seed * 10007 + worker_index)
 
     # -- weight sync (reference: EnvRunner.set_state / get_state) ----------
     def set_weights(self, params) -> None:
         self.params = jax.device_put(params)
+
+    def set_lifetime_steps(self, n: int) -> None:
+        """Resume the lifetime step counter (epsilon schedules are a pure
+        function of it) — called after a runner restart so exploration
+        doesn't restart from epsilon_initial."""
+        self.metrics["num_env_steps_sampled_lifetime"] = int(n)
 
     def get_weights(self):
         return jax.device_get(self.params)
@@ -111,6 +119,18 @@ class SingleAgentEnvRunner:
             action, logp, value = self._act(
                 self.params, jnp.asarray(self._obs), key, self.explore)
             action_np = np.asarray(action)
+            eps_steps = getattr(self.spec, "epsilon_timesteps", 0)
+            if self.explore and eps_steps:
+                t = self.metrics["num_env_steps_sampled_lifetime"] + steps
+                frac = min(1.0, t / eps_steps)
+                eps = (self.spec.epsilon_initial
+                       + frac * (self.spec.epsilon_final
+                                 - self.spec.epsilon_initial))
+                take_random = self._np_rng.random(self.num_envs) < eps
+                random_actions = self._np_rng.integers(
+                    0, self.spec.action_dim, self.num_envs)
+                action_np = np.where(take_random, random_actions,
+                                     action_np).astype(action_np.dtype)
             env_action = action_np
             if not self.spec.discrete:
                 env_action = np.clip(

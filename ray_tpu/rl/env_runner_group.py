@@ -38,6 +38,9 @@ class EnvRunnerGroup:
         self.spec = self.local_runner.spec
         self._actor_cls = ray_tpu.remote(SingleAgentEnvRunner)
         self.remote_runners: List[Any] = []
+        # Per-runner lifetime env-step estimates (index 0 = local runner),
+        # used to resume epsilon schedules on runner restarts.
+        self._lifetime_steps: Dict[int, int] = {}
         for i in range(num_env_runners):
             self.remote_runners.append(self._make_runner(i + 1))
 
@@ -79,8 +82,11 @@ class EnvRunnerGroup:
                 for r in self.remote_runners]
         results = self._gather(refs, restart_indices=True)
         episodes: List[Any] = []
-        for res in results:
+        for i, res in enumerate(results):
             if res is not None:
+                self._lifetime_steps[i + 1] = (
+                    self._lifetime_steps.get(i + 1, 0)
+                    + sum(len(e) for e in res))
                 episodes.extend(res)
         if not episodes:  # all runners died this round: fall back local
             episodes = self.local_runner.sample(
@@ -122,9 +128,13 @@ class EnvRunnerGroup:
                     except Exception:
                         pass
                     self.remote_runners[i] = self._make_runner(i + 1)
-                    # Freshly restarted runner needs current weights.
+                    # Freshly restarted runner needs current weights and
+                    # its lifetime counter (epsilon schedule) resumed.
                     try:
-                        ray_tpu.get(self.remote_runners[i].set_weights.remote(
+                        new = self.remote_runners[i]
+                        new.set_lifetime_steps.remote(
+                            self._lifetime_steps.get(i + 1, 0))
+                        ray_tpu.get(new.set_weights.remote(
                             self.local_runner.get_weights()), timeout=60)
                     except Exception:
                         pass

@@ -55,6 +55,12 @@ class JaxLearner:
              ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         raise NotImplementedError
 
+    def post_apply(self, params):
+        """Jittable hook run on params after every optimizer step (inside
+        the compiled update). Default: identity. SAC overrides this with
+        the polyak target-network average."""
+        return params
+
     # -- update ------------------------------------------------------------
     def _build_update(self):
         def one_step(params, opt_state, batch, rng):
@@ -62,6 +68,7 @@ class JaxLearner:
                 self.loss, has_aux=True)(params, batch, rng)
             updates, opt_state = self.tx.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            params = self.post_apply(params)
             aux = dict(aux)
             aux["total_loss"] = loss_val
             aux["grad_norm"] = optax.global_norm(grads)
@@ -90,7 +97,11 @@ class JaxLearner:
         batch_j = {k: jnp.asarray(v) for k, v in batch.items()}
         self.params, self.opt_state, aux = self._jit_update(
             self.params, self.opt_state, batch_j, sub)
-        self.metrics = {k: float(v) for k, v in aux.items()}
+        # Non-scalar aux (e.g. per-sample TD errors for prioritized replay)
+        # is kept on self.last_aux; metrics stay scalar floats.
+        self.last_aux = aux
+        self.metrics = {k: float(v) for k, v in aux.items()
+                        if np.ndim(v) == 0}
         return self.metrics
 
     # -- split gradient API (reference learner.py:446–568) -----------------
@@ -109,13 +120,15 @@ class JaxLearner:
         self.rng, sub = jax.random.split(self.rng)
         batch_j = {k: jnp.asarray(v) for k, v in batch.items()}
         grads, aux = self._jit_grad(self.params, batch_j, sub)
-        return jax.device_get(grads), {k: float(v) for k, v in aux.items()}
+        return jax.device_get(grads), {k: float(v) for k, v in aux.items()
+                                       if np.ndim(v) == 0}
 
     def apply_gradients(self, grads) -> None:
         if self._jit_apply is None:
             def apply_fn(params, opt_state, grads):
                 updates, opt_state = self.tx.update(grads, opt_state, params)
-                return optax.apply_updates(params, updates), opt_state
+                params = optax.apply_updates(params, updates)
+                return self.post_apply(params), opt_state
             self._jit_apply = jax.jit(apply_fn)
         self.params, self.opt_state = self._jit_apply(
             self.params, self.opt_state, jax.device_put(grads))

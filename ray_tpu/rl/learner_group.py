@@ -80,6 +80,16 @@ class LearnerGroup:
         return {k: float(np.mean([a[k] for a in auxes]))
                 for k in auxes[0]}
 
+    def foreach_learner(self, method: str, *args, **kwargs) -> List[Any]:
+        """Call a learner method everywhere (reference: LearnerGroup's
+        additional_update / foreach_learner fan-out). Used for e.g. DQN
+        target-network syncs."""
+        if self.local_learner is not None:
+            return [getattr(self.local_learner, method)(*args, **kwargs)]
+        return ray_tpu.get([
+            getattr(l, method).remote(*args, **kwargs)
+            for l in self.remote_learners])
+
     def get_weights(self):
         if self.local_learner is not None:
             return self.local_learner.get_weights()

@@ -98,6 +98,25 @@ class RLModuleSpec:
     def dist(self, inputs: jnp.ndarray):
         return Categorical(inputs) if self.discrete else DiagGaussian(inputs)
 
+    # -- module protocol (overridable by algorithm-specific specs) ---------
+    # Specs are frozen (hashable) dataclasses, so these methods are static
+    # w.r.t. jit: env runners and learners close over the spec and trace
+    # `act` once per compiled shape.
+
+    def init(self, key) -> Dict[str, Any]:
+        return init_params(self, key)
+
+    def act(self, params, obs: jnp.ndarray, key, explore: jnp.ndarray
+            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Jittable action selection: returns (action, logp, value)."""
+        dist_inputs, value = forward(params, obs)
+        dist = self.dist(dist_inputs)
+        action = jax.lax.cond(
+            explore,
+            lambda: dist.sample(key),
+            lambda: dist.deterministic())
+        return action, dist.logp(action), value
+
 
 def _init_mlp(key, sizes: Sequence[int], scale_last: float) -> Dict[str, Any]:
     layers = []
@@ -120,7 +139,9 @@ def _mlp(params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
-def init_params(spec: RLModuleSpec, key) -> Dict[str, Any]:
+def init_params(spec, key) -> Dict[str, Any]:
+    if not isinstance(spec, RLModuleSpec):
+        return spec.init(key)  # QNetworkSpec / SACModuleSpec / custom
     k_pi, k_v = jax.random.split(key)
     pi_sizes = [spec.obs_dim, *spec.hidden_sizes, spec.dist_inputs_dim]
     v_sizes = [spec.obs_dim, *spec.hidden_sizes, 1]
@@ -135,6 +156,146 @@ def forward(params: Dict[str, Any], obs: jnp.ndarray
     """Returns (dist_inputs, value). Pure; safe inside jit."""
     obs = obs.astype(jnp.float32)
     return _mlp(params["pi"], obs), _mlp(params["vf"], obs).squeeze(-1)
+
+
+# ---------------------------------------------------------------------------
+# Q-network module (DQN family)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class QNetworkSpec:
+    """Q-network over Discrete(n) actions with host-side epsilon-greedy.
+
+    Counterpart of the reference's DQN catalog/RLModule
+    (rllib/algorithms/dqn/). Params hold BOTH the online and target nets
+    ({"online": ..., "target": ...}) so the whole thing moves through the
+    learner-group weight-sync / checkpoint paths as one pytree; the target
+    net sees zero gradients (stop_gradient in the loss).
+
+    Epsilon-greedy exploration is annealed host-side by the env runner as a
+    pure function of lifetime env steps (epsilon_* fields below), so there
+    is no mutable exploration state to broadcast.
+    """
+
+    obs_dim: int
+    action_dim: int
+    hidden_sizes: Sequence[int] = (256, 256)
+    dueling: bool = True
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_timesteps: int = 10_000
+
+    discrete = True  # replay/env-runner compatibility with RLModuleSpec
+
+    def init(self, key) -> Dict[str, Any]:
+        online = self._init_one(key)
+        # Same key → identical target init; first hard update is a no-op.
+        return {"online": online, "target": self._init_one(key)}
+
+    def _init_one(self, key) -> Dict[str, Any]:
+        k_a, k_v = jax.random.split(key)
+        adv_sizes = [self.obs_dim, *self.hidden_sizes, self.action_dim]
+        net = {"adv": _init_mlp(k_a, adv_sizes, scale_last=0.01)}
+        if self.dueling:
+            v_sizes = [self.obs_dim, *self.hidden_sizes, 1]
+            net["val"] = _init_mlp(k_v, v_sizes, scale_last=1.0)
+        return net
+
+    def q_values(self, net: Dict[str, Any], obs: jnp.ndarray) -> jnp.ndarray:
+        """Q(s, ·) for one net ("online" or "target" subtree)."""
+        obs = obs.astype(jnp.float32)
+        adv = _mlp(net["adv"], obs)
+        if not self.dueling:
+            return adv
+        val = _mlp(net["val"], obs)
+        return val + adv - adv.mean(axis=-1, keepdims=True)
+
+    def act(self, params, obs, key, explore):
+        q = self.q_values(params["online"], obs)
+        action = jnp.argmax(q, axis=-1)
+        return action, jnp.zeros(q.shape[:-1]), jnp.max(q, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# SAC module: tanh-squashed Gaussian actor + twin Q critics
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SACModuleSpec:
+    """Soft actor-critic module for Box action spaces.
+
+    Counterpart of the reference's SAC catalog (rllib/algorithms/sac/).
+    Actions are env-scaled: the tanh output in [-1, 1] is affinely mapped to
+    [action_low, action_high] (tuples, so the spec stays hashable/static),
+    and the log-prob carries the tanh + affine Jacobian corrections. Critics
+    take concat(obs, env_action). Target critics live in the params pytree
+    and are polyak-averaged by the learner's post_apply hook.
+    """
+
+    obs_dim: int
+    action_dim: int
+    action_low: Tuple[float, ...] = ()
+    action_high: Tuple[float, ...] = ()
+    hidden_sizes: Sequence[int] = (256, 256)
+    log_std_min: float = -20.0
+    log_std_max: float = 2.0
+
+    discrete = False
+
+    def _bounds(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        low = jnp.asarray(self.action_low or (-1.0,) * self.action_dim)
+        high = jnp.asarray(self.action_high or (1.0,) * self.action_dim)
+        return low, high
+
+    def init(self, key) -> Dict[str, Any]:
+        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        pi_sizes = [self.obs_dim, *self.hidden_sizes, 2 * self.action_dim]
+        q_sizes = [self.obs_dim + self.action_dim, *self.hidden_sizes, 1]
+        q1 = _init_mlp(k_q1, q_sizes, scale_last=1.0)
+        q2 = _init_mlp(k_q2, q_sizes, scale_last=1.0)
+        return {
+            "actor": _init_mlp(k_pi, pi_sizes, scale_last=0.01),
+            "q1": q1, "q2": q2,
+            "target_q1": jax.tree.map(jnp.copy, q1),
+            "target_q2": jax.tree.map(jnp.copy, q2),
+            "log_alpha": jnp.zeros(()),
+        }
+
+    def sample_action(self, actor_params, obs, key, *, deterministic=False):
+        """Reparameterized sample → (env_action, logp). Jittable."""
+        obs = obs.astype(jnp.float32)
+        out = _mlp(actor_params, obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.log_std_min, self.log_std_max)
+        noise = jax.random.normal(key, mean.shape)
+        u = jnp.where(deterministic, mean, mean + jnp.exp(log_std) * noise)
+        # Gaussian logp of u, then tanh + affine change-of-variables.
+        var = jnp.exp(2 * log_std)
+        logp = jnp.sum(
+            -0.5 * ((u - mean) ** 2 / var + 2 * log_std
+                    + jnp.log(2 * jnp.pi)), axis=-1)
+        a = jnp.tanh(u)
+        logp -= jnp.sum(jnp.log(1.0 - a ** 2 + 1e-6), axis=-1)
+        low, high = self._bounds()
+        scale = (high - low) / 2.0
+        logp -= jnp.sum(jnp.log(scale))
+        env_action = low + (a + 1.0) * scale
+        return env_action, logp
+
+    def q_value(self, q_params, obs, action) -> jnp.ndarray:
+        x = jnp.concatenate(
+            [obs.astype(jnp.float32), action.astype(jnp.float32)], axis=-1)
+        return _mlp(q_params, x).squeeze(-1)
+
+    def act(self, params, obs, key, explore):
+        action, logp = jax.lax.cond(
+            explore,
+            lambda: self.sample_action(params["actor"], obs, key),
+            lambda: self.sample_action(params["actor"], obs, key,
+                                       deterministic=True))
+        value = jnp.minimum(self.q_value(params["q1"], obs, action),
+                            self.q_value(params["q2"], obs, action))
+        return action, logp, value
 
 
 def spec_for_env(env) -> RLModuleSpec:

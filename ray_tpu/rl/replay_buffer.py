@@ -1,0 +1,152 @@
+"""Replay buffers: uniform and prioritized transition storage.
+
+Counterpart of the reference's rllib/utils/replay_buffers/ —
+EpisodeReplayBuffer / PrioritizedEpisodeReplayBuffer (proportional PER,
+Schaul et al.) reduced to the TPU-first essentials: transitions live in
+preallocated numpy ring buffers on the host (replay is host bookkeeping —
+the chips only ever see the sampled fixed-shape batch), and `sample()`
+always returns one fixed-shape dict so the learner's jitted update never
+recompiles.
+
+N-step returns are folded in at insert time: a transition stores the
+n-step discounted reward, the obs n steps ahead, and its effective
+discount gamma^k (k < n at episode ends), so the TD target in the loss is
+always `reward + discount * (1 - done) * Q(next_obs)`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rl.episode import SingleAgentEpisode
+
+
+class ReplayBuffer:
+    """Uniform-sampling transition ring buffer."""
+
+    def __init__(self, capacity: int = 100_000, *, n_step: int = 1,
+                 gamma: float = 0.99, seed: int = 0):
+        self.capacity = int(capacity)
+        self.n_step = int(n_step)
+        self.gamma = float(gamma)
+        self._rng = np.random.default_rng(seed)
+        self._storage: Optional[Dict[str, np.ndarray]] = None
+        self._next = 0
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    # -- insert ------------------------------------------------------------
+    def _alloc(self, obs: np.ndarray, action: np.ndarray) -> None:
+        cap = self.capacity
+        self._storage = {
+            "obs": np.zeros((cap,) + obs.shape, dtype=np.float32),
+            "actions": np.zeros((cap,) + action.shape, dtype=action.dtype),
+            "rewards": np.zeros(cap, dtype=np.float32),
+            "next_obs": np.zeros((cap,) + obs.shape, dtype=np.float32),
+            "dones": np.zeros(cap, dtype=np.float32),
+            "discounts": np.zeros(cap, dtype=np.float32),
+        }
+
+    def _insert(self, row: Dict[str, np.ndarray]) -> int:
+        i = self._next
+        for k, v in row.items():
+            self._storage[k][i] = v
+        self._next = (self._next + 1) % self.capacity
+        self._size = min(self._size + 1, self.capacity)
+        return i
+
+    def add_episodes(self, episodes: List[SingleAgentEpisode]) -> int:
+        """Unroll episodes into n-step transitions. Returns rows added."""
+        added = 0
+        for ep in episodes:
+            ep = ep.finalize()
+            T = len(ep)
+            if T == 0:
+                continue
+            obs = np.asarray(ep.obs, dtype=np.float32)
+            obs = obs.reshape(T + 1, -1) if obs.ndim > 2 else obs
+            actions = np.asarray(ep.actions)
+            rewards = np.asarray(ep.rewards, dtype=np.float32)
+            if self._storage is None:
+                self._alloc(obs[0], actions[0])
+            for t in range(T):
+                k = min(self.n_step, T - t)
+                r = 0.0
+                for j in range(k):
+                    r += (self.gamma ** j) * rewards[t + j]
+                # done only if the n-step window hits a true terminal;
+                # truncation bootstraps through the final obs instead.
+                is_end = (t + k == T) and ep.terminated
+                self._add_row({
+                    "obs": obs[t],
+                    "actions": actions[t],
+                    "rewards": np.float32(r),
+                    "next_obs": obs[t + k],
+                    "dones": np.float32(is_end),
+                    "discounts": np.float32(self.gamma ** k),
+                })
+                added += 1
+        return added
+
+    def _add_row(self, row: Dict[str, np.ndarray]) -> None:
+        self._insert(row)
+
+    # -- sample ------------------------------------------------------------
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        assert self._size > 0, "cannot sample from an empty buffer"
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        batch = {k: v[idx] for k, v in self._storage.items()}
+        batch["weights"] = np.ones(batch_size, dtype=np.float32)
+        batch["indices"] = idx.astype(np.int32)
+        return batch
+
+    def update_priorities(self, indices: np.ndarray,
+                          td_errors: np.ndarray) -> None:
+        pass  # uniform buffer: no-op (keeps the caller code uniform)
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (Schaul et al. 2016).
+
+    Sampling probability ∝ (|td| + eps)^alpha; importance weights
+    (N * p)^-beta normalized by their max. Uses a cumsum + searchsorted
+    draw — O(N) vectorized per sample call, plenty at host scale.
+    """
+
+    def __init__(self, capacity: int = 100_000, *, alpha: float = 0.6,
+                 beta: float = 0.4, eps: float = 1e-6, **kwargs):
+        super().__init__(capacity, **kwargs)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.eps = float(eps)
+        self._priorities = np.zeros(self.capacity, dtype=np.float64)
+        self._max_priority = 1.0
+
+    def _add_row(self, row: Dict[str, np.ndarray]) -> None:
+        i = self._insert(row)
+        self._priorities[i] = self._max_priority ** self.alpha
+
+    def sample(self, batch_size: int) -> Dict[str, np.ndarray]:
+        assert self._size > 0, "cannot sample from an empty buffer"
+        p = self._priorities[:self._size]
+        cdf = np.cumsum(p)
+        total = cdf[-1]
+        draws = self._rng.random(batch_size) * total
+        idx = np.minimum(np.searchsorted(cdf, draws), self._size - 1)
+        probs = p[idx] / total
+        weights = (self._size * probs) ** (-self.beta)
+        weights = weights / weights.max()
+        batch = {k: v[idx] for k, v in self._storage.items()}
+        batch["weights"] = weights.astype(np.float32)
+        batch["indices"] = idx.astype(np.int32)
+        return batch
+
+    def update_priorities(self, indices: np.ndarray,
+                          td_errors: np.ndarray) -> None:
+        prios = np.abs(np.asarray(td_errors, dtype=np.float64)) + self.eps
+        self._priorities[np.asarray(indices)] = prios ** self.alpha
+        self._max_priority = max(self._max_priority, float(prios.max()))
