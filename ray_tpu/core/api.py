@@ -24,6 +24,7 @@ def init(num_cpus: Optional[float] = None,
          namespace: str = "",
          address: Optional[str] = None,
          ignore_reinit_error: bool = True,
+         log_to_driver: bool = True,
          _system_config: Optional[dict] = None) -> DriverRuntime:
     """Start the single-host runtime (control plane + worker pool), or —
     with ``address=`` — connect this driver to a running cluster
@@ -38,6 +39,7 @@ def init(num_cpus: Optional[float] = None,
     return DriverRuntime(
         num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
         namespace=namespace, address=address,
+        log_to_driver=log_to_driver,
         _system_config=_system_config)
 
 

@@ -28,7 +28,8 @@ class DriverRuntime:
                  resources: Optional[dict] = None,
                  _system_config: Optional[dict] = None,
                  namespace: str = "",
-                 address: Optional[str] = None):
+                 address: Optional[str] = None,
+                 log_to_driver: bool = True):
         """Head mode (default): start the control plane in-process.
         Connect mode (``address=``): attach this driver to an existing
         cluster's control server — counterpart of ray.init(address=...)
@@ -54,6 +55,12 @@ class DriverRuntime:
         if address:
             self.session_dir = self.core.session_dir
         self.namespace = namespace
+        # Worker stdout/stderr → driver console (reference log_monitor.py
+        # behavior; see core/log_monitor.py).
+        self.log_monitor = None
+        if log_to_driver:
+            from ray_tpu.core.log_monitor import LogMonitor
+            self.log_monitor = LogMonitor(self.session_dir).start()
         self.is_initialized = True
         set_runtime(self)
         atexit.register(self._atexit)
@@ -138,6 +145,16 @@ class DriverRuntime:
             return
         self.is_initialized = False
         set_runtime(None)
+        if self.log_monitor is not None:
+            try:
+                self.log_monitor.stop()
+            except Exception:
+                pass
+        try:
+            from ray_tpu.util.usage_stats import write_usage_report
+            write_usage_report(self.session_dir)
+        except Exception:
+            pass
         try:
             self.core.close()
         except Exception:

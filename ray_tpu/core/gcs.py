@@ -686,6 +686,11 @@ class ControlServer:
             return [
                 {"task_id": h, "name": r.spec.name, "state": r.state,
                  "worker": r.worker_hex,
+                 "submitted_at": r.submitted_at or None,
+                 "started_at": r.started_at or None,
+                 "finished_at": r.finished_at or None,
+                 "pid": (self.workers.get(r.worker_hex).pid
+                         if r.worker_hex in self.workers else None),
                  "duration_s": (r.finished_at - r.started_at)
                  if r.finished_at else None}
                 for h, r in self.tasks.items()
@@ -1362,6 +1367,10 @@ class ControlServer:
         env["RAY_TPU_ENV_KEY"] = env_key
         env["RAY_TPU_NAMESPACE"] = self.namespace
         env["RAY_TPU_NODE_ID"] = node_id
+        # Line-visible worker output: without this, task print()s sit in
+        # the child's block buffer until exit and the driver-side log
+        # monitor streams them far too late.
+        env["PYTHONUNBUFFERED"] = "1"
         # pyarrow's bundled jemalloc segfaults under this kernel (observed
         # SIGSEGV inside table allocation paths); the system allocator is
         # reliable and plenty fast for block-sized allocations.

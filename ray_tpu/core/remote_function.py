@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, Optional
 
 import cloudpickle
@@ -67,6 +68,16 @@ class RemoteFunction:
             runtime_env=self._runtime_env,
             scheduling_strategy=self._scheduling_strategy,
         )
+        # Opt-in tracing (util/tracing.py — reference tracing_helper wraps
+        # _remote the same way): record the submission as a span; the
+        # execution slice is correlated later by task_id from the cluster
+        # task records.
+        from ray_tpu.util import tracing
+        if tracing.is_tracing_enabled():
+            now = time.time()
+            tracing.record_span(
+                f"submit:{self._name}", now, now,
+                attributes={"object_ref": refs[0].hex()})
         if self._num_returns == 1:
             return refs[0]
         return refs

@@ -21,3 +21,8 @@ __all__ = [
     "DAGNode", "InputNode", "FunctionNode", "ClassMethodNode",
     "MultiOutputNode",
 ]
+
+# Feature-usage tag (util/usage_stats.py; local-only, no egress).
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("dag")
+del _rlu

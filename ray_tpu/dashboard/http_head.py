@@ -108,6 +108,15 @@ class Dashboard:
             return {"capacity": cap, "used": used, "num_objects": n,
                     "evicted_bytes": evicted,
                     "native": rt.core.store.native}
+        if path == "/metrics":
+            # Prometheus scrape endpoint (reference: per-node MetricsAgent
+            # re-exporting Prometheus; here one endpoint serves built-in
+            # state gauges + every process's published user metrics).
+            from ray_tpu.util.metrics import aggregate_prometheus_text
+            return aggregate_prometheus_text(rt)
+        if path == "/api/timeline":
+            from ray_tpu.util.timeline import timeline_events
+            return timeline_events(rt)
         if path == "/api/jobs":
             return self._jobs().list_jobs()
         if path.startswith("/api/jobs/"):

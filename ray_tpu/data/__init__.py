@@ -44,3 +44,8 @@ __all__ = [
     "read_numpy",
     "read_parquet",
 ]
+
+# Feature-usage tag (util/usage_stats.py; local-only, no egress).
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("data")
+del _rlu

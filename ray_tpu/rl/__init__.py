@@ -30,3 +30,8 @@ __all__ = [
     "LearnerGroup",
     "RLModuleSpec",
 ]
+
+# Feature-usage tag (util/usage_stats.py; local-only, no egress).
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("rl")
+del _rlu

@@ -47,7 +47,9 @@ class DQNConfig(AlgorithmConfig):
         self.target_network_update_freq: int = 500  # in gradient steps
         self.num_steps_sampled_before_learning_starts: int = 1000
         self.rollout_fragment_length: int = 64
-        # ~training_intensity: gradient steps per env step sampled.
+        # Transitions trained per transition sampled (reference dqn.py
+        # training_intensity): gradient steps per round =
+        # intensity * steps_sampled / train_batch_size.
         self.training_intensity: float = 1.0
         # replay
         self.replay_buffer_capacity: int = 100_000

@@ -221,16 +221,14 @@ class IMPALA(Algorithm):
                     + sum(len(e) for e in res))
                 collected.append(res)
             except Exception:
-                # Runner died: replace it in the group (this is the only
-                # gather on the async path, so restart must happen here).
+                # Runner died: replace it (this is the only gather on the
+                # async path, so restart must happen here), or — with
+                # restarts disabled — drop the slot so its permanently
+                # errored handle stops eating wait() rounds.
                 if grp.restart_failed and i < len(grp.remote_runners):
-                    try:
-                        ray_tpu.kill(grp.remote_runners[i])
-                    except Exception:
-                        pass
-                    grp.remote_runners[i] = grp._make_runner(i + 1)
-                    grp.remote_runners[i].set_lifetime_steps.remote(
-                        grp._lifetime_steps.get(i + 1, 0))
+                    grp.restart_runner(i)
+                else:
+                    continue
             if i < len(grp.remote_runners):
                 r = grp.remote_runners[i]
                 # Fire-and-forget weight push, then the next sample request
@@ -240,6 +238,11 @@ class IMPALA(Algorithm):
                 next_inflight.append((r.sample.remote(
                     num_env_steps=cfg.rollout_fragment_length), i))
         self._inflight = next_inflight
+        if not collected and not self._inflight:
+            # Every remote runner is gone and restarts are disabled: fall
+            # back to the local runner (sync-path parity).
+            return [grp.local_runner.sample(
+                num_env_steps=cfg.rollout_fragment_length)]
         return collected
 
     def training_step(self) -> Dict[str, Any]:

@@ -39,7 +39,10 @@ class SACConfig(AlgorithmConfig):
         self.n_step: int = 1
         self.hidden_sizes: Tuple[int, ...] = (256, 256)
         self.rollout_fragment_length: int = 64
-        self.training_intensity: float = 0.25   # grad steps per env step
+        # Transitions trained per transition sampled (reference
+        # dqn.py/sac.py training_intensity semantics, shared with DQN):
+        # gradient steps per round = intensity * steps_sampled / batch.
+        self.training_intensity: float = 64.0
         self.num_steps_sampled_before_learning_starts: int = 1000
         self.replay_buffer_capacity: int = 100_000
 
@@ -154,7 +157,8 @@ class SAC(Algorithm):
                                    "replay_buffer_size": len(self.replay)}
         if len(self.replay) < cfg.num_steps_sampled_before_learning_starts:
             return metrics
-        num_updates = max(1, round(cfg.training_intensity * steps_added))
+        num_updates = max(1, round(cfg.training_intensity * steps_added
+                                   / cfg.train_batch_size))
         for _ in range(num_updates):
             batch = self.replay.sample(cfg.train_batch_size)
             metrics.update(self.learner_group.update_from_batch(batch))

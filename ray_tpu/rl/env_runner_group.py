@@ -112,6 +112,25 @@ class EnvRunnerGroup:
         }
 
     # -- fault tolerance ---------------------------------------------------
+    def restart_runner(self, i: int) -> Any:
+        """Replace remote runner i (0-based slot) with a fresh actor:
+        kill the old handle, spawn, resume its lifetime counter (epsilon
+        schedule), and sync current weights. Returns the new handle.
+        Shared by the sync gather path and IMPALA's async sampling loop."""
+        try:
+            ray_tpu.kill(self.remote_runners[i])
+        except Exception:
+            pass
+        new = self._make_runner(i + 1)
+        self.remote_runners[i] = new
+        try:
+            new.set_lifetime_steps.remote(self._lifetime_steps.get(i + 1, 0))
+            ray_tpu.get(new.set_weights.remote(
+                self.local_runner.get_weights()), timeout=60)
+        except Exception:
+            pass
+        return new
+
     def _gather(self, refs: List[Any], restart_indices: bool) -> List[Any]:
         """ray.get each ref; on actor death, optionally restart that runner
         and return None for its slot (FaultTolerantActorManager parity)."""
@@ -123,21 +142,7 @@ class EnvRunnerGroup:
                 out.append(None)
                 if restart_indices and self.restart_failed and \
                         i < len(self.remote_runners):
-                    try:
-                        ray_tpu.kill(self.remote_runners[i])
-                    except Exception:
-                        pass
-                    self.remote_runners[i] = self._make_runner(i + 1)
-                    # Freshly restarted runner needs current weights and
-                    # its lifetime counter (epsilon schedule) resumed.
-                    try:
-                        new = self.remote_runners[i]
-                        new.set_lifetime_steps.remote(
-                            self._lifetime_steps.get(i + 1, 0))
-                        ray_tpu.get(new.set_weights.remote(
-                            self.local_runner.get_weights()), timeout=60)
-                    except Exception:
-                        pass
+                    self.restart_runner(i)
         return out
 
     def stop(self) -> None:

@@ -293,9 +293,10 @@ class SACModuleSpec:
             lambda: self.sample_action(params["actor"], obs, key),
             lambda: self.sample_action(params["actor"], obs, key,
                                        deterministic=True))
-        value = jnp.minimum(self.q_value(params["q1"], obs, action),
-                            self.q_value(params["q2"], obs, action))
-        return action, logp, value
+        # No critic evaluation in the rollout hot loop: SAC's learner
+        # recomputes Q from the replayed batch, so a per-step value
+        # estimate would be two dead MLP forwards per env step.
+        return action, logp, jnp.zeros(logp.shape)
 
 
 def spec_for_env(env) -> RLModuleSpec:

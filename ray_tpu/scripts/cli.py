@@ -175,6 +175,26 @@ def cmd_memory(args):
     return 0
 
 
+def cmd_timeline(args):
+    """Dump the cluster task timeline as chrome-trace JSON (reference:
+    `ray timeline`, _private/state.py:434)."""
+    client = _client()
+
+    class _Shim:
+        def state_list(self, kind):
+            return client.call({"op": f"list_{kind}"})
+
+    from ray_tpu.util.timeline import timeline_events
+
+    events = timeline_events(_Shim())
+    path = args.output or "timeline.json"
+    with open(path, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} events to {path} "
+          "(open in chrome://tracing or ui.perfetto.dev)")
+    return 0
+
+
 def cmd_job(args):
     import ray_tpu
     from ray_tpu.job import JobSubmissionClient
@@ -239,6 +259,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("summary", help="counts by state")
     sp.add_argument("kind", choices=["tasks", "actors"])
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("timeline", help="dump chrome-trace task timeline")
+    sp.add_argument("-o", "--output", default="")
+    sp.set_defaults(fn=cmd_timeline)
 
     sp = sub.add_parser("memory", help="object store contents")
     sp.set_defaults(fn=cmd_memory)

@@ -57,3 +57,8 @@ __all__ = [
     "get_replica_context",
     "Request",
 ]
+
+# Feature-usage tag (util/usage_stats.py; local-only, no egress).
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("serve")
+del _rlu

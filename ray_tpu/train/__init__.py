@@ -46,3 +46,8 @@ __all__ = [
     "get_mesh", "prepare_pytree", "shard_batch",
     "DataParallelTrainer", "JaxTrainer", "Result", "TrainingFailedError",
 ]
+
+# Feature-usage tag (util/usage_stats.py; local-only, no egress).
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("train")
+del _rlu

@@ -60,3 +60,8 @@ __all__ = [
     "MedianStoppingRule",
     "PopulationBasedTraining",
 ]
+
+# Feature-usage tag (util/usage_stats.py; local-only, no egress).
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("tune")
+del _rlu

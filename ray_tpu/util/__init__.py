@@ -10,8 +10,17 @@ from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
+from ray_tpu.util import metrics, timeline, tracing, usage_stats
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 __all__ = [
+    "metrics",
+    "timeline",
+    "tracing",
+    "usage_stats",
+    "Counter",
+    "Gauge",
+    "Histogram",
     "PlacementGroup",
     "placement_group",
     "placement_group_table",

@@ -1,0 +1,304 @@
+"""Application metrics API: Counter / Gauge / Histogram + Prometheus export.
+
+Counterpart of the reference's ray.util.metrics (python/ray/util/metrics.py
+→ Cython includes/metric.pxi → the OpenCensus C++ stack N15) and the
+per-node MetricsAgent (python/ray/_private/metrics_agent.py) that
+re-exports Prometheus. The multi-hop OpenCensus pipeline collapses to:
+
+  process-local registry  →  periodic pickled snapshot into the GCS KV
+  (`__metrics__/<worker_hex>`)  →  the dashboard's /metrics endpoint (and
+  `aggregate_prometheus_text()`) merges all live snapshots into one
+  Prometheus text exposition.
+
+Metrics are cheap host bookkeeping (a dict update behind a lock); nothing
+here touches the device path.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_KV_PREFIX = "__metrics__/"
+_PUBLISH_INTERVAL_S = 2.0
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+_publisher_started = False
+
+
+def _tags_key(tags: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted(tags.items()))
+
+
+class Metric:
+    """Base class: a named metric with static default tags and per-tag-set
+    series (reference util/metrics.py Metric)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name or any(c in name for c in " \n"):
+            raise ValueError(f"invalid metric name: {name!r}")
+        self.name = name
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self.default_tags: Dict[str, str] = {}
+        self._series: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            existing = _registry.get(name)
+            if existing is not None and existing.kind != self.kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as {existing.kind}")
+            _registry[name] = self
+        _ensure_publisher()
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self.default_tags = dict(tags)
+        return self
+
+    def _resolve_tags(self, tags: Optional[Dict[str, str]]
+                      ) -> Dict[str, str]:
+        merged = dict(self.default_tags)
+        if tags:
+            merged.update(tags)
+        extra = set(merged) - set(self.tag_keys)
+        if extra:
+            raise ValueError(
+                f"tags {sorted(extra)} not declared in tag_keys for "
+                f"metric {self.name!r}")
+        return merged
+
+    # -- snapshot / exposition ---------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            series = dict(self._series)
+        return {"name": self.name, "kind": self.kind,
+                "description": self.description, "series": series}
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0,
+            tags: Optional[Dict[str, str]] = None):
+        if value < 0:
+            raise ValueError("Counter.inc() value must be >= 0")
+        key = _tags_key(self._resolve_tags(tags))
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._resolve_tags(tags))
+        with self._lock:
+            self._series[key] = float(value)
+
+
+class Histogram(Metric):
+    """Fixed-boundary histogram (reference util/metrics.py Histogram).
+
+    Series values are (bucket_counts, sum, count) per tag set; exposition
+    follows the Prometheus cumulative-bucket convention.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        self.boundaries = sorted(boundaries or
+                                 (0.001, 0.01, 0.1, 1.0, 10.0, 100.0))
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None):
+        key = _tags_key(self._resolve_tags(tags))
+        with self._lock:
+            entry = self._series.get(key)
+            if entry is None:
+                entry = [[0] * (len(self.boundaries) + 1), 0.0, 0]
+                self._series[key] = entry
+            buckets, _, _ = entry
+            for i, b in enumerate(self.boundaries):
+                if value <= b:
+                    buckets[i] += 1
+                    break
+            else:
+                buckets[-1] += 1
+            entry[1] += float(value)
+            entry[2] += 1
+
+    def snapshot(self) -> dict:
+        snap = super().snapshot()
+        snap["boundaries"] = list(self.boundaries)
+        # Deep-copy mutable bucket lists so the publisher pickles a stable
+        # view.
+        snap["series"] = {k: [list(v[0]), v[1], v[2]]
+                          for k, v in snap["series"].items()}
+        return snap
+
+
+# ---------------------------------------------------------------------------
+# Exposition
+# ---------------------------------------------------------------------------
+
+def _fmt_tags(key: Tuple[Tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def snapshots_to_prometheus_text(snapshots: List[dict]) -> str:
+    """Render metric snapshots as Prometheus text exposition format."""
+    lines: List[str] = []
+    seen_help = set()
+    for snap in snapshots:
+        name, kind = snap["name"], snap["kind"]
+        if name not in seen_help:
+            if snap.get("description"):
+                lines.append(f"# HELP {name} {snap['description']}")
+            lines.append(f"# TYPE {name} "
+                         f"{kind if kind != 'untyped' else 'gauge'}")
+            seen_help.add(name)
+        for key, val in snap["series"].items():
+            tags = _fmt_tags(tuple(key))
+            if kind == "histogram":
+                buckets, total, count = val
+                base = tags[1:-1] if tags else ""
+
+                def bucket_label(le: str) -> str:
+                    inner = (base + "," if base else "") + f'le="{le}"'
+                    return "{" + inner + "}"
+
+                cumulative = 0
+                for b, c in zip(snap["boundaries"], buckets):
+                    cumulative += c
+                    lines.append(
+                        f"{name}_bucket{bucket_label(str(b))} {cumulative}")
+                lines.append(f"{name}_bucket{bucket_label('+Inf')} {count}")
+                lines.append(f"{name}_sum{tags} {total}")
+                lines.append(f"{name}_count{tags} {count}")
+            else:
+                lines.append(f"{name}{tags} {val}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def local_snapshots() -> List[dict]:
+    with _registry_lock:
+        metrics = list(_registry.values())
+    return [m.snapshot() for m in metrics]
+
+
+# ---------------------------------------------------------------------------
+# Publishing (process → GCS KV) and aggregation (KV → Prometheus text)
+# ---------------------------------------------------------------------------
+
+def publish_now() -> bool:
+    """Publish this process's snapshots to the cluster KV immediately."""
+    try:
+        from ray_tpu.core.runtime import get_runtime
+        rt = get_runtime()
+    except Exception:
+        return False
+    snaps = local_snapshots()
+    if not snaps:
+        return False
+    ident = rt.core.worker_hex if hasattr(rt, "core") else "driver"
+    payload = pickle.dumps({"ts": time.time(), "snapshots": snaps})
+    try:
+        rt.kv().call({"op": "kv_put", "key": _KV_PREFIX + ident,
+                      "value": payload, "overwrite": True})
+        return True
+    except Exception:
+        return False
+
+
+def _publisher_loop():
+    while True:
+        time.sleep(_PUBLISH_INTERVAL_S)
+        publish_now()
+
+
+def _ensure_publisher():
+    global _publisher_started
+    with _registry_lock:
+        if _publisher_started:
+            return
+        _publisher_started = True
+    threading.Thread(target=_publisher_loop, daemon=True,
+                     name="metrics-publisher").start()
+
+
+def aggregate_snapshots(kv_call, max_age_s: float = 60.0) -> List[dict]:
+    """Merge all processes' published snapshots (driver-side)."""
+    out: List[dict] = []
+    try:
+        keys = kv_call({"op": "kv_keys", "prefix": _KV_PREFIX}) or []
+    except Exception:
+        return out
+    for key in keys:
+        # Per-key isolation: one corrupt/raced snapshot must not hide the
+        # rest of the fleet's metrics.
+        try:
+            raw = kv_call({"op": "kv_get", "key": key})
+            if raw is None:
+                continue
+            payload = pickle.loads(raw)
+            if time.time() - payload.get("ts", 0) > max_age_s:
+                continue
+            out.extend(payload["snapshots"])
+        except Exception:
+            continue
+    return out
+
+
+def builtin_snapshots(runtime) -> List[dict]:
+    """Cluster-state gauges synthesized from the control plane (the
+    counterpart of the reference's ~90 C++ metric_defs: tasks/actors/
+    objects/nodes by state)."""
+    snaps: List[dict] = []
+
+    def gauge(name, desc, series):
+        snaps.append({"name": name, "kind": "gauge", "description": desc,
+                      "series": series})
+
+    try:
+        tasks = runtime.state_list("tasks")
+        by_state: Dict[str, int] = {}
+        for t in tasks:
+            by_state[t["state"]] = by_state.get(t["state"], 0) + 1
+        gauge("ray_tpu_tasks", "Tasks by state",
+              {(("state", s),): n for s, n in by_state.items()})
+        actors = runtime.state_list("actors")
+        by_state = {}
+        for a in actors:
+            by_state[a["state"]] = by_state.get(a["state"], 0) + 1
+        gauge("ray_tpu_actors", "Actors by state",
+              {(("state", s),): n for s, n in by_state.items()})
+        objs = runtime.state_list("objects")
+        gauge("ray_tpu_objects", "Objects in the cluster store",
+              {(): len(objs)})
+        gauge("ray_tpu_object_store_bytes", "Bytes in the object store",
+              {(): float(sum(o.get("size") or 0 for o in objs))})
+        nodes = runtime.state_list("nodes")
+        gauge("ray_tpu_nodes", "Alive nodes",
+              {(): sum(1 for n in nodes if n.get("alive", True))})
+    except Exception:
+        pass
+    return snaps
+
+
+def aggregate_prometheus_text(runtime) -> str:
+    """Everything the cluster knows, as one Prometheus exposition: built-in
+    state gauges + every process's user metrics."""
+    snaps = builtin_snapshots(runtime)
+    snaps.extend(aggregate_snapshots(lambda msg: runtime.kv().call(msg)))
+    return snapshots_to_prometheus_text(snaps)

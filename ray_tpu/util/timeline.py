@@ -1,0 +1,67 @@
+"""Task timeline: chrome-trace dump of task scheduling/execution.
+
+Counterpart of the reference's `ray timeline` path: TaskEventBuffer
+(src/ray/core_worker/task_event_buffer.h:206) → GcsTaskManager →
+chrome-trace JSON (python/ray/_private/state.py:434,
+profiling.py:124 chrome_tracing_dump). Here the control server already
+timestamps every task state transition (gcs.py TaskRecord), so the dump
+reads the state API and emits one chrome-trace row per worker process:
+a "scheduling" slice (submitted→started) on the driver row and an
+"execution" slice (started→finished) on the executing worker's row.
+
+Open the output in chrome://tracing or https://ui.perfetto.dev.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional
+
+
+def timeline_events(runtime=None) -> List[Dict[str, Any]]:
+    """Build chrome-trace event dicts from the cluster's task records."""
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = runtime or get_runtime()
+    tasks = rt.state_list("tasks")
+    events: List[Dict[str, Any]] = []
+    pids = set()
+    for t in tasks:
+        name = t.get("name") or t["task_id"][:8]
+        pid = t.get("pid") or 0
+        sub, start, fin = (t.get("submitted_at"), t.get("started_at"),
+                           t.get("finished_at"))
+        if sub and start and start >= sub:
+            events.append({
+                "cat": "scheduling", "name": f"schedule:{name}",
+                "ph": "X", "pid": 0, "tid": 0,
+                "ts": sub * 1e6, "dur": (start - sub) * 1e6,
+                "args": {"task_id": t["task_id"], "state": t["state"]},
+            })
+        if start and fin and fin >= start:
+            pids.add(pid)
+            events.append({
+                "cat": "task", "name": name, "ph": "X",
+                "pid": pid, "tid": 0,
+                "ts": start * 1e6, "dur": (fin - start) * 1e6,
+                "args": {"task_id": t["task_id"], "state": t["state"],
+                         "worker": t.get("worker", "")},
+            })
+    # Row labels (chrome-trace metadata events).
+    events.append({"ph": "M", "pid": 0, "name": "process_name",
+                   "args": {"name": "driver (scheduling)"}})
+    for pid in sorted(pids):
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": f"worker pid={pid}"}})
+    return events
+
+
+def timeline(filename: Optional[str] = None, runtime=None):
+    """Dump the chrome-trace timeline; returns the events (and writes
+    `filename` if given) — counterpart of ray.timeline()
+    (python/ray/_private/state.py:434)."""
+    events = timeline_events(runtime)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

@@ -1,0 +1,142 @@
+"""Opt-in application tracing: spans around task submission + user code.
+
+Counterpart of the reference's ray.util.tracing.tracing_helper
+(python/ray/util/tracing/tracing_helper.py: _OpenTelemetryProxy :34,
+_DictPropagator :165, decorators wrapping _remote/execute). The reference
+depends on the opentelemetry SDK and injects span context into task
+metadata; here tracing is self-contained (zero extra deps, zero egress):
+
+  - `enable_tracing()` flips a process-local flag (the reference's
+    `ray.init(_tracing_startup_hook=...)` opt-in).
+  - `trace_span(name)` is a context manager recording a span on a
+    thread-local stack (parent/child nesting within a process).
+  - The task layer records a `submit:<task>` span per submission when
+    tracing is on (hooked in core/remote_function.py); cross-process
+    correlation happens by task_id against the control server's task
+    records, so no context needs to ride the wire.
+  - `export_chrome_trace(path)` merges local spans with the cluster task
+    timeline (util/timeline.py) into one chrome-trace file.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+_enabled = False
+_spans: List[Dict[str, Any]] = []
+_spans_lock = threading.Lock()
+_local = threading.local()
+
+
+def enable_tracing() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable_tracing() -> None:
+    global _enabled
+    _enabled = False
+
+
+def is_tracing_enabled() -> bool:
+    return _enabled
+
+
+def _stack() -> List[str]:
+    if not hasattr(_local, "stack"):
+        _local.stack = []
+    return _local.stack
+
+
+def current_span_id() -> Optional[str]:
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+def record_span(name: str, start: float, end: float,
+                attributes: Optional[Dict[str, Any]] = None,
+                parent_id: Optional[str] = None) -> Optional[str]:
+    """Record a completed span (no-op unless tracing is enabled)."""
+    if not _enabled:
+        return None
+    span_id = uuid.uuid4().hex[:16]
+    with _spans_lock:
+        _spans.append({
+            "span_id": span_id,
+            "parent_id": parent_id or current_span_id(),
+            "name": name,
+            "start": start,
+            "end": end,
+            "attributes": attributes or {},
+        })
+    return span_id
+
+
+@contextmanager
+def trace_span(name: str, attributes: Optional[Dict[str, Any]] = None):
+    """Context manager for a nested span; cheap no-op when disabled."""
+    if not _enabled:
+        yield None
+        return
+    span_id = uuid.uuid4().hex[:16]
+    parent = current_span_id()
+    _stack().append(span_id)
+    start = time.time()
+    try:
+        yield span_id
+    finally:
+        _stack().pop()
+        with _spans_lock:
+            _spans.append({
+                "span_id": span_id, "parent_id": parent, "name": name,
+                "start": start, "end": time.time(),
+                "attributes": attributes or {},
+            })
+
+
+def get_spans() -> List[Dict[str, Any]]:
+    with _spans_lock:
+        return list(_spans)
+
+
+def clear_spans() -> None:
+    with _spans_lock:
+        _spans.clear()
+
+
+def spans_to_chrome_events(spans: List[Dict[str, Any]]
+                           ) -> List[Dict[str, Any]]:
+    events = []
+    for s in spans:
+        events.append({
+            "cat": "span", "name": s["name"], "ph": "X",
+            "pid": 1, "tid": 0,
+            "ts": s["start"] * 1e6,
+            "dur": max(0.0, s["end"] - s["start"]) * 1e6,
+            "args": {**s["attributes"], "span_id": s["span_id"],
+                     "parent_id": s["parent_id"]},
+        })
+    if events:
+        events.append({"ph": "M", "pid": 1, "name": "process_name",
+                       "args": {"name": "driver spans"}})
+    return events
+
+
+def export_chrome_trace(filename: str, include_tasks: bool = True) -> int:
+    """Write local spans (+ the cluster task timeline) as chrome-trace
+    JSON; returns the number of events written."""
+    events = spans_to_chrome_events(get_spans())
+    if include_tasks:
+        try:
+            from ray_tpu.util.timeline import timeline_events
+            events.extend(timeline_events())
+        except Exception:
+            pass
+    with open(filename, "w") as f:
+        json.dump(events, f)
+    return len(events)

@@ -29,3 +29,8 @@ __all__ = [
     "WorkflowStatus", "run", "run_async", "resume", "resume_async",
     "get_status", "get_output", "list_all", "cancel", "delete",
 ]
+
+# Feature-usage tag (util/usage_stats.py; local-only, no egress).
+from ray_tpu.util.usage_stats import record_library_usage as _rlu
+_rlu("workflow")
+del _rlu

@@ -120,7 +120,7 @@ def main():
                         rollout_fragment_length=64)
            .training(train_batch_size=32, hidden_sizes=(32,),
                      num_steps_sampled_before_learning_starts=64,
-                     training_intensity=0.1)
+                     training_intensity=1.0)
            .debugging(seed=0)).build()
     r = sac.step()
     r = sac.step()

@@ -1,0 +1,254 @@
+"""Observability stack tests: metrics, timeline, tracing, log monitor,
+usage stats (SURVEY.md §5 aux subsystems / §2.2 P15–P21)."""
+
+import json
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import metrics as metrics_mod
+from ray_tpu.util import tracing
+from ray_tpu.util.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    snapshots_to_prometheus_text,
+)
+
+
+# ---------------------------------------------------------------------------
+# Metrics: local registry + exposition
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_exposition():
+    c = Counter("test_requests_total", "reqs", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2.0, tags={"route": "/a"})
+    c.inc(tags={"route": "/b"})
+    g = Gauge("test_temperature", "deg")
+    g.set(42.5)
+    h = Histogram("test_latency", "s", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = snapshots_to_prometheus_text(
+        [c.snapshot(), g.snapshot(), h.snapshot()])
+    assert 'test_requests_total{route="/a"} 3.0' in text
+    assert 'test_requests_total{route="/b"} 1.0' in text
+    assert "test_temperature 42.5" in text
+    assert 'test_latency_bucket{le="0.1"} 1' in text
+    assert 'test_latency_bucket{le="1.0"} 2' in text
+    assert 'test_latency_bucket{le="+Inf"} 3' in text
+    assert "test_latency_count 3" in text
+    assert "# TYPE test_requests_total counter" in text
+
+
+def test_metric_tag_validation():
+    c = Counter("test_tags_strict", tag_keys=("k",))
+    with pytest.raises(ValueError):
+        c.inc(tags={"other": "x"})
+    with pytest.raises(ValueError):
+        c.inc(-1.0)
+    c.set_default_tags({"k": "v"})
+    c.inc()
+    assert c.snapshot()["series"][(("k", "v"),)] == 1.0
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_metrics_aggregate_across_workers():
+    """User metrics recorded inside worker processes surface in the
+    driver-side aggregation (KV publish path)."""
+
+    @ray_tpu.remote
+    def record():
+        from ray_tpu.util.metrics import Counter, publish_now
+
+        c = Counter("test_worker_events", tag_keys=())
+        c.inc(5.0)
+        assert publish_now()
+        return True
+
+    assert ray_tpu.get(record.remote())
+    from ray_tpu.core.runtime import get_runtime
+    rt = get_runtime()
+    text = metrics_mod.aggregate_prometheus_text(rt)
+    assert "test_worker_events 5.0" in text
+    # Built-in state gauges ride along.
+    assert "ray_tpu_tasks" in text
+    assert "ray_tpu_nodes" in text
+
+
+# ---------------------------------------------------------------------------
+# Timeline
+# ---------------------------------------------------------------------------
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_timeline_chrome_trace(tmp_path):
+    @ray_tpu.remote
+    def work(x):
+        time.sleep(0.05)
+        return x
+
+    ray_tpu.get([work.remote(i) for i in range(3)])
+    from ray_tpu.util.timeline import timeline
+
+    path = str(tmp_path / "trace.json")
+    # The task_done control message can land just after get() returns;
+    # poll briefly until all three records carry finish timestamps.
+    deadline = time.time() + 5
+    while True:
+        events = timeline(path)
+        done = [e for e in events
+                if e.get("ph") == "X" and e["cat"] == "task"]
+        if len(done) >= 3 or time.time() > deadline:
+            break
+        time.sleep(0.05)
+    with open(path) as f:
+        assert json.load(f) == events
+    slices = [e for e in events if e.get("ph") == "X" and e["cat"] == "task"]
+    assert len(slices) >= 3
+    for e in slices:
+        assert e["dur"] >= 0.05 * 1e6 * 0.5  # at least ~the sleep
+        assert e["args"]["task_id"]
+    assert any(e.get("ph") == "M" for e in events)  # row labels
+
+
+# ---------------------------------------------------------------------------
+# Tracing
+# ---------------------------------------------------------------------------
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_tracing_spans_and_submit_instrumentation(tmp_path):
+    tracing.clear_spans()
+    tracing.enable_tracing()
+    try:
+        @ray_tpu.remote
+        def traced_task():
+            return 1
+
+        with tracing.trace_span("outer", {"step": "1"}):
+            with tracing.trace_span("inner"):
+                ref = traced_task.remote()
+        ray_tpu.get(ref)
+        spans = tracing.get_spans()
+        names = [s["name"] for s in spans]
+        assert "outer" in names and "inner" in names
+        assert any(n.startswith("submit:") for n in names)
+        # Nesting: inner's parent is outer; submit's parent is inner.
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+        submit = next(s for s in spans if s["name"].startswith("submit:"))
+        assert submit["parent_id"] == by_name["inner"]["span_id"]
+        # Chrome export merges spans + cluster task slices.
+        path = str(tmp_path / "spans.json")
+        n = tracing.export_chrome_trace(path)
+        assert n >= len(spans)
+    finally:
+        tracing.disable_tracing()
+        tracing.clear_spans()
+
+
+def test_tracing_disabled_is_noop():
+    tracing.clear_spans()
+    with tracing.trace_span("nothing"):
+        pass
+    assert tracing.get_spans() == []
+
+
+# ---------------------------------------------------------------------------
+# Log monitor
+# ---------------------------------------------------------------------------
+
+def test_log_monitor_streams_worker_output(tmp_path, capsys):
+    import io
+
+    from ray_tpu.core.log_monitor import LogMonitor
+
+    logs = tmp_path / "logs"
+    logs.mkdir()
+    out = io.StringIO()
+    mon = LogMonitor(str(tmp_path), out=out, err=out).start()
+    try:
+        with open(logs / "worker-abcdef012345.out", "w") as f:
+            f.write("hello from worker\n")
+        deadline = time.time() + 5
+        while "hello from worker" not in out.getvalue():
+            assert time.time() < deadline, out.getvalue()
+            time.sleep(0.05)
+        assert "(abcdef01)" in out.getvalue()
+    finally:
+        mon.stop()
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_worker_prints_reach_driver():
+    """End to end: a task's print() lands in the worker's session log file
+    and a monitor attached to the live session streams it. (The built-in
+    monitor prints to the real stdout, which pytest's capture layers hide
+    from fixtures — so attach a second monitor with an explicit sink.)"""
+    import io
+
+    from ray_tpu.core.log_monitor import LogMonitor
+    from ray_tpu.core.runtime import get_runtime
+
+    out = io.StringIO()
+    mon = LogMonitor(get_runtime().session_dir, out=out, err=out).start()
+    try:
+        @ray_tpu.remote
+        def chatty():
+            print("WORKER_SAYS_HI")
+            return 0
+
+        ray_tpu.get(chatty.remote())
+        deadline = time.time() + 5
+        while "WORKER_SAYS_HI" not in out.getvalue():
+            assert time.time() < deadline, out.getvalue()
+            time.sleep(0.1)
+    finally:
+        mon.stop()
+
+
+# ---------------------------------------------------------------------------
+# Usage stats
+# ---------------------------------------------------------------------------
+
+def test_usage_stats_report(tmp_path):
+    from ray_tpu.util import usage_stats
+
+    usage_stats.record_library_usage("testlib")
+    usage_stats.record_extra_usage_tag("mesh_axes", "data,fsdp")
+    path = usage_stats.write_usage_report(str(tmp_path))
+    with open(path) as f:
+        report = json.load(f)
+    assert report["counters"].get("library:testlib", 0) >= 1
+    assert report["tags"]["mesh_axes"] == "data,fsdp"
+
+
+# ---------------------------------------------------------------------------
+# Dashboard endpoints
+# ---------------------------------------------------------------------------
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_dashboard_metrics_and_timeline_endpoints():
+    import urllib.request
+
+    from ray_tpu.dashboard import Dashboard
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    from ray_tpu.core.runtime import get_runtime
+    rt = get_runtime()
+    dash = Dashboard(rt)
+    try:
+        text = urllib.request.urlopen(dash.url + "/metrics").read().decode()
+        assert "ray_tpu_tasks" in text
+        tl = json.loads(
+            urllib.request.urlopen(dash.url + "/api/timeline").read())
+        assert isinstance(tl, list) and len(tl) >= 1
+    finally:
+        dash.stop()

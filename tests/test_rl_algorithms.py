@@ -183,7 +183,7 @@ def test_sac_pendulum_improves():
               .env_runners(num_envs_per_env_runner=4,
                            rollout_fragment_length=128)
               .training(train_batch_size=128, lr=3e-3,
-                        hidden_sizes=(64, 64), training_intensity=0.25,
+                        hidden_sizes=(64, 64), training_intensity=32.0,
                         num_steps_sampled_before_learning_starts=500)
               .debugging(seed=0))
     algo = config.build()
