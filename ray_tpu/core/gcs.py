@@ -206,6 +206,13 @@ class ControlServer:
         self.tasks: Dict[str, TaskRecord] = {}
         self.pending_tasks: List[TaskSpec] = []
         self.pending_actors: List[ActorCreationSpec] = []
+        # env_key -> runtime_env dict; workers fetch + apply their pool's
+        # env at startup (runtime_env/plugin.py).
+        self.runtime_envs: Dict[str, dict] = {}
+        # env_key -> setup error; tasks needing a broken env fail fast
+        # instead of respawning workers forever (reference: runtime-env
+        # agent setup failure fails the lease request).
+        self.broken_envs: Dict[str, str] = {}
 
         head = NodeState(node_id="head", total=resources,
                          available=resources, is_head=True)
@@ -1181,6 +1188,12 @@ class ControlServer:
             node = self.nodes.get(st.node_id)
             if node is None or not node.alive:
                 return f"node {st.node_id} is dead or does not exist"
+        renv = getattr(spec, "runtime_env", None)
+        if renv:
+            key = self._env_key_for(spec.resources, renv)
+            err = self.broken_envs.get(key)
+            if err:
+                return f"runtime_env setup failed: {err}"
         return None
 
     def _charge_avail(self, charge: tuple) -> ResourceSet:
@@ -1347,7 +1360,24 @@ class ControlServer:
 
             env_part = hashlib.sha1(
                 json.dumps(runtime_env, sort_keys=True).encode()).hexdigest()[:8]
-        return f"tpu{int(tpu)}-{env_part}"
+        key = f"tpu{int(tpu)}-{env_part}"
+        if runtime_env:
+            self.runtime_envs.setdefault(key, dict(runtime_env))
+        return key
+
+    def _op_get_runtime_env(self, conn, msg):
+        with self.lock:
+            return self.runtime_envs.get(msg.get("env_key", ""))
+
+    def _op_worker_setup_failed(self, conn, msg):
+        """A worker's runtime-env setup raised: poison the env so pending
+        and future work needing it fails fast (the worker exits itself)."""
+        env_key = msg.get("env_key", "")
+        error = msg.get("error", "runtime_env setup failed")
+        with self.lock:
+            self.broken_envs[env_key] = error
+        self._wake.set()
+        return True
 
     # ------------------------------------------------------------------
     # Worker pool (counterpart of raylet WorkerPool::StartWorkerProcess)

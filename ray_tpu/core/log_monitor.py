@@ -32,6 +32,9 @@ class LogMonitor:
         self.err = err or sys.stderr
         self._offsets: Dict[str, int] = {}
         self._stop = threading.Event()
+        # Serializes sweeps: stop()'s final flush can run concurrently
+        # with the monitor thread's sweep.
+        self._sweep_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="log-monitor")
 
@@ -50,6 +53,10 @@ class LogMonitor:
             self._stop.wait(_POLL_INTERVAL_S)
 
     def _sweep(self):
+        with self._sweep_lock:
+            self._sweep_locked()
+
+    def _sweep_locked(self):
         try:
             names = os.listdir(self.log_dir)
         except OSError:
@@ -59,22 +66,20 @@ class LogMonitor:
             if not m:
                 continue
             path = os.path.join(self.log_dir, name)
-            try:
-                size = os.path.getsize(path)
-            except OSError:
-                continue
             offset = self._offsets.get(path, 0)
-            if size <= offset:
-                continue
+            # Binary IO with byte offsets: text-mode seek/read would count
+            # characters and drift on multi-byte UTF-8.
             try:
-                with open(path, "r", errors="replace") as f:
+                with open(path, "rb") as f:
                     f.seek(offset)
-                    chunk = f.read(size - offset)
+                    chunk = f.read()
             except OSError:
                 continue
-            self._offsets[path] = size
+            if not chunk:
+                continue
+            self._offsets[path] = offset + len(chunk)
             stream = self.out if m.group("stream") == "out" else self.err
             prefix = f"({m.group('hex')[:8]}) "
-            for line in chunk.splitlines():
+            for line in chunk.decode(errors="replace").splitlines():
                 if line.strip():
                     print(prefix + line, file=stream)

@@ -254,6 +254,17 @@ class CoreClient:
     def fetch_func(self, func_id: str) -> Optional[bytes]:
         return self.client.call({"op": "get_func", "func_id": func_id})
 
+    def _prepare_runtime_env(self, runtime_env: Optional[dict]
+                             ) -> Optional[dict]:
+        """Package local working_dir/py_modules into content-addressed
+        pkg:// KV uploads (runtime_env/packaging.py) so the env dict that
+        ships — and keys the worker pool — is location-independent."""
+        if not runtime_env:
+            return runtime_env
+        from ray_tpu.runtime_env.packaging import prepare_runtime_env
+
+        return prepare_runtime_env(runtime_env, self.client.call)
+
     @staticmethod
     def _split_strategy(scheduling_strategy):
         """Extract (pg_hex, bundle_index, residual_strategy).
@@ -277,6 +288,7 @@ class CoreClient:
         borrows: List[str] = []
         task_args = self._prepare_args(args, borrows)
         self.ensure_func(func_id, func_blob)
+        runtime_env = self._prepare_runtime_env(runtime_env)
         return_ids = [ObjectID.from_random() for _ in range(num_returns)]
         pg_hex, bundle_index, scheduling_strategy = self._split_strategy(
             scheduling_strategy)
@@ -311,6 +323,7 @@ class CoreClient:
         borrows: List[str] = []
         task_args = self._prepare_args(args, borrows)
         self.ensure_func(class_id, class_blob)
+        runtime_env = self._prepare_runtime_env(runtime_env)
         actor_id = ActorID.from_random()
         pg_hex, bundle_index, scheduling_strategy = self._split_strategy(
             scheduling_strategy)

@@ -54,6 +54,31 @@ class WorkerRuntime:
         self._exec_pool: Optional[Any] = None
         self.is_initialized = True
         set_runtime(self)
+        # Apply this pool's runtime env (working_dir/py_modules/env_vars/
+        # pip validation — runtime_env/plugin.py) BEFORE reporting online
+        # so the first task already sees the prepared environment; a
+        # failed setup kills the worker with the error in its .err log
+        # (reference: runtime-env agent failure fails the lease).
+        renv = self.core.client.call({"op": "get_runtime_env",
+                                      "env_key": env_key})
+        if renv:
+            from ray_tpu.runtime_env.plugin import apply_runtime_env
+
+            try:
+                apply_runtime_env(renv, self.core.session_dir,
+                                  self.core.client.call)
+            except Exception as e:  # noqa: BLE001
+                import traceback
+
+                traceback.print_exc()
+                # Poison the env server-side so pending/future tasks fail
+                # fast instead of respawning this doomed pool forever.
+                try:
+                    self.core.client.call({
+                        "op": "worker_setup_failed", "env_key": env_key,
+                        "error": f"{type(e).__name__}: {e}"})
+                finally:
+                    os._exit(1)
         self.core.client.send({"op": "worker_online"})
 
     # -- runtime facade (same surface the driver runtime exposes) -------

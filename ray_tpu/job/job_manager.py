@@ -66,6 +66,28 @@ class _JobManager:
 
     def _run(self, job_id: str, entrypoint: str, env, cwd):
         info = self._jobs[job_id]
+        if cwd and str(cwd).startswith("pkg://"):
+            # A packaged working_dir (remote submission): fetch + extract
+            # from the cluster KV (runtime_env/packaging.py).
+            try:
+                from ray_tpu.core.runtime import get_runtime
+                from ray_tpu.runtime_env.packaging import (
+                    extract_package,
+                    fetch_package,
+                )
+
+                rt = get_runtime()
+                cache = os.path.join(rt.core.session_dir, "runtime_envs")
+                os.makedirs(cache, exist_ok=True)
+                kv_call = rt.core.client.call
+                cwd = extract_package(cwd, fetch_package(cwd, kv_call),
+                                      cache)
+            except Exception as e:  # noqa: BLE001
+                with self._lock:
+                    info["status"] = JobStatus.FAILED.value
+                    info["ended_at"] = time.time()
+                    info["error"] = f"working_dir setup failed: {e}"
+                return
         child_env = dict(os.environ)
         child_env.update(env or {})
         child_env["RAY_TPU_ADDRESS"] = self._address
@@ -187,6 +209,9 @@ class JobSubmissionClient:
                    metadata: Optional[Dict[str, str]] = None) -> str:
         env = dict((runtime_env or {}).get("env_vars", {}))
         cwd = (runtime_env or {}).get("working_dir")
+        if cwd and not os.path.isdir(str(cwd)) \
+                and not str(cwd).startswith("pkg://"):
+            raise ValueError(f"working_dir not found: {cwd!r}")
         return self._get(self._mgr.submit.remote(
             entrypoint, job_id, env, cwd, metadata))
 

@@ -175,6 +175,8 @@ class IMPALA(Algorithm):
     def _setup_from_config(self, config) -> None:
         # (ObjectRef, runner_index) sample requests kept in flight.
         self._inflight: List[Tuple[Any, int]] = []
+        # Slots shed after death with restarts disabled — never re-armed.
+        self._dead_slots: set = set()
         self._weights_ref = None
         self._batches_since_broadcast = 0
         super()._setup_from_config(config)
@@ -203,8 +205,13 @@ class IMPALA(Algorithm):
                 self.learner_group.get_weights())
         if not self._inflight:
             for i, r in enumerate(grp.remote_runners):
-                self._inflight.append((r.sample.remote(
-                    num_env_steps=cfg.rollout_fragment_length), i))
+                if i not in self._dead_slots:
+                    self._inflight.append((r.sample.remote(
+                        num_env_steps=cfg.rollout_fragment_length), i))
+        if not self._inflight:
+            # Every slot is dead (restarts disabled): sample locally.
+            return [grp.local_runner.sample(
+                num_env_steps=cfg.rollout_fragment_length)]
         ready, _ = ray_tpu.wait([ref for ref, _ in self._inflight],
                                 num_returns=1, timeout=120)
         ready_set = set(ready)
@@ -226,8 +233,10 @@ class IMPALA(Algorithm):
                 # restarts disabled — drop the slot so its permanently
                 # errored handle stops eating wait() rounds.
                 if grp.restart_failed and i < len(grp.remote_runners):
-                    grp.restart_runner(i)
+                    # Weights arrive via the fire-and-forget push below.
+                    grp.restart_runner(i, sync_weights=False)
                 else:
+                    self._dead_slots.add(i)
                     continue
             if i < len(grp.remote_runners):
                 r = grp.remote_runners[i]

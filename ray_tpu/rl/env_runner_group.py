@@ -112,11 +112,13 @@ class EnvRunnerGroup:
         }
 
     # -- fault tolerance ---------------------------------------------------
-    def restart_runner(self, i: int) -> Any:
+    def restart_runner(self, i: int, sync_weights: bool = True) -> Any:
         """Replace remote runner i (0-based slot) with a fresh actor:
         kill the old handle, spawn, resume its lifetime counter (epsilon
-        schedule), and sync current weights. Returns the new handle.
-        Shared by the sync gather path and IMPALA's async sampling loop."""
+        schedule), and (optionally) block-sync the local runner's weights.
+        IMPALA's async loop passes sync_weights=False — it pushes the
+        learner's (fresher) weights fire-and-forget right after. Shared
+        by the sync gather path and IMPALA's async sampling loop."""
         try:
             ray_tpu.kill(self.remote_runners[i])
         except Exception:
@@ -125,8 +127,9 @@ class EnvRunnerGroup:
         self.remote_runners[i] = new
         try:
             new.set_lifetime_steps.remote(self._lifetime_steps.get(i + 1, 0))
-            ray_tpu.get(new.set_weights.remote(
-                self.local_runner.get_weights()), timeout=60)
+            if sync_weights:
+                ray_tpu.get(new.set_weights.remote(
+                    self.local_runner.get_weights()), timeout=60)
         except Exception:
             pass
         return new

@@ -1,0 +1,39 @@
+"""Runtime environments: per-task/actor/job execution environments.
+
+Counterpart of the reference's runtime-env subsystem (SURVEY.md §2.2 P7:
+python/ray/_private/runtime_env/ plugin architecture + the per-node
+runtime-env agent the raylet calls). Architecture here:
+
+  driver: `prepare_runtime_env()` (packaging.py) turns local
+  working_dir / py_modules paths into content-addressed `pkg://<sha>`
+  zips uploaded once to the cluster KV — the reference's
+  packaging.py `upload_package_if_needed` flow with the GCS KV as the
+  package store.
+
+  control plane: the env dict is recorded per worker-pool env_key
+  (workers are pooled per runtime env, mirroring the reference's
+  per-env worker processes).
+
+  worker: on startup, fetches its pool's env dict and applies each
+  field through the plugin registry (plugin.py) — env_vars, working_dir,
+  py_modules, pip/conda (validation-only: this runtime has no network
+  egress; see plugin.py PipPlugin) — before reporting online.
+"""
+
+from ray_tpu.runtime_env.packaging import (
+    package_local_dir,
+    prepare_runtime_env,
+)
+from ray_tpu.runtime_env.plugin import (
+    RuntimeEnvPlugin,
+    apply_runtime_env,
+    register_plugin,
+)
+
+__all__ = [
+    "RuntimeEnvPlugin",
+    "apply_runtime_env",
+    "register_plugin",
+    "package_local_dir",
+    "prepare_runtime_env",
+]

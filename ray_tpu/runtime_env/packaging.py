@@ -1,0 +1,124 @@
+"""Driver-side runtime-env packaging: local dirs → content-addressed zips.
+
+Counterpart of the reference's python/ray/_private/runtime_env/packaging.py
+(`get_uri_for_directory` content hashing, `upload_package_if_needed` to the
+GCS KV, exclusion patterns). `pkg://<sha1>` URIs replace local paths inside
+the runtime_env dict before it ships, so the worker-pool env_key is a pure
+content hash and identical envs share one pool and one upload.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import hashlib
+import io
+import os
+import zipfile
+from typing import Dict, List, Optional
+
+_PKG_KV_PREFIX = "__runtime_env_pkg__/"
+# Mirrors the reference's default excludes + practical dev noise.
+_DEFAULT_EXCLUDES = [".git", "__pycache__", "*.pyc", ".venv", "node_modules"]
+_MAX_PACKAGE_BYTES = 512 * 1024 * 1024
+
+
+def _excluded(rel: str, excludes: List[str]) -> bool:
+    parts = rel.split(os.sep)
+    for pat in excludes:
+        if any(fnmatch.fnmatch(p, pat) for p in parts):
+            return True
+        if fnmatch.fnmatch(rel, pat):
+            return True
+    return False
+
+
+def zip_directory(path: str, excludes: Optional[List[str]] = None) -> bytes:
+    """Deterministic zip of a directory tree (stable order, fixed dates)
+    so the content hash is reproducible across processes."""
+    excludes = list(_DEFAULT_EXCLUDES) + list(excludes or [])
+    path = os.path.abspath(path)
+    entries: List[str] = []
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            rel = os.path.relpath(full, path)
+            if not _excluded(rel, excludes):
+                entries.append(rel)
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for rel in entries:
+            info = zipfile.ZipInfo(rel, date_time=(2000, 1, 1, 0, 0, 0))
+            info.compress_type = zipfile.ZIP_DEFLATED
+            with open(os.path.join(path, rel), "rb") as f:
+                zf.writestr(info, f.read())
+    data = buf.getvalue()
+    if len(data) > _MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package for {path!r} is {len(data)} bytes "
+            f"(limit {_MAX_PACKAGE_BYTES}); add excludes")
+    return data
+
+
+def package_local_dir(path: str, kv_call,
+                      excludes: Optional[List[str]] = None) -> str:
+    """Zip + upload a directory once; returns its pkg://<sha1> URI."""
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env directory not found: {path!r}")
+    data = zip_directory(path, excludes)
+    sha = hashlib.sha1(data).hexdigest()
+    uri = f"pkg://{sha}"
+    key = _PKG_KV_PREFIX + sha
+    if not kv_call({"op": "kv_exists", "key": key}):
+        kv_call({"op": "kv_put", "key": key, "value": data,
+                 "overwrite": False})
+    return uri
+
+
+def fetch_package(uri: str, kv_call) -> bytes:
+    assert uri.startswith("pkg://"), uri
+    data = kv_call({"op": "kv_get", "key": _PKG_KV_PREFIX + uri[6:]})
+    if data is None:
+        raise RuntimeError(f"runtime_env package {uri} not found in KV")
+    return data
+
+
+def extract_package(uri: str, data: bytes, cache_dir: str) -> str:
+    """Extract once into a per-URI cache dir (reference uri_cache.py role);
+    concurrent extractors race benignly via an atomic rename."""
+    sha = uri[6:]
+    target = os.path.join(cache_dir, sha)
+    if os.path.isdir(target):
+        return target
+    tmp = target + f".tmp.{os.getpid()}"
+    with zipfile.ZipFile(io.BytesIO(data)) as zf:
+        zf.extractall(tmp)
+    try:
+        os.rename(tmp, target)
+    except OSError:
+        # Another worker won the race; use its copy.
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
+def prepare_runtime_env(runtime_env: Optional[Dict], kv_call
+                        ) -> Optional[Dict]:
+    """Normalize a runtime_env dict for shipping: package local
+    working_dir / py_modules paths into pkg:// URIs. Driver-side, called
+    at task/actor submission (reference: upload happens in
+    job_config/working_dir_setup before the spec ships)."""
+    if not runtime_env:
+        return runtime_env
+    env = dict(runtime_env)
+    excludes = env.get("excludes")
+    wd = env.get("working_dir")
+    if wd and not str(wd).startswith("pkg://"):
+        env["working_dir"] = package_local_dir(str(wd), kv_call, excludes)
+    mods = env.get("py_modules")
+    if mods:
+        env["py_modules"] = [
+            m if str(m).startswith("pkg://")
+            else package_local_dir(str(m), kv_call, excludes)
+            for m in mods]
+    return env

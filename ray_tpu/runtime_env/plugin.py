@@ -1,0 +1,182 @@
+"""Runtime-env plugins: apply env fields inside a worker process.
+
+Counterpart of the reference's plugin architecture
+(python/ray/_private/runtime_env/plugin.py: RuntimeEnvPlugin ABC with
+priority ordering, discovered per field key). Each plugin owns one key of
+the runtime_env dict; `apply_runtime_env` runs them in priority order in
+the freshly-spawned worker before it reports online — the role the
+reference's per-node runtime-env agent plays for the raylet.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+
+class RuntimeEnvContext:
+    """Mutable result of plugin application (reference context.py)."""
+
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        self.cache_dir = os.path.join(session_dir, "runtime_envs")
+        os.makedirs(self.cache_dir, exist_ok=True)
+        self.env_vars: Dict[str, str] = {}
+        self.py_paths: List[str] = []
+        self.working_dir: Optional[str] = None
+
+
+class RuntimeEnvPlugin:
+    """One plugin per runtime_env key; lower priority applies first."""
+
+    name: str = ""
+    priority: int = 50
+
+    def apply(self, value: Any, ctx: RuntimeEnvContext, kv_call) -> None:
+        raise NotImplementedError
+
+
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 10
+
+    def apply(self, value, ctx, kv_call):
+        if not isinstance(value, dict):
+            raise ValueError("runtime_env['env_vars'] must be a dict")
+        for k, v in value.items():
+            ctx.env_vars[str(k)] = str(v)
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 20
+
+    def apply(self, value, ctx, kv_call):
+        from ray_tpu.runtime_env.packaging import (
+            extract_package,
+            fetch_package,
+        )
+
+        uri = str(value)
+        if not uri.startswith("pkg://"):
+            # Local path that skipped driver-side packaging (e.g. single
+            # host): use it directly.
+            ctx.working_dir = os.path.abspath(uri)
+            return
+        data = fetch_package(uri, kv_call)
+        ctx.working_dir = extract_package(uri, data, ctx.cache_dir)
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 30
+
+    def apply(self, value, ctx, kv_call):
+        from ray_tpu.runtime_env.packaging import (
+            extract_package,
+            fetch_package,
+        )
+
+        for uri in value or []:
+            uri = str(uri)
+            if uri.startswith("pkg://"):
+                path = extract_package(uri, fetch_package(uri, kv_call),
+                                       ctx.cache_dir)
+            else:
+                path = os.path.abspath(uri)
+            ctx.py_paths.append(path)
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    """Validation-only pip plugin.
+
+    The reference's pip plugin creates a virtualenv and installs packages
+    (runtime_env/pip.py). This runtime has no network egress, so instead
+    of silently doing nothing we verify each requested distribution is
+    already importable in the worker image and fail fast with a clear
+    error if not — same contract (the task runs only if its deps exist),
+    different mechanism. Version pins are checked when importlib.metadata
+    knows the installed version.
+    """
+
+    name = "pip"
+    priority = 40
+
+    def apply(self, value, ctx, kv_call):
+        reqs = value.get("packages", value) if isinstance(value, dict) \
+            else value
+        if isinstance(reqs, str):
+            reqs = [reqs]
+        missing = []
+        for req in reqs or []:
+            name = str(req).split("==")[0].split(">=")[0].split("<=")[0]
+            name = name.strip().replace("-", "_")
+            if importlib.util.find_spec(name) is None:
+                try:
+                    import importlib.metadata as md
+                    md.distribution(name)
+                except Exception:
+                    missing.append(str(req))
+        if missing:
+            raise RuntimeError(
+                f"runtime_env pip packages not available in this "
+                f"zero-egress image: {missing}; bake them into the image "
+                f"or drop the requirement")
+
+
+class CondaPlugin(PipPlugin):
+    """Conda envs collapse to the same validation-only contract."""
+
+    name = "conda"
+    priority = 40
+
+    def apply(self, value, ctx, kv_call):
+        if isinstance(value, dict):
+            deps = value.get("dependencies", [])
+            value = [d for d in deps if isinstance(d, str)
+                     and d != "python"]
+        super().apply(value, ctx, kv_call)
+
+
+_PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    _PLUGINS[plugin.name] = plugin
+
+
+for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
+           PipPlugin(), CondaPlugin()):
+    register_plugin(_p)
+
+_IGNORED_KEYS = {"excludes"}  # consumed at packaging time
+
+
+def apply_runtime_env(runtime_env: Optional[Dict], session_dir: str,
+                      kv_call) -> Optional[RuntimeEnvContext]:
+    """Run plugins for each env field and apply the resulting context to
+    THIS process (os.environ / sys.path / cwd). Called in worker startup
+    before it reports online; returns the context for inspection."""
+    if not runtime_env:
+        return None
+    ctx = RuntimeEnvContext(session_dir)
+    unknown = [k for k in runtime_env
+               if k not in _PLUGINS and k not in _IGNORED_KEYS]
+    if unknown:
+        raise ValueError(f"unknown runtime_env keys: {unknown}")
+    for key, plugin in sorted(_PLUGINS.items(),
+                              key=lambda kv: kv[1].priority):
+        if key in runtime_env:
+            plugin.apply(runtime_env[key], ctx, kv_call)
+    # Apply the context.
+    os.environ.update(ctx.env_vars)
+    for p in reversed(ctx.py_paths):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    if ctx.working_dir:
+        os.chdir(ctx.working_dir)
+        if ctx.working_dir not in sys.path:
+            sys.path.insert(0, ctx.working_dir)
+    return ctx

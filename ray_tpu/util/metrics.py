@@ -51,9 +51,16 @@ class Metric:
         self._lock = threading.Lock()
         with _registry_lock:
             existing = _registry.get(name)
-            if existing is not None and existing.kind != self.kind:
-                raise ValueError(
-                    f"metric {name!r} already registered as {existing.kind}")
+            if existing is not None:
+                if existing.kind != self.kind:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}")
+                # Re-instantiation (e.g. the same task body running twice
+                # in a reused worker) adopts the accumulated series rather
+                # than silently resetting counters.
+                self._series = existing._series
+                self._lock = existing._lock
             _registry[name] = self
         _ensure_publisher()
 
@@ -149,18 +156,53 @@ class Histogram(Metric):
 # Exposition
 # ---------------------------------------------------------------------------
 
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt_tags(key: Tuple[Tuple[str, str], ...]) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label(v)}"' for k, v in key)
     return "{" + inner + "}"
+
+
+def merge_snapshots(snapshots: List[dict]) -> List[dict]:
+    """Merge same-name metrics from different processes into one snapshot
+    per name so the exposition never carries duplicate samples (which
+    would fail the whole Prometheus scrape): counters and histograms sum,
+    gauges last-write-wins."""
+    merged: Dict[str, dict] = {}
+    for snap in snapshots:
+        name = snap["name"]
+        cur = merged.get(name)
+        if cur is None:
+            merged[name] = {**snap, "series": dict(snap["series"])}
+            continue
+        if cur["kind"] != snap["kind"]:
+            continue  # conflicting registration; keep the first
+        for key, val in snap["series"].items():
+            if key not in cur["series"]:
+                cur["series"][key] = val
+            elif cur["kind"] == "counter":
+                cur["series"][key] = cur["series"][key] + val
+            elif cur["kind"] == "histogram" and \
+                    cur.get("boundaries") == snap.get("boundaries"):
+                a, b = cur["series"][key], val
+                cur["series"][key] = [
+                    [x + y for x, y in zip(a[0], b[0])],
+                    a[1] + b[1], a[2] + b[2]]
+            else:
+                cur["series"][key] = val
+    return list(merged.values())
 
 
 def snapshots_to_prometheus_text(snapshots: List[dict]) -> str:
     """Render metric snapshots as Prometheus text exposition format."""
     lines: List[str] = []
     seen_help = set()
-    for snap in snapshots:
+    for snap in merge_snapshots(snapshots):
         name, kind = snap["name"], snap["kind"]
         if name not in seen_help:
             if snap.get("description"):

@@ -1,0 +1,145 @@
+"""Runtime-env subsystem tests (SURVEY.md §2.2 P7): packaging, plugins,
+worker-side application of env_vars / working_dir / py_modules, pip
+validation, and pool separation by env."""
+
+import os
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env.packaging import zip_directory
+from ray_tpu.runtime_env.plugin import apply_runtime_env
+
+
+def _write_module(dirpath, name, body):
+    os.makedirs(dirpath, exist_ok=True)
+    with open(os.path.join(dirpath, name), "w") as f:
+        f.write(textwrap.dedent(body))
+
+
+# ---------------------------------------------------------------------------
+# Packaging
+# ---------------------------------------------------------------------------
+
+def test_zip_directory_deterministic_and_excludes(tmp_path):
+    d = tmp_path / "proj"
+    _write_module(str(d), "a.py", "x = 1\n")
+    _write_module(str(d / "__pycache__"), "junk.pyc", "zz")
+    _write_module(str(d / ".git"), "config", "zz")
+    z1 = zip_directory(str(d))
+    z2 = zip_directory(str(d))
+    assert z1 == z2  # deterministic → content-addressable
+    import io
+    import zipfile
+
+    names = zipfile.ZipFile(io.BytesIO(z1)).namelist()
+    assert names == ["a.py"]
+
+
+def test_unknown_runtime_env_key_rejected(tmp_path):
+    with pytest.raises(ValueError, match="unknown runtime_env"):
+        apply_runtime_env({"bogus_key": 1}, str(tmp_path), None)
+
+
+def test_pip_plugin_validates_available_packages(tmp_path):
+    # numpy is baked into the image → passes; a made-up package fails.
+    apply_runtime_env({"pip": ["numpy"]}, str(tmp_path), None)
+    with pytest.raises(RuntimeError, match="zero-egress"):
+        apply_runtime_env({"pip": ["definitely_not_a_real_pkg_xyz"]},
+                          str(tmp_path), None)
+
+
+# ---------------------------------------------------------------------------
+# End to end through workers
+# ---------------------------------------------------------------------------
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_env_vars_reach_worker():
+    @ray_tpu.remote(runtime_env={"env_vars": {"MY_FLAG": "hello42"}})
+    def read_flag():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_flag.remote()) == "hello42"
+
+    # And a task WITHOUT the env runs in a pool without the var.
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("MY_FLAG")
+
+    assert ray_tpu.get(read_plain.remote()) is None
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_working_dir_ships_to_worker(tmp_path):
+    proj = tmp_path / "proj"
+    _write_module(str(proj), "my_working_dir_mod.py", "VALUE = 'wd-ok'\n")
+    _write_module(str(proj), "data.txt", "payload\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(proj)})
+    def use_working_dir():
+        import my_working_dir_mod  # importable: working_dir on sys.path
+
+        with open("data.txt") as f:  # cwd is the extracted package
+            return my_working_dir_mod.VALUE, f.read().strip()
+
+    assert ray_tpu.get(use_working_dir.remote()) == ("wd-ok", "payload")
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_py_modules_ships_to_worker(tmp_path):
+    mod = tmp_path / "extra_mod"
+    _write_module(str(mod), "__init__.py", "WHO = 'py-modules'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(tmp_path)]})
+    def use_module():
+        import extra_mod
+
+        return extra_mod.WHO
+
+    assert ray_tpu.get(use_module.remote()) == "py-modules"
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_actor_runtime_env(tmp_path):
+    class EnvActor:
+        def flag(self):
+            return os.environ.get("ACTOR_FLAG")
+
+    cls = ray_tpu.remote(EnvActor)
+    a = cls.options(
+        runtime_env={"env_vars": {"ACTOR_FLAG": "actor-env"}}).remote()
+    assert ray_tpu.get(a.flag.remote()) == "actor-env"
+    ray_tpu.kill(a)
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_bad_pip_requirement_fails_task():
+    from ray_tpu.core.exceptions import RayTpuError
+
+    @ray_tpu.remote(runtime_env={"pip": ["not_a_real_package_qq"]},
+                    max_retries=0)
+    def doomed():
+        return 1
+
+    ref = doomed.remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=60)
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_same_env_shares_worker_pool(tmp_path):
+    """Two tasks with the SAME runtime_env reuse one pool (same content
+    hash even from different dict instances)."""
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"K": "1"}})
+    def pid_a():
+        return os.getpid()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"K": "1"}})
+    def pid_b():
+        return os.getpid()
+
+    pa = ray_tpu.get(pid_a.remote())
+    pb = ray_tpu.get(pid_b.remote())
+    assert pa == pb
