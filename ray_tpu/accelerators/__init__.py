@@ -1,0 +1,40 @@
+"""Accelerator managers (counterpart of python/ray/_private/accelerators/).
+
+The reference ships one AcceleratorManager per vendor (nvidia/amd/intel
+GPU, TPU, neuron, hpu, npu — accelerator.py ABC). A TPU-native runtime
+needs exactly one real manager — TPU — plus the ABC so other accelerators
+can plug in; CPU needs no manager (cpu_count is core logic).
+"""
+
+from ray_tpu.accelerators.accelerator import AcceleratorManager
+from ray_tpu.accelerators.tpu import TPUAcceleratorManager
+
+_MANAGERS = [TPUAcceleratorManager()]
+
+
+def get_all_accelerator_managers():
+    return list(_MANAGERS)
+
+
+def register_accelerator_manager(mgr: AcceleratorManager) -> None:
+    _MANAGERS.append(mgr)
+
+
+def detect_additional_resources() -> dict:
+    """All managers' extra node resources (pod-type markers etc.)."""
+    out = {}
+    for mgr in _MANAGERS:
+        try:
+            out.update(mgr.get_additional_resources())
+        except Exception:
+            pass
+    return out
+
+
+__all__ = [
+    "AcceleratorManager",
+    "TPUAcceleratorManager",
+    "get_all_accelerator_managers",
+    "register_accelerator_manager",
+    "detect_additional_resources",
+]

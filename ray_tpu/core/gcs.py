@@ -209,10 +209,13 @@ class ControlServer:
         # env_key -> runtime_env dict; workers fetch + apply their pool's
         # env at startup (runtime_env/plugin.py).
         self.runtime_envs: Dict[str, dict] = {}
-        # env_key -> setup error; tasks needing a broken env fail fast
-        # instead of respawning workers forever (reference: runtime-env
-        # agent setup failure fails the lease request).
-        self.broken_envs: Dict[str, str] = {}
+        # env_key -> (setup error, poisoned_at); tasks needing a broken
+        # env fail fast instead of respawning workers forever (reference:
+        # runtime-env agent setup failure fails the lease request). The
+        # poison expires so transient node-local failures (full disk, KV
+        # hiccup) don't brick the env for the cluster's lifetime.
+        self.broken_envs: Dict[str, tuple] = {}
+        self.broken_env_ttl_s = 60.0
 
         head = NodeState(node_id="head", total=resources,
                          available=resources, is_head=True)
@@ -1191,9 +1194,12 @@ class ControlServer:
         renv = getattr(spec, "runtime_env", None)
         if renv:
             key = self._env_key_for(spec.resources, renv)
-            err = self.broken_envs.get(key)
-            if err:
-                return f"runtime_env setup failed: {err}"
+            entry = self.broken_envs.get(key)
+            if entry is not None:
+                err, poisoned_at = entry
+                if time.time() - poisoned_at <= self.broken_env_ttl_s:
+                    return f"runtime_env setup failed: {err}"
+                del self.broken_envs[key]  # expired: allow a fresh try
         return None
 
     def _charge_avail(self, charge: tuple) -> ResourceSet:
@@ -1375,7 +1381,7 @@ class ControlServer:
         env_key = msg.get("env_key", "")
         error = msg.get("error", "runtime_env setup failed")
         with self.lock:
-            self.broken_envs[env_key] = error
+            self.broken_envs[env_key] = (error, time.time())
         self._wake.set()
         return True
 

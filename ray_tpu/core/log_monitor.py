@@ -77,6 +77,15 @@ class LogMonitor:
                 continue
             if not chunk:
                 continue
+            # Emit only complete lines: a chunk can end mid-line (or even
+            # mid-UTF-8-sequence); holding the tail until its newline
+            # arrives keeps characters and lines intact across sweeps.
+            nl = chunk.rfind(b"\n")
+            if nl < 0:
+                if len(chunk) < 65536:
+                    continue  # wait for the newline
+                nl = len(chunk) - 1  # pathological no-newline flood: flush
+            chunk = chunk[:nl + 1]
             self._offsets[path] = offset + len(chunk)
             stream = self.out if m.group("stream") == "out" else self.err
             prefix = f"({m.group('hex')[:8]}) "

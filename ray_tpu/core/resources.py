@@ -84,7 +84,8 @@ class ResourceSet:
 
 
 def node_resources_from_env(num_cpus=None, num_tpus=None, extra=None) -> ResourceSet:
-    """Detect this host's resources (CPU count, TPU chips if visible)."""
+    """Detect this host's resources (CPU count, TPU chips if visible,
+    accelerator pod-type markers like TPU-v4-16 / TPU-v4-16-head)."""
     import os
 
     amounts: Dict[str, float] = {}
@@ -93,6 +94,12 @@ def node_resources_from_env(num_cpus=None, num_tpus=None, extra=None) -> Resourc
         num_tpus = detect_tpu_chips()
     if num_tpus:
         amounts[TPU] = float(num_tpus)
+        try:
+            from ray_tpu.accelerators import detect_additional_resources
+
+            amounts.update(detect_additional_resources())
+        except Exception:
+            pass
     if extra:
         amounts.update(extra)
     return ResourceSet(amounts)

@@ -23,8 +23,10 @@ from ray_tpu.data.dataset import (
 )
 from ray_tpu.data.datasource import Datasource, ReadTask
 from ray_tpu.data.iterator import DataIterator
+from ray_tpu.data import preprocessors
 
 __all__ = [
+    "preprocessors",
     "Block",
     "BlockAccessor",
     "BlockMetadata",

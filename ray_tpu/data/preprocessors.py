@@ -1,0 +1,290 @@
+"""Preprocessors: fit statistics on a Dataset, transform Datasets/batches.
+
+Counterpart of the reference's python/ray/data/preprocessors/ (Preprocessor
+ABC with fit/transform/fit_transform + concrete scalers/encoders/chains;
+SURVEY.md §2.3 L1). Fitting streams the dataset once through numpy
+aggregations on the host; `transform` is a `map_batches` over Arrow blocks,
+so preprocessed pipelines keep the streaming-executor shape that feeds
+device meshes. `transform_batch` applies the same stats to one in-memory
+batch (the serving path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Preprocessor:
+    """Fit/transform over ray_tpu.data Datasets."""
+
+    _fitted = False
+
+    # -- to be implemented by subclasses -----------------------------------
+    def _fit(self, ds) -> None:
+        """Compute and store statistics from the dataset."""
+        raise NotImplementedError
+
+    def _transform_numpy(self, batch: Dict[str, np.ndarray]
+                         ) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    # -- public API (reference preprocessor.py) ----------------------------
+    def fit(self, ds) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds):
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(
+                f"{type(self).__name__} must be fit before transform")
+        return ds.map_batches(self._transform_numpy, batch_format="numpy")
+
+    def fit_transform(self, ds):
+        return self.fit(ds).transform(ds)
+
+    def transform_batch(self, batch: Dict[str, np.ndarray]
+                        ) -> Dict[str, np.ndarray]:
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(
+                f"{type(self).__name__} must be fit before transform")
+        return self._transform_numpy(
+            {k: np.asarray(v) for k, v in batch.items()})
+
+    def _needs_fit(self) -> bool:
+        return True
+
+
+def _column_moments(ds, columns: Sequence[str]):
+    """One streaming pass: per-column count/sum/sumsq/min/max."""
+    stats = {c: [0, 0.0, 0.0, np.inf, -np.inf] for c in columns}
+    for batch in ds.iter_batches(batch_format="numpy"):
+        for c in columns:
+            v = np.asarray(batch[c], dtype=np.float64).ravel()
+            s = stats[c]
+            s[0] += v.size
+            s[1] += v.sum()
+            s[2] += (v * v).sum()
+            if v.size:
+                s[3] = min(s[3], v.min())
+                s[4] = max(s[4], v.max())
+    return stats
+
+
+class StandardScaler(Preprocessor):
+    """Column-wise (x - mean) / std (reference scaler.py StandardScaler)."""
+
+    def __init__(self, columns: Sequence[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, Any] = {}
+
+    def _fit(self, ds):
+        for c, (n, sm, ss, _, _) in _column_moments(ds, self.columns).items():
+            mean = sm / max(n, 1)
+            var = max(ss / max(n, 1) - mean * mean, 0.0)
+            self.stats_[c] = (mean, float(np.sqrt(var)))
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            mean, std = self.stats_[c]
+            out[c] = ((np.asarray(batch[c], dtype=np.float64) - mean)
+                      / (std or 1.0)).astype(np.float32)
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    """Column-wise (x - min) / (max - min)."""
+
+    def __init__(self, columns: Sequence[str]):
+        self.columns = list(columns)
+        self.stats_: Dict[str, Any] = {}
+
+    def _fit(self, ds):
+        for c, (_, _, _, lo, hi) in _column_moments(
+                ds, self.columns).items():
+            self.stats_[c] = (float(lo), float(hi))
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            lo, hi = self.stats_[c]
+            span = (hi - lo) or 1.0
+            out[c] = ((np.asarray(batch[c], dtype=np.float64) - lo)
+                      / span).astype(np.float32)
+        return out
+
+
+class LabelEncoder(Preprocessor):
+    """String/any labels → dense int codes (reference encoder.py)."""
+
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: List[Any] = []
+
+    def _fit(self, ds):
+        seen = set()
+        for batch in ds.iter_batches(batch_format="numpy"):
+            seen.update(np.asarray(batch[self.label_column]).ravel()
+                        .tolist())
+        self.classes_ = sorted(seen, key=str)
+        self._index = {v: i for i, v in enumerate(self.classes_)}
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        vals = np.asarray(batch[self.label_column]).ravel()
+        try:
+            out[self.label_column] = np.asarray(
+                [self._index[v] for v in vals.tolist()], dtype=np.int64)
+        except KeyError as e:
+            raise ValueError(
+                f"label {e.args[0]!r} not seen during fit") from None
+        return out
+
+
+class OneHotEncoder(Preprocessor):
+    """Categorical columns → one-hot float vectors in `{col}_onehot`."""
+
+    def __init__(self, columns: Sequence[str]):
+        self.columns = list(columns)
+        self.categories_: Dict[str, List[Any]] = {}
+
+    def _fit(self, ds):
+        seen: Dict[str, set] = {c: set() for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                seen[c].update(np.asarray(batch[c]).ravel().tolist())
+        self.categories_ = {c: sorted(v, key=str) for c, v in seen.items()}
+        self._index = {c: {v: i for i, v in enumerate(cats)}
+                       for c, cats in self.categories_.items()}
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            idx = self._index[c]
+            vals = np.asarray(batch[c]).ravel()
+            hot = np.zeros((len(vals), len(idx)), dtype=np.float32)
+            for r, v in enumerate(vals.tolist()):
+                j = idx.get(v)
+                if j is None:
+                    raise ValueError(
+                        f"category {v!r} in column {c!r} not seen "
+                        "during fit")
+                hot[r, j] = 1.0
+            out[f"{c}_onehot"] = hot
+            del out[c]
+        return out
+
+
+class SimpleImputer(Preprocessor):
+    """Fill NaNs with the column mean (strategy='mean') or a constant."""
+
+    def __init__(self, columns: Sequence[str], strategy: str = "mean",
+                 fill_value: Optional[float] = None):
+        if strategy not in ("mean", "constant"):
+            raise ValueError(f"unknown imputer strategy {strategy!r}")
+        self.columns = list(columns)
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: Dict[str, float] = {}
+
+    def _fit(self, ds):
+        if self.strategy == "constant":
+            self.stats_ = {c: float(self.fill_value or 0.0)
+                           for c in self.columns}
+            return
+        sums = {c: [0, 0.0] for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy"):
+            for c in self.columns:
+                v = np.asarray(batch[c], dtype=np.float64).ravel()
+                valid = v[~np.isnan(v)]
+                sums[c][0] += valid.size
+                sums[c][1] += valid.sum()
+        self.stats_ = {c: (s / max(n, 1)) for c, (n, s) in sums.items()}
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            v = np.asarray(batch[c], dtype=np.float64)
+            out[c] = np.where(np.isnan(v), self.stats_[c], v).astype(
+                np.float32)
+        return out
+
+
+class Concatenator(Preprocessor):
+    """Concatenate numeric columns into one vector column (the standard
+    last step before feeding a model; reference concatenator.py)."""
+
+    def __init__(self, columns: Sequence[str], output_column: str = "features",
+                 drop: bool = True):
+        self.columns = list(columns)
+        self.output_column = output_column
+        self.drop = drop
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, ds):
+        pass
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        parts = []
+        for c in self.columns:
+            v = np.asarray(batch[c], dtype=np.float32)
+            parts.append(v.reshape(len(v), -1))
+        out[self.output_column] = np.concatenate(parts, axis=1)
+        if self.drop:
+            for c in self.columns:
+                out.pop(c, None)
+        return out
+
+
+class BatchMapper(Preprocessor):
+    """Stateless user-function preprocessor (reference batch_mapper.py)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, ds):
+        pass
+
+    def _transform_numpy(self, batch):
+        return self.fn(batch)
+
+
+class Chain(Preprocessor):
+    """Sequentially-applied preprocessors; fit runs each stage on the
+    output of the previous stages (reference chain.py)."""
+
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = list(preprocessors)
+
+    def _needs_fit(self) -> bool:
+        return any(p._needs_fit() for p in self.preprocessors)
+
+    def fit(self, ds) -> "Chain":
+        for p in self.preprocessors:
+            if p._needs_fit():
+                p.fit(ds)
+            ds = p.transform(ds)
+        self._fitted = True
+        return self
+
+    def _fit(self, ds):  # unused; fit() overridden
+        pass
+
+    def transform(self, ds):
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds
+
+    def _transform_numpy(self, batch):
+        for p in self.preprocessors:
+            batch = p._transform_numpy(batch)
+        return batch

@@ -209,9 +209,18 @@ class JobSubmissionClient:
                    metadata: Optional[Dict[str, str]] = None) -> str:
         env = dict((runtime_env or {}).get("env_vars", {}))
         cwd = (runtime_env or {}).get("working_dir")
-        if cwd and not os.path.isdir(str(cwd)) \
-                and not str(cwd).startswith("pkg://"):
-            raise ValueError(f"working_dir not found: {cwd!r}")
+        if cwd and not str(cwd).startswith("pkg://"):
+            if not os.path.isdir(str(cwd)):
+                raise ValueError(f"working_dir not found: {cwd!r}")
+            # Package the local dir into the cluster KV: the manager
+            # actor may live on another node where this path does not
+            # exist (same flow as task/actor submission).
+            from ray_tpu.core.runtime import get_runtime
+            from ray_tpu.runtime_env.packaging import package_local_dir
+
+            cwd = package_local_dir(
+                str(cwd), get_runtime().kv().call,
+                (runtime_env or {}).get("excludes"))
         return self._get(self._mgr.submit.remote(
             entrypoint, job_id, env, cwd, metadata))
 

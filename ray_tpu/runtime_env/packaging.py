@@ -60,11 +60,41 @@ def zip_directory(path: str, excludes: Optional[List[str]] = None) -> bytes:
     return data
 
 
+# (abspath, excludes, stat fingerprint) -> pkg URI. Spares the full
+# read+deflate+sha1 on every submission of an unchanged directory (the
+# reference memoizes directory URIs the same way); the fingerprint walk
+# costs only stat calls, so edits are still picked up.
+_dir_uri_cache: Dict[tuple, str] = {}
+
+
+def _dir_fingerprint(path: str, excludes: List[str]) -> tuple:
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for f in sorted(files):
+            full = os.path.join(root, f)
+            rel = os.path.relpath(full, path)
+            if _excluded(rel, excludes):
+                continue
+            try:
+                st = os.stat(full)
+                entries.append((rel, st.st_size, st.st_mtime_ns))
+            except OSError:
+                entries.append((rel, -1, -1))
+    return tuple(entries)
+
+
 def package_local_dir(path: str, kv_call,
                       excludes: Optional[List[str]] = None) -> str:
     """Zip + upload a directory once; returns its pkg://<sha1> URI."""
     if not os.path.isdir(path):
         raise ValueError(f"runtime_env directory not found: {path!r}")
+    all_excludes = list(_DEFAULT_EXCLUDES) + list(excludes or [])
+    cache_key = (os.path.abspath(path), tuple(excludes or ()),
+                 _dir_fingerprint(os.path.abspath(path), all_excludes))
+    cached = _dir_uri_cache.get(cache_key)
+    if cached is not None:
+        return cached
     data = zip_directory(path, excludes)
     sha = hashlib.sha1(data).hexdigest()
     uri = f"pkg://{sha}"
@@ -72,6 +102,7 @@ def package_local_dir(path: str, kv_call,
     if not kv_call({"op": "kv_exists", "key": key}):
         kv_call({"op": "kv_put", "key": key, "value": data,
                  "overwrite": False})
+    _dir_uri_cache[cache_key] = uri
     return uri
 
 

@@ -95,30 +95,41 @@ class PipPlugin(RuntimeEnvPlugin):
     The reference's pip plugin creates a virtualenv and installs packages
     (runtime_env/pip.py). This runtime has no network egress, so instead
     of silently doing nothing we verify each requested distribution is
-    already importable in the worker image and fail fast with a clear
-    error if not — same contract (the task runs only if its deps exist),
-    different mechanism. Version pins are checked when importlib.metadata
-    knows the installed version.
+    already present in the worker image and fail fast with a clear error
+    if not — same contract (the task runs only if its deps exist),
+    different mechanism. Version specifiers are NOT checked, only
+    presence.
     """
 
     name = "pip"
     priority = 40
 
     def apply(self, value, ctx, kv_call):
+        import re
+
         reqs = value.get("packages", value) if isinstance(value, dict) \
             else value
         if isinstance(reqs, str):
             reqs = [reqs]
         missing = []
         for req in reqs or []:
-            name = str(req).split("==")[0].split(">=")[0].split("<=")[0]
-            name = name.strip().replace("-", "_")
-            if importlib.util.find_spec(name) is None:
+            # Project name = everything before any extras / specifier /
+            # marker (PEP 508): 'numpy>1.20', 'requests[socks]==2',
+            # 'pkg; python_version<"3.11"' all reduce to the name.
+            name = re.split(r"[\s\[<>=!~;(]", str(req).strip(), 1)[0]
+            if not name:
+                continue
+            found = importlib.util.find_spec(name.replace("-", "_")) \
+                is not None
+            if not found:
                 try:
                     import importlib.metadata as md
                     md.distribution(name)
+                    found = True
                 except Exception:
-                    missing.append(str(req))
+                    found = False
+            if not found:
+                missing.append(str(req))
         if missing:
             raise RuntimeError(
                 f"runtime_env pip packages not available in this "

@@ -56,6 +56,12 @@ class Metric:
                     raise ValueError(
                         f"metric {name!r} already registered as "
                         f"{existing.kind}")
+                if (self.kind == "histogram"
+                        and getattr(existing, "boundaries", None)
+                        != getattr(self, "boundaries", None)):
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"boundaries {existing.boundaries}")
                 # Re-instantiation (e.g. the same task body running twice
                 # in a reused worker) adopts the accumulated series rather
                 # than silently resetting counters.

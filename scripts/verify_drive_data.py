@@ -76,5 +76,21 @@ for batch in rd.range(64, parallelism=4).iter_device_batches(
 assert n == 64
 print("[5] iter_device_batches sharded over", len(jax.devices()), "devices")
 
+# [6] preprocessors: fit on a dataset, transform streams through workers,
+# transform_batch serves single batches with the same stats.
+import numpy as np
+
+from ray_tpu.data.preprocessors import Chain, Concatenator, StandardScaler
+
+ds6 = rd.from_items([{"x": float(i), "y": float(i % 3)} for i in range(20)])
+chain = Chain(StandardScaler(columns=["x"]),
+              Concatenator(columns=["x", "y"])).fit(ds6)
+feats = chain.transform(ds6).take_batch(20)["features"]
+assert feats.shape == (20, 2)
+assert abs(float(np.asarray(feats)[:, 0].mean())) < 1e-5
+one = chain.transform_batch({"x": np.array([9.5]), "y": np.array([1.0])})
+assert abs(float(one["features"][0, 0])) < 1e-5  # 9.5 = fitted mean
+print("[6] preprocessors fit/transform/transform_batch ok")
+
 ray_tpu.shutdown()
 print("DATA DRIVE OK")

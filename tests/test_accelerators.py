@@ -1,0 +1,75 @@
+"""TPU accelerator manager tests (SURVEY.md §2.2 P2)."""
+
+import os
+
+import pytest
+
+from ray_tpu.accelerators import (
+    TPUAcceleratorManager,
+    detect_additional_resources,
+)
+from ray_tpu.core.resources import node_resources_from_env
+
+
+@pytest.fixture
+def tpu_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_NO_METADATA", "1")
+    monkeypatch.setenv("TPU_VISIBLE_CHIPS", "0,1,2,3")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-16")
+    monkeypatch.setenv("TPU_TOPOLOGY", "2x2x2")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    yield
+
+
+def test_chip_and_type_detection(tpu_env):
+    mgr = TPUAcceleratorManager()
+    assert mgr.get_num_accelerators() == 4
+    assert mgr.get_accelerator_type() == "v4-16"
+    assert mgr.get_topology() == "2x2x2"
+    assert mgr.mesh_shape_hint() == [2, 2, 2]
+    assert mgr.get_worker_id() == 0
+
+
+def test_pod_resources_head_host(tpu_env):
+    res = detect_additional_resources()
+    assert res["TPU-v4-16"] == 4.0
+    assert res["TPU-v4-16-head"] == 1.0
+
+
+def test_pod_resources_non_head_host(tpu_env, monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_ID", "2")
+    res = detect_additional_resources()
+    assert res["TPU-v4-16"] == 4.0
+    assert "TPU-v4-16-head" not in res
+
+
+def test_node_resources_include_pod_markers(tpu_env):
+    rs = node_resources_from_env(num_cpus=2)
+    d = rs.to_dict()
+    assert d["TPU"] == 4.0
+    assert d["TPU-v4-16"] == 4.0
+    assert d["TPU-v4-16-head"] == 1.0
+
+
+def test_no_tpu_environment(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_NO_METADATA", "1")
+    monkeypatch.setenv("RAY_TPU_CHIPS", "none")
+    monkeypatch.delenv("TPU_VISIBLE_CHIPS", raising=False)
+    mgr = TPUAcceleratorManager()
+    assert mgr.get_num_accelerators() == 0
+    assert mgr.get_additional_resources() == {}
+    rs = node_resources_from_env(num_cpus=2)
+    assert "TPU" not in rs.to_dict()
+
+
+def test_request_validation():
+    mgr = TPUAcceleratorManager()
+    assert mgr.validate_resource_request_quantity(4.0) is None
+    assert mgr.validate_resource_request_quantity(1.0) is None
+    assert "fractional" in mgr.validate_resource_request_quantity(0.5)
+    assert "sub-host" in mgr.validate_resource_request_quantity(3.0)
+
+
+def test_visibility_env():
+    mgr = TPUAcceleratorManager()
+    assert mgr.get_visibility_env([0, 1]) == {"TPU_VISIBLE_CHIPS": "0,1"}

@@ -1,0 +1,105 @@
+"""Preprocessor tests (SURVEY.md §2.3 L1 preprocessors/)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu.data as rd
+from ray_tpu.data.preprocessors import (
+    BatchMapper,
+    Chain,
+    Concatenator,
+    LabelEncoder,
+    MinMaxScaler,
+    OneHotEncoder,
+    SimpleImputer,
+    StandardScaler,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _runtime():
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=8)
+    yield rt
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ds():
+    return rd.from_items([
+        {"a": float(i), "b": float(i * 10), "cat": ["x", "y", "z"][i % 3],
+         "label": ["neg", "pos"][i % 2]}
+        for i in range(12)
+    ])
+
+
+def test_standard_scaler(ds):
+    sc = StandardScaler(columns=["a"]).fit(ds)
+    out = sc.transform(ds).take_batch(12)
+    a = np.asarray(out["a"])
+    assert abs(a.mean()) < 1e-5
+    assert abs(a.std() - 1.0) < 1e-5
+    # transform_batch path (serving)
+    one = sc.transform_batch({"a": np.array([5.5])})
+    assert abs(float(one["a"][0])) < 1e-5  # 5.5 is the fitted mean
+
+
+def test_min_max_scaler(ds):
+    sc = MinMaxScaler(columns=["b"]).fit(ds)
+    out = sc.transform(ds).take_batch(12)
+    b = np.asarray(out["b"])
+    assert b.min() == 0.0 and b.max() == 1.0
+
+
+def test_label_encoder(ds):
+    enc = LabelEncoder("label").fit(ds)
+    assert enc.classes_ == ["neg", "pos"]
+    out = enc.transform(ds).take_batch(4)
+    assert set(np.asarray(out["label"]).tolist()) <= {0, 1}
+    with pytest.raises(ValueError, match="not seen"):
+        enc.transform_batch({"label": np.array(["mystery"])})
+
+
+def test_one_hot_encoder(ds):
+    enc = OneHotEncoder(columns=["cat"]).fit(ds)
+    out = enc.transform(ds).take_batch(6)
+    hot = np.asarray(out["cat_onehot"])
+    assert hot.shape == (6, 3)
+    np.testing.assert_allclose(hot.sum(axis=1), 1.0)
+    assert "cat" not in out
+
+
+def test_simple_imputer():
+    d = rd.from_items([{"v": 1.0}, {"v": float("nan")}, {"v": 3.0}])
+    imp = SimpleImputer(columns=["v"]).fit(d)
+    out = imp.transform(d).take_batch(3)
+    np.testing.assert_allclose(sorted(out["v"]), [1.0, 2.0, 3.0])
+
+
+def test_concatenator_and_chain(ds):
+    chain = Chain(
+        StandardScaler(columns=["a"]),
+        OneHotEncoder(columns=["cat"]),
+        Concatenator(columns=["a", "b", "cat_onehot"]),
+    ).fit(ds)
+    out = chain.transform(ds).take_batch(5)
+    # 1 (a) + 1 (b) + 3 (one-hot) = 5 features
+    assert np.asarray(out["features"]).shape == (5, 5)
+    assert "a" not in out and "cat_onehot" not in out
+    # Single-batch path matches the dataset path.
+    row = chain.transform_batch(
+        {"a": np.array([0.0]), "b": np.array([0.0]),
+         "cat": np.array(["x"])})
+    assert row["features"].shape == (1, 5)
+
+
+def test_batch_mapper(ds):
+    bm = BatchMapper(lambda b: {**b, "a2": np.asarray(b["a"]) * 2})
+    out = bm.transform(ds).take_batch(3)
+    np.testing.assert_allclose(out["a2"], np.asarray(out["a"]) * 2)
+
+
+def test_unfit_transform_raises(ds):
+    with pytest.raises(RuntimeError, match="must be fit"):
+        StandardScaler(columns=["a"]).transform(ds)
