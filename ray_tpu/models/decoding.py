@@ -1,0 +1,166 @@
+"""Autoregressive decoding over a paged KV cache.
+
+The reference serves LLMs by delegating to vLLM over compiled DAGs
+(SURVEY.md P12); here the inference path is owned end to end: prefill
+writes the prompt's K/V into pages, decode_step advances every active
+sequence one token with paged attention (ops/paged_attention.py). Both
+are single jitted programs with static shapes — [max_batch] slots,
+[B, max_pages] block tables — so continuous batching (serve/llm_engine.py)
+never recompiles as requests come and go.
+
+Numerics intentionally mirror models/transformer.py `forward` (same
+rms_norm/rope/projection order), so greedy decode reproduces the full
+forward's argmax token-for-token — tested in tests/test_llm_decoding.py.
+Dense blocks only for now (MoE decode lands with an EP-aware router).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    apply_rope,
+    rms_norm,
+    rope_freqs,
+)
+from ray_tpu.ops.paged_attention import paged_attention, write_page_tokens
+
+
+def init_kv_pages(config: TransformerConfig, num_pages: int,
+                  page_size: int) -> Dict[str, jax.Array]:
+    """Paged KV cache for all layers: [L, P, page, KVH, head_dim]."""
+    c = config
+    shape = (c.num_layers, num_pages, page_size, c.num_kv_heads,
+             c.head_dim_)
+    return {"k": jnp.zeros(shape, dtype=c.dtype),
+            "v": jnp.zeros(shape, dtype=c.dtype)}
+
+
+def _layer_params(params: Dict[str, Any], l: int):
+    """Blocks are stacked [L, ...] (scan layout); slice out layer l."""
+    return jax.tree.map(lambda x: x[l], params["blocks"])
+
+
+def _project_qkv(x, bp, positions, cos, sin, c: TransformerConfig):
+    """Shared prefill/decode Q/K/V computation ([B, S, ...])."""
+    b, s, h = x.shape
+    hd = c.head_dim_
+    y = rms_norm(x, bp["attn_norm"], c.rms_eps)
+    q = (y @ bp["wq"].astype(c.dtype)).reshape(b, s, c.num_heads, hd)
+    k = (y @ bp["wk"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
+    v = (y @ bp["wv"].astype(c.dtype)).reshape(b, s, c.num_kv_heads, hd)
+    safe_pos = jnp.maximum(positions, 0)
+    q = apply_rope(q, cos, sin, safe_pos)
+    k = apply_rope(k, cos, sin, safe_pos)
+    return q, k, v
+
+
+def _mlp(x, bp, c: TransformerConfig):
+    y = rms_norm(x, bp["mlp_norm"], c.rms_eps)
+    gate = jax.nn.silu(y @ bp["w_gate"].astype(c.dtype))
+    up = y @ bp["w_up"].astype(c.dtype)
+    return x + ((gate * up) @ bp["w_down"].astype(c.dtype))
+
+
+def _lm_head(x, params, c: TransformerConfig):
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    return jnp.einsum("bh,vh->bv", x.astype(jnp.float32),
+                      params["tok_embed"].astype(jnp.float32))
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill(params, tokens, positions, cache, block_tables,
+            config: TransformerConfig
+            ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Process a (padded) prompt, writing its K/V into pages.
+
+    tokens: [B, S] int32 (pad with anything); positions: [B, S] int32
+    absolute positions, -1 on padding (pad K/V writes are dropped and
+    pad queries masked). Returns (logits at each row's LAST valid
+    position [B, vocab] fp32, updated cache).
+    """
+    c = config
+    assert c.num_experts == 0, "MoE decode not wired yet"
+    assert c.scan_layers, \
+        "decoding expects stacked [L, ...] block params (scan_layers=True)"
+    B, S = tokens.shape
+    x = params["tok_embed"].astype(c.dtype)[tokens]
+    cos, sin = rope_freqs(c.head_dim_, c.max_seq_len, c.rope_theta)
+    # Causal within the prompt, restricted to valid (non-pad) keys.
+    q_pos = positions[:, :, None]                  # [B, S, 1]
+    k_pos = positions[:, None, :]                  # [B, 1, S]
+    mask = (k_pos >= 0) & (q_pos >= 0) & (k_pos <= q_pos)  # [B, S, S]
+    mask = mask[:, None, :, :]                     # [B, 1, S, S]
+    scale = 1.0 / math.sqrt(c.head_dim_)
+
+    new_cache_k, new_cache_v = cache["k"], cache["v"]
+    for l in range(c.num_layers):
+        bp = _layer_params(params, l)
+        q, k, v = _project_qkv(x, bp, positions, cos, sin, c)
+        new_cache_k, new_cache_v = _write_layer(
+            new_cache_k, new_cache_v, l, k, v, block_tables, positions)
+        kv = k.shape[2]
+        if kv != c.num_heads:
+            rep = c.num_heads // kv
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32),
+                               axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        x = x + attn.reshape(B, S, -1) @ bp["wo"].astype(c.dtype)
+        x = _mlp(x, bp, c)
+
+    # Last valid row per sequence.
+    last = jnp.argmax(positions, axis=1)           # [B]
+    x_last = jnp.take_along_axis(
+        x, last[:, None, None], axis=1)[:, 0]      # [B, h]
+    return _lm_head(x_last, params, c), {"k": new_cache_k,
+                                         "v": new_cache_v}
+
+
+def _write_layer(cache_k, cache_v, l, k, v, block_tables, positions):
+    kl, vl = write_page_tokens(cache_k[l], cache_v[l], k, v,
+                               block_tables, positions)
+    return cache_k.at[l].set(kl), cache_v.at[l].set(vl)
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode_step(params, tokens, cache, block_tables, positions,
+                context_lens, config: TransformerConfig
+                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Advance every slot one token.
+
+    tokens: [B] int32 (the previously emitted token per slot);
+    positions: [B] its absolute position; context_lens: [B] cache length
+    INCLUDING this token. Returns (logits [B, vocab] fp32, cache).
+    """
+    c = config
+    assert c.num_experts == 0, "MoE decode not wired yet"
+    assert c.scan_layers, \
+        "decoding expects stacked [L, ...] block params (scan_layers=True)"
+    B = tokens.shape[0]
+    x = params["tok_embed"].astype(c.dtype)[tokens][:, None, :]  # [B,1,h]
+    cos, sin = rope_freqs(c.head_dim_, c.max_seq_len, c.rope_theta)
+    pos2d = positions[:, None]
+
+    new_cache_k, new_cache_v = cache["k"], cache["v"]
+    for l in range(c.num_layers):
+        bp = _layer_params(params, l)
+        q, k, v = _project_qkv(x, bp, pos2d, cos, sin, c)
+        new_cache_k, new_cache_v = _write_layer(
+            new_cache_k, new_cache_v, l, k, v, block_tables, pos2d)
+        attn = paged_attention(q[:, 0], new_cache_k[l], new_cache_v[l],
+                               block_tables, context_lens)
+        x = x + (attn.reshape(B, 1, -1) @ bp["wo"].astype(c.dtype))
+        x = _mlp(x, bp, c)
+
+    return _lm_head(x[:, 0], params, c), {"k": new_cache_k,
+                                          "v": new_cache_v}

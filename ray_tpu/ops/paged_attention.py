@@ -1,0 +1,134 @@
+"""Paged attention: single-token decode over a paged KV cache.
+
+The reference delegates LLM serving to vLLM via compiled DAGs
+(SURVEY.md §2.2 P12 — "Ray's µs-latency GPU pipeline path"); the
+TPU-native build owns the inference path instead (§7.10 "LLM inference
+replica w/ paged attention"). KV blocks live in fixed-size pages
+([num_pages, page_size, kv_heads, head_dim]); each sequence owns a list
+of pages (its block table), so cache memory is allocated page-at-a-time
+with zero fragmentation-driven copies — the vLLM idea, expressed as XLA
+gathers instead of CUDA kernels:
+
+  - decode: gather the sequence's pages with one `take` on the page axis
+    (XLA lowers to a dynamic-gather DMA), then batched GQA attention on
+    the MXU with masking past `context_lens`.
+  - page writes are functional `.at[pages, offsets].set(...)` scatters,
+    so the cache threads through jit with buffer donation.
+
+Static shapes throughout: [B, max_pages] block tables padded with page 0
+and masked by context_lens, so one compiled decode program serves every
+batch composition (continuous batching never recompiles).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
+                    sm_scale: float | None = None):
+    """Decode-time attention for one new token per sequence.
+
+    q:            [B, H, D]           query for the current position
+    k_pages:      [P, page, KVH, D]   paged key cache (one layer)
+    v_pages:      [P, page, KVH, D]   paged value cache
+    block_tables: [B, max_pages] int32 page ids (padded entries ignored)
+    context_lens: [B] int32           tokens in cache per sequence
+                                      (including the current one)
+    Returns [B, H, D].
+    """
+    B, H, D = q.shape
+    P, page, KVH, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    G = H // KVH  # query heads per kv head (GQA)
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+
+    # Gather each sequence's pages: [B, max_pages, page, KVH, D] →
+    # [B, T, KVH, D] with T = max_pages * page.
+    k = jnp.take(k_pages, block_tables, axis=0).reshape(
+        B, max_pages * page, KVH, D)
+    v = jnp.take(v_pages, block_tables, axis=0).reshape(
+        B, max_pages * page, KVH, D)
+
+    qg = q.reshape(B, KVH, G, D)
+    logits = jnp.einsum("bkgd,btkd->bkgt", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    t_idx = jnp.arange(max_pages * page, dtype=jnp.int32)
+    valid = t_idx[None, :] < context_lens[:, None]           # [B, T]
+    logits = jnp.where(valid[:, None, None, :], logits,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bkgt,btkd->bkgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+def write_page_tokens(k_pages, v_pages, k_new, v_new, block_tables,
+                      positions):
+    """Scatter new K/V rows into their pages.
+
+    k_new/v_new: [B, S, KVH, D] projections for S new tokens per seq;
+    positions:   [B, S] int32 absolute positions (define page + offset);
+    block_tables:[B, max_pages].
+    Returns updated (k_pages, v_pages). Rows with position < 0 are
+    dropped (write to a scratch page slot) so padded prefills are safe.
+    """
+    B, S, KVH, D = k_new.shape
+    page = k_pages.shape[1]
+    page_idx = positions // page                              # [B, S]
+    offset = positions % page
+    valid = positions >= 0
+    pages = jnp.take_along_axis(
+        block_tables, jnp.maximum(page_idx, 0), axis=1)       # [B, S]
+    # Invalid rows get page index == num_pages: past-the-end is
+    # out-of-bounds under scatter mode="drop" (negative indices would
+    # WRAP, silently corrupting the last page), so those writes vanish.
+    pages = jnp.where(valid, pages, k_pages.shape[0])
+    flat_pages = pages.reshape(-1)
+    flat_off = jnp.maximum(offset, 0).reshape(-1)
+    k_flat = k_new.reshape(-1, KVH, D)
+    v_flat = v_new.reshape(-1, KVH, D)
+    k_pages = k_pages.at[flat_pages, flat_off].set(
+        k_flat, mode="drop")
+    v_pages = v_pages.at[flat_pages, flat_off].set(
+        v_flat, mode="drop")
+    return k_pages, v_pages
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables,
+                              context_lens):
+    """O(B·T) numpy-style reference for tests: per-sequence dense
+    attention over the gathered cache."""
+    import numpy as np
+
+    q = np.asarray(q, dtype=np.float64)
+    k_pages = np.asarray(k_pages, dtype=np.float64)
+    v_pages = np.asarray(v_pages, dtype=np.float64)
+    block_tables = np.asarray(block_tables)
+    context_lens = np.asarray(context_lens)
+    B, H, D = q.shape
+    page = k_pages.shape[1]
+    KVH = k_pages.shape[2]
+    G = H // KVH
+    out = np.zeros_like(q)
+    for b in range(B):
+        n = int(context_lens[b])
+        if n == 0:
+            continue
+        ks, vs = [], []
+        for t in range(n):
+            p = block_tables[b, t // page]
+            ks.append(k_pages[p, t % page])
+            vs.append(v_pages[p, t % page])
+        k = np.stack(ks)  # [n, KVH, D]
+        v = np.stack(vs)
+        for h in range(H):
+            kh = h // G
+            logits = (k[:, kh] @ q[b, h]) / np.sqrt(D)
+            w = np.exp(logits - logits.max())
+            w = w / w.sum()
+            out[b, h] = w @ v[:, kh]
+    return out

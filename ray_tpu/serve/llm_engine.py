@@ -1,0 +1,231 @@
+"""LLMEngine: continuous-batching inference over the paged KV cache.
+
+Counterpart of the capability the reference gets from vLLM-over-ADAG
+(SURVEY.md P12, §7.10) — owned here end to end, TPU-first:
+
+  - one compiled prefill program per prompt-length bucket and ONE
+    compiled decode program total ([max_batch] slots, static shapes);
+  - page-granular cache memory via a free-list allocator, so long and
+    short sequences share the pool with no fragmentation copies;
+  - continuous batching: finished sequences release their slot + pages
+    at the end of any step and queued requests join at the next one —
+    the batch never drains to refill.
+
+The engine is synchronous and single-host (one replica = one engine);
+serve/llm.py wraps it as a deployment for scale-out across replicas.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.models import transformer as tfm
+from ray_tpu.models.decoding import decode_step, init_kv_pages, prefill
+
+
+class PageAllocator:
+    """Free-list page allocator (vLLM's block manager, minus CUDA)."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise MemoryError(
+                f"KV cache exhausted: need {n} pages, "
+                f"{len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: Sequence[int]) -> None:
+        self._free.extend(pages)
+
+
+@dataclass
+class _Request:
+    req_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    generated: List[int] = field(default_factory=list)
+    slot: int = -1
+    pages: List[int] = field(default_factory=list)
+    eos_token: Optional[int] = None
+
+
+class LLMEngine:
+    def __init__(self, config: tfm.TransformerConfig,
+                 params: Optional[Dict[str, Any]] = None, *,
+                 page_size: int = 16, num_pages: int = 512,
+                 max_batch: int = 8, seed: int = 0):
+        import jax
+
+        c = config
+        self.config = c
+        self.page_size = page_size
+        self.max_batch = max_batch
+        self.max_pages_per_seq = math.ceil(c.max_seq_len / page_size)
+        self.params = params if params is not None else tfm.init_params(
+            c, jax.random.key(seed))
+        self.cache = init_kv_pages(c, num_pages, page_size)
+        self.allocator = PageAllocator(num_pages)
+        self._rng = np.random.default_rng(seed)
+
+        # Slot state (fixed [max_batch] shapes → one compiled decode).
+        self.block_tables = np.zeros(
+            (max_batch, self.max_pages_per_seq), dtype=np.int32)
+        self.context_lens = np.zeros(max_batch, dtype=np.int32)
+        self.last_tokens = np.zeros(max_batch, dtype=np.int32)
+        self.slot_req: List[Optional[_Request]] = [None] * max_batch
+
+        self._next_id = 0
+        self.waiting: List[_Request] = []
+        self.num_completed = 0
+
+    # -- public API --------------------------------------------------------
+    def add_request(self, prompt_tokens: Sequence[int],
+                    max_new_tokens: int = 32, *,
+                    temperature: float = 0.0,
+                    eos_token: Optional[int] = None) -> int:
+        if not prompt_tokens:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        if (len(prompt_tokens) + max_new_tokens) > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt+generation ({len(prompt_tokens)}+{max_new_tokens})"
+                f" exceeds max_seq_len={self.config.max_seq_len}")
+        req = _Request(self._next_id, list(prompt_tokens), max_new_tokens,
+                       temperature, eos_token=eos_token)
+        self._next_id += 1
+        self.waiting.append(req)
+        return req.req_id
+
+    @property
+    def num_active(self) -> int:
+        return sum(r is not None for r in self.slot_req)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.num_active > 0
+
+    def step(self) -> Dict[int, List[int]]:
+        """Admit waiting requests (prefill), then one batched decode step.
+        Returns requests that finished THIS step ({req_id: tokens})."""
+        done = self._admit()
+        if self.num_active:
+            done.update(self._decode())
+        return done
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 32, *,
+                 temperature: float = 0.0) -> List[List[int]]:
+        """Blocking batch generation (greedy by default)."""
+        ids = [self.add_request(p, max_new_tokens, temperature=temperature)
+               for p in prompts]
+        results: Dict[int, List[int]] = {}
+        while self.has_work():
+            results.update(self.step())
+        return [results[i] for i in ids]
+
+    # -- internals ---------------------------------------------------------
+    def _free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.slot_req) if r is None]
+
+    def _admit(self) -> Dict[int, List[int]]:
+        import jax.numpy as jnp
+
+        done: Dict[int, List[int]] = {}
+        free = self._free_slots()
+        while self.waiting and free:
+            req = self.waiting[0]
+            need = math.ceil(
+                (len(req.prompt) + req.max_new_tokens) / self.page_size)
+            if need > self.allocator.num_free:
+                break  # backpressure: wait for pages to free up
+            self.waiting.pop(0)
+            slot = free.pop(0)
+            req.slot = slot
+            req.pages = self.allocator.alloc(need)
+            table = np.zeros(self.max_pages_per_seq, dtype=np.int32)
+            table[:len(req.pages)] = req.pages
+            self.block_tables[slot] = table
+
+            # Prefill this sequence (B=1, length bucketed to limit
+            # compilations to one per power-of-two).
+            S = max(8, 1 << (len(req.prompt) - 1).bit_length())
+            tokens = np.zeros((1, S), dtype=np.int32)
+            tokens[0, :len(req.prompt)] = req.prompt
+            positions = np.full((1, S), -1, dtype=np.int32)
+            positions[0, :len(req.prompt)] = np.arange(len(req.prompt))
+            logits, self.cache = prefill(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                self.cache, jnp.asarray(table[None]), self.config)
+            next_tok = self._sample(np.asarray(logits)[0], req)
+            self.context_lens[slot] = len(req.prompt)
+            self.last_tokens[slot] = next_tok
+            req.generated.append(int(next_tok))
+            fin = self._maybe_finish(req)
+            if fin is not None:  # e.g. max_new_tokens == 1
+                done[req.req_id] = fin
+        return done
+
+    def _decode(self) -> Dict[int, List[int]]:
+        import jax.numpy as jnp
+
+        active = np.array([r is not None for r in self.slot_req])
+        # Inactive slots get position -1: their K/V writes are dropped
+        # (write_page_tokens) instead of landing in page 0 offset 0 via
+        # their zeroed block tables — which would corrupt whichever
+        # sequence owns page 0.
+        positions = np.where(active, self.context_lens, -1).astype(np.int32)
+        ctx = (self.context_lens + 1).astype(np.int32)
+        logits, self.cache = decode_step(
+            self.params, jnp.asarray(self.last_tokens), self.cache,
+            jnp.asarray(self.block_tables), jnp.asarray(positions),
+            jnp.asarray(ctx), self.config)
+        logits = np.asarray(logits)
+        done: Dict[int, List[int]] = {}
+        for slot, req in enumerate(self.slot_req):
+            if req is None:
+                continue
+            self.context_lens[slot] += 1
+            tok = self._sample(logits[slot], req)
+            self.last_tokens[slot] = tok
+            req.generated.append(int(tok))
+            fin = self._maybe_finish(req)
+            if fin is not None:
+                done[req.req_id] = fin
+        return done
+
+    def _sample(self, logits: np.ndarray, req: _Request) -> int:
+        if req.temperature <= 0.0:
+            return int(np.argmax(logits))
+        p = logits / req.temperature
+        p = np.exp(p - p.max())
+        p = p / p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _maybe_finish(self, req: _Request) -> Optional[List[int]]:
+        """Register req into its slot, or retire it if done. Returns the
+        generated tokens when finished."""
+        hit_eos = (req.eos_token is not None
+                   and req.generated
+                   and req.generated[-1] == req.eos_token)
+        if len(req.generated) >= req.max_new_tokens or hit_eos:
+            if req.slot >= 0:
+                self.slot_req[req.slot] = None
+                self.context_lens[req.slot] = 0
+                self.allocator.free(req.pages)
+            self.num_completed += 1
+            return req.generated
+        self.slot_req[req.slot] = req
+        return None

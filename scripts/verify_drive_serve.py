@@ -89,6 +89,24 @@ def main():
     assert "chain" not in serve.status()
     print(f"[5] status/delete ok; apps: {sorted(serve.status())}")
 
+    # [6] LLM deployment: continuous-batching paged-attention engine.
+    import jax.numpy as jnp
+
+    from ray_tpu.serve.llm import LLMServer
+
+    llm = serve.run(
+        LLMServer.bind(config_kwargs=dict(
+            num_layers=2, num_heads=4, num_kv_heads=2, hidden_size=32,
+            intermediate_size=64, vocab_size=64, max_seq_len=64,
+            dtype=jnp.float32, use_flash=False)),
+        name="llm", route_prefix=None)
+    outs = llm.generate_batch.remote(
+        [[1, 2, 3], [4, 5, 6, 7]], max_new_tokens=4).result()
+    assert len(outs) == 2 and all(len(o) == 4 for o in outs), outs
+    stats = llm.stats.remote().result()
+    assert stats["free_pages"] == stats["num_pages"], stats
+    print(f"[6] LLM paged-attention deployment ok ({outs})")
+
     serve.shutdown()
     ray_tpu.shutdown()
     print("SERVE DRIVE OK")
