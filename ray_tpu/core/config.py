@@ -34,6 +34,27 @@ class Config:
     object_store_memory: int = 0
     # Directory backing the shared-memory store.
     shm_dir: str = "/dev/shm"
+    # Spill shm objects to external storage once the arena passes this
+    # usage fraction (reference: object_spilling_threshold). 0 disables.
+    object_spilling_threshold: float = 0.8
+    # External storage spec: '' = <session_dir>/spilled, a path, or a
+    # smart_open URI prefix (s3://...). See core/external_storage.py.
+    spill_storage: str = ""
+    # Objects younger than this are not spilled (bounds the window where
+    # a client could hold a stale in-shm location).
+    spill_min_age_s: float = 1.0
+
+    # -- memory monitor (reference memory_monitor.h + OOM killer) -------
+    # Kill-and-retry the newest retriable task when host memory usage
+    # crosses this fraction. 0 disables the monitor.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_s: float = 1.0
+    # Minimum seconds between OOM kills, so reclaim from one kill lands
+    # before the next is considered (prevents cascade-killing the pool).
+    oom_kill_cooldown_s: float = 10.0
+    # Above this usage, non-retriable tasks become eligible too (last
+    # resort before the kernel OOM-kills the node).
+    memory_usage_threshold_critical: float = 0.98
 
     # -- scheduling -----------------------------------------------------
     # Max worker processes started eagerly at init.

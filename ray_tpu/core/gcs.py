@@ -59,6 +59,9 @@ class ObjectEntry:
     is_error: bool = False
     subscribers: List[rpc.Connection] = field(default_factory=list)
     producing_task: Optional[str] = None  # task hex, lineage hook
+    spilled_uri: Optional[str] = None  # external-storage URI when spilled
+    restoring: bool = False
+    stored_at: float = 0.0
 
 
 @dataclass
@@ -221,7 +224,27 @@ class ControlServer:
                          available=resources, is_head=True)
         self.nodes: Dict[str, NodeState] = {"head": head}
         self.placement_groups: Dict[str, PlacementGroupEntry] = {}
-        self.store = ShmObjectStore(session_id, config.shm_dir)
+        self.store = ShmObjectStore(session_id, config.shm_dir,
+                                    capacity=config.object_store_memory)
+        # Spilling (reference LocalObjectManager + external_storage.py):
+        # cold shm objects move to external storage past the usage
+        # threshold and restore transparently on next subscribe.
+        from ray_tpu.core.external_storage import storage_from_spec
+
+        self.external_storage = storage_from_spec(
+            config.spill_storage, session_dir)
+        self.spilled_bytes_total = 0
+        # OOM defense (reference memory_monitor.h + worker killing
+        # policies): kill-and-retry the newest retriable running task
+        # under host memory pressure.
+        self.memory_monitor = None
+        if config.memory_usage_threshold > 0:
+            from ray_tpu.core.memory_monitor import MemoryMonitor
+
+            self.memory_monitor = MemoryMonitor(
+                config.memory_usage_threshold,
+                config.memory_monitor_refresh_s,
+                on_high=self._on_memory_pressure).start()
 
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -239,6 +262,8 @@ class ControlServer:
     def stop(self):
         self._stopped.set()
         self._wake.set()
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
         with self.lock:
             workers = list(self.workers.values())
         for w in workers:
@@ -389,6 +414,7 @@ class ControlServer:
         entry.size = size
         entry.in_shm = in_shm
         entry.is_error = is_error
+        entry.stored_at = time.time()
         actor_hex = self.obj_actor.pop(obj_hex, None)
         if actor_hex is not None:
             self.actor_inflight.get(actor_hex, set()).discard(obj_hex)
@@ -419,7 +445,160 @@ class ControlServer:
                 is_error=msg.get("is_error", False),
                 in_shm=msg.get("in_shm", False),
             )
+        if msg.get("in_shm"):
+            # Outside the lock: spilling does storage I/O that must not
+            # stall the control plane.
+            self._maybe_spill()
         self._wake.set()
+
+    # -- spilling ------------------------------------------------------
+    def _maybe_spill(self):
+        """Spill oldest cold shm objects until under the threshold
+        (reference LocalObjectManager::SpillObjectsOfSize). Candidate
+        snapshot under the lock; reads/uploads outside it; per-object
+        finalize re-checks the entry (it may have been freed/raced)."""
+        thresh = self.config.object_spilling_threshold
+        cap, used, _, _ = self.store.stats()
+        if thresh <= 0 or cap <= 0 or used <= thresh * cap:
+            return
+        target = int(thresh * cap * 0.9)  # hysteresis below the threshold
+        with self.lock:
+            now = time.time()
+            candidates = sorted(
+                ((h, e.size, e.stored_at)
+                 for h, e in self.objects.items()
+                 if e.state == READY and e.in_shm
+                 and e.spilled_uri is None and not e.restoring
+                 and now - e.stored_at >= self.config.spill_min_age_s),
+                key=lambda t: t[2])
+        for obj_hex, size, _ in candidates:
+            if used <= target:
+                break
+            oid = ObjectID.from_hex(obj_hex)
+            try:
+                seg = self.store.attach(oid, size)
+                data = bytes(seg.buf[:size])
+                self.store.release(oid)
+                uri = self.external_storage.spill(obj_hex, data)
+            except Exception:
+                continue
+            with self.lock:
+                entry = self.objects.get(obj_hex)
+                if entry is None or not entry.in_shm \
+                        or entry.state != READY or entry.restoring:
+                    stale = True  # freed or changed while we spilled
+                else:
+                    stale = False
+                    entry.in_shm = False
+                    entry.spilled_uri = uri
+                    self.spilled_bytes_total += size
+            if stale:
+                try:
+                    self.external_storage.delete(uri)
+                except Exception:
+                    pass
+                continue
+            # Readers that attached before this keep valid views (the
+            # arena orphans pinned blocks); late readers restore.
+            self.store.delete(oid)
+            used -= size
+
+    def _restore_and_publish(self, obj_hex: str):
+        """Background restore of a spilled object: storage I/O happens
+        off the control-plane lock; subscribers get the ready push (or a
+        serialized error) when it lands."""
+        with self.lock:
+            entry = self.objects.get(obj_hex)
+            if entry is None or entry.spilled_uri is None \
+                    or entry.restoring:
+                return
+            entry.restoring = True
+            uri = entry.spilled_uri
+        data, err = None, None
+        try:
+            data = self.external_storage.restore(uri)
+        except Exception as e:  # noqa: BLE001
+            err = e
+        if data is not None:
+            try:
+                oid = ObjectID.from_hex(obj_hex)
+                seg = self.store.create(oid, len(data))
+                seg.buf[:len(data)] = data
+                self.store.seal(oid)
+            except Exception as e:  # noqa: BLE001
+                err, data = e, None
+        with self.lock:
+            entry = self.objects.get(obj_hex)
+            if entry is None:
+                return
+            entry.restoring = False
+            subs, entry.subscribers = entry.subscribers, []
+            if data is None:
+                # Publish a REAL serialized error so clients raise it
+                # (an empty-payload push would surface as a confusing
+                # "ready but has no payload").
+                from ray_tpu.core.serialization import serialize
+
+                payload = serialize(RuntimeError(
+                    f"restore of spilled object {obj_hex} failed: "
+                    f"{err}")).to_bytes()
+                push = {"op": "object_ready", "obj": obj_hex,
+                        "size": len(payload), "inline": payload,
+                        "in_shm": False, "is_error": True}
+            else:
+                entry.spilled_uri = None
+                entry.in_shm = True
+                entry.stored_at = time.time()
+                push = self._object_ready_msg(obj_hex, entry)
+        for c in subs:
+            try:
+                c.push(push)
+            except Exception:
+                pass
+        if data is not None:
+            try:
+                self.external_storage.delete(uri)
+            except Exception:
+                pass
+
+    # -- OOM defense ---------------------------------------------------
+    def _on_memory_pressure(self, fraction: float):
+        from ray_tpu.core.memory_monitor import pick_worker_to_kill
+
+        # Cooldown: give the previous kill's reclaim time to land before
+        # considering another, or a single spike cascades through the
+        # whole pool.
+        now = time.time()
+        if now - getattr(self, "_last_oom_kill", 0.0) \
+                < self.config.oom_kill_cooldown_s:
+            return
+        with self.lock:
+            candidates = []
+            for w in self.workers.values():
+                if w.state != "busy" or not w.current_task:
+                    continue
+                rec = self.tasks.get(w.current_task)
+                if rec is None:
+                    continue
+                candidates.append({
+                    "worker": w,
+                    "retriable":
+                        rec.spec.retry_count < rec.spec.max_retries,
+                    "started_at": rec.started_at,
+                })
+            pick = pick_worker_to_kill(
+                candidates,
+                allow_nonretriable=(
+                    fraction
+                    >= self.config.memory_usage_threshold_critical))
+        if pick is None:
+            return
+        self._last_oom_kill = now
+        w = pick["worker"]
+        try:
+            os.kill(w.pid, 9)  # _mark_worker_dead retries the task
+        except (ProcessLookupError, PermissionError):
+            pass
 
     def _op_subscribe_object(self, conn, msg):
         obj_hex = msg["obj"]
@@ -428,7 +607,15 @@ class ControlServer:
             if entry is None:
                 entry = self.objects[obj_hex] = ObjectEntry(refcount=0)
             if entry.state in (READY, ERRORED):
-                conn.push(self._object_ready_msg(obj_hex, entry))
+                if entry.spilled_uri is not None or entry.restoring:
+                    # Spilled: queue the subscriber and restore in the
+                    # background (storage I/O must not hold self.lock).
+                    entry.subscribers.append(conn)
+                    threading.Thread(
+                        target=self._restore_and_publish, args=(obj_hex,),
+                        daemon=True, name=f"restore-{obj_hex[:8]}").start()
+                else:
+                    conn.push(self._object_ready_msg(obj_hex, entry))
             else:
                 entry.subscribers.append(conn)
 
@@ -450,6 +637,11 @@ class ControlServer:
                 del self.objects[obj_hex]
                 if entry.in_shm:
                     to_delete.append(obj_hex)
+                if entry.spilled_uri:
+                    try:
+                        self.external_storage.delete(entry.spilled_uri)
+                    except Exception:
+                        pass
         for obj_hex in to_delete:
             self.store.delete(ObjectID.from_hex(obj_hex))
 
@@ -473,6 +665,11 @@ class ControlServer:
                 entry = self.objects.pop(obj_hex, None)
                 if entry is not None and entry.in_shm:
                     self.store.delete(ObjectID.from_hex(obj_hex))
+                if entry is not None and entry.spilled_uri:
+                    try:
+                        self.external_storage.delete(entry.spilled_uri)
+                    except Exception:
+                        pass
 
     # ------------------------------------------------------------------
     # Functions (counterpart of _private/function_manager.py export tables)
@@ -720,7 +917,8 @@ class ControlServer:
         with self.lock:
             return [
                 {"object_id": h, "state": e.state, "size": e.size,
-                 "refcount": e.refcount, "in_shm": e.in_shm}
+                 "refcount": e.refcount, "in_shm": e.in_shm,
+                 "spilled": e.spilled_uri is not None}
                 for h, e in self.objects.items()
             ]
 

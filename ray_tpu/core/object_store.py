@@ -180,6 +180,15 @@ class ShmObjectStore:
                     # ENOENT: an overflow object living in a file segment
                     if e.err != errno.ENOENT:
                         raise
+                else:
+                    # seal() drops the creator's arena pin, so the
+                    # writable view cached by create() may alias a block
+                    # that can now be deleted/reused (e.g. by spilling).
+                    # Evict it; a later read re-attaches with a proper
+                    # reader pin and a fresh view.
+                    seg = self._open.pop(object_id.hex(), None)
+                    if seg is not None:
+                        seg.close()
 
     def attach(self, object_id: ObjectID, size: int) -> ShmSegment:
         key = object_id.hex()

@@ -36,6 +36,19 @@ _global_runtime = None
 _runtime_lock = threading.Lock()
 
 
+def _is_missing_segment_error(e: Exception) -> bool:
+    """True for attach failures meaning "no longer at that location"
+    (deleted arena slot / unlinked file) as opposed to real IO faults."""
+    if isinstance(e, FileNotFoundError):
+        return True
+    try:
+        from ray_tpu.native.store import ArenaError
+
+        return isinstance(e, ArenaError)
+    except ImportError:
+        return False
+
+
 def get_runtime():
     if _global_runtime is None:
         raise RuntimeError(
@@ -126,11 +139,24 @@ class CoreClient:
                 self.client.send({"op": "subscribe_object", "obj": obj_hex})
         return fut
 
-    def _load_object(self, obj_hex: str, info: dict) -> Any:
+    def _load_object(self, obj_hex: str, info: dict,
+                     _retried: bool = False) -> Any:
         if info.get("inline") is not None:
             data = info["inline"]
         elif info.get("in_shm"):
-            seg = self.store.attach(ObjectID.from_hex(obj_hex), info["size"])
+            try:
+                seg = self.store.attach(ObjectID.from_hex(obj_hex),
+                                        info["size"])
+            except Exception as e:  # noqa: BLE001
+                # Stale location: the server may have SPILLED the object
+                # after this client cached its in-shm info. Drop the
+                # cached future + subscription and re-subscribe — the
+                # server restores spilled objects on subscribe.
+                if _retried or not _is_missing_segment_error(e):
+                    raise
+                fut = self._refetch_object(obj_hex)
+                return self._load_object(obj_hex, fut.result(timeout=60),
+                                         _retried=True)
             data = seg.buf[: info["size"]]
         else:
             raise RuntimeError(f"object {obj_hex} ready but has no payload")
@@ -138,6 +164,14 @@ class CoreClient:
         if info.get("is_error"):
             raise value
         return value
+
+    def _refetch_object(self, obj_hex: str) -> Future:
+        """Forget the resolved location of an object and subscribe again
+        (used when a cached in-shm location went stale via spilling)."""
+        with self._lock:
+            self._object_futures.pop(obj_hex, None)
+            self._subscribed.discard(obj_hex)
+        return self.object_future(obj_hex)
 
     def _on_ref_deser(self, ref: ObjectRef):
         # A ref arrived inside a deserialized value: register a borrow so the

@@ -54,6 +54,7 @@ class LLMServer:
             max_batch=max_batch)
         self._cv = threading.Condition()
         self._results: Dict[int, List[int]] = {}
+        self._engine_error: Optional[BaseException] = None
         self._stopped = False
         self._thread = threading.Thread(
             target=self._engine_loop, daemon=True, name="llm-engine")
@@ -66,7 +67,14 @@ class LLMServer:
                     self._cv.wait(timeout=1.0)
                 if self._stopped:
                     return
-                done = self.engine.step()
+                try:
+                    done = self.engine.step()
+                except Exception as e:  # noqa: BLE001
+                    # A dead engine must fail waiters loudly, not hang
+                    # them: record the error and wake everyone.
+                    self._engine_error = e
+                    self._cv.notify_all()
+                    return
                 if done:
                     self._results.update(done)
                     self._cv.notify_all()
@@ -75,11 +83,17 @@ class LLMServer:
                          max_new_tokens: int, temperature: float
                          ) -> List[List[int]]:
         with self._cv:
+            if self._engine_error is not None:
+                raise RuntimeError(
+                    f"LLM engine failed: {self._engine_error}")
             ids = [self.engine.add_request(
                 list(p), max_new_tokens, temperature=temperature)
                 for p in prompts]
             self._cv.notify_all()
             while not all(i in self._results for i in ids):
+                if self._engine_error is not None:
+                    raise RuntimeError(
+                        f"LLM engine failed: {self._engine_error}")
                 self._cv.wait()
             return [self._results.pop(i) for i in ids]
 

@@ -104,6 +104,14 @@ class LLMEngine:
             raise ValueError(
                 f"prompt+generation ({len(prompt_tokens)}+{max_new_tokens})"
                 f" exceeds max_seq_len={self.config.max_seq_len}")
+        need = math.ceil(
+            (len(prompt_tokens) + max_new_tokens) / self.page_size)
+        if need > self.allocator.num_pages:
+            # Would never be admittable — it would wedge the FIFO queue.
+            raise ValueError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self.allocator.num_pages}; raise num_pages or shorten "
+                "the request")
         req = _Request(self._next_id, list(prompt_tokens), max_new_tokens,
                        temperature, eos_token=eos_token)
         self._next_id += 1
