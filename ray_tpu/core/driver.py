@@ -29,7 +29,8 @@ class DriverRuntime:
                  _system_config: Optional[dict] = None,
                  namespace: str = "",
                  address: Optional[str] = None,
-                 log_to_driver: bool = True):
+                 log_to_driver: bool = True,
+                 thin: bool = False):
         """Head mode (default): start the control plane in-process.
         Connect mode (``address=``): attach this driver to an existing
         cluster's control server — counterpart of ray.init(address=...)
@@ -51,7 +52,7 @@ class DriverRuntime:
             control_addr = self.control.address
         self.core = CoreClient(
             control_addr, WorkerID.from_random().hex(),
-            kind="driver", config=self.config)
+            kind="driver", config=self.config, thin=thin)
         if address:
             self.session_dir = self.core.session_dir
         self.namespace = namespace

@@ -27,6 +27,7 @@ import subprocess
 import sys
 import threading
 import time
+import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
 
@@ -479,7 +480,11 @@ class ControlServer:
                 seg = self.store.attach(oid, size)
                 data = bytes(seg.buf[:size])
                 self.store.release(oid)
-                uri = self.external_storage.spill(obj_hex, data)
+                # Unique key per spill ATTEMPT: concurrent spillers of the
+                # same object must not share a URI, or the loser's stale
+                # cleanup would unlink the winner's (only) copy.
+                uri = self.external_storage.spill(
+                    f"{obj_hex}-{uuid.uuid4().hex[:8]}", data)
             except Exception:
                 continue
             with self.lock:
@@ -610,10 +615,14 @@ class ControlServer:
                 if entry.spilled_uri is not None or entry.restoring:
                     # Spilled: queue the subscriber and restore in the
                     # background (storage I/O must not hold self.lock).
+                    # An in-flight restore publishes to the whole queue,
+                    # so only the first subscriber spawns the thread.
                     entry.subscribers.append(conn)
-                    threading.Thread(
-                        target=self._restore_and_publish, args=(obj_hex,),
-                        daemon=True, name=f"restore-{obj_hex[:8]}").start()
+                    if not entry.restoring:
+                        threading.Thread(
+                            target=self._restore_and_publish,
+                            args=(obj_hex,), daemon=True,
+                            name=f"restore-{obj_hex[:8]}").start()
                 else:
                     conn.push(self._object_ready_msg(obj_hex, entry))
             else:
@@ -1568,6 +1577,38 @@ class ControlServer:
         if runtime_env:
             self.runtime_envs.setdefault(key, dict(runtime_env))
         return key
+
+    def _op_fetch_object(self, conn, msg):
+        """Read an object's payload server-side for thin clients (no shm
+        attachment — reference Ray Client server proxy role). Shm reads
+        and spilled-object restores happen outside the lock."""
+        obj_hex = msg["obj"]
+        # Retry loop: the object can migrate between shm and external
+        # storage (spill / concurrent restore) between the snapshot and
+        # the read; re-reading the entry makes the race benign.
+        for _ in range(4):
+            with self.lock:
+                entry = self.objects.get(obj_hex)
+                if entry is None or entry.state not in (READY, ERRORED):
+                    return None
+                if entry.inline is not None:
+                    return entry.inline
+                size = entry.size
+                spilled_uri = entry.spilled_uri
+            if spilled_uri is not None:
+                try:
+                    return self.external_storage.restore(spilled_uri)
+                except Exception:
+                    continue  # restored+deleted meanwhile: re-snapshot
+            try:
+                oid = ObjectID.from_hex(obj_hex)
+                seg = self.store.attach(oid, size)
+                data = bytes(seg.buf[:size])
+                self.store.release(oid)
+                return data
+            except Exception:
+                time.sleep(0.01)  # spilled meanwhile: re-snapshot
+        return None
 
     def _op_get_runtime_env(self, conn, msg):
         with self.lock:

@@ -33,6 +33,19 @@ def system_memory() -> Tuple[int, int]:
             limit = int(raw)
             with open("/sys/fs/cgroup/memory.current") as f:
                 used = int(f.read().strip())
+            # memory.current counts reclaimable page cache; subtract
+            # inactive_file so streaming IO (including our own spill
+            # writes) doesn't read as pressure — matching the host
+            # path's MemAvailable semantics (and the reference's
+            # memory_monitor.cc, which does the same).
+            try:
+                with open("/sys/fs/cgroup/memory.stat") as f:
+                    for line in f:
+                        if line.startswith("inactive_file "):
+                            used = max(0, used - int(line.split()[1]))
+                            break
+            except (OSError, ValueError):
+                pass
             if limit < total:
                 return (max(limit - used, 0), limit)
     except (OSError, ValueError):

@@ -67,10 +67,14 @@ class CoreClient:
 
     def __init__(self, control_addr: str, worker_hex: str, kind: str,
                  address: str = "", env_key: str = "",
-                 config: Optional[Config] = None):
+                 config: Optional[Config] = None, thin: bool = False):
         self.worker_hex = worker_hex
         self.kind = kind
         self.config = config or get_config()
+        # Thin mode (reference Ray Client, util/client/): no shared-memory
+        # attachment — every payload rides the TCP connection, so the
+        # client can live on any machine that reaches the control address.
+        self.thin = thin
         # Hooks must exist before the rpc recv thread can deliver pushes.
         self.on_execute_task = None
         self.on_create_actor = None
@@ -86,7 +90,8 @@ class CoreClient:
         })
         self.session_id = reply["session_id"]
         self.session_dir = reply["session_dir"]
-        self.store = ShmObjectStore(self.session_id, reply["shm_dir"])
+        self.store = None if thin else ShmObjectStore(
+            self.session_id, reply["shm_dir"])
 
         self._lock = threading.Lock()
         self._object_futures: Dict[str, Future] = {}
@@ -140,10 +145,19 @@ class CoreClient:
         return fut
 
     def _load_object(self, obj_hex: str, info: dict,
+                     timeout: Optional[float] = None,
                      _retried: bool = False) -> Any:
         if info.get("inline") is not None:
             data = info["inline"]
         elif info.get("in_shm"):
+            if self.store is None:
+                # Thin client: the server reads the shm payload for us.
+                data = self.client.call({"op": "fetch_object",
+                                         "obj": obj_hex})
+                if data is None:
+                    raise RuntimeError(
+                        f"object {obj_hex} no longer available")
+                return self._finish_load(obj_hex, data, info)
             try:
                 seg = self.store.attach(ObjectID.from_hex(obj_hex),
                                         info["size"])
@@ -155,11 +169,18 @@ class CoreClient:
                 if _retried or not _is_missing_segment_error(e):
                     raise
                 fut = self._refetch_object(obj_hex)
-                return self._load_object(obj_hex, fut.result(timeout=60),
-                                         _retried=True)
+                try:
+                    info2 = fut.result(timeout=timeout)
+                except TimeoutError:
+                    raise GetTimeoutError(
+                        f"timed out refetching {obj_hex}") from None
+                return self._load_object(obj_hex, info2, _retried=True)
             data = seg.buf[: info["size"]]
         else:
             raise RuntimeError(f"object {obj_hex} ready but has no payload")
+        return self._finish_load(obj_hex, data, info)
+
+    def _finish_load(self, obj_hex: str, data, info: dict) -> Any:
         value = serialization.deserialize(data, ref_deserializer=self._on_ref_deser)
         if info.get("is_error"):
             raise value
@@ -194,7 +215,10 @@ class CoreClient:
                 info = fut.result(timeout=remaining)
             except TimeoutError:
                 raise GetTimeoutError(f"get() timed out on {r}") from None
-            results.append(self._load_object(r.hex(), info))
+            remaining = None if deadline is None \
+                else max(deadline - time.monotonic(), 0.1)
+            results.append(self._load_object(r.hex(), info,
+                                             timeout=remaining))
         return results
 
     def put(self, value: Any) -> ObjectRef:
@@ -205,7 +229,20 @@ class CoreClient:
     def _store_value(self, oid: ObjectID, value: Any, is_error: bool = False):
         ser = serialization.serialize(value)
         size = ser.total_bytes
-        if size <= self.config.max_inline_object_size:
+        # Thin clients ship everything inline over the connection (bounded
+        # only by the rpc frame limit); full clients inline small objects
+        # and put the rest in shm.
+        if self.store is None:
+            if size > self.config.rpc_max_message_bytes:
+                raise ValueError(
+                    f"object of {size} bytes exceeds the thin client's "
+                    f"message limit ({self.config.rpc_max_message_bytes});"
+                    " connect a full driver (ray_tpu.init(address=...)) "
+                    "for shared-memory puts")
+            inline_ok = True
+        else:
+            inline_ok = size <= self.config.max_inline_object_size
+        if inline_ok:
             self.client.send({
                 "op": "put_object", "obj": oid.hex(), "size": size,
                 "inline": ser.to_bytes(), "is_error": is_error,

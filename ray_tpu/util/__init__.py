@@ -10,7 +10,7 @@ from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
-from ray_tpu.util import metrics, timeline, tracing, usage_stats
+from ray_tpu.util import client, metrics, timeline, tracing, usage_stats
 from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 __all__ = [

@@ -1,0 +1,59 @@
+"""Thin client: connect to a running cluster from anywhere with TCP.
+
+Counterpart of the reference's Ray Client (python/ray/util/client/ —
+gRPC thin client with pickled payloads, per-client server proxies;
+SURVEY.md §2.2 P13). Collapsed architecture: the control server's RPC
+protocol already carries every control operation, so the thin client is
+a CoreClient in `thin` mode — no shared-memory attachment; puts ship
+inline over the connection and gets of shm-resident objects are read
+server-side (gcs.py _op_fetch_object). Task submission, actors, named
+actors, placement groups, and the state API all work unchanged because
+they were connection-based to begin with.
+
+Usage:
+    ctx = ray_tpu.util.client.connect("host:port")   # or "auto"
+    ...ray_tpu.remote / get / put as usual...
+    ctx.disconnect()
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu.core import runtime as _runtime_mod
+from ray_tpu.core.driver import DriverRuntime
+
+
+class ClientContext:
+    def __init__(self, runtime: DriverRuntime):
+        self.runtime = runtime
+
+    @property
+    def address(self) -> str:
+        return self.runtime.address
+
+    def disconnect(self) -> None:
+        self.runtime.shutdown()
+
+    def __enter__(self) -> "ClientContext":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.disconnect()
+
+
+def connect(address: str = "auto") -> ClientContext:
+    """Attach a THIN client runtime to a running cluster (no shared
+    memory, all payloads over TCP — works cross-host). For a same-host
+    full driver (zero-copy shm objects), use ray_tpu.init(address=...)."""
+    if address == "auto":
+        from ray_tpu.core.api import _resolve_cluster_address
+
+        address = _resolve_cluster_address()
+    existing = _runtime_mod._global_runtime
+    if existing is not None and getattr(existing, "is_initialized", False):
+        raise RuntimeError(
+            "a runtime is already active in this process; call "
+            "ray_tpu.shutdown() first")
+    rt = DriverRuntime(address=address, thin=True, log_to_driver=False)
+    return ClientContext(rt)

@@ -1,0 +1,102 @@
+"""Thin-client tests (SURVEY.md §2.2 P13 Ray Client counterpart).
+
+The thin client is proven shm-independent two ways: in-process (its
+CoreClient has store=None, so any shm touch would crash) and from a real
+separate process connecting over TCP.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_thin_client_subprocess_end_to_end(cluster):
+    """A separate OS process connects with the thin client and runs
+    tasks, large-object put/get (inline path), and actors."""
+    script = textwrap.dedent(f"""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.setdefault("RAY_TPU_CHIPS", "none")
+        import numpy as np
+        import ray_tpu
+        from ray_tpu.util.client import connect
+
+        ctx = connect({cluster.address!r})
+        from ray_tpu.core.runtime import get_runtime
+        assert get_runtime().core.store is None  # truly thin
+
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        assert ray_tpu.get(add.remote(2, 3)) == 5
+
+        # Large object: > inline threshold, ships over TCP both ways.
+        big = np.arange(300_000, dtype=np.int64)
+        ref = ray_tpu.put(big)
+
+        @ray_tpu.remote
+        def total(x):
+            return int(x.sum())
+
+        assert ray_tpu.get(total.remote(ref)) == int(big.sum())
+        # Worker-produced large result read back through fetch_object.
+        @ray_tpu.remote
+        def make():
+            return np.ones(200_000, dtype=np.float64)
+
+        out = ray_tpu.get(make.remote())
+        assert out.shape == (200_000,) and float(out.sum()) == 200_000.0
+
+        class Counter:
+            def __init__(self):
+                self.n = 0
+            def incr(self):
+                self.n += 1
+                return self.n
+
+        C = ray_tpu.remote(Counter)
+        c = C.remote()
+        assert ray_tpu.get(c.incr.remote()) == 1
+        assert ray_tpu.get(c.incr.remote()) == 2
+        ctx.disconnect()
+        print("THIN_CLIENT_OK")
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=120, cwd="/root/repo")
+    assert "THIN_CLIENT_OK" in proc.stdout, (proc.stdout, proc.stderr)
+
+
+def test_thin_client_rejects_second_runtime(cluster):
+    from ray_tpu.util.client import connect
+
+    with pytest.raises(RuntimeError, match="already active"):
+        connect(cluster.address)
+
+
+def test_fetch_object_op_reads_shm_payload(cluster):
+    """fetch_object returns the serialized payload of a shm object (the
+    thin client's read path), including spilled objects."""
+    big = np.arange(100_000, dtype=np.int64)
+    ref = ray_tpu.put(big)
+    ray_tpu.wait([ref])
+    data = cluster.kv().call({"op": "fetch_object", "obj": ref.hex()})
+    assert data is not None
+    from ray_tpu.core.serialization import deserialize
+
+    np.testing.assert_array_equal(deserialize(data), big)
+    assert cluster.kv().call(
+        {"op": "fetch_object", "obj": "00" * 14}) is None
