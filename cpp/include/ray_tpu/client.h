@@ -1,0 +1,374 @@
+// C++ frontend for the ray_tpu control plane.
+//
+// Counterpart of the reference's C++ API (cpp/include/ray/api/*.h over the
+// core worker, SURVEY.md §2.1 N17) — redesigned for this runtime's
+// capability split: the TPU compute path (JAX/XLA) lives in Python
+// workers, so the C++ API is a *frontend*: it connects to the control
+// server, submits Python functions registered by name
+// (ray_tpu.register_named_function — the cross-language
+// FunctionDescriptor idea), polls results, and uses the cluster KV and
+// state API. Wire protocol: the control server's JSON frame kind
+// (ray_tpu/core/rpc.py kind=3), so this header has zero dependencies
+// beyond POSIX sockets.
+//
+// Usage:
+//   ray::tpu::Client c("127.0.0.1:6123");
+//   std::string obj = c.SubmitTask("add", "[2, 3]");
+//   ray::tpu::Json v = c.GetBlocking(obj, /*timeout_s=*/30);
+//   // v.num == 5
+//
+#pragma once
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cctype>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace ray {
+namespace tpu {
+
+// ---------------------------------------------------------------------------
+// Minimal JSON value + parser (objects/arrays/strings/numbers/bool/null).
+// ---------------------------------------------------------------------------
+struct Json {
+  enum Type { kNull, kBool, kNum, kStr, kArr, kObj } type = kNull;
+  bool boolean = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  const Json& at(const std::string& key) const {
+    static Json null_value;
+    auto it = obj.find(key);
+    return it == obj.end() ? null_value : it->second;
+  }
+  bool is_null() const { return type == kNull; }
+};
+
+namespace detail {
+
+class JsonParser {
+ public:
+  explicit JsonParser(const std::string& s) : s_(s) {}
+  Json Parse() {
+    Json v = Value();
+    Ws();
+    if (pos_ != s_.size()) throw std::runtime_error("trailing json");
+    return v;
+  }
+
+ private:
+  void Ws() {
+    while (pos_ < s_.size() && (s_[pos_] == ' ' || s_[pos_] == '\n' ||
+                                s_[pos_] == '\t' || s_[pos_] == '\r'))
+      pos_++;
+  }
+  char Peek() {
+    Ws();
+    if (pos_ >= s_.size()) throw std::runtime_error("eof in json");
+    return s_[pos_];
+  }
+  Json Value() {
+    switch (Peek()) {
+      case '{': return Obj();
+      case '[': return Arr();
+      case '"': { Json v; v.type = Json::kStr; v.str = Str(); return v; }
+      case 't': Lit("true");  { Json v; v.type = Json::kBool; v.boolean = true;  return v; }
+      case 'f': Lit("false"); { Json v; v.type = Json::kBool; v.boolean = false; return v; }
+      case 'n': Lit("null");  return Json();
+      default:  return Num();
+    }
+  }
+  void Lit(const char* lit) {
+    Ws();
+    size_t n = std::strlen(lit);
+    if (s_.compare(pos_, n, lit) != 0) throw std::runtime_error("bad json literal");
+    pos_ += n;
+  }
+  Json Num() {
+    Ws();
+    size_t end = pos_;
+    while (end < s_.size() &&
+           (std::isdigit((unsigned char)s_[end]) || s_[end] == '-' ||
+            s_[end] == '+' || s_[end] == '.' || s_[end] == 'e' ||
+            s_[end] == 'E'))
+      end++;
+    Json v;
+    v.type = Json::kNum;
+    v.num = std::stod(s_.substr(pos_, end - pos_));
+    pos_ = end;
+    return v;
+  }
+  std::string Str() {
+    Ws();
+    if (s_[pos_] != '"') throw std::runtime_error("expected string");
+    pos_++;
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) throw std::runtime_error("eof in string");
+      char c = s_[pos_++];
+      if (c == '"') break;
+      if (c == '\\') {
+        char e = s_[pos_++];
+        switch (e) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case 'b': out += '\b'; break;
+          case 'f': out += '\f'; break;
+          case '/': out += '/'; break;
+          case '\\': out += '\\'; break;
+          case '"': out += '"'; break;
+          case 'u': {
+            unsigned code = std::stoul(s_.substr(pos_, 4), nullptr, 16);
+            pos_ += 4;
+            // UTF-8 encode (BMP only; surrogate pairs folded naively).
+            if (code < 0x80) out += (char)code;
+            else if (code < 0x800) {
+              out += (char)(0xC0 | (code >> 6));
+              out += (char)(0x80 | (code & 0x3F));
+            } else {
+              out += (char)(0xE0 | (code >> 12));
+              out += (char)(0x80 | ((code >> 6) & 0x3F));
+              out += (char)(0x80 | (code & 0x3F));
+            }
+            break;
+          }
+          default: throw std::runtime_error("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+  Json Obj() {
+    Json v;
+    v.type = Json::kObj;
+    pos_++;  // '{'
+    if (Peek() == '}') { pos_++; return v; }
+    while (true) {
+      std::string key = Str();
+      Ws();
+      if (s_[pos_++] != ':') throw std::runtime_error("expected ':'");
+      v.obj[key] = Value();
+      char c = Peek();
+      pos_++;
+      if (c == '}') break;
+      if (c != ',') throw std::runtime_error("expected ',' in object");
+    }
+    return v;
+  }
+  Json Arr() {
+    Json v;
+    v.type = Json::kArr;
+    pos_++;  // '['
+    if (Peek() == ']') { pos_++; return v; }
+    while (true) {
+      v.arr.push_back(Value());
+      char c = Peek();
+      pos_++;
+      if (c == ']') break;
+      if (c != ',') throw std::runtime_error("expected ',' in array");
+    }
+    return v;
+  }
+  const std::string& s_;
+  size_t pos_ = 0;
+};
+
+inline std::string JsonEscape(const std::string& s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default: out += c;
+    }
+  }
+  return out;
+}
+
+}  // namespace detail
+
+// ---------------------------------------------------------------------------
+// Client
+// ---------------------------------------------------------------------------
+class Client {
+ public:
+  explicit Client(const std::string& address) {
+    auto colon = address.rfind(':');
+    if (colon == std::string::npos)
+      throw std::invalid_argument("address must be host:port");
+    std::string host = address.substr(0, colon);
+    int port = std::stoi(address.substr(colon + 1));
+
+    fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd_ < 0) throw std::runtime_error("socket() failed");
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons((uint16_t)port);
+    hostent* he = ::gethostbyname(host.c_str());
+    if (he == nullptr) throw std::runtime_error("cannot resolve " + host);
+    std::memcpy(&addr.sin_addr, he->h_addr, he->h_length);
+    if (::connect(fd_, (sockaddr*)&addr, sizeof(addr)) != 0) {
+      ::close(fd_);
+      throw std::runtime_error("cannot connect to " + address);
+    }
+    int one = 1;
+    ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // Register as a driver-kind peer so submissions have an owner.
+    worker_hex_ = RandomHex(28);
+    Json reply = Call(std::string("{\"op\":\"register\",\"worker_hex\":\"") +
+                      worker_hex_ +
+                      "\",\"pid\":" + std::to_string(::getpid()) +
+                      ",\"kind\":\"driver\",\"address\":\"\","
+                      "\"env_key\":\"\"}");
+    session_id_ = reply.at("session_id").str;
+  }
+
+  ~Client() {
+    if (fd_ >= 0) ::close(fd_);
+  }
+  Client(const Client&) = delete;
+  Client& operator=(const Client&) = delete;
+
+  const std::string& session_id() const { return session_id_; }
+
+  // Raw op call: `body` is the JSON message including the "op" key.
+  // Returns the "result" value; throws on {"status": "err"}.
+  Json Call(const std::string& body) {
+    SendFrame(3 /*KIND_REQUEST_JSON*/, ++req_id_, body);
+    while (true) {
+      uint8_t kind;
+      uint64_t rid;
+      std::string payload = RecvFrame(&kind, &rid);
+      if (kind != 1 /*KIND_RESPONSE*/) continue;  // pushes are pickled; skip
+      if (rid != req_id_) continue;
+      Json msg = detail::JsonParser(payload).Parse();
+      if (msg.at("status").str == "err")
+        throw std::runtime_error("server error: " + msg.at("error").str);
+      return msg.at("result");
+    }
+  }
+
+  // Submit a named Python function (see ray_tpu.register_named_function)
+  // with a JSON array of arguments; returns the result object's hex id.
+  std::string SubmitTask(const std::string& name,
+                         const std::string& args_json = "[]",
+                         double num_cpus = 1.0) {
+    std::string body = "{\"op\":\"submit_named_task\",\"name\":\"" +
+                       detail::JsonEscape(name) + "\",\"args\":" + args_json +
+                       ",\"num_cpus\":" + std::to_string(num_cpus) + "}";
+    return Call(body).str;
+  }
+
+  // Poll a result: status in {"pending", "ready", "error"}.
+  Json GetStatus(const std::string& obj_hex) {
+    return Call("{\"op\":\"get_object_json\",\"obj\":\"" + obj_hex + "\"}");
+  }
+
+  // Block (polling) until ready or timeout; returns the "value" field.
+  Json GetBlocking(const std::string& obj_hex, double timeout_s = 60.0) {
+    double waited = 0;
+    while (waited < timeout_s) {
+      Json st = GetStatus(obj_hex);
+      const std::string& s = st.at("status").str;
+      if (s == "ready") return st.at("value");
+      if (s == "error")
+        throw std::runtime_error("task failed: " + st.at("error").str);
+      ::usleep(20000);
+      waited += 0.02;
+    }
+    throw std::runtime_error("timeout waiting for " + obj_hex);
+  }
+
+  // Cluster KV (string values).
+  void KvPut(const std::string& key, const std::string& value) {
+    Call("{\"op\":\"kv_put\",\"key\":\"" + detail::JsonEscape(key) +
+         "\",\"value\":\"" + detail::JsonEscape(value) +
+         "\",\"overwrite\":true}");
+  }
+  Json KvGet(const std::string& key) {
+    return Call("{\"op\":\"kv_get\",\"key\":\"" + detail::JsonEscape(key) +
+                "\"}");
+  }
+
+  Json ClusterResources() { return Call("{\"op\":\"cluster_resources\"}"); }
+  Json ListTasks() { return Call("{\"op\":\"list_tasks\"}"); }
+  Json ListNodes() { return Call("{\"op\":\"list_nodes\"}"); }
+
+ private:
+  static std::string RandomHex(int n) {
+    // Process-wide generator, seeded once from the OS: two Clients in
+    // one process (or two processes in the same second) must not share
+    // a worker id — the server keys ownership on it.
+    static std::mt19937_64 rng{std::random_device{}()};
+    static const char* hex = "0123456789abcdef";
+    std::string out;
+    for (int i = 0; i < n; i++) out += hex[rng() % 16];
+    return out;
+  }
+
+  void SendFrame(uint8_t kind, uint64_t req_id, const std::string& payload) {
+    char header[13];
+    header[0] = (char)kind;
+    std::memcpy(header + 1, &req_id, 8);           // little-endian host
+    uint32_t len = (uint32_t)payload.size();
+    std::memcpy(header + 9, &len, 4);
+    SendAll(header, 13);
+    SendAll(payload.data(), payload.size());
+  }
+
+  std::string RecvFrame(uint8_t* kind, uint64_t* req_id) {
+    char header[13];
+    RecvAll(header, 13);
+    *kind = (uint8_t)header[0];
+    std::memcpy(req_id, header + 1, 8);
+    uint32_t len;
+    std::memcpy(&len, header + 9, 4);
+    std::string payload(len, '\0');
+    if (len) RecvAll(&payload[0], len);
+    return payload;
+  }
+
+  void SendAll(const char* data, size_t n) {
+    size_t sent = 0;
+    while (sent < n) {
+      ssize_t rc = ::send(fd_, data + sent, n - sent, 0);
+      if (rc <= 0) throw std::runtime_error("connection lost (send)");
+      sent += (size_t)rc;
+    }
+  }
+  void RecvAll(char* data, size_t n) {
+    size_t got = 0;
+    while (got < n) {
+      ssize_t rc = ::recv(fd_, data + got, n - got, 0);
+      if (rc <= 0) throw std::runtime_error("connection lost (recv)");
+      got += (size_t)rc;
+    }
+  }
+
+  int fd_ = -1;
+  uint64_t req_id_ = 0;
+  std::string worker_hex_;
+  std::string session_id_;
+};
+
+}  // namespace tpu
+}  // namespace ray

@@ -9,6 +9,7 @@ from ray_tpu._version import __version__
 from ray_tpu.core.api import (
     available_resources,
     cancel,
+    register_named_function,
     cluster_resources,
     get,
     get_actor,
@@ -44,6 +45,7 @@ __all__ = [
     "wait",
     "kill",
     "cancel",
+    "register_named_function",
     "get_actor",
     "cluster_resources",
     "available_resources",

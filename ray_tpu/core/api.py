@@ -117,6 +117,23 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
         list(refs), num_returns=num_returns, timeout=timeout)
 
 
+def register_named_function(name: str, fn) -> str:
+    """Register a Python function for cross-language invocation (the
+    reference's FunctionDescriptor story): C++ clients submit it by name
+    via submit_named_task (see cpp/). Returns the function id."""
+    import cloudpickle
+
+    from ray_tpu.core.runtime import func_content_id
+
+    rt = _runtime_mod.get_runtime()
+    blob = cloudpickle.dumps(fn)
+    func_id = func_content_id(blob)
+    rt.core.ensure_func(func_id, blob)
+    rt.kv().call({"op": "kv_put", "key": f"__named_fn__/{name}",
+                  "value": func_id.encode(), "overwrite": True})
+    return func_id
+
+
 def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
     """Cancel the task producing ``ref``.  Pending tasks are always
     cancellable; running tasks only with force=True (worker is killed)."""

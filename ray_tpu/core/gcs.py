@@ -730,6 +730,72 @@ class ControlServer:
             self.pending_tasks.append(spec)
         self._wake.set()
 
+    def _op_submit_named_task(self, conn, msg):
+        """Cross-language task submission (cpp/ frontend; counterpart of
+        the reference's cross-language FunctionDescriptor calls): invoke
+        a Python function registered under a name
+        (ray_tpu.register_named_function) with JSON-decoded args.
+        Returns the return object's hex for polling via get_object_json."""
+        from ray_tpu.core.ids import ObjectID as OID
+        from ray_tpu.core.ids import TaskID
+        from ray_tpu.core.serialization import serialize
+        from ray_tpu.core.task_spec import TaskArg
+
+        name = msg["name"]
+        with self.lock:
+            func_id = self.kv.get(f"__named_fn__/{name}")
+        if func_id is None:
+            raise ValueError(f"no function registered as {name!r}")
+        func_id = func_id.decode() if isinstance(func_id, bytes) else func_id
+        args = [TaskArg(is_ref=False, data=serialize(a).to_bytes())
+                for a in msg.get("args", [])]
+        return_id = OID.from_random()
+        owner = conn.meta.get("worker_hex", "")
+        spec = TaskSpec(
+            task_id=TaskID.from_random(), func_id=func_id, func_blob=None,
+            args=args, num_returns=1, return_ids=[return_id],
+            resources={"CPU": float(msg.get("num_cpus", 1.0)),
+                       **({"TPU": float(msg["num_tpus"])}
+                          if msg.get("num_tpus") else {})},
+            max_retries=int(msg.get("max_retries", 0)),
+            name=f"named:{name}", owner=owner)
+        self._op_submit_task(conn, {"spec": spec})
+        return return_id.hex()
+
+    def _op_get_object_json(self, conn, msg):
+        """Poll an object's value for non-Python clients: deserializes
+        and re-encodes as JSON. {"status": "pending"|"ready"|"error"}."""
+        import json as _json
+
+        with self.lock:
+            entry = self.objects.get(msg["obj"])
+            if entry is None:
+                return {"status": "error", "error": "object not found"}
+            if entry.state == PENDING:
+                return {"status": "pending"}
+            is_error = entry.is_error
+        payload = self._op_fetch_object(conn, msg)
+        if payload is None:
+            return {"status": "error",
+                    "error": "object payload unavailable"}
+        from ray_tpu.core.serialization import deserialize
+
+        try:
+            value = deserialize(payload)
+        except Exception as e:  # noqa: BLE001
+            return {"status": "error",
+                    "error": f"undeserializable result: {e}"}
+        if is_error:
+            return {"status": "error", "error": f"{value}"}
+        try:
+            _json.dumps(value)
+        except TypeError:
+            return {"status": "error",
+                    "error": f"result of type {type(value).__name__} is "
+                             "not JSON-representable; fetch it from a "
+                             "Python client"}
+        return {"status": "ready", "value": value}
+
     def _op_task_done(self, conn, msg):
         with self.lock:
             rec = self.tasks.get(msg["task_id"])

@@ -6,7 +6,13 @@ protocol over TCP sockets:
 
     [1-byte kind][8-byte request id][4-byte len][pickle payload]
 
-kind: 0 = request (expects response), 1 = response, 2 = one-way.
+kind: 0 = request (expects response), 1 = response, 2 = one-way,
+      3 = JSON request (payload is UTF-8 JSON; response is JSON too).
+
+Kind 3 is the cross-language door (reference: the gRPC protos any
+language can speak): non-Python frontends (cpp/ client) call the same
+ops with JSON payloads and get `{"status": "ok"|"err", ...}` JSON back;
+bytes values are transported as {"__bytes_b64__": ...}.
 
 Server: thread per connection, handler invoked per message; handler may
 return a value (sent back as response) or None for one-way messages.
@@ -15,6 +21,8 @@ Clients are thread-safe; concurrent calls are matched by request id.
 
 from __future__ import annotations
 
+import base64
+import json
 import pickle
 import socket
 import struct
@@ -27,6 +35,28 @@ _FRAME = struct.Struct("<BQI")
 KIND_REQUEST = 0
 KIND_RESPONSE = 1
 KIND_ONEWAY = 2
+KIND_REQUEST_JSON = 3
+
+
+def _to_jsonable(value: Any):
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return {"__bytes_b64__":
+                base64.b64encode(bytes(value)).decode("ascii")}
+    if isinstance(value, dict):
+        return {str(k): _to_jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_to_jsonable(v) for v in value]
+    return value
+
+
+def _from_jsonable(value: Any):
+    if isinstance(value, dict):
+        if set(value) == {"__bytes_b64__"}:
+            return base64.b64decode(value["__bytes_b64__"])
+        return {k: _from_jsonable(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_from_jsonable(v) for v in value]
+    return value
 
 
 class RpcError(ConnectionError):
@@ -152,6 +182,20 @@ class Server:
         try:
             while not self._stopped.is_set():
                 kind, req_id, payload = _recv_frame(conn.sock)
+                if kind == KIND_REQUEST_JSON:
+                    try:
+                        msg = _from_jsonable(json.loads(payload))
+                        result = self._handler(conn, msg)
+                        out = json.dumps({"status": "ok",
+                                          "result": _to_jsonable(result)})
+                    except Exception as e:  # noqa: BLE001
+                        out = json.dumps({
+                            "status": "err",
+                            "error": f"{type(e).__name__}: {e}"})
+                    with conn.send_lock:
+                        _send_frame(conn.sock, KIND_RESPONSE, req_id,
+                                    out.encode())
+                    continue
                 msg = pickle.loads(payload)
                 if kind == KIND_REQUEST:
                     try:

@@ -170,7 +170,11 @@ class CoreClient:
                     raise
                 fut = self._refetch_object(obj_hex)
                 try:
-                    info2 = fut.result(timeout=timeout)
+                    # Bounded even for timeout=None gets: if the object
+                    # was truly freed, the fresh subscription would stay
+                    # PENDING forever.
+                    info2 = fut.result(
+                        timeout=min(timeout, 60.0) if timeout else 60.0)
                 except TimeoutError:
                     raise GetTimeoutError(
                         f"timed out refetching {obj_hex}") from None

@@ -1,0 +1,108 @@
+"""C++ frontend tests (SURVEY.md §2.1 N17 counterpart): the JSON frame
+protocol, named-function registration, and the real compiled C++ client
+end to end."""
+
+import json
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+
+_REPO_ROOT = str(__import__("pathlib").Path(__file__).resolve().parent.parent)
+
+_BIN = "/tmp/ray_tpu_cpp_example"
+
+
+@pytest.fixture
+def cluster():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_json_frame_protocol(cluster):
+    """Speak the JSON frame kind directly from Python (what the C++
+    client does on the wire)."""
+    import socket
+    import struct
+
+    host, port = cluster.address.rsplit(":", 1)
+    s = socket.create_connection((host, int(port)), timeout=10)
+    frame = struct.Struct("<BQI")
+
+    def call(body: dict) -> dict:
+        payload = json.dumps(body).encode()
+        s.sendall(frame.pack(3, 1, len(payload)) + payload)
+        kind, _, length = frame.unpack(_recv(s, frame.size))
+        assert kind == 1
+        return json.loads(_recv(s, length))
+
+    def _recv(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            assert chunk
+            buf += chunk
+        return buf
+
+    out = call({"op": "cluster_resources"})
+    assert out["status"] == "ok"
+    assert out["result"]["CPU"] == 4.0
+    out = call({"op": "no_such_op"})
+    assert out["status"] == "err"
+    s.close()
+
+
+def test_named_function_python_roundtrip(cluster):
+    ray_tpu.register_named_function("mul", lambda a, b: a * b)
+    obj = cluster.kv().call({"op": "submit_named_task", "name": "mul",
+                             "args": [6, 7]})
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = cluster.kv().call({"op": "get_object_json", "obj": obj})
+        if st["status"] != "pending":
+            break
+        time.sleep(0.05)
+    assert st == {"status": "ready", "value": 42}
+
+    with pytest.raises(Exception, match="no function registered"):
+        cluster.kv().call({"op": "submit_named_task", "name": "ghost",
+                           "args": []})
+
+
+def test_non_jsonable_result_reports_clearly(cluster):
+    import numpy as np
+
+    ray_tpu.register_named_function("arr", lambda: np.ones(3))
+    obj = cluster.kv().call({"op": "submit_named_task", "name": "arr",
+                             "args": []})
+    import time
+
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        st = cluster.kv().call({"op": "get_object_json", "obj": obj})
+        if st["status"] != "pending":
+            break
+        time.sleep(0.05)
+    assert st["status"] == "error"
+    assert "not JSON-representable" in st["error"]
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+def test_cpp_client_end_to_end(cluster):
+    """Compile the real C++ example and run it against the live cluster."""
+    build = subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-Icpp/include", "cpp/example.cc",
+         "-o", _BIN],
+        capture_output=True, text=True, cwd=_REPO_ROOT)
+    assert build.returncode == 0, build.stderr
+
+    ray_tpu.register_named_function("add", lambda a, b: a + b)
+    proc = subprocess.run([_BIN, cluster.address], capture_output=True,
+                          text=True, timeout=120)
+    assert "CPP_CLIENT_OK" in proc.stdout, (proc.stdout, proc.stderr)

@@ -14,6 +14,8 @@ import pytest
 
 import ray_tpu
 
+_REPO_ROOT = str(__import__("pathlib").Path(__file__).resolve().parent.parent)
+
 
 @pytest.fixture
 def cluster():
@@ -76,7 +78,7 @@ def test_thin_client_subprocess_end_to_end(cluster):
     """)
     proc = subprocess.run(
         [sys.executable, "-c", script], capture_output=True, text=True,
-        timeout=120, cwd="/root/repo")
+        timeout=120, cwd=_REPO_ROOT)
     assert "THIN_CLIENT_OK" in proc.stdout, (proc.stdout, proc.stderr)
 
 
