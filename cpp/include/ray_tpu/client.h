@@ -27,6 +27,7 @@
 
 #include <cctype>
 #include <cstdint>
+#include <ctime>
 #include <cstring>
 #include <map>
 #include <memory>
@@ -232,14 +233,22 @@ class Client {
     }
     int one = 1;
     ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    // Register as a driver-kind peer so submissions have an owner.
-    worker_hex_ = RandomHex(28);
-    Json reply = Call(std::string("{\"op\":\"register\",\"worker_hex\":\"") +
-                      worker_hex_ +
-                      "\",\"pid\":" + std::to_string(::getpid()) +
-                      ",\"kind\":\"driver\",\"address\":\"\","
-                      "\"env_key\":\"\"}");
-    session_id_ = reply.at("session_id").str;
+    // Register as a driver-kind peer so submissions have an owner. A
+    // failed handshake must close the fd here — the destructor never
+    // runs for a partially constructed object.
+    try {
+      worker_hex_ = RandomHex(28);
+      Json reply =
+          Call(std::string("{\"op\":\"register\",\"worker_hex\":\"") +
+               worker_hex_ + "\",\"pid\":" + std::to_string(::getpid()) +
+               ",\"kind\":\"driver\",\"address\":\"\","
+               "\"env_key\":\"\"}");
+      session_id_ = reply.at("session_id").str;
+    } catch (...) {
+      ::close(fd_);
+      fd_ = -1;
+      throw;
+    }
   }
 
   ~Client() {
@@ -285,17 +294,22 @@ class Client {
 
   // Block (polling) until ready or timeout; returns the "value" field.
   Json GetBlocking(const std::string& obj_hex, double timeout_s = 60.0) {
-    double waited = 0;
-    while (waited < timeout_s) {
+    // Wall-clock deadline: RPC round-trip time counts against the
+    // timeout, not just the sleeps.
+    timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    double deadline = ts.tv_sec + ts.tv_nsec * 1e-9 + timeout_s;
+    while (true) {
       Json st = GetStatus(obj_hex);
       const std::string& s = st.at("status").str;
       if (s == "ready") return st.at("value");
       if (s == "error")
         throw std::runtime_error("task failed: " + st.at("error").str);
+      clock_gettime(CLOCK_MONOTONIC, &ts);
+      if (ts.tv_sec + ts.tv_nsec * 1e-9 >= deadline)
+        throw std::runtime_error("timeout waiting for " + obj_hex);
       ::usleep(20000);
-      waited += 0.02;
     }
-    throw std::runtime_error("timeout waiting for " + obj_hex);
   }
 
   // Cluster KV (string values).

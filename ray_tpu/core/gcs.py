@@ -787,9 +787,14 @@ class ControlServer:
                     "error": f"undeserializable result: {e}"}
         if is_error:
             return {"status": "error", "error": f"{value}"}
+        from ray_tpu.core.rpc import _to_jsonable
+
         try:
-            _json.dumps(value)
-        except TypeError:
+            # Validate the WIRE encoding (bytes become base64 envelopes);
+            # allow_nan=False because bare NaN/Infinity tokens are not
+            # JSON and break non-Python parsers.
+            _json.dumps(_to_jsonable(value), allow_nan=False)
+        except (TypeError, ValueError):
             return {"status": "error",
                     "error": f"result of type {type(value).__name__} is "
                              "not JSON-representable; fetch it from a "

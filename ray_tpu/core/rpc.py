@@ -186,8 +186,11 @@ class Server:
                     try:
                         msg = _from_jsonable(json.loads(payload))
                         result = self._handler(conn, msg)
+                        # allow_nan=False: bare NaN/Infinity tokens are
+                        # invalid JSON for non-Python peers.
                         out = json.dumps({"status": "ok",
-                                          "result": _to_jsonable(result)})
+                                          "result": _to_jsonable(result)},
+                                         allow_nan=False)
                     except Exception as e:  # noqa: BLE001
                         out = json.dumps({
                             "status": "err",

@@ -170,11 +170,13 @@ class CoreClient:
                     raise
                 fut = self._refetch_object(obj_hex)
                 try:
-                    # Bounded even for timeout=None gets: if the object
-                    # was truly freed, the fresh subscription would stay
-                    # PENDING forever.
+                    # Honor an explicit caller timeout fully; for
+                    # timeout=None gets, bound the wait generously (a
+                    # truly freed object's fresh subscription would stay
+                    # PENDING forever, but slow external-storage restores
+                    # must be allowed to finish).
                     info2 = fut.result(
-                        timeout=min(timeout, 60.0) if timeout else 60.0)
+                        timeout=timeout if timeout is not None else 300.0)
                 except TimeoutError:
                     raise GetTimeoutError(
                         f"timed out refetching {obj_hex}") from None

@@ -175,6 +175,14 @@ def cmd_memory(args):
     return 0
 
 
+def cmd_microbenchmark(args):
+    """Run the core microbenchmark suite (reference: `ray
+    microbenchmark`, _private/ray_perf.py)."""
+    from ray_tpu.scripts.microbenchmark import main as run_bench
+
+    return run_bench()
+
+
 def cmd_timeline(args):
     """Dump the cluster task timeline as chrome-trace JSON (reference:
     `ray timeline`, _private/state.py:434)."""
@@ -259,6 +267,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("summary", help="counts by state")
     sp.add_argument("kind", choices=["tasks", "actors"])
     sp.set_defaults(fn=cmd_summary)
+
+    sp = sub.add_parser("microbenchmark",
+                        help="core-runtime throughput microbenchmarks")
+    sp.set_defaults(fn=cmd_microbenchmark)
 
     sp = sub.add_parser("timeline", help="dump chrome-trace task timeline")
     sp.add_argument("-o", "--output", default="")

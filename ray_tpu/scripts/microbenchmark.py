@@ -1,0 +1,156 @@
+"""`ray-tpu microbenchmark`: core-runtime throughput microbenchmarks.
+
+Counterpart of the reference's `ray microbenchmark`
+(python/ray/_private/ray_perf.py + ray_microbenchmark_helpers.timeit).
+Benchmark keys intentionally match release/perf_metrics/microbenchmark.json
+(BASELINE.md's table) so results diff directly against the reference's
+recorded numbers.
+
+Run: `ray-tpu microbenchmark` or `python -m ray_tpu.scripts.microbenchmark`.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def timeit(name: str, fn: Callable[[], None], multiplier: int = 1, *,
+           trials: int = 4, window_s: float = 1.0,
+           results: Optional[List[Tuple[str, float, float]]] = None):
+    """Run fn repeatedly for `window_s` per trial; report ops/s
+    (mean, stddev across trials) — the reference helper's shape."""
+    # warmup
+    fn()
+    rates = []
+    for _ in range(trials):
+        start = time.perf_counter()
+        count = 0
+        while time.perf_counter() - start < window_s:
+            fn()
+            count += 1
+        elapsed = time.perf_counter() - start
+        rates.append(count * multiplier / elapsed)
+    mean = statistics.mean(rates)
+    std = statistics.stdev(rates) if len(rates) > 1 else 0.0
+    print(f"{name:<45s} {mean:>12.1f} ± {std:.1f} /s")
+    if results is not None:
+        results.append((name, mean, std))
+    return mean, std
+
+
+def main(argv=None) -> int:
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=8, log_to_driver=False)
+    results: List[Tuple[str, float, float]] = []
+
+    # -- object store ------------------------------------------------------
+    small = np.zeros(8, dtype=np.int64)            # inline path
+    shm_obj = np.zeros(200_000, dtype=np.uint8)    # shm path (>100KB)
+    big = np.zeros(100 * 1024 * 1024, dtype=np.uint8)  # 100 MB
+
+    ref_small = ray_tpu.put(shm_obj)
+    ray_tpu.get(ref_small)
+
+    timeit("single_client_get_calls_Plasma_Store",
+           lambda: ray_tpu.get(ref_small), results=results)
+
+    put_refs: List = []
+
+    def put_small():
+        put_refs.append(ray_tpu.put(shm_obj))
+        if len(put_refs) > 100:
+            put_refs.clear()  # let refcounts release
+
+    timeit("single_client_put_calls_Plasma_Store", put_small,
+           results=results)
+
+    def put_gb():
+        r = ray_tpu.put(big)
+        del r
+
+    n_gb = big.nbytes / 1e9
+    mean, std = timeit("single_client_put_gigabytes", put_gb,
+                       results=None)
+    results.append(("single_client_put_gigabytes", mean * n_gb,
+                    std * n_gb))
+    print(f"{'  -> GB/s':<45s} {mean * n_gb:>12.2f}")
+
+    # -- tasks -------------------------------------------------------------
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    timeit("single_client_tasks_sync",
+           lambda: ray_tpu.get(small_task.remote()), results=results)
+
+    def tasks_async():
+        ray_tpu.get([small_task.remote() for _ in range(100)])
+
+    timeit("single_client_tasks_async", tasks_async, multiplier=100,
+           results=results)
+
+    # -- actors ------------------------------------------------------------
+    class Sink:
+        def ping(self):
+            return b"ok"
+
+    Actor = ray_tpu.remote(Sink)
+    a = Actor.remote()
+    ray_tpu.get(a.ping.remote())
+
+    timeit("1_1_actor_calls_sync",
+           lambda: ray_tpu.get(a.ping.remote()), results=results)
+
+    def actor_async():
+        ray_tpu.get([a.ping.remote() for _ in range(100)])
+
+    timeit("1_1_actor_calls_async", actor_async, multiplier=100,
+           results=results)
+
+    # Fractional CPUs so sinks + callers (16 actors) fit the 8-CPU pool.
+    actors = [Actor.options(num_cpus=0.25).remote() for _ in range(8)]
+    ray_tpu.get([b.ping.remote() for b in actors])
+
+    def one_n_async():
+        ray_tpu.get([b.ping.remote() for b in actors for _ in range(12)])
+
+    timeit("1_n_actor_calls_async", one_n_async, multiplier=96,
+           results=results)
+
+    # n:n — 8 caller actors each driving their own sink actor.
+    class Caller:
+        def __init__(self, sink):
+            self.sink = sink
+
+        def drive(self, n):
+            import ray_tpu as rt
+
+            rt.get([self.sink.ping.remote() for _ in range(n)])
+            return n
+
+    CallerA = ray_tpu.remote(Caller)
+    callers = [CallerA.options(num_cpus=0.25).remote(s) for s in actors]
+    ray_tpu.get([c.drive.remote(1) for c in callers])
+
+    def n_n_async():
+        ray_tpu.get([c.drive.remote(12) for c in callers])
+
+    timeit("n_n_actor_calls_async", n_n_async, multiplier=96,
+           results=results)
+
+    ray_tpu.shutdown()
+
+    print(json.dumps({name: [mean, std] for name, mean, std in results}))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

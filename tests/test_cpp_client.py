@@ -16,6 +16,20 @@ _REPO_ROOT = str(__import__("pathlib").Path(__file__).resolve().parent.parent)
 _BIN = "/tmp/ray_tpu_cpp_example"
 
 
+def _poll(cluster, obj_hex, timeout=30.0):
+    """Poll get_object_json until it leaves 'pending' (what the C++
+    client's GetBlocking does on the wire)."""
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        st = cluster.kv().call({"op": "get_object_json", "obj": obj_hex})
+        if st["status"] != "pending":
+            return st
+        time.sleep(0.05)
+    return {"status": "pending"}
+
+
 @pytest.fixture
 def cluster():
     rt = ray_tpu.init(num_cpus=4)
@@ -60,15 +74,7 @@ def test_named_function_python_roundtrip(cluster):
     ray_tpu.register_named_function("mul", lambda a, b: a * b)
     obj = cluster.kv().call({"op": "submit_named_task", "name": "mul",
                              "args": [6, 7]})
-    import time
-
-    deadline = time.time() + 30
-    while time.time() < deadline:
-        st = cluster.kv().call({"op": "get_object_json", "obj": obj})
-        if st["status"] != "pending":
-            break
-        time.sleep(0.05)
-    assert st == {"status": "ready", "value": 42}
+    assert _poll(cluster, obj) == {"status": "ready", "value": 42}
 
     with pytest.raises(Exception, match="no function registered"):
         cluster.kv().call({"op": "submit_named_task", "name": "ghost",
@@ -81,14 +87,7 @@ def test_non_jsonable_result_reports_clearly(cluster):
     ray_tpu.register_named_function("arr", lambda: np.ones(3))
     obj = cluster.kv().call({"op": "submit_named_task", "name": "arr",
                              "args": []})
-    import time
-
-    deadline = time.time() + 30
-    while time.time() < deadline:
-        st = cluster.kv().call({"op": "get_object_json", "obj": obj})
-        if st["status"] != "pending":
-            break
-        time.sleep(0.05)
+    st = _poll(cluster, obj)
     assert st["status"] == "error"
     assert "not JSON-representable" in st["error"]
 
