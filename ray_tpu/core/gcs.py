@@ -1320,6 +1320,17 @@ class ControlServer:
             if entry is None or entry.state == PENDING:
                 self._store_object_locked(
                     oid.hex(), inline=data, size=len(data), is_error=True)
+        if getattr(spec, "is_streaming", False):
+            # Streaming tasks have no pre-registered returns: fail the
+            # end-of-stream object so iterating generators surface the
+            # error instead of waiting forever on the next item.
+            from ray_tpu.core.streaming import stream_eos_id
+
+            eos_hex = stream_eos_id(spec.task_id).hex()
+            entry = self.objects.get(eos_hex)
+            if entry is None or entry.state == PENDING:
+                self._store_object_locked(
+                    eos_hex, inline=data, size=len(data), is_error=True)
 
     # ------------------------------------------------------------------
     # Scheduler (counterpart of ClusterTaskManager::ScheduleAndDispatchTasks)
@@ -1648,6 +1659,32 @@ class ControlServer:
         if runtime_env:
             self.runtime_envs.setdefault(key, dict(runtime_env))
         return key
+
+    def _op_free_stream(self, conn, msg):
+        """Release a dropped ObjectRefGenerator's unconsumed items (and
+        its eos object if the consumer never read it). Only acts on
+        finished streams — a live one still needs its slots."""
+        from ray_tpu.core.serialization import deserialize
+        from ray_tpu.core.streaming import stream_eos_id, stream_item_id
+        from ray_tpu.core.ids import TaskID
+
+        task_id = TaskID.from_hex(msg["task"])
+        eos_hex = stream_eos_id(task_id).hex()
+        with self.lock:
+            eos = self.objects.get(eos_hex)
+            if eos is None or eos.state != READY or eos.inline is None:
+                return  # running, failed, or already cleaned up
+            try:
+                count = int(deserialize(eos.inline))
+            except Exception:
+                return
+            targets = [stream_item_id(task_id, i).hex()
+                       for i in range(int(msg.get("from_index", 0)),
+                                      count)]
+            if not msg.get("eos_consumed", False):
+                targets.append(eos_hex)
+        for obj_hex in targets:
+            self._op_decref(conn, {"obj": obj_hex})
 
     def _op_fetch_object(self, conn, msg):
         """Read an object's payload server-side for thin clients (no shm

@@ -20,6 +20,11 @@ class RemoteFunction:
                  runtime_env: Optional[dict] = None,
                  scheduling_strategy=None,
                  name: str = ""):
+        if not (num_returns == "streaming"
+                or (isinstance(num_returns, int) and num_returns >= 1)):
+            raise ValueError(
+                f"num_returns must be a positive int or 'streaming', "
+                f"got {num_returns!r}")
         self._fn = fn
         self._num_returns = num_returns
         self._num_cpus = 1.0 if num_cpus is None else num_cpus
@@ -75,9 +80,12 @@ class RemoteFunction:
         from ray_tpu.util import tracing
         if tracing.is_tracing_enabled():
             now = time.time()
+            anchor = (refs.task_id.hex()
+                      if self._num_returns == "streaming"
+                      else refs[0].hex())
             tracing.record_span(
                 f"submit:{self._name}", now, now,
-                attributes={"object_ref": refs[0].hex()})
+                attributes={"object_ref": anchor})
         if self._num_returns == 1:
             return refs[0]
         return refs

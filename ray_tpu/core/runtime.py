@@ -358,15 +358,21 @@ class CoreClient:
         return "", -1, scheduling_strategy
 
     def submit_task(self, func_id: str, func_blob: bytes, args: Sequence[Any],
-                    num_returns: int, resources: Dict[str, float],
+                    num_returns, resources: Dict[str, float],
                     max_retries: int, name: str = "",
                     runtime_env: Optional[dict] = None,
-                    scheduling_strategy=None) -> List[ObjectRef]:
+                    scheduling_strategy=None):
+        """Returns a list of ObjectRefs, or an ObjectRefGenerator when
+        num_returns == "streaming" (core/streaming.py)."""
+        from ray_tpu.core.streaming import STREAMING, ObjectRefGenerator
+
+        streaming = num_returns == STREAMING
         borrows: List[str] = []
         task_args = self._prepare_args(args, borrows)
         self.ensure_func(func_id, func_blob)
         runtime_env = self._prepare_runtime_env(runtime_env)
-        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        return_ids = [] if streaming else [
+            ObjectID.from_random() for _ in range(num_returns)]
         pg_hex, bundle_index, scheduling_strategy = self._split_strategy(
             scheduling_strategy)
         spec = TaskSpec(
@@ -374,7 +380,7 @@ class CoreClient:
             func_id=func_id,
             func_blob=None,
             args=task_args,
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
             return_ids=return_ids,
             resources=resources,
             max_retries=max_retries,
@@ -385,8 +391,11 @@ class CoreClient:
             placement_group_hex=pg_hex,
             bundle_index=bundle_index,
             borrows=borrows,
+            is_streaming=streaming,
         )
         self.client.send({"op": "submit_task", "spec": spec})
+        if streaming:
+            return ObjectRefGenerator(spec.task_id)
         return [ObjectRef(oid, owner=self.worker_hex) for oid in return_ids]
 
     # ------------------------------------------------------------------

@@ -42,6 +42,9 @@ class TaskSpec:
     actor_id: Optional[ActorID] = None
     method_name: str = ""
     seq_no: int = -1
+    # streaming-generator task (core/streaming.py): item objects are
+    # derived from the task id instead of pre-registered return_ids
+    is_streaming: bool = False
     # placement
     placement_group_hex: str = ""
     bundle_index: int = -1

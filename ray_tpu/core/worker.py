@@ -195,7 +195,37 @@ class WorkerRuntime:
                 fallback.cause = None
                 self.core._store_value(oid, fallback, is_error=True)
 
+    def _store_streaming_returns(self, spec: TaskSpec, value: Any,
+                                 failed: bool):
+        """Drain a generator task: each yield becomes its own object at
+        a derived id; the end-of-stream object records the item count
+        (core/streaming.py). A mid-stream exception lands in the next
+        item slot so iteration surfaces it on get()."""
+        from ray_tpu.core.streaming import stream_eos_id, stream_item_id
+
+        count = 0
+        if failed:
+            self.core._store_value(
+                stream_item_id(spec.task_id, 0), value, is_error=True)
+            count = 1
+        else:
+            try:
+                for item in value:
+                    self.core._store_value(
+                        stream_item_id(spec.task_id, count), item)
+                    count += 1
+            except BaseException as e:  # noqa: BLE001
+                err = TaskError(spec.name or spec.method_name, e)
+                self.core._store_value(
+                    stream_item_id(spec.task_id, count), err,
+                    is_error=True)
+                count += 1
+        self.core._store_value(stream_eos_id(spec.task_id), count)
+
     def _store_returns(self, spec: TaskSpec, value: Any, failed: bool):
+        if spec.is_streaming:
+            self._store_streaming_returns(spec, value, failed)
+            return
         if failed:
             self._store_error(spec, value)
             return

@@ -91,6 +91,16 @@ try:
 except ray_tpu.TaskError:
     print("[P5] wrong num_returns -> TaskError")
 
+# streaming generator tasks: items flow before the task finishes.
+@ray_tpu.remote(num_returns="streaming")
+def stream(n):
+    for i in range(n):
+        yield i * 10
+
+got = [ray_tpu.get(r) for r in stream.remote(4)]
+assert got == [0, 10, 20, 30], got
+print("[P6] streaming generator ->", got)
+
 t0 = time.time()
 ray_tpu.shutdown()
 print("[9] shutdown in %.2fs" % (time.time() - t0))

@@ -1,0 +1,162 @@
+"""Streaming-generator task tests (reference: num_returns="streaming"
+ObjectRefGenerator, _raylet.pyx streaming-generator execution)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.streaming import ObjectRefGenerator
+
+
+@pytest.fixture(autouse=True)
+def _rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_streaming_basic_order_and_values():
+    @ray_tpu.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    refs = gen.remote(5)
+    assert isinstance(refs, ObjectRefGenerator)
+    values = [ray_tpu.get(r) for r in refs]
+    assert values == [0, 1, 4, 9, 16]
+
+
+def test_streaming_items_arrive_before_task_finishes():
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        for i in range(4):
+            yield i
+            time.sleep(0.4)
+
+    t0 = time.time()
+    it = iter(slow_gen.remote())
+    first = ray_tpu.get(next(it))
+    first_latency = time.time() - t0
+    assert first == 0
+    # The first item must land well before the ~1.6s total runtime.
+    assert first_latency < 1.0, first_latency
+    assert [ray_tpu.get(r) for r in it] == [1, 2, 3]
+
+
+def test_streaming_empty_generator():
+    @ray_tpu.remote(num_returns="streaming")
+    def empty():
+        if False:
+            yield 1
+
+    assert list(empty.remote()) == []
+
+
+def test_streaming_error_mid_stream():
+    @ray_tpu.remote(num_returns="streaming", max_retries=0)
+    def bad():
+        yield 1
+        yield 2
+        raise ValueError("boom at item 2")
+
+    it = iter(bad.remote())
+    assert ray_tpu.get(next(it)) == 1
+    assert ray_tpu.get(next(it)) == 2
+    with pytest.raises(Exception, match="boom"):
+        ray_tpu.get(next(it))
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_streaming_function_raises_before_yield():
+    @ray_tpu.remote(num_returns="streaming", max_retries=0)
+    def broken(x):
+        raise RuntimeError("no stream for you")
+        yield x
+
+    it = iter(broken.remote(1))
+    with pytest.raises(Exception, match="no stream"):
+        ray_tpu.get(next(it))
+
+
+def test_streaming_worker_death_surfaces_error():
+    @ray_tpu.remote(num_returns="streaming", max_retries=0)
+    def dies():
+        yield 1
+        import os
+
+        os._exit(1)
+
+    it = iter(dies.remote())
+    assert ray_tpu.get(next(it)) == 1
+    with pytest.raises(Exception):
+        # Either the next item slot or the EOS object carries the
+        # worker-crash error.
+        for r in it:
+            ray_tpu.get(r)
+
+
+def test_streaming_generator_not_serializable():
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 1
+
+    g = gen.remote()
+    with pytest.raises(TypeError, match="cannot be serialized"):
+        ray_tpu.put(g)
+    list(g)  # drain
+
+
+def test_streaming_refs_usable_as_task_args():
+    @ray_tpu.remote(num_returns="streaming")
+    def produce():
+        for i in range(3):
+            yield i + 10
+
+    @ray_tpu.remote
+    def consume(x):
+        return x * 2
+
+    out = [ray_tpu.get(consume.remote(r)) for r in produce.remote()]
+    assert out == [20, 22, 24]
+
+
+def test_dropped_generator_frees_unconsumed_items():
+    """Partially consuming a finished stream then dropping the generator
+    releases the remaining items server-side (free_stream op)."""
+    import gc
+
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.core.streaming import stream_item_id
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        for i in range(6):
+            yield i
+
+    g = gen.remote()
+    first = next(iter(g))
+    task_id = g.task_id
+    assert ray_tpu.get(first) == 0
+    # Let the task finish so the items all exist.
+    time.sleep(0.5)
+    rt = get_runtime()
+    tail_hex = stream_item_id(task_id, 5).hex()
+    assert any(o["object_id"] == tail_hex
+               for o in rt.state_list("objects"))
+    del g, first
+    gc.collect()
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        alive = {o["object_id"] for o in rt.state_list("objects")}
+        if tail_hex not in alive:
+            break
+        time.sleep(0.05)
+    assert tail_hex not in alive
+
+
+def test_invalid_num_returns_rejected():
+    with pytest.raises(ValueError, match="num_returns"):
+        ray_tpu.remote(num_returns="stream")(lambda: None)
