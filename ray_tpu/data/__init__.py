@@ -19,6 +19,9 @@ from ray_tpu.data.dataset import (
     read_datasource,
     read_json,
     read_numpy,
+    read_text,
+    read_binary_files,
+    from_torch,
     read_parquet,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask
@@ -44,6 +47,9 @@ __all__ = [
     "read_datasource",
     "read_json",
     "read_numpy",
+    "read_text",
+    "read_binary_files",
+    "from_torch",
     "read_parquet",
 ]
 

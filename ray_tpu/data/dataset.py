@@ -571,3 +571,36 @@ def read_numpy(paths, *, column: str = "data",
                parallelism: int = -1) -> Dataset:
     return read_datasource(
         NumpyDatasource(paths, column=column), parallelism=parallelism)
+
+
+def read_text(paths, *, encoding: str = "utf-8",
+              drop_empty_lines: bool = True,
+              parallelism: int = -1) -> Dataset:
+    """One row per line, column "text" (reference read_api.read_text)."""
+    from ray_tpu.data.datasource import TextDatasource
+
+    return read_datasource(
+        TextDatasource(paths, encoding=encoding,
+                       drop_empty_lines=drop_empty_lines),
+        parallelism=parallelism)
+
+
+def read_binary_files(paths, *, include_paths: bool = False,
+                      parallelism: int = -1) -> Dataset:
+    """One row per file, column "bytes" (reference read_binary_files)."""
+    from ray_tpu.data.datasource import BinaryDatasource
+
+    return read_datasource(
+        BinaryDatasource(paths, include_paths=include_paths),
+        parallelism=parallelism)
+
+
+def from_torch(torch_dataset, *, column: str = "item",
+               parallelism: int = -1) -> Dataset:
+    """Map-style torch Dataset → Dataset (reference from_torch); tuple
+    items become col_0/col_1/... columns."""
+    from ray_tpu.data.datasource import TorchDatasource
+
+    return read_datasource(
+        TorchDatasource(torch_dataset, column=column),
+        parallelism=parallelism)

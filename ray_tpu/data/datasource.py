@@ -228,6 +228,101 @@ class NumpyDatasource(FileDatasource):
         yield batch_to_block({self._column: arr})
 
 
+class TextDatasource(FileDatasource):
+    """Line-per-row text files (reference read_api.read_text)."""
+
+    def __init__(self, paths, *, encoding: str = "utf-8",
+                 drop_empty_lines: bool = True):
+        super().__init__(paths)
+        self._encoding = encoding
+        self._drop_empty = drop_empty_lines
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        with open(path, "r", encoding=self._encoding,
+                  errors="replace") as f:
+            lines = [ln.rstrip("\n") for ln in f]
+        if self._drop_empty:
+            lines = [ln for ln in lines if ln]
+        yield batch_to_block({"text": np.asarray(lines, dtype=object)})
+
+
+class BinaryDatasource(FileDatasource):
+    """Whole-file bytes rows (reference read_api.read_binary_files)."""
+
+    def __init__(self, paths, *, include_paths: bool = False):
+        super().__init__(paths)
+        self._include_paths = include_paths
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        with open(path, "rb") as f:
+            data = f.read()
+        batch = {"bytes": np.asarray([data], dtype=object)}
+        if self._include_paths:
+            batch["path"] = np.asarray([path], dtype=object)
+        yield batch_to_block(batch)
+
+
+class TorchDatasource(Datasource):
+    """Map-style torch Dataset → rows (reference from_torch)."""
+
+    def __init__(self, torch_dataset, column: str = "item"):
+        self._ds = torch_dataset
+        self._column = column
+
+    def num_rows(self) -> Optional[int]:
+        try:
+            return len(self._ds)
+        except TypeError:
+            return None
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        try:
+            n = len(self._ds)
+        except TypeError:
+            raise TypeError(
+                "from_torch supports map-style datasets (defining "
+                "__len__/__getitem__); for an IterableDataset, "
+                "materialize it or wrap it in a map-style view") from None
+        parallelism = max(1, min(parallelism, n or 1))
+        bounds = np.linspace(0, n, parallelism + 1).astype(int)
+        tasks = []
+        ds, column = self._ds, self._column
+
+        def make(lo: int, hi: int):
+            def fn() -> Iterator[Block]:
+                items = [_torch_item_to_numpy(ds[i])
+                         for i in range(lo, hi)]
+                if items and isinstance(items[0], dict):
+                    cols = {k: np.asarray([it[k] for it in items])
+                            for k in items[0]}
+                else:
+                    cols = {column: np.asarray(items)}
+                yield batch_to_block(cols)
+
+            return fn
+
+        for i in range(parallelism):
+            lo, hi = int(bounds[i]), int(bounds[i + 1])
+            if hi > lo:
+                tasks.append(ReadTask(make(lo, hi), BlockMetadata(
+                    num_rows=hi - lo, size_bytes=0,
+                    schema_names=None)))
+        return tasks
+
+
+def _torch_item_to_numpy(item):
+    import torch
+
+    if isinstance(item, torch.Tensor):
+        return item.numpy()
+    if isinstance(item, (tuple, list)):
+        return {f"col_{i}": _torch_item_to_numpy(v)
+                for i, v in enumerate(item)}
+    if isinstance(item, dict):
+        return {k: _torch_item_to_numpy(v) for k, v in item.items()}
+    return item
+
+
 # ---------------------------------------------------------------------------
 # Writers (executed as map tasks over blocks)
 # ---------------------------------------------------------------------------

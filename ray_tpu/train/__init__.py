@@ -33,6 +33,7 @@ from ray_tpu.train.train_loop_utils import (
 from ray_tpu.train.trainer import (
     DataParallelTrainer,
     JaxTrainer,
+    TorchTrainer,
     Result,
     TrainingFailedError,
 )
@@ -44,7 +45,7 @@ __all__ = [
     "TrainContext", "report", "get_checkpoint", "get_context",
     "get_dataset_shard",
     "get_mesh", "prepare_pytree", "shard_batch",
-    "DataParallelTrainer", "JaxTrainer", "Result", "TrainingFailedError",
+    "DataParallelTrainer", "JaxTrainer", "TorchTrainer", "Result", "TrainingFailedError",
 ]
 
 # Feature-usage tag (util/usage_stats.py; local-only, no egress).

@@ -1,0 +1,156 @@
+"""Torch backend: torch.distributed process groups over the worker group.
+
+Counterpart of the reference's Train Torch backend
+(python/ray/train/torch/config.py:150 — TCP-store rendezvous from the
+rank-0 address :65, `dist.init_process_group`) and the worker loop
+utilities (torch/train_loop_utils.py:158 prepare_model / :200
+prepare_data_loader). The compute story differs from the reference's
+flagship — on this stack JAX/XLA owns the accelerators — but torch-CPU
+data-parallel training is a real workload (and the image bakes torch),
+so the backend does real gloo DDP, not a stub.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ray_tpu.train.backend import Backend, BackendConfig, _free_port
+
+
+@dataclass
+class TorchConfig(BackendConfig):
+    """backend: torch.distributed backend name ("gloo" on CPU hosts);
+    init_timeout_s: process-group rendezvous timeout."""
+
+    backend: str = "gloo"
+    init_timeout_s: float = 120.0
+
+    @property
+    def backend_cls(self):
+        return TorchBackend
+
+
+def _setup_torch_process_group(master_addr: str, master_port: int,
+                               rank: int, world_size: int, backend: str,
+                               timeout_s: float) -> Dict[str, int]:
+    """Runs ON each train worker (reference torch/config.py:65)."""
+    import datetime
+    import os
+
+    import torch.distributed as dist
+
+    os.environ["MASTER_ADDR"] = master_addr
+    os.environ["MASTER_PORT"] = str(master_port)
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world_size)
+    dist.init_process_group(
+        backend=backend,
+        init_method=f"tcp://{master_addr}:{master_port}",
+        rank=rank, world_size=world_size,
+        timeout=datetime.timedelta(seconds=timeout_s))
+    return {"rank": dist.get_rank(), "world_size": dist.get_world_size()}
+
+
+def _shutdown_torch_process_group() -> bool:
+    import torch.distributed as dist
+
+    if dist.is_initialized():
+        dist.destroy_process_group()
+    return True
+
+
+class TorchBackend(Backend):
+    def on_start(self, worker_group, backend_config: TorchConfig):
+        import ray_tpu
+
+        n = worker_group.num_workers
+        if n <= 1:
+            return  # single worker: no process group needed
+        port = _free_port()
+        refs = [
+            w.run.remote(
+                _setup_torch_process_group, "127.0.0.1", port, i, n,
+                backend_config.backend, backend_config.init_timeout_s)
+            for i, w in enumerate(worker_group.workers)
+        ]
+        infos = ray_tpu.get(refs,
+                            timeout=backend_config.init_timeout_s + 30)
+        for info in infos:
+            if info["world_size"] != n:
+                raise RuntimeError(
+                    f"torch process group world size mismatch: {infos}")
+
+    def on_shutdown(self, worker_group, backend_config: TorchConfig):
+        import ray_tpu
+
+        try:
+            ray_tpu.get(
+                [w.run.remote(_shutdown_torch_process_group)
+                 for w in worker_group.workers], timeout=30)
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Worker-loop utilities (reference torch/train_loop_utils.py)
+# ---------------------------------------------------------------------------
+
+def prepare_model(model):
+    """Wrap the model in DDP when a process group is active
+    (reference prepare_model :158, minus the GPU device moves)."""
+    import torch.distributed as dist
+    from torch.nn.parallel import DistributedDataParallel
+
+    if dist.is_available() and dist.is_initialized() \
+            and dist.get_world_size() > 1:
+        return DistributedDataParallel(model)
+    return model
+
+
+class _EpochedLoader:
+    """DataLoader wrapper that advances the DistributedSampler epoch on
+    every full iteration (the reference's _WrappedDataLoader role) so
+    shuffling reshuffles per epoch instead of repeating one permutation."""
+
+    def __init__(self, loader, sampler):
+        self._loader = loader
+        self._sampler = sampler
+        self._epoch = 0
+
+    def __iter__(self):
+        self._sampler.set_epoch(self._epoch)
+        self._epoch += 1
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __getattr__(self, name):
+        return getattr(self._loader, name)
+
+
+def prepare_data_loader(data_loader):
+    """Re-create the loader with a DistributedSampler so each rank sees
+    its shard (reference prepare_data_loader :200). Preserves the
+    loader's shuffle setting and reshuffles per epoch."""
+    import torch.distributed as dist
+    from torch.utils.data import (
+        DataLoader,
+        DistributedSampler,
+        RandomSampler,
+    )
+
+    if not (dist.is_available() and dist.is_initialized()
+            and dist.get_world_size() > 1):
+        return data_loader
+    shuffled = isinstance(data_loader.sampler, RandomSampler)
+    sampler = DistributedSampler(data_loader.dataset, shuffle=shuffled)
+    loader = DataLoader(
+        data_loader.dataset,
+        batch_size=data_loader.batch_size,
+        sampler=sampler,
+        num_workers=0,
+        collate_fn=data_loader.collate_fn,
+        drop_last=data_loader.drop_last)
+    return _EpochedLoader(loader, sampler)

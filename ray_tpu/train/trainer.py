@@ -197,3 +197,17 @@ class JaxTrainer(DataParallelTrainer):
         super().__init__(
             train_loop_per_worker,
             backend_config=backend_config or JaxBackendConfig(), **kw)
+
+
+class TorchTrainer(DataParallelTrainer):
+    """DataParallelTrainer with the torch.distributed (gloo) backend
+    (reference python/ray/train/torch/torch_trainer.py). Worker loops
+    use train.torch_backend.prepare_model / prepare_data_loader."""
+
+    def __init__(self, train_loop_per_worker, *, backend_config=None,
+                 **kw):
+        from ray_tpu.train.torch_backend import TorchConfig
+
+        super().__init__(
+            train_loop_per_worker,
+            backend_config=backend_config or TorchConfig(), **kw)

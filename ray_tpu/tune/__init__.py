@@ -14,6 +14,8 @@ from ray_tpu.tune.schedulers import (
 )
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
+    ConcurrencyLimiter,
+    TPESearcher,
     SearchAlgorithm,
     choice,
     grid_search,
@@ -53,7 +55,7 @@ __all__ = [
     "randn",
     "sample_from",
     "SearchAlgorithm",
-    "BasicVariantGenerator",
+    "BasicVariantGenerator", "TPESearcher", "ConcurrencyLimiter",
     "TrialScheduler",
     "FIFOScheduler",
     "AsyncHyperBandScheduler",

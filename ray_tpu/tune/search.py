@@ -175,7 +175,8 @@ class SearchAlgorithm:
         raise NotImplementedError
 
     def on_trial_complete(self, trial_id: str, result: Optional[Dict],
-                          error: bool = False):
+                          error: bool = False,
+                          config: Optional[Dict[str, Any]] = None):
         pass
 
 
@@ -235,3 +236,201 @@ class BasicVariantGenerator(SearchAlgorithm):
                 assignment = ()
             out.append(self._one(assignment))
         return out
+
+
+class TPESearcher(SearchAlgorithm):
+    """Tree-structured Parzen Estimator search (Bergstra et al. 2011),
+    pure numpy — the capability the reference gets from external
+    libraries (tune/search/hyperopt, optuna's default sampler) without
+    their dependencies.
+
+    Per dimension, completed trials split into good (top ``gamma``
+    quantile by objective) and bad; candidates sampled from the
+    good-points KDE are scored by the density ratio l(x)/g(x) and the
+    best candidate wins. Random sampling until ``n_initial`` results.
+    Supported domains: Uniform, LogUniform, QUniform, RandInt,
+    LogRandInt, RandN, Choice (categorical counts); grid_search and
+    sample_from fall back to BasicVariant behavior per draw.
+    """
+
+    def __init__(self, n_initial: int = 10, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None):
+        self.n_initial = n_initial
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = np.random.default_rng(seed)
+        self._space: Dict[str, Any] = {}
+        self._metric: Optional[str] = None
+        self._mode = "max"
+        self._dims: List = []        # (path, Domain)
+        self._observations: List = []  # (config, score)
+        self._fallback = BasicVariantGenerator(seed=seed)
+
+    def set_space(self, space, metric, mode):
+        self._space = space or {}
+        self._metric = metric
+        self._mode = mode
+        self._fallback.set_space(space, metric, mode)
+        self._dims = [
+            (path, leaf) for path, leaf in _walk(self._space)
+            if isinstance(leaf, Domain) and not isinstance(leaf, SampleFrom)
+        ]
+
+    def on_trial_complete(self, trial_id, result, error=False, config=None):
+        if error or not result or config is None or not self._metric:
+            return
+        score = result.get(self._metric)
+        if score is None:
+            return
+        score = float(score)
+        if self._mode == "min":
+            score = -score
+        self._observations.append((config, score))
+
+    # -- per-dimension sampling -------------------------------------------
+    @staticmethod
+    def _get_path(cfg: Dict, path):
+        cur = cfg
+        for k in path:
+            if not isinstance(cur, dict) or k not in cur:
+                return None
+            cur = cur[k]
+        return cur
+
+    def _to_unit(self, leaf, v) -> Optional[float]:
+        """Map a domain value onto a continuous line for KDE."""
+        try:
+            if isinstance(leaf, (LogUniform, LogRandInt)):
+                return float(np.log(float(v)))
+            return float(v)
+        except (TypeError, ValueError):
+            return None
+
+    def _from_line(self, leaf, x: float):
+        if isinstance(leaf, LogUniform):
+            return float(np.clip(np.exp(x), leaf.low, leaf.high))
+        if isinstance(leaf, LogRandInt):
+            return int(np.clip(round(np.exp(x)), leaf.low, leaf.high - 1))
+        if isinstance(leaf, Uniform):
+            return float(np.clip(x, leaf.low, leaf.high))
+        if isinstance(leaf, QUniform):
+            q = leaf.q
+            return float(np.clip(round(x / q) * q, leaf.low, leaf.high))
+        if isinstance(leaf, RandInt):
+            return int(np.clip(round(x), leaf.low, leaf.high - 1))
+        if isinstance(leaf, RandN):
+            return float(x)
+        return x
+
+    @staticmethod
+    def _kde_logpdf(points: np.ndarray, bw: float, xs: np.ndarray
+                    ) -> np.ndarray:
+        d = (xs[:, None] - points[None, :]) / bw
+        # log-mean-exp of Gaussian kernels
+        k = -0.5 * d * d - 0.5 * np.log(2 * np.pi) - np.log(bw)
+        m = k.max(axis=1, keepdims=True)
+        return (m[:, 0] + np.log(np.mean(np.exp(k - m), axis=1)))
+
+    def _suggest_numeric(self, leaf, good: List[float], bad: List[float]):
+        g = np.asarray(good, dtype=np.float64)
+        b = np.asarray(bad, dtype=np.float64) if bad else g
+        spread = max(g.std(), 1e-3) if len(g) > 1 else 1.0
+        bw = max(spread * len(g) ** -0.2, 1e-3)
+        cands = g[self._rng.integers(0, len(g), self.n_candidates)] + \
+            self._rng.normal(0, bw, self.n_candidates)
+        score = self._kde_logpdf(g, bw, cands) - \
+            self._kde_logpdf(b, bw, cands)
+        return float(cands[int(np.argmax(score))])
+
+    def _suggest_choice(self, leaf, good_vals: List, bad_vals: List):
+        values = list(leaf.values)
+        idx = {self._key(v): i for i, v in enumerate(values)}
+        g_counts = np.ones(len(values))
+        b_counts = np.ones(len(values))
+        for v in good_vals:
+            i = idx.get(self._key(v))
+            if i is not None:
+                g_counts[i] += 1
+        for v in bad_vals:
+            i = idx.get(self._key(v))
+            if i is not None:
+                b_counts[i] += 1
+        ratio = (g_counts / g_counts.sum()) / (b_counts / b_counts.sum())
+        # Sample ∝ ratio (not argmax): concurrent suggestions stay
+        # diverse and unlucky-early categories keep getting retried.
+        p = ratio / ratio.sum()
+        return values[int(self._rng.choice(len(values), p=p))]
+
+    @staticmethod
+    def _key(v):
+        try:
+            hash(v)
+            return v
+        except TypeError:
+            return repr(v)
+
+    def next_configs(self, n: int) -> List[Dict[str, Any]]:
+        out = []
+        for _ in range(n):
+            if len(self._observations) < self.n_initial or not self._dims:
+                out.extend(self._fallback.next_configs(1))
+                continue
+            ranked = sorted(self._observations, key=lambda cs: -cs[1])
+            n_good = max(1, int(len(ranked) * self.gamma))
+            good, bad = ranked[:n_good], ranked[n_good:]
+            cfg = self._fallback.next_configs(1)[0]  # base (grids etc.)
+            for path, leaf in self._dims:
+                g_vals = [self._get_path(c, path) for c, _ in good]
+                b_vals = [self._get_path(c, path) for c, _ in bad]
+                g_vals = [v for v in g_vals if v is not None]
+                b_vals = [v for v in b_vals if v is not None]
+                if not g_vals:
+                    continue
+                if isinstance(leaf, Choice):
+                    v = self._suggest_choice(leaf, g_vals, b_vals)
+                else:
+                    g_line = [self._to_unit(leaf, v) for v in g_vals]
+                    b_line = [self._to_unit(leaf, v) for v in b_vals]
+                    g_line = [v for v in g_line if v is not None]
+                    b_line = [v for v in b_line if v is not None]
+                    if not g_line:
+                        continue
+                    v = self._from_line(
+                        leaf, self._suggest_numeric(leaf, g_line, b_line))
+                _set_path(cfg, path, v)
+            # Re-resolve sample_from leaves AGAINST the final values —
+            # the fallback computed them from its own (now overwritten)
+            # random draws.
+            for path, leaf in _walk(self._space):
+                if isinstance(leaf, SampleFrom):
+                    _set_path(cfg, path, leaf.fn(cfg))
+            out.append(cfg)
+        return out
+
+
+class ConcurrencyLimiter(SearchAlgorithm):
+    """Caps in-flight suggestions from a wrapped searcher (reference
+    tune/search/concurrency_limiter.py) — important for adaptive
+    searchers, which degrade toward random when too many configs are
+    suggested before any results return."""
+
+    def __init__(self, searcher: SearchAlgorithm, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max(1, max_concurrent)
+        self._inflight = 0
+
+    def set_space(self, space, metric, mode):
+        self.searcher.set_space(space, metric, mode)
+
+    def next_configs(self, n: int) -> List[Dict[str, Any]]:
+        allowed = min(n, self.max_concurrent - self._inflight)
+        if allowed <= 0:
+            return []
+        configs = self.searcher.next_configs(allowed)
+        self._inflight += len(configs)
+        return configs
+
+    def on_trial_complete(self, trial_id, result, error=False, config=None):
+        self._inflight = max(0, self._inflight - 1)
+        self.searcher.on_trial_complete(trial_id, result, error=error,
+                                        config=config)

@@ -74,17 +74,40 @@ class TuneController:
         self._resources = resources_per_trial or {"num_cpus": 1.0}
         os.makedirs(run_dir, exist_ok=True)
 
-        configs = search_alg.next_configs(num_samples)
-        self.trials: List[Trial] = [
-            Trial(trial_id=f"trial_{i:05d}", config=cfg,
-                  trial_dir=os.path.join(run_dir, f"trial_{i:05d}"))
-            for i, cfg in enumerate(configs)
-        ]
+        # Trials are created LAZILY so adaptive searchers (TPE) see
+        # completed results before suggesting the next configs — the
+        # reference's suggest-on-demand loop rather than drawing the
+        # whole experiment up front.
+        self._num_samples = num_samples
+        self.trials: List[Trial] = []
+
+    def _maybe_create_trials(self):
+        active = sum(1 for t in self.trials
+                     if t.state in (PENDING, RUNNING))
+        want = min(self._num_samples - len(self.trials),
+                   self._max_concurrent - active)
+        if want <= 0:
+            return
+        configs = self._search.next_configs(want)
+        if not configs and active == 0:
+            # Searcher is dry with nothing in flight: the experiment is
+            # as large as it will get (prevents a livelock on exhausted
+            # finite searchers).
+            self._num_samples = len(self.trials)
+            return
+        for cfg in configs:
+            i = len(self.trials)
+            self.trials.append(Trial(
+                trial_id=f"trial_{i:05d}", config=cfg,
+                trial_dir=os.path.join(self._run_dir, f"trial_{i:05d}")))
 
     # ------------------------------------------------------------------
     def run(self) -> List[Trial]:
         try:
-            while any(t.state in (PENDING, RUNNING) for t in self.trials):
+            while (len(self.trials) < self._num_samples
+                   or any(t.state in (PENDING, RUNNING)
+                          for t in self.trials)):
+                self._maybe_create_trials()
                 self._start_pending()
                 self._poll_running()
                 self._save_experiment_state()
@@ -194,7 +217,8 @@ class TuneController:
                 pass
         self._shutdown_runner(t)
         t.state = TERMINATED
-        self._search.on_trial_complete(t.trial_id, t.last_result)
+        self._search.on_trial_complete(t.trial_id, t.last_result,
+                                       config=t.config)
         self._scheduler.on_trial_complete(t, t.last_result)
 
     def _on_trial_error(self, t: Trial, tb: str):
@@ -206,7 +230,8 @@ class TuneController:
             return
         t.error = tb
         t.state = ERROR
-        self._search.on_trial_complete(t.trial_id, None, error=True)
+        self._search.on_trial_complete(t.trial_id, None, error=True,
+                                       config=t.config)
 
     def _exploit(self, t: Trial):
         """PBT: restart this trial from the donor's checkpoint with the

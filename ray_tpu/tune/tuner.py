@@ -128,10 +128,14 @@ class Tuner:
         scheduler = tc.scheduler or FIFOScheduler()
 
         num_samples = tc.num_samples
-        if isinstance(search, BasicVariantGenerator):
+        # Unwrap ConcurrencyLimiter-style wrappers for grid accounting.
+        grid_owner = search
+        while hasattr(grid_owner, "searcher"):
+            grid_owner = grid_owner.searcher
+        if hasattr(grid_owner, "grid_size"):
             # grid axes multiply the sample count (reference semantics:
             # num_samples repeats of the full grid).
-            num_samples = tc.num_samples * search.grid_size()
+            num_samples = tc.num_samples * grid_owner.grid_size()
 
         run_dir = os.path.join(
             self.run_config.storage_path or

@@ -79,5 +79,26 @@ iters = sorted(r.metrics.get("training_iteration", 0) for r in grid)
 assert iters[0] < 15 and iters[-1] == 15, iters
 print("[4] ASHA early-stopped weak trials:", iters)
 
+# [5] TPE adaptive search finds the bowl minimum.
+from ray_tpu.tune import TPESearcher
+
+
+def bowl(config):
+    tune.report({"loss": (config["x"] - 0.3) ** 2
+                 + (config["y"] + 0.2) ** 2})
+
+
+tpe_res = tune.Tuner(
+    bowl,
+    param_space={"x": tune.uniform(-1, 1), "y": tune.uniform(-1, 1)},
+    tune_config=tune.TuneConfig(
+        metric="loss", mode="min", num_samples=24,
+        search_alg=TPESearcher(n_initial=8, seed=0),
+        max_concurrent_trials=2),
+).fit()
+best = tpe_res.get_best_result(metric="loss", mode="min").metrics["loss"]
+assert best < 0.1, best
+print(f"[5] TPE best loss: {best:.4f}")
+
 ray_tpu.shutdown()
 print("TUNE DRIVE OK")

@@ -1,0 +1,66 @@
+"""read_text / read_binary_files / from_torch datasource tests
+(SURVEY.md §2.3 L1 read_api breadth)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+import ray_tpu.data as rd
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _rt():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def test_read_text(tmp_path):
+    (tmp_path / "a.txt").write_text("hello\nworld\n\n")
+    (tmp_path / "b.txt").write_text("third line\n")
+    ds = rd.read_text([str(tmp_path / "a.txt"), str(tmp_path / "b.txt")])
+    rows = sorted(r["text"] for r in ds.take_all())
+    assert rows == ["hello", "third line", "world"]
+
+
+def test_read_text_keep_empty(tmp_path):
+    (tmp_path / "c.txt").write_text("x\n\ny\n")
+    ds = rd.read_text(str(tmp_path / "c.txt"), drop_empty_lines=False)
+    assert ds.count() == 3
+
+
+def test_read_binary_files(tmp_path):
+    (tmp_path / "one.bin").write_bytes(b"\x00\x01\x02")
+    (tmp_path / "two.bin").write_bytes(b"payload")
+    ds = rd.read_binary_files(
+        [str(tmp_path / "one.bin"), str(tmp_path / "two.bin")],
+        include_paths=True)
+    rows = {r["path"].rsplit("/", 1)[-1]: r["bytes"]
+            for r in ds.take_all()}
+    assert rows["one.bin"] == b"\x00\x01\x02"
+    assert rows["two.bin"] == b"payload"
+
+
+def test_from_torch_tensor_dataset():
+    import torch
+    from torch.utils.data import TensorDataset
+
+    xs = torch.arange(12).reshape(6, 2).float()
+    ys = torch.arange(6)
+    ds = rd.from_torch(TensorDataset(xs, ys), parallelism=3)
+    assert ds.count() == 6
+    batch = ds.take_batch(6)
+    # Tuple items become col_0/col_1.
+    np.testing.assert_allclose(
+        np.sort(np.asarray(batch["col_1"])), np.arange(6))
+    assert np.asarray(batch["col_0"]).shape == (6, 2)
+
+
+def test_from_torch_feeds_map_pipeline():
+    import torch
+    from torch.utils.data import TensorDataset
+
+    ds = rd.from_torch(TensorDataset(torch.arange(10).float()))
+    total = sum(r["col_0"] for r in
+                ds.map(lambda r: {"col_0": r["col_0"] * 2}).take_all())
+    assert total == 2 * sum(range(10))

@@ -1,0 +1,119 @@
+"""TorchTrainer tests: gloo process group over the worker group, DDP
+model wrap, distributed sampler sharding (SURVEY.md §2.3 L2 Torch
+backend counterpart)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import ScalingConfig, TorchTrainer
+from ray_tpu.train import session as train_session
+
+
+@pytest.fixture(autouse=True)
+def _rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_torch_trainer_ddp_two_workers():
+    """2 workers: DDP gradient averaging makes both ranks' models
+    identical after training on DIFFERENT data shards; losses converge
+    on a linear-regression toy."""
+
+    def loop(config):
+        import torch
+        import torch.distributed as dist
+        import torch.nn as nn
+
+        from ray_tpu.train.torch_backend import prepare_model
+
+        ctx = train_session.get_context()
+        rank, world = ctx.get_world_rank(), ctx.get_world_size()
+        assert dist.is_initialized() and dist.get_world_size() == world
+
+        torch.manual_seed(0)  # same init on both ranks
+        model = prepare_model(nn.Linear(4, 1))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+
+        g = torch.Generator().manual_seed(100 + rank)  # distinct shards
+        X = torch.randn(64, 4, generator=g)
+        w_true = torch.tensor([[1.0, -2.0, 3.0, 0.5]]).T
+        y = X @ w_true
+
+        loss_val = None
+        for _ in range(60):
+            opt.zero_grad()
+            loss = ((model(X) - y) ** 2).mean()
+            loss.backward()  # DDP averages grads across ranks here
+            opt.step()
+            loss_val = float(loss)
+
+        w = model.module.weight.detach().numpy().copy() \
+            if hasattr(model, "module") else \
+            model.weight.detach().numpy().copy()
+        # History records rank 0's reports (reference semantics), so
+        # gather every rank's weights before reporting.
+        gathered = [None] * world
+        dist.all_gather_object(gathered, w.tolist())
+        train_session.report({"loss": loss_val, "rank": rank,
+                              "all_weights": gathered})
+
+    result = TorchTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+    ).fit()
+    assert result.metrics["loss"] < 0.05, result.metrics
+    w0, w1 = result.metrics["all_weights"]
+    # DDP keeps replicas in sync: both ranks end with identical weights.
+    np.testing.assert_allclose(w0, w1, rtol=1e-6)
+    # And near the true weights.
+    np.testing.assert_allclose(
+        np.asarray(w0).ravel(), [1.0, -2.0, 3.0, 0.5], atol=0.2)
+
+
+def test_prepare_data_loader_shards_per_rank():
+    def loop(config):
+        import torch
+        from torch.utils.data import DataLoader, TensorDataset
+
+        from ray_tpu.train.torch_backend import prepare_data_loader
+
+        import torch.distributed as dist
+
+        ctx = train_session.get_context()
+        ds = TensorDataset(torch.arange(20).float())
+        loader = prepare_data_loader(DataLoader(ds, batch_size=5))
+        seen = sorted(int(x) for batch in loader for x in batch[0])
+        gathered = [None] * ctx.get_world_size()
+        dist.all_gather_object(gathered, seen)
+        train_session.report({"per_rank": gathered})
+
+    result = TorchTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2,
+                                     resources_per_worker={"CPU": 1}),
+    ).fit()
+    r0, r1 = result.metrics["per_rank"]
+    # Each rank sees half the dataset; together they cover everything.
+    assert len(r0) == 10 and len(r1) == 10
+    assert sorted(r0 + r1) == list(range(20))
+
+
+def test_torch_trainer_single_worker_no_group():
+    def loop(config):
+        import torch.distributed as dist
+
+        from ray_tpu.train.torch_backend import prepare_model
+        import torch.nn as nn
+
+        assert not dist.is_initialized()
+        model = prepare_model(nn.Linear(2, 1))
+        assert not hasattr(model, "module")  # no DDP wrap solo
+        train_session.report({"ok": 1})
+
+    result = TorchTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=1)).fit()
+    assert result.metrics["ok"] == 1

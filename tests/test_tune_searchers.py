@@ -1,0 +1,125 @@
+"""Adaptive search tests: TPE, ConcurrencyLimiter, lazy trial creation
+(SURVEY.md §2.3 L3 search algorithms)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    TPESearcher,
+)
+
+
+@pytest.fixture(autouse=True)
+def _rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _make_objective():
+    # Defined via closure so cloudpickle ships it by value (a module-level
+    # test function would pickle by reference and fail in workers).
+    def objective(config):
+        import numpy as np
+
+        from ray_tpu import tune
+
+        # Smooth bowl: optimum at x=0.3, y=-0.2, lr=1e-2.
+        x, y = config["x"], config["y"]
+        lr_err = (np.log10(config["lr"]) + 2.0) ** 2
+        loss = (x - 0.3) ** 2 + (y + 0.2) ** 2 + 0.1 * lr_err
+        tune.report({"loss": float(loss)})
+
+    return objective
+
+
+_SPACE = {
+    "x": tune.uniform(-1.0, 1.0),
+    "y": tune.uniform(-1.0, 1.0),
+    "lr": tune.loguniform(1e-4, 1e0),
+}
+
+
+def _best_loss(searcher, num_samples=28, seed=0):
+    tuner = tune.Tuner(
+        _make_objective(),
+        param_space=dict(_SPACE),
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=num_samples,
+            search_alg=searcher, max_concurrent_trials=2, seed=seed),
+    )
+    results = tuner.fit()
+    return results.get_best_result(metric="loss", mode="min").metrics[
+        "loss"]
+
+
+def test_tpe_unit_suggestions_move_toward_good_region():
+    """Pure-searcher unit check: feed synthetic results; suggestions
+    concentrate near the observed optimum."""
+    s = TPESearcher(n_initial=8, seed=0)
+    s.set_space(dict(_SPACE), "loss", "min")
+    rng = np.random.default_rng(0)
+    for i in range(30):
+        cfg = {"x": float(rng.uniform(-1, 1)),
+               "y": float(rng.uniform(-1, 1)),
+               "lr": float(10 ** rng.uniform(-4, 0))}
+        loss = (cfg["x"] - 0.3) ** 2 + (cfg["y"] + 0.2) ** 2
+        s.on_trial_complete(f"t{i}", {"loss": loss}, config=cfg)
+    xs = [c["x"] for c in s.next_configs(20)]
+    ys = [c["y"] for c in s.next_configs(20)]
+    # Suggestions cluster around the optimum, far tighter than the
+    # uniform prior (std 0.58 over [-1, 1]).
+    assert abs(np.mean(xs) - 0.3) < 0.35, np.mean(xs)
+    assert abs(np.mean(ys) + 0.2) < 0.35, np.mean(ys)
+
+
+def test_tpe_finds_lower_loss_than_its_random_phase():
+    best = _best_loss(TPESearcher(n_initial=8, seed=1), num_samples=28)
+    assert best < 0.08, best
+
+
+def test_lazy_trial_creation_feeds_searcher_results():
+    """Adaptive searchers must see earlier results before later
+    suggestions — verified by recording observation counts at suggest
+    time."""
+
+    class Recorder(BasicVariantGenerator):
+        def __init__(self):
+            super().__init__(seed=0)
+            self.completed = 0
+            self.seen_at_suggest = []
+
+        def next_configs(self, n):
+            self.seen_at_suggest.extend([self.completed] * n)
+            return super().next_configs(n)
+
+        def on_trial_complete(self, trial_id, result, error=False,
+                              config=None):
+            self.completed += 1
+
+    rec = Recorder()
+    tune.Tuner(
+        _make_objective(),
+        param_space=dict(_SPACE),
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=8,
+            search_alg=rec, max_concurrent_trials=2),
+    ).fit()
+    assert len(rec.seen_at_suggest) == 8
+    # The tail of the experiment was suggested AFTER results landed.
+    assert rec.seen_at_suggest[-1] >= 4, rec.seen_at_suggest
+
+
+def test_concurrency_limiter_caps_inflight():
+    inner = BasicVariantGenerator(seed=0)
+    lim = ConcurrencyLimiter(inner, max_concurrent=2)
+    lim.set_space(dict(_SPACE), "loss", "min")
+    first = lim.next_configs(5)
+    assert len(first) == 2  # capped
+    assert lim.next_configs(1) == []  # saturated
+    lim.on_trial_complete("a", {"loss": 1.0}, config=first[0])
+    assert len(lim.next_configs(5)) == 1  # one slot released
