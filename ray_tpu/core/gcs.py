@@ -605,6 +605,11 @@ class ControlServer:
         except (ProcessLookupError, PermissionError):
             pass
 
+    def _op_subscribe_objects(self, conn, msg):
+        """Batched subscribe (one message for a whole get())."""
+        for obj_hex in msg["objs"]:
+            self._op_subscribe_object(conn, {"obj": obj_hex})
+
     def _op_subscribe_object(self, conn, msg):
         obj_hex = msg["obj"]
         with self.lock:
@@ -803,6 +808,15 @@ class ControlServer:
 
     def _op_task_done(self, conn, msg):
         with self.lock:
+            # Batched result puts ride the done message (worker.py
+            # _finish); store them BEFORE completing the task so
+            # subscribers resolve before any retry bookkeeping.
+            for put in msg.get("puts", ()):
+                self._store_object_locked(
+                    put["obj"], inline=put.get("inline"),
+                    size=put["size"],
+                    is_error=put.get("is_error", False),
+                    in_shm=put.get("in_shm", False))
             rec = self.tasks.get(msg["task_id"])
             worker_hex = conn.meta.get("worker_hex")
             w = self.workers.get(worker_hex) if worker_hex else None
@@ -813,6 +827,10 @@ class ControlServer:
                 w.state = "idle"
                 w.current_task = None
                 self._release(w)
+        for obj_hex in msg.get("decrefs", ()):
+            self._op_decref(conn, {"obj": obj_hex})
+        if any(p.get("in_shm") for p in msg.get("puts", ())):
+            self._maybe_spill()
         self._wake.set()
 
     # ------------------------------------------------------------------
@@ -1571,10 +1589,39 @@ class ControlServer:
                 if charge not in avail_virtual:
                     avail_virtual[charge] = self._charge_avail(charge)
                 return avail_virtual[charge]
-            for spec in self.pending_tasks:
+            # A pass can place at most len(idle) tasks plus whatever new
+            # workers could still spawn; once that budget is spent, the
+            # rest of the queue cannot make progress THIS pass — bulk-
+            # defer it instead of rescanning (keeps each wake O(capacity)
+            # rather than O(pending), which made big async batches
+            # quadratic: every task_done re-scanned the whole queue).
+            spawn_headroom = sum(
+                max(0, self.config.max_workers_per_node
+                    - node_workers.get(nid, 0))
+                for nid, node in self.nodes.items() if node.alive)
+            budget = len(idle) + spawn_headroom
+            progress = 0
+            # Per-pass infeasibility memo: once a (resources, placement)
+            # shape fails to place, identical later requests are skipped
+            # in O(1). A saturated homogeneous queue (the common case:
+            # thousands of same-shaped tasks) costs one real placement
+            # attempt per pass instead of one per task — this is what
+            # keeps big async batches from going quadratic.
+            infeasible: set = set()
+
+            def _shape_key(s):
+                return (tuple(sorted(s.resources.items())),
+                        s.placement_group_hex, s.bundle_index,
+                        repr(s.scheduling_strategy))
+
+            for qi, spec in enumerate(self.pending_tasks):
                 if not self._deps_ready(spec):
                     still_pending.append(spec)
                     continue
+                # The unschedulable fast-fail must run for EVERY ready
+                # spec, even when the pass's placement budget is spent —
+                # a removed-PG/dead-node task that merely stays pending
+                # on a saturated cluster would deadlock its waiters.
                 why = self._unschedulable_reason(spec)
                 if why is not None:
                     rec = self.tasks.get(spec.task_id.hex())
@@ -1583,9 +1630,14 @@ class ControlServer:
                     self._fail_task_returns_with(
                         spec, why, kind="unschedulable")
                     continue
+                shape = _shape_key(spec)
+                if progress >= budget or shape in infeasible:
+                    still_pending.append(spec)
+                    continue
                 need = ResourceSet(spec.resources)
                 pick = self._pick_node(need, spec, avail_of=virt_get)
                 if pick is None:
+                    infeasible.add(shape)
                     still_pending.append(spec)
                     continue
                 node_id, charge = pick
@@ -1600,12 +1652,14 @@ class ControlServer:
                         key = (node_id, env_key)
                         if starting.get(key, 0) > 0:
                             starting[key] -= 1  # one already on the way
+                            progress += 1  # a worker really is incoming
                         elif (node_workers.get(node_id, 0)
                                 < self.config.max_workers_per_node):
                             self._spawn_worker(env_key=env_key, kind="pool",
                                                node_id=node_id)
                             node_workers[node_id] = node_workers.get(
                                 node_id, 0) + 1
+                            progress += 1
                     still_pending.append(spec)
                     continue
                 del idle[worker.worker_hex]
@@ -1623,6 +1677,7 @@ class ControlServer:
                     rec.worker_hex = worker.worker_hex
                     rec.started_at = time.time()
                 dispatches.append((worker, spec))
+                progress += 1
             self.pending_tasks = still_pending
 
             for spec, need, node_id, charge in to_spawn:

@@ -94,6 +94,10 @@ class CoreClient:
             self.session_id, reply["shm_dir"])
 
         self._lock = threading.Lock()
+        # Thread-local put buffering: a worker executing a task batches
+        # its result put_object messages into the task_done message (one
+        # control round instead of N+1) — see worker.py _execute.
+        self._tls = threading.local()
         self._object_futures: Dict[str, Future] = {}
         self._subscribed: set[str] = set()
         # actor state tracking
@@ -134,15 +138,26 @@ class CoreClient:
     # ------------------------------------------------------------------
     # Objects
     def object_future(self, obj_hex: str) -> Future:
+        return self.object_futures([obj_hex])[0]
+
+    def object_futures(self, obj_hexes: Sequence[str]) -> List[Future]:
+        """Batch variant: ONE subscribe message for all new hexes (a
+        get() of N refs used to cost N control messages)."""
+        futs: List[Future] = []
+        new: List[str] = []
         with self._lock:
-            fut = self._object_futures.get(obj_hex)
-            if fut is None:
-                fut = Future()
-                self._object_futures[obj_hex] = fut
-            if obj_hex not in self._subscribed:
-                self._subscribed.add(obj_hex)
-                self.client.send({"op": "subscribe_object", "obj": obj_hex})
-        return fut
+            for obj_hex in obj_hexes:
+                fut = self._object_futures.get(obj_hex)
+                if fut is None:
+                    fut = Future()
+                    self._object_futures[obj_hex] = fut
+                futs.append(fut)
+                if obj_hex not in self._subscribed:
+                    self._subscribed.add(obj_hex)
+                    new.append(obj_hex)
+            if new:
+                self.client.send({"op": "subscribe_objects", "objs": new})
+        return futs
 
     def _load_object(self, obj_hex: str, info: dict,
                      timeout: Optional[float] = None,
@@ -210,7 +225,7 @@ class CoreClient:
             pass
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float] = None):
-        futs = [self.object_future(r.hex()) for r in refs]
+        futs = self.object_futures([r.hex() for r in refs])
         deadline = None if timeout is None else time.monotonic() + timeout
         results = []
         for r, fut in zip(refs, futs):
@@ -249,7 +264,7 @@ class CoreClient:
         else:
             inline_ok = size <= self.config.max_inline_object_size
         if inline_ok:
-            self.client.send({
+            self._send_or_buffer({
                 "op": "put_object", "obj": oid.hex(), "size": size,
                 "inline": ser.to_bytes(), "is_error": is_error,
             })
@@ -257,14 +272,30 @@ class CoreClient:
             seg = self.store.create(oid, size)
             ser.write_into(seg.buf[:size])
             self.store.seal(oid)
-            self.client.send({
+            self._send_or_buffer({
                 "op": "put_object", "obj": oid.hex(), "size": size,
                 "inline": None, "in_shm": True, "is_error": is_error,
             })
 
+    def _send_or_buffer(self, msg: dict):
+        buf = getattr(self._tls, "put_buffer", None)
+        if buf is not None:
+            buf.append(msg)
+        else:
+            self.client.send(msg)
+
+    def begin_put_batch(self):
+        self._tls.put_buffer = []
+
+    def take_put_batch(self) -> List[dict]:
+        buf = getattr(self._tls, "put_buffer", None) or []
+        self._tls.put_buffer = None
+        return buf
+
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None):
-        futs = {r: self.object_future(r.hex()) for r in refs}
+        futs = dict(zip(refs, self.object_futures(
+            [r.hex() for r in refs])))
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
         import concurrent.futures as cf

@@ -250,16 +250,25 @@ class WorkerRuntime:
             except BaseException as e:  # noqa: BLE001 serialization failure
                 self._store_error(spec, TaskError(spec.name, e))
 
-    def _finish(self, spec: TaskSpec, failed: bool):
-        for obj_hex in spec.borrows:
-            self.core.client.send({"op": "decref", "obj": obj_hex})
+    def _finish(self, spec: TaskSpec, failed: bool,
+                puts: Optional[List[dict]] = None):
         if spec.actor_id is None:
+            # One combined control message: result puts + borrow decrefs
+            # + completion (was 1 put per return + 1 decref per borrow +
+            # 1 done = the control plane's hottest path).
             self.core.client.send({
                 "op": "task_done", "task_id": spec.task_id.hex(),
-                "failed": failed})
+                "failed": failed, "puts": puts or [],
+                "decrefs": list(spec.borrows)})
+        else:
+            for obj_hex in spec.borrows:
+                self.core.client.send({"op": "decref", "obj": obj_hex})
 
     def _execute(self, spec: TaskSpec, target_fn=None):
         failed = False
+        # Pool (non-actor, non-streaming) tasks batch their result puts
+        # into the task_done message; streaming items must flow live.
+        batch_puts = spec.actor_id is None and not spec.is_streaming
         try:
             args = self._resolve_args(spec)
             # kwargs are shipped as a trailing dict arg marked by name
@@ -272,15 +281,20 @@ class WorkerRuntime:
             failed = True
             value = TaskError(spec.name or spec.method_name, e)
             traceback.print_exc()
+        puts: Optional[List[dict]] = None
         try:
+            if batch_puts:
+                self.core.begin_put_batch()
             self._store_returns(spec, value, failed)
         except BaseException:  # noqa: BLE001
             failed = True
             traceback.print_exc()
         finally:
-            # Always release resources/borrows, even if storing returns blew
-            # up — a wedged-busy worker starves the whole pool.
-            self._finish(spec, failed)
+            if batch_puts:
+                puts = self.core.take_put_batch()
+            # Always release resources/borrows, even if storing returns
+            # blew up — a wedged-busy worker starves the whole pool.
+            self._finish(spec, failed, puts)
         return failed
 
     def _on_execute_task(self, spec: TaskSpec):

@@ -126,10 +126,20 @@ def test_pending_task_fails_when_pg_removed(cluster):
     def blocked():
         return 1
 
-    # occupy the bundle so the second task stays pending
+    @ray_tpu.remote(num_cpus=2, scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg))
+    def hold_bundle():
+        import time as _t
+
+        _t.sleep(3.0)
+        return 1
+
+    # Occupy the bundle LONG ENOUGH that `waiting` is still pending when
+    # the group is removed (a fast task can finish before the removal
+    # lands, letting `waiting` legally run).
     r1 = blocked.remote()
     ray_tpu.get(r1, timeout=20)
-    hold = blocked.remote()  # may run; then a third waits
+    hold = hold_bundle.remote()
     waiting = blocked.remote()
     remove_placement_group(pg)
     with pytest.raises((ray_tpu.TaskUnschedulableError, ray_tpu.RayTpuError)):
