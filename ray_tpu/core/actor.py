@@ -99,6 +99,10 @@ class ActorClass:
         self._num_tpus = num_tpus or 0.0
         self._resources = dict(resources or {})
         self._max_restarts = max_restarts
+        # max_concurrency is the SYNC-method thread count. Async methods
+        # always overlap: the worker schedules coroutines on the actor's
+        # event loop without parking a thread per call (worker.py
+        # _execute_async_actor_task), so async actors need no bump here.
         self._max_concurrency = max_concurrency
         self._name = name
         self._namespace = namespace

@@ -1614,7 +1614,7 @@ class ControlServer:
                         s.placement_group_hex, s.bundle_index,
                         repr(s.scheduling_strategy))
 
-            for qi, spec in enumerate(self.pending_tasks):
+            for spec in self.pending_tasks:
                 if not self._deps_ready(spec):
                     still_pending.append(spec)
                     continue

@@ -15,6 +15,7 @@ scheduling queues (transport/actor_scheduling_queue.cc).  Each worker runs:
 
 from __future__ import annotations
 
+import inspect
 import os
 import queue
 import sys
@@ -52,6 +53,7 @@ class WorkerRuntime:
         self._actor_hex: str = ""
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
         self._exec_pool: Optional[Any] = None
+        self._aio_lock = threading.Lock()
         self.is_initialized = True
         set_runtime(self)
         # Apply this pool's runtime env (working_dir/py_modules/env_vars/
@@ -277,6 +279,16 @@ class WorkerRuntime:
                 kwargs = args.pop().kwargs
             fn = target_fn if target_fn is not None else self._resolve_fn(spec)
             value = fn(*args, **kwargs)
+            if inspect.iscoroutine(value):
+                # Async actor method (reference: asyncio actors run via
+                # fibers, transport/fiber.h): await it on the actor's
+                # event loop. Each exec thread blocks on ITS call while
+                # the loop overlaps awaits across threads, so
+                # max_concurrency requests make progress concurrently.
+                import asyncio
+
+                value = asyncio.run_coroutine_threadsafe(
+                    value, self._actor_event_loop()).result()
         except BaseException as e:  # noqa: BLE001
             failed = True
             value = TaskError(spec.name or spec.method_name, e)
@@ -365,7 +377,68 @@ class WorkerRuntime:
                     spec, TaskError(method_name, e), failed=True)
                 self._finish(spec, failed=True)
                 continue
-            self._execute(spec, target_fn=method)
+            if inspect.iscoroutinefunction(method):
+                # Async method: schedule on the actor's event loop and
+                # complete from a done-callback — the queue thread moves
+                # on immediately, so awaits overlap without one parked
+                # OS thread per in-flight call (reference: asyncio
+                # actors on fibers). Sync methods stay governed by
+                # max_concurrency threads.
+                self._execute_async_actor_task(spec, method)
+            else:
+                self._execute(spec, target_fn=method)
+
+    def _execute_async_actor_task(self, spec: TaskSpec, method):
+        import asyncio
+
+        try:
+            args = self._resolve_args(spec)
+            kwargs = {}
+            if args and isinstance(args[-1], KwargsMarker):
+                kwargs = args.pop().kwargs
+            coro = method(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            traceback.print_exc()
+            self._store_returns(
+                spec, TaskError(spec.method_name, e), failed=True)
+            self._finish(spec, failed=True)
+            return
+        fut = asyncio.run_coroutine_threadsafe(
+            coro, self._actor_event_loop())
+
+        def _done(f):
+            failed = False
+            try:
+                value = f.result()
+            except BaseException as e:  # noqa: BLE001
+                failed = True
+                value = TaskError(spec.method_name, e)
+                traceback.print_exc()
+            try:
+                self._store_returns(spec, value, failed)
+            except BaseException:  # noqa: BLE001
+                failed = True
+                traceback.print_exc()
+            finally:
+                self._finish(spec, failed)
+
+        fut.add_done_callback(_done)
+
+    def _actor_event_loop(self):
+        """Lazily start this actor's asyncio loop thread."""
+        loop = getattr(self, "_aio_loop", None)
+        if loop is None:
+            import asyncio
+
+            with self._aio_lock:
+                loop = getattr(self, "_aio_loop", None)
+                if loop is None:
+                    loop = asyncio.new_event_loop()
+                    threading.Thread(target=loop.run_forever,
+                                     name="actor-asyncio",
+                                     daemon=True).start()
+                    self._aio_loop = loop
+        return loop
 
     # -- lifecycle ------------------------------------------------------
     def _on_exit(self):

@@ -91,6 +91,20 @@ try:
 except ray_tpu.TaskError:
     print("[P5] wrong num_returns -> TaskError")
 
+# async actors: awaits overlap (auto concurrency for coroutine methods).
+class AsyncSleeper:
+    async def nap(self, t):
+        import asyncio
+        await asyncio.sleep(t)
+        return t
+
+_s = ray_tpu.remote(AsyncSleeper).remote()
+ray_tpu.get(_s.nap.remote(0.01))
+_t0 = time.time()
+assert ray_tpu.get([_s.nap.remote(0.3) for _ in range(8)]) == [0.3] * 8
+assert time.time() - _t0 < 1.5, "async awaits did not overlap"
+print("[P7] async actor overlapped 8x0.3s naps in %.2fs" % (time.time() - _t0))
+
 # streaming generator tasks: items flow before the task finishes.
 @ray_tpu.remote(num_returns="streaming")
 def stream(n):
