@@ -16,10 +16,14 @@ import sys
 import time
 
 
-# Peak bf16 FLOP/s per chip by device kind (public TPU specs).
+# Peak bf16 FLOP/s per chip by device kind (public TPU specs). Longest
+# key wins, so "v5lite"/"v5e" match before the bare "v5" (v5p): PJRT
+# reports v5e as "TPU v5 lite", which must NOT take the 459 TF/s v5p
+# peak (it under-reported MFU 2.3x).
 _PEAK_FLOPS = {
     "v4": 275e12,
     "v5e": 197e12,
+    "v5lite": 197e12,
     "v5": 459e12,    # v5p
     "v5p": 459e12,
     "v6e": 918e12,
@@ -50,9 +54,13 @@ def main():
     on_tpu = devices[0].platform == "tpu"
 
     if on_tpu:
+        # Measured on v5e: remat_policy="dots" (save matmul outputs,
+        # recompute elementwise) beats full remat at this size, and b8
+        # fits comfortably; b16 OOMs under "dots".
         config = tfm.TransformerConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=6144,
             num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=1024,
+            remat_policy="dots",
         )
         batch, seq, steps = 8, 1024, 20
     else:  # CPU smoke mode — same code path, tiny shapes

@@ -50,6 +50,7 @@ class WorkerRuntime:
         self.core.on_exit = self._on_exit
         self._func_cache: dict[str, Any] = {}
         self._actor_instance: Any = None
+        self._actor_is_async = False
         self._actor_hex: str = ""
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
         self._exec_pool: Optional[Any] = None
@@ -168,6 +169,15 @@ class WorkerRuntime:
             self._func_cache[func_id] = fn
         return fn
 
+    def _resolve_call(self, spec: TaskSpec):
+        """(args, kwargs) for a task spec — the shared preamble of every
+        execution path (kwargs ride as a trailing marker arg)."""
+        args = self._resolve_args(spec)
+        kwargs = {}
+        if args and isinstance(args[-1], KwargsMarker):
+            kwargs = args.pop().kwargs
+        return args, kwargs
+
     def _resolve_args(self, spec: TaskSpec) -> List[Any]:
         args = []
         for a in spec.args:
@@ -272,11 +282,7 @@ class WorkerRuntime:
         # into the task_done message; streaming items must flow live.
         batch_puts = spec.actor_id is None and not spec.is_streaming
         try:
-            args = self._resolve_args(spec)
-            # kwargs are shipped as a trailing dict arg marked by name
-            kwargs = {}
-            if args and isinstance(args[-1], KwargsMarker):
-                kwargs = args.pop().kwargs
+            args, kwargs = self._resolve_call(spec)
             fn = target_fn if target_fn is not None else self._resolve_fn(spec)
             value = fn(*args, **kwargs)
             if inspect.iscoroutine(value):
@@ -330,12 +336,16 @@ class WorkerRuntime:
                 task_id=None, func_id="", func_blob=None, args=spec.args,
                 num_returns=0, return_ids=[], resources={},
                 borrows=[])
-            args = self._resolve_args(fake_task)
-            kwargs = {}
-            if args and isinstance(args[-1], KwargsMarker):
-                kwargs = args.pop().kwargs
+            args, kwargs = self._resolve_call(fake_task)
             self._actor_instance = cls(*args, **kwargs)
             self._actor_hex = spec.actor_id.hex()
+            # Async actors serialize ALL method bodies on one event loop
+            # (see _actor_loop); detected once here.
+            self._actor_is_async = any(
+                inspect.iscoroutinefunction(m)
+                for _, m in inspect.getmembers(
+                    type(self._actor_instance),
+                    predicate=inspect.isfunction))
             n = max(1, spec.max_concurrency)
             for _ in range(n):
                 threading.Thread(target=self._actor_loop, name="actor-exec",
@@ -377,13 +387,13 @@ class WorkerRuntime:
                     spec, TaskError(method_name, e), failed=True)
                 self._finish(spec, failed=True)
                 continue
-            if inspect.iscoroutinefunction(method):
-                # Async method: schedule on the actor's event loop and
-                # complete from a done-callback — the queue thread moves
-                # on immediately, so awaits overlap without one parked
-                # OS thread per in-flight call (reference: asyncio
-                # actors on fibers). Sync methods stay governed by
-                # max_concurrency threads.
+            if self._actor_is_async:
+                # Async actor: EVERY method body runs on the actor's
+                # event loop (sync ones wrapped in a trivial coroutine),
+                # so no two bodies ever run in parallel — the reference's
+                # asyncio-actor serialization — while awaits overlap.
+                # The queue thread moves on immediately; no parked OS
+                # thread per in-flight call.
                 self._execute_async_actor_task(spec, method)
             else:
                 self._execute(spec, target_fn=method)
@@ -392,11 +402,16 @@ class WorkerRuntime:
         import asyncio
 
         try:
-            args = self._resolve_args(spec)
-            kwargs = {}
-            if args and isinstance(args[-1], KwargsMarker):
-                kwargs = args.pop().kwargs
-            coro = method(*args, **kwargs)
+            args, kwargs = self._resolve_call(spec)
+            if inspect.iscoroutinefunction(method):
+                coro = method(*args, **kwargs)
+            else:
+                # Sync method of an async actor: run its body ON the
+                # loop so it serializes with async bodies.
+                async def _sync_body():
+                    return method(*args, **kwargs)
+
+                coro = _sync_body()
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
             self._store_returns(
@@ -406,7 +421,7 @@ class WorkerRuntime:
         fut = asyncio.run_coroutine_threadsafe(
             coro, self._actor_event_loop())
 
-        def _done(f):
+        def _store(f):
             failed = False
             try:
                 value = f.result()
@@ -422,7 +437,11 @@ class WorkerRuntime:
             finally:
                 self._finish(spec, failed)
 
-        fut.add_done_callback(_done)
+        # Completion (serialization + shm write + control sends) runs on
+        # a dedicated thread, NOT the loop thread — a multi-MB result
+        # must not stall every other in-flight await on this actor.
+        fut.add_done_callback(
+            lambda f: self._async_completions().submit(_store, f))
 
     def _actor_event_loop(self):
         """Lazily start this actor's asyncio loop thread."""
@@ -439,6 +458,21 @@ class WorkerRuntime:
                                      daemon=True).start()
                     self._aio_loop = loop
         return loop
+
+    def _async_completions(self):
+        """Single-thread executor storing async task results in
+        completion order (off the loop thread)."""
+        pool = getattr(self, "_aio_done_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with self._aio_lock:
+                pool = getattr(self, "_aio_done_pool", None)
+                if pool is None:
+                    pool = ThreadPoolExecutor(
+                        max_workers=1, thread_name_prefix="actor-aio-done")
+                    self._aio_done_pool = pool
+        return pool
 
     # -- lifecycle ------------------------------------------------------
     def _on_exit(self):

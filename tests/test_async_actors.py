@@ -76,6 +76,40 @@ def test_sync_methods_stay_serial_on_async_actor():
     assert out == list(range(1, 31))  # no lost increments
 
 
+def test_sync_and_async_bodies_never_overlap():
+    """Reference asyncio-actor semantics: sync AND async method bodies
+    all run on the event loop, so interleaved increments from both kinds
+    lose nothing."""
+
+    class Both:
+        def __init__(self):
+            self.n = 0
+
+        def bump_sync(self):
+            v = self.n
+            import time as _t
+
+            _t.sleep(0.001)
+            self.n = v + 1
+            return self.n
+
+        async def bump_async(self):
+            v = self.n
+            import asyncio
+
+            self.n = v + 1
+            await asyncio.sleep(0)
+            return v + 1  # this call's own increment (pre-await)
+
+    b = ray_tpu.remote(Both).remote()
+    refs = []
+    for i in range(20):
+        refs.append(b.bump_sync.remote() if i % 2 == 0
+                    else b.bump_async.remote())
+    vals = ray_tpu.get(refs)
+    assert sorted(vals) == list(range(1, 21)), vals
+
+
 def test_async_actor_exception_propagates():
     class Bad:
         async def boom(self):
