@@ -77,6 +77,13 @@ class Config:
     rpc_connect_timeout_s: float = 10.0
     rpc_max_message_bytes: int = 512 * 1024 * 1024
 
+    # -- control-plane persistence (reference: GCS StoreClient / Redis) --
+    # Path for the control server's KV journal; '' = in-memory only.
+    # With a path set, the cluster KV (user KV, runtime-env packages,
+    # named-function registrations AND their blobs) survives a head
+    # restart.
+    gcs_store_path: str = ""
+
     # -- logging --------------------------------------------------------
     log_dir: str = ""
 

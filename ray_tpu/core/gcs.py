@@ -199,7 +199,12 @@ class ControlServer:
         self.workers: Dict[str, WorkerInfo] = {}
         self.actors: Dict[str, ActorEntry] = {}
         self.named_actors: Dict[tuple, str] = {}
-        self.kv: Dict[str, bytes] = {}
+        # Pluggable KV storage (reference gcs/store_client/, N6):
+        # in-memory by default; a configured path journals to disk so
+        # the KV survives head restarts.
+        from ray_tpu.core.store_client import make_store_client
+
+        self.kv = make_store_client(config.gcs_store_path)
         self.funcs: Dict[str, bytes] = {}
         # In-flight actor-task return objects: actor hex -> pending obj
         # hexes, and the reverse map. Used to fail callers' gets when an
@@ -285,6 +290,12 @@ class ControlServer:
             except OSError:
                 pass
         self.server.stop()
+        # Close the KV journal only after the server stops accepting ops
+        # (an in-flight kv_put must not hit a closed file).
+        try:
+            self.kv.close()
+        except Exception:
+            pass
         self.store.cleanup()
 
     # ------------------------------------------------------------------
@@ -690,10 +701,19 @@ class ControlServer:
     def _op_put_func(self, conn, msg):
         with self.lock:
             self.funcs.setdefault(msg["func_id"], msg["blob"])
+            # Persistent-KV mode also journals the blob so named
+            # functions remain invokable after a head restart.
+            if self.config.gcs_store_path:
+                key = f"__fn_blob__/{msg['func_id']}"
+                if key not in self.kv:
+                    self.kv[key] = msg["blob"]
 
     def _op_get_func(self, conn, msg):
         with self.lock:
-            return self.funcs.get(msg["func_id"])
+            blob = self.funcs.get(msg["func_id"])
+            if blob is None:
+                blob = self.kv.get(f"__fn_blob__/{msg['func_id']}")
+            return blob
 
     # ------------------------------------------------------------------
     # KV store (reference: gcs_kv_manager / experimental/internal_kv.py)
