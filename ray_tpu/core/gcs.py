@@ -717,9 +717,18 @@ class ControlServer:
 
     # ------------------------------------------------------------------
     # KV store (reference: gcs_kv_manager / experimental/internal_kv.py)
+    # Internal-only namespace: persisted function BLOBS are executed as
+    # code on workers, so user-facing KV ops must not be able to write
+    # or delete them (a kv_put there would be code injection across a
+    # head restart).
+    _KV_RESERVED = "__fn_blob__/"
+
     def _op_kv_put(self, conn, msg):
+        key = msg["key"]
+        if key.startswith(self._KV_RESERVED):
+            raise ValueError(f"key prefix {self._KV_RESERVED!r} is "
+                             "reserved for the control plane")
         with self.lock:
-            key = msg["key"]
             if msg.get("overwrite", True) or key not in self.kv:
                 self.kv[key] = msg["value"]
                 return True
@@ -730,13 +739,17 @@ class ControlServer:
             return self.kv.get(msg["key"])
 
     def _op_kv_del(self, conn, msg):
+        if msg["key"].startswith(self._KV_RESERVED):
+            raise ValueError(f"key prefix {self._KV_RESERVED!r} is "
+                             "reserved for the control plane")
         with self.lock:
             return self.kv.pop(msg["key"], None) is not None
 
     def _op_kv_keys(self, conn, msg):
         prefix = msg.get("prefix", "")
         with self.lock:
-            return [k for k in self.kv if k.startswith(prefix)]
+            return [k for k in self.kv if k.startswith(prefix)
+                    and not k.startswith(self._KV_RESERVED)]
 
     def _op_kv_exists(self, conn, msg):
         with self.lock:

@@ -86,7 +86,10 @@ class FileBackedStoreClient(MutableMapping):
                 except Exception:
                     break
                 valid_end = f.tell()
-                if value == _DELETE:
+                # Type-check before comparing: arbitrary values (numpy
+                # arrays) don't support bool(==); and only the exact
+                # sentinel tuple is a delete.
+                if isinstance(value, tuple) and value == _DELETE:
                     self._d.pop(key, None)
                 else:
                     self._d[key] = value
@@ -126,6 +129,9 @@ class FileBackedStoreClient(MutableMapping):
         return self._d[k]
 
     def __setitem__(self, k, v):
+        if isinstance(v, tuple) and v == _DELETE:
+            raise ValueError(
+                "value collides with the journal's delete sentinel")
         self._d[k] = v
         self._append(k, v)
 

@@ -5,6 +5,7 @@ from ray_tpu.rl.algorithms.bc import (
     MARWILConfig,
     MARWILLearner,
 )
+from ray_tpu.rl.algorithms.cql import CQL, CQLConfig, CQLLearner
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, DQNLearner
 from ray_tpu.rl.algorithms.impala import (
     APPO,
@@ -20,6 +21,7 @@ from ray_tpu.rl.algorithms.sac import SAC, SACConfig, SACLearner
 __all__ = [
     "APPO", "APPOConfig", "APPOLearner",
     "BC", "BCConfig",
+    "CQL", "CQLConfig", "CQLLearner",
     "DQN", "DQNConfig", "DQNLearner",
     "IMPALA", "IMPALAConfig", "IMPALALearner",
     "MARWIL", "MARWILConfig", "MARWILLearner",

@@ -87,19 +87,27 @@ class MARWILLearner(JaxLearner):
         }
 
 
+def load_offline_episodes(config, algo_name: str
+                          ) -> List[SingleAgentEpisode]:
+    """Shared offline-input resolution for MARWIL/BC/CQL: in-memory
+    episodes win, else a pickle path, else a clear error."""
+    episodes = config.input_episodes
+    if episodes is None and config.input_path:
+        with open(config.input_path, "rb") as f:
+            episodes = pickle.load(f)
+    if not episodes:
+        raise ValueError(
+            f"{algo_name} is offline: config.offline_data("
+            "input_episodes=...) or input_path=... is required")
+    return episodes
+
+
 class MARWIL(Algorithm):
     config_class = MARWILConfig
     learner_class = MARWILLearner
 
     def _setup_from_config(self, config: "MARWILConfig") -> None:
-        episodes = config.input_episodes
-        if episodes is None and config.input_path:
-            with open(config.input_path, "rb") as f:
-                episodes = pickle.load(f)
-        if not episodes:
-            raise ValueError(
-                "MARWIL/BC needs offline data: config.offline_data("
-                "input_episodes=...) or input_path=...")
+        episodes = load_offline_episodes(config, "MARWIL/BC")
         self._dataset = self._episodes_to_rows(episodes, config.gamma)
         self._np_rng = np.random.default_rng(config.seed)
         super()._setup_from_config(config)

@@ -1,0 +1,119 @@
+"""CQL: Conservative Q-Learning for offline RL (Kumar et al. 2020).
+
+Counterpart of the reference's rllib/algorithms/cql/ (cql.py — SAC plus
+a conservative regularizer trained purely from offline data). The
+penalty pushes DOWN Q on out-of-distribution actions (logsumexp over
+sampled actions) and UP on dataset actions, so the learned policy stays
+within the data's support. Same single-jitted-update discipline as SAC;
+the offline episodes are unrolled once into the replay buffer and every
+step samples fixed-shape batches from it.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl import module as rl_module
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig, SACLearner
+from ray_tpu.rl.episode import SingleAgentEpisode
+from ray_tpu.rl.learner_group import LearnerGroup
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = CQL
+        # conservative penalty weight (reference cql.py min_q_weight)
+        self.cql_alpha: float = 1.0
+        self.num_action_samples: int = 8
+        self.num_sgd_iter: int = 32     # SGD steps per training_step
+        # offline_data()
+        self.input_episodes: Optional[List[SingleAgentEpisode]] = None
+        self.input_path: Optional[str] = None
+
+    def offline_data(self, *, input_episodes=None, input_path=None
+                     ) -> "CQLConfig":
+        if input_episodes is not None:
+            self.input_episodes = input_episodes
+        if input_path is not None:
+            self.input_path = input_path
+        return self
+
+
+class CQLLearner(SACLearner):
+    def __init__(self, spec, *, cql_alpha: float = 1.0,
+                 num_action_samples: int = 8, **kwargs):
+        super().__init__(spec, **kwargs)
+        self.cql_alpha = cql_alpha
+        self.num_action_samples = num_action_samples
+
+    def loss(self, params, batch: Dict[str, jnp.ndarray], rng):
+        spec: rl_module.SACModuleSpec = self.spec
+        base, aux = super().loss(params, batch, rng)
+
+        # Conservative penalty: logsumexp over random + policy actions
+        # minus the dataset actions' Q, for each critic.
+        B = batch["obs"].shape[0]
+        N = self.num_action_samples
+        k_rand, k_pol = jax.random.split(jax.random.fold_in(rng, 1))
+        low, high = spec._bounds()
+        rand_a = jax.random.uniform(
+            k_rand, (N, B, spec.action_dim),
+            minval=low, maxval=high)
+        pol_keys = jax.random.split(k_pol, N)
+        pol_a = jax.lax.stop_gradient(jax.vmap(
+            lambda k: spec.sample_action(
+                params["actor"], batch["obs"], k)[0])(pol_keys))
+        all_a = jnp.concatenate([rand_a, pol_a])            # [2N, B, A]
+
+        def penalty(q_params):
+            q_samp = jax.vmap(
+                lambda a: spec.q_value(q_params, batch["obs"], a))(all_a)
+            lse = jax.scipy.special.logsumexp(q_samp, axis=0)  # [B]
+            q_data = spec.q_value(q_params, batch["obs"],
+                                  batch["actions"])
+            return jnp.mean(lse - q_data)
+
+        cql_term = penalty(params["q1"]) + penalty(params["q2"])
+        total = base + self.cql_alpha * cql_term
+        aux = dict(aux)
+        aux["cql_penalty"] = cql_term
+        return total, aux
+
+
+class CQL(SAC):
+    config_class = CQLConfig
+    learner_class = CQLLearner
+
+    def _setup_from_config(self, config: "CQLConfig") -> None:
+        from ray_tpu.rl.algorithms.bc import load_offline_episodes
+
+        episodes = load_offline_episodes(config, "CQL")
+        super()._setup_from_config(config)
+        # Unroll the offline data once; training never touches the env
+        # (it exists for the module spec and evaluate()).
+        self.replay.add_episodes(list(episodes))
+
+    def _build_learner_group(self, config: "CQLConfig") -> LearnerGroup:
+        return LearnerGroup(
+            self.learner_class,
+            dict(spec=self._spec, gamma=config.gamma, tau=config.tau,
+                 target_entropy=self._target_entropy,
+                 cql_alpha=config.cql_alpha,
+                 num_action_samples=config.num_action_samples,
+                 learning_rate=config.lr, grad_clip=config.grad_clip,
+                 seed=config.seed, mesh_axes=config.mesh_axes),
+            num_learners=config.num_learners)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: CQLConfig = self.config
+        metrics: Dict[str, Any] = {"replay_buffer_size": len(self.replay)}
+        for _ in range(cfg.num_sgd_iter):
+            batch = self.replay.sample(cfg.train_batch_size)
+            metrics.update(self.learner_group.update_from_batch(batch))
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return metrics

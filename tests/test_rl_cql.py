@@ -1,0 +1,88 @@
+"""CQL (offline RL) tests — SURVEY.md §2.3 L5 algorithm family."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rl.episode import SingleAgentEpisode
+
+
+@pytest.fixture(autouse=True)
+def _rt():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def _bandit_episodes(n_episodes=24, T=16, seed=0):
+    """1-step-ish continuous bandit on Pendulum's spaces: reward
+    -(a - 0.5)^2, dataset actions confined to [0.2, 0.8]."""
+    rng = np.random.default_rng(seed)
+    episodes = []
+    for _ in range(n_episodes):
+        ep = SingleAgentEpisode()
+        obs = rng.normal(size=(T + 1, 3)).astype(np.float32)
+        ep.add_reset(obs[0])
+        for t in range(T):
+            a = float(rng.uniform(0.2, 0.8))
+            ep.add_step(obs[t + 1], np.array([a], dtype=np.float32),
+                        -(a - 0.5) ** 2, terminated=t == T - 1)
+        episodes.append(ep)
+    return episodes
+
+
+def test_cql_requires_offline_data():
+    from ray_tpu.rl.algorithms import CQLConfig
+
+    with pytest.raises(ValueError, match="offline"):
+        CQLConfig().environment("Pendulum-v1").build()
+
+
+def test_cql_trains_and_suppresses_ood_q():
+    from ray_tpu.rl.algorithms import CQLConfig
+
+    config = (CQLConfig()
+              .environment("Pendulum-v1")
+              .offline_data(input_episodes=_bandit_episodes())
+              .training(train_batch_size=64, lr=3e-4, gamma=0.0,
+                        hidden_sizes=(32, 32), num_sgd_iter=40,
+                        cql_alpha=2.0)
+              .debugging(seed=0))
+    algo = config.build()
+    result = {}
+    for _ in range(8):
+        result = algo.step()
+    assert "cql_penalty" in result and np.isfinite(result["cql_penalty"])
+
+    # Q(dataset-support action) must beat Q(out-of-distribution action).
+    import jax.numpy as jnp
+
+    params = algo.learner_group.get_weights()
+    spec = algo._spec
+    obs = jnp.asarray(np.random.default_rng(1).normal(
+        size=(64, 3)).astype(np.float32))
+    q_in = np.asarray(spec.q_value(
+        params["q1"], obs, jnp.full((64, 1), 0.5)))
+    q_ood = np.asarray(spec.q_value(
+        params["q1"], obs, jnp.full((64, 1), -1.9)))
+    algo.stop()
+    assert q_in.mean() > q_ood.mean() + 0.1, (q_in.mean(), q_ood.mean())
+
+
+def test_cql_never_samples_env():
+    from ray_tpu.rl.algorithms import CQLConfig
+
+    config = (CQLConfig()
+              .environment("Pendulum-v1")
+              .offline_data(input_episodes=_bandit_episodes(4, 8))
+              .training(train_batch_size=32, num_sgd_iter=2,
+                        hidden_sizes=(16,))
+              .debugging(seed=0))
+    algo = config.build()
+    before = algo.env_runner_group.local_runner.metrics[
+        "num_env_steps_sampled_lifetime"]
+    algo.step()
+    after = algo.env_runner_group.local_runner.metrics[
+        "num_env_steps_sampled_lifetime"]
+    algo.stop()
+    assert before == after == 0
