@@ -10,6 +10,7 @@ from ray_tpu.core.api import (
     available_resources,
     cancel,
     register_named_function,
+    get_runtime_context,
     cluster_resources,
     get,
     get_actor,
@@ -46,6 +47,7 @@ __all__ = [
     "kill",
     "cancel",
     "register_named_function",
+    "get_runtime_context",
     "get_actor",
     "cluster_resources",
     "available_resources",

@@ -117,6 +117,14 @@ def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
         list(refs), num_returns=num_returns, timeout=timeout)
 
 
+def get_runtime_context():
+    """Identity/context of the current process (reference:
+    ray.get_runtime_context(), python/ray/runtime_context.py)."""
+    from ray_tpu.core.runtime_context import get_runtime_context as _grc
+
+    return _grc()
+
+
 def register_named_function(name: str, fn) -> str:
     """Register a Python function for cross-language invocation (the
     reference's FunctionDescriptor story): C++ clients submit it by name

@@ -15,6 +15,7 @@ scheduling queues (transport/actor_scheduling_queue.cc).  Each worker runs:
 
 from __future__ import annotations
 
+import contextvars
 import inspect
 import os
 import queue
@@ -31,6 +32,12 @@ from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu.core.runtime import CoreClient, set_runtime
 from ray_tpu.core.task_spec import ActorCreationSpec, KwargsMarker, TaskSpec
+
+# Current task for async actor method bodies: coroutines interleave on
+# ONE loop thread, so thread-locals can't carry identity — contextvars
+# follow each asyncio task (runtime_context.py reads this).
+_current_spec_ctx: contextvars.ContextVar = contextvars.ContextVar(
+    "ray_tpu_current_task_spec", default=None)
 
 
 class WorkerRuntime:
@@ -55,6 +62,8 @@ class WorkerRuntime:
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
         self._exec_pool: Optional[Any] = None
         self._aio_lock = threading.Lock()
+        # Per-thread currently-executing spec (runtime_context.py).
+        self._cur_tls = threading.local()
         self.is_initialized = True
         set_runtime(self)
         # Apply this pool's runtime env (working_dir/py_modules/env_vars/
@@ -278,6 +287,7 @@ class WorkerRuntime:
 
     def _execute(self, spec: TaskSpec, target_fn=None):
         failed = False
+        self._cur_tls.spec = spec
         # Pool (non-actor, non-streaming) tasks batch their result puts
         # into the task_done message; streaming items must flow live.
         batch_puts = spec.actor_id is None and not spec.is_streaming
@@ -310,10 +320,18 @@ class WorkerRuntime:
         finally:
             if batch_puts:
                 puts = self.core.take_put_batch()
+            self._cur_tls.spec = None
             # Always release resources/borrows, even if storing returns
             # blew up — a wedged-busy worker starves the whole pool.
             self._finish(spec, failed, puts)
         return failed
+
+    @property
+    def _current_task_spec(self):
+        ctx_spec = _current_spec_ctx.get()
+        if ctx_spec is not None:
+            return ctx_spec
+        return getattr(self._cur_tls, "spec", None)
 
     def _on_execute_task(self, spec: TaskSpec):
         # pool tasks: one at a time, run on a dedicated thread so the rpc
@@ -403,15 +421,16 @@ class WorkerRuntime:
 
         try:
             args, kwargs = self._resolve_call(spec)
-            if inspect.iscoroutinefunction(method):
-                coro = method(*args, **kwargs)
-            else:
+
+            async def _body():
+                _current_spec_ctx.set(spec)
+                if inspect.iscoroutinefunction(method):
+                    return await method(*args, **kwargs)
                 # Sync method of an async actor: run its body ON the
                 # loop so it serializes with async bodies.
-                async def _sync_body():
-                    return method(*args, **kwargs)
+                return method(*args, **kwargs)
 
-                coro = _sync_body()
+            coro = _body()
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
             self._store_returns(

@@ -11,7 +11,6 @@ step samples fixed-shape batches from it.
 
 from __future__ import annotations
 
-import pickle
 from typing import Any, Dict, List, Optional
 
 import jax

@@ -11,9 +11,13 @@ from ray_tpu.util.scheduling_strategies import (
     PlacementGroupSchedulingStrategy,
 )
 from ray_tpu.util import client, metrics, timeline, tracing, usage_stats
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Queue
 from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 __all__ = [
+    "ActorPool",
+    "Queue",
     "metrics",
     "timeline",
     "tracing",

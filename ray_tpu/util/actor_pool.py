@@ -1,0 +1,110 @@
+"""ActorPool: round-robin work distribution over a fixed set of actors.
+
+Counterpart of python/ray/util/actor_pool.py — the same submit/get_next/
+map/map_unordered surface: a small scheduling convenience over actor
+handles, keeping each actor busy with at most one in-flight task from
+the pool.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, Iterator, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        if not actors:
+            raise ValueError("ActorPool needs at least one actor")
+        self._idle: List[Any] = list(actors)
+        # ref -> (actor, submission index)
+        self._inflight: dict = {}
+        self._index = 0
+        self._next_return = 0
+        self._done: dict = {}      # index -> result (ordered get_next)
+        self._consumed: set = set()  # indices taken by unordered gets
+
+    def has_free(self) -> bool:
+        return bool(self._idle)
+
+    def has_next(self) -> bool:
+        return bool(self._inflight) or bool(self._done)
+
+    def submit(self, fn: Callable[[Any, Any], Any], value: Any) -> None:
+        """fn(actor, value) -> ObjectRef; blocks until an actor frees."""
+        while not self._idle:
+            self._wait_one(None)
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._inflight[ref] = (actor, self._index)
+        self._index += 1
+
+    def _wait_one(self, deadline) -> None:
+        remaining = None if deadline is None \
+            else max(deadline - time.monotonic(), 0.0)
+        ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                timeout=remaining)
+        if not ready:
+            raise TimeoutError("ActorPool result wait timed out")
+        for ref in ready:
+            actor, idx = self._inflight.pop(ref)
+            self._idle.append(actor)
+            self._done[idx] = ray_tpu.get(ref)
+
+    def _deadline(self, timeout):
+        return None if timeout is None else time.monotonic() + timeout
+
+    def get_next(self, timeout: float = None) -> Any:
+        """Next result in SUBMISSION order (skipping indices already
+        taken by get_next_unordered)."""
+        while self._next_return in self._consumed:
+            self._consumed.discard(self._next_return)
+            self._next_return += 1
+        deadline = self._deadline(timeout)
+        while self._next_return not in self._done:
+            if not self._inflight:
+                raise StopIteration("no pending results")
+            self._wait_one(deadline)
+        idx = self._next_return
+        self._next_return += 1
+        return self._done.pop(idx)
+
+    def get_next_unordered(self, timeout: float = None) -> Any:
+        """Next result in COMPLETION order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        deadline = self._deadline(timeout)
+        while not self._done:
+            self._wait_one(deadline)
+        idx = next(iter(self._done))
+        self._consumed.add(idx)
+        return self._done.pop(idx)
+
+    def map(self, fn: Callable[[Any, Any], Any],
+            values: Iterable[Any]) -> Iterator[Any]:
+        """Ordered results; lazily keeps the pool saturated."""
+        values = iter(values)
+        submitted = 0
+        for v in values:
+            self.submit(fn, v)
+            submitted += 1
+            while not self.has_free():
+                yield self.get_next()
+                submitted -= 1
+        for _ in range(submitted):
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable[[Any, Any], Any],
+                      values: Iterable[Any]) -> Iterator[Any]:
+        values = iter(values)
+        submitted = 0
+        for v in values:
+            self.submit(fn, v)
+            submitted += 1
+            while not self.has_free():
+                yield self.get_next_unordered()
+                submitted -= 1
+        for _ in range(submitted):
+            yield self.get_next_unordered()

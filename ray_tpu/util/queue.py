@@ -1,0 +1,129 @@
+"""Distributed FIFO queue backed by an actor.
+
+Counterpart of python/ray/util/queue.py: a named-able, bounded queue any
+worker can put/get through its actor handle. Async actor methods give
+blocking semantics without tying up OS threads (the queue actor's event
+loop parks waiters — core/worker.py async-actor support).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import ray_tpu
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int):
+        import asyncio
+        import collections
+
+        self.maxsize = maxsize
+        self._items = collections.deque()
+        self._not_empty = asyncio.Event()
+        self._not_full = asyncio.Event()
+        self._not_full.set()
+
+    def _update_events(self):
+        if self._items:
+            self._not_empty.set()
+        else:
+            self._not_empty.clear()
+        if self.maxsize and len(self._items) >= self.maxsize:
+            self._not_full.clear()
+        else:
+            self._not_full.set()
+
+    async def put(self, item, timeout: Optional[float] = None) -> bool:
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+        # Re-check after every wake: another producer may have grabbed
+        # the freed slot first (append-after-single-wait overfilled
+        # bounded queues).
+        while self.maxsize and len(self._items) >= self.maxsize:
+            remaining = None if deadline is None \
+                else max(deadline - loop.time(), 0.0)
+            try:
+                await asyncio.wait_for(self._not_full.wait(), remaining)
+            except asyncio.TimeoutError:
+                return False
+        self._items.append(item)
+        self._update_events()
+        return True
+
+    async def get(self, timeout: Optional[float] = None):
+        import asyncio
+
+        while not self._items:
+            try:
+                await asyncio.wait_for(self._not_empty.wait(), timeout)
+            except asyncio.TimeoutError:
+                return ("__queue_empty__",)
+        item = self._items.popleft()
+        self._update_events()
+        return ("__queue_item__", item)
+
+    async def get_nowait_batch(self, n: int) -> List[Any]:
+        out = []
+        while self._items and len(out) < n:
+            out.append(self._items.popleft())
+        self._update_events()
+        return out
+
+    async def qsize(self) -> int:
+        return len(self._items)
+
+
+class Queue:
+    """Client handle; safe to pass to tasks/actors (the handle pickles,
+    the queue actor stays put)."""
+
+    def __init__(self, maxsize: int = 0, *, name: str = ""):
+        cls = ray_tpu.remote(_QueueActor)
+        opts = {"num_cpus": 0.05}
+        if name:
+            opts["name"] = name
+        self._actor = cls.options(**opts).remote(maxsize)
+
+    def put(self, item, timeout: Optional[float] = None) -> None:
+        ok = ray_tpu.get(self._actor.put.remote(item, timeout))
+        if not ok:
+            raise Full("queue is full")
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._actor.get.remote(timeout))
+        if out == ("__queue_empty__",):
+            raise Empty("queue is empty")
+        return out[1]
+
+    def get_nowait_batch(self, n: int) -> List[Any]:
+        return ray_tpu.get(self._actor.get_nowait_batch.remote(n))
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self._actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def shutdown(self) -> None:
+        ray_tpu.kill(self._actor)
+
+    @classmethod
+    def _from_actor(cls, actor) -> "Queue":
+        q = cls.__new__(cls)
+        q._actor = actor
+        return q
+
+    def __reduce__(self):
+        # Serializing the handle must NOT create a new queue actor.
+        return (Queue._from_actor, (self._actor,))

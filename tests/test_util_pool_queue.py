@@ -1,0 +1,125 @@
+"""ActorPool / Queue / runtime-context tests (reference:
+python/ray/util/actor_pool.py, util/queue.py, runtime_context.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Queue
+from ray_tpu.util.queue import Empty, Full
+
+
+@pytest.fixture(autouse=True)
+def _rt():
+    ray_tpu.init(num_cpus=4)
+    yield
+    ray_tpu.shutdown()
+
+
+def _doubler_cls():
+    # Local class: cloudpickle ships it by value (module-level test
+    # classes pickle by reference and fail in workers).
+    class Doubler:
+        def work(self, x):
+            return x * 2
+
+    return Doubler
+
+
+def test_actor_pool_map_ordered():
+    actors = [ray_tpu.remote(_doubler_cls()).options(num_cpus=0.5).remote()
+              for _ in range(3)]
+    pool = ActorPool(actors)
+    out = list(pool.map(lambda a, v: a.work.remote(v), range(10)))
+    assert out == [x * 2 for x in range(10)]
+
+
+def test_actor_pool_map_unordered_complete_set():
+    actors = [ray_tpu.remote(_doubler_cls()).options(num_cpus=0.5).remote()
+              for _ in range(2)]
+    pool = ActorPool(actors)
+    out = sorted(pool.map_unordered(
+        lambda a, v: a.work.remote(v), range(8)))
+    assert out == [x * 2 for x in range(8)]
+
+
+def test_actor_pool_submit_get_next():
+    actors = [ray_tpu.remote(_doubler_cls()).options(num_cpus=0.5).remote()]
+    pool = ActorPool(actors)
+    pool.submit(lambda a, v: a.work.remote(v), 5)
+    pool.submit(lambda a, v: a.work.remote(v), 6)
+    assert pool.get_next() == 10
+    assert pool.get_next() == 12
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_queue_fifo_and_cross_task():
+    q = Queue()
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+
+    # Handle pickles into tasks; items flow across processes.
+    @ray_tpu.remote
+    def producer(q):
+        for i in range(3):
+            q.put(i * 10)
+        return True
+
+    assert ray_tpu.get(producer.remote(q))
+    got = [q.get(timeout=10) for _ in range(4)]  # 'b' + 0,10,20
+    assert got == ["b", 0, 10, 20]
+    q.shutdown()
+
+
+def test_queue_blocking_get_unblocks_on_put():
+    q = Queue()
+
+    @ray_tpu.remote
+    def slow_put(q):
+        time.sleep(0.5)
+        q.put("late")
+        return True
+
+    ref = slow_put.remote(q)
+    t0 = time.time()
+    assert q.get(timeout=10) == "late"  # blocks until the put lands
+    assert time.time() - t0 >= 0.3
+    ray_tpu.get(ref)
+    q.shutdown()
+
+
+def test_queue_timeout_and_bounds():
+    q = Queue(maxsize=1)
+    q.put("x")
+    with pytest.raises(Full):
+        q.put("y", timeout=0.2)
+    assert q.get() == "x"
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_runtime_context_in_task_and_actor():
+    ctx = ray_tpu.get_runtime_context()
+    assert ctx.worker_id and ctx.session_id
+    assert ctx.get_task_id() is None  # driver
+
+    @ray_tpu.remote(num_cpus=0.5, resources={"extra": 0})
+    def who():
+        c = ray_tpu.get_runtime_context()
+        return (c.get_task_id(), c.get_assigned_resources())
+
+    task_id, res = ray_tpu.get(who.remote())
+    assert task_id and len(task_id) == 28
+    assert res.get("CPU") == 0.5
+
+    class A:
+        def me(self):
+            return ray_tpu.get_runtime_context().get_actor_id()
+
+    a = ray_tpu.remote(A).remote()
+    assert ray_tpu.get(a.me.remote())
