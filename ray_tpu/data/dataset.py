@@ -163,6 +163,9 @@ class Dataset:
     def iter_batches(self, **kw) -> Iterator[Any]:
         return self.iterator().iter_batches(**kw)
 
+    def iter_torch_batches(self, **kw) -> Iterator[Any]:
+        return self.iterator().iter_torch_batches(**kw)
+
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         return self.iterator().iter_rows()
 

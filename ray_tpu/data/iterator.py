@@ -59,6 +59,32 @@ class DataIterator:
         if builder.num_rows() > 0 and not drop_last:
             yield block_to_batch(builder.build(), batch_format)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           dtypes=None, device=None,
+                           **kw) -> Iterator[Dict[str, Any]]:
+        """Batches as torch tensors (reference iterator.iter_torch_batches
+        — minus GPU moves; `device` accepts e.g. "cpu")."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy", **kw):
+            out = {}
+            for k, v in batch.items():
+                try:
+                    t = torch.as_tensor(v)
+                except (TypeError, RuntimeError):
+                    out[k] = v  # non-numeric (strings/objects) pass through
+                    continue
+                if dtypes is not None:
+                    want = dtypes.get(k) if isinstance(dtypes, dict) \
+                        else dtypes
+                    if want is not None:
+                        t = t.to(want)
+                if device is not None:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for block in self.iter_blocks():
             yield from BlockAccessor(block).iter_rows()

@@ -259,8 +259,20 @@ class TuneController:
 
     # ------------------------------------------------------------------
     def _save_experiment_state(self):
+        # Lossless config sidecar: the JSON state stringifies non-JSON
+        # config values, which would corrupt re-run trials on restore.
+        try:
+            import pickle
+
+            with open(os.path.join(self._run_dir,
+                                   ".trial_configs.pkl"), "wb") as f:
+                pickle.dump({t.trial_id: t.config for t in self.trials},
+                            f)
+        except Exception:
+            pass
         state = {
             "timestamp": time.time(),
+            "num_samples": self._num_samples,
             "trials": [
                 {
                     "trial_id": t.trial_id,

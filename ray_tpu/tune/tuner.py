@@ -112,6 +112,30 @@ class Tuner:
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig()
         self.resources_per_trial = resources_per_trial
+        self._restore_path: Optional[str] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable, *,
+                param_space: Optional[Dict] = None,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional["RunConfig"] = None,
+                resources_per_trial: Optional[Dict[str, float]] = None
+                ) -> "Tuner":
+        """Resume an interrupted experiment from its run directory
+        (reference: Tuner.restore / tune/execution/experiment_state.py).
+        Finished trials keep their results; unfinished ones re-run from
+        their last checkpoint with their original configs; samples the
+        crashed run never created are drawn fresh (pass the original
+        param_space for that). Pass the original run_config to keep stop
+        criteria / failure limits — the state file does not record them."""
+        if not os.path.exists(os.path.join(path, "experiment_state.json")):
+            raise FileNotFoundError(
+                f"no experiment_state.json under {path!r}")
+        t = cls(trainable, param_space=param_space,
+                tune_config=tune_config, run_config=run_config,
+                resources_per_trial=resources_per_trial)
+        t._restore_path = path
+        return t
 
     def fit(self) -> ResultGrid:
         import ray_tpu
@@ -137,7 +161,7 @@ class Tuner:
             # num_samples repeats of the full grid).
             num_samples = tc.num_samples * grid_owner.grid_size()
 
-        run_dir = os.path.join(
+        run_dir = self._restore_path or os.path.join(
             self.run_config.storage_path or
             os.path.expanduser("~/ray_tpu_results"),
             self.run_config.name or "tune_run")
@@ -155,5 +179,61 @@ class Tuner:
             max_failures=self.run_config.failure_config.max_failures,
             resources_per_trial=self.resources_per_trial,
         )
+        if self._restore_path:
+            self._seed_restored_trials(controller)
         trials = controller.run()
         return ResultGrid(trials_to_results(trials), tc.metric, tc.mode)
+
+    def _seed_restored_trials(self, controller: TuneController) -> None:
+        """Rebuild trial state from experiment_state.json: TERMINATED
+        trials keep results; everything else re-runs (from its last
+        checkpoint when one exists) with its original config; samples
+        never created before the crash are drawn lazily as usual."""
+        import json
+        import pickle
+
+        from ray_tpu.tune.tune_controller import TERMINATED, Trial
+
+        with open(os.path.join(self._restore_path,
+                               "experiment_state.json")) as f:
+            saved = json.load(f)
+        # Lossless configs (the JSON state stringifies non-JSON values).
+        exact_configs = {}
+        sidecar = os.path.join(self._restore_path, ".trial_configs.pkl")
+        if os.path.exists(sidecar):
+            try:
+                with open(sidecar, "rb") as f:
+                    exact_configs = pickle.load(f)
+            except Exception:
+                exact_configs = {}
+        trials = []
+        for rec in saved["trials"]:
+            cfg = exact_configs.get(rec["trial_id"], rec["config"])
+            if not isinstance(cfg, dict):
+                raise ValueError(
+                    f"trial {rec['trial_id']} config was not recoverable "
+                    f"({cfg!r}); the run predates the config sidecar")
+            t = Trial(
+                trial_id=rec["trial_id"],
+                config=cfg,
+                trial_dir=os.path.join(self._restore_path,
+                                       rec["trial_id"]))
+            t.last_checkpoint = rec.get("last_checkpoint")
+            if rec["state"] == TERMINATED and not rec.get("error"):
+                t.state = TERMINATED
+                t.last_result = rec.get("last_result")
+                if t.last_result:
+                    t.metrics_history.append(t.last_result)
+                    # Replay into the scheduler so ASHA/median cutoffs
+                    # see the completed population, not an empty rung.
+                    try:
+                        controller._scheduler.on_trial_result(
+                            t, t.last_result)
+                        controller._scheduler.on_trial_complete(
+                            t, t.last_result)
+                    except Exception:
+                        pass
+            trials.append(t)
+        controller.trials = trials
+        controller._num_samples = max(
+            int(saved.get("num_samples", len(trials))), len(trials))
