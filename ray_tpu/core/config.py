@@ -44,6 +44,20 @@ class Config:
     # a client could hold a stale in-shm location).
     spill_min_age_s: float = 1.0
 
+    # -- lineage reconstruction -----------------------------------------
+    # Re-execute the producing task when an object's only copy is lost
+    # (reference: enable_object_reconstruction flag ray_config_def.h,
+    # ObjectRecoveryManager object_recovery_manager.h, lineage
+    # resubmission task_manager.h:208).
+    enable_object_reconstruction: bool = True
+    # Per-object cap on reconstruction re-executions (reference:
+    # task_retries consumed by reconstruction).
+    object_reconstruction_max_attempts: int = 3
+    # Cap on retained task records + lineage links; oldest finished
+    # records are evicted past this (reference: bounded lineage
+    # max_lineage_bytes + RAY_task_events_max_num_task_in_gcs).
+    max_lineage_entries: int = 100_000
+
     # -- memory monitor (reference memory_monitor.h + OOM killer) -------
     # Kill-and-retry the newest retriable task when host memory usage
     # crosses this fraction. 0 disables the monitor.

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional, Set
 
 from ray_tpu.core import rpc
 from ray_tpu.core.config import Config
+from ray_tpu.core.exceptions import ObjectLostError
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import ShmObjectStore
 from ray_tpu.core.resources import CPU, TPU, ResourceSet
@@ -63,6 +64,8 @@ class ObjectEntry:
     spilled_uri: Optional[str] = None  # external-storage URI when spilled
     restoring: bool = False
     stored_at: float = 0.0
+    # Times this object's value was re-created by lineage reconstruction.
+    reconstructions: int = 0
 
 
 @dataclass
@@ -213,6 +216,10 @@ class ControlServer:
         self.actor_inflight: Dict[str, Set[str]] = {}
         self.obj_actor: Dict[str, str] = {}
         self.tasks: Dict[str, TaskRecord] = {}
+        # Lineage: object hex -> producing task hex, kept even after the
+        # object entry itself is freed so a lost dependency can be
+        # re-created (reference lineage map, task_manager.h:208).
+        self.lineage: Dict[str, str] = {}
         self.pending_tasks: List[TaskSpec] = []
         self.pending_actors: List[ActorCreationSpec] = []
         # env_key -> runtime_env dict; workers fetch + apply their pool's
@@ -548,24 +555,24 @@ class ControlServer:
             if entry is None:
                 return
             entry.restoring = False
-            subs, entry.subscribers = entry.subscribers, []
             if data is None:
-                # Publish a REAL serialized error so clients raise it
-                # (an empty-payload push would surface as a confusing
-                # "ready but has no payload").
-                from ray_tpu.core.serialization import serialize
-
-                payload = serialize(RuntimeError(
-                    f"restore of spilled object {obj_hex} failed: "
-                    f"{err}")).to_bytes()
-                push = {"op": "object_ready", "obj": obj_hex,
-                        "size": len(payload), "inline": payload,
-                        "in_shm": False, "is_error": True}
-            else:
+                # The spilled copy is gone: fall back to lineage
+                # reconstruction; queued subscribers stay on the entry and
+                # resolve when the re-executed task stores the value.
                 entry.spilled_uri = None
-                entry.in_shm = True
-                entry.stored_at = time.time()
-                push = self._object_ready_msg(obj_hex, entry)
+                if not self._try_reconstruct_locked(obj_hex):
+                    # Store a REAL serialized error (not just a push):
+                    # current waiters raise it now and later gets see the
+                    # same ObjectLostError instead of a payload-less READY.
+                    self._store_lost_error_locked(
+                        obj_hex, f"restore of spilled copy failed ({err}) "
+                        "and lineage reconstruction was not possible")
+                return
+            subs, entry.subscribers = entry.subscribers, []
+            entry.spilled_uri = None
+            entry.in_shm = True
+            entry.stored_at = time.time()
+            push = self._object_ready_msg(obj_hex, entry)
         for c in subs:
             try:
                 c.push(push)
@@ -576,6 +583,135 @@ class ControlServer:
                 self.external_storage.delete(uri)
             except Exception:
                 pass
+
+    # -- lineage reconstruction ----------------------------------------
+    def _shm_value_lost(self, obj_hex: str, entry: ObjectEntry) -> bool:
+        """Lock held. True for a READY shm-backed object whose arena
+        segment is gone with no spilled copy: the value itself is lost."""
+        return (entry.state == READY and entry.in_shm
+                and entry.inline is None and entry.spilled_uri is None
+                and not entry.restoring
+                and not self.store.contains(ObjectID.from_hex(obj_hex)))
+
+    def _try_reconstruct_locked(self, obj_hex: str) -> bool:
+        """Lock held. Re-execute the task that produced a lost object
+        (reference ObjectRecoveryManager::RecoverObject,
+        core_worker/object_recovery_manager.h, + TaskManager lineage
+        resubmission, task_manager.h:208), recursively re-creating lost
+        dependencies first. Plans the full dependency tree before
+        mutating anything, so an unrecoverable dep deep in the chain
+        can't leave earlier deps pointlessly re-executing.
+
+        Returns True when the entry has been reset to PENDING and its
+        producing task queued (or already in flight); subscribers then
+        resolve through the normal object_ready push when the
+        re-execution stores the value."""
+        plan: List[tuple] = []  # (obj_hex, task_hex, resubmit)
+        if not self._plan_reconstruct_locked(obj_hex, plan, set()):
+            return False
+        requeued: Set[str] = set()
+        for o_hex, task_hex, resubmit in plan:
+            entry = self.objects.get(o_hex)
+            if entry is None:
+                entry = self.objects[o_hex] = ObjectEntry(
+                    refcount=0, producing_task=task_hex)
+            entry.reconstructions += 1
+            entry.state = PENDING
+            entry.inline = None
+            entry.in_shm = False
+            entry.spilled_uri = None
+            entry.is_error = False
+            if resubmit and task_hex not in requeued:
+                requeued.add(task_hex)
+                rec = self.tasks[task_hex]
+                spec = rec.spec
+                # Completing the re-run decrefs the task's borrows again
+                # (worker.py batches decrefs into task_done);
+                # pre-compensate so the double decref can't free
+                # arguments early.
+                for b in spec.borrows:
+                    dep = self.objects.get(b)
+                    if dep is not None:
+                        dep.refcount += 1
+                spec.retry_count = 0
+                rec.state = "PENDING"
+                rec.worker_hex = ""
+                self.pending_tasks.append(spec)
+        if requeued:
+            self._wake.set()
+        return True
+
+    def _plan_reconstruct_locked(self, obj_hex: str, plan: List[tuple],
+                                 seen: Set[str]) -> bool:
+        """Lock held. Validate that obj_hex (and every lost dependency
+        under it) is recoverable, appending (obj, task, resubmit) steps
+        to ``plan`` in dependency-first order. No mutation."""
+        if not self.config.enable_object_reconstruction:
+            return False
+        if obj_hex in seen:
+            return False  # cycle guard (shouldn't happen in a DAG)
+        seen.add(obj_hex)
+        task_hex = self.lineage.get(obj_hex)
+        rec = self.tasks.get(task_hex) if task_hex else None
+        if rec is None:
+            return False
+        spec = rec.spec
+        # Actor-method results depend on actor state and streaming items
+        # on consumed generators; neither re-executes deterministically
+        # (the reference likewise only reconstructs normal task returns).
+        if spec.actor_id is not None or spec.is_streaming:
+            return False
+        entry = self.objects.get(obj_hex)
+        if entry is not None and entry.reconstructions >= \
+                self.config.object_reconstruction_max_attempts:
+            return False
+        resubmit = rec.state not in ("PENDING", "RUNNING")
+        if resubmit:
+            # Lost dependencies must be re-created first; the scheduler
+            # then holds this task until they are READY (_deps_ready).
+            for arg in spec.args:
+                if not arg.is_ref:
+                    continue
+                dep = self.objects.get(arg.object_hex)
+                if dep is None or self._shm_value_lost(arg.object_hex,
+                                                       dep):
+                    if not self._plan_reconstruct_locked(
+                            arg.object_hex, plan, seen):
+                        return False
+        plan.append((obj_hex, task_hex, resubmit))
+        return True
+
+    def _store_lost_error_locked(self, obj_hex: str, why: str):
+        """Lock held. Store + publish a serialized ObjectLostError as the
+        object's value so pending and future gets raise it."""
+        from ray_tpu.core.serialization import serialize
+
+        payload = serialize(ObjectLostError(
+            f"object {obj_hex} is lost: {why}")).to_bytes()
+        self._store_object_locked(
+            obj_hex, inline=payload, size=len(payload), is_error=True)
+
+    def _prune_lineage_locked(self):
+        """Lock held. Evict the oldest finished task records (and their
+        return objects' lineage links) past the retention cap, bounding
+        control-plane memory on long-running drivers (reference: lineage
+        eviction under max_lineage_bytes + GcsTaskManager's
+        task_events_max_num_task_in_gcs cap)."""
+        cap = self.config.max_lineage_entries
+        if cap <= 0 or len(self.tasks) <= cap:
+            return
+        target = (cap * 3) // 4
+        drop = []
+        excess = len(self.tasks) - target
+        for task_hex, rec in self.tasks.items():
+            if len(drop) >= excess:
+                break
+            if rec.state in ("FINISHED", "FAILED"):
+                drop.append(task_hex)
+        for task_hex in drop:
+            rec = self.tasks.pop(task_hex)
+            for oid in rec.spec.return_ids:
+                self.lineage.pop(oid.hex(), None)
 
     # -- OOM defense ---------------------------------------------------
     def _on_memory_pressure(self, fraction: float):
@@ -639,6 +775,15 @@ class ControlServer:
                             target=self._restore_and_publish,
                             args=(obj_hex,), daemon=True,
                             name=f"restore-{obj_hex[:8]}").start()
+                elif self._shm_value_lost(obj_hex, entry):
+                    # Only copy vanished from the arena (swept orphan,
+                    # external deletion): reconstruct from lineage; the
+                    # subscriber resolves when the re-run stores it.
+                    entry.subscribers.append(conn)
+                    if not self._try_reconstruct_locked(obj_hex):
+                        self._store_lost_error_locked(
+                            obj_hex, "shm copy gone and lineage "
+                            "reconstruction not possible")
                 else:
                     conn.push(self._object_ready_msg(obj_hex, entry))
             else:
@@ -687,6 +832,9 @@ class ControlServer:
     def _op_free_objects(self, conn, msg):
         with self.lock:
             for obj_hex in msg["objs"]:
+                # Explicit free forfeits reconstruction (the reference
+                # likewise deletes lineage on ray.internal.free).
+                self.lineage.pop(obj_hex, None)
                 entry = self.objects.pop(obj_hex, None)
                 if entry is not None and entry.in_shm:
                     self.store.delete(ObjectID.from_hex(obj_hex))
@@ -763,6 +911,7 @@ class ControlServer:
             for oid in spec.return_ids:
                 self.objects.setdefault(oid.hex(), ObjectEntry(
                     producing_task=spec.task_id.hex()))
+                self.lineage[oid.hex()] = spec.task_id.hex()
             self.tasks[spec.task_id.hex()] = TaskRecord(
                 spec=spec, submitted_at=time.time())
             self.pending_tasks.append(spec)
@@ -811,11 +960,12 @@ class ControlServer:
                 return {"status": "error", "error": "object not found"}
             if entry.state == PENDING:
                 return {"status": "pending"}
-            is_error = entry.is_error
-        payload = self._op_fetch_object(conn, msg)
-        if payload is None:
+        reply = self._op_fetch_object(
+            conn, {"obj": msg["obj"], "with_meta": True})
+        if reply is None or reply.get("data") is None:
             return {"status": "error",
                     "error": "object payload unavailable"}
+        payload, is_error = reply["data"], reply["is_error"]
         from ray_tpu.core.serialization import deserialize
 
         try:
@@ -860,6 +1010,7 @@ class ControlServer:
                 w.state = "idle"
                 w.current_task = None
                 self._release(w)
+            self._prune_lineage_locked()
         for obj_hex in msg.get("decrefs", ()):
             self._op_decref(conn, {"obj": obj_hex})
         if any(p.get("in_shm") for p in msg.get("puts", ())):
@@ -1779,21 +1930,31 @@ class ControlServer:
         attachment — reference Ray Client server proxy role). Shm reads
         and spilled-object restores happen outside the lock."""
         obj_hex = msg["obj"]
+        # with_meta callers get {"data", "is_error"} so they never rely on
+        # a stale error flag cached before a reconstruction/lost event.
+        with_meta = bool(msg.get("with_meta"))
+
+        def reply(data, is_error):
+            return {"data": data, "is_error": is_error} if with_meta \
+                else data
+
         # Retry loop: the object can migrate between shm and external
         # storage (spill / concurrent restore) between the snapshot and
         # the read; re-reading the entry makes the race benign.
-        for _ in range(4):
+        for attempt in range(4):
             with self.lock:
                 entry = self.objects.get(obj_hex)
                 if entry is None or entry.state not in (READY, ERRORED):
                     return None
                 if entry.inline is not None:
-                    return entry.inline
+                    return reply(entry.inline, entry.is_error)
                 size = entry.size
                 spilled_uri = entry.spilled_uri
+                is_error = entry.is_error
             if spilled_uri is not None:
                 try:
-                    return self.external_storage.restore(spilled_uri)
+                    return reply(self.external_storage.restore(spilled_uri),
+                                 is_error)
                 except Exception:
                     continue  # restored+deleted meanwhile: re-snapshot
             try:
@@ -1801,9 +1962,33 @@ class ControlServer:
                 seg = self.store.attach(oid, size)
                 data = bytes(seg.buf[:size])
                 self.store.release(oid)
-                return data
+                return reply(data, is_error)
             except Exception:
-                time.sleep(0.01)  # spilled meanwhile: re-snapshot
+                # Spilled meanwhile (re-snapshot) — or the copy is gone,
+                # in which case kick lineage reconstruction and wait for
+                # the re-run to store the value; when reconstruction is
+                # impossible, materialize ObjectLostError so this (and
+                # every later) read returns the same error the subscribe
+                # path serves.
+                with self.lock:
+                    entry = self.objects.get(obj_hex)
+                    if entry is not None and \
+                            self._shm_value_lost(obj_hex, entry):
+                        if not self._try_reconstruct_locked(obj_hex):
+                            self._store_lost_error_locked(
+                                obj_hex, "shm copy gone and lineage "
+                                "reconstruction not possible")
+                deadline = time.time() + 30.0
+                while time.time() < deadline:
+                    with self.lock:
+                        entry = self.objects.get(obj_hex)
+                        if entry is None:
+                            return None
+                        if entry.state in (READY, ERRORED) and \
+                                not entry.restoring:
+                            break
+                    time.sleep(0.02)
+                time.sleep(0.01)
         return None
 
     def _op_get_runtime_env(self, conn, msg):

@@ -167,12 +167,18 @@ class CoreClient:
         elif info.get("in_shm"):
             if self.store is None:
                 # Thin client: the server reads the shm payload for us.
-                data = self.client.call({"op": "fetch_object",
-                                         "obj": obj_hex})
-                if data is None:
+                # with_meta: the error flag must come from the same
+                # snapshot as the payload — the object may have become an
+                # ObjectLostError after this client cached `info`.
+                reply = self.client.call({"op": "fetch_object",
+                                          "obj": obj_hex,
+                                          "with_meta": True})
+                if reply is None or reply.get("data") is None:
                     raise RuntimeError(
                         f"object {obj_hex} no longer available")
-                return self._finish_load(obj_hex, data, info)
+                return self._finish_load(
+                    obj_hex, reply["data"],
+                    {**info, "is_error": reply["is_error"]})
             try:
                 seg = self.store.attach(ObjectID.from_hex(obj_hex),
                                         info["size"])

@@ -100,6 +100,8 @@ class TuneController:
             self.trials.append(Trial(
                 trial_id=f"trial_{i:05d}", config=cfg,
                 trial_dir=os.path.join(self._run_dir, f"trial_{i:05d}")))
+        if configs:
+            self._configs_dirty = True
 
     # ------------------------------------------------------------------
     def run(self) -> List[Trial]:
@@ -255,21 +257,26 @@ class TuneController:
             return
         self._shutdown_runner(t)
         t.config = dict(directive.get("config") or t.config)
+        self._configs_dirty = True
         self._start_trial(t, checkpoint_path=donor_ckpt)
 
     # ------------------------------------------------------------------
     def _save_experiment_state(self):
         # Lossless config sidecar: the JSON state stringifies non-JSON
         # config values, which would corrupt re-run trials on restore.
-        try:
-            import pickle
+        # Rewritten only when a config changed (trial created / PBT
+        # exploit), not on every poll tick.
+        if getattr(self, "_configs_dirty", True):
+            try:
+                import pickle
 
-            with open(os.path.join(self._run_dir,
-                                   ".trial_configs.pkl"), "wb") as f:
-                pickle.dump({t.trial_id: t.config for t in self.trials},
-                            f)
-        except Exception:
-            pass
+                with open(os.path.join(self._run_dir,
+                                       ".trial_configs.pkl"), "wb") as f:
+                    pickle.dump({t.trial_id: t.config for t in self.trials},
+                                f)
+                self._configs_dirty = False
+            except Exception:
+                pass
         state = {
             "timestamp": time.time(),
             "num_samples": self._num_samples,

@@ -237,3 +237,12 @@ class Tuner:
         controller.trials = trials
         controller._num_samples = max(
             int(saved.get("num_samples", len(trials))), len(trials))
+        # Fast-forward the fresh searcher past the draws the original run
+        # already made: finite/grid searchers must resume at the next
+        # unseen point, not re-cycle duplicates from the start (random /
+        # TPE searchers just discard the replayed draws).
+        if trials:
+            try:
+                controller._search.next_configs(len(trials))
+            except Exception:
+                pass
