@@ -649,7 +649,11 @@ class ControlServer:
         if not self.config.enable_object_reconstruction:
             return False
         if obj_hex in seen:
-            return False  # cycle guard (shouldn't happen in a DAG)
+            # Already planned along another path (duplicate arg /
+            # diamond dependency). Object IDs form a DAG, so a revisit
+            # can't be a cycle; a node that failed validation aborts the
+            # whole plan before any revisit could happen.
+            return True
         seen.add(obj_hex)
         task_hex = self.lineage.get(obj_hex)
         rec = self.tasks.get(task_hex) if task_hex else None
@@ -1956,7 +1960,22 @@ class ControlServer:
                     return reply(self.external_storage.restore(spilled_uri),
                                  is_error)
                 except Exception:
-                    continue  # restored+deleted meanwhile: re-snapshot
+                    # Restored+deleted meanwhile (benign race) — or the
+                    # spilled copy itself is gone; mirror
+                    # _restore_and_publish: reconstruct from lineage or
+                    # materialize the lost error, then wait it out.
+                    with self.lock:
+                        entry = self.objects.get(obj_hex)
+                        if entry is not None \
+                                and entry.spilled_uri == spilled_uri \
+                                and not entry.restoring:
+                            entry.spilled_uri = None
+                            if not self._try_reconstruct_locked(obj_hex):
+                                self._store_lost_error_locked(
+                                    obj_hex, "spilled copy unreadable and "
+                                    "lineage reconstruction not possible")
+                    self._await_object_settled(obj_hex, 30.0)
+                    continue
             try:
                 oid = ObjectID.from_hex(obj_hex)
                 seg = self.store.attach(oid, size)
@@ -1978,18 +1997,23 @@ class ControlServer:
                             self._store_lost_error_locked(
                                 obj_hex, "shm copy gone and lineage "
                                 "reconstruction not possible")
-                deadline = time.time() + 30.0
-                while time.time() < deadline:
-                    with self.lock:
-                        entry = self.objects.get(obj_hex)
-                        if entry is None:
-                            return None
-                        if entry.state in (READY, ERRORED) and \
-                                not entry.restoring:
-                            break
-                    time.sleep(0.02)
+                self._await_object_settled(obj_hex, 30.0)
                 time.sleep(0.01)
         return None
+
+    def _await_object_settled(self, obj_hex: str, timeout: float) -> None:
+        """Poll (off-lock) until an object is READY/ERRORED and not mid-
+        restore — i.e. until a kicked reconstruction/restore lands."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self.lock:
+                entry = self.objects.get(obj_hex)
+                if entry is None:
+                    return
+                if entry.state in (READY, ERRORED) and \
+                        not entry.restoring:
+                    return
+            time.sleep(0.02)
 
     def _op_get_runtime_env(self, conn, msg):
         with self.lock:

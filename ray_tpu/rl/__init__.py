@@ -13,10 +13,15 @@ from ray_tpu.rl.episode import SingleAgentEpisode, episodes_to_batch
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
 from ray_tpu.rl.module import QNetworkSpec, RLModuleSpec, SACModuleSpec
-from ray_tpu.rl.replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rl.replay_buffer import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+    SequenceReplayBuffer,
+)
 
 __all__ = [
     "PrioritizedReplayBuffer",
+    "SequenceReplayBuffer",
     "QNetworkSpec",
     "ReplayBuffer",
     "SACModuleSpec",

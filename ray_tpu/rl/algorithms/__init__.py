@@ -7,6 +7,12 @@ from ray_tpu.rl.algorithms.bc import (
 )
 from ray_tpu.rl.algorithms.cql import CQL, CQLConfig, CQLLearner
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig, DQNLearner
+from ray_tpu.rl.algorithms.dreamerv3 import (
+    DreamerV3,
+    DreamerV3Config,
+    DreamerV3Learner,
+    DreamerV3ModuleSpec,
+)
 from ray_tpu.rl.algorithms.impala import (
     APPO,
     APPOConfig,
@@ -23,6 +29,8 @@ __all__ = [
     "BC", "BCConfig",
     "CQL", "CQLConfig", "CQLLearner",
     "DQN", "DQNConfig", "DQNLearner",
+    "DreamerV3", "DreamerV3Config", "DreamerV3Learner",
+    "DreamerV3ModuleSpec",
     "IMPALA", "IMPALAConfig", "IMPALALearner",
     "MARWIL", "MARWILConfig", "MARWILLearner",
     "PPO", "PPOConfig", "PPOLearner",

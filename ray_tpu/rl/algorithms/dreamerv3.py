@@ -1,0 +1,614 @@
+"""DreamerV3: model-based RL from a learned world model (Hafner et al. 2023).
+
+Counterpart of the reference's rllib/algorithms/dreamerv3/ (dreamerv3.py
+DreamerV3Config; torch RSSM + actor/critic in tf/torch sub-modules, DDP
+across learner actors) — re-done TPU-first: the whole update (world-model
+sequence loss via lax.scan, imagination rollout, actor and critic losses,
+EMA target/normalizer updates) is ONE jitted XLA program with three optax
+optimizers applied inside it. Acting is recurrent through the env runner's
+stateful-module protocol (env_runner.py act_stateful), with is_first
+resetting RSSM rows in-place so vectorized envs never re-trace.
+
+Vector-observation variant (MLP encoder/decoder; the reference's CNN
+encoder for Atari is an orthogonal input stage). Discrete actions use
+straight-through categorical latents + REINFORCE actor gradients;
+continuous actions use a tanh-Gaussian with the same REINFORCE estimator
+(the paper's appendix shows it competitive with dynamics backprop).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import JaxLearner
+from ray_tpu.rl.learner_group import LearnerGroup
+from ray_tpu.rl.replay_buffer import SequenceReplayBuffer
+
+sg = jax.lax.stop_gradient
+
+
+# ---------------------------------------------------------------------------
+# Symlog / twohot scalar codecs (DreamerV3 §"robust predictions")
+# ---------------------------------------------------------------------------
+
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def twohot(y, bins):
+    """Encode scalars as a two-hot distribution over fixed bins."""
+    y = jnp.clip(y, bins[0], bins[-1])
+    idx = jnp.clip(jnp.searchsorted(bins, y) - 1, 0, len(bins) - 2)
+    lo, hi = bins[idx], bins[idx + 1]
+    w_hi = (y - lo) / jnp.maximum(hi - lo, 1e-8)
+    return (jax.nn.one_hot(idx, len(bins)) * (1.0 - w_hi)[..., None]
+            + jax.nn.one_hot(idx + 1, len(bins)) * w_hi[..., None])
+
+
+def twohot_loss(logits, y, bins):
+    """Cross-entropy of a twohot(symlog(y)) target; y is raw scale."""
+    target = twohot(symlog(y), bins)
+    return -jnp.sum(target * jax.nn.log_softmax(logits), axis=-1)
+
+
+def twohot_mean(logits, bins):
+    """Expected raw-scale value of a twohot-symlog prediction head."""
+    return symexp(jnp.sum(jax.nn.softmax(logits) * bins, axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# Layers (local minimal MLP helpers: linear + layernorm + silu)
+# ---------------------------------------------------------------------------
+
+def _linear_init(key, din, dout, scale=1.0):
+    return {"w": jax.random.truncated_normal(
+                key, -2, 2, (din, dout)) * scale * jnp.sqrt(1.0 / din),
+            "b": jnp.zeros((dout,))}
+
+
+def _linear(p, x):
+    return x @ p["w"] + p["b"]
+
+
+def _norm_silu(x):
+    # Parameter-free layernorm keeps the pytree small; scale/shift are
+    # absorbed by the surrounding linears.
+    x = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    return jax.nn.silu(x)
+
+
+def _mlp_init(key, din, units, layers, dout, out_scale=1.0):
+    ks = jax.random.split(key, layers + 1)
+    sizes = [din] + [units] * layers
+    net = {"hidden": [
+        _linear_init(ks[i], sizes[i], sizes[i + 1]) for i in range(layers)]}
+    net["out"] = _linear_init(ks[-1], sizes[-1], dout, out_scale)
+    return net
+
+
+def _mlp(net, x):
+    for p in net["hidden"]:
+        x = _norm_silu(_linear(p, x))
+    return _linear(net["out"], x)
+
+
+# ---------------------------------------------------------------------------
+# Module spec
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class DreamerV3ModuleSpec:
+    """World model + actor + critic dimensions (frozen → jit-stable)."""
+
+    obs_dim: int
+    action_dim: int
+    discrete: bool = True
+    deter_dim: int = 256
+    stoch_vars: int = 16
+    stoch_classes: int = 16
+    units: int = 256
+    mlp_layers: int = 2
+    num_bins: int = 41
+    unimix: float = 0.01
+
+    @property
+    def stoch_dim(self) -> int:
+        return self.stoch_vars * self.stoch_classes
+
+    @property
+    def feat_dim(self) -> int:
+        return self.deter_dim + self.stoch_dim
+
+    @property
+    def action_vec_dim(self) -> int:
+        return self.action_dim if self.discrete else self.action_dim
+
+    def bins(self):
+        return jnp.linspace(-20.0, 20.0, self.num_bins)
+
+    # -- params ------------------------------------------------------------
+    def init(self, key) -> Dict[str, Any]:
+        ks = jax.random.split(key, 10)
+        L, U = self.mlp_layers, self.units
+        wm = {
+            "encoder": _mlp_init(ks[0], self.obs_dim, U, L, U),
+            # GRU over [z+a → hidden] input; 3 gates fused in one linear.
+            "img_in": _linear_init(
+                ks[1], self.stoch_dim + self.action_vec_dim, U),
+            "gru": _linear_init(ks[2], U + self.deter_dim,
+                                3 * self.deter_dim),
+            "prior": _mlp_init(ks[3], self.deter_dim, U, 1,
+                               self.stoch_dim, out_scale=1.0),
+            "posterior": _mlp_init(ks[4], self.deter_dim + U, U, 1,
+                                   self.stoch_dim, out_scale=1.0),
+            "decoder": _mlp_init(ks[5], self.feat_dim, U, L, self.obs_dim),
+            "reward": _mlp_init(ks[6], self.feat_dim, U, L,
+                                self.num_bins, out_scale=0.0),
+            "cont": _mlp_init(ks[7], self.feat_dim, U, L, 1),
+        }
+        adim = (self.action_dim if self.discrete else 2 * self.action_dim)
+        actor = _mlp_init(ks[8], self.feat_dim, U, L, adim, out_scale=0.01)
+        critic = _mlp_init(ks[9], self.feat_dim, U, L, self.num_bins,
+                           out_scale=0.0)
+        return {
+            "wm": wm, "actor": actor, "critic": critic,
+            "critic_slow": jax.tree.map(jnp.copy, critic),
+            # Return-range EMA for advantage normalization (§"actor").
+            "norm": {"lo": jnp.zeros(()), "hi": jnp.ones(())},
+        }
+
+    # -- RSSM --------------------------------------------------------------
+    def _logits_probs(self, logits):
+        """Unimix: 99% softmax + 1% uniform over classes (per latent var)."""
+        shaped = logits.reshape(logits.shape[:-1]
+                                + (self.stoch_vars, self.stoch_classes))
+        probs = jax.nn.softmax(shaped)
+        probs = ((1.0 - self.unimix) * probs
+                 + self.unimix / self.stoch_classes)
+        return shaped, probs
+
+    def _sample_stoch(self, logits, key):
+        """Straight-through one-hot sample of the categorical latents."""
+        _, probs = self._logits_probs(logits)
+        idx = jax.random.categorical(key, jnp.log(probs))
+        onehot = jax.nn.one_hot(idx, self.stoch_classes)
+        z = onehot + probs - sg(probs)
+        return z.reshape(z.shape[:-2] + (self.stoch_dim,))
+
+    def _gru(self, wm, h, x):
+        parts = _linear(wm["gru"], jnp.concatenate([
+            _norm_silu(_linear(wm["img_in"], x)), h], -1))
+        reset, cand, update = jnp.split(parts, 3, -1)
+        reset = jax.nn.sigmoid(reset)
+        cand = jnp.tanh(reset * cand)
+        update = jax.nn.sigmoid(update - 1.0)
+        return update * cand + (1.0 - update) * h
+
+    def rssm_step(self, wm, h, z, action_vec, key, embed=None):
+        """One posterior (embed given) or prior (imagination) step.
+        Returns (h', z', prior_logits, post_logits_or_None)."""
+        h = self._gru(wm, h, jnp.concatenate([z, action_vec], -1))
+        prior_logits = _mlp(wm["prior"], h)
+        if embed is None:
+            z = self._sample_stoch(prior_logits, key)
+            return h, z, prior_logits, None
+        post_logits = _mlp(wm["posterior"],
+                           jnp.concatenate([h, embed], -1))
+        z = self._sample_stoch(post_logits, key)
+        return h, z, prior_logits, post_logits
+
+    def kl(self, p_logits, q_logits):
+        """Sum over latent vars of KL(p || q) with unimixed probs."""
+        _, p = self._logits_probs(p_logits)
+        _, q = self._logits_probs(q_logits)
+        return jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=(-2, -1))
+
+    # -- policy heads ------------------------------------------------------
+    def actor_dist_params(self, actor, feat):
+        out = _mlp(actor, feat)
+        if self.discrete:
+            probs = ((1.0 - self.unimix) * jax.nn.softmax(out)
+                     + self.unimix / self.action_dim)
+            return jnp.log(probs)
+        mean, std = jnp.split(out, 2, -1)
+        return mean, jax.nn.softplus(std) + 0.1
+
+    def sample_action(self, actor, feat, key, *, mode=False):
+        """Returns (env_action, action_vec, logp, entropy)."""
+        if self.discrete:
+            logp_all = self.actor_dist_params(actor, feat)
+            a = jnp.where(mode, jnp.argmax(logp_all, -1),
+                          jax.random.categorical(key, logp_all))
+            vec = jax.nn.one_hot(a, self.action_dim)
+            logp = jnp.take_along_axis(
+                logp_all, a[..., None], -1)[..., 0]
+            ent = -jnp.sum(jnp.exp(logp_all) * logp_all, -1)
+            return a, vec, logp, ent
+        mean, std = self.actor_dist_params(actor, feat)
+        eps = jax.random.normal(key, mean.shape)
+        raw = jnp.where(mode, mean, mean + std * eps)
+        a = jnp.tanh(raw)
+        base_logp = jnp.sum(
+            -0.5 * (((raw - mean) / std) ** 2 + jnp.log(2 * jnp.pi))
+            - jnp.log(std), -1)
+        logp = base_logp - jnp.sum(
+            2.0 * (jnp.log(2.0) - raw - jax.nn.softplus(-2.0 * raw)), -1)
+        ent = jnp.sum(0.5 * jnp.log(2 * jnp.pi * jnp.e) + jnp.log(std), -1)
+        return a, a, logp, ent
+
+    def value(self, critic, feat):
+        return twohot_mean(_mlp(critic, feat), self.bins())
+
+    # -- env-runner stateful-acting protocol (env_runner.py) ---------------
+    def init_runner_state(self, n: int):
+        return {
+            "h": jnp.zeros((n, self.deter_dim)),
+            "z": jnp.zeros((n, self.stoch_dim)),
+            "a": jnp.zeros((n, self.action_vec_dim)),
+        }
+
+    def act_stateful(self, params, state, obs, key, explore, is_first):
+        mask = (1.0 - is_first.astype(jnp.float32))[:, None]
+        h, z, a = state["h"] * mask, state["z"] * mask, state["a"] * mask
+        k1, k2 = jax.random.split(key)
+        embed = _mlp(params["wm"]["encoder"], symlog(obs))
+        h, z, _, _ = self.rssm_step(params["wm"], h, z, a, k1, embed=embed)
+        feat = jnp.concatenate([h, z], -1)
+        action, vec, logp, _ = self.sample_action(
+            params["actor"], feat, k2, mode=jnp.logical_not(explore))
+        value = self.value(params["critic"], feat)
+        return action, logp, value, {"h": h, "z": z, "a": vec}
+
+    def action_vecs(self, actions):
+        """Buffer actions [B,T,?] → world-model action vectors [B,T,A]."""
+        if self.discrete:
+            return jax.nn.one_hot(
+                actions[..., 0].astype(jnp.int32), self.action_dim)
+        return actions
+
+
+# ---------------------------------------------------------------------------
+# Learner
+# ---------------------------------------------------------------------------
+
+class DreamerV3Learner(JaxLearner):
+    """Three-optimizer update (world model / actor / critic) in one jit."""
+
+    def __init__(self, spec: DreamerV3ModuleSpec, *,
+                 wm_lr: float = 1e-4, ac_lr: float = 3e-5,
+                 grad_clip: float = 100.0, horizon: int = 15,
+                 gamma: float = 0.997, lam: float = 0.95,
+                 entropy_coef: float = 3e-4, free_bits: float = 1.0,
+                 kl_dyn: float = 1.0, kl_rep: float = 0.1,
+                 slow_critic_tau: float = 0.02,
+                 norm_decay: float = 0.99, seed: int = 0,
+                 mesh_axes=None, **_):
+        self.spec = spec
+        self.horizon = horizon
+        self.gamma = gamma
+        self.lam = lam
+        self.entropy_coef = entropy_coef
+        self.free_bits = free_bits
+        self.kl_dyn = kl_dyn
+        self.kl_rep = kl_rep
+        self.slow_critic_tau = slow_critic_tau
+        self.norm_decay = norm_decay
+        self.data_axis = "data"
+        self.mesh = None
+        if mesh_axes:
+            from ray_tpu.parallel.mesh import build_mesh
+            self.mesh = build_mesh(axes=mesh_axes)
+        self.rng = jax.random.key(seed)
+        self.params = spec.init(jax.random.key(seed))
+
+        def tx(lr):
+            return optax.chain(optax.clip_by_global_norm(grad_clip),
+                               optax.adam(lr, eps=1e-8))
+
+        self.tx = {"wm": tx(wm_lr), "actor": tx(ac_lr), "critic": tx(ac_lr)}
+        self.opt_state = {k: t.init(self.params[k])
+                          for k, t in self.tx.items()}
+        self._jit_update = None
+        self.metrics: Dict[str, Any] = {}
+
+    # -- world-model sequence loss ----------------------------------------
+    def _wm_loss(self, wm, batch, rng):
+        spec = self.spec
+        B, T = batch["obs"].shape[:2]
+        obs_sym = symlog(batch["obs"])
+        embed = _mlp(wm["encoder"], obs_sym)
+        avec = spec.action_vecs(batch["actions"])
+        # Row t holds the action taken AFTER obs_t (replay_buffer.py), so
+        # the RSSM input at t is the action from row t-1 (zero at t=0 /
+        # is_first rows).
+        prev_a = jnp.concatenate(
+            [jnp.zeros_like(avec[:, :1]), avec[:, :-1]], 1)
+        keys = jax.random.split(rng, T)
+
+        def step(carry, xs):
+            h, z = carry
+            emb_t, a_t, first_t, key = xs
+            m = (1.0 - first_t)[:, None]
+            h, z, prior_logits, post_logits = spec.rssm_step(
+                wm, h * m, z * m, a_t * m, key, embed=emb_t)
+            return (h, z), (h, z, prior_logits, post_logits)
+
+        init = (jnp.zeros((B, spec.deter_dim)),
+                jnp.zeros((B, spec.stoch_dim)))
+        _, (hs, zs, priors, posts) = jax.lax.scan(
+            step, init,
+            (embed.swapaxes(0, 1), prev_a.swapaxes(0, 1),
+             batch["is_first"].swapaxes(0, 1), keys))
+        hs, zs = hs.swapaxes(0, 1), zs.swapaxes(0, 1)       # [B,T,...]
+        priors, posts = priors.swapaxes(0, 1), posts.swapaxes(0, 1)
+        feat = jnp.concatenate([hs, zs], -1)
+
+        recon = _mlp(wm["decoder"], feat)
+        recon_loss = jnp.sum((recon - obs_sym) ** 2, -1)
+        reward_loss = twohot_loss(_mlp(wm["reward"], feat),
+                                  batch["rewards"], spec.bins())
+        cont_logit = _mlp(wm["cont"], feat)[..., 0]
+        cont_loss = optax.sigmoid_binary_cross_entropy(
+            cont_logit, batch["cont"])
+        dyn = jnp.maximum(spec.kl(sg(posts), priors), self.free_bits)
+        rep = jnp.maximum(spec.kl(posts, sg(priors)), self.free_bits)
+        loss = jnp.mean(recon_loss + reward_loss + cont_loss
+                        + self.kl_dyn * dyn + self.kl_rep * rep)
+        aux = {
+            "wm_loss": loss,
+            "recon_loss": jnp.mean(recon_loss),
+            "reward_loss": jnp.mean(reward_loss),
+            "cont_loss": jnp.mean(cont_loss),
+            "kl_dyn": jnp.mean(dyn),
+        }
+        return loss, (aux, feat, hs, zs)
+
+    # -- imagination + actor/critic ----------------------------------------
+    def _imagine(self, params, h0, z0, rng):
+        """Roll the prior forward `horizon` steps under the actor.
+        Returns feats [H+1,N,F], action logp/entropy [H,N]."""
+        spec = self.spec
+
+        def step(carry, key):
+            h, z = carry
+            feat = jnp.concatenate([h, z], -1)
+            ka, kz = jax.random.split(key)
+            _, vec, logp, ent = spec.sample_action(
+                params["actor"], sg(feat), ka)
+            h, z, _, _ = spec.rssm_step(params["wm"], h, z, vec, kz)
+            return (h, z), (jnp.concatenate([h, z], -1), logp, ent)
+
+        keys = jax.random.split(rng, self.horizon)
+        _, (feats, logps, ents) = jax.lax.scan(step, (h0, z0), keys)
+        feat0 = jnp.concatenate([h0, z0], -1)[None]
+        return jnp.concatenate([feat0, feats], 0), logps, ents
+
+    def _build_update(self):
+        spec = self.spec
+
+        def one_step(params, opt_state, batch, rng):
+            k_wm, k_img = jax.random.split(rng)
+
+            # ---- world model ----
+            (wm_grads, (aux, feat, hs, zs)) = jax.grad(
+                lambda wm: self._wm_loss(wm, batch, k_wm),
+                has_aux=True)(params["wm"])
+            wm_upd, wm_opt = self.tx["wm"].update(
+                wm_grads, opt_state["wm"], params["wm"])
+            new_wm = optax.apply_updates(params["wm"], wm_upd)
+            aux["wm_grad_norm"] = optax.global_norm(wm_grads)
+
+            # ---- imagination from every posterior state ----
+            h0 = sg(hs.reshape(-1, spec.deter_dim))
+            z0 = sg(zs.reshape(-1, spec.stoch_dim))
+            frozen = {"wm": sg(new_wm), "actor": params["actor"],
+                      "critic": params["critic"]}
+
+            def ac_losses(actor, critic):
+                p = dict(frozen)
+                p["actor"] = actor
+                feats, logps, ents = self._imagine(p, h0, z0, k_img)
+                rewards = twohot_mean(
+                    _mlp(p["wm"]["reward"], feats[1:]), spec.bins())
+                cont = jax.nn.sigmoid(
+                    _mlp(p["wm"]["cont"], feats[1:])[..., 0])
+                values = spec.value(critic, sg(feats))     # [H+1,N]
+                slow_v = spec.value(params["critic_slow"], sg(feats))
+                disc = self.gamma * cont                    # [H,N]
+
+                def lam_step(nxt, xs):
+                    r, d, v_next = xs
+                    ret = r + d * ((1 - self.lam) * v_next + self.lam * nxt)
+                    return ret, ret
+
+                _, returns = jax.lax.scan(
+                    lam_step, values[-1],
+                    (rewards, disc, values[1:]), reverse=True)  # [H,N]
+                # Trajectory weights: products of continue probs (a
+                # predicted episode end downweights everything after it).
+                w = jnp.concatenate([
+                    jnp.ones_like(disc[:1]),
+                    jnp.cumprod(cont[:-1], 0)], 0)          # [H,N]
+                w = sg(w)
+
+                # Critic: twohot CE toward λ-returns + EMA self-regularizer.
+                logits = _mlp(critic, sg(feats[:-1]))
+                critic_loss = jnp.mean(w * (
+                    twohot_loss(logits, sg(returns), spec.bins())
+                    + twohot_loss(logits, sg(slow_v[:-1]), spec.bins())))
+
+                # Actor: REINFORCE on percentile-normalized advantages.
+                lo = params["norm"]["lo"] * self.norm_decay + \
+                    jnp.percentile(returns, 5.0) * (1 - self.norm_decay)
+                hi = params["norm"]["hi"] * self.norm_decay + \
+                    jnp.percentile(returns, 95.0) * (1 - self.norm_decay)
+                scale = jnp.maximum(1.0, hi - lo)
+                adv = sg((returns - values[:-1]) / scale)
+                actor_loss = -jnp.mean(
+                    w * (logps * adv + self.entropy_coef * ents))
+                a_aux = {
+                    "actor_loss": actor_loss,
+                    "critic_loss": critic_loss,
+                    "return_mean": jnp.mean(returns),
+                    "value_mean": jnp.mean(values),
+                    "entropy": jnp.mean(ents),
+                    "norm_lo": lo, "norm_hi": hi,
+                }
+                return actor_loss + critic_loss, a_aux
+
+            (a_grads, c_grads), a_aux = jax.grad(
+                ac_losses, argnums=(0, 1), has_aux=True)(
+                params["actor"], params["critic"])
+            a_upd, a_opt = self.tx["actor"].update(
+                a_grads, opt_state["actor"], params["actor"])
+            c_upd, c_opt = self.tx["critic"].update(
+                c_grads, opt_state["critic"], params["critic"])
+            new_actor = optax.apply_updates(params["actor"], a_upd)
+            new_critic = optax.apply_updates(params["critic"], c_upd)
+
+            tau = self.slow_critic_tau
+            new_params = {
+                "wm": new_wm, "actor": new_actor, "critic": new_critic,
+                "critic_slow": jax.tree.map(
+                    lambda s, c: (1 - tau) * s + tau * c,
+                    params["critic_slow"], new_critic),
+                "norm": {"lo": a_aux.pop("norm_lo"),
+                         "hi": a_aux.pop("norm_hi")},
+            }
+            aux.update(a_aux)
+            aux["total_loss"] = aux["wm_loss"] + aux["actor_loss"] \
+                + aux["critic_loss"]
+            new_opt = {"wm": wm_opt, "actor": a_opt, "critic": c_opt}
+            return new_params, new_opt, aux
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            replicated = NamedSharding(self.mesh, P())
+            batch_sharded = NamedSharding(self.mesh, P(self.data_axis))
+            return jax.jit(
+                one_step,
+                in_shardings=(replicated, replicated, batch_sharded,
+                              replicated),
+                out_shardings=(replicated, replicated, replicated))
+        return jax.jit(one_step)
+
+    def update_from_batch(self, batch: Dict[str, np.ndarray]
+                          ) -> Dict[str, float]:
+        if self._jit_update is None:
+            self._jit_update = self._build_update()
+        self.rng, sub = jax.random.split(self.rng)
+        batch_j = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, aux = self._jit_update(
+            self.params, self.opt_state, batch_j, sub)
+        self.metrics = {k: float(v) for k, v in aux.items()
+                        if np.ndim(v) == 0}
+        return self.metrics
+
+    # Host-DP split-gradient API is not meaningful for the three-phase
+    # update; multi-learner groups shard batches at the algorithm level.
+    def compute_gradients(self, batch):
+        raise NotImplementedError(
+            "DreamerV3 uses update_from_batch on each learner; "
+            "use num_learners=0 (chip-parallel via mesh_axes) instead")
+
+
+# ---------------------------------------------------------------------------
+# Config + Algorithm
+# ---------------------------------------------------------------------------
+
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DreamerV3
+        self.batch_size_B: int = 16
+        self.batch_length_T: int = 32
+        self.horizon: int = 15
+        self.gamma: float = 0.997
+        self.lam: float = 0.95
+        self.wm_lr: float = 1e-4
+        self.ac_lr: float = 3e-5
+        self.grad_clip: float = 100.0
+        self.entropy_coef: float = 3e-4
+        self.deter_dim: int = 256
+        self.stoch_vars: int = 16
+        self.stoch_classes: int = 16
+        self.units: int = 256
+        self.mlp_layers: int = 2
+        self.num_bins: int = 41
+        self.rollout_fragment_length: int = 64
+        # Replayed transitions trained per env step sampled (reference
+        # DreamerV3Config.training_ratio; 1024 for CartPole, 32 Atari).
+        self.training_ratio: float = 256.0
+        self.num_steps_sampled_before_learning_starts: int = 1024
+        self.replay_buffer_capacity: int = 100_000
+
+
+class DreamerV3(Algorithm):
+    config_class = DreamerV3Config
+
+    def _setup_from_config(self, config: "DreamerV3Config") -> None:
+        env = config.make_env_fn()()
+        try:
+            discrete = isinstance(env.action_space, gym.spaces.Discrete)
+            obs_dim = int(np.prod(env.observation_space.shape))
+            action_dim = (int(env.action_space.n) if discrete
+                          else int(np.prod(env.action_space.shape)))
+        finally:
+            env.close()
+        self._spec = DreamerV3ModuleSpec(
+            obs_dim=obs_dim, action_dim=action_dim, discrete=discrete,
+            deter_dim=config.deter_dim, stoch_vars=config.stoch_vars,
+            stoch_classes=config.stoch_classes, units=config.units,
+            mlp_layers=config.mlp_layers, num_bins=config.num_bins)
+        self.replay = SequenceReplayBuffer(
+            config.replay_buffer_capacity, seed=config.seed)
+        super()._setup_from_config(config)
+
+    def _make_runner_spec(self):
+        return self._spec
+
+    def _build_learner_group(self, config: "DreamerV3Config"
+                             ) -> LearnerGroup:
+        return LearnerGroup(
+            DreamerV3Learner,
+            dict(spec=self._spec, wm_lr=config.wm_lr, ac_lr=config.ac_lr,
+                 grad_clip=config.grad_clip, horizon=config.horizon,
+                 gamma=config.gamma, lam=config.lam,
+                 entropy_coef=config.entropy_coef, seed=config.seed,
+                 mesh_axes=config.mesh_axes),
+            num_learners=config.num_learners)
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: DreamerV3Config = self.config
+        episodes = self.env_runner_group.sample(
+            num_env_steps=cfg.rollout_fragment_length)
+        steps_added = self.replay.add_episodes(episodes)
+        metrics: Dict[str, Any] = {"num_env_steps_sampled": steps_added,
+                                   "replay_buffer_size": len(self.replay)}
+        if len(self.replay) < max(cfg.num_steps_sampled_before_learning_starts,
+                                  cfg.batch_length_T):
+            return metrics
+        per_update = cfg.batch_size_B * cfg.batch_length_T
+        num_updates = max(1, round(cfg.training_ratio * steps_added
+                                   / per_update))
+        for _ in range(num_updates):
+            batch = self.replay.sample(cfg.batch_size_B,
+                                       cfg.batch_length_T)
+            metrics.update(self.learner_group.update_from_batch(batch))
+        self.env_runner_group.sync_weights(self.learner_group.get_weights())
+        return metrics

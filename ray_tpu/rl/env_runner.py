@@ -61,11 +61,29 @@ class SingleAgentEnvRunner:
 
         spec = self.spec
 
-        @jax.jit
-        def _act(params, obs, key, explore_flag):
-            # Dispatch through the spec's module protocol (module.py) so
-            # Q-networks / SAC actors plug in without runner changes.
-            return spec.act(params, obs, key, explore_flag)
+        # Recurrent modules (DreamerV3's RSSM) expose the stateful-acting
+        # protocol: init_runner_state(n) + act_stateful(params, state,
+        # obs, key, explore, is_first) -> (action, logp, value, state).
+        # is_first resets the matching state rows inside the jitted step
+        # (counterpart of the reference's RLModule state_in/state_out
+        # columns in ConnectorV2 pipelines).
+        self._stateful = hasattr(spec, "act_stateful")
+        self._act_state = (spec.init_runner_state(num_envs)
+                           if self._stateful else None)
+        self._is_first = np.ones(num_envs, dtype=bool)
+
+        if self._stateful:
+            @jax.jit
+            def _act(params, state, obs, key, explore_flag, is_first):
+                return spec.act_stateful(params, state, obs, key,
+                                         explore_flag, is_first)
+        else:
+            @jax.jit
+            def _act(params, obs, key, explore_flag):
+                # Dispatch through the spec's module protocol (module.py)
+                # so Q-networks / SAC actors plug in without runner
+                # changes.
+                return spec.act(params, obs, key, explore_flag)
 
         self._act = _act
         # Host-side epsilon-greedy (specs with an epsilon_timesteps
@@ -107,6 +125,7 @@ class SingleAgentEnvRunner:
             for i in range(self.num_envs):
                 self._episodes[i].add_reset(obs[i])
             self._pending_reset[:] = False
+            self._is_first[:] = True
 
         done_episodes: List[SingleAgentEpisode] = []
         steps = 0
@@ -116,8 +135,14 @@ class SingleAgentEnvRunner:
             if num_episodes is not None and len(done_episodes) >= num_episodes:
                 break
             self._rng, key = jax.random.split(self._rng)
-            action, logp, value = self._act(
-                self.params, jnp.asarray(self._obs), key, self.explore)
+            if self._stateful:
+                action, logp, value, self._act_state = self._act(
+                    self.params, self._act_state, jnp.asarray(self._obs),
+                    key, self.explore, jnp.asarray(self._is_first))
+                self._is_first[:] = False
+            else:
+                action, logp, value = self._act(
+                    self.params, jnp.asarray(self._obs), key, self.explore)
             action_np = np.asarray(action)
             eps_steps = getattr(self.spec, "epsilon_timesteps", 0)
             if self.explore and eps_steps:
@@ -147,6 +172,8 @@ class SingleAgentEnvRunner:
                     self._episodes[i] = SingleAgentEpisode(id=uuid.uuid4().hex)
                     self._episodes[i].add_reset(next_obs[i])
                     self._pending_reset[i] = False
+                    # Recurrent state for env i resets on the next act.
+                    self._is_first[i] = True
                     continue
                 ep = self._episodes[i]
                 done = bool(terms[i] or truncs[i])

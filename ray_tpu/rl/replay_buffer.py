@@ -1,4 +1,4 @@
-"""Replay buffers: uniform and prioritized transition storage.
+"""Replay buffers: uniform, prioritized, and sequence storage.
 
 Counterpart of the reference's rllib/utils/replay_buffers/ —
 EpisodeReplayBuffer / PrioritizedEpisodeReplayBuffer (proportional PER,
@@ -150,3 +150,111 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         prios = np.abs(np.asarray(td_errors, dtype=np.float64)) + self.eps
         self._priorities[np.asarray(indices)] = prios ** self.alpha
         self._max_priority = max(self._max_priority, float(prios.max()))
+
+
+class SequenceReplayBuffer:
+    """Contiguous-sequence replay for recurrent world models (DreamerV3).
+
+    Counterpart of the reference's EpisodeReplayBuffer in
+    rllib/utils/replay_buffers/episode_replay_buffer.py (sample with
+    batch_length_T > 1): stores transitions as one flat stream with
+    is_first markers at episode starts and samples fixed-shape [B, T]
+    windows, so the learner's scanned RSSM update never recompiles.
+
+    Stream row layout at index t (v3 convention): obs_t, the action taken
+    AFTER obs_t, reward received ON ARRIVING at obs_t (0 at a segment
+    start), is_first_t, and cont_t (0 when obs_t is terminal). Windows may
+    span segment boundaries — is_first tells the RSSM to reset in-place.
+
+    Chunks from different vector-env slots interleave in the stream, so
+    EVERY appended chunk opens a new segment (is_first on its first row):
+    a window straddling a chunk boundary then resets state at the splice
+    instead of treating two unrelated episodes as one sequence.
+    """
+
+    def __init__(self, capacity: int = 100_000, *, seed: int = 0):
+        self.capacity = int(capacity)
+        self._rng = np.random.default_rng(seed)
+        self._storage: Optional[Dict[str, np.ndarray]] = None
+        self._next = 0
+        self._size = 0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def _alloc(self, obs: np.ndarray, action: np.ndarray) -> None:
+        cap = self.capacity
+        self._storage = {
+            "obs": np.zeros((cap,) + obs.shape, dtype=np.float32),
+            "actions": np.zeros((cap,) + action.shape, dtype=np.float32),
+            "rewards": np.zeros(cap, dtype=np.float32),
+            "is_first": np.zeros(cap, dtype=np.float32),
+            "cont": np.zeros(cap, dtype=np.float32),
+        }
+
+    def add_episodes(self, episodes: List[SingleAgentEpisode]) -> int:
+        """Append episode chunks to the stream. Returns rows added."""
+        added = 0
+        for ep in episodes:
+            ep = ep.finalize()
+            T = len(ep)
+            if T == 0:
+                continue
+            obs = np.asarray(ep.obs, dtype=np.float32)
+            obs = obs.reshape(T + 1, -1) if obs.ndim > 2 else obs
+            actions = np.asarray(ep.actions, dtype=np.float32)
+            if actions.ndim == 1:
+                actions = actions[:, None]
+            rewards = np.asarray(ep.rewards, dtype=np.float32)
+            if self._storage is None:
+                self._alloc(obs[0], actions[0])
+            for t in range(T):
+                self._append_row(
+                    obs[t], actions[t],
+                    0.0 if t == 0 else rewards[t - 1],
+                    is_first=(t == 0), cont=1.0)
+                added += 1
+            # Tail row carries the chunk's LAST reward (it arrives with
+            # obs[T]) — appended for non-done chunks too, else the reward
+            # at every fragment boundary would be dropped from the
+            # stream. Its zero action is only ever consumed as the "prev
+            # action" of the next row, which starts a new segment and is
+            # masked by is_first. cont=0 only for true termination
+            # (truncation bootstraps through the final obs).
+            self._append_row(
+                obs[T], np.zeros_like(actions[0]), rewards[T - 1],
+                is_first=False,
+                cont=0.0 if ep.terminated else 1.0)
+            added += 1
+        return added
+
+    def _append_row(self, obs, action, reward, *, is_first, cont):
+        i = self._next
+        s = self._storage
+        s["obs"][i] = obs
+        s["actions"][i] = action
+        s["rewards"][i] = np.float32(reward)
+        s["is_first"][i] = np.float32(is_first)
+        s["cont"][i] = np.float32(cont)
+        self._next = (self._next + 1) % self.capacity
+        self._size = min(self._size + 1, self.capacity)
+
+    def sample(self, batch_size: int, seq_len: int
+               ) -> Dict[str, np.ndarray]:
+        """[B, T] windows of the stream, contiguous modulo the ring."""
+        assert self._size >= seq_len, "buffer shorter than one sequence"
+        # Valid window starts avoid straddling the write head (stale rows).
+        if self._size < self.capacity:
+            starts = self._rng.integers(
+                0, self._size - seq_len + 1, size=batch_size)
+            idx = starts[:, None] + np.arange(seq_len)[None, :]
+        else:
+            offsets = self._rng.integers(
+                0, self.capacity - seq_len + 1, size=batch_size)
+            idx = (self._next + offsets[:, None]
+                   + np.arange(seq_len)[None, :]) % self.capacity
+        batch = {k: v[idx] for k, v in self._storage.items()}
+        # A window that starts mid-episode still needs a defined initial
+        # state: mark row 0 so the RSSM starts from zeros there.
+        batch["is_first"][:, 0] = 1.0
+        return batch

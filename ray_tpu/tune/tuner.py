@@ -240,9 +240,13 @@ class Tuner:
         # Fast-forward the fresh searcher past the draws the original run
         # already made: finite/grid searchers must resume at the next
         # unseen point, not re-cycle duplicates from the start (random /
-        # TPE searchers just discard the replayed draws).
+        # TPE searchers just discard the replayed draws). Unwrap any
+        # ConcurrencyLimiter — its in-flight cap would truncate the
+        # replay AND leave _inflight inflated with no completions coming.
         if trials:
             try:
-                controller._search.next_configs(len(trials))
+                search = controller._search
+                search = getattr(search, "searcher", search)
+                search.next_configs(len(trials))
             except Exception:
                 pass

@@ -83,6 +83,40 @@ def test_lost_dependency_chain_reconstructed(tmp_path):
         ray_tpu.shutdown()
 
 
+def test_diamond_dependency_reconstructs(tmp_path):
+    """A task consuming the same lost object twice (or a diamond) must
+    still plan successfully — revisits are 'already planned', not
+    cycles."""
+    marker = tmp_path / "runs"
+    rt = ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote
+        def base():
+            with open(marker, "a") as f:
+                f.write("b")
+            return np.arange(SIZE, dtype=np.int64)
+
+        @ray_tpu.remote
+        def add(x, y):
+            with open(marker, "a") as f:
+                f.write("a")
+            return x + y
+
+        a_ref = base.remote()
+        c_ref = add.remote(a_ref, a_ref)
+        np.testing.assert_array_equal(
+            ray_tpu.get(c_ref), 2 * np.arange(SIZE, dtype=np.int64))
+
+        _lose(rt, a_ref)
+        _lose(rt, c_ref)
+        got = ray_tpu.get(c_ref, timeout=30)
+        np.testing.assert_array_equal(
+            got, 2 * np.arange(SIZE, dtype=np.int64))
+        assert sorted(marker.read_text()) == ["a", "a", "b", "b"]
+    finally:
+        ray_tpu.shutdown()
+
+
 def test_put_objects_are_not_reconstructable():
     """ray.put() values have no lineage; losing them raises
     ObjectLostError (same contract as the reference for owned puts)."""

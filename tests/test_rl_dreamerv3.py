@@ -1,0 +1,216 @@
+"""DreamerV3 tests (reference rllib/algorithms/dreamerv3/): scalar
+codecs, sequence replay, RSSM world-model fitting, stateful recurrent
+acting through the env runner, and the end-to-end training step."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rl.algorithms.dreamerv3 import (
+    DreamerV3Config,
+    DreamerV3Learner,
+    DreamerV3ModuleSpec,
+    symexp,
+    symlog,
+    twohot,
+)
+from ray_tpu.rl.episode import SingleAgentEpisode
+from ray_tpu.rl.replay_buffer import SequenceReplayBuffer
+
+
+def tiny_spec(**kw):
+    defaults = dict(obs_dim=4, action_dim=2, discrete=True,
+                    deter_dim=32, stoch_vars=4, stoch_classes=4,
+                    units=32, mlp_layers=1, num_bins=41)
+    defaults.update(kw)
+    return DreamerV3ModuleSpec(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# Codecs
+# ---------------------------------------------------------------------------
+
+def test_symlog_symexp_roundtrip():
+    x = jnp.array([-1000.0, -1.0, 0.0, 0.5, 3000.0])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x),
+                               rtol=1e-4)
+
+
+def test_twohot_is_distribution_and_invertible():
+    bins = jnp.linspace(-20.0, 20.0, 41)
+    y = jnp.array([[0.0, 1.5], [-3.0, 100.0]])
+    t = twohot(symlog(y), bins)
+    np.testing.assert_allclose(np.asarray(t.sum(-1)), 1.0, rtol=1e-5)
+    rec = symexp(jnp.sum(t * bins, -1))
+    np.testing.assert_allclose(np.asarray(rec), np.asarray(y), rtol=1e-3,
+                               atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Sequence replay
+# ---------------------------------------------------------------------------
+
+def _episode(T, obs_dim=3, terminated=True, reward=1.0):
+    ep = SingleAgentEpisode()
+    ep.add_reset(np.zeros(obs_dim, np.float32))
+    for t in range(T):
+        ep.add_step(np.full(obs_dim, t + 1, np.float32), t % 2, reward,
+                    terminated=(terminated and t == T - 1))
+    return ep
+
+
+def test_sequence_buffer_layout():
+    buf = SequenceReplayBuffer(capacity=100, seed=0)
+    added = buf.add_episodes([_episode(5)])
+    # 5 transition rows + 1 terminal-obs row.
+    assert added == 6 and len(buf) == 6
+    s = buf._storage
+    assert s["is_first"][0] == 1.0 and s["is_first"][1:6].sum() == 0
+    assert s["cont"][5] == 0.0 and s["cont"][:5].min() == 1.0
+    # Reward lands on the row of the obs it arrived with (shifted by 1).
+    assert s["rewards"][0] == 0.0 and s["rewards"][1] == 1.0
+
+
+def test_sequence_buffer_sample_shapes_and_window_reset():
+    buf = SequenceReplayBuffer(capacity=1000, seed=0)
+    buf.add_episodes([_episode(20) for _ in range(5)])
+    batch = buf.sample(8, 10)
+    assert batch["obs"].shape == (8, 10, 3)
+    assert batch["actions"].shape == (8, 10, 1)
+    for k in ("rewards", "is_first", "cont"):
+        assert batch[k].shape == (8, 10)
+    # Every window is usable standalone: row 0 always starts a segment.
+    assert (batch["is_first"][:, 0] == 1.0).all()
+
+
+def test_sequence_buffer_keeps_fragment_boundary_reward():
+    """A non-done chunk's last reward must land in the stream (on the
+    tail-obs row), not vanish at the fragment boundary."""
+    buf = SequenceReplayBuffer(capacity=100, seed=0)
+    chunk = _episode(3, terminated=False, reward=7.0)  # in-progress cut
+    added = buf.add_episodes([chunk])
+    assert added == 4  # 3 transition rows + tail-obs row
+    s = buf._storage
+    assert s["rewards"][3] == 7.0 and s["cont"][3] == 1.0
+    # Tail row's zero action is never consumed: the next chunk opens a
+    # new segment.
+    buf.add_episodes([_episode(2)])
+    assert s["is_first"][4] == 1.0
+
+
+def test_sequence_buffer_truncation_bootstraps():
+    buf = SequenceReplayBuffer(capacity=100, seed=0)
+    ep = _episode(4, terminated=False)
+    ep.truncated = True
+    buf.add_episodes([ep])
+    # Truncated final obs keeps cont=1 (bootstrap through it).
+    assert buf._storage["cont"][4] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# World model + learner
+# ---------------------------------------------------------------------------
+
+def _rand_batch(rng, B=3, T=6, obs_dim=4):
+    batch = {
+        "obs": rng.normal(size=(B, T, obs_dim)).astype(np.float32),
+        "actions": rng.integers(0, 2, size=(B, T, 1)).astype(np.float32),
+        "rewards": rng.normal(size=(B, T)).astype(np.float32),
+        "is_first": np.zeros((B, T), np.float32),
+        "cont": np.ones((B, T), np.float32),
+    }
+    batch["is_first"][:, 0] = 1
+    return batch
+
+
+def test_world_model_fits_a_batch():
+    lrn = DreamerV3Learner(tiny_spec(), horizon=4, seed=0)
+    batch = _rand_batch(np.random.default_rng(0))
+    losses = [lrn.update_from_batch(batch)["wm_loss"] for _ in range(25)]
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+    m = lrn.metrics
+    for k in ("actor_loss", "critic_loss", "entropy", "kl_dyn"):
+        assert np.isfinite(m[k]), m
+
+
+def test_learner_state_roundtrip():
+    lrn = DreamerV3Learner(tiny_spec(), horizon=3, seed=0)
+    lrn.update_from_batch(_rand_batch(np.random.default_rng(1)))
+    state = lrn.get_state()
+    lrn2 = DreamerV3Learner(tiny_spec(), horizon=3, seed=9)
+    lrn2.set_state(state)
+    a = jax.tree.leaves(lrn.params)
+    b = jax.tree.leaves(lrn2.params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_continuous_action_head():
+    spec = tiny_spec(discrete=False, action_dim=2)
+    lrn = DreamerV3Learner(spec, horizon=3, seed=0)
+    rng = np.random.default_rng(2)
+    batch = _rand_batch(rng)
+    batch["actions"] = rng.uniform(-1, 1, size=(3, 6, 2)).astype(np.float32)
+    m = lrn.update_from_batch(batch)
+    assert np.isfinite(m["total_loss"])
+    state = spec.init_runner_state(2)
+    a, logp, v, state2 = spec.act_stateful(
+        lrn.params, state, jnp.zeros((2, 4)), jax.random.key(0),
+        True, jnp.array([True, True]))
+    assert a.shape == (2, 2) and np.abs(np.asarray(a)).max() <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# Stateful acting
+# ---------------------------------------------------------------------------
+
+def test_act_stateful_resets_rows_on_is_first():
+    spec = tiny_spec()
+    params = spec.init(jax.random.key(0))
+    state = spec.init_runner_state(2)
+    obs = jnp.ones((2, 4))
+    key = jax.random.key(1)
+    # Step twice to build up nonzero state everywhere (after one step
+    # from all-zero state only z is nonzero: h's GRU input was zero).
+    _, _, _, state = spec.act_stateful(
+        params, state, obs, key, True, jnp.array([True, True]))
+    _, _, _, state = spec.act_stateful(
+        params, state, obs, key, True, jnp.array([False, False]))
+    assert float(jnp.abs(state["h"]).sum()) > 0
+    # Resetting only row 0: its pre-step state contribution must vanish.
+    _, _, _, s_reset = spec.act_stateful(
+        params, state, obs, key, True, jnp.array([True, False]))
+    _, _, _, s_zero = spec.act_stateful(
+        params, spec.init_runner_state(2), obs, key, True,
+        jnp.array([True, True]))
+    np.testing.assert_allclose(np.asarray(s_reset["h"][0]),
+                               np.asarray(s_zero["h"][0]), rtol=1e-5)
+    assert not np.allclose(np.asarray(s_reset["h"][1]),
+                           np.asarray(s_zero["h"][1]))
+
+
+# ---------------------------------------------------------------------------
+# End to end
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("env", ["CartPole-v1"])
+def test_training_step_end_to_end(env):
+    cfg = DreamerV3Config().environment(env)
+    cfg.deter_dim = 32; cfg.stoch_vars = 4; cfg.stoch_classes = 4
+    cfg.units = 32; cfg.mlp_layers = 1
+    cfg.batch_size_B = 4; cfg.batch_length_T = 8; cfg.horizon = 4
+    cfg.rollout_fragment_length = 24
+    cfg.num_steps_sampled_before_learning_starts = 24
+    cfg.training_ratio = 4.0
+    algo = cfg.build()
+    try:
+        for _ in range(3):
+            res = algo.train()
+        assert res["replay_buffer_size"] > 0
+        assert np.isfinite(res["wm_loss"])
+        ev = algo.evaluate(num_episodes=1)
+        assert ev["evaluation/num_episodes"] == 1
+    finally:
+        algo.stop()
