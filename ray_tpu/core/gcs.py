@@ -2015,6 +2015,52 @@ class ControlServer:
                     return
             time.sleep(0.02)
 
+    # ------------------------------------------------------------------
+    # On-demand worker profiling (reference: dashboard reporter
+    # profile_manager.py py-spy/memray drivers; TPU-native addition per
+    # SURVEY.md §5: jax.profiler traces of live workers)
+    def _op_profile_worker(self, conn, msg):
+        """Ask a live worker for a profile and wait for its reply.
+        kind: 'stack' (all-thread dump) | 'jax_trace' (xplane trace dir).
+        Blocks this connection's handler thread only."""
+        worker_hex = msg["worker_hex"]
+        timeout = float(msg.get("timeout_s", 0) or
+                        (float(msg.get("duration_s", 2.0)) + 30.0))
+        with self.lock:
+            w = self.workers.get(worker_hex)
+            if w is None or w.conn is None or w.state == "dead":
+                raise ValueError(f"no live worker {worker_hex}")
+            if w.conn is conn:
+                # The reply would arrive on THIS connection, whose only
+                # handler thread is the one about to block here. Callers
+                # profile themselves locally (state/api.py shortcut).
+                raise ValueError(
+                    "cannot profile the requesting process through the "
+                    "control plane; take the dump locally")
+            token = uuid.uuid4().hex
+            from concurrent.futures import Future as _F
+
+            if not hasattr(self, "_profile_waiters"):
+                self._profile_waiters = {}
+            fut = self._profile_waiters[token] = _F()
+            w.conn.push({"op": "profile", "token": token,
+                         "kind": msg.get("kind", "stack"),
+                         "duration_s": float(msg.get("duration_s", 2.0))})
+        try:
+            return fut.result(timeout=timeout)
+        except TimeoutError:
+            raise TimeoutError(
+                f"worker {worker_hex} did not reply to profile request "
+                f"within {timeout:.0f}s") from None
+        finally:
+            self._profile_waiters.pop(token, None)
+
+    def _op_profile_result(self, conn, msg):
+        waiters = getattr(self, "_profile_waiters", {})
+        fut = waiters.get(msg.get("token"))
+        if fut is not None and not fut.done():
+            fut.set_result(msg.get("data"))
+
     def _op_get_runtime_env(self, conn, msg):
         with self.lock:
             return self.runtime_envs.get(msg.get("env_key", ""))

@@ -49,6 +49,21 @@ def _is_missing_segment_error(e: Exception) -> bool:
         return False
 
 
+def dump_all_stacks() -> str:
+    """Format every thread's current Python stack (the in-process
+    counterpart of the reference's py-spy `ray stack` dumps — no
+    external profiler binary needed for cooperative processes)."""
+    import sys
+    import traceback
+
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        out.append(f"--- Thread {tid} ({names.get(tid, '?')}) ---")
+        out.append("".join(traceback.format_stack(frame)))
+    return "\n".join(out)
+
+
 def get_runtime():
     if _global_runtime is None:
         raise RuntimeError(
@@ -122,8 +137,46 @@ class CoreClient:
             self.on_execute_task(msg["spec"])
         elif op == "create_actor_instance" and self.on_create_actor is not None:
             self.on_create_actor(msg["spec"])
+        elif op == "profile":
+            # On-demand profiling (gcs.py _op_profile_worker): run off
+            # the push thread; the worker keeps executing its task.
+            threading.Thread(target=self._run_profile, args=(msg,),
+                             name="profile", daemon=True).start()
         elif op == "exit" and self.on_exit is not None:
             self.on_exit()
+
+    def _run_profile(self, msg: dict):
+        kind = msg.get("kind", "stack")
+        try:
+            if kind == "stack":
+                data = dump_all_stacks()
+            elif kind == "jax_trace":
+                import time as _time
+
+                import jax
+
+                out_dir = os.path.join(
+                    self.session_dir, "profiles",
+                    f"{self.worker_hex[:8]}-{int(_time.time())}")
+                os.makedirs(out_dir, exist_ok=True)
+                # Process-wide xplane trace: captures any jitted work the
+                # task threads run during the window (viewable with
+                # tensorboard / xprof).
+                with jax.profiler.trace(out_dir):
+                    _time.sleep(float(msg.get("duration_s", 2.0)))
+                data = out_dir
+            else:
+                data = f"unknown profile kind {kind!r}"
+        except Exception as e:  # noqa: BLE001
+            data = f"profile failed: {type(e).__name__}: {e}"
+        if "_local_result" in msg:  # self-profile (state/api.py)
+            msg["_local_result"]["data"] = data
+            return
+        try:
+            self.client.send({"op": "profile_result",
+                              "token": msg.get("token"), "data": data})
+        except Exception:
+            pass
 
     def _handle_actor_update(self, msg: dict):
         actor_hex = msg["actor"]

@@ -117,6 +117,20 @@ class Dashboard:
         if path == "/api/timeline":
             from ray_tpu.util.timeline import timeline_events
             return timeline_events(rt)
+        if path.startswith("/api/workers/") and "/profile" in path:
+            # On-demand live-worker profiling (reference: dashboard
+            # reporter profile_manager.py py-spy/memray endpoints;
+            # kind=jax_trace adds the TPU-native xplane capture).
+            from urllib.parse import parse_qs as _pq
+            from urllib.parse import urlparse as _up
+            parsed = _up(path)
+            worker_hex = parsed.path.split("/")[3]
+            q = _pq(parsed.query)
+            from ray_tpu.state.api import profile_worker
+            data = profile_worker(
+                worker_hex, kind=q.get("kind", ["stack"])[0],
+                duration_s=float(q.get("duration_s", ["2"])[0]))
+            return {"worker": worker_hex, "profile": data}
         if path == "/api/jobs":
             return self._jobs().list_jobs()
         if path.startswith("/api/jobs/"):
@@ -137,6 +151,18 @@ class Dashboard:
         if path.startswith("/api/jobs/") and path.endswith("/stop"):
             job_id = path[len("/api/jobs/"):-len("/stop")]
             return {"stopped": self._jobs().stop_job(job_id)}
+        if path.startswith("/api/events/"):
+            # HTTP event provider (reference workflow/http_event_provider
+            # .py): external systems deliver workflow events by POSTing
+            # the JSON payload; KVEventListener picks it up from the KV.
+            from ray_tpu.workflow.event import EVENT_KV_PREFIX
+            key = path[len("/api/events/"):]
+            if not key:
+                raise KeyError(path)
+            self._runtime.core.client.call({
+                "op": "kv_put", "key": EVENT_KV_PREFIX + key,
+                "value": payload, "overwrite": True})
+            return {"status": "ok", "key": key}
         raise KeyError(path)
 
     def _jobs(self):

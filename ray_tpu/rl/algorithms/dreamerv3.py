@@ -135,7 +135,8 @@ class DreamerV3ModuleSpec:
 
     @property
     def action_vec_dim(self) -> int:
-        return self.action_dim if self.discrete else self.action_dim
+        # One-hot for discrete, raw vector for continuous — same width.
+        return self.action_dim
 
     def bins(self):
         return jnp.linspace(-20.0, 20.0, self.num_bins)
@@ -597,14 +598,18 @@ class DreamerV3(Algorithm):
         cfg: DreamerV3Config = self.config
         episodes = self.env_runner_group.sample(
             num_env_steps=cfg.rollout_fragment_length)
-        steps_added = self.replay.add_episodes(episodes)
-        metrics: Dict[str, Any] = {"num_env_steps_sampled": steps_added,
+        # Env interaction is the episode step count; add_episodes' row
+        # count also includes one tail row per chunk (buffer accounting
+        # only — it must not inflate the training ratio).
+        env_steps = sum(len(e) for e in episodes)
+        self.replay.add_episodes(episodes)
+        metrics: Dict[str, Any] = {"num_env_steps_sampled": env_steps,
                                    "replay_buffer_size": len(self.replay)}
         if len(self.replay) < max(cfg.num_steps_sampled_before_learning_starts,
                                   cfg.batch_length_T):
             return metrics
         per_update = cfg.batch_size_B * cfg.batch_length_T
-        num_updates = max(1, round(cfg.training_ratio * steps_added
+        num_updates = max(1, round(cfg.training_ratio * env_steps
                                    / per_update))
         for _ in range(num_updates):
             batch = self.replay.sample(cfg.batch_size_B,

@@ -164,6 +164,33 @@ def cmd_summary(args):
     return 0
 
 
+def cmd_stack(args):
+    """Dump every live worker's Python stacks (reference `ray stack`,
+    py-spy based; here workers self-report via the profile op)."""
+    client = _client()
+    workers = client.call({"op": "list_workers"})
+    shown = 0
+    for w in workers:
+        if w.get("state") == "dead" or not w.get("worker_id"):
+            continue
+        if args.worker and not w["worker_id"].startswith(args.worker):
+            continue
+        try:
+            dump = client.call({"op": "profile_worker",
+                                "worker_hex": w["worker_id"],
+                                "kind": "stack", "timeout_s": 10})
+        except Exception as e:  # noqa: BLE001
+            dump = f"<unavailable: {e}>"
+        print(f"===== worker {w['worker_id'][:12]} "
+              f"(pid {w.get('pid')}, {w.get('kind')}, "
+              f"{w.get('state')}) =====")
+        print(dump)
+        shown += 1
+    if not shown:
+        print("no live workers matched")
+    return 0
+
+
 def cmd_memory(args):
     client = _client()
     rows = client.call({"op": "list_objects"})
@@ -278,6 +305,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("memory", help="object store contents")
     sp.set_defaults(fn=cmd_memory)
+
+    sp = sub.add_parser("stack", help="dump live workers' Python stacks")
+    sp.add_argument("worker", nargs="?", default="",
+                    help="worker hex prefix filter (default: all)")
+    sp.set_defaults(fn=cmd_stack)
 
     sp = sub.add_parser("job", help="job submission")
     jsub = sp.add_subparsers(dest="job_cmd", required=True)

@@ -16,6 +16,7 @@ from ray_tpu.serve.api import (
     run,
     shutdown,
     start,
+    start_frame_ingress,
     status,
 )
 from ray_tpu.serve.batching import batch
@@ -44,6 +45,7 @@ __all__ = [
     "get_app_handle",
     "get_deployment_handle",
     "proxy_address",
+    "start_frame_ingress",
     "DeploymentHandle",
     "DeploymentResponse",
     "AutoscalingConfig",

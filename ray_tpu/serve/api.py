@@ -151,6 +151,18 @@ def proxy_address() -> Optional[str]:
         _get_controller().proxy_address.remote(), timeout=30)
 
 
+def start_frame_ingress() -> str:
+    """Start (idempotently) the frame-protocol ingress and return its
+    host:port. Counterpart of enabling the reference's gRPC proxy
+    (grpc_options on serve.start): non-HTTP clients send one JSON frame
+    {"op": "serve_request", "route": ..., "payload": ...} over the
+    framed RPC wire (core/rpc.py kind 3) — the same protocol the C++
+    frontend speaks."""
+    controller = _get_controller()
+    ray_tpu.get(controller.ensure_frame_proxy.remote(), timeout=30)
+    return ray_tpu.get(controller.frame_proxy_address.remote(), timeout=30)
+
+
 def shutdown():
     """Tear down all applications and the serve control plane."""
     global _controller

@@ -205,6 +205,24 @@ class ServeController:
             return None
         return ray_tpu.get(proxy.address.remote(), timeout=timeout)
 
+    def ensure_frame_proxy(self) -> None:
+        """Start the frame-protocol ingress actor once (counterpart of
+        the reference's gRPC proxy, started alongside HTTP)."""
+        with self._lock:
+            if getattr(self, "_frame_proxy_handle", None) is not None:
+                return
+            from ray_tpu.serve.proxy import FrameProxy
+
+            self._frame_proxy_handle = ray_tpu.remote(FrameProxy).options(
+                max_concurrency=4, num_cpus=0).remote(self._http[0], 0)
+
+    def frame_proxy_address(self, timeout: float = 20.0) -> Optional[str]:
+        with self._lock:
+            proxy = getattr(self, "_frame_proxy_handle", None)
+        if proxy is None:
+            return None
+        return ray_tpu.get(proxy.address.remote(), timeout=timeout)
+
     # ------------------------------------------------------------------
     # Introspection (routers, proxies, serve.status)
     def listen_for_change(self, known: Dict[str, int],

@@ -45,12 +45,68 @@ class Request:
                           self.headers))
 
 
-class HTTPProxy:
+class _RouteTable:
+    """Shared proxy plumbing: a route table kept fresh via the
+    controller's long-poll 'routes' key + longest-prefix matching.
+    Extended by the HTTP and frame-protocol ingresses."""
+
+    def _init_routes(self):
+        self._routes: Dict[str, Tuple[str, str]] = {}
+        self._routes_lock = threading.Lock()
+        self._stop = threading.Event()
+        threading.Thread(target=self._route_poll_loop,
+                         name="proxy-routes", daemon=True).start()
+
+    def _route_poll_loop(self):
+        from ray_tpu.serve.controller import (
+            CONTROLLER_NAME,
+            SERVE_NAMESPACE,
+        )
+
+        controller = None
+        known = {"routes": 0}
+        while not self._stop.is_set():
+            try:
+                if controller is None:
+                    controller = ray_tpu.get_actor(
+                        CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
+                    with self._routes_lock:
+                        self._routes = ray_tpu.get(
+                            controller.get_routes.remote(), timeout=10)
+                changed = ray_tpu.get(
+                    controller.listen_for_change.remote(
+                        known, LISTEN_TIMEOUT_S),
+                    timeout=LISTEN_TIMEOUT_S + 5)
+                for key, (version, value) in (changed or {}).items():
+                    if key == "routes":
+                        known[key] = version
+                        with self._routes_lock:
+                            self._routes = value or {}
+            except Exception:
+                controller = None
+                time.sleep(0.5)
+
+    def _match_route(self, path: str) -> Optional[Tuple[str, str, str]]:
+        with self._routes_lock:
+            routes = dict(self._routes)
+        best = None
+        for prefix, (app, ingress) in routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(
+                    norm if norm != "/" else "/"):
+                if norm != "/" and not (
+                        path == norm or path[len(norm):][:1] in ("/", "?")):
+                    continue
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, app, ingress)
+        return best
+
+
+class HTTPProxy(_RouteTable):
     """Actor: serves HTTP on (host, port); routes to ingress handles."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
-        self._routes: Dict[str, Tuple[str, str]] = {}
-        self._routes_lock = threading.Lock()
+        self._init_routes()
         proxy = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -89,9 +145,6 @@ class HTTPProxy:
                       f"{self._server.server_address[1]}")
         threading.Thread(target=self._server.serve_forever,
                          name="http-proxy", daemon=True).start()
-        self._stop = threading.Event()
-        threading.Thread(target=self._route_poll_loop,
-                         name="proxy-routes", daemon=True).start()
 
     # -- control --------------------------------------------------------
     def address(self) -> str:
@@ -100,51 +153,7 @@ class HTTPProxy:
     def ping(self) -> str:
         return "pong"
 
-    def _route_poll_loop(self):
-        from ray_tpu.serve.controller import (
-            CONTROLLER_NAME,
-            SERVE_NAMESPACE,
-        )
-
-        controller = None
-        known = {"routes": 0}
-        while not self._stop.is_set():
-            try:
-                if controller is None:
-                    controller = ray_tpu.get_actor(
-                        CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
-                    with self._routes_lock:
-                        self._routes = ray_tpu.get(
-                            controller.get_routes.remote(), timeout=10)
-                changed = ray_tpu.get(
-                    controller.listen_for_change.remote(
-                        known, LISTEN_TIMEOUT_S),
-                    timeout=LISTEN_TIMEOUT_S + 5)
-                for key, (version, value) in (changed or {}).items():
-                    if key == "routes":
-                        known[key] = version
-                        with self._routes_lock:
-                            self._routes = value or {}
-            except Exception:
-                controller = None
-                time.sleep(0.5)
-
     # -- data plane -----------------------------------------------------
-    def _match_route(self, path: str) -> Optional[Tuple[str, str, str]]:
-        with self._routes_lock:
-            routes = dict(self._routes)
-        best = None
-        for prefix, (app, ingress) in routes.items():
-            norm = prefix.rstrip("/") or "/"
-            if path == norm or path.startswith(
-                    norm if norm != "/" else "/"):
-                if norm != "/" and not (
-                        path == norm or path[len(norm):][:1] in ("/", "?")):
-                    continue
-                if best is None or len(norm) > len(best[0]):
-                    best = (norm, app, ingress)
-        return best
-
     def _handle(self, method: str, raw_path: str, body: bytes,
                 headers: Dict[str, str]) -> Tuple[int, bytes]:
         parsed = urlparse(raw_path)
@@ -172,3 +181,48 @@ class HTTPProxy:
             return 200, json.dumps(result).encode()
         except TypeError:
             return 200, json.dumps(str(result)).encode()
+
+
+class FrameProxy(_RouteTable):
+    """Cross-language ingress over the framed RPC wire (counterpart of
+    the reference's gRPCProxy, serve/_private/proxy.py:540).
+
+    Clients send ONE JSON frame (core/rpc.py kind 3 — the same protocol
+    the C++ frontend speaks):
+
+        {"op": "serve_request", "route": "/app", "payload": <json>}
+
+    and receive {"status": "ok", "result": <json>}. The ingress callable
+    sees the same Request object an HTTP call would produce (method
+    "FRAME", body = JSON-encoded payload), so one deployment serves both
+    ingresses.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        from ray_tpu.core import rpc
+
+        self._init_routes()
+        self._server = rpc.Server(self._handle_msg, host=host, port=port)
+
+    def address(self) -> str:
+        return f"{self._server.host}:{self._server.port}"
+
+    def ping(self) -> str:
+        return "pong"
+
+    def _handle_msg(self, conn, msg: dict):
+        if msg.get("op") != "serve_request":
+            raise ValueError(f"unknown op {msg.get('op')!r}")
+        route = msg.get("route", "/")
+        match = self._match_route(route)
+        if match is None:
+            raise ValueError(f"no application at {route}")
+        _, app, ingress = match
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        handle = DeploymentHandle(ingress, app)
+        req = Request("FRAME", route, {},
+                      json.dumps(msg.get("payload")).encode(),
+                      dict(msg.get("headers") or {}))
+        return handle.remote(req).result(
+            timeout_s=float(msg.get("timeout_s", 60)))

@@ -65,6 +65,30 @@ def list_placement_groups(filters=None, limit: int = 10000) -> List[dict]:
     return _list("placement_groups", filters, limit)
 
 
+def profile_worker(worker_hex: str, kind: str = "stack",
+                   duration_s: float = 2.0):
+    """Profile a live worker on demand (reference: dashboard reporter
+    profile_manager.py py-spy/memray drivers; `ray stack`).
+
+    kind='stack' returns an all-thread Python stack dump; 'jax_trace'
+    records a process-wide jax.profiler (xplane) trace for duration_s
+    seconds and returns the trace directory path."""
+    from ray_tpu.core.runtime import get_runtime
+
+    rt = get_runtime()
+    if worker_hex == rt.core.worker_hex:
+        # Self-profile runs locally: routing it through the control
+        # plane would wait on a reply that must arrive on the very
+        # connection this call is blocking.
+        result = {}
+        rt.core._run_profile({"kind": kind, "duration_s": duration_s,
+                              "_local_result": result})
+        return result["data"]
+    return rt.core.client.call({
+        "op": "profile_worker", "worker_hex": worker_hex,
+        "kind": kind, "duration_s": duration_s})
+
+
 def summarize_tasks() -> Dict[str, Any]:
     """Counts by state and by function name (reference `ray summary
     tasks`)."""

@@ -24,10 +24,17 @@ from ray_tpu.workflow.api import (
     run,
     run_async,
 )
+from ray_tpu.workflow.event import (
+    EventListener,
+    KVEventListener,
+    TimerListener,
+    wait_for_event,
+)
 
 __all__ = [
     "WorkflowStatus", "run", "run_async", "resume", "resume_async",
     "get_status", "get_output", "list_all", "cancel", "delete",
+    "EventListener", "KVEventListener", "TimerListener", "wait_for_event",
 ]
 
 # Feature-usage tag (util/usage_stats.py; local-only, no egress).

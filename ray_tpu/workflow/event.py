@@ -1,0 +1,120 @@
+"""Workflow events: steps that wait for external signals.
+
+Counterpart of the reference's workflow event system
+(python/ray/workflow/api.py wait_for_event + event_listener.py
+EventListener ABC + http_event_provider.py): a workflow step that blocks
+until an external event arrives, with the event payload checkpointed like
+any step result — on resume a received event is NOT waited for again.
+
+The HTTP event provider counterpart is the dashboard endpoint
+POST /api/events/<key> (dashboard/http_head.py), which writes the JSON
+body into the cluster KV under ``workflow_event/<key>``;
+``KVEventListener`` polls that key.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Type
+
+from ray_tpu.dag.dag_node import DAGNode
+
+EVENT_KV_PREFIX = "workflow_event/"
+
+
+def _raise_cancelled():
+    # Lazy import: executor.py imports this module at top level.
+    from ray_tpu.workflow.executor import WorkflowCancelled
+
+    raise WorkflowCancelled("workflow cancelled while waiting for event")
+
+
+class EventListener:
+    """Waits for one event (reference workflow/event_listener.py:
+    EventListenerType.poll_for_event)."""
+
+    def poll_for_event(self,
+                       should_cancel: Optional[Callable[[], bool]] = None
+                       ) -> Any:
+        """Block until the event arrives; return its payload.
+        Implementations should check ``should_cancel()`` periodically and
+        raise WorkflowCancelled-compatible errors via it."""
+        raise NotImplementedError
+
+
+class TimerListener(EventListener):
+    """Fires after a delay (reference workflow examples' TimerListener)."""
+
+    def __init__(self, delay_s: float):
+        self.delay_s = float(delay_s)
+
+    def poll_for_event(self, should_cancel=None) -> float:
+        deadline = time.time() + self.delay_s
+        while time.time() < deadline:
+            if should_cancel is not None and should_cancel():
+                _raise_cancelled()
+            time.sleep(min(0.1, max(0.0, deadline - time.time())))
+        return deadline
+
+
+class KVEventListener(EventListener):
+    """Waits for a cluster-KV key under ``workflow_event/`` — the
+    in-cluster half of the HTTP event provider (events arrive via
+    POST /api/events/<key> on the dashboard, or kv_put from any client).
+
+    The key is consumed (deleted) on receipt so a resumed workflow run
+    relies on the checkpointed payload, not a stale KV entry."""
+
+    def __init__(self, key: str, poll_interval_s: float = 0.2,
+                 consume: bool = True):
+        self.key = key
+        self.poll_interval_s = float(poll_interval_s)
+        self.consume = consume
+
+    def poll_for_event(self, should_cancel=None) -> Any:
+        from ray_tpu.experimental.internal_kv import kv_del, kv_get
+
+        full_key = EVENT_KV_PREFIX + self.key
+        while True:
+            if should_cancel is not None and should_cancel():
+                _raise_cancelled()
+            value = kv_get(full_key)
+            if value is not None:
+                if self.consume:
+                    kv_del(full_key)
+                return value
+            time.sleep(self.poll_interval_s)
+
+
+class EventNode(DAGNode):
+    """A DAG node that resolves to an event payload. No upstream deps;
+    executed inline by the workflow executor (not as a cluster task) so
+    cancellation can interrupt the wait."""
+
+    def __init__(self, listener_factory: Callable[[], EventListener],
+                 name: str):
+        super().__init__(args=(), kwargs={})
+        self._listener_factory = listener_factory
+        self._name = name
+
+    def _poll(self, should_cancel: Optional[Callable[[], bool]] = None):
+        return self._listener_factory().poll_for_event(should_cancel)
+
+
+def wait_for_event(listener: "Type[EventListener] | EventListener",
+                   *args, name: str = "event", **kwargs) -> EventNode:
+    """Create an event step (reference workflow.wait_for_event).
+
+    Accepts an EventListener subclass plus its constructor args, or a
+    ready instance. The returned node can be bound into a workflow DAG
+    like any step output."""
+    if isinstance(listener, EventListener):
+        factory = lambda: listener  # noqa: E731
+    else:
+        if not (isinstance(listener, type)
+                and issubclass(listener, EventListener)):
+            raise TypeError(
+                "wait_for_event expects an EventListener subclass or "
+                f"instance, got {listener!r}")
+        factory = lambda: listener(*args, **kwargs)  # noqa: E731
+    return EventNode(factory, name)

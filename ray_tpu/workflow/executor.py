@@ -19,6 +19,7 @@ from ray_tpu.dag.dag_node import (
     InputNode,
     MultiOutputNode,
 )
+from ray_tpu.workflow.event import EventNode
 from ray_tpu.workflow.storage import WorkflowStorage
 
 
@@ -68,7 +69,7 @@ class WorkflowExecutor:
             if not wave:
                 raise RuntimeError("workflow DAG has a cycle")
             refs = []
-            ref_nodes = []
+            event_waits = []
             for node in wave:
                 idx = order.index(node)
                 key = _step_key(node, idx, prefix)
@@ -82,12 +83,41 @@ class WorkflowExecutor:
                 if self.storage.has_step(key):
                     results[node._uid] = self.storage.load_step(key)
                     continue
+                if isinstance(node, EventNode):
+                    # Event steps run in-executor (not as cluster tasks)
+                    # so the wait is interruptible by cancel(); polled on
+                    # side threads AFTER the wave's cluster tasks are
+                    # submitted, so an event can't starve parallel steps.
+                    # The payload checkpoints like any step — a resumed
+                    # workflow does not wait for a received event again.
+                    event_waits.append((key, node))
+                    continue
                 ref = self._submit(node, results)
                 refs.append((key, node, ref))
+            event_threads = []
+            for key, node in event_waits:
+                box: Dict[str, Any] = {}
+
+                def poll(node=node, box=box):
+                    try:
+                        box["value"] = node._poll(self.cancel_ev.is_set)
+                    except BaseException as e:  # noqa: BLE001
+                        box["error"] = e
+
+                t = threading.Thread(target=poll, daemon=True,
+                                     name=f"wf-event-{node._name}")
+                t.start()
+                event_threads.append((key, node, box, t))
             for key, node, ref in refs:
                 value = api.get([ref])[0]
                 self.storage.save_step(key, value)
                 results[node._uid] = value
+            for key, node, box, t in event_threads:
+                t.join()
+                if "error" in box:
+                    raise box["error"]
+                self.storage.save_step(key, box["value"])
+                results[node._uid] = box["value"]
             pending = [n for n in pending if n._uid not in results]
         return results[dag._uid]
 

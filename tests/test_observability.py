@@ -252,3 +252,64 @@ def test_dashboard_metrics_and_timeline_endpoints():
         assert isinstance(tl, list) and len(tl) >= 1
     finally:
         dash.stop()
+
+
+# ---------------------------------------------------------------------------
+# On-demand worker profiling (reference: dashboard reporter
+# profile_manager.py py-spy/memray; SURVEY §5 TPU-native jax.profiler add)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_stack_profile_of_busy_worker():
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.state.api import list_workers, profile_worker
+
+    @ray_tpu.remote
+    def snooze_for_profiler():
+        _time.sleep(4.0)
+        return 1
+
+    ref = snooze_for_profiler.remote()
+    # Wait until a pool worker is busy with it.
+    deadline = _time.time() + 15
+    busy = None
+    while busy is None and _time.time() < deadline:
+        busy = next((w for w in list_workers()
+                     if w["kind"] == "pool" and w["state"] == "busy"),
+                    None)
+        _time.sleep(0.05)
+    assert busy is not None
+    dump = profile_worker(busy["worker_id"], kind="stack")
+    assert "snooze_for_profiler" in dump, dump[:2000]
+    assert "Thread" in dump
+    assert ray_tpu.get(ref) == 1
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_jax_trace_profile_of_driver():
+    """jax_trace writes an xplane trace dir; profiling the driver keeps
+    the test hermetic (jax is already imported here)."""
+    import os as _os
+
+    import ray_tpu
+    from ray_tpu.state.api import profile_worker
+
+    rt = ray_tpu.init()
+    out_dir = profile_worker(rt.core.worker_hex, kind="jax_trace",
+                             duration_s=0.3)
+    assert _os.path.isdir(out_dir), out_dir
+    # The profiler wrote something (plugins/profile/... xplane files).
+    found = [f for _, _, fs in _os.walk(out_dir) for f in fs]
+    assert found, f"empty trace dir {out_dir}"
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_profile_unknown_worker_errors():
+    import pytest as _pytest
+
+    from ray_tpu.state.api import profile_worker
+
+    with _pytest.raises(Exception, match="no live worker"):
+        profile_worker("ff" * 14)

@@ -268,3 +268,56 @@ def test_delete_application(serve_instance):
     assert "togo" in serve.status()
     serve.delete("togo")
     assert "togo" not in serve.status()
+
+
+def test_frame_protocol_ingress(serve_instance):
+    """The frame ingress (gRPC-proxy counterpart) serves the SAME
+    deployment as HTTP: one JSON frame in, one JSON reply out, speaking
+    the exact wire a C++ client uses (core/rpc.py kind 3)."""
+    import socket
+    import struct
+
+    @serve.deployment
+    class EchoApi:
+        def __call__(self, request):
+            return {"got": request.json(), "via": request.method}
+
+    serve.run(EchoApi.bind(), name="frameapp", route_prefix="/frameapp")
+    addr = serve.start_frame_ingress()
+    assert addr and ":" in addr
+    assert serve.start_frame_ingress() == addr  # idempotent
+
+    host, port = addr.rsplit(":", 1)
+    frame = struct.Struct("<BQI")
+
+    def _recv(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            assert chunk, "connection closed"
+            buf += chunk
+        return buf
+
+    def call(body):
+        s = socket.create_connection((host, int(port)), timeout=30)
+        try:
+            payload = json.dumps(body).encode()
+            s.sendall(frame.pack(3, 1, len(payload)) + payload)
+            kind, _, length = frame.unpack(_recv(s, frame.size))
+            return json.loads(_recv(s, length))
+        finally:
+            s.close()
+
+    deadline = time.time() + 20
+    reply = None
+    while time.time() < deadline:
+        reply = call({"op": "serve_request", "route": "/frameapp",
+                      "payload": {"n": 7}})
+        if reply.get("status") == "ok":
+            break
+        time.sleep(0.3)  # route table still propagating
+    assert reply["status"] == "ok", reply
+    assert reply["result"] == {"got": {"n": 7}, "via": "FRAME"}
+
+    bad = call({"op": "serve_request", "route": "/nosuch"})
+    assert bad["status"] == "err" and "no application" in bad["error"]

@@ -114,3 +114,97 @@ def test_delete_removes_storage(ray_start_regular):
     workflow.delete(wid)
     with pytest.raises(ValueError):
         workflow.get_status(wid)
+
+
+# ---------------------------------------------------------------------------
+# Events (reference workflow event listeners + HTTP event provider)
+# ---------------------------------------------------------------------------
+
+def test_timer_event_step(ray_start_regular):
+    ev = workflow.wait_for_event(workflow.TimerListener, 0.2)
+    dag = add.bind(double.bind(ev), 0)
+    t0 = time.time()
+    out = workflow.run(dag, workflow_id="wf_timer", timeout=30)
+    assert time.time() - t0 >= 0.2
+    # Payload is the fire deadline (a timestamp), doubled by the step.
+    assert isinstance(out, float) and out > 2 * t0
+
+
+def test_kv_event_step_and_resume(ray_start_regular):
+    """The workflow blocks until the event is posted; after completion a
+    resume re-serves the checkpointed payload without waiting again."""
+    from ray_tpu.experimental.internal_kv import kv_put
+    from ray_tpu.workflow.event import EVENT_KV_PREFIX
+
+    ev = workflow.wait_for_event(workflow.KVEventListener, "go",
+                                 poll_interval_s=0.05)
+    dag = double.bind(ev)
+    wid = workflow.run_async(dag, workflow_id="wf_kv_event")
+    time.sleep(0.3)
+    assert workflow.get_status(wid) == workflow.WorkflowStatus.RUNNING
+    kv_put(EVENT_KV_PREFIX + "go", 21)
+    assert workflow.get_output(wid, timeout=30) == 42
+    # Event key was consumed; resume must NOT block on it again.
+    assert workflow.resume("wf_kv_event", timeout=10) == 42
+
+
+def test_http_event_provider_endpoint(ray_start_regular):
+    """POST /api/events/<key> on the dashboard delivers a KV event."""
+    import json as _json
+    import urllib.request
+
+    from ray_tpu.dashboard.http_head import Dashboard
+
+    rt = ray_tpu.init()  # same runtime (double-init returns it)
+    dash = Dashboard(rt)
+    try:
+        ev = workflow.wait_for_event(workflow.KVEventListener, "httpkey",
+                                     poll_interval_s=0.05)
+        wid = workflow.run_async(double.bind(ev),
+                                 workflow_id="wf_http_event")
+        req = urllib.request.Request(
+            dash.url + "/api/events/httpkey",
+            data=_json.dumps(5).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert _json.loads(resp.read())["status"] == "ok"
+        assert workflow.get_output(wid, timeout=30) == 10
+    finally:
+        dash.stop()
+
+
+def test_cancel_while_waiting_for_event(ray_start_regular):
+    """cancel() during an event wait ends the run as CANCELED (not
+    FAILED) and does not checkpoint the event."""
+    ev = workflow.wait_for_event(workflow.KVEventListener, "never",
+                                 poll_interval_s=0.05)
+    wid = workflow.run_async(double.bind(ev), workflow_id="wf_cancel_ev")
+    time.sleep(0.3)
+    workflow.cancel(wid)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        s = workflow.get_status(wid)
+        if s == workflow.WorkflowStatus.CANCELED:
+            break
+        time.sleep(0.05)
+    assert workflow.get_status(wid) == workflow.WorkflowStatus.CANCELED
+
+
+def test_event_does_not_starve_parallel_steps(ray_start_regular):
+    """A same-wave cluster task runs (and can trigger the event) while
+    the event step is still waiting — events poll on side threads."""
+    from ray_tpu.experimental.internal_kv import kv_put
+    from ray_tpu.workflow.event import EVENT_KV_PREFIX
+
+    @ray_tpu.remote
+    def poster():
+        import ray_tpu as rt2
+        from ray_tpu.experimental.internal_kv import kv_put as _put
+        _put(EVENT_KV_PREFIX + "from_task", 11)
+        return 1
+
+    ev = workflow.wait_for_event(workflow.KVEventListener, "from_task",
+                                 poll_interval_s=0.05)
+    dag = MultiOutputNode([ev, poster.bind()])
+    out = workflow.run(dag, workflow_id="wf_parallel_ev", timeout=30)
+    assert out == [11, 1]
