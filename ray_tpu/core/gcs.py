@@ -2020,9 +2020,10 @@ class ControlServer:
     # profile_manager.py py-spy/memray drivers; TPU-native addition per
     # SURVEY.md §5: jax.profiler traces of live workers)
     def _op_profile_worker(self, conn, msg):
-        """Ask a live worker for a profile and wait for its reply.
-        kind: 'stack' (all-thread dump) | 'jax_trace' (xplane trace dir).
-        Blocks this connection's handler thread only."""
+        """Ask a live worker for a profile; the reply resolves a
+        Deferred so the CALLER's connection thread is never blocked (its
+        other in-flight control calls proceed during a long trace).
+        kind: 'stack' (all-thread dump) | 'jax_trace' (xplane dir)."""
         worker_hex = msg["worker_hex"]
         timeout = float(msg.get("timeout_s", 0) or
                         (float(msg.get("duration_s", 2.0)) + 30.0))
@@ -2031,35 +2032,37 @@ class ControlServer:
             if w is None or w.conn is None or w.state == "dead":
                 raise ValueError(f"no live worker {worker_hex}")
             if w.conn is conn:
-                # The reply would arrive on THIS connection, whose only
-                # handler thread is the one about to block here. Callers
+                # The reply would arrive on THIS connection, inside the
+                # request the target would have to answer. Callers
                 # profile themselves locally (state/api.py shortcut).
                 raise ValueError(
                     "cannot profile the requesting process through the "
                     "control plane; take the dump locally")
             token = uuid.uuid4().hex
-            from concurrent.futures import Future as _F
-
+            deferred = rpc.Deferred()
             if not hasattr(self, "_profile_waiters"):
                 self._profile_waiters = {}
-            fut = self._profile_waiters[token] = _F()
+            self._profile_waiters[token] = deferred
             w.conn.push({"op": "profile", "token": token,
                          "kind": msg.get("kind", "stack"),
                          "duration_s": float(msg.get("duration_s", 2.0))})
-        try:
-            return fut.result(timeout=timeout)
-        except TimeoutError:
-            raise TimeoutError(
-                f"worker {worker_hex} did not reply to profile request "
-                f"within {timeout:.0f}s") from None
-        finally:
-            self._profile_waiters.pop(token, None)
+
+        def on_timeout():
+            if self._profile_waiters.pop(token, None) is not None:
+                deferred.reject(TimeoutError(
+                    f"worker {worker_hex} did not reply to profile "
+                    f"request within {timeout:.0f}s"))
+
+        timer = threading.Timer(timeout, on_timeout)
+        timer.daemon = True
+        timer.start()
+        return deferred
 
     def _op_profile_result(self, conn, msg):
-        waiters = getattr(self, "_profile_waiters", {})
-        fut = waiters.get(msg.get("token"))
-        if fut is not None and not fut.done():
-            fut.set_result(msg.get("data"))
+        deferred = getattr(self, "_profile_waiters", {}).pop(
+            msg.get("token"), None)
+        if deferred is not None:
+            deferred.resolve(msg.get("data"))
 
     def _op_get_runtime_env(self, conn, msg):
         with self.lock:

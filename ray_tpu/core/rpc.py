@@ -124,12 +124,59 @@ class Connection:
             pass
 
 
+class Deferred:
+    """Deferred response for long-running ops (pickle-frame requests
+    only): return one from a server handler to free the connection's
+    serve loop immediately; call resolve()/reject() from any thread to
+    send the reply. Resolution before bind() (handler still returning)
+    is buffered; double-resolution is ignored."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._conn: Optional[Connection] = None
+        self._req_id: Optional[int] = None
+        self._outcome = None  # ("ok", v) | ("err", e) buffered pre-bind
+
+    def bind(self, conn: "Connection", req_id: int):
+        with self._lock:
+            outcome = self._outcome
+            if outcome is None:
+                self._conn, self._req_id = conn, req_id
+                return
+            self._outcome = None
+        # Resolved before bind: reply now; conn is never stored, so a
+        # concurrent second resolution can't double-send.
+        try:
+            conn.respond(req_id, outcome)
+        except Exception:
+            pass
+
+    def resolve(self, value: Any):
+        self._finish(("ok", value))
+
+    def reject(self, error: BaseException):
+        self._finish(("err", error))
+
+    def _finish(self, outcome):
+        with self._lock:
+            if self._conn is None:
+                if self._outcome is None:
+                    self._outcome = outcome
+                return
+            conn, req_id = self._conn, self._req_id
+            self._conn = None  # double-resolve becomes a no-op
+        try:
+            conn.respond(req_id, outcome)
+        except Exception:
+            pass
+
+
 class Server:
     """Threaded RPC server.
 
     handler(conn, msg) -> response | None. Called on a per-connection thread;
-    long handlers should offload.  on_disconnect(conn) fires when a peer
-    drops — the raylet's worker-death detection hook.
+    long handlers should offload (or return a Deferred).  on_disconnect(conn)
+    fires when a peer drops — the raylet's worker-death detection hook.
     """
 
     def __init__(
@@ -203,6 +250,14 @@ class Server:
                 if kind == KIND_REQUEST:
                     try:
                         result = self._handler(conn, msg)
+                        if isinstance(result, Deferred):
+                            # Long-running op: the handler parks the
+                            # response; another thread resolves it later.
+                            # This connection's serve loop moves on so
+                            # the client's other in-flight calls aren't
+                            # head-of-line blocked.
+                            result.bind(conn, req_id)
+                            continue
                         conn.respond(req_id, ("ok", result))
                     except Exception as e:  # noqa: BLE001
                         conn.respond(req_id, ("err", e))

@@ -7,6 +7,7 @@ schedulers, experiment state on disk.
 
 from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
+    HyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
@@ -59,6 +60,7 @@ __all__ = [
     "TrialScheduler",
     "FIFOScheduler",
     "AsyncHyperBandScheduler",
+    "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
 ]

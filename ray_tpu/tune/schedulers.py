@@ -192,3 +192,91 @@ class PopulationBasedTraining(TrialScheduler):
                     factor = 1.2 if self._rng.random() < 0.5 else 0.8
                     config[key] = type(cur)(cur * factor)
         return config
+
+
+class _HBBracket:
+    """One successive-halving bracket: n0 starting trials, first rung
+    budget r0, promoted survivors get eta× budget per rung."""
+
+    def __init__(self, s: int, eta: float, max_t: int, s_max: int):
+        self.eta = eta
+        self.max_t = max_t
+        self.n0 = int(math.ceil((s_max + 1) / (s + 1) * eta ** s))
+        self.r = max(1, int(round(max_t * eta ** (-s))))
+        self.members: Dict[str, Any] = {}       # trial_id -> Trial
+        self.rung_scores: Dict[str, float] = {}  # at the CURRENT rung
+
+    def has_room(self) -> bool:
+        return len(self.members) < self.n0
+
+    def live_ids(self) -> List[str]:
+        return [tid for tid, t in self.members.items()
+                if t.state not in ("TERMINATED", "ERROR")]
+
+
+class HyperBandScheduler(TrialScheduler):
+    """Synchronous HyperBand (reference tune/schedulers/hyperband.py
+    HyperBandScheduler, Li et al. 2018).
+
+    Trials are assigned round-robin over a band of brackets s_max..0
+    (aggressive early-stopping down to no early-stopping); within a
+    bracket each trial PAUSEs at the rung boundary until the whole
+    cohort arrives, then the top 1/eta continue with eta× budget and the
+    rest stop. Pause/resume is driven through the controller's
+    poll_paused hook (tune_controller.py _apply_unpause_decisions)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 max_t: int = 81, reduction_factor: float = 3):
+        self._time_attr = time_attr
+        self._max_t = int(max_t)
+        self._eta = float(reduction_factor)
+        self._s_max = max(0, int(math.log(max_t) / math.log(
+            reduction_factor)))
+        self._brackets: List[_HBBracket] = []
+        self._by_trial: Dict[str, _HBBracket] = {}
+
+    def on_trial_add(self, trial):
+        b = next((b for b in self._brackets if b.has_room()), None)
+        if b is None:
+            s = self._s_max - (len(self._brackets) % (self._s_max + 1))
+            b = _HBBracket(s, self._eta, self._max_t, self._s_max)
+            self._brackets.append(b)
+        b.members[trial.trial_id] = trial
+        self._by_trial[trial.trial_id] = b
+
+    def on_trial_result(self, trial, result):
+        t = result.get(self._time_attr)
+        score = self._score(result)
+        if t is None or score is None:
+            return CONTINUE
+        if t >= self._max_t:
+            return STOP
+        b = self._by_trial.get(trial.trial_id)
+        if b is None:  # restored run predating the bracket assignment
+            self.on_trial_add(trial)
+            b = self._by_trial[trial.trial_id]
+        if t >= b.r:
+            b.rung_scores[trial.trial_id] = score
+            return PAUSE  # wait for the cohort at this rung
+        return CONTINUE
+
+    def poll_paused(self) -> Dict[str, str]:
+        """Rung barrier: once every live member of a bracket has banked
+        a score for the current rung, promote the top 1/eta."""
+        decisions: Dict[str, str] = {}
+        for b in self._brackets:
+            live = b.live_ids()
+            if not live or not all(tid in b.rung_scores for tid in live):
+                continue
+            ranked = sorted(live, key=lambda tid: b.rung_scores[tid],
+                            reverse=True)
+            keep = max(1, int(math.ceil(len(live) / self._eta)))
+            for tid in ranked[:keep]:
+                decisions[tid] = CONTINUE
+            for tid in ranked[keep:]:
+                decisions[tid] = STOP
+            # Survivors run to the next rung (trials hitting max_t stop
+            # individually in on_trial_result, so no rung forms there).
+            b.r = min(int(round(b.r * self._eta)), self._max_t)
+            b.rung_scores = {}
+        return decisions

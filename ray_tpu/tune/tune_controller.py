@@ -26,6 +26,7 @@ from ray_tpu.tune.trainable import TrialRunner
 
 PENDING = "PENDING"
 RUNNING = "RUNNING"
+PAUSED = "PAUSED"
 TERMINATED = "TERMINATED"
 ERROR = "ERROR"
 
@@ -95,11 +96,15 @@ class TuneController:
             # finite searchers).
             self._num_samples = len(self.trials)
             return
+        on_add = getattr(self._scheduler, "on_trial_add", None)
         for cfg in configs:
             i = len(self.trials)
-            self.trials.append(Trial(
+            t = Trial(
                 trial_id=f"trial_{i:05d}", config=cfg,
-                trial_dir=os.path.join(self._run_dir, f"trial_{i:05d}")))
+                trial_dir=os.path.join(self._run_dir, f"trial_{i:05d}"))
+            self.trials.append(t)
+            if on_add is not None:
+                on_add(t)
         if configs:
             self._configs_dirty = True
 
@@ -107,9 +112,10 @@ class TuneController:
     def run(self) -> List[Trial]:
         try:
             while (len(self.trials) < self._num_samples
-                   or any(t.state in (PENDING, RUNNING)
+                   or any(t.state in (PENDING, RUNNING, PAUSED)
                           for t in self.trials)):
                 self._maybe_create_trials()
+                self._apply_unpause_decisions()
                 self._start_pending()
                 self._poll_running()
                 self._save_experiment_state()
@@ -196,6 +202,41 @@ class TuneController:
             self._complete(t)
         elif decision == PAUSE and t.exploit_directive:
             self._exploit(t)
+        elif decision == PAUSE:
+            self._pause(t)
+
+    def _pause(self, t: Trial):
+        """Checkpoint + release the runner; the trial waits for the
+        scheduler's unpause decision (synchronous HyperBand rungs —
+        reference hyperband.py pauses trials at rung boundaries)."""
+        if t.runner is not None:
+            try:
+                path = ray_tpu.get(t.runner.save.remote(), timeout=60)
+                if path:
+                    t.last_checkpoint = path
+            except Exception:
+                pass
+        self._shutdown_runner(t)
+        t.state = PAUSED
+
+    def _apply_unpause_decisions(self):
+        """Ask the scheduler about paused trials (schedulers without
+        rung barriers never pause, so this is a no-op for them)."""
+        poll = getattr(self._scheduler, "poll_paused", None)
+        if poll is None:
+            return
+        for trial_id, decision in (poll() or {}).items():
+            t = next((x for x in self.trials
+                      if x.trial_id == trial_id), None)
+            if t is None or t.state != PAUSED:
+                continue
+            if decision == STOP:
+                t.state = TERMINATED
+                self._search.on_trial_complete(
+                    t.trial_id, t.last_result, config=t.config)
+                self._scheduler.on_trial_complete(t, t.last_result)
+            else:  # CONTINUE: resume from own checkpoint
+                t.state = PENDING
 
     def _should_stop(self, trial_id: str, metrics: Dict[str, Any]) -> bool:
         stop = self._stop

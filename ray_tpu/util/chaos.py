@@ -1,0 +1,121 @@
+"""Chaos / fault-injection utilities for resilience testing.
+
+Counterpart of the reference's ResourceKillerActor hierarchy
+(python/ray/_private/test_utils.py:1433 — RayletKiller :1536,
+WorkerKillerActor :1597) wired into release tests via
+release/nightly_tests/setup_chaos.py: kill a class of resource on an
+interval while a workload runs, and assert the workload still completes.
+
+Killers run on a daemon thread in the calling process (they only need
+control-plane access); `.start()` / `.stop()`, kill history on
+`.killed`.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class ResourceKiller:
+    """Base: every `interval_s`, pick a target and kill it."""
+
+    def __init__(self, interval_s: float = 1.0,
+                 max_kills: Optional[int] = None,
+                 warmup_s: float = 0.0):
+        self.interval_s = float(interval_s)
+        self.max_kills = max_kills
+        self.warmup_s = float(warmup_s)
+        self.killed: List[Dict[str, Any]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- override ------------------------------------------------------
+    def find_target(self) -> Optional[Any]:
+        raise NotImplementedError
+
+    def kill(self, target: Any) -> bool:
+        raise NotImplementedError
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "ResourceKiller":
+        self._thread = threading.Thread(
+            target=self._loop, name=type(self).__name__, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self):
+        if self.warmup_s and self._stop.wait(self.warmup_s):
+            return
+        while not self._stop.is_set():
+            if self.max_kills is not None and \
+                    len(self.killed) >= self.max_kills:
+                return
+            try:
+                target = self.find_target()
+                if target is not None and self.kill(target):
+                    self.killed.append(
+                        {"target": target, "at": time.time()})
+            except Exception:
+                pass
+            if self._stop.wait(self.interval_s):
+                return
+
+
+class WorkerKiller(ResourceKiller):
+    """SIGKILL a random busy pool worker (reference WorkerKillerActor:
+    exercises task retry + lineage reconstruction paths)."""
+
+    def __init__(self, interval_s: float = 1.0, **kw):
+        super().__init__(interval_s, **kw)
+        import random
+
+        self._rng = random.Random(0)
+
+    def find_target(self) -> Optional[int]:
+        from ray_tpu.state.api import list_workers
+
+        busy = [w for w in list_workers()
+                if w["kind"] == "pool" and w["state"] == "busy"
+                and w.get("pid")]
+        if not busy:
+            return None
+        return int(self._rng.choice(busy)["pid"])
+
+    def kill(self, pid: int) -> bool:
+        try:
+            os.kill(pid, signal.SIGKILL)
+            return True
+        except OSError:
+            return False
+
+
+class NodeKiller(ResourceKiller):
+    """Remove a random non-head node (reference RayletKiller via
+    Cluster.remove_node: exercises PG teardown, task respill, actor
+    restart on surviving nodes)."""
+
+    def __init__(self, cluster, interval_s: float = 3.0, **kw):
+        super().__init__(interval_s, **kw)
+        self.cluster = cluster
+        import random
+
+        self._rng = random.Random(0)
+
+    def find_target(self) -> Optional[str]:
+        nodes = [n["node_id"] for n in self.cluster.list_nodes()
+                 if n.get("alive") and not n.get("is_head")]
+        if not nodes:
+            return None
+        return self._rng.choice(nodes)
+
+    def kill(self, node_id: str) -> bool:
+        return bool(self.cluster.remove_node(node_id))

@@ -41,6 +41,12 @@ class EventListener:
         raise WorkflowCancelled-compatible errors via it."""
         raise NotImplementedError
 
+    def post_checkpoint(self) -> None:
+        """Called by the executor AFTER the payload is durably
+        checkpointed. Side effects that would lose the event on a crash
+        (deleting the delivery record) belong here, not in
+        poll_for_event."""
+
 
 class TimerListener(EventListener):
     """Fires after a delay (reference workflow examples' TimerListener)."""
@@ -62,8 +68,10 @@ class KVEventListener(EventListener):
     in-cluster half of the HTTP event provider (events arrive via
     POST /api/events/<key> on the dashboard, or kv_put from any client).
 
-    The key is consumed (deleted) on receipt so a resumed workflow run
-    relies on the checkpointed payload, not a stale KV entry."""
+    The key is consumed (deleted) only after the executor has
+    checkpointed the payload (post_checkpoint), so a crash between
+    receipt and checkpoint cannot lose the event — the resumed run
+    re-reads it from the KV."""
 
     def __init__(self, key: str, poll_interval_s: float = 0.2,
                  consume: bool = True):
@@ -72,7 +80,7 @@ class KVEventListener(EventListener):
         self.consume = consume
 
     def poll_for_event(self, should_cancel=None) -> Any:
-        from ray_tpu.experimental.internal_kv import kv_del, kv_get
+        from ray_tpu.experimental.internal_kv import kv_get
 
         full_key = EVENT_KV_PREFIX + self.key
         while True:
@@ -80,10 +88,14 @@ class KVEventListener(EventListener):
                 _raise_cancelled()
             value = kv_get(full_key)
             if value is not None:
-                if self.consume:
-                    kv_del(full_key)
                 return value
             time.sleep(self.poll_interval_s)
+
+    def post_checkpoint(self) -> None:
+        if self.consume:
+            from ray_tpu.experimental.internal_kv import kv_del
+
+            kv_del(EVENT_KV_PREFIX + self.key)
 
 
 class EventNode(DAGNode):
@@ -98,7 +110,10 @@ class EventNode(DAGNode):
         self._name = name
 
     def _poll(self, should_cancel: Optional[Callable[[], bool]] = None):
-        return self._listener_factory().poll_for_event(should_cancel)
+        listener = self._listener_factory()
+        value = listener.poll_for_event(should_cancel)
+        listener.post_checkpoint()
+        return value
 
 
 def wait_for_event(listener: "Type[EventListener] | EventListener",

@@ -100,7 +100,10 @@ class WorkflowExecutor:
 
                 def poll(node=node, box=box):
                     try:
-                        box["value"] = node._poll(self.cancel_ev.is_set)
+                        listener = node._listener_factory()
+                        box["value"] = listener.poll_for_event(
+                            self.cancel_ev.is_set)
+                        box["listener"] = listener
                     except BaseException as e:  # noqa: BLE001
                         box["error"] = e
 
@@ -108,16 +111,32 @@ class WorkflowExecutor:
                                      name=f"wf-event-{node._name}")
                 t.start()
                 event_threads.append((key, node, box, t))
-            for key, node, ref in refs:
-                value = api.get([ref])[0]
-                self.storage.save_step(key, value)
-                results[node._uid] = value
-            for key, node, box, t in event_threads:
-                t.join()
-                if "error" in box:
-                    raise box["error"]
-                self.storage.save_step(key, box["value"])
-                results[node._uid] = box["value"]
+            try:
+                for key, node, ref in refs:
+                    value = api.get([ref])[0]
+                    self.storage.save_step(key, value)
+                    results[node._uid] = value
+                for key, node, box, t in event_threads:
+                    t.join()
+                    if "error" in box:
+                        raise box["error"]
+                    self.storage.save_step(key, box["value"])
+                    results[node._uid] = box["value"]
+                    # Consume the delivery record only now that the
+                    # payload is durably checkpointed: a crash before
+                    # this point leaves the event re-readable on resume.
+                    try:
+                        box["listener"].post_checkpoint()
+                    except Exception:
+                        pass
+            except BaseException:
+                # A failed task or event must not leak poll threads: a
+                # stale poller could otherwise swallow the event a
+                # RESUMED run of this workflow will wait for.
+                self.cancel_ev.set()
+                for _, _, _, t in event_threads:
+                    t.join(timeout=2)
+                raise
             pending = [n for n in pending if n._uid not in results]
         return results[dag._uid]
 

@@ -1,0 +1,90 @@
+"""Chaos tests (reference python/ray/tests/test_chaos.py +
+ResourceKillerActor, _private/test_utils.py:1433): workloads complete
+while workers/nodes are killed on an interval."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.chaos import NodeKiller, WorkerKiller
+
+
+def test_worker_killer_tasks_still_complete():
+    """Retriable tasks all finish while a WorkerKiller SIGKILLs busy
+    pool workers (task retry path, reference WorkerKillerActor)."""
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote(max_retries=5)
+        def chunk(i):
+            time.sleep(0.15)
+            return i * i
+
+        killer = WorkerKiller(interval_s=0.4, max_kills=3).start()
+        try:
+            refs = [chunk.remote(i) for i in range(40)]
+            out = ray_tpu.get(refs, timeout=120)
+        finally:
+            killer.stop()
+        assert out == [i * i for i in range(40)]
+        assert len(killer.killed) >= 1, "chaos never fired"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_worker_killer_with_lineage_reconstruction():
+    """Kills + lost shm objects together: downstream consumers still
+    resolve through retries and lineage re-execution."""
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote(max_retries=5)
+        def make(i):
+            time.sleep(0.05)
+            return np.full(60_000, i, dtype=np.int64)
+
+        @ray_tpu.remote(max_retries=5)
+        def reduce_sum(*parts):
+            return int(sum(int(p[0]) for p in parts))
+
+        killer = WorkerKiller(interval_s=0.3, max_kills=2).start()
+        try:
+            parts = [make.remote(i) for i in range(8)]
+            total = ray_tpu.get(reduce_sum.remote(*parts), timeout=120)
+        finally:
+            killer.stop()
+        assert total == sum(range(8))
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_node_killer_cluster_survives():
+    """Tasks keep completing while NodeKiller removes worker nodes; the
+    head continues serving (reference RayletKiller chaos)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2})
+    try:
+        for _ in range(2):
+            cluster.add_node(num_cpus=2)
+        assert len(cluster.node_ids) == 3
+
+        @ray_tpu.remote(max_retries=5)
+        def work(i):
+            time.sleep(0.1)
+            return i + 1
+
+        killer = NodeKiller(cluster, interval_s=0.5, max_kills=2,
+                            warmup_s=0.2).start()
+        try:
+            out = ray_tpu.get([work.remote(i) for i in range(30)],
+                              timeout=120)
+        finally:
+            killer.stop()
+        assert out == list(range(1, 31))
+        assert len(killer.killed) >= 1
+        alive = [n for n in cluster.list_nodes() if n["alive"]]
+        assert any(n["is_head"] for n in alive)
+    finally:
+        cluster.shutdown()

@@ -235,3 +235,61 @@ def test_median_stopping(rt, run_dir):
             r.metrics.get("training_iteration"))
     assert max(by_q[0.0]) < 10  # weak trials stopped early
     assert max(by_q[10.0]) == 10
+
+
+def test_hyperband_rung_barrier_and_promotion(rt, run_dir):
+    """Synchronous HyperBand: cohorts pause at rung boundaries; only the
+    top 1/eta of each bracket's cohort continues past its first rung
+    (reference tune/schedulers/hyperband.py)."""
+    def objective(config):
+        for step in range(1, 10):
+            tune.report({"score": config["q"] * step})
+
+    grid = tune.Tuner(
+        objective,
+        param_space={"q": tune.grid_search(
+            [0.1, 0.5, 1.0, 2.0, 4.0, 8.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max",
+            scheduler=tune.HyperBandScheduler(
+                max_t=9, reduction_factor=3),
+            max_concurrent_trials=6),
+        run_config=RunConfig(storage_path=run_dir, name="hyperband"),
+    ).fit()
+    iters = sorted(r.metrics.get("training_iteration", 0) for r in grid)
+    assert iters[0] < 9, iters      # some trials culled at a rung
+    assert iters[-1] == 9, iters    # a survivor ran to max_t
+    # The best trial (q=8.0) must have survived to max_t: score 8*9.
+    best = max(grid, key=lambda r: r.metrics.get("score", -1))
+    assert best.metrics["score"] == 72.0
+    assert best.metrics["training_iteration"] == 9
+
+
+def test_hyperband_unit_rung_math():
+    from ray_tpu.tune.schedulers import CONTINUE as C
+    from ray_tpu.tune.schedulers import PAUSE as P
+    from ray_tpu.tune.schedulers import STOP as S
+    from ray_tpu.tune.tune_controller import Trial
+
+    sched = tune.HyperBandScheduler(max_t=9, reduction_factor=3)
+    sched.set_objective("score", "max")
+    trials = [Trial(trial_id=f"t{i}", config={}, trial_dir="/tmp/x")
+              for i in range(3)]
+    for t in trials:
+        sched.on_trial_add(t)
+    b = sched._by_trial["t0"]
+    assert b.r >= 1
+    # Nobody pauses before the rung, everyone pauses at it.
+    assert sched.on_trial_result(
+        trials[0], {"training_iteration": 0, "score": 1}) == C \
+        or b.r <= 0
+    decisions = {}
+    for i, t in enumerate(trials):
+        d = sched.on_trial_result(
+            t, {"training_iteration": b.r, "score": float(i)})
+        decisions[t.trial_id] = d
+    assert all(d == P for d in decisions.values())
+    # Cohort complete: top ceil(3/3)=1 continues, two stop.
+    out = sched.poll_paused()
+    assert sorted(out.values()) == [C, S, S]
+    assert out["t2"] == C  # highest score survives
