@@ -2040,28 +2040,33 @@ class ControlServer:
                     "control plane; take the dump locally")
             token = uuid.uuid4().hex
             deferred = rpc.Deferred()
+
+            def on_timeout():
+                entry = self._profile_waiters.pop(token, None)
+                if entry is not None:
+                    entry[0].reject(TimeoutError(
+                        f"worker {worker_hex} did not reply to profile "
+                        f"request within {timeout:.0f}s"))
+
+            timer = threading.Timer(timeout, on_timeout)
+            timer.daemon = True
             if not hasattr(self, "_profile_waiters"):
                 self._profile_waiters = {}
-            self._profile_waiters[token] = deferred
+            # Register BEFORE the push: a fast worker's reply must find
+            # the waiter.
+            self._profile_waiters[token] = (deferred, timer)
             w.conn.push({"op": "profile", "token": token,
                          "kind": msg.get("kind", "stack"),
                          "duration_s": float(msg.get("duration_s", 2.0))})
-
-        def on_timeout():
-            if self._profile_waiters.pop(token, None) is not None:
-                deferred.reject(TimeoutError(
-                    f"worker {worker_hex} did not reply to profile "
-                    f"request within {timeout:.0f}s"))
-
-        timer = threading.Timer(timeout, on_timeout)
-        timer.daemon = True
         timer.start()
         return deferred
 
     def _op_profile_result(self, conn, msg):
-        deferred = getattr(self, "_profile_waiters", {}).pop(
+        entry = getattr(self, "_profile_waiters", {}).pop(
             msg.get("token"), None)
-        if deferred is not None:
+        if entry is not None:
+            deferred, timer = entry
+            timer.cancel()  # don't park a thread for the full timeout
             deferred.resolve(msg.get("data"))
 
     def _op_get_runtime_env(self, conn, msg):

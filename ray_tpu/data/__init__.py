@@ -21,6 +21,8 @@ from ray_tpu.data.dataset import (
     read_numpy,
     read_text,
     read_binary_files,
+    read_images,
+    read_sql,
     from_torch,
     read_parquet,
 )
@@ -49,6 +51,8 @@ __all__ = [
     "read_numpy",
     "read_text",
     "read_binary_files",
+    "read_images",
+    "read_sql",
     "from_torch",
     "read_parquet",
 ]

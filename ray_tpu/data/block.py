@@ -61,15 +61,58 @@ class BlockMetadata:
         )
 
 
+# Variable-shaped tensor columns (per-row ndarrays of differing shapes,
+# e.g. undecoded-size images) are stored as a struct of (bytes, shape,
+# dtype) — counterpart of the reference's ArrowVariableShapedTensorArray
+# (python/ray/air/util/tensor_extensions/arrow.py). The dunder field
+# names mark the encoding so user struct columns can't collide.
+_VST_FIELDS = ("__vst_data", "__vst_shape", "__vst_dtype")
+
+
+def _is_var_tensor_type(t: pa.DataType) -> bool:
+    return pa.types.is_struct(t) and \
+        sorted(f.name for f in t) == sorted(_VST_FIELDS)
+
+
+def _var_tensor_to_arrow(elems) -> pa.Array:
+    arrays = [np.ascontiguousarray(x) for x in elems]
+    return pa.StructArray.from_arrays(
+        [pa.array([a.tobytes() for a in arrays], type=pa.large_binary()),
+         pa.array([list(a.shape) for a in arrays],
+                  type=pa.list_(pa.int64())),
+         pa.array([str(a.dtype) for a in arrays])],
+        names=list(_VST_FIELDS))
+
+
+def _var_tensor_to_numpy(col) -> np.ndarray:
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    datas = col.field("__vst_data").to_pylist()
+    shapes = col.field("__vst_shape").to_pylist()
+    dtypes = col.field("__vst_dtype").to_pylist()
+    out = np.empty(len(datas), dtype=object)
+    for i, (d, s, dt) in enumerate(zip(datas, shapes, dtypes)):
+        out[i] = np.frombuffer(d, dtype=np.dtype(dt)).reshape(s).copy()
+    return out
+
+
 def _np_to_arrow_array(arr: np.ndarray) -> pa.Array:
     arr = np.asarray(arr)
+    if arr.dtype == object and arr.size and \
+            all(isinstance(x, np.ndarray) for x in arr):
+        return _var_tensor_to_arrow(list(arr))
     if arr.ndim <= 1:
         return pa.array(arr)
     # Multi-dim columns (images, token blocks) use the Arrow tensor
     # extension type so shape round-trips through slicing/concat/pickle
     # (reference ArrowTensorArray, python/ray/air/util/tensor_extensions/).
-    return pa.FixedShapeTensorArray.from_numpy_ndarray(
-        np.ascontiguousarray(arr))
+    arr = np.ascontiguousarray(arr)
+    if 0 in arr.strides:
+        # Views with a broadcast/new axis report stride 0 (arr[None]);
+        # contiguity-flagged, so ascontiguousarray won't rewrite them,
+        # but pyarrow's tensor importer rejects them.
+        arr = arr.copy()
+    return pa.FixedShapeTensorArray.from_numpy_ndarray(arr)
 
 
 def _column_to_arrow(values: Any) -> pa.Array:
@@ -154,6 +197,8 @@ def _arrow_col_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
     combined = col.combine_chunks()
     if isinstance(combined.type, pa.FixedShapeTensorType):
         return combined.to_numpy_ndarray()
+    if _is_var_tensor_type(combined.type):
+        return _var_tensor_to_numpy(combined)
     try:
         return combined.to_numpy(zero_copy_only=False)
     except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
@@ -193,12 +238,22 @@ class BlockAccessor:
 
     def iter_rows(self) -> Iterator[Dict[str, Any]]:
         for chunk_batch in self._block.to_batches():
-            cols = {
-                name: chunk_batch.column(i)
-                for i, name in enumerate(chunk_batch.schema.names)
-            }
+            cols: Dict[str, Any] = {}
+            for i, name in enumerate(chunk_batch.schema.names):
+                col = chunk_batch.column(i)
+                # Tensor-encoded columns yield ndarrays per row, not
+                # nested lists / raw encoding structs; decode shares
+                # _arrow_col_to_numpy so the formats can't diverge.
+                if _is_var_tensor_type(col.type) or \
+                        isinstance(col.type, pa.FixedShapeTensorType):
+                    cols[name] = _arrow_col_to_numpy(
+                        pa.chunked_array([col]))
+                else:
+                    cols[name] = col
             for i in range(chunk_batch.num_rows):
-                yield {name: col[i].as_py() for name, col in cols.items()}
+                yield {name: (col[i] if isinstance(col, np.ndarray)
+                              else col[i].as_py())
+                       for name, col in cols.items()}
 
     def select_columns(self, names: Sequence[str]) -> Block:
         return self._block.select(list(names))

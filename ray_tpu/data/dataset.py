@@ -598,6 +598,30 @@ def read_binary_files(paths, *, include_paths: bool = False,
         parallelism=parallelism)
 
 
+def read_images(paths, *, size=None, mode: str = None,
+                include_paths: bool = False,
+                parallelism: int = -1) -> Dataset:
+    """One row per image, column "image" as an HWC uint8 array
+    (reference read_api.read_images; size=(H, W) resizes for
+    fixed-shape device batches)."""
+    from ray_tpu.data.datasource import ImageDatasource
+
+    return read_datasource(
+        ImageDatasource(paths, size=size, mode=mode,
+                        include_paths=include_paths),
+        parallelism=parallelism)
+
+
+def read_sql(sql: str, connection_factory, *,
+             parallelism: int = -1) -> Dataset:
+    """Rows from a DB-API query; the factory opens the connection inside
+    the read task (reference read_api.read_sql)."""
+    from ray_tpu.data.datasource import SQLDatasource
+
+    return read_datasource(SQLDatasource(sql, connection_factory),
+                           parallelism=parallelism)
+
+
 def from_torch(torch_dataset, *, column: str = "item",
                parallelism: int = -1) -> Dataset:
     """Map-style torch Dataset → Dataset (reference from_torch); tuple

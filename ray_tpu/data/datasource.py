@@ -262,6 +262,72 @@ class BinaryDatasource(FileDatasource):
         yield batch_to_block(batch)
 
 
+class ImageDatasource(FileDatasource):
+    """Decoded image rows (reference data/datasource/image_datasource.py
+    ImageDatasource / read_api.read_images): column "image" holds HWC
+    uint8 arrays; optional resize keeps batches fixed-shape for the
+    device path."""
+
+    def __init__(self, paths, *, size: Optional[tuple] = None,
+                 mode: Optional[str] = None, include_paths: bool = False):
+        super().__init__(paths)
+        self._size = tuple(size) if size else None
+        self._mode = mode
+        self._include_paths = include_paths
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        from PIL import Image
+
+        with Image.open(path) as im:
+            if self._mode:
+                im = im.convert(self._mode)
+            if self._size:
+                im = im.resize((self._size[1], self._size[0]))
+            arr = np.asarray(im)
+        if self._size:
+            # Uniform shape: stacked tensor column, so iter_batches /
+            # device feeds get one dense array instead of dtype=object.
+            col = arr[None]
+        else:
+            col = np.empty(1, dtype=object)
+            col[0] = arr
+        batch = {"image": col}
+        if self._include_paths:
+            batch["path"] = np.asarray([path], dtype=object)
+        yield batch_to_block(batch)
+
+
+class SQLDatasource(Datasource):
+    """Rows from a DB-API 2.0 query (reference
+    data/datasource/sql_datasource.py + read_api.read_sql): the
+    connection factory runs INSIDE each read task, so connections never
+    cross process boundaries."""
+
+    def __init__(self, sql: str, connection_factory):
+        self._sql = sql
+        self._factory = connection_factory
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        sql, factory = self._sql, self._factory
+
+        def fn() -> Iterator[Block]:
+            conn = factory()
+            try:
+                cur = conn.cursor()
+                cur.execute(sql)
+                cols = [d[0] for d in cur.description]
+                rows = cur.fetchall()
+            finally:
+                conn.close()
+            if not rows:
+                return
+            batch = {c: np.asarray([r[i] for r in rows])
+                     for i, c in enumerate(cols)}
+            yield batch_to_block(batch)
+
+        return [ReadTask(fn, BlockMetadata(num_rows=0, size_bytes=0))]
+
+
 class TorchDatasource(Datasource):
     """Map-style torch Dataset → rows (reference from_torch)."""
 

@@ -230,8 +230,13 @@ class HyperBandScheduler(TrialScheduler):
         self._time_attr = time_attr
         self._max_t = int(max_t)
         self._eta = float(reduction_factor)
-        self._s_max = max(0, int(math.log(max_t) / math.log(
-            reduction_factor)))
+        # Integer loop, not float log-ratio: log(243)/log(3) is
+        # 4.9999…, which would truncate away the most aggressive
+        # bracket for exact-power max_t values.
+        s_max = 0
+        while reduction_factor ** (s_max + 1) <= max_t:
+            s_max += 1
+        self._s_max = s_max
         self._brackets: List[_HBBracket] = []
         self._by_trial: Dict[str, _HBBracket] = {}
 

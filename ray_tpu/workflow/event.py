@@ -109,11 +109,6 @@ class EventNode(DAGNode):
         self._listener_factory = listener_factory
         self._name = name
 
-    def _poll(self, should_cancel: Optional[Callable[[], bool]] = None):
-        listener = self._listener_factory()
-        value = listener.poll_for_event(should_cancel)
-        listener.post_checkpoint()
-        return value
 
 
 def wait_for_event(listener: "Type[EventListener] | EventListener",

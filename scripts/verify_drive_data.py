@@ -94,3 +94,44 @@ print("[6] preprocessors fit/transform/transform_batch ok")
 
 ray_tpu.shutdown()
 print("DATA DRIVE OK")
+
+
+def drive_images_and_sql():
+    """read_images (fixed + variable shape) and read_sql end to end."""
+    import sqlite3
+    import tempfile
+
+    import numpy as np
+    from PIL import Image
+
+    import ray_tpu
+    from ray_tpu import data
+
+    ray_tpu.init(num_cpus=2)  # the main drive shut its runtime down
+    with tempfile.TemporaryDirectory() as d:
+        for i, hw in enumerate([(8, 6), (10, 12), (6, 6)]):
+            Image.new("RGB", (hw[1], hw[0]),
+                      color=(i * 20, 0, 0)).save(f"{d}/im{i}.png")
+        rows = data.read_images(d, mode="RGB").take_all()
+        assert sorted(r["image"].shape for r in rows) == \
+            [(6, 6, 3), (8, 6, 3), (10, 12, 3)]
+        # Fixed-shape path stacks into dense device-ready batches.
+        batches = list(data.read_images(d, size=(4, 5), mode="RGB")
+                       .iter_batches(batch_size=3))
+        assert batches[0]["image"].shape == (3, 4, 5, 3)
+        assert batches[0]["image"].dtype == np.uint8
+
+        db = f"{d}/t.db"
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE m (step INTEGER, loss REAL)")
+        conn.executemany("INSERT INTO m VALUES (?, ?)",
+                         [(i, 5.0 - i) for i in range(4)])
+        conn.commit()
+        conn.close()
+        ds = data.read_sql("SELECT step, loss FROM m ORDER BY step",
+                           lambda: sqlite3.connect(db))
+        assert ds.count() == 4 and ds.take_all()[-1]["loss"] == 2.0
+    print("[images+sql] variable/fixed image reads + SQL rows OK")
+
+
+drive_images_and_sql()

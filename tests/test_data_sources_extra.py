@@ -64,3 +64,49 @@ def test_from_torch_feeds_map_pipeline():
     total = sum(r["col_0"] for r in
                 ds.map(lambda r: {"col_0": r["col_0"] * 2}).take_all())
     assert total == 2 * sum(range(10))
+
+
+def test_read_images(ray_start_regular, tmp_path):
+    """read_images decodes to HWC uint8 rows, with optional resize +
+    paths (reference data/datasource/image_datasource.py)."""
+    from PIL import Image
+
+    from ray_tpu import data
+
+    for i, size in enumerate([(8, 6), (10, 12), (6, 6)]):
+        Image.new("RGB", (size[1], size[0]),
+                  color=(i * 10, 0, 0)).save(tmp_path / f"im{i}.png")
+    ds = data.read_images(str(tmp_path), mode="RGB")
+    rows = ds.take_all()
+    assert len(rows) == 3
+    shapes = sorted(r["image"].shape for r in rows)
+    assert shapes == [(6, 6, 3), (8, 6, 3), (10, 12, 3)]
+
+    ds2 = data.read_images(str(tmp_path), size=(4, 5), mode="L",
+                           include_paths=True)
+    rows2 = ds2.take_all()
+    assert all(r["image"].shape == (4, 5) for r in rows2)
+    assert all(r["path"].endswith(".png") for r in rows2)
+
+
+def test_read_sql(ray_start_regular, tmp_path):
+    """read_sql pulls rows through a DB-API connection opened inside
+    the read task (reference read_api.read_sql)."""
+    import sqlite3
+
+    from ray_tpu import data
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE metrics (step INTEGER, loss REAL)")
+    conn.executemany("INSERT INTO metrics VALUES (?, ?)",
+                     [(i, 10.0 - i) for i in range(5)])
+    conn.commit()
+    conn.close()
+
+    ds = data.read_sql("SELECT step, loss FROM metrics ORDER BY step",
+                       lambda: sqlite3.connect(db))
+    rows = ds.take_all()
+    assert [r["step"] for r in rows] == list(range(5))
+    assert rows[0]["loss"] == 10.0
+    assert ds.count() == 5
