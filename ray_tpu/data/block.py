@@ -168,9 +168,22 @@ def rows_to_block(rows: Sequence[Any]) -> Block:
             if len(v) != n:
                 raise ValueError(f"row column {k!r} missing in some rows")
         return batch_to_block(
-            {k: np.asarray(v) if _is_numeric_list(v) else v
+            {k: _list_to_column(v) if _is_numeric_list(v) else v
              for k, v in cols.items()})
     return batch_to_block({ITEM_COLUMN: list(rows)})
+
+
+def _list_to_column(values: List[Any]) -> np.ndarray:
+    """Stack a numeric row-column; ndarray elements of DIFFERING shapes
+    become an object column (np.asarray would raise 'inhomogeneous
+    shape') so the variable-shaped tensor encoding can take over."""
+    if isinstance(values[0], np.ndarray) and \
+            len({v.shape for v in values}) > 1:
+        out = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            out[i] = v
+        return out
+    return np.asarray(values)
 
 
 def _is_numeric_list(values: List[Any]) -> bool:

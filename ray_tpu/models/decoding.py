@@ -133,6 +133,72 @@ def _write_layer(cache_k, cache_v, l, k, v, block_tables, positions):
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_with_context(params, tokens, positions, cache, block_tables,
+                         config: TransformerConfig
+                         ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Chunked prefill: process a prompt SUFFIX whose earlier tokens'
+    K/V already live in this sequence's pages (prefix caching,
+    serve/llm_engine.py PrefixCache — the capability vLLM calls
+    automatic prefix caching).
+
+    tokens: [B, S] the suffix (padded); positions: [B, S] absolute
+    positions starting at the first uncached token, -1 on padding.
+    Attention keys are gathered from the pages AFTER the suffix K/V is
+    written, so each query sees the cached prefix plus the causal
+    in-window context through one mask on absolute positions. Returns
+    (logits at each row's LAST valid position [B, vocab] fp32, cache).
+    """
+    c = config
+    assert c.num_experts == 0, "MoE decode not wired yet"
+    assert c.scan_layers, \
+        "decoding expects stacked [L, ...] block params (scan_layers=True)"
+    B, S = tokens.shape
+    x = params["tok_embed"].astype(c.dtype)[tokens]
+    cos, sin = rope_freqs(c.head_dim_, c.max_seq_len, c.rope_theta)
+    n_pages, page = cache["k"].shape[1], cache["k"].shape[2]
+    max_ctx = block_tables.shape[1] * page
+    q_pos = positions[:, :, None]                   # [B, S, 1]
+    k_pos = jnp.arange(max_ctx)[None, None, :]      # [1, 1, ctx]
+    # Pages are assigned contiguously, so slot index IS absolute
+    # position. Slots past the written region carry k_pos > max(q_pos)
+    # (or a stale tenant's data beyond this row's table) and are masked.
+    mask = (q_pos >= 0) & (k_pos <= q_pos)          # [B, S, ctx]
+    mask = mask[:, None, :, :]                      # [B, 1, S, ctx]
+    scale = 1.0 / math.sqrt(c.head_dim_)
+
+    new_cache_k, new_cache_v = cache["k"], cache["v"]
+    for l in range(c.num_layers):
+        bp = _layer_params(params, l)
+        q, k, v = _project_qkv(x, bp, positions, cos, sin, c)
+        new_cache_k, new_cache_v = _write_layer(
+            new_cache_k, new_cache_v, l, k, v, block_tables, positions)
+        # Gather the full context (cached prefix + just-written suffix)
+        # from the pages; K in pages is already rotary-encoded.
+        kf = new_cache_k[l][block_tables].reshape(B, max_ctx, -1,
+                                                  c.head_dim_)
+        vf = new_cache_v[l][block_tables].reshape(B, max_ctx, -1,
+                                                  c.head_dim_)
+        kv = kf.shape[2]
+        if kv != c.num_heads:
+            rep = c.num_heads // kv
+            kf = jnp.repeat(kf, rep, axis=2)
+            vf = jnp.repeat(vf, rep, axis=2)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, kf) * scale
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        probs = jax.nn.softmax(logits.astype(jnp.float32),
+                               axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+        x = x + attn.reshape(B, S, -1) @ bp["wo"].astype(c.dtype)
+        x = _mlp(x, bp, c)
+
+    last = jnp.argmax(positions, axis=1)
+    x_last = jnp.take_along_axis(
+        x, last[:, None, None], axis=1)[:, 0]
+    return _lm_head(x_last, params, c), {"k": new_cache_k,
+                                         "v": new_cache_v}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
 def decode_step(params, tokens, cache, block_tables, positions,
                 context_lens, config: TransformerConfig
                 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:

@@ -50,6 +50,87 @@ class PageAllocator:
 
 
 @dataclass
+class _CacheEntry:
+    page: int
+    refcount: int
+    depth: int  # chain position; leaves (deepest) evict first
+
+
+class PrefixCache:
+    """Hash-based sharing of full prompt-prefix KV pages across requests
+    (the capability vLLM calls automatic prefix caching; the reference
+    delegates it to vLLM — here it's in-tree and TPU-shaped: reuse only
+    changes block tables and how much of the prompt the chunked-prefill
+    program must process).
+
+    A FULL page of `page_size` prompt tokens is keyed by the chain hash
+    of every token up to and including that page, so a hit at page i
+    implies hits at 0..i-1 and the block-table prefix can be reused
+    verbatim. Pages enter with refcount 1 (the computing request);
+    refcount-0 pages stay cached but evictable, deepest chains first (a
+    child's reuse requires its parents, never vice versa)."""
+
+    def __init__(self, page_size: int):
+        self.page_size = page_size
+        self._entries: Dict[int, _CacheEntry] = {}
+        self.hits = 0
+        self.tokens_saved = 0
+
+    @staticmethod
+    def chain_hashes(tokens: Sequence[int], page_size: int,
+                     max_pages: int) -> List[int]:
+        """Chain hash per full page: h_i = hash(h_{i-1}, page tokens)."""
+        out, h = [], 0
+        for i in range(max_pages):
+            chunk = tuple(tokens[i * page_size:(i + 1) * page_size])
+            h = hash((h, chunk))
+            out.append(h)
+        return out
+
+    def match(self, keys: Sequence[int]) -> List[int]:
+        """Longest cached prefix: pages for keys[0..k), refcounts
+        bumped."""
+        pages = []
+        for key in keys:
+            e = self._entries.get(key)
+            if e is None:
+                break
+            e.refcount += 1
+            pages.append(e.page)
+        if pages:
+            self.hits += 1
+            self.tokens_saved += len(pages) * self.page_size
+        return pages
+
+    def register(self, key: int, page: int, depth: int) -> bool:
+        """Adopt a freshly computed full prompt page (refcount 1, held
+        by the computing request). False if the key is already cached
+        (a concurrent identical prompt won the race): the caller keeps
+        page ownership."""
+        if key in self._entries:
+            return False
+        self._entries[key] = _CacheEntry(page, 1, depth)
+        return True
+
+    def release(self, keys: Sequence[int]) -> None:
+        for key in keys:
+            e = self._entries.get(key)
+            if e is not None:
+                e.refcount = max(0, e.refcount - 1)
+
+    def evict(self, n: int) -> List[int]:
+        """Free up to n unreferenced pages (deepest chains first)."""
+        victims = sorted(
+            (k for k, e in self._entries.items() if e.refcount == 0),
+            key=lambda k: -self._entries[k].depth)[:n]
+        return [self._entries.pop(k).page for k in victims]
+
+    @property
+    def num_idle(self) -> int:
+        return sum(e.refcount == 0 for e in self._entries.values())
+
+
+@dataclass
 class _Request:
     req_id: int
     prompt: List[int]
@@ -57,15 +138,19 @@ class _Request:
     temperature: float = 0.0
     generated: List[int] = field(default_factory=list)
     slot: int = -1
-    pages: List[int] = field(default_factory=list)
+    pages: List[int] = field(default_factory=list)  # privately owned
     eos_token: Optional[int] = None
+    # Prefix-cache bookkeeping: chain keys this request holds refs on
+    # (reused + self-registered); released on finish.
+    cache_keys: List[int] = field(default_factory=list)
 
 
 class LLMEngine:
     def __init__(self, config: tfm.TransformerConfig,
                  params: Optional[Dict[str, Any]] = None, *,
                  page_size: int = 16, num_pages: int = 512,
-                 max_batch: int = 8, seed: int = 0):
+                 max_batch: int = 8, seed: int = 0,
+                 enable_prefix_caching: bool = True):
         import jax
 
         c = config
@@ -77,6 +162,8 @@ class LLMEngine:
             c, jax.random.key(seed))
         self.cache = init_kv_pages(c, num_pages, page_size)
         self.allocator = PageAllocator(num_pages)
+        self.prefix_cache = (PrefixCache(page_size)
+                             if enable_prefix_caching else None)
         self._rng = np.random.default_rng(seed)
 
         # Slot state (fixed [max_batch] shapes → one compiled decode).
@@ -148,37 +235,94 @@ class LLMEngine:
     def _free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.slot_req) if r is None]
 
+    def _alloc_evicting(self, n: int) -> List[int]:
+        """Allocate n pages, reclaiming idle prefix-cache pages when the
+        free list runs short (vLLM's evictor path)."""
+        short = n - self.allocator.num_free
+        if short > 0 and self.prefix_cache is not None:
+            self.allocator.free(self.prefix_cache.evict(short))
+        return self.allocator.alloc(n)
+
+    def _available_pages(self) -> int:
+        idle = (self.prefix_cache.num_idle
+                if self.prefix_cache is not None else 0)
+        return self.allocator.num_free + idle
+
     def _admit(self) -> Dict[int, List[int]]:
         import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import prefill_with_context
 
         done: Dict[int, List[int]] = {}
         free = self._free_slots()
         while self.waiting and free:
             req = self.waiting[0]
-            need = math.ceil(
-                (len(req.prompt) + req.max_new_tokens) / self.page_size)
-            if need > self.allocator.num_free:
-                break  # backpressure: wait for pages to free up
+            L = len(req.prompt)
+            total = math.ceil((L + req.max_new_tokens) / self.page_size)
+
+            # Prefix-cache hit: reuse the longest chain of FULL prompt
+            # pages, capped so at least one prompt token is recomputed
+            # (its logits seed sampling of the first generated token).
+            shared: List[int] = []
+            if self.prefix_cache is not None:
+                # Match is capped one page short of covering the whole
+                # prompt: at least one token must be recomputed so its
+                # logits can seed sampling of the first generated token.
+                matchable = max(0, (L - 1) // self.page_size)
+                keys = PrefixCache.chain_hashes(
+                    req.prompt, self.page_size, matchable)
+                shared = self.prefix_cache.match(keys)
+                req.cache_keys = keys[:len(shared)]
+            n_private = total - len(shared)
+            if n_private > self._available_pages():
+                # Backpressure: release the reservation and wait.
+                if self.prefix_cache is not None and req.cache_keys:
+                    self.prefix_cache.release(req.cache_keys)
+                    req.cache_keys = []
+                break
             self.waiting.pop(0)
             slot = free.pop(0)
             req.slot = slot
-            req.pages = self.allocator.alloc(need)
+            req.pages = self._alloc_evicting(n_private)
+            pages = shared + req.pages
             table = np.zeros(self.max_pages_per_seq, dtype=np.int32)
-            table[:len(req.pages)] = req.pages
+            table[:len(pages)] = pages
             self.block_tables[slot] = table
 
-            # Prefill this sequence (B=1, length bucketed to limit
+            # Prefill the uncached SUFFIX (B=1, length bucketed to limit
             # compilations to one per power-of-two).
-            S = max(8, 1 << (len(req.prompt) - 1).bit_length())
+            start = len(shared) * self.page_size
+            n_suffix = L - start
+            S = max(8, 1 << (n_suffix - 1).bit_length())
             tokens = np.zeros((1, S), dtype=np.int32)
-            tokens[0, :len(req.prompt)] = req.prompt
+            tokens[0, :n_suffix] = req.prompt[start:]
             positions = np.full((1, S), -1, dtype=np.int32)
-            positions[0, :len(req.prompt)] = np.arange(len(req.prompt))
-            logits, self.cache = prefill(
+            positions[0, :n_suffix] = np.arange(start, L)
+            fn = prefill if start == 0 else prefill_with_context
+            logits, self.cache = fn(
                 self.params, jnp.asarray(tokens), jnp.asarray(positions),
                 self.cache, jnp.asarray(table[None]), self.config)
+
+            # Adopt ALL full prompt pages this request just computed into
+            # the cache (depth = page index; leaves evict first). A full
+            # prompt page never receives later writes — generation
+            # continues in the partial/next page — so it is immutable.
+            if self.prefix_cache is not None:
+                full = L // self.page_size
+                all_keys = PrefixCache.chain_hashes(
+                    req.prompt, self.page_size, full)
+                own = []
+                for i in range(len(shared), full):
+                    page = pages[i]
+                    if self.prefix_cache.register(all_keys[i], page, i):
+                        req.cache_keys.append(all_keys[i])
+                        own.append(page)
+                # Registered pages now belong to the cache, not the
+                # request's private set.
+                req.pages = [p for p in req.pages if p not in own]
+
             next_tok = self._sample(np.asarray(logits)[0], req)
-            self.context_lens[slot] = len(req.prompt)
+            self.context_lens[slot] = L
             self.last_tokens[slot] = next_tok
             req.generated.append(int(next_tok))
             fin = self._maybe_finish(req)
@@ -233,6 +377,10 @@ class LLMEngine:
                 self.slot_req[req.slot] = None
                 self.context_lens[req.slot] = 0
                 self.allocator.free(req.pages)
+                if self.prefix_cache is not None and req.cache_keys:
+                    # Shared/registered prompt pages stay cached
+                    # (evictable once unreferenced).
+                    self.prefix_cache.release(req.cache_keys)
             self.num_completed += 1
             return req.generated
         self.slot_req[req.slot] = req

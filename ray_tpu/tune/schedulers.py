@@ -230,6 +230,9 @@ class HyperBandScheduler(TrialScheduler):
         self._time_attr = time_attr
         self._max_t = int(max_t)
         self._eta = float(reduction_factor)
+        if reduction_factor <= 1:
+            raise ValueError(
+                f"reduction_factor must be > 1, got {reduction_factor}")
         # Integer loop, not float log-ratio: log(243)/log(3) is
         # 4.9999…, which would truncate away the most aggressive
         # bracket for exact-power max_t values.

@@ -1,0 +1,64 @@
+"""Drive the LLM inference stack end-to-end: continuous batching,
+prefix caching (parity + measured savings), and the serve deployment."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"  # dev env exports =axon (TPU tunnel)
+os.environ.setdefault("RAY_TPU_CHIPS", "none")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from ray_tpu.models import transformer as tfm  # noqa: E402
+from ray_tpu.serve.llm_engine import LLMEngine  # noqa: E402
+
+
+def main():
+    config = tfm.TransformerConfig.tiny(
+        num_layers=2, num_heads=4, num_kv_heads=2, hidden_size=32,
+        intermediate_size=64, vocab_size=64, max_seq_len=256,
+        dtype=jnp.float32, use_flash=False, scan_layers=True)
+    params = tfm.init_params(config, jax.random.key(0))
+    rng = np.random.default_rng(0)
+
+    # Shared system-prompt style workload: one long prefix, many tails.
+    prefix = rng.integers(0, 64, size=96).tolist()
+    prompts = [prefix + rng.integers(0, 64, size=8).tolist()
+               for _ in range(6)]
+
+    cold = LLMEngine(config, params, page_size=16, num_pages=128,
+                     max_batch=2, enable_prefix_caching=False)
+    t0 = time.perf_counter()
+    expected = [cold.generate([p], max_new_tokens=8)[0] for p in prompts]
+    t_cold = time.perf_counter() - t0
+
+    warm = LLMEngine(config, params, page_size=16, num_pages=128,
+                     max_batch=2, enable_prefix_caching=True)
+    t0 = time.perf_counter()
+    got = [warm.generate([p], max_new_tokens=8)[0] for p in prompts]
+    t_warm = time.perf_counter() - t0
+
+    assert got == expected, "prefix-cached decode diverged from cold"
+    saved = warm.prefix_cache.tokens_saved
+    assert saved >= 5 * 96, saved  # requests 2..6 reuse the 96-tok prefix
+    print(f"[1] prefix caching: parity OK, {saved} prompt tokens skipped, "
+          f"{warm.prefix_cache.hits} hits "
+          f"(cold {t_cold:.2f}s vs warm {t_warm:.2f}s)")
+
+    # Continuous batching with mixed hit/miss admission.
+    out = warm.generate(prompts[:3] + [rng.integers(0, 64, 16).tolist()],
+                        max_new_tokens=4)
+    assert all(len(o) == 4 for o in out)
+    print("[2] continuous batching with mixed cached/uncached admits OK")
+    print("ALL OK")
+
+
+if __name__ == "__main__":
+    main()
