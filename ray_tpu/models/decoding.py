@@ -11,7 +11,9 @@ never recompiles as requests come and go.
 Numerics intentionally mirror models/transformer.py `forward` (same
 rms_norm/rope/projection order), so greedy decode reproduces the full
 forward's argmax token-for-token — tested in tests/test_llm_decoding.py.
-Dense blocks only for now (MoE decode lands with an EP-aware router).
+MoE blocks decode too: prefill routes through the same capacity-based
+moe_ffn as training, decode steps use the exact gather path
+(moe.moe_ffn_gather) so no live sequence's token is capacity-dropped.
 """
 
 from __future__ import annotations
@@ -61,8 +63,35 @@ def _project_qkv(x, bp, positions, cos, sin, c: TransformerConfig):
     return q, k, v
 
 
-def _mlp(x, bp, c: TransformerConfig):
+def _mlp(x, bp, c: TransformerConfig, positions=None):
     y = rms_norm(x, bp["mlp_norm"], c.rms_eps)
+    if c.num_experts > 0:
+        from ray_tpu.models.moe import moe_ffn, moe_ffn_gather
+
+        B, S, h = x.shape
+        y2d = y.reshape(B * S, h)
+        if S == 1:
+            # Decode step: exact gather path — a capacity cutoff over
+            # T = B tokens could silently drop a live sequence's token.
+            out2d = moe_ffn_gather(
+                y2d, bp["router"], bp["we_gate"], bp["we_up"],
+                bp["we_down"],
+                num_experts_per_token=c.num_experts_per_token,
+                dtype=c.dtype)
+        else:
+            # Prefill: same capacity-based program as the training
+            # forward, with pad-bucket tokens (positions < 0) masked
+            # out of routing so they never crowd real tokens out of
+            # expert capacity.
+            valid = (positions.reshape(-1) >= 0) \
+                if positions is not None else None
+            out2d, _ = moe_ffn(
+                y2d, bp["router"], bp["we_gate"], bp["we_up"],
+                bp["we_down"],
+                num_experts_per_token=c.num_experts_per_token,
+                capacity_factor=c.capacity_factor, dtype=c.dtype,
+                valid=valid)
+        return x + out2d.reshape(B, S, h)
     gate = jax.nn.silu(y @ bp["w_gate"].astype(c.dtype))
     up = y @ bp["w_up"].astype(c.dtype)
     return x + ((gate * up) @ bp["w_down"].astype(c.dtype))
@@ -86,7 +115,6 @@ def prefill(params, tokens, positions, cache, block_tables,
     position [B, vocab] fp32, updated cache).
     """
     c = config
-    assert c.num_experts == 0, "MoE decode not wired yet"
     assert c.scan_layers, \
         "decoding expects stacked [L, ...] block params (scan_layers=True)"
     B, S = tokens.shape
@@ -116,7 +144,7 @@ def prefill(params, tokens, positions, cache, block_tables,
                                axis=-1).astype(x.dtype)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         x = x + attn.reshape(B, S, -1) @ bp["wo"].astype(c.dtype)
-        x = _mlp(x, bp, c)
+        x = _mlp(x, bp, c, positions)
 
     # Last valid row per sequence.
     last = jnp.argmax(positions, axis=1)           # [B]
@@ -149,7 +177,6 @@ def prefill_with_context(params, tokens, positions, cache, block_tables,
     (logits at each row's LAST valid position [B, vocab] fp32, cache).
     """
     c = config
-    assert c.num_experts == 0, "MoE decode not wired yet"
     assert c.scan_layers, \
         "decoding expects stacked [L, ...] block params (scan_layers=True)"
     B, S = tokens.shape
@@ -189,7 +216,7 @@ def prefill_with_context(params, tokens, positions, cache, block_tables,
                                axis=-1).astype(x.dtype)
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
         x = x + attn.reshape(B, S, -1) @ bp["wo"].astype(c.dtype)
-        x = _mlp(x, bp, c)
+        x = _mlp(x, bp, c, positions)
 
     last = jnp.argmax(positions, axis=1)
     x_last = jnp.take_along_axis(
@@ -209,7 +236,6 @@ def decode_step(params, tokens, cache, block_tables, positions,
     INCLUDING this token. Returns (logits [B, vocab] fp32, cache).
     """
     c = config
-    assert c.num_experts == 0, "MoE decode not wired yet"
     assert c.scan_layers, \
         "decoding expects stacked [L, ...] block params (scan_layers=True)"
     B = tokens.shape[0]

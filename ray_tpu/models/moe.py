@@ -26,10 +26,13 @@ from ray_tpu.parallel.sharding import with_logical_constraint
 def moe_ffn(x, router_w, w_gate, w_up, w_down, *,
             num_experts_per_token: int = 2,
             capacity_factor: float = 1.25,
-            dtype=jnp.bfloat16) -> Tuple[jax.Array, jax.Array]:
+            dtype=jnp.bfloat16, valid=None) -> Tuple[jax.Array, jax.Array]:
     """MoE feed-forward on flattened tokens.
 
     x: [T, h]; router_w: [h, E]; w_gate/w_up: [E, h, m]; w_down: [E, m, h].
+    valid: optional [T] bool — False rows (pad-bucket tokens in serving
+    prefill) neither claim expert capacity nor produce output, so
+    padding can't crowd real tokens out of their experts.
     Returns (out [T, h], aux_loss scalar fp32).
     """
     T, h = x.shape
@@ -57,6 +60,11 @@ def moe_ffn(x, router_w, w_gate, w_up, w_down, *,
     # [k, T] priority order (slot 0 of every token beats slot 1).
     flat_expert = expert_idx.T.reshape(-1)                   # [k*T]
     onehot = jax.nn.one_hot(flat_expert, E, dtype=jnp.int32)  # [kT,E]
+    if valid is not None:
+        # Invalid (pad) tokens are excluded BEFORE the running count so
+        # they can't consume buffer slots ahead of real tokens.
+        onehot = onehot * jnp.tile(
+            valid.astype(jnp.int32), (k,))[:, None]
     pos_in_expert = jnp.cumsum(onehot, axis=0) - onehot      # [kT,E]
     pos = jnp.sum(pos_in_expert * onehot, axis=-1)           # [kT]
     keep = pos < capacity
@@ -65,6 +73,8 @@ def moe_ffn(x, router_w, w_gate, w_up, w_down, *,
     # back to [T,k]
     keep = keep.reshape(k, T).T
     pos = pos.reshape(k, T).T
+    if valid is not None:
+        keep = keep & valid[:, None]
     gate_vals = gate_vals * keep.astype(gate_vals.dtype)
 
     # dispatch [T,E,C] / combine [T,E,C]
@@ -86,3 +96,31 @@ def moe_ffn(x, router_w, w_gate, w_up, w_down, *,
 
     out = jnp.einsum("tec,ech->th", combine.astype(dtype), out_e)
     return out.astype(x.dtype), aux.astype(jnp.float32)
+
+
+def moe_ffn_gather(x, router_w, w_gate, w_up, w_down, *,
+                   num_experts_per_token: int = 2,
+                   dtype=jnp.bfloat16) -> jax.Array:
+    """Exact (capacity-free) MoE for SMALL token counts — decode steps.
+
+    Gathers each token's k expert weight slices directly instead of the
+    dispatch/combine capacity machinery: no token is ever dropped, so a
+    single decoded token is computed exactly. O(T*k*h*m) weight-gather
+    memory — right for T = max_batch decode slots, wrong for
+    prefill-sized T (use moe_ffn there).
+    """
+    k = num_experts_per_token
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, idx = jax.lax.top_k(probs, k)                 # [T,k]
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    wg = w_gate[idx].astype(dtype)                           # [T,k,h,m]
+    wu = w_up[idx].astype(dtype)
+    wd = w_down[idx].astype(dtype)                           # [T,k,m,h]
+    xin = x.astype(dtype)
+    g = jax.nn.silu(jnp.einsum("th,tkhm->tkm", xin, wg))
+    u = jnp.einsum("th,tkhm->tkm", xin, wu)
+    out = jnp.einsum("tkm,tkmh->tkh", g * u, wd)
+    out = jnp.einsum("tkh,tk->th", out, gate_vals.astype(dtype))
+    return out.astype(x.dtype)

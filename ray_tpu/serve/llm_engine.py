@@ -78,18 +78,25 @@ class PrefixCache:
 
     @staticmethod
     def chain_hashes(tokens: Sequence[int], page_size: int,
-                     max_pages: int) -> List[int]:
-        """Chain hash per full page: h_i = hash(h_{i-1}, page tokens)."""
-        out, h = [], 0
+                     max_pages: int) -> List[bytes]:
+        """Chain hash per full page: h_i = sha256(h_{i-1} || page
+        tokens). Cryptographic, not Python hash(): a collision here
+        would silently serve another prompt's KV pages."""
+        import hashlib
+
+        arr = np.asarray(tokens, dtype=np.int64)
+        out: List[bytes] = []
+        h = b""
         for i in range(max_pages):
-            chunk = tuple(tokens[i * page_size:(i + 1) * page_size])
-            h = hash((h, chunk))
+            chunk = arr[i * page_size:(i + 1) * page_size].tobytes()
+            h = hashlib.sha256(h + chunk).digest()
             out.append(h)
         return out
 
-    def match(self, keys: Sequence[int]) -> List[int]:
+    def match(self, keys: Sequence[bytes]) -> List[int]:
         """Longest cached prefix: pages for keys[0..k), refcounts
-        bumped."""
+        bumped. Stats are the ENGINE's to record on actual admission —
+        a backpressured retry match+release must not inflate them."""
         pages = []
         for key in keys:
             e = self._entries.get(key)
@@ -97,9 +104,6 @@ class PrefixCache:
                 break
             e.refcount += 1
             pages.append(e.page)
-        if pages:
-            self.hits += 1
-            self.tokens_saved += len(pages) * self.page_size
         return pages
 
     def register(self, key: int, page: int, depth: int) -> bool:
@@ -142,7 +146,10 @@ class _Request:
     eos_token: Optional[int] = None
     # Prefix-cache bookkeeping: chain keys this request holds refs on
     # (reused + self-registered); released on finish.
-    cache_keys: List[int] = field(default_factory=list)
+    cache_keys: List[bytes] = field(default_factory=list)
+    # Full-prompt chain hashes, computed once (backpressure retries and
+    # post-prefill registration reuse them).
+    chain_keys: Optional[List[bytes]] = None
 
 
 class LLMEngine:
@@ -265,14 +272,16 @@ class LLMEngine:
             # (its logits seed sampling of the first generated token).
             shared: List[int] = []
             if self.prefix_cache is not None:
+                if req.chain_keys is None:
+                    req.chain_keys = PrefixCache.chain_hashes(
+                        req.prompt, self.page_size, L // self.page_size)
                 # Match is capped one page short of covering the whole
                 # prompt: at least one token must be recomputed so its
                 # logits can seed sampling of the first generated token.
                 matchable = max(0, (L - 1) // self.page_size)
-                keys = PrefixCache.chain_hashes(
-                    req.prompt, self.page_size, matchable)
-                shared = self.prefix_cache.match(keys)
-                req.cache_keys = keys[:len(shared)]
+                shared = self.prefix_cache.match(
+                    req.chain_keys[:matchable])
+                req.cache_keys = req.chain_keys[:len(shared)]
             n_private = total - len(shared)
             if n_private > self._available_pages():
                 # Backpressure: release the reservation and wait.
@@ -298,24 +307,38 @@ class LLMEngine:
             tokens[0, :n_suffix] = req.prompt[start:]
             positions = np.full((1, S), -1, dtype=np.int32)
             positions[0, :n_suffix] = np.arange(start, L)
-            fn = prefill if start == 0 else prefill_with_context
-            logits, self.cache = fn(
-                self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                self.cache, jnp.asarray(table[None]), self.config)
+            if start == 0:
+                logits, self.cache = prefill(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(positions), self.cache,
+                    jnp.asarray(table[None]), self.config)
+            else:
+                # Chunked prefill gathers the WHOLE table width as
+                # attention context; bucket it to the pages this prompt
+                # actually spans (pow-2 for compile reuse) so a short
+                # cached prompt doesn't pay max_seq_len-wide attention.
+                W = min(self.max_pages_per_seq, max(1, 1 << (
+                    math.ceil(L / self.page_size) - 1).bit_length()))
+                logits, self.cache = prefill_with_context(
+                    self.params, jnp.asarray(tokens),
+                    jnp.asarray(positions), self.cache,
+                    jnp.asarray(table[:W][None]), self.config)
 
             # Adopt ALL full prompt pages this request just computed into
             # the cache (depth = page index; leaves evict first). A full
             # prompt page never receives later writes — generation
             # continues in the partial/next page — so it is immutable.
             if self.prefix_cache is not None:
+                if shared:
+                    self.prefix_cache.hits += 1
+                    self.prefix_cache.tokens_saved += start
                 full = L // self.page_size
-                all_keys = PrefixCache.chain_hashes(
-                    req.prompt, self.page_size, full)
                 own = []
                 for i in range(len(shared), full):
                     page = pages[i]
-                    if self.prefix_cache.register(all_keys[i], page, i):
-                        req.cache_keys.append(all_keys[i])
+                    if self.prefix_cache.register(req.chain_keys[i],
+                                                  page, i):
+                        req.cache_keys.append(req.chain_keys[i])
                         own.append(page)
                 # Registered pages now belong to the cache, not the
                 # request's private set.

@@ -57,6 +57,25 @@ def main():
                         max_new_tokens=4)
     assert all(len(o) == 4 for o in out)
     print("[2] continuous batching with mixed cached/uncached admits OK")
+
+    # MoE decoding: greedy engine output == full forward argmax.
+    moe_cfg = tfm.TransformerConfig.tiny(
+        num_layers=2, num_heads=4, num_kv_heads=2, hidden_size=32,
+        intermediate_size=32, vocab_size=64, max_seq_len=64,
+        num_experts=4, num_experts_per_token=2, capacity_factor=8.0,
+        dtype=jnp.float32, use_flash=False, scan_layers=True)
+    moe_params = tfm.init_params(moe_cfg, jax.random.key(1))
+    prompt = rng.integers(0, 64, size=9).tolist()
+    seq = list(prompt)
+    for _ in range(6):
+        logits = tfm.forward(moe_params, jnp.asarray([seq]),
+                             config=moe_cfg)
+        seq.append(int(np.argmax(np.asarray(logits)[0, len(seq) - 1])))
+    eng = LLMEngine(moe_cfg, moe_params, page_size=4, num_pages=64,
+                    max_batch=2)
+    got = eng.generate([prompt], max_new_tokens=6)[0]
+    assert got == seq[len(prompt):], (got, seq[len(prompt):])
+    print("[3] MoE decode == MoE forward argmax, token for token")
     print("ALL OK")
 
 

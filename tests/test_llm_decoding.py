@@ -258,3 +258,80 @@ def test_prefix_cache_eviction_under_pressure(tiny, params):
         assert len(out) == 4
     # Pool conservation: every page is free, idle-cached, or nothing.
     assert eng.allocator.num_free + eng.prefix_cache.num_idle == 12
+
+
+# ---------------------------------------------------------------------------
+# MoE decoding (decoding.py _mlp MoE branch + moe.moe_ffn_gather)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_moe():
+    # Generous capacity_factor: parity vs forward() requires that no
+    # token is capacity-dropped in either path (decoding.py _mlp note).
+    return tfm.TransformerConfig.tiny(
+        num_layers=2, num_heads=4, num_kv_heads=2, hidden_size=32,
+        intermediate_size=32, vocab_size=64, max_seq_len=64,
+        num_experts=4, num_experts_per_token=2, capacity_factor=8.0,
+        dtype=jnp.float32, use_flash=False, scan_layers=True)
+
+
+@pytest.fixture(scope="module")
+def moe_params(tiny_moe):
+    return tfm.init_params(tiny_moe, jax.random.key(1))
+
+
+def test_moe_gather_matches_capacity_path(tiny_moe, moe_params):
+    """With no drops, the exact gather MoE equals the dispatch/combine
+    capacity MoE (same routing + normalization)."""
+    from ray_tpu.models.moe import moe_ffn, moe_ffn_gather
+
+    bp = jax.tree.map(lambda x: x[0], moe_params["blocks"])
+    x = jax.random.normal(jax.random.key(2), (5, 32), dtype=jnp.float32)
+    cap, _ = moe_ffn(x, bp["router"], bp["we_gate"], bp["we_up"],
+                     bp["we_down"], num_experts_per_token=2,
+                     capacity_factor=8.0, dtype=jnp.float32)
+    exact = moe_ffn_gather(x, bp["router"], bp["we_gate"], bp["we_up"],
+                           bp["we_down"], num_experts_per_token=2,
+                           dtype=jnp.float32)
+    np.testing.assert_allclose(np.asarray(cap), np.asarray(exact),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_moe_greedy_decode_matches_forward(tiny_moe, moe_params):
+    """MoE greedy decode == full forward argmax, token for token."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    c, params = tiny_moe, moe_params
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, c.vocab_size, size=7).tolist()
+    steps = 6
+
+    # Reference: iterated full forward + argmax.
+    seq = list(prompt)
+    for _ in range(steps):
+        logits = tfm.forward(params, jnp.asarray([seq]), config=c)
+        seq.append(int(np.argmax(np.asarray(logits)[0, len(seq) - 1])))
+    expected = seq[len(prompt):]
+
+    eng = LLMEngine(c, params, page_size=4, num_pages=64, max_batch=2)
+    got = eng.generate([prompt], max_new_tokens=steps)[0]
+    assert got == expected, (got, expected)
+
+
+def test_moe_engine_batched_with_prefix_cache(tiny_moe, moe_params):
+    """MoE engine: continuous batching + prefix reuse stay coherent."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    rng = np.random.default_rng(4)
+    prefix = rng.integers(0, 64, size=8).tolist()
+    prompts = [prefix + rng.integers(0, 64, size=3).tolist()
+               for _ in range(3)]
+    eng = LLMEngine(tiny_moe, moe_params, page_size=4, num_pages=64,
+                    max_batch=3)
+    solo = [LLMEngine(tiny_moe, moe_params, page_size=4, num_pages=64,
+                      max_batch=1,
+                      enable_prefix_caching=False).generate(
+                          [p], max_new_tokens=4)[0] for p in prompts]
+    batch = eng.generate(prompts, max_new_tokens=4)
+    assert batch == solo
+    assert eng.prefix_cache.hits >= 2
