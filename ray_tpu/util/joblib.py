@@ -1,0 +1,114 @@
+"""joblib parallel backend on ray_tpu tasks.
+
+Counterpart of the reference's ray.util.joblib
+(python/ray/util/joblib/ray_backend.py): after `register_ray_tpu()`,
+scikit-learn / joblib workloads fan out over the cluster with
+
+    from joblib import Parallel, delayed, parallel_backend
+    from ray_tpu.util.joblib import register_ray_tpu
+    register_ray_tpu()
+    with parallel_backend("ray_tpu"):
+        Parallel()(delayed(f)(x) for x in xs)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import cluster_cpu_count
+
+__all__ = ["register_ray_tpu", "RayTpuBackend"]
+
+
+class _TaskFuture:
+    """joblib result handle: get(timeout) over an ObjectRef. joblib's
+    completion callback drives next-batch dispatch and MUST fire on
+    failure too (BatchCompletionCallBack contract) — errors surface
+    later through get(), not through the callback."""
+
+    def __init__(self, ref, callback):
+        self._ref = ref
+        if callback is not None:
+            threading.Thread(
+                target=self._notify, args=(callback,),
+                name="joblib-ray-tpu-cb", daemon=True).start()
+
+    def _notify(self, callback):
+        try:
+            # Settle without raising: wait() resolves for errored
+            # results too (the error is stored as the value).
+            ray_tpu.wait([self._ref], num_returns=1)
+        except Exception:
+            pass
+        try:
+            callback(None)  # args ignored by non-retrieve backends
+        except Exception:
+            pass
+
+    def get(self, timeout: Optional[float] = None):
+        return ray_tpu.get(self._ref, timeout=timeout)
+
+
+def _make_backend_class():
+    from joblib.parallel import ParallelBackendBase
+
+    class RayTpuBackend(ParallelBackendBase):
+        """Each joblib batch (a picklable BatchedCalls) runs as one
+        cluster task; n_jobs=-1 means the cluster's CPU count."""
+
+        supports_timeout = True
+        uses_threads = False
+        supports_sharedmem = False
+
+        def configure(self, n_jobs=1, parallel=None, **kwargs):
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            if n_jobs is None:
+                return 1
+            if n_jobs < 0:
+                return cluster_cpu_count()
+            return n_jobs
+
+        def apply_async(self, func, callback=None):
+            ref = ray_tpu.remote(lambda: func()).remote()
+            return _TaskFuture(ref, callback)
+
+        def abort_everything(self, ensure_ready=True):
+            if ensure_ready:
+                self.configure(n_jobs=self.parallel.n_jobs,
+                               parallel=self.parallel)
+
+    return RayTpuBackend
+
+
+_backend_class = None
+
+
+def _get_backend_class():
+    global _backend_class
+    if _backend_class is None:
+        _backend_class = _make_backend_class()
+    return _backend_class
+
+
+def __getattr__(name):
+    # Lazy class export: joblib import cost is paid only when used, and
+    # `from ray_tpu.util.joblib import RayTpuBackend` gets the real
+    # class, never a None placeholder.
+    if name == "RayTpuBackend":
+        return _get_backend_class()
+    raise AttributeError(name)
+
+
+def register_ray_tpu() -> None:
+    """Register the 'ray_tpu' joblib backend (reference
+    ray.util.joblib.register_ray)."""
+    from joblib import register_parallel_backend
+
+    register_parallel_backend("ray_tpu", _get_backend_class())

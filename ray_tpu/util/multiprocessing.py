@@ -1,0 +1,268 @@
+"""multiprocessing.Pool API over ray_tpu tasks.
+
+Counterpart of the reference's ray.util.multiprocessing
+(python/ray/util/multiprocessing/pool.py): drop-in Pool whose workers
+are cluster tasks, so `Pool().map(f, xs)` scales past one host without
+code changes. `processes` bounds in-flight tasks (chunks are submitted
+through a sliding window, not all at once); chunking matches
+multiprocessing semantics (~4 chunks per worker by default); timeouts
+raise multiprocessing.TimeoutError for drop-in except clauses."""
+
+from __future__ import annotations
+
+import math
+import time
+from multiprocessing import TimeoutError as MpTimeoutError
+from typing import Callable, Iterable, List, Optional
+
+import ray_tpu
+
+__all__ = ["Pool", "AsyncResult"]
+
+
+def _run_chunk(fn, chunk, star):
+    if star:
+        return [fn(*args) for args in chunk]
+    return [fn(x) for x in chunk]
+
+
+def cluster_cpu_count() -> int:
+    """Cluster CPU total, 1 when unavailable (shared by the joblib
+    backend's effective_n_jobs)."""
+    try:
+        return max(1, int(ray_tpu.cluster_resources().get("CPU", 1)))
+    except Exception:
+        return 1
+
+
+class _WindowedChunks:
+    """Submit chunk tasks through a sliding window of at most `window`
+    in-flight refs, so Pool(processes=N) actually bounds cluster load."""
+
+    def __init__(self, thunks: List[Callable], window: int):
+        self._thunks = list(thunks)
+        self._window = max(1, window)
+        self.refs: List = []
+
+    def pump(self) -> None:
+        if not self._thunks:
+            return
+        if self.refs:
+            done, _ = ray_tpu.wait(self.refs, num_returns=len(self.refs),
+                                   timeout=0)
+            inflight = len(self.refs) - len(done)
+        else:
+            inflight = 0
+        while self._thunks and inflight < self._window:
+            self.refs.append(self._thunks.pop(0)())
+            inflight += 1
+
+    @property
+    def all_submitted(self) -> bool:
+        return not self._thunks
+
+    def done(self) -> bool:
+        self.pump()
+        if self._thunks:
+            return False
+        ready, _ = ray_tpu.wait(self.refs, num_returns=len(self.refs),
+                                timeout=0)
+        return len(ready) == len(self.refs)
+
+    def wait_all(self, timeout: Optional[float] = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            self.pump()
+            remaining = None if deadline is None else \
+                max(0.0, deadline - time.monotonic())
+            if self.all_submitted:
+                ready, _ = ray_tpu.wait(
+                    self.refs, num_returns=len(self.refs),
+                    timeout=remaining)
+                if len(ready) == len(self.refs):
+                    return True
+            else:
+                # Wait for anything to finish so the window can refill.
+                ray_tpu.wait(self.refs, num_returns=len(self.refs),
+                             timeout=min(0.05, remaining)
+                             if remaining is not None else 0.05)
+            if deadline is not None and time.monotonic() >= deadline:
+                return self.done()
+
+
+class AsyncResult:
+    """multiprocessing.pool.AsyncResult counterpart."""
+
+    def __init__(self, chunks: _WindowedChunks, single: bool = False):
+        self._chunks = chunks
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._chunks.wait_all(timeout):
+            raise MpTimeoutError()
+        flat = [v for chunk in ray_tpu.get(self._chunks.refs)
+                for v in chunk]
+        return flat[0] if self._single else flat
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        self._chunks.wait_all(timeout)
+
+    def ready(self) -> bool:
+        return self._chunks.done()
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        try:
+            # Results are ready, so this returns without blocking.
+            self.get()
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Task-backed process pool. `processes` bounds in-flight tasks
+    (defaults to the cluster's CPU count)."""
+
+    def __init__(self, processes: Optional[int] = None):
+        if processes is not None and processes < 1:
+            raise ValueError(f"processes must be >= 1, got {processes}")
+        self._processes = processes
+        self._closed = False
+        self._remote_chunk = ray_tpu.remote(_run_chunk)
+        self._outstanding: List[_WindowedChunks] = []
+
+    @property
+    def _num_workers(self) -> int:
+        return self._processes or cluster_cpu_count()
+
+    def _check_open(self):
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    def _chunk_items(self, iterable: Iterable,
+                     chunksize: Optional[int]) -> List[list]:
+        items = list(iterable)
+        if chunksize is None:
+            chunksize = max(1, math.ceil(
+                len(items) / (self._num_workers * 4)))
+        return [items[i:i + chunksize]
+                for i in range(0, len(items), chunksize)]
+
+    def _submit(self, func, iterable, chunksize, star) -> _WindowedChunks:
+        self._check_open()
+        thunks = [
+            (lambda chunk=chunk: self._remote_chunk.remote(
+                func, chunk, star))
+            for chunk in self._chunk_items(iterable, chunksize)]
+        chunks = _WindowedChunks(thunks, self._num_workers)
+        chunks.pump()
+        self._outstanding.append(chunks)
+        self._outstanding = [c for c in self._outstanding
+                             if not (c.all_submitted and c.done())]
+        return chunks
+
+    # -- submission ----------------------------------------------------
+    def apply(self, func: Callable, args: tuple = (), kwds: dict = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func: Callable, args: tuple = (),
+                    kwds: dict = None) -> AsyncResult:
+        kwds = kwds or {}
+        return AsyncResult(
+            self._submit(lambda _=None: func(*args, **kwds), [None], 1,
+                         star=False),
+            single=True)
+
+    def map(self, func: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> list:
+        return self.map_async(func, iterable, chunksize).get()
+
+    def map_async(self, func: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        return AsyncResult(self._submit(func, iterable, chunksize,
+                                        star=False))
+
+    def starmap(self, func: Callable, iterable: Iterable,
+                chunksize: Optional[int] = None) -> list:
+        return self.starmap_async(func, iterable, chunksize).get()
+
+    def starmap_async(self, func: Callable, iterable: Iterable,
+                      chunksize: Optional[int] = None) -> AsyncResult:
+        return AsyncResult(self._submit(func, iterable, chunksize,
+                                        star=True))
+
+    def imap(self, func: Callable, iterable: Iterable, chunksize: int = 1):
+        """Ordered lazy iteration. Submission starts NOW (bounded by the
+        window), so a closed pool raises here, not at first next()."""
+        chunks = self._submit(func, iterable, chunksize, star=False)
+
+        def gen():
+            i = 0
+            while True:
+                chunks.pump()
+                if i >= len(chunks.refs):
+                    if chunks.all_submitted:
+                        return
+                    continue
+                for v in ray_tpu.get(chunks.refs[i]):
+                    yield v
+                i += 1
+
+        return gen()
+
+    def imap_unordered(self, func: Callable, iterable: Iterable,
+                       chunksize: int = 1):
+        """Unordered: chunks yield in completion order."""
+        chunks = self._submit(func, iterable, chunksize, star=False)
+
+        def gen():
+            consumed = set()
+            while True:
+                chunks.pump()
+                pending = [r for r in chunks.refs
+                           if r.hex() not in consumed]
+                if not pending:
+                    if chunks.all_submitted:
+                        return
+                    continue
+                done, _ = ray_tpu.wait(pending, num_returns=1)
+                consumed.add(done[0].hex())
+                for v in ray_tpu.get(done[0]):
+                    yield v
+
+        return gen()
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self):
+        """No new work; outstanding work keeps running (join to wait)."""
+        self._closed = True
+
+    def terminate(self):
+        """Close AND cancel outstanding work."""
+        self._closed = True
+        for chunks in self._outstanding:
+            chunks._thunks.clear()
+            for ref in chunks.refs:
+                try:
+                    ray_tpu.cancel(ref)
+                except Exception:
+                    pass
+
+    def join(self):
+        """Block until all outstanding work finishes (stdlib contract:
+        call close() or terminate() first)."""
+        if not self._closed:
+            raise ValueError("Pool is still open")
+        for chunks in self._outstanding:
+            try:
+                chunks.wait_all(None)
+            except Exception:
+                pass
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -123,3 +123,111 @@ def test_runtime_context_in_task_and_actor():
 
     a = ray_tpu.remote(A).remote()
     assert ray_tpu.get(a.me.remote())
+
+
+# ---------------------------------------------------------------------------
+# multiprocessing.Pool counterpart (reference ray.util.multiprocessing)
+# ---------------------------------------------------------------------------
+
+def test_mp_pool_map_and_starmap(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    # Defined in-test: cloudpickle ships nested functions by value, so
+    # pool workers don't need the test module importable.
+    def _sq(x):
+        return x * x
+
+    def _addmul(a, b):
+        return a + 10 * b
+
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [i * i for i in range(10)]
+        assert p.starmap(_addmul, [(1, 2), (3, 4)]) == [21, 43]
+        r = p.map_async(_sq, range(6), chunksize=2)
+        r.wait(timeout=30)
+        assert r.ready() and r.successful()
+        assert r.get() == [0, 1, 4, 9, 16, 25]
+
+
+def test_mp_pool_apply_and_imap(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def _sq(x):
+        return x * x
+
+    def _addmul(a, b):
+        return a + 10 * b
+
+    p = Pool(processes=2)
+    assert p.apply(_addmul, (2, 3)) == 32
+    assert p.apply_async(_sq, (7,)).get(timeout=30) == 49
+    assert list(p.imap(_sq, range(8), chunksize=3)) == \
+        [i * i for i in range(8)]
+    assert sorted(p.imap_unordered(_sq, range(8), chunksize=3)) == \
+        sorted(i * i for i in range(8))
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(_sq, [1])
+    p.join()
+
+
+def test_mp_pool_error_propagates(ray_start_regular):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def boom(x):
+        raise RuntimeError("pool task failed")
+
+    with Pool(processes=2) as p:
+        with pytest.raises(Exception, match="pool task failed"):
+            p.map(boom, range(3))
+        r = p.map_async(boom, range(3))
+        r.wait(timeout=30)
+        assert not r.successful()
+
+
+# ---------------------------------------------------------------------------
+# joblib backend (reference ray.util.joblib.register_ray)
+# ---------------------------------------------------------------------------
+
+def test_joblib_backend(ray_start_regular):
+    from joblib import Parallel, delayed, parallel_backend
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    def _sq(x):
+        return x * x
+
+    register_ray_tpu()
+    with parallel_backend("ray_tpu", n_jobs=2):
+        out = Parallel()(delayed(_sq)(i) for i in range(12))
+    assert out == [i * i for i in range(12)]
+
+
+def test_mp_pool_window_and_timeout_semantics(ray_start_regular):
+    """processes bounds in-flight tasks; get(timeout) raises
+    multiprocessing.TimeoutError; join waits for outstanding work."""
+    import time as _time
+    from multiprocessing import TimeoutError as MpTimeoutError
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    def slowsq(x):
+        _time.sleep(0.2)
+        return x * x
+
+    p = Pool(processes=2)
+    r = p.map_async(slowsq, range(8), chunksize=1)
+    # Window: at most `processes` chunks submitted before results land.
+    assert len(r._chunks.refs) <= 2
+    with pytest.raises(MpTimeoutError):
+        r.get(timeout=0.05)
+    p.close()
+    p.join()  # blocks until everything ran
+    assert r.ready()
+    assert r.get() == [i * i for i in range(8)]
+
+
+def test_joblib_backend_class_importable():
+    from ray_tpu.util.joblib import RayTpuBackend
+
+    assert RayTpuBackend is not None and isinstance(RayTpuBackend, type)
