@@ -160,23 +160,12 @@ def _write_layer(cache_k, cache_v, l, k, v, block_tables, positions):
     return cache_k.at[l].set(kl), cache_v.at[l].set(vl)
 
 
-@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def prefill_with_context(params, tokens, positions, cache, block_tables,
-                         config: TransformerConfig
-                         ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Chunked prefill: process a prompt SUFFIX whose earlier tokens'
-    K/V already live in this sequence's pages (prefix caching,
-    serve/llm_engine.py PrefixCache — the capability vLLM calls
-    automatic prefix caching).
-
-    tokens: [B, S] the suffix (padded); positions: [B, S] absolute
-    positions starting at the first uncached token, -1 on padding.
-    Attention keys are gathered from the pages AFTER the suffix K/V is
-    written, so each query sees the cached prefix plus the causal
-    in-window context through one mask on absolute positions. Returns
-    (logits at each row's LAST valid position [B, vocab] fp32, cache).
-    """
-    c = config
+def _chunk_forward(params, tokens, positions, cache, block_tables,
+                   c: TransformerConfig):
+    """Shared body of chunked prefill / speculative verification:
+    process a token chunk whose PRIOR context already lives in this
+    sequence's pages, writing the chunk's K/V and attending to the
+    full context via a page gather. Returns (x [B, S, h], cache)."""
     assert c.scan_layers, \
         "decoding expects stacked [L, ...] block params (scan_layers=True)"
     B, S = tokens.shape
@@ -217,12 +206,48 @@ def prefill_with_context(params, tokens, positions, cache, block_tables,
         attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
         x = x + attn.reshape(B, S, -1) @ bp["wo"].astype(c.dtype)
         x = _mlp(x, bp, c, positions)
+    return x, {"k": new_cache_k, "v": new_cache_v}
 
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def prefill_with_context(params, tokens, positions, cache, block_tables,
+                         config: TransformerConfig
+                         ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Chunked prefill: process a prompt SUFFIX whose earlier tokens'
+    K/V already live in this sequence's pages (prefix caching,
+    serve/llm_engine.py PrefixCache — the capability vLLM calls
+    automatic prefix caching).
+
+    tokens: [B, S] the suffix (padded); positions: [B, S] absolute
+    positions starting at the first uncached token, -1 on padding.
+    Attention keys are gathered from the pages AFTER the suffix K/V is
+    written, so each query sees the cached prefix plus the causal
+    in-window context through one mask on absolute positions. Returns
+    (logits at each row's LAST valid position [B, vocab] fp32, cache).
+    """
+    x, cache = _chunk_forward(params, tokens, positions, cache,
+                              block_tables, config)
     last = jnp.argmax(positions, axis=1)
     x_last = jnp.take_along_axis(
         x, last[:, None, None], axis=1)[:, 0]
-    return _lm_head(x_last, params, c), {"k": new_cache_k,
-                                         "v": new_cache_v}
+    return _lm_head(x_last, params, config), cache
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def verify_step(params, tokens, positions, cache, block_tables,
+                config: TransformerConfig
+                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Speculative verification: process [last_token, draft...] as one
+    chunk and return logits at EVERY position ([B, S, vocab] fp32) —
+    position i's argmax is the model's token after consuming
+    tokens[:i+1], which the engine compares against the draft
+    (serve/llm_engine.py speculative decoding; the greedy
+    prompt-lookup counterpart of vLLM's spec-decode path)."""
+    x, cache = _chunk_forward(params, tokens, positions, cache,
+                              block_tables, config)
+    B, S, h = x.shape
+    logits = _lm_head(x.reshape(B * S, h), params, config)
+    return logits.reshape(B, S, -1), cache
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))

@@ -150,6 +150,10 @@ class _Request:
     # Full-prompt chain hashes, computed once (backpressure retries and
     # post-prefill registration reuse them).
     chain_keys: Optional[List[bytes]] = None
+    # Speculative drafting: n-gram -> latest start index, maintained
+    # incrementally so draft lookup is O(1) per decode step.
+    ngram_index: Dict[tuple, int] = field(default_factory=dict)
+    indexed_upto: int = 0
 
 
 class LLMEngine:
@@ -157,13 +161,22 @@ class LLMEngine:
                  params: Optional[Dict[str, Any]] = None, *,
                  page_size: int = 16, num_pages: int = 512,
                  max_batch: int = 8, seed: int = 0,
-                 enable_prefix_caching: bool = True):
+                 enable_prefix_caching: bool = True,
+                 speculative_k: int = 0, speculative_ngram: int = 2):
         import jax
 
         c = config
         self.config = c
         self.page_size = page_size
         self.max_batch = max_batch
+        # Speculative decoding (greedy prompt-lookup): draft up to k
+        # tokens by matching the trailing n-gram earlier in the
+        # sequence, verify them in ONE chunked forward. 0 disables.
+        self.spec_k = int(speculative_k)
+        self.spec_ngram = max(1, int(speculative_ngram))
+        self.spec_steps = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self.max_pages_per_seq = math.ceil(c.max_seq_len / page_size)
         self.params = params if params is not None else tfm.init_params(
             c, jax.random.key(seed))
@@ -353,10 +366,116 @@ class LLMEngine:
                 done[req.req_id] = fin
         return done
 
+    def _draft_for(self, req: _Request, k: int) -> List[int]:
+        """Prompt-lookup drafting (n-gram match): copy what followed the
+        most recent earlier occurrence of the trailing n-gram. The
+        n-gram -> latest-start index is maintained incrementally, so
+        each lookup is O(n + k), not a rescan of the sequence."""
+        n = self.spec_ngram
+        seq = req.prompt + req.generated
+        if k <= 0 or len(seq) <= n:
+            return []
+        # Index n-grams that have at least one continuation token
+        # (ending at position <= len-2), from where we left off.
+        start = max(req.indexed_upto, n - 1)
+        for j in range(start, len(seq) - 1):
+            req.ngram_index[tuple(seq[j - n + 1:j + 1])] = j - n + 1
+        req.indexed_upto = max(req.indexed_upto, len(seq) - 1)
+        i = req.ngram_index.get(tuple(seq[-n:]))
+        if i is None:
+            return []
+        return list(seq[i + n:i + n + k])
+
+    def _spec_decode_batch(self, items: List[tuple]) -> Dict[int, int]:
+        """Verify every eligible slot's [last_token, draft...] in ONE
+        batched chunked forward; returns {slot: tokens_advanced} after
+        updating slot state. Rejected positions still yield the model's
+        own next token, so each slot advances by >= 1."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import verify_step
+
+        B = len(items)
+        n_chunks = [1 + len(d) for _, _, d in items]
+        S = max(2, 1 << (max(n_chunks) - 1).bit_length())  # pow-2 bucket
+        max_end = max(int(self.context_lens[s]) + n
+                      for (s, _, _), n in zip(items, n_chunks))
+        W = min(self.max_pages_per_seq, max(1, 1 << (
+            math.ceil(max_end / self.page_size) - 1).bit_length()))
+        tokens = np.zeros((B, S), dtype=np.int32)
+        positions = np.full((B, S), -1, dtype=np.int32)
+        tables = np.zeros((B, W), dtype=np.int32)
+        for r, ((slot, req, draft), n_chunk) in enumerate(
+                zip(items, n_chunks)):
+            cl = int(self.context_lens[slot])
+            tokens[r, 0] = self.last_tokens[slot]
+            tokens[r, 1:n_chunk] = draft
+            positions[r, :n_chunk] = np.arange(cl, cl + n_chunk)
+            tables[r] = self.block_tables[slot][:W]
+        logits, self.cache = verify_step(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            self.cache, jnp.asarray(tables), self.config)
+        logits = np.asarray(logits)
+
+        advanced: Dict[int, List[int]] = {}
+        for r, ((slot, req, draft), n_chunk) in enumerate(
+                zip(items, n_chunks)):
+            preds = np.argmax(logits[r, :n_chunk], axis=-1)
+            accepted: List[int] = []
+            for i, d in enumerate(draft):
+                if int(preds[i]) != d:
+                    break
+                accepted.append(d)
+            # The model's token at the first mismatch (or after a full
+            # acceptance) comes free from the same forward.
+            new_tokens = accepted + [int(preds[len(accepted)])]
+            # Rejected drafts' K/V sit beyond the new context length;
+            # the attention mask hides them until overwritten.
+            self.context_lens[slot] = \
+                int(self.context_lens[slot]) + len(new_tokens)
+            self.last_tokens[slot] = new_tokens[-1]
+            self.spec_drafted += len(draft)
+            self.spec_accepted += len(accepted)
+            advanced[slot] = new_tokens
+        self.spec_steps += 1
+        return advanced
+
     def _decode(self) -> Dict[int, List[int]]:
         import jax.numpy as jnp
 
-        active = np.array([r is not None for r in self.slot_req])
+        done: Dict[int, List[int]] = {}
+        spec_slots: set = set()
+        if self.spec_k > 0:
+            eligible = []
+            for slot, req in enumerate(self.slot_req):
+                if req is None or req.temperature > 0.0:
+                    continue  # sampling needs the rejection-free path
+                remaining = req.max_new_tokens - len(req.generated)
+                if remaining < 2:
+                    continue
+                draft = self._draft_for(req,
+                                        min(self.spec_k, remaining - 1))
+                if draft:
+                    eligible.append((slot, req, draft))
+            if eligible:
+                advanced = self._spec_decode_batch(eligible)
+                for slot, req, _ in eligible:
+                    spec_slots.add(slot)
+                    for tok in advanced[slot]:
+                        req.generated.append(tok)
+                        fin = self._maybe_finish(req)
+                        if fin is not None:
+                            # EOS / max inside the accepted block:
+                            # tokens past it are discarded.
+                            done[req.req_id] = fin
+                            break
+            if all(r is None or s in spec_slots
+                   for s, r in enumerate(self.slot_req)):
+                return done
+
+        active = np.array([
+            r is not None and s not in spec_slots
+            for s, r in enumerate(self.slot_req)])
         # Inactive slots get position -1: their K/V writes are dropped
         # (write_page_tokens) instead of landing in page 0 offset 0 via
         # their zeroed block tables — which would corrupt whichever
@@ -368,10 +487,9 @@ class LLMEngine:
             jnp.asarray(self.block_tables), jnp.asarray(positions),
             jnp.asarray(ctx), self.config)
         logits = np.asarray(logits)
-        done: Dict[int, List[int]] = {}
         for slot, req in enumerate(self.slot_req):
-            if req is None:
-                continue
+            if req is None or slot in spec_slots:
+                continue  # spec slots already advanced this step
             self.context_lens[slot] += 1
             tok = self._sample(logits[slot], req)
             self.last_tokens[slot] = tok

@@ -22,6 +22,16 @@ from ray_tpu.util.multiprocessing import cluster_cpu_count
 __all__ = ["register_ray_tpu", "RayTpuBackend"]
 
 
+def _run_batch(func):
+    return func()
+
+
+# One registered remote function for every batch (a fresh
+# ray_tpu.remote(lambda ...) per dispatch would re-pickle and re-export
+# a distinct function for each batch).
+_remote_run = ray_tpu.remote(_run_batch)
+
+
 class _TaskFuture:
     """joblib result handle: get(timeout) over an ObjectRef. joblib's
     completion callback drives next-batch dispatch and MUST fire on
@@ -76,7 +86,7 @@ def _make_backend_class():
             return n_jobs
 
         def apply_async(self, func, callback=None):
-            ref = ray_tpu.remote(lambda: func()).remote()
+            ref = _remote_run.remote(func)
             return _TaskFuture(ref, callback)
 
         def abort_everything(self, ensure_ready=True):

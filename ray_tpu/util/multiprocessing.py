@@ -265,4 +265,6 @@ class Pool:
         return self
 
     def __exit__(self, *exc) -> None:
-        self.close()
+        # Stdlib Pool.__exit__ terminates (kills stragglers); matching
+        # that here means no leaked cluster tasks after the with-block.
+        self.terminate()
