@@ -1,0 +1,179 @@
+"""Distributed progress bars (reference ray.experimental.tqdm_ray).
+
+The reference forwards tqdm state from workers to the driver through a
+magic-token stdout protocol consumed by its log monitor; here bar state
+rides the cluster KV (one key per bar under ``tqdm/``), and the driver
+renders with a small poller:
+
+    # worker code
+    from ray_tpu.experimental import tqdm_ray
+    for item in tqdm_ray.tqdm(items, desc="shard-3"):
+        ...
+
+    # driver (optional live rendering of every worker's bars)
+    monitor = tqdm_ray.start_monitor()   # prints to stderr
+    ...
+    monitor.stop()
+
+Bars are throttled (default 0.1s) so tight loops don't hammer the
+control plane; finished bars are cleaned from the KV.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Iterable, Optional
+
+KV_PREFIX = "tqdm/"
+_UPDATE_INTERVAL_S = 0.1
+
+
+def _kv():
+    from ray_tpu.core.runtime import get_runtime
+
+    return get_runtime().core.client
+
+
+class tqdm:  # noqa: N801 — matches the tqdm API it stands in for
+    """tqdm-compatible bar whose state is visible cluster-wide."""
+
+    def __init__(self, iterable: Optional[Iterable] = None, *,
+                 desc: str = "", total: Optional[int] = None,
+                 position: Optional[int] = None):
+        self._iterable = iterable
+        self.desc = desc
+        if total is None and iterable is not None:
+            try:
+                total = len(iterable)  # type: ignore[arg-type]
+            except TypeError:
+                total = None
+        self.total = total
+        self.n = 0
+        self._uuid = uuid.uuid4().hex
+        self._last_push = 0.0
+        self._closed = False
+        self._push(force=True)
+
+    # -- tqdm API ------------------------------------------------------
+    def update(self, n: int = 1) -> None:
+        self.n += n
+        self._push()
+
+    def set_description(self, desc: str) -> None:
+        self.desc = desc
+        self._push()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            _kv().call({"op": "kv_del", "key": KV_PREFIX + self._uuid})
+        except Exception:
+            pass
+
+    def refresh(self) -> None:
+        self._push(force=True)
+
+    def __iter__(self):
+        assert self._iterable is not None, "no iterable to iterate"
+        try:
+            for item in self._iterable:
+                yield item
+                self.update(1)
+        finally:
+            self.close()
+
+    def __enter__(self) -> "tqdm":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- state push ----------------------------------------------------
+    def _push(self, force: bool = False) -> None:
+        now = time.time()
+        if not force and now - self._last_push < _UPDATE_INTERVAL_S:
+            return
+        self._last_push = now
+        try:
+            _kv().call({
+                "op": "kv_put", "key": KV_PREFIX + self._uuid,
+                "value": {"desc": self.desc, "n": self.n,
+                          "total": self.total, "pid": os.getpid(),
+                          "at": now},
+                "overwrite": True})
+        except Exception:
+            pass  # progress reporting must never break the workload
+
+
+def _render(state: dict) -> str:
+    n, total = state.get("n", 0), state.get("total")
+    desc = state.get("desc") or f"pid {state.get('pid')}"
+    if total:
+        pct = 100.0 * n / max(1, total)
+        filled = int(pct / 5)
+        bar = "#" * filled + "-" * (20 - filled)
+        return f"{desc}: {pct:3.0f}%|{bar}| {n}/{total}"
+    return f"{desc}: {n} it"
+
+
+class _Monitor:
+    """Driver-side renderer: polls KV bar states, prints to stderr."""
+
+    def __init__(self, interval_s: float = 0.5, file=None):
+        self._interval = interval_s
+        self._file = file or sys.stderr
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="tqdm-monitor", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.print_once()
+            except Exception:
+                pass
+
+    def print_once(self) -> None:
+        bars = live_bars()
+        for state in bars.values():
+            print(_render(state), file=self._file)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+def live_bars(stale_s: float = 10.0) -> dict:
+    """Snapshot of every live bar's state keyed by bar id.
+
+    Bars whose last update is older than ``stale_s`` belong to crashed
+    or killed workers (close() never ran); they are dropped from the
+    snapshot AND deleted from the KV so dead bars don't render
+    forever."""
+    client = _kv()
+    out = {}
+    now = time.time()
+    for key in client.call({"op": "kv_keys", "prefix": KV_PREFIX}) or []:
+        state = client.call({"op": "kv_get", "key": key})
+        if state is None:
+            continue
+        if stale_s and now - float(state.get("at", 0)) > stale_s:
+            try:
+                client.call({"op": "kv_del", "key": key})
+            except Exception:
+                pass
+            continue
+        out[key[len(KV_PREFIX):]] = state
+    return out
+
+
+def start_monitor(interval_s: float = 0.5, file=None) -> _Monitor:
+    """Start rendering all workers' bars on this process's stderr."""
+    return _Monitor(interval_s, file)

@@ -369,22 +369,29 @@ class LLMEngine:
     def _draft_for(self, req: _Request, k: int) -> List[int]:
         """Prompt-lookup drafting (n-gram match): copy what followed the
         most recent earlier occurrence of the trailing n-gram. The
-        n-gram -> latest-start index is maintained incrementally, so
-        each lookup is O(n + k), not a rescan of the sequence."""
+        n-gram -> latest-start index is maintained incrementally and the
+        sequence is addressed through prompt/generated in place, so a
+        step costs O(new_tokens * n + k) — no per-step list copies."""
         n = self.spec_ngram
-        seq = req.prompt + req.generated
-        if k <= 0 or len(seq) <= n:
+        P = len(req.prompt)
+        L = P + len(req.generated)
+        if k <= 0 or L <= n:
             return []
+
+        def tok(i: int) -> int:
+            return req.prompt[i] if i < P else req.generated[i - P]
+
         # Index n-grams that have at least one continuation token
-        # (ending at position <= len-2), from where we left off.
-        start = max(req.indexed_upto, n - 1)
-        for j in range(start, len(seq) - 1):
-            req.ngram_index[tuple(seq[j - n + 1:j + 1])] = j - n + 1
-        req.indexed_upto = max(req.indexed_upto, len(seq) - 1)
-        i = req.ngram_index.get(tuple(seq[-n:]))
+        # (ending at position <= L-2), from where we left off.
+        for j in range(max(req.indexed_upto, n - 1), L - 1):
+            gram = tuple(tok(j - n + 1 + t) for t in range(n))
+            req.ngram_index[gram] = j - n + 1
+        req.indexed_upto = max(req.indexed_upto, L - 1)
+        tail = tuple(tok(L - n + t) for t in range(n))
+        i = req.ngram_index.get(tail)
         if i is None:
             return []
-        return list(seq[i + n:i + n + k])
+        return [tok(p) for p in range(i + n, min(i + n + k, L))]
 
     def _spec_decode_batch(self, items: List[tuple]) -> Dict[int, int]:
         """Verify every eligible slot's [last_token, draft...] in ONE
@@ -396,15 +403,20 @@ class LLMEngine:
         from ray_tpu.models.decoding import verify_step
 
         B = len(items)
+        # Every shape axis is pow-2 bucketed — B included — so
+        # fluctuating eligibility doesn't recompile verify_step each
+        # step (pad rows carry position -1: K/V writes dropped, logits
+        # ignored).
+        Bb = 1 << (B - 1).bit_length()
         n_chunks = [1 + len(d) for _, _, d in items]
-        S = max(2, 1 << (max(n_chunks) - 1).bit_length())  # pow-2 bucket
+        S = max(2, 1 << (max(n_chunks) - 1).bit_length())
         max_end = max(int(self.context_lens[s]) + n
                       for (s, _, _), n in zip(items, n_chunks))
         W = min(self.max_pages_per_seq, max(1, 1 << (
             math.ceil(max_end / self.page_size) - 1).bit_length()))
-        tokens = np.zeros((B, S), dtype=np.int32)
-        positions = np.full((B, S), -1, dtype=np.int32)
-        tables = np.zeros((B, W), dtype=np.int32)
+        tokens = np.zeros((Bb, S), dtype=np.int32)
+        positions = np.full((Bb, S), -1, dtype=np.int32)
+        tables = np.zeros((Bb, W), dtype=np.int32)
         for r, ((slot, req, draft), n_chunk) in enumerate(
                 zip(items, n_chunks)):
             cl = int(self.context_lens[slot])

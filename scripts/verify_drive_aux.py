@@ -29,15 +29,21 @@ def drive_workflow_events(rt):
     def double(x):
         return 2 * x
 
+    import uuid as _uuid
+
     dash = Dashboard(rt)
     try:
-        ev = workflow.wait_for_event(workflow.KVEventListener, "golive",
+        # Unique id + key: workflow storage persists across drive runs,
+        # and a checkpointed event step would complete instantly.
+        key = f"golive-{_uuid.uuid4().hex[:8]}"
+        ev = workflow.wait_for_event(workflow.KVEventListener, key,
                                      poll_interval_s=0.05)
-        wid = workflow.run_async(double.bind(ev), workflow_id="wf_drive")
+        wid = workflow.run_async(double.bind(ev),
+                                 workflow_id=f"wf_drive_{key}")
         time.sleep(0.2)
         assert workflow.get_status(wid) == workflow.WorkflowStatus.RUNNING
         req = urllib.request.Request(
-            dash.url + "/api/events/golive", data=json.dumps(8).encode(),
+            dash.url + f"/api/events/{key}", data=json.dumps(8).encode(),
             headers={"Content-Type": "application/json"})
         with urllib.request.urlopen(req, timeout=10) as resp:
             assert json.loads(resp.read())["status"] == "ok"
@@ -63,6 +69,30 @@ def drive_workflow_events(rt):
                                duration_s=0.3)
     assert os.path.isdir(trace_dir)
     print(f"[3] jax xplane trace captured -> {trace_dir}")
+
+
+def drive_tqdm(rt):
+    from ray_tpu.experimental import tqdm_ray
+
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.experimental import tqdm_ray as tr
+        bar = tr.tqdm(desc="drive-bar", total=5)
+        for _ in range(5):
+            bar.update(1)
+            bar.refresh()
+            time.sleep(0.05)
+        return bar.n  # left open: the driver monitor sees it
+
+    ref = work.remote()
+    seen = False
+    deadline = time.time() + 20
+    while not seen and time.time() < deadline:
+        seen = any(b.get("desc") == "drive-bar"
+                   for b in tqdm_ray.live_bars().values())
+        time.sleep(0.05)
+    assert ray_tpu.get(ref) == 5 and seen
+    print("[3b] tqdm_ray: worker bar visible from the driver")
 
 
 def drive_frame_ingress():
@@ -109,6 +139,7 @@ def main():
         return 0
     ray_tpu.get(warm.remote())
     drive_workflow_events(rt)
+    drive_tqdm(rt)
     drive_frame_ingress()
     ray_tpu.shutdown()
     print("ALL OK")

@@ -78,6 +78,9 @@ def main():
     print("[3] MoE decode == MoE forward argmax, token for token")
 
     # Speculative decoding: exact greedy outputs, fewer device steps.
+    # (Exactness holds at fp32; bf16 configs could tie-break argmax
+    # differently between the verify and decode programs — still a
+    # valid greedy continuation, just not bitwise-identical.)
     rep_prompt = ([5, 9, 2, 14] * 10)[:38]
     plain = LLMEngine(config, params, page_size=16, num_pages=128,
                       max_batch=1)

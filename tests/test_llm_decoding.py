@@ -344,7 +344,13 @@ def test_moe_engine_batched_with_prefix_cache(tiny_moe, moe_params):
 def test_spec_decode_matches_plain_greedy(tiny, params):
     """Verification makes speculation exact: spec engine output ==
     plain engine output, with a nonzero acceptance rate on repetitive
-    sequences."""
+    sequences.
+
+    NOTE exactness relies on argmax agreeing between decode_step and
+    verify_step (different reduction orders); safe at fp32 on this toy
+    vocab, while bf16 production configs could tie-break differently —
+    the output would still be a valid greedy continuation, just not
+    bitwise-identical to the single-step path."""
     from ray_tpu.serve.llm_engine import LLMEngine
 
     # Strongly repetitive prompt: n-gram lookup should draft well.

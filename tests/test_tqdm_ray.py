@@ -1,0 +1,57 @@
+"""tqdm_ray tests (reference ray/experimental/tqdm_ray.py counterpart:
+cluster-visible progress bars)."""
+
+import io
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import tqdm_ray
+
+
+def test_local_bar_iterates_and_cleans_up(ray_start_regular):
+    out = list(tqdm_ray.tqdm(range(5), desc="local"))
+    assert out == [0, 1, 2, 3, 4]
+    assert tqdm_ray.live_bars() == {}  # closed bars leave no KV entry
+
+
+def test_worker_bars_visible_from_driver(ray_start_regular):
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.experimental import tqdm_ray as tr
+        bar = tr.tqdm(desc="worker-bar", total=10)
+        for _ in range(7):
+            bar.update(1)
+            bar.refresh()
+            time.sleep(0.05)
+        state = {"n": bar.n}
+        # Leave the bar OPEN so the driver can observe it.
+        return state
+
+    ref = work.remote()
+    seen = {}
+    deadline = time.time() + 20
+    while time.time() < deadline and not seen:
+        for state in tqdm_ray.live_bars().values():
+            if state.get("desc") == "worker-bar" and state.get("n", 0) > 0:
+                seen = state
+        time.sleep(0.05)
+    assert ray_tpu.get(ref)["n"] == 7
+    assert seen, "driver never observed the worker's bar"
+    assert seen["total"] == 10
+
+
+def test_monitor_renders(ray_start_regular):
+    buf = io.StringIO()
+    bar = tqdm_ray.tqdm(desc="render-me", total=4)
+    bar.update(2)
+    bar.refresh()
+    mon = tqdm_ray.start_monitor(interval_s=0.1, file=buf)
+    try:
+        mon.print_once()
+    finally:
+        mon.stop()
+        bar.close()
+    text = buf.getvalue()
+    assert "render-me" in text and "2/4" in text
