@@ -33,9 +33,11 @@ _UPDATE_INTERVAL_S = 0.1
 
 
 def _kv():
-    from ray_tpu.core.runtime import get_runtime
+    # One import point for the sibling KV helpers (internal_kv.py) so
+    # the wire protocol lives in exactly one module.
+    from ray_tpu.experimental import internal_kv
 
-    return get_runtime().core.client
+    return internal_kv
 
 
 class tqdm:  # noqa: N801 — matches the tqdm API it stands in for
@@ -61,7 +63,10 @@ class tqdm:  # noqa: N801 — matches the tqdm API it stands in for
     # -- tqdm API ------------------------------------------------------
     def update(self, n: int = 1) -> None:
         self.n += n
-        self._push()
+        # Completion always pushes: a tight loop's final update must not
+        # die in the throttle window and render n<total forever.
+        self._push(force=(self.total is not None
+                          and self.n >= self.total))
 
     def set_description(self, desc: str) -> None:
         self.desc = desc
@@ -72,7 +77,7 @@ class tqdm:  # noqa: N801 — matches the tqdm API it stands in for
             return
         self._closed = True
         try:
-            _kv().call({"op": "kv_del", "key": KV_PREFIX + self._uuid})
+            _kv().kv_del(KV_PREFIX + self._uuid)
         except Exception:
             pass
 
@@ -101,12 +106,10 @@ class tqdm:  # noqa: N801 — matches the tqdm API it stands in for
             return
         self._last_push = now
         try:
-            _kv().call({
-                "op": "kv_put", "key": KV_PREFIX + self._uuid,
-                "value": {"desc": self.desc, "n": self.n,
-                          "total": self.total, "pid": os.getpid(),
-                          "at": now},
-                "overwrite": True})
+            _kv().kv_put(
+                KV_PREFIX + self._uuid,
+                {"desc": self.desc, "n": self.n, "total": self.total,
+                 "pid": os.getpid(), "at": now})
         except Exception:
             pass  # progress reporting must never break the workload
 
@@ -157,16 +160,16 @@ def live_bars(stale_s: float = 10.0) -> dict:
     or killed workers (close() never ran); they are dropped from the
     snapshot AND deleted from the KV so dead bars don't render
     forever."""
-    client = _kv()
+    kv = _kv()
     out = {}
     now = time.time()
-    for key in client.call({"op": "kv_keys", "prefix": KV_PREFIX}) or []:
-        state = client.call({"op": "kv_get", "key": key})
+    for key in kv.kv_keys(KV_PREFIX) or []:
+        state = kv.kv_get(key)
         if state is None:
             continue
         if stale_s and now - float(state.get("at", 0)) > stale_s:
             try:
-                client.call({"op": "kv_del", "key": key})
+                kv.kv_del(key)
             except Exception:
                 pass
             continue
