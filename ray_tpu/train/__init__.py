@@ -11,6 +11,7 @@ from ray_tpu.train.checkpoint import (
     StorageContext,
     load_pytree,
     save_pytree,
+    save_pytree_async,
 )
 from ray_tpu.train.config import (
     CheckpointConfig,
@@ -40,7 +41,7 @@ from ray_tpu.train.trainer import (
 
 __all__ = [
     "Backend", "BackendConfig", "JaxBackendConfig",
-    "Checkpoint", "StorageContext", "save_pytree", "load_pytree",
+    "Checkpoint", "StorageContext", "save_pytree", "save_pytree_async", "load_pytree",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "TrainContext", "report", "get_checkpoint", "get_context",
     "get_dataset_shard",

@@ -57,18 +57,64 @@ class Checkpoint:
 # JAX pytree persistence (orbax)
 # ---------------------------------------------------------------------------
 
+def _resolve_ckpt_path(directory: str, step: Optional[int]) -> str:
+    """Shared sync/async step-directory naming (they must never
+    diverge: a restore looks up whichever the save wrote)."""
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    return os.path.join(directory, f"step_{step}") if step is not None \
+        else directory
+
+
 def save_pytree(tree: Any, directory: str, *, step: Optional[int] = None,
                 force: bool = True) -> str:
     """Save a JAX pytree (sharded arrays fine) under `directory`."""
     import orbax.checkpoint as ocp
 
-    directory = os.path.abspath(directory)
-    os.makedirs(directory, exist_ok=True)
-    path = os.path.join(directory, f"step_{step}") if step is not None \
-        else directory
+    path = _resolve_ckpt_path(directory, step)
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path, tree, force=force)
     return path
+
+
+class AsyncSave:
+    """Handle for an in-flight async checkpoint (orbax
+    AsyncCheckpointer): the device arrays were snapshotted at save();
+    wait() blocks until the write is durable and releases the
+    checkpointer's background resources. Keep the handle alive and
+    ALWAYS wait() before relying on the checkpoint — there is no
+    reliable non-blocking completion probe in orbax's public API."""
+
+    def __init__(self, checkpointer, path: str):
+        self._ckptr = checkpointer
+        self.path = path
+
+    def wait(self) -> str:
+        if self._ckptr is not None:
+            self._ckptr.wait_until_finished()
+            try:
+                self._ckptr.close()
+            except Exception:
+                pass
+            self._ckptr = None  # idempotent; no leaked async manager
+        return self.path
+
+
+def save_pytree_async(tree: Any, directory: str, *,
+                      step: Optional[int] = None,
+                      force: bool = True) -> AsyncSave:
+    """Start a non-blocking checkpoint save and return an AsyncSave.
+
+    TPU-native checkpointing: orbax snapshots the arrays to host
+    immediately and flushes to storage on background threads, so the
+    train loop's next jitted step overlaps with checkpoint I/O instead
+    of stalling on it (the reference's trainers block on upload)."""
+    import orbax.checkpoint as ocp
+
+    path = _resolve_ckpt_path(directory, step)
+    ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    ckptr.save(path, args=ocp.args.StandardSave(tree), force=force)
+    return AsyncSave(ckptr, path)
 
 
 def load_pytree(path: str, *, target: Any = None,

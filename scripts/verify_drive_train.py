@@ -6,6 +6,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("RAY_TPU_CHIPS", "none")
 
+import jax as _jax
+
+# The dev sitecustomize re-points jax at the axon TPU tunnel; force CPU.
+_jax.config.update("jax_platforms", "cpu")
+
 import tempfile
 
 import numpy as np
@@ -86,4 +91,42 @@ assert w0 == w1, (w0, w1)
 print("[4] TorchTrainer DDP replicas in sync:", w0)
 
 ray_tpu.shutdown()
+
+
+def drive_async_checkpoint():
+    """Async orbax checkpointing overlapping a live train loop."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.train.checkpoint import load_pytree, save_pytree_async
+
+    @jax.jit
+    def step(w, x):
+        g = jax.grad(lambda w: jnp.mean((x @ w - 1.0) ** 2))(w)
+        return w - 0.1 * g
+
+    w = jnp.zeros((256, 256))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(64, 256)),
+                    dtype=jnp.float32)
+    w = step(w, x)  # compile
+    d = tempfile.mkdtemp(prefix="vdt_ck_")
+    save_pytree_async({"w": w}, d + "/warm").wait()  # orbax warmup
+    t0 = time.perf_counter()
+    h = save_pytree_async({"w": w, "meta": jnp.asarray(5)},
+                          d + "/ck", step=5)
+    submit = time.perf_counter() - t0
+    for _ in range(20):  # train while the write flushes
+        w = step(w, x)
+    float(w[0, 0])
+    path = h.wait()
+    total = time.perf_counter() - t0
+    back = load_pytree(path)
+    assert int(back["meta"]) == 5 and back["w"].shape == (256, 256)
+    print(f"[5] async ckpt: submit {submit*1e3:.0f}ms, 20 train steps "
+          f"overlapped the {total*1e3:.0f}ms durable write; restore OK")
+
+
+drive_async_checkpoint()
 print("ALL OK")
