@@ -1636,6 +1636,28 @@ class ControlServer:
             if not st.soft:
                 return None
             # soft: fall through to default policy
+        if st is not None and \
+                type(st).__name__ == "NodeLabelSchedulingStrategy":
+            hard = st.hard or {}
+            soft = st.soft or {}
+
+            def match(n, req):
+                return all(n.labels.get(k) == v for k, v in req.items())
+
+            labeled = [n for n in alive if match(n, hard)]
+            pool = [n for n in labeled if match(n, soft)] if soft \
+                else labeled
+            feasible = [n for n in pool
+                        if need.is_subset_of(node_avail(n))]
+            if soft and not feasible:
+                # Soft preference exhausted: any hard-matching node.
+                feasible = [n for n in labeled
+                            if need.is_subset_of(node_avail(n))]
+            if not feasible:
+                return None  # pending until a hard match has capacity
+            node = min(feasible, key=lambda n: (
+                self._utilization(n, node_avail(n)), n.node_id))
+            return node.node_id, ("node", node.node_id)
         feasible = [n for n in alive if need.is_subset_of(node_avail(n))]
         if not feasible:
             return None

@@ -8,6 +8,7 @@ from ray_tpu.util.placement_group import (
 )
 from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
 from ray_tpu.util import client, metrics, timeline, tracing, usage_stats
@@ -30,5 +31,6 @@ __all__ = [
     "placement_group_table",
     "remove_placement_group",
     "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
     "PlacementGroupSchedulingStrategy",
 ]

@@ -9,7 +9,7 @@ HybridSchedulingPolicy (raylet/scheduling/policy/hybrid_scheduling_policy.h:50).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Dict, Optional
 
 
 @dataclass
@@ -18,6 +18,21 @@ class NodeAffinitySchedulingStrategy:
 
     node_id: str
     soft: bool = False
+
+
+@dataclass
+class NodeLabelSchedulingStrategy:
+    """Schedule by node labels (reference node-label policy,
+    raylet/scheduling/policy/node_label_scheduling_policy.h).
+
+    ``hard`` labels MUST all match — the task stays pending until a
+    matching node has capacity; ``soft`` labels prefer matching nodes
+    but fall back to the hard-matching set. The TPU headline use is
+    slice affinity: hard={"slice": name} co-locates work with one ICI
+    slice's hosts (accelerators/tpu.py get_slice_name)."""
+
+    hard: Optional[Dict[str, str]] = None
+    soft: Optional[Dict[str, str]] = None
 
 
 @dataclass

@@ -68,3 +68,40 @@ remove_placement_group(pg)
 print("[7] available after all removals:", ray_tpu.available_resources())
 c.shutdown()
 print("ALL OK")
+
+
+def drive_node_labels():
+    """NodeLabelSchedulingStrategy: hard pin + pending-until-join."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import NodeLabelSchedulingStrategy
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    try:
+        cluster.add_node(num_cpus=2, labels={"slice": "s0"})
+        target = cluster.add_node(num_cpus=2, labels={"slice": "s1"})
+
+        @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"slice": "s1"}))
+        def where():
+            return ray_tpu.get_runtime_context().node_id
+
+        assert ray_tpu.get(where.remote(), timeout=30) == target
+
+        @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"slice": "s9"}))
+        def later():
+            return ray_tpu.get_runtime_context().node_id
+
+        ref = later.remote()
+        ready, _ = ray_tpu.wait([ref], timeout=0.5)
+        assert not ready  # pending: no s9 node yet
+        joined = cluster.add_node(num_cpus=1, labels={"slice": "s9"})
+        assert ray_tpu.get(ref, timeout=30) == joined
+        print("[labels] hard label pin + pending-until-node-joins OK")
+    finally:
+        cluster.shutdown()
+
+
+drive_node_labels()

@@ -162,3 +162,52 @@ def test_hard_node_affinity_to_dead_node_fails_fast(cluster):
 
     with pytest.raises(ray_tpu.TaskUnschedulableError):
         ray_tpu.get(g.remote(), timeout=10)
+
+
+def test_node_label_scheduling_strategy():
+    """Hard labels pin to matching nodes (pending otherwise); soft
+    labels prefer but fall back (reference node-label policy,
+    scheduling/policy/node_label_scheduling_policy.h)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeLabelSchedulingStrategy,
+    )
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1})
+    try:
+        a = cluster.add_node(num_cpus=2, labels={"slice": "s0",
+                                                 "zone": "a"})
+        b = cluster.add_node(num_cpus=2, labels={"slice": "s1",
+                                                 "zone": "a"})
+
+        @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"slice": "s1"}))
+        def where():
+            return ray_tpu.get_runtime_context().node_id
+
+        assert all(n == b for n in ray_tpu.get(
+            [where.remote() for _ in range(4)]))
+
+        # Soft preference lands on the match while it has capacity.
+        @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"zone": "a"}, soft={"slice": "s0"}))
+        def soft_where():
+            return ray_tpu.get_runtime_context().node_id
+
+        assert ray_tpu.get(soft_where.remote()) == a
+
+        # Unsatisfiable hard label: stays pending, then runs once a
+        # matching node joins.
+        @ray_tpu.remote(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"slice": "s9"}))
+        def later():
+            return ray_tpu.get_runtime_context().node_id
+
+        ref = later.remote()
+        ready, _ = ray_tpu.wait([ref], timeout=1.0)
+        assert not ready
+        c = cluster.add_node(num_cpus=1, labels={"slice": "s9"})
+        assert ray_tpu.get(ref, timeout=30) == c
+    finally:
+        cluster.shutdown()

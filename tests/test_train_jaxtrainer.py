@@ -271,8 +271,11 @@ def test_async_checkpoint_overlaps_and_roundtrips(tmp_path):
     path = h.wait()
     total_s = time.perf_counter() - t0
     # Real asynchrony: submission must be a small fraction of the full
-    # durable write (measured ~50ms vs ~2s; generous margin for CI).
-    assert submit_s < total_s / 2, (submit_s, total_s)
+    # durable write (measured ~50ms vs ~2s). Skip the ratio when the
+    # whole write finished too fast to measure overlap meaningfully
+    # (tmpfs-fast storage would make any ratio assertion a coin flip).
+    if total_s > 0.25:
+        assert submit_s < total_s / 2, (submit_s, total_s)
     back = load_pytree(path)
     np.testing.assert_array_equal(np.asarray(back["w"]),
                                   np.asarray(tree["w"]))
