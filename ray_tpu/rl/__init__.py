@@ -13,6 +13,12 @@ from ray_tpu.rl.episode import SingleAgentEpisode, episodes_to_batch
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
 from ray_tpu.rl.module import QNetworkSpec, RLModuleSpec, SACModuleSpec
+from ray_tpu.rl.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rl.replay_buffer import (
     PrioritizedReplayBuffer,
     ReplayBuffer,
@@ -34,6 +40,10 @@ __all__ = [
     "JaxLearner",
     "LearnerGroup",
     "RLModuleSpec",
+    "MultiAgentEnv",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
 ]
 
 # Feature-usage tag (util/usage_stats.py; local-only, no egress).

@@ -1,0 +1,323 @@
+"""Multi-agent RL: env API, episode collection, and multi-policy PPO.
+
+Counterpart of the reference's multi-agent stack — rllib/env/
+multi_agent_env.py (MultiAgentEnv, "__all__" termination key),
+multi_agent_episode.py, and the MultiRLModule container
+(core/rl_module/multi_rl_module.py) driven through policy_mapping_fn.
+TPU-first shape discipline carries over: each POLICY keeps its own
+fixed-shape jitted learner update (one compile per policy for the whole
+run); agent→policy grouping is cheap host bookkeeping between device
+steps.
+
+The runner steps one MultiAgentEnv in-process (the reference's
+MultiAgentEnvRunner is likewise single-env); scale-out comes from
+running the whole algorithm under Tune or wrapping runners in actors.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rl import module as rl_module
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.algorithms.ppo import PPOConfig, PPOLearner, compute_gae
+from ray_tpu.rl.episode import SingleAgentEpisode
+
+
+class MultiAgentEnv:
+    """Multi-agent env API (reference rllib/env/multi_agent_env.py).
+
+    reset() -> (obs_dict, info_dict); step(action_dict) ->
+    (obs, rewards, terminateds, truncateds, infos) — all keyed by agent
+    id; terminateds/truncateds carry the "__all__" episode-end key.
+    Only agents present in the obs dict act next step."""
+
+    possible_agents: List[Any] = []
+    # {agent_id: (obs_dim, action_dim, discrete)} — specs for module
+    # inference; envs may instead expose gym-style spaces dicts.
+    agent_specs: Dict[Any, tuple] = {}
+
+    def reset(self, *, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[Any, Any]):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class MultiAgentEnvRunner:
+    """Samples a MultiAgentEnv with one RLModule per policy.
+
+    Episodes are recorded PER AGENT as SingleAgentEpisodes and grouped
+    by policy on return — the per-policy learners then consume exactly
+    the same containers the single-agent stack uses."""
+
+    def __init__(self, env_fn: Callable[[], MultiAgentEnv],
+                 specs: Dict[str, rl_module.RLModuleSpec],
+                 policy_mapping_fn: Callable[[Any], str],
+                 seed: int = 0, explore: bool = True):
+        self.env = env_fn()
+        self.specs = specs
+        self.map_fn = policy_mapping_fn
+        self.explore = explore
+        self.seed = seed
+        self._rng = jax.random.key(seed)
+        self.params = {pid: rl_module.init_params(s, jax.random.key(seed))
+                       for pid, s in specs.items()}
+        self._acts = {}
+        for pid, spec in specs.items():
+            self._acts[pid] = jax.jit(
+                lambda p, o, k, e, spec=spec: spec.act(p, o, k, e))
+        self._obs: Optional[Dict[Any, Any]] = None
+        self._episodes: Dict[Any, SingleAgentEpisode] = {}
+        self.metrics: Dict[str, Any] = {
+            "num_env_steps_sampled_lifetime": 0,
+            "episode_returns": [],
+        }
+
+    def set_weights(self, params: Dict[str, Any]) -> None:
+        self.params = jax.device_put(params)
+
+    def _reset(self):
+        obs, _ = self.env.reset(seed=self.seed)
+        self._obs = obs
+        self._episodes = {
+            a: SingleAgentEpisode(id=uuid.uuid4().hex) for a in obs}
+        for a, o in obs.items():
+            self._episodes[a].add_reset(o)
+
+    def sample(self, *, num_env_steps: int
+               ) -> Dict[str, List[SingleAgentEpisode]]:
+        """Collect ~num_env_steps env steps; returns completed episodes
+        plus in-progress cuts, grouped {policy_id: [episodes]}."""
+        if self._obs is None:
+            self._reset()
+        done_eps: List[tuple] = []  # (agent_id, episode)
+        for _ in range(num_env_steps):
+            # Group live agents by policy; one batched act per policy.
+            by_policy: Dict[str, List[Any]] = {}
+            for a in self._obs:
+                by_policy.setdefault(self.map_fn(a), []).append(a)
+            actions: Dict[Any, Any] = {}
+            step_logp: Dict[Any, float] = {}
+            step_val: Dict[Any, float] = {}
+            for pid, agents in by_policy.items():
+                obs = jnp.asarray(np.stack(
+                    [np.asarray(self._obs[a]).reshape(-1)
+                     for a in agents]))
+                self._rng, key = jax.random.split(self._rng)
+                act, logp, val = self._acts[pid](
+                    self.params[pid], obs, key, self.explore)
+                act, logp, val = (np.asarray(act), np.asarray(logp),
+                                  np.asarray(val))
+                for i, a in enumerate(agents):
+                    actions[a] = act[i]
+                    step_logp[a] = float(logp[i])
+                    step_val[a] = float(val[i])
+            obs2, rewards, terms, truncs, _ = self.env.step(actions)
+            all_done = bool(terms.get("__all__") or truncs.get("__all__"))
+            for a, act in actions.items():
+                ep = self._episodes[a]
+                # Next obs for a finished agent is its final one if the
+                # env reported it, else its last seen obs.
+                nxt = obs2.get(a, self._obs[a])
+                ep.add_step(
+                    np.asarray(nxt), act, float(rewards.get(a, 0.0)),
+                    terminated=bool(terms.get(a) or terms.get("__all__")),
+                    truncated=bool(truncs.get(a) or truncs.get("__all__")),
+                    logp=step_logp[a],
+                    extra={"values": step_val[a]})
+                if ep.is_done:
+                    done_eps.append((a, ep))
+                    self.metrics["episode_returns"].append(
+                        ep.total_reward)
+                    del self._episodes[a]
+            self.metrics["num_env_steps_sampled_lifetime"] += 1
+            if all_done or not obs2:
+                self._obs = None
+                self._reset()
+            else:
+                self._obs = obs2
+                for a in obs2:
+                    if a not in self._episodes:  # late-joining agent
+                        self._episodes[a] = SingleAgentEpisode(
+                            id=uuid.uuid4().hex)
+                        self._episodes[a].add_reset(obs2[a])
+        # Ship in-progress fragments too (PPO uses truncated cuts).
+        # Agents alive but absent from the current obs (turn-based envs
+        # where only some agents act next) keep their episode open — it
+        # ships once they reappear or finish.
+        for a, ep in list(self._episodes.items()):
+            if len(ep) > 0 and a in self._obs:
+                done_eps.append((a, ep.finalize()))
+                cont = SingleAgentEpisode(id=ep.id)
+                cont.add_reset(self._obs[a])
+                self._episodes[a] = cont
+        out: Dict[str, List[SingleAgentEpisode]] = {}
+        for a, ep in done_eps:
+            out.setdefault(self.map_fn(a), []).append(ep.finalize())
+        self.metrics["episode_returns"] = \
+            self.metrics["episode_returns"][-100:]
+        return out
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MultiAgentPPO
+        self.policies: Dict[str, Optional[rl_module.RLModuleSpec]] = {}
+        self.policy_mapping_fn: Callable[[Any], str] = lambda a: "default"
+
+    def multi_agent(self, *, policies: Dict[str, Any],
+                    policy_mapping_fn: Callable[[Any], str]
+                    ) -> "MultiAgentPPOConfig":
+        self.policies = dict(policies)
+        self.policy_mapping_fn = policy_mapping_fn
+        return self
+
+
+class MultiAgentPPO(Algorithm):
+    """PPO over multiple policies (reference: PPO + MultiRLModule +
+    policy_mapping_fn). Each policy has its own PPOLearner — one
+    compiled update per policy — trained on its agents' episodes."""
+
+    config_class = MultiAgentPPOConfig
+
+    def _setup_from_config(self, config: "MultiAgentPPOConfig") -> None:
+        self.config = config
+        env = config.make_env_fn()()
+        try:
+            specs: Dict[str, rl_module.RLModuleSpec] = {}
+            for pid, spec in config.policies.items():
+                if spec is None:
+                    # Infer one spec from any agent mapped to this
+                    # policy (homogeneous obs/action per policy).
+                    agent = next(
+                        a for a in env.possible_agents
+                        if config.policy_mapping_fn(a) == pid)
+                    obs_dim, action_dim, discrete = \
+                        env.agent_specs[agent]
+                    spec = rl_module.RLModuleSpec(
+                        obs_dim=obs_dim, action_dim=action_dim,
+                        discrete=discrete)
+                specs[pid] = spec
+        finally:
+            env.close()
+        self._specs = specs
+        self.runner = MultiAgentEnvRunner(
+            config.make_env_fn(), specs, config.policy_mapping_fn,
+            seed=config.seed)
+        self.learners = {
+            pid: PPOLearner(
+                spec, clip_param=config.clip_param,
+                vf_loss_coeff=config.vf_loss_coeff,
+                entropy_coeff=config.entropy_coeff,
+                learning_rate=config.lr, grad_clip=config.grad_clip,
+                seed=config.seed, mesh_axes=config.mesh_axes)
+            for pid, spec in specs.items()}
+        self.runner.set_weights(
+            {pid: lr.get_weights() for pid, lr in self.learners.items()})
+        self.env_runner_group = None
+        self.learner_group = None
+        self._setup_done = True
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg: MultiAgentPPOConfig = self.config
+        by_policy = self.runner.sample(
+            num_env_steps=cfg.train_batch_size)
+        metrics: Dict[str, Any] = {}
+        for pid, episodes in by_policy.items():
+            learner = self.learners[pid]
+            rows = compute_gae(episodes, learner.params, cfg.gamma,
+                               cfg.lambda_)
+            flat = {k: np.concatenate([r[k] for r in rows])
+                    for k in rows[0]}
+            n = flat["obs"].shape[0]
+            target = cfg.train_batch_size
+            mask = np.ones(n, dtype=np.float32)
+            if n < target:
+                pad = target - n
+                flat = {k: np.concatenate(
+                    [v, np.zeros((pad,) + v.shape[1:], dtype=v.dtype)])
+                    for k, v in flat.items()}
+                mask = np.concatenate(
+                    [mask, np.zeros(pad, dtype=np.float32)])
+            else:
+                flat = {k: v[:target] for k, v in flat.items()}
+                mask = mask[:target]
+            flat["mask"] = mask
+            if cfg.normalize_advantages:
+                valid = mask > 0
+                mean = flat["advantages"][valid].mean()
+                std = flat["advantages"][valid].std() + 1e-8
+                flat["advantages"] = np.where(
+                    valid, (flat["advantages"] - mean) / std,
+                    0.0).astype(np.float32)
+            rng = np.random.default_rng(cfg.seed + self.iteration)
+            mb = min(cfg.minibatch_size, target)
+            for _ in range(cfg.num_epochs):
+                perm = rng.permutation(target)
+                for start in range(0, target - mb + 1, mb):
+                    idx = perm[start:start + mb]
+                    m = learner.update_from_batch(
+                        {k: v[idx] for k, v in flat.items()})
+            metrics.update({f"{pid}/{k}": v for k, v in m.items()})
+            metrics[f"{pid}/num_env_steps_trained"] = int(n)
+        self.runner.set_weights(
+            {pid: lr.get_weights() for pid, lr in self.learners.items()})
+        return metrics
+
+    def step(self) -> Dict[str, Any]:
+        import time as _time
+
+        t0 = _time.time()
+        results = self.training_step()
+        self.iteration += 1
+        rets = self.runner.metrics["episode_returns"]
+        if rets:
+            results["episode_return_mean"] = float(np.mean(rets[-20:]))
+        results["training_iteration"] = self.iteration
+        results["time_this_iter_s"] = _time.time() - t0
+        return results
+
+    def evaluate(self, num_episodes: int = 5) -> Dict[str, Any]:
+        raise NotImplementedError(
+            "multi-agent evaluation: run a fresh runner with "
+            "explore=False")
+
+    def save_checkpoint(self, checkpoint_dir: str) -> None:
+        import os
+        import pickle
+
+        state = {"iteration": self.iteration,
+                 "learners": {pid: lr.get_state()
+                              for pid, lr in self.learners.items()}}
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "wb") as f:
+            pickle.dump(state, f)
+
+    def load_checkpoint(self, checkpoint_dir: str) -> None:
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "algorithm_state.pkl"),
+                  "rb") as f:
+            state = pickle.load(f)
+        self.iteration = state["iteration"]
+        for pid, s in state["learners"].items():
+            self.learners[pid].set_state(s)
+        self.runner.set_weights(
+            {pid: lr.get_weights() for pid, lr in self.learners.items()})
+
+    def stop(self) -> None:
+        try:
+            self.runner.env.close()
+        except Exception:
+            pass

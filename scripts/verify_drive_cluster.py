@@ -67,7 +67,6 @@ print("[P5] cancel same ref twice:", ray_tpu.cancel(r, force=True))
 remove_placement_group(pg)
 print("[7] available after all removals:", ray_tpu.available_resources())
 c.shutdown()
-print("ALL OK")
 
 
 def drive_node_labels():
@@ -105,3 +104,4 @@ def drive_node_labels():
 
 
 drive_node_labels()
+print("ALL OK")

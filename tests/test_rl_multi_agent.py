@@ -1,0 +1,163 @@
+"""Multi-agent RL tests (reference rllib/env/multi_agent_env.py +
+MultiRLModule/policy_mapping_fn stack): env API, per-policy episode
+grouping, and multi-policy PPO learning a simple coordination game."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+
+
+class TargetMatch(MultiAgentEnv):
+    """Two agents each see a one-hot target and get +1 for picking the
+    matching action. Episodes run 6 steps; trivially learnable, so PPO
+    returns must climb."""
+
+    N = 4
+    possible_agents = ["a0", "a1"]
+    agent_specs = {"a0": (4, 4, True), "a1": (4, 4, True)}
+
+    def __init__(self, seed: int = 0):
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+
+    def _obs(self):
+        self._targets = {a: int(self._rng.integers(0, self.N))
+                         for a in self.possible_agents}
+        return {a: np.eye(self.N, dtype=np.float32)[t]
+                for a, t in self._targets.items()}
+
+    def reset(self, *, seed=None):
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action_dict):
+        rewards = {a: float(int(action_dict[a]) == self._targets[a])
+                   for a in action_dict}
+        self._t += 1
+        done = self._t >= 6
+        obs = {} if done else self._obs()
+        flags = {a: done for a in self.possible_agents}
+        flags["__all__"] = done
+        return obs, rewards, flags, {"__all__": False}, {}
+
+
+def test_runner_groups_episodes_by_policy():
+    from ray_tpu.rl.module import RLModuleSpec
+
+    specs = {"p0": RLModuleSpec(obs_dim=4, action_dim=4),
+             "p1": RLModuleSpec(obs_dim=4, action_dim=4)}
+    runner = MultiAgentEnvRunner(
+        TargetMatch, specs,
+        policy_mapping_fn=lambda a: "p0" if a == "a0" else "p1", seed=0)
+    out = runner.sample(num_env_steps=13)
+    assert set(out) == {"p0", "p1"}
+    for eps in out.values():
+        # 13 env steps -> two full 6-step episodes + a 1-step cut.
+        assert sum(len(e) for e in eps) == 13
+        for ep in eps:
+            assert len(ep.obs) == len(ep) + 1
+
+
+def test_shared_policy_mapping():
+    from ray_tpu.rl.module import RLModuleSpec
+
+    runner = MultiAgentEnvRunner(
+        TargetMatch, {"shared": RLModuleSpec(obs_dim=4, action_dim=4)},
+        policy_mapping_fn=lambda a: "shared", seed=1)
+    out = runner.sample(num_env_steps=6)
+    # Both agents' episodes land under the one policy.
+    assert len(out["shared"]) == 2
+
+
+def test_multi_agent_ppo_learns_target_match():
+    cfg = MultiAgentPPOConfig().environment(env_fn=TargetMatch)
+    cfg.train_batch_size = 256
+    cfg.minibatch_size = 128
+    cfg.num_epochs = 6
+    cfg.lr = 5e-3
+    cfg = cfg.multi_agent(
+        policies={"p0": None, "p1": None},
+        policy_mapping_fn=lambda a: "p0" if a == "a0" else "p1")
+    algo = cfg.build()
+    try:
+        first = algo.train()
+        for _ in range(7):
+            res = algo.train()
+        # Max per-agent return is 6.0/episode; random is ~1.5.
+        assert res["episode_return_mean"] > 3.0, res
+        assert any(k.startswith("p0/") for k in res)
+        assert any(k.startswith("p1/") for k in res)
+    finally:
+        algo.stop()
+
+
+def test_multi_agent_checkpoint_roundtrip(tmp_path):
+    cfg = MultiAgentPPOConfig().environment(env_fn=TargetMatch)
+    cfg.train_batch_size = 64
+    cfg = cfg.multi_agent(policies={"shared": None},
+                          policy_mapping_fn=lambda a: "shared")
+    algo = cfg.build()
+    try:
+        algo.train()
+        algo.save_checkpoint(str(tmp_path))
+        it = algo.iteration
+
+        algo2 = cfg.build()
+        algo2.load_checkpoint(str(tmp_path))
+        assert algo2.iteration == it
+        a = algo.learners["shared"].get_weights()
+        b = algo2.learners["shared"].get_weights()
+        import jax
+
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        algo2.stop()
+    finally:
+        algo.stop()
+
+
+def test_turn_based_env_absent_agents_keep_episodes_open():
+    """An agent alive but absent from the obs dict at fragment-cut time
+    must not crash sampling; its episode ships when it reappears."""
+    from ray_tpu.rl.module import RLModuleSpec
+
+    class Alternating(MultiAgentEnv):
+        possible_agents = ["a", "b"]
+        agent_specs = {"a": (2, 2, True), "b": (2, 2, True)}
+
+        def __init__(self):
+            self._t = 0
+
+        def reset(self, *, seed=None):
+            self._t = 0
+            return {"a": np.zeros(2, np.float32),
+                    "b": np.zeros(2, np.float32)}, {}
+
+        def step(self, action_dict):
+            self._t += 1
+            done = self._t >= 8
+            # Only one agent observes (acts) each turn.
+            turn = "a" if self._t % 2 == 0 else "b"
+            obs = {} if done else {turn: np.zeros(2, np.float32)}
+            rew = {a: 0.5 for a in action_dict}
+            flags = {a: done for a in self.possible_agents}
+            flags["__all__"] = done
+            return obs, rew, flags, {"__all__": False}, {}
+
+    runner = MultiAgentEnvRunner(
+        Alternating, {"shared": RLModuleSpec(obs_dim=2, action_dim=2)},
+        policy_mapping_fn=lambda a: "shared", seed=0)
+    out = runner.sample(num_env_steps=3)  # cut mid-episode, one absent
+    total = sum(len(e) for e in out.get("shared", []))
+    out2 = runner.sample(num_env_steps=8)  # completes + restarts
+    total += sum(len(e) for e in out2.get("shared", []))
+    # Turn-based cadence: ~1 acting agent per env step (both act after
+    # each reset). The exact count depends on cut alignment; the
+    # invariant is that sampling never crashed and steps keep shipping.
+    assert total >= 6, total
