@@ -7,24 +7,17 @@ fixtures.  TPU/mesh tests run on a virtual 8-device CPU mesh via XLA_FLAGS
 """
 
 import os
+import sys
 
-# Must be set before jax backends initialize anywhere in the test process.
-# FORCE cpu (not setdefault): the dev environment exports
-# JAX_PLATFORMS=axon, whose PJRT plugin dials the TPU tunnel and blocks —
-# tests always run on the virtual CPU mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("RAY_TPU_CHIPS", "none")
+# Must run before jax backends initialize anywhere in the test process:
+# force the virtual 8-device CPU mesh (the dev environment exports
+# JAX_PLATFORMS=axon, whose PJRT plugin dials the TPU tunnel and blocks).
+# The recipe lives in __graft_entry__._force_virtual_cpu so the driver's
+# dryrun and the test suite provision identical meshes.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import _force_virtual_cpu  # noqa: E402
 
-# The axon sitecustomize calls jax.config.update("jax_platforms",
-# "axon,cpu") at interpreter start, overriding the env var; force it back
-# so no test ever initializes the tunnel backend.
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+_force_virtual_cpu(8)
 
 import pytest  # noqa: E402
 
