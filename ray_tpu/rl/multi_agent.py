@@ -141,6 +141,19 @@ class MultiAgentEnvRunner:
                     del self._episodes[a]
             self.metrics["num_env_steps_sampled_lifetime"] += 1
             if all_done or not obs2:
+                # Env-wide termination also ends episodes of agents that
+                # were alive but not acting this step (turn-based envs) —
+                # ship their collected steps instead of dropping them in
+                # _reset().  Mark them done so GAE doesn't bootstrap past
+                # the end: terminated when the env said __all__ terminated,
+                # truncated otherwise (time limit / env gave no next obs).
+                for a, ep in list(self._episodes.items()):
+                    if len(ep) > 0:
+                        ep.terminated = bool(terms.get("__all__"))
+                        ep.truncated = not ep.terminated
+                        done_eps.append((a, ep))
+                        self.metrics["episode_returns"].append(
+                            ep.total_reward)
                 self._obs = None
                 self._reset()
             else:

@@ -72,7 +72,7 @@ class PrefixCache:
 
     def __init__(self, page_size: int):
         self.page_size = page_size
-        self._entries: Dict[int, _CacheEntry] = {}
+        self._entries: Dict[bytes, _CacheEntry] = {}
         self.hits = 0
         self.tokens_saved = 0
 
@@ -106,7 +106,7 @@ class PrefixCache:
             pages.append(e.page)
         return pages
 
-    def register(self, key: int, page: int, depth: int) -> bool:
+    def register(self, key: bytes, page: int, depth: int) -> bool:
         """Adopt a freshly computed full prompt page (refcount 1, held
         by the computing request). False if the key is already cached
         (a concurrent identical prompt won the race): the caller keeps
@@ -116,7 +116,7 @@ class PrefixCache:
         self._entries[key] = _CacheEntry(page, 1, depth)
         return True
 
-    def release(self, keys: Sequence[int]) -> None:
+    def release(self, keys: Sequence[bytes]) -> None:
         for key in keys:
             e = self._entries.get(key)
             if e is not None:

@@ -205,6 +205,10 @@ class Pool:
                 if i >= len(chunks.refs):
                     if chunks.all_submitted:
                         return
+                    # Normally unreachable (every consumed ref is done, so
+                    # pump() refills); defensive guard against busy-spin if
+                    # the window invariant ever changes.
+                    time.sleep(0.001)
                     continue
                 for v in ray_tpu.get(chunks.refs[i]):
                     yield v
@@ -226,6 +230,9 @@ class Pool:
                 if not pending:
                     if chunks.all_submitted:
                         return
+                    # Normally unreachable (consumed refs are done, so
+                    # pump() refills); defensive guard against busy-spin.
+                    time.sleep(0.001)
                     continue
                 done, _ = ray_tpu.wait(pending, num_returns=1)
                 consumed.add(done[0].hex())

