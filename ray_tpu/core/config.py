@@ -90,6 +90,31 @@ class Config:
     # -- rpc ------------------------------------------------------------
     rpc_connect_timeout_s: float = 10.0
     rpc_max_message_bytes: int = 512 * 1024 * 1024
+    # Address this host's rpc servers BIND. 127.0.0.1 keeps single-host
+    # setups private; set to the host's reachable IP or 0.0.0.0 for real
+    # multi-host clusters.
+    node_ip_address: str = "127.0.0.1"
+    # Address ADVERTISED to peers (actor transport, node object plane).
+    # '' = node_ip_address, except 0.0.0.0/:: resolves to the hostname's
+    # IP (an advertised wildcard would point peers at themselves).
+    node_advertise_ip: str = ""
+
+    def advertised_host(self) -> str:
+        host = self.node_advertise_ip or self.node_ip_address
+        if host in ("0.0.0.0", "::"):
+            import socket
+
+            try:
+                host = socket.gethostbyname(socket.gethostname())
+            except OSError:
+                host = "127.0.0.1"
+        return host
+    # Chunk size for cross-node object pulls (reference
+    # object_manager_default_chunk_size, ray_config_def.h).
+    transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # A spawned worker that hasn't registered within this window is
+    # presumed dead (its node crashed mid-spawn) and its work is retried.
+    worker_register_timeout_s: float = 60.0
 
     # -- control-plane persistence (reference: GCS StoreClient / Redis) --
     # Path for the control server's KV journal; '' = in-memory only.

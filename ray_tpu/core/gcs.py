@@ -66,6 +66,11 @@ class ObjectEntry:
     stored_at: float = 0.0
     # Times this object's value was re-created by lineage reconstruction.
     reconstructions: int = 0
+    # Which node's shm arena holds the primary copy ("head" = the head's
+    # arena, shared by logical/fake-cluster nodes).  Counterpart of the
+    # reference's object directory locations
+    # (ownership_based_object_directory.cc).
+    node_id: str = "head"
 
 
 @dataclass
@@ -85,6 +90,17 @@ class NodeState:
     alive: bool = True
     is_head: bool = False
     labels: Dict[str, str] = field(default_factory=dict)
+    # Real (remote-host) nodes: set by register_node.  Logical nodes
+    # (fake-cluster partitions) leave these empty and share the head's
+    # arena/worker spawner.
+    address: str = ""  # the node manager's object-plane rpc server
+    conn: Optional[rpc.Connection] = None  # its control connection
+    store_key: str = ""  # its arena name ('' = shares the head arena)
+    shm_dir: str = ""
+
+    @property
+    def is_remote(self) -> bool:
+        return self.conn is not None or bool(self.store_key)
 
 
 @dataclass
@@ -128,6 +144,9 @@ class WorkerInfo:
     # where acquired resources were charged: ("node", node_id) or
     # ("pg", pg_hex, bundle_index)
     charge: tuple = ()
+    # When the spawn was requested; remote spawns (proc is None) that
+    # never register are reaped after worker_register_timeout_s.
+    spawned_at: float = 0.0
 
 
 @dataclass
@@ -261,7 +280,8 @@ class ControlServer:
 
         self._wake = threading.Event()
         self._stopped = threading.Event()
-        self.server = rpc.Server(self._handle, on_disconnect=self._on_disconnect)
+        self.server = rpc.Server(self._handle, host=config.node_ip_address,
+                                 on_disconnect=self._on_disconnect)
         self._sched_thread = threading.Thread(
             target=self._schedule_loop, name="scheduler", daemon=True
         )
@@ -270,7 +290,9 @@ class ControlServer:
     # ------------------------------------------------------------------
     @property
     def address(self) -> str:
-        return self.server.address
+        # Advertised (not bind) address: binding 0.0.0.0 must not hand
+        # peers an unroutable wildcard.
+        return f"{self.config.advertised_host()}:{self.server.port}"
 
     def stop(self):
         self._stopped.set()
@@ -279,12 +301,24 @@ class ControlServer:
             self.memory_monitor.stop()
         with self.lock:
             workers = list(self.workers.values())
+            node_conns = [n.conn for n in self.nodes.values()
+                          if n.conn is not None]
         for w in workers:
             if w.conn is not None and w.kind != "driver":
                 try:
                     w.conn.push({"op": "exit"})
                 except Exception:
                     pass
+        for conn in node_conns:
+            try:
+                conn.push({"op": "exit"})
+            except Exception:
+                pass
+        for client in getattr(self, "_node_clients", {}).values():
+            try:
+                client.close()
+            except Exception:
+                pass
         procs = [w.proc for w in workers if w.proc is not None]
         deadline = time.monotonic() + 1.0
         while procs and time.monotonic() < deadline:
@@ -315,6 +349,10 @@ class ControlServer:
         return fn(conn, msg)
 
     def _on_disconnect(self, conn: rpc.Connection):
+        node_id = conn.meta.get("node_id")
+        if node_id is not None:
+            self._handle_node_death(node_id)
+            return
         worker_hex = conn.meta.get("worker_hex")
         if worker_hex is None:
             return
@@ -325,6 +363,40 @@ class ControlServer:
             self._mark_worker_dead(w, "connection lost")
         self._wake.set()
         self._sweep_store()
+
+    def _handle_node_death(self, node_id: str):
+        """A node manager's connection dropped: the host (and its arena)
+        is gone.  Counterpart of GCS node-failure handling
+        (gcs_node_manager.cc OnNodeFailure): fail/retry its workers'
+        work, tear down its PG bundles, and recover or error every object
+        whose only copy lived in its arena (lineage reconstruction,
+        object_recovery_manager.h)."""
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return
+            node.alive = False
+            node.available = ResourceSet()
+            node.conn = None
+            for w in list(self.workers.values()):
+                if w.node_id == node_id and w.state != "dead":
+                    self._mark_worker_dead(w, f"node {node_id} died")
+            for pg in self.placement_groups.values():
+                if pg.state == "CREATED" and any(
+                        b.node_id == node_id for b in pg.bundles):
+                    self._teardown_pg(pg, reason=f"node {node_id} died")
+            # Objects whose shm copy lived on the dead node: reconstruct
+            # from lineage or materialize ObjectLostError.
+            for obj_hex, entry in list(self.objects.items()):
+                if entry.node_id != node_id or not entry.in_shm \
+                        or entry.state != READY:
+                    continue
+                entry.in_shm = False
+                if not self._try_reconstruct_locked(obj_hex):
+                    self._store_lost_error_locked(
+                        obj_hex, f"node {node_id} holding the only copy "
+                        "died and lineage reconstruction was not possible")
+        self._wake.set()
 
     def _sweep_store(self):
         """Drop shm-arena pins held by dead processes so their blocks can be
@@ -414,17 +486,63 @@ class ControlServer:
             # (hooks installed); dispatching earlier races task delivery.
             if w.kind == "driver":
                 w.state = "driver"
+                w.node_id = w.node_id or "head"
+            # The client attaches ITS node's arena; logical nodes (and
+            # the head) share the head arena.
+            node = self.nodes.get(w.node_id)
+            if node is not None and node.store_key:
+                shm_dir = node.shm_dir or self.config.shm_dir
+                store_key, store_node = node.store_key, node.node_id
+            else:
+                shm_dir = self.config.shm_dir
+                store_key, store_node = self.session_id, "head"
         self._wake.set()
         return {
             "session_id": self.session_id,
-            "shm_dir": self.config.shm_dir,
+            "shm_dir": shm_dir,
+            "store_key": store_key,
+            "store_node": store_node,
             "session_dir": self.session_dir,
         }
+
+    def _op_register_node(self, conn, msg):
+        """A node manager joins the cluster (reference raylet → GCS
+        RegisterNode, gcs_service.proto NodeInfoGcsService)."""
+        node_id = msg.get("node_id") or ""
+        res = ResourceSet(msg["resources"])
+        with self.lock:
+            if not node_id:
+                i = len(self.nodes)
+                while f"node-{i}" in self.nodes:
+                    i += 1
+                node_id = f"node-{i}"
+            existing = self.nodes.get(node_id)
+            if existing is not None and existing.alive:
+                raise ValueError(f"node {node_id} already exists")
+            self.nodes[node_id] = NodeState(
+                node_id=node_id, total=res, available=res,
+                labels=msg.get("labels") or {},
+                address=msg.get("address", ""), conn=conn,
+                store_key=msg.get("store_key", ""),
+                shm_dir=msg.get("shm_dir", ""))
+            conn.meta["node_id"] = node_id
+        self._wake.set()
+        return {"node_id": node_id, "session_id": self.session_id,
+                "namespace": self.namespace}
+
+    def _op_worker_spawn_failed(self, conn, msg):
+        """A node manager could not start a requested worker process."""
+        with self.lock:
+            w = self.workers.get(msg.get("worker_hex", ""))
+            if w is not None and w.state != "dead":
+                self._mark_worker_dead(
+                    w, f"spawn failed: {msg.get('error', 'unknown')}")
+        self._wake.set()
 
     # ------------------------------------------------------------------
     # Objects
     def _store_object_locked(self, obj_hex: str, *, inline, size, is_error,
-                             in_shm: bool = False):
+                             in_shm: bool = False, node_id: str = "head"):
         entry = self.objects.get(obj_hex)
         if entry is None:
             entry = self.objects[obj_hex] = ObjectEntry()
@@ -432,6 +550,7 @@ class ControlServer:
         entry.inline = inline
         entry.size = size
         entry.in_shm = in_shm
+        entry.node_id = node_id if in_shm else "head"
         entry.is_error = is_error
         entry.stored_at = time.time()
         actor_hex = self.obj_actor.pop(obj_hex, None)
@@ -446,6 +565,13 @@ class ControlServer:
                 pass
 
     def _object_ready_msg(self, obj_hex, entry):
+        # Location info lets clients on OTHER nodes pull the bytes from
+        # the holding node's manager ("addr"); addr == "" means the copy
+        # is in the head arena (fetch rides the control connection).
+        addr = ""
+        if entry.in_shm and entry.node_id != "head":
+            node = self.nodes.get(entry.node_id)
+            addr = node.address if node is not None else ""
         return {
             "op": "object_ready",
             "obj": obj_hex,
@@ -453,7 +579,19 @@ class ControlServer:
             "inline": entry.inline,
             "in_shm": entry.in_shm,
             "is_error": entry.is_error,
+            "node": entry.node_id,
+            "addr": addr,
         }
+
+    def _store_node_for(self, conn) -> str:
+        """Lock held. Which node's arena a connection's shm puts land in."""
+        worker_hex = conn.meta.get("worker_hex")
+        w = self.workers.get(worker_hex) if worker_hex else None
+        if w is None:
+            return "head"
+        node = self.nodes.get(w.node_id)
+        return node.node_id if node is not None and node.store_key \
+            else "head"
 
     def _op_put_object(self, conn, msg):
         with self.lock:
@@ -463,6 +601,7 @@ class ControlServer:
                 size=msg["size"],
                 is_error=msg.get("is_error", False),
                 in_shm=msg.get("in_shm", False),
+                node_id=self._store_node_for(conn),
             )
         if msg.get("in_shm"):
             # Outside the lock: spilling does storage I/O that must not
@@ -487,6 +626,7 @@ class ControlServer:
                 ((h, e.size, e.stored_at)
                  for h, e in self.objects.items()
                  if e.state == READY and e.in_shm
+                 and e.node_id == "head"  # only the head reads its arena
                  and e.spilled_uri is None and not e.restoring
                  and now - e.stored_at >= self.config.spill_min_age_s),
                 key=lambda t: t[2])
@@ -571,6 +711,7 @@ class ControlServer:
             subs, entry.subscribers = entry.subscribers, []
             entry.spilled_uri = None
             entry.in_shm = True
+            entry.node_id = "head"  # restored into the head arena
             entry.stored_at = time.time()
             push = self._object_ready_msg(obj_hex, entry)
         for c in subs:
@@ -588,10 +729,17 @@ class ControlServer:
     def _shm_value_lost(self, obj_hex: str, entry: ObjectEntry) -> bool:
         """Lock held. True for a READY shm-backed object whose arena
         segment is gone with no spilled copy: the value itself is lost."""
-        return (entry.state == READY and entry.in_shm
+        if not (entry.state == READY and entry.in_shm
                 and entry.inline is None and entry.spilled_uri is None
-                and not entry.restoring
-                and not self.store.contains(ObjectID.from_hex(obj_hex)))
+                and not entry.restoring):
+            return False
+        if entry.node_id != "head":
+            # Remote-node arena: the head can't probe it; the copy is
+            # lost exactly when its node is (node death already triggers
+            # reconstruction eagerly in _handle_node_death).
+            node = self.nodes.get(entry.node_id)
+            return node is None or not node.alive
+        return not self.store.contains(ObjectID.from_hex(obj_hex))
 
     def _try_reconstruct_locked(self, obj_hex: str) -> bool:
         """Lock held. Re-execute the task that produced a lost object
@@ -733,6 +881,11 @@ class ControlServer:
             for w in self.workers.values():
                 if w.state != "busy" or not w.current_task:
                     continue
+                if w.proc is None:
+                    # Remote-node worker: its pid belongs to another host
+                    # (killing it locally would hit an unrelated process),
+                    # and the pressure being relieved is THIS host's.
+                    continue
                 rec = self.tasks.get(w.current_task)
                 if rec is None:
                     continue
@@ -810,14 +963,33 @@ class ControlServer:
             if entry.refcount <= 0 and entry.state in (READY, ERRORED):
                 del self.objects[obj_hex]
                 if entry.in_shm:
-                    to_delete.append(obj_hex)
+                    to_delete.append((obj_hex, entry.node_id))
                 if entry.spilled_uri:
                     try:
                         self.external_storage.delete(entry.spilled_uri)
                     except Exception:
                         pass
-        for obj_hex in to_delete:
+        for obj_hex, node_loc in to_delete:
+            self._delete_shm_copy(obj_hex, node_loc)
+
+    def _delete_shm_copy(self, obj_hex: str, node_loc: str):
+        """Free an object's arena copy wherever it lives: the head's
+        store directly, or a delete push to the holding node's manager
+        (remote arenas would otherwise fill with freed garbage)."""
+        if node_loc == "head":
             self.store.delete(ObjectID.from_hex(obj_hex))
+            return
+        with self.lock:
+            cached = getattr(self, "_proxy_cache", None)
+            if cached is not None and cached[0] == obj_hex:
+                self._proxy_cache = None
+            node = self.nodes.get(node_loc)
+            conn = node.conn if node is not None and node.alive else None
+        if conn is not None:
+            try:
+                conn.push({"op": "delete_object", "obj": obj_hex})
+            except Exception:
+                pass
 
     def _op_register_objects(self, conn, msg):
         """Pre-register return objects of direct (actor) tasks with one ref
@@ -834,6 +1006,7 @@ class ControlServer:
                     self.obj_actor[obj_hex] = actor_hex
 
     def _op_free_objects(self, conn, msg):
+        to_delete = []
         with self.lock:
             for obj_hex in msg["objs"]:
                 # Explicit free forfeits reconstruction (the reference
@@ -841,12 +1014,14 @@ class ControlServer:
                 self.lineage.pop(obj_hex, None)
                 entry = self.objects.pop(obj_hex, None)
                 if entry is not None and entry.in_shm:
-                    self.store.delete(ObjectID.from_hex(obj_hex))
+                    to_delete.append((obj_hex, entry.node_id))
                 if entry is not None and entry.spilled_uri:
                     try:
                         self.external_storage.delete(entry.spilled_uri)
                     except Exception:
                         pass
+        for obj_hex, node_loc in to_delete:
+            self._delete_shm_copy(obj_hex, node_loc)
 
     # ------------------------------------------------------------------
     # Functions (counterpart of _private/function_manager.py export tables)
@@ -998,12 +1173,14 @@ class ControlServer:
             # Batched result puts ride the done message (worker.py
             # _finish); store them BEFORE completing the task so
             # subscribers resolve before any retry bookkeeping.
+            put_node = self._store_node_for(conn)
             for put in msg.get("puts", ()):
                 self._store_object_locked(
                     put["obj"], inline=put.get("inline"),
                     size=put["size"],
                     is_error=put.get("is_error", False),
-                    in_shm=put.get("in_shm", False))
+                    in_shm=put.get("in_shm", False),
+                    node_id=put_node)
             rec = self.tasks.get(msg["task_id"])
             worker_hex = conn.meta.get("worker_hex")
             w = self.workers.get(worker_hex) if worker_hex else None
@@ -1251,6 +1428,13 @@ class ControlServer:
             node = self.nodes.get(node_id)
             if node is None:
                 return False
+            if node.conn is not None:
+                # Real node: ask its manager to exit; its disconnect then
+                # runs the full node-death path (object recovery etc.).
+                try:
+                    node.conn.push({"op": "exit"})
+                except Exception:
+                    pass
             node.alive = False
             node.available = ResourceSet()
             for w in list(self.workers.values()):
@@ -1490,7 +1674,11 @@ class ControlServer:
                 return True
             if rec.state == "RUNNING" and force:
                 w = self.workers.get(rec.worker_hex)
-                if w is not None and w.proc is not None:
+                node = self.nodes.get(w.node_id) if w is not None else None
+                killable = w is not None and (
+                    w.proc is not None
+                    or (node is not None and node.conn is not None))
+                if killable:
                     rec.spec.max_retries = rec.spec.retry_count  # no retry
                     rec.state = "CANCELLED"
                     self._fail_task_returns_with(
@@ -1498,10 +1686,18 @@ class ControlServer:
                     # Kill + mark dead under the lock: releasing first would
                     # let the worker finish, grab another task, and eat the
                     # SIGKILL meant for this one.  kill() is non-blocking.
-                    try:
-                        w.proc.kill()
-                    except OSError:
-                        pass
+                    if w.proc is not None:
+                        try:
+                            w.proc.kill()
+                        except OSError:
+                            pass
+                    else:
+                        # Remote worker: its node manager owns the Popen.
+                        try:
+                            node.conn.push({"op": "kill_worker",
+                                            "worker_hex": w.worker_hex})
+                        except Exception:
+                            pass
                     self._mark_worker_dead(w, "task cancelled")
                     return True
             return False  # running w/o force, or already finished
@@ -1740,6 +1936,7 @@ class ControlServer:
 
     def _schedule_once(self):
         with self.lock:
+            self._reap_unregistered_workers()
             # 0. retry pending placement groups (resources may have freed or
             # nodes joined — reference GcsPlacementGroupManager retry loop)
             for pg in self.placement_groups.values():
@@ -1951,6 +2148,110 @@ class ControlServer:
         for obj_hex in targets:
             self._op_decref(conn, {"obj": obj_hex})
 
+    # -- cross-node object plane ---------------------------------------
+    def _node_client(self, node_id: str) -> Optional[rpc.Client]:
+        """Head-side rpc client to a node manager's object server."""
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive or not node.address:
+                return None
+            address = node.address
+        clients = getattr(self, "_node_clients", None)
+        if clients is None:
+            clients = self._node_clients = {}
+        client = clients.get(address)
+        if client is None or client._closed:
+            try:
+                client = rpc.Client(address, connect_timeout=2.0)
+            except Exception:
+                return None
+            racer = clients.setdefault(address, client)
+            if racer is not client:  # another handler dialed first
+                if racer._closed:
+                    clients[address] = client
+                else:
+                    client.close()
+                    client = racer
+        return client
+
+    def _pull_node_object(self, node_id: str, obj_hex: str,
+                          size: int) -> Optional[bytes]:
+        """Pull a whole object's bytes from its holding node (chunked)."""
+        client = self._node_client(node_id)
+        if client is None:
+            return None
+        try:
+            return rpc.pull_object_chunked(
+                client, obj_hex, size, self.config.transfer_chunk_bytes)
+        except Exception:
+            return None
+
+    def _op_fetch_chunk(self, conn, msg):
+        """Serve one chunk of a head-arena object to a remote puller
+        (reference ObjectManager chunked Push/Pull,
+        object_manager.h:206/:139).  The attach stays cached in the
+        store until the object is deleted, so concurrent chunk reads of
+        one object never race a release."""
+        obj_hex = msg["obj"]
+        with self.lock:
+            entry = self.objects.get(obj_hex)
+            node_loc = entry.node_id if entry is not None else "head"
+        if entry is None:
+            return None
+        if node_loc != "head":
+            # Rare proxy case (location moved between the client's info
+            # snapshot and this request): pull-through from the real
+            # node, caching the payload so the client's REMAINING chunk
+            # requests for this object don't each re-pull the whole
+            # thing (one-entry cache; the window is one transfer).
+            with self.lock:
+                cached = getattr(self, "_proxy_cache", None)
+            if cached is None or cached[0] != obj_hex:
+                data = self._pull_node_object(node_loc, obj_hex,
+                                              msg["size"])
+                if data is None:
+                    return None
+                with self.lock:
+                    self._proxy_cache = (obj_hex, data)
+                cached = (obj_hex, data)
+            return cached[1][msg["offset"]:msg["offset"] + msg["length"]]
+        seg = self.store.attach(ObjectID.from_hex(obj_hex), msg["size"])
+        off, n = msg["offset"], msg["length"]
+        return bytes(seg.buf[off:off + n])
+
+    def _op_report_object_lost(self, conn, msg):
+        """A client's pull from a remote node failed (the node's arena
+        evicted/lost the copy while the node itself stays alive): verify
+        with the node and fall back to lineage reconstruction — the
+        remote-arena counterpart of the head's _shm_value_lost probe."""
+        obj_hex = msg["obj"]
+        with self.lock:
+            entry = self.objects.get(obj_hex)
+            if entry is None or not entry.in_shm or entry.restoring \
+                    or entry.node_id == "head" or entry.state != READY:
+                return False
+            node_loc = entry.node_id
+        client = self._node_client(node_loc)
+        if client is not None:
+            try:
+                if client.call({"op": "has_object", "obj": obj_hex},
+                               timeout=5.0):
+                    return False  # still there; the pull failure was racy
+            except Exception:
+                pass  # node unreachable: treat as lost
+        with self.lock:
+            entry = self.objects.get(obj_hex)
+            if entry is None or not entry.in_shm \
+                    or entry.node_id != node_loc or entry.state != READY:
+                return False
+            entry.in_shm = False
+            if not self._try_reconstruct_locked(obj_hex):
+                self._store_lost_error_locked(
+                    obj_hex, f"copy on node {node_loc} is gone and "
+                    "lineage reconstruction was not possible")
+        self._wake.set()
+        return True
+
     def _op_fetch_object(self, conn, msg):
         """Read an object's payload server-side for thin clients (no shm
         attachment — reference Ray Client server proxy role). Shm reads
@@ -1977,6 +2278,16 @@ class ControlServer:
                 size = entry.size
                 spilled_uri = entry.spilled_uri
                 is_error = entry.is_error
+                node_loc = entry.node_id
+            if spilled_uri is None and node_loc != "head":
+                # Copy lives in a remote node's arena: pull it over the
+                # object plane.  A failed pull means the node just died —
+                # _handle_node_death kicks reconstruction; wait and retry.
+                data = self._pull_node_object(node_loc, obj_hex, size)
+                if data is not None:
+                    return reply(data, is_error)
+                self._await_object_settled(obj_hex, 30.0)
+                continue
             if spilled_uri is not None:
                 try:
                     return reply(self.external_storage.restore(spilled_uri),
@@ -2109,51 +2420,53 @@ class ControlServer:
     # Worker pool (counterpart of raylet WorkerPool::StartWorkerProcess)
     def _spawn_worker(self, env_key: str, kind: str,
                       node_id: str = "head") -> WorkerInfo:
-        """Lock held."""
+        """Lock held.  Local nodes fork the process here; remote nodes
+        get a spawn_worker push to their manager (reference: the raylet
+        owns worker processes on its host, worker_pool.h:159)."""
+        from ray_tpu.core.node_manager import spawn_worker_process
+
         worker_id = WorkerID.from_random()
         w = WorkerInfo(worker_hex=worker_id.hex(), kind=kind, env_key=env_key,
-                       state="starting", node_id=node_id)
+                       state="starting", node_id=node_id,
+                       spawned_at=time.time())
         self.workers[worker_id.hex()] = w
-
-        env = dict(os.environ)
-        env["RAY_TPU_CONTROL_ADDR"] = self.address
-        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
-        env["RAY_TPU_SESSION_ID"] = self.session_id
-        env["RAY_TPU_WORKER_KIND"] = kind
-        env["RAY_TPU_ENV_KEY"] = env_key
-        env["RAY_TPU_NAMESPACE"] = self.namespace
-        env["RAY_TPU_NODE_ID"] = node_id
-        # Line-visible worker output: without this, task print()s sit in
-        # the child's block buffer until exit and the driver-side log
-        # monitor streams them far too late.
-        env["PYTHONUNBUFFERED"] = "1"
-        # pyarrow's bundled jemalloc segfaults under this kernel (observed
-        # SIGSEGV inside table allocation paths); the system allocator is
-        # reliable and plenty fast for block-sized allocations.
-        env.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
-        cmd = [sys.executable, "-m", "ray_tpu.core.worker"]
-        if env_key.startswith("tpu0") or not env_key.startswith("tpu"):
-            # CPU-only worker: never let it grab the TPU runtime, and skip
-            # site initialization — the environment's sitecustomize imports
-            # jax (~1.7 s) into every interpreter, which a CPU pool worker
-            # doesn't need.  Site-packages go back on the path via PYTHONPATH.
-            env["JAX_PLATFORMS"] = "cpu"
-            extra = [p for p in (_site_packages(), env.get("PYTHONPATH"))
-                     if p]
-            if extra:
-                env["PYTHONPATH"] = os.pathsep.join(extra)
-            cmd = [sys.executable, "-S", "-m", "ray_tpu.core.worker"]
-        log_base = os.path.join(self.session_dir, "logs",
-                                f"worker-{worker_id.hex()[:8]}")
-        stdout = open(log_base + ".out", "ab")
-        stderr = open(log_base + ".err", "ab")
-        proc = subprocess.Popen(
-            cmd, env=env, stdout=stdout, stderr=stderr,
-            cwd=os.getcwd(),
-        )
+        node = self.nodes.get(node_id)
+        if node is not None and node.conn is not None:
+            try:
+                node.conn.push({
+                    "op": "spawn_worker", "worker_hex": worker_id.hex(),
+                    "kind": kind, "env_key": env_key,
+                    "namespace": self.namespace})
+            except Exception:
+                self._mark_worker_dead(w, "node manager unreachable")
+            return w
+        proc = spawn_worker_process(
+            control_addr=self.address, worker_hex=worker_id.hex(),
+            kind=kind, env_key=env_key, namespace=self.namespace,
+            node_id=node_id,
+            log_dir=os.path.join(self.session_dir, "logs"),
+            session_id=self.session_id)
         w.proc = proc
         w.pid = proc.pid
         return w
+
+    def _reap_unregistered_workers(self):
+        """Lock held.  A spawned worker that never registered within the
+        timeout (its process died pre-registration, or its node crashed
+        mid-spawn) will produce no disconnect event — observe the death
+        here so its task/actor is retried instead of hanging."""
+        timeout = self.config.worker_register_timeout_s
+        if timeout <= 0:
+            return
+        now = time.time()
+        for w in list(self.workers.values()):
+            if w.state != "starting" or w.conn is not None:
+                continue
+            if not w.spawned_at or now - w.spawned_at < timeout:
+                continue
+            if w.proc is not None and w.proc.poll() is None:
+                continue  # local process still alive (slow import)
+            self._mark_worker_dead(w, "worker never registered")
 
     def deliver_pending_create(self, w: WorkerInfo):
         spec = getattr(w, "pending_create", None)

@@ -1,0 +1,257 @@
+"""Per-node manager daemon: the raylet counterpart for worker hosts.
+
+A NodeManager joins an existing cluster head (`ray-tpu start
+--address=<head>`), registers this host's resources, and then:
+
+  - spawns/supervises the local worker pool when the head's scheduler
+    places work on this node (reference WorkerPool::StartWorkerProcess,
+    src/ray/raylet/worker_pool.h:159),
+  - owns the node-local shared-memory arena (the embedded plasma store of
+    a raylet, src/ray/object_manager/plasma/store_runner.h) that this
+    node's workers read/write,
+  - serves chunked object fetches to other nodes/the head over the frame
+    protocol (reference ObjectManager::Push/HandlePull,
+    src/ray/object_manager/object_manager.h:206/:139),
+  - sweeps dead-process pins from its arena (plasma client-disconnect
+    accounting).
+
+The head keeps the cluster-wide object *directory* (who has what) and
+does location lookup; the bulk bytes move node-to-node without transiting
+the head (reference OwnershipBasedObjectDirectory + direct raylet-to-
+raylet transfer).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+from ray_tpu.core import rpc
+from ray_tpu.core.config import get_config, reset_config
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_store import ShmObjectStore
+from ray_tpu.core.resources import node_resources_from_env
+
+
+def spawn_worker_process(*, control_addr: str, worker_hex: str, kind: str,
+                         env_key: str, namespace: str, node_id: str,
+                         log_dir: str, session_id: str,
+                         extra_env: Optional[dict] = None
+                         ) -> subprocess.Popen:
+    """Start one worker process (shared by the head's in-process pool and
+    remote node managers — reference worker_pool.h StartWorkerProcess)."""
+    from ray_tpu.core.gcs import _site_packages
+
+    env = dict(os.environ)
+    env["RAY_TPU_CONTROL_ADDR"] = control_addr
+    env["RAY_TPU_WORKER_ID"] = worker_hex
+    env["RAY_TPU_SESSION_ID"] = session_id
+    env["RAY_TPU_WORKER_KIND"] = kind
+    env["RAY_TPU_ENV_KEY"] = env_key
+    env["RAY_TPU_NAMESPACE"] = namespace
+    env["RAY_TPU_NODE_ID"] = node_id
+    # Line-visible worker output (see gcs.py _spawn_worker).
+    env["PYTHONUNBUFFERED"] = "1"
+    # pyarrow's bundled jemalloc segfaults under this kernel.
+    env.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+    if extra_env:
+        env.update(extra_env)
+    cmd = [sys.executable, "-m", "ray_tpu.core.worker"]
+    if env_key.startswith("tpu0") or not env_key.startswith("tpu"):
+        # CPU-only worker: skip site init (sitecustomize imports jax).
+        env["JAX_PLATFORMS"] = "cpu"
+        extra = [p for p in (_site_packages(), env.get("PYTHONPATH")) if p]
+        if extra:
+            env["PYTHONPATH"] = os.pathsep.join(extra)
+        cmd = [sys.executable, "-S", "-m", "ray_tpu.core.worker"]
+    os.makedirs(log_dir, exist_ok=True)
+    log_base = os.path.join(log_dir, f"worker-{worker_hex[:8]}")
+    stdout = open(log_base + ".out", "ab")
+    stderr = open(log_base + ".err", "ab")
+    return subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr,
+                            cwd=os.getcwd())
+
+
+class NodeManager:
+    """One per worker host; dies with the cluster (or when the head asks)."""
+
+    def __init__(self, head_address: str, num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 resources: Optional[dict] = None, node_id: str = "",
+                 labels: Optional[Dict[str, str]] = None):
+        reset_config()
+        self.config = get_config()
+        self.head_address = head_address
+        # The arena name must be unique per NODE, not per session: two
+        # node managers simulated on one machine (tests) must not share
+        # /dev/shm segments, or "remote" fetches silently read locally.
+        self.store_key = f"node-{uuid.uuid4().hex[:12]}"
+        self._stopped = threading.Event()
+        self._lock = threading.Lock()
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self.server = rpc.Server(self._handle,
+                                 host=self.config.node_ip_address)
+        # Advertised (not bind) address: a 0.0.0.0 bind must not hand
+        # peers an unroutable wildcard.
+        self.address = (f"{self.config.advertised_host()}:"
+                        f"{self.server.port}")
+        node_res = node_resources_from_env(num_cpus, num_tpus, resources)
+        self.head = rpc.Client(head_address, on_push=self._on_push)
+        reply = self.head.call({
+            "op": "register_node",
+            "node_id": node_id,
+            "resources": node_res.to_dict(),
+            "address": self.address,
+            "labels": labels or {},
+            "store_key": self.store_key,
+            "shm_dir": self.config.shm_dir,
+        })
+        self.node_id = reply["node_id"]
+        self.session_id = reply["session_id"]
+        self.namespace = reply.get("namespace", "")
+        self.session_dir = os.path.join(
+            "/tmp/ray_tpu", f"session-{self.session_id}",
+            f"node-{self.node_id}")
+        os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
+        self.store = ShmObjectStore(self.store_key, self.config.shm_dir)
+        self._sweeper = threading.Thread(target=self._sweep_loop,
+                                         name="node-sweep", daemon=True)
+        self._sweeper.start()
+
+    # -- head → node pushes --------------------------------------------
+    def _on_push(self, msg: dict):
+        op = msg.get("op")
+        if op == "spawn_worker":
+            try:
+                proc = spawn_worker_process(
+                    control_addr=self.head_address,
+                    worker_hex=msg["worker_hex"], kind=msg["kind"],
+                    env_key=msg["env_key"],
+                    namespace=msg.get("namespace", self.namespace),
+                    node_id=self.node_id,
+                    log_dir=os.path.join(self.session_dir, "logs"),
+                    session_id=self.session_id)
+                with self._lock:
+                    self._procs[msg["worker_hex"]] = proc
+            except Exception as e:  # noqa: BLE001
+                try:
+                    self.head.send({"op": "worker_spawn_failed",
+                                    "worker_hex": msg["worker_hex"],
+                                    "error": f"{type(e).__name__}: {e}"})
+                except Exception:
+                    pass
+        elif op == "kill_worker":
+            with self._lock:
+                proc = self._procs.pop(msg["worker_hex"], None)
+            if proc is not None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+        elif op == "delete_object":
+            # Cluster-wide refcount hit 0 (head decref/free): release the
+            # local arena copy.
+            try:
+                self.store.delete(ObjectID.from_hex(msg["obj"]))
+            except Exception:
+                pass
+        elif op == "exit":
+            self._stopped.set()
+
+    # -- peer/head → node requests (object plane) ----------------------
+    def _handle(self, conn: rpc.Connection, msg: dict):
+        op = msg.get("op")
+        if op == "fetch_chunk":
+            # Chunked pull of a locally stored object.  The segment stays
+            # attached (cached in the store) until the object is deleted,
+            # so concurrent chunk reads never race a release.
+            oid = ObjectID.from_hex(msg["obj"])
+            seg = self.store.attach(oid, msg["size"])
+            off, n = msg["offset"], msg["length"]
+            return bytes(seg.buf[off:off + n])
+        if op == "has_object":
+            return self.store.contains(ObjectID.from_hex(msg["obj"]))
+        if op == "ping":
+            return "pong"
+        raise ValueError(f"unknown node op {op}")
+
+    # -- lifecycle ------------------------------------------------------
+    def _sweep_loop(self):
+        """Reap exited worker processes and drop their arena pins."""
+        while not self._stopped.wait(1.0):
+            with self._lock:
+                for hex_, p in list(self._procs.items()):
+                    if p.poll() is not None:
+                        del self._procs[hex_]
+                alive = [p.pid for p in self._procs.values()]
+            alive.append(os.getpid())
+            try:
+                self.store.sweep(alive)
+            except Exception:
+                pass
+            # The head going away (without a clean exit push) orphans
+            # this node: shut down rather than leak workers.
+            if self.head._closed:
+                self._stopped.set()
+
+    def run_forever(self):
+        try:
+            self._stopped.wait()
+        except KeyboardInterrupt:
+            pass
+        self.shutdown()
+
+    def shutdown(self):
+        self._stopped.set()
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        deadline = time.monotonic() + 1.0
+        while procs and time.monotonic() < deadline:
+            procs = [p for p in procs if p.poll() is None]
+            if procs:
+                time.sleep(0.02)
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        try:
+            self.server.stop()
+        except Exception:
+            pass
+        try:
+            self.head.close()
+        except Exception:
+            pass
+        self.store.cleanup()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser("ray_tpu.core.node_manager")
+    p.add_argument("--address", required=True, help="head control address")
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--node-id", default="")
+    p.add_argument("--label", action="append", default=[],
+                   help="k=v node label (repeatable)")
+    args = p.parse_args(argv)
+    labels = dict(kv.split("=", 1) for kv in args.label)
+    nm = NodeManager(args.address, num_cpus=args.num_cpus,
+                     num_tpus=args.num_tpus, node_id=args.node_id,
+                     labels=labels)
+    print(f"node {nm.node_id} joined {args.address} "
+          f"(object server {nm.server.address})", flush=True)
+    nm.run_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

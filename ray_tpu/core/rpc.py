@@ -63,6 +63,27 @@ class RpcError(ConnectionError):
     pass
 
 
+def pull_object_chunked(client: "Client", obj_hex: str, size: int,
+                        chunk: int, timeout: float = 60.0) -> bytes:
+    """Pull an object's bytes via fetch_chunk requests (the cross-node
+    object plane's one wire loop — shared by workers pulling from peer
+    nodes and the head proxying for thin clients).  Raises on a short or
+    failed read."""
+    chunk = max(1 << 20, chunk)
+    data = bytearray(size)
+    off = 0
+    while off < size:
+        n = min(chunk, size - off)
+        part = client.call({"op": "fetch_chunk", "obj": obj_hex,
+                            "size": size, "offset": off, "length": n},
+                           timeout=timeout)
+        if not part:
+            raise RpcError(f"peer no longer serves object {obj_hex}")
+        data[off:off + len(part)] = part
+        off += len(part)
+    return bytes(data)
+
+
 class _RemoteTraceback(Exception):
     pass
 

@@ -105,8 +105,12 @@ class CoreClient:
         })
         self.session_id = reply["session_id"]
         self.session_dir = reply["session_dir"]
+        # The arena this process attaches is its NODE's (multi-host:
+        # each node manager owns one; head + logical nodes share the
+        # head's — gcs.py _op_register decides).
+        self.store_node = reply.get("store_node", "head")
         self.store = None if thin else ShmObjectStore(
-            self.session_id, reply["shm_dir"])
+            reply.get("store_key") or self.session_id, reply["shm_dir"])
 
         self._lock = threading.Lock()
         # Thread-local put buffering: a worker executing a task batches
@@ -119,6 +123,8 @@ class CoreClient:
         self._actor_state: Dict[str, dict] = {}
         self._actor_cv = threading.Condition()
         self._actor_conns: Dict[str, rpc.Client] = {}
+        # Connections to other nodes' object servers (cross-node pulls).
+        self._node_conns: Dict[str, rpc.Client] = {}
         self._actor_queues: Dict[str, List[TaskSpec]] = {}
         self._sent_funcs: set[str] = set()
         self._closed = False
@@ -236,6 +242,28 @@ class CoreClient:
                 seg = self.store.attach(ObjectID.from_hex(obj_hex),
                                         info["size"])
             except Exception as e:  # noqa: BLE001
+                if info.get("node", "head") != self.store_node:
+                    # Not in this node's arena (and no cached replica):
+                    # pull the bytes from the holding node over the
+                    # object plane (reference ObjectManager Pull,
+                    # object_manager.h:139) and cache them locally.
+                    try:
+                        data = self._pull_remote_object(obj_hex, info)
+                        return self._finish_load(obj_hex, data, info)
+                    except Exception:
+                        if _retried:
+                            raise
+                        # Node dead or its arena evicted the copy: tell
+                        # the head (it verifies and kicks lineage
+                        # reconstruction), then re-subscribe for the
+                        # recovered value.
+                        try:
+                            self.client.call(
+                                {"op": "report_object_lost",
+                                 "obj": obj_hex}, timeout=30.0)
+                        except Exception:
+                            pass
+                        e = FileNotFoundError(obj_hex)
                 # Stale location: the server may have SPILLED the object
                 # after this client cached its in-shm info. Drop the
                 # cached future + subscription and re-subscribe — the
@@ -265,6 +293,45 @@ class CoreClient:
         if info.get("is_error"):
             raise value
         return value
+
+    def _node_conn(self, address: str) -> rpc.Client:
+        """Connection to another node's object server (cached).  The dial
+        happens OUTSIDE self._lock — a dead node's connect retries must
+        not stall this process's object subscription path."""
+        with self._lock:
+            conn = self._node_conns.get(address)
+        if conn is not None and not conn._closed:
+            return conn
+        conn = rpc.Client(address, connect_timeout=5.0)
+        with self._lock:
+            existing = self._node_conns.get(address)
+            if existing is not None and not existing._closed:
+                conn.close()
+                return existing
+            self._node_conns[address] = conn
+        return conn
+
+    def _pull_remote_object(self, obj_hex: str, info: dict) -> bytes:
+        """Chunked pull of an object living in another node's arena
+        (reference ObjectManager chunked transfer via object_buffer_pool).
+        addr == "" means the head arena: chunks ride the control client.
+        The bytes are cached into the local arena so later readers on
+        this node hit shm (the reference PullManager materializes pulled
+        chunks into local plasma the same way)."""
+        size = info["size"]
+        addr = info.get("addr", "")
+        client = self._node_conn(addr) if addr else self.client
+        payload = rpc.pull_object_chunked(
+            client, obj_hex, size, self.config.transfer_chunk_bytes,
+            timeout=120.0)
+        try:
+            oid = ObjectID.from_hex(obj_hex)
+            seg = self.store.create(oid, size)
+            seg.buf[:size] = payload
+            self.store.seal(oid)
+        except Exception:  # cache is best-effort (arena full, race)
+            pass
+        return payload
 
     def _refetch_object(self, obj_hex: str) -> Future:
         """Forget the resolved location of an object and subscribe again
@@ -633,6 +700,8 @@ class CoreClient:
     def close(self):
         self._closed = True
         for conn in self._actor_conns.values():
+            conn.close()
+        for conn in self._node_conns.values():
             conn.close()
         self.client.close()
 

@@ -48,10 +48,18 @@ class WorkerRuntime:
                  env_key: str):
         self.namespace = os.environ.get("RAY_TPU_NAMESPACE", "")
         self._exit_ev = threading.Event()
-        self.server = rpc.Server(self._handle_direct)
+        from ray_tpu.core.config import get_config
+
+        cfg = get_config()
+        self.server = rpc.Server(self._handle_direct,
+                                 host=cfg.node_ip_address)
+        # Advertised (not bind) address: actor callers on other hosts
+        # dial this.
+        self.advertised_address = (f"{cfg.advertised_host()}:"
+                                   f"{self.server.port}")
         self.core = CoreClient(
             control_addr, worker_hex, kind=kind,
-            address=self.server.address, env_key=env_key)
+            address=self.advertised_address, env_key=env_key)
         self.core.on_execute_task = self._on_execute_task
         self.core.on_create_actor = self._on_create_actor
         self.core.on_exit = self._on_exit
@@ -370,7 +378,7 @@ class WorkerRuntime:
                                  daemon=True).start()
             self.core.client.send({
                 "op": "actor_ready", "actor": spec.actor_id.hex(),
-                "address": self.server.address})
+                "address": self.advertised_address})
         except BaseException as e:  # noqa: BLE001
             traceback.print_exc()
             self.core.client.send({

@@ -55,9 +55,11 @@ def cmd_start(args):
     import ray_tpu
 
     if not args.head:
-        print("only --head is supported (workers join via cluster_utils "
-              "or the autoscaler)", file=sys.stderr)
-        return 1
+        if not args.address:
+            print("pass --head to start a cluster or --address=<head> to "
+                  "join one", file=sys.stderr)
+            return 1
+        return _start_worker_node(args)
     rt = ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
     os.makedirs(os.path.dirname(_ADDRESS_FILE), exist_ok=True)
     with open(_ADDRESS_FILE, "w") as f:
@@ -83,6 +85,26 @@ def cmd_start(args):
         print("running in background of this process; use --block to wait "
               "(or keep this python process alive)")
         signal.pause()
+    return 0
+
+
+def _start_worker_node(args):
+    """Join an existing cluster as a worker node: run the per-node
+    manager daemon (reference `ray start --address=<head>` starting a
+    raylet, scripts.py:571)."""
+    from ray_tpu.core.node_manager import NodeManager
+
+    address = args.address
+    if address == "auto":
+        with open(_ADDRESS_FILE) as f:
+            address = f.read().strip()
+    labels = dict(kv.split("=", 1) for kv in (args.label or []))
+    nm = NodeManager(address, num_cpus=args.num_cpus,
+                     num_tpus=args.num_tpus, node_id=args.node_id,
+                     labels=labels)
+    print(f"node {nm.node_id} joined cluster at {address}")
+    print(f"object server at {nm.server.address}; Ctrl-C to leave")
+    nm.run_forever()
     return 0
 
 
@@ -270,8 +292,14 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser("ray-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    sp = sub.add_parser("start", help="start a cluster head")
+    sp = sub.add_parser("start", help="start a cluster head or join one")
     sp.add_argument("--head", action="store_true")
+    sp.add_argument("--address", default="",
+                    help="head address to join as a worker node "
+                         "('auto' reads the local address file)")
+    sp.add_argument("--node-id", default="")
+    sp.add_argument("--label", action="append", default=[],
+                    help="k=v node label (repeatable)")
     sp.add_argument("--num-cpus", type=float, default=None)
     sp.add_argument("--num-tpus", type=float, default=None)
     sp.add_argument("--dashboard", action=argparse.BooleanOptionalAction,

@@ -161,44 +161,44 @@ def test_lost_spilled_copy_falls_back_to_lineage(tmp_path):
         if not rt.core.store.native:
             pytest.skip("file-backed store has no bounded arena to spill")
 
+        # Deterministic setup: ONE lineage-backed target created first
+        # (spill evicts oldest-first), then filler driver puts (no
+        # lineage) to build arena pressure past the threshold.
         @ray_tpu.remote
-        def produce(i):
+        def produce():
             with open(marker, "a") as f:
-                f.write(str(i))
-            return np.full(300_000, i, dtype=np.uint8)
+                f.write("x")
+            return np.full(300_000, 7, dtype=np.uint8)
 
-        refs = [produce.remote(i) for i in range(8)]  # ~2.4 MB > 30%
-        for i, r in enumerate(refs):
-            assert ray_tpu.get(r)[0] == i
-        # Find a spilled one and delete its backing copy.
+        ref = produce.remote()
+        assert ray_tpu.get(ref, timeout=30)[0] == 7
+        assert marker.read_text() == "x"
+        fillers = [ray_tpu.put(np.zeros(300_000, dtype=np.uint8))
+                   for _ in range(8)]  # ~2.4 MB > 30% of 4 MB
+        assert fillers
+        # Drive the spill of OUR object explicitly.
         import time
         server = rt.control
-        spilled_hex = None
         deadline = time.time() + 15
-        while spilled_hex is None and time.time() < deadline:
+        uri = None
+        while uri is None and time.time() < deadline:
+            server._maybe_spill()
             with server.lock:
-                for obj_hex, entry in server.objects.items():
-                    # Skip entries mid-restore: the restore may already
-                    # have read the backing file, so deleting it here
-                    # would not force the lineage fallback (flaky).
-                    if entry.spilled_uri is not None and not entry.restoring:
-                        spilled_hex = obj_hex
-                        server.external_storage.delete(entry.spilled_uri)
-                        break
-            if spilled_hex is None:
-                server._maybe_spill()
-                time.sleep(0.2)
-        if spilled_hex is None:
+                entry = server.objects.get(ref.hex())
+                assert entry is not None
+                if entry.spilled_uri is not None and not entry.restoring:
+                    uri = entry.spilled_uri
+                    server.external_storage.delete(uri)
+            if uri is None:
+                time.sleep(0.1)
+        if uri is None:
             pytest.skip("spill did not trigger on this arena layout")
-        lost_ref = next(r for r in refs if r.hex() == spilled_hex)
-        idx = refs.index(lost_ref)
-        # The arena may still hold the pre-spill copy; lose that too so
-        # the only remaining path is restore (which will fail) → lineage.
-        _lose(rt, lost_ref)
-        got = ray_tpu.get(lost_ref, timeout=60)
-        assert got[0] == idx and len(got) == 300_000
-        # Re-executed at least once; background spill/restore races can
-        # legitimately reconstruct more than once under suite load.
-        assert marker.read_text().count(str(idx)) >= 2
+        # The driver may still hold a pinned (orphaned) mapping of the
+        # pre-spill copy; drop it so the only remaining path is restore
+        # (which will fail: backing file deleted) → lineage re-execution.
+        _lose(rt, ref)
+        got = ray_tpu.get(ref, timeout=60)
+        assert got[0] == 7 and len(got) == 300_000
+        assert marker.read_text().count("x") >= 2  # task re-executed
     finally:
         ray_tpu.shutdown()
