@@ -71,6 +71,9 @@ class ObjectEntry:
     # reference's object directory locations
     # (ownership_based_object_directory.cc).
     node_id: str = "head"
+    # Nodes that cached a pulled replica (so freeing the object can
+    # delete every arena copy, not just the primary's).
+    replicas: Set[str] = field(default_factory=set)
 
 
 @dataclass
@@ -963,7 +966,8 @@ class ControlServer:
             if entry.refcount <= 0 and entry.state in (READY, ERRORED):
                 del self.objects[obj_hex]
                 if entry.in_shm:
-                    to_delete.append((obj_hex, entry.node_id))
+                    for loc in {entry.node_id, *entry.replicas}:
+                        to_delete.append((obj_hex, loc))
                 if entry.spilled_uri:
                     try:
                         self.external_storage.delete(entry.spilled_uri)
@@ -991,6 +995,17 @@ class ControlServer:
             except Exception:
                 pass
 
+    def _op_object_replica(self, conn, msg):
+        """A client cached a pulled copy in its node's arena: record the
+        location so freeing the object deletes every copy."""
+        with self.lock:
+            entry = self.objects.get(msg["obj"])
+            if entry is None:
+                return
+            node = self._store_node_for(conn)
+            if node != entry.node_id:
+                entry.replicas.add(node)
+
     def _op_register_objects(self, conn, msg):
         """Pre-register return objects of direct (actor) tasks with one ref
         held by the submitter, mirroring TaskManager::AddPendingTask return
@@ -1014,7 +1029,8 @@ class ControlServer:
                 self.lineage.pop(obj_hex, None)
                 entry = self.objects.pop(obj_hex, None)
                 if entry is not None and entry.in_shm:
-                    to_delete.append((obj_hex, entry.node_id))
+                    for loc in {entry.node_id, *entry.replicas}:
+                        to_delete.append((obj_hex, loc))
                 if entry is not None and entry.spilled_uri:
                     try:
                         self.external_storage.delete(entry.spilled_uri)
@@ -1428,13 +1444,22 @@ class ControlServer:
             node = self.nodes.get(node_id)
             if node is None:
                 return False
-            if node.conn is not None:
-                # Real node: ask its manager to exit; its disconnect then
-                # runs the full node-death path (object recovery etc.).
-                try:
-                    node.conn.push({"op": "exit"})
-                except Exception:
-                    pass
+            conn = node.conn
+        if conn is not None:
+            # Real node: ask its manager to exit and run the full
+            # node-death path NOW (worker fail/retry, PG teardown, object
+            # recovery) — the later disconnect then no-ops on the
+            # already-dead node.
+            try:
+                conn.push({"op": "exit"})
+            except Exception:
+                pass
+            self._handle_node_death(node_id)
+            return True
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return False
             node.alive = False
             node.available = ResourceSet()
             for w in list(self.workers.values()):
@@ -1935,8 +1960,8 @@ class ControlServer:
             node.available = node.available.subtract(need)
 
     def _schedule_once(self):
+        self._reap_unregistered_workers()
         with self.lock:
-            self._reap_unregistered_workers()
             # 0. retry pending placement groups (resources may have freed or
             # nodes joined — reference GcsPlacementGroupManager retry loop)
             for pg in self.placement_groups.values():
@@ -2451,22 +2476,46 @@ class ControlServer:
         return w
 
     def _reap_unregistered_workers(self):
-        """Lock held.  A spawned worker that never registered within the
-        timeout (its process died pre-registration, or its node crashed
+        """A spawned worker that never registered within the timeout
+        (its process died pre-registration, or its node crashed
         mid-spawn) will produce no disconnect event — observe the death
-        here so its task/actor is retried instead of hanging."""
+        here so its task/actor is retried instead of hanging.  Takes
+        and releases the lock itself (remote liveness probes must not
+        run under it)."""
         timeout = self.config.worker_register_timeout_s
         if timeout <= 0:
             return
         now = time.time()
-        for w in list(self.workers.values()):
-            if w.state != "starting" or w.conn is not None:
+        remote_suspects = []
+        with self.lock:
+            for w in list(self.workers.values()):
+                if w.state != "starting" or w.conn is not None:
+                    continue
+                if not w.spawned_at or now - w.spawned_at < timeout:
+                    continue
+                if w.proc is not None:
+                    if w.proc.poll() is None:
+                        continue  # local process still alive (slow import)
+                    self._mark_worker_dead(w, "worker never registered")
+                else:
+                    remote_suspects.append(w)
+        # Remote workers get the same tolerance as slow local imports:
+        # ask their node manager whether the process is still alive.
+        for w in remote_suspects:
+            alive = False
+            client = self._node_client(w.node_id)
+            if client is not None:
+                try:
+                    alive = bool(client.call(
+                        {"op": "worker_alive", "worker_hex": w.worker_hex},
+                        timeout=5.0))
+                except Exception:
+                    alive = False
+            if alive:
                 continue
-            if not w.spawned_at or now - w.spawned_at < timeout:
-                continue
-            if w.proc is not None and w.proc.poll() is None:
-                continue  # local process still alive (slow import)
-            self._mark_worker_dead(w, "worker never registered")
+            with self.lock:
+                if w.state == "starting" and w.conn is None:
+                    self._mark_worker_dead(w, "worker never registered")
 
     def deliver_pending_create(self, w: WorkerInfo):
         spec = getattr(w, "pending_create", None)

@@ -92,6 +92,10 @@ class NodeManager:
         # /dev/shm segments, or "remote" fetches silently read locally.
         self.store_key = f"node-{uuid.uuid4().hex[:12]}"
         self._stopped = threading.Event()
+        # Head pushes (spawn_worker) and peer fetches can arrive the
+        # moment register_node returns — before __init__ finishes
+        # assigning session_dir/store below.  Handlers gate on this.
+        self._ready = threading.Event()
         self._lock = threading.Lock()
         self._procs: Dict[str, subprocess.Popen] = {}
         self.server = rpc.Server(self._handle,
@@ -119,12 +123,14 @@ class NodeManager:
             f"node-{self.node_id}")
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self.store = ShmObjectStore(self.store_key, self.config.shm_dir)
+        self._ready.set()
         self._sweeper = threading.Thread(target=self._sweep_loop,
                                          name="node-sweep", daemon=True)
         self._sweeper.start()
 
     # -- head → node pushes --------------------------------------------
     def _on_push(self, msg: dict):
+        self._ready.wait(timeout=60.0)
         op = msg.get("op")
         if op == "spawn_worker":
             try:
@@ -165,6 +171,7 @@ class NodeManager:
 
     # -- peer/head → node requests (object plane) ----------------------
     def _handle(self, conn: rpc.Connection, msg: dict):
+        self._ready.wait(timeout=60.0)
         op = msg.get("op")
         if op == "fetch_chunk":
             # Chunked pull of a locally stored object.  The segment stays
@@ -176,6 +183,10 @@ class NodeManager:
             return bytes(seg.buf[off:off + n])
         if op == "has_object":
             return self.store.contains(ObjectID.from_hex(msg["obj"]))
+        if op == "worker_alive":
+            with self._lock:
+                proc = self._procs.get(msg["worker_hex"])
+            return proc is not None and proc.poll() is None
         if op == "ping":
             return "pong"
         raise ValueError(f"unknown node op {op}")

@@ -329,6 +329,9 @@ class CoreClient:
             seg = self.store.create(oid, size)
             seg.buf[:size] = payload
             self.store.seal(oid)
+            # Tell the directory about the replica so a cluster-wide free
+            # deletes this arena's copy too (no leak on consumer nodes).
+            self.client.send({"op": "object_replica", "obj": obj_hex})
         except Exception:  # cache is best-effort (arena full, race)
             pass
         return payload
