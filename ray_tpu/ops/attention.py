@@ -1,4 +1,4 @@
-"""Attention ops: Pallas TPU flash attention with a jnp reference fallback.
+"""Attention ops: Pallas TPU flash attention (fwd + bwd) with a jnp fallback.
 
 The reference framework ships no attention kernels (SURVEY.md §5 — long-context
 machinery is absent in-tree); on TPU this is a core op.  Design:
@@ -6,12 +6,18 @@ machinery is absent in-tree); on TPU this is a core op.  Design:
   - `flash_attention(q, k, v, causal=...)`: online-softmax tiled kernel
     (Pallas, grid over (batch*heads, q-blocks), fori_loop over k-blocks) so
     the s×s score matrix never materializes in HBM.
-  - CPU / odd-shape fallback: blockwise jnp reference with identical
-    semantics — used in unit tests (which compare the two in interpret mode)
-    and under the virtual CPU mesh.
-  - Backward: custom VJP recomputes attention blockwise using the saved
-    logsumexp (standard flash backward), in jnp — XLA fuses it; a Pallas
-    backward kernel is a later optimization.
+  - `flash_attention_chunk(...)`: the offset-aware variant returning
+    (out, lse) — the building block ring attention uses per K/V chunk
+    (ops/ring_attention.py); positions enter as DYNAMIC scalars so the
+    same compiled kernel serves every ring step.
+  - Backward: Pallas dq and dk/dv kernels recomputing scores blockwise
+    from the saved logsumexp (standard flash backward — dq grid over
+    q-blocks, dkv grid over k-blocks); the s×s matrix never exists in
+    the backward either.  The lse OUTPUT is differentiable too (ring
+    attention's merge weights depend on it): ds += p * dlse.
+  - CPU / odd-shape fallback: `attention_reference` with identical
+    semantics — the numerical ground truth in tests (which compare both
+    paths in interpret mode, values and grads).
 
 Layout convention: q, k, v are [batch, seq, heads, head_dim] (the models/
 convention); kernels internally fold batch×heads.
@@ -75,11 +81,17 @@ def attention_reference(q, k, v, causal: bool = True,
 
 
 # ---------------------------------------------------------------------------
-# Pallas forward kernel
+# Pallas forward kernel (offset-aware, emits logsumexp)
 # ---------------------------------------------------------------------------
+# Scalar-prefetch arg offs = [q_off, kv_off]: global position of this
+# operand's row/col 0.  The plain causal call uses (sk - sq, 0) (ends
+# aligned); ring attention passes each chunk's global offsets, so one
+# compiled kernel serves every ring step (fully-unmasked, diagonal, and
+# fully-masked chunks alike).
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
-                block_q: int, block_k: int, seq_k: int, sm_scale: float):
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                causal: bool, block_q: int, block_k: int, seq_k: int,
+                sm_scale: float):
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
@@ -92,9 +104,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
 
     num_k_blocks = seq_k // block_k
     if causal:
-        # Last k-block any row of this q-block may attend to.
-        hi = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        hi = jnp.minimum(hi, num_k_blocks)
+        q_off = offs_ref[0]
+        kv_off = offs_ref[1]
+        # Last k-block any row of this q-block may attend to:
+        # col <= q_off - kv_off + row_max.  floor_divide (NOT lax.div,
+        # which truncates toward zero) so negative row_max yields hi=0.
+        row_max = q_off - kv_off + (qi + 1) * block_q - 1
+        hi = jnp.clip(jnp.floor_divide(row_max, block_k) + 1,
+                      0, num_k_blocks)
     else:
         hi = num_k_blocks
 
@@ -106,9 +123,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [block_q, block_k]
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
+            rows = offs_ref[0] + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(
+            cols = offs_ref[1] + j * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(cols <= rows, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -121,17 +138,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal: bool,
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    # Rows with no visible keys (possible in ring chunks "from the
+    # future"): m stayed at -inf, so p accumulated exp(0)=1 garbage —
+    # zero the output and mark lse = -inf ("no weight" for the merge).
+    valid = m > _NEG_INF / 2
+    o_ref[0] = jnp.where(valid, acc / l_safe, 0.0).astype(o_ref.dtype)
+    lse = jnp.where(valid & (l > 0), m + jnp.log(l_safe), _NEG_INF)
     # lse is logically [block_q]; stored broadcast over an 8-sublane axis so
     # the block shape ends in (8, block_q) per Mosaic's tiling constraint.
-    lse_ref[0] = jnp.broadcast_to(
-        (m + jnp.log(l))[:, 0][None, :], (8, block_q))
+    lse_ref[0] = jnp.broadcast_to(lse[:, 0][None, :], (8, block_q))
 
 
-def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
+def _flash_fwd(q, k, v, offs, causal: bool, sm_scale: float,
                block_q: int, block_k: int):
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -146,70 +168,271 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale: float,
         seq_k=sk, sm_scale=sm_scale)
     out, lse = pl.pallas_call(
         kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, 8, block_q), lambda bh, i: (bh, 0, i)),
-        ],
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bh, i, offs: (bh, i, 0)),
+                pl.BlockSpec((1, sk, d), lambda bh, i, offs: (bh, 0, 0)),
+                pl.BlockSpec((1, sk, d), lambda bh, i, offs: (bh, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bh, i, offs: (bh, i, 0)),
+                pl.BlockSpec((1, 8, block_q), lambda bh, i, offs: (bh, 0, i)),
+            ],
+        ),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, 8, sq), jnp.float32),
         ],
         interpret=_interpret_mode(),
-    )(qf, kf, vf)
+    )(offs, qf, kf, vf)
     out = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    return out, lse[:, 0, :]
+    return out, lse[:, 0, :]  # lse: [bh, sq]
 
 
 # ---------------------------------------------------------------------------
-# custom VJP: forward saves logsumexp; backward recomputes blockwise in jnp.
+# Pallas backward kernels: recompute-by-block using the saved logsumexp.
+# Standard flash backward split (the reference design point is the public
+# flash-attention algorithm, not the Ray repo): dq iterates k-blocks per
+# q-block; dk/dv iterate q-blocks per k-block.  delta = rowsum(do * out)
+# is precomputed outside; dlse is the cotangent of the lse OUTPUT (zero
+# for plain flash_attention, nonzero under ring attention's merge).
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k):
-    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return out
+def _bwd_recompute_p(q, k, lse_row, rows, cols, causal, sm_scale):
+    """Shared score recompute: p_ij = exp(q·k·scale - lse_i), masked."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * sm_scale
+    p = jnp.exp(s - lse_row[:, None])
+    if causal:
+        p = jnp.where(cols <= rows, p, 0.0)
+    return p
 
 
-def _flash_vjp_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return out, (q, k, v, out, lse)
+def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dlse_ref, dq_ref, *, causal: bool,
+                   block_q: int, block_k: int, seq_k: int, sm_scale: float):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)          # [block_q, d]
+    do = do_ref[0].astype(jnp.float32)        # [block_q, d]
+    lse = lse_ref[0, 0, :]                    # [block_q]
+    # (delta + (-dlse)) enters every column uniformly: fold into one term.
+    corr = delta_ref[0, 0, :] - dlse_ref[0, 0, :]  # [block_q]
+    d = q.shape[-1]
+
+    num_k_blocks = seq_k // block_k
+    if causal:
+        row_max = offs_ref[0] - offs_ref[1] + (qi + 1) * block_q - 1
+        hi = jnp.clip(jnp.floor_divide(row_max, block_k) + 1,
+                      0, num_k_blocks)
+    else:
+        hi = num_k_blocks
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        if causal:
+            rows = offs_ref[0] + qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = offs_ref[1] + j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+        else:
+            rows = cols = None
+        p = _bwd_recompute_p(q, k_blk, lse, rows, cols, causal, sm_scale)
+        dp = jax.lax.dot_general(                  # do · v^T
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # [block_q, block_k]
+        ds = p * (dp - corr[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _flash_vjp_bwd(causal, sm_scale, block_q, block_k, res, dout):
-    q, k, v, out, lse = res
+def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dlse_ref, dk_ref, dv_ref, *, causal: bool,
+                    block_q: int, block_k: int, seq_q: int, sm_scale: float):
+    from jax.experimental import pallas as pl
+
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)          # [block_k, d]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+
+    num_q_blocks = seq_q // block_q
+    if causal:
+        # First q-block whose last row can see this k-block's first col.
+        lo = jnp.clip(
+            jnp.floor_divide(offs_ref[1] + ki * block_k - offs_ref[0],
+                             block_q),
+            0, num_q_blocks)
+    else:
+        lo = 0
+
+    def body(j, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse_blk = lse_ref[0, 0, pl.ds(j * block_q, block_q)]
+        corr = (delta_ref[0, 0, pl.ds(j * block_q, block_q)]
+                - dlse_ref[0, 0, pl.ds(j * block_q, block_q)])
+        if causal:
+            rows = offs_ref[0] + j * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = offs_ref[1] + ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+        else:
+            rows = cols = None
+        p = _bwd_recompute_p(q_blk, k, lse_blk, rows, cols, causal,
+                             sm_scale)                 # [block_q, block_k]
+        dv_new = dv + jax.lax.dot_general(             # p^T · do
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [block_k, d]
+        dp = jax.lax.dot_general(                      # do · v^T
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - corr[:, None]) * sm_scale
+        dk_new = dk + jax.lax.dot_general(             # ds^T · q
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        lo, num_q_blocks, body,
+        (jnp.zeros((block_k, d), jnp.float32),
+         jnp.zeros((block_k, d), jnp.float32)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _lse8(x, bh, s):
+    """[bh, s] f32 -> [bh, 8, s] sublane-broadcast (Mosaic tiling)."""
+    return jnp.broadcast_to(x[:, None, :], (bh, 8, s))
+
+
+def _flash_bwd(q, k, v, out, lse, offs, dout, dlse, causal, sm_scale,
+               block_q, block_k):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    qf = q.astype(jnp.float32)
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
-    do = dout.astype(jnp.float32)
-    lse_ = lse.reshape(b, h, sq)
+    bh = b * h
+    qf = q.transpose(0, 2, 1, 3).reshape(bh, sq, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(bh, sk, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(bh, sk, d)
+    dof = dout.transpose(0, 2, 1, 3).reshape(bh, sq, d)
+    delta = jnp.sum(dof.astype(jnp.float32)
+                    * out.transpose(0, 2, 1, 3).reshape(bh, sq, d)
+                    .astype(jnp.float32), axis=-1)      # [bh, sq]
+    lse8 = _lse8(lse, bh, sq)
+    delta8 = _lse8(delta, bh, sq)
+    dlse8 = _lse8(dlse.astype(jnp.float32), bh, sq)
 
-    # p_ij = exp(q·k * scale - lse_i): exact probabilities, no re-softmax.
-    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,
-                   preferred_element_type=jnp.float32) * sm_scale
-    if causal:
-        mask = (jnp.arange(sk)[None, :] - (sk - sq)
-                <= jnp.arange(sq)[:, None])
-        s = jnp.where(mask[None, None], s, _NEG_INF)
-    p = jnp.exp(s - lse_[..., None])
+    seq_spec = pl.BlockSpec((1, 8, sq), lambda g, i, offs: (g, 0, 0))
+    full_q = pl.BlockSpec((1, sq, d), lambda g, i, offs: (g, 0, 0))
+    full_k = pl.BlockSpec((1, sk, d), lambda g, i, offs: (g, 0, 0))
 
-    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do)
-    dp = jnp.einsum("bqhd,bkhd->bhqk", do, vf)
-    delta = jnp.sum(do * out.astype(jnp.float32), axis=-1)  # [b, sq, h]
-    ds = p * (dp - delta.transpose(0, 2, 1)[..., None]) * sm_scale
-    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
-    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, block_q=block_q,
+                          block_k=block_k, seq_k=sk, sm_scale=sm_scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, sq // block_q),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda g, i, offs: (g, i, 0)),
+                full_k, full_k,
+                pl.BlockSpec((1, block_q, d), lambda g, i, offs: (g, i, 0)),
+                pl.BlockSpec((1, 8, block_q), lambda g, i, offs: (g, 0, i)),
+                pl.BlockSpec((1, 8, block_q), lambda g, i, offs: (g, 0, i)),
+                pl.BlockSpec((1, 8, block_q), lambda g, i, offs: (g, 0, i)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d),
+                                   lambda g, i, offs: (g, i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        interpret=_interpret_mode(),
+    )(offs, qf, kf, vf, dof, lse8, delta8, dlse8)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, block_q=block_q,
+                          block_k=block_k, seq_q=sq, sm_scale=sm_scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, sk // block_k),
+            in_specs=[
+                full_q,
+                pl.BlockSpec((1, block_k, d), lambda g, i, offs: (g, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda g, i, offs: (g, i, 0)),
+                full_q, seq_spec, seq_spec, seq_spec,
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_k, d), lambda g, i, offs: (g, i, 0)),
+                pl.BlockSpec((1, block_k, d), lambda g, i, offs: (g, i, 0)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        interpret=_interpret_mode(),
+    )(offs, qf, kf, vf, dof, lse8, delta8, dlse8)
+
+    dq = dq.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    dk = dk.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    dv = dv.reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    return dq, dk, dv
 
 
-_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+# ---------------------------------------------------------------------------
+# custom VJP over (out, lse)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_lse(q, k, v, offs, causal, sm_scale, block_q, block_k):
+    return _flash_fwd(q, k, v, offs, causal, sm_scale, block_q, block_k)
+
+
+def _flash_lse_fwd(q, k, v, offs, causal, sm_scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, offs, causal, sm_scale, block_q, block_k)
+    return (out, lse), (q, k, v, out, lse, offs)
+
+
+def _flash_lse_bwd(causal, sm_scale, block_q, block_k, res, cts):
+    q, k, v, out, lse, offs = res
+    dout, dlse = cts
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, offs, dout, dlse,
+                            causal, sm_scale, block_q, block_k)
+    return dq, dk, dv, None  # offs (int positions) has no gradient
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Public entry points
+# ---------------------------------------------------------------------------
+
+def flash_attention_chunk(q, k, v, q_off, kv_off, causal: bool = True,
+                          sm_scale: Optional[float] = None,
+                          block_q: int = 128, block_k: int = 128):
+    """Offset-aware flash attention returning (out, lse).
+
+    q_off / kv_off: GLOBAL position of q[:,0] / k[:,0] (may be traced —
+    ring attention passes per-device values).  lse is [b*h, sq] float32;
+    rows with no visible keys get lse = -inf (merge-neutral).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    offs = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(kv_off, jnp.int32)])
+    return _flash_lse(q, k, v, offs, causal, sm_scale, block_q, block_k)
 
 
 def flash_attention(q, k, v, causal: bool = True,
@@ -217,9 +440,10 @@ def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = 128, block_k: int = 128):
     """Tiled attention. q:[b,s,h,d], k/v:[b,t,h,d] -> [b,s,h,d].
 
-    Uses the Pallas kernel on TPU (or in interpret mode for tests); falls
+    Uses the Pallas kernels on TPU (or in interpret mode for tests); falls
     back to the jnp reference elsewhere.  Heads must already be expanded
-    (GQA repeat happens in the model).
+    (GQA repeat happens in the model).  When sq < sk the windows are
+    end-aligned (decode convention), matching attention_reference.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
@@ -227,5 +451,8 @@ def flash_attention(q, k, v, causal: bool = True,
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     if _can_use_pallas(sq, sk, d, bq, bk):
-        return _flash(q, k, v, causal, sm_scale, bq, bk)
+        out, _ = flash_attention_chunk(
+            q, k, v, sk - sq, 0, causal=causal, sm_scale=sm_scale,
+            block_q=bq, block_k=bk)
+        return out
     return attention_reference(q, k, v, causal, sm_scale)

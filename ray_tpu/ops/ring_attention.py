@@ -78,17 +78,29 @@ def _merge(o1, l1, o2, l2):
 
 
 def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = True,
-                           sm_scale: Optional[float] = None):
+                           sm_scale: Optional[float] = None,
+                           block_q: int = 128, block_k: int = 128):
     """Collective ring attention; call inside shard_map over ``axis_name``.
 
     q, k, v: [b, s_local, h, hd] — this device's sequence chunk.
+
+    The per-step chunk op is the offset-aware Pallas flash kernel
+    (ops/attention.py flash_attention_chunk) whenever shapes allow: the
+    s_local×s_local score block then never materializes in HBM, in
+    forward OR backward (the kernel's custom VJP recomputes by block
+    from the saved lse).  Global positions enter the kernel as dynamic
+    scalars, so one compiled program serves every ring step.
     """
+    from ray_tpu.ops.attention import _can_use_pallas, flash_attention_chunk
+
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, s_loc, h, hd = q.shape
 
+    bq, bk = min(block_q, s_loc), min(block_k, s_loc)
+    use_flash = _can_use_pallas(s_loc, s_loc, hd, bq, bk)
     q_pos = my * s_loc + jnp.arange(s_loc)            # global q positions
 
     o = jnp.zeros((b, s_loc, h, hd), jnp.float32)
@@ -102,13 +114,20 @@ def ring_attention_sharded(q, k, v, axis_name: str, causal: bool = True,
         # after `step` rotations this device holds the chunk that started
         # on device (my - step) mod n
         src = (my - step) % n
-        kv_pos = src * s_loc + jnp.arange(s_loc)
-        if causal:
-            mask = q_pos[:, None] >= kv_pos[None, :]  # [sq, sk] global causal
-            mask = mask[None, None, :, :]             # [1,1,sq,sk]
+        if use_flash:
+            o_c, lse_flat = flash_attention_chunk(
+                q, k_cur, v_cur, my * s_loc, src * s_loc,
+                causal=causal, sm_scale=sm_scale, block_q=bq, block_k=bk)
+            o_c = o_c.astype(jnp.float32)
+            lse_c = lse_flat.reshape(b, h, s_loc)
         else:
-            mask = None
-        o_c, lse_c = _chunk_attention(q, k_cur, v_cur, mask, sm_scale)
+            kv_pos = src * s_loc + jnp.arange(s_loc)
+            if causal:
+                mask = q_pos[:, None] >= kv_pos[None, :]  # global causal
+                mask = mask[None, None, :, :]             # [1,1,sq,sk]
+            else:
+                mask = None
+            o_c, lse_c = _chunk_attention(q, k_cur, v_cur, mask, sm_scale)
         o, lse = _merge(o, lse, o_c, lse_c)
         if step != n - 1:
             k_cur = jax.lax.ppermute(k_cur, axis_name, perm)

@@ -76,3 +76,98 @@ def test_fallback_on_odd_shapes():
     ref = attn.attention_reference(q, q, q, causal=True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def _rand_qkv(seed, b, s, h, d):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), jnp.float32)
+                 for k in ks)
+
+
+@pytest.mark.parametrize("kv_off,label", [(0, "past"), (256, "diagonal"),
+                                          (384, "future")])
+def test_chunk_offsets_match_masked_reference(kv_off, label):
+    """flash_attention_chunk with global offsets == explicit-mask chunk
+    attention, for each ring-step shape (fully visible / diagonal /
+    fully masked)."""
+    from ray_tpu.ops import ring_attention as ring
+
+    b, s, h, d = 1, 128, 2, 64
+    q, k, v = _rand_qkv(4, b, s, h, d)
+    out, lse = attn.flash_attention_chunk(
+        q, k, v, 256, kv_off, causal=True, block_q=64, block_k=64)
+    qpos = 256 + jnp.arange(s)
+    kpos = kv_off + jnp.arange(s)
+    mask = (qpos[:, None] >= kpos[None, :])[None, None]
+    o_ref, lse_ref = ring._chunk_attention(q, k, v, mask, 1.0 / d ** 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(o_ref),
+                               atol=2e-5, rtol=2e-5)
+    lse = lse.reshape(b, h, s)
+    masked = np.asarray(lse_ref) < -1e29
+    assert (np.asarray(lse) < -1e29).tolist() == masked.tolist()
+    np.testing.assert_allclose(np.asarray(lse)[~masked],
+                               np.asarray(lse_ref)[~masked],
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_chunk_lse_gradient_flows_through_merge():
+    """Ring merges weight chunks by lse, so the chunk op's lse output
+    must be differentiable: two merged flash chunks == one reference
+    attention over the concatenated keys, gradients included."""
+    from ray_tpu.ops import ring_attention as ring
+
+    b, s, h, d = 1, 128, 2, 64
+    q, k, v = _rand_qkv(5, b, s, h, d)
+
+    def loss_merged(q, k, v):
+        o1, l1 = attn.flash_attention_chunk(
+            q, k, v, s, 0, causal=True, block_q=64, block_k=64)
+        o2, l2 = attn.flash_attention_chunk(
+            q, k, v, s, s, causal=True, block_q=64, block_k=64)
+        o, _ = ring._merge(o1.astype(jnp.float32), l1.reshape(b, h, s),
+                           o2.astype(jnp.float32), l2.reshape(b, h, s))
+        return jnp.sum(o ** 2)
+
+    def loss_ref(q, k, v):
+        kk = jnp.concatenate([k, k], axis=1)
+        vv = jnp.concatenate([v, v], axis=1)
+        return jnp.sum(
+            attn.attention_reference(q, kk, vv, causal=True) ** 2)
+
+    g1 = jax.grad(loss_merged, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=5e-4, rtol=5e-4)
+
+
+def test_backward_never_materializes_s_by_s():
+    """The VERDICT round-2 bar: a long-sequence train step must not
+    materialize the s×s score matrix in fwd OR bwd.  Trace the full
+    value-and-grad jaxpr at seq 8192 and assert no intermediate is
+    score-matrix sized (the old jnp backward produced [b,h,s,s] —
+    256 MB/head-batch at this length)."""
+    b, s, h, d = 1, 8192, 2, 64
+    q = jax.ShapeDtypeStruct((b, s, h, d), jnp.float32)
+
+    def loss(q, k, v):
+        return jnp.sum(attn.flash_attention(q, k, v, causal=True) ** 2)
+
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, q, q)
+
+    def all_avals(jpr, acc):
+        for eqn in jpr.eqns:
+            for var in eqn.outvars:
+                acc.append(var.aval)
+            for val in eqn.params.values():
+                if hasattr(val, "jaxpr"):  # nested (pallas kernels etc.)
+                    all_avals(val.jaxpr, acc)
+        return acc
+
+    score_elems = s * s
+    for aval in all_avals(jaxpr.jaxpr, []):
+        if hasattr(aval, "shape") and aval.shape:
+            elems = int(np.prod(aval.shape))
+            assert elems < score_elems, (
+                f"intermediate of shape {aval.shape} is score-matrix "
+                "sized — flash backward must recompute by block")
