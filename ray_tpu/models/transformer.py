@@ -271,6 +271,36 @@ def _block(x, bp, cos, sin, positions, mask, config: TransformerConfig):
     return with_logical_constraint(x, ("batch", "seq", "embed")), aux
 
 
+def _embed_tokens(params, tokens, c: TransformerConfig):
+    x = params["tok_embed"].astype(c.dtype)[tokens]
+    return with_logical_constraint(x, ("batch", "seq", "embed"))
+
+
+def _lm_head(params, x, c: TransformerConfig):
+    """Final norm + weight-tied head (bf16 operands, fp32 accumulation:
+    the MXU's native mode — an fp32xfp32 einsum here ran at half rate
+    for ~10% of the model's FLOPs)."""
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = jnp.einsum(
+        "bsh,vh->bsv", x.astype(c.dtype),
+        params["tok_embed"].astype(c.dtype),
+        preferred_element_type=jnp.float32)
+    return with_logical_constraint(logits, ("batch", "seq", "vocab"))
+
+
+def _maybe_remat(block_fn, c: TransformerConfig):
+    if not c.remat:
+        return block_fn
+    if c.remat_policy == "dots":
+        return jax.checkpoint(
+            block_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if c.remat_policy == "full":
+        return jax.checkpoint(block_fn)
+    raise ValueError(f"unknown remat_policy {c.remat_policy!r}; "
+                     "expected 'full' or 'dots'")
+
+
 def forward(params: Dict[str, Any], tokens, config: TransformerConfig,
             positions=None, return_aux: bool = False):
     """tokens: [b, s] int32 → logits [b, s, vocab] (fp32).
@@ -281,25 +311,13 @@ def forward(params: Dict[str, Any], tokens, config: TransformerConfig,
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-    x = params["tok_embed"].astype(c.dtype)[tokens]
-    x = with_logical_constraint(x, ("batch", "seq", "embed"))
+    x = _embed_tokens(params, tokens, c)
     cos, sin = rope_freqs(c.head_dim_, c.max_seq_len, c.rope_theta)
     mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None, :, :]
 
-    block_fn = partial(_block, cos=cos, sin=sin, positions=positions,
-                       mask=mask, config=c)
-    if c.remat:
-        if c.remat_policy == "dots":
-            block_fn = jax.checkpoint(
-                block_fn,
-                policy=jax.checkpoint_policies
-                .dots_with_no_batch_dims_saveable)
-        elif c.remat_policy == "full":
-            block_fn = jax.checkpoint(block_fn)
-        else:
-            raise ValueError(
-                f"unknown remat_policy {c.remat_policy!r}; "
-                "expected 'full' or 'dots'")
+    block_fn = _maybe_remat(
+        partial(_block, cos=cos, sin=sin, positions=positions,
+                mask=mask, config=c), c)
 
     aux_total = jnp.zeros((), jnp.float32)
     if c.scan_layers:
@@ -312,19 +330,87 @@ def forward(params: Dict[str, Any], tokens, config: TransformerConfig,
     else:
         x, aux_total = block_fn(x, params["blocks"])
 
-    x = rms_norm(x, params["final_norm"], c.rms_eps)
-    # weight-tied LM head (Llama ties off; tying keeps the flagship simple
-    # and MXU-heavy either way). bf16 operands + fp32 accumulation: the
-    # MXU's native mode — an fp32xfp32 einsum here ran at half rate for
-    # ~10% of the model's FLOPs.
-    logits = jnp.einsum(
-        "bsh,vh->bsv", x.astype(c.dtype),
-        params["tok_embed"].astype(c.dtype),
-        preferred_element_type=jnp.float32)
-    logits = with_logical_constraint(logits, ("batch", "seq", "vocab"))
+    logits = _lm_head(params, x, c)
     if return_aux:
         return logits, aux_total
     return logits
+
+
+def forward_pipelined(params: Dict[str, Any], tokens,
+                      config: TransformerConfig, num_stages: int,
+                      num_microbatches: Optional[int] = None,
+                      mesh=None):
+    """GPipe-pipelined forward over the mesh "stage" axis.
+
+    Capability the reference lacks entirely (SURVEY.md §2.4 — Ray has no
+    in-tree PP).  The layer stack splits into `num_stages` contiguous
+    runs; microbatch activations hop stages via ppermute inside ONE
+    jitted program (parallel/pipeline.py), and the embed/LM-head ends
+    run replicated across stages.  Differentiable end-to-end, so
+    ShardedTrainStep trains through it directly.  Composes with
+    data/fsdp axes (they stay under GSPMD); ring attention (seq axis)
+    is mutually exclusive with PP for now.
+    """
+    from ray_tpu.parallel.pipeline import pipeline_apply
+
+    c = config
+    if not c.scan_layers:
+        raise ValueError("pipelined forward requires scan_layers=True")
+    if c.num_experts > 0:
+        raise ValueError("pipelined forward does not support MoE yet")
+    if c.ring_attention is True:
+        raise ValueError("pipelined forward does not compose with ring "
+                         "attention yet (use seq=1 with stage>1)")
+    if c.num_layers % num_stages:
+        raise ValueError(
+            f"{c.num_layers} layers not divisible by {num_stages} stages")
+    b, s = tokens.shape
+    x = _embed_tokens(params, tokens, c)
+    cos, sin = rope_freqs(c.head_dim_, c.max_seq_len, c.rope_theta)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))[None, None, :, :]
+
+    def stage_fn(stage_blocks, xm):
+        # xm: one microbatch's activations [mb, s, h]; stage_blocks
+        # leaves [L/S, ...] (this stage's contiguous layers).
+        mb = xm.shape[0]
+        positions = jnp.broadcast_to(
+            jnp.arange(s, dtype=jnp.int32), (mb, s))
+        block = _maybe_remat(
+            partial(_block, cos=cos, sin=sin, positions=positions,
+                    mask=mask, config=c), c)
+
+        def scan_body(carry, layer_params):
+            y, _aux = block(carry, layer_params)
+            return y, None
+
+        y, _ = jax.lax.scan(scan_body, xm, stage_blocks)
+        return y
+
+    stacked = jax.tree.map(
+        lambda p: p.reshape(num_stages, c.num_layers // num_stages,
+                            *p.shape[1:]),
+        params["blocks"])
+    x = pipeline_apply(stage_fn, stacked, x, mesh=mesh,
+                       num_microbatches=num_microbatches)
+    return _lm_head(params, x, c)
+
+
+def loss_fn_pipelined(params, batch, config: TransformerConfig,
+                      num_stages: int,
+                      num_microbatches: Optional[int] = None,
+                      mesh=None):
+    """Next-token cross-entropy through the pipelined forward."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward_pipelined(params, inputs, config, num_stages,
+                               num_microbatches, mesh=mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
 
 
 def loss_fn(params, batch, config: TransformerConfig):

@@ -3,61 +3,29 @@
 Greenfield capability (SURVEY.md §2.4 — the reference has no in-tree
 pipeline parallelism; its ADAG/channel substrate is the GPU analogue).
 TPU-native design: the pipeline is ONE jitted program over a "stage" mesh
-axis.  Layers are sharded stage-wise (leading axis of stacked params);
-microbatch activations hop stage→stage via `jax.lax.ppermute` over ICI.
-The schedule is the classic GPipe fill-and-drain loop: with S stages and
-M microbatches, S+M-1 steps, each step running every stage's block on its
-in-flight microbatch (the bubble is the usual (S-1)/(S+M-1) fraction).
+axis, expressed entirely in GSPMD (no shard_map): layers are sharded
+stage-wise (leading axis of stacked params), each schedule step runs
+every stage's block as one `jax.vmap` over that stage-sharded axis, and
+the stage→stage activation hop is a concatenate-shift on it — which the
+compiler lowers to a collective-permute over ICI.  The schedule is the
+classic GPipe fill-and-drain loop: with S stages and M microbatches,
+S+M-1 steps (the bubble is the usual (S-1)/(S+M-1) fraction), and
+autodiff of the loop yields the reversed drain-fill backward, so the
+pipeline trains.
 
-  - `pipeline_sharded(stage_fn, params, micro, axis_name)`: collective
-    form, call inside shard_map (params = THIS stage's params).
   - `pipeline_apply(stage_fn, stacked_params, x, mesh, num_microbatches)`:
-    jit-level wrapper; stacked params [S, ...] shard on "stage".
+    stacked params [S, ...] shard on "stage"; composes with data/fsdp/
+    tensor axes (they stay under GSPMD, including logical-axis
+    constraints inside stage_fn).
+  - `stack_stage_params(layer_params, n_stages)`: [L, ...] → [S, L/S, ...]
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Tuple
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-
-
-def pipeline_sharded(stage_fn: Callable[[Any, jax.Array], jax.Array],
-                     stage_params: Any,
-                     micro: jax.Array,
-                     axis_name: str = "stage") -> jax.Array:
-    """GPipe schedule inside shard_map.
-
-    stage_params: this stage's params (already stage-local).
-    micro: [M, mb, ...] all microbatches (replicated; only stage 0 reads).
-    Returns [M, mb, ...] outputs (replicated across stages after a psum).
-    """
-    n = jax.lax.axis_size(axis_name)
-    idx = jax.lax.axis_index(axis_name)
-    m = micro.shape[0]
-    is_first = (idx == 0)
-    is_last = (idx == n - 1)
-
-    # forward shift: stage i sends to stage i+1 (no wraparound)
-    perm = [(i, i + 1) for i in range(n - 1)]
-
-    received = jnp.zeros_like(micro[0])
-    outputs = []
-    for t in range(m + n - 1):
-        inp = micro[t] if t < m else jnp.zeros_like(micro[0])
-        state_in = jnp.where(is_first, inp, received)
-        y = stage_fn(stage_params, state_in)
-        out_idx = t - (n - 1)
-        if 0 <= out_idx < m:
-            outputs.append(jnp.where(is_last, y, 0.0))
-        if t != m + n - 2:
-            received = jax.lax.ppermute(y, axis_name, perm)
-    out = jnp.stack(outputs)                       # valid on last stage only
-    # broadcast the last stage's outputs to every stage (one psum over the
-    # stage axis — everything else contributed zeros)
-    return jax.lax.psum(out, axis_name)
 
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -71,15 +39,25 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     stacked_params: pytree with leading axis S (one slice per stage),
     sharded on the "stage" mesh axis.  num_microbatches defaults to S
     (minimum); more microbatches shrink the bubble.
+
+    Pure-GSPMD schedule (no shard_map): every stage's block runs each
+    step as one `jax.vmap` over the stage-SHARDED leading axis — the
+    compiler partitions it along "stage" with zero communication — and
+    the stage→stage activation hop is a concatenate-shift on that axis,
+    which GSPMD lowers to a collective-permute over ICI.  Because the
+    whole schedule stays in GSPMD land, data/fsdp/tensor shardings
+    (including with_logical_constraint calls inside stage_fn) compose
+    with PP, and autodiff of the fill-drain loop yields the reversed
+    drain-fill backward — PP training for free.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
     if mesh is None:
         mesh = jax.sharding.get_abstract_mesh()
         if mesh is None or mesh.empty:
             raise ValueError("pipeline_apply requires a mesh")
-    n_stages = mesh.shape[axis_name]
+    n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
     if num_microbatches is None:
         num_microbatches = n_stages
     b = x.shape[0]
@@ -88,21 +66,30 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
             f"batch {b} not divisible by num_microbatches={num_microbatches}")
     micro = x.reshape(num_microbatches, b // num_microbatches, *x.shape[1:])
 
-    param_specs = jax.tree.map(
-        lambda p: P(axis_name, *([None] * (p.ndim - 1))), stacked_params)
+    def on_stage(arr):
+        """Constrain an [S, ...] array's leading dim to the stage axis."""
+        spec = P(axis_name, *([None] * (arr.ndim - 1)))
+        if isinstance(mesh, jax.sharding.AbstractMesh):
+            # Ambient abstract mesh (inside jit): constrain by spec.
+            return jax.lax.with_sharding_constraint(arr, spec)
+        return jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh, spec))
 
-    def inner(params, micro_in):
-        # shard_map gives us the stage-local slice with a leading axis of
-        # size 1 — drop it.
-        params = jax.tree.map(lambda p: p[0], params)
-        return pipeline_sharded(stage_fn, params, micro_in, axis_name)
-
-    out = shard_map(
-        inner, mesh=mesh,
-        in_specs=(param_specs, P()),
-        out_specs=P(),
-        check_rep=False,
-    )(stacked_params, micro)
+    vstage = jax.vmap(stage_fn, in_axes=(0, 0))
+    zeros_mb = jnp.zeros_like(micro[0])
+    prev = jnp.zeros((n_stages,) + micro.shape[1:], micro.dtype)
+    outputs = []
+    for t in range(num_microbatches + n_stages - 1):
+        inp0 = micro[t] if t < num_microbatches else zeros_mb
+        # stage 0 <- fresh microbatch; stage k <- stage k-1's last output
+        # (the concatenate shift along the sharded axis IS the pipeline
+        # hop: GSPMD emits a collective-permute).
+        state = on_stage(jnp.concatenate([inp0[None], prev[:-1]], axis=0))
+        out = on_stage(vstage(stacked_params, state))
+        if t >= n_stages - 1:
+            outputs.append(out[-1])  # drained from the last stage
+        prev = out
+    out = jnp.stack(outputs)  # [M, mb, ...]
     return out.reshape(b, *out.shape[2:])
 
 

@@ -29,7 +29,12 @@ DEFAULT_RULES: Rules = (
     ("mlp", "tensor"),
     ("vocab", "tensor"),
     ("expert", "expert"),
-    ("layers", None),
+    # Layer dim shards over the stage axis: with stage>1 each device
+    # holds its pipeline stage's contiguous run of layers at rest, so
+    # the [L,...] -> [S, L/S, ...] regroup in the pipelined forward is a
+    # local reshape (no resharding).  Size-1 stage axes make this a
+    # no-op.
+    ("layers", "stage"),
 )
 
 

@@ -81,13 +81,27 @@ class ShardedTrainStep:
     def __init__(self, config: tfm.TransformerConfig, mesh,
                  optimizer: Optional[optax.GradientTransformation] = None,
                  rules: Rules = DEFAULT_RULES,
-                 loss_fn: Optional[Callable] = None):
+                 loss_fn: Optional[Callable] = None,
+                 num_microbatches: Optional[int] = None):
         self.config = config
         self.mesh = mesh
         self.optimizer = optimizer or default_optimizer()
         self.rules = rules
-        self.loss_fn = loss_fn or (
-            lambda p, b: tfm.loss_fn(p, b, config))
+        # Pipeline parallelism: a stage axis >1 in the mesh routes the
+        # loss through the GPipe-pipelined forward (greenfield vs the
+        # reference — Ray ships no in-tree PP, SURVEY.md §2.4).  Params
+        # keep their [L, ...] layout; the layers->stage rule shards the
+        # layer dim so each device already holds its stage's run.
+        self.num_stages = int(dict(mesh.shape).get("stage", 1))
+        self.num_microbatches = num_microbatches
+        if loss_fn is not None:
+            self.loss_fn = loss_fn
+        elif self.num_stages > 1:
+            self.loss_fn = lambda p, b: tfm.loss_fn_pipelined(
+                p, b, config, self.num_stages, self.num_microbatches,
+                mesh=mesh)
+        else:
+            self.loss_fn = lambda p, b: tfm.loss_fn(p, b, config)
         self.param_logical = tfm.logical_axes(config)
         self.param_shardings = tree_shardings(
             mesh, self.param_logical, rules)

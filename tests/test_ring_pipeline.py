@@ -189,6 +189,40 @@ def test_pipeline_more_microbatches_smaller_bubble():
                                rtol=2e-4, atol=2e-5)
 
 
+def test_pipeline_training_matches_unstaged():
+    """VERDICT round-2 bar: ShardedTrainStep with stage>1 trains (GPipe
+    fwd + autodiff drain-fill bwd) combined with dp/fsdp axes, with the
+    loss trajectory matching the stage=1 run."""
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.train.train_state import ShardedTrainStep, default_optimizer
+
+    config = tfm.TransformerConfig.tiny(
+        num_layers=4, num_heads=4, num_kv_heads=4, max_seq_len=64)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (8, 33), 0, 256)
+    batch = {"tokens": tokens}
+
+    def opt():
+        return default_optimizer(warmup_steps=1, total_steps=20)
+
+    ts1 = ShardedTrainStep(config, build_mesh(axes={"data": 8}),
+                           optimizer=opt())
+    s1 = ts1.init(jax.random.PRNGKey(0))
+    ts2 = ShardedTrainStep(
+        config, build_mesh(axes={"data": 2, "stage": 2, "fsdp": 2}),
+        optimizer=opt())
+    assert ts2.num_stages == 2
+    s2 = ts2.init(jax.random.PRNGKey(0))
+
+    l1, l2 = [], []
+    for _ in range(5):
+        s1, m1 = ts1.step(s1, batch)
+        s2, m2 = ts2.step(s2, batch)
+        l1.append(float(m1["loss"]))
+        l2.append(float(m2["loss"]))
+    np.testing.assert_allclose(l1, l2, atol=5e-3)
+    assert l2[-1] < l2[0]  # converging
+
+
 def test_pipeline_rejects_bad_microbatching():
     mesh = build_mesh(axes={"stage": 2, "data": 4})
     stacked = stack_stage_params(
