@@ -358,7 +358,10 @@ def forward_pipelined(params: Dict[str, Any], tokens,
         raise ValueError("pipelined forward requires scan_layers=True")
     if c.num_experts > 0:
         raise ValueError("pipelined forward does not support MoE yet")
-    if c.ring_attention is True:
+    ring_on = c.ring_attention is True or (
+        c.ring_attention == "auto" and mesh is not None
+        and dict(mesh.shape).get("seq", 1) > 1)
+    if ring_on:
         raise ValueError("pipelined forward does not compose with ring "
                          "attention yet (use seq=1 with stage>1)")
     if c.num_layers % num_stages:
@@ -386,10 +389,9 @@ def forward_pipelined(params: Dict[str, Any], tokens,
         y, _ = jax.lax.scan(scan_body, xm, stage_blocks)
         return y
 
-    stacked = jax.tree.map(
-        lambda p: p.reshape(num_stages, c.num_layers // num_stages,
-                            *p.shape[1:]),
-        params["blocks"])
+    from ray_tpu.parallel.pipeline import stack_stage_params
+
+    stacked = stack_stage_params(params["blocks"], num_stages)
     x = pipeline_apply(stage_fn, stacked, x, mesh=mesh,
                        num_microbatches=num_microbatches)
     return _lm_head(params, x, c)
