@@ -119,9 +119,22 @@ class Config:
     # -- control-plane persistence (reference: GCS StoreClient / Redis) --
     # Path for the control server's KV journal; '' = in-memory only.
     # With a path set, the cluster KV (user KV, runtime-env packages,
-    # named-function registrations AND their blobs) survives a head
-    # restart.
+    # named-function registrations AND their blobs) PLUS cluster
+    # metadata (session id, named actors, placement groups, logical
+    # nodes) survive a head restart.
     gcs_store_path: str = ""
+    # Fixed control-server port (0 = ephemeral). A restartable head
+    # needs a stable port so workers/drivers/nodes can redial it.
+    control_port: int = 0
+    # How long clients (workers, drivers, node managers) retry redialing
+    # a lost head before giving up (reference: raylet reconnect backoff
+    # after NotifyGCSRestart, node_manager.proto:383). 0 disables
+    # reconnection (a lost head kills the client, the old behavior).
+    gcs_reconnect_timeout_s: float = 30.0
+    # After a head restart, how long a restored-but-unclaimed entity
+    # (RESTARTING actor nobody re-announced, re-subscribed object whose
+    # producer never reported) waits before being failed/respawned.
+    head_restart_grace_s: float = 15.0
 
     # -- logging --------------------------------------------------------
     log_dir: str = ""

@@ -42,6 +42,16 @@ class DriverRuntime:
             control_addr = address
         else:
             session_id = uuid.uuid4().hex[:12]
+            if self.config.gcs_store_path:
+                # Restart path: adopt the journaled session so the shm
+                # arena (still holding sealed objects) and session dir
+                # are re-attached rather than recreated.
+                from ray_tpu.core.store_client import peek_journal_key
+
+                prev = peek_journal_key(self.config.gcs_store_path,
+                                        "__meta__/session_id")
+                if prev:
+                    session_id = prev
             self.session_dir = os.path.join(
                 "/tmp/ray_tpu", f"session-{session_id}")
             os.makedirs(self.session_dir, exist_ok=True)

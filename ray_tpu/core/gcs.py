@@ -281,14 +281,100 @@ class ControlServer:
                 config.memory_monitor_refresh_s,
                 on_high=self._on_memory_pressure).start()
 
+        # Restore journaled cluster metadata (named actors, PGs, logical
+        # nodes) BEFORE serving: a restarted head must know its actors
+        # before their still-alive workers redial and re-announce
+        # (reference: GCS restart from Redis, redis_store_client.h:33).
+        self._restored_actors: Set[str] = set()
+        self._restore_from_journal()
+
         self._wake = threading.Event()
         self._stopped = threading.Event()
         self.server = rpc.Server(self._handle, host=config.node_ip_address,
+                                 port=config.control_port,
                                  on_disconnect=self._on_disconnect)
         self._sched_thread = threading.Thread(
             target=self._schedule_loop, name="scheduler", daemon=True
         )
         self._sched_thread.start()
+        if self._restored_actors:
+            timer = threading.Timer(config.head_restart_grace_s,
+                                    self._reap_restored_actors)
+            timer.daemon = True
+            timer.start()
+
+    # -- journal (reference: GCS table persistence via StoreClient) -----
+    def _journal_put(self, key: str, value):
+        if self.config.gcs_store_path:
+            self.kv[f"__meta__/{key}"] = value
+
+    def _journal_del(self, key: str):
+        if self.config.gcs_store_path:
+            self.kv.pop(f"__meta__/{key}", None)
+
+    def _restore_from_journal(self):
+        if not self.config.gcs_store_path:
+            return
+        if self.kv.get("__meta__/session_id") is None:
+            self.kv["__meta__/session_id"] = self.session_id
+            return
+        # A previous head wrote this journal: restore cluster metadata.
+        # Resource accounting for still-alive workers is rebuilt lazily
+        # (they re-register unclaimed; transient over-subscription is
+        # accepted, as in the reference's GCS-restart window).
+        for key in list(self.kv):
+            if key.startswith("__meta__/actor/"):
+                spec = self.kv[key]
+                actor_hex = spec.actor_id.hex()
+                entry = ActorEntry(spec=spec, state=A_RESTARTING)
+                self.actors[actor_hex] = entry
+                if spec.name:
+                    self.named_actors[(spec.namespace, spec.name)] = \
+                        actor_hex
+                self._restored_actors.add(actor_hex)
+            elif key.startswith("__meta__/pg/"):
+                d = self.kv[key]
+                pg = PlacementGroupEntry(
+                    pg_hex=key.rsplit("/", 1)[1],
+                    strategy=d["strategy"],
+                    bundle_specs=d["bundle_specs"],
+                    name=d.get("name", ""),
+                    ready_obj=d.get("ready_obj", ""))
+                self.placement_groups[pg.pg_hex] = pg
+                if pg.ready_obj:
+                    # Re-reservation will seal it; a reconnecting
+                    # driver's pg.ready() then resolves instead of
+                    # hitting the restart-grace lost error.
+                    self.objects.setdefault(pg.ready_obj,
+                                            ObjectEntry(refcount=0))
+            elif key.startswith("__meta__/node/"):
+                d = self.kv[key]
+                node_id = key.rsplit("/", 1)[1]
+                res = ResourceSet(d["resources"])
+                self.nodes[node_id] = NodeState(
+                    node_id=node_id, total=res, available=res,
+                    labels=d.get("labels") or {})
+
+    def _reap_restored_actors(self):
+        """Grace expired: restored actors whose worker never re-announced
+        are respawned (restarts permitting) or declared dead."""
+        with self.lock:
+            for actor_hex in list(self._restored_actors):
+                entry = self.actors.get(actor_hex)
+                self._restored_actors.discard(actor_hex)
+                if entry is None or entry.state != A_RESTARTING \
+                        or entry.worker_hex:
+                    continue
+                spec = entry.spec
+                if spec.restart_count < spec.max_restarts:
+                    spec.restart_count += 1
+                    self.pending_actors.append(spec)
+                else:
+                    entry.state = A_DEAD
+                    entry.death_reason = \
+                        "lost in head restart (no restarts left)"
+                    self._push_actor_update(entry, actor_hex)
+        self._wake.set()
 
     # ------------------------------------------------------------------
     @property
@@ -352,8 +438,16 @@ class ControlServer:
         return fn(conn, msg)
 
     def _on_disconnect(self, conn: rpc.Connection):
+        # Stale-connection fencing: with client reconnection, a dropped
+        # OLD socket must not kill an entity that has already re-bound a
+        # NEW one (reference: GCS ignores failure reports from
+        # superseded raylet connections).
         node_id = conn.meta.get("node_id")
         if node_id is not None:
+            with self.lock:
+                node = self.nodes.get(node_id)
+                if node is None or node.conn is not conn:
+                    return
             self._handle_node_death(node_id)
             return
         worker_hex = conn.meta.get("worker_hex")
@@ -361,7 +455,7 @@ class ControlServer:
             return
         with self.lock:
             w = self.workers.get(worker_hex)
-            if w is None or w.state == "dead":
+            if w is None or w.state == "dead" or w.conn is not conn:
                 return
             self._mark_worker_dead(w, "connection lost")
         self._wake.set()
@@ -477,7 +571,10 @@ class ControlServer:
         with self.lock:
             w = self.workers.get(worker_hex)
             if w is None:
-                w = WorkerInfo(worker_hex=worker_hex)
+                # Unknown worker: either a driver, or a worker surviving
+                # a head restart re-registering (it reports its node).
+                w = WorkerInfo(worker_hex=worker_hex,
+                               node_id=msg.get("node_id") or "head")
                 self.workers[worker_hex] = w
             w.conn = conn
             w.pid = msg.get("pid", 0)
@@ -520,8 +617,12 @@ class ControlServer:
                     i += 1
                 node_id = f"node-{i}"
             existing = self.nodes.get(node_id)
-            if existing is not None and existing.alive:
+            if existing is not None and existing.alive \
+                    and existing.conn is not None:
                 raise ValueError(f"node {node_id} already exists")
+            # Dead (or restart-orphaned) node ids may be revived: the
+            # manager reconnecting after a head restart keeps its
+            # identity, arena and workers.
             self.nodes[node_id] = NodeState(
                 node_id=node_id, total=res, available=res,
                 labels=msg.get("labels") or {},
@@ -915,7 +1016,26 @@ class ControlServer:
     def _op_subscribe_objects(self, conn, msg):
         """Batched subscribe (one message for a whole get())."""
         for obj_hex in msg["objs"]:
-            self._op_subscribe_object(conn, {"obj": obj_hex})
+            self._op_subscribe_object(
+                conn, {"obj": obj_hex, "grace": msg.get("grace", False)})
+
+    def _schedule_object_grace(self, obj_hex: str):
+        """A post-restart re-subscribe referenced an object this head
+        doesn't know.  Its producer may still be running (result lands
+        via task_done puts) — give it a grace window, then fail the
+        object so gets surface an error instead of hanging (the
+        'resubmitted or surfaced as errors' half of restart FT)."""
+        def expire():
+            with self.lock:
+                entry = self.objects.get(obj_hex)
+                if entry is not None and entry.state == PENDING:
+                    self._store_lost_error_locked(
+                        obj_hex, "lost in head restart (no producer "
+                        "re-reported it within the grace window)")
+
+        timer = threading.Timer(self.config.head_restart_grace_s, expire)
+        timer.daemon = True
+        timer.start()
 
     def _op_subscribe_object(self, conn, msg):
         obj_hex = msg["obj"]
@@ -923,6 +1043,8 @@ class ControlServer:
             entry = self.objects.get(obj_hex)
             if entry is None:
                 entry = self.objects[obj_hex] = ObjectEntry(refcount=0)
+                if msg.get("grace"):
+                    self._schedule_object_grace(obj_hex)
             if entry.state in (READY, ERRORED):
                 if entry.spilled_uri is not None or entry.restoring:
                     # Spilled: queue the subscriber and restore in the
@@ -1060,11 +1182,11 @@ class ControlServer:
 
     # ------------------------------------------------------------------
     # KV store (reference: gcs_kv_manager / experimental/internal_kv.py)
-    # Internal-only namespace: persisted function BLOBS are executed as
-    # code on workers, so user-facing KV ops must not be able to write
-    # or delete them (a kv_put there would be code injection across a
-    # head restart).
-    _KV_RESERVED = "__fn_blob__/"
+    # Internal-only namespaces: persisted function BLOBS are executed as
+    # code on workers and __meta__/ holds journaled cluster state, so
+    # user-facing KV ops must not be able to write or delete them (a
+    # kv_put there would be code injection across a head restart).
+    _KV_RESERVED = ("__fn_blob__/", "__meta__/")
 
     def _op_kv_put(self, conn, msg):
         key = msg["key"]
@@ -1230,6 +1352,7 @@ class ControlServer:
                     return
                 self.named_actors[key] = spec.actor_id.hex()
             self.pending_actors.append(spec)
+            self._journal_put(f"actor/{spec.actor_id.hex()}", spec)
         self._wake.set()
 
     def _op_actor_ready(self, conn, msg):
@@ -1247,8 +1370,34 @@ class ControlServer:
                 except Exception:
                     pass
                 return
+            announcer = conn.meta.get("worker_hex")
+            if entry.state == A_ALIVE and entry.worker_hex \
+                    and entry.worker_hex != announcer:
+                cur = self.workers.get(entry.worker_hex)
+                if cur is not None and cur.state != "dead" \
+                        and cur.conn is not None:
+                    # Fencing: the actor was respawned (e.g. restart
+                    # grace expired) and its ORIGINAL worker re-announced
+                    # late — one instance must win, the late announcer
+                    # exits (reference: GCS actor-registration fencing).
+                    try:
+                        conn.push({"op": "exit"})
+                    except Exception:
+                        pass
+                    return
             entry.state = A_ALIVE
             entry.address = msg["address"]
+            # Bind the announcing worker: after a head restart the actor
+            # re-announces from a worker this head never spawned, and the
+            # binding is what routes death-detection → actor restart.
+            worker_hex = conn.meta.get("worker_hex")
+            if worker_hex:
+                entry.worker_hex = worker_hex
+                w = self.workers.get(worker_hex)
+                if w is not None:
+                    w.actor_hex = actor_hex
+                    w.kind = "actor"
+            self._restored_actors.discard(actor_hex)
             self._push_actor_update(entry, actor_hex)
 
     def _op_actor_creation_failed(self, conn, msg):
@@ -1313,6 +1462,7 @@ class ControlServer:
         subs = list(entry.subscribers)
         if entry.state == A_DEAD:
             entry.subscribers = []
+            self._journal_del(f"actor/{actor_hex}")
             # Release the actor's name so it can be reused (the reference
             # unregisters names on death, gcs_actor_manager.cc).  Guard on
             # ownership: an actor that died *because* the name was taken
@@ -1429,6 +1579,9 @@ class ControlServer:
             self.nodes[node_id] = NodeState(
                 node_id=node_id, total=res, available=res,
                 labels=msg.get("labels") or {})
+            self._journal_put(f"node/{node_id}", {
+                "resources": res.to_dict(),
+                "labels": msg.get("labels") or {}})
         self._wake.set()
         return node_id
 
@@ -1462,6 +1615,7 @@ class ControlServer:
                 return False
             node.alive = False
             node.available = ResourceSet()
+            self._journal_del(f"node/{node_id}")
             for w in list(self.workers.values()):
                 if w.node_id == node_id and w.state != "dead":
                     to_kill.append(w)
@@ -1602,6 +1756,7 @@ class ControlServer:
                 node.available = node.available.add(b.available)
         pg.state = "REMOVED"
         pg.bundles = []
+        self._journal_del(f"pg/{pg.pg_hex}")
         # exit workers charged against this PG
         for w in list(self.workers.values()):
             if w.charge and w.charge[0] == "pg" and w.charge[1] == pg.pg_hex:
@@ -1634,6 +1789,11 @@ class ControlServer:
             if pg.ready_obj:
                 self.objects.setdefault(pg.ready_obj, ObjectEntry())
             self._try_reserve_pg(pg)
+            self._journal_put(f"pg/{pg.pg_hex}", {
+                "strategy": pg.strategy,
+                "bundle_specs": pg.bundle_specs,
+                "name": pg.name,
+                "ready_obj": pg.ready_obj})
         self._wake.set()
 
     def _op_remove_pg(self, conn, msg):
@@ -1645,6 +1805,7 @@ class ControlServer:
                 self._teardown_pg(pg, "removed")
             else:
                 pg.state = "REMOVED"
+                self._journal_del(f"pg/{pg.pg_hex}")
         self._wake.set()
         return True
 

@@ -105,8 +105,7 @@ class NodeManager:
         self.address = (f"{self.config.advertised_host()}:"
                         f"{self.server.port}")
         node_res = node_resources_from_env(num_cpus, num_tpus, resources)
-        self.head = rpc.Client(head_address, on_push=self._on_push)
-        reply = self.head.call({
+        self._register_msg = {
             "op": "register_node",
             "node_id": node_id,
             "resources": node_res.to_dict(),
@@ -114,7 +113,9 @@ class NodeManager:
             "labels": labels or {},
             "store_key": self.store_key,
             "shm_dir": self.config.shm_dir,
-        })
+        }
+        self.head = rpc.Client(head_address, on_push=self._on_push)
+        reply = self.head.call(self._register_msg)
         self.node_id = reply["node_id"]
         self.session_id = reply["session_id"]
         self.namespace = reply.get("namespace", "")
@@ -205,10 +206,30 @@ class NodeManager:
                 self.store.sweep(alive)
             except Exception:
                 pass
-            # The head going away (without a clean exit push) orphans
-            # this node: shut down rather than leak workers.
-            if self.head._closed:
+            # The head going away (without a clean exit push): try to
+            # redial — a restarted head accepts node re-registration
+            # (gcs.py _op_register_node revival).  Only give up (and
+            # reap the workers) when the reconnect window expires.
+            if self.head._closed and not self._reconnect_head():
                 self._stopped.set()
+
+    def _reconnect_head(self) -> bool:
+        timeout = self.config.gcs_reconnect_timeout_s
+        if timeout <= 0:
+            return False
+        deadline = time.monotonic() + timeout
+        self._register_msg["node_id"] = self.node_id  # keep identity
+        while not self._stopped.is_set() and time.monotonic() < deadline:
+            try:
+                head = rpc.Client(self.head_address, on_push=self._on_push,
+                                  connect_timeout=1.0)
+                head.call(self._register_msg, timeout=10.0)
+            except Exception:
+                time.sleep(0.5)
+                continue
+            self.head = head
+            return True
+        return False
 
     def run_forever(self):
         try:

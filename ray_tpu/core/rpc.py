@@ -329,7 +329,9 @@ class Client:
         address: str,
         on_push: Optional[Callable[[Any], None]] = None,
         connect_timeout: float = 10.0,
+        on_disconnect: Optional[Callable[[], None]] = None,
     ):
+        self._on_disconnect = on_disconnect
         host, port = address.rsplit(":", 1)
         deadline = time.monotonic() + connect_timeout
         last_err: Exception | None = None
@@ -375,11 +377,21 @@ class Client:
 
                         traceback.print_exc()
         except (RpcError, OSError, EOFError):
+            was_closed = self._closed
             self._closed = True
             err = ("err", RpcError(f"connection to {self.address} lost"))
             for req_id, ev in list(self._pending.items()):
                 self._results[req_id] = err
                 ev.set()
+            # Fire only on an UNEXPECTED loss (close() sets _closed
+            # before shutting the socket down).
+            if not was_closed and self._on_disconnect is not None:
+                try:
+                    self._on_disconnect()
+                except Exception:
+                    import traceback
+
+                    traceback.print_exc()
 
     def call(self, msg: Any, timeout: Optional[float] = None) -> Any:
         if self._closed:

@@ -86,6 +86,11 @@ class CoreClient:
         self.worker_hex = worker_hex
         self.kind = kind
         self.config = config or get_config()
+        # Set BEFORE any rpc.Client exists: its reader thread can fire
+        # _on_control_disconnect mid-__init__ (head dying in the
+        # registration window), which dereferences these.
+        self._closed = False
+        self._reconnecting = threading.Lock()
         # Thin mode (reference Ray Client, util/client/): no shared-memory
         # attachment — every payload rides the TCP connection, so the
         # client can live on any machine that reaches the control address.
@@ -94,15 +99,22 @@ class CoreClient:
         self.on_execute_task = None
         self.on_create_actor = None
         self.on_exit = None
-        self.client = rpc.Client(control_addr, on_push=self._on_push)
-        reply = self.client.call({
+        # Fired after a successful control-plane reconnect (head restart
+        # tolerance): workers re-announce themselves here.
+        self.on_reconnect = None
+        self.control_addr = control_addr
+        self._register_msg = {
             "op": "register",
             "worker_hex": worker_hex,
             "pid": os.getpid(),
             "kind": kind,
             "address": address,
             "env_key": env_key,
-        })
+            "node_id": os.environ.get("RAY_TPU_NODE_ID", ""),
+        }
+        self.client = rpc.Client(control_addr, on_push=self._on_push,
+                                 on_disconnect=self._on_control_disconnect)
+        reply = self.client.call(self._register_msg)
         self.session_id = reply["session_id"]
         self.session_dir = reply["session_dir"]
         # The arena this process attaches is its NODE's (multi-host:
@@ -127,9 +139,86 @@ class CoreClient:
         self._node_conns: Dict[str, rpc.Client] = {}
         self._actor_queues: Dict[str, List[TaskSpec]] = {}
         self._sent_funcs: set[str] = set()
-        self._closed = False
 
     # ------------------------------------------------------------------
+    # Control-plane reconnection (reference: raylet/worker redial after
+    # GCS restart, NotifyGCSRestart node_manager.proto:383).
+    def _on_control_disconnect(self):
+        if self._closed:
+            return
+        if self.config.gcs_reconnect_timeout_s <= 0:
+            if self.on_exit is not None:
+                self.on_exit()
+            return
+        # One loop at a time: a flapping head must not stack concurrent
+        # reconnectors racing writes to self.client.
+        if not self._reconnecting.acquire(blocking=False):
+            return
+        threading.Thread(target=self._reconnect_loop,
+                         name="control-reconnect", daemon=True).start()
+
+    def _reconnect_loop(self):
+        try:
+            self._reconnect_loop_inner()
+        finally:
+            self._reconnecting.release()
+
+    def _reconnect_loop_inner(self):
+        deadline = time.monotonic() + self.config.gcs_reconnect_timeout_s
+        delay = 0.2
+        while not self._closed and time.monotonic() < deadline:
+            client = None
+            try:
+                # No on_disconnect on the probe: a flap during resync
+                # must not spawn a second loop; the callback is attached
+                # only once this client is adopted.
+                client = rpc.Client(
+                    self.control_addr, on_push=self._on_push,
+                    connect_timeout=1.0)
+                client.call(self._register_msg, timeout=10.0)
+                # Re-subscribe everything unresolved.  grace=True: the
+                # restarted head fails objects nobody re-produces within
+                # its grace window instead of leaving gets hanging.
+                with self._lock:
+                    pending = [
+                        h for h in self._subscribed
+                        if (f := self._object_futures.get(h)) is not None
+                        and not f.done()]
+                with self._actor_cv:
+                    actors = set(self._actor_state) | \
+                        set(self._actor_queues)
+                if pending:
+                    client.send({"op": "subscribe_objects",
+                                 "objs": pending, "grace": True})
+                for actor_hex in actors:
+                    client.send({"op": "subscribe_actor",
+                                 "actor": actor_hex})
+            except Exception:
+                if client is not None:
+                    client.close()
+                time.sleep(delay)
+                delay = min(delay * 1.7, 2.0)
+                continue
+            client._on_disconnect = self._on_control_disconnect
+            if client._closed:
+                # Dropped between resync and adoption: the callback we
+                # just attached never fires for that earlier loss.
+                client.close()
+                time.sleep(delay)
+                continue
+            self.client = client
+            cb = self.on_reconnect
+            if cb is not None:
+                try:
+                    cb()
+                except Exception:
+                    pass
+            return
+        # Could not reach a head within the window: give up the same way
+        # a worker death would.
+        if self.on_exit is not None:
+            self.on_exit()
+
     def _on_push(self, msg: dict):
         op = msg.get("op")
         if op == "object_ready":

@@ -155,3 +155,16 @@ class FileBackedStoreClient(MutableMapping):
 def make_store_client(path: str = ""):
     """'' → in-memory (default); a path → file-backed journal."""
     return FileBackedStoreClient(path) if path else InMemoryStoreClient()
+
+
+def peek_journal_key(path: str, key: str):
+    """Read one key from a journal without keeping it open (used by a
+    restarting head to adopt the previous session id before the control
+    server re-opens the store)."""
+    if not path or not os.path.exists(path):
+        return None
+    store = FileBackedStoreClient(path)
+    try:
+        return store.get(key)
+    finally:
+        store.close()

@@ -63,6 +63,7 @@ class WorkerRuntime:
         self.core.on_execute_task = self._on_execute_task
         self.core.on_create_actor = self._on_create_actor
         self.core.on_exit = self._on_exit
+        self.core.on_reconnect = self._on_reconnect
         self._func_cache: dict[str, Any] = {}
         self._actor_instance: Any = None
         self._actor_is_async = False
@@ -502,6 +503,20 @@ class WorkerRuntime:
         return pool
 
     # -- lifecycle ------------------------------------------------------
+    def _on_reconnect(self):
+        """Control plane came back (head restart): re-announce so the
+        restored registry can rebind this worker (reference: raylet
+        re-registration after NotifyGCSRestart)."""
+        try:
+            if self._actor_hex:
+                self.core.client.send({
+                    "op": "actor_ready", "actor": self._actor_hex,
+                    "address": self.advertised_address})
+            else:
+                self.core.client.send({"op": "worker_online"})
+        except Exception:
+            pass
+
     def _on_exit(self):
         self._exit_ev.set()
 

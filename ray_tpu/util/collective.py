@@ -199,11 +199,11 @@ class HostCollectiveGroup:
             client.close()
         return entry
 
-    def _msg_oid(self, dst: int, kind: str, seq) -> ObjectID:
+    def _msg_oid(self, src: int, dst: int, kind: str, seq) -> ObjectID:
         import hashlib
 
         h = hashlib.sha1(
-            f"colp2p|{self.group_name}|{kind}|{seq}|{self.rank}|{dst}"
+            f"colp2p|{self.group_name}|{kind}|{seq}|{src}|{dst}"
             .encode()).digest()
         return ObjectID(h[:14])
 
@@ -216,20 +216,41 @@ class HostCollectiveGroup:
         if self._store is not None and self._store_node \
                 and peer_node == self._store_node:
             # Same arena: one memcpy into shm; peer reads zero-copy.
-            oid = self._msg_oid(dst, kind, seq)
+            oid = self._msg_oid(self.rank, dst, kind, seq)
+            created = False
             try:
                 seg = self._store.create(oid, max(arr.nbytes, 1))
+                created = True
                 seg.buf[:arr.nbytes] = memoryview(arr).cast("B")
                 self._store.seal(oid)
                 client.send({**head, "shm": oid.hex(),
                              "nbytes": arr.nbytes})
                 return
             except Exception:
-                pass  # arena full/unavailable: raw bytes below
+                # Arena full/unavailable OR the notify failed: retire any
+                # created segment (only the receiver would ever delete it,
+                # and it will never hear about this one) and fall back.
+                if created:
+                    try:
+                        self._store.delete(oid)
+                    except Exception:
+                        pass
         client.send({**head, "data": arr.tobytes()})
 
     def _recv_from(self, src: int, kind: str, seq) -> np.ndarray:
-        msg = self._inbox.take((kind, seq, src), self.timeout_s)
+        try:
+            msg = self._inbox.take((kind, seq, src), self.timeout_s)
+        except CollectiveGroupError:
+            # The sender may have parked a segment for us (same-arena
+            # path) before the op died: retire it so timeouts don't
+            # strand payload-sized blocks.
+            if self._store is not None:
+                try:
+                    self._store.delete(
+                        self._msg_oid(src, self.rank, kind, seq))
+                except Exception:
+                    pass
+            raise
         if "shm" in msg:
             oid = ObjectID.from_hex(msg["shm"])
             seg = self._store.attach(oid, max(msg["nbytes"], 1))
