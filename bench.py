@@ -41,7 +41,39 @@ def _peak_flops(device) -> float:
     return 275e12
 
 
-def main():
+def _tpu_config_ladder(tfm):
+    """Largest-first configs (VERDICT r2: billion-class params, seq>=2048,
+    fsdp on); the bench walks down on OOM so the driver's automated run
+    always lands on the biggest model the chip holds.
+
+    v5e (16 GB HBM) sweep, AdamW mu in bf16 (10 B/param of state),
+    head_dim 128 (flash kernel), seq 2048:
+      879M full-remat: b4=39.8%, b6=40.1% MFU, b8=38.3%; "dots" OOMs
+        at this size even at b2 (its per-layer saves + fp32 logits
+        exceed HBM at seq 2048).
+      804M (h1536 L20) full: b8=38.6%.
+      502M dots: b4=37.7% at seq 2048 (r01: 43.4% at seq 1024).
+    """
+    ladder = []
+    ladder.append(("0.9B", tfm.TransformerConfig(
+        vocab_size=32000, hidden_size=1792, intermediate_size=7168,
+        num_layers=16, num_heads=14, num_kv_heads=14, max_seq_len=2048,
+        remat_policy="full",
+    ), 6, 2048))
+    ladder.append(("0.8B", tfm.TransformerConfig(
+        vocab_size=32000, hidden_size=1536, intermediate_size=6144,
+        num_layers=20, num_heads=12, num_kv_heads=12, max_seq_len=2048,
+        remat_policy="full",
+    ), 8, 2048))
+    ladder.append(("0.5B", tfm.TransformerConfig(
+        vocab_size=32000, hidden_size=1536, intermediate_size=6144,
+        num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=2048,
+        remat_policy="full",
+    ), 8, 2048))
+    return ladder
+
+
+def _run_once(config, batch, seq, steps, devices):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -50,28 +82,13 @@ def main():
     from ray_tpu.parallel.mesh import build_mesh
     from ray_tpu.train.train_state import ShardedTrainStep, default_optimizer
 
-    devices = jax.devices()
-    on_tpu = devices[0].platform == "tpu"
-
-    if on_tpu:
-        # Measured on v5e: remat_policy="dots" (save matmul outputs,
-        # recompute elementwise) beats full remat and no-remat at this
-        # size; batch sweep: b8=42.7%, b10=43.3%, b12=40.1% (spills),
-        # b16 OOMs; remat off tops out at 41.6% (b4) and fails >= b6.
-        config = tfm.TransformerConfig(
-            vocab_size=32000, hidden_size=1536, intermediate_size=6144,
-            num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=1024,
-            remat_policy="dots",
-        )
-        batch, seq, steps = 10, 1024, 20
-    else:  # CPU smoke mode — same code path, tiny shapes
-        config = tfm.TransformerConfig.tiny()
-        batch, seq, steps = 4, 64, 3
-
-    mesh = build_mesh(axes={"data": len(devices)}, devices=devices)
+    # fsdp as the device axis: on one chip it is size 1 (pure compute);
+    # on a pod slice the same program shards params/opt-state FSDP-style.
+    mesh = build_mesh(axes={"fsdp": len(devices)}, devices=devices)
     ts = ShardedTrainStep(
         config, mesh,
-        optimizer=default_optimizer(warmup_steps=10, total_steps=1000))
+        optimizer=default_optimizer(warmup_steps=10, total_steps=1000,
+                                    mu_dtype=jnp.bfloat16))
     state = ts.init(jax.random.key(0))
 
     rng = np.random.default_rng(0)
@@ -97,7 +114,52 @@ def main():
     flops_tok = tfm.flops_per_token(config, seq)
     peak = _peak_flops(devices[0]) * len(devices)
     mfu = tok_per_sec * flops_tok / peak
+    return mfu, tok_per_sec, final_loss
 
+
+def main():
+    import jax
+
+    from ray_tpu.models import transformer as tfm
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+
+    if on_tpu:
+        ladder = _tpu_config_ladder(tfm)
+        steps = 20
+    else:  # CPU smoke mode — same code path, tiny shapes
+        ladder = [("tiny", tfm.TransformerConfig.tiny(), 4, 64)]
+        steps = 3
+
+    result = None
+    for name, config, batch, seq in ladder:
+        try:
+            mfu, tok_per_sec, final_loss = _run_once(
+                config, batch, seq, steps, devices)
+            result = (name, config, batch, seq, mfu, tok_per_sec,
+                      final_loss)
+            break
+        except Exception as e:  # noqa: BLE001 — OOM: walk down the ladder
+            msg = str(e)
+            # The axon remote-compile transport wraps HBM OOMs in an
+            # INTERNAL/HTTP 500 error; treat any compile failure as
+            # "doesn't fit" and walk down.
+            if any(s in msg for s in (
+                    "RESOURCE_EXHAUSTED", "Out of memory",
+                    "Ran out of memory", "exceeds the",
+                    "remote_compile", "HTTP 500")):
+                print(f"# {name} did not fit/compile; trying next config",
+                      file=sys.stderr)
+                continue
+            raise
+    if result is None:
+        print(json.dumps({"metric": "train_mfu", "value": 0.0,
+                          "unit": "MFU", "vs_baseline": 0.0,
+                          "error": "all configs OOMed"}))
+        return 1
+
+    name, config, batch, seq, mfu, tok_per_sec, final_loss = result
     print(json.dumps({
         "metric": "train_mfu",
         "value": round(mfu, 4),
@@ -105,6 +167,9 @@ def main():
         "vs_baseline": round(mfu / 0.40, 4),
         "tokens_per_sec_per_chip": round(tok_per_sec / len(devices), 1),
         "model_params": tfm.num_params(config),
+        "model": name,
+        "seq_len": seq,
+        "batch": batch,
         "device": getattr(devices[0], "device_kind", devices[0].platform),
         "n_devices": len(devices),
         "final_loss": round(final_loss, 4),

@@ -1024,18 +1024,32 @@ class ControlServer:
         doesn't know.  Its producer may still be running (result lands
         via task_done puts) — give it a grace window, then fail the
         object so gets surface an error instead of hanging (the
-        'resubmitted or surfaced as errors' half of restart FT)."""
-        def expire():
-            with self.lock:
+        'resubmitted or surfaced as errors' half of restart FT).
+        Lock held.  ONE shared timer sweeps the whole graced set — a
+        big fan-out's re-subscribe batch must not spawn a thread per
+        object."""
+        graced = getattr(self, "_graced_objects", None)
+        if graced is None:
+            graced = self._graced_objects = set()
+        graced.add(obj_hex)
+        timer = getattr(self, "_grace_timer", None)
+        if timer is None or not timer.is_alive():
+            timer = threading.Timer(self.config.head_restart_grace_s,
+                                    self._expire_graced_objects)
+            timer.daemon = True
+            self._grace_timer = timer
+            timer.start()
+
+    def _expire_graced_objects(self):
+        with self.lock:
+            graced = getattr(self, "_graced_objects", set())
+            self._graced_objects = set()
+            for obj_hex in graced:
                 entry = self.objects.get(obj_hex)
                 if entry is not None and entry.state == PENDING:
                     self._store_lost_error_locked(
                         obj_hex, "lost in head restart (no producer "
                         "re-reported it within the grace window)")
-
-        timer = threading.Timer(self.config.head_restart_grace_s, expire)
-        timer.daemon = True
-        timer.start()
 
     def _op_subscribe_object(self, conn, msg):
         obj_hex = msg["obj"]

@@ -162,6 +162,12 @@ class CoreClient:
             self._reconnect_loop_inner()
         finally:
             self._reconnecting.release()
+        # A drop during the adoption/resync window fires the callback
+        # while _reconnecting is still held (swallowed by the
+        # non-blocking acquire) — recheck now that it's released.
+        client = self.client
+        if not self._closed and getattr(client, "_closed", False):
+            self._on_control_disconnect()
 
     def _reconnect_loop_inner(self):
         deadline = time.monotonic() + self.config.gcs_reconnect_timeout_s

@@ -296,6 +296,7 @@ class WorkerRuntime:
 
     def _execute(self, spec: TaskSpec, target_fn=None):
         failed = False
+        self._executing = True
         self._cur_tls.spec = spec
         # Pool (non-actor, non-streaming) tasks batch their result puts
         # into the task_done message; streaming items must flow live.
@@ -330,6 +331,7 @@ class WorkerRuntime:
             if batch_puts:
                 puts = self.core.take_put_batch()
             self._cur_tls.spec = None
+            self._executing = False
             # Always release resources/borrows, even if storing returns
             # blew up — a wedged-busy worker starves the whole pool.
             self._finish(spec, failed, puts)
@@ -512,7 +514,11 @@ class WorkerRuntime:
                 self.core.client.send({
                     "op": "actor_ready", "actor": self._actor_hex,
                     "address": self.advertised_address})
-            else:
+            elif not getattr(self, "_executing", False):
+                # Mid-task workers must NOT report online: the restarted
+                # head would mark them idle and double-book a second
+                # concurrent task; the in-flight task's task_done flips
+                # them idle when it actually finishes.
                 self.core.client.send({"op": "worker_online"})
         except Exception:
             pass

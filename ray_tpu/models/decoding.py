@@ -250,16 +250,11 @@ def verify_step(params, tokens, positions, cache, block_tables,
     return logits.reshape(B, S, -1), cache
 
 
-@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
-def decode_step(params, tokens, cache, block_tables, positions,
+def _decode_one(params, tokens, cache, block_tables, positions,
                 context_lens, config: TransformerConfig
                 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """Advance every slot one token.
-
-    tokens: [B] int32 (the previously emitted token per slot);
-    positions: [B] its absolute position; context_lens: [B] cache length
-    INCLUDING this token. Returns (logits [B, vocab] fp32, cache).
-    """
+    """One decode step's body (unjitted; shared by decode_step and
+    decode_multi_step)."""
     c = config
     assert c.scan_layers, \
         "decoding expects stacked [L, ...] block params (scan_layers=True)"
@@ -281,3 +276,60 @@ def decode_step(params, tokens, cache, block_tables, positions,
 
     return _lm_head(x[:, 0], params, c), {"k": new_cache_k,
                                           "v": new_cache_v}
+
+
+@partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
+def decode_step(params, tokens, cache, block_tables, positions,
+                context_lens, config: TransformerConfig
+                ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Advance every slot one token.
+
+    tokens: [B] int32 (the previously emitted token per slot);
+    positions: [B] its absolute position; context_lens: [B] cache length
+    INCLUDING this token. Returns (logits [B, vocab] fp32, cache).
+    """
+    return _decode_one(params, tokens, cache, block_tables, positions,
+                       context_lens, config)
+
+
+@partial(jax.jit, static_argnames=("config", "n_steps"),
+         donate_argnames=("cache",))
+def decode_multi_step(params, tokens, cache, block_tables, positions,
+                      context_lens, limits, eos, config: TransformerConfig,
+                      n_steps: int
+                      ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Advance every slot up to n_steps GREEDY tokens entirely on device
+    (vLLM's multi-step scheduling, TPU-shaped): the argmax token feeds
+    the next step without a host round trip, so the host syncs once per
+    n_steps instead of per token — the difference between dispatch-bound
+    and compute-bound decode on high-latency transports.
+
+    limits: [B] int32 — highest absolute position a slot may WRITE
+    (len(prompt)+max_new-1); a slot stops when its next write would
+    exceed it.  eos: [B] int32 — per-slot EOS token id, -1 for none; a
+    slot stops after emitting it.  Returns (tokens [B, n_steps] int32,
+    -1 past a slot's stop, and the updated cache).
+    """
+    B = tokens.shape[0]
+
+    def body(i, carry):
+        tokens, cache, positions, ctx, out = carry
+        alive = positions >= 0
+        logits, cache = _decode_one(params, tokens, cache, block_tables,
+                                    positions, ctx, config)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(alive, nxt, -1)
+        out = out.at[:, i].set(nxt)
+        hit_eos = alive & (eos >= 0) & (nxt == eos)
+        new_pos = positions + 1
+        stop = hit_eos | (new_pos > limits)
+        positions = jnp.where(alive & ~stop, new_pos, -1)
+        ctx = jnp.where(alive & ~stop, ctx + 1, ctx)
+        tokens = jnp.where(alive, nxt, tokens)
+        return tokens, cache, positions, ctx, out
+
+    out0 = jnp.full((B, n_steps), -1, jnp.int32)
+    _, cache, _, _, out = jax.lax.fori_loop(
+        0, n_steps, body,
+        (tokens, cache, positions, context_lens, out0))
+    return out, cache

@@ -162,7 +162,8 @@ class LLMEngine:
                  page_size: int = 16, num_pages: int = 512,
                  max_batch: int = 8, seed: int = 0,
                  enable_prefix_caching: bool = True,
-                 speculative_k: int = 0, speculative_ngram: int = 2):
+                 speculative_k: int = 0, speculative_ngram: int = 2,
+                 multi_step: int = 1):
         import jax
 
         c = config
@@ -177,6 +178,11 @@ class LLMEngine:
         self.spec_steps = 0
         self.spec_drafted = 0
         self.spec_accepted = 0
+        # Multi-step decoding (greedy only): run n decode iterations on
+        # device per engine step, syncing tokens to the host once — the
+        # host-overhead/dispatch-latency amortizer (models/decoding.py
+        # decode_multi_step). 1 = classic per-token stepping.
+        self.multi_step = max(1, int(multi_step))
         self.max_pages_per_seq = math.ceil(c.max_seq_len / page_size)
         self.params = params if params is not None else tfm.init_params(
             c, jax.random.key(seed))
@@ -494,9 +500,59 @@ class LLMEngine:
         # sequence owns page 0.
         positions = np.where(active, self.context_lens, -1).astype(np.int32)
         ctx = (self.context_lens + 1).astype(np.int32)
+        # Bucket the table width to the longest live context (pow-2 for
+        # compile reuse): the decode gather's HBM traffic is
+        # O(B·W·page) PER LAYER, so passing the full max_seq_len-wide
+        # tables made every step pay for contexts nobody had (measured
+        # 15-20x step-time inflation at 2k max_seq_len / 256-token
+        # contexts on v5e).  The width must also cover the furthest
+        # position a multi-step burst can write.
+        n = self.multi_step
+        if n > 1 and (spec_slots or any(
+                r is not None and r.temperature > 0.0
+                for r in self.slot_req)):
+            n = 1  # sampling/spec slots need per-token host control
+        max_write = int(ctx.max(initial=1)) + (n - 1)
+        pages_needed = max(1, math.ceil(max_write / self.page_size))
+        W = min(self.max_pages_per_seq,
+                1 << (pages_needed - 1).bit_length())
+        tables = jnp.asarray(self.block_tables[:, :W])
+
+        if n > 1:
+            from ray_tpu.models.decoding import decode_multi_step
+
+            limits = np.zeros(self.max_batch, dtype=np.int32)
+            eos = np.full(self.max_batch, -1, dtype=np.int32)
+            for slot, req in enumerate(self.slot_req):
+                if req is None:
+                    continue
+                limits[slot] = len(req.prompt) + req.max_new_tokens - 1
+                if req.eos_token is not None:
+                    eos[slot] = req.eos_token
+            toks, self.cache = decode_multi_step(
+                self.params, jnp.asarray(self.last_tokens), self.cache,
+                tables, jnp.asarray(positions), jnp.asarray(ctx),
+                jnp.asarray(limits), jnp.asarray(eos), self.config, n)
+            toks = np.asarray(toks)  # [B, n] — the ONLY device sync
+            for slot, req in enumerate(self.slot_req):
+                if req is None:
+                    continue
+                for j in range(n):
+                    tok = int(toks[slot, j])
+                    if tok < 0:
+                        break
+                    self.context_lens[slot] += 1
+                    self.last_tokens[slot] = tok
+                    req.generated.append(tok)
+                    fin = self._maybe_finish(req)
+                    if fin is not None:
+                        done[req.req_id] = fin
+                        break
+            return done
+
         logits, self.cache = decode_step(
             self.params, jnp.asarray(self.last_tokens), self.cache,
-            jnp.asarray(self.block_tables), jnp.asarray(positions),
+            tables, jnp.asarray(positions),
             jnp.asarray(ctx), self.config)
         logits = np.asarray(logits)
         for slot, req in enumerate(self.slot_req):

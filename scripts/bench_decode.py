@@ -1,0 +1,88 @@
+"""Decode/serving benchmark: tokens/s through LLMEngine.step on TPU
+(paged KV cache + continuous batching + optional prompt-lookup
+speculation).
+
+Run: python scripts/bench_decode.py  (writes one JSON line to stdout;
+results committed as DECODE_BENCH_r02.json).
+
+The reference has no comparable in-tree number (its serve LLM tests are
+pass/fail wrappers); this establishes the framework's own baseline, per
+BASELINE.md 'Missing from reference'.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    if on_tpu:
+        # Inference-sized 1.1B (no optimizer state): bf16 weights + a
+        # ~1 GB paged KV pool fit comfortably in 16 GB HBM.
+        config = tfm.TransformerConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=8192,
+            num_layers=16, num_heads=16, num_kv_heads=16,
+            max_seq_len=2048, remat=False)
+        n_requests, prompt_len, max_new = 32, 128, 128
+        page_size, num_pages, max_batch = 16, 512, 16
+        multi_step = 8
+    else:
+        multi_step = 1
+    if not on_tpu:
+        config = tfm.TransformerConfig.tiny()
+        n_requests, prompt_len, max_new = 4, 8, 8
+        page_size, num_pages, max_batch = 4, 64, 4
+
+    eng = LLMEngine(config, page_size=page_size, num_pages=num_pages,
+                    max_batch=max_batch, multi_step=multi_step)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, config.vocab_size, prompt_len).tolist()
+               for _ in range(n_requests)]
+
+    # Warmup: compile prefill + decode once.
+    eng.generate([prompts[0]], max_new_tokens=4)
+
+    t0 = time.perf_counter()
+    ids = [eng.add_request(p, max_new_tokens=max_new) for p in prompts]
+    results = {}
+    steps = 0
+    while eng.has_work():
+        results.update(eng.step())
+        steps += 1
+    dt = time.perf_counter() - t0
+    assert set(ids) <= set(results), "missing results"
+    # Engine results are the GENERATED tokens (prompt excluded).
+    gen_tokens = sum(len(results[i]) for i in ids)
+    prefill_tokens = n_requests * prompt_len
+
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec",
+        "value": round(gen_tokens / dt, 1),
+        "unit": "tokens/s",
+        "generated_tokens": gen_tokens,
+        "prefill_tokens": prefill_tokens,
+        "wall_s": round(dt, 2),
+        "engine_steps": steps,
+        "concurrent_requests": n_requests,
+        "max_batch": max_batch,
+        "model_params": tfm.num_params(config),
+        "seq": f"{prompt_len}+{max_new}",
+        "device": getattr(devices[0], "device_kind", devices[0].platform),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

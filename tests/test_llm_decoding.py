@@ -141,6 +141,38 @@ def test_engine_continuous_batching_matches_sequential(tiny, params):
         assert all(0 <= t < tiny.vocab_size for t in out)
 
 
+def test_engine_multi_step_matches_single_step(tiny, params):
+    """Greedy multi-step decoding (n tokens per device sync,
+    models/decoding.py decode_multi_step) must be token-identical to
+    per-token stepping, including EOS and max_new cutoffs."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, tiny.vocab_size, size=n).tolist()
+               for n in (3, 5, 9, 4)]
+
+    ref_eng = LLMEngine(tiny, params, page_size=4, num_pages=64,
+                        max_batch=4)
+    ref = ref_eng.generate(prompts, max_new_tokens=7)
+    ms_eng = LLMEngine(tiny, params, page_size=4, num_pages=64,
+                       max_batch=4, multi_step=3)
+    out = ms_eng.generate(prompts, max_new_tokens=7)
+    assert out == ref
+
+    # EOS stop inside a multi-step burst: pick each prompt's first
+    # greedily generated token as its EOS so generation stops at 1.
+    eos_outs = []
+    for p, r in zip(prompts, ref):
+        eng = LLMEngine(tiny, params, page_size=4, num_pages=64,
+                        max_batch=2, multi_step=4)
+        rid = eng.add_request(p, max_new_tokens=7, eos_token=r[0])
+        results = {}
+        while eng.has_work():
+            results.update(eng.step())
+        eos_outs.append(results[rid])
+    assert eos_outs == [[r[0]] for r in ref]
+
+
 def test_engine_queueing_beyond_max_batch(tiny, params):
     """More requests than slots: the queue drains through continuous
     batching and every request completes."""
