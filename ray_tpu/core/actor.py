@@ -24,6 +24,7 @@ class ActorMethod:
         self._num_returns = num_returns
 
     def remote(self, *args, **kwargs):
+        from ray_tpu.core.streaming import STREAMING
         from ray_tpu.core.task_spec import KwargsMarker
 
         call_args = list(args)
@@ -32,11 +33,13 @@ class ActorMethod:
         refs = get_runtime().submit_actor_task(
             self._handle._actor_hex, self._method_name, call_args,
             num_returns=self._num_returns)
+        if self._num_returns == STREAMING:
+            return refs  # an ObjectRefGenerator
         if self._num_returns == 1:
             return refs[0]
         return refs
 
-    def options(self, num_returns: int = 1):
+    def options(self, num_returns=1):
         return ActorMethod(self._handle, self._method_name, num_returns)
 
     def bind(self, *args, **kwargs):

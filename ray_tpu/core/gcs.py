@@ -1085,6 +1085,20 @@ class ControlServer:
             else:
                 entry.subscribers.append(conn)
 
+    def _op_forget_object(self, conn, msg):
+        """Drop a speculative PENDING entry created by a subscribe that
+        will never resolve (stream item probes past the final index)."""
+        with self.lock:
+            entry = self.objects.get(msg["obj"])
+            if entry is None:
+                return
+            entry.subscribers = [c for c in entry.subscribers
+                                 if c is not conn]
+            if entry.state == PENDING and entry.refcount <= 0 \
+                    and not entry.subscribers \
+                    and entry.producing_task is None:
+                del self.objects[msg["obj"]]
+
     def _op_incref(self, conn, msg):
         with self.lock:
             entry = self.objects.get(msg["obj"])

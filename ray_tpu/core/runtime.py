@@ -431,6 +431,20 @@ class CoreClient:
             pass
         return payload
 
+    def forget_object(self, obj_hex: str):
+        """Retire a speculative subscription (a stream-item probe for an
+        index the stream ended before): drop the local future and tell
+        the directory to delete the PENDING placeholder if nothing else
+        references it — otherwise every consumed stream leaks one
+        entry on the head and one future here."""
+        with self._lock:
+            self._object_futures.pop(obj_hex, None)
+            self._subscribed.discard(obj_hex)
+        try:
+            self.client.send({"op": "forget_object", "obj": obj_hex})
+        except Exception:
+            pass
+
     def _refetch_object(self, obj_hex: str) -> Future:
         """Forget the resolved location of an object and subscribe again
         (used when a cached in-shm location went stale via spilling)."""
@@ -713,21 +727,38 @@ class CoreClient:
                 self._actor_cv.wait(timeout=remaining)
 
     def submit_actor_task(self, actor_hex: str, method_name: str,
-                          args: Sequence[Any], num_returns: int,
-                          name: str = "") -> List[ObjectRef]:
+                          args: Sequence[Any], num_returns,
+                          name: str = ""):
+        """num_returns may be "streaming": the method is a generator and
+        each yield becomes its own object (core/streaming.py), returned
+        as an ObjectRefGenerator — the streaming-response path serve's
+        ingress uses for token streams."""
+        from ray_tpu.core.streaming import (
+            STREAMING,
+            ObjectRefGenerator,
+            stream_eos_id,
+        )
+
+        streaming = num_returns == STREAMING
         borrows: List[str] = []
         task_args = self._prepare_args(args, borrows)
-        return_ids = [ObjectID.from_random() for _ in range(num_returns)]
+        task_id = TaskID.from_random()
+        return_ids = [] if streaming else [
+            ObjectID.from_random() for _ in range(num_returns)]
+        # Register returns under the actor so its death fails waiters;
+        # for streams that role falls to the end-of-stream object.
+        reg = [stream_eos_id(task_id).hex()] if streaming else \
+            [oid.hex() for oid in return_ids]
         self.client.send({
             "op": "register_objects",
-            "objs": [oid.hex() for oid in return_ids],
+            "objs": reg,
             "actor": actor_hex,
         })
         spec = TaskSpec(
-            task_id=TaskID.from_random(),
+            task_id=task_id,
             func_id="", func_blob=None,
             args=task_args,
-            num_returns=num_returns,
+            num_returns=0 if streaming else num_returns,
             return_ids=return_ids,
             resources={},
             owner=self.worker_hex,
@@ -735,8 +766,11 @@ class CoreClient:
             method_name=method_name,
             name=name or method_name,
             borrows=borrows,
+            is_streaming=streaming,
         )
         self._route_actor_task(actor_hex, spec)
+        if streaming:
+            return ObjectRefGenerator(spec.task_id)
         return [ObjectRef(oid, owner=self.worker_hex) for oid in return_ids]
 
     def _route_actor_task(self, actor_hex: str, spec: TaskSpec):
@@ -783,6 +817,14 @@ class CoreClient:
 
     def _fail_actor_task(self, spec: TaskSpec, reason: str):
         err = ActorDiedError(spec.actor_id, reason)
+        if getattr(spec, "is_streaming", False):
+            # Streams have no pre-registered returns: fail the
+            # end-of-stream object so iteration raises.
+            from ray_tpu.core.streaming import stream_eos_id
+
+            self._store_value(stream_eos_id(spec.task_id), err,
+                              is_error=True)
+            return
         for oid in spec.return_ids:
             self._store_value(oid, err, is_error=True)
 

@@ -97,6 +97,11 @@ class ObjectRefGenerator:
                     except Exception:
                         pass
                     if self._i >= self._count:
+                        # The probe subscribed item[count], which will
+                        # never exist — retire the speculative entry so
+                        # heavy stream consumers don't leak directory
+                        # entries/futures.
+                        core.forget_object(item_hex)
                         raise StopIteration
                     # Items are stored BEFORE eos, so item i exists: the
                     # ref is valid even if its push hasn't landed yet

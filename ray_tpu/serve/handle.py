@@ -87,6 +87,46 @@ def _identity(x):
     return x
 
 
+class DeploymentResponseGenerator:
+    """Streaming response: iterates the replica generator's yielded
+    values as they arrive (reference DeploymentResponseGenerator;
+    handle.options(stream=True))."""
+
+    def __init__(self, handle: "DeploymentHandle", method: str,
+                 args: tuple, kwargs: dict):
+        h = handle
+        self._handle = h
+        hex_id, actor = h._router().assign_replica(
+            timeout_s=h._assign_timeout_s)
+        self._assigned_hex = hex_id
+        self._released = False
+        meta = {"multiplexed_model_id": h._multiplexed_model_id}
+        self._gen = actor.handle_request_streaming.options(
+            num_returns="streaming").remote(method, args, kwargs, meta)
+
+    @property
+    def task_id(self):
+        return self._gen.task_id
+
+    def __iter__(self):
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref)
+        finally:
+            self._release()
+
+    def _release(self):
+        if not self._released:
+            self._released = True
+            self._handle._router().release(self._assigned_hex)
+
+    def __del__(self):
+        try:
+            self._release()
+        except Exception:
+            pass
+
+
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
                  method_name: str = "__call__"):
@@ -95,6 +135,7 @@ class DeploymentHandle:
         self._method_name = method_name
         self._multiplexed_model_id = ""
         self._assign_timeout_s = 30.0
+        self._stream = False
 
     def _router(self) -> Router:
         from ray_tpu.serve.api import _get_controller
@@ -104,7 +145,8 @@ class DeploymentHandle:
 
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
-                assign_timeout_s: Optional[float] = None
+                assign_timeout_s: Optional[float] = None,
+                stream: Optional[bool] = None
                 ) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self.app_name,
                              method_name or self._method_name)
@@ -113,9 +155,13 @@ class DeploymentHandle:
             else self._multiplexed_model_id)
         if assign_timeout_s is not None:
             h._assign_timeout_s = assign_timeout_s
+        h._stream = self._stream if stream is None else stream
         return h
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
+        if self._stream:
+            return DeploymentResponseGenerator(
+                self, self._method_name, args, kwargs)
         return DeploymentResponse(self, self._method_name, args, kwargs)
 
     def __getattr__(self, name: str):

@@ -108,6 +108,43 @@ class LLMServer:
                        temperature: float = 0.0) -> List[List[int]]:
         return self._submit_and_wait(prompts, max_new_tokens, temperature)
 
+    def generate_stream(self, prompt_tokens: Sequence[int],
+                        max_new_tokens: int = 32,
+                        temperature: float = 0.0):
+        """Generator: yields tokens AS the engine decodes them — call
+        through handle.options(stream=True) (or the HTTP proxy's
+        streaming mode) for streamed chat completions.  The request
+        still rides the shared continuous-batching engine loop."""
+        with self._cv:
+            if self._engine_error is not None:
+                raise RuntimeError(
+                    f"LLM engine failed: {self._engine_error}")
+            rid = self.engine.add_request(
+                list(prompt_tokens), max_new_tokens,
+                temperature=temperature)
+            req = next(r for r in self.engine.waiting
+                       if r.req_id == rid)
+            self._cv.notify_all()
+        sent = 0
+        while True:
+            with self._cv:
+                if self._engine_error is not None:
+                    raise RuntimeError(
+                        f"LLM engine failed: {self._engine_error}")
+                finished = rid in self._results
+                toks = (self._results[rid] if finished
+                        else list(req.generated))
+                if not finished and len(toks) == sent:
+                    self._cv.wait(timeout=1.0)
+                    continue
+                if finished:
+                    self._results.pop(rid, None)
+            for t in toks[sent:]:
+                yield int(t)
+            sent = len(toks)
+            if finished:
+                return
+
     def stats(self) -> Dict[str, Any]:
         eng = self.engine
         with self._cv:

@@ -1,26 +1,39 @@
-"""HTTP ingress proxy.
+"""HTTP ingress proxy (asyncio data plane).
 
 Counterpart of python/ray/serve/_private/proxy.py (HTTPProxy :761): an
-actor that runs a threaded HTTP server, longest-prefix-matches the request
-path against application route prefixes (kept fresh via the controller's
-long-poll 'routes' key), and forwards to the app's ingress deployment
-through a DeploymentHandle.  JSON in / JSON out — the stdlib server
-replaces uvicorn/starlette (no ASGI dependency in this build).
+actor that serves HTTP on an asyncio event loop (the role uvicorn plays
+in the reference — one loop holds ANY number of in-flight requests, no
+thread-per-request), longest-prefix-matches the request path against
+application route prefixes (kept fresh via the controller's long-poll
+'routes' key), and forwards to the app's ingress deployment through a
+DeploymentHandle.  JSON in / JSON out; a request carrying
+``Accept: text/event-stream`` or ``X-Serve-Stream: 1`` gets a CHUNKED
+response that flushes each item the deployment's generator yields (one
+JSON document per line) — the streaming-token path for LLM serving.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import threading
 import time
 import traceback
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 import ray_tpu
 
 LISTEN_TIMEOUT_S = 10.0
+DATA_PLANE_TIMEOUT_S = 60.0
+
+
+def _hget(headers: Dict[str, str], name: str, default: str = "") -> str:
+    """Case-insensitive header lookup over the original-cased dict."""
+    for k, v in headers.items():
+        if k.lower() == name:
+            return v
+    return default
 
 
 class Request:
@@ -103,48 +116,32 @@ class _RouteTable:
 
 
 class HTTPProxy(_RouteTable):
-    """Actor: serves HTTP on (host, port); routes to ingress handles."""
+    """Actor: serves HTTP on (host, port) from one asyncio loop."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 8000):
         self._init_routes()
-        proxy = self
+        self._loop = asyncio.new_event_loop()
+        threading.Thread(target=self._loop.run_forever,
+                         name="http-proxy-loop", daemon=True).start()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._start(host, port), self._loop)
+        self._addr = fut.result(timeout=30)
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):  # quiet
-                pass
-
-            def _dispatch(self):
-                try:
-                    length = int(self.headers.get("Content-Length") or 0)
-                    body = self.rfile.read(length) if length else b""
-                    status, payload = proxy._handle(
-                        self.command, self.path, body,
-                        dict(self.headers.items()))
-                except Exception:
-                    status, payload = 500, traceback.format_exc().encode()
-                self.send_response(status)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(payload)))
-                self.end_headers()
-                self.wfile.write(payload)
-
-            do_GET = do_POST = do_PUT = do_DELETE = _dispatch
-
+    async def _start(self, host: str, port: int) -> str:
         # port=0 lets the OS pick; retry upward if a fixed port is taken
         last_err = None
         for attempt in range(20):
             try:
-                self._server = ThreadingHTTPServer(
-                    (host, port + attempt if port else 0), Handler)
+                self._server = await asyncio.start_server(
+                    self._serve_conn, host,
+                    port + attempt if port else 0)
                 break
             except OSError as e:
                 last_err = e
         else:
             raise last_err
-        self._addr = (f"http://{self._server.server_address[0]}:"
-                      f"{self._server.server_address[1]}")
-        threading.Thread(target=self._server.serve_forever,
-                         name="http-proxy", daemon=True).start()
+        sock = self._server.sockets[0].getsockname()
+        return f"http://{sock[0]}:{sock[1]}"
 
     # -- control --------------------------------------------------------
     def address(self) -> str:
@@ -153,34 +150,258 @@ class HTTPProxy(_RouteTable):
     def ping(self) -> str:
         return "pong"
 
-    # -- data plane -----------------------------------------------------
-    def _handle(self, method: str, raw_path: str, body: bytes,
-                headers: Dict[str, str]) -> Tuple[int, bytes]:
+    # -- HTTP plumbing ---------------------------------------------------
+    async def _serve_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter):
+        try:
+            while True:
+                line = await reader.readline()
+                if not line:
+                    return
+                if line in (b"\r\n", b"\n"):
+                    continue
+                try:
+                    method, raw_path, _ver = \
+                        line.decode("latin1").split(" ", 2)
+                except ValueError:
+                    return
+                # Original header casing is preserved: Request.headers is
+                # a plain dict user code indexes with canonical names
+                # ('Content-Type'); the proxy's own lookups go through
+                # the case-insensitive _hget.
+                headers: Dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if h in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip()] = v.strip()
+                try:
+                    length = int(_hget(headers, "content-length") or 0)
+                except ValueError:
+                    self._write_response(writer, 400, json.dumps(
+                        {"error": "bad Content-Length"}).encode())
+                    await writer.drain()
+                    return
+                body = await reader.readexactly(length) if length else b""
+                keep = _hget(headers, "connection", "").lower() != "close"
+                try:
+                    await self._dispatch(writer, method, raw_path, body,
+                                         headers)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception:  # noqa: BLE001 — any bug → 500, not
+                    # a silently closed socket (old handler's contract)
+                    self._write_response(
+                        writer, 500, traceback.format_exc().encode())
+                    await writer.drain()
+                if not keep:
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    @staticmethod
+    def _write_response(writer, status: int, payload: bytes,
+                        content_type: str = "application/json"):
+        reason = {200: "OK", 404: "Not Found",
+                  500: "Internal Server Error",
+                  503: "Service Unavailable"}.get(status, "OK")
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+            f"Connection: keep-alive\r\n\r\n".encode() + payload)
+
+    @staticmethod
+    def _wants_stream(headers: Dict[str, str]) -> bool:
+        return ("text/event-stream" in _hget(headers, "accept")
+                or _hget(headers, "x-serve-stream") in ("1", "true"))
+
+    async def _dispatch(self, writer, method: str, raw_path: str,
+                        body: bytes, headers: Dict[str, str]):
         parsed = urlparse(raw_path)
         path = parsed.path
         if path == "/-/healthz":
-            return 200, b'"ok"'
+            self._write_response(writer, 200, b'"ok"')
+            return await writer.drain()
         if path == "/-/routes":
             with self._routes_lock:
-                return 200, json.dumps(
+                payload = json.dumps(
                     {k: list(v) for k, v in self._routes.items()}).encode()
+            self._write_response(writer, 200, payload)
+            return await writer.drain()
         match = self._match_route(path)
         if match is None:
-            return 404, json.dumps(
-                {"error": f"no application at {path}"}).encode()
-        prefix, app, ingress = match
+            self._write_response(writer, 404, json.dumps(
+                {"error": f"no application at {path}"}).encode())
+            return await writer.drain()
+        _, app, ingress = match
         from ray_tpu.serve.handle import DeploymentHandle
 
         handle = DeploymentHandle(ingress, app)
         req = Request(method, path, parse_qs(parsed.query), body, headers)
+        if self._wants_stream(headers):
+            return await self._dispatch_streaming(writer, handle, req)
         try:
-            result = handle.remote(req).result(timeout_s=60)
-        except Exception as e:
-            return 500, json.dumps({"error": str(e)}).encode()
+            result = await self._call_async(handle, req)
+        except Exception as e:  # noqa: BLE001
+            self._write_response(writer, 500, json.dumps(
+                {"error": str(e)}).encode())
+            return await writer.drain()
         try:
-            return 200, json.dumps(result).encode()
-        except TypeError:
-            return 200, json.dumps(str(result)).encode()
+            payload = json.dumps(result).encode()
+        except (TypeError, ValueError):  # unserializable / circular
+            payload = json.dumps(str(result)).encode()
+        self._write_response(writer, 200, payload)
+        await writer.drain()
+
+    async def _call_async(self, handle, req,
+                          timeout_s: float = DATA_PLANE_TIMEOUT_S):
+        """Submit through the router without blocking the loop (replica
+        backpressure becomes async sleep, not a parked thread) and await
+        the result ref; retries once through another replica on actor
+        death — the async twin of DeploymentResponse.result()."""
+        from ray_tpu.core.runtime import get_runtime
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        attempts = 0
+        while True:
+            try:
+                resp = await loop.run_in_executor(
+                    None,
+                    lambda: handle.options(
+                        assign_timeout_s=0.0).remote(req))
+            except TimeoutError:
+                if loop.time() >= deadline:
+                    raise TimeoutError(
+                        "no replica available within the timeout")
+                await asyncio.sleep(0.02)
+                continue
+            fut = get_runtime().as_future(resp._to_object_ref())
+            try:
+                return await asyncio.wait_for(
+                    asyncio.wrap_future(fut),
+                    max(0.1, deadline - loop.time()))
+            except ray_tpu.ActorError:
+                resp._release()
+                handle._router().drop_replica(resp._assigned_hex)
+                attempts += 1
+                if attempts >= 3:
+                    raise
+
+    async def _dispatch_streaming(self, writer, handle, req,
+                                  timeout_s: float = DATA_PLANE_TIMEOUT_S):
+        """Chunked transfer: one JSON document per line per yielded item,
+        flushed as it arrives (the reference's streaming ASGI responses;
+        token streaming for LLM chat).  Replica backpressure is an async
+        sleep/retry (assign_timeout_s=0), same as _call_async — a full
+        cluster must not park an executor thread per waiting stream."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while True:
+            try:
+                gen = await loop.run_in_executor(
+                    None, lambda: handle.options(
+                        stream=True, assign_timeout_s=0.0).remote(req))
+                break
+            except TimeoutError:
+                if loop.time() >= deadline:
+                    self._write_response(writer, 503, json.dumps(
+                        {"error": "no replica available"}).encode())
+                    return await writer.drain()
+                await asyncio.sleep(0.02)
+            except Exception as e:  # noqa: BLE001
+                self._write_response(writer, 500, json.dumps(
+                    {"error": str(e)}).encode())
+                return await writer.drain()
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            b"Content-Type: application/jsonl\r\n"
+            b"Transfer-Encoding: chunked\r\n"
+            b"Connection: keep-alive\r\n\r\n")
+        await writer.drain()
+        state = {"i": 0, "eos_consumed": False}
+        try:
+            async for item in _astream_values(gen.task_id, state):
+                data = (json.dumps(item) + "\n").encode()
+                writer.write(f"{len(data):x}\r\n".encode() + data
+                             + b"\r\n")
+                await writer.drain()
+        except (ConnectionError, OSError):
+            raise  # client went away; cleanup in finally
+        except Exception as e:  # noqa: BLE001 — mid-stream: emit an
+            # error line (headers already sent, status is fixed)
+            data = (json.dumps({"error": str(e)}) + "\n").encode()
+            writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+        finally:
+            gen._release()
+            # Free whatever this consumer will never read (finished
+            # streams only — a still-running generator's items are
+            # reclaimed at session teardown; actor-task cancellation is
+            # a future capability).
+            try:
+                from ray_tpu.core.runtime import get_runtime
+
+                get_runtime().core.client.send({
+                    "op": "free_stream", "task": gen.task_id.hex(),
+                    "from_index": state["i"],
+                    "eos_consumed": state["eos_consumed"]})
+            except Exception:
+                pass
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+
+
+async def _astream_values(task_id, state: Optional[dict] = None):
+    """Async mirror of core.streaming.ObjectRefGenerator: await each
+    item's object future on the event loop (no parked thread per
+    stream), resolve and decref as consumed.  `state` (if given) tracks
+    {"i": consumed, "eos_consumed": bool} for the caller's cleanup."""
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.core.streaming import stream_eos_id, stream_item_id
+
+    core = get_runtime().core
+    eos_hex = stream_eos_id(task_id).hex()
+    eos_fut = asyncio.wrap_future(core.object_future(eos_hex))
+    count = None
+    i = 0
+    while count is None or i < count:
+        item_hex = stream_item_id(task_id, i).hex()
+        item_fut = asyncio.wrap_future(core.object_future(item_hex))
+        if count is None:
+            while not item_fut.done():
+                await asyncio.wait({item_fut, eos_fut},
+                                   return_when=asyncio.FIRST_COMPLETED)
+                if eos_fut.done() and not item_fut.done():
+                    # Stream ended (or failed — _load_object raises).
+                    count = core._load_object(eos_hex, eos_fut.result())
+                    if state is not None:
+                        state["eos_consumed"] = True
+                    try:
+                        core.client.send({"op": "decref", "obj": eos_hex})
+                    except Exception:
+                        pass
+                    if i >= count:
+                        # The probe subscribed item[count], which will
+                        # never exist — retire the speculative entry.
+                        core.forget_object(item_hex)
+                        return
+                    break  # item i exists (items stored before eos)
+        value = core._load_object(item_hex, await item_fut)
+        try:
+            core.client.send({"op": "decref", "obj": item_hex})
+        except Exception:
+            pass
+        i += 1
+        if state is not None:
+            state["i"] = i
+        yield value
 
 
 class FrameProxy(_RouteTable):

@@ -77,14 +77,15 @@ class Replica:
         return a
 
     # -- data plane -----------------------------------------------------
-    def handle_request(self, method: str, args: tuple, kwargs: dict,
-                       request_meta: Optional[dict] = None) -> Any:
+    def _prepare_call(self, method: str, args: tuple, kwargs: dict,
+                      request_meta: Optional[dict]):
+        """Shared data-plane prologue: resolve composition ObjectRefs
+        (upstream DeploymentResponses arrive as refs, handle.py
+        __reduce__), set the request context, bump the ongoing count,
+        and resolve the target callable."""
         import ray_tpu
         from ray_tpu.core.object_ref import ObjectRef
 
-        # Composition: upstream DeploymentResponses arrive as ObjectRefs
-        # (handle.py __reduce__); resolve them here so user code sees
-        # values (reference replica resolves handle-arg refs the same way).
         args = tuple(ray_tpu.get(a) if isinstance(a, ObjectRef) else a
                      for a in args)
         kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef) else v
@@ -95,13 +96,36 @@ class Replica:
             self._app_name, self._deployment_name, self._replica_id)
         _replica_context.request = RequestContext(
             **(request_meta or {}))
+        target = (self._callable if method == "__call__"
+                  else getattr(self._callable, method))
+        return target, args, kwargs
+
+    def _finish_call(self):
+        with self._lock:
+            self._ongoing -= 1
+
+    def handle_request(self, method: str, args: tuple, kwargs: dict,
+                       request_meta: Optional[dict] = None) -> Any:
+        target, args, kwargs = self._prepare_call(
+            method, args, kwargs, request_meta)
         try:
-            target = (self._callable if method == "__call__"
-                      else getattr(self._callable, method))
             return target(*args, **kwargs)
         finally:
-            with self._lock:
-                self._ongoing -= 1
+            self._finish_call()
+
+    def handle_request_streaming(self, method: str, args: tuple,
+                                 kwargs: dict,
+                                 request_meta: Optional[dict] = None):
+        """Generator variant: the user callable's iterable result is
+        yielded item by item; called with num_returns='streaming' so
+        each item flows to the proxy/handle as its own object (the
+        reference's streaming ASGI responses, proxy.py:761)."""
+        target, args, kwargs = self._prepare_call(
+            method, args, kwargs, request_meta)
+        try:
+            yield from target(*args, **kwargs)
+        finally:
+            self._finish_call()
 
     # -- control plane --------------------------------------------------
     def num_ongoing(self) -> int:

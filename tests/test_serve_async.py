@@ -1,0 +1,184 @@
+"""Async serve data plane: event-loop ingress concurrency + streaming.
+
+VERDICT r2 item 8 'done' bars: a concurrent-load test with 100 in-flight
+HTTP requests and a streamed chat completion test.  Reference
+counterparts: uvicorn/starlette ASGI ingress (serve/_private/proxy.py)
+and streaming DeploymentResponseGenerator (serve/handle.py).
+"""
+
+import http.client
+import json
+import threading
+import time
+from urllib.parse import urlparse
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.deployment import deployment
+
+
+@pytest.fixture
+def serve_rt():
+    rt = ray_tpu.init(num_cpus=8)
+    serve.start()
+    yield rt
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _http(base_url, method, path, body=None, headers=None, timeout=60):
+    u = urlparse(base_url)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=timeout)
+    payload = json.dumps(body).encode() if body is not None else None
+    conn.request(method, path, body=payload, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_100_inflight_requests(serve_rt):
+    """100 concurrent HTTP requests against a deployment that sleeps:
+    the asyncio proxy holds them all in flight at once (no
+    thread-per-request ceiling) and total wall time stays near
+    ceil(100/capacity) * sleep, not 100 * sleep."""
+
+    @deployment(name="napper", num_replicas=2, max_ongoing_requests=32)
+    class Napper:
+        def __call__(self, request):
+            time.sleep(0.5)
+            return {"ok": True}
+
+    serve.run(Napper.bind(), name="nap", route_prefix="/nap")
+    base = serve.proxy_address()
+
+    results = []
+    errors = []
+
+    def hit():
+        try:
+            status, data = _http(base, "GET", "/nap", timeout=120)
+            results.append((status, json.loads(data)))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=hit) for _ in range(100)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    dt = time.monotonic() - t0
+
+    assert not errors, errors[:3]
+    assert len(results) == 100
+    assert all(s == 200 and d == {"ok": True} for s, d in results)
+    # Capacity = 2 replicas x 32 -> 2 waves of 0.5 s compute; generous
+    # bound still rules out serialized (50 s) execution.
+    assert dt < 25, f"100 in-flight requests took {dt:.1f}s"
+
+
+def test_streaming_deployment_chunks_arrive_incrementally(serve_rt):
+    @deployment(name="ticker")
+    class Ticker:
+        def __call__(self, request):
+            for i in range(5):
+                time.sleep(0.3)
+                yield {"tick": i}
+
+    serve.run(Ticker.bind(), name="tick", route_prefix="/tick")
+    base = serve.proxy_address()
+    u = urlparse(base)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=60)
+    conn.request("GET", "/tick", headers={"X-Serve-Stream": "1"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.headers.get("Transfer-Encoding") == "chunked"
+    arrivals = []
+    lines = []
+    buf = b""
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            lines.append(json.loads(line))
+            arrivals.append(time.monotonic())
+    conn.close()
+    assert lines == [{"tick": i} for i in range(5)]
+    # Streaming, not buffering: the first item landed well before the
+    # last (each tick is 0.3 s apart).
+    assert arrivals[-1] - arrivals[0] > 0.5
+
+
+def test_handle_streaming_generator(serve_rt):
+    @deployment(name="counter-stream")
+    class Gen:
+        def run(self, n):
+            for i in range(n):
+                yield i * i
+
+    h = serve.run(Gen.bind(), name="sq", route_prefix="/sq")
+    out = list(h.options(stream=True, method_name="run").remote(6))
+    assert out == [i * i for i in range(6)]
+
+
+def test_streamed_chat_completion(serve_rt):
+    """Streamed LLM chat completion: tokens arrive one by one through
+    handle.options(stream=True) AND over HTTP chunked transfer, and
+    match the non-streamed generation."""
+    from ray_tpu.serve.llm import LLMServer
+
+    h = serve.run(
+        LLMServer.bind(config_kwargs={}, page_size=4, num_pages=64,
+                       max_batch=2),
+        name="llm", route_prefix="/llm")
+    ref_tokens = h.generate.remote([1, 2, 3], 6).result(timeout_s=120)
+    streamed = list(h.options(
+        stream=True, method_name="generate_stream").remote([1, 2, 3], 6))
+    assert streamed == ref_tokens
+    assert len(streamed) == 6
+
+
+def test_streamed_chat_completion_over_http(serve_rt):
+    from ray_tpu.serve.llm import LLMServer
+    from ray_tpu.serve.proxy import Request
+
+    @deployment(name="chat")
+    class Chat:
+        def __init__(self, llm):
+            self.llm = llm
+
+        def __call__(self, request: Request):
+            body = request.json() or {}
+            prompt = body.get("prompt", [1, 2, 3])
+            n = int(body.get("max_new_tokens", 5))
+            # Proxy streaming iterates THIS generator; each yielded
+            # token rides its own HTTP chunk.
+            for tok in self.llm.options(
+                    stream=True,
+                    method_name="generate_stream").remote(prompt, n):
+                yield {"token": tok}
+
+    llm = LLMServer.bind(config_kwargs={}, page_size=4, num_pages=64,
+                         max_batch=2)
+    serve.run(Chat.bind(llm), name="chat", route_prefix="/chat")
+    base = serve.proxy_address()
+    u = urlparse(base)
+    conn = http.client.HTTPConnection(u.hostname, u.port, timeout=120)
+    conn.request("POST", "/chat",
+                 body=json.dumps({"prompt": [1, 2, 3],
+                                  "max_new_tokens": 5}).encode(),
+                 headers={"X-Serve-Stream": "1",
+                          "Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    body = resp.read()
+    conn.close()
+    lines = [json.loads(x) for x in body.splitlines() if x]
+    assert len(lines) == 5
+    assert all("token" in d for d in lines)
