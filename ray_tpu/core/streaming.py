@@ -88,10 +88,15 @@ class ObjectRefGenerator:
                 wait([item_fut, eos_fut], return_when=FIRST_COMPLETED)
                 if eos_fut.done():
                     # Stream ended; resolve the count exactly once. A
-                    # failed task stores an ERROR eos, which raises here.
+                    # failed task stores an ERROR eos, which raises here
+                    # — retire the speculative item probe either way.
                     eos_hex = stream_eos_id(self._task_id).hex()
-                    self._count = core._load_object(
-                        eos_hex, eos_fut.result())
+                    try:
+                        self._count = core._load_object(
+                            eos_hex, eos_fut.result())
+                    except BaseException:
+                        core.forget_object(item_hex)
+                        raise
                     try:
                         core.client.send({"op": "decref", "obj": eos_hex})
                     except Exception:

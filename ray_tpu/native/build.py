@@ -30,26 +30,37 @@ def build_library(name: str, source: str) -> str:
 
     Raises NativeBuildError if no compiler is available or the build fails.
     """
+    # Sanitizer build flavor (reference: bazel --config=asan/tsan,
+    # .bazelrc:104-125): RAY_TPU_NATIVE_SANITIZE=address|thread builds a
+    # separate lib<name>-<san>.so.  Loading an ASan .so into a vanilla
+    # python requires LD_PRELOAD of libasan — scripts/asan_native_store.py
+    # wires that up for the test suite.
+    sanitize = os.environ.get("RAY_TPU_NATIVE_SANITIZE", "")
     with _lock:
-        if name in _built:
-            return _built[name]
+        key = (name, sanitize)
+        if key in _built:
+            return _built[key]
         src = source
         if not os.path.exists(src):
             raise NativeBuildError(f"native source not found: {src}")
         os.makedirs(_LIB_DIR, exist_ok=True)
-        out = os.path.join(_LIB_DIR, f"lib{name}.so")
+        suffix = f"-{sanitize}" if sanitize else ""
+        out = os.path.join(_LIB_DIR, f"lib{name}{suffix}.so")
         stamp = out + ".stamp"
         with open(src, "rb") as f:
             digest = hashlib.sha256(f.read()).hexdigest()
         if os.path.exists(out) and os.path.exists(stamp):
             with open(stamp) as f:
                 if f.read().strip() == digest:
-                    _built[name] = out
+                    _built[key] = out
                     return out
         cmd = [
             os.environ.get("CXX", "g++"), "-O2", "-g", "-std=c++17",
             "-fPIC", "-shared", "-Wall", "-o", out, src, "-lpthread",
         ]
+        if sanitize:
+            cmd.insert(1, f"-fsanitize={sanitize}")
+            cmd.insert(1, "-fno-omit-frame-pointer")
         try:
             proc = subprocess.run(
                 cmd, capture_output=True, text=True, timeout=120)
@@ -60,5 +71,5 @@ def build_library(name: str, source: str) -> str:
                 f"build of {name} failed:\n{proc.stderr[-4000:]}")
         with open(stamp, "w") as f:
             f.write(digest)
-        _built[name] = out
+        _built[key] = out
         return out

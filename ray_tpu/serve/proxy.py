@@ -155,7 +155,19 @@ class HTTPProxy(_RouteTable):
                           writer: asyncio.StreamWriter):
         try:
             while True:
-                line = await reader.readline()
+                try:
+                    # Idle keep-alive timeout: a parked client must not
+                    # hold an fd/task forever; oversized request lines
+                    # (StreamReader's 64 KiB limit) get a 400.
+                    line = await asyncio.wait_for(reader.readline(),
+                                                  timeout=75.0)
+                except asyncio.TimeoutError:
+                    return
+                except (ValueError, asyncio.LimitOverrunError):
+                    self._write_response(writer, 400, json.dumps(
+                        {"error": "request line too long"}).encode())
+                    await writer.drain()
+                    return
                 if not line:
                     return
                 if line in (b"\r\n", b"\n"):
@@ -171,7 +183,13 @@ class HTTPProxy(_RouteTable):
                 # the case-insensitive _hget.
                 headers: Dict[str, str] = {}
                 while True:
-                    h = await reader.readline()
+                    try:
+                        h = await reader.readline()
+                    except (ValueError, asyncio.LimitOverrunError):
+                        self._write_response(writer, 400, json.dumps(
+                            {"error": "header too long"}).encode())
+                        await writer.drain()
+                        return
                     if h in (b"\r\n", b"\n", b""):
                         break
                     k, _, v = h.decode("latin1").partition(":")
@@ -367,6 +385,7 @@ async def _astream_values(task_id, state: Optional[dict] = None):
     from ray_tpu.core.streaming import stream_eos_id, stream_item_id
 
     core = get_runtime().core
+    loop = asyncio.get_running_loop()
     eos_hex = stream_eos_id(task_id).hex()
     eos_fut = asyncio.wrap_future(core.object_future(eos_hex))
     count = None
@@ -380,7 +399,17 @@ async def _astream_values(task_id, state: Optional[dict] = None):
                                    return_when=asyncio.FIRST_COMPLETED)
                 if eos_fut.done() and not item_fut.done():
                     # Stream ended (or failed — _load_object raises).
-                    count = core._load_object(eos_hex, eos_fut.result())
+                    # Loads run OFF the loop: a shm/cross-node read must
+                    # not stall every other in-flight request.  The
+                    # speculative item[i] probe is retired on BOTH the
+                    # ended and the failed path.
+                    try:
+                        count = await loop.run_in_executor(
+                            None, core._load_object, eos_hex,
+                            eos_fut.result())
+                    except BaseException:
+                        core.forget_object(item_hex)
+                        raise
                     if state is not None:
                         state["eos_consumed"] = True
                     try:
@@ -388,12 +417,12 @@ async def _astream_values(task_id, state: Optional[dict] = None):
                     except Exception:
                         pass
                     if i >= count:
-                        # The probe subscribed item[count], which will
-                        # never exist — retire the speculative entry.
                         core.forget_object(item_hex)
                         return
                     break  # item i exists (items stored before eos)
-        value = core._load_object(item_hex, await item_fut)
+        info = await item_fut
+        value = await loop.run_in_executor(
+            None, core._load_object, item_hex, info)
         try:
             core.client.send({"op": "decref", "obj": item_hex})
         except Exception:

@@ -90,14 +90,17 @@ class Replica:
                      for a in args)
         kwargs = {k: ray_tpu.get(v) if isinstance(v, ObjectRef) else v
                   for k, v in kwargs.items()}
-        with self._lock:
-            self._ongoing += 1
         _replica_context.ctx = ReplicaContext(
             self._app_name, self._deployment_name, self._replica_id)
         _replica_context.request = RequestContext(
             **(request_meta or {}))
+        # Resolve the target BEFORE counting the request: a bad method
+        # name must not inflate _ongoing with no matching decrement
+        # (that would eventually read as a saturated replica).
         target = (self._callable if method == "__call__"
                   else getattr(self._callable, method))
+        with self._lock:
+            self._ongoing += 1
         return target, args, kwargs
 
     def _finish_call(self):

@@ -1,0 +1,51 @@
+"""ASAN shard for the native shm arena (reference: bazel --config=asan
+CI shards, .bazelrc:104-125).
+
+Builds src/store/tpustore.cc with -fsanitize=address
+(RAY_TPU_NATIVE_SANITIZE=address -> ray_tpu/native/build.py) and runs
+tests/test_native_store.py + the multi-process fuzz in a subprocess with
+libasan LD_PRELOADed (an ASan .so cannot be dlopen'ed into a vanilla
+python otherwise).  Exits nonzero on any sanitizer report or test
+failure.
+
+Run: python scripts/asan_native_store.py
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    probe = subprocess.run(
+        [os.environ.get("CC", "gcc"), "-print-file-name=libasan.so"],
+        capture_output=True, text=True)
+    libasan = probe.stdout.strip()
+    if not libasan or not os.path.exists(libasan):
+        print("libasan not found; skipping ASAN shard")
+        return 0
+
+    env = dict(os.environ)
+    env["RAY_TPU_NATIVE_SANITIZE"] = "address"
+    env["LD_PRELOAD"] = libasan
+    # leak detection off: the long-lived python process 'leaks' plenty
+    # of interpreter allocations by design; we're after heap/shm
+    # overflows and use-after-free in the arena code.
+    env["ASAN_OPTIONS"] = ("detect_leaks=0:abort_on_error=1:"
+                           "handle_segv=1")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_CHIPS"] = "none"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_native_store.py", "tests/test_native_store_fuzz.py",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=REPO, env=env)
+    if proc.returncode == 0:
+        print("ASAN shard clean")
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
