@@ -26,8 +26,86 @@ os.environ.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
 
 import pyarrow as pa
 
-# A Block at rest.
-Block = pa.Table
+
+class _PandasSchema:
+    """Just enough schema surface (.names) for block accounting."""
+
+    __slots__ = ("names",)
+
+    def __init__(self, names):
+        self.names = list(names)
+
+
+class PandasBlock:
+    """pandas.DataFrame at rest, quacking the pa.Table size/shape surface
+    the executor's accounting reads (num_rows/nbytes/schema.names) so
+    pandas blocks flow through the same operators.  Counterpart of the
+    reference's pandas block type (python/ray/data/_internal/
+    pandas_block.py); selected via DataContext.block_format="pandas"."""
+
+    __slots__ = ("df",)
+
+    def __init__(self, df):
+        self.df = df
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.df)
+
+    @property
+    def nbytes(self) -> int:
+        # Object columns hold per-row ndarrays/strings whose payloads
+        # memory_usage(deep=False) would count at ~8 B/row — size the
+        # elements, or the executor's accounting is off by orders of
+        # magnitude on exactly the tensor blocks this format carries.
+        import sys
+
+        total = 0
+        for name in self.df.columns:
+            s = self.df[name]
+            if s.dtype == object:
+                total += int(sum(
+                    x.nbytes if isinstance(x, np.ndarray)
+                    else sys.getsizeof(x) for x in s))
+            else:
+                total += int(s.memory_usage(index=False, deep=False))
+        return total
+
+    @property
+    def schema(self) -> _PandasSchema:
+        return _PandasSchema(self.df.columns)
+
+    def to_pandas(self):
+        return self.df
+
+    def column(self, name: str) -> "_PandasColumn":
+        return _PandasColumn(self.df[name])
+
+    def __reduce__(self):
+        return (PandasBlock, (self.df,))
+
+
+class _PandasColumn:
+    """pa-column-shaped view (to_pylist) over a Series."""
+
+    __slots__ = ("series",)
+
+    def __init__(self, series):
+        self.series = series
+
+    def to_pylist(self) -> List[Any]:
+        return [x.item() if isinstance(x, np.generic) else x
+                for x in self.series.tolist()]
+
+    def to_numpy(self, zero_copy_only: bool = True) -> np.ndarray:
+        return _series_to_numpy(self.series)
+
+    def __len__(self) -> int:
+        return len(self.series)
+
+
+# A Block at rest: pyarrow.Table (default) or PandasBlock.
+Block = Union[pa.Table, PandasBlock]
 
 # What user map functions may return / what builders accept.
 BatchLike = Union[pa.Table, Dict[str, Any], "pandas.DataFrame"]  # noqa: F821
@@ -125,10 +203,29 @@ def _column_to_arrow(values: Any) -> pa.Array:
     return pa.array(values)
 
 
-def batch_to_block(batch: BatchLike) -> Block:
-    """Normalize any user-returned batch into a pyarrow Table."""
+def batch_to_block(batch: BatchLike, block_format: Optional[str] = None
+                   ) -> Block:
+    """Normalize any user-returned batch into a block at rest: a pyarrow
+    Table, or a PandasBlock when the context's block_format is pandas."""
     import pandas as pd
 
+    if isinstance(batch, PandasBlock):
+        return batch
+    if block_format is None:
+        from ray_tpu.data.context import block_format as _ctx_fmt
+
+        block_format = _ctx_fmt()
+    if block_format == "pandas":
+        if isinstance(batch, pd.DataFrame):
+            return PandasBlock(batch.reset_index(drop=True))
+        if isinstance(batch, pa.Table):
+            return PandasBlock(
+                block_to_batch(batch, "pandas").reset_index(drop=True))
+        if isinstance(batch, dict):
+            return PandasBlock(_dict_to_df(batch))
+        raise TypeError(
+            f"map function must return dict/pandas.DataFrame/"
+            f"pyarrow.Table, got {type(batch)}")
     if isinstance(batch, pa.Table):
         return batch
     if isinstance(batch, pd.DataFrame):
@@ -150,6 +247,48 @@ def batch_to_block(batch: BatchLike) -> Block:
     raise TypeError(
         f"map function must return dict/pandas.DataFrame/pyarrow.Table, "
         f"got {type(batch)}")
+
+
+def _dict_to_df(batch: Dict[str, Any]):
+    """dict-of-columns → DataFrame.  Multi-dim numpy columns (tokens,
+    images) become object Series of per-row ndarrays — pandas has no
+    native tensor column; block_to_batch re-stacks them."""
+    import pandas as pd
+
+    cols = {}
+    n_rows = None
+    for name, col in batch.items():
+        if isinstance(col, pa.ChunkedArray):
+            col = col.combine_chunks()
+        if isinstance(col, pa.Array):
+            col = _arrow_col_to_numpy(pa.chunked_array([col]))
+        arr = np.asarray(col) if not isinstance(col, np.ndarray) else col
+        if arr.dtype == object or arr.ndim <= 1:
+            series = pd.Series(arr) if arr.ndim == 1 else pd.Series(
+                list(arr), dtype=object)
+        else:
+            out = np.empty(len(arr), dtype=object)
+            for i in range(len(arr)):
+                out[i] = np.asarray(arr[i])
+            series = pd.Series(out, dtype=object)
+        if n_rows is None:
+            n_rows = len(series)
+        elif len(series) != n_rows:
+            raise ValueError(
+                f"batch columns have unequal lengths: {name!r} has "
+                f"{len(series)}, expected {n_rows}")
+        cols[name] = series
+    return pd.DataFrame(cols)
+
+
+def block_to_arrow(block: Block) -> pa.Table:
+    """Boundary conversion for arrow-only sinks (parquet writes,
+    Dataset.to_arrow): PandasBlocks round-trip through the numpy batch
+    path so tensor columns get the arrow tensor encodings."""
+    if isinstance(block, pa.Table):
+        return block
+    return batch_to_block(block_to_batch(block, "numpy"),
+                          block_format="arrow")
 
 
 def rows_to_block(rows: Sequence[Any]) -> Block:
@@ -192,6 +331,17 @@ def _is_numeric_list(values: List[Any]) -> bool:
 
 
 def block_to_batch(block: Block, batch_format: str = "numpy") -> BatchLike:
+    if isinstance(block, PandasBlock):
+        if batch_format in ("numpy", "default"):
+            return {name: _series_to_numpy(block.df[name])
+                    for name in block.df.columns}
+        if batch_format == "pandas":
+            return block.df
+        if batch_format == "pyarrow":
+            return block_to_arrow(block)
+        raise ValueError(
+            f"batch_format must be one of {VALID_BATCH_FORMATS}, "
+            f"got {batch_format!r}")
     if batch_format in ("numpy", "default"):
         return {
             name: _arrow_col_to_numpy(block.column(name))
@@ -204,6 +354,18 @@ def block_to_batch(block: Block, batch_format: str = "numpy") -> BatchLike:
     raise ValueError(
         f"batch_format must be one of {VALID_BATCH_FORMATS}, "
         f"got {batch_format!r}")
+
+
+def _series_to_numpy(series) -> np.ndarray:
+    """Column → ndarray; object series of same-shaped ndarrays restack
+    into one dense array (the inverse of _dict_to_df's tensor storage)."""
+    arr = series.to_numpy()
+    if arr.dtype == object and len(arr) and \
+            all(isinstance(x, np.ndarray) for x in arr):
+        shapes = {x.shape for x in arr}
+        if len(shapes) == 1:
+            return np.stack(list(arr))
+    return arr
 
 
 def _arrow_col_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
@@ -220,14 +382,20 @@ def _arrow_col_to_numpy(col: pa.ChunkedArray) -> np.ndarray:
 
 class BlockAccessor:
     """Uniform block operations (slice/take/iterate/size), counterpart of
-    python/ray/data/block.py BlockAccessor."""
+    python/ray/data/block.py BlockAccessor — dispatches on the block's
+    at-rest type (arrow Table vs PandasBlock)."""
+
+    def __new__(cls, block: Block):
+        if cls is BlockAccessor and isinstance(block, PandasBlock):
+            return super().__new__(PandasBlockAccessor)
+        return super().__new__(cls)
 
     def __init__(self, block: Block):
         self._block = block
 
     @staticmethod
     def for_block(block: Block) -> "BlockAccessor":
-        if not isinstance(block, pa.Table):
+        if not isinstance(block, (pa.Table, PandasBlock)):
             block = batch_to_block(block)
         return BlockAccessor(block)
 
@@ -292,6 +460,50 @@ class BlockAccessor:
         return self.take(idx.tolist())
 
 
+class PandasBlockAccessor(BlockAccessor):
+    """The pandas peer of the arrow accessor (reference
+    pandas_block.py PandasBlockAccessor)."""
+
+    @property
+    def _df(self):
+        return self._block.df
+
+    def schema(self) -> _PandasSchema:
+        return self._block.schema
+
+    def slice(self, start: int, end: int) -> Block:
+        return PandasBlock(
+            self._df.iloc[start:end].reset_index(drop=True))
+
+    def take(self, indices: Sequence[int]) -> Block:
+        return PandasBlock(
+            self._df.iloc[list(indices)].reset_index(drop=True))
+
+    def iter_rows(self) -> Iterator[Dict[str, Any]]:
+        df = self._df
+        cols = list(df.columns)
+        arrays = {c: df[c].to_numpy() for c in cols}
+        for i in range(len(df)):
+            yield {c: arrays[c][i] for c in cols}
+
+    def select_columns(self, names: Sequence[str]) -> Block:
+        return PandasBlock(self._df[list(names)])
+
+    def rename_columns(self, mapping: Dict[str, str]) -> Block:
+        return PandasBlock(self._df.rename(columns=dict(mapping)))
+
+    def drop_columns(self, names: Sequence[str]) -> Block:
+        return PandasBlock(self._df.drop(columns=list(names)))
+
+    def sort(self, key: Union[str, Sequence[str]],
+             descending: bool = False) -> Block:
+        keys = [key] if isinstance(key, str) else list(key)
+        return PandasBlock(
+            self._df.sort_values(keys, ascending=not descending,
+                                 kind="mergesort")
+            .reset_index(drop=True))
+
+
 class BlockBuilder:
     """Accumulate rows/batches/blocks, emit a single combined Block.
 
@@ -328,9 +540,21 @@ class BlockBuilder:
         return self._approx_bytes
 
     def build(self) -> Block:
+        import pandas as pd
+
         self._flush_rows()
+        from ray_tpu.data.context import block_format as _ctx_fmt
+
         if not self._tables:
+            if _ctx_fmt() == "pandas":
+                return PandasBlock(pd.DataFrame())
             return pa.table({})
+        if any(isinstance(t, PandasBlock) for t in self._tables):
+            frames = [t.df if isinstance(t, PandasBlock)
+                      else block_to_batch(t, "pandas")
+                      for t in self._tables]
+            return PandasBlock(
+                pd.concat(frames, ignore_index=True))
         tables = _unify_tables(self._tables)
         return pa.concat_tables(tables).combine_chunks()
 

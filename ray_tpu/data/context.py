@@ -1,0 +1,41 @@
+"""Per-process data-execution context.
+
+Counterpart of python/ray/data/context.py DataContext (trimmed to the
+knobs this build honors).  ``block_format`` selects the at-rest block
+representation: "arrow" (pyarrow.Table — the default; zero-copy slices,
+cheap size accounting) or "pandas" (pandas.DataFrame blocks, the
+reference's pandas_block.py peer type — for pandas-native pipelines that
+would otherwise pay an arrow conversion on every map).
+
+The env var RAY_TPU_DATA_BLOCK_FORMAT seeds the default so worker
+processes (which execute map tasks) inherit the driver's choice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass
+class DataContext:
+    block_format: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "RAY_TPU_DATA_BLOCK_FORMAT", "arrow"))
+
+    _current = None
+
+    @classmethod
+    def get_current(cls) -> "DataContext":
+        if cls._current is None:
+            cls._current = cls()
+        return cls._current
+
+
+def block_format() -> str:
+    fmt = DataContext.get_current().block_format
+    if fmt not in ("arrow", "pandas"):
+        raise ValueError(
+            f"DataContext.block_format must be 'arrow' or 'pandas', "
+            f"got {fmt!r}")
+    return fmt

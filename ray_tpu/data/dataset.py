@@ -58,13 +58,21 @@ class Dataset:
                     batch_format: str = "numpy",
                     fn_constructor: Optional[Callable[[], Any]] = None,
                     num_cpus: float = 1.0,
-                    concurrency: Optional[int] = None) -> "Dataset":
+                    concurrency: Optional[int] = None,
+                    compute: Optional[str] = None) -> "Dataset":
+        """compute="actors" runs this op on a pool of long-lived actors
+        (callable class constructed once per actor, state reused across
+        tasks — the reference's ActorPoolStrategy); default is stateless
+        pool tasks."""
         if fn is None and fn_constructor is None:
             raise ValueError("map_batches requires fn or fn_constructor")
+        if compute not in (None, "tasks", "actors"):
+            raise ValueError(f"compute must be 'tasks' or 'actors', "
+                             f"got {compute!r}")
         return self._append(L.MapBatches(
             fn=fn, batch_size=batch_size, batch_format=batch_format,
             fn_constructor=fn_constructor, num_cpus=num_cpus,
-            concurrency=concurrency))
+            concurrency=concurrency, compute=compute))
 
     def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
         return self._append(L.MapRows(fn=fn))
@@ -298,7 +306,10 @@ class Dataset:
             list(self.iter_internal_blocks())).to_pandas()
 
     def to_arrow(self) -> pa.Table:
-        return concat_blocks(list(self.iter_internal_blocks()))
+        from ray_tpu.data.block import block_to_arrow
+
+        return block_to_arrow(
+            concat_blocks(list(self.iter_internal_blocks())))
 
     def __repr__(self):
         return f"Dataset(plan={self._plan().describe()})"

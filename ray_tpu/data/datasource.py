@@ -397,18 +397,22 @@ def _torch_item_to_numpy(item):
 def write_block_parquet(block: Block, path: str, index: int) -> str:
     import pyarrow.parquet as pq
 
+    from ray_tpu.data.block import block_to_arrow
+
     os.makedirs(path, exist_ok=True)
     out = os.path.join(path, f"part-{index:05d}.parquet")
-    pq.write_table(block, out)
+    pq.write_table(block_to_arrow(block), out)
     return out
 
 
 def write_block_csv(block: Block, path: str, index: int) -> str:
     import pyarrow.csv as pacsv
 
+    from ray_tpu.data.block import block_to_arrow
+
     os.makedirs(path, exist_ok=True)
     out = os.path.join(path, f"part-{index:05d}.csv")
-    pacsv.write_csv(block, out)
+    pacsv.write_csv(block_to_arrow(block), out)
     return out
 
 

@@ -68,9 +68,27 @@ def _run_transform_chain(chain: Sequence[BlockTransform],
     return it
 
 
-def _map_task(chain: Sequence[BlockTransform],
+def _ctx_payload() -> dict:
+    """The driver's DataContext, shipped with every task so workers
+    produce blocks in the same at-rest format (the reference serializes
+    DataContext into each task the same way)."""
+    from ray_tpu.data.context import DataContext
+
+    return {"block_format": DataContext.get_current().block_format}
+
+
+def _apply_ctx(ctx: Optional[dict]):
+    if ctx:
+        from ray_tpu.data.context import DataContext
+
+        DataContext.get_current().block_format = ctx["block_format"]
+
+
+def _map_task(chain: Sequence[BlockTransform], ctx: Optional[dict],
               *input_lists: List[Block]) -> Tuple[List[Block], dict]:
     """Remote body for all fused map work.  Returns (blocks, summary)."""
+    _apply_ctx(ctx)
+
     def gen() -> Iterator[Block]:
         for blocks in input_lists:
             for b in blocks:
@@ -85,7 +103,9 @@ def _map_task(chain: Sequence[BlockTransform],
 
 
 def _read_task_body(read_task,
-                    chain: Sequence[BlockTransform] = ()) -> Tuple[List[Block], dict]:
+                    chain: Sequence[BlockTransform] = (),
+                    ctx: Optional[dict] = None) -> Tuple[List[Block], dict]:
+    _apply_ctx(ctx)
     it: Iterator[Block] = iter(read_task())
     if chain:
         it = _run_transform_chain(chain, it)
@@ -157,6 +177,10 @@ class PhysicalOperator:
     def outstanding_refs(self) -> List[Any]:
         return []
 
+    def close(self):
+        """Release long-lived resources (executor calls this on every
+        exit path — clean, error, or shutdown)."""
+
 
 class InputDataBuffer(PhysicalOperator):
     """Source operator over pre-made bundles or ReadTasks
@@ -183,7 +207,8 @@ class InputDataBuffer(PhysicalOperator):
         n = 0
         while self._pending_reads and n < budget:
             seq, rt = self._pending_reads.popleft()
-            blocks_ref, meta_ref = self._remote_read.remote(rt, self._chain)
+            blocks_ref, meta_ref = self._remote_read.remote(
+                rt, self._chain, _ctx_payload())
             self._running[meta_ref] = (blocks_ref, seq)
             self.stats.tasks_submitted += 1
             n += 1
@@ -237,7 +262,7 @@ class TaskPoolMapOperator(PhysicalOperator):
         while q and n < budget:
             bundle = q.popleft()
             blocks_ref, meta_ref = self._remote.remote(
-                self._chain, bundle.blocks_ref)
+                self._chain, _ctx_payload(), bundle.blocks_ref)
             self._running[meta_ref] = (blocks_ref, bundle.seq)
             self.stats.tasks_submitted += 1
             n += 1
@@ -256,6 +281,95 @@ class TaskPoolMapOperator(PhysicalOperator):
                 self.output_queue.append(RefBundle(
                     blocks_ref, summary["num_rows"], summary["size_bytes"],
                     seq))
+
+    def outstanding_refs(self):
+        return list(self._running)
+
+
+class _MapWorker:
+    """Actor body for ActorPoolMapOperator: the transform (and its
+    callable-class UDF) is constructed ONCE here and reused across every
+    task this actor serves."""
+
+    def __init__(self, transform_factory, ctx: Optional[dict] = None):
+        _apply_ctx(ctx)
+        self._transform = transform_factory()
+
+    def map(self, blocks: List[Block]) -> Tuple[List[Block], dict]:
+        out = [b for b in self._transform(iter(blocks))
+               if b.num_rows > 0]
+        return out, {
+            "num_rows": sum(b.num_rows for b in out),
+            "size_bytes": sum(b.nbytes for b in out),
+        }
+
+
+class ActorPoolMapOperator(PhysicalOperator):
+    """Map over a pool of long-lived actors
+    (…/operators/actor_pool_map_operator.py + ActorPoolStrategy): one
+    constructed UDF per actor amortized across tasks, bundles routed to
+    the least-loaded actor.  This is also the executor-off-driver mode:
+    transform state lives in worker processes, not the driver."""
+
+    def __init__(self, name: str, transform_factory,
+                 pool_size: int = 2, num_cpus: float = 1.0,
+                 max_tasks_per_actor: int = 2):
+        super().__init__(name)
+        cls = ray_tpu.remote(num_cpus=num_cpus)(_MapWorker)
+        self._actors = [cls.remote(transform_factory, _ctx_payload())
+                        for _ in range(max(1, pool_size))]
+        self._inflight = [0] * len(self._actors)
+        self._max_per_actor = max_tasks_per_actor
+        self._running: Dict[Any, Any] = {}  # meta_ref -> (blocks_ref, seq, ai)
+        self._closed = False
+
+    def num_active_tasks(self) -> int:
+        return len(self._running)
+
+    def dispatch(self, budget: int) -> int:
+        n = 0
+        q = self.input_queues[0]
+        while q and n < budget:
+            ai = min(range(len(self._actors)),
+                     key=lambda i: self._inflight[i])
+            if self._inflight[ai] >= self._max_per_actor:
+                break  # pool saturated: backpressure upstream
+            bundle = q.popleft()
+            blocks_ref, meta_ref = self._actors[ai].map.options(
+                num_returns=2).remote(bundle.blocks_ref)
+            self._running[meta_ref] = (blocks_ref, bundle.seq, ai)
+            self._inflight[ai] += 1
+            self.stats.tasks_submitted += 1
+            n += 1
+        return n
+
+    def poll(self):
+        if self._running:
+            ready, _ = ray_tpu.wait(
+                list(self._running), num_returns=len(self._running),
+                timeout=0)
+            for meta_ref in ready:
+                blocks_ref, seq, ai = self._running.pop(meta_ref)
+                self._inflight[ai] -= 1
+                summary = ray_tpu.get(meta_ref)
+                self.stats.tasks_finished += 1
+                if summary["num_rows"] > 0:
+                    self.output_queue.append(RefBundle(
+                        blocks_ref, summary["num_rows"],
+                        summary["size_bytes"], seq))
+        if self.all_inputs_done() and not any(self.input_queues) \
+                and not self._running:
+            self.close()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
 
     def outstanding_refs(self):
         return list(self._running)
@@ -313,15 +427,29 @@ class UnionOperator(PhysicalOperator):
 def _zip_task(left: List[Block], right: List[Block]) -> Tuple[List[Block], dict]:
     import pyarrow as pa
 
+    from ray_tpu.data.block import PandasBlock
+
     lt, rt = concat_blocks(left), concat_blocks(right)
     if lt.num_rows != rt.num_rows:
         raise ValueError(
             f"zip requires equal rows, got {lt.num_rows} vs {rt.num_rows}")
-    cols = {n: lt.column(n) for n in lt.schema.names}
-    for n in rt.schema.names:
-        name = n if n not in cols else n + "_1"
-        cols[name] = rt.column(n)
-    out = pa.Table.from_arrays(list(cols.values()), names=list(cols))
+    if isinstance(lt, PandasBlock) or isinstance(rt, PandasBlock):
+        ldf = BlockAccessor(lt).to_batch("pandas")
+        rdf = BlockAccessor(rt).to_batch("pandas")
+        rdf = rdf.rename(columns={
+            n: (n if n not in ldf.columns else n + "_1")
+            for n in rdf.columns})
+        import pandas as pd
+
+        out: Block = PandasBlock(pd.concat(
+            [ldf.reset_index(drop=True), rdf.reset_index(drop=True)],
+            axis=1))
+    else:
+        cols = {n: lt.column(n) for n in lt.schema.names}
+        for n in rt.schema.names:
+            name = n if n not in cols else n + "_1"
+            cols[name] = rt.column(n)
+        out = pa.Table.from_arrays(list(cols.values()), names=list(cols))
     return [out], {"num_rows": out.num_rows, "size_bytes": out.nbytes}
 
 
@@ -483,6 +611,13 @@ class StreamingExecutor:
         except BaseException as e:
             self._error = e
         finally:
+            # Operator cleanup on EVERY exit path (clean, error, stop):
+            # actor pools must not outlive the pipeline.
+            for op in self._ops:
+                try:
+                    op.close()
+                except Exception:
+                    pass
             self._outq.put(_SENTINEL)
 
     def _completed(self) -> bool:

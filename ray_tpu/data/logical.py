@@ -39,6 +39,10 @@ class MapBatches(LogicalOp):
     fn_constructor: Optional[Callable[[], Any]] = None  # actor/callable-class
     num_cpus: float = 1.0
     concurrency: Optional[int] = None
+    # "tasks" (default): stateless pool tasks; "actors": a pool of
+    # long-lived actors, the callable class constructed ONCE per actor
+    # (reference ActorPoolMapOperator / ActorPoolStrategy).
+    compute: Optional[str] = None
 
 
 @dataclasses.dataclass

@@ -27,6 +27,7 @@ from ray_tpu.data.block import (
     rows_to_block,
 )
 from ray_tpu.data.execution import (
+    ActorPoolMapOperator,
     AllToAllOperator,
     BlockTransform,
     InputDataBuffer,
@@ -67,6 +68,19 @@ def _rebatch(blocks: Iterator[Block], batch_size: Optional[int]) -> Iterator[Blo
         yield builder.build()
 
 
+def _apply_udf_batches(callable_fn, blocks: Iterator[Block], fmt: str,
+                       batch_size) -> Iterator[Block]:
+    """The shared map_batches loop (rebatch → format → UDF → re-block)
+    used by both the per-task transform and the per-actor factory."""
+    for block in _rebatch(blocks, batch_size):
+        out = callable_fn(block_to_batch(block, fmt))
+        if _is_iterator_of_batches(out):
+            for b in out:
+                yield batch_to_block(b)
+        else:
+            yield batch_to_block(out)
+
+
 def _map_batches_transform(op: L.MapBatches) -> BlockTransform:
     fn = op.fn
     fmt = op.batch_format
@@ -74,19 +88,10 @@ def _map_batches_transform(op: L.MapBatches) -> BlockTransform:
     ctor = op.fn_constructor
 
     def transform(blocks: Iterator[Block]) -> Iterator[Block]:
-        callable_fn = fn
-        if ctor is not None:
-            # Callable-class UDF: constructed once per task (the reference
-            # uses actor pools; task-lifetime reuse gives the same
-            # amortization on our single-host pool).
-            callable_fn = ctor()
-        for block in _rebatch(blocks, batch_size):
-            out = callable_fn(block_to_batch(block, fmt))
-            if _is_iterator_of_batches(out):
-                for b in out:
-                    yield batch_to_block(b)
-            else:
-                yield batch_to_block(out)
+        # Callable-class UDF: constructed once per task (compute="actors"
+        # moves construction to once per pool actor instead).
+        callable_fn = fn if ctor is None else ctor()
+        yield from _apply_udf_batches(callable_fn, blocks, fmt, batch_size)
 
     return transform
 
@@ -164,6 +169,31 @@ _MAP_COMPILERS = {
 
 def _is_map_op(op: L.LogicalOp) -> bool:
     return type(op) in _MAP_COMPILERS
+
+
+def _is_actor_map_op(op: L.LogicalOp) -> bool:
+    return isinstance(op, L.MapBatches) and \
+        getattr(op, "compute", None) == "actors"
+
+
+def _map_batches_actor_factory(op: L.MapBatches):
+    """Transform factory for ActorPoolMapOperator: called once in each
+    pool actor's __init__, so a callable-class UDF is constructed per
+    ACTOR and reused across all its tasks (reference ActorPoolStrategy
+    semantics — the amortization the per-task path can't give)."""
+    fn, fmt, batch_size, ctor = (op.fn, op.batch_format, op.batch_size,
+                                 op.fn_constructor)
+
+    def factory():
+        callable_fn = fn if ctor is None else ctor()
+
+        def transform(blocks: Iterator[Block]) -> Iterator[Block]:
+            yield from _apply_udf_batches(callable_fn, blocks, fmt,
+                                          batch_size)
+
+        return transform
+
+    return factory
 
 
 # ---------------------------------------------------------------------------
@@ -460,16 +490,30 @@ def build_topology(plan: "L.LogicalPlan") -> List[PhysicalOperator]:
         if id(op) in phys_of:
             return phys_of[id(op)]
 
+        if _is_actor_map_op(op):
+            # Actor-pool compute: its own operator, never fused (the
+            # UDF's state lives in the pool actors).
+            up_phys = lower(op.inputs[0])
+            phys = emit(ActorPoolMapOperator(
+                f"{op.name}[actors]", _map_batches_actor_factory(op),
+                pool_size=op.concurrency or 2,
+                num_cpus=op.num_cpus or 1.0))
+            connect(up_phys, phys)
+            phys_of[id(op)] = phys
+            return phys
+
         if _is_map_op(op):
-            # Collect the maximal map chain ending at `op`.
+            # Collect the maximal map chain ending at `op` (actor-compute
+            # ops break the chain — they don't fuse).
             chain_ops: List[L.LogicalOp] = []
             cur = op
-            while _is_map_op(cur):
+            while _is_map_op(cur) and not _is_actor_map_op(cur):
                 chain_ops.append(cur)
                 if len(cur.inputs) != 1:
                     break
                 nxt = cur.inputs[0]
-                if not _is_map_op(nxt) or consumers.get(id(nxt), 0) > 1 \
+                if not _is_map_op(nxt) or _is_actor_map_op(nxt) \
+                        or consumers.get(id(nxt), 0) > 1 \
                         or id(nxt) in phys_of:
                     cur = nxt
                     break

@@ -184,7 +184,12 @@ class HTTPProxy(_RouteTable):
                 headers: Dict[str, str] = {}
                 while True:
                     try:
-                        h = await reader.readline()
+                        # Bounded like the request line: a client going
+                        # silent mid-headers must not park the fd/task.
+                        h = await asyncio.wait_for(reader.readline(),
+                                                   timeout=30.0)
+                    except asyncio.TimeoutError:
+                        return
                     except (ValueError, asyncio.LimitOverrunError):
                         self._write_response(writer, 400, json.dumps(
                             {"error": "header too long"}).encode())
@@ -201,7 +206,12 @@ class HTTPProxy(_RouteTable):
                         {"error": "bad Content-Length"}).encode())
                     await writer.drain()
                     return
-                body = await reader.readexactly(length) if length else b""
+                try:
+                    body = await asyncio.wait_for(
+                        reader.readexactly(length),
+                        timeout=75.0) if length else b""
+                except asyncio.TimeoutError:
+                    return
                 keep = _hget(headers, "connection", "").lower() != "close"
                 try:
                     await self._dispatch(writer, method, raw_path, body,

@@ -1,0 +1,99 @@
+"""Data-plane breadth (VERDICT r2 item 10): pandas blocks at rest and
+actor-pool map compute.
+
+- DataContext.block_format="pandas" keeps blocks as DataFrames
+  end-to-end (reference pandas_block.py peer type); the whole data test
+  suite must pass under both formats — proven here by running
+  tests/test_data.py in a subprocess with the env toggle.
+- map_batches(compute="actors") runs on a pool of long-lived actors:
+  callable-class UDFs construct once per actor and keep state across
+  tasks (reference ActorPoolMapOperator/ActorPoolStrategy).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def rt():
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def test_pandas_block_format_end_to_end(rt):
+    import pandas as pd
+
+    from ray_tpu.data.block import PandasBlock
+    from ray_tpu.data.context import DataContext
+
+    ctx = DataContext.get_current()
+    prev = ctx.block_format
+    ctx.block_format = "pandas"
+    try:
+        ds = rd.range(100).map_batches(
+            lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+        blocks = list(ds.iter_internal_blocks())
+        # Blocks really are pandas at rest, not arrow-with-conversion.
+        assert blocks and all(isinstance(b, PandasBlock) for b in blocks)
+        out = ds.take_all()
+        assert sorted(r["sq"] for r in out) == [i * i for i in range(100)]
+        df = ds.to_pandas()
+        assert isinstance(df, pd.DataFrame) and len(df) == 100
+    finally:
+        ctx.block_format = prev
+
+
+def test_full_data_suite_passes_under_pandas_blocks():
+    """The VERDICT 'done' bar, literally: the existing data tests pass
+    under the pandas block type (workers inherit the env toggle)."""
+    env = dict(os.environ)
+    env["RAY_TPU_DATA_BLOCK_FORMAT"] = "pandas"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_data.py", "-q",
+         "-p", "no:cacheprovider"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout[-2000:]
+
+
+def test_actor_pool_map_constructs_udf_once_per_actor(rt):
+    class Stateful:
+        """Counts how many batches THIS instance served; with actor
+        compute, one instance lives per pool actor, so counts exceed 1
+        (per-task construction would always report 1)."""
+
+        def __init__(self):
+            self.calls = 0
+
+        def __call__(self, batch):
+            self.calls += 1
+            return {"id": batch["id"], "nth_call": np.full(
+                len(batch["id"]), self.calls)}
+
+    ds = rd.range(200, parallelism=8).map_batches(
+        fn_constructor=Stateful, compute="actors", concurrency=2)
+    rows = ds.take_all()
+    assert len(rows) == 200
+    assert sorted({r["id"] for r in rows}) == list(range(200))
+    # 8 input bundles over 2 actors: some actor served several batches
+    # with ONE constructed instance.
+    assert max(r["nth_call"] for r in rows) >= 2
+
+
+def test_actor_pool_matches_task_pool_results(rt):
+    def double(batch):
+        return {"id": batch["id"] * 2}
+
+    a = rd.range(50, parallelism=4).map_batches(
+        double, compute="actors", concurrency=2).take_all()
+    b = rd.range(50, parallelism=4).map_batches(double).take_all()
+    assert sorted(r["id"] for r in a) == sorted(r["id"] for r in b)
