@@ -1,0 +1,30 @@
+"""Autoscaler v2: reconciler-based instance management.
+
+Counterpart of python/ray/autoscaler/v2/ (SURVEY.md §2.2 P16): instead
+of v1's launch-and-forget loop, every cloud instance is tracked through
+an explicit lifecycle state machine by an InstanceManager, and a
+Reconciler periodically converges three views — desired capacity
+(demand scheduler), cloud reality (provider), and cluster reality
+(nodes the control plane sees).
+"""
+
+from ray_tpu.autoscaler.v2.instance_manager import (
+    Instance,
+    InstanceManager,
+    InstanceState,
+)
+from ray_tpu.autoscaler.v2.providers import (
+    CloudInstanceProvider,
+    QueuedResourceTPUProvider,
+)
+from ray_tpu.autoscaler.v2.reconciler import AutoscalerV2, Reconciler
+
+__all__ = [
+    "AutoscalerV2",
+    "CloudInstanceProvider",
+    "Instance",
+    "InstanceManager",
+    "InstanceState",
+    "QueuedResourceTPUProvider",
+    "Reconciler",
+]

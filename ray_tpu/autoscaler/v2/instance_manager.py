@@ -1,0 +1,155 @@
+"""Instance lifecycle state machine + storage.
+
+Counterpart of python/ray/autoscaler/v2/instance_manager/ (Instance
+proto states, InstanceStorage, InstanceManager): each cloud instance is
+one record moving through an explicit lifecycle; every transition is
+validated against the legal-edge table and versioned, so the reconciler
+can detect stuck/illegal flows instead of losing instances the way a
+launch-and-forget loop does.
+
+TPU shaping: the ALLOCATED→RUNNING hop is where a GCE *queued resource*
+becomes an ACTIVE pod slice whose node manager joins the cluster; there
+is no RAY_INSTALLING phase (the node manager IS the bootstrap).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import threading
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+
+class InstanceState(str, enum.Enum):
+    QUEUED = "QUEUED"                  # decided, not yet requested
+    REQUESTED = "REQUESTED"            # provider request in flight
+    ALLOCATED = "ALLOCATED"            # cloud granted; node not joined
+    RUNNING = "RUNNING"                # node joined the cluster
+    TERMINATING = "TERMINATING"        # terminate requested
+    TERMINATED = "TERMINATED"          # gone (terminal)
+    ALLOCATION_FAILED = "ALLOCATION_FAILED"  # terminal for this record
+
+
+_LEGAL_EDGES = {
+    InstanceState.QUEUED: {InstanceState.REQUESTED,
+                           InstanceState.TERMINATED},
+    InstanceState.REQUESTED: {InstanceState.ALLOCATED,
+                              InstanceState.ALLOCATION_FAILED,
+                              InstanceState.TERMINATING},
+    InstanceState.ALLOCATED: {InstanceState.RUNNING,
+                              InstanceState.TERMINATING,
+                              InstanceState.TERMINATED},
+    InstanceState.RUNNING: {InstanceState.TERMINATING,
+                            InstanceState.TERMINATED},
+    InstanceState.TERMINATING: {InstanceState.TERMINATED},
+    InstanceState.TERMINATED: set(),
+    InstanceState.ALLOCATION_FAILED: set(),
+}
+
+TERMINAL_STATES = (InstanceState.TERMINATED,
+                   InstanceState.ALLOCATION_FAILED)
+
+
+class InvalidTransitionError(RuntimeError):
+    pass
+
+
+@dataclasses.dataclass
+class Instance:
+    instance_id: str
+    node_type: str
+    state: InstanceState = InstanceState.QUEUED
+    cloud_id: str = ""        # provider's handle once ALLOCATED
+    node_id: str = ""         # cluster node id once RUNNING
+    version: int = 0
+    state_since: float = dataclasses.field(default_factory=time.time)
+    retries: int = 0
+    error: str = ""
+
+
+class InstanceManager:
+    """Versioned instance table with validated transitions (the
+    InstanceStorage + InstanceManager pair of the reference, collapsed:
+    one process owns the autoscaler here, so optimistic cross-process
+    versioning reduces to a lock)."""
+
+    def __init__(self,
+                 on_change: Optional[Callable[[Instance], None]] = None):
+        self._lock = threading.Lock()
+        self._instances: Dict[str, Instance] = {}
+        self._on_change = on_change
+
+    # -- queries --------------------------------------------------------
+    def list(self, *states: InstanceState) -> List[Instance]:
+        with self._lock:
+            out = [dataclasses.replace(i)
+                   for i in self._instances.values()]
+        if states:
+            out = [i for i in out if i.state in states]
+        return out
+
+    def get(self, instance_id: str) -> Optional[Instance]:
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            return dataclasses.replace(inst) if inst else None
+
+    def count_active(self, node_type: Optional[str] = None) -> int:
+        """Instances that hold (or will hold) capacity."""
+        with self._lock:
+            return sum(
+                1 for i in self._instances.values()
+                if i.state not in TERMINAL_STATES
+                and (node_type is None or i.node_type == node_type))
+
+    # -- mutations ------------------------------------------------------
+    def create(self, node_type: str, retries: int = 0) -> Instance:
+        inst = Instance(instance_id=f"inst-{uuid.uuid4().hex[:8]}",
+                        node_type=node_type, retries=retries)
+        with self._lock:
+            self._instances[inst.instance_id] = inst
+        return dataclasses.replace(inst)
+
+    def annotate(self, instance_id: str, **updates) -> None:
+        """Update bookkeeping fields WITHOUT a state transition (e.g.
+        marking a failed record as already-retried)."""
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst is None:
+                return
+            for k, v in updates.items():
+                setattr(inst, k, v)
+
+    def transition(self, instance_id: str, to: InstanceState,
+                   **updates) -> Instance:
+        with self._lock:
+            inst = self._instances.get(instance_id)
+            if inst is None:
+                raise KeyError(instance_id)
+            if to not in _LEGAL_EDGES[inst.state]:
+                raise InvalidTransitionError(
+                    f"{instance_id}: {inst.state.value} -> {to.value} "
+                    "is not a legal edge")
+            inst.state = to
+            inst.version += 1
+            inst.state_since = time.time()
+            for k, v in updates.items():
+                setattr(inst, k, v)
+            snap = dataclasses.replace(inst)
+        if self._on_change is not None:
+            try:
+                self._on_change(snap)
+            except Exception:
+                pass
+        return snap
+
+    def prune_terminal(self, keep_last: int = 100):
+        """Bound table growth: drop oldest terminal records."""
+        with self._lock:
+            terminal = sorted(
+                (i for i in self._instances.values()
+                 if i.state in TERMINAL_STATES),
+                key=lambda i: i.state_since)
+            for i in terminal[:-keep_last] if keep_last else terminal:
+                del self._instances[i.instance_id]

@@ -1,0 +1,142 @@
+"""Async cloud-instance providers for autoscaler v2.
+
+Counterpart of python/ray/autoscaler/v2/instance_manager/cloud_providers/:
+the v2 provider model is ASYNCHRONOUS — requesting capacity returns
+immediately and the reconciler later observes what the cloud actually
+granted.  That shape is exactly how TPU capacity works on GCE: a pod
+slice is a *queued resource* that sits in QUEUED/PROVISIONING before
+becoming ACTIVE (or FAILED/exhausted), often for minutes.
+
+QueuedResourceTPUProvider models that lifecycle faithfully (configurable
+provisioning delay, capacity ceiling, failure injection) against the
+in-process cluster substrate: an ACTIVE grant materializes as a cluster
+node (cluster_utils.add_node — the same fixture real scheduling tests
+use).  A real GCE binding would swap the `_materialize` step for the TPU
+queued-resource REST calls; everything above it (state machine,
+reconciler) is transport-agnostic by design.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+
+class CloudInstance:
+    """Provider-side record of one granted/pending instance."""
+
+    def __init__(self, cloud_id: str, node_type: str,
+                 resources: Dict[str, float]):
+        self.cloud_id = cloud_id
+        self.node_type = node_type
+        self.resources = dict(resources)
+        self.status = "QUEUED"   # QUEUED | ACTIVE | FAILED | TERMINATED
+        self.node_id = ""        # cluster node once ACTIVE
+        self.ready_at = 0.0
+        self.error = ""
+
+
+class CloudInstanceProvider:
+    """v2 provider ABC: async request / observe / terminate."""
+
+    def request_instance(self, node_type: str,
+                         resources: Dict[str, float]) -> str:
+        """Returns a cloud_id immediately; allocation continues async."""
+        raise NotImplementedError
+
+    def describe(self, cloud_id: str) -> Optional[CloudInstance]:
+        raise NotImplementedError
+
+    def terminate(self, cloud_id: str) -> bool:
+        raise NotImplementedError
+
+    def non_terminated(self) -> List[CloudInstance]:
+        raise NotImplementedError
+
+
+class QueuedResourceTPUProvider(CloudInstanceProvider):
+    """Simulated GCE queued-resource lifecycle over the in-process
+    cluster: QUEUED →(provision_delay_s)→ ACTIVE (node joins) with
+    optional capacity ceilings and injected failures."""
+
+    def __init__(self, cluster, provision_delay_s: float = 0.0,
+                 capacity: Optional[int] = None,
+                 fail_next: int = 0):
+        self._cluster = cluster
+        self._delay = provision_delay_s
+        self._capacity = capacity
+        self.fail_next = fail_next  # tests flip this for chaos
+        self._lock = threading.Lock()
+        self._instances: Dict[str, CloudInstance] = {}
+
+    # -- provider API ---------------------------------------------------
+    def request_instance(self, node_type: str,
+                         resources: Dict[str, float]) -> str:
+        cloud_id = f"qr-{uuid.uuid4().hex[:8]}"
+        inst = CloudInstance(cloud_id, node_type, resources)
+        inst.ready_at = time.monotonic() + self._delay
+        with self._lock:
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                inst.status = "FAILED"
+                inst.error = "injected allocation failure"
+            elif self._capacity is not None and sum(
+                    1 for i in self._instances.values()
+                    if i.status in ("QUEUED", "ACTIVE")) >= self._capacity:
+                inst.status = "FAILED"
+                inst.error = "queued resource: capacity exhausted"
+            self._instances[cloud_id] = inst
+        return cloud_id
+
+    def describe(self, cloud_id: str) -> Optional[CloudInstance]:
+        self._advance()
+        with self._lock:
+            return self._instances.get(cloud_id)
+
+    def terminate(self, cloud_id: str) -> bool:
+        with self._lock:
+            inst = self._instances.get(cloud_id)
+            if inst is None or inst.status == "TERMINATED":
+                return False
+            node_id, was_active = inst.node_id, inst.status == "ACTIVE"
+            inst.status = "TERMINATED"
+        if was_active and node_id:
+            try:
+                self._cluster.remove_node(node_id)
+            except Exception:
+                pass
+        return True
+
+    def non_terminated(self) -> List[CloudInstance]:
+        self._advance()
+        with self._lock:
+            return [i for i in self._instances.values()
+                    if i.status != "TERMINATED"]
+
+    # -- queued-resource simulation ------------------------------------
+    def _advance(self):
+        """Flip QUEUED grants whose delay elapsed to ACTIVE, joining the
+        cluster (the moment a real pod slice's node manager would dial
+        the head)."""
+        now = time.monotonic()
+        to_join: List[CloudInstance] = []
+        with self._lock:
+            for inst in self._instances.values():
+                if inst.status == "QUEUED" and now >= inst.ready_at:
+                    inst.status = "ACTIVE"
+                    to_join.append(inst)
+        for inst in to_join:
+            res = dict(inst.resources)
+            cpus = res.pop("CPU", 0)
+            tpus = res.pop("TPU", 0)
+            try:
+                inst.node_id = self._cluster.add_node(
+                    num_cpus=cpus, num_tpus=tpus, resources=res,
+                    node_id=f"{inst.node_type}-{inst.cloud_id[-6:]}",
+                    labels={"autoscaler-node-type": inst.node_type,
+                            "cloud-id": inst.cloud_id})
+            except Exception as e:  # noqa: BLE001
+                inst.status = "FAILED"
+                inst.error = f"node join failed: {e}"

@@ -1,0 +1,236 @@
+"""Reconciler: converge desired capacity, cloud state, and cluster state.
+
+Counterpart of python/ray/autoscaler/v2/instance_manager/reconciler.py:
+each tick
+  1. observes the cloud (provider.describe of every tracked instance)
+     and the cluster (get_load's node list) and advances the instance
+     state machine accordingly — REQUESTED→ALLOCATED/ALLOCATION_FAILED,
+     ALLOCATED→RUNNING (node joined), RUNNING→TERMINATED (node died);
+  2. fails requests stuck past request_timeout_s and retries
+     ALLOCATION_FAILED instances up to max_retries (fresh record per
+     attempt — terminal states stay terminal);
+  3. computes unmet demand (the v1 bin-packing scheduler) and QUEUES
+     new instances, then pushes QUEUED→REQUESTED through the provider;
+  4. scales down instances whose nodes sat idle past the timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig
+from ray_tpu.autoscaler.resource_demand_scheduler import fit_demands
+from ray_tpu.autoscaler.v2.instance_manager import (
+    Instance,
+    InstanceManager,
+    InstanceState,
+)
+from ray_tpu.autoscaler.v2.providers import CloudInstanceProvider
+
+
+class Reconciler:
+    def __init__(self, kv_call: Callable, provider: CloudInstanceProvider,
+                 config: AutoscalerConfig,
+                 im: Optional[InstanceManager] = None,
+                 request_timeout_s: float = 120.0,
+                 max_retries: int = 2):
+        self._call = kv_call
+        self.provider = provider
+        self.config = config
+        self.im = im or InstanceManager()
+        self.request_timeout_s = request_timeout_s
+        self.max_retries = max_retries
+        self._idle_since: Dict[str, float] = {}
+        self.last_infeasible: List[Dict[str, float]] = []
+
+    # -- one tick -------------------------------------------------------
+    def reconcile(self) -> Dict[str, int]:
+        load = self._call({"op": "get_load"})
+        alive_nodes = {n["node_id"]: n for n in load["nodes"]
+                       if n["alive"]}
+        self._observe(alive_nodes)
+        self._retry_failures()
+        launched = self._scale_up(load, alive_nodes)
+        self._scale_down(alive_nodes)
+        self.im.prune_terminal()
+        return launched
+
+    # -- step 1: observation -------------------------------------------
+    def _observe(self, alive_nodes: Dict[str, dict]):
+        for inst in self.im.list(InstanceState.REQUESTED,
+                                 InstanceState.ALLOCATED,
+                                 InstanceState.RUNNING,
+                                 InstanceState.TERMINATING):
+            cloud = (self.provider.describe(inst.cloud_id)
+                     if inst.cloud_id else None)
+            if inst.state == InstanceState.REQUESTED:
+                if cloud is None:
+                    continue
+                if cloud.status == "FAILED":
+                    self.im.transition(
+                        inst.instance_id,
+                        InstanceState.ALLOCATION_FAILED,
+                        error=cloud.error)
+                elif cloud.status in ("QUEUED", "ACTIVE"):
+                    self.im.transition(inst.instance_id,
+                                       InstanceState.ALLOCATED)
+                elif time.time() - inst.state_since \
+                        > self.request_timeout_s:
+                    self.provider.terminate(inst.cloud_id)
+                    self.im.transition(
+                        inst.instance_id,
+                        InstanceState.ALLOCATION_FAILED,
+                        error="request timed out")
+            elif inst.state == InstanceState.ALLOCATED:
+                if cloud is None or cloud.status == "TERMINATED":
+                    self.im.transition(inst.instance_id,
+                                       InstanceState.TERMINATED)
+                elif cloud.status == "FAILED":
+                    self.im.transition(
+                        inst.instance_id, InstanceState.TERMINATING)
+                    self.provider.terminate(inst.cloud_id)
+                    self.im.transition(inst.instance_id,
+                                       InstanceState.TERMINATED)
+                elif cloud.status == "ACTIVE" \
+                        and cloud.node_id in alive_nodes:
+                    self.im.transition(inst.instance_id,
+                                       InstanceState.RUNNING,
+                                       node_id=cloud.node_id)
+            elif inst.state == InstanceState.RUNNING:
+                if inst.node_id not in alive_nodes:
+                    # Node died under us: release the cloud resource.
+                    self.provider.terminate(inst.cloud_id)
+                    self.im.transition(inst.instance_id,
+                                       InstanceState.TERMINATED)
+            elif inst.state == InstanceState.TERMINATING:
+                if cloud is None or cloud.status == "TERMINATED":
+                    self.im.transition(inst.instance_id,
+                                       InstanceState.TERMINATED)
+
+    # -- step 2: failure retry -----------------------------------------
+    def _retry_failures(self):
+        for inst in self.im.list(InstanceState.ALLOCATION_FAILED):
+            if inst.retries >= self.max_retries or inst.error == "retried":
+                continue
+            # Fresh record carries the attempt count; the failed record
+            # is marked consumed so it is retried exactly once.
+            self.im.create(inst.node_type, retries=inst.retries + 1)
+            self.im.annotate(inst.instance_id, error="retried")
+
+    # -- step 3: scale up ----------------------------------------------
+    def _scale_up(self, load: dict,
+                  alive_nodes: Dict[str, dict]) -> Dict[str, int]:
+        demands = list(load["demands"])
+        for pg in load["pg_demands"]:
+            demands.extend(pg["bundles"])
+
+        # Capacity already on the way (QUEUED/REQUESTED/ALLOCATED)
+        # counts as spare, or every tick before a queued resource lands
+        # would launch another copy of the same demand.
+        pending_spare = []
+        counts: Dict[str, int] = {}
+        for inst in self.im.list():
+            if inst.state in (InstanceState.QUEUED,
+                              InstanceState.REQUESTED,
+                              InstanceState.ALLOCATED,
+                              InstanceState.RUNNING):
+                counts[inst.node_type] = counts.get(inst.node_type, 0) + 1
+            if inst.state in (InstanceState.QUEUED,
+                              InstanceState.REQUESTED,
+                              InstanceState.ALLOCATED):
+                pending_spare.append(dict(
+                    self.config.node_types[inst.node_type].resources))
+
+        spare = [dict(n["available"]) for n in alive_nodes.values()]
+        to_add, self.last_infeasible = fit_demands(
+            demands, spare + pending_spare,
+            {t: c.resources for t, c in self.config.node_types.items()},
+            {t: c.max_workers for t, c in self.config.node_types.items()},
+            counts)
+
+        # min_workers floor
+        for t, cfg in self.config.node_types.items():
+            have = counts.get(t, 0) + to_add.get(t, 0)
+            if have < cfg.min_workers:
+                to_add[t] = to_add.get(t, 0) + (cfg.min_workers - have)
+
+        launched: Dict[str, int] = {}
+        for t, n in to_add.items():
+            for _ in range(n):
+                self.im.create(t)
+            if n:
+                launched[t] = n
+
+        # QUEUED → REQUESTED through the provider.
+        for inst in self.im.list(InstanceState.QUEUED):
+            cloud_id = self.provider.request_instance(
+                inst.node_type,
+                self.config.node_types[inst.node_type].resources)
+            self.im.transition(inst.instance_id, InstanceState.REQUESTED,
+                               cloud_id=cloud_id)
+        return launched
+
+    # -- step 4: scale down --------------------------------------------
+    def _scale_down(self, alive_nodes: Dict[str, dict]):
+        now = time.time()
+        for inst in self.im.list(InstanceState.RUNNING):
+            node = alive_nodes.get(inst.node_id)
+            if node is None:
+                continue
+            cfg = self.config.node_types.get(inst.node_type)
+            floor = cfg.min_workers if cfg else 0
+            if self.im.count_active(inst.node_type) <= floor:
+                self._idle_since.pop(inst.instance_id, None)
+                continue
+            idle = node["available"] == node["total"]
+            if not idle:
+                self._idle_since.pop(inst.instance_id, None)
+                continue
+            first = self._idle_since.setdefault(inst.instance_id, now)
+            if now - first >= self.config.idle_timeout_s:
+                self._idle_since.pop(inst.instance_id, None)
+                self.im.transition(inst.instance_id,
+                                   InstanceState.TERMINATING)
+                self.provider.terminate(inst.cloud_id)
+                self.im.transition(inst.instance_id,
+                                   InstanceState.TERMINATED)
+
+
+class AutoscalerV2:
+    """The v2 control loop: a Reconciler on a timer (reference
+    autoscaler/v2/autoscaler.py)."""
+
+    def __init__(self, kv_call, provider, config: AutoscalerConfig,
+                 interval_s: float = 1.0, **reconciler_kwargs):
+        self.reconciler = Reconciler(kv_call, provider, config,
+                                     **reconciler_kwargs)
+        self._interval = interval_s
+        self._stopped = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def im(self) -> InstanceManager:
+        return self.reconciler.im
+
+    def step(self) -> Dict[str, int]:
+        return self.reconciler.reconcile()
+
+    def start(self) -> "AutoscalerV2":
+        self._thread = threading.Thread(
+            target=self._run, name="autoscaler-v2", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stopped.wait(self._interval):
+            try:
+                self.reconciler.reconcile()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def stop(self):
+        self._stopped.set()

@@ -43,10 +43,11 @@ class PandasBlock:
     reference's pandas block type (python/ray/data/_internal/
     pandas_block.py); selected via DataContext.block_format="pandas"."""
 
-    __slots__ = ("df",)
+    __slots__ = ("df", "_nbytes")
 
     def __init__(self, df):
         self.df = df
+        self._nbytes = -1
 
     @property
     def num_rows(self) -> int:
@@ -58,6 +59,10 @@ class PandasBlock:
         # memory_usage(deep=False) would count at ~8 B/row — size the
         # elements, or the executor's accounting is off by orders of
         # magnitude on exactly the tensor blocks this format carries.
+        # Cached: blocks are never mutated in place and accounting reads
+        # this at every operator boundary.
+        if self._nbytes >= 0:
+            return self._nbytes
         import sys
 
         total = 0
@@ -69,6 +74,7 @@ class PandasBlock:
                     else sys.getsizeof(x) for x in s))
             else:
                 total += int(s.memory_usage(index=False, deep=False))
+        self._nbytes = total
         return total
 
     @property
@@ -219,8 +225,7 @@ def batch_to_block(batch: BatchLike, block_format: Optional[str] = None
         if isinstance(batch, pd.DataFrame):
             return PandasBlock(batch.reset_index(drop=True))
         if isinstance(batch, pa.Table):
-            return PandasBlock(
-                block_to_batch(batch, "pandas").reset_index(drop=True))
+            return PandasBlock(_table_to_df(batch))
         if isinstance(batch, dict):
             return PandasBlock(_dict_to_df(batch))
         raise TypeError(
@@ -279,6 +284,16 @@ def _dict_to_df(batch: Dict[str, Any]):
                 f"{len(series)}, expected {n_rows}")
         cols[name] = series
     return pd.DataFrame(cols)
+
+
+def _table_to_df(table: pa.Table):
+    """arrow Table → DataFrame, DECODING tensor-encoded columns back to
+    per-row ndarrays (plain to_pandas would surface the raw encoding
+    structs) — the inverse of block_to_arrow's numpy round trip."""
+    if any(isinstance(f.type, pa.FixedShapeTensorType)
+           or _is_var_tensor_type(f.type) for f in table.schema):
+        return _dict_to_df(block_to_batch(table, "numpy"))
+    return table.to_pandas().reset_index(drop=True)
 
 
 def block_to_arrow(block: Block) -> pa.Table:
@@ -551,7 +566,7 @@ class BlockBuilder:
             return pa.table({})
         if any(isinstance(t, PandasBlock) for t in self._tables):
             frames = [t.df if isinstance(t, PandasBlock)
-                      else block_to_batch(t, "pandas")
+                      else _table_to_df(t)
                       for t in self._tables]
             return PandasBlock(
                 pd.concat(frames, ignore_index=True))

@@ -207,7 +207,10 @@ class Dataset:
         ex = self._execute()
         return sum(b.num_rows for b in ex.output_bundles())
 
-    def schema(self) -> Optional[pa.Schema]:
+    def schema(self):
+        """First block's schema: a pyarrow.Schema under the default
+        block format, or a names-only shim under
+        DataContext.block_format="pandas" (both expose ``.names``)."""
         for block in self.limit(1).iter_internal_blocks():
             return block.schema
         return None

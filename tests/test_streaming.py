@@ -140,10 +140,17 @@ def test_dropped_generator_frees_unconsumed_items():
     first = next(iter(g))
     task_id = g.task_id
     assert ray_tpu.get(first) == 0
-    # Let the task finish so the items all exist.
-    time.sleep(0.5)
+    # Wait (deterministically — a fixed sleep flaked under suite load)
+    # until the task finished and the tail item exists: free_stream is
+    # a no-op while the generator still runs.
     rt = get_runtime()
     tail_hex = stream_item_id(task_id, 5).hex()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if any(o["object_id"] == tail_hex
+               for o in rt.state_list("objects")):
+            break
+        time.sleep(0.05)
     assert any(o["object_id"] == tail_hex
                for o in rt.state_list("objects"))
     del g, first
