@@ -40,7 +40,8 @@ _LEGAL_EDGES = {
                               InstanceState.TERMINATING},
     InstanceState.ALLOCATED: {InstanceState.RUNNING,
                               InstanceState.TERMINATING,
-                              InstanceState.TERMINATED},
+                              InstanceState.TERMINATED,
+                              InstanceState.ALLOCATION_FAILED},
     InstanceState.RUNNING: {InstanceState.TERMINATING,
                             InstanceState.TERMINATED},
     InstanceState.TERMINATING: {InstanceState.TERMINATED},
@@ -67,6 +68,10 @@ class Instance:
     state_since: float = dataclasses.field(default_factory=time.time)
     retries: int = 0
     error: str = ""
+    # Set once a replacement has been queued for this failed record, so
+    # each failure is retried exactly once (and `error` keeps the
+    # original diagnostic).
+    retried: bool = False
 
 
 class InstanceManager:

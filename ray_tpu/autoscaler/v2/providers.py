@@ -97,11 +97,13 @@ class QueuedResourceTPUProvider(CloudInstanceProvider):
 
     def terminate(self, cloud_id: str) -> bool:
         with self._lock:
-            inst = self._instances.get(cloud_id)
+            # Drop the record entirely: describe() of a terminated id
+            # returns None (which callers treat as TERMINATED), and the
+            # table never grows with churn.
+            inst = self._instances.pop(cloud_id, None)
             if inst is None or inst.status == "TERMINATED":
                 return False
             node_id, was_active = inst.node_id, inst.status == "ACTIVE"
-            inst.status = "TERMINATED"
         if was_active and node_id:
             try:
                 self._cluster.remove_node(node_id)

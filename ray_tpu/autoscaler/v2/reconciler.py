@@ -35,12 +35,18 @@ class Reconciler:
                  config: AutoscalerConfig,
                  im: Optional[InstanceManager] = None,
                  request_timeout_s: float = 120.0,
+                 allocate_timeout_s: float = 900.0,
                  max_retries: int = 2):
         self._call = kv_call
         self.provider = provider
         self.config = config
         self.im = im or InstanceManager()
         self.request_timeout_s = request_timeout_s
+        # How long a granted-but-not-joined instance (a queued resource
+        # sitting in PROVISIONING) may take before it is abandoned and
+        # retried — without this, phantom pending capacity suppresses
+        # replacement launches forever.
+        self.allocate_timeout_s = allocate_timeout_s
         self.max_retries = max_retries
         self._idle_since: Dict[str, float] = {}
         self.last_infeasible: List[Dict[str, float]] = []
@@ -66,18 +72,20 @@ class Reconciler:
             cloud = (self.provider.describe(inst.cloud_id)
                      if inst.cloud_id else None)
             if inst.state == InstanceState.REQUESTED:
-                if cloud is None:
-                    continue
-                if cloud.status == "FAILED":
+                if cloud is not None and cloud.status == "FAILED":
                     self.im.transition(
                         inst.instance_id,
                         InstanceState.ALLOCATION_FAILED,
                         error=cloud.error)
-                elif cloud.status in ("QUEUED", "ACTIVE"):
+                elif cloud is not None and cloud.status in ("QUEUED",
+                                                            "ACTIVE"):
                     self.im.transition(inst.instance_id,
                                        InstanceState.ALLOCATED)
                 elif time.time() - inst.state_since \
                         > self.request_timeout_s:
+                    # Covers BOTH stuck shapes: a request the provider
+                    # never acknowledged (cloud None) and one it can't
+                    # classify.
                     self.provider.terminate(inst.cloud_id)
                     self.im.transition(
                         inst.instance_id,
@@ -98,6 +106,15 @@ class Reconciler:
                     self.im.transition(inst.instance_id,
                                        InstanceState.RUNNING,
                                        node_id=cloud.node_id)
+                elif time.time() - inst.state_since \
+                        > self.allocate_timeout_s:
+                    # Queued resource stuck in provisioning: abandon it;
+                    # the retry path queues a replacement.
+                    self.provider.terminate(inst.cloud_id)
+                    self.im.transition(
+                        inst.instance_id,
+                        InstanceState.ALLOCATION_FAILED,
+                        error="provisioning timed out")
             elif inst.state == InstanceState.RUNNING:
                 if inst.node_id not in alive_nodes:
                     # Node died under us: release the cloud resource.
@@ -112,12 +129,12 @@ class Reconciler:
     # -- step 2: failure retry -----------------------------------------
     def _retry_failures(self):
         for inst in self.im.list(InstanceState.ALLOCATION_FAILED):
-            if inst.retries >= self.max_retries or inst.error == "retried":
+            if inst.retries >= self.max_retries or inst.retried:
                 continue
             # Fresh record carries the attempt count; the failed record
-            # is marked consumed so it is retried exactly once.
+            # is flagged consumed (its error diagnostic stays intact).
             self.im.create(inst.node_type, retries=inst.retries + 1)
-            self.im.annotate(inst.instance_id, error="retried")
+            self.im.annotate(inst.instance_id, retried=True)
 
     # -- step 3: scale up ----------------------------------------------
     def _scale_up(self, load: dict,

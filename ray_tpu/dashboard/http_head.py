@@ -32,13 +32,14 @@ class Dashboard:
             def log_message(self, *a):  # quiet
                 pass
 
-            def _send(self, payload, code=200, raw=False):
+            def _send(self, payload, code=200, raw=False,
+                      content_type=None):
                 body = payload.encode() if raw else json.dumps(
                     payload, default=str).encode()
                 self.send_response(code)
                 self.send_header(
-                    "Content-Type",
-                    "text/plain" if raw else "application/json")
+                    "Content-Type", content_type or (
+                        "text/plain" if raw else "application/json"))
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -51,7 +52,10 @@ class Dashboard:
                 except Exception as e:  # noqa: BLE001
                     self._send({"error": str(e)}, 500)
                 else:
-                    if isinstance(out, str):
+                    if isinstance(out, tuple) and out[0] == "__html__":
+                        self._send(out[1], raw=True,
+                                   content_type="text/html")
+                    elif isinstance(out, str):
                         self._send(out, raw=True)
                     else:
                         self._send(out)
@@ -87,6 +91,14 @@ class Dashboard:
     # ------------------------------------------------------------------
     def _route_get(self, path: str):
         rt = self._runtime
+        if path in ("/", "/index.html"):
+            from ray_tpu.dashboard.ui import INDEX_HTML
+
+            return ("__html__", INDEX_HTML)
+        if path == "/api/grafana_dashboard":
+            from ray_tpu.dashboard.ui import grafana_dashboard_json
+
+            return grafana_dashboard_json()
         if path in ("/api/healthz", "/healthz"):
             return "success"
         if path == "/api/version":

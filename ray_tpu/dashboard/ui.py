@@ -1,0 +1,108 @@
+"""Dashboard web UI: a single self-contained HTML page over the JSON API.
+
+Counterpart of the reference's dashboard frontend (python/ray/dashboard/
+client — a React bundle); here one dependency-free page polls the same
+/api/* endpoints the CLI/state SDK consume and renders cluster
+resources, nodes, tasks, actors, objects and jobs.  Grafana users get a
+generated dashboard JSON for the Prometheus /metrics endpoint instead
+(grafana_dashboard_json below — the counterpart of
+dashboard/modules/metrics' shipped dashboards).
+"""
+
+from __future__ import annotations
+
+INDEX_HTML = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:1.2rem;background:#fafafa;color:#222}
+ h1{font-size:1.3rem} h2{font-size:1.05rem;margin:1.2rem 0 .4rem}
+ table{border-collapse:collapse;width:100%;background:#fff;font-size:.85rem}
+ th,td{border:1px solid #ddd;padding:.3rem .5rem;text-align:left}
+ th{background:#f0f0f0} .num{text-align:right}
+ .pill{display:inline-block;padding:0 .5rem;border-radius:9px;background:#e8f0fe}
+ #bar{display:flex;gap:1rem;flex-wrap:wrap}
+ .card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:.6rem 1rem}
+ .muted{color:#888;font-size:.8rem}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="bar"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Tasks</h2><table id="tasks"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Objects (top by size)</h2><table id="objects"></table>
+<p class="muted">Auto-refreshes every 2s · JSON API under /api/* ·
+Prometheus at /metrics · chrome trace at /api/timeline</p>
+<script>
+async function j(p){const r=await fetch(p);return r.json()}
+// API strings (task names, job entrypoints) are user-controlled:
+// escape EVERYTHING interpolated into markup (stored-XSS guard).
+function esc(x){return String(x).replace(/[&<>"']/g,
+  c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
+function table(el, rows, cols){
+  const t=document.getElementById(el);
+  if(!rows||!rows.length){t.innerHTML='<tr><td class="muted">(none)</td></tr>';return}
+  let h='<tr>'+cols.map(c=>'<th>'+esc(c)+'</th>').join('')+'</tr>';
+  for(const r of rows.slice(0,50))
+    h+='<tr>'+cols.map(c=>'<td>'+esc(r[c]??'')+'</td>').join('')+'</tr>';
+  t.innerHTML=h;
+}
+async function tick(){
+ try{
+  const [res,avail,store,nodes,tasks,actors,objects,jobs]=await Promise.all([
+    j('/api/cluster_resources'),j('/api/available_resources'),
+    j('/api/object_store_stats'),j('/api/nodes'),j('/api/tasks'),
+    j('/api/actors'),j('/api/objects'),j('/api/jobs')]);
+  let bar='';
+  for(const k of Object.keys(res))
+    bar+=`<div class="card"><b>${esc(k)}</b><br>${esc(avail[k]??0)} / ${esc(res[k])} free</div>`;
+  bar+=`<div class="card"><b>object store</b><br>`+
+       `${(store.used/1048576).toFixed(1)} / ${(store.capacity/1048576).toFixed(0)} MiB</div>`;
+  document.getElementById('bar').innerHTML=bar;
+  table('nodes',nodes,['node_id','alive','is_head','resources','available']);
+  table('tasks',tasks.filter(t=>t.state!=='FINISHED').concat(
+        tasks.filter(t=>t.state==='FINISHED')).slice(0,50),
+        ['task_id','name','state','duration_s']);
+  table('actors',actors,['actor_id','class','name','state','pid']);
+  table('jobs',jobs,['job_id','status','entrypoint']);
+  objects.sort((a,b)=>(b.size||0)-(a.size||0));
+  table('objects',objects,['object_id','state','size','refcount','in_shm']);
+ }catch(e){console.log(e)}
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>
+"""
+
+
+def grafana_dashboard_json(prometheus_job: str = "ray_tpu") -> dict:
+    """A ready-to-import Grafana dashboard over the /metrics endpoint
+    (reference: dashboard/modules/metrics generates shipped Grafana
+    dashboards the same way).  Returned as a dict so the HTTP route
+    serves it as application/json."""
+
+    def panel(panel_id, title, expr, unit="short", x=0, y=0):
+        return {
+            "id": panel_id, "type": "timeseries", "title": title,
+            "gridPos": {"h": 8, "w": 12, "x": x, "y": y},
+            "fieldConfig": {"defaults": {"unit": unit}},
+            "targets": [{"expr": expr, "refId": "A"}],
+        }
+
+    dash = {
+        "title": "ray_tpu cluster",
+        "uid": "ray-tpu-cluster",
+        "timezone": "browser",
+        "refresh": "5s",
+        "panels": [
+            # Series names match util/metrics.py builtin_snapshots.
+            panel(1, "Tasks by state", "ray_tpu_tasks", x=0, y=0),
+            panel(2, "Actors by state", "ray_tpu_actors", x=12, y=0),
+            panel(3, "Object store bytes", "ray_tpu_object_store_bytes",
+                  unit="bytes", x=0, y=8),
+            panel(4, "Objects", "ray_tpu_objects", x=12, y=8),
+            panel(5, "Alive nodes", "ray_tpu_nodes", x=0, y=16),
+        ],
+        "templating": {"list": []},
+        "schemaVersion": 39,
+    }
+    return dash

@@ -4,12 +4,14 @@ Counterpart of the reference's Block abstraction (python/ray/data/block.py,
 python/ray/data/_internal/arrow_block.py, pandas_block.py): a Dataset is a
 list of object-store refs to Blocks; each Block is a columnar table.
 
-Design: a Block is always a ``pyarrow.Table`` at rest (one canonical
-representation instead of the reference's Arrow|pandas|list union — simpler
-ownership, zero-copy slicing, cheap size accounting).  Batches handed to user
-functions are converted on the fly to the requested ``batch_format``:
-"numpy" (dict of np.ndarray, the default — feeds jnp.asarray zero-copy for
-numeric dtypes), "pandas", or "pyarrow".
+Design: a Block at rest is a ``pyarrow.Table`` (the default — zero-copy
+slicing, cheap size accounting) or, under
+``DataContext.block_format="pandas"``, a :class:`PandasBlock` wrapping a
+DataFrame (the reference's pandas_block.py peer type, for pandas-native
+pipelines that would otherwise pay an arrow conversion per map).
+Batches handed to user functions are converted on the fly to the
+requested ``batch_format``: "numpy" (dict of np.ndarray, the default —
+feeds jnp.asarray zero-copy for numeric dtypes), "pandas", or "pyarrow".
 """
 
 from __future__ import annotations
@@ -363,7 +365,9 @@ def block_to_batch(block: Block, batch_format: str = "numpy") -> BatchLike:
             for name in block.schema.names
         }
     if batch_format == "pandas":
-        return block.to_pandas()
+        # _table_to_df (not bare to_pandas): tensor-encoded columns
+        # must surface as per-row ndarrays, not encoding structs.
+        return _table_to_df(block)
     if batch_format == "pyarrow":
         return block
     raise ValueError(

@@ -1,0 +1,115 @@
+"""HuggingFace Llama checkpoint compatibility.
+
+A user leaving the reference stack typically holds HF-format Llama
+weights (the reference's LLM release tests wrap HF models,
+release/release_tests.yaml:842-1015).  This module maps an HF
+``LlamaForCausalLM`` (or its state dict) onto the flagship transformer's
+parameter pytree so those checkpoints train/serve here unchanged:
+
+    params, config = params_from_hf_llama(hf_model)
+    logits = transformer.forward(params, tokens, config)
+
+Conventions line up exactly — HF's rotate-half RoPE is our split-half
+apply_rope, LlamaRMSNorm is our rms_norm (fp32 accumulation), linear
+weights transpose ([out,in] → [in,out]), and the tied lm_head is our
+weight-tied head.  Verified logit-for-logit against transformers in
+tests/test_hf_compat.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from ray_tpu.models.transformer import TransformerConfig
+
+
+def config_from_hf(hf_config) -> TransformerConfig:
+    if getattr(hf_config, "model_type", "llama") != "llama":
+        raise ValueError(
+            f"unsupported HF model_type {hf_config.model_type!r}; "
+            "only llama-family checkpoints map onto the flagship model")
+    if not getattr(hf_config, "tie_word_embeddings", False):
+        raise ValueError(
+            "untied lm_head checkpoints are not supported yet (the "
+            "flagship model weight-ties its head); retie or fold the "
+            "head into the embedding first")
+    return TransformerConfig(
+        vocab_size=hf_config.vocab_size,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=hf_config.intermediate_size,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        num_kv_heads=getattr(hf_config, "num_key_value_heads",
+                             hf_config.num_attention_heads),
+        head_dim=getattr(hf_config, "head_dim", None),
+        max_seq_len=hf_config.max_position_embeddings,
+        rope_theta=float(getattr(hf_config, "rope_theta", 10000.0)),
+        rms_eps=float(getattr(hf_config, "rms_norm_eps", 1e-5)),
+    )
+
+
+def _t(state_dict, key) -> np.ndarray:
+    """Fetch a linear weight as [in, out] float32 (HF stores [out, in])."""
+    w = state_dict[key]
+    try:  # torch tensor
+        w = w.detach().to("cpu").float().numpy()
+    except AttributeError:
+        w = np.asarray(w, dtype=np.float32)
+    return np.ascontiguousarray(w.T)
+
+
+def _v(state_dict, key) -> np.ndarray:
+    w = state_dict[key]
+    try:
+        return w.detach().to("cpu").float().numpy()
+    except AttributeError:
+        return np.asarray(w, dtype=np.float32)
+
+
+def params_from_hf_llama(model_or_state_dict, hf_config=None
+                         ) -> Tuple[Dict[str, Any], TransformerConfig]:
+    """Convert an HF LlamaForCausalLM (or its state_dict + config) into
+    (params, TransformerConfig) for models/transformer.forward."""
+    import jax.numpy as jnp
+
+    if hasattr(model_or_state_dict, "state_dict"):
+        sd = model_or_state_dict.state_dict()
+        hf_config = model_or_state_dict.config
+    else:
+        sd = model_or_state_dict
+        if hf_config is None:
+            raise ValueError("pass hf_config when converting a raw "
+                             "state_dict")
+    config = config_from_hf(hf_config)
+    pd = config.param_dtype
+    L = config.num_layers
+
+    def stack(keys_fmt: str, linear: bool) -> jnp.ndarray:
+        fetch = _t if linear else _v
+        return jnp.stack([
+            jnp.asarray(fetch(sd, keys_fmt.format(i)), dtype=pd)
+            for i in range(L)])
+
+    blocks = {
+        "attn_norm": stack(
+            "model.layers.{}.input_layernorm.weight", linear=False),
+        "wq": stack("model.layers.{}.self_attn.q_proj.weight", True),
+        "wk": stack("model.layers.{}.self_attn.k_proj.weight", True),
+        "wv": stack("model.layers.{}.self_attn.v_proj.weight", True),
+        "wo": stack("model.layers.{}.self_attn.o_proj.weight", True),
+        "mlp_norm": stack(
+            "model.layers.{}.post_attention_layernorm.weight",
+            linear=False),
+        "w_gate": stack("model.layers.{}.mlp.gate_proj.weight", True),
+        "w_up": stack("model.layers.{}.mlp.up_proj.weight", True),
+        "w_down": stack("model.layers.{}.mlp.down_proj.weight", True),
+    }
+    params = {
+        "tok_embed": jnp.asarray(
+            _v(sd, "model.embed_tokens.weight"), dtype=pd),
+        "blocks": blocks,
+        "final_norm": jnp.asarray(_v(sd, "model.norm.weight"), dtype=pd),
+    }
+    return params, config

@@ -120,7 +120,7 @@ def test_allocation_failure_retries_then_gives_up(cluster):
     while time.time() < deadline:
         rec.reconcile()
         failed = rec.im.list(InstanceState.ALLOCATION_FAILED)
-        consumed = [i for i in failed if i.error == "retried"]
+        consumed = [i for i in failed if i.retried]
         exhausted = [i for i in failed if i.retries >= 1]
         if consumed and exhausted:
             break

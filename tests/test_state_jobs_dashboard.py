@@ -160,3 +160,24 @@ def test_dashboard_404(dashboard):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(f"{dashboard.url}/api/nope", timeout=10)
     assert ei.value.code == 404
+
+
+def test_dashboard_ui_and_grafana(dashboard):
+    """The dashboard serves a human UI at / (reference: the React
+    frontend) and a ready-to-import Grafana dashboard whose series names
+    match the /metrics exposition."""
+    import json as _json
+    import urllib.request
+
+    html = urllib.request.urlopen(dashboard.url + "/").read().decode()
+    assert "<title>ray_tpu dashboard</title>" in html
+    assert "/api/cluster_resources" in html
+
+    graf = _json.loads(urllib.request.urlopen(
+        dashboard.url + "/api/grafana_dashboard").read())
+    exprs = [t["expr"] for p in graf["panels"] for t in p["targets"]]
+    metrics = urllib.request.urlopen(dashboard.url + "/metrics")\
+        .read().decode()
+    for expr in exprs:
+        name = expr.split("{")[0]
+        assert name in metrics, f"{name} not in /metrics exposition"
