@@ -281,6 +281,16 @@ class LLMEngine:
 
         done: Dict[int, List[int]] = {}
         free = self._free_slots()
+        # Phase 1: admit (slot + page allocation, table build) WITHOUT
+        # prefilling, so phase 2 can batch uncached prompts of one
+        # length bucket into a single prefill program — one device sync
+        # for the whole admission wave instead of one per request.
+        admitted: List[tuple] = []  # (req, shared, pages, start)
+        # Prompt-page keys the CURRENT wave will register: a same-wave
+        # request sharing a prefix is deferred one step so it admits
+        # against the registered cache instead of recomputing (keeps the
+        # sequential path's dedup for shared-prefix bursts).
+        pending_keys: set = set()
         while self.waiting and free:
             req = self.waiting[0]
             L = len(req.prompt)
@@ -294,9 +304,8 @@ class LLMEngine:
                 if req.chain_keys is None:
                     req.chain_keys = PrefixCache.chain_hashes(
                         req.prompt, self.page_size, L // self.page_size)
-                # Match is capped one page short of covering the whole
-                # prompt: at least one token must be recomputed so its
-                # logits can seed sampling of the first generated token.
+                if req.chain_keys and req.chain_keys[0] in pending_keys:
+                    break  # defer: this wave is computing its prefix
                 matchable = max(0, (L - 1) // self.page_size)
                 shared = self.prefix_cache.match(
                     req.chain_keys[:matchable])
@@ -316,61 +325,101 @@ class LLMEngine:
             table = np.zeros(self.max_pages_per_seq, dtype=np.int32)
             table[:len(pages)] = pages
             self.block_tables[slot] = table
+            if self.prefix_cache is not None and req.chain_keys:
+                pending_keys.update(
+                    req.chain_keys[:L // self.page_size])
+            admitted.append((req, shared, pages,
+                             len(shared) * self.page_size))
 
-            # Prefill the uncached SUFFIX (B=1, length bucketed to limit
-            # compilations to one per power-of-two).
-            start = len(shared) * self.page_size
-            n_suffix = L - start
+        # Phase 2: prefill.  Uncached prompts (start == 0) batch by
+        # pow-2 suffix bucket; cache-hit suffixes keep the per-request
+        # chunked path (their table widths differ).
+        groups: Dict[int, List[tuple]] = {}
+        singles: List[tuple] = []
+        for item in admitted:
+            req, shared, pages, start = item
+            n_suffix = len(req.prompt) - start
             S = max(8, 1 << (n_suffix - 1).bit_length())
+            if start == 0:
+                groups.setdefault(S, []).append(item)
+            else:
+                singles.append((item, S))
+
+        for S, items in groups.items():
+            # Batch dim bucketed pow-2 (pad rows carry positions=-1, so
+            # their K/V writes drop) — one compile per (B, S) bucket.
+            B = 1 << (len(items) - 1).bit_length()
+            tokens = np.zeros((B, S), dtype=np.int32)
+            positions = np.full((B, S), -1, dtype=np.int32)
+            tables = np.zeros((B, self.max_pages_per_seq),
+                              dtype=np.int32)
+            for r, (req, _, _, _) in enumerate(items):
+                L = len(req.prompt)
+                tokens[r, :L] = req.prompt
+                positions[r, :L] = np.arange(L)
+                tables[r] = self.block_tables[req.slot]
+            logits, self.cache = prefill(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                self.cache, jnp.asarray(tables), self.config)
+            logits = np.asarray(logits)  # one sync for the whole group
+            for r, item in enumerate(items):
+                self._finish_admit(item, logits[r], done)
+
+        for (item, S) in singles:
+            req, shared, pages, start = item
+            L = len(req.prompt)
+            n_suffix = L - start
             tokens = np.zeros((1, S), dtype=np.int32)
             tokens[0, :n_suffix] = req.prompt[start:]
             positions = np.full((1, S), -1, dtype=np.int32)
             positions[0, :n_suffix] = np.arange(start, L)
-            if start == 0:
-                logits, self.cache = prefill(
-                    self.params, jnp.asarray(tokens),
-                    jnp.asarray(positions), self.cache,
-                    jnp.asarray(table[None]), self.config)
-            else:
-                # Chunked prefill gathers the WHOLE table width as
-                # attention context; bucket it to the pages this prompt
-                # actually spans (pow-2 for compile reuse) so a short
-                # cached prompt doesn't pay max_seq_len-wide attention.
-                W = min(self.max_pages_per_seq, max(1, 1 << (
-                    math.ceil(L / self.page_size) - 1).bit_length()))
-                logits, self.cache = prefill_with_context(
-                    self.params, jnp.asarray(tokens),
-                    jnp.asarray(positions), self.cache,
-                    jnp.asarray(table[:W][None]), self.config)
-
-            # Adopt ALL full prompt pages this request just computed into
-            # the cache (depth = page index; leaves evict first). A full
-            # prompt page never receives later writes — generation
-            # continues in the partial/next page — so it is immutable.
-            if self.prefix_cache is not None:
-                if shared:
-                    self.prefix_cache.hits += 1
-                    self.prefix_cache.tokens_saved += start
-                full = L // self.page_size
-                own = []
-                for i in range(len(shared), full):
-                    page = pages[i]
-                    if self.prefix_cache.register(req.chain_keys[i],
-                                                  page, i):
-                        req.cache_keys.append(req.chain_keys[i])
-                        own.append(page)
-                # Registered pages now belong to the cache, not the
-                # request's private set.
-                req.pages = [p for p in req.pages if p not in own]
-
-            next_tok = self._sample(np.asarray(logits)[0], req)
-            self.context_lens[slot] = L
-            self.last_tokens[slot] = next_tok
-            req.generated.append(int(next_tok))
-            fin = self._maybe_finish(req)
-            if fin is not None:  # e.g. max_new_tokens == 1
-                done[req.req_id] = fin
+            # Chunked prefill gathers the WHOLE table width as attention
+            # context; bucket it to the pages this prompt actually spans
+            # (pow-2 for compile reuse) so a short cached prompt doesn't
+            # pay max_seq_len-wide attention.
+            W = min(self.max_pages_per_seq, max(1, 1 << (
+                math.ceil(L / self.page_size) - 1).bit_length()))
+            table = self.block_tables[req.slot]
+            logits, self.cache = prefill_with_context(
+                self.params, jnp.asarray(tokens),
+                jnp.asarray(positions), self.cache,
+                jnp.asarray(table[:W][None]), self.config)
+            self._finish_admit(item, np.asarray(logits)[0], done)
         return done
+
+    def _finish_admit(self, item: tuple, logits_row: np.ndarray,
+                      done: Dict[int, List[int]]):
+        """Post-prefill bookkeeping for one admitted request: adopt its
+        full prompt pages into the prefix cache, sample the first token,
+        arm the decode slot."""
+        req, shared, pages, start = item
+        L = len(req.prompt)
+        # Adopt ALL full prompt pages this request just computed into
+        # the cache (depth = page index; leaves evict first). A full
+        # prompt page never receives later writes — generation
+        # continues in the partial/next page — so it is immutable.
+        if self.prefix_cache is not None:
+            if shared:
+                self.prefix_cache.hits += 1
+                self.prefix_cache.tokens_saved += start
+            full = L // self.page_size
+            own = []
+            for i in range(len(shared), full):
+                page = pages[i]
+                if self.prefix_cache.register(req.chain_keys[i], page, i):
+                    req.cache_keys.append(req.chain_keys[i])
+                    own.append(page)
+            # Registered pages now belong to the cache, not the
+            # request's private set.
+            req.pages = [p for p in req.pages if p not in own]
+
+        next_tok = self._sample(logits_row, req)
+        self.context_lens[req.slot] = L
+        self.last_tokens[req.slot] = next_tok
+        req.generated.append(int(next_tok))
+        fin = self._maybe_finish(req)
+        if fin is not None:  # e.g. max_new_tokens == 1
+            done[req.req_id] = fin
 
     def _draft_for(self, req: _Request, k: int) -> List[int]:
         """Prompt-lookup drafting (n-gram match): copy what followed the
