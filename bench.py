@@ -149,6 +149,12 @@ def main():
                     "RESOURCE_EXHAUSTED", "Out of memory",
                     "Ran out of memory", "exceeds the",
                     "remote_compile", "HTTP 500")):
+                # Full text to stderr: a genuine compiler/transport bug
+                # must stay visible, not be silently masked by walking
+                # down to a smaller config.
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
                 print(f"# {name} did not fit/compile; trying next config",
                       file=sys.stderr)
                 continue

@@ -125,6 +125,14 @@ class Reconciler:
                 if cloud is None or cloud.status == "TERMINATED":
                     self.im.transition(inst.instance_id,
                                        InstanceState.TERMINATED)
+                else:
+                    # A lost/failed terminate call would otherwise leave
+                    # the instance TERMINATING forever with the cloud
+                    # resource still running: re-issue (idempotent).
+                    try:
+                        self.provider.terminate(inst.cloud_id)
+                    except Exception:
+                        pass  # retried next tick
 
     # -- step 2: failure retry -----------------------------------------
     def _retry_failures(self):

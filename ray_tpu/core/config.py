@@ -30,6 +30,10 @@ class Config:
     # directory instead of a shared-memory segment (reference:
     # max_direct_call_object_size, ray_config_def.h).
     max_inline_object_size: int = 100 * 1024
+    # Owner-direct actor results at or below this size ride the direct
+    # actor connection back to the submitter (runtime.py); larger ones
+    # fall back to the shared-memory store via the head.
+    max_direct_result_bytes: int = 1024 * 1024
     # Shared-memory store capacity (bytes). 0 = unlimited (bounded by /dev/shm).
     object_store_memory: int = 0
     # Directory backing the shared-memory store.

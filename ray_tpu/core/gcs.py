@@ -243,6 +243,12 @@ class ControlServer:
         # re-created (reference lineage map, task_manager.h:208).
         self.lineage: Dict[str, str] = {}
         self.pending_tasks: List[TaskSpec] = []
+        # Objects some pending task waits on (ref args not yet READY):
+        # lets task_done wake the scheduler only when a completion's
+        # puts actually unblock someone (fast-redispatch keeps the
+        # no-deps burst path pass-free).  Stale entries merely cause an
+        # extra wake; pruned when the pending queue drains.
+        self._dep_waiters: set = set()
         self.pending_actors: List[ActorCreationSpec] = []
         # env_key -> runtime_env dict; workers fetch + apply their pool's
         # env at startup (runtime_env/plugin.py).
@@ -1105,6 +1111,13 @@ class ControlServer:
             if entry is not None:
                 entry.refcount += msg.get("n", 1)
 
+    def _op_incref_batch(self, conn, msg):
+        with self.lock:
+            for obj_hex in msg["objs"]:
+                entry = self.objects.get(obj_hex)
+                if entry is not None:
+                    entry.refcount += 1
+
     def _op_decref(self, conn, msg):
         to_delete = []
         with self.lock:
@@ -1250,16 +1263,32 @@ class ControlServer:
 
     # ------------------------------------------------------------------
     # Tasks
+    def _enqueue_task_locked(self, spec: TaskSpec, now: float):
+        for oid in spec.return_ids:
+            self.objects.setdefault(oid.hex(), ObjectEntry(
+                producing_task=spec.task_id.hex()))
+            self.lineage[oid.hex()] = spec.task_id.hex()
+        for arg in spec.args:
+            if arg.is_ref:
+                entry = self.objects.get(arg.object_hex)
+                if entry is None or entry.state == PENDING:
+                    self._dep_waiters.add(arg.object_hex)
+        self.tasks[spec.task_id.hex()] = TaskRecord(
+            spec=spec, submitted_at=now)
+        self.pending_tasks.append(spec)
+
     def _op_submit_task(self, conn, msg):
-        spec: TaskSpec = msg["spec"]
         with self.lock:
-            for oid in spec.return_ids:
-                self.objects.setdefault(oid.hex(), ObjectEntry(
-                    producing_task=spec.task_id.hex()))
-                self.lineage[oid.hex()] = spec.task_id.hex()
-            self.tasks[spec.task_id.hex()] = TaskRecord(
-                spec=spec, submitted_at=time.time())
-            self.pending_tasks.append(spec)
+            self._enqueue_task_locked(msg["spec"], time.time())
+        self._wake.set()
+
+    def _op_submit_task_batch(self, conn, msg):
+        """Coalesced submission (runtime.py _queue_for_flush): one frame
+        and one lock acquisition for a whole burst of tasks."""
+        now = time.time()
+        with self.lock:
+            for spec in msg["specs"]:
+                self._enqueue_task_locked(spec, now)
         self._wake.set()
 
     def _op_submit_named_task(self, conn, msg):
@@ -1353,16 +1382,89 @@ class ControlServer:
             if rec is not None:
                 rec.state = "FAILED" if msg.get("failed") else "FINISHED"
                 rec.finished_at = time.time()
+            claimed = None
+            need_wake = True
             if w is not None and w.kind == "pool":
                 w.state = "idle"
                 w.current_task = None
+                released = w.acquired
                 self._release(w)
+                # Fast redispatch: hand this worker the next compatible
+                # pending task WITHOUT a full scheduler pass (a 1k-task
+                # burst used to trigger 1k O(pending) rescans, one per
+                # completion).  Conservative: plain tasks only; anything
+                # with placement/strategy/PG falls back to the pass.
+                claimed = self._fast_claim_locked(w)
+                if claimed is not None:
+                    # The pass is still needed when this completion could
+                    # have unblocked anything BEYOND the claimed task:
+                    # leftover freed resources (shapes differ), a put
+                    # that made a dep-blocked task ready (which may need
+                    # a worker SPAWN, not just an idle worker), an idle
+                    # worker for it, or queued actors/PGs.
+                    if not self.pending_tasks:
+                        self._dep_waiters.clear()
+                    unblocked = any(
+                        p["obj"] in self._dep_waiters
+                        for p in msg.get("puts", ()))
+                    need_wake = bool(
+                        unblocked
+                        or released.to_dict()
+                        != ResourceSet(claimed.resources).to_dict()
+                        or self.pending_actors
+                        or any(pg.state == "PENDING"
+                               for pg in self.placement_groups.values())
+                        or any(x.kind == "pool" and x.state == "idle"
+                               and x.conn is not None
+                               for x in self.workers.values()))
             self._prune_lineage_locked()
         for obj_hex in msg.get("decrefs", ()):
             self._op_decref(conn, {"obj": obj_hex})
         if any(p.get("in_shm") for p in msg.get("puts", ())):
             self._maybe_spill()
-        self._wake.set()
+        if claimed is not None:
+            try:
+                w.conn.push({"op": "execute_task", "spec": claimed})
+            except Exception:
+                with self.lock:
+                    self._mark_worker_dead(w, "push failed")
+                need_wake = True
+        if need_wake:
+            self._wake.set()
+
+    def _fast_claim_locked(self, w) -> Optional[TaskSpec]:
+        """Lock held.  Pop the first plain pending task this idle worker
+        can run right now (deps ready, same env, resources fit its
+        node); None defers to the scheduling pass."""
+        node = self.nodes.get(w.node_id)
+        if node is None or not node.alive:
+            return None
+        pending = self.pending_tasks
+        for i in range(min(len(pending), 64)):
+            spec = pending[i]
+            if (spec.placement_group_hex
+                    or spec.scheduling_strategy is not None
+                    or not self._deps_ready(spec)):
+                continue
+            if self._env_key_for(spec.resources, spec.runtime_env) \
+                    != w.env_key:
+                continue
+            need = ResourceSet(spec.resources)
+            if not need.is_subset_of(node.available):
+                continue
+            del pending[i]
+            node.available = node.available.subtract(need)
+            w.acquired = need
+            w.charge = ("node", w.node_id)
+            w.state = "busy"
+            w.current_task = spec.task_id.hex()
+            rec = self.tasks.get(spec.task_id.hex())
+            if rec is not None:
+                rec.state = "RUNNING"
+                rec.worker_hex = w.worker_hex
+                rec.started_at = time.time()
+            return spec
+        return None
 
     # ------------------------------------------------------------------
     # Actors
@@ -2084,7 +2186,9 @@ class ControlServer:
             lowest = util(feasible[0])
             ties = [n for n in feasible if util(n) == lowest]
             tid = getattr(spec, "task_id", None) or spec.actor_id
-            node = ties[int(tid.hex()[:8], 16) % len(ties)]
+            # hash() (not a raw prefix slice): ids are counter-derived,
+            # so any fixed byte slice can alias mod len(ties).
+            node = ties[hash(tid.binary()) % len(ties)]
             return node.node_id, ("node", node.node_id)
         # hybrid default: pack onto the busiest node below the spread
         # threshold; above it, spread to the least utilized.
@@ -2428,7 +2532,16 @@ class ControlServer:
                 with self.lock:
                     self._proxy_cache = (obj_hex, data)
                 cached = (obj_hex, data)
-            return cached[1][msg["offset"]:msg["offset"] + msg["length"]]
+            part = cached[1][msg["offset"]:msg["offset"] + msg["length"]]
+            if msg["offset"] + msg["length"] >= msg["size"]:
+                # Final chunk served: drop the (potentially 100s-of-MB)
+                # payload instead of pinning it in head memory until the
+                # next proxy pull happens to evict it.
+                with self.lock:
+                    if getattr(self, "_proxy_cache", None) is not None \
+                            and self._proxy_cache[0] == obj_hex:
+                        self._proxy_cache = None
+            return part
         seg = self.store.attach(ObjectID.from_hex(obj_hex), msg["size"])
         off, n = msg["offset"], msg["length"]
         return bytes(seg.buf[off:off + n])

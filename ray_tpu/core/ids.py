@@ -16,8 +16,28 @@ import threading
 _ID_NBYTES = 14
 
 
+# Unique-ID generation: one urandom seed per (process, thread), then a
+# counter suffix — keeps from_random() syscall-free on the hot submission
+# paths (two IDs per task) while preserving global uniqueness.
+_rand_local = threading.local()
+
+
+def _next_unique() -> bytes:
+    st = _rand_local
+    try:
+        n = st.counter
+    except AttributeError:
+        st.suffix = os.urandom(_ID_NBYTES - 6)
+        st.counter = n = int.from_bytes(os.urandom(6), "little")
+    st.counter = (n + 1) & 0xFFFFFFFFFFFF
+    # Counter bytes FIRST: consumers hash id prefixes (e.g. the SPREAD
+    # tie-break), so the varying part must lead; the per-thread random
+    # suffix carries the uniqueness across processes/threads.
+    return n.to_bytes(6, "little") + st.suffix
+
+
 class BaseID:
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hex")
     _prefix = "id"
 
     def __init__(self, binary: bytes):
@@ -26,6 +46,7 @@ class BaseID:
                 f"{type(self).__name__} requires {_ID_NBYTES} bytes, got {binary!r}"
             )
         self._bytes = binary
+        self._hex = None
 
     @classmethod
     def nil(cls):
@@ -33,7 +54,7 @@ class BaseID:
 
     @classmethod
     def from_random(cls):
-        return cls(os.urandom(_ID_NBYTES))
+        return cls(_next_unique())
 
     @classmethod
     def from_hex(cls, hex_str: str):
@@ -43,7 +64,10 @@ class BaseID:
         return self._bytes
 
     def hex(self) -> str:
-        return self._bytes.hex()
+        h = self._hex
+        if h is None:
+            h = self._hex = self._bytes.hex()
+        return h
 
     def is_nil(self) -> bool:
         return self._bytes == b"\x00" * _ID_NBYTES

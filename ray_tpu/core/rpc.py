@@ -79,6 +79,12 @@ def pull_object_chunked(client: "Client", obj_hex: str, size: int,
                            timeout=timeout)
         if not part:
             raise RpcError(f"peer no longer serves object {obj_hex}")
+        if len(part) > n:
+            # An oversized reply must not silently grow the payload past
+            # the declared object size.
+            raise RpcError(
+                f"peer returned {len(part)} bytes for a {n}-byte chunk "
+                f"of object {obj_hex}")
         data[off:off + len(part)] = part
         off += len(part)
     return bytes(data)
@@ -348,6 +354,10 @@ class Client:
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self.address = address
         self._on_push = on_push
+        # Optional hook run before every synchronous call(): lets the
+        # core runtime flush coalesced one-way sends so request/response
+        # ops observe everything submitted before them (runtime.py).
+        self._pre_call: Optional[Callable[[], None]] = None
         self._send_lock = threading.Lock()
         self._pending: dict[int, threading.Event] = {}
         self._results: dict[int, Any] = {}
@@ -396,6 +406,8 @@ class Client:
     def call(self, msg: Any, timeout: Optional[float] = None) -> Any:
         if self._closed:
             raise RpcError(f"connection to {self.address} closed")
+        if self._pre_call is not None:
+            self._pre_call()
         with self._id_lock:
             req_id = self._next_id
             self._next_id += 1

@@ -103,6 +103,8 @@ class CoreClient:
         # tolerance): workers re-announce themselves here.
         self.on_reconnect = None
         self.control_addr = control_addr
+        # Must exist before the client's first call() fires _pre_call.
+        self._pending_count = 0
         self._register_msg = {
             "op": "register",
             "worker_hex": worker_hex,
@@ -114,6 +116,7 @@ class CoreClient:
         }
         self.client = rpc.Client(control_addr, on_push=self._on_push,
                                  on_disconnect=self._on_control_disconnect)
+        self.client._pre_call = self._flush_if_pending
         reply = self.client.call(self._register_msg)
         self.session_id = reply["session_id"]
         self.session_dir = reply["session_dir"]
@@ -131,6 +134,28 @@ class CoreClient:
         self._tls = threading.local()
         self._object_futures: Dict[str, Future] = {}
         self._subscribed: set[str] = set()
+        # Owner-direct actor results (the control plane is OFF the actor
+        # hot path — reference direct_actor_task_submitter.cc): futures
+        # resolved by pushes on the direct actor connection, never
+        # registered with the head unless the ref escapes this process.
+        self._direct_futures: Dict[str, Future] = {}
+        self._direct_inflight: Dict[str, set] = {}  # actor_hex -> obj hexes
+        self._direct_actor_of: Dict[str, str] = {}  # obj hex -> actor_hex
+        # Direct refs that escaped (were serialized into another task /
+        # put) before or after resolving: the head got a registration and
+        # must receive the value once it lands (ownership promotion).
+        self._direct_promoted: set[str] = set()
+        # Submit-side coalescing: actor-task sends queue per address and
+        # flush as ONE actor_task_batch frame at the next get()/wait()
+        # (or a 2 ms timer / 64-spec cap for fire-and-forget callers).
+        # On a contended host this amortizes the per-call syscall +
+        # wakeup cost across the burst — the reference gets the same
+        # effect from gRPC stream batching.
+        self._send_lock = threading.Lock()
+        self._pending_direct: Dict[str, List[TaskSpec]] = {}
+        self._pending_submits: List[TaskSpec] = []
+        self._flush_ev = threading.Event()
+        self._flusher_started = False
         # actor state tracking
         self._actor_state: Dict[str, dict] = {}
         self._actor_cv = threading.Condition()
@@ -206,6 +231,7 @@ class CoreClient:
                 delay = min(delay * 1.7, 2.0)
                 continue
             client._on_disconnect = self._on_control_disconnect
+            client._pre_call = self._flush_if_pending
             if client._closed:
                 # Dropped between resync and adoption: the callback we
                 # just attached never fires for that earlier loss.
@@ -213,6 +239,10 @@ class CoreClient:
                 time.sleep(delay)
                 continue
             self.client = client
+            # Anything stranded by a mid-outage flush failure goes out
+            # now that a live connection exists.
+            if self._pending_count:
+                self._flush_ev.set()
             cb = self.on_reconnect
             if cb is not None:
                 try:
@@ -288,6 +318,185 @@ class CoreClient:
             self._flush_actor_queue(actor_hex, msg["address"])
         elif msg["state"] == "DEAD":
             self._fail_actor_queue(actor_hex, msg.get("reason", ""))
+            self._fail_direct_inflight(actor_hex, msg.get("reason", ""))
+        elif msg["state"] == "RESTARTING":
+            # Tasks already DELIVERED to the dead instance are lost (the
+            # restarted instance never sees them); queued ones re-flush
+            # on ALIVE.  Mirrors the head's _fail_actor_inflight for the
+            # registered (non-direct) path.
+            self._fail_direct_inflight(
+                actor_hex, msg.get("reason", "actor restarting"))
+
+    # ------------------------------------------------------------------
+    # Owner-direct actor results: the result of a plain (1-return,
+    # non-streaming) actor call is pushed straight back on the direct
+    # actor connection; the head is not involved unless the ref escapes
+    # this process (promotion) or the result is too large for the wire.
+    def _register_direct(self, obj_hex: str, actor_hex: str) -> Future:
+        fut = Future()
+        with self._lock:
+            self._direct_futures[obj_hex] = fut
+            self._direct_actor_of[obj_hex] = actor_hex
+        return fut
+
+    def _mark_direct_delivered(self, spec):
+        """The spec was actually sent to a live instance: its results are
+        now at risk of that instance's death."""
+        if not getattr(spec, "direct", False):
+            return
+        actor_hex = spec.actor_id.hex()
+        with self._lock:
+            for oid in spec.return_ids:
+                if oid.hex() in self._direct_futures:
+                    self._direct_inflight.setdefault(
+                        actor_hex, set()).add(oid.hex())
+
+    def _on_direct_push(self, msg: dict):
+        op = msg.get("op")
+        if op == "direct_result":
+            self._resolve_direct(
+                msg["obj"], {"direct": True, "data": msg["data"],
+                             "is_error": msg.get("is_error", False)})
+        elif op == "direct_result_batch":
+            results = msg["results"]
+            promoted = []
+            with self._lock:
+                resolved = []
+                for obj_hex, data, is_error in results:
+                    fut = self._direct_futures.get(obj_hex)
+                    actor_hex = self._direct_actor_of.get(obj_hex, "")
+                    self._direct_inflight.get(
+                        actor_hex, set()).discard(obj_hex)
+                    if obj_hex in self._direct_promoted:
+                        promoted.append((obj_hex, data, is_error))
+                    resolved.append((fut, data, is_error))
+            for obj_hex, data, is_error in promoted:
+                try:
+                    self.client.send({
+                        "op": "put_object", "obj": obj_hex,
+                        "size": len(data), "inline": bytes(data),
+                        "is_error": is_error})
+                except Exception:
+                    pass
+            for fut, data, is_error in resolved:
+                if fut is not None and not fut.done():
+                    fut.set_result({"direct": True, "data": data,
+                                    "is_error": is_error})
+        elif op == "direct_result_remote":
+            # Result was too large for the wire: the worker stored it via
+            # the head (shm path); chain the head subscription into the
+            # local direct future.
+            obj_hex = msg["obj"]
+            with self._lock:
+                # The head now holds an entry (refcount 1 from the
+                # worker's put): mark it head-known so this ref's
+                # deletion sends the decref — otherwise every oversized
+                # direct result would pin head memory forever.
+                self._direct_promoted.add(obj_hex)
+                fut = self._direct_futures.get(obj_hex)
+                head_fut = self._object_futures.get(obj_hex)
+                if head_fut is None:
+                    head_fut = Future()
+                    self._object_futures[obj_hex] = head_fut
+                if obj_hex not in self._subscribed:
+                    self._subscribed.add(obj_hex)
+                    self.client.send({"op": "subscribe_objects",
+                                      "objs": [obj_hex]})
+            if fut is None:
+                return
+
+            def _chain(hf, fut=fut, obj_hex=obj_hex):
+                with self._lock:
+                    self._direct_inflight.get(
+                        self._direct_actor_of.get(obj_hex, ""),
+                        set()).discard(obj_hex)
+                if fut.done():
+                    return
+                try:
+                    fut.set_result(hf.result())
+                except BaseException as e:  # noqa: BLE001
+                    fut.set_exception(e)
+
+            head_fut.add_done_callback(_chain)
+
+    def _resolve_direct(self, obj_hex: str, info: dict):
+        with self._lock:
+            fut = self._direct_futures.get(obj_hex)
+            actor_hex = self._direct_actor_of.get(obj_hex, "")
+            self._direct_inflight.get(actor_hex, set()).discard(obj_hex)
+            promoted = obj_hex in self._direct_promoted
+        if promoted:
+            # The ref escaped before the value landed: forward the bytes
+            # to the head so remote holders resolve.
+            try:
+                self.client.send({
+                    "op": "put_object", "obj": obj_hex,
+                    "size": len(info["data"]), "inline": bytes(info["data"]),
+                    "is_error": info.get("is_error", False)})
+            except Exception:
+                pass
+        if fut is not None and not fut.done():
+            fut.set_result(info)
+
+    def _fail_direct(self, obj_hex: str, err: Exception):
+        from ray_tpu.core import serialization
+
+        with self._lock:
+            fut = self._direct_futures.get(obj_hex)
+            actor_hex = self._direct_actor_of.get(obj_hex, "")
+            self._direct_inflight.get(actor_hex, set()).discard(obj_hex)
+            promoted = obj_hex in self._direct_promoted
+        if fut is not None and fut.done():
+            # Already resolved (result raced the failure notification):
+            # a stale inflight entry must NOT overwrite the delivered —
+            # possibly promoted — value with an actor-died error.
+            return
+        data = serialization.serialize(err).to_bytes()
+        if promoted:
+            try:
+                self.client.send({
+                    "op": "put_object", "obj": obj_hex, "size": len(data),
+                    "inline": data, "is_error": True})
+            except Exception:
+                pass
+        if fut is not None and not fut.done():
+            fut.set_result({"direct": True, "data": data,
+                            "is_error": True})
+
+    def _fail_direct_inflight(self, actor_hex: str, reason: str):
+        with self._lock:
+            pending = list(self._direct_inflight.pop(actor_hex, ()))
+        if not pending:
+            return
+        err = ActorDiedError(actor_hex, reason or "actor died")
+        for obj_hex in pending:
+            self._fail_direct(obj_hex, err)
+
+    def _maybe_promote_direct(self, obj_hex: str):
+        """The ref is escaping this process (serialized into a task arg /
+        put): make it resolvable via the head.  Resolved → forward the
+        bytes now; pending → register (tied to its actor so actor death
+        fails remote waiters too) and forward on arrival."""
+        with self._lock:
+            fut = self._direct_futures.get(obj_hex)
+            if fut is None or obj_hex in self._direct_promoted:
+                return
+            self._direct_promoted.add(obj_hex)
+            actor_hex = self._direct_actor_of.get(obj_hex, "")
+        self.client.send({"op": "register_objects", "objs": [obj_hex],
+                          "actor": actor_hex})
+        if fut.done():
+            info = fut.result()
+            if info.get("direct"):
+                try:
+                    self.client.send({
+                        "op": "put_object", "obj": obj_hex,
+                        "size": len(info["data"]),
+                        "inline": bytes(info["data"]),
+                        "is_error": info.get("is_error", False)})
+                except Exception:
+                    pass
+        # pending: _resolve_direct / _fail_direct forwards on arrival
 
     # ------------------------------------------------------------------
     # Objects
@@ -296,11 +505,18 @@ class CoreClient:
 
     def object_futures(self, obj_hexes: Sequence[str]) -> List[Future]:
         """Batch variant: ONE subscribe message for all new hexes (a
-        get() of N refs used to cost N control messages)."""
+        get() of N refs used to cost N control messages).  Owner-direct
+        actor results resolve from local futures — no head subscribe."""
+        if self._pending_count:
+            self._flush_direct_sends()
         futs: List[Future] = []
         new: List[str] = []
         with self._lock:
             for obj_hex in obj_hexes:
+                fut = self._direct_futures.get(obj_hex)
+                if fut is not None:
+                    futs.append(fut)
+                    continue
                 fut = self._object_futures.get(obj_hex)
                 if fut is None:
                     fut = Future()
@@ -316,6 +532,10 @@ class CoreClient:
     def _load_object(self, obj_hex: str, info: dict,
                      timeout: Optional[float] = None,
                      _retried: bool = False) -> Any:
+        if info.get("direct"):
+            # Owner-direct actor result: the serialized bytes arrived on
+            # the direct actor connection (never touched the head).
+            return self._finish_load(obj_hex, info["data"], info)
         if info.get("inline") is not None:
             data = info["inline"]
         elif info.get("in_shm"):
@@ -384,7 +604,23 @@ class CoreClient:
         return self._finish_load(obj_hex, data, info)
 
     def _finish_load(self, obj_hex: str, data, info: dict) -> Any:
-        value = serialization.deserialize(data, ref_deserializer=self._on_ref_deser)
+        # Collect borrow increfs for every ref inside the value into ONE
+        # control message (a get() of an object holding 10k refs used to
+        # cost 10k sends).
+        self._tls.incref_buf = buf = []
+        try:
+            value = serialization.deserialize(
+                data, ref_deserializer=self._on_ref_deser)
+        finally:
+            self._tls.incref_buf = None
+            # Send whatever was buffered even if deserialize raised
+            # partway: the already-constructed refs will decref on GC,
+            # and uncovered increfs would underflow head refcounts.
+            if buf:
+                try:
+                    self.client.send({"op": "incref_batch", "objs": buf})
+                except Exception:
+                    pass
         if info.get("is_error"):
             raise value
         return value
@@ -457,6 +693,10 @@ class CoreClient:
         # A ref arrived inside a deserialized value: register a borrow so the
         # owner keeps the object alive while this process holds the ref
         # (reference borrowing protocol, reference_count.h).
+        buf = getattr(self._tls, "incref_buf", None)
+        if buf is not None:
+            buf.append(ref.hex())
+            return
         try:
             self.client.send({"op": "incref", "obj": ref.hex()})
         except Exception:
@@ -485,8 +725,19 @@ class CoreClient:
         self._store_value(oid, value)
         return ObjectRef(oid, owner=self.worker_hex)
 
-    def _store_value(self, oid: ObjectID, value: Any, is_error: bool = False):
+    def _serialize_for_ship(self, value: Any):
+        """Serialize a value that is leaving this process, promoting any
+        direct-owned refs it contains so remote holders can resolve them."""
         ser = serialization.serialize(value)
+        for hex_id in ser.contained_refs:
+            self._maybe_promote_direct(hex_id)
+        return ser
+
+    def _store_value(self, oid: ObjectID, value: Any, is_error: bool = False):
+        ser = self._serialize_for_ship(value)
+        return self._store_serialized(oid, ser, is_error=is_error)
+
+    def _store_serialized(self, oid: ObjectID, ser, is_error: bool = False):
         size = ser.total_bytes
         # Thin clients ship everything inline over the connection (bounded
         # only by the rpc frame limit); full clients inline small objects
@@ -532,13 +783,35 @@ class CoreClient:
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None):
+        if num_returns == 1:
+            # Fast path for the wait-one polling idiom: one O(n) scan of
+            # already-registered futures, no dict building.
+            if self._pending_count:
+                self._flush_direct_sends()
+            with self._lock:
+                for i, r in enumerate(refs):
+                    h = r.hex()
+                    fut = self._direct_futures.get(h) or \
+                        self._object_futures.get(h)
+                    if fut is not None and fut.done():
+                        return [r], [x for j, x in enumerate(refs)
+                                     if j != i]
         futs = dict(zip(refs, self.object_futures(
             [r.hex() for r in refs])))
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: List[ObjectRef] = []
+        pending = dict(futs)
+        # Fast path: harvest already-done futures without registering
+        # waiters — a wait() loop popping one ref at a time off 1k refs
+        # used to cost O(n^2) waiter registrations in cf.wait.
+        for r in list(pending):
+            if pending[r].done():
+                ready.append(r)
+                del pending[r]
+                if len(ready) >= num_returns:
+                    break
         import concurrent.futures as cf
 
-        pending = dict(futs)
         while len(ready) < num_returns and pending:
             remaining = None if deadline is None else max(
                 0.0, deadline - time.monotonic())
@@ -561,8 +834,23 @@ class CoreClient:
     def on_ref_deleted(self, object_id: ObjectID):
         if self._closed:
             return
+        if self._pending_count:
+            # A queued submit must register its return objects before
+            # any decref for them reaches the head.
+            self._flush_direct_sends()
+        obj_hex = object_id.hex()
+        with self._lock:
+            if obj_hex in self._direct_futures:
+                self._direct_futures.pop(obj_hex, None)
+                actor_hex = self._direct_actor_of.pop(obj_hex, "")
+                self._direct_inflight.get(actor_hex, set()).discard(obj_hex)
+                # Never promoted → the head has no entry: purely local
+                # cleanup, zero control messages for the whole call.
+                if obj_hex not in self._direct_promoted:
+                    return
+                self._direct_promoted.discard(obj_hex)
         try:
-            self.client.send({"op": "decref", "obj": object_id.hex()})
+            self.client.send({"op": "decref", "obj": obj_hex})
         except Exception:
             pass
 
@@ -572,14 +860,19 @@ class CoreClient:
         out: List[TaskArg] = []
         for a in args:
             if isinstance(a, ObjectRef):
+                self._maybe_promote_direct(a.hex())
                 borrows.append(a.hex())
-                self.client.send({"op": "incref", "obj": a.hex()})
+                # Queued (not sent): the submit that registered this ref
+                # may itself still be in the flush queue — the incref
+                # must reach the head AFTER it or it no-ops.
+                self._queue_for_flush("incref", None, a.hex())
                 out.append(TaskArg(is_ref=True, object_hex=a.hex()))
             else:
                 ser = serialization.serialize(a)
                 for hex_id in ser.contained_refs:
+                    self._maybe_promote_direct(hex_id)
                     borrows.append(hex_id)
-                    self.client.send({"op": "incref", "obj": hex_id})
+                    self._queue_for_flush("incref", None, hex_id)
                 if ser.total_bytes > self.config.max_inline_object_size:
                     ref = self.put(a)
                     borrows.append(ref.hex())
@@ -662,7 +955,7 @@ class CoreClient:
             borrows=borrows,
             is_streaming=streaming,
         )
-        self.client.send({"op": "submit_task", "spec": spec})
+        self._queue_for_flush("submit", None, spec)
         if streaming:
             return ObjectRefGenerator(spec.task_id)
         return [ObjectRef(oid, owner=self.worker_hex) for oid in return_ids]
@@ -745,15 +1038,23 @@ class CoreClient:
         task_id = TaskID.from_random()
         return_ids = [] if streaming else [
             ObjectID.from_random() for _ in range(num_returns)]
-        # Register returns under the actor so its death fails waiters;
-        # for streams that role falls to the end-of-stream object.
-        reg = [stream_eos_id(task_id).hex()] if streaming else \
-            [oid.hex() for oid in return_ids]
-        self.client.send({
-            "op": "register_objects",
-            "objs": reg,
-            "actor": actor_hex,
-        })
+        # Plain single-return calls take the owner-direct path: the
+        # result rides the direct actor connection back and the head
+        # never sees the call (reference: direct actor transport — GCS
+        # uninvolved — plus the in-process store for small returns).
+        direct = not streaming and num_returns == 1
+        if direct:
+            self._register_direct(return_ids[0].hex(), actor_hex)
+        else:
+            # Register returns under the actor so its death fails
+            # waiters; for streams that role falls to the EOS object.
+            reg = [stream_eos_id(task_id).hex()] if streaming else \
+                [oid.hex() for oid in return_ids]
+            self.client.send({
+                "op": "register_objects",
+                "objs": reg,
+                "actor": actor_hex,
+            })
         spec = TaskSpec(
             task_id=task_id,
             func_id="", func_blob=None,
@@ -767,6 +1068,7 @@ class CoreClient:
             name=name or method_name,
             borrows=borrows,
             is_streaming=streaming,
+            direct=direct,
         )
         self._route_actor_task(actor_hex, spec)
         if streaming:
@@ -791,16 +1093,128 @@ class CoreClient:
     def _actor_conn(self, address: str) -> rpc.Client:
         with self._lock:
             conn = self._actor_conns.get(address)
-            if conn is None:
-                conn = rpc.Client(address)
-                self._actor_conns[address] = conn
-            return conn
+            if conn is not None:
+                return conn
+        # Dial outside the lock; on_push carries owner-direct results.
+        conn = rpc.Client(address, on_push=self._on_direct_push)
+        with self._lock:
+            existing = self._actor_conns.get(address)
+            if existing is not None:
+                conn.close()
+                return existing
+            self._actor_conns[address] = conn
+        return conn
 
     def _send_actor_task(self, actor_hex: str, address: str, spec: TaskSpec):
-        try:
-            self._actor_conn(address).send({"op": "actor_task", "spec": spec})
-        except Exception as e:  # connection refused: actor just died
-            self._fail_actor_task(spec, f"cannot reach actor: {e}")
+        # One persistent flusher per client (not a timer per burst:
+        # thread spawns cost more than the flush).  It is the
+        # fire-and-forget safety net; the common case is the submitting
+        # thread flushing at its next get()/wait().
+        self._queue_for_flush("direct", address, spec)
+
+    def _flush_if_pending(self):
+        if self._pending_count:
+            self._flush_direct_sends()
+
+    def _send_flusher(self):
+        while not self._closed:
+            self._flush_ev.wait()
+            self._flush_ev.clear()
+            time.sleep(0.002)
+            try:
+                self._flush_direct_sends()
+            except Exception:
+                # The flusher is the fire-and-forget safety net; it must
+                # survive transient send failures (head restart window).
+                time.sleep(0.05)
+
+    def _queue_for_flush(self, kind: str, key, item):
+        """Shared enqueue for coalesced control sends (actor tasks, head
+        submits, and borrow increfs — increfs must stay ORDERED after the
+        submits that register their objects); flushed by get()/wait(),
+        the 64-item cap, or the 2 ms flusher."""
+        with self._send_lock:
+            if kind == "direct":
+                self._pending_direct.setdefault(key, []).append(item)
+            else:
+                self._pending_submits.append((kind, item))
+            self._pending_count += 1
+            count = self._pending_count
+            if not self._flusher_started:
+                self._flusher_started = True
+                threading.Thread(target=self._send_flusher,
+                                 name="direct-send-flush",
+                                 daemon=True).start()
+        if count >= 64:
+            self._flush_direct_sends()
+        else:
+            self._flush_ev.set()
+
+    def _flush_direct_sends(self):
+        with self._send_lock:
+            if self._pending_count == 0:
+                return
+            pending, self._pending_direct = self._pending_direct, {}
+            submits, self._pending_submits = self._pending_submits, []
+            self._pending_count = 0
+        if submits:
+            sent_upto = 0
+            try:
+                for end, msg in self._head_frames(submits):
+                    self.client.send(msg)
+                    sent_upto = end
+            except Exception:
+                # Head connection down mid-flush (restart window): put
+                # back ONLY the unsent tail (re-queuing sent frames
+                # would double-execute tasks) and arm the flusher so
+                # the retry happens even if no further get()/call()
+                # ever fires.
+                rest = submits[sent_upto:]
+                if rest:
+                    with self._send_lock:
+                        self._pending_submits = rest + self._pending_submits
+                        self._pending_count += len(rest)
+                    self._flush_ev.set()
+        for address, specs in pending.items():
+            try:
+                conn = self._actor_conn(address)
+                # Mark delivered BEFORE the send: a fast direct_result
+                # reply must find the inflight entry already present
+                # (resolving discards it; marking after the send could
+                # re-add an already-resolved object).
+                for spec in specs:
+                    self._mark_direct_delivered(spec)
+                if len(specs) == 1:
+                    conn.send({"op": "actor_task", "spec": specs[0]})
+                else:
+                    conn.send({"op": "actor_task_batch", "specs": specs})
+            except Exception as e:  # connection refused: actor just died
+                for spec in specs:
+                    self._fail_actor_task(spec, f"cannot reach actor: {e}")
+
+    @staticmethod
+    def _head_frames(items):
+        """Yield (end_index, frame_msg) for queued head messages,
+        preserving enqueue order: runs of consecutive submits collapse
+        into submit_task_batch frames, runs of increfs into
+        incref_batch frames."""
+        i, n = 0, len(items)
+        while i < n:
+            kind = items[i][0]
+            j = i
+            while j < n and items[j][0] == kind:
+                j += 1
+            run = [it for _, it in items[i:j]]
+            if kind == "submit":
+                msg = {"op": "submit_task", "spec": run[0]} \
+                    if len(run) == 1 else \
+                    {"op": "submit_task_batch", "specs": run}
+            else:  # incref
+                msg = {"op": "incref", "obj": run[0]} \
+                    if len(run) == 1 else \
+                    {"op": "incref_batch", "objs": run}
+            yield j, msg
+            i = j
 
     def _flush_actor_queue(self, actor_hex: str, address: str):
         with self._actor_cv:
@@ -825,10 +1239,15 @@ class CoreClient:
             self._store_value(stream_eos_id(spec.task_id), err,
                               is_error=True)
             return
+        if getattr(spec, "direct", False):
+            for oid in spec.return_ids:
+                self._fail_direct(oid.hex(), err)
+            return
         for oid in spec.return_ids:
             self._store_value(oid, err, is_error=True)
 
     def kill_actor(self, actor_hex: str, no_restart: bool = True):
+        self._flush_direct_sends()  # queued calls precede the kill
         self.client.send({"op": "kill_actor", "actor": actor_hex,
                           "no_restart": no_restart})
 
@@ -838,6 +1257,10 @@ class CoreClient:
 
     # ------------------------------------------------------------------
     def close(self):
+        try:
+            self._flush_direct_sends()
+        except Exception:
+            pass
         self._closed = True
         for conn in self._actor_conns.values():
             conn.close()

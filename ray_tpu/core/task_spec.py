@@ -24,6 +24,19 @@ class TaskArg:
     # for inline values: raw serialized bytes (serialization.py layout)
     data: bytes = b""
 
+    # Compact wire form: the control plane ships thousands of these per
+    # second; tuple-reduce is ~5x faster than dataclass state pickling.
+    def __reduce__(self):
+        return (_mk_arg, (self.is_ref, self.object_hex, self.data))
+
+
+def _mk_arg(is_ref, object_hex, data):
+    a = TaskArg.__new__(TaskArg)
+    a.is_ref = is_ref
+    a.object_hex = object_hex
+    a.data = data
+    return a
+
 
 @dataclass
 class TaskSpec:
@@ -45,6 +58,12 @@ class TaskSpec:
     # streaming-generator task (core/streaming.py): item objects are
     # derived from the task id instead of pre-registered return_ids
     is_streaming: bool = False
+    # owner-direct actor task (runtime.py submit_actor_task): the result
+    # is pushed straight back to the submitter over the direct actor
+    # connection — the control server never sees the call (reference:
+    # the direct actor transport + in-process store for small returns,
+    # transport/direct_actor_task_submitter.cc)
+    direct: bool = False
     # placement
     placement_group_hex: str = ""
     bundle_index: int = -1
@@ -54,6 +73,51 @@ class TaskSpec:
     # (top-level ref args + refs captured inside inline args); the executor
     # decrefs them after the task finishes.
     borrows: List[str] = field(default_factory=list)
+
+    # Hot-path wire form (submit/actor_task ride this thousands of times
+    # per second): IDs travel as raw bytes, fields as a flat tuple.
+    # ~5x faster than the default dataclass pickling on both ends.
+    def __reduce__(self):
+        return (_mk_spec, (
+            self.task_id.binary() if self.task_id is not None else None,
+            self.func_id, self.func_blob, self.args, self.num_returns,
+            [o.binary() for o in self.return_ids], self.resources,
+            self.max_retries, self.retry_count, self.name, self.owner,
+            self.actor_id.binary() if self.actor_id is not None else None,
+            self.method_name, self.seq_no, self.is_streaming,
+            self.placement_group_hex, self.bundle_index,
+            self.scheduling_strategy, self.runtime_env, self.borrows,
+            self.direct))
+
+
+def _mk_spec(task_id, func_id, func_blob, args, num_returns, return_ids,
+             resources, max_retries, retry_count, name, owner, actor_id,
+             method_name, seq_no, is_streaming, placement_group_hex,
+             bundle_index, scheduling_strategy, runtime_env, borrows,
+             direct):
+    s = TaskSpec.__new__(TaskSpec)
+    s.task_id = TaskID(task_id) if task_id is not None else None
+    s.func_id = func_id
+    s.func_blob = func_blob
+    s.args = args
+    s.num_returns = num_returns
+    s.return_ids = [ObjectID(b) for b in return_ids]
+    s.resources = resources
+    s.max_retries = max_retries
+    s.retry_count = retry_count
+    s.name = name
+    s.owner = owner
+    s.actor_id = ActorID(actor_id) if actor_id is not None else None
+    s.method_name = method_name
+    s.seq_no = seq_no
+    s.is_streaming = is_streaming
+    s.placement_group_hex = placement_group_hex
+    s.bundle_index = bundle_index
+    s.scheduling_strategy = scheduling_strategy
+    s.runtime_env = runtime_env
+    s.borrows = borrows
+    s.direct = direct
+    return s
 
 
 class KwargsMarker:

@@ -21,6 +21,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 from typing import Any, List, Optional
 
@@ -71,6 +72,12 @@ class WorkerRuntime:
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
         self._exec_pool: Optional[Any] = None
         self._aio_lock = threading.Lock()
+        # Direct-result coalescing (see _push_direct_result).
+        self._res_lock = threading.Lock()
+        self._res_buf: dict = {}
+        self._res_flush_ev = threading.Event()
+        threading.Thread(target=self._result_flusher,
+                         name="direct-result-flush", daemon=True).start()
         # Per-thread currently-executing spec (runtime_context.py).
         self._cur_tls = threading.local()
         self.is_initialized = True
@@ -169,7 +176,17 @@ class WorkerRuntime:
     def _handle_direct(self, conn, msg):
         op = msg.get("op")
         if op == "actor_task":
-            self._task_queue.put(msg["spec"])
+            spec = msg["spec"]
+            # Owner-direct path: remember which connection the call came
+            # in on so the result can be pushed straight back to the
+            # submitter (no head involvement) — see _store_returns.
+            spec._arrival_conn = conn
+            self._task_queue.put(spec)
+            return None
+        if op == "actor_task_batch":
+            for spec in msg["specs"]:
+                spec._arrival_conn = conn
+                self._task_queue.put(spec)
             return None
         if op == "ping":
             return "pong"
@@ -256,6 +273,9 @@ class WorkerRuntime:
         if spec.is_streaming:
             self._store_streaming_returns(spec, value, failed)
             return
+        if getattr(spec, "direct", False) and \
+                self._store_direct_return(spec, value, failed):
+            return
         if failed:
             self._store_error(spec, value)
             return
@@ -279,6 +299,99 @@ class WorkerRuntime:
                 self.core._store_value(oid, v)
             except BaseException as e:  # noqa: BLE001 serialization failure
                 self._store_error(spec, TaskError(spec.name, e))
+
+    def _store_direct_return(self, spec: TaskSpec, value: Any,
+                             failed: bool) -> bool:
+        """Push an owner-direct actor result back over the connection the
+        task arrived on (reference: direct actor transport replies
+        peer-to-peer; the GCS never sees the call).  Returns False to
+        fall back to the head path (no arrival conn, e.g. a queued spec
+        replayed through an exotic route).  Oversized results go to the
+        head store and the owner gets a 'see head' marker instead."""
+        conn = getattr(spec, "_arrival_conn", None)
+        if conn is None or not spec.return_ids:
+            return False
+        obj_hex = spec.return_ids[0].hex()
+        try:
+            ser = self.core._serialize_for_ship(value)
+        except BaseException as e:  # noqa: BLE001 unpicklable result
+            err = TaskError(spec.name or spec.method_name, e) \
+                if not failed else value
+            try:
+                ser = self.core._serialize_for_ship(err)
+            except BaseException:
+                fallback = TaskError(
+                    spec.name or spec.method_name, None,
+                    tb=getattr(err, "traceback_str", None) or str(err))
+                fallback.cause = None
+                ser = self.core._serialize_for_ship(fallback)
+            failed = True
+        size = ser.total_bytes
+        if size > self.core.config.max_direct_result_bytes:
+            # Large result: store via head (shm) and point the owner at it.
+            self.core._store_serialized(spec.return_ids[0], ser,
+                                        is_error=failed)
+            try:
+                conn.push({"op": "direct_result_remote", "obj": obj_hex})
+            except Exception:
+                pass  # owner gone; the head copy ages out via refcount
+            return True
+        self._push_direct_result(conn, obj_hex, ser.to_bytes(), failed)
+        return True
+
+    def _push_direct_result(self, conn, obj_hex: str, data: bytes,
+                            is_error: bool):
+        """Coalesce back-to-back results into one direct_result_batch
+        push: with more calls already queued, buffer; the buffer flushes
+        when the queue drains, at 64 results, or after 1 ms (flusher
+        thread) — whichever first.  A lone result pushes immediately, so
+        sync callers see no added latency."""
+        with self._res_lock:
+            buffered = self._res_buf.get(id(conn))
+            if buffered is None and self._task_queue.empty():
+                buffered = False  # immediate path
+            else:
+                if buffered is None:
+                    buffered = self._res_buf[id(conn)] = (conn, [])
+                buffered[1].append((obj_hex, data, is_error))
+                n = len(buffered[1])
+        if buffered is False:
+            try:
+                conn.push({"op": "direct_result", "obj": obj_hex,
+                           "data": data, "is_error": is_error})
+            except Exception:
+                pass  # owner disconnected: nobody is waiting
+            return
+        if n >= 64 or self._task_queue.empty():
+            self._flush_direct_results()
+        else:
+            self._res_flush_ev.set()
+
+    def _flush_direct_results(self):
+        with self._res_lock:
+            if not self._res_buf:
+                return
+            bufs, self._res_buf = self._res_buf, {}
+        for conn, results in bufs.values():
+            try:
+                if len(results) == 1:
+                    obj_hex, data, is_error = results[0]
+                    conn.push({"op": "direct_result", "obj": obj_hex,
+                               "data": data, "is_error": is_error})
+                else:
+                    conn.push({"op": "direct_result_batch",
+                               "results": results})
+            except Exception:
+                pass  # owner disconnected
+
+    def _result_flusher(self):
+        """Bounds the buffering delay: a burst followed by a slow task
+        must not park finished results behind it."""
+        while not self._exit_ev.is_set():
+            self._res_flush_ev.wait()
+            self._res_flush_ev.clear()
+            time.sleep(0.001)
+            self._flush_direct_results()
 
     def _finish(self, spec: TaskSpec, failed: bool,
                 puts: Optional[List[dict]] = None):
@@ -345,11 +458,29 @@ class WorkerRuntime:
         return getattr(self._cur_tls, "spec", None)
 
     def _on_execute_task(self, spec: TaskSpec):
-        # pool tasks: one at a time, run on a dedicated thread so the rpc
-        # receive thread stays responsive
-        threading.Thread(
-            target=self._execute, args=(spec,), name="task-exec", daemon=True
-        ).start()
+        # pool tasks: one at a time on a PERSISTENT executor thread (a
+        # thread spawn per task costs ~100 us — the dominant per-task
+        # overhead at small-task rates); the rpc receive thread stays
+        # responsive because it only enqueues.
+        q = getattr(self, "_pool_queue", None)
+        if q is None:
+            with self._aio_lock:
+                q = getattr(self, "_pool_queue", None)
+                if q is None:
+                    q = queue.Queue()
+                    threading.Thread(target=self._pool_exec_loop,
+                                     args=(q,), name="task-exec",
+                                     daemon=True).start()
+                    self._pool_queue = q
+        q.put(spec)
+
+    def _pool_exec_loop(self, q: "queue.Queue[TaskSpec]"):
+        while not self._exit_ev.is_set():
+            try:
+                spec = q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            self._execute(spec)
 
     # -- actor hosting --------------------------------------------------
     def _on_create_actor(self, spec: ActorCreationSpec):

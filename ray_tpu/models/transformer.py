@@ -295,10 +295,20 @@ def _maybe_remat(block_fn, c: TransformerConfig):
         return jax.checkpoint(
             block_fn,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    if c.remat_policy == "save_attn":
+        # Middle ground between "full" (recompute everything, min HBM)
+        # and "dots" (save every matmul, OOMs at billion scale): keep
+        # only the flash kernel's outputs (out + lse, named in
+        # ops/attention.py _flash_lse_fwd) so the backward re-derives
+        # the cheap projections but never re-runs the attention kernel.
+        return jax.checkpoint(
+            block_fn,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_out", "attn_lse"))
     if c.remat_policy == "full":
         return jax.checkpoint(block_fn)
     raise ValueError(f"unknown remat_policy {c.remat_policy!r}; "
-                     "expected 'full' or 'dots'")
+                     "expected 'full', 'dots' or 'save_attn'")
 
 
 def forward(params: Dict[str, Any], tokens, config: TransformerConfig,

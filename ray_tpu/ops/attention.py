@@ -401,7 +401,16 @@ def _flash_lse(q, k, v, offs, causal, sm_scale, block_q, block_k):
 
 def _flash_lse_fwd(q, k, v, offs, causal, sm_scale, block_q, block_k):
     out, lse = _flash_fwd(q, k, v, offs, causal, sm_scale, block_q, block_k)
-    return (out, lse), (q, k, v, out, lse, offs)
+    # Named residuals: under jax.checkpoint with
+    # save_only_these_names("attn_out", "attn_lse") (the transformer's
+    # "save_attn" remat policy) the kernel outputs are kept from the
+    # primal pass, so the backward never re-runs the forward kernel —
+    # q/k/v residuals are cheap projections the remat re-derives.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out_r = checkpoint_name(out, "attn_out")
+    lse_r = checkpoint_name(lse, "attn_lse")
+    return (out, lse), (q, k, v, out_r, lse_r, offs)
 
 
 def _flash_lse_bwd(causal, sm_scale, block_q, block_k, res, cts):

@@ -118,7 +118,12 @@ class _RouteTable:
 class HTTPProxy(_RouteTable):
     """Actor: serves HTTP on (host, port) from one asyncio loop."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 8000):
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 max_body_bytes: int = 100 * 1024 * 1024):
+        # Cap request bodies (the declared Content-Length is read fully
+        # into memory): a single client must not be able to make the
+        # proxy buffer an arbitrarily large body.
+        self.max_body_bytes = max_body_bytes
         self._init_routes()
         self._loop = asyncio.new_event_loop()
         threading.Thread(target=self._loop.run_forever,
@@ -204,6 +209,22 @@ class HTTPProxy(_RouteTable):
                 except ValueError:
                     self._write_response(writer, 400, json.dumps(
                         {"error": "bad Content-Length"}).encode())
+                    await writer.drain()
+                    return
+                if "chunked" in _hget(
+                        headers, "transfer-encoding", "").lower():
+                    # Chunked request bodies are not supported; say so
+                    # (411: send a Content-Length) instead of silently
+                    # treating the body as empty.
+                    self._write_response(writer, 411, json.dumps(
+                        {"error": "chunked request bodies unsupported; "
+                                  "send Content-Length"}).encode())
+                    await writer.drain()
+                    return
+                if length > self.max_body_bytes:
+                    self._write_response(writer, 413, json.dumps(
+                        {"error": f"body of {length} bytes exceeds the "
+                                  f"{self.max_body_bytes} limit"}).encode())
                     await writer.drain()
                     return
                 try:

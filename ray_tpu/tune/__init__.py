@@ -13,6 +13,12 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
     TrialScheduler,
 )
+from ray_tpu.tune.pb2 import PB2
+from ray_tpu.tune.searchers import (
+    OptunaSearch,
+    Searcher,
+    as_search_algorithm,
+)
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     ConcurrencyLimiter,
@@ -57,12 +63,14 @@ __all__ = [
     "sample_from",
     "SearchAlgorithm",
     "BasicVariantGenerator", "TPESearcher", "ConcurrencyLimiter",
+    "Searcher", "OptunaSearch", "as_search_algorithm",
     "TrialScheduler",
     "FIFOScheduler",
     "AsyncHyperBandScheduler",
     "HyperBandScheduler",
     "MedianStoppingRule",
     "PopulationBasedTraining",
+    "PB2",
 ]
 
 # Feature-usage tag (util/usage_stats.py; local-only, no egress).

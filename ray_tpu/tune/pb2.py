@@ -1,0 +1,161 @@
+"""PB2: Population Based Bandits (Parker-Holder et al., NeurIPS 2020).
+
+Counterpart of python/ray/tune/schedulers/pb2.py (507 LoC wrapping GPy):
+PBT's exploit step with the random perturbation replaced by a GP-bandit
+suggestion — a Gaussian process is fit on (time, hyperparameters) →
+reward *change* observations from the whole population, and the new
+hyperparameters for the exploiting trial maximize UCB over the bounded
+search box.  Native numpy GP (RBF kernel + jittered Cholesky), no GPy
+dependency.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu.tune.schedulers import (
+    CONTINUE,
+    PAUSE,
+    PopulationBasedTraining,
+)
+
+
+def _rbf(a: np.ndarray, b: np.ndarray, ls: float) -> np.ndarray:
+    d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+    return np.exp(-0.5 * d2 / (ls * ls))
+
+
+class _GP:
+    """Minimal GP regression: RBF kernel, fixed unit signal variance,
+    median-heuristic lengthscale, jittered Cholesky solve."""
+
+    def __init__(self, x: np.ndarray, y: np.ndarray, noise: float = 1e-2):
+        self.x = x
+        mu, sd = y.mean(), max(y.std(), 1e-8)
+        self.y_mu, self.y_sd = mu, sd
+        self.y = (y - mu) / sd
+        if len(x) > 1:
+            d2 = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+            med = np.median(d2[d2 > 0]) if (d2 > 0).any() else 1.0
+            self.ls = math.sqrt(max(med, 1e-6))
+        else:
+            self.ls = 1.0
+        k = _rbf(x, x, self.ls) + noise * np.eye(len(x))
+        self.chol = np.linalg.cholesky(k + 1e-8 * np.eye(len(x)))
+        self.alpha = np.linalg.solve(
+            self.chol.T, np.linalg.solve(self.chol, self.y))
+
+    def predict(self, xs: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        ks = _rbf(xs, self.x, self.ls)
+        mu = ks @ self.alpha
+        v = np.linalg.solve(self.chol, ks.T)
+        var = np.clip(1.0 - (v * v).sum(0), 1e-12, None)
+        return mu * self.y_sd + self.y_mu, np.sqrt(var) * self.y_sd
+
+
+class PB2(PopulationBasedTraining):
+    """PBT with a GP-bandit explore step over continuous bounds.
+
+    hyperparam_bounds: {key: (low, high)} continuous box; categorical
+    keys can still be mutated PBT-style via hyperparam_mutations.
+    """
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 perturbation_interval: int = 4,
+                 hyperparam_bounds: Optional[
+                     Dict[str, Tuple[float, float]]] = None,
+                 hyperparam_mutations: Optional[Dict[str, Any]] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 2.0,
+                 n_candidates: int = 256,
+                 log_scale_auto: bool = True,
+                 seed: Optional[int] = None):
+        super().__init__(
+            time_attr=time_attr,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations=hyperparam_mutations,
+            quantile_fraction=quantile_fraction,
+            seed=seed)
+        self._bounds = {k: (float(lo), float(hi))
+                        for k, (lo, hi) in (hyperparam_bounds or {}).items()}
+        self._kappa = ucb_kappa
+        self._n_candidates = n_candidates
+        # Auto log-scaling for bounds spanning >=2 decades (learning
+        # rates etc.) — PB2's GP operates in a warped unit box.
+        self._log = {
+            k: (log_scale_auto and lo > 0 and hi / max(lo, 1e-300) >= 100)
+            for k, (lo, hi) in self._bounds.items()}
+        # Per-trial observation history: time -> (score, config snapshot)
+        self._history: Dict[str, List[Tuple[float, float, Dict]]] = \
+            defaultdict(list)
+
+    # -- data collection ----------------------------------------------------
+    def on_trial_result(self, trial, result):
+        score = self._score(result)
+        t = result.get(self._time_attr, 0)
+        if score is not None:
+            self._history[trial.trial_id].append(
+                (float(t), score, {k: trial.config.get(k)
+                                   for k in self._bounds}))
+        return super().on_trial_result(trial, result)
+
+    # -- warping ------------------------------------------------------------
+    def _to_unit(self, key: str, v: float) -> float:
+        lo, hi = self._bounds[key]
+        if self._log[key]:
+            lo_, hi_, v_ = math.log(lo), math.log(hi), math.log(
+                max(float(v), 1e-300))
+            return (v_ - lo_) / max(hi_ - lo_, 1e-12)
+        return (float(v) - lo) / max(hi - lo, 1e-12)
+
+    def _from_unit(self, key: str, u: float) -> float:
+        lo, hi = self._bounds[key]
+        u = min(max(u, 0.0), 1.0)
+        if self._log[key]:
+            return float(math.exp(math.log(lo)
+                                  + u * (math.log(hi) - math.log(lo))))
+        return float(lo + u * (hi - lo))
+
+    # -- GP-bandit explore (overrides PBT's random perturbation) ------------
+    def _explore(self, config: Dict[str, Any]) -> Dict[str, Any]:
+        keys = list(self._bounds)
+        data_x, data_y = [], []
+        tmax = 1.0
+        for obs in self._history.values():
+            for (t, _, _) in obs:
+                tmax = max(tmax, t)
+        for obs in self._history.values():
+            for i in range(1, len(obs)):
+                t0, s0, _ = obs[i - 1]
+                t1, s1, cfg = obs[i]
+                xs = [t1 / tmax] + [
+                    self._to_unit(k, cfg.get(k, self._bounds[k][0]))
+                    for k in keys]
+                data_x.append(xs)
+                data_y.append(s1 - s0)  # reward CHANGE — PB2's target
+        if len(data_y) >= 3:
+            gp = _GP(np.asarray(data_x), np.asarray(data_y))
+            cand_u = self._rng.uniform(
+                0, 1, size=(self._n_candidates, len(keys)))
+            t_col = np.full((self._n_candidates, 1), 1.0)  # next window
+            mu, sd = gp.predict(np.concatenate([t_col, cand_u], axis=1))
+            best = cand_u[int(np.argmax(mu + self._kappa * sd))]
+            for k, u in zip(keys, best):
+                new = self._from_unit(k, float(u))
+                cur = config.get(k)
+                config[k] = type(cur)(new) if isinstance(cur, int) else new
+        else:
+            # Too little signal for a GP: uniform draw inside the box
+            # (the paper's cold-start behavior).
+            for k in keys:
+                new = self._from_unit(k, float(self._rng.uniform()))
+                cur = config.get(k)
+                config[k] = type(cur)(new) if isinstance(cur, int) else new
+        # Non-bounded (categorical) keys keep PBT-style mutation.
+        if self._mutations:
+            config = super()._explore(config)
+        return config

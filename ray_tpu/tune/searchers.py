@@ -1,0 +1,196 @@
+"""Ask/tell Searcher protocol + external-searcher integrations.
+
+Counterpart of python/ray/tune/search/searcher.py (the per-trial
+ask/tell `Searcher` interface external libraries implement) and
+python/ray/tune/search/optuna/optuna_search.py (the reference's Optuna
+adapter).  The internal planner interface stays SearchAlgorithm
+(search.py — batch `next_configs`); `as_search_algorithm` adapts any
+Searcher onto it, so one adapter covers every ask/tell integration.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.search import (
+    Choice,
+    Domain,
+    GridSearch,
+    LogRandInt,
+    LogUniform,
+    QUniform,
+    RandInt,
+    RandN,
+    SampleFrom,
+    SearchAlgorithm,
+    Uniform,
+    _set_path,
+    _walk,
+)
+
+
+class Searcher:
+    """Per-trial ask/tell interface (reference tune/search/searcher.py).
+
+    Implementations return one config per `suggest(trial_id)` and learn
+    from `on_trial_complete(trial_id, result, error)`.  Return None from
+    suggest() to signal exhaustion."""
+
+    def set_search_properties(self, metric: Optional[str], mode: str,
+                              space: Dict[str, Any]) -> bool:
+        self._metric, self._mode, self._space = metric, mode, space
+        return True
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[Dict] = None,
+                          error: bool = False) -> None:
+        pass
+
+
+class _SearcherAdapter(SearchAlgorithm):
+    """Adapts an ask/tell Searcher onto the internal SearchAlgorithm
+    (batch) protocol: generates trial ids for suggestions; completions
+    route back via the __searcher_trial_id__ key carried in each
+    suggested config."""
+
+    def __init__(self, searcher: Searcher):
+        self.searcher = searcher
+
+    def set_space(self, space, metric, mode):
+        self.searcher.set_search_properties(metric, mode, space or {})
+
+    def next_configs(self, n: int) -> List[Dict[str, Any]]:
+        out = []
+        for _ in range(n):
+            tid = uuid.uuid4().hex[:8]
+            cfg = self.searcher.suggest(tid)
+            if cfg is None:
+                break
+            cfg = dict(cfg)
+            cfg["__searcher_trial_id__"] = tid
+            out.append(cfg)
+        return out
+
+    def on_trial_complete(self, trial_id, result, error=False, config=None):
+        tid = (config or {}).get("__searcher_trial_id__") or trial_id
+        self.searcher.on_trial_complete(tid, result=result, error=error)
+
+
+def as_search_algorithm(searcher) -> SearchAlgorithm:
+    """Wrap an ask/tell Searcher for Tuner(search_alg=...); passes
+    SearchAlgorithm instances through unchanged."""
+    if isinstance(searcher, SearchAlgorithm):
+        return searcher
+    return _SearcherAdapter(searcher)
+
+
+class OptunaSearch(Searcher):
+    """Optuna integration via its ask/tell API (reference
+    tune/search/optuna/optuna_search.py).  Maps search.py domains onto
+    optuna distributions; raises ImportError with guidance when optuna
+    is not installed (this image has no egress — the adapter is tested
+    with a stub and activates automatically where optuna exists)."""
+
+    def __init__(self, sampler=None, seed: Optional[int] = None,
+                 _optuna_module=None):
+        if _optuna_module is not None:
+            self._optuna = _optuna_module
+        else:
+            try:
+                import optuna  # noqa: PLC0415
+
+                self._optuna = optuna
+            except ImportError as e:
+                raise ImportError(
+                    "OptunaSearch requires the `optuna` package "
+                    "(pip install optuna); in the air-gapped image use "
+                    "TPESearcher (ray_tpu.tune.TPESearcher), the native "
+                    "equivalent of optuna's default TPE sampler") from e
+        self._sampler = sampler
+        self._seed = seed
+        self._study = None
+        self._trials: Dict[str, Any] = {}
+        self._dims: List = []
+        self._metric = None
+        self._mode = "max"
+        self._space: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, space):
+        self._metric, self._mode, self._space = metric, mode, space or {}
+        direction = "minimize" if mode == "min" else "maximize"
+        sampler = self._sampler
+        if sampler is None and hasattr(self._optuna, "samplers"):
+            try:
+                sampler = self._optuna.samplers.TPESampler(seed=self._seed)
+            except Exception:
+                sampler = None
+        self._study = self._optuna.create_study(
+            direction=direction, sampler=sampler)
+        self._dims = [
+            (path, leaf) for path, leaf in _walk(self._space)
+            if isinstance(leaf, Domain) and not isinstance(leaf, SampleFrom)
+        ]
+        return True
+
+    def _suggest_leaf(self, trial, name: str, leaf):
+        if isinstance(leaf, LogUniform):
+            return trial.suggest_float(name, leaf.low, leaf.high, log=True)
+        if isinstance(leaf, Uniform):
+            return trial.suggest_float(name, leaf.low, leaf.high)
+        if isinstance(leaf, QUniform):
+            return trial.suggest_float(name, leaf.low, leaf.high,
+                                       step=leaf.q)
+        if isinstance(leaf, LogRandInt):
+            return trial.suggest_int(name, leaf.low, max(leaf.low,
+                                                         leaf.high - 1),
+                                     log=True)
+        if isinstance(leaf, RandInt):
+            return trial.suggest_int(name, leaf.low, max(leaf.low,
+                                                         leaf.high - 1))
+        if isinstance(leaf, RandN):
+            # No native normal distribution: approximate with +-4 sd.
+            return trial.suggest_float(name, leaf.mean - 4 * leaf.sd,
+                                       leaf.mean + 4 * leaf.sd)
+        if isinstance(leaf, Choice):
+            return trial.suggest_categorical(name, list(leaf.values))
+        return None
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, Any]]:
+        trial = self._study.ask()
+        self._trials[trial_id] = trial
+        cfg: Dict[str, Any] = {}
+        deferred = []
+        for path, leaf in _walk(self._space):
+            name = ".".join(path)
+            if isinstance(leaf, SampleFrom):
+                deferred.append((path, leaf))
+            elif isinstance(leaf, GridSearch):
+                # Grids become categoricals under optuna's sampler.
+                _set_path(cfg, path,
+                          trial.suggest_categorical(name,
+                                                    list(leaf.values)))
+            elif isinstance(leaf, Domain):
+                _set_path(cfg, path, self._suggest_leaf(trial, name, leaf))
+            else:
+                _set_path(cfg, path, leaf)
+        for path, leaf in deferred:
+            _set_path(cfg, path, leaf.fn(cfg))
+        return cfg
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        trial = self._trials.pop(trial_id, None)
+        if trial is None or self._study is None:
+            return
+        if error or not result or self._metric not in result:
+            state = getattr(self._optuna.trial, "TrialState", None)
+            try:
+                self._study.tell(trial, state=state.FAIL
+                                 if state is not None else None)
+            except Exception:
+                pass
+            return
+        self._study.tell(trial, float(result[self._metric]))

@@ -1,0 +1,83 @@
+"""MFU sweep over remat policy x batch on the real chip.
+
+Usage: python scripts/bench_sweep.py [policy batch [seq]] ...
+  with no args runs the default grid for the 0.9B headline config.
+Prints one line per combo; OOMs are reported and skipped.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+def run(policy: str, batch: int, seq: int = 2048, steps: int = 10):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import transformer as tfm
+    from ray_tpu.parallel.mesh import build_mesh
+    from ray_tpu.train.train_state import ShardedTrainStep, default_optimizer
+
+    sys.path.insert(0, ".")
+    from bench import _peak_flops
+
+    config = tfm.TransformerConfig(
+        vocab_size=32000, hidden_size=1792, intermediate_size=7168,
+        num_layers=16, num_heads=14, num_kv_heads=14, max_seq_len=seq,
+        remat_policy=policy,
+    )
+    devices = jax.devices()
+    mesh = build_mesh(axes={"fsdp": len(devices)}, devices=devices)
+    ts = ShardedTrainStep(
+        config, mesh,
+        optimizer=default_optimizer(warmup_steps=10, total_steps=1000,
+                                    mu_dtype=jnp.bfloat16))
+    state = ts.init(jax.random.key(0))
+    rng = np.random.default_rng(0)
+    batch_np = {"tokens": jnp.asarray(
+        rng.integers(0, config.vocab_size, (batch, seq + 1)),
+        dtype=jnp.int32)}
+    state, metrics = ts.step(state, batch_np)
+    float(metrics["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = ts.step(state, batch_np)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+    tok = batch * seq * steps / dt
+    mfu = tok * tfm.flops_per_token(config, seq) / (
+        _peak_flops(devices[0]) * len(devices))
+    print(f"policy={policy:<10s} b={batch} seq={seq}: "
+          f"MFU={mfu:.4f} tok/s={tok:.0f}", flush=True)
+    return mfu
+
+
+def main():
+    args = sys.argv[1:]
+    if args:
+        combos = []
+        i = 0
+        while i < len(args):
+            policy, batch = args[i], int(args[i + 1])
+            seq = 2048
+            if i + 2 < len(args) and args[i + 2].isdigit():
+                seq = int(args[i + 2])
+                i += 1
+            combos.append((policy, batch, seq))
+            i += 2
+    else:
+        combos = [("save_attn", 6, 2048), ("save_attn", 8, 2048),
+                  ("full", 6, 2048), ("save_attn", 4, 2048)]
+    for policy, batch, seq in combos:
+        try:
+            run(policy, batch, seq)
+        except Exception as e:  # noqa: BLE001
+            msg = str(e)[:200].replace("\n", " ")
+            print(f"policy={policy:<10s} b={batch} seq={seq}: FAILED {msg}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

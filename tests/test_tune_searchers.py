@@ -123,3 +123,177 @@ def test_concurrency_limiter_caps_inflight():
     assert lim.next_configs(1) == []  # saturated
     lim.on_trial_complete("a", {"loss": 1.0}, config=first[0])
     assert len(lim.next_configs(5)) == 1  # one slot released
+
+
+# ---------------------------------------------------------------------------
+# ask/tell Searcher protocol + Optuna adapter + PB2 (round 3:
+# reference searcher.py / optuna_search.py / schedulers/pb2.py)
+# ---------------------------------------------------------------------------
+
+import math
+import types
+
+from ray_tpu.tune.pb2 import PB2, _GP
+from ray_tpu.tune.searchers import (
+    OptunaSearch,
+    Searcher,
+    as_search_algorithm,
+)
+
+
+class CountingSearcher(Searcher):
+    """Deterministic ask/tell searcher: suggests x = n."""
+
+    def __init__(self):
+        self.n = 0
+        self.told = []
+
+    def suggest(self, trial_id):
+        self.n += 1
+        return {"x": self.n}
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        self.told.append((trial_id, result, error))
+
+
+def test_adapter_suggest_and_tell_roundtrip():
+    s = CountingSearcher()
+    alg = as_search_algorithm(s)
+    alg.set_space({}, "score", "max")
+    cfgs = alg.next_configs(3)
+    assert [c["x"] for c in cfgs] == [1, 2, 3]
+    alg.on_trial_complete("t0", {"score": 5.0}, config=cfgs[1])
+    assert len(s.told) == 1
+    tid, result, error = s.told[0]
+    assert result == {"score": 5.0} and not error
+    assert tid == cfgs[1]["__searcher_trial_id__"]
+
+
+def test_adapter_end_to_end_with_tuner():
+    searcher = CountingSearcher()
+
+    def objective(config):
+        tune.report({"score": config["x"] * 2.0})
+
+    results = tune.Tuner(
+        objective,
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=4,
+            search_alg=as_search_algorithm(searcher)),
+    ).fit()
+    assert results.get_best_result(
+        metric="score", mode="max").metrics["score"] == 8.0
+    assert len(searcher.told) == 4
+
+
+def _stub_optuna():
+    """Minimal ask/tell optuna lookalike (image is offline)."""
+    rng = np.random.default_rng(0)
+
+    class _Trial:
+        def suggest_float(self, name, lo, hi, log=False, step=None):
+            v = (math.exp(rng.uniform(math.log(lo), math.log(hi)))
+                 if log else float(rng.uniform(lo, hi)))
+            return round(v / step) * step if step else v
+
+        def suggest_int(self, name, lo, hi, log=False):
+            return int(rng.integers(lo, hi + 1))
+
+        def suggest_categorical(self, name, values):
+            return values[int(rng.integers(0, len(values)))]
+
+    class _Study:
+        def __init__(self):
+            self.told = []
+
+        def ask(self):
+            return _Trial()
+
+        def tell(self, trial, value=None, state=None):
+            self.told.append((trial, value, state))
+
+    stub = types.SimpleNamespace()
+    stub.create_study = lambda direction=None, sampler=None: _Study()
+    stub.trial = types.SimpleNamespace(TrialState=None)
+    return stub
+
+
+def test_optuna_adapter_with_stub():
+    s = OptunaSearch(_optuna_module=_stub_optuna())
+    s.set_search_properties("loss", "min", {
+        "lr": tune.loguniform(1e-5, 1e-1),
+        "layers": tune.randint(1, 5),
+        "act": tune.choice(["relu", "gelu"]),
+        "fixed": 7,
+    })
+    cfg = s.suggest("t1")
+    assert 1e-5 <= cfg["lr"] <= 1e-1
+    assert 1 <= cfg["layers"] <= 4
+    assert cfg["act"] in ("relu", "gelu")
+    assert cfg["fixed"] == 7
+    s.on_trial_complete("t1", {"loss": 0.3})
+    assert s._study.told[0][1] == 0.3
+
+
+def test_optuna_missing_raises_with_guidance():
+    with pytest.raises(ImportError, match="TPESearcher"):
+        OptunaSearch()
+
+
+def test_gp_fits_and_predicts():
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, size=(30, 2))
+    y = np.sin(3 * x[:, 0]) + 0.1 * x[:, 1]
+    gp = _GP(x, y)
+    mu, sd = gp.predict(x[:5])
+    assert np.allclose(mu, y[:5], atol=0.2)
+    assert (sd >= 0).all()
+
+
+class _FakeTrial:
+    def __init__(self, tid, config):
+        self.trial_id = tid
+        self.config = config
+        self.exploit_directive = None
+
+
+def test_pb2_exploit_suggests_within_bounds():
+    pb2 = PB2(perturbation_interval=2,
+              hyperparam_bounds={"lr": (1e-4, 1e-1)}, seed=0)
+    pb2.set_objective("score", "max")
+    trials = [_FakeTrial(f"t{i}", {"lr": lr})
+              for i, lr in enumerate([1e-4, 1e-3, 1e-2, 1e-1])]
+    for step in range(1, 7):
+        for i, tr in enumerate(trials):
+            # higher lr -> bigger score gains (monotone signal)
+            pb2.on_trial_result(
+                tr, {"training_iteration": step,
+                     "score": step * (i + 1) * 0.1})
+    directives = [t.exploit_directive for t in trials
+                  if t.exploit_directive is not None]
+    assert directives, "bottom-quantile trial never exploited"
+    for d in directives:
+        assert 1e-4 <= d["config"]["lr"] <= 1e-1
+        assert d["donor"] in {t.trial_id for t in trials}
+
+
+def test_pb2_end_to_end_learns():
+    """PB2-driven tuning of a 1-d quadratic: exploited configs stay in
+    bounds and the experiment improves on the cold start."""
+
+    def objective(config):
+        x = config["x"]
+        for i in range(6):
+            tune.report({"score": -(x - 0.7) ** 2 + 0.01 * i})
+
+    pb2 = PB2(perturbation_interval=2,
+              hyperparam_bounds={"x": (0.0, 1.0)}, seed=1)
+    results = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(0.0, 1.0)},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=4, scheduler=pb2),
+    ).fit()
+    best = results.get_best_result(
+        metric="score", mode="max").metrics["score"]
+    assert best > -0.5
