@@ -46,29 +46,36 @@ def _tpu_config_ladder(tfm):
     fsdp on); the bench walks down on OOM so the driver's automated run
     always lands on the biggest model the chip holds.
 
-    v5e (16 GB HBM) sweep, AdamW mu in bf16 (10 B/param of state),
-    head_dim 128 (flash kernel), seq 2048:
-      879M full-remat: b4=39.8%, b6=40.1% MFU, b8=38.3%; "dots" OOMs
-        at this size even at b2 (its per-layer saves + fp32 logits
-        exceed HBM at seq 2048).
-      804M (h1536 L20) full: b8=38.6%.
-      502M dots: b4=37.7% at seq 2048 (r01: 43.4% at seq 1024).
+    v5e (16 GB HBM) sweep at seq 2048, head_dim 128 (flash kernel,
+    512x512 tiles), AdamW mu+nu in bf16 (8 B/param of state), fused
+    chunked cross-entropy (ops/fused_ce.py — the r2 log_softmax path's
+    [tokens, 32000] fp32 buffers + vocab-scatter backward cost ~25% of
+    the step):
+      879M full-remat + fused CE: b8=54.7% MFU, b12=54.4%, b10=54.0%
+        (r2 without fused CE: b6=40.1%); dots_no_mlp b4=51.8%,
+        save_attn b8=53.5% — full remat + big batch wins once the CE
+        drag is gone.
     """
     ladder = []
     ladder.append(("0.9B", tfm.TransformerConfig(
         vocab_size=32000, hidden_size=1792, intermediate_size=7168,
         num_layers=16, num_heads=14, num_kv_heads=14, max_seq_len=2048,
-        remat_policy="full",
+        remat_policy="full", fused_ce=True,
+    ), 8, 2048))
+    ladder.append(("0.9B-b6", tfm.TransformerConfig(
+        vocab_size=32000, hidden_size=1792, intermediate_size=7168,
+        num_layers=16, num_heads=14, num_kv_heads=14, max_seq_len=2048,
+        remat_policy="full", fused_ce=True,
     ), 6, 2048))
     ladder.append(("0.8B", tfm.TransformerConfig(
         vocab_size=32000, hidden_size=1536, intermediate_size=6144,
         num_layers=20, num_heads=12, num_kv_heads=12, max_seq_len=2048,
-        remat_policy="full",
+        remat_policy="full", fused_ce=True,
     ), 8, 2048))
     ladder.append(("0.5B", tfm.TransformerConfig(
         vocab_size=32000, hidden_size=1536, intermediate_size=6144,
         num_layers=12, num_heads=12, num_kv_heads=12, max_seq_len=2048,
-        remat_policy="full",
+        remat_policy="full", fused_ce=True,
     ), 8, 2048))
     return ladder
 
@@ -88,7 +95,8 @@ def _run_once(config, batch, seq, steps, devices):
     ts = ShardedTrainStep(
         config, mesh,
         optimizer=default_optimizer(warmup_steps=10, total_steps=1000,
-                                    mu_dtype=jnp.bfloat16))
+                                    mu_dtype=jnp.bfloat16,
+                                    nu_dtype=jnp.bfloat16))
     state = ts.init(jax.random.key(0))
 
     rng = np.random.default_rng(0)

@@ -18,6 +18,7 @@ from ray_tpu.core.api import (
     is_initialized,
     kill,
     put,
+    method,
     remote,
     shutdown,
     wait,
@@ -41,6 +42,7 @@ __all__ = [
     "shutdown",
     "is_initialized",
     "remote",
+    "method",
     "get",
     "put",
     "wait",

@@ -10,5 +10,7 @@ from ray_tpu.channel.shared_memory_channel import (
     ChannelClosedError,
     ChannelTimeoutError,
 )
+from ray_tpu.channel.tensor_channel import DeviceTensorChannel, TensorType
 
-__all__ = ["Channel", "ChannelClosedError", "ChannelTimeoutError"]
+__all__ = ["Channel", "ChannelClosedError", "ChannelTimeoutError",
+           "DeviceTensorChannel", "TensorType"]

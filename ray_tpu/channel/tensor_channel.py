@@ -1,0 +1,159 @@
+"""Device-tensor channel tier for compiled DAGs.
+
+Counterpart of the reference's NCCL channel tier
+(python/ray/experimental/channel/torch_tensor_nccl_channel.py +
+torch_tensor_type.py): a `.with_tensor_transport()` hint on a DAG node
+switches that node's output edges to a TENSOR protocol — no pickle
+anywhere on the hot path.  v1 is host-mediated (the VERDICT's
+"jax.device_put between jitted steps"): the producer DMAs the device
+array to host (np.asarray) and copies raw bytes + a fixed struct header
+straight into the mutable shm slot; the consumer views the slot memory
+(np.frombuffer, zero-copy) and `jax.device_put`s it onto its own
+device, ready for the next jitted stage.  On a multi-chip runtime the
+same hint upgrades to ICI send/recv compiled into the stage programs;
+the channel protocol (header + raw payload) is transport-agnostic.
+
+Supports a single array or a flat tuple/list of arrays per message.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.channel.shared_memory_channel import (
+    _PAYLOAD_OFF,
+    Channel,
+)
+
+# payload layout: u32 count, then per tensor:
+#   u32 dtype_len, dtype bytes, u32 ndim, u64 x ndim shape, u64 nbytes,
+#   raw buffer
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+
+class TensorType:
+    """Edge hint: values on this edge are device tensors; move them via
+    the tensor protocol instead of pickle (reference
+    experimental/channel/torch_tensor_type.py)."""
+
+    def __init__(self, transport: str = "auto", device: str = "auto"):
+        self.transport = transport
+        self.device = device
+
+    def __repr__(self):
+        return f"TensorType(transport={self.transport!r})"
+
+
+class DeviceTensorChannel(Channel):
+    """Channel endpoint speaking the raw-tensor protocol."""
+
+    def __init__(self, *args, device=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._device = device
+
+    # -- write ----------------------------------------------------------
+    def write(self, value: Any, timeout: Optional[float] = None):
+        arrays = value if isinstance(value, (tuple, list)) else (value,)
+        if not all(hasattr(a, "dtype") and hasattr(a, "shape")
+                   for a in arrays):
+            # Non-tensor payload (e.g. a DagExecutionError envelope from
+            # a failing stage): fall back to the pickle protocol; the
+            # reader dispatches on the kind field.
+            return Channel.write(self, value, timeout)
+        hosts = [np.asarray(a) for a in arrays]  # device->host DMA
+        total = _U32.size
+        metas = []
+        for h in hosts:
+            dt = np.dtype(h.dtype).str.encode()
+            total += _U32.size + len(dt) + _U32.size \
+                + _U64.size * h.ndim + _U64.size + h.nbytes
+            metas.append(dt)
+        if total > self.capacity:
+            raise ValueError(
+                f"tensor message of {total} bytes exceeds channel "
+                f"capacity {self.capacity}; size the DAG's "
+                "buffer_size_bytes for the largest stage output")
+        seq = self._seq()
+        self._wait(
+            lambda: all(self._ack(i) >= seq
+                        for i in range(self.num_readers)),
+            timeout, "write")
+        mm = self._mm
+        off = _PAYLOAD_OFF
+        _U32.pack_into(mm, off, len(hosts))
+        off += _U32.size
+        for h, dt in zip(hosts, metas):
+            _U32.pack_into(mm, off, len(dt))
+            off += _U32.size
+            mm[off:off + len(dt)] = dt
+            off += len(dt)
+            _U32.pack_into(mm, off, h.ndim)
+            off += _U32.size
+            for d in h.shape:
+                _U64.pack_into(mm, off, d)
+                off += _U64.size
+            _U64.pack_into(mm, off, h.nbytes)
+            off += _U64.size
+            mv = memoryview(np.ascontiguousarray(h)).cast("B")
+            mm[off:off + h.nbytes] = mv
+            off += h.nbytes
+        struct.pack_into("<Q", mm, 24, off - _PAYLOAD_OFF)  # msg_len
+        struct.pack_into("<I", mm, 32, 2)  # kind: tensor protocol
+        self._set_seq(seq + 1)
+
+    # -- read -----------------------------------------------------------
+    def read(self, timeout: Optional[float] = None) -> Any:
+        if self.reader_idx is None:
+            raise RuntimeError("writer endpoint cannot read")
+        my = self._ack(self.reader_idx)
+        self._wait(lambda: self._seq() > my, timeout, "read")
+        (kind,) = _U32.unpack_from(self._mm, 32)
+        if kind != 2:
+            # Pickle-protocol payload (error envelope — possibly
+            # ref-spilled): the base reader handles inline AND spilled
+            # kinds and acks; the slot is still unread for us, so its
+            # wait returns immediately.
+            return Channel.read(self, timeout)
+        import jax
+
+        mm = self._mm
+        off = _PAYLOAD_OFF
+        (count,) = _U32.unpack_from(mm, off)
+        off += _U32.size
+        out = []
+        for _ in range(count):
+            (dt_len,) = _U32.unpack_from(mm, off)
+            off += _U32.size
+            dtype = np.dtype(bytes(mm[off:off + dt_len]).decode())
+            off += dt_len
+            (ndim,) = _U32.unpack_from(mm, off)
+            off += _U32.size
+            shape = []
+            for _ in range(ndim):
+                (d,) = _U64.unpack_from(mm, off)
+                off += _U64.size
+                shape.append(d)
+            (nbytes,) = _U64.unpack_from(mm, off)
+            off += _U64.size
+            host = np.frombuffer(
+                mm, dtype=dtype, count=int(np.prod(shape, dtype=np.int64))
+                if shape else 1, offset=off).reshape(shape)
+            off += nbytes
+            # host view -> this process's device; the copy happens in
+            # the transfer engine, never through pickle.
+            dev = self._device or jax.devices()[0]
+            if dev.platform == "cpu":
+                # CPU backend may alias the numpy buffer — and the slot
+                # is recycled after the ack — so copy out of the mmap.
+                host = host.copy()
+            out.append(jax.device_put(host, dev))
+        # The H2D DMA must complete before the ack releases the slot to
+        # the writer, or the next message overwrites bytes mid-transfer.
+        for a in out:
+            jax.block_until_ready(a)
+        self._set_ack(self.reader_idx, my + 1)
+        return out[0] if count == 1 else tuple(out)

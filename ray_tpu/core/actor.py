@@ -86,12 +86,33 @@ def _rebuild_handle(actor_hex: str, class_name: str):
     return ActorHandle(actor_hex, class_name)
 
 
+def method(*, concurrency_group: Optional[str] = None):
+    """Method decorator (reference ray.method): annotate an actor method
+    with its concurrency group.
+
+        @ray_tpu.remote(concurrency_groups={"io": 2, "compute": 1})
+        class A:
+            @ray_tpu.method(concurrency_group="io")
+            def fetch(self): ...
+
+    (Per-method num_returns rides ActorMethod.options(num_returns=...)
+    at the call site instead.)"""
+
+    def decorator(fn):
+        if concurrency_group is not None:
+            fn.__concurrency_group__ = concurrency_group
+        return fn
+
+    return decorator
+
+
 class ActorClass:
     def __init__(self, cls, *, num_cpus: Optional[float] = None,
                  num_tpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None,
                  max_restarts: int = 0,
                  max_concurrency: int = 1,
+                 concurrency_groups: Optional[Dict[str, int]] = None,
                  name: str = "",
                  namespace: str = "",
                  lifetime: str = "",
@@ -107,6 +128,11 @@ class ActorClass:
         # event loop without parking a thread per call (worker.py
         # _execute_async_actor_task), so async actors need no bump here.
         self._max_concurrency = max_concurrency
+        # Named concurrency groups: each group gets its own executor
+        # pool in the hosting worker (reference
+        # concurrency_group_manager.cc); methods pick a group via
+        # @ray_tpu.method(concurrency_group=...).
+        self._concurrency_groups = dict(concurrency_groups or {})
         self._name = name
         self._namespace = namespace
         self._runtime_env = runtime_env
@@ -148,6 +174,7 @@ class ActorClass:
             name=self._name,
             namespace=self._namespace,
             max_concurrency=self._max_concurrency,
+            concurrency_groups=self._concurrency_groups,
             runtime_env=self._runtime_env,
             scheduling_strategy=self._scheduling_strategy,
         )
@@ -160,6 +187,7 @@ class ActorClass:
             "resources": self._resources,
             "max_restarts": self._max_restarts,
             "max_concurrency": self._max_concurrency,
+            "concurrency_groups": self._concurrency_groups,
             "name": self._name,
             "namespace": self._namespace,
             "runtime_env": self._runtime_env,

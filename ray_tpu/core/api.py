@@ -12,6 +12,7 @@ from typing import Any, List, Optional, Sequence, Union
 
 from ray_tpu.core import runtime as _runtime_mod
 from ray_tpu.core.actor import ActorClass, ActorHandle
+from ray_tpu.core.actor import method as method  # noqa: PLC0414 re-export
 from ray_tpu.core.driver import DriverRuntime
 from ray_tpu.core.exceptions import RayTpuError
 from ray_tpu.core.object_ref import ObjectRef
@@ -79,8 +80,9 @@ def remote(*args, **kwargs):
     def make(obj):
         if inspect.isclass(obj):
             valid = {"num_cpus", "num_tpus", "resources", "max_restarts",
-                     "max_concurrency", "name", "namespace", "lifetime",
-                     "runtime_env", "scheduling_strategy"}
+                     "max_concurrency", "concurrency_groups", "name",
+                     "namespace", "lifetime", "runtime_env",
+                     "scheduling_strategy"}
             opts = {k: v for k, v in kwargs.items() if k in valid}
             return ActorClass(obj, **opts)
         valid = {"num_returns", "num_cpus", "num_tpus", "resources",

@@ -296,9 +296,12 @@ class ControlServer:
 
         self._wake = threading.Event()
         self._stopped = threading.Event()
+        from ray_tpu.core.wire_schema import validate as _wire_validate
+
         self.server = rpc.Server(self._handle, host=config.node_ip_address,
                                  port=config.control_port,
-                                 on_disconnect=self._on_disconnect)
+                                 on_disconnect=self._on_disconnect,
+                                 json_validator=_wire_validate)
         self._sched_thread = threading.Thread(
             target=self._schedule_loop, name="scheduler", daemon=True
         )
@@ -1117,6 +1120,10 @@ class ControlServer:
                 entry = self.objects.get(obj_hex)
                 if entry is not None:
                     entry.refcount += 1
+
+    def _op_decref_batch(self, conn, msg):
+        for obj_hex in msg["objs"]:
+            self._op_decref(conn, {"obj": obj_hex})
 
     def _op_decref(self, conn, msg):
         to_delete = []

@@ -212,9 +212,15 @@ class Server:
         host: str = "127.0.0.1",
         port: int = 0,
         on_disconnect: Optional[Callable[[Connection], None]] = None,
+        json_validator: Optional[Callable[[Any], None]] = None,
     ):
         self._handler = handler
         self._on_disconnect = on_disconnect
+        # Schema check applied to KIND_REQUEST_JSON frames only — the
+        # cross-language door accepts frames from non-Python peers, so
+        # it validates against the typed contract (core/wire_schema.py)
+        # before dispatch; pickle frames come from our own runtime.
+        self._json_validator = json_validator
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -259,6 +265,8 @@ class Server:
                 if kind == KIND_REQUEST_JSON:
                     try:
                         msg = _from_jsonable(json.loads(payload))
+                        if self._json_validator is not None:
+                            self._json_validator(msg)
                         result = self._handler(conn, msg)
                         # allow_nan=False: bare NaN/Infinity tokens are
                         # invalid JSON for non-Python peers.

@@ -127,7 +127,9 @@ class CoreClient:
         self.store = None if thin else ShmObjectStore(
             reply.get("store_key") or self.session_id, reply["shm_dir"])
 
-        self._lock = threading.Lock()
+        # RLock: on_ref_deleted (GC __del__) takes it and can fire while
+        # this same thread already holds it in a get()/put() section.
+        self._lock = threading.RLock()
         # Thread-local put buffering: a worker executing a task batches
         # its result put_object messages into the task_done message (one
         # control round instead of N+1) — see worker.py _execute.
@@ -151,7 +153,19 @@ class CoreClient:
         # On a contended host this amortizes the per-call syscall +
         # wakeup cost across the burst — the reference gets the same
         # effect from gRPC stream batching.
-        self._send_lock = threading.Lock()
+        # RLocks, deliberately: ObjectRef.__del__ fires from GC at
+        # ARBITRARY points — including while this same thread is inside
+        # a section holding these locks (observed: a Thread.__init__
+        # allocation inside _queue_for_flush triggered GC -> __del__ ->
+        # on_ref_deleted -> flush -> self-deadlock on a plain Lock).
+        # The __del__ path only appends to the queues, which is safe to
+        # re-enter.
+        self._send_lock = threading.RLock()
+        # Serializes whole flushes (swap + send): two flushers racing
+        # (inline at get() vs the 2 ms background thread) must not
+        # reorder an incref frame ahead of the submit that registers
+        # its object.
+        self._flush_mutex = threading.RLock()
         self._pending_direct: Dict[str, List[TaskSpec]] = {}
         self._pending_submits: List[TaskSpec] = []
         self._flush_ev = threading.Event()
@@ -832,12 +846,13 @@ class CoreClient:
         return ready, not_ready
 
     def on_ref_deleted(self, object_id: ObjectID):
+        """Runs from ObjectRef.__del__ — i.e. at ARBITRARY GC points,
+        possibly while this thread holds runtime or socket locks.  It
+        must only touch the RLock'd flush queue: the decref rides the
+        ordered head queue (naturally AFTER the submit that registered
+        the object), and the background flusher ships it."""
         if self._closed:
             return
-        if self._pending_count:
-            # A queued submit must register its return objects before
-            # any decref for them reaches the head.
-            self._flush_direct_sends()
         obj_hex = object_id.hex()
         with self._lock:
             if obj_hex in self._direct_futures:
@@ -850,7 +865,7 @@ class CoreClient:
                     return
                 self._direct_promoted.discard(obj_hex)
         try:
-            self.client.send({"op": "decref", "obj": obj_hex})
+            self._queue_for_flush("decref", None, obj_hex, from_del=True)
         except Exception:
             pass
 
@@ -966,6 +981,7 @@ class CoreClient:
                      args: Sequence[Any], resources: Dict[str, float],
                      max_restarts: int, name: str, namespace: str,
                      max_concurrency: int,
+                     concurrency_groups: Optional[Dict[str, int]] = None,
                      runtime_env: Optional[dict] = None,
                      scheduling_strategy=None) -> ActorID:
         borrows: List[str] = []
@@ -985,6 +1001,7 @@ class CoreClient:
             name=name,
             namespace=namespace,
             max_concurrency=max_concurrency,
+            concurrency_groups=concurrency_groups or None,
             owner=self.worker_hex,
             runtime_env=runtime_env,
             scheduling_strategy=scheduling_strategy,
@@ -1128,11 +1145,14 @@ class CoreClient:
                 # survive transient send failures (head restart window).
                 time.sleep(0.05)
 
-    def _queue_for_flush(self, kind: str, key, item):
+    def _queue_for_flush(self, kind: str, key, item, from_del=False):
         """Shared enqueue for coalesced control sends (actor tasks, head
-        submits, and borrow increfs — increfs must stay ORDERED after the
-        submits that register their objects); flushed by get()/wait(),
-        the 64-item cap, or the 2 ms flusher."""
+        submits, borrow increfs and ref-deletion decrefs — refcount ops
+        must stay ORDERED after the submits that register their
+        objects); flushed by get()/wait(), the 64-item cap, or the 2 ms
+        flusher.  Safe to re-enter from __del__ (pure queue appends
+        under an RLock; the flusher thread start happens outside)."""
+        start_flusher = False
         with self._send_lock:
             if kind == "direct":
                 self._pending_direct.setdefault(key, []).append(item)
@@ -1142,15 +1162,23 @@ class CoreClient:
             count = self._pending_count
             if not self._flusher_started:
                 self._flusher_started = True
-                threading.Thread(target=self._send_flusher,
-                                 name="direct-send-flush",
-                                 daemon=True).start()
-        if count >= 64:
+                start_flusher = True
+        if start_flusher:
+            threading.Thread(target=self._send_flusher,
+                             name="direct-send-flush",
+                             daemon=True).start()
+        if count >= 64 and not from_del:
             self._flush_direct_sends()
         else:
+            # from_del: never flush inline — the interrupted frame may
+            # be inside the rpc client's (non-reentrant) socket lock.
             self._flush_ev.set()
 
     def _flush_direct_sends(self):
+        with self._flush_mutex:
+            self._flush_direct_sends_locked()
+
+    def _flush_direct_sends_locked(self):
         with self._send_lock:
             if self._pending_count == 0:
                 return
@@ -1209,10 +1237,14 @@ class CoreClient:
                 msg = {"op": "submit_task", "spec": run[0]} \
                     if len(run) == 1 else \
                     {"op": "submit_task_batch", "specs": run}
-            else:  # incref
+            elif kind == "incref":
                 msg = {"op": "incref", "obj": run[0]} \
                     if len(run) == 1 else \
                     {"op": "incref_batch", "objs": run}
+            else:  # decref (ref deletions ride the same ordered queue)
+                msg = {"op": "decref", "obj": run[0]} \
+                    if len(run) == 1 else \
+                    {"op": "decref_batch", "objs": run}
             yield j, msg
             i = j
 
@@ -1262,6 +1294,10 @@ class CoreClient:
         except Exception:
             pass
         self._closed = True
+        # Wake the send flusher so it observes _closed and exits — a
+        # flusher parked in wait() forever leaked one thread per
+        # init/shutdown cycle (hundreds across a long test session).
+        self._flush_ev.set()
         for conn in self._actor_conns.values():
             conn.close()
         for conn in self._node_conns.values():

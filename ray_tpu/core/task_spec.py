@@ -145,6 +145,10 @@ class ActorCreationSpec:
     name: str = ""
     namespace: str = ""
     max_concurrency: int = 1
+    # Named concurrency groups {name: pool size}; methods route via
+    # @ray_tpu.method(concurrency_group=...) (reference
+    # concurrency_group_manager.cc per-group executor pools).
+    concurrency_groups: Optional[Dict[str, int]] = None
     owner: str = ""
     placement_group_hex: str = ""
     bundle_index: int = -1

@@ -1,0 +1,148 @@
+"""Typed wire contract for the control-plane frame protocol.
+
+Counterpart of the reference's proto IDL tier (src/ray/protobuf/*.proto
+— the typed schemas every language speaks).  The framed RPC layer
+(core/rpc.py) carries pickled dicts between Python peers and JSON dicts
+for the cross-language door; this module is the SCHEMA for those
+messages: one declarative table of every public op, its required and
+optional fields with types, machine-checkable on both ends.
+
+`validate(msg)` is cheap enough for ingress paths that accept untrusted
+frames (the JSON door, the serve frame ingress); Python-internal paths
+trust their own senders and skip it, exactly like generated proto
+bindings trusting in-process construction.  `export_schema()` dumps the
+contract as JSON for non-Python client generators (the C++ client's
+hand-built frames can be checked against it in CI —
+tests/test_cpp_client.py).
+
+Field types: "str", "int", "float", "bool", "bytes", "list", "dict",
+"any".  A trailing "?" marks the field optional.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+# op -> {field: type_spec}
+SCHEMA: Dict[str, Dict[str, str]] = {
+    # -- registration / lifecycle --------------------------------------
+    "register": {"worker_hex": "str", "pid": "int", "kind": "str",
+                 "address": "str?", "env_key": "str?", "node_id": "str?"},
+    "register_node": {"node_id": "str?", "resources": "dict",
+                      "address": "str", "labels": "dict?",
+                      "store_key": "str?", "shm_dir": "str?"},
+    "worker_online": {},
+    "ping": {},
+    # -- objects -------------------------------------------------------
+    "put_object": {"obj": "str", "size": "int", "inline": "bytes?",
+                   "in_shm": "bool?", "is_error": "bool?"},
+    "subscribe_objects": {"objs": "list", "grace": "bool?"},
+    "subscribe_object": {"obj": "str", "grace": "bool?"},
+    "fetch_object": {"obj": "str", "with_meta": "bool?"},
+    "fetch_chunk": {"obj": "str", "size": "int", "offset": "int",
+                    "length": "int"},
+    "incref": {"obj": "str", "n": "int?"},
+    "incref_batch": {"objs": "list"},
+    "decref": {"obj": "str", "n": "int?"},
+    "decref_batch": {"objs": "list"},
+    "free_objects": {"objs": "list"},
+    "forget_object": {"obj": "str"},
+    "object_replica": {"obj": "str"},
+    "report_object_lost": {"obj": "str"},
+    # -- tasks ---------------------------------------------------------
+    "submit_task": {"spec": "any"},
+    "submit_task_batch": {"specs": "list"},
+    "submit_named_task": {"name": "str", "args": "list?",
+                          "num_cpus": "float?", "num_tpus": "float?",
+                          "max_retries": "int?"},
+    "task_done": {"task_id": "str", "failed": "bool?", "puts": "list?",
+                  "decrefs": "list?"},
+    "get_object_json": {"obj": "str"},
+    "cancel_object": {"obj": "str", "force": "bool?"},
+    "cancel_task": {"task": "str", "force": "bool?"},
+    # -- functions -----------------------------------------------------
+    "put_func": {"func_id": "str", "blob": "bytes"},
+    "get_func": {"func_id": "str"},
+    # -- actors --------------------------------------------------------
+    "create_actor": {"spec": "any"},
+    "subscribe_actor": {"actor": "str"},
+    "actor_ready": {"actor": "str", "address": "str"},
+    "actor_creation_failed": {"actor": "str", "reason": "str?"},
+    "kill_actor": {"actor": "str", "no_restart": "bool?"},
+    "get_named_actor": {"name": "str", "namespace": "str?"},
+    "list_named_actors": {"namespace": "str?"},
+    "register_objects": {"objs": "list", "actor": "str?"},
+    # -- KV ------------------------------------------------------------
+    # value: bytes from Python peers; the JSON door also takes plain
+    # strings (the C++ client's convenience form, utf-8 at rest).
+    "kv_put": {"key": "str", "value": "bytes|str", "overwrite": "bool?"},
+    "kv_get": {"key": "str"},
+    "kv_del": {"key": "str"},
+    "kv_keys": {"prefix": "str?"},
+    "kv_exists": {"key": "str"},
+    # -- cluster / state -----------------------------------------------
+    "cluster_resources": {},
+    "available_resources": {},
+    "list_tasks": {}, "list_actors": {}, "list_objects": {},
+    "list_workers": {}, "list_nodes": {},
+    "list_placement_groups": {},
+    "add_node": {"resources": "dict", "node_id": "str?", "labels": "dict?"},
+    "remove_node": {"node_id": "str"},
+    "shutdown_cluster": {},
+    "get_load": {},
+    # -- placement groups ----------------------------------------------
+    "create_pg": {"bundles": "list", "strategy": "str?", "name": "str?"},
+    "remove_pg": {"pg": "str"},
+    "pg_state": {"pg": "str"},
+    # -- serve frame ingress (proxy.py FrameIngress) -------------------
+    "serve_request": {"route": "str", "payload": "any?", "headers": "dict?"},
+}
+
+_TYPES = {
+    "str": str, "int": int, "float": (int, float), "bool": bool,
+    "bytes": (bytes, bytearray), "list": (list, tuple), "dict": dict,
+}
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def validate(msg: Any) -> None:
+    """Raise SchemaError if msg is not a well-formed frame for its op.
+
+    Unknown ops fail closed — an ingress accepting untrusted frames
+    must not forward ops the contract doesn't name."""
+    if not isinstance(msg, dict):
+        raise SchemaError(f"frame must be a dict, got {type(msg).__name__}")
+    op = msg.get("op")
+    if not isinstance(op, str):
+        raise SchemaError("frame missing string 'op'")
+    fields = SCHEMA.get(op)
+    if fields is None:
+        raise SchemaError(f"unknown op {op!r}")
+    for name, spec in fields.items():
+        optional = spec.endswith("?")
+        tname = spec.rstrip("?")
+        if name not in msg or msg[name] is None:
+            if optional:
+                continue
+            raise SchemaError(f"op {op!r} missing required field {name!r}")
+        if tname == "any":
+            continue
+        expected = tuple(
+            t for alt in tname.split("|")
+            for t in (_TYPES[alt] if isinstance(_TYPES[alt], tuple)
+                      else (_TYPES[alt],)))
+        if not isinstance(msg[name], expected):
+            raise SchemaError(
+                f"op {op!r} field {name!r}: expected {tname}, got "
+                f"{type(msg[name]).__name__}")
+    extra = set(msg) - set(fields) - {"op"}
+    if extra:
+        raise SchemaError(f"op {op!r} has undeclared fields {sorted(extra)}")
+
+
+def export_schema() -> Dict[str, Any]:
+    """The contract as plain JSON (for non-Python client generators)."""
+    return {"version": 1, "ops": SCHEMA}

@@ -506,7 +506,33 @@ class WorkerRuntime:
                 for _, m in inspect.getmembers(
                     type(self._actor_instance),
                     predicate=inspect.isfunction))
-            n = max(1, spec.max_concurrency)
+            # Named concurrency groups: one bounded executor pool per
+            # group (reference concurrency_group_manager.cc); methods
+            # annotated @ray_tpu.method(concurrency_group=...) run
+            # there, overlapping with the default lane while staying
+            # FIFO within their group.
+            groups = getattr(spec, "concurrency_groups", None) or {}
+            if groups:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._group_pools = {
+                    gname: ThreadPoolExecutor(
+                        max_workers=max(1, int(size)),
+                        thread_name_prefix=f"actor-cg-{gname}")
+                    for gname, size in groups.items()}
+                # With groups on, the queue thread is a pure
+                # dispatcher: un-grouped methods run on a default pool
+                # (size max_concurrency) so a long default-lane call
+                # never blocks dispatch into the other lanes.
+                self._group_pools["_default"] = ThreadPoolExecutor(
+                    max_workers=max(1, spec.max_concurrency),
+                    thread_name_prefix="actor-cg-default")
+            # With groups, exactly ONE dispatcher thread feeds the pools
+            # (multiple dispatchers would race task_queue.get -> submit
+            # and break FIFO within a group); concurrency comes from the
+            # pools themselves.  Without groups, the queue threads ARE
+            # the executors.
+            n = 1 if groups else max(1, spec.max_concurrency)
             for _ in range(n):
                 threading.Thread(target=self._actor_loop, name="actor-exec",
                                  daemon=True).start()
@@ -556,7 +582,27 @@ class WorkerRuntime:
                 # thread per in-flight call.
                 self._execute_async_actor_task(spec, method)
             else:
-                self._execute(spec, target_fn=method)
+                pools = getattr(self, "_group_pools", None)
+                if pools:
+                    group = getattr(method, "__concurrency_group__", None)
+                    if group is not None and group not in pools:
+                        # An undeclared group silently landing in the
+                        # default lane would quietly drop the isolation
+                        # the caller asked for — fail the call instead.
+                        self._store_returns(
+                            spec, TaskError(method_name, ValueError(
+                                f"method {method_name!r} names "
+                                f"concurrency group {group!r}, which "
+                                "this actor does not declare")),
+                            failed=True)
+                        self._finish(spec, failed=True)
+                        continue
+                    pool = pools.get(group) or pools["_default"]
+                    # Grouped dispatch: lanes overlap; FIFO within a
+                    # lane; the single dispatcher thread moves on.
+                    pool.submit(self._execute, spec, method)
+                else:
+                    self._execute(spec, target_fn=method)
 
     def _execute_async_actor_task(self, spec: TaskSpec, method):
         import asyncio

@@ -144,17 +144,33 @@ class CompiledDAG:
             return os.path.join(
                 shm_dir, f"raytpu-{session}-chan-{tag}-{producer_uid}")
 
+        # Producers hinted with .with_tensor_transport() get the device
+        # tensor tier (channel/tensor_channel.py): raw array bytes on
+        # the edge, jax.device_put on the consumer — no pickle.
+        uid_to_node = {n._uid: n for n in self._nodes}
+
+        def is_tensor_edge(producer_uid: int) -> bool:
+            node = uid_to_node.get(producer_uid)
+            return node is not None and \
+                getattr(node, "_tensor_transport", None) is not None
+
+        from ray_tpu.channel.tensor_channel import DeviceTensorChannel
+
+        def open_endpoint(uid: int, **kw) -> Channel:
+            cls = DeviceTensorChannel if is_tensor_edge(uid) else Channel
+            return cls(chan_path(uid), **kw)
+
         # one output channel per producer that has consumers
         self._channels: Dict[int, Channel] = {
-            uid: Channel(chan_path(uid), capacity=self._buffer,
-                         num_readers=nreaders, create=True)
+            uid: open_endpoint(uid, capacity=self._buffer,
+                               num_readers=nreaders, create=True)
             for uid, nreaders in edge_counter.items()
         }
 
         # driver endpoints
         self._input_writer = self._channels[self._input_node._uid]
         self._output_readers = [
-            Channel(chan_path(out._uid), reader_idx=slot)
+            open_endpoint(out._uid, reader_idx=slot)
             for out, slot in zip(self._output_nodes, driver_slots)
         ]
 
@@ -202,7 +218,8 @@ class CompiledDAG:
                 kind, v = entry
                 if kind == "chan-slot":
                     uid, slot = v
-                    return ("chan", (chan_path(uid), slot))
+                    proto = "devchan" if is_tensor_edge(uid) else "chan"
+                    return (proto, (chan_path(uid), slot))
                 return entry
 
             desc = {
@@ -210,7 +227,8 @@ class CompiledDAG:
                 "args": [to_spec(e) for e in slots["args"]],
                 "kwargs": {k: to_spec(e)
                            for k, e in slots["kwargs"].items()},
-                "output": (chan_path(n._uid), None)
+                "output": (chan_path(n._uid), None,
+                           is_tensor_edge(n._uid))
                 if n._uid in self._channels else None,
             }
             self._actors.append(n._actor)
@@ -311,16 +329,24 @@ def run_actor_loop(instance, desc: dict) -> int:
 
     method = getattr(instance, desc["method"])
 
-    def open_chan(spec):
-        path, reader_idx = spec
-        return Channel(path, reader_idx=reader_idx)
+    def open_chan(spec, tensor=False):
+        from ray_tpu.channel.tensor_channel import DeviceTensorChannel
 
-    arg_tmpl = [(k, open_chan(v) if k == "chan" else v)
+        path, reader_idx = spec[0], spec[1]
+        cls = DeviceTensorChannel if tensor else Channel
+        return cls(path, reader_idx=reader_idx)
+
+    arg_tmpl = [("chan", open_chan(v, tensor=(k == "devchan")))
+                if k in ("chan", "devchan") else (k, v)
                 for k, v in desc["args"]]
-    kwarg_tmpl = {name: (k, open_chan(v) if k == "chan" else v)
+    kwarg_tmpl = {name: (("chan", open_chan(v, tensor=(k == "devchan")))
+                         if k in ("chan", "devchan") else (k, v))
                   for name, (k, v) in desc["kwargs"].items()}
-    out: Optional[Channel] = (
-        open_chan(desc["output"]) if desc["output"] is not None else None)
+    out: Optional[Channel] = None
+    if desc["output"] is not None:
+        od = desc["output"]
+        out = open_chan(od[:2], tensor=bool(od[2]) if len(od) > 2
+                        else False)
     count = 0
     while True:
         try:

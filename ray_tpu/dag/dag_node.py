@@ -21,6 +21,21 @@ class DAGNode:
         self._bound_args = args
         self._bound_kwargs = kwargs
         self._uid = next(_node_counter)
+        # Edge hint: this node's OUTPUT values are device tensors; the
+        # compiled DAG moves them via the raw tensor protocol
+        # (channel/tensor_channel.py) instead of pickle.  Reference:
+        # DAGNode.with_tensor_transport + TorchTensorType.
+        self._tensor_transport = None
+
+    def with_tensor_transport(self, transport: str = "auto") -> "DAGNode":
+        """Mark this node's outputs as device tensors (jax.Arrays).
+
+        Consumers receive them on THEIR device via the tensor channel
+        tier — no pickle on the edge; see channel/tensor_channel.py."""
+        from ray_tpu.channel.tensor_channel import TensorType
+
+        self._tensor_transport = TensorType(transport)
+        return self
 
     # -- graph helpers ---------------------------------------------------
     def _upstream(self) -> List["DAGNode"]:

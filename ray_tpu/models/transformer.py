@@ -25,6 +25,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ray_tpu.parallel.sharding import (
     DEFAULT_RULES,
@@ -58,6 +59,11 @@ class TransformerConfig:
     # axis (ops/ring_attention.py).  "auto" uses it iff the ambient mesh
     # shards seq; True forces; False never.
     ring_attention: Any = "auto"
+    # Fused chunked cross-entropy (ops/fused_ce.py): never materializes
+    # the fp32 [tokens, vocab] logits — frees the GBs that let
+    # recompute-free remat policies fit HBM.  Training-loss path only;
+    # forward() still produces real logits for inference.
+    fused_ce: bool = False
     # Mixture-of-experts: num_experts > 0 replaces the dense FFN with a
     # top-k routed expert FFN (models/moe.py) on the "expert" mesh axis.
     num_experts: int = 0
@@ -249,7 +255,9 @@ def _block(x, bp, cos, sin, positions, mask, config: TransformerConfig):
     k = apply_rope(k, cos, sin, positions)
     attn = _attention(q, k, v, mask, c)
     attn = attn.reshape(b, s, c.num_heads * hd)
-    x = x + (attn @ bp["wo"].astype(c.dtype))
+    attn_proj = checkpoint_name(
+        attn @ bp["wo"].astype(c.dtype), "attn_proj")
+    x = x + attn_proj
     x = with_logical_constraint(x, ("batch", "seq", "embed"))
 
     y = rms_norm(x, bp["mlp_norm"], c.rms_eps)
@@ -267,7 +275,9 @@ def _block(x, bp, cos, sin, positions, mask, config: TransformerConfig):
         gate = jax.nn.silu(y @ bp["w_gate"].astype(c.dtype))
         up = y @ bp["w_up"].astype(c.dtype)
         ffn = with_logical_constraint(gate * up, ("batch", "seq", "mlp"))
-        x = x + (ffn @ bp["w_down"].astype(c.dtype))
+        mlp_out = checkpoint_name(
+            ffn @ bp["w_down"].astype(c.dtype), "mlp_out")
+        x = x + mlp_out
     return with_logical_constraint(x, ("batch", "seq", "embed")), aux
 
 
@@ -305,18 +315,29 @@ def _maybe_remat(block_fn, c: TransformerConfig):
             block_fn,
             policy=jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "attn_lse"))
+    if c.remat_policy == "dots_no_mlp":
+        # "dots" minus its biggest buffers: save every matmul output
+        # EXCEPT the gate/up MLP intermediates ([b, s, intermediate] —
+        # 4x the hidden-size tensors), which the backward recomputes
+        # from the saved layer input.  ~40% of dots' activation memory
+        # for ~0.6N of the 2N recompute "full" pays — the policy that
+        # fits billion-class models at useful batch sizes.
+        return jax.checkpoint(
+            block_fn,
+            policy=jax.checkpoint_policies.save_only_these_names(
+                "attn_q", "attn_k", "attn_v", "attn_out", "attn_lse",
+                "attn_proj", "mlp_out"))
     if c.remat_policy == "full":
         return jax.checkpoint(block_fn)
-    raise ValueError(f"unknown remat_policy {c.remat_policy!r}; "
-                     "expected 'full', 'dots' or 'save_attn'")
+    raise ValueError(f"unknown remat_policy {c.remat_policy!r}; expected "
+                     "'full', 'dots', 'save_attn' or 'dots_no_mlp'")
 
 
-def forward(params: Dict[str, Any], tokens, config: TransformerConfig,
-            positions=None, return_aux: bool = False):
-    """tokens: [b, s] int32 → logits [b, s, vocab] (fp32).
-
-    With return_aux=True also returns the MoE router load-balance loss
-    (zero for dense models)."""
+def forward_hidden(params: Dict[str, Any], tokens,
+                   config: TransformerConfig, positions=None):
+    """Embed + layer stack + final RMSNorm (no lm head): returns
+    (x_normed [b, s, h], moe_aux).  The fused-CE training path consumes
+    this directly (ops/fused_ce.py)."""
     c = config
     b, s = tokens.shape
     if positions is None:
@@ -339,8 +360,22 @@ def forward(params: Dict[str, Any], tokens, config: TransformerConfig,
             scan_body, (x, aux_total), params["blocks"])
     else:
         x, aux_total = block_fn(x, params["blocks"])
+    return rms_norm(x, params["final_norm"], c.rms_eps), aux_total
 
-    logits = _lm_head(params, x, c)
+
+def forward(params: Dict[str, Any], tokens, config: TransformerConfig,
+            positions=None, return_aux: bool = False):
+    """tokens: [b, s] int32 → logits [b, s, vocab] (fp32).
+
+    With return_aux=True also returns the MoE router load-balance loss
+    (zero for dense models)."""
+    c = config
+    x, aux_total = forward_hidden(params, tokens, c, positions)
+    logits = jnp.einsum(
+        "bsh,vh->bsv", x.astype(c.dtype),
+        params["tok_embed"].astype(c.dtype),
+        preferred_element_type=jnp.float32)
+    logits = with_logical_constraint(logits, ("batch", "seq", "vocab"))
     if return_aux:
         return logits, aux_total
     return logits
@@ -430,15 +465,29 @@ def loss_fn(params, batch, config: TransformerConfig):
     batch: {"tokens": [b, s+1] int32}."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits, aux = forward(params, inputs, config, return_aux=True)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = batch.get("mask")
-    if mask is not None:
-        mask = mask[:, 1:]
-        ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    if config.fused_ce:
+        from ray_tpu.ops.fused_ce import fused_ce_nll
+
+        b, s = inputs.shape
+        x, aux = forward_hidden(params, inputs, config)
+        nll = fused_ce_nll(x.reshape(b * s, -1), params["tok_embed"],
+                           targets.reshape(-1))
+        if mask is not None:
+            m = mask[:, 1:].reshape(-1)
+            ce = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1)
+        else:
+            ce = jnp.mean(nll)
     else:
-        ce = jnp.mean(nll)
+        logits, aux = forward(params, inputs, config, return_aux=True)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, targets[..., None], axis=-1)[..., 0]
+        if mask is not None:
+            m = mask[:, 1:]
+            ce = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1)
+        else:
+            ce = jnp.mean(nll)
     if config.num_experts > 0:
         ce = ce + config.router_aux_coef * aux / config.num_layers
     return ce

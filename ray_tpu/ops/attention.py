@@ -408,9 +408,12 @@ def _flash_lse_fwd(q, k, v, offs, causal, sm_scale, block_q, block_k):
     # q/k/v residuals are cheap projections the remat re-derives.
     from jax.ad_checkpoint import checkpoint_name
 
+    q_r = checkpoint_name(q, "attn_q")
+    k_r = checkpoint_name(k, "attn_k")
+    v_r = checkpoint_name(v, "attn_v")
     out_r = checkpoint_name(out, "attn_out")
     lse_r = checkpoint_name(lse, "attn_lse")
-    return (out, lse), (q, k, v, out_r, lse_r, offs)
+    return (out, lse), (q_r, k_r, v_r, out_r, lse_r, offs)
 
 
 def _flash_lse_bwd(causal, sm_scale, block_q, block_k, res, cts):
@@ -446,7 +449,7 @@ def flash_attention_chunk(q, k, v, q_off, kv_off, causal: bool = True,
 
 def flash_attention(q, k, v, causal: bool = True,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: int = 512, block_k: int = 512):
     """Tiled attention. q:[b,s,h,d], k/v:[b,t,h,d] -> [b,s,h,d].
 
     Uses the Pallas kernels on TPU (or in interpret mode for tests); falls

@@ -1,0 +1,123 @@
+"""Fused cross-entropy over a chunked vocabulary projection.
+
+The naive lm-head + log_softmax path materializes fp32 logits
+[tokens, vocab] TWICE (forward value + saved-for-backward) — 2 x 1.6 GB
+at the headline bench shapes, the buffer that decides whether the
+fast `dots_no_mlp` remat policy fits HBM.  This custom-vjp computes
+mean next-token NLL by scanning vocab chunks: the forward keeps only
+the running log-sum-exp and the target logit ([tokens] fp32 each), the
+backward recomputes each chunk's logits to form (softmax - onehot) and
+accumulates dx / dW on the fly.  Peak extra memory = one
+[tokens, chunk] fp32 tile instead of [tokens, vocab].
+
+Cost: one extra tokens x h x V matmul in the backward (logit
+recompute) — ~6% of model FLOPs, traded for the GBs that buy a
+recompute-free remat policy elsewhere.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _pick_chunks(vocab: int, target: int = 4096) -> int:
+    """Largest chunk count <= vocab/target that divides vocab."""
+    n = max(1, vocab // target)
+    while vocab % n:
+        n -= 1
+    return n
+
+
+@jax.custom_vjp
+def fused_ce_nll(x, w, targets):
+    """Per-token NLL of a tied lm head without full logits.
+
+    x:       [T, h]  final-norm hidden states (bf16)
+    w:       [V, h]  vocab projection (the tied embedding; any dtype)
+    targets: [T] int32
+    Returns [T] fp32 NLL; callers apply their own mask/mean (the
+    cotangent rides into the backward as per-row weights).
+    """
+    nll, _ = _ce_fwd_core(x, w, targets)
+    return nll
+
+
+def _ce_fwd_core(x, w, targets):
+    T, h = x.shape
+    V = w.shape[0]
+    n_chunks = _pick_chunks(V)
+    C = V // n_chunks
+    wc = w.reshape(n_chunks, C, h)
+    xb = x.astype(jnp.bfloat16)
+
+    def body(carry, inputs):
+        m, s, tgt_logit = carry
+        ci, w_chunk = inputs
+        logits = jnp.einsum(
+            "th,ch->tc", xb, w_chunk.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32)          # [T, C]
+        new_m = jnp.maximum(m, logits.max(axis=1))
+        s = s * jnp.exp(m - new_m) + jnp.exp(
+            logits - new_m[:, None]).sum(axis=1)
+        base = ci * C
+        in_chunk = (targets >= base) & (targets < base + C)
+        idx = jnp.clip(targets - base, 0, C - 1)
+        tl = jnp.take_along_axis(logits, idx[:, None], axis=1)[:, 0]
+        tgt_logit = jnp.where(in_chunk, tl, tgt_logit)
+        return (new_m, s, tgt_logit), None
+
+    m0 = jnp.full((T,), -jnp.inf, jnp.float32)
+    s0 = jnp.zeros((T,), jnp.float32)
+    t0 = jnp.zeros((T,), jnp.float32)
+    (m, s, tgt_logit), _ = jax.lax.scan(
+        body, (m0, s0, t0), (jnp.arange(n_chunks), wc))
+    lse = m + jnp.log(s)
+    nll = lse - tgt_logit                                 # [T]
+    return nll, (x, w, targets, lse)
+
+
+def _ce_fwd(x, w, targets):
+    return _ce_fwd_core(x, w, targets)
+
+
+def _ce_bwd(res, g):
+    x, w, targets, lse = res
+    T, h = x.shape
+    V = w.shape[0]
+    n_chunks = _pick_chunks(V)
+    C = V // n_chunks
+    wc = w.reshape(n_chunks, C, h)
+    xb = x.astype(jnp.bfloat16)
+    row_g = g.astype(jnp.float32)                         # [T]
+
+    def body(dx, inputs):
+        ci, w_chunk = inputs
+        logits = jnp.einsum(
+            "th,ch->tc", xb, w_chunk.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])                # softmax chunk
+        base = ci * C
+        in_chunk = (targets >= base) & (targets < base + C)
+        idx = jnp.clip(targets - base, 0, C - 1)
+        onehot = (jax.nn.one_hot(idx, C, dtype=jnp.float32)
+                  * in_chunk[:, None].astype(jnp.float32))
+        dlogits = (p - onehot) * row_g[:, None]           # [T, C] fp32
+        dl16 = dlogits.astype(jnp.bfloat16)
+        dx = dx + jnp.einsum(
+            "tc,ch->th", dl16, w_chunk.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32)
+        dw_chunk = jnp.einsum(
+            "tc,th->ch", dl16, xb,
+            preferred_element_type=jnp.float32)
+        return dx, dw_chunk
+
+    dx0 = jnp.zeros((T, h), jnp.float32)
+    dx, dwc = jax.lax.scan(body, dx0, (jnp.arange(n_chunks), wc))
+    dw = dwc.reshape(V, h).astype(w.dtype)
+    return dx.astype(x.dtype), dw, None
+
+
+fused_ce_nll.defvjp(_ce_fwd, _ce_bwd)

@@ -22,11 +22,26 @@ batch composition (continuous batching never recompiles).
 
 from __future__ import annotations
 
+import functools
 import math
+import os
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _platform() -> str:
+    try:
+        return jax.devices()[0].platform
+    except Exception:  # noqa: BLE001
+        return "cpu"
+
+
+def _interpret_mode() -> bool:
+    return os.environ.get("RAY_TPU_PALLAS_INTERPRET", "") == "1"
 
 
 def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
@@ -40,7 +55,26 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     context_lens: [B] int32           tokens in cache per sequence
                                       (including the current one)
     Returns [B, H, D].
+
+    On TPU this runs the Pallas kernel below (pages stream through VMEM
+    driven by the scalar-prefetched block table — the gathered
+    [B, T, KVH, D] intermediate is never materialized in HBM); other
+    platforms use the XLA gather formulation.
     """
+    B, H, D = q.shape
+    P, page, KVH, _ = k_pages.shape
+    if ((_platform() == "tpu" or _interpret_mode())
+            and D % 128 == 0 and H % KVH == 0):
+        return _paged_attention_pallas(
+            q, k_pages, v_pages, block_tables, context_lens,
+            sm_scale if sm_scale is not None else 1.0 / math.sqrt(D))
+    return _paged_attention_gather(
+        q, k_pages, v_pages, block_tables, context_lens, sm_scale)
+
+
+def _paged_attention_gather(q, k_pages, v_pages, block_tables,
+                            context_lens, sm_scale: float | None = None):
+    """XLA gather formulation (non-TPU fallback)."""
     B, H, D = q.shape
     P, page, KVH, _ = k_pages.shape
     max_pages = block_tables.shape[1]
@@ -64,6 +98,98 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgt,btkd->bkgd", probs, v.astype(jnp.float32))
     return out.reshape(B, H, D).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas decode kernel: one grid step per (sequence, page); the block
+# table rides as a scalar-prefetch operand so each step's BlockSpec DMAs
+# exactly the page it needs.  Flash-style running (max, sum, acc) in
+# VMEM scratch across the page axis.
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, page: int, W: int,
+                         kvh: int, g: int, sm_scale: float):
+    b = pl.program_id(0)
+    w = pl.program_id(1)
+
+    @pl.when(w == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    ctx = ctx_ref[b]
+
+    @pl.when(w * page < ctx)
+    def _compute():
+        d = q_ref.shape[-1]
+        q = q_ref[0].astype(jnp.float32).reshape(kvh, g, d)   # [KVH,G,D]
+        k = k_ref[0].astype(jnp.float32)                      # [page,KVH,D]
+        v = v_ref[0].astype(jnp.float32)
+        kt = k.transpose(1, 0, 2)                             # [KVH,page,D]
+        logits = jax.lax.dot_general(
+            q, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * sm_scale    # [KVH,G,page]
+        pos = w * page + jax.lax.broadcasted_iota(
+            jnp.int32, (kvh, g, page), 2)
+        logits = jnp.where(pos < ctx, logits, -jnp.inf)
+
+        m_prev = m_ref[...]                                   # [KVH, G]
+        m_new = jnp.maximum(m_prev, logits.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(logits - m_new[..., None])                # [KVH,G,page]
+        l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1)
+        vt = v.transpose(1, 0, 2)                             # [KVH,page,D]
+        pv = jax.lax.dot_general(
+            p, vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)               # [KVH,G,D]
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
+        m_ref[...] = m_new
+
+    @pl.when(w == W - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[...], 1e-30)[..., None]
+        h = kvh * g
+        o_ref[0] = (acc_ref[...] / l).reshape(h, q_ref.shape[-1]) \
+            .astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pages, v_pages, block_tables,
+                            context_lens, sm_scale: float):
+    B, H, D = q.shape
+    P, page, KVH, _ = k_pages.shape
+    W = block_tables.shape[1]
+    G = H // KVH
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, W),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, w, tables, ctx: (b, 0, 0)),
+            pl.BlockSpec((1, page, KVH, D),
+                         lambda b, w, tables, ctx: (tables[b, w], 0, 0, 0)),
+            pl.BlockSpec((1, page, KVH, D),
+                         lambda b, w, tables, ctx: (tables[b, w], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, H, D), lambda b, w, tables, ctx: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KVH, G), jnp.float32),
+            pltpu.VMEM((KVH, G), jnp.float32),
+            pltpu.VMEM((KVH, G, D), jnp.float32),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page=page, W=W, kvh=KVH,
+                          g=G, sm_scale=sm_scale),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=_interpret_mode(),
+    )
+    return kernel(block_tables.astype(jnp.int32),
+                  context_lens.astype(jnp.int32), q, k_pages, v_pages)
 
 
 def write_page_tokens(k_pages, v_pages, k_new, v_new, block_tables,

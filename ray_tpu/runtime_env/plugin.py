@@ -90,33 +90,98 @@ class PyModulesPlugin(RuntimeEnvPlugin):
 
 
 class PipPlugin(RuntimeEnvPlugin):
-    """Validation-only pip plugin.
+    """pip plugin: real env materialization from a local wheel source.
 
-    The reference's pip plugin creates a virtualenv and installs packages
-    (runtime_env/pip.py). This runtime has no network egress, so instead
-    of silently doing nothing we verify each requested distribution is
-    already present in the worker image and fail fast with a clear error
-    if not — same contract (the task runs only if its deps exist),
-    different mechanism. Version specifiers are NOT checked, only
-    presence.
+    The reference's pip plugin creates a virtualenv and downloads
+    packages (runtime_env/pip.py).  This runtime is zero-egress, so the
+    install source must be LOCAL: with ``{"pip": {"packages": [...],
+    "wheel_dir": "/path/to/wheels"}}`` (or RAY_TPU_WHEEL_DIR set) the
+    plugin materializes a per-node site directory via
+    ``pip install --no-index --find-links <wheel_dir> --target <env>``,
+    cached by content hash of (requirements, wheel set) so every worker
+    on the node reuses one build — the role of the reference's per-node
+    runtime-env agent cache, with the venv's python swapped for a
+    sys.path prefix because workers are already-running processes.
+
+    Without a wheel source the plugin degrades to validation: each
+    requested distribution must already exist in the image, checked by
+    name (version specifiers are not checked), failing fast otherwise.
     """
 
     name = "pip"
     priority = 40
 
     def apply(self, value, ctx, kv_call):
-        import re
-
-        reqs = value.get("packages", value) if isinstance(value, dict) \
-            else value
+        wheel_dir = None
+        reqs = value
+        if isinstance(value, dict):
+            reqs = value.get("packages", [])
+            wheel_dir = value.get("wheel_dir")
         if isinstance(reqs, str):
             reqs = [reqs]
+        reqs = [str(r).strip() for r in (reqs or []) if str(r).strip()]
+        wheel_dir = wheel_dir or os.environ.get("RAY_TPU_WHEEL_DIR")
+        if not reqs:
+            return  # nothing requested: a bare wheel_dir is a no-op
+        if wheel_dir:
+            self._materialize(reqs, wheel_dir, ctx)
+        else:
+            self._validate(reqs)
+
+    def _materialize(self, reqs, wheel_dir: str, ctx):
+        import hashlib
+        import subprocess
+
+        wheel_dir = os.path.abspath(wheel_dir)
+        if not os.path.isdir(wheel_dir):
+            raise RuntimeError(
+                f"runtime_env pip wheel_dir {wheel_dir!r} does not exist")
+        # Content hash: requirements + the wheel files available.  A new
+        # wheel drop or changed requirement builds a fresh env.
+        h = hashlib.sha1()
+        for r in sorted(reqs):
+            h.update(r.encode())
+        for f in sorted(os.listdir(wheel_dir)):
+            if f.endswith(".whl"):
+                st = os.stat(os.path.join(wheel_dir, f))
+                h.update(f"{f}:{st.st_size}:{int(st.st_mtime)}".encode())
+        env_dir = os.path.join(ctx.cache_dir, f"pip-{h.hexdigest()[:16]}")
+        marker = os.path.join(env_dir, ".ready")
+        if not os.path.exists(marker):
+            lock = env_dir + ".lock"
+            fd = os.open(lock, os.O_CREAT | os.O_RDWR)
+            try:
+                import fcntl
+
+                fcntl.flock(fd, fcntl.LOCK_EX)  # one builder per node
+                if not os.path.exists(marker):
+                    os.makedirs(env_dir, exist_ok=True)
+                    cmd = [sys.executable, "-m", "pip", "install",
+                           "--quiet", "--no-index",
+                           "--find-links", wheel_dir,
+                           "--target", env_dir, *reqs]
+                    proc = subprocess.run(cmd, capture_output=True,
+                                          text=True, timeout=600)
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            "runtime_env pip install failed "
+                            f"(--no-index, local wheels only): "
+                            f"{proc.stderr[-2000:]}")
+                    with open(marker, "w") as f:
+                        f.write("\n".join(reqs))
+            finally:
+                os.close(fd)
+        ctx.py_paths.append(env_dir)
+
+    def _validate(self, reqs):
+        import re
+
         missing = []
-        for req in reqs or []:
+        for req in reqs:
             # Project name = everything before any extras / specifier /
             # marker (PEP 508): 'numpy>1.20', 'requests[socks]==2',
             # 'pkg; python_version<"3.11"' all reduce to the name.
-            name = re.split(r"[\s\[<>=!~;(]", str(req).strip(), 1)[0]
+            name = re.split(r"[\s\[<>=!~;(]", req, 1)[0]
             if not name:
                 continue
             found = importlib.util.find_spec(name.replace("-", "_")) \
@@ -129,16 +194,19 @@ class PipPlugin(RuntimeEnvPlugin):
                 except Exception:
                     found = False
             if not found:
-                missing.append(str(req))
+                missing.append(req)
         if missing:
             raise RuntimeError(
                 f"runtime_env pip packages not available in this "
-                f"zero-egress image: {missing}; bake them into the image "
-                f"or drop the requirement")
+                f"zero-egress image: {missing}; provide a local "
+                f"wheel_dir to materialize them, bake them into the "
+                f"image, or drop the requirement")
 
 
 class CondaPlugin(PipPlugin):
-    """Conda envs collapse to the same validation-only contract."""
+    """Conda envs collapse to the validation contract — conda version
+    specs (single '=') aren't pip requirements, so they must never be
+    routed into the wheel-dir materializer."""
 
     name = "conda"
     priority = 40
@@ -148,7 +216,12 @@ class CondaPlugin(PipPlugin):
             deps = value.get("dependencies", [])
             value = [d for d in deps if isinstance(d, str)
                      and d != "python"]
-        super().apply(value, ctx, kv_call)
+        if isinstance(value, str):
+            value = [value]
+        reqs = [str(r).strip() for r in (value or []) if str(r).strip()]
+        if reqs:
+            # Name-only presence check; strip conda's name=ver form.
+            self._validate([r.split("=")[0] for r in reqs])
 
 
 _PLUGINS: Dict[str, RuntimeEnvPlugin] = {}

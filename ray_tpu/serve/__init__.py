@@ -19,6 +19,7 @@ from ray_tpu.serve.api import (
     start_frame_ingress,
     status,
 )
+from ray_tpu.serve.asgi import asgi_app, ingress
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import (
     ApplicationStatus,
@@ -58,6 +59,8 @@ __all__ = [
     "get_multiplexed_model_id",
     "get_replica_context",
     "Request",
+    "ingress",
+    "asgi_app",
 ]
 
 # Feature-usage tag (util/usage_stats.py; local-only, no egress).

@@ -68,8 +68,11 @@ def run(app: Application, *, name: str = "default",
                 node.deployment.config.autoscaling_config.to_dict()
                 if node.deployment.config.autoscaling_config else None),
         })
+    is_asgi = bool(getattr(ingress.deployment.func_or_class,
+                           "__serve_asgi__", False))
     ray_tpu.get(controller.deploy_application.remote(
-        name, route_prefix, ingress.deployment.name, payload), timeout=30)
+        name, route_prefix, ingress.deployment.name, payload,
+        is_asgi=is_asgi), timeout=30)
     if _blocking:
         _wait_for_app(controller, name, blocking_timeout_s)
     return DeploymentHandle(ingress.deployment.name, name)

@@ -75,6 +75,9 @@ class AppTarget:
     ingress: str  # ingress deployment name
     deployments: Dict[str, DeploymentTarget] = field(default_factory=dict)
     deleting: bool = False
+    # Ingress speaks the ASGI contract (serve/asgi.py): the proxy
+    # renders its streamed response events as raw HTTP.
+    is_asgi: bool = False
 
 
 class ServeController:
@@ -96,7 +99,8 @@ class ServeController:
     def deploy_application(self, app_name: str,
                            route_prefix: Optional[str],
                            ingress_name: str,
-                           deployments: List[dict]) -> None:
+                           deployments: List[dict],
+                           is_asgi: bool = False) -> None:
         """deployments: [{name, blob, config(dict),
         autoscaling(dict|None)}]"""
         with self._lock:
@@ -106,6 +110,7 @@ class ServeController:
                 self._apps[app_name] = app
             app.route_prefix = route_prefix
             app.ingress = ingress_name
+            app.is_asgi = is_asgi
             app.deleting = False
             new_names = set()
             for d in deployments:
@@ -524,7 +529,8 @@ class ServeController:
             routes = {}
             for app in self._apps.values():
                 if app.route_prefix and not app.deleting:
-                    routes[app.route_prefix] = (app.name, app.ingress)
+                    routes[app.route_prefix] = (app.name, app.ingress,
+                                                app.is_asgi)
         self._poll.set("routes", routes)
 
 

@@ -99,11 +99,14 @@ class _RouteTable:
                 controller = None
                 time.sleep(0.5)
 
-    def _match_route(self, path: str) -> Optional[Tuple[str, str, str]]:
+    def _match_route(self, path: str
+                     ) -> Optional[Tuple[str, str, str, bool]]:
         with self._routes_lock:
             routes = dict(self._routes)
         best = None
-        for prefix, (app, ingress) in routes.items():
+        for prefix, entry in routes.items():
+            app, ingress = entry[0], entry[1]
+            is_asgi = bool(entry[2]) if len(entry) > 2 else False
             norm = prefix.rstrip("/") or "/"
             if path == norm or path.startswith(
                     norm if norm != "/" else "/"):
@@ -111,7 +114,7 @@ class _RouteTable:
                         path == norm or path[len(norm):][:1] in ("/", "?")):
                     continue
                 if best is None or len(norm) > len(best[0]):
-                    best = (norm, app, ingress)
+                    best = (norm, app, ingress, is_asgi)
         return best
 
 
@@ -289,11 +292,16 @@ class HTTPProxy(_RouteTable):
             self._write_response(writer, 404, json.dumps(
                 {"error": f"no application at {path}"}).encode())
             return await writer.drain()
-        _, app, ingress = match
+        prefix, app, ingress, is_asgi = match
         from ray_tpu.serve.handle import DeploymentHandle
 
         handle = DeploymentHandle(ingress, app)
         req = Request(method, path, parse_qs(parsed.query), body, headers)
+        if is_asgi:
+            # ASGI ingress: the replica streams response events
+            # (serve/asgi.py); render them as real HTTP, chunked so
+            # streaming responses flush as the app sends.
+            return await self._dispatch_asgi(writer, handle, req)
         if self._wants_stream(headers):
             return await self._dispatch_streaming(writer, handle, req)
         try:
@@ -344,6 +352,99 @@ class HTTPProxy(_RouteTable):
                 if attempts >= 3:
                     raise
 
+    async def _acquire_stream(self, writer, handle, req,
+                              timeout_s: float = DATA_PLANE_TIMEOUT_S):
+        """Obtain a streaming generator from a replica with async
+        backpressure retries; writes the error response and returns
+        None when no replica materializes in time."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout_s
+        while True:
+            try:
+                return await loop.run_in_executor(
+                    None, lambda: handle.options(
+                        stream=True, assign_timeout_s=0.0).remote(req))
+            except TimeoutError:
+                if loop.time() >= deadline:
+                    self._write_response(writer, 503, json.dumps(
+                        {"error": "no replica available"}).encode())
+                    await writer.drain()
+                    return None
+                await asyncio.sleep(0.02)
+            except Exception as e:  # noqa: BLE001
+                self._write_response(writer, 500, json.dumps(
+                    {"error": str(e)}).encode())
+                await writer.drain()
+                return None
+
+    async def _dispatch_asgi(self, writer, handle, req,
+                             timeout_s: float = DATA_PLANE_TIMEOUT_S):
+        """Render an ASGI ingress's streamed response events
+        (serve/asgi.py asgi_stream) as raw HTTP: the first item carries
+        status + headers, subsequent raw-bytes items are body chunks
+        (Transfer-Encoding: chunked, so app-driven streaming flushes)."""
+        gen = await self._acquire_stream(writer, handle, req, timeout_s)
+        if gen is None:
+            return
+        state = {"i": 0, "eos_consumed": False}
+        started = False
+        failed_mid_stream = False
+        try:
+            async for item in _astream_values(gen.task_id, state):
+                if not started:
+                    if not (isinstance(item, dict)
+                            and "__asgi_start__" in item):
+                        raise RuntimeError(
+                            "ASGI ingress did not send a response start")
+                    start = item["__asgi_start__"]
+                    # Content-Length is replaced by chunked transfer;
+                    # hop-by-hop headers stay ours.
+                    hdrs = "".join(
+                        f"{k}: {v}\r\n" for k, v in start["headers"]
+                        if k.lower() not in ("content-length",
+                                             "transfer-encoding",
+                                             "connection"))
+                    writer.write(
+                        f"HTTP/1.1 {start['status']} \r\n{hdrs}"
+                        f"Transfer-Encoding: chunked\r\n"
+                        f"Connection: keep-alive\r\n\r\n".encode())
+                    await writer.drain()
+                    started = True
+                    continue
+                data = bytes(item)
+                if data:
+                    writer.write(f"{len(data):x}\r\n".encode() + data
+                                 + b"\r\n")
+                    await writer.drain()
+        except (ConnectionError, OSError):
+            raise
+        except Exception as e:  # noqa: BLE001
+            if not started:
+                self._write_response(writer, 500, json.dumps(
+                    {"error": str(e)}).encode())
+                return await writer.drain()
+            # Mid-stream failure: abort the connection WITHOUT the
+            # chunked terminator — a truncated chunked body is the
+            # protocol-level failure signal; writing "0\r\n\r\n" would
+            # present the partial body as a complete 200.
+            failed_mid_stream = True
+        finally:
+            gen._release()
+            try:
+                from ray_tpu.core.runtime import get_runtime
+
+                get_runtime().core.client.send({
+                    "op": "free_stream", "task": gen.task_id.hex(),
+                    "from_index": state["i"],
+                    "eos_consumed": state["eos_consumed"]})
+            except Exception:
+                pass
+        if failed_mid_stream:
+            writer.close()
+            return
+        writer.write(b"0\r\n\r\n")
+        await writer.drain()
+
     async def _dispatch_streaming(self, writer, handle, req,
                                   timeout_s: float = DATA_PLANE_TIMEOUT_S):
         """Chunked transfer: one JSON document per line per yielded item,
@@ -351,24 +452,9 @@ class HTTPProxy(_RouteTable):
         token streaming for LLM chat).  Replica backpressure is an async
         sleep/retry (assign_timeout_s=0), same as _call_async — a full
         cluster must not park an executor thread per waiting stream."""
-        loop = asyncio.get_running_loop()
-        deadline = loop.time() + timeout_s
-        while True:
-            try:
-                gen = await loop.run_in_executor(
-                    None, lambda: handle.options(
-                        stream=True, assign_timeout_s=0.0).remote(req))
-                break
-            except TimeoutError:
-                if loop.time() >= deadline:
-                    self._write_response(writer, 503, json.dumps(
-                        {"error": "no replica available"}).encode())
-                    return await writer.drain()
-                await asyncio.sleep(0.02)
-            except Exception as e:  # noqa: BLE001
-                self._write_response(writer, 500, json.dumps(
-                    {"error": str(e)}).encode())
-                return await writer.drain()
+        gen = await self._acquire_stream(writer, handle, req, timeout_s)
+        if gen is None:
+            return
         writer.write(
             b"HTTP/1.1 200 OK\r\n"
             b"Content-Type: application/jsonl\r\n"
@@ -498,7 +584,7 @@ class FrameProxy(_RouteTable):
         match = self._match_route(route)
         if match is None:
             raise ValueError(f"no application at {route}")
-        _, app, ingress = match
+        _, app, ingress, _is_asgi = match
         from ray_tpu.serve.handle import DeploymentHandle
 
         handle = DeploymentHandle(ingress, app)

@@ -1,0 +1,82 @@
+"""Isolated flash-attention kernel timings at the headline bench shapes.
+
+Prints fwd and fwd+bwd wall times and achieved FLOP/s vs the chip peak,
+for a grid of (block_q, block_k) — locates how much of the train step's
+non-MXU time lives in the attention kernels and which tiling recovers it.
+
+Usage: python scripts/bench_attention.py [b] [s] [h] [d]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import _peak_flops
+    from ray_tpu.ops.attention import flash_attention
+
+    b = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    s = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+    h = int(sys.argv[3]) if len(sys.argv) > 3 else 14
+    d = int(sys.argv[4]) if len(sys.argv) > 4 else 128
+
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (b, s, h, d), dtype=jnp.bfloat16)
+    k = jax.random.normal(key, (b, s, h, d), dtype=jnp.bfloat16)
+    v = jax.random.normal(key, (b, s, h, d), dtype=jnp.bfloat16)
+
+    peak = _peak_flops(jax.devices()[0])
+    # causal attention FLOPs: 2 matmuls (QK^T, PV) over the lower
+    # triangle = 2 * 2 * b*h*s^2*d / 2
+    fwd_flops = 2 * b * h * s * s * d
+    steps = 20
+
+    for bq, bk in ((128, 128), (256, 256), (256, 512), (512, 512),
+                   (128, 512), (512, 1024)):
+        if bq > s or bk > s:
+            continue
+
+        def fwd(q, k, v, bq=bq, bk=bk):
+            return flash_attention(q, k, v, causal=True,
+                                   block_q=bq, block_k=bk)
+
+        jfwd = jax.jit(fwd)
+        out = jfwd(q, k, v)
+        float(out.sum())  # sync
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = jfwd(q, k, v)
+        float(out.sum())
+        dt = (time.perf_counter() - t0) / steps
+        eff_f = fwd_flops / dt / peak
+
+        def loss(q, k, v, bq=bq, bk=bk):
+            return flash_attention(q, k, v, causal=True, block_q=bq,
+                                   block_k=bk).astype(jnp.float32).sum()
+
+        jgrad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        g = jgrad(q, k, v)
+        float(g[0].sum())
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            g = jgrad(q, k, v)
+        float(g[0].sum())
+        dtg = (time.perf_counter() - t0) / steps
+        # fwd+bwd ~ 3.5x fwd matmul work (dq, dk, dv + p recompute x2)
+        eff_g = 3.5 * fwd_flops / dtg / peak
+        print(f"bq={bq:<4d} bk={bk:<4d}: fwd {dt*1e3:7.2f} ms "
+              f"({eff_f*100:5.1f}% peak)   fwd+bwd {dtg*1e3:7.2f} ms "
+              f"({eff_g*100:5.1f}% of peak at 3.5x-fwd credit)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -7,8 +7,11 @@ Prints one line per combo; OOMs are reported and skipped.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run(policy: str, batch: int, seq: int = 2048, steps: int = 10):
@@ -20,20 +23,27 @@ def run(policy: str, batch: int, seq: int = 2048, steps: int = 10):
     from ray_tpu.parallel.mesh import build_mesh
     from ray_tpu.train.train_state import ShardedTrainStep, default_optimizer
 
-    sys.path.insert(0, ".")
     from bench import _peak_flops
 
+    # Policy suffixes: "+nu16" stores Adam's second moment in bf16
+    # (train/optim.py); "+fce" uses the fused chunked cross-entropy
+    # (ops/fused_ce.py) — both buy the HBM headroom that lets faster
+    # remat policies fit.
+    nu16 = "+nu16" in policy
+    fce = "+fce" in policy
+    policy = policy.replace("+nu16", "").replace("+fce", "")
     config = tfm.TransformerConfig(
         vocab_size=32000, hidden_size=1792, intermediate_size=7168,
         num_layers=16, num_heads=14, num_kv_heads=14, max_seq_len=seq,
-        remat_policy=policy,
+        remat_policy=policy, fused_ce=fce,
     )
     devices = jax.devices()
     mesh = build_mesh(axes={"fsdp": len(devices)}, devices=devices)
     ts = ShardedTrainStep(
         config, mesh,
-        optimizer=default_optimizer(warmup_steps=10, total_steps=1000,
-                                    mu_dtype=jnp.bfloat16))
+        optimizer=default_optimizer(
+            warmup_steps=10, total_steps=1000, mu_dtype=jnp.bfloat16,
+            nu_dtype=jnp.bfloat16 if nu16 else None))
     state = ts.init(jax.random.key(0))
     rng = np.random.default_rng(0)
     batch_np = {"tokens": jnp.asarray(

@@ -1,0 +1,147 @@
+"""Control-plane scale probe: locate the head's ceiling on one host.
+
+VERDICT r2 weak #8: the biggest cluster any test exercised was 3 logical
+nodes; BASELINE.md's envelope rows are 2,000 nodes / 40k actors / 1M
+queued tasks / 1k PGs (on 64-core cloud hosts).  This probe drives the
+same four dimensions as far as one host allows and records the rates:
+
+  - logical nodes registered (default 50)
+  - queued no-op tasks drained through the scheduler (default 10k)
+  - actors created to ALIVE (default 1000 — each actor is a real
+    process, so on small hosts the bound is process spawn, not the
+    head; the probe records both the rate and that attribution)
+  - placement groups created+removed (default 100)
+
+Writes SCALE_r03.json at the repo root.
+Usage: python scripts/scale_probe.py [--nodes N] [--tasks N]
+       [--actors N] [--pgs N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=50)
+    ap.add_argument("--tasks", type=int, default=10_000)
+    ap.add_argument("--actors", type=int, default=1_000)
+    ap.add_argument("--pgs", type=int, default=100)
+    ap.add_argument("--out", default="SCALE_r03.json")
+    args = ap.parse_args(argv)
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    results: dict = {
+        "host_cpus": len(os.sched_getaffinity(0)),
+        "targets": {"nodes": args.nodes, "tasks": args.tasks,
+                    "actors": args.actors, "pgs": args.pgs},
+    }
+
+    # max_workers_per_node clamped so 50 nodes x 64 logical CPUs don't
+    # spawn thousands of real worker processes on the probe host; the
+    # head's bookkeeping still sees the full logical resource pool.
+    cluster = Cluster(head_node_args={
+        "num_cpus": 64, "log_to_driver": False,
+        "_system_config": {"max_workers_per_node": 2}})
+
+    # -- 1. logical nodes --------------------------------------------------
+    t0 = time.perf_counter()
+    for i in range(args.nodes - 1):
+        cluster.add_node(num_cpus=64, node_id=f"scale-{i}")
+    dt = time.perf_counter() - t0
+    n_nodes = len(cluster.list_nodes())
+    results["nodes"] = {"count": n_nodes,
+                        "register_per_s": round((args.nodes - 1) / dt, 1)}
+    print(f"nodes: {n_nodes} registered at "
+          f"{results['nodes']['register_per_s']}/s", flush=True)
+
+    # -- 2. queued tasks ---------------------------------------------------
+    @ray_tpu.remote(num_cpus=1)
+    def noop():
+        return 0
+
+    t0 = time.perf_counter()
+    refs = [noop.remote() for _ in range(args.tasks)]
+    submit_dt = time.perf_counter() - t0
+    ray_tpu.get(refs, timeout=3600)
+    drain_dt = time.perf_counter() - t0
+    results["tasks"] = {
+        "queued": args.tasks,
+        "submit_per_s": round(args.tasks / submit_dt, 1),
+        "drain_per_s": round(args.tasks / drain_dt, 1),
+    }
+    print(f"tasks: {args.tasks} submitted at "
+          f"{results['tasks']['submit_per_s']}/s, drained at "
+          f"{results['tasks']['drain_per_s']}/s", flush=True)
+
+    # -- 3. actors ---------------------------------------------------------
+    class A:
+        def ping(self):
+            return 0
+
+    Actor = ray_tpu.remote(A)
+    t0 = time.perf_counter()
+    actors = [Actor.options(num_cpus=0.01).remote()
+              for _ in range(args.actors)]
+    # One call per actor proves every one reached ALIVE and answers.
+    ray_tpu.get([a.ping.remote() for a in actors], timeout=3600)
+    dt = time.perf_counter() - t0
+    results["actors"] = {
+        "count": args.actors,
+        "to_alive_per_s": round(args.actors / dt, 1),
+        "note": "each actor is a dedicated OS process; on few-core "
+                "hosts this rate is process-spawn-bound, not "
+                "head-bound",
+    }
+    print(f"actors: {args.actors} alive at "
+          f"{results['actors']['to_alive_per_s']}/s", flush=True)
+
+    # Tear the actors down so PG timing below is clean.
+    t0 = time.perf_counter()
+    for a in actors:
+        ray_tpu.kill(a)
+    results["actors"]["kill_per_s"] = round(
+        args.actors / (time.perf_counter() - t0), 1)
+
+    # -- 4. placement groups ----------------------------------------------
+    t0 = time.perf_counter()
+    pgs = [placement_group([{"CPU": 1}] * 2, strategy="SPREAD")
+           for _ in range(args.pgs)]
+    ray_tpu.get([pg.ready() for pg in pgs], timeout=600)
+    create_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for pg in pgs:
+        remove_placement_group(pg)
+    remove_dt = time.perf_counter() - t0
+    results["placement_groups"] = {
+        "count": args.pgs,
+        "create_ready_per_s": round(args.pgs / create_dt, 1),
+        "remove_per_s": round(args.pgs / remove_dt, 1),
+    }
+    print(f"pgs: {args.pgs} ready at "
+          f"{results['placement_groups']['create_ready_per_s']}/s, "
+          f"removed at {results['placement_groups']['remove_per_s']}/s",
+          flush=True)
+
+    cluster.shutdown()
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

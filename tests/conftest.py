@@ -38,3 +38,32 @@ def ray_start_shared():
     rt = ray_tpu.init(num_cpus=8)
     yield rt
     ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Hang watchdog: any single test exceeding WATCHDOG_S dumps EVERY
+# thread's stack to the real stderr (bypassing capture) and kills the
+# run — a wedged test must produce a diagnosis, not a silent stall.
+# Disable with RAY_TPU_TEST_WATCHDOG=0.
+
+import faulthandler  # noqa: E402
+import os as _os  # noqa: E402
+
+_WATCHDOG_S = float(_os.environ.get("RAY_TPU_TEST_WATCHDOG", "420"))
+# A dedicated fd: pytest's fd-level capture dup2's over fd 2, so a dump
+# aimed at sys.__stderr__ would vanish into the capture tmpfile.
+_WATCHDOG_LOG = _os.environ.get("RAY_TPU_TEST_WATCHDOG_LOG",
+                                "/tmp/ray_tpu_test_watchdog.log")
+_watchdog_file = open(_WATCHDOG_LOG, "a") if _WATCHDOG_S > 0 else None
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    if _watchdog_file is not None:
+        _watchdog_file.write(f"::watchdog arm {item.nodeid}\n")
+        _watchdog_file.flush()
+        faulthandler.dump_traceback_later(
+            _WATCHDOG_S, exit=True, file=_watchdog_file)
+    yield
+    if _watchdog_file is not None:
+        faulthandler.cancel_dump_traceback_later()

@@ -85,7 +85,7 @@ def test_7b_width_truncated_depth_trains_on_virtual_mesh():
     exact per-layer partitioning the full model uses, with memory a CPU
     host can hold."""
     config = tfm.TransformerConfig.llama2_7b(
-        num_layers=2, max_seq_len=64)
+        num_layers=1, max_seq_len=32)
     devices = jax.devices()[:8]
     mesh = build_mesh(axes={"fsdp": 8}, devices=devices)
     ts = ShardedTrainStep(
@@ -95,7 +95,7 @@ def test_7b_width_truncated_depth_trains_on_virtual_mesh():
     state = ts.init(jax.random.key(0))
     # batch 8: the data/fsdp sharding divides the batch across devices
     batch = {"tokens": jnp.asarray(
-        np.random.default_rng(0).integers(0, config.vocab_size, (8, 33)),
+        np.random.default_rng(0).integers(0, config.vocab_size, (8, 17)),
         dtype=jnp.int32)}
     state, metrics = ts.step(state, batch)
     loss = float(metrics["loss"])

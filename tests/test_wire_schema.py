@@ -1,0 +1,72 @@
+"""Wire-contract schema tests (reference: the proto IDL tier,
+src/ray/protobuf/*.proto — typed messages every language can speak)."""
+
+import json
+
+import pytest
+
+from ray_tpu.core.wire_schema import (
+    SCHEMA,
+    SchemaError,
+    export_schema,
+    validate,
+)
+
+
+def test_validate_accepts_wellformed_frames():
+    validate({"op": "put_object", "obj": "ab" * 14, "size": 3,
+              "inline": b"xyz"})
+    validate({"op": "kv_put", "key": "k", "value": b"v",
+              "overwrite": True})
+    validate({"op": "serve_request", "route": "/app",
+              "payload": {"x": 1}})
+    validate({"op": "register", "worker_hex": "ff" * 14, "pid": 1,
+              "kind": "driver"})
+
+
+def test_validate_rejects_malformed_frames():
+    with pytest.raises(SchemaError, match="unknown op"):
+        validate({"op": "no_such_op"})
+    with pytest.raises(SchemaError, match="missing required"):
+        validate({"op": "put_object", "size": 3})
+    with pytest.raises(SchemaError, match="expected int"):
+        validate({"op": "put_object", "obj": "ab", "size": "big"})
+    with pytest.raises(SchemaError, match="undeclared"):
+        validate({"op": "kv_get", "key": "k", "sneaky": 1})
+    with pytest.raises(SchemaError, match="dict"):
+        validate(["op", "ping"])
+
+
+def test_export_schema_is_json_serializable():
+    blob = json.dumps(export_schema())
+    assert json.loads(blob)["ops"]["submit_task"] == {"spec": "any"}
+
+
+def test_cpp_client_frames_conform():
+    """The C++ client's hand-built JSON frames (cpp/include/ray_tpu/
+    client.h) must match the declared contract — the CI check that
+    replaces generated bindings for non-Python frontends."""
+    # The ops the C++ client emits today:
+    cpp_frames = [
+        {"op": "register", "worker_hex": "aa" * 14, "pid": 42,
+         "kind": "cpp"},
+        {"op": "ping"},
+        {"op": "kv_put", "key": "k", "value": b"v", "overwrite": True},
+        {"op": "kv_get", "key": "k"},
+        {"op": "submit_named_task", "name": "f", "args": [1, 2],
+         "num_cpus": 1.0},
+        {"op": "get_object_json", "obj": "ab" * 14},
+        {"op": "list_nodes"},
+        {"op": "cluster_resources"},
+    ]
+    for frame in cpp_frames:
+        validate(frame)
+
+
+def test_schema_covers_hot_control_ops():
+    # The ops the core runtime sends on its hot paths must stay declared.
+    for op in ("submit_task", "submit_task_batch", "task_done",
+               "put_object", "subscribe_objects", "incref", "decref",
+               "incref_batch", "register_objects", "create_actor",
+               "actor_ready", "kill_actor"):
+        assert op in SCHEMA, op
