@@ -676,6 +676,15 @@ class ControlServer:
                 c.push(push)
             except Exception:
                 pass
+        # A dropped generator's free may have arrived before this EOS
+        # put: apply it now that the stream is provably finished.
+        frees = getattr(self, "_pending_stream_frees", None)
+        if frees:
+            parked = frees.pop(obj_hex, None)
+            if parked is not None:
+                threading.Thread(
+                    target=self._op_free_stream, args=(None, parked),
+                    name="stream-free", daemon=True).start()
 
     def _object_ready_msg(self, obj_hex, entry):
         # Location info lets clients on OTHER nodes pull the bytes from
@@ -2457,17 +2466,40 @@ class ControlServer:
 
         task_id = TaskID.from_hex(msg["task"])
         eos_hex = stream_eos_id(task_id).hex()
+        start = int(msg.get("from_index", 0))
         with self.lock:
             eos = self.objects.get(eos_hex)
-            if eos is None or eos.state != READY or eos.inline is None:
-                return  # running, failed, or already cleaned up
-            try:
-                count = int(deserialize(eos.inline))
-            except Exception:
+            if eos is None or eos.state == PENDING:
+                # Stream still running (or its EOS put is still in
+                # flight — item puts and the EOS are separate frames, so
+                # a consumer can observe the tail item and drop the
+                # generator before the EOS lands): park the free and
+                # apply it when the EOS stores (_store_object_locked).
+                # A CONSUMED EOS (the normal fully-drained lifecycle)
+                # was decref-deleted and will never store again — there
+                # is nothing left to free, so parking it would leak one
+                # entry per drained stream.
+                if not msg.get("eos_consumed", False):
+                    frees = getattr(self, "_pending_stream_frees", None)
+                    if frees is None:
+                        frees = self._pending_stream_frees = {}
+                    if len(frees) >= 4096:  # bound pathological growth
+                        frees.pop(next(iter(frees)))
+                    frees[eos_hex] = dict(msg)
                 return
+            count = None
+            if eos.state == READY and eos.inline is not None:
+                try:
+                    count = int(deserialize(eos.inline))
+                except Exception:
+                    count = None
+            if count is None:
+                # ERRORED EOS (producer died mid-stream) carries no item
+                # count: probe a bounded id range — decref no-ops on
+                # ids that were never stored.
+                count = start + 4096
             targets = [stream_item_id(task_id, i).hex()
-                       for i in range(int(msg.get("from_index", 0)),
-                                      count)]
+                       for i in range(start, count)]
             if not msg.get("eos_consumed", False):
                 targets.append(eos_hex)
         for obj_hex in targets:

@@ -119,8 +119,9 @@ class ObjectRefGenerator:
     def __del__(self):
         # Free unconsumed items server-side (they were stored with one
         # owner ref that only __next__'s ObjectRefs would release).
-        # Only possible once the stream finished; dropping a generator
-        # of a still-running task leaves cleanup to session teardown.
+        # If the stream is still RUNNING, the head parks this free and
+        # applies it when the EOS object lands (gcs.py _op_free_stream /
+        # _store_object_locked) — mid-stream drops clean up too.
         try:
             rt = self._rt
             if rt is None or not getattr(rt, "is_initialized", False):

@@ -167,3 +167,41 @@ def test_dropped_generator_frees_unconsumed_items():
 def test_invalid_num_returns_rejected():
     with pytest.raises(ValueError, match="num_returns"):
         ray_tpu.remote(num_returns="stream")(lambda: None)
+
+
+def test_generator_dropped_before_stream_finishes_still_frees():
+    """Dropping the generator while the task is still producing parks
+    the free on the head; when the EOS lands the unconsumed items are
+    released (the race a loaded host exposed: tail item visible before
+    the EOS put processed)."""
+    import gc
+
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.core.streaming import stream_eos_id, stream_item_id
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen():
+        for i in range(4):
+            time.sleep(0.15)
+            yield i
+
+    g = slow_gen.remote()
+    first = next(iter(g))
+    task_id = g.task_id
+    assert ray_tpu.get(first) == 0
+    # Drop while the producer is mid-stream: the free_stream op arrives
+    # at the head long before the EOS object exists.
+    del g, first
+    gc.collect()
+    rt = get_runtime()
+    tail_hex = stream_item_id(task_id, 3).hex()
+    eos_hex = stream_eos_id(task_id).hex()
+    deadline = time.time() + 30
+    alive = set()
+    while time.time() < deadline:
+        alive = {o["object_id"] for o in rt.state_list("objects")}
+        if tail_hex not in alive and eos_hex not in alive:
+            break
+        time.sleep(0.1)
+    assert tail_hex not in alive, "unconsumed tail item leaked"
+    assert eos_hex not in alive, "EOS object leaked"
