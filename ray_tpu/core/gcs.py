@@ -2467,6 +2467,18 @@ class ControlServer:
         task_id = TaskID.from_hex(msg["task"])
         eos_hex = stream_eos_id(task_id).hex()
         start = int(msg.get("from_index", 0))
+        known_count = msg.get("count")
+        if known_count is not None:
+            # The consumer read the EOS (whose decref may already have
+            # deleted it here): free directly from the count it learned
+            # — no EOS lookup, no parking.
+            targets = [stream_item_id(task_id, i).hex()
+                       for i in range(start, int(known_count))]
+            if not msg.get("eos_consumed", False):
+                targets.append(eos_hex)
+            for obj_hex in targets:
+                self._op_decref(conn, {"obj": obj_hex})
+            return
         with self.lock:
             eos = self.objects.get(eos_hex)
             if eos is None or eos.state == PENDING:

@@ -86,6 +86,12 @@ class ObjectRefGenerator:
                 stream_eos_id(self._task_id).hex())
             while not item_fut.done():
                 wait([item_fut, eos_fut], return_when=FIRST_COMPLETED)
+                # Both may resolve in the same wake (a crashed worker's
+                # error EOS lands right behind its last item): the item,
+                # when present, wins — the EOS is only consulted for
+                # indexes past the stream's end.
+                if item_fut.done():
+                    break
                 if eos_fut.done():
                     # Stream ended; resolve the count exactly once. A
                     # failed task stores an ERROR eos, which raises here
@@ -116,12 +122,21 @@ class ObjectRefGenerator:
         self._i += 1
         return ObjectRef(ObjectID.from_hex(item_hex))
 
+    def disown(self):
+        """The caller takes over server-side stream cleanup (serve's
+        proxy consumes by task id and sends its own free_stream with
+        accurate consumed/count state): suppress __del__'s own free so
+        a stale duplicate never parks on the head."""
+        self._disowned = True
+
     def __del__(self):
         # Free unconsumed items server-side (they were stored with one
         # owner ref that only __next__'s ObjectRefs would release).
         # If the stream is still RUNNING, the head parks this free and
         # applies it when the EOS object lands (gcs.py _op_free_stream /
         # _store_object_locked) — mid-stream drops clean up too.
+        if getattr(self, "_disowned", False):
+            return
         try:
             rt = self._rt
             if rt is None or not getattr(rt, "is_initialized", False):
@@ -131,6 +146,10 @@ class ObjectRefGenerator:
                 "task": self._task_id.hex(),
                 "from_index": self._i,
                 "eos_consumed": self._count is not None,
+                # When this consumer already read the EOS (and its
+                # decref may have DELETED it head-side), the head can't
+                # learn the item count from the EOS anymore — ship it.
+                "count": self._count,
             })
         except Exception:
             pass

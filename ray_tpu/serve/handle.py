@@ -120,6 +120,12 @@ class DeploymentResponseGenerator:
             self._released = True
             self._handle._router().release(self._assigned_hex)
 
+    def disown_stream(self):
+        """Caller consumes by task id and owns cleanup (proxy paths):
+        suppress the inner generator's own free-on-GC, whose position
+        state never advanced and would park a stale free head-side."""
+        self._gen.disown()
+
     def __del__(self):
         try:
             self._release()

@@ -436,7 +436,9 @@ class HTTPProxy(_RouteTable):
                 get_runtime().core.client.send({
                     "op": "free_stream", "task": gen.task_id.hex(),
                     "from_index": state["i"],
-                    "eos_consumed": state["eos_consumed"]})
+                    "eos_consumed": state["eos_consumed"],
+                    "count": state.get("count")})
+                gen.disown_stream()
             except Exception:
                 pass
         if failed_mid_stream:
@@ -486,7 +488,9 @@ class HTTPProxy(_RouteTable):
                 get_runtime().core.client.send({
                     "op": "free_stream", "task": gen.task_id.hex(),
                     "from_index": state["i"],
-                    "eos_consumed": state["eos_consumed"]})
+                    "eos_consumed": state["eos_consumed"],
+                    "count": state.get("count")})
+                gen.disown_stream()
             except Exception:
                 pass
         writer.write(b"0\r\n\r\n")
@@ -529,6 +533,10 @@ async def _astream_values(task_id, state: Optional[dict] = None):
                         raise
                     if state is not None:
                         state["eos_consumed"] = True
+                        # The decref below may DELETE the eos head-side;
+                        # cleanup's free_stream then needs the count
+                        # from us (gcs.py _op_free_stream).
+                        state["count"] = count
                     try:
                         core.client.send({"op": "decref", "obj": eos_hex})
                     except Exception:
