@@ -148,6 +148,12 @@ def cancel(ref: ObjectRef, *, force: bool = False) -> bool:
     """Cancel the task producing ``ref``.  Pending tasks are always
     cancellable; running tasks only with force=True (worker is killed)."""
     rt = _runtime_mod.get_runtime()
+    core = getattr(rt, "core", None)
+    if core is not None:
+        # Owner-side first: lease-path tasks never reached the head
+        # (reference: cancellation is owner-initiated, CancelTask
+        # core_worker.proto:441).
+        return core.cancel_ref(ref.hex(), force=force)
     return bool(rt.kv().call(
         {"op": "cancel_object", "obj": ref.hex(), "force": force}))
 

@@ -84,6 +84,19 @@ class Config:
     # Top-k random choice among feasible nodes (reference hybrid policy
     # scheduling/policy/hybrid_scheduling_policy.h).
     scheduler_top_k_fraction: float = 0.2
+    # Owner-direct task leases (reference: the lease protocol of
+    # CoreWorkerDirectTaskSubmitter, direct_task_transport.h:75/:353 —
+    # the owner leases workers from the scheduler once, then pushes
+    # task specs peer-to-peer and reuses the lease while same-shaped
+    # work remains).  Off = every task transits the head.
+    direct_task_leases: bool = True
+    # In-flight pipeline depth per leased worker (reference pipelines
+    # via max_tasks_in_flight_per_worker).
+    lease_pipeline_depth: int = 4
+    # Owner returns an idle lease after this long without queued work.
+    lease_idle_timeout_s: float = 0.25
+    # Cap on workers one lease request asks for.
+    max_lease_workers_per_request: int = 16
 
     # -- fault tolerance ------------------------------------------------
     task_max_retries: int = 3

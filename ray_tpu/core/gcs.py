@@ -147,6 +147,10 @@ class WorkerInfo:
     # where acquired resources were charged: ("node", node_id) or
     # ("pg", pg_hex, bundle_index)
     charge: tuple = ()
+    # state == "leased": the owner (worker hex) this worker is leased to
+    # (reference: a granted lease binds the worker to the requesting
+    # CoreWorkerDirectTaskSubmitter, direct_task_transport.h:353)
+    leased_to: str = ""
     # When the spawn was requested; remote spawns (proc is None) that
     # never register are reaped after worker_register_timeout_s.
     spawned_at: float = 0.0
@@ -250,6 +254,11 @@ class ControlServer:
         # extra wake; pruned when the pending queue drains.
         self._dep_waiters: set = set()
         self.pending_actors: List[ActorCreationSpec] = []
+        # Unsatisfied worker-lease requests (owner-direct task path):
+        # granted as workers come online / free up, or denied on expiry
+        # so the owner re-requests (reference: queued lease requests in
+        # NodeManager::HandleRequestWorkerLease, node_manager.cc:1794).
+        self.pending_leases: List[dict] = []
         # env_key -> runtime_env dict; workers fetch + apply their pool's
         # env at startup (runtime_env/plugin.py).
         self.runtime_envs: Dict[str, dict] = {}
@@ -518,9 +527,32 @@ class ControlServer:
 
     def _mark_worker_dead(self, w: WorkerInfo, reason: str):
         """Called with lock held. Fail/retry its task, kill/restart its actor."""
+        was_leased_to = w.leased_to if w.state == "leased" else ""
         w.state = "dead"
         w.conn = None
+        w.leased_to = ""
         self._release(w)
+        if was_leased_to:
+            # Tell the lease holder so it fails over the in-flight
+            # specs it owns (the head never saw them).
+            owner = self.workers.get(was_leased_to)
+            if owner is not None and owner.conn is not None:
+                try:
+                    owner.conn.push({"op": "lease_revoked",
+                                     "worker": w.worker_hex,
+                                     "reason": reason})
+                except Exception:
+                    pass
+        # Leases this worker HELD as an owner die with it.
+        for x in self.workers.values():
+            if x.state == "leased" and x.leased_to == w.worker_hex:
+                self._release(x)
+                x.state = "idle"
+                x.leased_to = ""
+        if self.pending_leases:
+            self.pending_leases = [
+                pl for pl in self.pending_leases
+                if pl["owner"] != w.worker_hex]
         if w.current_task:
             rec = self.tasks.get(w.current_task)
             if rec is not None and rec.state == "RUNNING":
@@ -1483,6 +1515,249 @@ class ControlServer:
         return None
 
     # ------------------------------------------------------------------
+    # Worker leases: the owner-direct task path's only head involvement
+    # (reference: NodeManager::HandleRequestWorkerLease
+    # node_manager.cc:1794 grants a worker binding; the owner then
+    # pushes tasks peer-to-peer, direct_task_transport.h:75).
+    def _op_request_lease(self, conn, msg):
+        owner_hex = conn.meta.get("worker_hex", "")
+        count = max(1, min(int(msg.get("count", 1)),
+                           self.config.max_lease_workers_per_request))
+        resources = msg.get("resources") or {}
+        renv = msg.get("runtime_env")
+        token = msg.get("token")
+        granted: List[dict] = []
+        denied = 0
+        error = ""
+        with self.lock:
+            env_key = self._env_key_for(resources, renv)
+            broken = self.broken_envs.get(env_key)
+            if broken is not None and \
+                    time.time() - broken[1] <= self.broken_env_ttl_s:
+                denied, error = count, f"runtime_env setup failed: " \
+                    f"{broken[0]}"
+                count = 0
+            need = ResourceSet(resources)
+            # Virtual availability across the grant loop, so N spawn
+            # decisions spread over nodes instead of all landing on the
+            # first pick (mirrors the schedule pass's virtual view).
+            avail_virtual: Dict[str, ResourceSet] = {}
+
+            def virt(nid: str) -> ResourceSet:
+                if nid not in avail_virtual:
+                    node = self.nodes.get(nid)
+                    av = (node.available if node is not None
+                          and node.alive else ResourceSet())
+                    # Earlier queued lease demand already spoken for on
+                    # this node reduces what THIS request can plan with.
+                    for pl in self.pending_leases:
+                        if pl.get("node_id") == nid:
+                            pneed = ResourceSet(pl["resources"])
+                            av = av.subtract(pneed) \
+                                if pneed.is_subset_of(av) else ResourceSet()
+                    avail_virtual[nid] = av
+                return avail_virtual[nid]
+
+            node_workers: Dict[str, int] = {}
+            starting_total = 0
+            for w in self.workers.values():
+                if w.kind == "pool" and w.state != "dead":
+                    node_workers[w.node_id] = node_workers.get(
+                        w.node_id, 0) + 1
+                    if w.state == "starting" and w.env_key == env_key:
+                        starting_total += 1
+            # Spawns already claimed by earlier queued lease requests
+            # must not dedupe THIS request's spawns.
+            unclaimed = starting_total - sum(
+                1 for pl in self.pending_leases
+                if pl["env_key"] == env_key)
+            for i in range(count):
+                w = self._idle_lease_worker_locked(env_key, need, virt)
+                if w is not None:
+                    charge = ("node", w.node_id)
+                    avail_virtual[w.node_id] = virt(
+                        w.node_id).subtract(need)
+                    self._charge_target_subtract(charge, need)
+                    w.acquired = need
+                    w.charge = charge
+                    w.state = "leased"
+                    w.leased_to = owner_hex
+                    granted.append({"worker": w.worker_hex,
+                                    "address": w.address})
+                    continue
+                # No idle worker: place a spawn (virtual accounting) or
+                # deny the remainder fast — the owner pipelines onto
+                # what it has and retries after a backoff.
+                feasible = [n for n in self.nodes.values()
+                            if n.alive and need.is_subset_of(
+                                virt(n.node_id))]
+                if not feasible:
+                    denied += count - i
+                    break
+                node = max(feasible, key=lambda n: (
+                    self._utilization(n, virt(n.node_id)), n.is_head))
+                nid = node.node_id
+                avail_virtual[nid] = virt(nid).subtract(need)
+                if unclaimed > 0:
+                    unclaimed -= 1  # one already on the way
+                elif node_workers.get(nid, 0) < \
+                        self.config.max_workers_per_node:
+                    self._spawn_worker(env_key=env_key, kind="pool",
+                                       node_id=nid)
+                    node_workers[nid] = node_workers.get(nid, 0) + 1
+                self.pending_leases.append({
+                    "owner": owner_hex, "env_key": env_key,
+                    "resources": dict(resources), "token": token,
+                    "node_id": nid, "created": time.time()})
+        self._push_lease_grants([(conn, token, granted, denied, error)])
+
+    def _idle_lease_worker_locked(self, env_key: str, need: "ResourceSet",
+                                  avail_of=None):
+        """Lock held.  Any idle pool worker with the right env whose
+        node can hold the lease's resources."""
+        for x in self.workers.values():
+            if (x.kind == "pool" and x.state == "idle"
+                    and x.conn is not None and x.env_key == env_key
+                    and x.address):
+                node = self.nodes.get(x.node_id)
+                if node is None or not node.alive:
+                    continue
+                avail = avail_of(x.node_id) if avail_of is not None \
+                    else node.available
+                if need.is_subset_of(avail):
+                    return x
+        return None
+
+    def _op_release_lease(self, conn, msg):
+        owner_hex = conn.meta.get("worker_hex", "")
+        with self.lock:
+            for whex in msg.get("workers", ()):
+                w = self.workers.get(whex)
+                if w is not None and w.state == "leased" and \
+                        (not owner_hex or w.leased_to == owner_hex):
+                    self._release(w)
+                    w.state = "idle"
+                    w.leased_to = ""
+        self._wake.set()
+
+    def _op_kill_worker(self, conn, msg):
+        """Owner-initiated kill of a leased worker (force-cancel of a
+        lease-path task; reference: CancelTask with force_kill kills
+        the executing worker)."""
+        whex = msg.get("worker")
+        owner_hex = conn.meta.get("worker_hex", "")
+        with self.lock:
+            w = self.workers.get(whex)
+            if w is None or w.state == "dead":
+                return False
+            if w.state == "leased" and owner_hex and \
+                    w.leased_to != owner_hex:
+                return False  # only the lease holder may kill
+            node = self.nodes.get(w.node_id)
+            if w.proc is not None:
+                try:
+                    w.proc.kill()
+                except OSError:
+                    pass
+            elif node is not None and node.conn is not None:
+                try:
+                    node.conn.push({"op": "kill_worker",
+                                    "worker_hex": whex})
+                except Exception:
+                    pass
+            else:
+                return False
+            self._mark_worker_dead(w, "killed by owner (task cancelled)")
+        self._wake.set()
+        return True
+
+    def _try_grant_leases_locked(self) -> List[tuple]:
+        """Lock held.  Match queued lease requests against idle workers
+        / freed resources; expired ones are denied so the owner's pump
+        re-requests.  Returns (conn, token, workers, denied) tuples to
+        push outside the lock."""
+        if not self.pending_leases:
+            return []
+        out: List[tuple] = []
+        still: List[dict] = []
+        now = time.time()
+        for pl in self.pending_leases:
+            owner = self.workers.get(pl["owner"])
+            if owner is None or owner.state == "dead" or owner.conn is None:
+                continue  # owner gone: drop the demand
+            need = ResourceSet(pl["resources"])
+            w = self._idle_lease_worker_locked(pl["env_key"], need)
+            if w is not None:
+                charge = ("node", w.node_id)
+                self._charge_target_subtract(charge, need)
+                w.acquired = need
+                w.charge = charge
+                w.state = "leased"
+                w.leased_to = pl["owner"]
+                out.append((owner.conn, pl["token"],
+                            [{"worker": w.worker_hex,
+                              "address": w.address}], 0, ""))
+            elif now - pl["created"] > 10.0:
+                # The spawn this entry waited for never materialized:
+                # deny so the owner's pump re-requests.
+                out.append((owner.conn, pl["token"], [], 1, ""))
+            else:
+                still.append(pl)
+        self.pending_leases = still
+        return out
+
+    def _push_lease_grants(self, grants: List[tuple]):
+        for oconn, token, workers, denied, error in grants:
+            if not workers and not denied:
+                continue
+            try:
+                oconn.push({"op": "lease_granted", "token": token,
+                            "workers": workers, "denied": denied,
+                            "error": error})
+            except Exception:
+                # Owner unreachable: reclaim the workers.
+                with self.lock:
+                    for wi in workers:
+                        x = self.workers.get(wi["worker"])
+                        if x is not None and x.state == "leased":
+                            self._release(x)
+                            x.state = "idle"
+                            x.leased_to = ""
+
+    def _op_task_events(self, conn, msg):
+        """Batched execution events from workers running lease-path
+        tasks (reference TaskEventBuffer → GcsTaskManager,
+        task_event_buffer.h:206): keeps the state API and timeline
+        complete for tasks the head never scheduled."""
+        now = time.time()
+        worker_hex = conn.meta.get("worker_hex", "")
+        with self.lock:
+            for ev in msg.get("events", ()):
+                rec = self.tasks.get(ev["task_id"])
+                if rec is None:
+                    spec = TaskSpec(
+                        task_id=TaskID.from_hex(ev["task_id"]),
+                        func_id="", func_blob=None, args=[],
+                        num_returns=1, return_ids=[], resources={},
+                        name=ev.get("name", ""),
+                        owner=ev.get("owner", ""), direct=True)
+                    rec = self.tasks[ev["task_id"]] = TaskRecord(
+                        spec=spec, submitted_at=ev.get("start") or now)
+                elif not rec.spec.direct and rec.state in ("PENDING",
+                                                           "RUNNING"):
+                    # A live head-path record (the task was fallback-
+                    # resubmitted through the scheduler after its lease
+                    # worker was presumed lost): a stale event from the
+                    # old worker must not clobber the retry's state or
+                    # its death-detection worker binding.
+                    continue
+                rec.state = ev.get("state", "FINISHED")
+                rec.worker_hex = worker_hex
+                rec.started_at = ev.get("start", 0.0)
+                rec.finished_at = ev.get("end", 0.0)
+            self._prune_lineage_locked()
+
+    # ------------------------------------------------------------------
     # Actors
     def _op_create_actor(self, conn, msg):
         spec: ActorCreationSpec = msg["spec"]
@@ -1799,6 +2074,10 @@ class ControlServer:
         with self.lock:
             demands = [dict(s.resources) for s in self.pending_tasks]
             demands += [dict(s.resources) for s in self.pending_actors]
+            # Unsatisfied worker-lease requests are task demand too
+            # (owner-direct tasks never appear in pending_tasks).
+            demands += [dict(pl["resources"])
+                        for pl in self.pending_leases]
             pg_demands = [
                 {"strategy": pg.strategy, "bundles": list(pg.bundle_specs)}
                 for pg in self.placement_groups.values()
@@ -2434,6 +2713,12 @@ class ControlServer:
                 # queue the creation spec; delivered when the worker registers
                 w.pending_create = spec  # type: ignore[attr-defined]
 
+            # 3. queued lease requests take what's left (tasks/actors
+            # queued at the head go first — they were already waiting).
+            lease_grants = self._try_grant_leases_locked()
+
+        if lease_grants:
+            self._push_lease_grants(lease_grants)
         for worker, spec in dispatches:
             try:
                 worker.conn.push({"op": "execute_task", "spec": spec})

@@ -77,6 +77,37 @@ def set_runtime(rt):
         _global_runtime = rt
 
 
+class _LeasePool:
+    """Owner-side lease state for one task shape (resources +
+    runtime_env): the granted workers, their in-flight specs, and the
+    not-yet-assigned queue.  Counterpart of the per-SchedulingKey entry
+    in the reference's CoreWorkerDirectTaskSubmitter
+    (direct_task_transport.h:75)."""
+
+    __slots__ = ("resources", "runtime_env", "workers", "inflight",
+                 "queue", "requested", "idle_since", "backoff_until")
+
+    def __init__(self, resources: Dict[str, float],
+                 runtime_env: Optional[dict]):
+        self.resources = dict(resources)
+        self.runtime_env = runtime_env
+        import collections
+
+        self.workers: Dict[str, str] = {}  # worker_hex -> address
+        self.inflight: Dict[str, Dict[str, TaskSpec]] = {}
+        # deque: a big burst drains via popleft; list.pop(0) would be
+        # O(n^2) under the lease lock.
+        self.queue = collections.deque()
+        self.requested = 0  # workers asked for but not yet granted
+        self.idle_since: Optional[float] = None
+        # Set on denial (cluster saturated): no re-request until then —
+        # pipeline onto what we have and retry for freed capacity.
+        self.backoff_until = 0.0
+
+    def busy(self) -> bool:
+        return bool(self.queue) or any(self.inflight.values())
+
+
 class CoreClient:
     """Client-side core: object futures, submission, refcounting."""
 
@@ -167,7 +198,25 @@ class CoreClient:
         # its object.
         self._flush_mutex = threading.RLock()
         self._pending_direct: Dict[str, List[TaskSpec]] = {}
+        self._pending_pool: Dict[str, List[TaskSpec]] = {}
         self._pending_submits: List[TaskSpec] = []
+        # Owner-direct task leases (reference: the lease protocol of
+        # CoreWorkerDirectTaskSubmitter, direct_task_transport.h:75 —
+        # RequestNewWorkerIfNeeded :353 leases workers from the
+        # scheduler; the owner then pushes specs peer-to-peer and
+        # reuses the lease while same-shaped work remains, OnWorkerIdle
+        # :197).  One pool per task shape.
+        self._lease_lock = threading.RLock()
+        self._leases: Dict[tuple, "_LeasePool"] = {}
+        self._lease_tokens: Dict[int, tuple] = {}  # token -> shape key
+        self._lease_token_seq = 0
+        self._lease_of_obj: Dict[str, tuple] = {}  # obj -> (shape, whex, task_hex)
+        self._lease_addr_workers: Dict[str, set] = {}  # addr -> worker hexes
+        self._lease_request_pending = False
+        # Objects this process itself stored (put / stored returns):
+        # their refs are resolvable without waiting, so tasks using them
+        # as args stay lease-eligible.
+        self._local_known: set = set()
         self._flush_ev = threading.Event()
         self._flusher_started = False
         # actor state tracking
@@ -253,6 +302,21 @@ class CoreClient:
                 time.sleep(delay)
                 continue
             self.client = client
+            # The restarted head rebuilt worker states from re-announces
+            # and knows nothing of our leases: drop granted workers
+            # (in-flight results still arrive on their live direct
+            # conns) and let the pump re-request against the new head.
+            with self._lease_lock:
+                self._lease_tokens.clear()
+                # _lease_addr_workers is deliberately KEPT: in-flight
+                # specs survive the restart, and a later death of their
+                # worker must still map the dropped connection back to
+                # the worker hex to fail them over.
+                for shape, pool in self._leases.items():
+                    pool.workers.clear()
+                    pool.requested = 0
+                    if pool.queue:
+                        self._pump_lease_locked(shape, pool)
             # Anything stranded by a mid-outage flush failure goes out
             # now that a live connection exists.
             if self._pending_count:
@@ -282,6 +346,11 @@ class CoreClient:
             self.on_execute_task(msg["spec"])
         elif op == "create_actor_instance" and self.on_create_actor is not None:
             self.on_create_actor(msg["spec"])
+        elif op == "lease_granted":
+            self._on_lease_granted(msg)
+        elif op == "lease_revoked":
+            self._on_lease_worker_lost(msg["worker"],
+                                       msg.get("reason", "worker died"))
         elif op == "profile":
             # On-demand profiling (gcs.py _op_profile_worker): run off
             # the push thread; the worker keeps executing its task.
@@ -392,6 +461,8 @@ class CoreClient:
                         "is_error": is_error})
                 except Exception:
                     pass
+            for obj_hex, _, _ in results:
+                self._lease_task_completed(obj_hex)
             for fut, data, is_error in resolved:
                 if fut is not None and not fut.done():
                     fut.set_result({"direct": True, "data": data,
@@ -401,6 +472,9 @@ class CoreClient:
             # the head (shm path); chain the head subscription into the
             # local direct future.
             obj_hex = msg["obj"]
+            # The worker is done with the task either way: free its
+            # lease pipeline slot now, not when the owner resolves.
+            self._lease_task_completed(obj_hex)
             with self._lock:
                 # The head now holds an entry (refcount 1 from the
                 # worker's put): mark it head-known so this ref's
@@ -420,6 +494,7 @@ class CoreClient:
                 return
 
             def _chain(hf, fut=fut, obj_hex=obj_hex):
+                self._lease_task_completed(obj_hex)
                 with self._lock:
                     self._direct_inflight.get(
                         self._direct_actor_of.get(obj_hex, ""),
@@ -434,6 +509,7 @@ class CoreClient:
             head_fut.add_done_callback(_chain)
 
     def _resolve_direct(self, obj_hex: str, info: dict):
+        self._lease_task_completed(obj_hex)
         with self._lock:
             fut = self._direct_futures.get(obj_hex)
             actor_hex = self._direct_actor_of.get(obj_hex, "")
@@ -455,6 +531,7 @@ class CoreClient:
     def _fail_direct(self, obj_hex: str, err: Exception):
         from ray_tpu.core import serialization
 
+        self._lease_task_completed(obj_hex)
         with self._lock:
             fut = self._direct_futures.get(obj_hex)
             actor_hex = self._direct_actor_of.get(obj_hex, "")
@@ -511,6 +588,409 @@ class CoreClient:
                 except Exception:
                     pass
         # pending: _resolve_direct / _fail_direct forwards on arrival
+
+    # ------------------------------------------------------------------
+    # Owner-direct task leases.  The reference's normal-task hot path
+    # (CoreWorkerDirectTaskSubmitter, direct_task_transport.h:75): the
+    # owner leases workers from the scheduler once per task shape
+    # (RequestNewWorkerIfNeeded :353), pushes specs peer-to-peer
+    # (PushNormalTask :601), reuses idle leases (OnWorkerIdle :197) and
+    # returns them when the shape's queue drains.  Results ride the
+    # same direct connection back; the head is only involved in the
+    # lease grant/return and never sees individual tasks.
+    def _lease_eligible(self, spec: TaskSpec) -> bool:
+        if not self.config.direct_task_leases or self.thin:
+            return False
+        if spec.is_streaming or spec.num_returns != 1:
+            return False
+        if spec.placement_group_hex or spec.scheduling_strategy is not None:
+            return False
+        # Every arg must be resolvable without waiting: a leased worker
+        # blocking on an unproduced upstream object would hold the
+        # lease's resources and can deadlock the pool; the head path
+        # queues dep-pending tasks instead (reference: the owner-side
+        # DependencyResolver waits before pushing,
+        # transport/dependency_resolver.cc).
+        for a in spec.args:
+            if a.is_ref and not self._ref_resolved(a.object_hex):
+                return False
+        return True
+
+    def _ref_resolved(self, obj_hex: str) -> bool:
+        with self._lock:
+            if obj_hex in self._local_known:
+                return True
+            fut = self._direct_futures.get(obj_hex)
+            if fut is None:
+                fut = self._object_futures.get(obj_hex)
+            return fut is not None and fut.done()
+
+    @staticmethod
+    def _shape_of(spec: TaskSpec) -> tuple:
+        env_part = ""
+        if spec.runtime_env:
+            import json
+
+            env_part = hashlib.sha1(json.dumps(
+                spec.runtime_env, sort_keys=True).encode()).hexdigest()[:8]
+        return (tuple(sorted(spec.resources.items())), env_part)
+
+    def _submit_via_lease(self, spec: TaskSpec):
+        spec.direct = True
+        self._register_direct(spec.return_ids[0].hex(), "")
+        shape = self._shape_of(spec)
+        with self._lease_lock:
+            pool = self._leases.get(shape)
+            if pool is None:
+                pool = self._leases[shape] = _LeasePool(
+                    spec.resources, spec.runtime_env)
+            pool.queue.append(spec)
+            pool.idle_since = None
+            self._pump_lease_locked(shape, pool)
+
+    def _pump_lease_locked(self, shape: tuple, pool: "_LeasePool"):
+        """Lease lock held.  Assign queued specs to granted workers with
+        pipeline headroom; ask the head for workers for the rest."""
+        depth = self.config.lease_pipeline_depth
+        # While more workers are expected (granted or spawning), hold
+        # pipelining at 1 so concurrent tasks land on distinct workers
+        # (parity with the reference's one-lease-per-running-task
+        # default); once the fleet is settled — grants exhausted or
+        # denied — pipeline to full depth to absorb the backlog.
+        if pool.requested > 0 and \
+                len(pool.workers) < self.config.max_lease_workers_per_request:
+            depth = 1
+        assigns = []
+        if pool.queue and pool.workers:
+            # Breadth-first, least-loaded first: concurrent tasks land
+            # on distinct (ideally empty) workers; pipelining only
+            # absorbs backlog beyond the fleet cap.
+            order = sorted(pool.workers.items(),
+                           key=lambda kv: len(pool.inflight.get(kv[0], ())))
+            progress = True
+            while pool.queue and progress:
+                progress = False
+                for whex, addr in order:
+                    if not pool.queue:
+                        break
+                    infl = pool.inflight.setdefault(whex, {})
+                    if len(infl) >= depth:
+                        continue
+                    spec = pool.queue.popleft()
+                    task_hex = spec.task_id.hex()
+                    infl[task_hex] = spec
+                    self._lease_of_obj[spec.return_ids[0].hex()] = (
+                        shape, whex, task_hex)
+                    assigns.append((whex, addr, spec))
+                    progress = True
+        for whex, addr, spec in assigns:
+            key = "lease:" + whex
+            obj_hex = spec.return_ids[0].hex()
+            with self._lock:
+                self._direct_actor_of[obj_hex] = key
+                self._direct_inflight.setdefault(key, set()).add(obj_hex)
+            self._queue_for_flush("pool", addr, spec)
+        if pool.queue and time.monotonic() >= pool.backoff_until and \
+                min(len(pool.workers) + len(pool.queue),
+                    self.config.max_lease_workers_per_request) \
+                - len(pool.workers) - pool.requested > 0:
+            # Worker deficit: DEFER the request to the flusher so a
+            # submit burst coalesces into one request_lease carrying
+            # the whole count — N count=1 requests would each pick a
+            # spawn node with no view of the others' demand and stack
+            # every spawn on the same node.
+            self._lease_request_pending = True
+            self._ensure_flusher()
+            self._flush_ev.set()
+
+    def _send_lease_requests(self):
+        """Deferred lease requests (one per shape, batched count)."""
+        if not getattr(self, "_lease_request_pending", False):
+            return
+        self._lease_request_pending = False
+        with self._lease_lock:
+            now = time.monotonic()
+            for shape, pool in self._leases.items():
+                if not pool.queue or now < pool.backoff_until:
+                    continue
+                # Desired fleet: one worker per still-queued task
+                # (tasks that could run concurrently must not serialize
+                # behind a pipeline), capped.
+                desired = min(len(pool.workers) + len(pool.queue),
+                              self.config.max_lease_workers_per_request)
+                ask = desired - len(pool.workers) - pool.requested
+                if ask <= 0:
+                    continue
+                self._lease_token_seq += 1
+                token = self._lease_token_seq
+                self._lease_tokens[token] = [shape, ask]
+                pool.requested += ask
+                try:
+                    self.client.send({
+                        "op": "request_lease", "token": token,
+                        "resources": pool.resources,
+                        "runtime_env": pool.runtime_env, "count": ask})
+                except Exception:
+                    pool.requested -= ask
+                    self._lease_tokens.pop(token, None)
+
+    def _on_lease_granted(self, msg: dict):
+        workers = msg.get("workers", ())
+        denied = int(msg.get("denied", 0))
+        error = msg.get("error", "")
+        token = msg.get("token")
+        give_back = []
+        failed_specs: List[TaskSpec] = []
+        with self._lease_lock:
+            ent = self._lease_tokens.get(token)
+            if ent is None:
+                # Lease pool already released (queue drained while the
+                # request was in flight): hand the workers straight back.
+                give_back = [w["worker"] for w in workers]
+                pool = shape = None
+            else:
+                shape = ent[0]
+                ent[1] -= len(workers) + denied
+                if ent[1] <= 0:
+                    self._lease_tokens.pop(token, None)
+                pool = self._leases.get(shape)
+                if pool is None:
+                    give_back = [w["worker"] for w in workers]
+                else:
+                    pool.requested = max(
+                        0, pool.requested - len(workers) - denied)
+                    if denied and not workers:
+                        # Saturated (or broken env): back off before
+                        # re-requesting; keep pipelining what we have.
+                        pool.backoff_until = time.monotonic() + 0.25
+                    if error:
+                        # Permanent denial (runtime_env setup failed):
+                        # fail the queued specs like the head path's
+                        # unschedulable fast-fail.
+                        import collections
+
+                        failed_specs = list(pool.queue)
+                        pool.queue = collections.deque()
+                    for w in workers:
+                        whex, addr = w["worker"], w["address"]
+                        pool.workers[whex] = addr
+                        self._lease_addr_workers.setdefault(
+                            addr, set()).add(whex)
+                    self._pump_lease_locked(shape, pool)
+        if failed_specs:
+            from ray_tpu.core.exceptions import RuntimeEnvSetupError
+
+            for spec in failed_specs:
+                self._fail_direct(spec.return_ids[0].hex(),
+                                  RuntimeEnvSetupError(error))
+        if give_back:
+            try:
+                self.client.send({"op": "release_lease",
+                                  "workers": give_back})
+            except Exception:
+                pass
+        # Ship the assignments now — the granting push arrived on the
+        # rpc reader thread; the submitting thread may be parked in
+        # get() already.
+        self._flush_if_pending()
+
+    def _lease_task_completed(self, obj_hex: str):
+        """A direct task's result (or failure) arrived: free its
+        pipeline slot and feed the lease more work / start its idle
+        clock (reference OnWorkerIdle, direct_task_transport.cc:197)."""
+        with self._lease_lock:
+            ent = self._lease_of_obj.pop(obj_hex, None)
+            if ent is None:
+                return
+            shape, whex, task_hex = ent
+            pool = self._leases.get(shape)
+            if pool is None:
+                return
+            pool.inflight.get(whex, {}).pop(task_hex, None)
+            if pool.queue:
+                self._pump_lease_locked(shape, pool)
+            elif not pool.busy():
+                pool.idle_since = time.monotonic()
+
+    def _on_lease_worker_lost(self, whex: str, reason: str):
+        """A leased worker died (direct connection broke, or the head
+        pushed lease_revoked): owner-side retry of its in-flight specs
+        through the head path, mirroring the reference's owner-side
+        TaskManager retries (task_manager.h:208)."""
+        specs: List[TaskSpec] = []
+        shape = None
+        with self._lease_lock:
+            for s, p in self._leases.items():
+                # Match by inflight too: a reconnect drops granted
+                # workers but keeps their in-flight specs, which must
+                # still fail over if the worker then dies.
+                if whex in p.workers or p.inflight.get(whex):
+                    shape = s
+                    pool = p
+                    break
+            else:
+                return
+            addr = pool.workers.pop(whex, None)
+            if addr is not None:
+                peers = self._lease_addr_workers.get(addr)
+                if peers is not None:
+                    peers.discard(whex)
+                    if not peers:
+                        self._lease_addr_workers.pop(addr, None)
+            for task_hex, spec in pool.inflight.pop(whex, {}).items():
+                self._lease_of_obj.pop(spec.return_ids[0].hex(), None)
+                specs.append(spec)
+        with self._lock:
+            self._direct_inflight.pop("lease:" + whex, None)
+        from ray_tpu.core.exceptions import WorkerCrashedError
+
+        for spec in specs:
+            if spec.retry_count < spec.max_retries:
+                spec.retry_count += 1
+                self._lease_fallback_resubmit(spec)
+            else:
+                self._fail_direct(
+                    spec.return_ids[0].hex(),
+                    WorkerCrashedError(
+                        f"task {spec.name or spec.task_id.hex()}: "
+                        f"worker died: {reason}"))
+        with self._lease_lock:
+            pool = self._leases.get(shape)
+            if pool is not None and pool.queue:
+                self._pump_lease_locked(shape, pool)
+
+    def _lease_fallback_resubmit(self, spec: TaskSpec):
+        """Re-route a direct spec through the head's scheduler (worker
+        died / lease unavailable): the head registers its returns from
+        the spec, and the owner's direct future chains onto the head
+        subscription."""
+        spec.direct = False
+        obj_hex = spec.return_ids[0].hex()
+        # Sent inline (not queued): the subscribe below must reach the
+        # head AFTER the submit registers the return object.
+        try:
+            self.client.send({"op": "submit_task", "spec": spec})
+        except Exception:
+            return  # control plane down; reconnect path re-resolves
+        self._chain_head_to_direct(obj_hex)
+
+    def _chain_head_to_direct(self, obj_hex: str):
+        """Resolve a direct future from the head's object subscription
+        (the same promotion the oversized direct_result_remote path
+        uses)."""
+        with self._lock:
+            fut = self._direct_futures.get(obj_hex)
+            head_fut = self._object_futures.get(obj_hex)
+            if head_fut is None:
+                head_fut = Future()
+                self._object_futures[obj_hex] = head_fut
+            if obj_hex not in self._subscribed:
+                self._subscribed.add(obj_hex)
+                self.client.send({"op": "subscribe_objects",
+                                  "objs": [obj_hex]})
+        if fut is None or fut is head_fut:
+            return
+
+        def _chain(hf, fut=fut):
+            if fut.done():
+                return
+            try:
+                fut.set_result(hf.result())
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        head_fut.add_done_callback(_chain)
+
+    def _sweep_idle_leases(self):
+        """Return leases idle past the timeout (reference
+        OnWorkerIdle lease return after worker_lease_timeout)."""
+        now = time.monotonic()
+        to_release: List[str] = []
+        with self._lease_lock:
+            for shape, pool in list(self._leases.items()):
+                if pool.busy():
+                    pool.idle_since = None
+                    # Backed-off pool whose window expired: retry the
+                    # lease request for freed capacity.
+                    if pool.queue and pool.requested == 0 and \
+                            now >= pool.backoff_until:
+                        self._pump_lease_locked(shape, pool)
+                    continue
+                if pool.idle_since is None:
+                    pool.idle_since = now
+                    continue
+                if now - pool.idle_since < self.config.lease_idle_timeout_s:
+                    continue
+                for whex, addr in pool.workers.items():
+                    to_release.append(whex)
+                    peers = self._lease_addr_workers.get(addr)
+                    if peers is not None:
+                        peers.discard(whex)
+                        if not peers:
+                            self._lease_addr_workers.pop(addr, None)
+                del self._leases[shape]
+        if to_release:
+            try:
+                self.client.send({"op": "release_lease",
+                                  "workers": to_release})
+            except Exception:
+                pass
+
+    def _release_all_leases(self):
+        with self._lease_lock:
+            workers = [whex for pool in self._leases.values()
+                       for whex in pool.workers]
+            self._leases.clear()
+            self._lease_addr_workers.clear()
+            self._lease_tokens.clear()
+        if workers:
+            try:
+                self.client.send({"op": "release_lease",
+                                  "workers": workers})
+            except Exception:
+                pass
+
+    def cancel_ref(self, obj_hex: str, force: bool = False) -> bool:
+        """ray.cancel() entry: lease-path tasks are the owner's to
+        cancel (the head never saw them); everything else goes to the
+        head (reference: CancelTask is owner-initiated,
+        core_worker.proto:441)."""
+        from ray_tpu.core.exceptions import TaskCancelledError
+
+        with self._lease_lock:
+            # Queued, not yet assigned: drop it locally.
+            for pool in self._leases.values():
+                for i, spec in enumerate(pool.queue):
+                    if spec.return_ids and \
+                            spec.return_ids[0].hex() == obj_hex:
+                        del pool.queue[i]
+                        self._fail_direct(obj_hex, TaskCancelledError(
+                            f"task {spec.name or spec.task_id.hex()}: "
+                            "task cancelled"))
+                        return True
+            ent = self._lease_of_obj.get(obj_hex)
+        if ent is not None:
+            if not force:
+                return False  # running; parity with the head path
+            shape, whex, task_hex = ent
+            with self._lease_lock:
+                pool = self._leases.get(shape)
+                spec = pool.inflight.get(whex, {}).get(task_hex) \
+                    if pool is not None else None
+            if spec is not None:
+                spec.max_retries = spec.retry_count  # no retry on kill
+            self._fail_direct(obj_hex, TaskCancelledError(
+                "task cancelled (force)"))
+            try:
+                self.client.send({"op": "kill_worker", "worker": whex})
+            except Exception:
+                pass
+            return True
+        try:
+            return bool(self.client.call(
+                {"op": "cancel_object", "obj": obj_hex, "force": force}))
+        except Exception:
+            return False
 
     # ------------------------------------------------------------------
     # Objects
@@ -752,6 +1232,8 @@ class CoreClient:
         return self._store_serialized(oid, ser, is_error=is_error)
 
     def _store_serialized(self, oid: ObjectID, ser, is_error: bool = False):
+        with self._lock:
+            self._local_known.add(oid.hex())
         size = ser.total_bytes
         # Thin clients ship everything inline over the connection (bounded
         # only by the rpc frame limit); full clients inline small objects
@@ -855,6 +1337,7 @@ class CoreClient:
             return
         obj_hex = object_id.hex()
         with self._lock:
+            self._local_known.discard(obj_hex)
             if obj_hex in self._direct_futures:
                 self._direct_futures.pop(obj_hex, None)
                 actor_hex = self._direct_actor_of.pop(obj_hex, "")
@@ -970,7 +1453,12 @@ class CoreClient:
             borrows=borrows,
             is_streaming=streaming,
         )
-        self._queue_for_flush("submit", None, spec)
+        if self._lease_eligible(spec):
+            # Owner-direct lease path: the head never sees this task
+            # (reference direct task transport).
+            self._submit_via_lease(spec)
+        else:
+            self._queue_for_flush("submit", None, spec)
         if streaming:
             return ObjectRefGenerator(spec.task_id)
         return [ObjectRef(oid, owner=self.worker_hex) for oid in return_ids]
@@ -1113,7 +1601,9 @@ class CoreClient:
             if conn is not None:
                 return conn
         # Dial outside the lock; on_push carries owner-direct results.
-        conn = rpc.Client(address, on_push=self._on_direct_push)
+        conn = rpc.Client(
+            address, on_push=self._on_direct_push,
+            on_disconnect=lambda: self._on_direct_conn_lost(address))
         with self._lock:
             existing = self._actor_conns.get(address)
             if existing is not None:
@@ -1121,6 +1611,21 @@ class CoreClient:
                 return existing
             self._actor_conns[address] = conn
         return conn
+
+    def _on_direct_conn_lost(self, address: str):
+        """A direct (actor / leased-worker) connection dropped.  Actor
+        callers recover via the head's actor_update pushes; lease
+        workers are the owner's to fail over."""
+        if self._closed:
+            return
+        with self._lock:
+            conn = self._actor_conns.get(address)
+            if conn is not None and conn._closed:
+                self._actor_conns.pop(address, None)
+        with self._lease_lock:
+            whexes = list(self._lease_addr_workers.get(address, ()))
+        for whex in whexes:
+            self._on_lease_worker_lost(whex, "connection lost")
 
     def _send_actor_task(self, actor_hex: str, address: str, spec: TaskSpec):
         # One persistent flusher per client (not a timer per burst:
@@ -1132,14 +1637,32 @@ class CoreClient:
     def _flush_if_pending(self):
         if self._pending_count:
             self._flush_direct_sends()
+        if getattr(self, "_lease_request_pending", False):
+            self._send_lease_requests()
+
+    def _ensure_flusher(self):
+        start = False
+        with self._send_lock:
+            if not self._flusher_started:
+                self._flusher_started = True
+                start = True
+        if start:
+            threading.Thread(target=self._send_flusher,
+                             name="direct-send-flush",
+                             daemon=True).start()
 
     def _send_flusher(self):
         while not self._closed:
-            self._flush_ev.wait()
+            # With live leases the flusher doubles as the idle-lease
+            # sweeper (bounded wait); otherwise it parks until woken.
+            self._flush_ev.wait(timeout=0.1 if self._leases else None)
             self._flush_ev.clear()
             time.sleep(0.002)
             try:
                 self._flush_direct_sends()
+                self._send_lease_requests()
+                if self._leases:
+                    self._sweep_idle_leases()
             except Exception:
                 # The flusher is the fire-and-forget safety net; it must
                 # survive transient send failures (head restart window).
@@ -1156,6 +1679,8 @@ class CoreClient:
         with self._send_lock:
             if kind == "direct":
                 self._pending_direct.setdefault(key, []).append(item)
+            elif kind == "pool":
+                self._pending_pool.setdefault(key, []).append(item)
             else:
                 self._pending_submits.append((kind, item))
             self._pending_count += 1
@@ -1183,6 +1708,7 @@ class CoreClient:
             if self._pending_count == 0:
                 return
             pending, self._pending_direct = self._pending_direct, {}
+            pool_sends, self._pending_pool = self._pending_pool, {}
             submits, self._pending_submits = self._pending_submits, []
             self._pending_count = 0
         if submits:
@@ -1219,6 +1745,25 @@ class CoreClient:
             except Exception as e:  # connection refused: actor just died
                 for spec in specs:
                     self._fail_actor_task(spec, f"cannot reach actor: {e}")
+        for address, specs in pool_sends.items():
+            try:
+                conn = self._actor_conn(address)
+                if len(specs) == 1:
+                    conn.send({"op": "pool_task", "spec": specs[0]})
+                else:
+                    conn.send({"op": "pool_task_batch", "specs": specs})
+            except Exception:
+                # Leased worker unreachable: the per-worker loss path
+                # retries/fails each in-flight spec.
+                lost = set()
+                with self._lease_lock:
+                    for spec in specs:
+                        ent = self._lease_of_obj.get(
+                            spec.return_ids[0].hex())
+                        if ent is not None:
+                            lost.add(ent[1])
+                for whex in lost:
+                    self._on_lease_worker_lost(whex, "connection lost")
 
     @staticmethod
     def _head_frames(items):
@@ -1291,6 +1836,10 @@ class CoreClient:
     def close(self):
         try:
             self._flush_direct_sends()
+        except Exception:
+            pass
+        try:
+            self._release_all_leases()
         except Exception:
             pass
         self._closed = True

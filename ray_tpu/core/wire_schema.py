@@ -60,6 +60,12 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     "get_object_json": {"obj": "str"},
     "cancel_object": {"obj": "str", "force": "bool?"},
     "cancel_task": {"task": "str", "force": "bool?"},
+    # -- worker leases (owner-direct task path) ------------------------
+    "request_lease": {"token": "int?", "resources": "dict?",
+                      "runtime_env": "dict?", "count": "int?"},
+    "release_lease": {"workers": "list"},
+    "kill_worker": {"worker": "str"},
+    "task_events": {"events": "list"},
     # -- functions -----------------------------------------------------
     "put_func": {"func_id": "str", "blob": "bytes"},
     "get_func": {"func_id": "str"},

@@ -188,6 +188,19 @@ class WorkerRuntime:
                 spec._arrival_conn = conn
                 self._task_queue.put(spec)
             return None
+        if op == "pool_task":
+            # Owner-direct leased task (reference PushNormalTask,
+            # direct_task_transport.cc:601): executes on the pool lane;
+            # the result rides this connection back.
+            spec = msg["spec"]
+            spec._arrival_conn = conn
+            self._on_execute_task(spec)
+            return None
+        if op == "pool_task_batch":
+            for spec in msg["specs"]:
+                spec._arrival_conn = conn
+                self._on_execute_task(spec)
+            return None
         if op == "ping":
             return "pong"
         raise ValueError(f"unknown direct op {op}")
@@ -346,9 +359,12 @@ class WorkerRuntime:
         when the queue drains, at 64 results, or after 1 ms (flusher
         thread) — whichever first.  A lone result pushes immediately, so
         sync callers see no added latency."""
+        pool_q = getattr(self, "_pool_queue", None)
+        queued = not self._task_queue.empty() or (
+            pool_q is not None and not pool_q.empty())
         with self._res_lock:
             buffered = self._res_buf.get(id(conn))
-            if buffered is None and self._task_queue.empty():
+            if buffered is None and not queued:
                 buffered = False  # immediate path
             else:
                 if buffered is None:
@@ -362,7 +378,7 @@ class WorkerRuntime:
             except Exception:
                 pass  # owner disconnected: nobody is waiting
             return
-        if n >= 64 or self._task_queue.empty():
+        if n >= 64 or not queued:
             self._flush_direct_results()
         else:
             self._res_flush_ev.set()
@@ -392,10 +408,34 @@ class WorkerRuntime:
             self._res_flush_ev.clear()
             time.sleep(0.001)
             self._flush_direct_results()
+            self._flush_task_events()
 
     def _finish(self, spec: TaskSpec, failed: bool,
                 puts: Optional[List[dict]] = None):
         if spec.actor_id is None:
+            if getattr(spec, "direct", False) and \
+                    getattr(spec, "_arrival_conn", None) is not None:
+                # Leased task (owner-direct): no head slot to return —
+                # the lease holds the resources until the owner releases
+                # it.  Only the borrow decrefs (coalesced) and a batched
+                # task event for observability go to the head
+                # (reference: TaskEventBuffer flushes execution events
+                # off the hot path, task_event_buffer.h:206).
+                for obj_hex in spec.borrows:
+                    self.core._queue_for_flush("decref", None, obj_hex)
+                self._buffer_task_event(spec, failed)
+                if getattr(self, "_announce_pending", False):
+                    # Deferred post-head-restart announce (see
+                    # _on_reconnect): without it this worker would stay
+                    # 'starting' on the restarted head forever.
+                    pool_q = getattr(self, "_pool_queue", None)
+                    if pool_q is None or pool_q.empty():
+                        self._announce_pending = False
+                        try:
+                            self.core.client.send({"op": "worker_online"})
+                        except Exception:
+                            pass
+                return
             # One combined control message: result puts + borrow decrefs
             # + completion (was 1 put per return + 1 decref per borrow +
             # 1 done = the control plane's hottest path).
@@ -403,17 +443,58 @@ class WorkerRuntime:
                 "op": "task_done", "task_id": spec.task_id.hex(),
                 "failed": failed, "puts": puts or [],
                 "decrefs": list(spec.borrows)})
+            self._announce_pending = False  # task_done re-binds state
         else:
             for obj_hex in spec.borrows:
                 self.core.client.send({"op": "decref", "obj": obj_hex})
+
+    def _buffer_task_event(self, spec: TaskSpec, failed: bool):
+        """Queue a compact task-state event; flushed in batches so the
+        state API / timeline still see lease-path tasks the head never
+        scheduled (reference GcsTaskManager events)."""
+        ev = {
+            "task_id": spec.task_id.hex(),
+            "name": spec.name or spec.func_id[:8],
+            "owner": spec.owner,
+            "state": "FAILED" if failed else "FINISHED",
+            "start": getattr(spec, "_exec_started", 0.0),
+            "end": time.time(),
+        }
+        with self._res_lock:
+            buf = getattr(self, "_task_events", None)
+            if buf is None:
+                buf = self._task_events = []
+            buf.append(ev)
+            n = len(buf)
+        if n >= 100:
+            self._flush_task_events()
+        else:
+            self._res_flush_ev.set()
+
+    def _flush_task_events(self):
+        with self._res_lock:
+            buf = getattr(self, "_task_events", None)
+            if not buf:
+                return
+            self._task_events = []
+        try:
+            self.core.client.send({"op": "task_events", "events": buf})
+        except Exception:
+            pass
 
     def _execute(self, spec: TaskSpec, target_fn=None):
         failed = False
         self._executing = True
         self._cur_tls.spec = spec
+        spec._exec_started = time.time()
         # Pool (non-actor, non-streaming) tasks batch their result puts
         # into the task_done message; streaming items must flow live.
-        batch_puts = spec.actor_id is None and not spec.is_streaming
+        # Leased (owner-direct) tasks send no task_done at all, so their
+        # (rare, oversized-result) puts must flow immediately.
+        batch_puts = (spec.actor_id is None and not spec.is_streaming
+                      and not (getattr(spec, "direct", False)
+                               and getattr(spec, "_arrival_conn", None)
+                               is not None))
         try:
             args, kwargs = self._resolve_call(spec)
             fn = target_fn if target_fn is not None else self._resolve_fn(spec)
@@ -697,6 +778,12 @@ class WorkerRuntime:
                 # concurrent task; the in-flight task's task_done flips
                 # them idle when it actually finishes.
                 self.core.client.send({"op": "worker_online"})
+            else:
+                # Leased tasks send no task_done, so nothing would ever
+                # flip this worker out of 'starting' on the restarted
+                # head — announce when the current work drains
+                # (_finish direct branch).
+                self._announce_pending = True
         except Exception:
             pass
 

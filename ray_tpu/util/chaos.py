@@ -83,8 +83,10 @@ class WorkerKiller(ResourceKiller):
     def find_target(self) -> Optional[int]:
         from ray_tpu.state.api import list_workers
 
+        # "leased" workers are the owner-direct path's busy equivalent
+        # (resources held, likely executing).
         busy = [w for w in list_workers()
-                if w["kind"] == "pool" and w["state"] == "busy"
+                if w["kind"] == "pool" and w["state"] in ("busy", "leased")
                 and w.get("pid")]
         if not busy:
             return None

@@ -26,17 +26,23 @@ def test_add_node_grows_resources(cluster):
 
 
 def test_tasks_spill_to_second_node(cluster):
-    """More concurrent tasks than head CPUs -> some run via node-2 workers."""
+    """More concurrent tasks than head CPUs -> some run via node-2 workers.
+
+    The sleeps must outlast worker-spawn latency: the owner-direct lease
+    path reuses a finished worker for queued same-shape work (work
+    conservation, reference OnWorkerIdle direct_task_transport.cc:197),
+    so only tasks still queued when the node-2 spawns come online land
+    there."""
     cluster.add_node(num_cpus=2, node_id="n2")
 
     @ray_tpu.remote
     def which():
         import os
-        time.sleep(0.3)
+        time.sleep(3.0)
         return os.getpid()
 
     refs = [which.remote() for _ in range(4)]
-    pids = set(ray_tpu.get(refs, timeout=30))
+    pids = set(ray_tpu.get(refs, timeout=60))
     assert len(pids) == 4  # 4 concurrent workers needed 2 nodes
 
 
