@@ -17,6 +17,7 @@ from ray_tpu.serve.api import (
     shutdown,
     start,
     start_frame_ingress,
+    start_grpc_ingress,
     status,
 )
 from ray_tpu.serve.asgi import asgi_app, ingress
@@ -47,6 +48,7 @@ __all__ = [
     "get_deployment_handle",
     "proxy_address",
     "start_frame_ingress",
+    "start_grpc_ingress",
     "DeploymentHandle",
     "DeploymentResponse",
     "AutoscalingConfig",

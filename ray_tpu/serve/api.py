@@ -166,6 +166,17 @@ def start_frame_ingress() -> str:
     return ray_tpu.get(controller.frame_proxy_address.remote(), timeout=30)
 
 
+def start_grpc_ingress() -> str:
+    """Start (idempotently) the typed gRPC ingress and return its
+    host:port.  The wire contract is ray_tpu/serve/protos/serve.proto
+    (service ray_tpu.serve.ServeAPI: Call / CallStream / ListRoutes /
+    Healthz) — the counterpart of the reference's gRPC proxy + serve
+    proto schema (serve/_private/proxy.py:540, protobuf/serve.proto)."""
+    controller = _get_controller()
+    ray_tpu.get(controller.ensure_grpc_proxy.remote(), timeout=30)
+    return ray_tpu.get(controller.grpc_proxy_address.remote(), timeout=30)
+
+
 def shutdown():
     """Tear down all applications and the serve control plane."""
     global _controller

@@ -228,6 +228,24 @@ class ServeController:
             return None
         return ray_tpu.get(proxy.address.remote(), timeout=timeout)
 
+    def ensure_grpc_proxy(self) -> None:
+        """Start the typed gRPC ingress actor once (reference gRPCProxy,
+        serve/_private/proxy.py:540; contract in serve/protos/serve.proto)."""
+        with self._lock:
+            if getattr(self, "_grpc_proxy_handle", None) is not None:
+                return
+            from ray_tpu.serve.grpc_proxy import GrpcProxy
+
+            self._grpc_proxy_handle = ray_tpu.remote(GrpcProxy).options(
+                max_concurrency=8, num_cpus=0).remote(self._http[0], 0)
+
+    def grpc_proxy_address(self, timeout: float = 20.0) -> Optional[str]:
+        with self._lock:
+            proxy = getattr(self, "_grpc_proxy_handle", None)
+        if proxy is None:
+            return None
+        return ray_tpu.get(proxy.address.remote(), timeout=timeout)
+
     # ------------------------------------------------------------------
     # Introspection (routers, proxies, serve.status)
     def listen_for_change(self, known: Dict[str, int],

@@ -1,0 +1,1 @@
+"""Generated + source proto contracts for the Serve gRPC ingress."""

@@ -1,0 +1,95 @@
+"""Typed gRPC ingress tests (reference: serve gRPC proxy
+python/ray/serve/_private/proxy.py:540 + protobuf/serve.proto; ours is
+ray_tpu/serve/protos/serve.proto served by serve/grpc_proxy.py)."""
+
+import json
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+grpc = pytest.importorskip("grpc")
+
+from ray_tpu.serve.protos import serve_pb2  # noqa: E402
+
+_SVC = "/ray_tpu.serve.ServeAPI"
+
+
+@pytest.fixture(scope="module")
+def grpc_serve():
+    ray_tpu.init(num_cpus=8)
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, req):
+            body = json.loads(req.body) if req.body else None
+            return {"echo": body, "hdr": req.headers.get("x-tag", "")}
+
+        def gen(self, req):
+            n = json.loads(req.body)["n"]
+            for i in range(n):
+                yield {"i": i}
+
+    serve.run(Echo.bind(), name="echo", route_prefix="/echo")
+    addr = serve.start_grpc_ingress()
+    assert addr == serve.start_grpc_ingress()  # idempotent
+    channel = grpc.insecure_channel(addr)
+    yield channel
+    channel.close()
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _stub(channel, method, req_cls, reply_cls, stream=False):
+    factory = channel.unary_stream if stream else channel.unary_unary
+    return factory(f"{_SVC}/{method}",
+                   request_serializer=req_cls.SerializeToString,
+                   response_deserializer=reply_cls.FromString)
+
+
+def test_grpc_healthz_and_routes(grpc_serve):
+    hz = _stub(grpc_serve, "Healthz", serve_pb2.Empty, serve_pb2.Empty)
+    hz(serve_pb2.Empty(), timeout=30)
+    lr = _stub(grpc_serve, "ListRoutes", serve_pb2.Empty,
+               serve_pb2.RouteListing)
+    deadline = time.monotonic() + 20
+    routes = {}
+    while time.monotonic() < deadline:
+        routes = dict(lr(serve_pb2.Empty(), timeout=30).routes)
+        if "/echo" in routes:
+            break
+        time.sleep(0.2)
+    assert "/echo" in routes and routes["/echo"].startswith("echo/")
+
+
+def test_grpc_unary_call(grpc_serve):
+    call = _stub(grpc_serve, "Call", serve_pb2.ServeRequest,
+                 serve_pb2.ServeReply)
+    reply = call(serve_pb2.ServeRequest(
+        route="/echo", payload=json.dumps({"a": 1}).encode(),
+        headers={"x-tag": "t1"}), timeout=60)
+    assert reply.status == 200, reply.error
+    assert json.loads(reply.payload) == {"echo": {"a": 1}, "hdr": "t1"}
+
+
+def test_grpc_unknown_route_404(grpc_serve):
+    call = _stub(grpc_serve, "Call", serve_pb2.ServeRequest,
+                 serve_pb2.ServeReply)
+    reply = call(serve_pb2.ServeRequest(route="/nope", payload=b"{}"),
+                 timeout=60)
+    assert reply.status == 404
+    assert "no application" in reply.error
+
+
+def test_grpc_stream_call(grpc_serve):
+    stream = _stub(grpc_serve, "CallStream", serve_pb2.ServeRequest,
+                   serve_pb2.ServeReply, stream=True)
+    frames = list(stream(serve_pb2.ServeRequest(
+        route="/echo", method="gen",
+        payload=json.dumps({"n": 4}).encode()), timeout=60))
+    assert frames[-1].is_final
+    items = [json.loads(f.payload) for f in frames if f.payload]
+    assert items == [{"i": i} for i in range(4)]
+    assert all(f.status == 200 for f in frames)
