@@ -1,0 +1,141 @@
+"""Command runners: how the autoscaler executes setup/start commands on
+provisioned hosts.
+
+Reference counterpart: python/ray/autoscaler/_private/command_runner.py
+(SSHCommandRunner / DockerCommandRunner).  Two implementations:
+
+- SSHCommandRunner: real ssh/scp subprocesses with connection reuse
+  (ControlMaster) and the usual non-interactive hardening flags — the
+  path a real GCE/ssh cluster uses.
+- LocalCommandRunner: the same interface over a local shell, used by the
+  "local" provider (worker processes on this host) and by tests — the
+  zero-egress stand-in for a remote host.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from typing import Dict, List, Optional
+
+
+class CommandRunner:
+    """Run shell commands (and file pushes) on one target host."""
+
+    def run(self, cmd: str, timeout: float = 120.0,
+            env: Optional[Dict[str, str]] = None) -> str:
+        """Run `cmd`, return stdout; raise CalledProcessError on rc!=0."""
+        raise NotImplementedError
+
+    def run_rsync_up(self, source: str, target: str) -> None:
+        """Copy a local file/dir to the target host."""
+        raise NotImplementedError
+
+    def remote_shell_command_str(self) -> str:
+        """A copy-pastable shell line for debugging this host."""
+        raise NotImplementedError
+
+
+class LocalCommandRunner(CommandRunner):
+    """Runs on THIS host — the 'local' provider's runner and the test
+    double for SSH (identical interface, identical updater flow)."""
+
+    def __init__(self, log_prefix: str = ""):
+        self.log_prefix = log_prefix
+
+    def run(self, cmd: str, timeout: float = 120.0,
+            env: Optional[Dict[str, str]] = None) -> str:
+        merged = dict(os.environ)
+        if env:
+            merged.update(env)
+        out = subprocess.run(
+            ["bash", "-c", cmd], capture_output=True, text=True,
+            timeout=timeout, env=merged)
+        if out.returncode != 0:
+            raise subprocess.CalledProcessError(
+                out.returncode, cmd, out.stdout, out.stderr)
+        return out.stdout
+
+    def run_rsync_up(self, source: str, target: str) -> None:
+        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+        subprocess.run(["cp", "-r", source, target], check=True)
+
+    def remote_shell_command_str(self) -> str:
+        return "bash"
+
+
+class SSHCommandRunner(CommandRunner):
+    """ssh/scp with ControlMaster connection reuse (reference
+    command_runner.py SSHCommandRunner + SSHOptions)."""
+
+    def __init__(self, host: str, user: str = "",
+                 ssh_key: str = "", port: int = 22,
+                 control_path_dir: str = "/tmp/ray_tpu_ssh"):
+        self.host = host
+        self.user = user
+        self.port = port
+        self.ssh_key = ssh_key
+        os.makedirs(control_path_dir, exist_ok=True)
+        control = os.path.join(
+            control_path_dir, f"{user or 'me'}@{host}:{port}")
+        self._opts: List[str] = [
+            "-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null",
+            "-o", "LogLevel=ERROR",
+            "-o", "IdentitiesOnly=yes",
+            "-o", "ConnectTimeout=10",
+            "-o", "ControlMaster=auto",
+            "-o", f"ControlPath={control}",
+            "-o", "ControlPersist=30s",
+            "-p", str(port),
+        ]
+        if ssh_key:
+            self._opts += ["-i", ssh_key]
+
+    @property
+    def _target(self) -> str:
+        return f"{self.user}@{self.host}" if self.user else self.host
+
+    def run(self, cmd: str, timeout: float = 120.0,
+            env: Optional[Dict[str, str]] = None) -> str:
+        envline = ""
+        if env:
+            exports = " ".join(
+                f"{k}={subprocess.list2cmdline([v])}"
+                for k, v in env.items())
+            envline = f"export {exports} && "
+        full = ["ssh", *self._opts, self._target,
+                f"bash -lc {subprocess.list2cmdline([envline + cmd])}"]
+        out = subprocess.run(full, capture_output=True, text=True,
+                             timeout=timeout)
+        if out.returncode != 0:
+            raise subprocess.CalledProcessError(
+                out.returncode, cmd, out.stdout, out.stderr)
+        return out.stdout
+
+    def run_rsync_up(self, source: str, target: str) -> None:
+        subprocess.run(
+            ["scp", *self._opts, "-r", source,
+             f"{self._target}:{target}"], check=True,
+            capture_output=True)
+
+    def remote_shell_command_str(self) -> str:
+        key = f" -i {self.ssh_key}" if self.ssh_key else ""
+        return f"ssh{key} -p {self.port} {self._target}"
+
+
+def wait_ready(runner: CommandRunner, timeout: float = 120.0,
+               poll: float = 2.0) -> None:
+    """Block until the host answers a trivial command (reference
+    updater's wait_ready loop probing `uptime`)."""
+    deadline = time.monotonic() + timeout
+    last: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            runner.run("uptime", timeout=15.0)
+            return
+        except Exception as e:  # noqa: BLE001 — host still booting
+            last = e
+            time.sleep(poll)
+    raise TimeoutError(f"host never became reachable: {last}")

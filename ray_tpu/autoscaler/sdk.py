@@ -1,0 +1,146 @@
+"""Cluster-launcher SDK: `ray-tpu up / down` from a YAML config.
+
+Reference counterpart: python/ray/autoscaler/sdk.py +
+autoscaler/_private/commands.py (`ray up`): start the head over its
+host's command runner, then bring worker nodes up through the node
+updater — files synced, setup commands run, node daemon started and
+joined.
+
+Config schema (a compact cousin of autoscaler/ray-schema.json):
+
+    cluster_name: demo
+    max_workers: 2
+    provider:
+      type: local | ssh
+      head_ip: 127.0.0.1
+      head_port: 7399          # control port workers dial
+      worker_ips: [10.0.0.2]
+      nodes_per_host: 1        # 0 = unlimited (local testing)
+    auth:
+      ssh_user: ubuntu
+      ssh_private_key: ~/.ssh/key.pem
+    file_mounts: {/remote/path: /local/path}
+    initialization_commands: []
+    setup_commands: []
+    worker_nodes:
+      CPU: 4
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ray_tpu.autoscaler.command_runner import CommandRunner, wait_ready
+from ray_tpu.autoscaler.ssh_provider import ManualHostProvider
+from ray_tpu.autoscaler.updater import NodeUpdater
+
+
+def load_config(path: str) -> dict:
+    import yaml
+
+    with open(path) as f:
+        config = yaml.safe_load(f)
+    provider = config.setdefault("provider", {})
+    provider.setdefault("type", "local")
+    provider.setdefault("head_ip", "127.0.0.1")
+    provider.setdefault("head_port", 7399)
+    config.setdefault("worker_nodes", {"CPU": 1})
+    config.setdefault("max_workers", len(
+        provider.get("worker_ips", ["127.0.0.1"])))
+    return config
+
+
+def head_address(config: dict) -> str:
+    p = config["provider"]
+    return f"{p['head_ip']}:{p['head_port']}"
+
+
+def _head_runner(config: dict) -> CommandRunner:
+    provider = ManualHostProvider(config, head_address(config))
+    return provider.runner_for(config["provider"]["head_ip"])
+
+
+def _head_alive(config: dict) -> bool:
+    from ray_tpu.core import rpc
+
+    try:
+        client = rpc.Client(head_address(config), connect_timeout=2.0)
+        client.call({"op": "ping"}, timeout=5.0)
+        client.close()
+        return True
+    except Exception:
+        return False
+
+
+def create_or_update_cluster(config: dict,
+                             workers: Optional[int] = None) -> dict:
+    """Bring the cluster to the configured shape; returns a report.
+
+    Idempotent like the reference's `ray up`: a live head is reused,
+    worker bring-up runs through NodeUpdaters in parallel."""
+    addr = head_address(config)
+    report = {"head": addr, "workers": [], "failed": []}
+    runner = _head_runner(config)
+    if not _head_alive(config):
+        head_res = config.get("head_node", {})
+        cmd = ("python -m ray_tpu.scripts.cli start --head --block "
+               "--no-dashboard "
+               + " ".join(f"--num-cpus {v:g}" if k == "CPU" else
+                          f"--num-tpus {v:g}" if k == "TPU" else ""
+                          for k, v in head_res.items()).strip()
+               + " > /tmp/ray_tpu/head-up.log 2>&1 & disown")
+        runner.run("mkdir -p /tmp/ray_tpu", timeout=30)
+        runner.run(cmd, timeout=30, env={
+            "RAY_TPU_CONTROL_PORT": str(config["provider"]["head_port"]),
+            "RAY_TPU_NODE_IP_ADDRESS": config["provider"]["head_ip"]})
+        deadline = time.monotonic() + 60
+        while not _head_alive(config):
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"head never came up at {addr}; see "
+                    "/tmp/ray_tpu/head-up.log on the head host")
+            time.sleep(0.5)
+    provider = ManualHostProvider(config, addr)
+    want = config["max_workers"] if workers is None else workers
+    node_ids: List[str] = []
+    for _ in range(want):
+        nid = provider.create_node("worker", dict(config["worker_nodes"]))
+        if nid is None:
+            break
+        node_ids.append(nid)
+    deadline = time.monotonic() + 300
+    for nid in node_ids:
+        upd: NodeUpdater = provider._nodes[nid]["updater"]
+        ok = upd.wait(max(0.0, deadline - time.monotonic()))
+        (report["workers"] if ok else report["failed"]).append(
+            {"node_id": nid, "status": upd.status,
+             "error": upd.error})
+    report["provider"] = provider
+    return report
+
+
+def teardown_cluster(config: dict) -> None:
+    """`ray down`: remove worker nodes, then stop the head."""
+    from ray_tpu.core import rpc
+
+    addr = head_address(config)
+    try:
+        client = rpc.Client(addr, connect_timeout=2.0)
+    except Exception:
+        return  # nothing running
+    try:
+        nodes = client.call({"op": "list_nodes"}, timeout=10)
+        for n in nodes:
+            if not n.get("is_head") and n.get("alive"):
+                try:
+                    client.call({"op": "remove_node",
+                                 "node_id": n["node_id"]}, timeout=10)
+                except Exception:
+                    pass
+        try:
+            client.call({"op": "shutdown_cluster"}, timeout=5)
+        except Exception:
+            pass  # head exits mid-reply
+    finally:
+        client.close()

@@ -1,0 +1,111 @@
+"""Manual-host node provider: real provisioning over command runners.
+
+Reference counterpart: the "local" node provider
+(python/ray/autoscaler/_private/local/node_provider.py) — a fixed pool
+of reachable hosts; bring-up/teardown happen over SSH via the command
+runner + node updater rather than a cloud API.  With `type: local` the
+same flow runs through LocalCommandRunner (worker daemons on this
+host), which is also how tests exercise the full path offline.
+"""
+
+from __future__ import annotations
+
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.command_runner import (
+    CommandRunner,
+    LocalCommandRunner,
+    SSHCommandRunner,
+)
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.updater import NodeUpdater, stop_node
+
+
+class ManualHostProvider(NodeProvider):
+    """Provision worker nodes onto a fixed host pool via ssh/local
+    command runners."""
+
+    def __init__(self, config: dict, head_address: str):
+        provider = config.get("provider", {})
+        self._type = provider.get("type", "local")
+        self._hosts: List[str] = list(
+            provider.get("worker_ips", ["127.0.0.1"]))
+        self._auth = config.get("auth", {})
+        self._config = config
+        self.head_address = head_address
+        self._lock = threading.Lock()
+        # node_id -> {host, type, updater}
+        self._nodes: Dict[str, dict] = {}
+        self._in_use: Dict[str, int] = {}  # host -> node count
+        # type: local allows many nodes per host; ssh defaults to one.
+        self._per_host = int(provider.get(
+            "nodes_per_host", 0 if self._type == "local" else 1))
+
+    def runner_for(self, host: str) -> CommandRunner:
+        if self._type == "local" or host in ("localhost", "127.0.0.1"):
+            return LocalCommandRunner(log_prefix=host)
+        return SSHCommandRunner(
+            host, user=self._auth.get("ssh_user", ""),
+            ssh_key=self._auth.get("ssh_private_key", ""),
+            port=int(self._auth.get("ssh_port", 22)))
+
+    def _pick_host(self) -> Optional[str]:
+        for h in self._hosts:
+            used = self._in_use.get(h, 0)
+            if not self._per_host or used < self._per_host:
+                return h
+        return None
+
+    def create_node(self, node_type: str,
+                    resources: Dict[str, float]) -> Optional[str]:
+        with self._lock:
+            host = self._pick_host()
+            if host is None:
+                return None  # pool exhausted
+            node_id = f"{node_type}-{uuid.uuid4().hex[:6]}"
+            self._in_use[host] = self._in_use.get(host, 0) + 1
+            entry = {"host": host, "type": node_type, "updater": None}
+            self._nodes[node_id] = entry
+        res = dict(resources)
+        updater = NodeUpdater(
+            node_id, self.runner_for(host),
+            head_address=self.head_address,
+            file_mounts=self._config.get("file_mounts"),
+            initialization_commands=self._config.get(
+                "initialization_commands"),
+            setup_commands=self._config.get("setup_commands"),
+            num_cpus=res.pop("CPU", None),
+            num_tpus=res.pop("TPU", None),
+            labels={"autoscaler-node-type": node_type})
+        entry["updater"] = updater
+        updater.start()
+        return node_id
+
+    def terminate_node(self, node_id: str) -> bool:
+        with self._lock:
+            entry = self._nodes.pop(node_id, None)
+            if entry is None:
+                return False
+            host = entry["host"]
+            self._in_use[host] = max(0, self._in_use.get(host, 1) - 1)
+        stop_node(self.runner_for(host), node_id, self.head_address)
+        return True
+
+    def non_terminated_nodes(self) -> List[str]:
+        with self._lock:
+            return list(self._nodes)
+
+    def node_type_of(self, node_id: str) -> Optional[str]:
+        with self._lock:
+            entry = self._nodes.get(node_id)
+            return entry["type"] if entry else None
+
+    def node_status(self, node_id: str) -> str:
+        with self._lock:
+            entry = self._nodes.get(node_id)
+        if entry is None:
+            return "terminated"
+        upd = entry["updater"]
+        return upd.status if upd is not None else "unknown"

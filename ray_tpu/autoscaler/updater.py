@@ -1,0 +1,134 @@
+"""Node updater: drives one provisioned host from "instance exists" to
+"node manager joined the cluster".
+
+Reference counterpart: python/ray/autoscaler/_private/updater.py
+(NodeUpdaterThread): wait for the host, push files, run initialization
+and setup commands, then the start command, reporting status back to
+the provider's tag store.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ray_tpu.autoscaler.command_runner import CommandRunner, wait_ready
+
+STATUS_WAITING = "waiting-for-ssh"
+STATUS_SYNCING = "syncing-files"
+STATUS_SETTING_UP = "setting-up"
+STATUS_STARTING = "starting-ray"
+STATUS_UP_TO_DATE = "up-to-date"
+STATUS_FAILED = "update-failed"
+
+
+class NodeUpdater:
+    """One host's bring-up; run() is blocking, start() threads it."""
+
+    def __init__(self, node_id: str, runner: CommandRunner, *,
+                 head_address: str,
+                 file_mounts: Optional[Dict[str, str]] = None,
+                 initialization_commands: Optional[List[str]] = None,
+                 setup_commands: Optional[List[str]] = None,
+                 start_command: str = "",
+                 num_cpus: Optional[float] = None,
+                 num_tpus: Optional[float] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 on_status: Optional[Callable[[str, str], None]] = None,
+                 ready_timeout: float = 120.0):
+        self.node_id = node_id
+        self.runner = runner
+        self.head_address = head_address
+        self.file_mounts = file_mounts or {}
+        self.initialization_commands = initialization_commands or []
+        self.setup_commands = setup_commands or []
+        self.start_command = start_command
+        self.num_cpus = num_cpus
+        self.num_tpus = num_tpus
+        self.labels = labels or {}
+        self.ready_timeout = ready_timeout
+        self._on_status = on_status
+        self.status = STATUS_WAITING
+        self.error: str = ""
+        self._thread: Optional[threading.Thread] = None
+
+    def _set_status(self, status: str):
+        self.status = status
+        if self._on_status is not None:
+            try:
+                self._on_status(self.node_id, status)
+            except Exception:
+                pass
+
+    def _default_start_command(self) -> str:
+        parts = ["python -m ray_tpu.scripts.cli start",
+                 f"--address {self.head_address}",
+                 f"--node-id {self.node_id}", "--detach"]
+        if self.num_cpus is not None:
+            parts.append(f"--num-cpus {self.num_cpus:g}")
+        if self.num_tpus is not None:
+            parts.append(f"--num-tpus {self.num_tpus:g}")
+        for k, v in self.labels.items():
+            parts.append(f"--label {k}={v}")
+        return " ".join(parts)
+
+    def run(self) -> bool:
+        try:
+            self._set_status(STATUS_WAITING)
+            wait_ready(self.runner, timeout=self.ready_timeout)
+            if self.file_mounts:
+                self._set_status(STATUS_SYNCING)
+                for target, source in self.file_mounts.items():
+                    self.runner.run_rsync_up(source, target)
+            if self.initialization_commands or self.setup_commands:
+                self._set_status(STATUS_SETTING_UP)
+                for cmd in (*self.initialization_commands,
+                            *self.setup_commands):
+                    self.runner.run(cmd, timeout=600.0)
+            self._set_status(STATUS_STARTING)
+            self.runner.run(
+                self.start_command or self._default_start_command(),
+                timeout=300.0)
+            self._set_status(STATUS_UP_TO_DATE)
+            return True
+        except subprocess.CalledProcessError as e:
+            self.error = (f"command failed (rc={e.returncode}): "
+                          f"{e.cmd}\n{e.stderr or e.output or ''}")
+        except Exception as e:  # noqa: BLE001 — surfaced via status
+            self.error = f"{type(e).__name__}: {e}"
+        self._set_status(STATUS_FAILED)
+        return False
+
+    def start(self) -> threading.Thread:
+        t = threading.Thread(target=self.run,
+                             name=f"updater-{self.node_id}", daemon=True)
+        t.start()
+        self._thread = t
+        return t
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self.status == STATUS_UP_TO_DATE
+
+
+def stop_node(runner: CommandRunner, node_id: str,
+              head_address: str) -> None:
+    """Tear down a provisioned node (reference: `ray stop` over the
+    command runner during teardown)."""
+    try:
+        runner.run("python -m ray_tpu.scripts.cli stop --node "
+                   f"{node_id} --address {head_address}", timeout=60.0)
+    except Exception:
+        pass  # best-effort; the head reaps the dead node either way
+
+
+def _updater_wait_all(updaters: List[NodeUpdater],
+                      timeout: float = 300.0) -> bool:
+    deadline = time.monotonic() + timeout
+    ok = True
+    for u in updaters:
+        ok &= u.wait(max(0.0, deadline - time.monotonic()))
+    return ok

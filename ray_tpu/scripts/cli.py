@@ -31,17 +31,18 @@ _ADDRESS_FILE = "/tmp/ray_tpu/cluster_address"
 _DASHBOARD_FILE = "/tmp/ray_tpu/dashboard_url"
 
 
-def _client():
+def _client(addr: str = None):
     """Bare control-plane client for read-only commands (no runtime)."""
     from ray_tpu.core import rpc
 
-    try:
-        with open(_ADDRESS_FILE) as f:
-            addr = f.read().strip()
-    except FileNotFoundError:
-        print("no running cluster (did you `ray-tpu start --head`?)",
-              file=sys.stderr)
-        sys.exit(1)
+    if not addr:
+        try:
+            with open(_ADDRESS_FILE) as f:
+                addr = f.read().strip()
+        except FileNotFoundError:
+            print("no running cluster (did you `ray-tpu start --head`?)",
+                  file=sys.stderr)
+            sys.exit(1)
     try:
         return rpc.Client(addr)
     except OSError:
@@ -91,13 +92,41 @@ def cmd_start(args):
 def _start_worker_node(args):
     """Join an existing cluster as a worker node: run the per-node
     manager daemon (reference `ray start --address=<head>` starting a
-    raylet, scripts.py:571)."""
+    raylet, scripts.py:571).  --detach forks the daemon into its own
+    session and returns once the node registers — the form the
+    autoscaler's SSH updater runs (updater.py)."""
     from ray_tpu.core.node_manager import NodeManager
 
     address = args.address
     if address == "auto":
         with open(_ADDRESS_FILE) as f:
             address = f.read().strip()
+    if getattr(args, "detach", False):
+        import subprocess
+
+        argv = [sys.executable, "-m", "ray_tpu.scripts.cli", "start",
+                "--address", address]
+        if args.node_id:
+            argv += ["--node-id", args.node_id]
+        if args.num_cpus is not None:
+            argv += ["--num-cpus", f"{args.num_cpus:g}"]
+        if args.num_tpus is not None:
+            argv += ["--num-tpus", f"{args.num_tpus:g}"]
+        for kv in (args.label or []):
+            argv += ["--label", kv]
+        log = open(f"/tmp/ray_tpu/node-{args.node_id or 'worker'}.log",
+                   "ab") if os.path.isdir("/tmp/ray_tpu") else \
+            subprocess.DEVNULL
+        proc = subprocess.Popen(argv, start_new_session=True,
+                                stdout=log, stderr=subprocess.STDOUT)
+        # Confirm the daemon survives its startup window.
+        time.sleep(1.0)
+        if proc.poll() is not None:
+            print(f"node daemon exited rc={proc.returncode}",
+                  file=sys.stderr)
+            return 1
+        print(f"node daemon started (pid {proc.pid})")
+        return 0
     labels = dict(kv.split("=", 1) for kv in (args.label or []))
     nm = NodeManager(address, num_cpus=args.num_cpus,
                      num_tpus=args.num_tpus, node_id=args.node_id,
@@ -109,7 +138,14 @@ def _start_worker_node(args):
 
 
 def cmd_stop(args):
-    client = _client()
+    client = _client(getattr(args, "address", "") or None)
+    if getattr(args, "node", ""):
+        # Targeted removal of one worker node (autoscaler teardown path).
+        ok = client.call({"op": "remove_node", "node_id": args.node},
+                         timeout=10)
+        print(f"node {args.node} removed" if ok else
+              f"node {args.node} not found")
+        return 0
     try:
         client.call({"op": "shutdown_cluster"}, timeout=5)
     except Exception:
@@ -120,6 +156,31 @@ def cmd_stop(args):
         except FileNotFoundError:
             pass
     print("cluster stopped")
+    return 0
+
+
+def cmd_up(args):
+    """Provision head + workers from a YAML cluster config (reference
+    `ray up`, autoscaler/_private/commands.py)."""
+    from ray_tpu.autoscaler import sdk
+
+    config = sdk.load_config(args.config)
+    report = sdk.create_or_update_cluster(config)
+    print(f"head: {report['head']}")
+    for w in report["workers"]:
+        print(f"worker {w['node_id']}: {w['status']}")
+    for w in report["failed"]:
+        print(f"worker {w['node_id']} FAILED: {w['status']} "
+              f"{w['error']}", file=sys.stderr)
+    return 1 if report["failed"] else 0
+
+
+def cmd_down(args):
+    from ray_tpu.autoscaler import sdk
+
+    config = sdk.load_config(args.config)
+    sdk.teardown_cluster(config)
+    print("cluster torn down")
     return 0
 
 
@@ -306,10 +367,27 @@ def build_parser() -> argparse.ArgumentParser:
                     default=True)
     sp.add_argument("--dashboard-port", type=int, default=0)
     sp.add_argument("--block", action="store_true")
+    sp.add_argument("--detach", action="store_true",
+                    help="worker join only: fork the node daemon and "
+                         "return (the autoscaler updater's form)")
     sp.set_defaults(fn=cmd_start)
 
     sp = sub.add_parser("stop", help="stop the running cluster")
+    sp.add_argument("--node", default="",
+                    help="remove just this worker node instead of "
+                         "stopping the cluster")
+    sp.add_argument("--address", default="",
+                    help="head address (default: local address file)")
     sp.set_defaults(fn=cmd_stop)
+
+    sp = sub.add_parser("up", help="provision a cluster from a YAML "
+                                   "config (autoscaler sdk)")
+    sp.add_argument("config")
+    sp.set_defaults(fn=cmd_up)
+
+    sp = sub.add_parser("down", help="tear down a provisioned cluster")
+    sp.add_argument("config")
+    sp.set_defaults(fn=cmd_down)
 
     sp = sub.add_parser("status", help="cluster resources + load")
     sp.set_defaults(fn=cmd_status)
