@@ -190,6 +190,8 @@ class JsonParser {
   size_t pos_ = 0;
 };
 
+inline std::string JsonDump(const Json& v);
+
 inline std::string JsonEscape(const std::string& s) {
   std::string out;
   for (char c : s) {
@@ -203,6 +205,41 @@ inline std::string JsonEscape(const std::string& s) {
     }
   }
   return out;
+}
+
+inline std::string JsonDump(const Json& v) {
+  switch (v.type) {
+    case Json::kNull: return "null";
+    case Json::kBool: return v.boolean ? "true" : "false";
+    case Json::kNum: {
+      double d = v.num;
+      if (d == (long long)d)  // integral: no exponent/decimals
+        return std::to_string((long long)d);
+      char buf[32];
+      std::snprintf(buf, sizeof(buf), "%.17g", d);
+      return buf;
+    }
+    case Json::kStr: return "\"" + JsonEscape(v.str) + "\"";
+    case Json::kArr: {
+      std::string out = "[";
+      for (size_t i = 0; i < v.arr.size(); i++) {
+        if (i) out += ",";
+        out += JsonDump(v.arr[i]);
+      }
+      return out + "]";
+    }
+    case Json::kObj: {
+      std::string out = "{";
+      bool first = true;
+      for (auto& kv : v.obj) {
+        if (!first) out += ",";
+        first = false;
+        out += "\"" + JsonEscape(kv.first) + "\":" + JsonDump(kv.second);
+      }
+      return out + "}";
+    }
+  }
+  return "null";
 }
 
 }  // namespace detail
@@ -267,12 +304,36 @@ class Client {
       uint8_t kind;
       uint64_t rid;
       std::string payload = RecvFrame(&kind, &rid);
-      if (kind != 1 /*KIND_RESPONSE*/) continue;  // pushes are pickled; skip
+      if (kind == 4 /*KIND_ONEWAY_JSON*/) {
+        // A task push raced the reply: buffer for RecvPushJson — a
+        // C++ worker must not lose calls delivered mid-Call.
+        pending_pushes_.push_back(std::move(payload));
+        continue;
+      }
+      if (kind != 1 /*KIND_RESPONSE*/) continue;  // pickled pushes: skip
       if (rid != req_id_) continue;
       Json msg = detail::JsonParser(payload).Parse();
       if (msg.at("status").str == "err")
         throw std::runtime_error("server error: " + msg.at("error").str);
       return msg.at("result");
+    }
+  }
+
+  // Block until a JSON push (KIND_ONEWAY_JSON) arrives — the C++
+  // worker's task-delivery channel (worker.h ServeWorker loop).
+  Json RecvPushJson() {
+    if (!pending_pushes_.empty()) {
+      std::string payload = std::move(pending_pushes_.front());
+      pending_pushes_.erase(pending_pushes_.begin());
+      return detail::JsonParser(payload).Parse();
+    }
+    while (true) {
+      uint8_t kind;
+      uint64_t rid;
+      std::string payload = RecvFrame(&kind, &rid);
+      if (kind == 4 /*KIND_ONEWAY_JSON*/)
+        return detail::JsonParser(payload).Parse();
+      // pickled pushes / stray frames: ignore
     }
   }
 
@@ -382,6 +443,7 @@ class Client {
   uint64_t req_id_ = 0;
   std::string worker_hex_;
   std::string session_id_;
+  std::vector<std::string> pending_pushes_;
 };
 
 }  // namespace tpu

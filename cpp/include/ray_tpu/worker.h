@@ -1,0 +1,253 @@
+// C++-DEFINED tasks and actors for ray_tpu.
+//
+// Counterpart of the reference's C++ worker API (cpp/include/ray/api/*.h:
+// RAY_REMOTE-registered functions and actor classes executed by C++
+// worker processes).  Redesign for this runtime: a C++ worker process
+// registers its function/actor-class names with the control server
+// (op register_cpp_functions) and then serves calls pushed to it as
+// KIND_ONEWAY_JSON frames ({"op": "execute_cpp_task", ...}); results
+// return via the cpp_task_done op.  Any frontend (Python via
+// ray_tpu.cross_lang, C++ via Client::SubmitTask, the CLI door) can
+// invoke them; results land in the cluster object directory.
+//
+// Usage:
+//   static double Add(double a, double b) { return a + b; }
+//   RAY_TPU_REMOTE(Add);
+//
+//   class Counter {
+//    public:
+//     explicit Counter(double start) : v_(start) {}
+//     double Inc(double by) { v_ += by; return v_; }
+//    private:
+//     double v_;
+//   };
+//   RAY_TPU_ACTOR(Counter, Counter(double),
+//                 RAY_TPU_METHOD(Counter, Inc));
+//
+//   int main() {
+//     ray::tpu::Client c(address);
+//     ray::tpu::ServeWorker(c);   // blocks, executing pushed calls
+//   }
+#pragma once
+
+#include <functional>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "client.h"
+
+namespace ray {
+namespace tpu {
+
+using JsonFn = std::function<Json(const std::vector<Json>&)>;
+
+// ---------------------------------------------------------------------------
+// Json <-> C++ argument conversion for common types.
+// ---------------------------------------------------------------------------
+namespace detail {
+
+inline void FromJson(const Json& j, double* out) { *out = j.num; }
+inline void FromJson(const Json& j, int* out) { *out = (int)j.num; }
+inline void FromJson(const Json& j, long* out) { *out = (long)j.num; }
+inline void FromJson(const Json& j, bool* out) { *out = j.boolean; }
+inline void FromJson(const Json& j, std::string* out) { *out = j.str; }
+inline void FromJson(const Json& j, Json* out) { *out = j; }
+
+inline Json ToJson(double v) {
+  Json j; j.type = Json::kNum; j.num = v; return j;
+}
+inline Json ToJson(int v) { return ToJson((double)v); }
+inline Json ToJson(long v) { return ToJson((double)v); }
+inline Json ToJson(bool v) {
+  Json j; j.type = Json::kBool; j.boolean = v; return j;
+}
+inline Json ToJson(const std::string& v) {
+  Json j; j.type = Json::kStr; j.str = v; return j;
+}
+inline Json ToJson(const char* v) { return ToJson(std::string(v)); }
+inline Json ToJson(const Json& v) { return v; }
+
+template <typename T>
+T ArgAt(const std::vector<Json>& args, size_t i) {
+  if (i >= args.size())
+    throw std::runtime_error("missing argument " + std::to_string(i));
+  T out{};
+  FromJson(args[i], &out);
+  return out;
+}
+
+// Wrap a free function of any registered-convertible signature.
+template <typename R, typename... Args, size_t... I>
+JsonFn WrapImpl(R (*fn)(Args...), std::index_sequence<I...>) {
+  return [fn](const std::vector<Json>& args) -> Json {
+    return ToJson(fn(ArgAt<std::decay_t<Args>>(args, I)...));
+  };
+}
+
+template <typename R, typename... Args>
+JsonFn Wrap(R (*fn)(Args...)) {
+  return WrapImpl(fn, std::index_sequence_for<Args...>{});
+}
+
+}  // namespace detail
+
+// ---------------------------------------------------------------------------
+// Process-local registries (filled by the RAY_TPU_* macros).
+// ---------------------------------------------------------------------------
+struct ActorClassEntry {
+  // args -> opaque instance
+  std::function<std::shared_ptr<void>(const std::vector<Json>&)> make;
+  // method name -> (instance, args) -> result
+  std::map<std::string,
+           std::function<Json(void*, const std::vector<Json>&)>> methods;
+};
+
+inline std::map<std::string, JsonFn>& FunctionRegistry() {
+  static std::map<std::string, JsonFn> r;
+  return r;
+}
+inline std::map<std::string, ActorClassEntry>& ActorRegistry() {
+  static std::map<std::string, ActorClassEntry> r;
+  return r;
+}
+
+struct Registrar {
+  Registrar(const std::string& name, JsonFn fn) {
+    FunctionRegistry()[name] = std::move(fn);
+  }
+};
+
+#define RAY_TPU_REMOTE(fn) \
+  static ::ray::tpu::Registrar _ray_tpu_reg_##fn{#fn, \
+      ::ray::tpu::detail::Wrap(&fn)}
+
+// Actor method binder: (instance*, args) -> Json
+#define RAY_TPU_METHOD(Cls, Method)                                        \
+  {#Method, [](void* self, const std::vector<::ray::tpu::Json>& args)      \
+                -> ::ray::tpu::Json {                                      \
+     return ::ray::tpu::detail::ToJson(                                    \
+         ::ray::tpu::detail::InvokeMethod(                                 \
+             static_cast<Cls*>(self), &Cls::Method, args));                \
+   }}
+
+namespace detail {
+template <typename C, typename R, typename... Args, size_t... I>
+R InvokeMethodImpl(C* self, R (C::*m)(Args...),
+                   const std::vector<Json>& args,
+                   std::index_sequence<I...>) {
+  return (self->*m)(ArgAt<std::decay_t<Args>>(args, I)...);
+}
+template <typename C, typename R, typename... Args>
+R InvokeMethod(C* self, R (C::*m)(Args...), const std::vector<Json>& args) {
+  return InvokeMethodImpl(self, m, args, std::index_sequence_for<Args...>{});
+}
+
+template <typename C, typename... CtorArgs, size_t... I>
+std::shared_ptr<void> MakeImpl(const std::vector<Json>& args,
+                               std::index_sequence<I...>) {
+  return std::static_pointer_cast<void>(
+      std::make_shared<C>(ArgAt<std::decay_t<CtorArgs>>(args, I)...));
+}
+}  // namespace detail
+
+// RAY_TPU_ACTOR(Counter, Counter(double), RAY_TPU_METHOD(Counter, Inc), ...)
+#define RAY_TPU_ACTOR(Cls, Ctor, ...)                                      \
+  static bool _ray_tpu_actor_##Cls = ([] {                                 \
+    ::ray::tpu::ActorClassEntry e;                                         \
+    e.make = ::ray::tpu::detail::CtorWrap<Cls, Ctor>::Make();              \
+    e.methods = {__VA_ARGS__};                                             \
+    ::ray::tpu::ActorRegistry()[#Cls] = std::move(e);                      \
+    return true;                                                           \
+  })()
+
+namespace detail {
+// Deduce constructor arg types from a function-type tag (e.g.
+// `Counter(double)` names the type "function taking double").
+template <typename C, typename Sig>
+struct CtorWrap;
+template <typename C, typename R, typename... Args>
+struct CtorWrap<C, R(Args...)> {
+  static std::function<std::shared_ptr<void>(const std::vector<Json>&)>
+  Make() {
+    return [](const std::vector<Json>& args) {
+      return MakeImpl<C, Args...>(args,
+                                  std::index_sequence_for<Args...>{});
+    };
+  }
+};
+}  // namespace detail
+
+// ---------------------------------------------------------------------------
+// The worker loop: register names, then execute pushed calls.
+// ---------------------------------------------------------------------------
+inline void ServeWorker(Client& client) {
+  std::string fns = "[";
+  for (auto& kv : FunctionRegistry()) {
+    if (fns.size() > 1) fns += ",";
+    fns += "\"" + detail::JsonEscape(kv.first) + "\"";
+  }
+  fns += "]";
+  std::string classes = "[";
+  for (auto& kv : ActorRegistry()) {
+    if (classes.size() > 1) classes += ",";
+    classes += "\"" + detail::JsonEscape(kv.first) + "\"";
+  }
+  classes += "]";
+  client.Call("{\"op\":\"register_cpp_functions\",\"functions\":" + fns +
+              ",\"actor_classes\":" + classes + "}");
+
+  std::map<std::string, std::shared_ptr<void>> instances;
+  std::map<std::string, const ActorClassEntry*> instance_cls;
+  while (true) {
+    Json msg = client.RecvPushJson();  // blocks
+    if (msg.at("op").str != "execute_cpp_task") continue;
+    const std::string ret = msg.at("return").str;
+    std::string error;
+    Json result;
+    try {
+      const std::vector<Json>& args = msg.at("args").arr;
+      if (!msg.at("fn").is_null()) {
+        auto it = FunctionRegistry().find(msg.at("fn").str);
+        if (it == FunctionRegistry().end())
+          throw std::runtime_error("unknown function " + msg.at("fn").str);
+        result = it->second(args);
+      } else if (!msg.at("create_actor").is_null()) {
+        const std::string& cls = msg.at("create_actor").str;
+        auto it = ActorRegistry().find(cls);
+        if (it == ActorRegistry().end())
+          throw std::runtime_error("unknown actor class " + cls);
+        const std::string& inst = msg.at("instance").str;
+        instances[inst] = it->second.make(args);
+        instance_cls[inst] = &it->second;
+        result = detail::ToJson(inst);
+      } else if (!msg.at("method").is_null()) {
+        const std::string& inst = msg.at("instance").str;
+        auto ii = instances.find(inst);
+        if (ii == instances.end())
+          throw std::runtime_error("unknown instance " + inst);
+        const ActorClassEntry* e = instance_cls[inst];
+        auto mi = e->methods.find(msg.at("method").str);
+        if (mi == e->methods.end())
+          throw std::runtime_error("unknown method " + msg.at("method").str);
+        result = mi->second(ii->second.get(), args);
+      } else {
+        throw std::runtime_error("malformed execute_cpp_task frame");
+      }
+    } catch (const std::exception& e) {
+      error = e.what();
+    }
+    std::string done = "{\"op\":\"cpp_task_done\",\"return\":\"" + ret + "\"";
+    if (!error.empty()) {
+      done += ",\"error\":\"" + detail::JsonEscape(error) + "\"";
+    } else {
+      done += ",\"result\":" + detail::JsonDump(result);
+    }
+    done += "}";
+    client.Call(done);
+  }
+}
+
+}  // namespace tpu
+}  // namespace ray

@@ -1,0 +1,42 @@
+// Example C++ worker: DEFINES remote functions and an actor class in
+// C++ and serves them to the cluster (see include/ray_tpu/worker.h).
+// Built and driven by tests/test_cpp_worker.py.
+#include <cstdio>
+#include <string>
+
+#include "ray_tpu/client.h"
+#include "ray_tpu/worker.h"
+
+static double Add(double a, double b) { return a + b; }
+RAY_TPU_REMOTE(Add);
+
+static std::string Greet(std::string name) { return "hello " + name; }
+RAY_TPU_REMOTE(Greet);
+
+static double Fail(double) { throw std::runtime_error("boom from c++"); }
+RAY_TPU_REMOTE(Fail);
+
+class Counter {
+ public:
+  explicit Counter(double start) : v_(start) {}
+  double Inc(double by) { v_ += by; return v_; }
+  double Value() { return v_; }
+
+ private:
+  double v_;
+};
+RAY_TPU_ACTOR(Counter, Counter(double),
+              RAY_TPU_METHOD(Counter, Inc),
+              RAY_TPU_METHOD(Counter, Value));
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <head host:port>\n", argv[0]);
+    return 2;
+  }
+  ray::tpu::Client client(argv[1]);
+  std::printf("cpp worker registered; serving\n");
+  std::fflush(stdout);
+  ray::tpu::ServeWorker(client);  // blocks
+  return 0;
+}

@@ -35,8 +35,10 @@ from ray_tpu.core.exceptions import (
     WorkerCrashedError,
 )
 from ray_tpu.core.object_ref import ObjectRef
+from ray_tpu import cross_lang
 
 __all__ = [
+    "cross_lang",
     "__version__",
     "init",
     "shutdown",

@@ -269,6 +269,13 @@ class ControlServer:
         # hiccup) don't brick the env for the cluster's lifetime.
         self.broken_envs: Dict[str, tuple] = {}
         self.broken_env_ttl_s = 60.0
+        # C++-defined tasks/actors (reference: cpp/include/ray/api —
+        # remote functions DEFINED in C++, executed by a C++ worker
+        # that registers its function/class names here).
+        self.cpp_functions: Dict[str, rpc.Connection] = {}
+        self.cpp_actor_classes: Dict[str, rpc.Connection] = {}
+        self.cpp_instances: Dict[str, rpc.Connection] = {}
+        self.cpp_inflight: Dict[int, tuple] = {}  # id(conn) -> (conn, objs)
 
         head = NodeState(node_id="head", total=resources,
                          available=resources, is_head=True)
@@ -460,6 +467,8 @@ class ControlServer:
         # OLD socket must not kill an entity that has already re-bound a
         # NEW one (reference: GCS ignores failure reports from
         # superseded raylet connections).
+        if conn.meta.get("cpp_worker"):
+            self._cleanup_cpp_worker(conn)
         node_id = conn.meta.get("node_id")
         if node_id is not None:
             with self.lock:
@@ -1339,11 +1348,116 @@ class ControlServer:
                 self._enqueue_task_locked(spec, now)
         self._wake.set()
 
+    # -- C++-defined tasks/actors ---------------------------------------
+    # Reference: cpp/include/ray/api/*.h lets users DEFINE remote
+    # functions and actors in C++; a C++ worker process registers its
+    # function/class names and executes pushed calls
+    # (cpp/include/ray_tpu/worker.h speaks this protocol).
+    def _op_register_cpp_functions(self, conn, msg):
+        with self.lock:
+            conn.meta["cpp_worker"] = True
+            for name in msg.get("functions", ()):
+                self.cpp_functions[name] = conn
+            for name in msg.get("actor_classes", ()):
+                self.cpp_actor_classes[name] = conn
+        return {"registered": True}
+
+    def _submit_cpp_call(self, target: rpc.Connection, what: dict,
+                         args) -> str:
+        """Create the return object and push the call to the C++ worker
+        (JSON one-way frame); returns the return object hex."""
+        return_id = ObjectID.from_random().hex()
+        with self.lock:
+            self.objects.setdefault(return_id, ObjectEntry())
+            self.cpp_inflight.setdefault(
+                id(target), (target, set()))[1].add(return_id)
+        try:
+            target.push_json({"op": "execute_cpp_task", **what,
+                              "args": list(args or ()),
+                              "return": return_id})
+        except Exception as e:  # worker gone mid-call
+            self._fail_cpp_return(return_id, f"cpp worker unreachable: {e}")
+        return return_id
+
+    def _fail_cpp_return(self, obj_hex: str, reason: str):
+        from ray_tpu.core.serialization import serialize
+
+        data = serialize(RuntimeError(reason)).to_bytes()
+        with self.lock:
+            entry = self.objects.get(obj_hex)
+            if entry is None or entry.state == PENDING:
+                self._store_object_locked(
+                    obj_hex, inline=data, size=len(data), is_error=True)
+
+    def _cleanup_cpp_worker(self, conn):
+        """The C++ worker's connection dropped: unregister its names,
+        fail its in-flight calls, drop its actor instances."""
+        with self.lock:
+            self.cpp_functions = {
+                k: v for k, v in self.cpp_functions.items() if v is not conn}
+            self.cpp_actor_classes = {
+                k: v for k, v in self.cpp_actor_classes.items()
+                if v is not conn}
+            self.cpp_instances = {
+                k: v for k, v in self.cpp_instances.items() if v is not conn}
+            _, objs = self.cpp_inflight.pop(id(conn), (None, set()))
+        for obj_hex in objs:
+            self._fail_cpp_return(obj_hex, "cpp worker died")
+
+    def _op_cpp_task_done(self, conn, msg):
+        from ray_tpu.core.serialization import serialize
+
+        obj_hex = msg["return"]
+        err = msg.get("error")
+        value = (RuntimeError(f"cpp task failed: {err}") if err
+                 else msg.get("result"))
+        data = serialize(value).to_bytes()
+        with self.lock:
+            ent = self.cpp_inflight.get(id(conn))
+            if ent is not None:
+                ent[1].discard(obj_hex)
+            self._store_object_locked(
+                obj_hex, inline=data, size=len(data),
+                is_error=bool(err))
+        return True
+
+    def _op_list_cpp_functions(self, conn, msg):
+        with self.lock:
+            return sorted(self.cpp_functions)
+
+    def _op_create_cpp_actor(self, conn, msg):
+        cls = msg["actor_class"]
+        with self.lock:
+            target = self.cpp_actor_classes.get(cls)
+        if target is None:
+            raise ValueError(f"no C++ actor class registered as {cls!r}")
+        import uuid as _uuid
+
+        instance = _uuid.uuid4().hex[:16]
+        with self.lock:
+            self.cpp_instances[instance] = target
+        ready = self._submit_cpp_call(
+            target, {"create_actor": cls, "instance": instance},
+            msg.get("args"))
+        return {"instance": instance, "ready_obj": ready}
+
+    def _op_submit_cpp_actor_task(self, conn, msg):
+        instance = msg["instance"]
+        with self.lock:
+            target = self.cpp_instances.get(instance)
+        if target is None:
+            raise ValueError(f"unknown C++ actor instance {instance!r}")
+        return self._submit_cpp_call(
+            target, {"method": msg["method"], "instance": instance},
+            msg.get("args"))
+
     def _op_submit_named_task(self, conn, msg):
         """Cross-language task submission (cpp/ frontend; counterpart of
         the reference's cross-language FunctionDescriptor calls): invoke
         a Python function registered under a name
-        (ray_tpu.register_named_function) with JSON-decoded args.
+        (ray_tpu.register_named_function) with JSON-decoded args —
+        or a C++-defined function if a C++ worker registered the name
+        (_op_register_cpp_functions).
         Returns the return object's hex for polling via get_object_json."""
         from ray_tpu.core.ids import ObjectID as OID
         from ray_tpu.core.ids import TaskID
@@ -1351,6 +1465,11 @@ class ControlServer:
         from ray_tpu.core.task_spec import TaskArg
 
         name = msg["name"]
+        with self.lock:
+            cpp_target = self.cpp_functions.get(name)
+        if cpp_target is not None:
+            return self._submit_cpp_call(
+                cpp_target, {"fn": name}, msg.get("args"))
         with self.lock:
             func_id = self.kv.get(f"__named_fn__/{name}")
         if func_id is None:

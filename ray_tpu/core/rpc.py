@@ -36,6 +36,9 @@ KIND_REQUEST = 0
 KIND_RESPONSE = 1
 KIND_ONEWAY = 2
 KIND_REQUEST_JSON = 3
+# One-way server→client push encoded as JSON — for non-Python peers
+# (the C++ worker's task delivery; cpp/include/ray_tpu/worker.h).
+KIND_ONEWAY_JSON = 4
 
 
 def _to_jsonable(value: Any):
@@ -133,6 +136,12 @@ class Connection:
         payload = pickle.dumps(msg, protocol=5)
         with self.send_lock:
             _send_frame(self.sock, KIND_ONEWAY, 0, payload)
+
+    def push_json(self, msg: Any):
+        """One-way push a non-Python peer can parse (KIND_ONEWAY_JSON)."""
+        payload = json.dumps(_to_jsonable(msg)).encode()
+        with self.send_lock:
+            _send_frame(self.sock, KIND_ONEWAY_JSON, 0, payload)
 
     def respond(self, req_id: int, msg: Any):
         payload = pickle.dumps(msg, protocol=5)

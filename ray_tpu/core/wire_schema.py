@@ -60,6 +60,14 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     "get_object_json": {"obj": "str"},
     "cancel_object": {"obj": "str", "force": "bool?"},
     "cancel_task": {"task": "str", "force": "bool?"},
+    # -- C++-defined tasks/actors (cpp/include/ray_tpu/worker.h) -------
+    "register_cpp_functions": {"functions": "list?",
+                               "actor_classes": "list?"},
+    "cpp_task_done": {"return": "str", "result": "any?", "error": "str?"},
+    "create_cpp_actor": {"actor_class": "str", "args": "list?"},
+    "list_cpp_functions": {},
+    "submit_cpp_actor_task": {"instance": "str", "method": "str",
+                              "args": "list?"},
     # -- worker leases (owner-direct task path) ------------------------
     "request_lease": {"token": "int?", "resources": "dict?",
                       "runtime_env": "dict?", "count": "int?"},

@@ -1,0 +1,101 @@
+"""Calling C++-defined tasks/actors from Python.
+
+Reference counterpart: Ray's cross-language calls via typed
+FunctionDescriptors (src/ray/common/function_descriptor.h) — Python
+invoking functions/actors DEFINED in C++ (cpp/include/ray/api).  Here a
+C++ worker (cpp/include/ray_tpu/worker.h) registers its names with the
+control server; these wrappers submit calls to them and return ordinary
+ObjectRefs (results land in the cluster object directory as plain
+Python values decoded from the JSON wire form).
+
+    add = ray_tpu.cross_lang.cpp_function("Add")
+    ref = add.remote(2, 3)          # -> ObjectRef, ray_tpu.get -> 5.0
+
+    Counter = ray_tpu.cross_lang.cpp_actor_class("Counter")
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.Inc.remote(5)) == 15.0
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from ray_tpu.core import runtime as _runtime_mod
+from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.object_ref import ObjectRef
+
+
+def _client():
+    return _runtime_mod.get_runtime().kv()
+
+
+def _ref_of(obj_hex: str) -> ObjectRef:
+    return ObjectRef(ObjectID.from_hex(obj_hex))
+
+
+class CppFunction:
+    """Handle to a C++-registered remote function."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def remote(self, *args: Any) -> ObjectRef:
+        obj_hex = _client().call({
+            "op": "submit_named_task", "name": self._name,
+            "args": list(args)})
+        return _ref_of(obj_hex)
+
+
+def cpp_function(name: str) -> CppFunction:
+    return CppFunction(name)
+
+
+class CppActorMethod:
+    def __init__(self, instance: str, method: str):
+        self._instance = instance
+        self._method = method
+
+    def remote(self, *args: Any) -> ObjectRef:
+        obj_hex = _client().call({
+            "op": "submit_cpp_actor_task", "instance": self._instance,
+            "method": self._method, "args": list(args)})
+        return _ref_of(obj_hex)
+
+
+class CppActorHandle:
+    def __init__(self, instance: str, ready_ref: ObjectRef):
+        self._instance = instance
+        self._ready_ref = ready_ref
+
+    def __getattr__(self, name: str) -> CppActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return CppActorMethod(self._instance, name)
+
+
+class CppActorClass:
+    """Handle to a C++-registered actor class."""
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def remote(self, *args: Any) -> CppActorHandle:
+        reply = _client().call({
+            "op": "create_cpp_actor", "actor_class": self._name,
+            "args": list(args)})
+        return CppActorHandle(reply["instance"],
+                              _ref_of(reply["ready_obj"]))
+
+
+def cpp_actor_class(name: str) -> CppActorClass:
+    return CppActorClass(name)
+
+
+def registered_cpp_functions() -> List[str]:
+    """Names currently served by connected C++ workers (debugging)."""
+    rows = _client().call({"op": "list_cpp_functions"})
+    return rows
+
+
+__all__ = ["cpp_function", "cpp_actor_class", "CppFunction",
+           "CppActorClass", "registered_cpp_functions"]

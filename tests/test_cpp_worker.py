@@ -1,0 +1,91 @@
+"""C++-DEFINED tasks/actors end to end (reference: cpp/include/ray/api
+RAY_REMOTE functions + actor classes executed by C++ workers): build
+cpp/worker_example.cc, run it against a live head, and drive it from
+Python via ray_tpu.cross_lang."""
+
+import pathlib
+import shutil
+import subprocess
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import cross_lang
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+_BIN = "/tmp/ray_tpu_cpp_worker_example"
+
+
+@pytest.fixture(scope="module")
+def cpp_worker():
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    subprocess.run(
+        ["g++", "-std=c++17", "-O1", "-Wall", "-Iinclude",
+         "worker_example.cc", "-o", _BIN],
+        cwd=_REPO / "cpp", check=True, capture_output=True, timeout=300)
+    rt = ray_tpu.init(num_cpus=2)
+    proc = subprocess.Popen([_BIN, rt.address],
+                            stdout=subprocess.PIPE, text=True)
+    # Registration confirmation: the worker prints after register_cpp_functions
+    line = proc.stdout.readline()
+    assert "serving" in line, line
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if "Add" in cross_lang.registered_cpp_functions():
+            break
+        time.sleep(0.1)
+    yield proc
+    proc.kill()
+    ray_tpu.shutdown()
+
+
+def test_cpp_function_call(cpp_worker):
+    add = cross_lang.cpp_function("Add")
+    assert ray_tpu.get(add.remote(2, 3), timeout=30) == 5.0
+    greet = cross_lang.cpp_function("Greet")
+    assert ray_tpu.get(greet.remote("tpu"), timeout=30) == "hello tpu"
+
+
+def test_cpp_function_error_propagates(cpp_worker):
+    fail = cross_lang.cpp_function("Fail")
+    with pytest.raises(RuntimeError, match="boom from c.."):
+        ray_tpu.get(fail.remote(1), timeout=30)
+
+
+def test_cpp_function_via_named_task_door(cpp_worker):
+    """The same C++ function resolves through submit_named_task, i.e.
+    the existing C++ *client* can call C++-defined functions too."""
+    from ray_tpu.core.runtime import get_runtime
+
+    client = get_runtime().kv()
+    hex_ = client.call({"op": "submit_named_task", "name": "Add",
+                        "args": [10, 20]})
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        st = client.call({"op": "get_object_json", "obj": hex_})
+        if st["status"] != "pending":
+            break
+        time.sleep(0.05)
+    assert st["status"] == "ready" and st["value"] == 30.0
+
+
+def test_cpp_actor_lifecycle(cpp_worker):
+    Counter = cross_lang.cpp_actor_class("Counter")
+    c = Counter.remote(10)
+    assert ray_tpu.get(c._ready_ref, timeout=30)  # created
+    assert ray_tpu.get(c.Inc.remote(5), timeout=30) == 15.0
+    assert ray_tpu.get(c.Inc.remote(1), timeout=30) == 16.0
+    assert ray_tpu.get(c.Value.remote(), timeout=30) == 16.0
+    # second instance is independent state
+    c2 = Counter.remote(0)
+    assert ray_tpu.get(c2.Inc.remote(2), timeout=30) == 2.0
+    assert ray_tpu.get(c.Value.remote(), timeout=30) == 16.0
+
+
+def test_cpp_unknown_names_error_cleanly(cpp_worker):
+    with pytest.raises(Exception, match="no function registered"):
+        cross_lang.cpp_function("NoSuchFn").remote(1)
+    with pytest.raises(Exception, match="no C\\+\\+ actor class"):
+        cross_lang.cpp_actor_class("NoSuchCls").remote()
