@@ -2218,7 +2218,8 @@ class ControlServer:
             return [
                 {"node_id": n.node_id, "alive": n.alive,
                  "is_head": n.is_head, "resources": n.total.to_dict(),
-                 "available": n.available.to_dict(), "labels": n.labels}
+                 "available": n.available.to_dict(), "labels": n.labels,
+                 "address": n.address}
                 for n in self.nodes.values()
             ]
 
@@ -2478,6 +2479,45 @@ class ControlServer:
                 import traceback
 
                 traceback.print_exc()
+            try:
+                self._sync_resource_view()
+            except Exception:
+                pass
+
+    # -- resource-view sync (N8; reference common/ray_syncer/ -----------
+    # ray_syncer.h:88 RESOURCE_VIEW stream).  The head is the view's
+    # source of truth (it charges/releases all resources), so the sync
+    # is a debounced head -> node-manager broadcast of per-node
+    # availability; node managers serve it locally (cluster_view /
+    # available_resources ops) so colocated workers' resource queries
+    # and future local decisions need not transit the head.
+    def _sync_resource_view(self):
+        now = time.monotonic()
+        if now - getattr(self, "_view_last_sync", 0.0) < 0.2:
+            return
+        with self.lock:
+            view = {
+                nid: {"total": n.total.to_dict(),
+                      "available": n.available.to_dict(),
+                      "alive": n.alive, "is_head": n.is_head,
+                      "labels": dict(n.labels)}
+                for nid, n in self.nodes.items()
+            }
+            targets = [n.conn for n in self.nodes.values()
+                       if n.conn is not None and n.alive]
+        if view == getattr(self, "_view_last", None) or not targets:
+            self._view_last = view
+            self._view_last_sync = now
+            return
+        self._view_last = view
+        self._view_last_sync = now
+        seq = self._view_seq = getattr(self, "_view_seq", 0) + 1
+        msg = {"op": "resource_view", "seq": seq, "nodes": view}
+        for conn in targets:
+            try:
+                conn.push(msg)
+            except Exception:
+                pass  # node death handled by its disconnect
 
     def _deps_ready(self, spec: TaskSpec) -> bool:
         for arg in spec.args:

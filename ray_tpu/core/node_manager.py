@@ -98,6 +98,11 @@ class NodeManager:
         self._ready = threading.Event()
         self._lock = threading.Lock()
         self._procs: Dict[str, subprocess.Popen] = {}
+        # Synced cluster resource view (head broadcast; gcs.py
+        # _sync_resource_view).
+        self._view: Dict[str, dict] = {}
+        self._view_seq = -1
+        self._view_at = 0.0
         self.server = rpc.Server(self._handle,
                                  host=self.config.node_ip_address)
         # Advertised (not bind) address: a 0.0.0.0 bind must not hand
@@ -142,7 +147,10 @@ class NodeManager:
                     namespace=msg.get("namespace", self.namespace),
                     node_id=self.node_id,
                     log_dir=os.path.join(self.session_dir, "logs"),
-                    session_id=self.session_id)
+                    session_id=self.session_id,
+                    # Local workers answer resource queries from this
+                    # manager's synced view instead of dialing the head.
+                    extra_env={"RAY_TPU_LOCAL_NM": self.address})
                 with self._lock:
                     self._procs[msg["worker_hex"]] = proc
             except Exception as e:  # noqa: BLE001
@@ -160,6 +168,15 @@ class NodeManager:
                     proc.kill()
                 except OSError:
                     pass
+        elif op == "resource_view":
+            # Synced cluster resource view (N8, reference ray_syncer
+            # RESOURCE_VIEW): newest seq wins; served locally to this
+            # node's workers (_handle cluster_view below).
+            with self._lock:
+                if msg["seq"] > self._view_seq:
+                    self._view_seq = msg["seq"]
+                    self._view = msg["nodes"]
+                    self._view_at = time.time()
         elif op == "delete_object":
             # Cluster-wide refcount hit 0 (head decref/free): release the
             # local arena copy.
@@ -184,6 +201,29 @@ class NodeManager:
             return bytes(seg.buf[off:off + n])
         if op == "has_object":
             return self.store.contains(ObjectID.from_hex(msg["obj"]))
+        if op == "cluster_view":
+            with self._lock:
+                return {"seq": self._view_seq, "at": self._view_at,
+                        "nodes": self._view}
+        if op == "available_resources":
+            # Node-local answer from the synced view (no head hop).
+            with self._lock:
+                nodes = self._view
+            out: Dict[str, float] = {}
+            for n in nodes.values():
+                if n.get("alive"):
+                    for k, v in n["available"].items():
+                        out[k] = out.get(k, 0.0) + v
+            return out
+        if op == "cluster_resources":
+            with self._lock:
+                nodes = self._view
+            out = {}
+            for n in nodes.values():
+                if n.get("alive"):
+                    for k, v in n["total"].items():
+                        out[k] = out.get(k, 0.0) + v
+            return out
         if op == "worker_alive":
             with self._lock:
                 proc = self._procs.get(msg["worker_hex"])

@@ -145,10 +145,43 @@ class WorkerRuntime:
     def on_ref_deleted(self, object_id: ObjectID):
         self.core.on_ref_deleted(object_id)
 
+    def _local_nm(self):
+        """Connection to this node's manager, if any (N8 resource-view
+        sync: resource queries answer from the manager's synced view
+        without a head round trip)."""
+        addr = os.environ.get("RAY_TPU_LOCAL_NM", "")
+        if not addr:
+            return None
+        conn = getattr(self, "_nm_conn", None)
+        if conn is not None and not conn._closed:
+            return conn
+        try:
+            conn = rpc.Client(addr, connect_timeout=2.0)
+        except Exception:
+            return None
+        self._nm_conn = conn
+        return conn
+
     def cluster_resources(self):
+        nm = self._local_nm()
+        if nm is not None:
+            try:
+                out = nm.call({"op": "cluster_resources"}, timeout=5.0)
+                if out:
+                    return out
+            except Exception:
+                pass
         return self.core.client.call({"op": "cluster_resources"})
 
     def available_resources(self):
+        nm = self._local_nm()
+        if nm is not None:
+            try:
+                out = nm.call({"op": "available_resources"}, timeout=5.0)
+                if out:
+                    return out
+            except Exception:
+                pass
         return self.core.client.call({"op": "available_resources"})
 
     def state_list(self, kind: str):

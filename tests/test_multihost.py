@@ -220,3 +220,35 @@ def test_task_spread_across_real_nodes(two_host_cluster):
 
     spots = set(ray_tpu.get([where.remote() for _ in range(5)], timeout=60))
     assert {"hostA", "hostB"} <= spots
+
+
+def test_resource_view_sync(two_host_cluster):
+    """N8 resource-view syncer (reference common/ray_syncer
+    ray_syncer.h:88): node managers receive the head's debounced view
+    broadcast and serve cluster_view / available_resources locally."""
+    from ray_tpu.core import rpc
+
+    rt = two_host_cluster
+    nodes = {n["node_id"]: n for n in rt.state_list("nodes")}
+    total_cpu = rt.cluster_resources()["CPU"]
+    for host in ("hostA", "hostB"):
+        addr = nodes[host]["address"]
+        client = rpc.Client(addr, connect_timeout=5.0)
+        try:
+            deadline = time.time() + 15
+            view = {}
+            while time.time() < deadline:
+                view = client.call({"op": "cluster_view"}, timeout=5.0)
+                if len(view["nodes"]) >= 3:
+                    break
+                time.sleep(0.2)
+            assert len(view["nodes"]) >= 3, view
+            assert view["seq"] >= 0
+            local_total = client.call({"op": "cluster_resources"},
+                                      timeout=5.0)
+            assert local_total["CPU"] == total_cpu
+            avail = client.call({"op": "available_resources"},
+                                timeout=5.0)
+            assert 0 <= avail["CPU"] <= total_cpu
+        finally:
+            client.close()
