@@ -566,7 +566,12 @@ class ControlServer:
             rec = self.tasks.get(w.current_task)
             if rec is not None and rec.state == "RUNNING":
                 spec = rec.spec
-                if spec.retry_count < spec.max_retries:
+                if spec.direct:
+                    # Lease-path task: the record is a skeletal event
+                    # mirror — retry/failure is the OWNER's job
+                    # (lease_revoked push above); never requeue it here.
+                    rec.state = "FAILED"
+                elif spec.retry_count < spec.max_retries:
                     spec.retry_count += 1
                     rec.state = "PENDING"
                     rec.worker_hex = ""
@@ -680,6 +685,9 @@ class ControlServer:
                 store_key=msg.get("store_key", ""),
                 shm_dir=msg.get("shm_dir", ""))
             conn.meta["node_id"] = node_id
+        # Force a view broadcast so the (re)joining manager gets the
+        # current resource view even when nothing else changed.
+        self._view_last = None
         self._wake.set()
         return {"node_id": node_id, "session_id": self.session_id,
                 "namespace": self.namespace}
@@ -758,6 +766,23 @@ class ControlServer:
 
     def _op_put_object(self, conn, msg):
         with self.lock:
+            spec = msg.get("lineage")
+            if spec is not None:
+                # Owner-side lineage shipped with the object (lease-path
+                # tasks whose oversized result lands in shm: the head
+                # never saw the spec, but must be able to re-execute it
+                # if the copy is lost — reference: owner-held lineage,
+                # task_manager.h:208).
+                task_hex = spec.task_id.hex()
+                existing = self.tasks.get(task_hex)
+                if existing is None or not existing.spec.return_ids:
+                    # Replace the skeletal event-mirror record (if any):
+                    # only the full spec can be re-executed.
+                    self.tasks[task_hex] = TaskRecord(
+                        spec=spec, state="FINISHED",
+                        submitted_at=time.time(),
+                        finished_at=time.time())
+                self.lineage[msg["obj"]] = task_hex
             self._store_object_locked(
                 msg["obj"],
                 inline=msg.get("inline"),
@@ -1042,7 +1067,9 @@ class ControlServer:
         with self.lock:
             candidates = []
             for w in self.workers.values():
-                if w.state != "busy" or not w.current_task:
+                # "leased" workers' running tasks are known via their
+                # batched RUNNING events (_op_task_events).
+                if w.state not in ("busy", "leased") or not w.current_task:
                     continue
                 if w.proc is None:
                     # Remote-node worker: its pid belongs to another host
@@ -1711,7 +1738,20 @@ class ControlServer:
                             if n.alive and need.is_subset_of(
                                 virt(n.node_id))]
                 if not feasible:
-                    denied += count - i
+                    if int(msg.get("have", 0)) > 0:
+                        # Owner has workers to pipeline onto: deny the
+                        # excess fast (it backs off and retries).
+                        denied += count - i
+                    else:
+                        # Nothing to pipeline onto: queue the demand —
+                        # it must stay visible to the autoscaler
+                        # (get_load) and grants when capacity appears.
+                        for _ in range(count - i):
+                            self.pending_leases.append({
+                                "owner": owner_hex, "env_key": env_key,
+                                "resources": dict(resources),
+                                "token": token, "node_id": "",
+                                "created": time.time()})
                     break
                 node = max(feasible, key=lambda n: (
                     self._utilization(n, virt(n.node_id)), n.is_head))
@@ -1800,6 +1840,17 @@ class ControlServer:
         out: List[tuple] = []
         still: List[dict] = []
         now = time.time()
+        # Per-pass spawn accounting: queued demand may target nodes
+        # that joined AFTER the request (autoscaler growth) — spawn
+        # there, deduped against already-starting workers.
+        node_workers: Dict[str, int] = {}
+        starting: Dict[str, int] = {}
+        for w in self.workers.values():
+            if w.kind == "pool" and w.state != "dead":
+                node_workers[w.node_id] = node_workers.get(
+                    w.node_id, 0) + 1
+                if w.state == "starting":
+                    starting[w.env_key] = starting.get(w.env_key, 0) + 1
         for pl in self.pending_leases:
             owner = self.workers.get(pl["owner"])
             if owner is None or owner.state == "dead" or owner.conn is None:
@@ -1816,9 +1867,30 @@ class ControlServer:
                 out.append((owner.conn, pl["token"],
                             [{"worker": w.worker_hex,
                               "address": w.address}], 0, ""))
-            elif now - pl["created"] > 10.0:
-                # The spawn this entry waited for never materialized:
-                # deny so the owner's pump re-requests.
+                continue
+            if starting.get(pl["env_key"], 0) > 0:
+                starting[pl["env_key"]] -= 1  # a spawn is on the way
+                still.append(pl)
+                continue
+            feasible = [n for n in self.nodes.values()
+                        if n.alive and need.is_subset_of(n.available)
+                        and node_workers.get(n.node_id, 0)
+                        < self.config.max_workers_per_node]
+            if feasible:
+                node = max(feasible, key=lambda n: (
+                    self._utilization(n), n.is_head))
+                self._spawn_worker(env_key=pl["env_key"], kind="pool",
+                                   node_id=node.node_id)
+                node_workers[node.node_id] = node_workers.get(
+                    node.node_id, 0) + 1
+                still.append(pl)
+            elif now - pl["created"] > (10.0 if pl.get("node_id")
+                                        else 15.0):
+                # Spawn never materialized (10s), or cluster-infeasible
+                # demand went stale (15s): deny so the owner's pump
+                # re-requests — a still-wanting owner refreshes the
+                # entry within its backoff, keeping the demand visible
+                # to the autoscaler without leaking dead entries.
                 out.append((owner.conn, pl["token"], [], 1, ""))
             else:
                 still.append(pl)
@@ -1851,6 +1923,7 @@ class ControlServer:
         now = time.time()
         worker_hex = conn.meta.get("worker_hex", "")
         with self.lock:
+            w = self.workers.get(worker_hex)
             for ev in msg.get("events", ()):
                 rec = self.tasks.get(ev["task_id"])
                 if rec is None:
@@ -1858,6 +1931,7 @@ class ControlServer:
                         task_id=TaskID.from_hex(ev["task_id"]),
                         func_id="", func_blob=None, args=[],
                         num_returns=1, return_ids=[], resources={},
+                        max_retries=int(ev.get("retries_left", 0)),
                         name=ev.get("name", ""),
                         owner=ev.get("owner", ""), direct=True)
                     rec = self.tasks[ev["task_id"]] = TaskRecord(
@@ -1870,10 +1944,18 @@ class ControlServer:
                     # old worker must not clobber the retry's state or
                     # its death-detection worker binding.
                     continue
-                rec.state = ev.get("state", "FINISHED")
+                state = ev.get("state", "FINISHED")
+                rec.state = state
                 rec.worker_hex = worker_hex
                 rec.started_at = ev.get("start", 0.0)
                 rec.finished_at = ev.get("end", 0.0)
+                # Track the leased worker's current task so the OOM
+                # victim policy can pick/kill it like a busy worker.
+                if w is not None and w.state == "leased":
+                    if state == "RUNNING":
+                        w.current_task = ev["task_id"]
+                    elif w.current_task == ev["task_id"]:
+                        w.current_task = None
             self._prune_lineage_locked()
 
     # ------------------------------------------------------------------
@@ -2505,14 +2587,22 @@ class ControlServer:
             }
             targets = [n.conn for n in self.nodes.values()
                        if n.conn is not None and n.alive]
-        if view == getattr(self, "_view_last", None) or not targets:
-            self._view_last = view
-            self._view_last_sync = now
+        self._view_last_sync = now
+        if not targets:
+            # Nothing listening: do NOT record the view as sent — a
+            # manager joining later must still get the first broadcast.
+            return
+        if view == getattr(self, "_view_last", None):
             return
         self._view_last = view
-        self._view_last_sync = now
         seq = self._view_seq = getattr(self, "_view_seq", 0) + 1
-        msg = {"op": "resource_view", "seq": seq, "nodes": view}
+        # Epoch disambiguates head restarts: a restarted head's seq
+        # counter restarts, and managers must not reject it as stale.
+        epoch = getattr(self, "_view_epoch", None)
+        if epoch is None:
+            epoch = self._view_epoch = uuid.uuid4().hex[:12]
+        msg = {"op": "resource_view", "seq": seq, "epoch": epoch,
+               "nodes": view}
         for conn in targets:
             try:
                 conn.push(msg)

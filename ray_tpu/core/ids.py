@@ -37,7 +37,7 @@ def _next_unique() -> bytes:
 
 
 class BaseID:
-    __slots__ = ("_bytes", "_hex")
+    __slots__ = ("_bytes", "_hex", "_hashv")
     _prefix = "id"
 
     def __init__(self, binary: bytes):
@@ -47,6 +47,7 @@ class BaseID:
             )
         self._bytes = binary
         self._hex = None
+        self._hashv = None
 
     @classmethod
     def nil(cls):
@@ -73,7 +74,12 @@ class BaseID:
         return self._bytes == b"\x00" * _ID_NBYTES
 
     def __hash__(self):
-        return hash((self._prefix, self._bytes))
+        # Cached: ids key hot dicts/sets (wait sets, arg prep) and the
+        # tuple construction + double hash dominated profiles.
+        h = self._hashv
+        if h is None:
+            h = self._hashv = hash((self._prefix, self._bytes))
+        return h
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes

@@ -102,6 +102,7 @@ class NodeManager:
         # _sync_resource_view).
         self._view: Dict[str, dict] = {}
         self._view_seq = -1
+        self._view_epoch = ""
         self._view_at = 0.0
         self.server = rpc.Server(self._handle,
                                  host=self.config.node_ip_address)
@@ -170,9 +171,14 @@ class NodeManager:
                     pass
         elif op == "resource_view":
             # Synced cluster resource view (N8, reference ray_syncer
-            # RESOURCE_VIEW): newest seq wins; served locally to this
-            # node's workers (_handle cluster_view below).
+            # RESOURCE_VIEW): newest seq per head epoch wins — a
+            # restarted head's counter restarts, so a new epoch always
+            # supersedes the old view.
             with self._lock:
+                epoch = msg.get("epoch", "")
+                if epoch != self._view_epoch:
+                    self._view_epoch = epoch
+                    self._view_seq = -1
                 if msg["seq"] > self._view_seq:
                     self._view_seq = msg["seq"]
                     self._view = msg["nodes"]

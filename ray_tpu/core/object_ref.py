@@ -46,11 +46,14 @@ def _reconstruct_ref(hex_id: str, owner: Any):
 
 
 class ObjectRef:
-    __slots__ = ("_id", "_owner", "__weakref__")
+    __slots__ = ("_id", "_owner", "_hex", "__weakref__")
 
     def __init__(self, object_id: ObjectID, owner=None):
         self._id = object_id
         self._owner = owner
+        # Precomputed: hot paths (wait partition scans) read the
+        # attribute directly instead of two method calls per ref.
+        self._hex = object_id.hex()
 
     @property
     def id(self) -> ObjectID:
@@ -61,7 +64,7 @@ class ObjectRef:
         return self._owner
 
     def hex(self) -> str:
-        return self._id.hex()
+        return self._hex
 
     def binary(self) -> bytes:
         return self._id.binary()

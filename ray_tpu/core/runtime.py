@@ -167,6 +167,11 @@ class CoreClient:
         self._tls = threading.local()
         self._object_futures: Dict[str, Future] = {}
         self._subscribed: set[str] = set()
+        # Hexes whose future has resolved — maintained by done-callbacks
+        # so wait() is a set-membership check + condition wait instead
+        # of an O(n) future-lock scan per call.
+        self._resolved: set = set()
+        self._resolved_cond = threading.Condition()
         # Owner-direct actor results (the control plane is OFF the actor
         # hot path — reference direct_actor_task_submitter.cc): futures
         # resolved by pushes on the direct actor connection, never
@@ -217,6 +222,12 @@ class CoreClient:
         # their refs are resolvable without waiting, so tasks using them
         # as args stay lease-eligible.
         self._local_known: set = set()
+        # Small put payloads kept for arg hydration: a resolved ref arg
+        # whose bytes we hold ships INLINE in the spec instead of making
+        # the executor fetch it (reference: the DependencyResolver
+        # inlines small resolved deps, transport/dependency_resolver.cc).
+        self._inline_cache: Dict[str, bytes] = {}
+        self._inline_cache_bytes = 0
         self._flush_ev = threading.Event()
         self._flusher_started = False
         # actor state tracking
@@ -415,11 +426,20 @@ class CoreClient:
     # non-streaming) actor call is pushed straight back on the direct
     # actor connection; the head is not involved unless the ref escapes
     # this process (promotion) or the result is too large for the wire.
+    def _mark_resolved(self, obj_hex: str):
+        with self._resolved_cond:
+            self._resolved.add(obj_hex)
+            self._resolved_cond.notify_all()
+
+    def _track_resolution(self, obj_hex: str, fut: Future):
+        fut.add_done_callback(lambda f, h=obj_hex: self._mark_resolved(h))
+
     def _register_direct(self, obj_hex: str, actor_hex: str) -> Future:
         fut = Future()
         with self._lock:
             self._direct_futures[obj_hex] = fut
             self._direct_actor_of[obj_hex] = actor_hex
+        self._track_resolution(obj_hex, fut)
         return fut
 
     def _mark_direct_delivered(self, spec):
@@ -486,6 +506,7 @@ class CoreClient:
                 if head_fut is None:
                     head_fut = Future()
                     self._object_futures[obj_hex] = head_fut
+                    self._track_resolution(obj_hex, head_fut)
                 if obj_hex not in self._subscribed:
                     self._subscribed.add(obj_hex)
                     self.client.send({"op": "subscribe_objects",
@@ -599,7 +620,9 @@ class CoreClient:
     # same direct connection back; the head is only involved in the
     # lease grant/return and never sees individual tasks.
     def _lease_eligible(self, spec: TaskSpec) -> bool:
-        if not self.config.direct_task_leases or self.thin:
+        # Thin clients lease too: the direct worker connections are
+        # plain TCP (cross-host safe); only shm attachment is off.
+        if not self.config.direct_task_leases:
             return False
         if spec.is_streaming or spec.num_returns != 1:
             return False
@@ -729,7 +752,11 @@ class CoreClient:
                     self.client.send({
                         "op": "request_lease", "token": token,
                         "resources": pool.resources,
-                        "runtime_env": pool.runtime_env, "count": ask})
+                        "runtime_env": pool.runtime_env, "count": ask,
+                        # Workers we already hold: with none, the head
+                        # must queue (not deny) an unsatisfiable request
+                        # so the demand stays visible to the autoscaler.
+                        "have": len(pool.workers)})
                 except Exception:
                     pool.requested -= ask
                     self._lease_tokens.pop(token, None)
@@ -884,6 +911,7 @@ class CoreClient:
             if head_fut is None:
                 head_fut = Future()
                 self._object_futures[obj_hex] = head_fut
+                self._track_resolution(obj_hex, head_fut)
             if obj_hex not in self._subscribed:
                 self._subscribed.add(obj_hex)
                 self.client.send({"op": "subscribe_objects",
@@ -1005,6 +1033,7 @@ class CoreClient:
             self._flush_direct_sends()
         futs: List[Future] = []
         new: List[str] = []
+        created: List[tuple] = []
         with self._lock:
             for obj_hex in obj_hexes:
                 fut = self._direct_futures.get(obj_hex)
@@ -1015,12 +1044,15 @@ class CoreClient:
                 if fut is None:
                     fut = Future()
                     self._object_futures[obj_hex] = fut
+                    created.append((obj_hex, fut))
                 futs.append(fut)
                 if obj_hex not in self._subscribed:
                     self._subscribed.add(obj_hex)
                     new.append(obj_hex)
             if new:
                 self.client.send({"op": "subscribe_objects", "objs": new})
+        for obj_hex, fut in created:
+            self._track_resolution(obj_hex, fut)
         return futs
 
     def _load_object(self, obj_hex: str, info: dict,
@@ -1170,6 +1202,7 @@ class CoreClient:
         with self._lock:
             self._object_futures.pop(obj_hex, None)
             self._subscribed.discard(obj_hex)
+        self._resolved.discard(obj_hex)
         try:
             self.client.send({"op": "forget_object", "obj": obj_hex})
         except Exception:
@@ -1177,10 +1210,18 @@ class CoreClient:
 
     def _refetch_object(self, obj_hex: str) -> Future:
         """Forget the resolved location of an object and subscribe again
-        (used when a cached in-shm location went stale via spilling)."""
+        (used when a cached in-shm location went stale via spilling or
+        loss)."""
         with self._lock:
             self._object_futures.pop(obj_hex, None)
             self._subscribed.discard(obj_hex)
+            # A stale DIRECT future must go too: object_futures prefers
+            # it, so leaving it would replay the dead location forever
+            # (oversized direct results resolve to an in_shm pointer).
+            fut = self._direct_futures.get(obj_hex)
+            if fut is not None and fut.done():
+                self._direct_futures.pop(obj_hex, None)
+        self._resolved.discard(obj_hex)
         return self.object_future(obj_hex)
 
     def _on_ref_deser(self, ref: ObjectRef):
@@ -1231,7 +1272,8 @@ class CoreClient:
         ser = self._serialize_for_ship(value)
         return self._store_serialized(oid, ser, is_error=is_error)
 
-    def _store_serialized(self, oid: ObjectID, ser, is_error: bool = False):
+    def _store_serialized(self, oid: ObjectID, ser, is_error: bool = False,
+                          lineage_spec=None):
         with self._lock:
             self._local_known.add(oid.hex())
         size = ser.total_bytes
@@ -1249,18 +1291,30 @@ class CoreClient:
         else:
             inline_ok = size <= self.config.max_inline_object_size
         if inline_ok:
+            data = ser.to_bytes()
+            if not is_error and size <= 64 * 1024:
+                with self._lock:
+                    self._inline_cache[oid.hex()] = data
+                    self._inline_cache_bytes += size
+                    while self._inline_cache_bytes > 16 * 1024 * 1024:
+                        old, blob = next(iter(self._inline_cache.items()))
+                        del self._inline_cache[old]
+                        self._inline_cache_bytes -= len(blob)
             self._send_or_buffer({
                 "op": "put_object", "obj": oid.hex(), "size": size,
-                "inline": ser.to_bytes(), "is_error": is_error,
+                "inline": data, "is_error": is_error,
             })
         else:
             seg = self.store.create(oid, size)
             ser.write_into(seg.buf[:size])
             self.store.seal(oid)
-            self._send_or_buffer({
+            put = {
                 "op": "put_object", "obj": oid.hex(), "size": size,
                 "inline": None, "in_shm": True, "is_error": is_error,
-            })
+            }
+            if lineage_spec is not None:
+                put["lineage"] = lineage_spec
+            self._send_or_buffer(put)
 
     def _send_or_buffer(self, msg: dict):
         buf = getattr(self._tls, "put_buffer", None)
@@ -1279,52 +1333,77 @@ class CoreClient:
 
     def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None):
-        if num_returns == 1:
-            # Fast path for the wait-one polling idiom: one O(n) scan of
-            # already-registered futures, no dict building.
-            if self._pending_count:
-                self._flush_direct_sends()
-            with self._lock:
-                for i, r in enumerate(refs):
-                    h = r.hex()
-                    fut = self._direct_futures.get(h) or \
-                        self._object_futures.get(h)
-                    if fut is not None and fut.done():
-                        return [r], [x for j, x in enumerate(refs)
-                                     if j != i]
-        futs = dict(zip(refs, self.object_futures(
-            [r.hex() for r in refs])))
+        """Readiness via the resolved-hex set (maintained by future
+        done-callbacks): each call is set-membership over the refs plus
+        a condition wait — no per-future lock traffic, so the classic
+        pop-one-of-N polling loop is O(n) set lookups per call instead
+        of O(n) future-lock acquisitions."""
+        if self._pending_count:
+            self._flush_direct_sends()
+        resolved = self._resolved
+        hexes = [r._hex for r in refs]
+        # Refs this process doesn't track yet need futures/subscriptions
+        # (and their done-callbacks feed the resolved set).
+        with self._lock:
+            untracked = [
+                h for h in hexes
+                if h not in resolved and h not in self._direct_futures
+                and h not in self._object_futures]
+        if untracked:
+            self.object_futures(hexes)
         deadline = None if timeout is None else time.monotonic() + timeout
-        ready: List[ObjectRef] = []
-        pending = dict(futs)
-        # Fast path: harvest already-done futures without registering
-        # waiters — a wait() loop popping one ref at a time off 1k refs
-        # used to cost O(n^2) waiter registrations in cf.wait.
-        for r in list(pending):
-            if pending[r].done():
-                ready.append(r)
-                del pending[r]
-                if len(ready) >= num_returns:
-                    break
-        import concurrent.futures as cf
+        # More returns than refs can never be satisfied — clamp so the
+        # loop terminates once everything resolved (wait([]) included).
+        num_returns = min(num_returns, len(hexes))
+        if not hexes:
+            return [], []
 
-        while len(ready) < num_returns and pending:
-            remaining = None if deadline is None else max(
-                0.0, deadline - time.monotonic())
-            done, _ = cf.wait(
-                list(pending.values()), timeout=remaining,
-                return_when=cf.FIRST_COMPLETED)
-            if not done:
-                break
-            for r in list(pending):
-                if pending[r].done():
-                    ready.append(r)
-                    del pending[r]
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-        ready = ready[:num_returns]
-        ready_set = set(ready)
-        not_ready = [r for r in refs if r not in ready_set]
+        def _first_idx():
+            for i, h in enumerate(hexes):
+                if h in resolved:
+                    return i
+            return -1
+
+        if num_returns == 1:
+            # The pop-one-of-N polling idiom: early-exit scan + C-speed
+            # list slicing keep each call near O(position of first
+            # resolved) instead of O(n) Python-level list building.
+            with self._resolved_cond:
+                while True:
+                    idx = _first_idx()
+                    if idx >= 0:
+                        break
+                    remaining = None if deadline is None else \
+                        deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        break
+                    if not self._resolved_cond.wait(timeout=remaining):
+                        break
+            if idx < 0:
+                return [], list(refs)
+            refs = list(refs)
+            return [refs[idx]], refs[:idx] + refs[idx + 1:]
+
+        with self._resolved_cond:
+            while True:
+                n_ready = sum(1 for h in hexes if h in resolved)
+                if n_ready >= num_returns:
+                    break
+                remaining = None if deadline is None else \
+                    deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                if not self._resolved_cond.wait(timeout=remaining):
+                    break
+        # Single-pass partition: resolved refs beyond num_returns stay
+        # in not_ready, per wait() semantics.
+        ready: List[ObjectRef] = []
+        not_ready: List[ObjectRef] = []
+        for r, h in zip(refs, hexes):
+            if len(ready) < num_returns and h in resolved:
+                ready.append(r)
+            else:
+                not_ready.append(r)
         return ready, not_ready
 
     def on_ref_deleted(self, object_id: ObjectID):
@@ -1336,8 +1415,15 @@ class CoreClient:
         if self._closed:
             return
         obj_hex = object_id.hex()
+        # Bare discard (no cond): set ops are GIL-atomic, and taking the
+        # non-reentrant condition from a GC-triggered __del__ could
+        # deadlock against a thread inside _mark_resolved.
+        self._resolved.discard(obj_hex)
         with self._lock:
             self._local_known.discard(obj_hex)
+            blob = self._inline_cache.pop(obj_hex, None)
+            if blob is not None:
+                self._inline_cache_bytes -= len(blob)
             if obj_hex in self._direct_futures:
                 self._direct_futures.pop(obj_hex, None)
                 actor_hex = self._direct_actor_of.pop(obj_hex, "")
@@ -1358,6 +1444,13 @@ class CoreClient:
         out: List[TaskArg] = []
         for a in args:
             if isinstance(a, ObjectRef):
+                cached = self._inline_cache.get(a.hex())
+                if cached is not None:
+                    # Hydrate: the executor gets the value inline — no
+                    # borrow, no incref, no fetch round trips (top-level
+                    # ref args resolve to values either way).
+                    out.append(TaskArg(is_ref=False, data=cached))
+                    continue
                 self._maybe_promote_direct(a.hex())
                 borrows.append(a.hex())
                 # Queued (not sent): the submit that registered this ref

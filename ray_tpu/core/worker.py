@@ -374,9 +374,13 @@ class WorkerRuntime:
             failed = True
         size = ser.total_bytes
         if size > self.core.config.max_direct_result_bytes:
-            # Large result: store via head (shm) and point the owner at it.
-            self.core._store_serialized(spec.return_ids[0], ser,
-                                        is_error=failed)
+            # Large result: store via head (shm) and point the owner at
+            # it.  For lease-path pool tasks, ship the producing spec as
+            # lineage so the head can re-execute on copy loss (the spec
+            # never transited the head on submit).
+            self.core._store_serialized(
+                spec.return_ids[0], ser, is_error=failed,
+                lineage_spec=spec if spec.actor_id is None else None)
             try:
                 conn.push({"op": "direct_result_remote", "obj": obj_hex})
             except Exception:
@@ -481,17 +485,20 @@ class WorkerRuntime:
             for obj_hex in spec.borrows:
                 self.core.client.send({"op": "decref", "obj": obj_hex})
 
-    def _buffer_task_event(self, spec: TaskSpec, failed: bool):
+    def _buffer_task_event(self, spec: TaskSpec, failed: bool,
+                           state: str = ""):
         """Queue a compact task-state event; flushed in batches so the
-        state API / timeline still see lease-path tasks the head never
-        scheduled (reference GcsTaskManager events)."""
+        state API / timeline / OOM victim policy still see lease-path
+        tasks the head never scheduled (reference GcsTaskManager
+        events + TaskEventBuffer)."""
         ev = {
             "task_id": spec.task_id.hex(),
             "name": spec.name or spec.func_id[:8],
             "owner": spec.owner,
-            "state": "FAILED" if failed else "FINISHED",
+            "state": state or ("FAILED" if failed else "FINISHED"),
+            "retries_left": max(0, spec.max_retries - spec.retry_count),
             "start": getattr(spec, "_exec_started", 0.0),
-            "end": time.time(),
+            "end": 0.0 if state == "RUNNING" else time.time(),
         }
         with self._res_lock:
             buf = getattr(self, "_task_events", None)
@@ -520,6 +527,11 @@ class WorkerRuntime:
         self._executing = True
         self._cur_tls.spec = spec
         spec._exec_started = time.time()
+        if spec.actor_id is None and getattr(spec, "direct", False) and \
+                getattr(spec, "_arrival_conn", None) is not None:
+            # Leased task: tell the head it is RUNNING here (batched) so
+            # the state API and the OOM victim policy see it.
+            self._buffer_task_event(spec, failed=False, state="RUNNING")
         # Pool (non-actor, non-streaming) tasks batch their result puts
         # into the task_done message; streaming items must flow live.
         # Leased (owner-direct) tasks send no task_done at all, so their

@@ -84,7 +84,7 @@ def test_head_restart_preserves_actors_and_inflight_work(tmp_path):
         # Let the task dispatch to a worker before the head dies.
         deadline = time.time() + 30
         while not any(
-                w["state"] == "busy"
+                w["state"] in ("busy", "leased")
                 for w in rt.state_list("workers")) \
                 and time.time() < deadline:
             time.sleep(0.2)

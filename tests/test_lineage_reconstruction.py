@@ -22,7 +22,11 @@ def _lose(rt, ref):
     rt.core.store.delete(oid)
 
 
-SIZE = 64_000  # int64 payload ~512 KB, safely above the inline threshold
+# int64 payload ~1.6 MB: above BOTH the inline threshold and
+# max_direct_result_bytes, so results land in the shm arena where a
+# copy can actually be lost.  (Smaller lease-path results live in the
+# owner's process and never need reconstruction.)
+SIZE = 200_000
 
 
 def test_lost_object_is_reconstructed(tmp_path):
@@ -168,7 +172,9 @@ def test_lost_spilled_copy_falls_back_to_lineage(tmp_path):
         def produce():
             with open(marker, "a") as f:
                 f.write("x")
-            return np.full(300_000, 7, dtype=np.uint8)
+            # >1 MB: above max_direct_result_bytes so the
+            # result lands in the (spillable) shm arena.
+            return np.full(1_500_000, 7, dtype=np.uint8)
 
         ref = produce.remote()
         assert ray_tpu.get(ref, timeout=30)[0] == 7
@@ -198,7 +204,7 @@ def test_lost_spilled_copy_falls_back_to_lineage(tmp_path):
         # (which will fail: backing file deleted) → lineage re-execution.
         _lose(rt, ref)
         got = ray_tpu.get(ref, timeout=60)
-        assert got[0] == 7 and len(got) == 300_000
+        assert got[0] == 7 and len(got) == 1_500_000
         assert marker.read_text().count("x") >= 2  # task re-executed
     finally:
         ray_tpu.shutdown()

@@ -276,8 +276,10 @@ def test_stack_profile_of_busy_worker():
     deadline = _time.time() + 15
     busy = None
     while busy is None and _time.time() < deadline:
+        # "leased" = executing via the owner-direct lease path
         busy = next((w for w in list_workers()
-                     if w["kind"] == "pool" and w["state"] == "busy"),
+                     if w["kind"] == "pool"
+                     and w["state"] in ("busy", "leased")),
                     None)
         _time.sleep(0.05)
     assert busy is not None
