@@ -566,10 +566,12 @@ class ControlServer:
             rec = self.tasks.get(w.current_task)
             if rec is not None and rec.state == "RUNNING":
                 spec = rec.spec
-                if spec.direct:
-                    # Lease-path task: the record is a skeletal event
-                    # mirror — retry/failure is the OWNER's job
-                    # (lease_revoked push above); never requeue it here.
+                if spec.direct and not spec.return_ids:
+                    # Skeletal event-mirror of a lease-path task —
+                    # retry/failure is the OWNER's job (lease_revoked
+                    # push above); never requeue the arg-less mirror.
+                    # Full direct specs (lineage-shipped, re-dispatched
+                    # by reconstruction) take the normal retry path.
                     rec.state = "FAILED"
                 elif spec.retry_count < spec.max_retries:
                     spec.retry_count += 1

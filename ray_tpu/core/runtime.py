@@ -1294,9 +1294,13 @@ class CoreClient:
             data = ser.to_bytes()
             if not is_error and size <= 64 * 1024:
                 with self._lock:
+                    prev = self._inline_cache.pop(oid.hex(), None)
+                    if prev is not None:  # overwrite (retry/recon re-put)
+                        self._inline_cache_bytes -= len(prev)
                     self._inline_cache[oid.hex()] = data
                     self._inline_cache_bytes += size
-                    while self._inline_cache_bytes > 16 * 1024 * 1024:
+                    while self._inline_cache_bytes > 16 * 1024 * 1024 \
+                            and self._inline_cache:
                         old, blob = next(iter(self._inline_cache.items()))
                         del self._inline_cache[old]
                         self._inline_cache_bytes -= len(blob)

@@ -31,14 +31,21 @@ from ray_tpu.models.transformer import (
     rms_norm,
     rope_freqs,
 )
-from ray_tpu.ops.paged_attention import paged_attention, write_page_tokens
+from ray_tpu.ops.paged_attention import (
+    paged_attention,
+    write_page_tokens,
+    write_token_rows,
+)
 
 
 def init_kv_pages(config: TransformerConfig, num_pages: int,
                   page_size: int) -> Dict[str, jax.Array]:
-    """Paged KV cache for all layers: [L, P, page, KVH, head_dim]."""
+    """Paged KV cache for all layers, kv-head-major:
+    [KVH, L, P, page, head_dim] — the layout the TPU paged-attention
+    kernel streams ([page, D] tiles contiguous per head), with L and P
+    adjacent so the flat [KVH, L*P, ...] view is a free reshape."""
     c = config
-    shape = (c.num_layers, num_pages, page_size, c.num_kv_heads,
+    shape = (c.num_kv_heads, c.num_layers, num_pages, page_size,
              c.head_dim_)
     return {"k": jnp.zeros(shape, dtype=c.dtype),
             "v": jnp.zeros(shape, dtype=c.dtype)}
@@ -50,7 +57,7 @@ def _layer_params(params: Dict[str, Any], l: int):
 
 
 def _flat_cache(cache: Dict[str, jax.Array]):
-    """View the [L, P, page, KVH, D] cache as [L*P, page, KVH, D].
+    """View the [KVH, L, P, page, D] cache as [KVH, L*P, page, D].
 
     Layer l's page p lives at flat index l*P + p, so per-layer writes
     are ONE scatter into the whole cache instead of slice-out /
@@ -58,16 +65,18 @@ def _flat_cache(cache: Dict[str, jax.Array]):
     analysis and copied ~2 x 33 MB of pages per layer per decode step
     (the dominant cost of the r2 decode bench).  Reshape of a
     contiguous array is metadata-only; the engine-facing cache dict
-    keeps its [L, ...] shape."""
-    L, P = cache["k"].shape[0], cache["k"].shape[1]
-    rest = cache["k"].shape[2:]
-    return (cache["k"].reshape(L * P, *rest),
-            cache["v"].reshape(L * P, *rest), L, P)
+    keeps its [KVH, L, ...] shape."""
+    KVH, L, P = cache["k"].shape[:3]
+    rest = cache["k"].shape[3:]
+    return (cache["k"].reshape(KVH, L * P, *rest),
+            cache["v"].reshape(KVH, L * P, *rest), L, P)
 
 
 def _unflat_cache(kf, vf, L: int, P: int) -> Dict[str, jax.Array]:
-    rest = kf.shape[1:]
-    return {"k": kf.reshape(L, P, *rest), "v": vf.reshape(L, P, *rest)}
+    KVH = kf.shape[0]
+    rest = kf.shape[2:]
+    return {"k": kf.reshape(KVH, L, P, *rest),
+            "v": vf.reshape(KVH, L, P, *rest)}
 
 
 def _project_qkv(x, bp, positions, cos, sin, c: TransformerConfig):
@@ -185,7 +194,7 @@ def _chunk_forward(params, tokens, positions, cache, block_tables,
     B, S = tokens.shape
     x = params["tok_embed"].astype(c.dtype)[tokens]
     cos, sin = rope_freqs(c.head_dim_, c.max_seq_len, c.rope_theta)
-    n_pages, page = cache["k"].shape[1], cache["k"].shape[2]
+    page = cache["k"].shape[3]
     max_ctx = block_tables.shape[1] * page
     q_pos = positions[:, :, None]                   # [B, S, 1]
     k_pos = jnp.arange(max_ctx)[None, None, :]      # [1, 1, ctx]
@@ -204,8 +213,12 @@ def _chunk_forward(params, tokens, positions, cache, block_tables,
         ck, cv = write_page_tokens(ck, cv, k, v, tables_l, positions)
         # Gather the full context (cached prefix + just-written suffix)
         # from the pages; K in pages is already rotary-encoded.
-        kf = ck[tables_l].reshape(B, max_ctx, -1, c.head_dim_)
-        vf = cv[tables_l].reshape(B, max_ctx, -1, c.head_dim_)
+        # [KVH, B, W, page, D] -> [B, ctx, KVH, D]
+        kvh = ck.shape[0]
+        kf = ck[:, tables_l].reshape(
+            kvh, B, max_ctx, c.head_dim_).transpose(1, 2, 0, 3)
+        vf = cv[:, tables_l].reshape(
+            kvh, B, max_ctx, c.head_dim_).transpose(1, 2, 0, 3)
         kv = kf.shape[2]
         if kv != c.num_heads:
             rep = c.num_heads // kv
@@ -280,7 +293,11 @@ def _decode_one(params, tokens, cache, block_tables, positions,
         bp = _layer_params(params, l)
         q, k, v = _project_qkv(x, bp, pos2d, cos, sin, c)
         tables_l = block_tables + l * P
-        ck, cv = write_page_tokens(ck, cv, k, v, tables_l, pos2d)
+        # DUS row writes, not scatter: scatter's preferred layout
+        # differs from the attention kernel's and XLA would copy the
+        # whole cache per layer to convert (write_token_rows docstring).
+        ck, cv = write_token_rows(ck, cv, k[:, 0], v[:, 0], tables_l,
+                                  positions)
         attn = paged_attention(q[:, 0], ck, cv, tables_l, context_lens)
         x = x + (attn.reshape(B, 1, -1) @ bp["wo"].astype(c.dtype))
         x = _mlp(x, bp, c)

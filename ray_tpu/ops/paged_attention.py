@@ -3,21 +3,27 @@
 The reference delegates LLM serving to vLLM via compiled DAGs
 (SURVEY.md §2.2 P12 — "Ray's µs-latency GPU pipeline path"); the
 TPU-native build owns the inference path instead (§7.10 "LLM inference
-replica w/ paged attention"). KV blocks live in fixed-size pages
-([num_pages, page_size, kv_heads, head_dim]); each sequence owns a list
-of pages (its block table), so cache memory is allocated page-at-a-time
-with zero fragmentation-driven copies — the vLLM idea, expressed as XLA
-gathers instead of CUDA kernels:
+replica w/ paged attention"). KV blocks live in fixed-size pages laid
+out KV-HEAD-MAJOR ([kv_heads, num_pages, page_size, head_dim]) — the
+layout the TPU kernel wants (contiguous [page, D] tiles per head) —
+and each sequence owns a list of pages (its block table), so cache
+memory is allocated page-at-a-time with zero fragmentation-driven
+copies: the vLLM idea, TPU-shaped.
 
-  - decode: gather the sequence's pages with one `take` on the page axis
-    (XLA lowers to a dynamic-gather DMA), then batched GQA attention on
-    the MXU with masking past `context_lens`.
-  - page writes are functional `.at[pages, offsets].set(...)` scatters,
-    so the cache threads through jit with buffer donation.
+  - decode on TPU runs JAX's Pallas paged-attention kernel
+    (jax.experimental.pallas.ops.tpu.paged_attention — public JAX ops,
+    multi-page compute blocks with double-buffered async copies; our
+    earlier one-page-per-grid-step kernel was DMA-issue-bound at ~15%
+    of HBM bandwidth).
+  - other platforms use an XLA gather formulation, and a small
+    interpret-mode Pallas kernel covers kernel-semantics tests on CPU.
+  - page writes are functional `.at[:, pages, offsets].set(...)`
+    scatters, so the cache threads through jit with buffer donation.
 
-Static shapes throughout: [B, max_pages] block tables padded with page 0
-and masked by context_lens, so one compiled decode program serves every
-batch composition (continuous batching never recompiles).
+Static shapes throughout: [B, max_pages] block tables padded with page
+0 and masked by context_lens, bucketed by the engine to the live
+context width (serve/llm_engine.py), so a handful of compiled decode
+programs serve every batch composition.
 """
 
 from __future__ import annotations
@@ -25,7 +31,6 @@ from __future__ import annotations
 import functools
 import math
 import os
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp
@@ -48,23 +53,41 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
                     sm_scale: float | None = None):
     """Decode-time attention for one new token per sequence.
 
-    q:            [B, H, D]           query for the current position
-    k_pages:      [P, page, KVH, D]   paged key cache (one layer)
-    v_pages:      [P, page, KVH, D]   paged value cache
+    q:            [B, H, D]            query for the current position
+    k_pages:      [KVH, P, page, D]    paged key cache (one layer)
+    v_pages:      [KVH, P, page, D]    paged value cache
     block_tables: [B, max_pages] int32 page ids (padded entries ignored)
-    context_lens: [B] int32           tokens in cache per sequence
-                                      (including the current one)
+    context_lens: [B] int32            tokens in cache per sequence
+                                       (including the current one)
     Returns [B, H, D].
-
-    On TPU this runs the Pallas kernel below (pages stream through VMEM
-    driven by the scalar-prefetched block table — the gathered
-    [B, T, KVH, D] intermediate is never materialized in HBM); other
-    platforms use the XLA gather formulation.
     """
     B, H, D = q.shape
-    P, page, KVH, _ = k_pages.shape
-    if ((_platform() == "tpu" or _interpret_mode())
-            and D % 128 == 0 and H % KVH == 0):
+    KVH, P, page, _ = k_pages.shape
+    W = block_tables.shape[1]
+    if _platform() == "tpu" and D % 128 == 0 and H % KVH == 0 \
+            and sm_scale is None:
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as _jax_paged_attention,
+        )
+
+        # pages_per_compute_block must DIVIDE the table width (the
+        # engine buckets W pow-2 but clamps to max_pages_per_seq, which
+        # need not be); 32 pages per block measured fastest on v5e
+        # (larger async copies beat finer skip granularity).
+        ppcb = min(32, W)
+        while W % ppcb:
+            ppcb -= 1
+        # The jax kernel applies no softmax scale internally: fold
+        # 1/sqrt(D) into q (the gather/interpret paths scale in the
+        # logits; skipping this made TPU logits sqrt(D)x too large).
+        q_scaled = (q.astype(jnp.float32)
+                    * (1.0 / math.sqrt(D))).astype(q.dtype)
+        out = _jax_paged_attention(
+            q_scaled, k_pages, v_pages, context_lens.astype(jnp.int32),
+            block_tables.astype(jnp.int32),
+            pages_per_compute_block=ppcb)
+        return out.astype(q.dtype)
+    if _interpret_mode() and D % 8 == 0 and H % KVH == 0:
         return _paged_attention_pallas(
             q, k_pages, v_pages, block_tables, context_lens,
             sm_scale if sm_scale is not None else 1.0 / math.sqrt(D))
@@ -76,35 +99,35 @@ def _paged_attention_gather(q, k_pages, v_pages, block_tables,
                             context_lens, sm_scale: float | None = None):
     """XLA gather formulation (non-TPU fallback)."""
     B, H, D = q.shape
-    P, page, KVH, _ = k_pages.shape
+    KVH, P, page, _ = k_pages.shape
     max_pages = block_tables.shape[1]
     G = H // KVH  # query heads per kv head (GQA)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
 
-    # Gather each sequence's pages: [B, max_pages, page, KVH, D] →
-    # [B, T, KVH, D] with T = max_pages * page.
-    k = jnp.take(k_pages, block_tables, axis=0).reshape(
-        B, max_pages * page, KVH, D)
-    v = jnp.take(v_pages, block_tables, axis=0).reshape(
-        B, max_pages * page, KVH, D)
+    # Gather each sequence's pages: [KVH, B, max_pages, page, D] →
+    # [B, KVH, T, D] with T = max_pages * page.
+    k = jnp.take(k_pages, block_tables, axis=1).reshape(
+        KVH, B, max_pages * page, D).transpose(1, 0, 2, 3)
+    v = jnp.take(v_pages, block_tables, axis=1).reshape(
+        KVH, B, max_pages * page, D).transpose(1, 0, 2, 3)
 
     qg = q.reshape(B, KVH, G, D)
-    logits = jnp.einsum("bkgd,btkd->bkgt", qg.astype(jnp.float32),
+    logits = jnp.einsum("bkgd,bktd->bkgt", qg.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     t_idx = jnp.arange(max_pages * page, dtype=jnp.int32)
     valid = t_idx[None, :] < context_lens[:, None]           # [B, T]
     logits = jnp.where(valid[:, None, None, :], logits,
                        jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bkgt,btkd->bkgd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bkgt,bktd->bkgd", probs, v.astype(jnp.float32))
     return out.reshape(B, H, D).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
-# Pallas decode kernel: one grid step per (sequence, page); the block
-# table rides as a scalar-prefetch operand so each step's BlockSpec DMAs
-# exactly the page it needs.  Flash-style running (max, sum, acc) in
-# VMEM scratch across the page axis.
+# Interpret-mode Pallas kernel (kernel-semantics tests on CPU): one page
+# per grid step, block table as a scalar-prefetch operand, flash-style
+# running (max, sum, acc) in VMEM scratch across the page axis.  The
+# TPU serving path uses JAX's multi-page kernel above instead.
 # ---------------------------------------------------------------------------
 
 
@@ -126,11 +149,10 @@ def _paged_decode_kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
     def _compute():
         d = q_ref.shape[-1]
         q = q_ref[0].astype(jnp.float32).reshape(kvh, g, d)   # [KVH,G,D]
-        k = k_ref[0].astype(jnp.float32)                      # [page,KVH,D]
-        v = v_ref[0].astype(jnp.float32)
-        kt = k.transpose(1, 0, 2)                             # [KVH,page,D]
+        k = k_ref[:, 0]                                       # [KVH,page,D]
+        v = v_ref[:, 0]
         logits = jax.lax.dot_general(
-            q, kt, (((2,), (2,)), ((0,), (0,))),
+            q, k.astype(jnp.float32), (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * sm_scale    # [KVH,G,page]
         pos = w * page + jax.lax.broadcasted_iota(
             jnp.int32, (kvh, g, page), 2)
@@ -141,9 +163,8 @@ def _paged_decode_kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(logits - m_new[..., None])                # [KVH,G,page]
         l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1)
-        vt = v.transpose(1, 0, 2)                             # [KVH,page,D]
         pv = jax.lax.dot_general(
-            p, vt, (((2,), (1,)), ((0,), (0,))),
+            p, v.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)               # [KVH,G,D]
         acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
         m_ref[...] = m_new
@@ -159,7 +180,7 @@ def _paged_decode_kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
 def _paged_attention_pallas(q, k_pages, v_pages, block_tables,
                             context_lens, sm_scale: float):
     B, H, D = q.shape
-    P, page, KVH, _ = k_pages.shape
+    KVH, P, page, _ = k_pages.shape
     W = block_tables.shape[1]
     G = H // KVH
 
@@ -168,10 +189,10 @@ def _paged_attention_pallas(q, k_pages, v_pages, block_tables,
         grid=(B, W),
         in_specs=[
             pl.BlockSpec((1, H, D), lambda b, w, tables, ctx: (b, 0, 0)),
-            pl.BlockSpec((1, page, KVH, D),
-                         lambda b, w, tables, ctx: (tables[b, w], 0, 0, 0)),
-            pl.BlockSpec((1, page, KVH, D),
-                         lambda b, w, tables, ctx: (tables[b, w], 0, 0, 0)),
+            pl.BlockSpec((KVH, 1, page, D),
+                         lambda b, w, tables, ctx: (0, tables[b, w], 0, 0)),
+            pl.BlockSpec((KVH, 1, page, D),
+                         lambda b, w, tables, ctx: (0, tables[b, w], 0, 0)),
         ],
         out_specs=pl.BlockSpec(
             (1, H, D), lambda b, w, tables, ctx: (b, 0, 0)),
@@ -196,14 +217,16 @@ def write_page_tokens(k_pages, v_pages, k_new, v_new, block_tables,
                       positions):
     """Scatter new K/V rows into their pages.
 
+    k_pages/v_pages: [KVH, P, page, D] (kv-head-major);
     k_new/v_new: [B, S, KVH, D] projections for S new tokens per seq;
     positions:   [B, S] int32 absolute positions (define page + offset);
     block_tables:[B, max_pages].
     Returns updated (k_pages, v_pages). Rows with position < 0 are
-    dropped (write to a scratch page slot) so padded prefills are safe.
+    dropped (out-of-bounds page under scatter mode="drop") so padded
+    prefills are safe.
     """
     B, S, KVH, D = k_new.shape
-    page = k_pages.shape[1]
+    page = k_pages.shape[2]
     page_idx = positions // page                              # [B, S]
     offset = positions % page
     valid = positions >= 0
@@ -212,16 +235,82 @@ def write_page_tokens(k_pages, v_pages, k_new, v_new, block_tables,
     # Invalid rows get page index == num_pages: past-the-end is
     # out-of-bounds under scatter mode="drop" (negative indices would
     # WRAP, silently corrupting the last page), so those writes vanish.
-    pages = jnp.where(valid, pages, k_pages.shape[0])
-    flat_pages = pages.reshape(-1)
+    pages = jnp.where(valid, pages, k_pages.shape[1])
+    flat_pages = pages.reshape(-1)                            # [B*S]
     flat_off = jnp.maximum(offset, 0).reshape(-1)
-    k_flat = k_new.reshape(-1, KVH, D)
-    v_flat = v_new.reshape(-1, KVH, D)
-    k_pages = k_pages.at[flat_pages, flat_off].set(
+    k_flat = k_new.reshape(-1, KVH, D).transpose(1, 0, 2)     # [KVH,N,D]
+    v_flat = v_new.reshape(-1, KVH, D).transpose(1, 0, 2)
+    k_pages = k_pages.at[:, flat_pages, flat_off].set(
         k_flat, mode="drop")
-    v_pages = v_pages.at[flat_pages, flat_off].set(
+    v_pages = v_pages.at[:, flat_pages, flat_off].set(
         v_flat, mode="drop")
     return k_pages, v_pages
+
+
+def _row_write_kernel(pages_ref, offs_ref, kin_ref, vin_ref, knew_ref,
+                      vnew_ref, ok_ref, ov_ref):
+    """Read-modify-write one page: carry the page block through and
+    overwrite row offs[b] with the new token's K/V."""
+    del pages_ref
+    b = pl.program_id(0)
+    off = offs_ref[b]
+    kvh, _, page, d = ok_ref.shape
+    page_pos = jax.lax.broadcasted_iota(jnp.int32, (kvh, 1, page, d), 2)
+    k_row = knew_ref[0][:, None, None, :]  # [KVH,1,1,D]
+    v_row = vnew_ref[0][:, None, None, :]
+    ok_ref[...] = jnp.where(page_pos == off, k_row, kin_ref[...])
+    ov_ref[...] = jnp.where(page_pos == off, v_row, vin_ref[...])
+
+
+def write_token_rows(k_pages, v_pages, k_new, v_new, block_tables,
+                     positions):
+    """Decode-path single-token write: one [KVH, D] row per sequence,
+    in place via an aliased Pallas kernel (NOT an XLA scatter).
+
+    XLA's layout assignment gives a middle-axis scatter a different
+    preferred cache layout ({3,0,2,1}: update rows contiguous) than the
+    paged-attention custom call ({3,2,1,0}: per-head page tiles), so a
+    scatter here made every decode layer copy the multi-GB cache twice
+    to ping-pong layouts — 238 ms/iter on v5e.  A pallas_call pins the
+    default layout on both sides and input_output_aliases makes the
+    write genuinely in place.
+
+    k_pages/v_pages: [KVH, FP, page, D]; k_new/v_new: [B, KVH, D];
+    positions: [B] absolute position (< 0 = drop); block_tables:
+    [B, W] (already layer-offset).  Dropped rows land in the GLOBAL
+    scratch page FP-1 — the engine reserves the last physical page
+    (llm_engine.py PageAllocator) so nothing lives there.
+    """
+    B, KVH, D = k_new.shape
+    FP, page = k_pages.shape[1], k_pages.shape[2]
+    page_idx = positions // page
+    offs = jnp.where(positions >= 0, positions % page, 0) \
+        .astype(jnp.int32)
+    pages = jnp.take_along_axis(
+        block_tables, jnp.maximum(page_idx, 0)[:, None], axis=1)[:, 0]
+    pages = jnp.where(positions >= 0, pages, FP - 1).astype(jnp.int32)
+
+    cache_spec = pl.BlockSpec(
+        (KVH, 1, page, D),
+        lambda b, pages, offs: (0, pages[b], 0, 0))
+    new_spec = pl.BlockSpec((1, KVH, D), lambda b, pages, offs: (b, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B,),
+        in_specs=[cache_spec, cache_spec, new_spec, new_spec],
+        out_specs=[cache_spec, cache_spec],
+    )
+    kernel = pl.pallas_call(
+        _row_write_kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                   jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)],
+        # Indices count every positional operand including the two
+        # scalar-prefetch arrays: 2 = k_pages -> out 0, 3 = v_pages.
+        input_output_aliases={2: 0, 3: 1},
+        interpret=_platform() != "tpu",
+    )
+    return kernel(pages, offs, k_pages, v_pages, k_new, v_new)
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
@@ -236,8 +325,7 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
     block_tables = np.asarray(block_tables)
     context_lens = np.asarray(context_lens)
     B, H, D = q.shape
-    page = k_pages.shape[1]
-    KVH = k_pages.shape[2]
+    KVH, P, page, _ = k_pages.shape
     G = H // KVH
     out = np.zeros_like(q)
     for b in range(B):
@@ -247,8 +335,8 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
         ks, vs = [], []
         for t in range(n):
             p = block_tables[b, t // page]
-            ks.append(k_pages[p, t % page])
-            vs.append(v_pages[p, t % page])
+            ks.append(k_pages[:, p, t % page])
+            vs.append(v_pages[:, p, t % page])
         k = np.stack(ks)  # [n, KVH, D]
         v = np.stack(vs)
         for h in range(H):

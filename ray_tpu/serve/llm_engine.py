@@ -32,7 +32,10 @@ class PageAllocator:
 
     def __init__(self, num_pages: int):
         self.num_pages = num_pages
-        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        # The LAST physical page is the decode write path's scratch
+        # target for inactive slots (ops/paged_attention.py
+        # write_token_rows) — never allocate it.
+        self._free: List[int] = list(range(num_pages - 2, -1, -1))
 
     @property
     def num_free(self) -> int:
@@ -219,12 +222,14 @@ class LLMEngine:
                 f" exceeds max_seq_len={self.config.max_seq_len}")
         need = math.ceil(
             (len(prompt_tokens) + max_new_tokens) / self.page_size)
-        if need > self.allocator.num_pages:
+        # num_pages - 1: the last physical page is the decode scratch
+        # target (PageAllocator) and can never be allocated.
+        if need > self.allocator.num_pages - 1:
             # Would never be admittable — it would wedge the FIFO queue.
             raise ValueError(
                 f"request needs {need} KV pages but the pool only has "
-                f"{self.allocator.num_pages}; raise num_pages or shorten "
-                "the request")
+                f"{self.allocator.num_pages - 1} allocatable; raise "
+                "num_pages or shorten the request")
         req = _Request(self._next_id, list(prompt_tokens), max_new_tokens,
                        temperature, eos_token=eos_token)
         self._next_id += 1

@@ -1,13 +1,18 @@
 """Decode/serving benchmark: tokens/s through LLMEngine.step on TPU
-(paged KV cache + continuous batching + optional prompt-lookup
-speculation).
+(paged KV cache + continuous batching + device-resident multi-step).
 
 Run: python scripts/bench_decode.py  (writes one JSON line to stdout;
-results committed as DECODE_BENCH_r02.json).
+results committed as DECODE_BENCH_r03.json).
 
 The reference has no comparable in-tree number (its serve LLM tests are
 pass/fail wrappers); this establishes the framework's own baseline, per
-BASELINE.md 'Missing from reference'.
+BASELINE.md 'Missing from reference'.  Two shapes run: the r02
+comparison point (128+128) and a longer-generation shape (128+512).
+The roofline is HONEST about both traffic terms: every decode iteration
+reads the full bf16 weights AND the live KV context, so
+
+    iters/s <= HBM_BW / (weight_bytes + avg_kv_bytes_per_iter)
+    tokens/s <= iters/s * batch
 """
 
 import json
@@ -20,33 +25,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
-def main():
-    import jax
-
+def run_shape(config, *, n_requests, prompt_len, max_new, page_size,
+              num_pages, max_batch, multi_step, hbm_gb_s):
     from ray_tpu.models import transformer as tfm
     from ray_tpu.serve.llm_engine import LLMEngine
-
-    devices = jax.devices()
-    on_tpu = devices[0].platform == "tpu"
-    if on_tpu:
-        # Inference-sized 1.1B (no optimizer state): bf16 weights + a
-        # ~2 GB paged KV pool fit comfortably in 16 GB HBM.  multi_step
-        # 32 amortizes the per-dispatch transport latency (~35 ms on
-        # the tunneled dev chip; measured ~3.5 ms/iteration device
-        # time at batch 16 = 77% of the weights-bandwidth roofline).
-        config = tfm.TransformerConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=8192,
-            num_layers=16, num_heads=16, num_kv_heads=16,
-            max_seq_len=2048, remat=False)
-        n_requests, prompt_len, max_new = 64, 128, 128
-        page_size, num_pages, max_batch = 16, 1024, 32
-        multi_step = 32
-    else:
-        multi_step = 1
-    if not on_tpu:
-        config = tfm.TransformerConfig.tiny()
-        n_requests, prompt_len, max_new = 4, 8, 8
-        page_size, num_pages, max_batch = 4, 64, 4
 
     eng = LLMEngine(config, page_size=page_size, num_pages=num_pages,
                     max_batch=max_batch, multi_step=multi_step)
@@ -54,13 +36,13 @@ def main():
     prompts = [rng.integers(1, config.vocab_size, prompt_len).tolist()
                for _ in range(n_requests)]
 
-    # Warmup: compile every bucket the measured run will hit — the full
-    # batched-prefill (B=max_batch, S bucket of prompt_len) and the
-    # decode/multi-step programs.  Compiles are cached; steady-state
-    # serving never pays them, so neither should the measurement.
+    # Warmup compiles every bucket the measured run hits: the batched
+    # prefill and one decode program per pow-2 context-width bucket
+    # (steady-state serving never pays compiles, so neither should the
+    # measurement).
     warm = [rng.integers(1, config.vocab_size, prompt_len).tolist()
             for _ in range(max_batch)]
-    eng.generate(warm, max_new_tokens=multi_step + 1)
+    eng.generate(warm, max_new_tokens=max_new)
 
     t0 = time.perf_counter()
     ids = [eng.add_request(p, max_new_tokens=max_new) for p in prompts]
@@ -71,37 +53,82 @@ def main():
         steps += 1
     dt = time.perf_counter() - t0
     assert set(ids) <= set(results), "missing results"
-    # Engine results are the GENERATED tokens (prompt excluded).
     gen_tokens = sum(len(results[i]) for i in ids)
-    prefill_tokens = n_requests * prompt_len
 
-    # Weights-bandwidth roofline: every decode iteration reads the full
-    # bf16 weights once; HBM bandwidth caps iterations/s, and batch
-    # multiplies tokens per iteration (VERDICT r2 framing).
-    hbm_gb_s = {"TPU v5 lite": 819e9, "TPU v5": 2765e9,
-                "TPU v4": 1228e9}.get(
-        getattr(devices[0], "device_kind", ""), 819e9)
     weight_bytes = 2 * tfm.num_params(config)
-    roofline_tok_s = hbm_gb_s / weight_bytes * max_batch
+    # Average KV bytes read per decode iteration: bf16 K+V over the
+    # average live context across the generation window.
+    kv_per_token = (2 * config.num_layers * config.num_kv_heads
+                    * config.head_dim_ * 2)
+    avg_ctx = prompt_len + max_new / 2
+    kv_bytes = max_batch * avg_ctx * kv_per_token
+    roofline_tok_s = hbm_gb_s / (weight_bytes + kv_bytes) * max_batch
     tok_s = gen_tokens / dt
-    print(json.dumps({
-        "metric": "decode_tokens_per_sec",
-        "value": round(tok_s, 1),
-        "unit": "tokens/s",
+    return {
+        "tokens_per_sec": round(tok_s, 1),
         "roofline_tokens_per_sec": round(roofline_tok_s, 1),
         "roofline_fraction": round(tok_s / roofline_tok_s, 3),
-        "roofline_note": ("weights-bandwidth bound: HBM_BW / "
-                          "(2 B/param) x batch; includes prefill + "
-                          "per-dispatch transport latency in the wall"),
         "generated_tokens": gen_tokens,
-        "prefill_tokens": prefill_tokens,
+        "prefill_tokens": n_requests * prompt_len,
         "wall_s": round(dt, 2),
         "engine_steps": steps,
         "concurrent_requests": n_requests,
         "max_batch": max_batch,
         "multi_step": multi_step,
-        "model_params": tfm.num_params(config),
         "seq": f"{prompt_len}+{max_new}",
+    }
+
+
+def main():
+    import jax
+
+    from ray_tpu.models import transformer as tfm
+
+    devices = jax.devices()
+    on_tpu = devices[0].platform == "tpu"
+    hbm_gb_s = {"TPU v5 lite": 819e9, "TPU v5": 2765e9,
+                "TPU v4": 1228e9}.get(
+        getattr(devices[0], "device_kind", ""), 819e9)
+    if on_tpu:
+        # Inference-sized 1.1B (no optimizer state): bf16 weights + a
+        # ~4 GB paged KV pool fit comfortably in 16 GB HBM.
+        # 1.0B GQA 4:1 (TinyLlama-class): grouped-query attention is
+        # the TPU-first shape — 4x the MXU work per KV byte streamed,
+        # 4x smaller KV pool, so batch (and the bandwidth roofline's
+        # useful output) doubles.
+        config = tfm.TransformerConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=22, num_heads=16, num_kv_heads=4,
+            max_seq_len=2048, remat=False)
+        shapes = [
+            dict(n_requests=128, prompt_len=128, max_new=128,
+                 page_size=16, num_pages=4096, max_batch=64,
+                 multi_step=32),
+            dict(n_requests=64, prompt_len=128, max_new=512,
+                 page_size=16, num_pages=4096, max_batch=64,
+                 multi_step=64),
+        ]
+    else:
+        config = tfm.TransformerConfig.tiny()
+        shapes = [dict(n_requests=4, prompt_len=8, max_new=8,
+                       page_size=4, num_pages=64, max_batch=4,
+                       multi_step=1)]
+
+    rows = [run_shape(config, hbm_gb_s=hbm_gb_s, **s) for s in shapes]
+    head = rows[0]
+    print(json.dumps({
+        "metric": "decode_tokens_per_sec",
+        "value": head["tokens_per_sec"],
+        "unit": "tokens/s",
+        "roofline_tokens_per_sec": head["roofline_tokens_per_sec"],
+        "roofline_fraction": head["roofline_fraction"],
+        "roofline_note": ("HBM_BW / (weight_bytes + avg live KV bytes) "
+                          "x batch — both traffic terms every decode "
+                          "iteration reads; wall includes prefill and "
+                          "per-dispatch transport latency on the "
+                          "tunneled dev chip"),
+        "shapes": rows,
+        "model_params": tfm.num_params(config),
         "device": getattr(devices[0], "device_kind", devices[0].platform),
     }))
     return 0

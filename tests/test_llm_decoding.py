@@ -39,8 +39,8 @@ def test_paged_attention_matches_reference():
     rng = np.random.default_rng(0)
     B, H, KVH, D, page, P = 3, 8, 2, 16, 4, 12
     q = rng.normal(size=(B, H, D)).astype(np.float32)
-    kp = rng.normal(size=(P, page, KVH, D)).astype(np.float32)
-    vp = rng.normal(size=(P, page, KVH, D)).astype(np.float32)
+    kp = rng.normal(size=(KVH, P, page, D)).astype(np.float32)
+    vp = rng.normal(size=(KVH, P, page, D)).astype(np.float32)
     bt = np.array([[1, 2, 3], [4, 5, 0], [6, 0, 0]], dtype=np.int32)
     cl = np.array([12, 5, 1], dtype=np.int32)
     out = paged_attention(jnp.asarray(q), jnp.asarray(kp),
@@ -51,14 +51,14 @@ def test_paged_attention_matches_reference():
 
 
 def test_write_page_tokens_drops_invalid_positions():
-    kp = jnp.zeros((4, 2, 1, 3))
+    kp = jnp.zeros((1, 4, 2, 3))  # [KVH, P, page, D]
     vp = jnp.zeros_like(kp)
     k_new = jnp.ones((1, 2, 1, 3))
     bt = jnp.asarray([[2, 3]], dtype=jnp.int32)
     pos = jnp.asarray([[3, -1]], dtype=jnp.int32)  # page 3 slot 1; drop
     kp2, _ = write_page_tokens(kp, vp, k_new, k_new, bt, pos)
     kp2 = np.asarray(kp2)
-    assert kp2[3, 1].sum() == 3.0
+    assert kp2[0, 3, 1].sum() == 3.0  # [kvh=0, page 3, slot 1]
     assert kp2.sum() == 3.0  # nothing else written
 
 
@@ -288,8 +288,9 @@ def test_prefix_cache_eviction_under_pressure(tiny, params):
         p = rng.integers(0, tiny.vocab_size, size=8).tolist()
         out = eng.generate([p], max_new_tokens=4)[0]
         assert len(out) == 4
-    # Pool conservation: every page is free, idle-cached, or nothing.
-    assert eng.allocator.num_free + eng.prefix_cache.num_idle == 12
+    # Pool conservation: every page is free, idle-cached, or reserved
+    # (num_pages minus the decode scratch page, PageAllocator).
+    assert eng.allocator.num_free + eng.prefix_cache.num_idle == 11
 
 
 # ---------------------------------------------------------------------------
@@ -450,8 +451,8 @@ def test_paged_attention_pallas_kernel_matches_reference(monkeypatch):
     rng = np.random.default_rng(0)
     B, H, KVH, D, P, page, W = 3, 8, 4, 128, 32, 8, 4
     q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
-    kp = jnp.asarray(rng.standard_normal((P, page, KVH, D)), jnp.float32)
-    vp = jnp.asarray(rng.standard_normal((P, page, KVH, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((KVH, P, page, D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((KVH, P, page, D)), jnp.float32)
     tables = jnp.asarray(
         rng.permutation(P)[:B * W].reshape(B, W).astype(np.int32))
     ctx = jnp.asarray([1, 13, 32], jnp.int32)
