@@ -133,6 +133,26 @@ class Dataset:
         op.inputs = [self._terminal, other._terminal]
         return Dataset(op)
 
+    # -- global aggregates (reference Dataset.sum/min/max/mean/std) ----
+    def _global_agg(self, kind: str, on: str):
+        rows = GroupedData(self, None)._agg(kind, on).take_all()
+        return rows[0][f"{kind}({on})"] if rows else None
+
+    def sum(self, on: str):
+        return self._global_agg("sum", on)
+
+    def min(self, on: str):
+        return self._global_agg("min", on)
+
+    def max(self, on: str):
+        return self._global_agg("max", on)
+
+    def mean(self, on: str):
+        return self._global_agg("mean", on)
+
+    def std(self, on: str):
+        return self._global_agg("std", on)
+
     def groupby(self, key: Optional[str]) -> "GroupedData":
         return GroupedData(self, key)
 

@@ -97,3 +97,14 @@ def test_actor_pool_matches_task_pool_results(rt):
         double, compute="actors", concurrency=2).take_all()
     b = rd.range(50, parallelism=4).map_batches(double).take_all()
     assert sorted(r["id"] for r in a) == sorted(r["id"] for r in b)
+
+
+def test_global_aggregates(rt):
+    """Dataset-level sum/min/max/mean/std (reference dataset.py
+    Dataset.sum etc. — scalar results, no groupby key)."""
+    ds = rd.from_items([{"x": i, "y": i * 2.0} for i in range(10)])
+    assert ds.sum("x") == 45
+    assert ds.min("x") == 0
+    assert ds.max("y") == 18.0
+    assert ds.mean("x") == 4.5
+    assert abs(ds.std("x") - 3.0276) < 0.01
