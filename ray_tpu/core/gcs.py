@@ -3344,13 +3344,17 @@ class ControlServer:
                        state="starting", node_id=node_id,
                        spawned_at=time.time())
         self.workers[worker_id.hex()] = w
+        renv = self.runtime_envs.get(env_key)
         node = self.nodes.get(node_id)
         if node is not None and node.conn is not None:
             try:
                 node.conn.push({
                     "op": "spawn_worker", "worker_hex": worker_id.hex(),
                     "kind": kind, "env_key": env_key,
-                    "namespace": self.namespace})
+                    "namespace": self.namespace,
+                    # The container wrapper applies at SPAWN on the
+                    # worker's own host (runtime_env/container.py).
+                    "runtime_env": renv})
             except Exception:
                 self._mark_worker_dead(w, "node manager unreachable")
             return w
@@ -3359,7 +3363,7 @@ class ControlServer:
             kind=kind, env_key=env_key, namespace=self.namespace,
             node_id=node_id,
             log_dir=os.path.join(self.session_dir, "logs"),
-            session_id=self.session_id)
+            session_id=self.session_id, runtime_env=renv)
         w.proc = proc
         w.pid = proc.pid
         return w

@@ -41,10 +41,16 @@ from ray_tpu.core.resources import node_resources_from_env
 def spawn_worker_process(*, control_addr: str, worker_hex: str, kind: str,
                          env_key: str, namespace: str, node_id: str,
                          log_dir: str, session_id: str,
-                         extra_env: Optional[dict] = None
+                         extra_env: Optional[dict] = None,
+                         runtime_env: Optional[dict] = None
                          ) -> subprocess.Popen:
     """Start one worker process (shared by the head's in-process pool and
-    remote node managers — reference worker_pool.h StartWorkerProcess)."""
+    remote node managers — reference worker_pool.h StartWorkerProcess).
+
+    A runtime_env carrying a `container` spec wraps the command so the
+    worker boots chrooted into the image rootfs inside a private
+    user+mount namespace (runtime_env/container.py — the reference
+    applies its podman prefix at the same point, worker_pool / image_uri)."""
     from ray_tpu.core.gcs import _site_packages
 
     env = dict(os.environ)
@@ -69,6 +75,12 @@ def spawn_worker_process(*, control_addr: str, worker_hex: str, kind: str,
         if extra:
             env["PYTHONPATH"] = os.pathsep.join(extra)
         cmd = [sys.executable, "-S", "-m", "ray_tpu.core.worker"]
+    if runtime_env and runtime_env.get("container"):
+        from ray_tpu.runtime_env.container import build_container_command
+
+        cmd = build_container_command(
+            runtime_env["container"], cmd, cwd=os.getcwd(),
+            shm_dir=get_config().shm_dir)
     os.makedirs(log_dir, exist_ok=True)
     log_base = os.path.join(log_dir, f"worker-{worker_hex[:8]}")
     stdout = open(log_base + ".out", "ab")
@@ -149,6 +161,7 @@ class NodeManager:
                     node_id=self.node_id,
                     log_dir=os.path.join(self.session_dir, "logs"),
                     session_id=self.session_id,
+                    runtime_env=msg.get("runtime_env"),
                     # Local workers answer resource queries from this
                     # manager's synced view instead of dialing the head.
                     extra_env={"RAY_TPU_LOCAL_NM": self.address})

@@ -1,0 +1,101 @@
+"""Container runtime envs without a container engine.
+
+Reference counterpart: python/ray/_private/runtime_env/image_uri.py —
+`runtime_env={"container": {"image_uri": ...}}` runs the worker inside
+a container.  The reference shells out to podman; this image has no
+container engine (and no registry egress), but the kernel primitives
+are available, so the plugin builds containers from first principles:
+
+  - `image_uri: "file:///path/to/rootfs"` names a local root
+    filesystem directory (the unpacked image).
+  - the worker process is wrapped in `unshare --user --map-root-user
+    --mount`: an unprivileged user namespace owning a private mount
+    namespace.
+  - inside, the plugin bind-mounts /proc, /dev (incl. the /dev/shm
+    object arena — workers must still attach it), /tmp (session dirs)
+    and the repo working directory into the rootfs, chroots, and execs
+    the worker command.
+  - `bind_host_base: true` overlays the host's base directories
+    (/usr, /bin, /lib…) into the rootfs for images that only ADD
+    files on top of the host environment — the zero-egress way to
+    build a derived "image" (mirror of a Dockerfile FROM layer).
+
+Containerization happens at worker SPAWN (the command is wrapped
+before exec), mirroring the reference where the raylet's worker pool
+applies the container prefix — by the time user code runs, it is
+already inside.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+from typing import Dict, List, Optional
+
+_BASE_DIRS = ("usr", "bin", "sbin", "lib", "lib64", "lib32", "opt",
+              "etc", "root", "home")
+
+
+class ContainerError(ValueError):
+    pass
+
+
+def validate_container_spec(spec: Dict) -> Dict:
+    if not isinstance(spec, dict):
+        raise ContainerError("container spec must be a dict")
+    uri = spec.get("image_uri", "")
+    if not uri.startswith("file://"):
+        raise ContainerError(
+            "image_uri must be file:///path/to/rootfs (no registry "
+            "egress in this environment); got " + repr(uri))
+    rootfs = uri[len("file://"):]
+    if not os.path.isdir(rootfs):
+        raise ContainerError(f"image rootfs {rootfs!r} does not exist")
+    return {"rootfs": rootfs,
+            "bind_host_base": bool(spec.get("bind_host_base", False)),
+            "binds": list(spec.get("binds", ()))}
+
+
+def container_available() -> bool:
+    """True when unprivileged user+mount namespaces work here."""
+    try:
+        out = subprocess.run(
+            ["unshare", "--user", "--map-root-user", "--mount",
+             "true"], capture_output=True, timeout=10)
+        return out.returncode == 0
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def build_container_command(spec: Dict, inner_cmd: List[str],
+                            cwd: Optional[str] = None,
+                            shm_dir: str = "/dev/shm") -> List[str]:
+    """Wrap `inner_cmd` so it executes chrooted into the image rootfs
+    inside a private user+mount namespace."""
+    spec = validate_container_spec(spec)
+    rootfs = spec["rootfs"]
+    cwd = cwd or os.getcwd()
+    lines = ["set -e", f"R={shlex.quote(rootfs)}"]
+    if spec["bind_host_base"]:
+        for d in _BASE_DIRS:
+            lines.append(
+                f'[ -e /{d} ] && {{ mkdir -p "$R/{d}"; '
+                f'mount --rbind "/{d}" "$R/{d}"; }} || true')
+    # Runtime plumbing the worker needs regardless of the image: proc,
+    # dev (the shm object arena lives under /dev/shm), tmp (session
+    # dirs + logs), and the repo working directory.
+    for src in ("/proc", "/dev", "/tmp", cwd, *spec["binds"]):
+        dst = f'"$R"{shlex.quote(src)}'
+        lines.append(f"mkdir -p {dst}")
+        lines.append(f"mount --rbind {shlex.quote(src)} {dst}")
+    if shm_dir not in ("/dev/shm",):  # non-default arena location
+        lines.append(f'mkdir -p "$R"{shlex.quote(shm_dir)}')
+        lines.append(f'mount --rbind {shlex.quote(shm_dir)} '
+                     f'"$R"{shlex.quote(shm_dir)}')
+    inner = " ".join(shlex.quote(c) for c in inner_cmd)
+    lines.append(f'exec chroot "$R" /bin/sh -c '
+                 f'{shlex.quote(f"cd {shlex.quote(cwd)} && exec {inner}")}')
+    script = "\n".join(lines)
+    return ["unshare", "--user", "--map-root-user", "--mount", "--",
+            "/bin/sh", "-c", script]

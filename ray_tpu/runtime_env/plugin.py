@@ -224,6 +224,31 @@ class CondaPlugin(PipPlugin):
             self._validate([r.split("=")[0] for r in reqs])
 
 
+class ContainerPlugin(RuntimeEnvPlugin):
+    """Namespace containers (reference image_uri.py, podman-free).
+
+    Containerization is applied at worker SPAWN — the node manager
+    wraps the worker command in unshare+chroot before exec
+    (core/node_manager.py spawn_worker_process +
+    runtime_env/container.py) — so by apply() time this process is
+    already inside the image.  apply() just re-validates the spec and
+    records the marker env var for introspection."""
+
+    name = "container"
+    priority = 5
+
+    def apply(self, value, ctx, kv_call):
+        uri = (value or {}).get("image_uri", "") \
+            if isinstance(value, dict) else ""
+        if not uri.startswith("file://"):
+            raise ValueError(
+                "container.image_uri must be file:///path/to/rootfs")
+        # No isdir re-check: inside the chroot the image path need not
+        # be visible anymore.
+        ctx.env_vars.setdefault("RAY_TPU_CONTAINER_IMAGE",
+                                uri[len("file://"):])
+
+
 _PLUGINS: Dict[str, RuntimeEnvPlugin] = {}
 
 
@@ -232,7 +257,7 @@ def register_plugin(plugin: RuntimeEnvPlugin) -> None:
 
 
 for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
-           PipPlugin(), CondaPlugin()):
+           PipPlugin(), CondaPlugin(), ContainerPlugin()):
     register_plugin(_p)
 
 _IGNORED_KEYS = {"excludes"}  # consumed at packaging time

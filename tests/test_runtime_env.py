@@ -225,3 +225,44 @@ def test_pip_env_cache_is_content_keyed(tmp_path):
     plug.apply({"packages": ["tinywheel"], "wheel_dir": wheel_dir},
                ctx3, None)
     assert ctx3.py_paths != ctx1.py_paths  # wheel set changed the key
+
+
+def test_container_runtime_env(tmp_path):
+    """Namespace containers (reference image_uri.py): a task declaring
+    runtime_env={"container": ...} executes chrooted into the image
+    rootfs inside a private user+mount namespace — no podman/docker."""
+    from ray_tpu.runtime_env.container import container_available
+
+    if not container_available():
+        pytest.skip("unprivileged user+mount namespaces unavailable")
+
+    rootfs = tmp_path / "image"
+    rootfs.mkdir()
+    # The "image": host base dirs overlaid (FROM host) + one added file.
+    (rootfs / "container-marker.txt").write_text("in-container")
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(runtime_env={"container": {
+            "image_uri": f"file://{rootfs}", "bind_host_base": True}})
+        def probe():
+            import os as _os
+
+            return (_os.path.exists("/container-marker.txt"),
+                    open("/container-marker.txt").read(),
+                    _os.environ.get("RAY_TPU_CONTAINER_IMAGE", ""))
+
+        inside, marker, img = ray_tpu.get(probe.remote(), timeout=120)
+        assert inside and marker == "in-container"
+        assert img.endswith("image")
+
+        # A plain task (no container env) must NOT see the marker.
+        @ray_tpu.remote
+        def outside():
+            import os as _os
+
+            return _os.path.exists("/container-marker.txt")
+
+        assert ray_tpu.get(outside.remote(), timeout=60) is False
+    finally:
+        ray_tpu.shutdown()
