@@ -222,6 +222,9 @@ class ControlServer:
         self.session_dir = session_dir
         self.namespace = namespace
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        from ray_tpu.core.node_manager import prewarm_zygote
+
+        prewarm_zygote()  # worker template warms while the head boots
 
         self.lock = threading.RLock()
         self.objects: Dict[str, ObjectEntry] = {}

@@ -38,6 +38,39 @@ from ray_tpu.core.object_store import ShmObjectStore
 from ray_tpu.core.resources import node_resources_from_env
 
 
+def zygote_enabled() -> bool:
+    return os.environ.get("RAY_TPU_DISABLE_ZYGOTE", "") != "1"
+
+
+def cpu_worker_env(env: dict) -> dict:
+    """CPU-class worker environment, shared by exec spawns and the zygote
+    template so fork spawns stay environment-identical to exec spawns:
+    skip sitecustomize's jax/TPU grab (the `-S` interpreter needs
+    site-packages restored via PYTHONPATH), line-visible output, and the
+    pyarrow jemalloc guard (bundled jemalloc segfaults on this kernel)."""
+    from ray_tpu.core.gcs import _site_packages
+
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env.setdefault("ARROW_DEFAULT_MEMORY_POOL", "system")
+    extra = [p for p in (_site_packages(), env.get("PYTHONPATH")) if p]
+    if extra:
+        env["PYTHONPATH"] = os.pathsep.join(extra)
+    return env
+
+
+def prewarm_zygote() -> None:
+    """Start warming this process's worker template (no-op when disabled)."""
+    if not zygote_enabled():
+        return
+    try:
+        from ray_tpu.core.zygote import get_zygote
+
+        get_zygote().prewarm()
+    except Exception:
+        pass
+
+
 def spawn_worker_process(*, control_addr: str, worker_hex: str, kind: str,
                          env_key: str, namespace: str, node_id: str,
                          log_dir: str, session_id: str,
@@ -51,8 +84,6 @@ def spawn_worker_process(*, control_addr: str, worker_hex: str, kind: str,
     worker boots chrooted into the image rootfs inside a private
     user+mount namespace (runtime_env/container.py — the reference
     applies its podman prefix at the same point, worker_pool / image_uri)."""
-    from ray_tpu.core.gcs import _site_packages
-
     env = dict(os.environ)
     env["RAY_TPU_CONTROL_ADDR"] = control_addr
     env["RAY_TPU_WORKER_ID"] = worker_hex
@@ -68,21 +99,41 @@ def spawn_worker_process(*, control_addr: str, worker_hex: str, kind: str,
     if extra_env:
         env.update(extra_env)
     cmd = [sys.executable, "-m", "ray_tpu.core.worker"]
-    if env_key.startswith("tpu0") or not env_key.startswith("tpu"):
+    cpu_class = env_key.startswith("tpu0") or not env_key.startswith("tpu")
+    if cpu_class:
         # CPU-only worker: skip site init (sitecustomize imports jax).
-        env["JAX_PLATFORMS"] = "cpu"
-        extra = [p for p in (_site_packages(), env.get("PYTHONPATH")) if p]
-        if extra:
-            env["PYTHONPATH"] = os.pathsep.join(extra)
+        cpu_worker_env(env)
         cmd = [sys.executable, "-S", "-m", "ray_tpu.core.worker"]
+    os.makedirs(log_dir, exist_ok=True)
+    log_base = os.path.join(log_dir, f"worker-{worker_hex[:8]}")
+    # Fork-from-warm-template fast path (core/zygote.py): the common CPU
+    # worker class skips interpreter startup + imports entirely.  Exec
+    # paths remain for container envs (chroot wrapper), envs that swap
+    # package resolution (pip/conda/py_modules pins would be shadowed by
+    # the template's pre-imported modules in sys.modules), TPU workers
+    # (sitecustomize), and as the fallback whenever the template is cold
+    # (spawn() raises until the template answers a ping — a warming
+    # zygote must never add latency to a worker the scheduler waits on)
+    # or broken.
+    _zygote_safe_env_keys = {"env_vars", "working_dir", "excludes"}
+    if (cpu_class
+            and not (runtime_env
+                     and set(runtime_env) - _zygote_safe_env_keys)
+            and not (extra_env and "JAX_PLATFORMS" in extra_env)
+            and zygote_enabled()):
+        try:
+            from ray_tpu.core.zygote import get_zygote
+
+            return get_zygote().spawn(env=env, log_base=log_base,
+                                      cwd=os.getcwd())
+        except Exception:
+            pass
     if runtime_env and runtime_env.get("container"):
         from ray_tpu.runtime_env.container import build_container_command
 
         cmd = build_container_command(
             runtime_env["container"], cmd, cwd=os.getcwd(),
             shm_dir=get_config().shm_dir)
-    os.makedirs(log_dir, exist_ok=True)
-    log_base = os.path.join(log_dir, f"worker-{worker_hex[:8]}")
     stdout = open(log_base + ".out", "ab")
     stderr = open(log_base + ".err", "ab")
     return subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr,
@@ -116,6 +167,7 @@ class NodeManager:
         self._view_seq = -1
         self._view_epoch = ""
         self._view_at = 0.0
+        prewarm_zygote()  # template warms while the node registers
         self.server = rpc.Server(self._handle,
                                  host=self.config.node_ip_address)
         # Advertised (not bind) address: a 0.0.0.0 bind must not hand

@@ -992,6 +992,11 @@ class CoreClient:
                     if spec.return_ids and \
                             spec.return_ids[0].hex() == obj_hex:
                         del pool.queue[i]
+                        # No worker will ever _finish this spec: the
+                        # borrow decrefs are the owner's to issue, or
+                        # the args stay pinned for the session.
+                        for bhex in spec.borrows:
+                            self._queue_for_flush("decref", None, bhex)
                         self._fail_direct(obj_hex, TaskCancelledError(
                             f"task {spec.name or spec.task_id.hex()}: "
                             "task cancelled"))
@@ -999,7 +1004,50 @@ class CoreClient:
             ent = self._lease_of_obj.get(obj_hex)
         if ent is not None:
             if not force:
-                return False  # running; parity with the head path
+                # Dispatched, but possibly still QUEUED on the worker
+                # (pipelined behind a running task).  Ask the executor to
+                # drop it from its queue — the reference cancels here too
+                # (normal_scheduling_queue CancelTaskIfFound); only a
+                # task that already started is uncancellable sans force.
+                shape, whex, task_hex = ent
+                with self._lease_lock:
+                    pool = self._leases.get(shape)
+                    addr = pool.workers.get(whex) if pool else None
+                if addr is None:
+                    return False
+                # The spec may still sit in the coalescing send buffer —
+                # a direct .call() would overtake it on the socket and
+                # the worker would truthfully say "not queued".  Cancel
+                # it right out of the buffer when possible; flush
+                # otherwise so the queue scan sees it.
+                dropped = None
+                with self._send_lock:  # NB: never nest _lease_lock inside
+                    specs = self._pending_pool.get(addr, [])
+                    for i, s in enumerate(specs):
+                        if s.task_id is not None \
+                                and s.task_id.hex() == task_hex:
+                            del specs[i]
+                            self._pending_count -= 1
+                            dropped = s
+                            break
+                if dropped is not None:
+                    for bhex in dropped.borrows:  # no worker will _finish it
+                        self._queue_for_flush("decref", None, bhex)
+                    self._fail_direct(obj_hex, TaskCancelledError(
+                        "task cancelled"))
+                    return True
+                self._flush_direct_sends()
+                try:
+                    reply = self._actor_conn(addr).call(
+                        {"op": "cancel_pool_task", "task": task_hex},
+                        timeout=10.0)
+                except Exception:
+                    return False
+                if not (reply or {}).get("cancelled"):
+                    return False  # already executing
+                self._fail_direct(obj_hex, TaskCancelledError(
+                    "task cancelled"))
+                return True
             shape, whex, task_hex = ent
             with self._lease_lock:
                 pool = self._leases.get(shape)

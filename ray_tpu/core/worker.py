@@ -70,6 +70,7 @@ class WorkerRuntime:
         self._actor_is_async = False
         self._actor_hex: str = ""
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
+        self._cancelled_pool: set = set()  # task hexes cancelled while queued
         self._exec_pool: Optional[Any] = None
         self._aio_lock = threading.Lock()
         # Direct-result coalescing (see _push_direct_result).
@@ -234,6 +235,25 @@ class WorkerRuntime:
                 spec._arrival_conn = conn
                 self._on_execute_task(spec)
             return None
+        if op == "cancel_pool_task":
+            # Owner-initiated cancel of a dispatched-but-not-started
+            # task (reference normal_scheduling_queue CancelTaskIfFound):
+            # cancellable only while it still sits in the pool queue.
+            task_hex = msg.get("task")
+            q = getattr(self, "_pool_queue", None)
+            if q is not None:
+                # The add must happen under q.mutex: the executor's pop
+                # also takes it, so in-queue-while-marked guarantees the
+                # drain check sees the hex (no started-anyway race).
+                with q.mutex:
+                    found = any(
+                        s.task_id is not None
+                        and s.task_id.hex() == task_hex for s in q.queue)
+                    if found:
+                        self._cancelled_pool.add(task_hex)
+                if found:
+                    return {"cancelled": True}
+            return {"cancelled": False}
         if op == "ping":
             return "pong"
         raise ValueError(f"unknown direct op {op}")
@@ -605,6 +625,15 @@ class WorkerRuntime:
             try:
                 spec = q.get(timeout=0.2)
             except queue.Empty:
+                continue
+            th = spec.task_id.hex() if spec.task_id is not None else None
+            if th is not None and th in self._cancelled_pool:
+                # Owner cancelled it while queued: release borrows and
+                # report the terminal event, never run the body.  The
+                # owner already failed its future with
+                # TaskCancelledError (cancel_ref).
+                self._cancelled_pool.discard(th)
+                self._finish(spec, failed=True)
                 continue
             self._execute(spec)
 

@@ -1,0 +1,171 @@
+"""Worker zygote (core/zygote.py): fork-from-warm-template spawns.
+
+The reference amortizes worker startup with WorkerPool prestart
+(src/ray/raylet/worker_pool.h:159); the zygote goes further — workers
+fork from a pre-imported template, so spawn cost is milliseconds.  These
+tests pin the correctness properties the fast path must preserve:
+identical task/actor semantics, per-spawn env isolation, kill/death
+detection through the template, and no leaked children after shutdown.
+"""
+
+import os
+import signal
+import subprocess
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core.zygote import ZygoteProc, get_zygote
+
+
+@pytest.fixture
+def zcluster():
+    rt = ray_tpu.init(num_cpus=2, log_to_driver=False)
+    try:
+        yield rt
+    finally:
+        ray_tpu.shutdown()
+
+
+def _wait_ready(timeout=60.0):
+    h = get_zygote()
+    h.prewarm()
+    deadline = time.time() + timeout
+    while not h._ready and time.time() < deadline:
+        time.sleep(0.1)
+    assert h._ready, "zygote template never became ready"
+
+
+def test_zygote_spawn_and_semantics(zcluster):
+    """Once the template is warm, new workers are forks (ZygoteProc) and
+    run tasks/actors with full semantics."""
+    _wait_ready()
+
+    # Force fresh spawns with a distinct runtime env (new env_key -> new
+    # worker pool), so these workers are post-warm spawns.
+    @ray_tpu.remote(runtime_env={"env_vars": {"ZSPAWN": "1"}})
+    def probe():
+        import os
+
+        return (os.getpid(), os.environ.get("ZSPAWN"))
+
+    pid, flag = ray_tpu.get(probe.remote(), timeout=120)
+    assert flag == "1"
+    workers = [w for w in zcluster.control.workers.values()
+               if w.proc is not None and isinstance(w.proc, ZygoteProc)]
+    assert workers, "no worker was spawned via the zygote fast path"
+    assert pid in {w.proc.pid for w in workers}
+
+
+def test_zygote_env_isolation(zcluster):
+    """Two spawns with different env vars must not bleed into each other
+    (os.environ is rebuilt per fork)."""
+    _wait_ready()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ISO": "a"}})
+    def get_a():
+        import os
+
+        return os.environ.get("ISO")
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ISO": "b"}})
+    def get_b():
+        import os
+
+        return os.environ.get("ISO")
+
+    assert ray_tpu.get(get_a.remote(), timeout=120) == "a"
+    assert ray_tpu.get(get_b.remote(), timeout=120) == "b"
+
+
+def test_zygote_actor_kill_and_death_detection(zcluster):
+    """ray_tpu.kill routes through the template; death is detected."""
+    _wait_ready()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ZK": "1"}})
+    class A:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    a = A.options(num_cpus=0).remote()
+    pid = ray_tpu.get(a.pid.remote(), timeout=120)
+    ray_tpu.kill(a)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            os.kill(pid, 0)
+            time.sleep(0.2)
+        except ProcessLookupError:
+            break
+    else:
+        raise AssertionError("killed actor process still alive")
+    with pytest.raises(Exception):
+        ray_tpu.get(a.pid.remote(), timeout=30)
+
+
+def test_zygote_no_leaked_children():
+    """After shutdown, the template reports zero live children."""
+    rt = ray_tpu.init(num_cpus=2, log_to_driver=False)
+    _wait_ready()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ZL": "1"}})
+    def f():
+        return 1
+
+    assert ray_tpu.get([f.remote() for _ in range(4)], timeout=120) == [1] * 4
+    ray_tpu.shutdown()
+    h = get_zygote()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            reply = h._request({"op": "poll_all"})
+        except RuntimeError:
+            return  # template already gone: nothing to leak from
+        if not reply["alive"]:
+            return
+        time.sleep(0.5)
+    raise AssertionError(f"zygote still reports children: {reply['alive']}")
+
+
+def test_zygote_proc_poll_reports_exit(zcluster):
+    """ZygoteProc.poll() flips from None to an exit code when the child
+    dies outside the framework's own kill paths (e.g. OOM-killed)."""
+    _wait_ready()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ZP": "1"}})
+    class B:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    b = B.options(num_cpus=0).remote()
+    pid = ray_tpu.get(b.pid.remote(), timeout=120)
+    procs = [w.proc for w in zcluster.control.workers.values()
+             if w.proc is not None and getattr(w.proc, "pid", None) == pid]
+    assert procs and isinstance(procs[0], ZygoteProc)
+    assert procs[0].poll() is None
+    os.kill(pid, signal.SIGKILL)
+    deadline = time.time() + 30
+    while procs[0].poll() is None and time.time() < deadline:
+        time.sleep(0.2)
+    assert procs[0].poll() is not None
+
+
+def test_container_env_bypasses_zygote(zcluster, tmp_path):
+    """A container runtime env must take the exec path (chroot wrapper),
+    never the fork path."""
+    from ray_tpu.core.node_manager import spawn_worker_process
+    from ray_tpu.runtime_env.container import ContainerError
+
+    # The container path validates the image at spawn: reaching that
+    # validation (instead of a successful fork) proves the bypass.
+    with pytest.raises(ContainerError):
+        spawn_worker_process(
+            control_addr="127.0.0.1:1", worker_hex="f" * 32, kind="pool",
+            env_key="", namespace="", node_id="head",
+            log_dir=str(tmp_path), session_id="zygote-test",
+            runtime_env={"container": {"image_uri": "file:///nonexistent"}})
