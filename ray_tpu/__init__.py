@@ -9,7 +9,10 @@ from ray_tpu._version import __version__
 from ray_tpu.core.api import (
     available_resources,
     cancel,
+    client,
     register_named_function,
+    get_accelerator_ids,
+    get_gpu_ids,
     get_runtime_context,
     cluster_resources,
     get,
@@ -17,10 +20,12 @@ from ray_tpu.core.api import (
     init,
     is_initialized,
     kill,
+    nodes,
     put,
     method,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from ray_tpu.core.exceptions import (
@@ -55,6 +60,11 @@ __all__ = [
     "get_actor",
     "cluster_resources",
     "available_resources",
+    "nodes",
+    "timeline",
+    "client",
+    "get_accelerator_ids",
+    "get_gpu_ids",
     "ObjectRef",
     "RayTpuError",
     "TaskError",

@@ -176,3 +176,52 @@ def cluster_resources() -> dict:
 
 def available_resources() -> dict:
     return _runtime_mod.get_runtime().available_resources()
+
+
+def nodes() -> list:
+    """Cluster membership with resources and liveness (counterpart of
+    ray.nodes(), python/ray/_private/worker.py; served from the state
+    API's node table — on workers, from the locally synced view)."""
+    return _runtime_mod.get_runtime().state_list("nodes")
+
+
+def timeline(filename=None):
+    """Chrome-trace dump of task state transitions (counterpart of
+    ray.timeline(), python/ray/_private/state.py:434).  Returns the
+    event list; with ``filename`` also writes chrome://tracing JSON."""
+    from ray_tpu.util.timeline import timeline as _timeline
+
+    return _timeline(filename)
+
+
+def get_accelerator_ids() -> dict:
+    """Accelerator ids assigned to this worker, keyed by resource name
+    (counterpart of ray.get_runtime_context().get_accelerator_ids();
+    same TPU_VISIBLE_CHIPS/RAY_TPU_CHIPS parsing the scheduler's chip
+    detection uses — core/resources.py)."""
+    from ray_tpu.core.resources import visible_tpu_chip_ids
+
+    ids = visible_tpu_chip_ids()
+    return {"TPU": ids if ids is not None else []}
+
+
+def get_gpu_ids() -> list:
+    """Compat shim for ray.get_gpu_ids(): this framework schedules TPUs
+    (see get_accelerator_ids); GPU ids are always empty."""
+    return []
+
+
+def client(address: str = "auto"):
+    """Thin-client connection builder (counterpart of ray.client() /
+    ClientBuilder, python/ray/client_builder.py): returns a context
+    whose ``connect()``/``disconnect()`` manage a TCP-only runtime."""
+    from ray_tpu.util import client as _client
+
+    class _Builder:
+        def __init__(self, addr):
+            self._addr = addr
+
+        def connect(self):
+            return _client.connect(self._addr)
+
+    return _Builder(address)

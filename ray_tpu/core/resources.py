@@ -105,6 +105,21 @@ def node_resources_from_env(num_cpus=None, num_tpus=None, extra=None) -> Resourc
     return ResourceSet(amounts)
 
 
+def visible_tpu_chip_ids() -> Optional[list]:
+    """Chip ids assigned via env (TPU_VISIBLE_CHIPS / RAY_TPU_CHIPS),
+    None when no env override is present.  Single source of the parsing
+    shared by the scheduler (detect_tpu_chips) and the worker-facing
+    get_accelerator_ids()."""
+    import os
+
+    env = os.environ.get("TPU_VISIBLE_CHIPS") or os.environ.get("RAY_TPU_CHIPS")
+    if not env:
+        return None  # unset/empty: caller falls back to device probing
+    if env == "none":
+        return []
+    return [c for c in env.split(",") if c != ""]
+
+
 def detect_tpu_chips() -> int:
     """Count locally visible TPU chips without initializing a JAX backend.
 
@@ -115,11 +130,9 @@ def detect_tpu_chips() -> int:
     """
     import os
 
-    env = os.environ.get("TPU_VISIBLE_CHIPS") or os.environ.get("RAY_TPU_CHIPS")
-    if env:
-        if env in ("", "none"):
-            return 0
-        return len([c for c in env.split(",") if c != ""])
+    ids = visible_tpu_chip_ids()
+    if ids is not None:
+        return len(ids)
     # vfio / accel device nodes on TPU VMs
     for pattern_dir, prefix in (("/dev", "accel"), ("/dev/vfio", "")):
         try:

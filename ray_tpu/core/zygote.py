@@ -329,6 +329,13 @@ class ZygoteProc:
 
     def poll(self) -> Optional[int]:
         if self.returncode is None:
+            try:  # reaped-and-gone is detectable without any template IPC
+                os.kill(self.pid, 0)
+            except ProcessLookupError:
+                self.returncode = self._handle.exit_code(self.pid)
+                return self.returncode
+            except PermissionError:
+                pass
             self.returncode = self._handle.status(self.pid)
         return self.returncode
 
@@ -345,6 +352,9 @@ class ZygoteProc:
 
     def kill(self) -> None:
         self._handle.kill(self.pid, signal.SIGKILL)
+
+    def __repr__(self) -> str:
+        return f"<ZygoteProc pid={self.pid} returncode={self.returncode}>"
 
 
 class ZygoteHandle:
@@ -502,8 +512,18 @@ class ZygoteHandle:
             self._exited.pop(pid, None)  # pid reuse: drop stale exit record
         return ZygoteProc(self, pid)
 
+    def exit_code(self, pid: int) -> int:
+        """Recorded exit code for a pid known to be gone (-1 if the
+        template never reported one, e.g. it died before reaping)."""
+        with self._lock:
+            return self._exited.get(pid, -1)
+
     def status(self, pid: int) -> Optional[int]:
-        """Exit code if the worker has exited, else None (= running)."""
+        """Exit code if the worker has exited, else None (= running).
+        A transient template hiccup must NOT read as worker death — the
+        caller (ZygoteProc.poll) has already os.kill(pid, 0)-checked
+        that the process exists, so on template trouble we report
+        'running' and let the next poll retry."""
         now = time.time()
         with self._lock:
             if pid in self._exited:
@@ -514,9 +534,7 @@ class ZygoteHandle:
         try:
             reply = self._request({"op": "poll_all"})
         except RuntimeError:
-            # Template gone: every child it owned is unsupervised; report
-            # exited so sweeps clean up rather than waiting forever.
-            return self._exited.get(pid, -1)
+            return None  # process exists (caller checked); template flaky
         with self._lock:
             self._alive = set(reply["alive"])
             for p, code in reply["exited"].items():
@@ -527,16 +545,18 @@ class ZygoteHandle:
             self._polled_at = now
             if pid in self._exited:
                 return self._exited[pid]
-            return None if pid in self._alive else self._exited.get(pid, -1)
+            # Not this template's child (restarted template) but the
+            # process exists per the caller's os.kill check: running.
+            return None
 
     def kill(self, pid: int, sig: int) -> None:
+        # Direct signal: pids are host pids and several callers hold
+        # control-plane locks expecting Popen's non-blocking kill() —
+        # the template only REAPS (its waitpid loop collects the exit).
         try:
-            self._request({"op": "kill", "pid": pid, "sig": sig})
-        except RuntimeError:
-            try:  # template gone — children were reparented; kill directly
-                os.kill(pid, sig)
-            except ProcessLookupError:
-                pass
+            os.kill(pid, sig)
+        except ProcessLookupError:
+            pass
 
     def shutdown(self) -> None:
         with self._lock:

@@ -113,13 +113,22 @@ class MeshConfig:
 def build_mesh(config: Optional[MeshConfig] = None,
                devices: Optional[Sequence] = None,
                axes: Optional[Dict[str, int]] = None,
-               dcn_axes: Sequence[str] = ()):
+               dcn_axes: Sequence[str] = (),
+               n_slices: Optional[int] = None):
     """Create a `jax.sharding.Mesh` with named axes over the device topology.
 
     Uses `jax.experimental.mesh_utils.create_device_mesh` so the mesh axes map
     onto the physical ICI torus (nearest-neighbor rings per axis) instead of
     raw device enumeration order.  With `dcn_axes` and >1 slice, builds a
-    hybrid ICI+DCN mesh (`create_hybrid_device_mesh`).
+    hybrid ICI+DCN mesh: dcn axes iterate across slices (outermost, low
+    traffic) while every other axis stays within a slice's ICI — the
+    reference's NCCL inter-node / intra-node split, expressed as mesh
+    geometry (SURVEY.md §5 distributed-comm tier 3).
+
+    n_slices: virtual slice count for hosts whose devices carry no
+    slice_index (CPU meshes in tests / the driver dryrun): the flat device
+    list is split into that many contiguous groups, exercising the same
+    hybrid layout the real multi-slice path takes.
     """
     import jax
     from jax.experimental import mesh_utils
@@ -131,14 +140,17 @@ def build_mesh(config: Optional[MeshConfig] = None,
     sizes = config.sizes(len(devices))
     shape = tuple(sizes[a] for a in AXIS_ORDER)
 
-    n_slices = len({getattr(d, "slice_index", 0) for d in devices})
-    if config.dcn_axes and n_slices > 1:
-        dcn_shape = tuple(
-            sizes[a] if a in config.dcn_axes else 1 for a in AXIS_ORDER)
-        ici_shape = tuple(
-            1 if a in config.dcn_axes else sizes[a] for a in AXIS_ORDER)
-        dev_array = mesh_utils.create_hybrid_device_mesh(
-            ici_shape, dcn_shape, devices=devices)
+    slice_ids = sorted({getattr(d, "slice_index", 0) for d in devices})
+    if config.dcn_axes and (len(slice_ids) > 1 or (n_slices or 1) > 1):
+        if len(slice_ids) > 1:
+            groups = [[d for d in devices
+                       if getattr(d, "slice_index", 0) == s]
+                      for s in slice_ids]
+        else:
+            per = len(devices) // n_slices
+            groups = [devices[i * per:(i + 1) * per]
+                      for i in range(n_slices)]
+        dev_array = _hybrid_device_mesh(sizes, config.dcn_axes, groups)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
@@ -148,6 +160,47 @@ def build_mesh(config: Optional[MeshConfig] = None,
             # lost.
             dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, AXIS_ORDER)
+
+
+def _hybrid_device_mesh(sizes: Dict[str, int], dcn_axes: Tuple[str, ...],
+                        groups: Sequence[Sequence]) -> "np.ndarray":
+    """Assemble the hybrid layout: per-group ICI meshes (topology-aware),
+    stacked so each dcn coordinate addresses one slice group."""
+    from jax.experimental import mesh_utils
+
+    dcn_sizes = [sizes[a] for a in AXIS_ORDER if a in dcn_axes]
+    n_groups = math.prod(dcn_sizes) if dcn_sizes else 1
+    if n_groups != len(groups):
+        raise ValueError(
+            f"dcn axes {dcn_axes} require {n_groups} slices, have "
+            f"{len(groups)}")
+    group_size = len(groups[0])
+    if any(len(g) != group_size for g in groups):
+        raise ValueError("slices must be equally sized for a hybrid mesh")
+    ici_shape = tuple(
+        1 if a in dcn_axes else sizes[a] for a in AXIS_ORDER)
+    if math.prod(ici_shape) != group_size:
+        raise ValueError(
+            f"ICI shape {ici_shape} does not cover a {group_size}-device "
+            "slice")
+    ici_arrays = []
+    for g in groups:
+        try:
+            ici_arrays.append(
+                mesh_utils.create_device_mesh(ici_shape, devices=list(g)))
+        except (ValueError, AssertionError):
+            ici_arrays.append(np.asarray(list(g)).reshape(ici_shape))
+    # (G, *ici_shape) -> (*dcn_sizes, *ici_shape) -> interleave each dcn
+    # dim just before its axis's (size-1) ICI dim -> collapse pairwise.
+    full = np.stack(ici_arrays).reshape(*dcn_sizes, *ici_shape)
+    perm = []
+    dcn_order = [a for a in AXIS_ORDER if a in dcn_axes]
+    for j, a in enumerate(AXIS_ORDER):
+        if a in dcn_axes:
+            perm.append(dcn_order.index(a))
+        perm.append(len(dcn_order) + j)
+    final_shape = tuple(sizes[a] for a in AXIS_ORDER)
+    return full.transpose(perm).reshape(final_shape)
 
 
 def single_axis_mesh(axis: str = "data", devices: Optional[Sequence] = None):

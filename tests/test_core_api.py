@@ -141,3 +141,22 @@ def test_options_override(ray_start_regular):
 def test_cluster_resources(ray_start_regular):
     res = ray_tpu.cluster_resources()
     assert res["CPU"] == 4.0
+
+
+def test_public_api_surface(ray_start_regular):
+    """Top-level parity helpers (ray.nodes/timeline/get_gpu_ids/client —
+    python/ray/__init__.py __all__)."""
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    assert ray_tpu.get(one.remote()) == 1
+    ns = ray_tpu.nodes()
+    assert any(n["node_id"] == "head" and n["alive"] for n in ns)
+    events = ray_tpu.timeline()
+    assert isinstance(events, list) and events
+    assert set(ray_tpu.get_accelerator_ids()) == {"TPU"}
+    assert ray_tpu.get_gpu_ids() == []
+    builder = ray_tpu.client("127.0.0.1:1")
+    assert hasattr(builder, "connect")

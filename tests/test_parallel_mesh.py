@@ -66,3 +66,41 @@ def test_data_sharding_batch_axis():
     # each shard holds batch/8
     shard_shapes = {s.data.shape for s in y.addressable_shards}
     assert shard_shapes == {(1, 16)}
+
+
+def test_hybrid_dcn_mesh_virtual_slices():
+    """Hybrid ICI+DCN layout (reference tier-3 comm split, SURVEY §5):
+    each dcn coordinate addresses one slice group; other axes stay
+    within a slice; collectives compile across the dcn axis."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(axes={"data": 2, "fsdp": 4}, dcn_axes=("data",),
+                      n_slices=2)
+    arr = mesh.devices  # (data=2, stage, fsdp=4, 1, 1, 1)
+    g0 = {d.id for d in arr[0].flatten()}
+    g1 = {d.id for d in arr[1].flatten()}
+    assert g0 == {0, 1, 2, 3} and g1 == {4, 5, 6, 7}
+
+    x = jax.device_put(
+        jnp.arange(8.0), NamedSharding(mesh, P(("data", "fsdp"))))
+    f = jax.jit(jax.shard_map(
+        lambda v: jax.lax.psum(v, ("data",)), mesh=mesh,
+        in_specs=P(("data", "fsdp")), out_specs=P(("data", "fsdp"))))
+    y = np.asarray(f(x))
+    assert list(y[:4]) == [4.0, 6.0, 8.0, 10.0]
+
+
+def test_hybrid_dcn_mesh_shape_errors():
+    import pytest
+
+    from ray_tpu.parallel.mesh import build_mesh
+
+    with pytest.raises(ValueError):
+        # 4 slices wanted by dcn axis but only 2 virtual slices given
+        build_mesh(axes={"data": 4, "fsdp": 2}, dcn_axes=("data",),
+                   n_slices=2)

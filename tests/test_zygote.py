@@ -108,15 +108,18 @@ def test_zygote_actor_kill_and_death_detection(zcluster):
 
 def test_zygote_no_leaked_children():
     """After shutdown, the template reports zero live children."""
-    rt = ray_tpu.init(num_cpus=2, log_to_driver=False)
-    _wait_ready()
+    ray_tpu.init(num_cpus=2, log_to_driver=False)
+    try:
+        _wait_ready()
 
-    @ray_tpu.remote(runtime_env={"env_vars": {"ZL": "1"}})
-    def f():
-        return 1
+        @ray_tpu.remote(runtime_env={"env_vars": {"ZL": "1"}})
+        def f():
+            return 1
 
-    assert ray_tpu.get([f.remote() for _ in range(4)], timeout=120) == [1] * 4
-    ray_tpu.shutdown()
+        assert ray_tpu.get([f.remote() for _ in range(4)],
+                           timeout=120) == [1] * 4
+    finally:
+        ray_tpu.shutdown()
     h = get_zygote()
     deadline = time.time() + 30
     while time.time() < deadline:
