@@ -769,38 +769,61 @@ class ControlServer:
         return node.node_id if node is not None and node.store_key \
             else "head"
 
+    def _op_put_object_batch(self, conn, msg):
+        """A run of consecutive puts from one owner, registered under ONE
+        lock hold with one spill check and at most one scheduler wake
+        (the put-heavy loops in ray_perf made per-put head work the
+        dominant cost)."""
+        any_shm = False
+        with self.lock:
+            for item in msg["items"]:
+                self._put_object_locked(conn, item)
+                any_shm = any_shm or bool(item.get("in_shm"))
+        if any_shm:
+            self._maybe_spill()
+        if self.pending_tasks or self.pending_leases:
+            self._wake.set()
+
     def _op_put_object(self, conn, msg):
         with self.lock:
-            spec = msg.get("lineage")
-            if spec is not None:
-                # Owner-side lineage shipped with the object (lease-path
-                # tasks whose oversized result lands in shm: the head
-                # never saw the spec, but must be able to re-execute it
-                # if the copy is lost — reference: owner-held lineage,
-                # task_manager.h:208).
-                task_hex = spec.task_id.hex()
-                existing = self.tasks.get(task_hex)
-                if existing is None or not existing.spec.return_ids:
-                    # Replace the skeletal event-mirror record (if any):
-                    # only the full spec can be re-executed.
-                    self.tasks[task_hex] = TaskRecord(
-                        spec=spec, state="FINISHED",
-                        submitted_at=time.time(),
-                        finished_at=time.time())
-                self.lineage[msg["obj"]] = task_hex
-            self._store_object_locked(
-                msg["obj"],
-                inline=msg.get("inline"),
-                size=msg["size"],
-                is_error=msg.get("is_error", False),
-                in_shm=msg.get("in_shm", False),
-                node_id=self._store_node_for(conn),
-            )
+            self._put_object_locked(conn, msg)
         if msg.get("in_shm"):
             # Outside the lock: spilling does storage I/O that must not
             # stall the control plane.
             self._maybe_spill()
-        self._wake.set()
+        # Wake the scheduler only when something could be waiting on the
+        # arrival (a put with no queued work has nothing to unblock; the
+        # loop's 0.5 s timeout covers stragglers).
+        if self.pending_tasks or self.pending_leases:
+            self._wake.set()
+
+    def _put_object_locked(self, conn, msg):
+        """Lock held (both callers)."""
+        spec = msg.get("lineage")
+        if spec is not None:
+            # Owner-side lineage shipped with the object (lease-path
+            # tasks whose oversized result lands in shm: the head
+            # never saw the spec, but must be able to re-execute it
+            # if the copy is lost — reference: owner-held lineage,
+            # task_manager.h:208).
+            task_hex = spec.task_id.hex()
+            existing = self.tasks.get(task_hex)
+            if existing is None or not existing.spec.return_ids:
+                # Replace the skeletal event-mirror record (if any):
+                # only the full spec can be re-executed.
+                self.tasks[task_hex] = TaskRecord(
+                    spec=spec, state="FINISHED",
+                    submitted_at=time.time(),
+                    finished_at=time.time())
+            self.lineage[msg["obj"]] = task_hex
+        self._store_object_locked(
+            msg["obj"],
+            inline=msg.get("inline"),
+            size=msg["size"],
+            is_error=msg.get("is_error", False),
+            in_shm=msg.get("in_shm", False),
+            node_id=self._store_node_for(conn),
+        )
 
     # -- spilling ------------------------------------------------------
     def _maybe_spill(self):

@@ -1373,7 +1373,12 @@ class CoreClient:
         if buf is not None:
             buf.append(msg)
         else:
-            self.client.send(msg)
+            # Ride the ordered coalescing queue: consecutive puts collapse
+            # into one put_object_batch frame (head registers the run
+            # under one lock hold), and ordering against later submits
+            # that reference the object is preserved.  get()/wait()/
+            # direct sends flush first, so visibility is unchanged.
+            self._queue_for_flush("put", None, msg)
 
     def begin_put_batch(self):
         self._tls.put_buffer = []
@@ -1519,7 +1524,11 @@ class CoreClient:
                 if ser.total_bytes > self.config.max_inline_object_size:
                     ref = self.put(a)
                     borrows.append(ref.hex())
-                    self.client.send({"op": "incref", "obj": ref.hex()})
+                    # Same ordered queue as the put itself: a direct send
+                    # would reach the head BEFORE the buffered put_object
+                    # (no-op incref), and the temp ref's __del__ decref —
+                    # also queued — would then free the fresh object.
+                    self._queue_for_flush("incref", None, ref.hex())
                     out.append(TaskArg(is_ref=True, object_hex=ref.hex()))
                 else:
                     out.append(TaskArg(is_ref=False, data=ser.to_bytes()))
@@ -1927,6 +1936,9 @@ class CoreClient:
                 msg = {"op": "submit_task", "spec": run[0]} \
                     if len(run) == 1 else \
                     {"op": "submit_task_batch", "specs": run}
+            elif kind == "put":
+                msg = run[0] if len(run) == 1 else \
+                    {"op": "put_object_batch", "items": run}
             elif kind == "incref":
                 msg = {"op": "incref", "obj": run[0]} \
                     if len(run) == 1 else \

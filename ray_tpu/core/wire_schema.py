@@ -36,6 +36,7 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     # -- objects -------------------------------------------------------
     "put_object": {"obj": "str", "size": "int", "inline": "bytes?",
                    "in_shm": "bool?", "is_error": "bool?"},
+    "put_object_batch": {"items": "list"},
     "subscribe_objects": {"objs": "list", "grace": "bool?"},
     "subscribe_object": {"obj": "str", "grace": "bool?"},
     "fetch_object": {"obj": "str", "with_meta": "bool?"},

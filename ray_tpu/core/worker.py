@@ -89,7 +89,7 @@ class WorkerRuntime:
         # failed setup kills the worker with the error in its .err log
         # (reference: runtime-env agent failure fails the lease).
         renv = self.core.client.call({"op": "get_runtime_env",
-                                      "env_key": env_key})
+                                    "env_key": env_key})
         if renv:
             from ray_tpu.runtime_env.plugin import apply_runtime_env
 
@@ -326,6 +326,12 @@ class WorkerRuntime:
                 for item in value:
                     self.core._store_value(
                         stream_item_id(spec.task_id, count), item)
+                    # Streamed items must flow LIVE: puts normally ride
+                    # the coalescing queue, but a consumer is already
+                    # waiting on this item — and a crash between yields
+                    # (or user code calling os._exit) must not lose an
+                    # item the generator already produced.
+                    self.core._flush_direct_sends()
                     count += 1
             except BaseException as e:  # noqa: BLE001
                 err = TaskError(spec.name or spec.method_name, e)
@@ -334,6 +340,7 @@ class WorkerRuntime:
                     is_error=True)
                 count += 1
         self.core._store_value(stream_eos_id(spec.task_id), count)
+        self.core._flush_direct_sends()
 
     def _store_returns(self, spec: TaskSpec, value: Any, failed: bool):
         if spec.is_streaming:
@@ -401,6 +408,11 @@ class WorkerRuntime:
             self.core._store_serialized(
                 spec.return_ids[0], ser, is_error=failed,
                 lineage_spec=spec if spec.actor_id is None else None)
+            # The put rides the coalescing queue; the owner reacts to the
+            # push below INSTANTLY (subscribe, or a fire-and-forget
+            # __del__ decref) — the head must learn of the object first
+            # or that decref lands on nothing and the entry leaks.
+            self.core._flush_direct_sends()
             try:
                 conn.push({"op": "direct_result_remote", "obj": obj_hex})
             except Exception:
