@@ -39,6 +39,7 @@ from ray_tpu.core.exceptions import (
     TaskUnschedulableError,
     WorkerCrashedError,
 )
+from ray_tpu.core.logging_config import LoggingConfig
 from ray_tpu.core.object_ref import ObjectRef
 from ray_tpu import cross_lang
 
@@ -65,6 +66,7 @@ __all__ = [
     "client",
     "get_accelerator_ids",
     "get_gpu_ids",
+    "LoggingConfig",
     "ObjectRef",
     "RayTpuError",
     "TaskError",

@@ -26,17 +26,43 @@ def init(num_cpus: Optional[float] = None,
          address: Optional[str] = None,
          ignore_reinit_error: bool = True,
          log_to_driver: bool = True,
+         logging_config=None,
          _system_config: Optional[dict] = None) -> DriverRuntime:
     """Start the single-host runtime (control plane + worker pool), or —
     with ``address=`` — connect this driver to a running cluster
-    ("auto" resolves the address file written by ``ray-tpu start``)."""
+    ("auto" resolves the address file written by ``ray-tpu start``).
+
+    logging_config: a LoggingConfig applied to this driver and inherited
+    by workers this process spawns (core/logging_config.py).  In connect
+    mode (address=...) remote workers are spawned by the cluster's own
+    daemons and keep the config the cluster was started with."""
     rt = _runtime_mod._global_runtime
     if rt is not None and getattr(rt, "is_initialized", False):
         if ignore_reinit_error:
+            if logging_config is not None:
+                import logging as _logging
+
+                _logging.getLogger(__name__).warning(
+                    "init(logging_config=...) ignored: runtime already "
+                    "initialized (call shutdown() first)")
             return rt
         raise RayTpuError("ray_tpu.init() called twice")
     if address == "auto":
         address = _resolve_cluster_address()
+    if logging_config is not None:
+        from ray_tpu.core import logging_config as _lc
+
+        if address:
+            import logging as _logging
+
+            _logging.getLogger(__name__).warning(
+                "logging_config applies to this driver only: cluster "
+                "daemons at %s spawn workers with their own environment",
+                address)
+        _lc.apply(logging_config)
+        _lc.export_to_env(logging_config)
+        global _logging_config_exported
+        _logging_config_exported = True
     return DriverRuntime(
         num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
         namespace=namespace, address=address,
@@ -68,10 +94,21 @@ def is_initialized() -> bool:
     return rt is not None and getattr(rt, "is_initialized", False)
 
 
+_logging_config_exported = False
+
+
 def shutdown():
     rt = _runtime_mod._global_runtime
     if rt is not None and hasattr(rt, "shutdown"):
         rt.shutdown()
+    # Session config must not leak into the next init — but only pop what
+    # init() itself exported (a user-exported variable is theirs to keep).
+    global _logging_config_exported
+    if _logging_config_exported:
+        from ray_tpu.core import logging_config as _lc
+
+        _lc.export_to_env(None)
+        _logging_config_exported = False
 
 
 def remote(*args, **kwargs):

@@ -89,7 +89,7 @@ class WorkerRuntime:
         # failed setup kills the worker with the error in its .err log
         # (reference: runtime-env agent failure fails the lease).
         renv = self.core.client.call({"op": "get_runtime_env",
-                                    "env_key": env_key})
+                                      "env_key": env_key})
         if renv:
             from ray_tpu.runtime_env.plugin import apply_runtime_env
 
@@ -889,6 +889,9 @@ def main():
     import faulthandler
 
     faulthandler.enable()  # native-crash stacks land in the worker .err log
+    from ray_tpu.core.logging_config import apply_from_env
+
+    apply_from_env()  # session LoggingConfig (TEXT/JSON), if the driver set one
     control_addr = os.environ["RAY_TPU_CONTROL_ADDR"]
     worker_hex = os.environ["RAY_TPU_WORKER_ID"]
     kind = os.environ.get("RAY_TPU_WORKER_KIND", "pool")

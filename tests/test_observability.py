@@ -315,3 +315,67 @@ def test_profile_unknown_worker_errors():
 
     with _pytest.raises(Exception, match="no live worker"):
         profile_worker("ff" * 14)
+
+
+def test_logging_config_structured_workers():
+    """ray_tpu.LoggingConfig (counterpart of ray.LoggingConfig,
+    _private/ray_logging/): JSON encoding + level apply to the driver
+    and propagate to workers via the session environment."""
+    import json
+    import logging
+
+    import ray_tpu
+    from ray_tpu.core.logging_config import JsonFormatter, LoggingConfig
+
+    # Formatter unit: record -> one JSON object with context fields.
+    fmt = JsonFormatter(extra_attrs=("lineno",))
+    rec = logging.LogRecord("my.logger", logging.WARNING, __file__, 42,
+                            "boom %s", ("x",), None)
+    obj = json.loads(fmt.format(rec))
+    assert obj["levelname"] == "WARNING"
+    assert obj["name"] == "my.logger"
+    assert obj["message"] == "boom x"
+    assert obj["lineno"] == 42
+
+    with pytest.raises(ValueError):
+        LoggingConfig(encoding="YAML")
+
+    root = logging.getLogger()
+    prev_level = root.level
+    prev_formatters = [(h, h.formatter) for h in root.handlers]
+    ray_tpu.init(num_cpus=2, log_to_driver=False,
+                 logging_config=LoggingConfig(encoding="JSON",
+                                              log_level="DEBUG"))
+    try:
+        assert logging.getLogger().level == logging.DEBUG
+
+        @ray_tpu.remote
+        def probe():
+            import json as _json
+            import logging as _logging
+            import os as _os
+
+            root = _logging.getLogger()
+            h = root.handlers[0]
+            rec = _logging.LogRecord("w", _logging.INFO, "f", 1,
+                                     "from-worker", (), None)
+            return {
+                "level": root.level,
+                "formatted": h.formatter.format(rec),
+                "env": _os.environ.get("RAY_TPU_LOGGING_CONFIG", ""),
+            }
+
+        out = ray_tpu.get(probe.remote(), timeout=120)
+        assert out["level"] == logging.DEBUG
+        parsed = json.loads(out["formatted"])
+        assert parsed["message"] == "from-worker"
+        assert parsed.get("worker_id")  # executing-context join key
+        assert "JSON" in out["env"]
+    finally:
+        ray_tpu.shutdown()
+        import os
+
+        assert "RAY_TPU_LOGGING_CONFIG" not in os.environ
+        root.setLevel(prev_level)  # don't leak DEBUG into later tests
+        for h, f in prev_formatters:
+            h.setFormatter(f)
