@@ -100,13 +100,18 @@ def main():
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_layers=22, num_heads=16, num_kv_heads=4,
             max_seq_len=2048, remat=False)
+        # multi_step = max_new: the whole generation runs device-resident
+        # in one dispatch per wave (greedy bench has no per-token host
+        # decisions; latency-sensitive serving would use a smaller burst).
+        # The GQA KV pool covers batch 128 x 256-token contexts (2048 of
+        # 4096 pages) for the short shape.
         shapes = [
             dict(n_requests=128, prompt_len=128, max_new=128,
-                 page_size=16, num_pages=4096, max_batch=64,
-                 multi_step=32),
+                 page_size=16, num_pages=4096, max_batch=128,
+                 multi_step=128),
             dict(n_requests=64, prompt_len=128, max_new=512,
                  page_size=16, num_pages=4096, max_batch=64,
-                 multi_step=64),
+                 multi_step=512),
         ]
     else:
         config = tfm.TransformerConfig.tiny()
