@@ -172,3 +172,44 @@ def test_container_env_bypasses_zygote(zcluster, tmp_path):
             env_key="", namespace="", node_id="head",
             log_dir=str(tmp_path), session_id="zygote-test",
             runtime_env={"container": {"image_uri": "file:///nonexistent"}})
+
+
+def test_template_death_degrades_to_exec_spawns(zcluster):
+    """SIGKILL the template mid-session: existing workers keep running,
+    poll() does not false-report them dead, and NEW spawns take the
+    exec (Popen) fallback until the background re-warm."""
+    _wait_ready()
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"ZT": "1"}})
+    class A:
+        def ping(self):
+            return "alive"
+
+    a = A.options(num_cpus=0).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=120) == "alive"
+
+    h = get_zygote()
+    h._proc.kill()
+    h._proc.wait(timeout=10)
+
+    # Existing zygote-forked actor still serves calls, and repeated
+    # polls (sweeps run them every second) must not declare it dead.
+    for _ in range(5):
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == "alive"
+        time.sleep(0.3)
+
+    # A NEW pool (fresh env_key) forces fresh spawns post-template.
+    @ray_tpu.remote(runtime_env={"env_vars": {"ZT": "2"}})
+    def f():
+        import os
+
+        return os.getpid()
+
+    pid = ray_tpu.get(f.remote(), timeout=120)
+    # The spawn must have taken the exec path — a spawn that waited for
+    # the re-warmed template would reintroduce the startup-latency stall
+    # the fallback exists to prevent.
+    procs = [w.proc for w in zcluster.control.workers.values()
+             if w.proc is not None and getattr(w.proc, "pid", None) == pid]
+    assert procs and isinstance(procs[0], subprocess.Popen)
+    assert not isinstance(procs[0], ZygoteProc)
