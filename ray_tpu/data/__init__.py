@@ -22,6 +22,7 @@ from ray_tpu.data.dataset import (
     read_text,
     read_binary_files,
     read_images,
+    read_tfrecords,
     read_sql,
     from_torch,
     read_parquet,
@@ -51,6 +52,7 @@ __all__ = [
     "read_numpy",
     "read_text",
     "read_binary_files",
+    "read_tfrecords",
     "read_images",
     "read_sql",
     "from_torch",

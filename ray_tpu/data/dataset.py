@@ -324,6 +324,13 @@ class Dataset:
     def write_json(self, path: str) -> List[str]:
         return self._write(write_block_json, path)
 
+    def write_tfrecords(self, path: str) -> List[str]:
+        """tf.train.Example files readable by TensorFlow (and
+        read_tfrecords); no tensorflow needed (data/tfrecords.py)."""
+        from ray_tpu.data.datasource import write_block_tfrecords
+
+        return self._write(write_block_tfrecords, path)
+
     def to_pandas(self):
         return concat_blocks(
             list(self.iter_internal_blocks())).to_pandas()
@@ -619,6 +626,18 @@ def read_text(paths, *, encoding: str = "utf-8",
     return read_datasource(
         TextDatasource(paths, encoding=encoding,
                        drop_empty_lines=drop_empty_lines),
+        parallelism=parallelism)
+
+
+def read_tfrecords(paths, *, validate_crc: bool = False,
+                   parallelism: int = -1) -> Dataset:
+    """One row per tf.train.Example record; columns from feature names
+    (reference read_api.read_tfrecords — parsed without tensorflow,
+    data/tfrecords.py)."""
+    from ray_tpu.data.datasource import TFRecordDatasource
+
+    return read_datasource(
+        TFRecordDatasource(paths, validate_crc=validate_crc),
         parallelism=parallelism)
 
 

@@ -439,3 +439,68 @@ def _json_safe(obj):
     if isinstance(obj, np.generic):
         return obj.item()
     return obj
+
+
+class TFRecordDatasource(FileDatasource):
+    """tf.train.Example records without tensorflow (data/tfrecords.py;
+    reference read_api.read_tfrecords)."""
+
+    def __init__(self, paths, *, validate_crc: bool = False,
+                 batch_rows: int = 4096):
+        super().__init__(paths)
+        self._validate_crc = validate_crc
+        self._batch_rows = batch_rows
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        from ray_tpu.data import tfrecords as tfr
+
+        rows: List[dict] = []
+        for payload in tfr.read_records(path,
+                                        validate_crc=self._validate_crc):
+            rows.append(tfr.parse_example(payload))
+            if len(rows) >= self._batch_rows:
+                yield _rows_to_block(rows)
+                rows = []
+        if rows:
+            yield _rows_to_block(rows)
+
+
+def _rows_to_block(rows: List[dict]) -> Block:
+    cols: Dict[str, list] = {}
+    for r in rows:
+        for k in r:
+            cols.setdefault(k, [])
+    for r in rows:
+        for k, vals in cols.items():
+            vals.append(r.get(k))
+    # Natural arrow columns (ints/floats/bytes/lists-of-scalars map to
+    # int64/double/binary/list<>); only genuinely ragged/mixed columns
+    # fall back to the tensor encoding via per-row ndarrays.
+    arrays = {}
+    for k, vals in cols.items():
+        try:
+            arrays[k] = pa.array(vals)
+        except (pa.ArrowInvalid, pa.ArrowTypeError, pa.ArrowNotImplementedError):
+            # np.asarray(list-of-ndarrays) collapses same-shape rows
+            # into one 2-D array (see block.py _list_to_column);
+            # element-wise fill keeps one ndarray per row.
+            col = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                col[i] = np.asarray(v)
+            arrays[k] = col
+    return batch_to_block(arrays)
+
+
+def write_block_tfrecords(block: Block, path: str, index: int) -> str:
+    from ray_tpu.data import tfrecords as tfr
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.tfrecords")
+    # Row iteration through the accessor: tensor-encoded columns decode
+    # to per-row ndarrays (a raw arrow to_pylist would hand back the
+    # encoding structs).
+    tfr.write_records(
+        out, (tfr.encode_example(row)
+              for row in BlockAccessor(block).iter_rows()))
+    return out

@@ -1,0 +1,282 @@
+"""TFRecord read/write without TensorFlow (reference read_api.read_tfrecords
+/ Dataset.write_tfrecords, python/ray/data/_internal/datasource/tfrecords_*).
+
+TFRecord framing (the TensorFlow on-disk format):
+
+    [8-byte LE length][4-byte masked crc32c(length)]
+    [payload bytes]   [4-byte masked crc32c(payload)]
+
+Payloads are serialized ``tf.train.Example`` protos.  The image has no
+tensorflow/protobuf-generated bindings, so both the record framing and
+the Example message are handled directly: crc32c (Castagnoli) via a
+software table, and Example's three-level proto shape —
+
+    Example       { 1: Features }
+    Features      { 1: map<string, Feature> }
+    Feature       { 1: BytesList | 2: FloatList | 3: Int64List }
+    BytesList     { 1: repeated bytes }
+    FloatList     { 1: repeated float  (packed) }
+    Int64List     { 1: repeated int64  (packed varint) }
+
+— encoded/parsed with the plain protobuf wire rules (varint keys,
+length-delimited submessages).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterator, List
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# crc32c (Castagnoli) + TFRecord masking
+# ---------------------------------------------------------------------------
+
+_CRC_TABLE: List[int] = []
+
+
+def _crc_table() -> List[int]:
+    global _CRC_TABLE
+    if not _CRC_TABLE:
+        poly = 0x82F63B78  # reflected Castagnoli
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# Record framing
+# ---------------------------------------------------------------------------
+
+
+def read_records(path: str, *, validate_crc: bool = False
+                 ) -> Iterator[bytes]:
+    """Yield raw record payloads.  CRC validation is opt-in: the software
+    crc32c is Python-speed (~tens of MB/s); framing errors still raise
+    either way because lengths stop lining up."""
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(12)
+            if not hdr:
+                return
+            if len(hdr) < 12:
+                raise ValueError(f"{path}: truncated record header")
+            (length,) = struct.unpack("<Q", hdr[:8])
+            if validate_crc:
+                (got,) = struct.unpack("<I", hdr[8:12])
+                if got != _masked_crc(hdr[:8]):
+                    raise ValueError(f"{path}: length crc mismatch")
+            payload = f.read(length)
+            if len(payload) < length:
+                raise ValueError(f"{path}: truncated record payload")
+            tail = f.read(4)
+            if len(tail) < 4:
+                raise ValueError(f"{path}: truncated payload crc")
+            if validate_crc:
+                (got,) = struct.unpack("<I", tail)
+                if got != _masked_crc(payload):
+                    raise ValueError(f"{path}: payload crc mismatch")
+            yield payload
+
+
+def write_records(path: str, payloads) -> int:
+    n = 0
+    with open(path, "wb") as f:
+        for p in payloads:
+            hdr = struct.pack("<Q", len(p))
+            f.write(hdr)
+            f.write(struct.pack("<I", _masked_crc(hdr)))
+            f.write(p)
+            f.write(struct.pack("<I", _masked_crc(p)))
+            n += 1
+    return n
+
+
+# ---------------------------------------------------------------------------
+# Protobuf wire primitives
+# ---------------------------------------------------------------------------
+
+
+def _write_varint(out: bytearray, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(data: memoryview, off: int):
+    result = shift = 0
+    while True:
+        b = data[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, off
+        shift += 7
+
+
+def _write_field(out: bytearray, number: int, payload: bytes) -> None:
+    _write_varint(out, number << 3 | 2)  # wire type 2: length-delimited
+    _write_varint(out, len(payload))
+    out.extend(payload)
+
+
+def _iter_fields(data: memoryview):
+    """Yield (field_number, wire_type, value, next_offset) triples."""
+    off = 0
+    n = len(data)
+    while off < n:
+        key, off = _read_varint(data, off)
+        number, wire = key >> 3, key & 7
+        if wire == 2:
+            length, off = _read_varint(data, off)
+            yield number, wire, data[off:off + length]
+            off += length
+        elif wire == 0:
+            v, off = _read_varint(data, off)
+            yield number, wire, v
+        elif wire == 5:
+            yield number, wire, data[off:off + 4]
+            off += 4
+        elif wire == 1:
+            yield number, wire, data[off:off + 8]
+            off += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+# ---------------------------------------------------------------------------
+# tf.train.Example encode / parse
+# ---------------------------------------------------------------------------
+
+
+def encode_example(row: Dict[str, Any]) -> bytes:
+    """Encode one row.  int -> Int64List, float -> FloatList, bytes/str
+    -> BytesList; lists/arrays of those encode as multi-value lists."""
+    features = bytearray()
+    for name, value in row.items():
+        feature = bytearray()
+        vals = value
+        if isinstance(value, np.ndarray):
+            vals = value.tolist()
+        if not isinstance(vals, (list, tuple)):
+            vals = [vals]
+        if any(v is None for v in vals):
+            raise ValueError(
+                f"feature {name!r}: tf.train.Example has no null type "
+                "(fill or drop missing values before write_tfrecords)")
+        if vals and all(isinstance(v, (bool, int, np.integer))
+                        for v in vals):
+            packed = bytearray()
+            for v in vals:
+                v = int(v)
+                if not -(1 << 63) <= v < 1 << 63:
+                    raise OverflowError(
+                        f"feature {name!r}: {v} does not fit int64")
+                _write_varint(packed, v & 0xFFFFFFFFFFFFFFFF)
+            lst = bytearray()
+            _write_field(lst, 1, bytes(packed))
+            _write_field(feature, 3, bytes(lst))  # Int64List
+        elif vals and all(isinstance(v, (float, np.floating))
+                          for v in vals):
+            lst = bytearray()
+            _write_field(lst, 1, struct.pack(f"<{len(vals)}f", *vals))
+            _write_field(feature, 2, bytes(lst))  # FloatList
+        elif all(isinstance(v, (bytes, bytearray, str)) for v in vals):
+            lst = bytearray()
+            for v in vals:
+                if isinstance(v, str):
+                    v = v.encode()
+                _write_field(lst, 1, bytes(v))
+            _write_field(feature, 1, bytes(lst))  # BytesList
+        else:
+            raise TypeError(
+                f"feature {name!r}: values must be uniformly int, float, "
+                f"or bytes/str — got {sorted({type(v).__name__ for v in vals})}")
+        entry = bytearray()  # map<string, Feature> entry
+        _write_field(entry, 1, name.encode())
+        _write_field(entry, 2, bytes(feature))
+        _write_field(features, 1, bytes(entry))
+    example = bytearray()
+    _write_field(example, 1, bytes(features))
+    return bytes(example)
+
+
+def _parse_feature(data: memoryview):
+    for number, _wire, val in _iter_fields(data):
+        if number == 1:  # BytesList
+            return [bytes(v) for _n, _w, v in _iter_fields(val) if _n == 1]
+        if number == 2:  # FloatList (packed or repeated fixed32)
+            out: List[float] = []
+            for _n, _w, v in _iter_fields(val):
+                if _n != 1:
+                    continue
+                if _w == 2:
+                    out.extend(struct.unpack(f"<{len(v) // 4}f", bytes(v)))
+                elif _w == 5:
+                    out.append(struct.unpack("<f", bytes(v))[0])
+            return out
+        if number == 3:  # Int64List (packed or repeated varint)
+            out = []
+            for _n, _w, v in _iter_fields(val):
+                if _n != 1:
+                    continue
+                if _w == 2:
+                    off = 0
+                    while off < len(v):
+                        u, off = _read_varint(v, off)
+                        if u >= 1 << 63:
+                            u -= 1 << 64  # two's complement
+                        out.append(u)
+                elif _w == 0:
+                    out.append(v if v < 1 << 63 else v - (1 << 64))
+            return out
+    return []
+
+
+def parse_example(payload: bytes) -> Dict[str, Any]:
+    """Parse one Example.  Single-value lists unwrap to scalars (the
+    reference's tfrecord reader does the same)."""
+    row: Dict[str, Any] = {}
+    for number, _wire, features in _iter_fields(memoryview(payload)):
+        if number != 1:
+            continue
+        for fnum, _fw, entry in _iter_fields(features):
+            if fnum != 1:
+                continue
+            name = None
+            feature_vals: Any = []
+            for enum_, _ew, v in _iter_fields(entry):
+                if enum_ == 1:
+                    name = bytes(v).decode()
+                elif enum_ == 2:
+                    feature_vals = _parse_feature(v)
+            if name is not None:
+                if isinstance(feature_vals, list) \
+                        and len(feature_vals) == 1:
+                    feature_vals = feature_vals[0]
+                row[name] = feature_vals
+    return row
