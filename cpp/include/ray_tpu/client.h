@@ -20,12 +20,17 @@
 #pragma once
 
 #include <arpa/inet.h>
+#include <dlfcn.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/tcp.h>
+#include <sys/mman.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <unistd.h>
 
 #include <cctype>
+#include <cerrno>
 #include <cstdint>
 #include <ctime>
 #include <cstring>
@@ -388,6 +393,12 @@ class Client {
   Json ListTasks() { return Call("{\"op\":\"list_tasks\"}"); }
   Json ListNodes() { return Call("{\"op\":\"list_nodes\"}"); }
 
+  // Ask where (and whether) an object can be mapped zero-copy on this
+  // host: {"in_shm": bool, "arena": path, "lib": path, "size": N}.
+  Json ObjectShmInfo(const std::string& obj_hex) {
+    return Call("{\"op\":\"object_shm_info\",\"obj\":\"" + obj_hex + "\"}");
+  }
+
  private:
   static std::string RandomHex(int n) {
     // Process-wide generator, seeded once from the OS: two Clients in
@@ -444,6 +455,167 @@ class Client {
   std::string worker_hex_;
   std::string session_id_;
   std::vector<std::string> pending_pushes_;
+};
+
+// ---------------------------------------------------------------------------
+// Zero-copy object reads from the node arena (src/store/tpustore.cc).
+//
+// Counterpart of the reference plasma C++ client attach path
+// (object_manager/plasma/): a same-host native process maps the arena
+// file read-only and pins sealed objects via the store library's C API
+// instead of proxying payloads through the control server.  Use
+// Client::ObjectShmInfo to discover the arena + library paths, then:
+//
+//   ray::tpu::ShmReader r(info.at("lib").str, info.at("arena").str);
+//   ray::tpu::ShmReader::View v = r.Get(obj_hex);   // pins
+//   ... v.data / v.size: the serialized object envelope ...
+//   r.Release(obj_hex);                             // unpins
+// ---------------------------------------------------------------------------
+class ShmReader {
+ public:
+  struct View {
+    const uint8_t* data = nullptr;
+    uint64_t size = 0;
+    // Non-empty when the pin table was full and the object was copied
+    // out instead (tps_read fallback); data then points here and no
+    // Release is needed.
+    std::vector<uint8_t> owned;
+    bool pinned() const { return owned.empty(); }
+  };
+
+  ShmReader(const std::string& lib_path, const std::string& arena_path) {
+    // A throwing constructor never runs the destructor: every failure
+    // path below must unwind what already succeeded by hand.
+    try {
+      lib_ = ::dlopen(lib_path.c_str(), RTLD_NOW | RTLD_LOCAL);
+      if (!lib_)
+        throw std::runtime_error(std::string("dlopen: ") + dlerror());
+      tps_open_ = reinterpret_cast<OpenFn>(::dlsym(lib_, "tps_open"));
+      tps_close_ = reinterpret_cast<CloseFn>(::dlsym(lib_, "tps_close"));
+      tps_get_ = reinterpret_cast<GetFn>(::dlsym(lib_, "tps_get"));
+      tps_release_ = reinterpret_cast<RelFn>(::dlsym(lib_, "tps_release"));
+      tps_read_ = reinterpret_cast<ReadFn>(::dlsym(lib_, "tps_read"));
+      if (!tps_open_ || !tps_close_ || !tps_get_ || !tps_release_ ||
+          !tps_read_)
+        throw std::runtime_error("store library missing tps_* symbols");
+      handle_ = tps_open_(arena_path.c_str(), 0, 0);
+      if (!handle_)
+        throw std::runtime_error("tps_open: " +
+                                 std::string(strerror(errno)));
+      // Own read-only mapping for the data plane; the handle is only the
+      // pin/metadata channel.
+      int fd = ::open(arena_path.c_str(), O_RDONLY);
+      if (fd < 0)
+        throw std::runtime_error("open arena: " +
+                                 std::string(strerror(errno)));
+      struct stat st;
+      if (::fstat(fd, &st) != 0) {
+        ::close(fd);
+        throw std::runtime_error("fstat arena failed");
+      }
+      map_size_ = static_cast<size_t>(st.st_size);
+      void* m = ::mmap(nullptr, map_size_, PROT_READ, MAP_SHARED, fd, 0);
+      ::close(fd);
+      if (m == MAP_FAILED) throw std::runtime_error("mmap arena failed");
+      base_ = static_cast<const uint8_t*>(m);
+    } catch (...) {
+      Cleanup();
+      throw;
+    }
+  }
+
+  ~ShmReader() { Cleanup(); }
+  ShmReader(const ShmReader&) = delete;
+  ShmReader& operator=(const ShmReader&) = delete;
+
+  // Pin + map a sealed object; the View aliases the arena until
+  // Release (or owns a copy when the pin table was full — EBUSY is the
+  // store's documented "use the locked-copy path" answer, tps_read).
+  View Get(const std::string& obj_hex) {
+    uint8_t id[kIdLen] = {0};
+    HexToId(obj_hex, id);
+    uint64_t off = 0, size = 0;
+    int rc = tps_get_(handle_, id, &off, &size);
+    if (rc == 0) {
+      View v;
+      v.data = base_ + off;
+      v.size = size;
+      return v;
+    }
+    if (rc == -ENOENT)
+      throw std::runtime_error("object not in arena: " + obj_hex);
+    if (rc == -EBUSY) {  // pin slots exhausted: copy out instead
+      View v;
+      v.owned.resize(1 << 20);
+      while (true) {
+        int64_t n = tps_read_(handle_, id, v.owned.data(), v.owned.size());
+        if (n == -ERANGE) {  // buffer too small: grow and retry
+          v.owned.resize(v.owned.size() * 8);
+          continue;
+        }
+        if (n < 0)
+          throw std::runtime_error("tps_read failed rc=" + std::to_string(n));
+        v.owned.resize(static_cast<size_t>(n));
+        v.data = v.owned.data();
+        v.size = static_cast<uint64_t>(n);
+        return v;
+      }
+    }
+    throw std::runtime_error("tps_get failed rc=" + std::to_string(rc));
+  }
+
+  void Release(const std::string& obj_hex) {
+    uint8_t id[kIdLen] = {0};
+    HexToId(obj_hex, id);
+    tps_release_(handle_, id);
+  }
+
+ private:
+  static constexpr int kIdLen = 20;  // tpustore.cc kIdLen (ids zero-padded)
+
+  void Cleanup() {
+    if (base_) {
+      ::munmap(const_cast<uint8_t*>(base_), map_size_);
+      base_ = nullptr;
+    }
+    if (handle_) {
+      tps_close_(handle_);
+      handle_ = nullptr;
+    }
+    if (lib_) {
+      ::dlclose(lib_);
+      lib_ = nullptr;
+    }
+  }
+
+  static void HexToId(const std::string& hex, uint8_t* id) {
+    if (hex.size() / 2 > kIdLen || hex.size() % 2 != 0)
+      throw std::runtime_error("bad object hex: " + hex);
+    auto nib = [](char c) -> int {
+      if (c >= '0' && c <= '9') return c - '0';
+      if (c >= 'a' && c <= 'f') return c - 'a' + 10;
+      if (c >= 'A' && c <= 'F') return c - 'A' + 10;
+      throw std::runtime_error("bad hex digit");
+    };
+    for (size_t i = 0; i + 1 < hex.size(); i += 2)
+      id[i / 2] = static_cast<uint8_t>(nib(hex[i]) << 4 | nib(hex[i + 1]));
+  }
+
+  using OpenFn = void* (*)(const char*, uint64_t, int);
+  using CloseFn = void (*)(void*);
+  using GetFn = int (*)(void*, const uint8_t*, uint64_t*, uint64_t*);
+  using RelFn = int (*)(void*, const uint8_t*);
+  using ReadFn = int64_t (*)(void*, const uint8_t*, uint8_t*, uint64_t);
+
+  void* lib_ = nullptr;
+  void* handle_ = nullptr;
+  const uint8_t* base_ = nullptr;
+  size_t map_size_ = 0;
+  OpenFn tps_open_ = nullptr;
+  CloseFn tps_close_ = nullptr;
+  GetFn tps_get_ = nullptr;
+  RelFn tps_release_ = nullptr;
+  ReadFn tps_read_ = nullptr;
 };
 
 }  // namespace tpu

@@ -1,0 +1,41 @@
+// Zero-copy object read from the node arena (ShmReader, client.h).
+//
+// Build:  make ray_tpu_shm_example   (needs -ldl)
+// Run:    ./ray_tpu_shm_example <control-address> <object-hex>
+//
+// Asks the control server where the object can be mapped
+// (object_shm_info), attaches the arena through the store library, pins
+// the object, and prints "<size> <checksum>" where checksum is the
+// 64-bit wrapping byte sum of the serialized envelope — the Python test
+// computes the same pair over its own serialize() output
+// (tests/test_cpp_client.py).
+
+#include <cinttypes>
+#include <cstdio>
+
+#include "ray_tpu/client.h"
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s host:port object-hex\n", argv[0]);
+    return 2;
+  }
+  try {
+    ray::tpu::Client client(argv[1]);
+    ray::tpu::Json info = client.ObjectShmInfo(argv[2]);
+    if (!info.at("in_shm").boolean) {
+      std::fprintf(stderr, "object not mappable on this host\n");
+      return 3;
+    }
+    ray::tpu::ShmReader reader(info.at("lib").str, info.at("arena").str);
+    ray::tpu::ShmReader::View v = reader.Get(argv[2]);
+    uint64_t sum = 0;
+    for (uint64_t i = 0; i < v.size; i++) sum += v.data[i];
+    std::printf("%" PRIu64 " %" PRIu64 "\n", v.size, sum);
+    if (v.pinned()) reader.Release(argv[2]);
+    return 0;
+  } catch (const std::exception& e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

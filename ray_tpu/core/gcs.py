@@ -3192,6 +3192,35 @@ class ControlServer:
         self._wake.set()
         return True
 
+    def _op_object_shm_info(self, conn, msg):
+        """Where a same-host native client can map an object zero-copy
+        (the reference's plasma C++ client attach path: cpp frontends
+        read sealed objects straight from the arena instead of proxying
+        payloads through the server — object_manager/plasma/).  Replies
+        with the head arena + store library paths only when the object's
+        authoritative copy lives in the head arena; everything else is
+        "not mappable here" and callers fall back to fetch_object."""
+        obj_hex = msg["obj"]
+        with self.lock:
+            entry = self.objects.get(obj_hex)
+            if entry is None or entry.state not in (READY, ERRORED) \
+                    or not entry.in_shm or entry.spilled_uri is not None \
+                    or entry.node_id != "head":
+                return {"in_shm": False}
+            size = entry.size
+            is_error = entry.is_error
+        arena = getattr(self.store, "_arena", None)
+        if arena is None:
+            return {"in_shm": False}  # file-per-object fallback store
+        from ray_tpu.native.store import library_path
+
+        try:
+            lib = library_path()
+        except Exception:
+            lib = ""
+        return {"in_shm": True, "arena": arena.path, "lib": lib,
+                "size": size, "is_error": is_error}
+
     def _op_fetch_object(self, conn, msg):
         """Read an object's payload server-side for thin clients (no shm
         attachment — reference Ray Client server proxy role). Shm reads

@@ -49,6 +49,7 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     "free_objects": {"objs": "list"},
     "forget_object": {"obj": "str"},
     "object_replica": {"obj": "str"},
+    "object_shm_info": {"obj": "str"},
     "report_object_lost": {"obj": "str"},
     # -- tasks ---------------------------------------------------------
     "submit_task": {"spec": "any"},

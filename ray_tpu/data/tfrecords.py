@@ -153,17 +153,20 @@ def _iter_fields(data: memoryview):
         number, wire = key >> 3, key & 7
         if wire == 2:
             length, off = _read_varint(data, off)
+            if off + length > n:  # slicing would silently clip
+                raise ValueError(
+                    f"field {number}: length {length} overruns buffer")
             yield number, wire, data[off:off + length]
             off += length
         elif wire == 0:
             v, off = _read_varint(data, off)
             yield number, wire, v
-        elif wire == 5:
-            yield number, wire, data[off:off + 4]
-            off += 4
-        elif wire == 1:
-            yield number, wire, data[off:off + 8]
-            off += 8
+        elif wire in (5, 1):
+            width = 4 if wire == 5 else 8
+            if off + width > n:
+                raise ValueError(f"field {number}: truncated fixed{width * 8}")
+            yield number, wire, data[off:off + width]
+            off += width
         else:
             raise ValueError(f"unsupported wire type {wire}")
 

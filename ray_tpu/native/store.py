@@ -27,6 +27,12 @@ _SRC = os.path.join(
 _lib = None
 
 
+def library_path() -> str:
+    """Filesystem path of the built store library (native C++ clients
+    dlopen it to attach the arena — cpp/include/ray_tpu/client.h)."""
+    return build_library("tpustore", source=_SRC)
+
+
 def load_library():
     global _lib
     if _lib is not None:
