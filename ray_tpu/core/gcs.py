@@ -3212,12 +3212,14 @@ class ControlServer:
         arena = getattr(self.store, "_arena", None)
         if arena is None:
             return {"in_shm": False}  # file-per-object fallback store
-        from ray_tpu.native.store import library_path
-
         try:
+            from ray_tpu.native.store import library_path
+
             lib = library_path()
         except Exception:
-            lib = ""
+            # No loadable store library -> the client cannot attach;
+            # answer "not mappable" so it falls back to fetch_object.
+            return {"in_shm": False}
         return {"in_shm": True, "arena": arena.path, "lib": lib,
                 "size": size, "is_error": is_error}
 

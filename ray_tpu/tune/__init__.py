@@ -22,6 +22,7 @@ from ray_tpu.tune.searchers import (
 from ray_tpu.tune.search import (
     BasicVariantGenerator,
     ConcurrencyLimiter,
+    BOHBSearcher,
     TPESearcher,
     SearchAlgorithm,
     choice,
@@ -62,7 +63,7 @@ __all__ = [
     "randn",
     "sample_from",
     "SearchAlgorithm",
-    "BasicVariantGenerator", "TPESearcher", "ConcurrencyLimiter",
+    "BasicVariantGenerator", "TPESearcher", "BOHBSearcher", "ConcurrencyLimiter",
     "Searcher", "OptunaSearch", "as_search_algorithm",
     "TrialScheduler",
     "FIFOScheduler",

@@ -277,14 +277,22 @@ class TPESearcher(SearchAlgorithm):
         ]
 
     def on_trial_complete(self, trial_id, result, error=False, config=None):
+        score = self._score(result, error=error, config=config)
+        if score is not None:
+            self._record(config, score, result)
+
+    def _score(self, result, *, error: bool, config) -> Optional[float]:
+        """Normalized maximize-me objective, or None if unusable."""
         if error or not result or config is None or not self._metric:
-            return
+            return None
         score = result.get(self._metric)
         if score is None:
-            return
+            return None
         score = float(score)
-        if self._mode == "min":
-            score = -score
+        return -score if self._mode == "min" else score
+
+    def _record(self, config, score: float, result) -> None:
+        """Observation sink — subclasses re-bin (BOHB buckets by budget)."""
         self._observations.append((config, score))
 
     # -- per-dimension sampling -------------------------------------------
@@ -434,3 +442,68 @@ class ConcurrencyLimiter(SearchAlgorithm):
         self._inflight = max(0, self._inflight - 1)
         self.searcher.on_trial_complete(trial_id, result, error=error,
                                         config=config)
+
+
+class BOHBSearcher(TPESearcher):
+    """BOHB's model-based multi-fidelity proposals (Falkner et al. 2018)
+    composed natively with HyperBandScheduler — the capability the
+    reference gets from tune/search/bohb + schedulers/hb_bohb.py over
+    the external hpbandster dependency.
+
+    Observations are keyed by the budget a trial REACHED (its final
+    ``time_attr``): under HyperBand, every rung's stopped cohort
+    completes at that rung's budget, so completed trials alone span all
+    fidelities — no mid-trial searcher hook needed.  Proposals condition
+    on the LARGEST budget that has enough observations (the paper's
+    model-selection rule: models on high budgets are most informative,
+    low budgets fill in while they warm up), falling back to random
+    sampling before any budget qualifies.
+
+    Use paired with the rung scheduler::
+
+        TuneConfig(search_alg=BOHBSearcher(),
+                   scheduler=HyperBandScheduler(max_t=81))
+    """
+
+    def __init__(self, n_initial: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: Optional[int] = None,
+                 time_attr: str = "training_iteration"):
+        super().__init__(n_initial=n_initial, gamma=gamma,
+                         n_candidates=n_candidates, seed=seed)
+        self._time_attr = time_attr
+        self._obs_by_budget: Dict[float, List] = {}
+
+    def _record(self, config, score: float, result) -> None:
+        raw = result.get(self._time_attr)
+        budget = self._budget_bin(1.0 if raw is None else float(raw))
+        self._obs_by_budget.setdefault(budget, []).append((config, score))
+
+    @staticmethod
+    def _budget_bin(budget: float) -> float:
+        """Integral budgets (training_iteration rungs) key exactly;
+        continuous attrs (time_total_s) coalesce to 2 significant
+        figures — otherwise every completion lands in a singleton
+        bucket and no budget ever accumulates a model."""
+        if budget == int(budget):
+            return budget
+        if budget <= 0:
+            return budget
+        exp = math.floor(math.log10(abs(budget)))
+        q = 10.0 ** (exp - 1)
+        return round(budget / q) * q
+
+    def _model_budget(self) -> Optional[float]:
+        """Largest budget with enough observations to fit the KDE split."""
+        need = max(self.n_initial, len(self._dims) + 2)
+        qualified = [b for b, obs in self._obs_by_budget.items()
+                     if len(obs) >= need]
+        return max(qualified) if qualified else None
+
+    def next_configs(self, n: int) -> List[Dict[str, Any]]:
+        budget = self._model_budget()
+        # TPESearcher.next_configs proposes from self._observations;
+        # point it at the chosen fidelity's observation set.
+        self._observations = (
+            self._obs_by_budget.get(budget, []) if budget is not None
+            else [])
+        return super().next_configs(n)

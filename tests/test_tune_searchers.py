@@ -297,3 +297,90 @@ def test_pb2_end_to_end_learns():
     best = results.get_best_result(
         metric="score", mode="max").metrics["score"]
     assert best > -0.5
+
+
+def test_bohb_conditions_on_largest_adequate_budget():
+    """BOHB model selection (Falkner et al. 2018): proposals use the
+    LARGEST budget with enough completed observations; low-budget
+    observations only fill in before any budget qualifies."""
+    from ray_tpu.tune.search import BOHBSearcher, uniform
+
+    s = BOHBSearcher(n_initial=4, seed=0)
+    s.set_space({"x": uniform(0.0, 1.0)}, metric="score", mode="max")
+
+    # 6 completions at budget 1 (good x near 0.9), 4 at budget 9
+    # (good x near 0.1 — the higher fidelity disagrees on purpose).
+    for i in range(6):
+        x = 0.9 + 0.01 * i
+        s.on_trial_complete(f"a{i}", {"score": 1 - abs(x - 0.9),
+                                      "training_iteration": 1},
+                            config={"x": x})
+    assert s._model_budget() == 1.0
+    for i in range(4):
+        x = 0.1 + 0.01 * i
+        s.on_trial_complete(f"b{i}", {"score": 1 - abs(x - 0.1),
+                                      "training_iteration": 9},
+                            config={"x": x})
+    assert s._model_budget() == 9.0  # switched to the higher fidelity
+
+    xs = [c["x"] for c in s.next_configs(20)]
+    # Proposals must follow the high-budget model (cluster near 0.1).
+    assert sum(1 for x in xs if x < 0.5) >= 15, xs
+
+
+def test_bohb_with_hyperband_end_to_end():
+    """BOHB + HyperBand pairing over a real Tuner run: rung stops give
+    completed trials at multiple budgets, and the searcher's model picks
+    up the signal."""
+    import ray_tpu
+    from ray_tpu import tune
+    from ray_tpu.tune.schedulers import HyperBandScheduler
+    from ray_tpu.tune.search import BOHBSearcher
+
+    ray_tpu.init(num_cpus=4, log_to_driver=False)
+    try:
+        def objective(config):
+            for it in range(9):
+                tune.report({"score": 1.0 - (config["x"] - 0.7) ** 2
+                             + 0.01 * it})
+
+        results = tune.Tuner(
+            objective,
+            param_space={"x": tune.uniform(0.0, 1.0)},
+            tune_config=tune.TuneConfig(
+                metric="score", mode="max", num_samples=16,
+                max_concurrent_trials=4, seed=3,
+                search_alg=BOHBSearcher(n_initial=4, seed=3),
+                scheduler=HyperBandScheduler(max_t=9,
+                                             reduction_factor=3)),
+        ).fit()
+        best = results.get_best_result()
+        assert best.metrics["score"] > 0.8
+        budgets = {float(r.metrics.get("training_iteration", 0))
+                   for r in results}
+        assert len(budgets) > 1, budgets  # rung stops -> multi-fidelity
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_bohb_budget_binning():
+    """Integral budgets key exactly; continuous ones coalesce (a raw
+    float time_total_s key would make every bucket a singleton); a
+    budget of 0 is kept, not rebinned by truthiness."""
+    from ray_tpu.tune.search import BOHBSearcher, uniform
+
+    s = BOHBSearcher(n_initial=2, time_attr="time_total_s", seed=0)
+    s.set_space({"x": uniform(0.0, 1.0)}, metric="m", mode="max")
+    for i, t in enumerate([60.12, 60.33, 59.8, 61.0]):
+        s.on_trial_complete(f"t{i}", {"m": 0.5, "time_total_s": t},
+                            config={"x": 0.5})
+    assert len(s._obs_by_budget) <= 2  # coalesced, not 4 singletons
+    assert s._model_budget() is not None
+
+    assert BOHBSearcher._budget_bin(0.0) == 0.0
+    assert BOHBSearcher._budget_bin(9.0) == 9.0
+    s2 = BOHBSearcher(n_initial=2, seed=0)
+    s2.set_space({"x": uniform(0.0, 1.0)}, metric="m", mode="max")
+    s2.on_trial_complete("z", {"m": 1.0, "training_iteration": 0},
+                         config={"x": 0.1})
+    assert 0.0 in s2._obs_by_budget  # not merged into budget 1
