@@ -34,6 +34,10 @@ def main(argv=None) -> int:
     ap.add_argument("--tasks", type=int, default=10_000)
     ap.add_argument("--actors", type=int, default=1_000)
     ap.add_argument("--pgs", type=int, default=100)
+    ap.add_argument("--big-object-gb", type=float, default=0,
+                    help="also put+get one N-GiB object through the shm "
+                         "arena (BASELINE.md 'max ray.get numpy object' "
+                         "row); sizes the arena to fit")
     ap.add_argument("--out", default="SCALE_r03.json")
     args = ap.parse_args(argv)
 
@@ -53,9 +57,16 @@ def main(argv=None) -> int:
     # max_workers_per_node clamped so 50 nodes x 64 logical CPUs don't
     # spawn thousands of real worker processes on the probe host; the
     # head's bookkeeping still sees the full logical resource pool.
+    sysconf: dict = {"max_workers_per_node": 2}
+    if args.big_object_gb:
+        # Arena sized to hold the object with headroom; spilling off so
+        # the measurement is the shm path, not disk.
+        sysconf["object_store_memory"] = int(
+            args.big_object_gb * (1 << 30) * 1.25)
+        sysconf["object_spilling_threshold"] = 0
     cluster = Cluster(head_node_args={
         "num_cpus": 64, "log_to_driver": False,
-        "_system_config": {"max_workers_per_node": 2}})
+        "_system_config": sysconf})
 
     # -- 1. logical nodes --------------------------------------------------
     t0 = time.perf_counter()
@@ -135,6 +146,58 @@ def main(argv=None) -> int:
           f"{results['placement_groups']['create_ready_per_s']}/s, "
           f"removed at {results['placement_groups']['remove_per_s']}/s",
           flush=True)
+
+    # -- 5. large single object (opt-in) ----------------------------------
+    if args.big_object_gb:
+        import mmap
+
+        import numpy as np
+
+        n = int(args.big_object_gb * (1 << 30) // 8)
+        arr = np.arange(n, dtype=np.int64)  # real bytes, not COW zeros
+        nbytes = n * 8
+        # Control: a bare tmpfs mmap write of the SAME byte count —
+        # big-object puts are first-touch page-fault bound on virtualized
+        # hosts, so the honest framework number is overhead OVER this.
+        ctl_path = os.path.join("/dev/shm", f"scale-probe-ctl-{os.getpid()}")
+        with open(ctl_path, "w+b") as f:
+            f.truncate(nbytes)
+            mm = mmap.mmap(f.fileno(), nbytes)
+            view = memoryview(mm)
+            t0 = time.perf_counter()
+            view[:nbytes] = memoryview(arr).cast("B")
+            raw_dt = time.perf_counter() - t0
+            view.release()
+            mm.close()
+        os.unlink(ctl_path)
+        t0 = time.perf_counter()
+        ref = ray_tpu.put(arr)
+        put_dt = time.perf_counter() - t0
+        del arr
+        t0 = time.perf_counter()
+        back = ray_tpu.get(ref, timeout=3600)
+        get_dt = time.perf_counter() - t0
+        assert int(back[0]) == 0 and int(back[-1]) == n - 1
+        gb = nbytes / 1e9
+        results["large_object"] = {
+            "gigabytes": round(gb, 2),
+            "put_s": round(put_dt, 2),
+            "put_gb_per_s": round(gb / put_dt, 2),
+            "raw_tmpfs_write_s": round(raw_dt, 2),
+            "framework_overhead_pct": round(
+                max(0.0, put_dt / raw_dt - 1.0) * 100, 1),
+            "get_s": round(get_dt, 3),
+            "note": "get is a zero-copy view over the shm arena "
+                    "(deserialize aliases the segment); "
+                    "raw_tmpfs_write_s is a bare mmap write of the same "
+                    "byte count on the same host, measured just before "
+                    "the put",
+        }
+        print(f"large object: {gb:.1f} GB put in {put_dt:.1f}s "
+              f"(raw tmpfs control {raw_dt:.1f}s -> "
+              f"{results['large_object']['framework_overhead_pct']}% "
+              f"overhead), get in {get_dt:.3f}s", flush=True)
+        del back, ref
 
     cluster.shutdown()
     with open(args.out, "w") as f:
