@@ -1885,6 +1885,22 @@ class ControlServer:
                 continue  # owner gone: drop the demand
             need = ResourceSet(pl["resources"])
             w = self._idle_lease_worker_locked(pl["env_key"], need)
+            if w is None:
+                broken = self.broken_envs.get(pl["env_key"])
+                if broken is not None and \
+                        now - broken[1] <= self.broken_env_ttl_s:
+                    # Env poisoned AFTER this request was queued (its
+                    # own spawn usually revealed the poison) and no
+                    # healthy idle worker can serve it: deny with the
+                    # setup error so the owner fast-fails its queued
+                    # specs — without this the loop would re-spawn
+                    # doomed workers forever while the owner waits.
+                    # (With healthy idle workers — an earlier setup of
+                    # the same env succeeded — the demand is served,
+                    # not failed.)
+                    out.append((owner.conn, pl["token"], [], 1,
+                                f"runtime_env setup failed: {broken[0]}"))
+                    continue
             if w is not None:
                 charge = ("node", w.node_id)
                 self._charge_target_subtract(charge, need)

@@ -33,7 +33,7 @@ def main():
     def base():
         with open(MARK, "a") as f:
             f.write("b")
-        return np.arange(80_000, dtype=np.int64)
+        return np.arange(300_000, dtype=np.int64)
 
     @ray_tpu.remote
     def double(a):
@@ -41,7 +41,7 @@ def main():
             f.write("d")
         return a * 2
 
-    expected = np.arange(80_000, dtype=np.int64) * 2
+    expected = np.arange(300_000, dtype=np.int64) * 2
     t0 = time.time()
     a = base.remote()
     b = double.remote(a)
@@ -67,7 +67,7 @@ def main():
     assert sorted(runs) == ["b", "b", "d", "d", "d"], runs
     print(f"[3] chain loss -> recursive re-run, runs={runs!r}")
 
-    p = ray_tpu.put(np.arange(80_000))
+    p = ray_tpu.put(np.arange(300_000))
     lose(rt, p)
     try:
         ray_tpu.get(p, timeout=30)

@@ -115,7 +115,11 @@ def test_actor_runtime_env(tmp_path):
 
 @pytest.mark.usefixtures("ray_start_regular")
 def test_bad_pip_requirement_fails_task():
-    from ray_tpu.core.exceptions import RayTpuError
+    """Env poisoning must FAST-fail the task with the setup error — on
+    the lease path too (the grant loop denies poisoned-env demand with
+    the error instead of re-spawning doomed workers; a GetTimeoutError
+    here means the poison never reached the waiting owner)."""
+    from ray_tpu.core.exceptions import GetTimeoutError
 
     @ray_tpu.remote(runtime_env={"pip": ["not_a_real_package_qq"]},
                     max_retries=0)
@@ -123,8 +127,13 @@ def test_bad_pip_requirement_fails_task():
         return 1
 
     ref = doomed.remote()
-    with pytest.raises(Exception):
+    with pytest.raises(Exception) as ei:
         ray_tpu.get(ref, timeout=60)
+    # A GetTimeoutError would mean the poison never reached the owner —
+    # that IS the fast-fail distinction (no wall-clock bound needed).
+    assert not isinstance(ei.value, GetTimeoutError), ei.value
+    msg = str(ei.value)
+    assert "runtime_env" in msg or "not_a_real_package_qq" in msg, msg
 
 
 @pytest.mark.usefixtures("ray_start_regular")
