@@ -537,6 +537,14 @@ class ShmReader {
     uint64_t off = 0, size = 0;
     int rc = tps_get_(handle_, id, &off, &size);
     if (rc == 0) {
+      // A stale pin record, an arena recreated at a different size, or
+      // corrupt metadata could hand back a span past our mapping — fail
+      // loudly instead of letting the caller segfault on the alias.
+      if (off > map_size_ || size > map_size_ - off) {
+        tps_release_(handle_, id);
+        throw std::runtime_error(
+            "tps_get returned span outside arena mapping for " + obj_hex);
+      }
       View v;
       v.data = base_ + off;
       v.size = size;

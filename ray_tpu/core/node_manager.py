@@ -71,6 +71,23 @@ def prewarm_zygote() -> None:
         pass
 
 
+def _has_exec_only_env_vars(runtime_env: Optional[dict]) -> bool:
+    """True when a runtime_env's env_vars only take effect at exec time —
+    dynamic-loader paths, interpreter flags, native thread-pool init —
+    and so would be silently inert in a forked zygote child whose
+    interpreter and native libs are already loaded.  Such spawns keep the
+    Popen path (mirroring the JAX_PLATFORMS special case above) so the
+    same runtime_env behaves identically warm or cold."""
+    if not runtime_env:
+        return False
+    env_vars = runtime_env.get("env_vars") or {}
+    for k in env_vars:
+        if k.startswith(("LD_", "PYTHON", "OMP_", "OPENBLAS_", "MKL_",
+                         "MALLOC_", "GOMP_", "XLA_FLAGS")):
+            return True
+    return False
+
+
 def spawn_worker_process(*, control_addr: str, worker_hex: str, kind: str,
                          env_key: str, namespace: str, node_id: str,
                          log_dir: str, session_id: str,
@@ -120,6 +137,7 @@ def spawn_worker_process(*, control_addr: str, worker_hex: str, kind: str,
             and not (runtime_env
                      and set(runtime_env) - _zygote_safe_env_keys)
             and not (extra_env and "JAX_PLATFORMS" in extra_env)
+            and not _has_exec_only_env_vars(runtime_env)
             and zygote_enabled()):
         try:
             from ray_tpu.core.zygote import get_zygote

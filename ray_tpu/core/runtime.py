@@ -808,6 +808,11 @@ class CoreClient:
             from ray_tpu.core.exceptions import RuntimeEnvSetupError
 
             for spec in failed_specs:
+                # No worker will ever _finish these specs (same contract
+                # as the cancel paths below): release their borrowed args
+                # or they stay pinned at the head for the session.
+                for bhex in spec.borrows:
+                    self._queue_for_flush("decref", None, bhex)
                 self._fail_direct(spec.return_ids[0].hex(),
                                   RuntimeEnvSetupError(error))
         if give_back:

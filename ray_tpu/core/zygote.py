@@ -96,24 +96,25 @@ class _ZygoteServer:
             pass
         self.listener.bind(sock_path)
         self.listener.listen(4)
-        self.children: Dict[int, bool] = {}  # pid -> alive (bookkeeping set)
+        self.children: Dict[int, str] = {}  # pid -> spawn nonce ("" if none)
         self.exited: Dict[int, int] = {}  # pid -> exit code (drained by poll)
         self.parent_pid = os.getppid()
-        self._jax_warmed = False
         self._fork_unsafe = False
 
     def warm(self) -> None:
-        """Import the worker stack (fast — a few hundred ms).  Runs after
-        bind/listen so the owner's connect() never races it.  The heavier
-        jax import is deferred to idle loop ticks (_warm_jax) so it never
-        delays a pending spawn."""
+        """Import the worker stack.  Runs after bind/listen so the owner's
+        connect() never races it, and BEFORE serve_forever: the owner's
+        first request already tolerates up to 120 s of warm imports, and a
+        multi-second jax import inside the serve loop would stall
+        spawn/poll requests into their 5 s client timeouts (three of
+        which permanently disable the zygote fast path)."""
         import ray_tpu.core.worker  # noqa: F401  (the whole point)
 
         try:
             import numpy  # noqa: F401
         except Exception:
             pass
-        self._check_fork_safe()
+        self._warm_jax()  # ends with the fork-safety thread check
 
     def _check_fork_safe(self) -> None:
         if threading.active_count() > 1:
@@ -126,10 +127,9 @@ class _ZygoteServer:
             self._fork_unsafe = True
 
     def _warm_jax(self) -> None:
-        """Import jax on an idle tick — import only, never backend init:
-        XLA client/device threads must be created per-child, post-fork,
-        under each worker's own XLA_FLAGS/platform env."""
-        self._jax_warmed = True
+        """Import jax — import only, never backend init: XLA client/device
+        threads must be created per-child, post-fork, under each worker's
+        own XLA_FLAGS/platform env."""
         try:
             import jax  # noqa: F401
         except Exception:
@@ -147,15 +147,11 @@ class _ZygoteServer:
                 try:
                     conn, _ = self.listener.accept()
                 except socket.timeout:
-                    if not self._jax_warmed:
-                        self._warm_jax()
                     continue
                 conn.settimeout(0.5)
             try:
                 req = _recv_msg(conn)
             except socket.timeout:
-                if not self._jax_warmed:
-                    self._warm_jax()
                 continue
             except OSError:
                 req = None
@@ -173,6 +169,19 @@ class _ZygoteServer:
                 try:
                     _send_msg(conn, reply)
                 except OSError:
+                    # The owner closed this connection (e.g. a client-side
+                    # timeout while this request sat in the socket buffer).
+                    # If the request we just served was a spawn, the owner
+                    # never learned the pid and has already fallen back to
+                    # a Popen spawn under the SAME worker id — kill the
+                    # orphan fork before two processes register as one
+                    # worker.
+                    if req.get("op") == "spawn" and "pid" in reply:
+                        try:
+                            os.kill(reply["pid"], signal.SIGKILL)
+                        except (ProcessLookupError, PermissionError):
+                            pass
+                        self.children.pop(reply["pid"], None)
                     conn.close()
                     conn = None
 
@@ -200,12 +209,12 @@ class _ZygoteServer:
             pid = os.fork()
             if pid == 0:
                 self._child(req, conn)  # never returns
-            self.children[pid] = True
+            self.children[pid] = req.get("nonce", "")
             # The kernel may hand a new fork a previously-recorded pid;
             # a stale exit record would make the owner declare the new
             # worker dead on its first poll.
             self.exited.pop(pid, None)
-            return {"pid": pid}
+            return {"pid": pid, "nonce": req.get("nonce", "")}
         if op == "poll_all":
             self._reap()
             out = {"alive": list(self.children), "exited": self.exited}
@@ -217,6 +226,21 @@ class _ZygoteServer:
                 return {"ok": True}
             except ProcessLookupError:
                 return {"ok": False}
+        if op == "reap_stale":
+            # The owner timed out waiting for these spawns' replies and
+            # fell back to Popen: if any of them executed anyway, the fork
+            # is a ghost worker sharing the fallback's worker id — kill it.
+            stale = set(req.get("nonces", ()))
+            killed = []
+            for pid, nonce in list(self.children.items()):
+                if nonce and nonce in stale:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                    self.children.pop(pid, None)
+                    killed.append(pid)
+            return {"ok": True, "killed": killed}
         if op == "ping":
             return {"ok": True, "pid": os.getpid()}
         if op == "shutdown":
@@ -378,6 +402,13 @@ class ZygoteHandle:
         self._warming = False
         self._failures = 0
         self._disabled = False
+        # Nonces of spawn requests whose reply we never saw (client-side
+        # timeout): the template may still execute them later, forking a
+        # ghost worker under a worker id we have already re-used for a
+        # Popen fallback.  Flushed as a reap_stale op before the next
+        # request so such forks are detected and killed.  Ordered so the
+        # overflow bound evicts the OLDEST nonce, never a pending one.
+        self._stale_nonces: Dict[str, None] = {}
 
     def prewarm(self) -> None:
         """Kick off template start + connect on a daemon thread (idempotent,
@@ -472,6 +503,14 @@ class ZygoteHandle:
         with self._lock:
             self._ensure(start)
             try:
+                if self._stale_nonces and req.get("op") != "reap_stale":
+                    # Same-connection ordering guarantees the reap runs
+                    # after any still-buffered stale spawn it names.
+                    _send_msg(self._conn, {"op": "reap_stale",
+                                           "nonces": list(self._stale_nonces)})
+                    r = _recv_msg(self._conn)
+                    if r is not None and "error" not in r:
+                        self._stale_nonces.clear()
                 _send_msg(self._conn, req)
                 reply = _recv_msg(self._conn)
             except OSError as e:
@@ -497,12 +536,20 @@ class ZygoteHandle:
         if not self._ready:
             self.prewarm()
             raise RuntimeError("zygote template not ready yet")
+        nonce = os.urandom(8).hex()
         try:
             reply = self._request(
-                {"op": "spawn", "env": env, "log_base": log_base, "cwd": cwd})
+                {"op": "spawn", "env": env, "log_base": log_base,
+                 "cwd": cwd, "nonce": nonce})
         except RuntimeError:
             # Template died/hiccuped: stop routing spawns here (callers
-            # fall back to Popen) and re-warm in the background.
+            # fall back to Popen) and re-warm in the background.  The
+            # request may still execute out of the socket buffer later —
+            # remember the nonce so the fork gets reaped, not adopted.
+            with self._lock:
+                self._stale_nonces[nonce] = None
+                while len(self._stale_nonces) > 1024:
+                    self._stale_nonces.pop(next(iter(self._stale_nonces)))
             self._ready = False
             self.prewarm()
             raise

@@ -213,3 +213,63 @@ def test_template_death_degrades_to_exec_spawns(zcluster):
              if w.proc is not None and getattr(w.proc, "pid", None) == pid]
     assert procs and isinstance(procs[0], subprocess.Popen)
     assert not isinstance(procs[0], ZygoteProc)
+
+
+def test_stale_spawn_nonce_reaped(zcluster, tmp_path):
+    """A spawn whose reply the owner never saw (client-side timeout) must
+    not leave a ghost fork running under a worker id the owner has
+    already re-used: the recorded nonce is flushed as reap_stale on the
+    next request and the template kills the fork (ADVICE r3, medium)."""
+    import socket
+
+    _wait_ready()
+    h = get_zygote()
+
+    # A listening-but-silent control socket keeps spawned workers
+    # blocked in registration (alive) instead of exiting on refusal.
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    env = dict(os.environ)
+    env["RAY_TPU_CONTROL_ADDR"] = "127.0.0.1:%d" % lsock.getsockname()[1]
+    env["RAY_TPU_WORKER_ID"] = "e" * 32
+    env["RAY_TPU_SESSION_ID"] = "stale-test"
+    env["RAY_TPU_WORKER_KIND"] = "pool"
+    env["RAY_TPU_ENV_KEY"] = ""
+    env["RAY_TPU_NAMESPACE"] = ""
+    env["RAY_TPU_NODE_ID"] = "head"
+
+    proc = h.spawn(env=env, log_base=str(tmp_path / "stale"),
+                   cwd=str(tmp_path))
+    assert proc.poll() is None
+
+    # Simulate an owner-side timeout on a second spawn: the owner never
+    # saw the pid and recorded the nonce for reaping (drive the protocol
+    # directly — spawn() only exposes the nonce on failure).
+    nonce2 = os.urandom(8).hex()
+    r2 = h._request({"op": "spawn", "env": env,
+                     "log_base": str(tmp_path / "stale2"),
+                     "cwd": str(tmp_path), "nonce": nonce2})
+    pid2 = r2["pid"]
+    assert r2.get("nonce") == nonce2
+
+    with h._lock:
+        h._stale_nonces[nonce2] = None
+    # Any subsequent request flushes the reap first.
+    h._request({"op": "ping"})
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            os.kill(pid2, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("stale-nonce fork was not reaped")
+    with h._lock:
+        assert not h._stale_nonces
+
+    # The first (legitimately acknowledged) worker is untouched.
+    assert proc.poll() is None
+    proc.kill()
+    lsock.close()
