@@ -40,13 +40,15 @@ from ray_tpu.ops.paged_attention import (
 
 def init_kv_pages(config: TransformerConfig, num_pages: int,
                   page_size: int) -> Dict[str, jax.Array]:
-    """Paged KV cache for all layers, kv-head-major:
-    [KVH, L, P, page, head_dim] — the layout the TPU paged-attention
-    kernel streams ([page, D] tiles contiguous per head), with L and P
-    adjacent so the flat [KVH, L*P, ...] view is a free reshape."""
+    """Paged KV cache for all layers, fused-head rows:
+    [L, P, page, KVH * head_dim] — one page is one CONTIGUOUS HBM
+    region covering every kv head, so the decode kernel streams it as
+    a single large DMA (ops/paged_attention.py module docstring).  L
+    and P are adjacent so the flat [L*P, page, KD] view is a free
+    reshape and layer l's page p addresses as flat page l*P + p."""
     c = config
-    shape = (c.num_kv_heads, c.num_layers, num_pages, page_size,
-             c.head_dim_)
+    shape = (c.num_layers, num_pages, page_size,
+             c.num_kv_heads * c.head_dim_)
     return {"k": jnp.zeros(shape, dtype=c.dtype),
             "v": jnp.zeros(shape, dtype=c.dtype)}
 
@@ -57,7 +59,7 @@ def _layer_params(params: Dict[str, Any], l: int):
 
 
 def _flat_cache(cache: Dict[str, jax.Array]):
-    """View the [KVH, L, P, page, D] cache as [KVH, L*P, page, D].
+    """View the [L, P, page, KD] cache as [L*P, page, KD].
 
     Layer l's page p lives at flat index l*P + p, so per-layer writes
     are ONE scatter into the whole cache instead of slice-out /
@@ -65,18 +67,17 @@ def _flat_cache(cache: Dict[str, jax.Array]):
     analysis and copied ~2 x 33 MB of pages per layer per decode step
     (the dominant cost of the r2 decode bench).  Reshape of a
     contiguous array is metadata-only; the engine-facing cache dict
-    keeps its [KVH, L, ...] shape."""
-    KVH, L, P = cache["k"].shape[:3]
-    rest = cache["k"].shape[3:]
-    return (cache["k"].reshape(KVH, L * P, *rest),
-            cache["v"].reshape(KVH, L * P, *rest), L, P)
+    keeps its [L, ...] shape."""
+    L, P = cache["k"].shape[:2]
+    rest = cache["k"].shape[2:]
+    return (cache["k"].reshape(L * P, *rest),
+            cache["v"].reshape(L * P, *rest), L, P)
 
 
 def _unflat_cache(kf, vf, L: int, P: int) -> Dict[str, jax.Array]:
-    KVH = kf.shape[0]
-    rest = kf.shape[2:]
-    return {"k": kf.reshape(KVH, L, P, *rest),
-            "v": vf.reshape(KVH, L, P, *rest)}
+    rest = kf.shape[1:]
+    return {"k": kf.reshape(L, P, *rest),
+            "v": vf.reshape(L, P, *rest)}
 
 
 def _project_qkv(x, bp, positions, cos, sin, c: TransformerConfig):
@@ -129,8 +130,15 @@ def _mlp(x, bp, c: TransformerConfig, positions=None):
 
 def _lm_head(x, params, c: TransformerConfig):
     x = rms_norm(x, params["final_norm"], c.rms_eps)
-    return jnp.einsum("bh,vh->bv", x.astype(jnp.float32),
-                      params["tok_embed"].astype(jnp.float32))
+    # Read the embedding in its stored dtype and accumulate in fp32 on
+    # the MXU (preferred_element_type) rather than materializing an
+    # fp32 copy of the [vocab, h] table every decode iteration — the
+    # numerics are identical (bf16 inputs are exact in fp32; products
+    # and accumulation happen in fp32 either way) but the HBM read
+    # halves.
+    return jnp.einsum("bh,vh->bv", x.astype(c.dtype),
+                      params["tok_embed"].astype(c.dtype),
+                      preferred_element_type=jnp.float32)
 
 
 @partial(jax.jit, static_argnames=("config",), donate_argnames=("cache",))
@@ -194,7 +202,7 @@ def _chunk_forward(params, tokens, positions, cache, block_tables,
     B, S = tokens.shape
     x = params["tok_embed"].astype(c.dtype)[tokens]
     cos, sin = rope_freqs(c.head_dim_, c.max_seq_len, c.rope_theta)
-    page = cache["k"].shape[3]
+    page = cache["k"].shape[2]
     max_ctx = block_tables.shape[1] * page
     q_pos = positions[:, :, None]                   # [B, S, 1]
     k_pos = jnp.arange(max_ctx)[None, None, :]      # [1, 1, ctx]
@@ -213,12 +221,11 @@ def _chunk_forward(params, tokens, positions, cache, block_tables,
         ck, cv = write_page_tokens(ck, cv, k, v, tables_l, positions)
         # Gather the full context (cached prefix + just-written suffix)
         # from the pages; K in pages is already rotary-encoded.
-        # [KVH, B, W, page, D] -> [B, ctx, KVH, D]
-        kvh = ck.shape[0]
-        kf = ck[:, tables_l].reshape(
-            kvh, B, max_ctx, c.head_dim_).transpose(1, 2, 0, 3)
-        vf = cv[:, tables_l].reshape(
-            kvh, B, max_ctx, c.head_dim_).transpose(1, 2, 0, 3)
+        # [B, W, page, KVH*D] -> [B, ctx, KVH, D] (fused-head rows
+        # split back into heads — a free trailing-dim reshape).
+        kvh = c.num_kv_heads
+        kf = ck[tables_l].reshape(B, max_ctx, kvh, c.head_dim_)
+        vf = cv[tables_l].reshape(B, max_ctx, kvh, c.head_dim_)
         kv = kf.shape[2]
         if kv != c.num_heads:
             rep = c.num_heads // kv
@@ -323,8 +330,7 @@ def decode_step(params, tokens, cache, block_tables, positions,
          donate_argnames=("cache",))
 def decode_multi_step(params, tokens, cache, block_tables, positions,
                       context_lens, limits, eos, config: TransformerConfig,
-                      n_steps: int
-                      ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+                      n_steps: int):
     """Advance every slot up to n_steps GREEDY tokens entirely on device
     (vLLM's multi-step scheduling, TPU-shaped): the argmax token feeds
     the next step without a host round trip, so the host syncs once per
@@ -334,8 +340,15 @@ def decode_multi_step(params, tokens, cache, block_tables, positions,
     limits: [B] int32 — highest absolute position a slot may WRITE
     (len(prompt)+max_new-1); a slot stops when its next write would
     exceed it.  eos: [B] int32 — per-slot EOS token id, -1 for none; a
-    slot stops after emitting it.  Returns (tokens [B, n_steps] int32,
-    -1 past a slot's stop, and the updated cache).
+    slot stops after emitting it.
+
+    Returns (out [B, n_steps] int32 tokens, -1 past a slot's stop;
+    tokens [B]; positions [B]; context_lens [B]; cache) — the final
+    per-slot state comes back as DEVICE arrays so the engine can chain
+    the next chunk off them without a host round trip: chunks dispatch
+    back-to-back (pipelined behind the out transfer) and the device
+    never idles on the host/tunnel latency (serve/llm_engine.py
+    pipelined decode).
     """
     B = tokens.shape[0]
 
@@ -356,7 +369,22 @@ def decode_multi_step(params, tokens, cache, block_tables, positions,
         return tokens, cache, positions, ctx, out
 
     out0 = jnp.full((B, n_steps), -1, jnp.int32)
-    _, cache, _, _, out = jax.lax.fori_loop(
+    tokens, cache, positions, ctx, out = jax.lax.fori_loop(
         0, n_steps, body,
         (tokens, cache, positions, context_lens, out0))
-    return out, cache
+    return out, tokens, positions, ctx, cache
+
+
+@partial(jax.jit, donate_argnames=("tokens", "positions", "context_lens",
+                                   "limits", "eos"))
+def merge_slot_state(tokens, positions, context_lens, limits, eos,
+                     mask, new_tokens, new_positions, new_context_lens,
+                     new_limits, new_eos):
+    """Fold host-side slot changes (admissions, frees) into the
+    device-chained decode state without reading it back: a masked
+    select per array.  Used by the engine's pipelined decode path to
+    admit requests between in-flight chunks."""
+    sel = lambda n, o: jnp.where(mask, n, o)  # noqa: E731
+    return (sel(new_tokens, tokens), sel(new_positions, positions),
+            sel(new_context_lens, context_lens), sel(new_limits, limits),
+            sel(new_eos, eos))

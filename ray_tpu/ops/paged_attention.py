@@ -4,21 +4,28 @@ The reference delegates LLM serving to vLLM via compiled DAGs
 (SURVEY.md §2.2 P12 — "Ray's µs-latency GPU pipeline path"); the
 TPU-native build owns the inference path instead (§7.10 "LLM inference
 replica w/ paged attention"). KV blocks live in fixed-size pages laid
-out KV-HEAD-MAJOR ([kv_heads, num_pages, page_size, head_dim]) — the
-layout the TPU kernel wants (contiguous [page, D] tiles per head) —
-and each sequence owns a list of pages (its block table), so cache
-memory is allocated page-at-a-time with zero fragmentation-driven
+out ROW-MAJOR with all KV heads fused into the row:
+
+    k_pages / v_pages: [P, page, KVH * D]
+
+so one page is ONE contiguous HBM region covering every kv head — the
+decode kernel streams it with a single large DMA (64 KB at page=64,
+KVH*D=512) instead of one 4 KB copy per (head, page) pair.  DMA size is
+what decides decode bandwidth on TPU: the per-(head,page) scheme
+measured 130-150 GB/s on v5e, the fused-row layout streams at several
+hundred GB/s.  Each sequence owns a list of pages (its block table), so
+cache memory is allocated page-at-a-time with zero fragmentation-driven
 copies: the vLLM idea, TPU-shaped.
 
-  - decode on TPU runs JAX's Pallas paged-attention kernel
-    (jax.experimental.pallas.ops.tpu.paged_attention — public JAX ops,
-    multi-page compute blocks with double-buffered async copies; our
-    earlier one-page-per-grid-step kernel was DMA-issue-bound at ~15%
-    of HBM bandwidth).
-  - other platforms use an XLA gather formulation, and a small
-    interpret-mode Pallas kernel covers kernel-semantics tests on CPU.
-  - page writes are functional `.at[:, pages, offsets].set(...)`
-    scatters, so the cache threads through jit with buffer donation.
+  - decode on TPU runs the in-tree Pallas GQA kernel below: grid
+    (batch, context blocks), double-buffered manual DMAs of whole
+    fused-head pages, flash-style online softmax across blocks, and
+    length-based block skip so short contexts don't pay for the table
+    width.
+  - other platforms use an XLA gather formulation, and the same Pallas
+    kernel runs in interpret mode for kernel-semantics tests on CPU.
+  - prompt-page writes are functional scatters; decode-token writes go
+    through an aliased sublane-strip RMW kernel (write_token_rows).
 
 Static shapes throughout: [B, max_pages] block tables padded with page
 0 and masked by context_lens, bucketed by the engine to the live
@@ -54,62 +61,45 @@ def paged_attention(q, k_pages, v_pages, block_tables, context_lens,
     """Decode-time attention for one new token per sequence.
 
     q:            [B, H, D]            query for the current position
-    k_pages:      [KVH, P, page, D]    paged key cache (one layer)
-    v_pages:      [KVH, P, page, D]    paged value cache
+    k_pages:      [P, page, KVH*D]     paged key cache (one layer)
+    v_pages:      [P, page, KVH*D]     paged value cache
     block_tables: [B, max_pages] int32 page ids (padded entries ignored)
     context_lens: [B] int32            tokens in cache per sequence
                                        (including the current one)
-    Returns [B, H, D].
+    Returns [B, H, D].  KVH is inferred from the fused row width.
     """
     B, H, D = q.shape
-    KVH, P, page, _ = k_pages.shape
+    P, page, KD = k_pages.shape
+    KVH = KD // D
     W = block_tables.shape[1]
-    if _platform() == "tpu" and D % 128 == 0 and H % KVH == 0 \
-            and sm_scale is None:
-        from jax.experimental.pallas.ops.tpu.paged_attention import (
-            paged_attention as _jax_paged_attention,
-        )
-
-        # pages_per_compute_block must DIVIDE the table width (the
-        # engine buckets W pow-2 but clamps to max_pages_per_seq, which
-        # need not be); 32 pages per block measured fastest on v5e
-        # (larger async copies beat finer skip granularity).
-        ppcb = min(32, W)
-        while W % ppcb:
-            ppcb -= 1
-        # The jax kernel applies no softmax scale internally: fold
-        # 1/sqrt(D) into q (the gather/interpret paths scale in the
-        # logits; skipping this made TPU logits sqrt(D)x too large).
-        q_scaled = (q.astype(jnp.float32)
-                    * (1.0 / math.sqrt(D))).astype(q.dtype)
-        out = _jax_paged_attention(
-            q_scaled, k_pages, v_pages, context_lens.astype(jnp.int32),
-            block_tables.astype(jnp.int32),
-            pages_per_compute_block=ppcb)
-        return out.astype(q.dtype)
-    if _interpret_mode() and D % 8 == 0 and H % KVH == 0:
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
+    on_tpu = _platform() == "tpu"
+    # Kernel tiling constraints: fused row must fill whole lanes and a
+    # page must cover the bf16 sublane tile.
+    kernel_ok = (KD % 128 == 0 and H % KVH == 0 and page % 8 == 0)
+    if (on_tpu or _interpret_mode()) and kernel_ok:
         return _paged_attention_pallas(
-            q, k_pages, v_pages, block_tables, context_lens,
-            sm_scale if sm_scale is not None else 1.0 / math.sqrt(D))
+            q, k_pages, v_pages, block_tables, context_lens, scale,
+            interpret=not on_tpu)
     return _paged_attention_gather(
-        q, k_pages, v_pages, block_tables, context_lens, sm_scale)
+        q, k_pages, v_pages, block_tables, context_lens, scale)
 
 
 def _paged_attention_gather(q, k_pages, v_pages, block_tables,
-                            context_lens, sm_scale: float | None = None):
+                            context_lens, scale: float):
     """XLA gather formulation (non-TPU fallback)."""
     B, H, D = q.shape
-    KVH, P, page, _ = k_pages.shape
+    P, page, KD = k_pages.shape
+    KVH = KD // D
     max_pages = block_tables.shape[1]
     G = H // KVH  # query heads per kv head (GQA)
-    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(D)
 
-    # Gather each sequence's pages: [KVH, B, max_pages, page, D] →
+    # Gather each sequence's pages: [B, max_pages, page, KVH*D] →
     # [B, KVH, T, D] with T = max_pages * page.
-    k = jnp.take(k_pages, block_tables, axis=1).reshape(
-        KVH, B, max_pages * page, D).transpose(1, 0, 2, 3)
-    v = jnp.take(v_pages, block_tables, axis=1).reshape(
-        KVH, B, max_pages * page, D).transpose(1, 0, 2, 3)
+    k = jnp.take(k_pages, block_tables, axis=0).reshape(
+        B, max_pages * page, KVH, D).transpose(0, 2, 1, 3)
+    v = jnp.take(v_pages, block_tables, axis=0).reshape(
+        B, max_pages * page, KVH, D).transpose(0, 2, 1, 3)
 
     qg = q.reshape(B, KVH, G, D)
     logits = jnp.einsum("bkgd,bktd->bkgt", qg.astype(jnp.float32),
@@ -124,90 +114,188 @@ def _paged_attention_gather(q, k_pages, v_pages, block_tables,
 
 
 # ---------------------------------------------------------------------------
-# Interpret-mode Pallas kernel (kernel-semantics tests on CPU): one page
-# per grid step, block table as a scalar-prefetch operand, flash-style
-# running (max, sum, acc) in VMEM scratch across the page axis.  The
-# TPU serving path uses JAX's multi-page kernel above instead.
+# TPU decode kernel: grid (B, blocks-of-pages).  Each grid step streams
+# one compute block (ppcb fused-head pages) for one sequence into VMEM
+# with double-buffered async copies — one DMA per PAGE, each covering
+# every kv head — and folds it into flash-style running (m, l, acc)
+# scratch.  Blocks past a sequence's context length are skipped: no
+# compute AND no copy, so the cost tracks the live context, not the
+# table width.  The copy for the next active block is issued before the
+# current block's compute so the DMA engine stays ahead of the VPU/MXU.
 # ---------------------------------------------------------------------------
 
 
-def _paged_decode_kernel(tables_ref, ctx_ref, q_ref, k_ref, v_ref, o_ref,
-                         m_ref, l_ref, acc_ref, *, page: int, W: int,
-                         kvh: int, g: int, sm_scale: float):
+def _next_active(b, i, ctx_ref, blk: int, NB: int, B: int):
+    """First grid position at or after (b, i) whose block holds live
+    context.  Rows with ctx == 0 (inactive slots) are skipped whole."""
+
+    def cond(state):
+        bb, ii = state
+        done = bb >= B
+        live = jnp.logical_and(bb < B,
+                               ii * blk < ctx_ref[jnp.minimum(bb, B - 1)])
+        return jnp.logical_and(~done, ~live)
+
+    def step(state):
+        bb, ii = state
+        # Block ii dead for row bb: the rest of bb's blocks are dead
+        # too (context is a prefix), so advance to the next row.
+        return bb + 1, jnp.zeros_like(ii)
+
+    nb, ni = jax.lax.while_loop(cond, step, (b, i))
+    return nb, ni
+
+
+def _gqa_decode_kernel(tables_ref, ctx_ref, q_ref, kf_ref, vf_ref, o_ref,
+                       m_ref, l_ref, acc_ref, k_buf, v_buf, buf_ref,
+                       sems, *, page: int, ppcb: int, NB: int, B: int,
+                       kvh: int, g: int, d: int, scale: float):
     b = pl.program_id(0)
-    w = pl.program_id(1)
-
-    @pl.when(w == 0)
-    def _init():
-        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
-        l_ref[...] = jnp.zeros_like(l_ref)
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
+    i = pl.program_id(1)
+    blk = page * ppcb
     ctx = ctx_ref[b]
+    live = i * blk < ctx
 
-    @pl.when(w * page < ctx)
-    def _compute():
-        d = q_ref.shape[-1]
-        q = q_ref[0].astype(jnp.float32).reshape(kvh, g, d)   # [KVH,G,D]
-        k = k_ref[:, 0]                                       # [KVH,page,D]
-        v = v_ref[:, 0]
-        logits = jax.lax.dot_general(
-            q, k.astype(jnp.float32), (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32) * sm_scale    # [KVH,G,page]
-        pos = w * page + jax.lax.broadcasted_iota(
-            jnp.int32, (kvh, g, page), 2)
-        logits = jnp.where(pos < ctx, logits, -jnp.inf)
+    def copies(bb, ii, slot):
+        """Async copies loading block (bb, ii) into buffer `slot` —
+        recreated identically at start and wait time (each descriptor
+        pairs one fused-head page with one buffer slice)."""
+        out = []
+        for j in range(ppcb):
+            pg = tables_ref[jnp.minimum(bb, B - 1), ii * ppcb + j]
+            out.append(pltpu.make_async_copy(
+                kf_ref.at[pg], k_buf.at[slot, j], sems.at[slot, 0]))
+            out.append(pltpu.make_async_copy(
+                vf_ref.at[pg], v_buf.at[slot, j], sems.at[slot, 1]))
+        return out
 
-        m_prev = m_ref[...]                                   # [KVH, G]
-        m_new = jnp.maximum(m_prev, logits.max(axis=-1))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(logits - m_new[..., None])                # [KVH,G,page]
-        l_ref[...] = l_ref[...] * alpha + p.sum(axis=-1)
-        pv = jax.lax.dot_general(
-            p, v.astype(jnp.float32), (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)               # [KVH,G,D]
-        acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
-        m_ref[...] = m_new
+    # The buffer parity is a running toggle over ACTIVE steps (SMEM
+    # scratch), not i % 2: with skipped blocks and row transitions the
+    # producing step's slot would otherwise disagree with the consuming
+    # step's.
+    fb, fi = _next_active(jnp.zeros_like(b), jnp.zeros_like(i),
+                          ctx_ref, blk, NB, B)
+    is_first = jnp.logical_and(b == fb, i == fi)
 
-    @pl.when(w == W - 1)
-    def _finalize():
-        l = jnp.maximum(l_ref[...], 1e-30)[..., None]
-        h = kvh * g
-        o_ref[0] = (acc_ref[...] / l).reshape(h, q_ref.shape[-1]) \
-            .astype(o_ref.dtype)
+    @pl.when(jnp.logical_and(ctx == 0, i == NB - 1))
+    def _zero_dead():
+        # No block of a ctx==0 row is live, so nothing below would
+        # write its output — without this the (1, H, D) VMEM output
+        # block flushes back holding the PREVIOUS row's attention.
+        # Dead rows return defined zeros instead.
+        o_ref[0] = jnp.zeros_like(o_ref[0])
+
+    @pl.when(is_first)
+    def _prime():
+        # The very first active step has no predecessor to prefetch for
+        # it: issue its own copies (they complete during grid ramp-up).
+        buf_ref[0] = 0
+        for c in copies(b, i, 0):
+            c.start()
+
+    @pl.when(live)
+    def _step():
+        slot = buf_ref[0]
+        # Issue the NEXT active block's copies before touching this
+        # block's data: the wait below then overlaps the next DMA wave.
+        nb, ni = _next_active(
+            jnp.where(i + 1 < NB, b, b + 1),
+            jnp.where(i + 1 < NB, i + 1, 0),
+            ctx_ref, blk, NB, B)
+
+        @pl.when(nb < B)
+        def _prefetch():
+            for c in copies(nb, ni, 1 - slot):
+                c.start()
+
+        for c in copies(b, i, slot):
+            c.wait()
+        buf_ref[0] = 1 - slot
+
+        @pl.when(i == 0)
+        def _init():
+            m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        # [ppcb, page, KVH*D] -> [T, KVH*D]: leading dims flatten free;
+        # heads are addressed by static LANE slices (h*D:(h+1)*D), not
+        # a lane-splitting reshape (which would relayout vregs).
+        k = k_buf[slot].reshape(blk, kvh * d)
+        v = v_buf[slot].reshape(blk, kvh * d)
+        q = q_ref[0].astype(jnp.float32)                      # [H, D]
+        pos = i * blk + jax.lax.broadcasted_iota(jnp.int32, (g, blk), 1)
+        mask = pos < ctx
+        for h in range(kvh):
+            k_h = k[:, h * d:(h + 1) * d].astype(jnp.float32)
+            v_h = v[:, h * d:(h + 1) * d].astype(jnp.float32)
+            q_h = q[h * g:(h + 1) * g]                        # [G, D]
+            logits = jax.lax.dot_general(
+                q_h, k_h, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale   # [G, blk]
+            logits = jnp.where(mask, logits, -jnp.inf)
+            m_prev = m_ref[h]                                 # [G]
+            m_new = jnp.maximum(m_prev, logits.max(axis=-1))
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(logits - m_new[:, None])              # [G, blk]
+            l_ref[h] = l_ref[h] * alpha + p.sum(axis=-1)
+            pv = jax.lax.dot_general(
+                p, v_h, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)           # [G, D]
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + pv
+            m_ref[h] = m_new
+
+        # Last live block for this sequence: finalize into the output.
+        @pl.when((i + 1) * blk >= ctx)
+        def _finalize():
+            l = jnp.maximum(l_ref[...], 1e-30)[..., None]
+            o_ref[0] = (acc_ref[...] / l).reshape(kvh * g, d) \
+                .astype(o_ref.dtype)
 
 
 def _paged_attention_pallas(q, k_pages, v_pages, block_tables,
-                            context_lens, sm_scale: float):
+                            context_lens, scale: float, *,
+                            interpret: bool):
     B, H, D = q.shape
-    KVH, P, page, _ = k_pages.shape
+    P, page, KD = k_pages.shape
+    KVH = KD // D
     W = block_tables.shape[1]
     G = H // KVH
+    # ~512-token compute blocks: big enough that the per-page DMAs
+    # amortize grid-step latency, small enough that length-based skip
+    # still saves traffic on short contexts.  W and page are pow-2 in
+    # practice; fall back to 1-page blocks otherwise.
+    ppcb = max(1, min(512 // page, W))
+    while W % ppcb:
+        ppcb -= 1
+    NB = W // ppcb
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, W),
+        grid=(B, NB),
         in_specs=[
-            pl.BlockSpec((1, H, D), lambda b, w, tables, ctx: (b, 0, 0)),
-            pl.BlockSpec((KVH, 1, page, D),
-                         lambda b, w, tables, ctx: (0, tables[b, w], 0, 0)),
-            pl.BlockSpec((KVH, 1, page, D),
-                         lambda b, w, tables, ctx: (0, tables[b, w], 0, 0)),
+            pl.BlockSpec((1, H, D), lambda b, i, tables, ctx: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),  # k_pages (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),  # v_pages
         ],
         out_specs=pl.BlockSpec(
-            (1, H, D), lambda b, w, tables, ctx: (b, 0, 0)),
+            (1, H, D), lambda b, i, tables, ctx: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((KVH, G), jnp.float32),
             pltpu.VMEM((KVH, G), jnp.float32),
             pltpu.VMEM((KVH, G, D), jnp.float32),
+            pltpu.VMEM((2, ppcb, page, KD), k_pages.dtype),
+            pltpu.VMEM((2, ppcb, page, KD), v_pages.dtype),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
     kernel = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, page=page, W=W, kvh=KVH,
-                          g=G, sm_scale=sm_scale),
+        functools.partial(_gqa_decode_kernel, page=page, ppcb=ppcb,
+                          NB=NB, B=B, kvh=KVH, g=G, d=D, scale=scale),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
-        interpret=_interpret_mode(),
+        interpret=interpret,
     )
     return kernel(block_tables.astype(jnp.int32),
                   context_lens.astype(jnp.int32), q, k_pages, v_pages)
@@ -215,9 +303,9 @@ def _paged_attention_pallas(q, k_pages, v_pages, block_tables,
 
 def write_page_tokens(k_pages, v_pages, k_new, v_new, block_tables,
                       positions):
-    """Scatter new K/V rows into their pages.
+    """Scatter new K/V rows into their pages (prefill path).
 
-    k_pages/v_pages: [KVH, P, page, D] (kv-head-major);
+    k_pages/v_pages: [P, page, KVH*D] (fused-head rows);
     k_new/v_new: [B, S, KVH, D] projections for S new tokens per seq;
     positions:   [B, S] int32 absolute positions (define page + offset);
     block_tables:[B, max_pages].
@@ -226,7 +314,7 @@ def write_page_tokens(k_pages, v_pages, k_new, v_new, block_tables,
     prefills are safe.
     """
     B, S, KVH, D = k_new.shape
-    page = k_pages.shape[2]
+    page = k_pages.shape[1]
     page_idx = positions // page                              # [B, S]
     offset = positions % page
     valid = positions >= 0
@@ -235,67 +323,83 @@ def write_page_tokens(k_pages, v_pages, k_new, v_new, block_tables,
     # Invalid rows get page index == num_pages: past-the-end is
     # out-of-bounds under scatter mode="drop" (negative indices would
     # WRAP, silently corrupting the last page), so those writes vanish.
-    pages = jnp.where(valid, pages, k_pages.shape[1])
+    pages = jnp.where(valid, pages, k_pages.shape[0])
     flat_pages = pages.reshape(-1)                            # [B*S]
     flat_off = jnp.maximum(offset, 0).reshape(-1)
-    k_flat = k_new.reshape(-1, KVH, D).transpose(1, 0, 2)     # [KVH,N,D]
-    v_flat = v_new.reshape(-1, KVH, D).transpose(1, 0, 2)
-    k_pages = k_pages.at[:, flat_pages, flat_off].set(
-        k_flat, mode="drop")
-    v_pages = v_pages.at[:, flat_pages, flat_off].set(
-        v_flat, mode="drop")
+    k_flat = k_new.reshape(-1, KVH * D)                       # [N, KD]
+    v_flat = v_new.reshape(-1, KVH * D)
+    k_pages = k_pages.at[flat_pages, flat_off].set(k_flat, mode="drop")
+    v_pages = v_pages.at[flat_pages, flat_off].set(v_flat, mode="drop")
     return k_pages, v_pages
 
 
-def _row_write_kernel(pages_ref, offs_ref, kin_ref, vin_ref, knew_ref,
-                      vnew_ref, ok_ref, ov_ref):
-    """Read-modify-write one page: carry the page block through and
-    overwrite row offs[b] with the new token's K/V."""
-    del pages_ref
+def _row_write_kernel(pages_ref, strips_ref, rows_ref, kin_ref, vin_ref,
+                      knew_ref, vnew_ref, ok_ref, ov_ref):
+    """Read-modify-write one sublane strip: carry the strip through and
+    overwrite row rows[b] (the offset within the strip) with the new
+    token's fused-head K/V row."""
+    del pages_ref, strips_ref
     b = pl.program_id(0)
-    off = offs_ref[b]
-    kvh, _, page, d = ok_ref.shape
-    page_pos = jax.lax.broadcasted_iota(jnp.int32, (kvh, 1, page, d), 2)
-    k_row = knew_ref[0][:, None, None, :]  # [KVH,1,1,D]
-    v_row = vnew_ref[0][:, None, None, :]
-    ok_ref[...] = jnp.where(page_pos == off, k_row, kin_ref[...])
-    ov_ref[...] = jnp.where(page_pos == off, v_row, vin_ref[...])
+    row = rows_ref[b]
+    _, strip, kd = ok_ref.shape
+    strip_pos = jax.lax.broadcasted_iota(jnp.int32, (1, strip, kd), 1)
+    k_row = knew_ref[...]                      # [1, 1, KD] -> broadcast
+    v_row = vnew_ref[...]
+    ok_ref[...] = jnp.where(strip_pos == row, k_row, kin_ref[...])
+    ov_ref[...] = jnp.where(strip_pos == row, v_row, vin_ref[...])
 
 
 def write_token_rows(k_pages, v_pages, k_new, v_new, block_tables,
                      positions):
-    """Decode-path single-token write: one [KVH, D] row per sequence,
-    in place via an aliased Pallas kernel (NOT an XLA scatter).
+    """Decode-path single-token write: one fused [KVH*D] row per
+    sequence, in place via an aliased Pallas kernel (NOT an XLA
+    scatter).
 
     XLA's layout assignment gives a middle-axis scatter a different
-    preferred cache layout ({3,0,2,1}: update rows contiguous) than the
-    paged-attention custom call ({3,2,1,0}: per-head page tiles), so a
-    scatter here made every decode layer copy the multi-GB cache twice
-    to ping-pong layouts — 238 ms/iter on v5e.  A pallas_call pins the
-    default layout on both sides and input_output_aliases makes the
-    write genuinely in place.
+    preferred cache layout (update rows contiguous) than the attention
+    kernel's streaming layout, so a scatter here made every decode
+    layer copy the multi-GB cache twice to ping-pong layouts — 238
+    ms/iter on v5e.  A pallas_call pins the default layout on both
+    sides and input_output_aliases makes the write genuinely in place.
 
-    k_pages/v_pages: [KVH, FP, page, D]; k_new/v_new: [B, KVH, D];
+    The RMW granule is one 8-row SUBLANE STRIP of the page, not the
+    page itself: serving configs use big pages (64+ tokens — see the
+    module docstring's DMA note), and carrying a whole page block
+    through VMEM per written token would scale the write cost with
+    page size.  The strip keeps per-token traffic constant regardless
+    of page size.
+
+    k_pages/v_pages: [FP, page, KVH*D]; k_new/v_new: [B, KVH, D];
     positions: [B] absolute position (< 0 = drop); block_tables:
     [B, W] (already layer-offset).  Dropped rows land in the GLOBAL
     scratch page FP-1 — the engine reserves the last physical page
     (llm_engine.py PageAllocator) so nothing lives there.
     """
     B, KVH, D = k_new.shape
-    FP, page = k_pages.shape[1], k_pages.shape[2]
+    FP, page = k_pages.shape[0], k_pages.shape[1]
+    KD = KVH * D
+    strip = min(8, page)  # tiny test configs use page sizes < 8
+    while page % strip:   # strip must tile the page dimension
+        strip -= 1
     page_idx = positions // page
     offs = jnp.where(positions >= 0, positions % page, 0) \
         .astype(jnp.int32)
     pages = jnp.take_along_axis(
         block_tables, jnp.maximum(page_idx, 0)[:, None], axis=1)[:, 0]
     pages = jnp.where(positions >= 0, pages, FP - 1).astype(jnp.int32)
+    strips = (offs // strip).astype(jnp.int32)
+    rows = (offs % strip).astype(jnp.int32)
 
     cache_spec = pl.BlockSpec(
-        (KVH, 1, page, D),
-        lambda b, pages, offs: (0, pages[b], 0, 0))
-    new_spec = pl.BlockSpec((1, KVH, D), lambda b, pages, offs: (b, 0, 0))
+        (1, strip, KD),
+        lambda b, pages, strips, rows: (pages[b], strips[b], 0))
+    # [B, 1, KD] with block (1, 1, KD): the singleton middle dim keeps
+    # the trailing two block dims equal to the array dims (a Mosaic
+    # tiling requirement a flat [B, KD] row block would violate).
+    new_spec = pl.BlockSpec((1, 1, KD),
+                            lambda b, pages, strips, rows: (b, 0, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(B,),
         in_specs=[cache_spec, cache_spec, new_spec, new_spec],
         out_specs=[cache_spec, cache_spec],
@@ -305,12 +409,13 @@ def write_token_rows(k_pages, v_pages, k_new, v_new, block_tables,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
                    jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)],
-        # Indices count every positional operand including the two
-        # scalar-prefetch arrays: 2 = k_pages -> out 0, 3 = v_pages.
-        input_output_aliases={2: 0, 3: 1},
+        # Indices count every positional operand including the three
+        # scalar-prefetch arrays: 3 = k_pages -> out 0, 4 = v_pages.
+        input_output_aliases={3: 0, 4: 1},
         interpret=_platform() != "tpu",
     )
-    return kernel(pages, offs, k_pages, v_pages, k_new, v_new)
+    return kernel(pages, strips, rows, k_pages, v_pages,
+                  k_new.reshape(B, 1, KD), v_new.reshape(B, 1, KD))
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
@@ -325,7 +430,8 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
     block_tables = np.asarray(block_tables)
     context_lens = np.asarray(context_lens)
     B, H, D = q.shape
-    KVH, P, page, _ = k_pages.shape
+    P, page, KD = k_pages.shape
+    KVH = KD // D
     G = H // KVH
     out = np.zeros_like(q)
     for b in range(B):
@@ -335,8 +441,8 @@ def paged_attention_reference(q, k_pages, v_pages, block_tables,
         ks, vs = [], []
         for t in range(n):
             p = block_tables[b, t // page]
-            ks.append(k_pages[:, p, t % page])
-            vs.append(v_pages[:, p, t % page])
+            ks.append(k_pages[p, t % page].reshape(KVH, D))
+            vs.append(v_pages[p, t % page].reshape(KVH, D))
         k = np.stack(ks)  # [n, KVH, D]
         v = np.stack(vs)
         for h in range(H):

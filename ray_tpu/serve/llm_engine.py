@@ -166,7 +166,7 @@ class LLMEngine:
                  max_batch: int = 8, seed: int = 0,
                  enable_prefix_caching: bool = True,
                  speculative_k: int = 0, speculative_ngram: int = 2,
-                 multi_step: int = 1):
+                 multi_step: int = 1, pipeline_depth: int = 2):
         import jax
 
         c = config
@@ -186,9 +186,38 @@ class LLMEngine:
         # host-overhead/dispatch-latency amortizer (models/decoding.py
         # decode_multi_step). 1 = classic per-token stepping.
         self.multi_step = max(1, int(multi_step))
+        # Pipelined chunk dispatch (greedy multi-step only): chunk k+1
+        # is dispatched off chunk k's DEVICE-resident final state
+        # (decode_multi_step returns tokens/positions/ctx as device
+        # arrays) while chunk k's token transfer is still in flight, so
+        # the device runs back-to-back and the host/tunnel round-trip
+        # latency (~70-100 ms on a tunneled dev chip) hides behind
+        # compute instead of stalling every chunk.  Admissions fold in
+        # between chunks via merge_slot_state — continuous batching
+        # keeps its <= multi_step-token admission latency WITHOUT
+        # paying a sync per chunk.  Depth 1 = dispatch-then-reconcile
+        # (classic synchronous behavior).
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._inflight: List[dict] = []  # FIFO of dispatched chunks
+        self._dstate = None  # device (tokens, positions, ctx, lim, eos)
+        self._dirty_slots: set = set()  # freed slots to zero on device
+        self._just_admitted: set = set()  # slots to fold into dstate
         self.max_pages_per_seq = math.ceil(c.max_seq_len / page_size)
-        self.params = params if params is not None else tfm.init_params(
+        params = params if params is not None else tfm.init_params(
             c, jax.random.key(seed))
+        # Serve in the compute dtype: params arrive in param_dtype (fp32
+        # master weights — a training artifact), but every decode
+        # iteration streams ALL weights from HBM, so fp32 storage would
+        # double the traffic of the bandwidth-bound decode step and cap
+        # the engine at half its roofline.  The forward casts per-use
+        # (`.astype(c.dtype)`), so a one-time cast here is numerically
+        # identical and makes the per-step reads bf16-sized.
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(
+            lambda x: x.astype(c.dtype)
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x, params)
         self.cache = init_kv_pages(c, num_pages, page_size)
         self.allocator = PageAllocator(num_pages)
         self.prefix_cache = (PrefixCache(page_size)
@@ -241,15 +270,53 @@ class LLMEngine:
         return sum(r is not None for r in self.slot_req)
 
     def has_work(self) -> bool:
-        return bool(self.waiting) or self.num_active > 0
+        return bool(self.waiting) or self.num_active > 0 \
+            or bool(self._inflight)
 
     def step(self) -> Dict[int, List[int]]:
-        """Admit waiting requests (prefill), then one batched decode step.
-        Returns requests that finished THIS step ({req_id: tokens})."""
-        done = self._admit()
+        """Admit waiting requests (prefill), then one batched decode step
+        (a pipelined multi_step chunk on the greedy path).  Returns
+        requests that finished THIS step ({req_id: tokens}); with
+        pipelining, a request's completion surfaces when its chunk's
+        tokens are reconciled (<= pipeline_depth steps after the chunk
+        that produced them)."""
+        done: Dict[int, List[int]] = {}
+        if self._pipelined_ok():
+            # Admissions need free slots: recycle the oldest in-flight
+            # chunk first when the queue would otherwise starve.
+            if self.waiting and not self._free_slots() and self._inflight:
+                self._reconcile_oldest(done)
+            done.update(self._admit())
+            if not self._pipelined_ok():
+                # An admission just seated a sampling request: drain
+                # and run this step on the classic per-token path.
+                self._flush_pipeline(done)
+                if self.num_active:
+                    done.update(self._decode())
+                return done
+            dispatched = self._dispatch_chunk()
+            if len(self._inflight) >= self.pipeline_depth \
+                    or (self._inflight and not dispatched):
+                self._reconcile_oldest(done)
+            return done
+        self._flush_pipeline(done)
+        done.update(self._admit())
         if self.num_active:
             done.update(self._decode())
         return done
+
+    def _pipelined_ok(self) -> bool:
+        """Pipelined chunk decode serves the greedy multi-step path;
+        sampling and speculative slots need per-token host control and
+        fall back to the classic synchronous step.  Only ACTIVE slots
+        are checked: a sampling request still in the queue must not
+        degrade a full greedy batch (it can't run anyway until a slot
+        frees); the post-admission re-check in step() handles the
+        moment it actually lands."""
+        if self.multi_step <= 1 or self.spec_k > 0:
+            return False
+        return not any(r is not None and r.temperature > 0.0
+                       for r in self.slot_req)
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int = 32, *,
@@ -422,9 +489,138 @@ class LLMEngine:
         self.context_lens[req.slot] = L
         self.last_tokens[req.slot] = next_tok
         req.generated.append(int(next_tok))
+        self._just_admitted.add(req.slot)  # pipelined path merges it in
         fin = self._maybe_finish(req)
         if fin is not None:  # e.g. max_new_tokens == 1
             done[req.req_id] = fin
+
+    # -- pipelined chunk decode (greedy multi-step) ------------------------
+    def _slot_state_rows(self, slot: int):
+        """Host-authoritative device-state row for one slot: live slots
+        mirror the armed decode state; empty slots read as dead
+        (pos=-1, ctx=0) so the device skips their attention and drops
+        their writes."""
+        req = self.slot_req[slot]
+        if req is None:
+            return 0, -1, 0, -1, -1
+        cl = int(self.context_lens[slot])
+        limit = len(req.prompt) + req.max_new_tokens - 1
+        eos = req.eos_token if req.eos_token is not None else -1
+        return int(self.last_tokens[slot]), cl, cl + 1, limit, eos
+
+    def _sync_dstate(self):
+        """Create or update the device-chained decode state.  A full
+        rebuild only happens entering pipelined mode; afterwards host
+        slot changes (admissions, frees) fold in via ONE masked-select
+        dispatch (merge_slot_state) — never a device read-back."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import merge_slot_state
+
+        B = self.max_batch
+        if self._dstate is None:
+            rows = [self._slot_state_rows(s) for s in range(B)]
+            cols = list(zip(*rows))
+            self._dstate = tuple(
+                jnp.asarray(np.asarray(c, dtype=np.int32)) for c in cols)
+            self._just_admitted.clear()
+            self._dirty_slots.clear()
+            return
+        changed = self._just_admitted | self._dirty_slots
+        if not changed:
+            return
+        mask = np.zeros(B, dtype=bool)
+        new = np.zeros((5, B), dtype=np.int32)
+        for s in changed:
+            mask[s] = True
+            new[:, s] = self._slot_state_rows(s)
+        self._dstate = merge_slot_state(
+            *self._dstate, jnp.asarray(mask), *map(jnp.asarray, new))
+        self._just_admitted.clear()
+        self._dirty_slots.clear()
+
+    def _inflight_tokens(self, slot: int) -> int:
+        """Upper bound on tokens already dispatched for a slot in
+        chunks not yet reconciled."""
+        return sum(ch["planned"].get(slot, 0) for ch in self._inflight)
+
+    def _dispatch_chunk(self) -> bool:
+        """Dispatch one multi_step decode chunk off the device-chained
+        state.  Never blocks: inputs are the previous chunk's device
+        arrays plus the (tiny) host block tables.  Returns False when
+        every expected token is already in flight."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import decode_multi_step
+
+        n = self.multi_step
+        snapshot: Dict[int, _Request] = {}
+        planned: Dict[int, int] = {}
+        max_ub = 1
+        for slot, req in enumerate(self.slot_req):
+            if req is None:
+                continue
+            rem = (req.max_new_tokens - len(req.generated)
+                   - self._inflight_tokens(slot))
+            if rem > 0:
+                snapshot[slot] = req
+                planned[slot] = min(n, rem)
+                # Furthest position this chunk can WRITE for the slot.
+                max_ub = max(max_ub, int(self.context_lens[slot])
+                             + self._inflight_tokens(slot) + min(n, rem))
+        if not snapshot:
+            return False
+        self._sync_dstate()
+        pages_needed = max(1, math.ceil(max_ub / self.page_size))
+        W = min(self.max_pages_per_seq,
+                1 << (pages_needed - 1).bit_length())
+        tables = jnp.asarray(self.block_tables[:, :W])
+        toks, pos, ctx, lim, eos = self._dstate
+        out, toks, pos, ctx, self.cache = decode_multi_step(
+            self.params, toks, self.cache, tables, pos, ctx, lim, eos,
+            self.config, n)
+        self._dstate = (toks, pos, ctx, lim, eos)
+        self._inflight.append(
+            {"out": out, "snapshot": snapshot, "planned": planned,
+             "n": n})
+        return True
+
+    def _reconcile_oldest(self, done: Dict[int, List[int]]):
+        """Materialize the oldest in-flight chunk's tokens (this is the
+        only point the pipelined path waits on the device) and replay
+        them into host state: append tokens, advance context mirrors,
+        finish/free requests.  Rows for slots that died device-side
+        (limit/EOS) carry -1 past the stop."""
+        ch = self._inflight.pop(0)
+        toks = np.asarray(ch["out"])
+        for slot, req in ch["snapshot"].items():
+            if self.slot_req[slot] is not req:
+                continue  # finished in an earlier chunk; rows are -1
+            for j in range(ch["n"]):
+                tok = int(toks[slot, j])
+                if tok < 0:
+                    break
+                self.context_lens[slot] += 1
+                self.last_tokens[slot] = tok
+                req.generated.append(tok)
+                fin = self._maybe_finish(req)
+                if fin is not None:
+                    done[req.req_id] = fin
+                    # Zero the slot on device at the next merge so
+                    # in-flight chunks' dead-slot attention stops
+                    # burning bandwidth on freed pages.
+                    self._dirty_slots.add(slot)
+                    break
+
+    def _flush_pipeline(self, done: Dict[int, List[int]]):
+        """Drain every in-flight chunk and drop the device state (host
+        mirrors become authoritative) — the classic path and mode
+        switches run against host state."""
+        while self._inflight:
+            self._reconcile_oldest(done)
+        self._dstate = None
+        self._just_admitted.clear()
+        self._dirty_slots.clear()
 
     def _draft_for(self, req: _Request, k: int) -> List[int]:
         """Prompt-lookup drafting (n-gram match): copy what followed the
@@ -559,50 +755,15 @@ class LLMEngine:
         # O(B·W·page) PER LAYER, so passing the full max_seq_len-wide
         # tables made every step pay for contexts nobody had (measured
         # 15-20x step-time inflation at 2k max_seq_len / 256-token
-        # contexts on v5e).  The width must also cover the furthest
-        # position a multi-step burst can write.
-        n = self.multi_step
-        if n > 1 and (spec_slots or any(
-                r is not None and r.temperature > 0.0
-                for r in self.slot_req)):
-            n = 1  # sampling/spec slots need per-token host control
-        max_write = int(ctx.max(initial=1)) + (n - 1)
-        pages_needed = max(1, math.ceil(max_write / self.page_size))
+        # contexts on v5e).  Greedy multi-step batches route through
+        # the pipelined chunk path (_dispatch_chunk) before reaching
+        # here; this classic step serves sampling/spec slots one token
+        # at a time.
+        pages_needed = max(1, math.ceil(int(ctx.max(initial=1))
+                                        / self.page_size))
         W = min(self.max_pages_per_seq,
                 1 << (pages_needed - 1).bit_length())
         tables = jnp.asarray(self.block_tables[:, :W])
-
-        if n > 1:
-            from ray_tpu.models.decoding import decode_multi_step
-
-            limits = np.zeros(self.max_batch, dtype=np.int32)
-            eos = np.full(self.max_batch, -1, dtype=np.int32)
-            for slot, req in enumerate(self.slot_req):
-                if req is None:
-                    continue
-                limits[slot] = len(req.prompt) + req.max_new_tokens - 1
-                if req.eos_token is not None:
-                    eos[slot] = req.eos_token
-            toks, self.cache = decode_multi_step(
-                self.params, jnp.asarray(self.last_tokens), self.cache,
-                tables, jnp.asarray(positions), jnp.asarray(ctx),
-                jnp.asarray(limits), jnp.asarray(eos), self.config, n)
-            toks = np.asarray(toks)  # [B, n] — the ONLY device sync
-            for slot, req in enumerate(self.slot_req):
-                if req is None:
-                    continue
-                for j in range(n):
-                    tok = int(toks[slot, j])
-                    if tok < 0:
-                        break
-                    self.context_lens[slot] += 1
-                    self.last_tokens[slot] = tok
-                    req.generated.append(tok)
-                    fin = self._maybe_finish(req)
-                    if fin is not None:
-                        done[req.req_id] = fin
-                        break
-            return done
 
         logits, self.cache = decode_step(
             self.params, jnp.asarray(self.last_tokens), self.cache,
