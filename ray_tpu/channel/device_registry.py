@@ -1,0 +1,74 @@
+"""Process-local device-array handoff registry for tensor channels.
+
+The device-native channel tier (tensor_channel.py) moves jax.Arrays
+between pipeline stages WITHOUT host staging when both endpoints live in
+one process — the TPU-normal topology: one host process drives all its
+local chips through a single XLA client, so a pipeline stage handoff is
+a chip-to-chip `jax.device_put` over ICI.  (The reference reaches the
+same capability with one process per GPU bridged by NCCL,
+python/ray/experimental/channel/nccl_group.py:19 — on TPU that shape
+would forfeit the single-client d2d path, so the process boundary moves
+to the host.)
+
+The shm slot still carries the message FRAME (sequencing, backpressure,
+error envelopes); only the array payload bypasses it: the writer
+publishes the device arrays here keyed by (channel path, seq) and
+readers in the same process take them directly.  Writers decide per
+message: the token mode is only used when EVERY reader of the channel
+has registered from this process, so a cross-process consumer always
+gets the host-bytes fallback and can never see an unresolvable token.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Tuple
+
+_lock = threading.Lock()
+_readers: Dict[str, int] = {}          # path -> local reader endpoints
+_entries: Dict[Tuple[str, int], list] = {}  # (path, seq) -> [value, refs]
+
+
+def register_reader(path: str) -> None:
+    with _lock:
+        _readers[path] = _readers.get(path, 0) + 1
+
+
+def unregister_reader(path: str) -> None:
+    with _lock:
+        n = _readers.get(path, 0) - 1
+        if n <= 0:
+            _readers.pop(path, None)
+        else:
+            _readers[path] = n
+
+
+def local_reader_count(path: str) -> int:
+    with _lock:
+        return _readers.get(path, 0)
+
+
+def publish(path: str, seq: int, value: Any, nreaders: int) -> None:
+    with _lock:
+        _entries[(path, seq)] = [value, nreaders]
+
+
+def take(path: str, seq: int):
+    """Fetch the published value for (path, seq); the entry is dropped
+    once every reader took it.  Returns None when absent (the writer
+    used the bytes fallback for this message)."""
+    with _lock:
+        ent = _entries.get((path, seq))
+        if ent is None:
+            return None
+        ent[1] -= 1
+        if ent[1] <= 0:
+            del _entries[(path, seq)]
+        return ent[0]
+
+
+def purge(path: str) -> None:
+    """Drop any unconsumed entries for a channel (teardown)."""
+    with _lock:
+        for key in [k for k in _entries if k[0] == path]:
+            del _entries[key]

@@ -128,13 +128,20 @@ class Channel:
             delay = min(delay * 2, _POLL_MAX_S)
 
     # -- API -------------------------------------------------------------
-    def write(self, value: Any, timeout: Optional[float] = None):
-        """Write the next value; blocks until every reader consumed the
-        previous one (single-slot backpressure)."""
+    def _wait_writable(self, timeout: Optional[float]) -> int:
+        """Single-slot backpressure shared by every transport tier:
+        block until all readers acked the previous value; returns the
+        sequence number to publish under."""
         seq = self._seq()
         self._wait(
             lambda: all(self._ack(i) >= seq for i in range(self.num_readers)),
             timeout, "write")
+        return seq
+
+    def write(self, value: Any, timeout: Optional[float] = None):
+        """Write the next value; blocks until every reader consumed the
+        previous one (single-slot backpressure)."""
+        seq = self._wait_writable(timeout)
         ser = serialization.serialize(value)
         n = ser.total_bytes
         kind = _KIND_INLINE

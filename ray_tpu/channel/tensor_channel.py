@@ -4,14 +4,23 @@ Counterpart of the reference's NCCL channel tier
 (python/ray/experimental/channel/torch_tensor_nccl_channel.py +
 torch_tensor_type.py): a `.with_tensor_transport()` hint on a DAG node
 switches that node's output edges to a TENSOR protocol — no pickle
-anywhere on the hot path.  v1 is host-mediated (the VERDICT's
-"jax.device_put between jitted steps"): the producer DMAs the device
-array to host (np.asarray) and copies raw bytes + a fixed struct header
-straight into the mutable shm slot; the consumer views the slot memory
-(np.frombuffer, zero-copy) and `jax.device_put`s it onto its own
-device, ready for the next jitted stage.  On a multi-chip runtime the
-same hint upgrades to ICI send/recv compiled into the stage programs;
-the channel protocol (header + raw payload) is transport-agnostic.
+anywhere on the hot path.  Two transports, chosen per message:
+
+  - DEVICE-NATIVE (zero host copies): when every reader of the edge
+    lives in the writer's process — the TPU-normal topology, one host
+    process driving all local chips through one XLA client
+    (dag/device_stage.py stages) — the shm slot carries only a frame;
+    the jax.Arrays hand over through the process-local registry
+    (channel/device_registry.py) and land on the consumer's device via
+    `jax.device_put`, a chip-to-chip ICI copy.  The reference needs
+    NCCL for this because its stages are separate processes per GPU;
+    the JAX client makes the same capability a d2d transfer.
+    Asserted host-transfer-free by
+    tests/test_dag.py::test_device_native_dag_zero_host_copies under
+    jax transfer guards.
+  - HOST-SHM (explicit fallback): cross-process consumers get raw
+    array bytes + a fixed struct header in the slot (producer
+    np.asarray -> shm; consumer np.frombuffer view -> device_put).
 
 Supports a single array or a flat tuple/list of arrays per message.
 """
@@ -23,22 +32,31 @@ from typing import Any, Optional
 
 import numpy as np
 
+from ray_tpu.channel import device_registry
 from ray_tpu.channel.shared_memory_channel import (
     _PAYLOAD_OFF,
     Channel,
 )
 
-# payload layout: u32 count, then per tensor:
+# payload layout (kind 2, host bytes): u32 count, then per tensor:
 #   u32 dtype_len, dtype bytes, u32 ndim, u64 x ndim shape, u64 nbytes,
 #   raw buffer
+# payload layout (kind 3, device token): u32 count (arrays live in the
+#   process-local registry keyed by (path, seq))
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
+
+_KIND_TENSOR_BYTES = 2
+_KIND_TENSOR_TOKEN = 3
 
 
 class TensorType:
     """Edge hint: values on this edge are device tensors; move them via
     the tensor protocol instead of pickle (reference
-    experimental/channel/torch_tensor_type.py)."""
+    experimental/channel/torch_tensor_type.py).
+
+    transport: "auto" picks device-native when the edge's endpoints
+    share a process, host-shm otherwise; "shm" forces the host path."""
 
     def __init__(self, transport: str = "auto", device: str = "auto"):
         self.transport = transport
@@ -48,12 +66,43 @@ class TensorType:
         return f"TensorType(transport={self.transport!r})"
 
 
-class DeviceTensorChannel(Channel):
-    """Channel endpoint speaking the raw-tensor protocol."""
+_jax_array_type = None
 
-    def __init__(self, *args, device=None, **kwargs):
+
+def _is_jax_array(a) -> bool:
+    global _jax_array_type
+    if _jax_array_type is None:
+        try:
+            import jax
+
+            _jax_array_type = jax.Array
+        except Exception:  # noqa: BLE001
+            _jax_array_type = ()  # jax absent: nothing ever matches
+    return isinstance(a, _jax_array_type)
+
+
+class DeviceTensorChannel(Channel):
+    """Channel endpoint speaking the tensor protocol."""
+
+    def __init__(self, *args, device=None, transport: str = "auto",
+                 **kwargs):
         super().__init__(*args, **kwargs)
         self._device = device
+        self._transport = transport
+        self._registered = False
+        if self.reader_idx is not None:
+            device_registry.register_reader(self.path)
+            self._registered = True
+
+    def close(self):
+        if self._registered:
+            device_registry.unregister_reader(self.path)
+            self._registered = False
+        super().close()
+
+    def destroy(self):
+        device_registry.purge(self.path)
+        super().destroy()
 
     # -- write ----------------------------------------------------------
     def write(self, value: Any, timeout: Optional[float] = None):
@@ -64,6 +113,26 @@ class DeviceTensorChannel(Channel):
             # a failing stage): fall back to the pickle protocol; the
             # reader dispatches on the kind field.
             return Channel.write(self, value, timeout)
+        if (self._transport != "shm"
+                and device_registry.local_reader_count(self.path)
+                >= self.num_readers
+                and all(_is_jax_array(a) for a in arrays)):
+            return self._write_token(arrays, timeout)
+        return self._write_bytes(arrays, timeout)
+
+    def _write_token(self, arrays, timeout):
+        """Device-native handoff: frame through shm, arrays through the
+        process-local registry — the payload never touches the host."""
+        seq = self._wait_writable(timeout)
+        device_registry.publish(self.path, seq, tuple(arrays),
+                                self.num_readers)
+        mm = self._mm
+        _U32.pack_into(mm, _PAYLOAD_OFF, len(arrays))
+        struct.pack_into("<Q", mm, 24, _U32.size)  # msg_len
+        struct.pack_into("<I", mm, 32, _KIND_TENSOR_TOKEN)
+        self._set_seq(seq + 1)
+
+    def _write_bytes(self, arrays, timeout):
         hosts = [np.asarray(a) for a in arrays]  # device->host DMA
         total = _U32.size
         metas = []
@@ -77,11 +146,7 @@ class DeviceTensorChannel(Channel):
                 f"tensor message of {total} bytes exceeds channel "
                 f"capacity {self.capacity}; size the DAG's "
                 "buffer_size_bytes for the largest stage output")
-        seq = self._seq()
-        self._wait(
-            lambda: all(self._ack(i) >= seq
-                        for i in range(self.num_readers)),
-            timeout, "write")
+        seq = self._wait_writable(timeout)
         mm = self._mm
         off = _PAYLOAD_OFF
         _U32.pack_into(mm, off, len(hosts))
@@ -102,7 +167,7 @@ class DeviceTensorChannel(Channel):
             mm[off:off + h.nbytes] = mv
             off += h.nbytes
         struct.pack_into("<Q", mm, 24, off - _PAYLOAD_OFF)  # msg_len
-        struct.pack_into("<I", mm, 32, 2)  # kind: tensor protocol
+        struct.pack_into("<I", mm, 32, _KIND_TENSOR_BYTES)
         self._set_seq(seq + 1)
 
     # -- read -----------------------------------------------------------
@@ -112,12 +177,49 @@ class DeviceTensorChannel(Channel):
         my = self._ack(self.reader_idx)
         self._wait(lambda: self._seq() > my, timeout, "read")
         (kind,) = _U32.unpack_from(self._mm, 32)
-        if kind != 2:
+        if kind == _KIND_TENSOR_TOKEN:
+            return self._read_token(my)
+        if kind != _KIND_TENSOR_BYTES:
             # Pickle-protocol payload (error envelope — possibly
             # ref-spilled): the base reader handles inline AND spilled
             # kinds and acks; the slot is still unread for us, so its
             # wait returns immediately.
             return Channel.read(self, timeout)
+        return self._read_bytes(my)
+
+    def _read_token(self, my: int) -> Any:
+        import jax
+
+        value = device_registry.take(self.path, my)
+        if value is None:
+            raise RuntimeError(
+                f"device-token message {my} on {self.path} has no "
+                "registry entry in this process — writer/reader "
+                "locality handshake broken")
+        out = []
+        for a in value:
+            if self._device is not None \
+                    and a.device != self._device:
+                # Chip-to-chip placement (ICI d2d) — no host staging.
+                a = jax.device_put(a, self._device)
+            else:
+                # Same device (or unpinned endpoint): an on-device copy
+                # insulates the consumer from writer-side donation or
+                # reuse — without it the consumer would hold the
+                # WRITER's buffer, and a jit(donate_argnums=...) in
+                # either stage would delete it under the other.
+                import jax.numpy as jnp
+
+                a = jnp.copy(a)
+            out.append(a)
+        # The d2d copy must complete before the ack releases the slot:
+        # the writer may overwrite/donate its buffer next iteration.
+        for a in out:
+            jax.block_until_ready(a)
+        self._set_ack(self.reader_idx, my + 1)
+        return out[0] if len(out) == 1 else tuple(out)
+
+    def _read_bytes(self, my: int) -> Any:
         import jax
 
         mm = self._mm

@@ -16,10 +16,11 @@ from ray_tpu.dag.dag_node import (
     InputNode,
     MultiOutputNode,
 )
+from ray_tpu.dag.device_stage import DeviceStageActor
 
 __all__ = [
     "DAGNode", "InputNode", "FunctionNode", "ClassMethodNode",
-    "MultiOutputNode",
+    "MultiOutputNode", "DeviceStageActor",
 ]
 
 # Feature-usage tag (util/usage_stats.py; local-only, no egress).

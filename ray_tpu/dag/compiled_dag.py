@@ -208,9 +208,15 @@ class CompiledDAG:
         # Pin each actor with its loop descriptor. Channel endpoints are
         # shipped as (path, reader_idx) SPECS and opened inside the actor
         # — opening them here too would leak one fd+mmap per edge per
-        # compile on the driver.
+        # compile on the driver.  DeviceStageActor stages (in-process
+        # device pipelines, dag/device_stage.py) run the SAME loop on a
+        # driver thread instead: their tensor edges then hand device
+        # arrays over without host staging.
+        from ray_tpu.dag.device_stage import DeviceStageActor
+
         self._loop_refs = []
         self._actors = []
+        self._local_loops: List[threading.Thread] = []
         for n in actor_nodes:
             slots = node_slots[n._uid]
 
@@ -231,6 +237,16 @@ class CompiledDAG:
                            is_tensor_edge(n._uid))
                 if n._uid in self._channels else None,
             }
+            if isinstance(n._actor, DeviceStageActor):
+                desc["device"] = n._actor.device
+                t = threading.Thread(
+                    target=run_actor_loop,
+                    args=(n._actor._instance, desc),
+                    daemon=True,
+                    name=f"dag-stage-{n._method_name}")
+                t.start()
+                self._local_loops.append(t)
+                continue
             self._actors.append(n._actor)
             self._loop_refs.append(
                 ActorMethod(n._actor, _LOOP_METHOD).remote(desc))
@@ -286,9 +302,12 @@ class CompiledDAG:
         from ray_tpu.core import api
 
         try:
-            api.get(self._loop_refs, timeout=5.0)
+            if self._loop_refs:
+                api.get(self._loop_refs, timeout=5.0)
         except Exception:
             pass
+        for t in self._local_loops:
+            t.join(timeout=5.0)
         for ch in self._channels.values():
             ch.destroy()
 
@@ -333,8 +352,12 @@ def run_actor_loop(instance, desc: dict) -> int:
         from ray_tpu.channel.tensor_channel import DeviceTensorChannel
 
         path, reader_idx = spec[0], spec[1]
-        cls = DeviceTensorChannel if tensor else Channel
-        return cls(path, reader_idx=reader_idx)
+        if tensor:
+            # In-process device stages pin their consumer device so
+            # token-mode reads land arrays chip-to-chip (d2d).
+            return DeviceTensorChannel(path, reader_idx=reader_idx,
+                                       device=desc.get("device"))
+        return Channel(path, reader_idx=reader_idx)
 
     arg_tmpl = [("chan", open_chan(v, tensor=(k == "devchan")))
                 if k in ("chan", "devchan") else (k, v)
@@ -348,31 +371,60 @@ def run_actor_loop(instance, desc: dict) -> int:
         out = open_chan(od[:2], tensor=bool(od[2]) if len(od) > 2
                         else False)
     count = 0
-    while True:
-        try:
-            args = [
-                v.read() if kind == "chan" else v
-                for kind, v in arg_tmpl
-            ]
-            kwargs = {
-                k: (v.read() if kind == "chan" else v)
-                for k, (kind, v) in kwarg_tmpl.items()
-            }
-            upstream_err = next(
-                (a for a in args if isinstance(a, DagExecutionError)), None
-            ) or next(
-                (v for v in kwargs.values()
-                 if isinstance(v, DagExecutionError)), None)
-            if upstream_err is not None:
-                result = upstream_err  # forward, don't execute
-            else:
-                try:
-                    result = method(*args, **kwargs)
-                except Exception:  # noqa: BLE001
-                    result = DagExecutionError(
-                        desc["method"], traceback.format_exc())
-            if out is not None:
-                out.write(result)
-            count += 1
-        except ChannelClosedError:
-            return count
+    try:
+        while True:
+            try:
+                args = [
+                    v.read() if kind == "chan" else v
+                    for kind, v in arg_tmpl
+                ]
+                kwargs = {
+                    k: (v.read() if kind == "chan" else v)
+                    for k, (kind, v) in kwarg_tmpl.items()
+                }
+                upstream_err = next(
+                    (a for a in args if isinstance(a, DagExecutionError)),
+                    None
+                ) or next(
+                    (v for v in kwargs.values()
+                     if isinstance(v, DagExecutionError)), None)
+                if upstream_err is not None:
+                    result = upstream_err  # forward, don't execute
+                else:
+                    try:
+                        result = method(*args, **kwargs)
+                    except Exception:  # noqa: BLE001
+                        result = DagExecutionError(
+                            desc["method"], traceback.format_exc())
+                if out is not None:
+                    out.write(result)
+                count += 1
+            except ChannelClosedError:
+                return count
+            except Exception:  # noqa: BLE001
+                # A CHANNEL failure (oversized tensor message, broken
+                # token handshake, ...) — not the stage method, which is
+                # handled above.  Dying silently would wedge the whole
+                # pipeline: downstream reads and the driver's get()
+                # block forever.  Forward an error envelope so the
+                # driver raises, then keep serving (the next execute()
+                # may be fine, e.g. with a smaller payload).
+                env = DagExecutionError(
+                    desc["method"], traceback.format_exc())
+                if out is None:
+                    raise
+                out.write(env)
+                count += 1
+    finally:
+        # Close every endpoint this loop opened: releases fds/mmaps and
+        # (for device-tensor readers) the process-local registry
+        # registration — in-process stage loops otherwise leak a
+        # registry entry per compile for the driver's lifetime.
+        for kind, v in arg_tmpl:
+            if kind == "chan":
+                v.close()
+        for kind, v in kwarg_tmpl.values():
+            if kind == "chan":
+                v.close()
+        if out is not None:
+            out.close()

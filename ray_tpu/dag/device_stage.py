@@ -1,0 +1,78 @@
+"""In-process device stages for compiled DAGs.
+
+On TPU, one host process drives all of its local chips through a single
+XLA client — that is the deployment shape JAX/libtpu require (one
+process per host, `jax.local_devices()` = the host's chips).  A
+pipeline whose stages sit on different chips of the same host therefore
+belongs in ONE process, with stage handoff as a chip-to-chip
+`jax.device_put` over ICI.  The reference gets the equivalent
+capability from one process per GPU bridged by NCCL channels
+(python/ray/experimental/channel/nccl_group.py:19,
+torch_tensor_nccl_channel.py); porting that process-per-device shape to
+TPU would forfeit the single-client d2d path, so the process boundary
+moves up to the host and the compiled DAG runs its stage loops on
+threads.
+
+``DeviceStageActor`` hosts a stage instance pinned to one device and
+quacks enough like an actor handle for DAG building::
+
+    s1 = DeviceStageActor(MyStage, device=jax.devices()[1])
+    s2 = DeviceStageActor(MyStage, device=jax.devices()[2])
+    with InputNode() as inp:
+        dag = s2.step.bind(
+            s1.step.bind(inp.with_tensor_transport())
+              .with_tensor_transport()).with_tensor_transport()
+    compiled = dag.experimental_compile()
+
+Edges hinted `.with_tensor_transport()` then use the device-native
+channel tier (channel/tensor_channel.py): the shm slot carries only a
+frame, arrays hand over in-process and land on the consumer's device
+without EVER staging through host memory — asserted under jax transfer
+guards in tests/test_dag.py.  Stage loops run on daemon threads; the
+GIL releases during device execution, so stages pipeline like their
+process-actor counterparts.  Remote (process) actors remain the right
+tool when stages span hosts — mix freely; the channel falls back to
+host-shm bytes per edge.
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any
+
+from ray_tpu.dag.dag_node import ClassMethodNode
+
+
+class _LocalMethod:
+    """Bound-method shim exposing ``.bind`` for DAG authoring."""
+
+    def __init__(self, actor: "DeviceStageActor", name: str):
+        self._actor = actor
+        self._name = name
+
+    def bind(self, *args, **kwargs) -> ClassMethodNode:
+        return ClassMethodNode(self._actor, self._name, args, kwargs)
+
+
+class DeviceStageActor:
+    """A pipeline-stage host living in the driver process, pinned to
+    one local device.  Only compiled DAGs drive it (there is no task
+    queue or process behind it — `.remote()` calls belong to real
+    actors)."""
+
+    def __init__(self, cls, *args, device=None, **kwargs):
+        self._instance = cls(*args, **kwargs)
+        self.device = device
+        self._actor_hex = f"devstage-{uuid.uuid4().hex[:12]}"
+
+    def __repr__(self):
+        return (f"DeviceStageActor({type(self._instance).__name__}, "
+                f"device={self.device})")
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if not callable(getattr(self._instance, name, None)):
+            raise AttributeError(
+                f"{type(self._instance).__name__} has no method {name!r}")
+        return _LocalMethod(self, name)
