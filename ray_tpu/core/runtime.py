@@ -1110,7 +1110,13 @@ class CoreClient:
 
     def _load_object(self, obj_hex: str, info: dict,
                      timeout: Optional[float] = None,
-                     _retried: bool = False) -> Any:
+                     _attempt: int = 0,
+                     _deadline: Optional[float] = None) -> Any:
+        # An explicit caller timeout is a TOTAL budget across every
+        # refetch retry round, not per round: convert it to a deadline
+        # once and hand each round the remainder.
+        if timeout is not None and _deadline is None:
+            _deadline = time.monotonic() + timeout
         if info.get("direct"):
             # Owner-direct actor result: the serialized bytes arrived on
             # the direct actor connection (never touched the head).
@@ -1145,7 +1151,7 @@ class CoreClient:
                         data = self._pull_remote_object(obj_hex, info)
                         return self._finish_load(obj_hex, data, info)
                     except Exception:
-                        if _retried:
+                        if _attempt >= 3:
                             raise
                         # Node dead or its arena evicted the copy: tell
                         # the head (it verifies and kicks lineage
@@ -1161,22 +1167,29 @@ class CoreClient:
                 # Stale location: the server may have SPILLED the object
                 # after this client cached its in-shm info. Drop the
                 # cached future + subscription and re-subscribe — the
-                # server restores spilled objects on subscribe.
-                if _retried or not _is_missing_segment_error(e):
+                # server restores spilled objects on subscribe.  Bounded
+                # RETRIES, not one shot: under arena pressure a
+                # lineage-reconstructed value can get spilled again
+                # between the server's publish and our attach, and one
+                # more subscribe round is the correct response.
+                if _attempt >= 3 or not _is_missing_segment_error(e):
                     raise
                 fut = self._refetch_object(obj_hex)
                 try:
-                    # Honor an explicit caller timeout fully; for
+                    # Honor an explicit caller deadline fully; for
                     # timeout=None gets, bound the wait generously (a
                     # truly freed object's fresh subscription would stay
                     # PENDING forever, but slow external-storage restores
                     # must be allowed to finish).
                     info2 = fut.result(
-                        timeout=timeout if timeout is not None else 300.0)
+                        timeout=max(_deadline - time.monotonic(), 0.1)
+                        if _deadline is not None else 300.0)
                 except TimeoutError:
                     raise GetTimeoutError(
                         f"timed out refetching {obj_hex}") from None
-                return self._load_object(obj_hex, info2, _retried=True)
+                return self._load_object(obj_hex, info2,
+                                         _attempt=_attempt + 1,
+                                         _deadline=_deadline)
             data = seg.buf[: info["size"]]
         else:
             raise RuntimeError(f"object {obj_hex} ready but has no payload")

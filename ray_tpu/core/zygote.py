@@ -99,22 +99,30 @@ class _ZygoteServer:
         self.children: Dict[int, str] = {}  # pid -> spawn nonce ("" if none)
         self.exited: Dict[int, int] = {}  # pid -> exit code (drained by poll)
         self.parent_pid = os.getppid()
+        self._jax_warmed = False
         self._fork_unsafe = False
 
     def warm(self) -> None:
-        """Import the worker stack.  Runs after bind/listen so the owner's
-        connect() never races it, and BEFORE serve_forever: the owner's
-        first request already tolerates up to 120 s of warm imports, and a
-        multi-second jax import inside the serve loop would stall
-        spawn/poll requests into their 5 s client timeouts (three of
-        which permanently disable the zygote fast path)."""
+        """Import the worker stack (fast — a few hundred ms).  Runs after
+        bind/listen so the owner's connect() never races it.  The heavier
+        jax import stays DEFERRED to idle loop ticks (_warm_jax): at
+        cluster boot every template (head + each node manager) would
+        otherwise burn seconds of CPU importing jax concurrently with
+        worker spawns — on small hosts that starves remote nodes of
+        their first workers and measurably skews scheduling.  A
+        spawn/poll colliding with the deferred import times out
+        client-side and falls back to Popen; the owner's stale-nonce
+        reap cleans up if the buffered spawn executes later, and the
+        prewarm ping's long reconnect timeout (ZygoteHandle._ensure)
+        keeps those collisions from counting toward the disable
+        threshold."""
         import ray_tpu.core.worker  # noqa: F401  (the whole point)
 
         try:
             import numpy  # noqa: F401
         except Exception:
             pass
-        self._warm_jax()  # ends with the fork-safety thread check
+        self._check_fork_safe()
 
     def _check_fork_safe(self) -> None:
         if threading.active_count() > 1:
@@ -127,9 +135,10 @@ class _ZygoteServer:
             self._fork_unsafe = True
 
     def _warm_jax(self) -> None:
-        """Import jax — import only, never backend init: XLA client/device
-        threads must be created per-child, post-fork, under each worker's
-        own XLA_FLAGS/platform env."""
+        """Import jax on an idle tick — import only, never backend init:
+        XLA client/device threads must be created per-child, post-fork,
+        under each worker's own XLA_FLAGS/platform env."""
+        self._jax_warmed = True
         try:
             import jax  # noqa: F401
         except Exception:
@@ -147,11 +156,15 @@ class _ZygoteServer:
                 try:
                     conn, _ = self.listener.accept()
                 except socket.timeout:
+                    if not self._jax_warmed:
+                        self._warm_jax()
                     continue
                 conn.settimeout(0.5)
             try:
                 req = _recv_msg(conn)
             except socket.timeout:
+                if not self._jax_warmed:
+                    self._warm_jax()
                 continue
             except OSError:
                 req = None
@@ -420,14 +433,30 @@ class ZygoteHandle:
             self._warming = True
 
         def _bg():
+            # The template's deferred jax import (_warm_jax) can block
+            # its serve loop for seconds; a ping colliding with it times
+            # out at the normal 5 s.  That is the WARMUP WINDOW, not a
+            # broken template: retry with short attempts (each holds
+            # self._lock for at most the 5 s socket timeout, so
+            # foreground spawn/status callers stay fail-fast) until a
+            # deadline, and only count a disable strike when the whole
+            # window expires.
+            deadline = time.time() + 120.0
             try:
-                self._request({"op": "ping"}, start=True)
-                self._ready = True
-                self._failures = 0
-            except Exception:
-                self._failures += 1
-                if self._failures >= 3:
-                    self._disabled = True  # broken environment: stay on Popen
+                while True:
+                    try:
+                        self._request({"op": "ping"}, start=True)
+                        self._ready = True
+                        self._failures = 0
+                        return
+                    except Exception:
+                        if time.time() >= deadline:
+                            self._failures += 1
+                            if self._failures >= 3:
+                                # Broken environment: stay on Popen.
+                                self._disabled = True
+                            return
+                        time.sleep(1.0)
             finally:
                 self._warming = False
 

@@ -129,6 +129,27 @@ def main(argv=None) -> int:
                     std * n_gb))
     print(f"{'  -> GB/s':<50s} {mean * n_gb:>12.2f}")
 
+    # CONTROL: raw write of the same payload into a fresh tmpfs mmap —
+    # the hardware/OS ceiling for any shm-backed put on this host (page
+    # allocation + memcpy, no framework).  put_gigabytes is honest only
+    # relative to this number; the baseline host's 19.5 GB/s row ran on
+    # different silicon.
+    import mmap as _mmap
+    import tempfile as _tf
+
+    def tmpfs_control():
+        with _tf.NamedTemporaryFile(dir="/dev/shm") as f:
+            os.ftruncate(f.fileno(), big.nbytes)
+            mm = _mmap.mmap(f.fileno(), big.nbytes)
+            mm[:] = memoryview(big).cast("B")
+            mm.close()
+
+    mean, std = timeit("control_tmpfs_write_gigabytes", tmpfs_control,
+                       results=None)
+    results.append(("control_tmpfs_write_gigabytes", mean * n_gb,
+                    std * n_gb))
+    print(f"{'  -> GB/s (control)':<50s} {mean * n_gb:>12.2f}")
+
     # multi-client puts: nested putter actors (reference: separate
     # client processes)
     class Putter:
