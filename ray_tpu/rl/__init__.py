@@ -12,7 +12,14 @@ from ray_tpu.rl.env_runner_group import EnvRunnerGroup
 from ray_tpu.rl.episode import SingleAgentEpisode, episodes_to_batch
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
-from ray_tpu.rl.module import QNetworkSpec, RLModuleSpec, SACModuleSpec
+from ray_tpu.rl.module import (ConvRLModuleSpec, QNetworkSpec,
+                               RLModuleSpec, SACModuleSpec)
+from ray_tpu.rl.offline import (
+    dataset_to_episodes,
+    episodes_to_dataset,
+    read_offline_episodes,
+    write_offline_dataset,
+)
 from ray_tpu.rl.multi_agent import (
     MultiAgentEnv,
     MultiAgentEnvRunner,
@@ -39,7 +46,12 @@ __all__ = [
     "episodes_to_batch",
     "JaxLearner",
     "LearnerGroup",
+    "ConvRLModuleSpec",
     "RLModuleSpec",
+    "dataset_to_episodes",
+    "episodes_to_dataset",
+    "read_offline_episodes",
+    "write_offline_dataset",
     "MultiAgentEnv",
     "MultiAgentEnvRunner",
     "MultiAgentPPO",

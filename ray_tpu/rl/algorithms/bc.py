@@ -11,22 +11,24 @@ batch from host numpy arrays (same shape discipline as ppo.py).
 
 from __future__ import annotations
 
-import pickle
 from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ray_tpu.rl import module as rl_module
 from ray_tpu.rl.algorithm import Algorithm
 from ray_tpu.rl.config import AlgorithmConfig
 from ray_tpu.rl.episode import SingleAgentEpisode
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
+from ray_tpu.rl.offline import (
+    OfflineInputConfigMixin,
+    load_offline_episodes,
+)
 
 
-class MARWILConfig(AlgorithmConfig):
+class MARWILConfig(OfflineInputConfigMixin, AlgorithmConfig):
     def __init__(self):
         super().__init__()
         self.algo_class = MARWIL
@@ -35,17 +37,7 @@ class MARWILConfig(AlgorithmConfig):
         self.train_batch_size: int = 256
         self.num_sgd_iter: int = 16     # SGD steps per training_step
         self.lr: float = 1e-3
-        # offline_data()
-        self.input_episodes: Optional[List[SingleAgentEpisode]] = None
-        self.input_path: Optional[str] = None
-
-    def offline_data(self, *, input_episodes=None, input_path=None
-                     ) -> "MARWILConfig":
-        if input_episodes is not None:
-            self.input_episodes = input_episodes
-        if input_path is not None:
-            self.input_path = input_path
-        return self
+        self._init_offline_fields()  # offline_data() section
 
 
 class BCConfig(MARWILConfig):
@@ -63,7 +55,7 @@ class MARWILLearner(JaxLearner):
         self.vf_coeff = vf_coeff
 
     def loss(self, params, batch: Dict[str, jnp.ndarray], rng):
-        dist_inputs, values = rl_module.forward(params, batch["obs"])
+        dist_inputs, values = self.spec.forward(params, batch["obs"])
         dist = self.spec.dist(dist_inputs)
         logp = dist.logp(batch["actions"])
         if self.beta > 0.0:
@@ -85,21 +77,6 @@ class MARWILLearner(JaxLearner):
             "vf_loss": vf_loss,
             "bc_logp": jnp.mean(logp),
         }
-
-
-def load_offline_episodes(config, algo_name: str
-                          ) -> List[SingleAgentEpisode]:
-    """Shared offline-input resolution for MARWIL/BC/CQL: in-memory
-    episodes win, else a pickle path, else a clear error."""
-    episodes = config.input_episodes
-    if episodes is None and config.input_path:
-        with open(config.input_path, "rb") as f:
-            episodes = pickle.load(f)
-    if not episodes:
-        raise ValueError(
-            f"{algo_name} is offline: config.offline_data("
-            "input_episodes=...) or input_path=... is required")
-    return episodes
 
 
 class MARWIL(Algorithm):

@@ -17,12 +17,13 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.rl import module as rl_module
+from ray_tpu.rl.offline import OfflineInputConfigMixin
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig, SACLearner
 from ray_tpu.rl.episode import SingleAgentEpisode
 from ray_tpu.rl.learner_group import LearnerGroup
 
 
-class CQLConfig(SACConfig):
+class CQLConfig(OfflineInputConfigMixin, SACConfig):
     def __init__(self):
         super().__init__()
         self.algo_class = CQL
@@ -30,17 +31,7 @@ class CQLConfig(SACConfig):
         self.cql_alpha: float = 1.0
         self.num_action_samples: int = 8
         self.num_sgd_iter: int = 32     # SGD steps per training_step
-        # offline_data()
-        self.input_episodes: Optional[List[SingleAgentEpisode]] = None
-        self.input_path: Optional[str] = None
-
-    def offline_data(self, *, input_episodes=None, input_path=None
-                     ) -> "CQLConfig":
-        if input_episodes is not None:
-            self.input_episodes = input_episodes
-        if input_path is not None:
-            self.input_path = input_path
-        return self
+        self._init_offline_fields()  # offline_data() section
 
 
 class CQLLearner(SACLearner):
@@ -89,7 +80,7 @@ class CQL(SAC):
     learner_class = CQLLearner
 
     def _setup_from_config(self, config: "CQLConfig") -> None:
-        from ray_tpu.rl.algorithms.bc import load_offline_episodes
+        from ray_tpu.rl.offline import load_offline_episodes
 
         episodes = load_offline_episodes(config, "CQL")
         super()._setup_from_config(config)

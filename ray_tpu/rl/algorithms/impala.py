@@ -23,7 +23,6 @@ import jax.numpy as jnp
 import numpy as np
 
 import ray_tpu
-from ray_tpu.rl import module as rl_module
 from ray_tpu.rl.algorithm import Algorithm
 from ray_tpu.rl.config import AlgorithmConfig
 from ray_tpu.rl.episode import SingleAgentEpisode
@@ -72,7 +71,7 @@ class IMPALALearner(JaxLearner):
         return -(logp * adv)
 
     def loss(self, params, batch: Dict[str, jnp.ndarray], rng):
-        dist_inputs, values = rl_module.forward(params, batch["obs"])
+        dist_inputs, values = self.spec.forward(params, batch["obs"])
         dist = self.spec.dist(dist_inputs)
         logp = dist.logp(batch["actions"])
         mask = batch["mask"]
@@ -123,7 +122,7 @@ def compute_vtrace(episodes: List[SingleAgentEpisode], params, spec,
     """
     obs_all = np.concatenate(
         [np.asarray(e.obs).reshape(len(e.obs), -1) for e in episodes])
-    dist_inputs, values_all = rl_module.forward(params, jnp.asarray(obs_all))
+    dist_inputs, values_all = spec.forward(params, jnp.asarray(obs_all))
     dist_inputs = np.asarray(dist_inputs)
     values_all = np.asarray(values_all)
 

@@ -50,7 +50,7 @@ class PPOLearner(JaxLearner):
 
     def loss(self, params, batch: Dict[str, jnp.ndarray], rng
              ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-        dist_inputs, values = rl_module.forward(params, batch["obs"])
+        dist_inputs, values = self.spec.forward(params, batch["obs"])
         dist = self.spec.dist(dist_inputs)
         logp = dist.logp(batch["actions"])
         mask = batch["mask"]
@@ -78,14 +78,16 @@ class PPOLearner(JaxLearner):
 
 
 def compute_gae(episodes: List[SingleAgentEpisode], params,
-                gamma: float, lam: float) -> List[Dict[str, np.ndarray]]:
+                gamma: float, lam: float,
+                spec=None) -> List[Dict[str, np.ndarray]]:
     """Per-episode GAE(λ) with value bootstrap for truncated/cut episodes.
 
     Values come from the rollout (`values` extra); the bootstrap value of
     each episode's final obs is evaluated in one batched forward pass.
     """
     finals = np.stack([np.asarray(e.obs[-1]).reshape(-1) for e in episodes])
-    _, boot = rl_module.forward(params, jnp.asarray(finals))
+    fwd = spec.forward if spec is not None else rl_module.forward
+    _, boot = fwd(params, jnp.asarray(finals))
     boot = np.asarray(boot)
     out: List[Dict[str, np.ndarray]] = []
     for i, ep in enumerate(episodes):
@@ -133,7 +135,8 @@ class PPO(Algorithm):
         episodes = self.env_runner_group.sample(
             num_env_steps=cfg.train_batch_size)
         weights = self.learner_group.get_weights()
-        rows = compute_gae(episodes, weights, cfg.gamma, cfg.lambda_)
+        rows = compute_gae(episodes, weights, cfg.gamma, cfg.lambda_,
+                           spec=self.env_runner_group.spec)
         flat = {k: np.concatenate([r[k] for r in rows]) for k in rows[0]}
         n = flat["obs"].shape[0]
         # Pad/trim to exactly train_batch_size so every minibatch slice has

@@ -1,0 +1,5 @@
+"""Synthetic environments shipped with the RL library."""
+
+from ray_tpu.rl.envs.pixel import BrightQuadrantEnv
+
+__all__ = ["BrightQuadrantEnv"]

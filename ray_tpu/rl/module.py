@@ -104,12 +104,28 @@ class RLModuleSpec:
     # `act` once per compiled shape.
 
     def init(self, key) -> Dict[str, Any]:
-        return init_params(self, key)
+        k_pi, k_v = jax.random.split(key)
+        pi_sizes = [self.obs_dim, *self.hidden_sizes,
+                    self.dist_inputs_dim]
+        v_sizes = [self.obs_dim, *self.hidden_sizes, 1]
+        return {
+            "pi": _init_mlp(k_pi, pi_sizes, scale_last=0.01),
+            "vf": _init_mlp(k_v, v_sizes, scale_last=1.0),
+        }
+
+    def forward(self, params: Dict[str, Any], obs: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """(dist_inputs, value) for a flat [B, obs_dim] batch.  The
+        learners/GAE/V-trace paths all flatten observations before
+        batching, so every spec's forward takes the FLAT layout and
+        owns any structural reshape (see ConvRLModuleSpec)."""
+        return forward(params, obs)
 
     def act(self, params, obs: jnp.ndarray, key, explore: jnp.ndarray
             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Jittable action selection: returns (action, logp, value)."""
-        dist_inputs, value = forward(params, obs)
+        dist_inputs, value = self.forward(
+            params, obs.reshape(obs.shape[0], -1))
         dist = self.dist(dist_inputs)
         action = jax.lax.cond(
             explore,
@@ -140,15 +156,7 @@ def _mlp(params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
 
 
 def init_params(spec, key) -> Dict[str, Any]:
-    if not isinstance(spec, RLModuleSpec):
-        return spec.init(key)  # QNetworkSpec / SACModuleSpec / custom
-    k_pi, k_v = jax.random.split(key)
-    pi_sizes = [spec.obs_dim, *spec.hidden_sizes, spec.dist_inputs_dim]
-    v_sizes = [spec.obs_dim, *spec.hidden_sizes, 1]
-    return {
-        "pi": _init_mlp(k_pi, pi_sizes, scale_last=0.01),
-        "vf": _init_mlp(k_v, v_sizes, scale_last=1.0),
-    }
+    return spec.init(key)  # each spec owns its parameter layout
 
 
 def forward(params: Dict[str, Any], obs: jnp.ndarray
@@ -156,6 +164,64 @@ def forward(params: Dict[str, Any], obs: jnp.ndarray
     """Returns (dist_inputs, value). Pure; safe inside jit."""
     obs = obs.astype(jnp.float32)
     return _mlp(params["pi"], obs), _mlp(params["vf"], obs).squeeze(-1)
+
+
+# ---------------------------------------------------------------------------
+# Pixel-input conv module
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class ConvRLModuleSpec(RLModuleSpec):
+    """Pixel-input actor-critic: a shared conv trunk (NHWC,
+    lax.conv_general_dilated — the MXU-friendly layout) feeding separate
+    MLP policy/value heads.  Counterpart of the reference's CNN encoder
+    catalog path (rllib/core/models/catalog.py conv_filters /
+    rllib/models/torch/visionnet.py), TPU-shaped: static shapes, one
+    jitted forward for act and train alike.
+
+    obs arrives FLAT ([B, H*W*C] — every learner batches flat) and is
+    reshaped against obs_shape here; uint8-scaled inputs should be
+    normalized by the env (or wrapped) to keep the module dtype-free.
+    conv_filters rows are (out_channels, kernel, stride), padding SAME.
+    """
+
+    obs_shape: Tuple[int, int, int] = (16, 16, 1)   # H, W, C
+    conv_filters: Tuple[Tuple[int, int, int], ...] = ((16, 4, 2),
+                                                      (32, 4, 2))
+
+    def init(self, key) -> Dict[str, Any]:
+        H, W, C = self.obs_shape
+        keys = jax.random.split(key, len(self.conv_filters) + 2)
+        convs = []
+        cin = C
+        for i, (cout, k, s) in enumerate(self.conv_filters):
+            fan_in = k * k * cin
+            convs.append({
+                "w": jax.random.normal(keys[i], (k, k, cin, cout))
+                * jnp.sqrt(2.0 / fan_in),
+                "b": jnp.zeros((cout,)),
+            })
+            H, W, cin = -(-H // s), -(-W // s), cout  # ceil (SAME pad)
+        feat = H * W * cin
+        pi_sizes = [feat, *self.hidden_sizes, self.dist_inputs_dim]
+        v_sizes = [feat, *self.hidden_sizes, 1]
+        return {
+            "conv": convs,
+            "pi": _init_mlp(keys[-2], pi_sizes, scale_last=0.01),
+            "vf": _init_mlp(keys[-1], v_sizes, scale_last=1.0),
+        }
+
+    def forward(self, params: Dict[str, Any], obs: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        B = obs.shape[0]
+        x = obs.astype(jnp.float32).reshape(B, *self.obs_shape)
+        for layer, (cout, k, s) in zip(params["conv"], self.conv_filters):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + layer["b"])
+        x = x.reshape(B, -1)
+        return _mlp(params["pi"], x), _mlp(params["vf"], x).squeeze(-1)
 
 
 # ---------------------------------------------------------------------------
@@ -300,7 +366,10 @@ class SACModuleSpec:
 
 
 def spec_for_env(env) -> RLModuleSpec:
-    """Build a spec from a gymnasium env's spaces."""
+    """Build a spec from a gymnasium env's spaces.  3-D Box observation
+    spaces (H, W, C pixels) get the conv module automatically — the
+    counterpart of the reference catalog's obs-shape dispatch
+    (rllib/core/models/catalog.py)."""
     import gymnasium as gym
 
     obs_space, act_space = env.observation_space, env.action_space
@@ -309,8 +378,12 @@ def spec_for_env(env) -> RLModuleSpec:
     act_space = getattr(env, "single_action_space", act_space)
     obs_dim = int(np.prod(obs_space.shape))
     if isinstance(act_space, gym.spaces.Discrete):
-        return RLModuleSpec(obs_dim=obs_dim, action_dim=int(act_space.n),
-                            discrete=True)
-    return RLModuleSpec(obs_dim=obs_dim,
-                        action_dim=int(np.prod(act_space.shape)),
-                        discrete=False)
+        action_dim, discrete = int(act_space.n), True
+    else:
+        action_dim, discrete = int(np.prod(act_space.shape)), False
+    if len(obs_space.shape) == 3:
+        return ConvRLModuleSpec(obs_dim=obs_dim, action_dim=action_dim,
+                                discrete=discrete,
+                                obs_shape=tuple(obs_space.shape))
+    return RLModuleSpec(obs_dim=obs_dim, action_dim=action_dim,
+                        discrete=discrete)

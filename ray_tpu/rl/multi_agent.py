@@ -249,7 +249,7 @@ class MultiAgentPPO(Algorithm):
         for pid, episodes in by_policy.items():
             learner = self.learners[pid]
             rows = compute_gae(episodes, learner.params, cfg.gamma,
-                               cfg.lambda_)
+                               cfg.lambda_, spec=learner.spec)
             flat = {k: np.concatenate([r[k] for r in rows])
                     for k in rows[0]}
             n = flat["obs"].shape[0]

@@ -1,0 +1,195 @@
+"""Offline-RL data pipeline over ray_tpu.data datasets.
+
+Counterpart of the reference's rllib/offline/ (offline_data.py reads
+SampleBatch rows through ray.data — Parquet/JSON datasets of
+per-transition columns — and feeds them to BC/MARWIL/CQL).  Here the
+exchange format is the same idea on this stack's data library: ONE ROW
+PER TRANSITION with columns
+
+    eps_id, t, obs, next_obs, action, reward, logp, terminated, truncated
+
+written/read through ray_tpu.data (parquet or json), so offline corpora
+compose with the whole data layer — filters, repartitions, splits,
+streaming — before they ever reach a learner.  Episode reconstruction
+groups rows by (eps_id, frag) and orders by t; the final row of a
+fragment contributes its next_obs as the T+1-th observation.  `frag`
+(the position in the written list) exists because TRUNCATED sampling
+ships several fragments of one logical episode under the same eps_id,
+each restarting t at 0 — grouping by id alone would interleave them
+into transition sequences that never happened.
+
+Zero-step fragments (reset-only, common at truncation boundaries) carry
+no transitions and are dropped at write time — an offline corpus is a
+set of transitions, not a replay of the sampler's bookkeeping.
+
+Observations are flattened per row (data-layer friendly); the module
+specs re-shape structurally as needed (module.ConvRLModuleSpec).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.rl.episode import SingleAgentEpisode
+
+
+def episodes_to_dataset(episodes: Sequence[SingleAgentEpisode],
+                        *, parallelism: int = -1):
+    """One dataset row per transition (see module docstring)."""
+    from ray_tpu import data as rt_data
+
+    rows = []
+    for i, ep in enumerate(episodes):
+        eid = ep.id or f"ep-{i}"
+        T = len(ep)
+        for t in range(T):
+            rows.append({
+                "eps_id": eid,
+                "frag": i,
+                "t": t,
+                "obs": np.asarray(ep.obs[t]).reshape(-1)
+                .astype(np.float32),
+                "next_obs": np.asarray(ep.obs[t + 1]).reshape(-1)
+                .astype(np.float32),
+                "action": ep.actions[t],
+                "reward": float(ep.rewards[t]),
+                "logp": float(ep.logp[t]) if t < len(ep.logp) else 0.0,
+                "terminated": bool(ep.terminated and t == T - 1),
+                "truncated": bool(ep.truncated and t == T - 1),
+            })
+    return rt_data.from_items(rows, parallelism=parallelism)
+
+
+def write_offline_dataset(episodes: Sequence[SingleAgentEpisode],
+                          path: str, *, format: str = "parquet"
+                          ) -> List[str]:
+    """Write episodes as a transition dataset directory."""
+    ds = episodes_to_dataset(episodes)
+    if format == "parquet":
+        return ds.write_parquet(path)
+    if format == "json":
+        return ds.write_json(path)
+    raise ValueError(f"unsupported offline dataset format: {format!r}")
+
+
+def dataset_to_episodes(ds) -> List[SingleAgentEpisode]:
+    """Group a transition dataset back into episode fragments (rows may
+    arrive in any block order — repartitioned/shuffled corpora are
+    fine).  Fragments keep their original eps_id; `frag` only
+    disambiguates the grouping."""
+    by_ep = {}
+    for row in ds.iter_rows():
+        by_ep.setdefault((row["eps_id"], int(row.get("frag", 0))),
+                         []).append(row)
+    episodes: List[SingleAgentEpisode] = []
+    for (eid, _), rows in sorted(by_ep.items(),
+                                 key=lambda kv: kv[0][1]):
+        rows.sort(key=lambda r: int(r["t"]))
+        ep = SingleAgentEpisode(id=str(eid))
+        ep.add_reset(np.asarray(rows[0]["obs"], dtype=np.float32))
+        for r in rows:
+            ep.add_step(
+                np.asarray(r["next_obs"], dtype=np.float32),
+                _scalar(r["action"]),
+                float(r["reward"]),
+                terminated=bool(r["terminated"]),
+                truncated=bool(r["truncated"]),
+                logp=float(r.get("logp", 0.0)),
+            )
+        episodes.append(ep)
+    return episodes
+
+
+def read_offline_episodes(path: str, *, format: Optional[str] = None
+                          ) -> List[SingleAgentEpisode]:
+    """Read a transition dataset directory/file into episodes.
+
+    format: "parquet" | "json" | None (inferred from the files)."""
+    import os
+
+    from ray_tpu import data as rt_data
+
+    if format is None:
+        names = [path]
+        if os.path.isdir(path):
+            names = os.listdir(path)
+        if any(str(n).endswith(".parquet") for n in names):
+            format = "parquet"
+        elif any(str(n).endswith((".json", ".jsonl")) for n in names):
+            format = "json"
+        else:
+            raise ValueError(
+                f"cannot infer offline dataset format under {path!r}; "
+                "pass format='parquet' or 'json'")
+    ds = rt_data.read_parquet(path) if format == "parquet" \
+        else rt_data.read_json(path)
+    return dataset_to_episodes(ds)
+
+
+class OfflineInputConfigMixin:
+    """Shared offline_data() section for MARWIL/BC/CQL configs — one
+    definition of the input surface so new input options cannot drift
+    between the offline algorithm families."""
+
+    def _init_offline_fields(self) -> None:
+        self.input_episodes = None
+        self.input_path: Optional[str] = None
+        self.input_dataset = None  # ray_tpu.data.Dataset of transitions
+
+    def offline_data(self, *, input_episodes=None, input_path=None,
+                     input_dataset=None):
+        """Offline input: in-memory episodes, a ray_tpu.data Dataset of
+        transition rows, or a path — pickle files of episode lists, or
+        a parquet/json transition-dataset directory (this module; the
+        counterpart of the reference's rllib/offline input readers)."""
+        if input_episodes is not None:
+            self.input_episodes = input_episodes
+        if input_path is not None:
+            self.input_path = input_path
+        if input_dataset is not None:
+            self.input_dataset = input_dataset
+        return self
+
+
+def load_offline_episodes(config, algo_name: str
+                          ) -> List[SingleAgentEpisode]:
+    """Shared offline-input resolution for MARWIL/BC/CQL: in-memory
+    episodes win, else a ray_tpu.data transition dataset, else a path.
+    A path that is a regular file NOT named like a dataset is sniffed
+    as a pickle first (the historical format, whatever its extension);
+    directories and .parquet/.json paths read as transition datasets."""
+    import os
+    import pickle
+
+    episodes = config.input_episodes
+    if episodes is None and getattr(config, "input_dataset", None) \
+            is not None:
+        episodes = dataset_to_episodes(config.input_dataset)
+    if episodes is None and config.input_path:
+        path = config.input_path
+        looks_dataset = path.endswith((".parquet", ".json", ".jsonl"))
+        if os.path.isfile(path) and not looks_dataset:
+            try:
+                with open(path, "rb") as f:
+                    episodes = pickle.load(f)
+            except Exception:
+                episodes = read_offline_episodes(path)
+        else:
+            episodes = read_offline_episodes(path)
+    if not episodes:
+        raise ValueError(
+            f"{algo_name} is offline: config.offline_data("
+            "input_episodes=... / input_dataset=... / input_path=...) "
+            "is required")
+    return episodes
+
+
+def _scalar(v):
+    """Parquet round-trips python scalars as numpy scalars; actions may
+    also be vectors (continuous control) — pass those through."""
+    a = np.asarray(v)
+    if a.shape == ():
+        return a.item()
+    return a.astype(np.float32)

@@ -328,3 +328,78 @@ def test_marwil_beta_weights_advantages():
     probs = np.asarray(jax.nn.softmax(di, axis=-1))
     algo.stop()
     assert probs[:, 1].mean() > 0.7, probs[:, 1].mean()
+
+
+@pytest.mark.usefixtures("ray_start_regular")
+def test_offline_dataset_roundtrip_and_bc_learns_from_file(tmp_path):
+    """episodes -> parquet transition dataset -> episodes is lossless
+    (block order independent), and BC trained from the written FILE
+    recovers the expert action mapping (VERDICT r3 item 5; reference
+    rllib/offline over ray.data)."""
+    import numpy as np
+
+    from ray_tpu.rl.algorithms import BCConfig
+    from ray_tpu.rl.episode import SingleAgentEpisode
+    from ray_tpu.rl.offline import (
+        read_offline_episodes,
+        write_offline_dataset,
+    )
+
+    rng = np.random.default_rng(3)
+    episodes = []
+    for i in range(30):
+        ep = SingleAgentEpisode(id=f"ep-{i}")
+        obs = rng.normal(size=4).astype(np.float32)
+        ep.add_reset(obs)
+        for t in range(12):
+            a = int(obs.sum() > 0)  # expert: sign of the obs sum
+            obs = rng.normal(size=4).astype(np.float32)
+            ep.add_step(obs, a, 1.0, terminated=(t == 11), logp=-0.1)
+        episodes.append(ep)
+
+    path = str(tmp_path / "bc-corpus")
+    write_offline_dataset(episodes, path, format="parquet")
+    back = read_offline_episodes(path)
+    assert len(back) == len(episodes)
+    by_id = {e.id: e for e in back}
+    for ep in episodes:
+        got = by_id[ep.id]
+        assert got.actions == ep.actions
+        assert got.rewards == ep.rewards
+        assert got.terminated == ep.terminated
+        np.testing.assert_allclose(np.stack(got.obs), np.stack(ep.obs))
+
+    import gymnasium as gym
+
+    class FakeEnv(gym.Env):
+        observation_space = gym.spaces.Box(-10, 10, (4,), np.float32)
+        action_space = gym.spaces.Discrete(2)
+
+        def reset(self, *, seed=None, options=None):
+            return np.zeros(4, np.float32), {}
+
+        def step(self, action):
+            return np.zeros(4, np.float32), 0.0, True, False, {}
+
+    config = (BCConfig()
+              .environment(env_fn=FakeEnv)
+              .training(train_batch_size=128, lr=1e-2)
+              .debugging(seed=0))
+    config.num_sgd_iter = 40
+    config.offline_data(input_path=path)
+    algo = config.build()
+    algo.step()
+    algo.step()
+
+    # The cloned policy reproduces the expert rule on held-out obs.
+    import jax
+    import jax.numpy as jnp
+
+    spec = algo.env_runner_group.spec
+    params = algo.learner_group.get_weights()
+    test_obs = rng.normal(size=(256, 4)).astype(np.float32)
+    dist_inputs, _ = spec.forward(params, jnp.asarray(test_obs))
+    pred = np.asarray(jnp.argmax(dist_inputs, axis=-1))
+    expert = (test_obs.sum(axis=1) > 0).astype(int)
+    algo.stop()
+    assert (pred == expert).mean() > 0.9, (pred == expert).mean()

@@ -86,3 +86,55 @@ def test_cql_never_samples_env():
         "num_env_steps_sampled_lifetime"]
     algo.stop()
     assert before == after == 0
+
+
+def test_cql_trains_from_written_dataset_file(tmp_path):
+    """Offline pipeline end to end (VERDICT r3 item 5): episodes are
+    written as a ray_tpu.data parquet transition dataset, CQL reads the
+    directory back through the data layer and trains from it (reference
+    rllib/offline/offline_data.py over ray.data)."""
+    import numpy as np
+
+    from ray_tpu.rl.algorithms import CQLConfig
+    from ray_tpu.rl.episode import SingleAgentEpisode
+    from ray_tpu.rl.offline import write_offline_dataset
+
+    rng = np.random.default_rng(0)
+    episodes = []
+    for i in range(12):
+        ep = SingleAgentEpisode(id=f"ep-{i}")
+        obs = rng.normal(size=3).astype(np.float32)
+        ep.add_reset(obs)
+        for t in range(10):
+            a = rng.uniform(-1, 1, size=1).astype(np.float32)
+            obs = (obs + 0.1 * a.sum()).astype(np.float32)
+            ep.add_step(obs, a, float(-np.abs(obs).sum()),
+                        terminated=(t == 9))
+        episodes.append(ep)
+    path = str(tmp_path / "corpus")
+    files = write_offline_dataset(episodes, path, format="parquet")
+    assert files and all(f.endswith(".parquet") for f in files)
+
+    import gymnasium as gym
+
+    class FakeEnv(gym.Env):
+        observation_space = gym.spaces.Box(-10, 10, (3,), np.float32)
+        action_space = gym.spaces.Box(-1, 1, (1,), np.float32)
+
+        def reset(self, *, seed=None, options=None):
+            return np.zeros(3, np.float32), {}
+
+        def step(self, action):
+            return np.zeros(3, np.float32), 0.0, True, False, {}
+
+    config = (CQLConfig()
+              .environment(env_fn=FakeEnv)
+              .training(train_batch_size=64)
+              .debugging(seed=0))
+    config.num_sgd_iter = 4
+    config.offline_data(input_path=path)
+    algo = config.build()
+    m1 = algo.step()
+    m2 = algo.step()
+    algo.stop()
+    assert np.isfinite(m1["critic_loss"]) and np.isfinite(m2["critic_loss"])
