@@ -111,6 +111,7 @@ class ActorClass:
                  num_tpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None,
                  max_restarts: int = 0,
+                 max_task_retries: int = 0,
                  max_concurrency: int = 1,
                  concurrency_groups: Optional[Dict[str, int]] = None,
                  name: str = "",
@@ -123,6 +124,7 @@ class ActorClass:
         self._num_tpus = num_tpus or 0.0
         self._resources = dict(resources or {})
         self._max_restarts = max_restarts
+        self._max_task_retries = max_task_retries
         # max_concurrency is the SYNC-method thread count. Async methods
         # always overlap: the worker schedules coroutines on the actor's
         # event loop without parking a thread per call (worker.py
@@ -171,6 +173,7 @@ class ActorClass:
             class_id, blob, call_args,
             resources=self._resource_demand(),
             max_restarts=self._max_restarts,
+            max_task_retries=self._max_task_retries,
             name=self._name,
             namespace=self._namespace,
             max_concurrency=self._max_concurrency,
@@ -186,6 +189,7 @@ class ActorClass:
             "num_tpus": self._num_tpus,
             "resources": self._resources,
             "max_restarts": self._max_restarts,
+            "max_task_retries": self._max_task_retries,
             "max_concurrency": self._max_concurrency,
             "concurrency_groups": self._concurrency_groups,
             "name": self._name,

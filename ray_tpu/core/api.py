@@ -117,6 +117,7 @@ def remote(*args, **kwargs):
     def make(obj):
         if inspect.isclass(obj):
             valid = {"num_cpus", "num_tpus", "resources", "max_restarts",
+                     "max_task_retries",
                      "max_concurrency", "concurrency_groups", "name",
                      "namespace", "lifetime", "runtime_env",
                      "scheduling_strategy"}

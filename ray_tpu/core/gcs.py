@@ -1199,6 +1199,18 @@ class ControlServer:
             else:
                 entry.subscribers.append(conn)
 
+    def _op_object_info(self, conn, msg):
+        """Synchronous location/size lookup for a READY object (the
+        push-broadcast path, core/object_plane.py, needs size +
+        shm-residency without a subscription round trip)."""
+        with self.lock:
+            entry = self.objects.get(msg["obj"])
+            if entry is None or entry.state != READY:
+                return None
+            info = self._object_ready_msg(msg["obj"], entry)
+        info.pop("op", None)
+        return info
+
     def _op_forget_object(self, conn, msg):
         """Drop a speculative PENDING entry created by a subscribe that
         will never resolve (stream item probes past the final index)."""
@@ -2121,6 +2133,11 @@ class ControlServer:
             "state": entry.state,
             "address": entry.address,
             "reason": entry.death_reason,
+            # Owners use this to resubmit delivered-but-unfinished
+            # direct calls across a restart (runtime max_task_retries;
+            # getattr: journal-replayed specs may predate the field).
+            "max_task_retries": getattr(entry.spec, "max_task_retries",
+                                        0),
         }
 
     def _push_actor_update(self, entry: ActorEntry, actor_hex: str):

@@ -179,6 +179,10 @@ class NodeManager:
         self._ready = threading.Event()
         self._lock = threading.Lock()
         self._procs: Dict[str, subprocess.Popen] = {}
+        # In-progress push-broadcast receptions: obj_hex -> [segment,
+        # size, received_bytes, last_activity] (reaped by age in the
+        # sweep loop so aborted senders don't leak arena memory).
+        self._incoming: Dict[str, list] = {}
         # Synced cluster resource view (head broadcast; gcs.py
         # _sync_resource_view).
         self._view: Dict[str, dict] = {}
@@ -290,6 +294,78 @@ class NodeManager:
             return bytes(seg.buf[off:off + n])
         if op == "has_object":
             return self.store.contains(ObjectID.from_hex(msg["obj"]))
+        if op == "push_begin":
+            # Push-broadcast receiver (core/object_plane.py PushManager;
+            # reference ObjectManager::Push + HandlePush).  Admission is
+            # allocate-or-REJECT: the whole object is claimed from the
+            # arena up front, so a broadcast the node can't hold fails
+            # fast at the sender instead of wedging mid-stream.
+            oid = ObjectID.from_hex(msg["obj"])
+            if self.store.contains(oid):
+                return {"have": True}
+            with self._lock:
+                ent = self._incoming.get(msg["obj"])
+                if ent is not None:
+                    # Restarted sender (or a concurrent duplicate):
+                    # chunk writes are idempotent rewrites of the same
+                    # immutable bytes, and progress is a HIGH-WATER
+                    # MARK (not a byte count), so re-streaming from
+                    # offset 0 converges instead of double-counting.
+                    ent[3] = time.monotonic()
+                    return {"ok": True}
+            try:
+                seg = self.store.create(oid, msg["size"])
+            except Exception as e:  # noqa: BLE001 — arena full/too big
+                return {"reject": f"{type(e).__name__}: {e}"}
+            with self._lock:
+                # [segment, size, high-water mark, last_activity,
+                #  writes-in-progress]
+                self._incoming[msg["obj"]] = [seg, msg["size"], 0,
+                                              time.monotonic(), 0]
+            return {"ok": True}
+        if op == "push_chunk":
+            with self._lock:
+                ent = self._incoming.get(msg["obj"])
+                if ent is not None:
+                    ent[4] += 1  # sweep must not reap mid-write
+            if ent is None:
+                raise ValueError(f"no push in progress for {msg['obj']}")
+            try:
+                data = msg["data"]
+                off = msg["offset"]
+                ent[0].buf[off:off + len(data)] = data
+            finally:
+                with self._lock:
+                    # TCP orders a connection's chunks, so the high
+                    # water mark equals contiguous bytes received.
+                    ent[2] = max(ent[2], off + len(data))
+                    ent[3] = time.monotonic()
+                    ent[4] -= 1
+            return {"ok": True}
+        if op == "push_end":
+            oid = ObjectID.from_hex(msg["obj"])
+            with self._lock:
+                ent = self._incoming.get(msg["obj"])
+                if ent is not None and ent[2] == ent[1]:
+                    del self._incoming[msg["obj"]]
+            if ent is None:
+                # A concurrent duplicate push already finalized it.
+                return {"ok": True} if self.store.contains(oid) \
+                    else {"error": "no push in progress"}
+            if ent[2] != ent[1]:
+                # Short stream: drop the partial allocation (under the
+                # lock the entry stays for a restarted sender; this
+                # sender's stream simply failed).
+                return {"error": f"short push: {ent[2]}/{ent[1]} bytes"}
+            self.store.seal(oid)
+            # Register the replica so a cluster-wide free deletes this
+            # copy too (same contract as pull-side caching).
+            try:
+                self.head.send({"op": "object_replica",
+                                "obj": msg["obj"]})
+            except Exception:
+                pass
+            return {"ok": True}
         if op == "cluster_view":
             with self._lock:
                 return {"seq": self._view_seq, "at": self._view_at,
@@ -323,13 +399,30 @@ class NodeManager:
 
     # -- lifecycle ------------------------------------------------------
     def _sweep_loop(self):
-        """Reap exited worker processes and drop their arena pins."""
+        """Reap exited worker processes and drop their arena pins; age
+        out abandoned push-broadcast receptions."""
         while not self._stopped.wait(1.0):
+            stale = []
             with self._lock:
                 for hex_, p in list(self._procs.items()):
                     if p.poll() is not None:
                         del self._procs[hex_]
                 alive = [p.pid for p in self._procs.values()]
+                now = time.monotonic()
+                for obj_hex, ent in list(self._incoming.items()):
+                    # Reap only senders that are provably gone: a long
+                    # idle window (budget-contended broadcasts can gap
+                    # minutes between chunks) AND no write in progress
+                    # (deleting the segment under an active write would
+                    # free an arena block mid-memcpy).
+                    if now - ent[3] > 300.0 and ent[4] == 0:
+                        del self._incoming[obj_hex]
+                        stale.append(obj_hex)
+            for obj_hex in stale:
+                try:
+                    self.store.delete(ObjectID.from_hex(obj_hex))
+                except Exception:
+                    pass
             alive.append(os.getpid())
             try:
                 self.store.sweep(alive)

@@ -178,6 +178,10 @@ class CoreClient:
         # registered with the head unless the ref escapes this process.
         self._direct_futures: Dict[str, Future] = {}
         self._direct_inflight: Dict[str, set] = {}  # actor_hex -> obj hexes
+        # Delivered direct specs kept for resubmission across an actor
+        # RESTART (only when the actor was created with
+        # max_task_retries > 0); obj_hex -> TaskSpec.
+        self._direct_inflight_specs: Dict[str, TaskSpec] = {}
         self._direct_actor_of: Dict[str, str] = {}  # obj hex -> actor_hex
         # Direct refs that escaped (were serialized into another task /
         # put) before or after resolving: the head got a registration and
@@ -416,10 +420,12 @@ class CoreClient:
         elif msg["state"] == "RESTARTING":
             # Tasks already DELIVERED to the dead instance are lost (the
             # restarted instance never sees them); queued ones re-flush
-            # on ALIVE.  Mirrors the head's _fail_actor_inflight for the
-            # registered (non-direct) path.
+            # on ALIVE.  With max_task_retries they resubmit to the
+            # restarted instance; otherwise this mirrors the head's
+            # _fail_actor_inflight for the registered (non-direct) path.
             self._fail_direct_inflight(
-                actor_hex, msg.get("reason", "actor restarting"))
+                actor_hex, msg.get("reason", "actor restarting"),
+                retryable=True)
 
     # ------------------------------------------------------------------
     # Owner-direct actor results: the result of a plain (1-return,
@@ -444,15 +450,22 @@ class CoreClient:
 
     def _mark_direct_delivered(self, spec):
         """The spec was actually sent to a live instance: its results are
-        now at risk of that instance's death."""
+        now at risk of that instance's death.  Actors created with
+        max_task_retries keep the spec around so a RESTART resubmits it
+        instead of failing the caller."""
         if not getattr(spec, "direct", False):
             return
         actor_hex = spec.actor_id.hex()
+        with self._actor_cv:
+            st = self._actor_state.get(actor_hex) or {}
+            retryable = st.get("max_task_retries", 0) > 0
         with self._lock:
             for oid in spec.return_ids:
                 if oid.hex() in self._direct_futures:
                     self._direct_inflight.setdefault(
                         actor_hex, set()).add(oid.hex())
+                    if retryable:
+                        self._direct_inflight_specs[oid.hex()] = spec
 
     def _on_direct_push(self, msg: dict):
         op = msg.get("op")
@@ -535,6 +548,7 @@ class CoreClient:
             fut = self._direct_futures.get(obj_hex)
             actor_hex = self._direct_actor_of.get(obj_hex, "")
             self._direct_inflight.get(actor_hex, set()).discard(obj_hex)
+            self._direct_inflight_specs.pop(obj_hex, None)
             promoted = obj_hex in self._direct_promoted
         if promoted:
             # The ref escaped before the value landed: forward the bytes
@@ -575,14 +589,37 @@ class CoreClient:
             fut.set_result({"direct": True, "data": data,
                             "is_error": True})
 
-    def _fail_direct_inflight(self, actor_hex: str, reason: str):
+    def _fail_direct_inflight(self, actor_hex: str, reason: str,
+                              retryable: bool = False):
+        """Tasks delivered to a dead actor instance.  retryable=True
+        (the actor is RESTARTING): specs with max_task_retries budget
+        left re-queue for the restarted instance — the owner is the
+        only party holding the spec on the direct path, so the retry
+        happens here, not at the head (reference
+        direct_actor_task_submitter retry-on-restart).  Everything else
+        fails with ActorDiedError."""
         with self._lock:
             pending = list(self._direct_inflight.pop(actor_hex, ()))
+            specs = {h: self._direct_inflight_specs.pop(h, None)
+                     for h in pending}
         if not pending:
             return
+        with self._actor_cv:
+            mtr = (self._actor_state.get(actor_hex)
+                   or {}).get("max_task_retries", 0)
         err = ActorDiedError(actor_hex, reason or "actor died")
+        retried = []
         for obj_hex in pending:
-            self._fail_direct(obj_hex, err)
+            spec = specs.get(obj_hex)
+            if retryable and spec is not None and spec.retry_count < mtr:
+                spec.retry_count += 1
+                retried.append(spec)
+            else:
+                self._fail_direct(obj_hex, err)
+        for spec in retried:
+            # Actor state is RESTARTING: this queues the spec and it
+            # flushes when the ALIVE update lands.
+            self._route_actor_task(actor_hex, spec)
 
     def _maybe_promote_direct(self, obj_hex: str):
         """The ref is escaping this process (serialized into a task arg /
@@ -1641,6 +1678,7 @@ class CoreClient:
                      args: Sequence[Any], resources: Dict[str, float],
                      max_restarts: int, name: str, namespace: str,
                      max_concurrency: int,
+                     max_task_retries: int = 0,
                      concurrency_groups: Optional[Dict[str, int]] = None,
                      runtime_env: Optional[dict] = None,
                      scheduling_strategy=None) -> ActorID:
@@ -1658,6 +1696,7 @@ class CoreClient:
             args=task_args,
             resources=resources,
             max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
             name=name,
             namespace=namespace,
             max_concurrency=max_concurrency,

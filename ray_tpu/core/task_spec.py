@@ -142,6 +142,10 @@ class ActorCreationSpec:
     args: List[TaskArg]
     resources: Dict[str, float]
     max_restarts: int = 0
+    # Per-method retry budget on actor RESTART (reference
+    # max_task_retries): delivered-but-unfinished direct calls are
+    # resubmitted by their owner when the actor comes back ALIVE.
+    max_task_retries: int = 0
     name: str = ""
     namespace: str = ""
     max_concurrency: int = 1

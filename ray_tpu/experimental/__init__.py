@@ -1,1 +1,5 @@
 """Experimental utilities (counterpart of the reference's ray.experimental)."""
+
+from ray_tpu.core.object_plane import PushManager, broadcast_object
+
+__all__ = ["PushManager", "broadcast_object"]

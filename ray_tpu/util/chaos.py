@@ -100,6 +100,35 @@ class WorkerKiller(ResourceKiller):
             return False
 
 
+class ActorKiller(ResourceKiller):
+    """SIGKILL a random live ACTOR worker process (exercises actor
+    restart + method retry paths; reference WorkerKillerActor aimed at
+    actors instead of pool workers)."""
+
+    def __init__(self, interval_s: float = 1.0, **kw):
+        super().__init__(interval_s, **kw)
+        import random
+
+        self._rng = random.Random(0)
+
+    def find_target(self) -> Optional[int]:
+        from ray_tpu.state.api import list_workers
+
+        live = [w for w in list_workers()
+                if w["kind"] == "actor" and w.get("pid")
+                and w["state"] not in ("dead",)]
+        if not live:
+            return None
+        return int(self._rng.choice(live)["pid"])
+
+    def kill(self, pid: int) -> bool:
+        try:
+            os.kill(pid, signal.SIGKILL)
+            return True
+        except OSError:
+            return False
+
+
 class NodeKiller(ResourceKiller):
     """Remove a random non-head node (reference RayletKiller via
     Cluster.remove_node: exercises PG teardown, task respill, actor

@@ -104,3 +104,52 @@ def test_named_actor_name_reusable_after_kill(ray_start_regular):
     pid2 = ray_tpu.get(b.who.remote(), timeout=30)
     assert pid1 != pid2
     ray_tpu.kill(b)
+
+
+def test_actor_max_task_retries_resubmits_across_restart():
+    """A method call delivered to an actor instance that dies mid-
+    execution is resubmitted to the RESTARTED instance when the actor
+    was created with max_task_retries (reference direct-actor-submitter
+    retry-on-restart); without it the caller gets ActorDiedError."""
+    import os
+    import signal
+    import time
+
+    ray_tpu.init(num_cpus=2)
+    try:
+        @ray_tpu.remote(max_restarts=4, max_task_retries=4)
+        class Slow:
+            def pid_after(self, delay):
+                import os as o
+                import time as t
+
+                t.sleep(delay)
+                return o.getpid()
+
+        a = Slow.options(num_cpus=0).remote()
+        pid = ray_tpu.get(a.pid_after.remote(0), timeout=60)
+        ref = a.pid_after.remote(1.0)   # in flight when the kill lands
+        time.sleep(0.2)
+        os.kill(pid, signal.SIGKILL)
+        pid2 = ray_tpu.get(ref, timeout=120)  # retried on the restart
+        assert pid2 != pid
+
+        @ray_tpu.remote(max_restarts=4)  # NO task retries: old contract
+        class Slow0:
+            def pid_after(self, delay):
+                import os as o
+                import time as t
+
+                t.sleep(delay)
+                return o.getpid()
+
+        b = Slow0.options(num_cpus=0).remote()
+        pidb = ray_tpu.get(b.pid_after.remote(0), timeout=60)
+        refb = b.pid_after.remote(1.0)
+        time.sleep(0.2)
+        os.kill(pidb, signal.SIGKILL)
+        with pytest.raises(Exception) as ei:
+            ray_tpu.get(refb, timeout=120)
+        assert "died" in str(ei.value).lower()
+    finally:
+        ray_tpu.shutdown()

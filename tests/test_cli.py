@@ -36,13 +36,20 @@ def cluster_head():
          "--num-cpus", "2", "--block", "--no-dashboard"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env)
-    deadline = time.monotonic() + 30
-    while not os.path.exists("/tmp/ray_tpu/cluster_address"):
-        if time.monotonic() > deadline or proc.poll() is not None:
-            out = proc.stdout.read() if proc.stdout else ""
-            raise RuntimeError(f"head did not start: {out}")
-        time.sleep(0.1)
-    time.sleep(0.3)
+    try:
+        deadline = time.monotonic() + 30
+        while not os.path.exists("/tmp/ray_tpu/cluster_address"):
+            if time.monotonic() > deadline or proc.poll() is not None:
+                out = proc.stdout.read() if proc.stdout else ""
+                raise RuntimeError(f"head did not start: {out}")
+            time.sleep(0.1)
+        time.sleep(0.3)
+    except BaseException:
+        # The pre-yield error path must not leak a --block head: each
+        # leaked head idles forever and skews every later timing
+        # measurement on the host.
+        proc.kill()
+        raise
     yield proc
     _run(["stop"])
     try:
