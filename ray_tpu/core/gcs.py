@@ -100,6 +100,9 @@ class NodeState:
     conn: Optional[rpc.Connection] = None  # its control connection
     store_key: str = ""  # its arena name ('' = shares the head arena)
     shm_dir: str = ""
+    # Last host-stats report from the node's reporter
+    # (dashboard/reporter.py; reference reporter_agent.py).
+    stats: Dict[str, Any] = field(default_factory=dict)
 
     @property
     def is_remote(self) -> bool:
@@ -593,8 +596,19 @@ class ControlServer:
                 # Tasks already delivered to the dead process are lost either
                 # way; fail their return objects so callers' gets raise
                 # instead of hanging.
-                self._fail_actor_inflight(w.actor_hex, reason)
-                if spec.restart_count < spec.max_restarts:
+                will_restart = spec.restart_count < spec.max_restarts
+                if not (will_restart
+                        and getattr(spec, "max_task_retries", 0) > 0):
+                    self._fail_actor_inflight(w.actor_hex, reason)
+                # else: the OWNER arbitrates in-flight calls across the
+                # restart (runtime max_task_retries): retried calls'
+                # results — and non-retried calls' errors — flow back
+                # through the owner's promoted-object forwarding, so
+                # the head writing ActorDiedError here would make one
+                # ref read as an error remotely while the owner's
+                # retry succeeds locally.  The entries stay queued; a
+                # later DEAD transition fails whatever remains.
+                if will_restart:
                     spec.restart_count += 1
                     entry.state = A_RESTARTING
                     entry.worker_hex = ""
@@ -664,6 +678,15 @@ class ControlServer:
             "store_node": store_node,
             "session_dir": self.session_dir,
         }
+
+    def _op_node_stats(self, conn, msg):
+        """Periodic host-stats report from a node manager's reporter
+        thread (dashboard/reporter.py)."""
+        with self.lock:
+            for n in self.nodes.values():
+                if n.conn is conn:
+                    n.stats = msg.get("stats") or {}
+                    return
 
     def _op_register_node(self, conn, msg):
         """A node manager joins the cluster (reference raylet → GCS
@@ -2357,14 +2380,36 @@ class ControlServer:
                 "nodes": nodes}
 
     def _op_list_nodes(self, conn, msg):
+        self._sample_head_stats()
         with self.lock:
             return [
                 {"node_id": n.node_id, "alive": n.alive,
                  "is_head": n.is_head, "resources": n.total.to_dict(),
                  "available": n.available.to_dict(), "labels": n.labels,
-                 "address": n.address}
+                 "address": n.address, "stats": dict(n.stats)}
                 for n in self.nodes.values()
             ]
+
+    def _sample_head_stats(self):
+        """The head has no reporter thread; sample its host stats on
+        read (list_nodes is the only consumer) with the same helper the
+        node reporters use."""
+        sampler = getattr(self, "_head_stats_sampler", None)
+        if sampler is None:
+            from ray_tpu.dashboard.reporter import HostStatsSampler
+
+            sampler = self._head_stats_sampler = HostStatsSampler()
+        try:
+            with self.lock:
+                nw = sum(1 for w in self.workers.values()
+                         if w.state != "dead")
+            stats = sampler.sample(store=self.store, num_workers=nw)
+            with self.lock:
+                head = self.nodes.get("head")
+                if head is not None:
+                    head.stats = stats
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     # Placement groups (counterpart of GcsPlacementGroupManager +

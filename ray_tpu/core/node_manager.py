@@ -296,14 +296,17 @@ class NodeManager:
             return self.store.contains(ObjectID.from_hex(msg["obj"]))
         if op == "push_begin":
             # Push-broadcast receiver (core/object_plane.py PushManager;
-            # reference ObjectManager::Push + HandlePush).  Admission is
-            # allocate-or-REJECT: the whole object is claimed from the
-            # arena up front, so a broadcast the node can't hold fails
-            # fast at the sender instead of wedging mid-stream.
+            # reference ObjectManager::Push + HandlePush).  The whole
+            # object is claimed up front: arena if it fits, the store's
+            # file-backed overflow path otherwise (consumers still read
+            # one mmap); a size the store cannot place at all REJECTS
+            # so the sender fails fast instead of wedging mid-stream.
             oid = ObjectID.from_hex(msg["obj"])
-            if self.store.contains(oid):
-                return {"have": True}
             with self._lock:
+                # The in-progress check comes BEFORE store.contains: a
+                # file-spilled partial allocation already "exists" on
+                # disk, and answering "have" for it would strand a
+                # restarted sender with a truncated object forever.
                 ent = self._incoming.get(msg["obj"])
                 if ent is not None:
                     # Restarted sender (or a concurrent duplicate):
@@ -313,20 +316,33 @@ class NodeManager:
                     # offset 0 converges instead of double-counting.
                     ent[3] = time.monotonic()
                     return {"ok": True}
+                if self.store.contains(oid):
+                    return {"have": True}
+                # Claim the slot BEFORE the (lock-free) create so a
+                # concurrent duplicate can't double-create and orphan
+                # the first segment; [segment, size, high-water mark,
+                # last_activity, writes-in-progress].
+                ent = self._incoming[msg["obj"]] = [
+                    None, msg["size"], 0, time.monotonic(), 0]
             try:
                 seg = self.store.create(oid, msg["size"])
-            except Exception as e:  # noqa: BLE001 — arena full/too big
+            except Exception as e:  # noqa: BLE001 — nowhere to put it
+                with self._lock:
+                    self._incoming.pop(msg["obj"], None)
                 return {"reject": f"{type(e).__name__}: {e}"}
             with self._lock:
-                # [segment, size, high-water mark, last_activity,
-                #  writes-in-progress]
-                self._incoming[msg["obj"]] = [seg, msg["size"], 0,
-                                              time.monotonic(), 0]
+                ent[0] = seg
             return {"ok": True}
         if op == "push_chunk":
             with self._lock:
                 ent = self._incoming.get(msg["obj"])
                 if ent is not None:
+                    if ent[0] is None:
+                        # Concurrent duplicate raced the creator's
+                        # allocation window; this stream fails, the
+                        # sender's retry converges.
+                        raise ValueError(
+                            f"push of {msg['obj']} not ready")
                     ent[4] += 1  # sweep must not reap mid-write
             if ent is None:
                 raise ValueError(f"no push in progress for {msg['obj']}")
@@ -400,8 +416,25 @@ class NodeManager:
     # -- lifecycle ------------------------------------------------------
     def _sweep_loop(self):
         """Reap exited worker processes and drop their arena pins; age
-        out abandoned push-broadcast receptions."""
+        out abandoned push-broadcast receptions; report host stats to
+        the head on an interval (dashboard/reporter.py — the per-node
+        reporter agent role)."""
+        from ray_tpu.dashboard.reporter import HostStatsSampler
+
+        sampler = HostStatsSampler()
+        last_report = 0.0
         while not self._stopped.wait(1.0):
+            if time.monotonic() - last_report >= 5.0:
+                last_report = time.monotonic()
+                try:
+                    with self._lock:
+                        nw = len(self._procs)
+                    self.head.send({
+                        "op": "node_stats",
+                        "stats": sampler.sample(store=self.store,
+                                                num_workers=nw)})
+                except Exception:
+                    pass
             stale = []
             with self._lock:
                 for hex_, p in list(self._procs.items()):

@@ -5,8 +5,16 @@ Routes (all GET unless noted):
   /api/healthz             -> "success"
   /api/nodes               /api/tasks        /api/actors
   /api/objects             /api/workers      /api/placement_groups
+      (all table routes accept server-side controls:
+       ?limit=&offset=&sort_by=&descending=1 plus any other key as an
+       equality filter — "key=!v" negates, "key=~v" substring)
+  /api/summary/tasks|actors|objects  -> aggregated counts
+  /api/node_stats          -> per-node host stats (reporter agents)
+  /api/timeline?max_tasks= -> chrome trace (uniformly sampled at scale)
+  /api/workers/<hex>/profile?kind=stack|jax_trace&duration_s=
   /api/cluster_resources   /api/available_resources
-  /api/object_store_stats
+  /api/object_store_stats  /metrics (Prometheus)
+  /api/grafana_dashboard   -> importable Grafana JSON
   /api/jobs                (GET list; POST {"entrypoint": ...} submits)
   /api/jobs/<id>           -> job info
   /api/jobs/<id>/logs      -> text
@@ -103,14 +111,52 @@ class Dashboard:
             return "success"
         if path == "/api/version":
             return {"version": __version__}
+        from urllib.parse import parse_qs, urlparse
+
+        parsed = urlparse(path)
+        qs = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         simple = {
             "/api/nodes": "nodes", "/api/tasks": "tasks",
             "/api/actors": "actors", "/api/objects": "objects",
             "/api/workers": "workers",
             "/api/placement_groups": "placement_groups",
         }
-        if path in simple:
-            return rt.state_list(simple[path])
+        if parsed.path in simple:
+            # Server-side filter/sort/paginate (reference state-API
+            # table semantics): any other query key is an equality
+            # filter ("key=!value" negates, "key=~value" = contains),
+            # plus limit/offset/sort_by/descending controls.
+            from ray_tpu.state import api as state_api
+
+            limit = int(qs.pop("limit", 10000))
+            offset = int(qs.pop("offset", 0))
+            sort_by = qs.pop("sort_by", None)
+            descending = qs.pop("descending", "0") in ("1", "true")
+            filters = []
+            for k, v in qs.items():
+                if v.startswith("!"):
+                    filters.append((k, "!=", v[1:]))
+                elif v.startswith("~"):
+                    filters.append((k, "contains", v[1:]))
+                else:
+                    filters.append((k, "=", v))
+            return state_api._list(
+                simple[parsed.path], filters or None, limit,
+                offset=offset, sort_by=sort_by, descending=descending)
+        if parsed.path.startswith("/api/summary/"):
+            from ray_tpu.state import api as state_api
+
+            kind = parsed.path[len("/api/summary/"):]
+            fn = {"tasks": state_api.summarize_tasks,
+                  "actors": state_api.summarize_actors,
+                  "objects": state_api.summarize_objects}.get(kind)
+            if fn is None:
+                raise KeyError(path)
+            return fn()
+        if parsed.path == "/api/node_stats":
+            # Per-node host stats (dashboard/reporter.py reports).
+            return {n["node_id"]: n.get("stats", {})
+                    for n in rt.state_list("nodes")}
         if path == "/api/cluster_resources":
             return rt.cluster_resources()
         if path == "/api/available_resources":
@@ -126,9 +172,10 @@ class Dashboard:
             # state gauges + every process's published user metrics).
             from ray_tpu.util.metrics import aggregate_prometheus_text
             return aggregate_prometheus_text(rt)
-        if path == "/api/timeline":
+        if parsed.path == "/api/timeline":
             from ray_tpu.util.timeline import timeline_events
-            return timeline_events(rt)
+            return timeline_events(
+                rt, max_tasks=int(qs.get("max_tasks", 0)))
         if path.startswith("/api/workers/") and "/profile" in path:
             # On-demand live-worker profiling (reference: dashboard
             # reporter profile_manager.py py-spy/memray endpoints;

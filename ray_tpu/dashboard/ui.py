@@ -101,6 +101,20 @@ def grafana_dashboard_json(prometheus_job: str = "ray_tpu") -> dict:
                   unit="bytes", x=0, y=8),
             panel(4, "Objects", "ray_tpu_objects", x=12, y=8),
             panel(5, "Alive nodes", "ray_tpu_nodes", x=0, y=16),
+            panel(6, "Workers by state", "ray_tpu_workers", x=12, y=16),
+            panel(7, "Placement groups by state",
+                  "ray_tpu_placement_groups", x=0, y=24),
+            panel(8, "Node CPU %", "ray_tpu_node_cpu_percent",
+                  unit="percent", x=12, y=24),
+            panel(9, "Node memory used", "ray_tpu_node_mem_used_bytes",
+                  unit="bytes", x=0, y=32),
+            panel(10, "Node load (1m)", "ray_tpu_node_load_avg_1m",
+                  x=12, y=32),
+            panel(11, "Node arena used",
+                  "ray_tpu_node_object_store_used_bytes",
+                  unit="bytes", x=0, y=40),
+            panel(12, "Node worker processes", "ray_tpu_node_workers",
+                  x=12, y=40),
         ],
         "templating": {"list": []},
         "schemaVersion": 39,

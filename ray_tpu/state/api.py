@@ -14,6 +14,20 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 from ray_tpu.core.runtime import get_runtime
 
 
+def _cmp_num(have, value, op) -> bool:
+    try:
+        a, b = float(have), float(value)
+    except (TypeError, ValueError):
+        a, b = str(have), str(value)
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    return a >= b
+
+
 def _apply_filters(rows: List[dict],
                    filters: Optional[Sequence[Tuple]] = None) -> List[dict]:
     if not filters:
@@ -27,6 +41,10 @@ def _apply_filters(rows: List[dict],
                 ok = str(have) == str(value)
             elif op == "!=":
                 ok = str(have) != str(value)
+            elif op == "contains":
+                ok = str(value) in str(have)
+            elif op in ("<", "<=", ">", ">="):
+                ok = _cmp_num(have, value, op)
             else:
                 raise ValueError(f"unsupported filter op {op!r}")
             if not ok:
@@ -36,33 +54,52 @@ def _apply_filters(rows: List[dict],
     return out
 
 
-def _list(kind: str, filters=None, limit: int = 10000) -> List[dict]:
+def _list(kind: str, filters=None, limit: int = 10000, *,
+          offset: int = 0, sort_by: Optional[str] = None,
+          descending: bool = False) -> List[dict]:
+    """Filter -> sort -> paginate, in that order (the reference's state
+    API contract: limit/offset apply to the FILTERED set so pages are
+    stable under unrelated churn)."""
     rows = get_runtime().state_list(kind)
-    return _apply_filters(rows, filters)[:limit]
+    rows = _apply_filters(rows, filters)
+    if sort_by is not None:
+        def key(r):
+            v = r.get(sort_by)
+            # Numeric columns (size, pid, timestamps) must sort
+            # numerically — a str() sort would order 9 > 2048 and feed
+            # wrong pages through limit/offset.
+            try:
+                return (v is None, 0, float(v), "")
+            except (TypeError, ValueError):
+                return (v is None, 1, 0.0, str(v))
+
+        rows.sort(key=key, reverse=descending)
+    return rows[offset:offset + limit]
 
 
-def list_tasks(filters=None, limit: int = 10000) -> List[dict]:
-    return _list("tasks", filters, limit)
+def list_tasks(filters=None, limit: int = 10000, **kw) -> List[dict]:
+    return _list("tasks", filters, limit, **kw)
 
 
-def list_actors(filters=None, limit: int = 10000) -> List[dict]:
-    return _list("actors", filters, limit)
+def list_actors(filters=None, limit: int = 10000, **kw) -> List[dict]:
+    return _list("actors", filters, limit, **kw)
 
 
-def list_objects(filters=None, limit: int = 10000) -> List[dict]:
-    return _list("objects", filters, limit)
+def list_objects(filters=None, limit: int = 10000, **kw) -> List[dict]:
+    return _list("objects", filters, limit, **kw)
 
 
-def list_nodes(filters=None, limit: int = 10000) -> List[dict]:
-    return _list("nodes", filters, limit)
+def list_nodes(filters=None, limit: int = 10000, **kw) -> List[dict]:
+    return _list("nodes", filters, limit, **kw)
 
 
-def list_workers(filters=None, limit: int = 10000) -> List[dict]:
-    return _list("workers", filters, limit)
+def list_workers(filters=None, limit: int = 10000, **kw) -> List[dict]:
+    return _list("workers", filters, limit, **kw)
 
 
-def list_placement_groups(filters=None, limit: int = 10000) -> List[dict]:
-    return _list("placement_groups", filters, limit)
+def list_placement_groups(filters=None, limit: int = 10000,
+                          **kw) -> List[dict]:
+    return _list("placement_groups", filters, limit, **kw)
 
 
 def profile_worker(worker_hex: str, kind: str = "stack",
@@ -106,4 +143,21 @@ def summarize_actors() -> Dict[str, Any]:
         "total": len(rows),
         "by_state": dict(Counter(r.get("state", "?") for r in rows)),
         "by_class": dict(Counter(r.get("class", "?") for r in rows)),
+    }
+
+
+def summarize_objects() -> Dict[str, Any]:
+    """Counts + bytes by state (reference `ray summary objects`)."""
+    rows = list_objects()
+    by_state = Counter(r.get("state", "?") for r in rows)
+    bytes_by_state: Dict[str, float] = {}
+    for r in rows:
+        bytes_by_state[r.get("state", "?")] = (
+            bytes_by_state.get(r.get("state", "?"), 0.0)
+            + float(r.get("size") or 0))
+    return {
+        "total": len(rows),
+        "total_bytes": sum(bytes_by_state.values()),
+        "by_state": dict(by_state),
+        "bytes_by_state": bytes_by_state,
     }

@@ -339,6 +339,46 @@ def builtin_snapshots(runtime) -> List[dict]:
         nodes = runtime.state_list("nodes")
         gauge("ray_tpu_nodes", "Alive nodes",
               {(): sum(1 for n in nodes if n.get("alive", True))})
+        workers = runtime.state_list("workers")
+        by_state = {}
+        for w in workers:
+            by_state[w["state"]] = by_state.get(w["state"], 0) + 1
+        gauge("ray_tpu_workers", "Workers by state",
+              {(("state", s),): n for s, n in by_state.items()})
+        pgs = runtime.state_list("placement_groups")
+        by_state = {}
+        for p in pgs:
+            by_state[p["state"]] = by_state.get(p["state"], 0) + 1
+        gauge("ray_tpu_placement_groups", "Placement groups by state",
+              {(("state", s),): n for s, n in by_state.items()})
+        # Per-node host stats from the reporter agents
+        # (dashboard/reporter.py; reference reporter_agent metrics).
+        per_node = {
+            "cpu_percent": ("ray_tpu_node_cpu_percent",
+                            "Node CPU utilization %"),
+            "mem_used_bytes": ("ray_tpu_node_mem_used_bytes",
+                               "Node memory used"),
+            "mem_total_bytes": ("ray_tpu_node_mem_total_bytes",
+                                "Node memory total"),
+            "load_avg_1m": ("ray_tpu_node_load_avg_1m",
+                            "Node 1-minute load average"),
+            "object_store_used_bytes": (
+                "ray_tpu_node_object_store_used_bytes",
+                "Node arena bytes used"),
+            "object_store_capacity_bytes": (
+                "ray_tpu_node_object_store_capacity_bytes",
+                "Node arena capacity"),
+            "num_workers": ("ray_tpu_node_workers",
+                            "Worker processes on the node"),
+        }
+        for key, (mname, mdesc) in per_node.items():
+            series = {}
+            for n in nodes:
+                v = (n.get("stats") or {}).get(key)
+                if v is not None:
+                    series[(("node", n["node_id"]),)] = float(v)
+            if series:
+                gauge(mname, mdesc, series)
     except Exception:
         pass
     return snaps

@@ -18,12 +18,22 @@ import json
 from typing import Any, Dict, List, Optional
 
 
-def timeline_events(runtime=None) -> List[Dict[str, Any]]:
-    """Build chrome-trace event dicts from the cluster's task records."""
+def timeline_events(runtime=None,
+                    max_tasks: int = 0) -> List[Dict[str, Any]]:
+    """Build chrome-trace event dicts from the cluster's task records.
+
+    max_tasks > 0 UNIFORMLY SAMPLES the task records first (every k-th
+    by submit order): a million-task session produces a trace a
+    browser can open instead of a multi-GB JSON (reference timeline at
+    scale samples the same way)."""
     from ray_tpu.core.runtime import get_runtime
 
     rt = runtime or get_runtime()
     tasks = rt.state_list("tasks")
+    if max_tasks and len(tasks) > max_tasks:
+        tasks.sort(key=lambda t: t.get("submitted_at") or 0)
+        step = len(tasks) / max_tasks
+        tasks = [tasks[int(i * step)] for i in range(max_tasks)]
     events: List[Dict[str, Any]] = []
     pids = set()
     for t in tasks:

@@ -193,3 +193,112 @@ def test_dashboard_ui_and_grafana(dashboard):
     for expr in exprs:
         name = expr.split("{")[0]
         assert name in metrics, f"{name} not in /metrics exposition"
+
+
+def test_dashboard_full_surface_three_node_cluster(tmp_path):
+    """Every dashboard endpoint against a live 3-node cluster (VERDICT
+    r3 item 4): per-node reporter stats, table filters/pagination/
+    sorting, summaries, sampled timeline, on-demand worker profiling,
+    Prometheus families matching the Grafana dashboard."""
+    import os
+    import subprocess
+    import time as _time
+
+    from ray_tpu.core.runtime import get_runtime
+    from ray_tpu.dashboard import Dashboard
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rt = ray_tpu.init(num_cpus=2, log_to_driver=False)
+    procs = []
+    dash = None
+    try:
+        env = dict(os.environ)
+        env["PYTHONUNBUFFERED"] = "1"
+        for nid in ("dashA", "dashB"):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.node_manager",
+                 "--address", rt.address, "--node-id", nid,
+                 "--num-cpus", "2", "--num-tpus", "0"],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        deadline = _time.time() + 60
+        while _time.time() < deadline:
+            alive = {n["node_id"] for n in rt.state_list("nodes")
+                     if n["alive"]}
+            if {"dashA", "dashB"} <= alive:
+                break
+            _time.sleep(0.3)
+        dash = Dashboard(get_runtime())
+        base = dash.url
+
+        @ray_tpu.remote
+        def work(i):
+            return i * 2
+
+        ray_tpu.get([work.remote(i) for i in range(6)], timeout=60)
+
+        # Table controls: filter + sort + pagination on the tasks table.
+        all_tasks = _get_json(f"{base}/api/tasks")
+        assert len(all_tasks) >= 6
+        fin = _get_json(f"{base}/api/tasks?state=FINISHED")
+        assert fin and all(t["state"] == "FINISHED" for t in fin)
+        page = _get_json(
+            f"{base}/api/tasks?state=FINISHED&limit=2&offset=1"
+            "&sort_by=task_id")
+        assert len(page) == 2
+        full = _get_json(f"{base}/api/tasks?state=FINISHED&limit=3"
+                         "&sort_by=task_id")
+        assert page == full[1:3]  # stable pagination over the sort
+        neg = _get_json(f"{base}/api/tasks?state=!FINISHED")
+        assert all(t["state"] != "FINISHED" for t in neg)
+
+        # Summaries.
+        ts = _get_json(f"{base}/api/summary/tasks")
+        assert ts["total"] >= 6 and "FINISHED" in ts["by_state"]
+        assert _get_json(f"{base}/api/summary/actors")["total"] >= 0
+        objs = _get_json(f"{base}/api/summary/objects")
+        assert "total_bytes" in objs
+
+        # Per-node reporter stats: the head samples on read; remote
+        # nodes report on a 5s interval — wait one period.
+        deadline = _time.time() + 30
+        while _time.time() < deadline:
+            stats = _get_json(f"{base}/api/node_stats")
+            remote_ok = all(
+                stats.get(n, {}).get("mem_total_bytes")
+                for n in ("dashA", "dashB"))
+            if remote_ok and stats.get("head", {}).get("mem_total_bytes"):
+                break
+            _time.sleep(1.0)
+        assert remote_ok, stats
+        assert stats["dashA"]["object_store_capacity_bytes"] > 0
+
+        # Sampled timeline.
+        tl = _get_json(f"{base}/api/timeline?max_tasks=3")
+        assert isinstance(tl, list)
+
+        # On-demand profile of a LIVE worker from the head.
+        workers = [w for w in rt.state_list("workers")
+                   if w["kind"] == "pool" and w.get("pid")]
+        assert workers
+        prof = _get_json(
+            f"{base}/api/workers/{workers[0]['worker_id']}/profile"
+            "?kind=stack")
+        assert "Thread" in str(prof["profile"]) or "File" in str(
+            prof["profile"])
+
+        # Prometheus families cover what the Grafana dashboard plots.
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        graf = _get_json(f"{base}/api/grafana_dashboard")
+        exprs = [t["expr"] for p in graf["panels"]
+                 for t in p["targets"]]
+        for expr in exprs:
+            assert expr in text, f"grafana series {expr} not exported"
+    finally:
+        if dash is not None:
+            dash.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        ray_tpu.shutdown()
