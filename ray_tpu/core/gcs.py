@@ -2401,8 +2401,13 @@ class ControlServer:
             sampler = self._head_stats_sampler = HostStatsSampler()
         try:
             with self.lock:
+                # HEAD-LOCAL workers only: self.workers is the
+                # cluster-wide registry (remote workers register with
+                # their node_id), and the per-node gauge must not
+                # attribute them to the head.
                 nw = sum(1 for w in self.workers.values()
-                         if w.state != "dead")
+                         if w.state != "dead"
+                         and w.node_id in ("", "head"))
             stats = sampler.sample(store=self.store, num_workers=nw)
             with self.lock:
                 head = self.nodes.get("head")

@@ -176,19 +176,16 @@ class Dashboard:
             from ray_tpu.util.timeline import timeline_events
             return timeline_events(
                 rt, max_tasks=int(qs.get("max_tasks", 0)))
-        if path.startswith("/api/workers/") and "/profile" in path:
+        if parsed.path.startswith("/api/workers/") \
+                and parsed.path.endswith("/profile"):
             # On-demand live-worker profiling (reference: dashboard
             # reporter profile_manager.py py-spy/memray endpoints;
             # kind=jax_trace adds the TPU-native xplane capture).
-            from urllib.parse import parse_qs as _pq
-            from urllib.parse import urlparse as _up
-            parsed = _up(path)
             worker_hex = parsed.path.split("/")[3]
-            q = _pq(parsed.query)
             from ray_tpu.state.api import profile_worker
             data = profile_worker(
-                worker_hex, kind=q.get("kind", ["stack"])[0],
-                duration_s=float(q.get("duration_s", ["2"])[0]))
+                worker_hex, kind=qs.get("kind", "stack"),
+                duration_s=float(qs.get("duration_s", "2")))
             return {"worker": worker_hex, "profile": data}
         if path == "/api/jobs":
             return self._jobs().list_jobs()

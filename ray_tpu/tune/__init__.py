@@ -14,6 +14,12 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.pb2 import PB2
+from ray_tpu.tune.external_searchers import (
+    AxSearch,
+    HEBOSearch,
+    NevergradSearch,
+    ZOOptSearch,
+)
 from ray_tpu.tune.searchers import (
     OptunaSearch,
     Searcher,
@@ -65,6 +71,7 @@ __all__ = [
     "SearchAlgorithm",
     "BasicVariantGenerator", "TPESearcher", "BOHBSearcher", "ConcurrencyLimiter",
     "Searcher", "OptunaSearch", "as_search_algorithm",
+    "AxSearch", "NevergradSearch", "HEBOSearch", "ZOOptSearch",
     "TrialScheduler",
     "FIFOScheduler",
     "AsyncHyperBandScheduler",

@@ -1,0 +1,418 @@
+"""External-library searcher adapters: Ax, Nevergrad, HEBO, ZOOpt.
+
+Counterpart of the reference's python/ray/tune/search/{ax,nevergrad,
+hebo,zoopt}/ adapters.  Each maps search.py domains onto the library's
+own ask/tell surface and implements the in-tree `Searcher` protocol
+(searchers.py), so `as_search_algorithm` plugs any of them into the
+Tuner.  None of the libraries ship in the air-gapped image: every
+adapter raises ImportError with guidance toward the native in-tree
+equivalent (TPE / BOHB / PB2 / BasicVariant), takes a `_module=`
+injection point, and is exercised against protocol-faithful stubs in
+tests/test_tune_searchers.py — where the real package exists, the same
+code activates unchanged.
+
+Domain mapping rules shared by all adapters:
+  - Uniform / QUniform  -> continuous range (q rounded after ask)
+  - LogUniform          -> log-scaled continuous range
+  - RandInt / LogRandInt-> integer range (high exclusive, like
+                           search.py's samplers)
+  - RandN               -> continuous range mean +- 4 sd (libraries
+                           without a normal prior)
+  - Choice / GridSearch -> categorical
+  - SampleFrom          -> resolved locally after the library's ask
+                           (depends on the sampled config)
+  - plain values        -> passed through untouched
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.tune.search import (
+    Choice,
+    Domain,
+    GridSearch,
+    LogRandInt,
+    LogUniform,
+    QUniform,
+    RandInt,
+    RandN,
+    SampleFrom,
+    Uniform,
+    _set_path,
+    _walk,
+)
+from ray_tpu.tune.searchers import Searcher
+
+
+def _missing(pkg: str, native: str):
+    return ImportError(
+        f"{pkg} is not installed (pip install {pkg}); in the "
+        f"air-gapped image use the native in-tree equivalent: {native}")
+
+
+def _dims(space) -> List[Tuple[Tuple[str, ...], Any]]:
+    """(path, leaf) for every tunable leaf, skipping SampleFrom.
+    GridSearch is NOT a Domain subclass (search.py treats grids as an
+    enumeration directive, not a sampler) but external optimizers see
+    it as a categorical, so it is included explicitly."""
+    return [(path, leaf) for path, leaf in _walk(space or {})
+            if (isinstance(leaf, Domain)
+                and not isinstance(leaf, SampleFrom))
+            or isinstance(leaf, GridSearch)]
+
+
+def _assemble(space, sampled: Dict[str, Any]) -> Dict[str, Any]:
+    """Merge library-sampled values (keyed by dotted path) with the
+    constant / SampleFrom parts of the space."""
+    cfg: Dict[str, Any] = {}
+    deferred = []
+    for path, leaf in _walk(space or {}):
+        name = ".".join(path)
+        if isinstance(leaf, SampleFrom):
+            deferred.append((path, leaf))
+        elif name in sampled:
+            _set_path(cfg, path, sampled[name])
+        else:
+            _set_path(cfg, path, leaf)
+    for path, leaf in deferred:
+        _set_path(cfg, path, leaf.fn(cfg))
+    return cfg
+
+
+def _bounds(leaf) -> Tuple[float, float, bool, bool]:
+    """(low, high, is_int, log) for range-typed domains."""
+    if isinstance(leaf, LogUniform):
+        return float(leaf.low), float(leaf.high), False, True
+    if isinstance(leaf, (Uniform, QUniform)):
+        return float(leaf.low), float(leaf.high), False, False
+    if isinstance(leaf, LogRandInt):
+        return float(leaf.low), float(max(leaf.low, leaf.high - 1)), \
+            True, True
+    if isinstance(leaf, RandInt):
+        return float(leaf.low), float(max(leaf.low, leaf.high - 1)), \
+            True, False
+    if isinstance(leaf, RandN):
+        return leaf.mean - 4 * leaf.sd, leaf.mean + 4 * leaf.sd, \
+            False, False
+    raise TypeError(f"not a range domain: {leaf!r}")
+
+
+def _postprocess(leaf, value):
+    """Round q-quantized and integer domains after the library's ask."""
+    if isinstance(leaf, QUniform):
+        return round(round(float(value) / leaf.q) * leaf.q, 10)
+    if isinstance(leaf, (RandInt, LogRandInt)):
+        return int(round(float(value)))
+    return value
+
+
+class AxSearch(Searcher):
+    """Adapter over Ax's Service API (reference
+    tune/search/ax/ax_search.py): AxClient.create_experiment with typed
+    parameter dicts, get_next_trial -> complete_trial."""
+
+    def __init__(self, ax_client=None, _module=None):
+        if ax_client is None and _module is None:
+            try:
+                from ax.service.ax_client import AxClient  # noqa: PLC0415
+
+                _module = AxClient
+            except ImportError as e:
+                raise _missing(
+                    "ax-platform",
+                    "PB2 (native GP-bandit, ray_tpu.tune.PB2) or "
+                    "TPESearcher") from e
+        self._client = ax_client if ax_client is not None else _module()
+        self._trials: Dict[str, int] = {}
+        self._space = {}
+        self._leaves: Dict[str, Any] = {}
+        self._metric = None
+
+    def set_search_properties(self, metric, mode, space):
+        self._metric, self._space = metric, space or {}
+        params = []
+        for path, leaf in _dims(self._space):
+            name = ".".join(path)
+            self._leaves[name] = leaf
+            if isinstance(leaf, (Choice, GridSearch)):
+                params.append({"name": name, "type": "choice",
+                               "values": list(leaf.values)})
+            else:
+                lo, hi, is_int, log = _bounds(leaf)
+                params.append({
+                    "name": name, "type": "range",
+                    "bounds": [int(lo), int(hi)] if is_int
+                    else [lo, hi],
+                    "value_type": "int" if is_int else "float",
+                    "log_scale": log,
+                })
+        self._client.create_experiment(
+            name="ray_tpu_tune", parameters=params,
+            objective_name=metric, minimize=(mode == "min"))
+        return True
+
+    def suggest(self, trial_id):
+        params, index = self._client.get_next_trial()
+        self._trials[trial_id] = index
+        sampled = {k: _postprocess(self._leaves[k], v)
+                   for k, v in params.items() if k in self._leaves}
+        return _assemble(self._space, sampled)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        index = self._trials.pop(trial_id, None)
+        if index is None:
+            return
+        if error or not result or self._metric not in result:
+            try:
+                self._client.log_trial_failure(index)
+            except Exception:
+                pass
+            return
+        self._client.complete_trial(
+            index, raw_data={self._metric:
+                             (float(result[self._metric]), 0.0)})
+
+
+class NevergradSearch(Searcher):
+    """Adapter over nevergrad's ask/tell optimizers (reference
+    tune/search/nevergrad/nevergrad_search.py): a parametrization Dict
+    of Scalar/Log/Choice instruments, optimizer.ask() -> .tell()."""
+
+    def __init__(self, optimizer: Optional[str] = "NGOpt", budget=None,
+                 _module=None):
+        if _module is None:
+            try:
+                import nevergrad  # noqa: PLC0415
+
+                _module = nevergrad
+            except ImportError as e:
+                raise _missing(
+                    "nevergrad",
+                    "TPESearcher or BasicVariantGenerator") from e
+        self._ng = _module
+        self._optimizer_name = optimizer
+        self._budget = budget
+        self._opt = None
+        self._space = {}
+        self._leaves: Dict[str, Any] = {}
+        self._metric = None
+        self._mode = "max"
+        self._candidates: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, space):
+        self._metric, self._mode, self._space = metric, mode, space or {}
+        ng = self._ng
+        instruments = {}
+        for path, leaf in _dims(self._space):
+            name = ".".join(path)
+            self._leaves[name] = leaf
+            if isinstance(leaf, (Choice, GridSearch)):
+                instruments[name] = ng.p.Choice(list(leaf.values))
+            else:
+                lo, hi, is_int, log = _bounds(leaf)
+                scalar = ng.p.Log(lower=lo, upper=hi) if log \
+                    else ng.p.Scalar(lower=lo, upper=hi)
+                if is_int:
+                    scalar = scalar.set_integer_casting()
+                instruments[name] = scalar
+        param = ng.p.Dict(**instruments)
+        opt_cls = getattr(ng.optimizers, self._optimizer_name)
+        self._opt = opt_cls(parametrization=param, budget=self._budget)
+        return True
+
+    def suggest(self, trial_id):
+        cand = self._opt.ask()
+        self._candidates[trial_id] = cand
+        sampled = {k: _postprocess(self._leaves[k], v)
+                   for k, v in cand.value.items()}
+        return _assemble(self._space, sampled)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        cand = self._candidates.pop(trial_id, None)
+        if cand is None or error or not result \
+                or self._metric not in result:
+            return
+        value = float(result[self._metric])
+        # nevergrad minimizes.
+        self._opt.tell(cand, -value if self._mode == "max" else value)
+
+
+class HEBOSearch(Searcher):
+    """Adapter over HEBO's DataFrame ask/tell (reference
+    tune/search/hebo/hebo_search.py): DesignSpace.parse of typed
+    variable dicts, suggest() -> observe()."""
+
+    def __init__(self, _module=None):
+        if _module is None:
+            try:
+                import hebo.optimizers.hebo as hebo_mod  # noqa: PLC0415
+                from hebo.design_space.design_space import (  # noqa
+                    DesignSpace,
+                )
+
+                _module = (hebo_mod.HEBO, DesignSpace)
+            except ImportError as e:
+                raise _missing(
+                    "HEBO", "PB2 (native GP-bandit) or BOHBSearcher"
+                ) from e
+        self._hebo_cls, self._space_cls = _module
+        self._opt = None
+        self._space = {}
+        self._leaves: Dict[str, Any] = {}
+        self._metric = None
+        self._mode = "max"
+        self._pending: Dict[str, Any] = {}
+
+    def set_search_properties(self, metric, mode, space):
+        self._metric, self._mode, self._space = metric, mode, space or {}
+        specs = []
+        for path, leaf in _dims(self._space):
+            name = ".".join(path)
+            self._leaves[name] = leaf
+            if isinstance(leaf, (Choice, GridSearch)):
+                specs.append({"name": name, "type": "cat",
+                              "categories": list(leaf.values)})
+            else:
+                lo, hi, is_int, log = _bounds(leaf)
+                if is_int:
+                    specs.append({"name": name, "type": "int",
+                                  "lb": int(lo), "ub": int(hi)})
+                elif log:
+                    specs.append({"name": name, "type": "pow",
+                                  "lb": lo, "ub": hi})
+                else:
+                    specs.append({"name": name, "type": "num",
+                                  "lb": lo, "ub": hi})
+        self._opt = self._hebo_cls(self._space_cls().parse(specs))
+        return True
+
+    def suggest(self, trial_id):
+        rec = self._opt.suggest(n_suggestions=1)
+        self._pending[trial_id] = rec
+        row = rec.iloc[0].to_dict()
+        sampled = {k: _postprocess(self._leaves[k], v)
+                   for k, v in row.items() if k in self._leaves}
+        return _assemble(self._space, sampled)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        import numpy as np
+
+        rec = self._pending.pop(trial_id, None)
+        if rec is None or error or not result \
+                or self._metric not in result:
+            return
+        value = float(result[self._metric])
+        # HEBO minimizes.
+        y = -value if self._mode == "max" else value
+        self._opt.observe(rec, np.asarray([[y]]))
+
+
+class ZOOptSearch(Searcher):
+    """Adapter over ZOOpt (reference tune/search/zoopt/zoopt_search.py).
+
+    ZOOpt's public surface is solve-oriented (`Opt.min(objective,
+    parameter)` drives the loop), so the adapter INVERTS it: the solve
+    loop runs on a daemon thread whose objective function blocks
+    handing each solution to `suggest` and waits for
+    `on_trial_complete` to report the value — the classic
+    loop-inversion bridge between solve-style optimizers and ask/tell
+    schedulers."""
+
+    def __init__(self, budget: int = 100, _module=None):
+        if _module is None:
+            try:
+                import zoopt  # noqa: PLC0415
+
+                _module = zoopt
+            except ImportError as e:
+                raise _missing(
+                    "zoopt", "TPESearcher or BOHBSearcher") from e
+        self._zoopt = _module
+        self._budget = budget
+        self._space = {}
+        self._leaves: List[Tuple[str, Any]] = []
+        self._metric = None
+        self._mode = "max"
+        import queue
+        import threading
+
+        self._asks = queue.Queue(maxsize=1)
+        self._tells: Dict[int, Any] = {}
+        self._tell_cv = threading.Condition()
+        self._pending: Dict[str, Tuple[int, Any]] = {}
+        self._next_ask = 0
+        self._thread = None
+
+    def set_search_properties(self, metric, mode, space):
+        self._metric, self._mode, self._space = metric, mode, space or {}
+        z = self._zoopt
+        dims = []
+        self._leaves = []
+        for path, leaf in _dims(self._space):
+            name = ".".join(path)
+            self._leaves.append((name, leaf))
+            if isinstance(leaf, (Choice, GridSearch)):
+                # Categoricals become an index dimension.
+                dims.append(([0, len(leaf.values) - 1], False))
+            else:
+                lo, hi, is_int, _log = _bounds(leaf)
+                dims.append(([lo, hi], not is_int))
+
+        def objective(solution):
+            xs = solution.get_x()
+            idx = self._enqueue(xs)
+            return self._await_tell(idx)
+
+        dim = z.Dimension(len(dims), [d[0] for d in dims],
+                          [d[1] for d in dims])
+        obj = z.Objective(objective, dim)
+        par = z.Parameter(budget=self._budget)
+        import threading
+
+        self._thread = threading.Thread(
+            target=lambda: z.Opt.min(obj, par), daemon=True,
+            name="zoopt-solve")
+        self._thread.start()
+        return True
+
+    def _enqueue(self, xs) -> int:
+        with self._tell_cv:
+            idx = self._next_ask
+            self._next_ask += 1
+        self._asks.put((idx, xs))
+        return idx
+
+    def _await_tell(self, idx: int) -> float:
+        with self._tell_cv:
+            while idx not in self._tells:
+                self._tell_cv.wait(timeout=1.0)
+            return self._tells.pop(idx)
+
+    def suggest(self, trial_id):
+        try:
+            idx, xs = self._asks.get(timeout=30.0)
+        except Exception:
+            return None  # budget exhausted: solve thread finished
+        sampled = {}
+        for (name, leaf), value in zip(self._leaves, xs):
+            if isinstance(leaf, (Choice, GridSearch)):
+                sampled[name] = list(leaf.values)[int(round(value))]
+            else:
+                sampled[name] = _postprocess(leaf, value)
+        self._pending[trial_id] = (idx, xs)
+        return _assemble(self._space, sampled)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        ent = self._pending.pop(trial_id, None)
+        if ent is None:
+            return
+        idx, _ = ent
+        if error or not result or self._metric not in result:
+            value = float("inf")  # zoopt minimizes; a failure is worst
+        else:
+            v = float(result[self._metric])
+            value = -v if self._mode == "max" else v
+        with self._tell_cv:
+            self._tells[idx] = value
+            self._tell_cv.notify_all()

@@ -271,19 +271,29 @@ def test_dashboard_full_surface_three_node_cluster(tmp_path):
                 break
             _time.sleep(1.0)
         assert remote_ok, stats
+        assert stats.get("head", {}).get("mem_total_bytes"), stats
         assert stats["dashA"]["object_store_capacity_bytes"] > 0
 
         # Sampled timeline.
         tl = _get_json(f"{base}/api/timeline?max_tasks=3")
         assert isinstance(tl, list)
 
-        # On-demand profile of a LIVE worker from the head.
+        # On-demand profile of a LIVE worker from the head.  A listed
+        # idle worker can exit between the listing and the profile
+        # call (pool reaping), so try each until one answers.
         workers = [w for w in rt.state_list("workers")
                    if w["kind"] == "pool" and w.get("pid")]
         assert workers
-        prof = _get_json(
-            f"{base}/api/workers/{workers[0]['worker_id']}/profile"
-            "?kind=stack")
+        prof = None
+        for w in workers:
+            try:
+                prof = _get_json(
+                    f"{base}/api/workers/{w['worker_id']}/profile"
+                    "?kind=stack")
+                break
+            except Exception:
+                continue
+        assert prof is not None, "no live worker answered a profile"
         assert "Thread" in str(prof["profile"]) or "File" in str(
             prof["profile"])
 
