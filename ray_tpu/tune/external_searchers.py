@@ -390,10 +390,32 @@ class ZOOptSearch(Searcher):
             return self._tells.pop(idx)
 
     def suggest(self, trial_id):
+        """ZOOpt's sequential RACOS proposes ONE solution at a time
+        (the solve thread blocks in the objective until the previous
+        trial reports), so with a trial in flight this returns None
+        immediately — the controller retries after completions instead
+        of stalling its loop.  None with nothing in flight and a dead
+        solve thread means the budget is exhausted."""
+        import queue
+        import time
+
         try:
-            idx, xs = self._asks.get(timeout=30.0)
-        except Exception:
-            return None  # budget exhausted: solve thread finished
+            idx, xs = self._asks.get_nowait()
+        except queue.Empty:
+            if self._pending:
+                return None  # a solution is in flight; ask again later
+            deadline = time.monotonic() + 5.0
+            idx = None
+            while time.monotonic() < deadline:
+                try:
+                    idx, xs = self._asks.get(timeout=0.2)
+                    break
+                except queue.Empty:
+                    if self._thread is None \
+                            or not self._thread.is_alive():
+                        return None  # budget exhausted
+            if idx is None:
+                return None
         sampled = {}
         for (name, leaf), value in zip(self._leaves, xs):
             if isinstance(leaf, (Choice, GridSearch)):
