@@ -36,7 +36,14 @@ class LLMServer:
                  config: Optional[tfm.TransformerConfig] = None,
                  checkpoint_path: Optional[str] = None,
                  page_size: int = 16, num_pages: int = 512,
-                 max_batch: int = 8):
+                 max_batch: int = 8, **engine_kwargs):
+        """Extra engine knobs pass through to LLMEngine (multi_step,
+        pipeline_depth, enable_prefix_caching, speculative_k, ...).
+        TPU serving guidance (measured, DECODE_BENCH_r04): page_size
+        >= 64 — the decode kernel streams one fused-head page per DMA,
+        so tiny pages are latency-bound — and multi_step 16-32 with the
+        default pipelined dispatch keeps the chip busy while bounding
+        admission latency; the tiny defaults here suit CPU tests."""
         import threading
 
         if config is None:
@@ -51,7 +58,7 @@ class LLMServer:
 
         self.engine = LLMEngine(
             config, params, page_size=page_size, num_pages=num_pages,
-            max_batch=max_batch)
+            max_batch=max_batch, **engine_kwargs)
         self._cv = threading.Condition()
         self._results: Dict[int, List[int]] = {}
         self._engine_error: Optional[BaseException] = None
