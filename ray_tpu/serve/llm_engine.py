@@ -109,6 +109,16 @@ class PrefixCache:
             pages.append(e.page)
         return pages
 
+    def peek(self, keys: Sequence[bytes]) -> int:
+        """Length of the cached chain WITHOUT touching refcounts (the
+        packed-admission eligibility probe)."""
+        n = 0
+        for key in keys:
+            if key not in self._entries:
+                break
+            n += 1
+        return n
+
     def register(self, key: bytes, page: int, depth: int) -> bool:
         """Adopt a freshly computed full prompt page (refcount 1, held
         by the computing request). False if the key is already cached
@@ -166,7 +176,10 @@ class LLMEngine:
                  max_batch: int = 8, seed: int = 0,
                  enable_prefix_caching: bool = True,
                  speculative_k: int = 0, speculative_ngram: int = 2,
-                 multi_step: int = 1, pipeline_depth: int = 2):
+                 multi_step: int = 1, pipeline_depth: int = 2,
+                 packed_admit: bool = True,
+                 prefill_wave_tokens: int = 8192,
+                 prefill_row_tokens: int = 1024):
         import jax
 
         c = config
@@ -198,6 +211,22 @@ class LLMEngine:
         # paying a sync per chunk.  Depth 1 = dispatch-then-reconcile
         # (classic synchronous behavior).
         self.pipeline_depth = max(1, int(pipeline_depth))
+        # Packed async admission (greedy pipelined path): waiting
+        # prompts are padded to a pow-2 page-multiple bucket, packed
+        # into long rows (matmul-efficient layout), prefilled AND
+        # folded into the device decode state in one dispatch — the
+        # first tokens come back off the critical path, so admission
+        # never stalls in-flight decode chunks on a host sync
+        # (models/decoding.py packed_prefill_admit).
+        self.packed_admit = bool(packed_admit) \
+            and page_size & (page_size - 1) == 0
+        self.prefill_wave_tokens = max(page_size,
+                                       int(prefill_wave_tokens))
+        self.prefill_row_tokens = max(page_size, int(prefill_row_tokens))
+        # Step-classification counters (benchmarks use these to tell
+        # pure-decode steps from ones that did admission work).
+        self.waves_dispatched = 0
+        self.prefill_reconciles = 0
         self._inflight: List[dict] = []  # FIFO of dispatched chunks
         self._dstate = None  # device (tokens, positions, ctx, lim, eos)
         self._dirty_slots: set = set()  # freed slots to zero on device
@@ -282,20 +311,29 @@ class LLMEngine:
         that produced them)."""
         done: Dict[int, List[int]] = {}
         if self._pipelined_ok():
+            # Completed in-flight work costs nothing to fold in.
+            self._eager_reconcile(done)
             # Admissions need free slots: recycle the oldest in-flight
             # chunk first when the queue would otherwise starve.
             if self.waiting and not self._free_slots() and self._inflight:
                 self._reconcile_oldest(done)
-            done.update(self._admit())
-            if not self._pipelined_ok():
-                # An admission just seated a sampling request: drain
-                # and run this step on the classic per-token path.
-                self._flush_pipeline(done)
-                if self.num_active:
-                    done.update(self._decode())
-                return done
+            self._dispatch_prefill_wave()
+            if self.waiting and self._free_slots() \
+                    and not self._wave_eligible(self.waiting[0]):
+                # Head of queue needs the classic synchronous path
+                # (sampling, prefix-cache hit, packed admission off).
+                done.update(self._admit())
+                if not self._pipelined_ok():
+                    # An admission just seated a sampling request: drain
+                    # and run this step on the classic per-token path.
+                    self._flush_pipeline(done)
+                    if self.num_active:
+                        done.update(self._decode())
+                    return done
             dispatched = self._dispatch_chunk()
-            if len(self._inflight) >= self.pipeline_depth \
+            ndecode = sum(1 for ch in self._inflight
+                          if ch.get("type") != "prefill")
+            if ndecode >= self.pipeline_depth \
                     or (self._inflight and not dispatched):
                 self._reconcile_oldest(done)
             return done
@@ -494,6 +532,163 @@ class LLMEngine:
         if fin is not None:  # e.g. max_new_tokens == 1
             done[req.req_id] = fin
 
+    # -- packed async admission (greedy pipelined path) --------------------
+    def _seg_len(self, prompt_len: int) -> int:
+        """Pow-2 page-multiple bucket a prompt pads to inside a packed
+        row (pow-2 >= page_size is automatically a page multiple)."""
+        return max(self.page_size, 1 << (prompt_len - 1).bit_length())
+
+    def _wave_eligible(self, req: "_Request") -> bool:
+        """Packed admission serves greedy, prefix-cache-miss prompts;
+        sampling needs host logits and cache hits need the gather-based
+        chunked program — both stay on the classic path."""
+        if not self.packed_admit or req.temperature > 0.0:
+            return False
+        if self.prefix_cache is not None:
+            L = len(req.prompt)
+            if req.chain_keys is None:
+                req.chain_keys = PrefixCache.chain_hashes(
+                    req.prompt, self.page_size, L // self.page_size)
+            matchable = max(0, (L - 1) // self.page_size)
+            if self.prefix_cache.peek(req.chain_keys[:matchable]) > 0:
+                return False
+        return True
+
+    def _dispatch_prefill_wave(self) -> int:
+        """Admit a FIFO prefix of wave-eligible same-bucket requests in
+        ONE async dispatch (models/decoding.py packed_prefill_admit):
+        prompts pack into matmul-efficient rows, K/V pages are written,
+        first greedy tokens computed, and the device decode state
+        updated — without materializing anything on the host.  The
+        first tokens surface at reconcile time, off the critical path,
+        so in-flight decode chunks keep the device busy while prompts
+        prefill."""
+        if not self.packed_admit or not self._pipelined_ok():
+            return 0
+        free = self._free_slots()
+        if not free or not self.waiting:
+            return 0
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import packed_prefill_admit
+
+        batch: List[_Request] = []
+        head_sl = None
+        budget = self.prefill_wave_tokens
+        # Same-wave shared-prefix dedup (mirrors classic _admit's
+        # pending_keys): a request whose prefix THIS wave will register
+        # defers one step, then admits via the cache-hit classic path
+        # instead of recomputing the prefix.
+        pending_keys: set = set()
+        while self.waiting and free:
+            req = self.waiting[0]
+            if not self._wave_eligible(req):
+                break
+            if req.chain_keys and req.chain_keys[0] in pending_keys:
+                break
+            L = len(req.prompt)
+            sl = self._seg_len(L)
+            if head_sl is None:
+                head_sl = sl
+            elif sl != head_sl:
+                break  # next bucket gets its own wave next step
+            if batch and budget < sl:
+                break
+            total = math.ceil((L + req.max_new_tokens) / self.page_size)
+            if total > self._available_pages():
+                break  # backpressure: wait for pages
+            self.waiting.pop(0)
+            req.slot = free.pop(0)
+            req.pages = self._alloc_evicting(total)
+            if self.prefix_cache is not None and req.chain_keys:
+                pending_keys.update(
+                    req.chain_keys[:L // self.page_size])
+            batch.append(req)
+            budget -= sl
+        if not batch:
+            return 0
+        # Fold pending host-side slot changes in BEFORE the wave slots
+        # become live: a freed-slot merge arriving after assignment
+        # would overwrite the wave's device-computed rows.
+        self._sync_dstate()
+
+        seg_len = head_sl
+        ps = self.page_size
+        segs_per_row = max(1, self.prefill_row_tokens // seg_len)
+        rows = math.ceil(len(batch) / segs_per_row)
+        R = 1 << (rows - 1).bit_length()
+        S_row = segs_per_row * seg_len
+        nseg = R * segs_per_row
+        seg_pages = seg_len // ps
+        tokens = np.zeros((R, S_row), dtype=np.int32)
+        positions = np.full((R, S_row), -1, dtype=np.int32)
+        row_tables = np.zeros((R, S_row // ps), dtype=np.int32)
+        seg_slot = np.full(nseg, self.max_batch, dtype=np.int32)
+        seg_limit = np.zeros(nseg, dtype=np.int32)
+        seg_eos = np.full(nseg, -1, dtype=np.int32)
+        for i, req in enumerate(batch):
+            r, si = divmod(i, segs_per_row)
+            L = len(req.prompt)
+            j0 = si * seg_len
+            tokens[r, j0:j0 + L] = req.prompt
+            positions[r, j0:j0 + L] = np.arange(L)
+            npg = min(len(req.pages), seg_pages)
+            row_tables[r, si * seg_pages:si * seg_pages + npg] = \
+                req.pages[:npg]
+            seg_slot[i] = req.slot
+            seg_limit[i] = L + req.max_new_tokens - 1
+            seg_eos[i] = req.eos_token if req.eos_token is not None \
+                else -1
+            table = np.zeros(self.max_pages_per_seq, dtype=np.int32)
+            table[:len(req.pages)] = req.pages
+            self.block_tables[req.slot] = table
+            self.context_lens[req.slot] = L
+            self.slot_req[req.slot] = req
+            # Wave slots are device-authoritative from here on; the
+            # _sync_dstate() call above flushed any pending host-side
+            # merge for them while they were still free, so no stale
+            # host row can overwrite the wave's device-computed state.
+            # Adopt full prompt pages immediately: later matches order
+            # behind this dispatch through the device cache handle.
+            if self.prefix_cache is not None and req.chain_keys:
+                own = []
+                for pi in range(L // ps):
+                    page = req.pages[pi]
+                    if self.prefix_cache.register(
+                            req.chain_keys[pi], page, pi):
+                        req.cache_keys.append(req.chain_keys[pi])
+                        own.append(page)
+                req.pages = [p for p in req.pages if p not in own]
+
+        toks, pos, ctx, lim, eos = self._dstate
+        first, self.cache, toks, pos, ctx, lim, eos = \
+            packed_prefill_admit(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(row_tables), jnp.asarray(seg_slot),
+                jnp.asarray(seg_limit), jnp.asarray(seg_eos),
+                self.cache, toks, pos, ctx, lim, eos, self.config,
+                seg_len)
+        self._dstate = (toks, pos, ctx, lim, eos)
+        self._inflight.append({
+            "type": "prefill", "first": first, "segs": list(batch),
+            "planned": {req.slot: 1 for req in batch}})
+        self.waves_dispatched += 1
+        return len(batch)
+
+    def _eager_reconcile(self, done: Dict[int, List[int]]):
+        """Fold in any in-flight records whose device results are
+        already materialized — free TTFT/latency, no waiting."""
+        while self._inflight:
+            ch = self._inflight[0]
+            arr = ch["first"] if ch.get("type") == "prefill" \
+                else ch["out"]
+            try:
+                if not arr.is_ready():
+                    break
+            except AttributeError:
+                break
+            self._reconcile_oldest(done)
+
     # -- pipelined chunk decode (greedy multi-step) ------------------------
     def _slot_state_rows(self, slot: int):
         """Host-authoritative device-state row for one slot: live slots
@@ -592,6 +787,23 @@ class LLMEngine:
         finish/free requests.  Rows for slots that died device-side
         (limit/EOS) carry -1 past the stop."""
         ch = self._inflight.pop(0)
+        if ch.get("type") == "prefill":
+            self.prefill_reconciles += 1
+            first = np.asarray(ch["first"])
+            for i, req in enumerate(ch["segs"]):
+                if self.slot_req[req.slot] is not req:
+                    continue
+                tok = int(first[i])
+                # Keep the host mirror authoritative: a mode switch to
+                # the classic path (_flush_pipeline -> _decode) resumes
+                # decoding from last_tokens.
+                self.last_tokens[req.slot] = tok
+                req.generated.append(tok)
+                fin = self._maybe_finish(req)
+                if fin is not None:
+                    done[req.req_id] = fin
+                    self._dirty_slots.add(req.slot)
+            return
         toks = np.asarray(ch["out"])
         for slot, req in ch["snapshot"].items():
             if self.slot_req[slot] is not req:

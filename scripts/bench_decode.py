@@ -52,13 +52,21 @@ def run_shape(config, *, n_requests, prompt_len, max_new, page_size,
     prompts = [rng.integers(1, config.vocab_size, prompt_len).tolist()
                for _ in range(n_requests)]
 
-    # Warmup compiles every bucket the measured run hits: the batched
-    # prefill and one decode program per pow-2 context-width bucket
-    # (steady-state serving never pays compiles, so neither should the
-    # measurement).
+    # Warmup compiles every program the measured run hits: the packed
+    # admission wave, one decode program per pow-2 context-width
+    # bucket, AND the dirty-slot merge (a mid-run admission while old
+    # slots finish exercises merge_slot_state; steady-state serving
+    # never pays compiles, so neither should the measurement).
     warm = [rng.integers(1, config.vocab_size, prompt_len).tolist()
             for _ in range(max_batch)]
     eng.generate(warm, max_new_tokens=max_new)
+    stagger = [rng.integers(1, config.vocab_size, prompt_len).tolist()
+               for _ in range(2)]
+    eng.add_request(stagger[0], max_new_tokens=max_new)
+    eng.step()
+    eng.add_request(stagger[1], max_new_tokens=8)
+    while eng.has_work():
+        eng.step()
 
     t0 = time.perf_counter()
     t_add = {}
@@ -82,6 +90,7 @@ def run_shape(config, *, n_requests, prompt_len, max_new, page_size,
 
     while eng.has_work():
         waiting_before = len(eng.waiting)
+        waves_before = (eng.waves_dispatched, eng.prefill_reconciles)
         ts = time.perf_counter()
         done = eng.step()
         te = time.perf_counter()
@@ -96,8 +105,12 @@ def run_shape(config, *, n_requests, prompt_len, max_new, page_size,
         for rid in done:
             t_first.setdefault(rid, now)
         emitted = emitted_now()
-        if len(eng.waiting) == waiting_before and waiting_before == 0:
-            # Pure decode step: no admission/prefill work happened.
+        if (len(eng.waiting) == waiting_before and waiting_before == 0
+                and (eng.waves_dispatched,
+                     eng.prefill_reconciles) == waves_before):
+            # Pure decode step: no admission/prefill work happened —
+            # dispatching a wave or waiting on a wave's first tokens
+            # both disqualify the step from the decode-only wall.
             decode_wall += te - ts
             decode_tokens += emitted - emitted_prev
         emitted_prev = emitted

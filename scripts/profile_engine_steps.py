@@ -1,0 +1,53 @@
+"""Per-step wall-time trace of the 128+128 bench shape."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from ray_tpu.models import transformer as tfm
+from ray_tpu.serve.llm_engine import LLMEngine
+
+
+def main():
+    config = tfm.TransformerConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=22, num_heads=16, num_kv_heads=4,
+        max_seq_len=2048, remat=False)
+    eng = LLMEngine(config, page_size=128, num_pages=320,
+                    max_batch=128, multi_step=32)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, config.vocab_size, 128).tolist()
+               for _ in range(128)]
+    warm = [rng.integers(1, config.vocab_size, 128).tolist()
+            for _ in range(128)]
+    t0 = time.perf_counter()
+    eng.generate(warm, max_new_tokens=128)
+    print(f"warm done {time.perf_counter()-t0:.1f}s", flush=True)
+
+    t0 = time.perf_counter()
+    for p in prompts:
+        eng.add_request(p, max_new_tokens=128)
+    results = {}
+    step = 0
+    while eng.has_work():
+        ts = time.perf_counter()
+        nw, ni = len(eng.waiting), len(eng._inflight)
+        done = eng.step()
+        te = time.perf_counter()
+        results.update(done)
+        print(f"step {step}: {te-ts:7.3f}s  waiting {nw}->"
+              f"{len(eng.waiting)}  inflight {ni}->"
+              f"{len(eng._inflight)}  done {len(done)}  "
+              f"t={te-t0:.3f}", flush=True)
+        step += 1
+    print(f"total {time.perf_counter()-t0:.2f}s  "
+          f"requests {len(results)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

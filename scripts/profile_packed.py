@@ -1,0 +1,85 @@
+"""Time packed_prefill_admit at the bench wave shape."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import transformer as tfm
+from ray_tpu.models.decoding import init_kv_pages, packed_prefill_admit
+
+
+def main():
+    config = tfm.TransformerConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=22, num_heads=16, num_kv_heads=4,
+        max_seq_len=2048, remat=False)
+    c = config
+    params = tfm.init_params(c, jax.random.key(0))
+    params = jax.tree.map(
+        lambda x: x.astype(c.dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x, params)
+    P_total = tfm.num_params(c)
+    rng = np.random.default_rng(0)
+    ps, num_pages, max_batch = 128, 320, 128
+
+    for (R, S_row, seg_len) in [(16, 1024, 128), (8, 2048, 128),
+                                (16, 1024, 1024), (4, 1024, 128)]:
+        nseg = R * S_row // seg_len
+        segs_per_row = S_row // seg_len
+        tokens = np.zeros((R, S_row), dtype=np.int32)
+        positions = np.full((R, S_row), -1, dtype=np.int32)
+        row_tables = np.zeros((R, S_row // ps), dtype=np.int32)
+        seg_slot = np.full(nseg, max_batch, dtype=np.int32)
+        seg_limit = np.zeros(nseg, dtype=np.int32)
+        seg_eos = np.full(nseg, -1, dtype=np.int32)
+        L = seg_len  # full segments
+        pg = 0
+        for i in range(min(nseg, max_batch)):
+            r, si = divmod(i, segs_per_row)
+            j0 = si * seg_len
+            tokens[r, j0:j0 + L] = rng.integers(1, c.vocab_size, L)
+            positions[r, j0:j0 + L] = np.arange(L)
+            for k in range(seg_len // ps):
+                row_tables[r, si * (seg_len // ps) + k] = \
+                    pg % (num_pages - 2)
+                pg += 1
+            seg_slot[i] = i % max_batch
+            seg_limit[i] = L + 128 - 1
+        st = [jnp.zeros(max_batch, dtype=jnp.int32) for _ in range(5)]
+        cache = init_kv_pages(c, num_pages, ps)
+        state = {"cache": cache, "st": st}
+
+        def run():
+            first, state["cache"], *new_st = packed_prefill_admit(
+                params, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(row_tables), jnp.asarray(seg_slot),
+                jnp.asarray(seg_limit), jnp.asarray(seg_eos),
+                state["cache"], *state["st"], c, seg_len)
+            state["st"] = new_st
+            return first
+
+        jax.block_until_ready(run())
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(run())
+            times.append(time.perf_counter() - t0)
+        dt = min(times)
+        ntok = R * S_row
+        flops = 2 * P_total * ntok
+        print(f"packed R={R:3d} S={S_row:5d} seg={seg_len:5d}: "
+              f"{dt*1e3:8.1f} ms  {ntok/dt:9.0f} tok/s  "
+              f"mfu={flops/dt/197e12:.3f}")
+        del state
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,111 @@
+"""Ad-hoc profiling of prefill/decode building blocks on the real chip.
+
+Not part of the test suite; used to attribute serving wall time between
+prefill compute, cache writes, and the decode gather widths.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import transformer as tfm
+from ray_tpu.models.decoding import (
+    decode_multi_step, init_kv_pages, prefill)
+
+
+def timeit(fn, n=5):
+    jax.block_until_ready(fn())  # warm
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        np.asarray(fn())
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    config = tfm.TransformerConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=22, num_heads=16, num_kv_heads=4,
+        max_seq_len=2048, remat=False)
+    c = config
+    params = tfm.init_params(c, jax.random.key(0))
+    params = jax.tree.map(
+        lambda x: x.astype(c.dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x, params)
+    page_size, num_pages = 128, 320
+    cache = init_kv_pages(c, num_pages, page_size)
+    P = tfm.num_params(c)
+    print(f"params {P/1e9:.2f}B, cache {cache['k'].nbytes*2/1e9:.2f} GB",
+          file=sys.stderr)
+
+    max_pages_per_seq = c.max_seq_len // page_size
+    rng = np.random.default_rng(0)
+
+    for B in (128, 64, 32, 16):
+        S = 128
+        tokens = jnp.asarray(
+            rng.integers(1, c.vocab_size, (B, S)), dtype=jnp.int32)
+        positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+        # one page per row for the prompt
+        tables = np.zeros((B, max_pages_per_seq), dtype=np.int32)
+        for r in range(B):
+            tables[r, 0] = (2 * r) % (num_pages - 2)
+            tables[r, 1] = (2 * r + 1) % (num_pages - 2)
+        tables = jnp.asarray(tables)
+
+        state = {"cache": cache}
+
+        def run():
+            logits, state["cache"] = prefill(
+                params, tokens, positions, state["cache"], tables, c)
+            return logits
+
+        dt = timeit(run, n=3)
+        flops = 2 * P * B * S
+        print(f"prefill B={B:4d} S={S}: {dt*1e3:8.1f} ms  "
+              f"{B*S/dt:9.0f} tok/s  mfu={flops/dt/197e12:.3f}")
+        cache = state["cache"]
+
+    # decode chunk timing at two table widths
+    B = 128
+    toks = jnp.asarray(rng.integers(1, c.vocab_size, B), dtype=jnp.int32)
+    pos = jnp.full((B,), 128, dtype=jnp.int32)
+    ctx = jnp.full((B,), 129, dtype=jnp.int32)
+    lim = jnp.full((B,), 100000, dtype=jnp.int32)
+    eos = jnp.full((B,), -1, dtype=jnp.int32)
+    for W in (2, 4, 16):
+        tables = np.zeros((B, W), dtype=np.int32)
+        for r in range(B):
+            tables[r, 0] = (2 * r) % (num_pages - 2)
+            tables[r, 1] = (2 * r + 1) % (num_pages - 2)
+        tables = jnp.asarray(tables)
+        state = {"cache": cache, "toks": toks, "pos": pos, "ctx": ctx}
+
+        def run():
+            out, t2, p2, c2, state["cache"] = decode_multi_step(
+                params, state["toks"], state["cache"], tables,
+                state["pos"], state["ctx"], lim, eos, c, 32)
+            return out
+
+        dt = timeit(run, n=3)
+        per_iter = dt / 32
+        traffic = 2 * P + B * 129 * (2 * c.num_layers * c.num_kv_heads
+                                     * c.head_dim_ * 2)
+        print(f"decode32 B={B} W={W:3d}: {dt*1e3:8.1f} ms "
+              f"({per_iter*1e3:6.2f} ms/iter, roofline "
+              f"{traffic/819e9*1e3:.2f} ms/iter, "
+              f"frac={traffic/819e9/per_iter:.3f})")
+        cache = state["cache"]
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

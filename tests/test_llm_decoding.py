@@ -503,3 +503,66 @@ def test_mid_generation_admission(tiny, params):
                      multi_step=4)
     sa = solo.generate([a_req.prompt], max_new_tokens=24)[0]
     assert results[a] == sa
+
+
+def test_packed_admission_edges(tiny, params):
+    """Packed async admission (models/decoding.py packed_prefill_admit)
+    edge cases in one wave: max_new_tokens == 1 (finished by the first
+    device-computed token), an EOS that fires on the first token, and a
+    normal request — all admitted without a host sync, all correct at
+    reconcile (VERDICT r4 item 1)."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, tiny.vocab_size, 6).tolist()
+               for _ in range(3)]
+    # Reference tokens from the classic synchronous engine.
+    ref = LLMEngine(tiny, params, page_size=4, num_pages=64,
+                    max_batch=4, multi_step=1)
+    ref_out = ref.generate(prompts, max_new_tokens=8)
+
+    eng = LLMEngine(tiny, params, page_size=4, num_pages=64,
+                    max_batch=4, multi_step=4)
+    assert eng.packed_admit
+    a = eng.add_request(prompts[0], max_new_tokens=1)
+    b = eng.add_request(prompts[1], max_new_tokens=8,
+                        eos_token=ref_out[1][0])  # EOS == first token
+    c = eng.add_request(prompts[2], max_new_tokens=8)
+    waves0 = eng.waves_dispatched
+    results = {}
+    while eng.has_work():
+        results.update(eng.step())
+    assert eng.waves_dispatched > waves0, "packed wave not used"
+    assert results[a] == ref_out[0][:1]
+    assert results[b] == ref_out[1][:1]
+    assert results[c] == ref_out[2]
+
+
+def test_packed_admission_mixed_with_sampling(tiny, params):
+    """A sampling request in the queue routes through the classic path
+    (host logits) while greedy requests keep the packed path; everyone
+    completes with the right token counts."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, tiny.vocab_size, 5).tolist()
+               for _ in range(3)]
+    # Greedy reference tokens for the two deterministic requests.
+    ref = LLMEngine(tiny, params, page_size=4, num_pages=64,
+                    max_batch=4, multi_step=1)
+    ref_out = ref.generate([prompts[0], prompts[2]], max_new_tokens=6)
+
+    eng = LLMEngine(tiny, params, page_size=4, num_pages=64,
+                    max_batch=4, multi_step=4)
+    g1 = eng.add_request(prompts[0], max_new_tokens=6)
+    s = eng.add_request(prompts[1], max_new_tokens=6, temperature=0.8)
+    g2 = eng.add_request(prompts[2], max_new_tokens=6)
+    results = {}
+    while eng.has_work():
+        results.update(eng.step())
+    assert sorted(results) == sorted([g1, s, g2])
+    assert all(len(v) == 6 for v in results.values())
+    # The wave -> classic handoff must not perturb greedy streams
+    # (host last_tokens mirror stays authoritative at reconcile).
+    assert results[g1] == ref_out[0]
+    assert results[g2] == ref_out[1]
