@@ -95,7 +95,11 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * sm_scale  # [block_q, d]
+    # Keep q in its NATIVE dtype: on TPU a bf16×bf16 matmul with f32
+    # accumulation runs the MXU at full rate, while upcasting inputs to
+    # f32 forces the multi-pass f32 path (~3-6× slower).  sm_scale is
+    # applied to the f32 scores after the matmul instead.
+    q = q_ref[0]  # [block_q, d]
     d = q.shape[-1]
 
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
@@ -117,11 +121,12 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [block_q, block_k]
+            preferred_element_type=jnp.float32) * sm_scale
+        # [block_q, block_k] f32
         if causal:
             rows = offs_ref[0] + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -132,8 +137,12 @@ def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        # p in v's dtype for the second MXU matmul (f32 accumulation
+        # preserved by preferred_element_type) — same as every
+        # production flash kernel; probabilities are in [0, 1] so bf16
+        # rounding here is benign relative to the softmax itself.
         acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
@@ -217,8 +226,8 @@ def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     from jax.experimental import pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)          # [block_q, d]
-    do = do_ref[0].astype(jnp.float32)        # [block_q, d]
+    q = q_ref[0]                              # [block_q, d] native dtype
+    do = do_ref[0]                            # [block_q, d] native dtype
     lse = lse_ref[0, 0, :]                    # [block_q]
     # (delta + (-dlse)) enters every column uniformly: fold into one term.
     corr = delta_ref[0, 0, :] - dlse_ref[0, 0, :]  # [block_q]
@@ -233,8 +242,8 @@ def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         hi = num_k_blocks
 
     def body(j, dq):
-        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
         if causal:
             rows = offs_ref[0] + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
@@ -248,7 +257,7 @@ def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32)    # [block_q, block_k]
         ds = p * (dp - corr[:, None]) * sm_scale
         return dq + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
@@ -261,8 +270,8 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     from jax.experimental import pallas as pl
 
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)          # [block_k, d]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                              # [block_k, d] native dtype
+    v = v_ref[0]
     d = k.shape[-1]
 
     num_q_blocks = seq_q // block_q
@@ -277,8 +286,8 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     def body(j, carry):
         dk, dv = carry
-        q_blk = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        q_blk = q_ref[0, pl.ds(j * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(j * block_q, block_q), :]
         lse_blk = lse_ref[0, 0, pl.ds(j * block_q, block_q)]
         corr = (delta_ref[0, 0, pl.ds(j * block_q, block_q)]
                 - dlse_ref[0, 0, pl.ds(j * block_q, block_q)])
@@ -292,14 +301,14 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         p = _bwd_recompute_p(q_blk, k, lse_blk, rows, cols, causal,
                              sm_scale)                 # [block_q, block_k]
         dv_new = dv + jax.lax.dot_general(             # p^T · do
-            p, do_blk, (((0,), (0,)), ((), ())),
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # [block_k, d]
         dp = jax.lax.dot_general(                      # do · v^T
             do_blk, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - corr[:, None]) * sm_scale
         dk_new = dk + jax.lax.dot_general(             # ds^T · q
-            ds, q_blk, (((0,), (0,)), ((), ())),
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_new, dv_new
 

@@ -25,24 +25,38 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def _time_chained(step_fn, carry0, steps=20):
-    """Time steps that CHAIN on device (step k+1 consumes step k's
-    output) and sync through ONE scalar fetch — on a tunneled dev chip
-    a full-tensor transfer costs ~200 ms and would swamp ms-scale
-    kernels."""
-    import jax.numpy as jnp
+def _time_chained(step_fn, carry0, steps=50):
+    """Time steps that CHAIN on device INSIDE one jitted fori_loop.
 
-    carry = step_fn(carry0)  # compile
-    float(jnp.sum(carry[0] if isinstance(carry, tuple) else carry))
-    best = 1e9
-    for _ in range(3):
-        carry = carry0
+    On the tunneled dev chip a single dispatch costs ~50-100 ms, so a
+    Python-level chain (one dispatch per step) swamps ms-scale kernels
+    with dispatch latency — r4 under-reported flash fwd 4x this way.
+    Running the whole chain as one device program and subtracting an
+    empty-loop control of the same trip count isolates the kernel."""
+    import jax
+    from jax import lax
+
+    @jax.jit
+    def run(c):
+        return lax.fori_loop(0, steps, lambda i, c: step_fn(c), c)
+
+    @jax.jit
+    def empty(c):
+        return lax.fori_loop(
+            0, steps,
+            lambda i, c: jax.tree.map(lambda x: x * (1 + 1e-7), c), c)
+
+    jax.block_until_ready(run(carry0))    # compile
+    jax.block_until_ready(empty(carry0))
+    tb = te = 1e9
+    for _ in range(4):
         t0 = time.perf_counter()
-        for _ in range(steps):
-            carry = step_fn(carry)
-        float(jnp.sum(carry[0] if isinstance(carry, tuple) else carry))
-        best = min(best, (time.perf_counter() - t0) / steps)
-    return best
+        jax.block_until_ready(empty(carry0))
+        te = min(te, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(carry0))
+        tb = min(tb, time.perf_counter() - t0)
+    return max((tb - te) / steps, 1e-9)
 
 
 def chip_rows():
@@ -77,23 +91,24 @@ def chip_rows():
         naive = lambda q, k, v: attention_reference(  # noqa: E731
             q, k, v, causal=True)
 
+        naive_steps = 4 if s >= 4096 else 20  # dense s8192 is ~1.5 s/step
         row = {"shape": f"b{b} s{s} h{h} d{d}"}
         t = _time_chained(fwd_step_of(flash), q)
         row["flash_fwd_ms"] = round(t * 1e3, 2)
         row["flash_fwd_flops_frac"] = round(causal_flops / t / peak, 3)
         try:
-            t = _time_chained(fwd_step_of(naive), q)
+            t = _time_chained(fwd_step_of(naive), q, steps=naive_steps)
             row["naive_fwd_ms"] = round(t * 1e3, 2)
             row["speedup_fwd"] = round(
                 row["naive_fwd_ms"] / row["flash_fwd_ms"], 2)
         except Exception as e:  # noqa: BLE001 — dense s=8192 can OOM
             row["naive_fwd_ms"] = f"OOM: {type(e).__name__}"
-        t = _time_chained(bwd_step_of(flash), q)
+        t = _time_chained(bwd_step_of(flash), q, steps=25)
         row["flash_fwd_bwd_ms"] = round(t * 1e3, 2)
         row["flash_fwd_bwd_flops_frac"] = round(
             3.5 * causal_flops / t / peak, 3)
         try:
-            t = _time_chained(bwd_step_of(naive), q)
+            t = _time_chained(bwd_step_of(naive), q, steps=naive_steps)
             row["naive_fwd_bwd_ms"] = round(t * 1e3, 2)
             row["speedup_fwd_bwd"] = round(
                 row["naive_fwd_bwd_ms"] / row["flash_fwd_bwd_ms"], 2)
@@ -165,10 +180,13 @@ def main():
         "ring_attention_cpu_mesh_step_ms": ring_rows(),
         "note": ("flash = in-tree Pallas kernel (ops/attention.py), "
                  "naive = dense XLA reference materializing [s,s] "
-                 "scores; ring rows time one jitted step of "
-                 "sequence-parallel ring attention (ops/"
-                 "ring_attention.py) on an n-device virtual CPU mesh "
-                 "at fixed GLOBAL shape b2 s2048 h4 d64"),
+                 "scores; timing = on-device fori_loop chain minus an "
+                 "empty-loop control (r4 chained at Python level and "
+                 "paid ~50-100 ms tunnel dispatch per step, "
+                 "under-reporting flash fwd ~4x); ring rows time one "
+                 "jitted step of sequence-parallel ring attention "
+                 "(ops/ring_attention.py) on an n-device virtual CPU "
+                 "mesh at fixed GLOBAL shape b2 s2048 h4 d64"),
     }
     print(json.dumps(doc))
     return 0
