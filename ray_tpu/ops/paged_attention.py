@@ -114,59 +114,71 @@ def _paged_attention_gather(q, k_pages, v_pages, block_tables,
 
 
 # ---------------------------------------------------------------------------
-# TPU decode kernel: grid (B, blocks-of-pages).  Each grid step streams
-# one compute block (ppcb fused-head pages) for one sequence into VMEM
-# with double-buffered async copies — one DMA per PAGE, each covering
-# every kv head — and folds it into flash-style running (m, l, acc)
-# scratch.  Blocks past a sequence's context length are skipped: no
-# compute AND no copy, so the cost tracks the live context, not the
-# table width.  The copy for the next active block is issued before the
-# current block's compute so the DMA engine stays ahead of the VPU/MXU.
+# TPU decode kernel: grid (B/SB, blocks-of-pages).  Each grid step
+# streams one compute block (ppcb fused-head pages) for each of SB
+# sequences into VMEM with double-buffered async copies — one DMA per
+# PAGE, each covering every kv head — and folds them into flash-style
+# running (m, l, acc) scratch.  Batching SB sequences per step is what
+# makes decode track the bandwidth roofline: with one sequence per step
+# (r4) the kernel paid ~17 us of grid-step overhead per 0.5 MB of
+# traffic (measured 4.0 ms/layer-iter at B=128 W=2 page=128 vs the
+# 1.8 ms roofline); SB sequences amortize that overhead and keep
+# SB*ppcb*2 DMAs in flight per step.  Blocks past every member
+# sequence's context are skipped: no compute AND no copy, so cost
+# tracks live context at SB granularity, not table width.
 # ---------------------------------------------------------------------------
 
 
-def _next_active(b, i, ctx_ref, blk: int, NB: int, B: int):
-    """First grid position at or after (b, i) whose block holds live
-    context.  Rows with ctx == 0 (inactive slots) are skipped whole."""
+def _next_active(b, i, bctx_ref, blk: int, NB: int, NSB: int):
+    """First grid position at or after (b, i) whose sequence-block
+    holds live context for ANY member (bctx_ref: per-block max ctx).
+    Blocks whose max ctx == 0 are skipped whole."""
 
     def cond(state):
         bb, ii = state
-        done = bb >= B
-        live = jnp.logical_and(bb < B,
-                               ii * blk < ctx_ref[jnp.minimum(bb, B - 1)])
+        done = bb >= NSB
+        live = jnp.logical_and(
+            bb < NSB,
+            ii * blk < bctx_ref[jnp.minimum(bb, NSB - 1)])
         return jnp.logical_and(~done, ~live)
 
     def step(state):
         bb, ii = state
-        # Block ii dead for row bb: the rest of bb's blocks are dead
-        # too (context is a prefix), so advance to the next row.
+        # Block ii dead for seq-block bb: later blocks are dead too
+        # (context is a prefix), so advance to the next seq-block.
         return bb + 1, jnp.zeros_like(ii)
 
     nb, ni = jax.lax.while_loop(cond, step, (b, i))
     return nb, ni
 
 
-def _gqa_decode_kernel(tables_ref, ctx_ref, q_ref, kf_ref, vf_ref, o_ref,
-                       m_ref, l_ref, acc_ref, k_buf, v_buf, buf_ref,
-                       sems, *, page: int, ppcb: int, NB: int, B: int,
-                       kvh: int, g: int, d: int, scale: float):
-    b = pl.program_id(0)
+def _gqa_decode_kernel(tables_ref, ctx_ref, bctx_ref, q_ref, kf_ref,
+                       vf_ref, o_ref, m_ref, l_ref, acc_ref, logit_ref,
+                       k_buf, v_buf, buf_ref, sems, *, page: int,
+                       ppcb: int, NB: int, B: int, SB: int, kvh: int,
+                       g: int, d: int, scale: float):
+    b = pl.program_id(0)           # sequence-block index (SB rows)
     i = pl.program_id(1)
     blk = page * ppcb
-    ctx = ctx_ref[b]
-    live = i * blk < ctx
+    NSB = B // SB
+    bctx = bctx_ref[b]             # max ctx within this seq-block
+    live = i * blk < bctx
 
     def copies(bb, ii, slot):
         """Async copies loading block (bb, ii) into buffer `slot` —
         recreated identically at start and wait time (each descriptor
         pairs one fused-head page with one buffer slice)."""
         out = []
-        for j in range(ppcb):
-            pg = tables_ref[jnp.minimum(bb, B - 1), ii * ppcb + j]
-            out.append(pltpu.make_async_copy(
-                kf_ref.at[pg], k_buf.at[slot, j], sems.at[slot, 0]))
-            out.append(pltpu.make_async_copy(
-                vf_ref.at[pg], v_buf.at[slot, j], sems.at[slot, 1]))
+        for s in range(SB):
+            row = jnp.minimum(bb * SB + s, B - 1)
+            for j in range(ppcb):
+                pg = tables_ref[row, ii * ppcb + j]
+                out.append(pltpu.make_async_copy(
+                    kf_ref.at[pg], k_buf.at[slot, s, j],
+                    sems.at[slot, 0]))
+                out.append(pltpu.make_async_copy(
+                    vf_ref.at[pg], v_buf.at[slot, s, j],
+                    sems.at[slot, 1]))
         return out
 
     # The buffer parity is a running toggle over ACTIVE steps (SMEM
@@ -174,16 +186,16 @@ def _gqa_decode_kernel(tables_ref, ctx_ref, q_ref, kf_ref, vf_ref, o_ref,
     # producing step's slot would otherwise disagree with the consuming
     # step's.
     fb, fi = _next_active(jnp.zeros_like(b), jnp.zeros_like(i),
-                          ctx_ref, blk, NB, B)
+                          bctx_ref, blk, NB, NSB)
     is_first = jnp.logical_and(b == fb, i == fi)
 
-    @pl.when(jnp.logical_and(ctx == 0, i == NB - 1))
+    @pl.when(jnp.logical_and(bctx == 0, i == NB - 1))
     def _zero_dead():
-        # No block of a ctx==0 row is live, so nothing below would
-        # write its output — without this the (1, H, D) VMEM output
-        # block flushes back holding the PREVIOUS row's attention.
-        # Dead rows return defined zeros instead.
-        o_ref[0] = jnp.zeros_like(o_ref[0])
+        # No block of an all-dead seq-block is live, so nothing below
+        # would write its output — without this the (SB, H, D) VMEM
+        # output block flushes back holding the PREVIOUS block's
+        # attention.  Dead rows return defined zeros instead.
+        o_ref[...] = jnp.zeros_like(o_ref[...])
 
     @pl.when(is_first)
     def _prime():
@@ -201,9 +213,9 @@ def _gqa_decode_kernel(tables_ref, ctx_ref, q_ref, kf_ref, vf_ref, o_ref,
         nb, ni = _next_active(
             jnp.where(i + 1 < NB, b, b + 1),
             jnp.where(i + 1 < NB, i + 1, 0),
-            ctx_ref, blk, NB, B)
+            bctx_ref, blk, NB, NSB)
 
-        @pl.when(nb < B)
+        @pl.when(nb < NSB)
         def _prefetch():
             for c in copies(nb, ni, 1 - slot):
                 c.start()
@@ -218,39 +230,73 @@ def _gqa_decode_kernel(tables_ref, ctx_ref, q_ref, kf_ref, vf_ref, o_ref,
             l_ref[...] = jnp.zeros_like(l_ref)
             acc_ref[...] = jnp.zeros_like(acc_ref)
 
-        # [ppcb, page, KVH*D] -> [T, KVH*D]: leading dims flatten free;
-        # heads are addressed by static LANE slices (h*D:(h+1)*D), not
-        # a lane-splitting reshape (which would relayout vregs).
-        k = k_buf[slot].reshape(blk, kvh * d)
-        v = v_buf[slot].reshape(blk, kvh * d)
-        q = q_ref[0].astype(jnp.float32)                      # [H, D]
-        pos = i * blk + jax.lax.broadcasted_iota(jnp.int32, (g, blk), 1)
-        mask = pos < ctx
-        for h in range(kvh):
-            k_h = k[:, h * d:(h + 1) * d].astype(jnp.float32)
-            v_h = v[:, h * d:(h + 1) * d].astype(jnp.float32)
-            q_h = q[h * g:(h + 1) * g]                        # [G, D]
-            logits = jax.lax.dot_general(
-                q_h, k_h, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale   # [G, blk]
-            logits = jnp.where(mask, logits, -jnp.inf)
-            m_prev = m_ref[h]                                 # [G]
-            m_new = jnp.maximum(m_prev, logits.max(axis=-1))
-            alpha = jnp.exp(m_prev - m_new)
-            p = jnp.exp(logits - m_new[:, None])              # [G, blk]
-            l_ref[h] = l_ref[h] * alpha + p.sum(axis=-1)
-            pv = jax.lax.dot_general(
-                p, v_h, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)           # [G, D]
-            acc_ref[h] = acc_ref[h] * alpha[:, None] + pv
-            m_ref[h] = m_new
+        # Phase 1 — logits: per-(row, head) MXU dots into ONE stacked
+        # [SB*H, blk] tile.  The dots are irreducibly per-head (GQA
+        # attention is block-diagonal over kv heads), but stacking
+        # their outputs lets phase 2 run ONE vectorized softmax-update
+        # chain over full 8-sublane tiles instead of SB*KVH tiny [G,
+        # blk] chains — the r4 kernel issued ~1k scalar-core ops per
+        # call that way and ran 2x+ off the bandwidth roofline.
+        for s in range(SB):
+            kb = k_buf[slot, s].reshape(blk, kvh * d)
+            q = q_ref[s]                                      # [H, D]
+            for h in range(kvh):
+                k_h = kb[:, h * d:(h + 1) * d]
+                q_h = q[h * g:(h + 1) * g]                    # [G, D]
+                logit_ref[s * kvh * g + h * g:
+                          s * kvh * g + (h + 1) * g, :] = \
+                    jax.lax.dot_general(
+                        q_h, k_h, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
 
-        # Last live block for this sequence: finalize into the output.
-        @pl.when((i + 1) * blk >= ctx)
+        # Phase 2 — one flash update over the whole [SB*H, blk] tile.
+        # ctx per stacked row: ctx_ref[b*SB + s] broadcast over H,
+        # built with iota+select (dynamic_update_slice doesn't lower
+        # in Mosaic).
+        seq_of_row = jax.lax.broadcasted_iota(
+            jnp.int32, (SB * kvh * g, 1), 0) // (kvh * g)
+        ctx_col = jnp.zeros((SB * kvh * g, 1), jnp.int32)
+        for s in range(SB):
+            ctx_col = jnp.where(seq_of_row == s,
+                                ctx_ref[b * SB + s], ctx_col)
+        pos = i * blk + jax.lax.broadcasted_iota(
+            jnp.int32, (SB * kvh * g, blk), 1)
+        logits = logit_ref[...] * scale
+        logits = jnp.where(pos < ctx_col, logits, -jnp.inf)
+        m_prev = m_ref[...]                       # [SB*H, 1]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(logits, axis=-1, keepdims=True))
+        # Rows past their context this block (or dead): m stays -inf;
+        # exp(-inf - -inf) = exp(nan) guard via where.
+        alpha = jnp.where(jnp.isneginf(m_prev) & jnp.isneginf(m_new),
+                          0.0, jnp.exp(m_prev - m_new))
+        p = jnp.exp(logits - m_new)               # [SB*H, blk]
+        p = jnp.where(jnp.isneginf(m_new), 0.0, p)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1,
+                                                  keepdims=True)
+        m_ref[...] = m_new
+
+        # Phase 3 — p·V per (row, head) dots off the stacked p tile.
+        pb = p.astype(v_buf.dtype)
+        for s in range(SB):
+            vb = v_buf[slot, s].reshape(blk, kvh * d)
+            for h in range(kvh):
+                v_h = vb[:, h * d:(h + 1) * d]
+                r0 = s * kvh * g + h * g
+                pv = jax.lax.dot_general(
+                    pb[r0:r0 + g, :], v_h, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)       # [G, D]
+                acc_ref[r0:r0 + g, :] = \
+                    acc_ref[r0:r0 + g, :] * alpha[r0:r0 + g] + pv
+
+        # Finalize every row whose context ends in this block; zero
+        # dead rows (ctx == 0) inside a live seq-block.
+        @pl.when((i + 1) * blk >= bctx)
         def _finalize():
-            l = jnp.maximum(l_ref[...], 1e-30)[..., None]
-            o_ref[0] = (acc_ref[...] / l).reshape(kvh * g, d) \
-                .astype(o_ref.dtype)
+            l = jnp.maximum(l_ref[...], 1e-30)
+            live_rows = ctx_col > 0
+            out = jnp.where(live_rows, acc_ref[...] / l, 0.0)
+            o_ref[...] = out.reshape(SB, kvh * g, d).astype(o_ref.dtype)
 
 
 def _paged_attention_pallas(q, k_pages, v_pages, block_tables,
@@ -269,36 +315,54 @@ def _paged_attention_pallas(q, k_pages, v_pages, block_tables,
     while W % ppcb:
         ppcb -= 1
     NB = W // ppcb
+    # Sequences per grid step: as many as keep the double-buffered
+    # K/V blocks within ~8 MB of VMEM (half the core's budget, leaving
+    # room for q/out/acc and the next block's buffers).
+    blk_bytes = ppcb * page * KD * k_pages.dtype.itemsize * 4  # k+v, dbl
+    SB = max(1, min(B, int(8e6 // max(blk_bytes, 1))))
+    SB = 1 << (SB.bit_length() - 1)  # pow-2 for clean division
+    if os.environ.get("RAY_TPU_PA_SB"):  # perf experiments only
+        SB = max(1, min(B, int(os.environ["RAY_TPU_PA_SB"])))
+    while B % SB:
+        SB //= 2
+
+    # Per-seq-block max context for the skip logic.
+    bctx = jnp.max(context_lens.astype(jnp.int32).reshape(B // SB, SB),
+                   axis=1)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, NB),
+        num_scalar_prefetch=3,
+        grid=(B // SB, NB),
         in_specs=[
-            pl.BlockSpec((1, H, D), lambda b, i, tables, ctx: (b, 0, 0)),
+            pl.BlockSpec((SB, H, D),
+                         lambda b, i, tables, ctx, bctx: (b, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),  # k_pages (manual DMA)
             pl.BlockSpec(memory_space=pl.ANY),  # v_pages
         ],
         out_specs=pl.BlockSpec(
-            (1, H, D), lambda b, i, tables, ctx: (b, 0, 0)),
+            (SB, H, D), lambda b, i, tables, ctx, bctx: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((KVH, G), jnp.float32),
-            pltpu.VMEM((KVH, G), jnp.float32),
-            pltpu.VMEM((KVH, G, D), jnp.float32),
-            pltpu.VMEM((2, ppcb, page, KD), k_pages.dtype),
-            pltpu.VMEM((2, ppcb, page, KD), v_pages.dtype),
+            pltpu.VMEM((SB * H, 1), jnp.float32),        # m
+            pltpu.VMEM((SB * H, 1), jnp.float32),        # l
+            pltpu.VMEM((SB * H, D), jnp.float32),        # acc
+            pltpu.VMEM((SB * H, page * ppcb), jnp.float32),  # logits
+            pltpu.VMEM((2, SB, ppcb, page, KD), k_pages.dtype),
+            pltpu.VMEM((2, SB, ppcb, page, KD), v_pages.dtype),
             pltpu.SMEM((1,), jnp.int32),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
     kernel = pl.pallas_call(
         functools.partial(_gqa_decode_kernel, page=page, ppcb=ppcb,
-                          NB=NB, B=B, kvh=KVH, g=G, d=D, scale=scale),
+                          NB=NB, B=B, SB=SB, kvh=KVH, g=G, d=D,
+                          scale=scale),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=interpret,
     )
     return kernel(block_tables.astype(jnp.int32),
-                  context_lens.astype(jnp.int32), q, k_pages, v_pages)
+                  context_lens.astype(jnp.int32), bctx, q, k_pages,
+                  v_pages)
 
 
 def write_page_tokens(k_pages, v_pages, k_new, v_new, block_tables,

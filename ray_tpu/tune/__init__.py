@@ -17,6 +17,7 @@ from ray_tpu.tune.pb2 import PB2
 from ray_tpu.tune.external_searchers import (
     AxSearch,
     HEBOSearch,
+    HyperOptSearch,
     NevergradSearch,
     ZOOptSearch,
 )
@@ -72,6 +73,7 @@ __all__ = [
     "BasicVariantGenerator", "TPESearcher", "BOHBSearcher", "ConcurrencyLimiter",
     "Searcher", "OptunaSearch", "as_search_algorithm",
     "AxSearch", "NevergradSearch", "HEBOSearch", "ZOOptSearch",
+    "HyperOptSearch",
     "TrialScheduler",
     "FIFOScheduler",
     "AsyncHyperBandScheduler",

@@ -1,7 +1,8 @@
-"""External-library searcher adapters: Ax, Nevergrad, HEBO, ZOOpt.
+"""External-library searcher adapters: Ax, Nevergrad, HEBO, ZOOpt,
+HyperOpt.
 
 Counterpart of the reference's python/ray/tune/search/{ax,nevergrad,
-hebo,zoopt}/ adapters.  Each maps search.py domains onto the library's
+hebo,zoopt,hyperopt}/ adapters.  Each maps search.py domains onto the library's
 own ask/tell surface and implements the in-tree `Searcher` protocol
 (searchers.py), so `as_search_algorithm` plugs any of them into the
 Tuner.  None of the libraries ship in the air-gapped image: every
@@ -438,3 +439,131 @@ class ZOOptSearch(Searcher):
         with self._tell_cv:
             self._tells[idx] = value
             self._tell_cv.notify_all()
+
+
+class HyperOptSearch(Searcher):
+    """Adapter over HyperOpt's Trials store + suggest algorithm
+    (reference tune/search/hyperopt/hyperopt_search.py).
+
+    HyperOpt has no ask/tell optimizer object — the `Trials` store IS
+    the protocol: new trial docs come from
+    `algo(new_ids, domain, trials, seed)` (tpe.suggest by default), get
+    inserted into the store, and results are reported by mutating the
+    doc's state/result in place followed by `trials.refresh()`.
+    Sampled values are read from the doc's misc vals
+    (`base.spec_from_misc`); `hp.choice` dims store the INDEX there, so
+    the adapter maps indices back through the in-tree Choice values
+    itself instead of evaluating the domain expression the way the
+    reference does with memo tricks.
+    """
+
+    def __init__(self, n_initial_points: Optional[int] = None,
+                 random_state_seed: int = 0, _module=None):
+        if _module is None:
+            try:
+                import hyperopt  # noqa: PLC0415
+
+                _module = hyperopt
+            except ImportError as e:
+                raise _missing(
+                    "hyperopt",
+                    "TPESearcher (native TPE — the same algorithm "
+                    "family — ray_tpu.tune.TPESearcher)") from e
+        self._hpo = _module
+        self._algo = _module.tpe.suggest
+        if n_initial_points is not None:
+            import functools
+
+            self._algo = functools.partial(
+                _module.tpe.suggest, n_startup_jobs=n_initial_points)
+        import numpy as _np
+
+        self._rng = _np.random.default_rng(random_state_seed)
+        self._store = None
+        self._domain = None
+        self._space = {}
+        self._leaves: Dict[str, Any] = {}
+        self._live: Dict[str, Any] = {}
+        self._metric = None
+        self._mode = "max"
+
+    def set_search_properties(self, metric, mode, space):
+        self._metric, self._mode, self._space = metric, mode, space or {}
+        hp = self._hpo.hp
+        import math as _math
+
+        dims = {}
+        for path, leaf in _dims(self._space):
+            name = ".".join(path)
+            self._leaves[name] = leaf
+            if isinstance(leaf, (Choice, GridSearch)):
+                # Values stay adapter-side: misc vals carry the index.
+                dims[name] = hp.choice(name, list(range(
+                    len(list(leaf.values)))))
+            elif isinstance(leaf, RandN):
+                dims[name] = hp.normal(name, leaf.mean, leaf.sd)
+            elif isinstance(leaf, QUniform):
+                dims[name] = hp.quniform(name, leaf.low, leaf.high,
+                                         leaf.q)
+            else:
+                lo, hi, is_int, log = _bounds(leaf)
+                if is_int and log:
+                    dims[name] = hp.qloguniform(
+                        name, _math.log(max(lo, 1e-12)),
+                        _math.log(max(hi, 1e-12)), 1)
+                elif is_int:
+                    dims[name] = hp.quniform(name, lo, hi, 1)
+                elif log:
+                    # hyperopt's loguniform takes LOG-space bounds.
+                    dims[name] = hp.loguniform(
+                        name, _math.log(lo), _math.log(hi))
+                else:
+                    dims[name] = hp.uniform(name, lo, hi)
+        self._store = self._hpo.Trials()
+        self._domain = self._hpo.Domain(lambda spc: 0, dims)
+        return True
+
+    def suggest(self, trial_id):
+        trials = self._store
+        new_ids = trials.new_trial_ids(1)
+        trials.refresh()
+        docs = self._algo(new_ids, self._domain, trials,
+                          int(self._rng.integers(2 ** 31 - 1)))
+        trials.insert_trial_docs(docs)
+        trials.refresh()
+        doc = docs[0]
+        self._live[trial_id] = doc
+        vals = self._hpo.base.spec_from_misc(doc["misc"])
+        sampled = {}
+        for name, leaf in self._leaves.items():
+            if name not in vals:
+                continue
+            v = vals[name]
+            if isinstance(leaf, (Choice, GridSearch)):
+                sampled[name] = list(leaf.values)[int(v)]
+            else:
+                sampled[name] = _postprocess(leaf, v)
+        return _assemble(self._space, sampled)
+
+    def on_trial_complete(self, trial_id, result=None, error=False):
+        live = self._live.pop(trial_id, None)
+        if live is None:
+            return
+        # Mutate the doc IN THE STORE, not the pre-insert original:
+        # the real library's insert_trial_docs stores a SONify'd deep
+        # copy, so updates to the original would never reach TPE (the
+        # reference adapter looks its doc up by tid the same way).
+        doc = next((t for t in self._store.trials
+                    if t["tid"] == live["tid"]), live)
+        base = self._hpo.base
+        if error or not result or self._metric not in result:
+            doc["state"] = base.JOB_STATE_ERROR
+            doc["misc"]["error"] = ("ray_tpu.tune", "trial failed")
+        else:
+            v = float(result[self._metric])
+            # hyperopt minimizes loss.
+            doc["state"] = base.JOB_STATE_DONE
+            doc["result"] = {
+                "loss": -v if self._mode == "max" else v,
+                "status": "ok"}
+        self._store.refresh()

@@ -1,0 +1,147 @@
+"""Ablation profiling: attribute decode/prefill step time to cache
+writes, paged attention, and matmul body by stubbing pieces out.
+
+Not part of the test suite — a diagnosis tool for the serving bench.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import transformer as tfm
+import ray_tpu.models.decoding as dec
+import ray_tpu.ops.paged_attention as pa
+
+
+def timeit(fn, n=4):
+    jax.block_until_ready(fn())
+    times = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def main():
+    config = tfm.TransformerConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_layers=22, num_heads=16, num_kv_heads=4,
+        max_seq_len=2048, remat=False)
+    c = config
+    params = tfm.init_params(c, jax.random.key(0))
+    params = jax.tree.map(
+        lambda x: x.astype(c.dtype)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+        else x, params)
+    page_size, num_pages = 128, 320
+    rng = np.random.default_rng(0)
+    max_pages_per_seq = c.max_seq_len // page_size
+
+    real_wtr = pa.write_token_rows
+    real_pat = pa.paged_attention
+    real_wpt = pa.write_page_tokens
+
+    def fake_wtr(k_pages, v_pages, k_new, v_new, tables, positions):
+        return k_pages, v_pages
+
+    def fake_pat(q, k_pages, v_pages, tables, ctx, sm_scale=None):
+        return q  # [B, H, D] passthrough
+
+    def fake_wpt(k_pages, v_pages, k_new, v_new, tables, positions):
+        return k_pages, v_pages
+
+    # ---- decode32 ablations -------------------------------------------
+    B, W = 128, 2
+    toks = jnp.asarray(rng.integers(1, c.vocab_size, B), dtype=jnp.int32)
+    pos = jnp.full((B,), 128, dtype=jnp.int32)
+    ctx = jnp.full((B,), 129, dtype=jnp.int32)
+    lim = jnp.full((B,), 100000, dtype=jnp.int32)
+    eos = jnp.full((B,), -1, dtype=jnp.int32)
+    tables = np.zeros((B, W), dtype=np.int32)
+    for r in range(B):
+        tables[r, 0] = (2 * r) % (num_pages - 2)
+        tables[r, 1] = (2 * r + 1) % (num_pages - 2)
+    tables = jnp.asarray(tables)
+
+    variants = [
+        ("full", {}),
+        ("no_write", {"write_token_rows": fake_wtr}),
+        ("no_attn", {"paged_attention": fake_pat}),
+        ("no_both", {"write_token_rows": fake_wtr,
+                     "paged_attention": fake_pat}),
+    ]
+    for name, patches in variants:
+        for attr, fn in patches.items():
+            setattr(pa, attr, fn)
+        setattr(dec, "write_token_rows", patches.get(
+            "write_token_rows", real_wtr))
+        setattr(dec, "paged_attention", patches.get(
+            "paged_attention", real_pat))
+        cache = dec.init_kv_pages(c, num_pages, page_size)
+        state = {"cache": cache, "toks": toks, "pos": pos, "ctx": ctx}
+        fn_jit = jax.jit(
+            lambda tk, ca, po, cx: dec.decode_multi_step.__wrapped__(
+                params, tk, ca, tables, po, cx, lim, eos, c, 32),
+            donate_argnums=(1,))
+
+        def run():
+            out, t2, p2, c2, state["cache"] = fn_jit(
+                state["toks"], state["cache"], state["pos"], state["ctx"])
+            state["cache"] = jax.tree.map(lambda x: x, state["cache"])
+            return out
+
+        # fresh cache each call since donated
+        def run2():
+            cache2 = dec.init_kv_pages(c, num_pages, page_size)
+            out, *_ = fn_jit(toks, cache2, pos, ctx)
+            return out
+
+        dt = timeit(run2, n=3)
+        print(f"decode32 {name:9s}: {dt*1e3:8.1f} ms "
+              f"({dt/32*1e3:6.2f} ms/iter)", flush=True)
+        for attr in patches:
+            setattr(pa, attr, {"write_token_rows": real_wtr,
+                               "paged_attention": real_pat}[attr])
+        setattr(dec, "write_token_rows", real_wtr)
+        setattr(dec, "paged_attention", real_pat)
+
+    # ---- prefill ablations -------------------------------------------
+    B, S = 128, 128
+    tokens = jnp.asarray(
+        rng.integers(1, c.vocab_size, (B, S)), dtype=jnp.int32)
+    positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None], (B, 1))
+    ptables = np.zeros((B, max_pages_per_seq), dtype=np.int32)
+    for r in range(B):
+        ptables[r, 0] = (2 * r) % (num_pages - 2)
+        ptables[r, 1] = (2 * r + 1) % (num_pages - 2)
+    ptables = jnp.asarray(ptables)
+
+    P = tfm.num_params(c)
+    for name, patch in (("full", None), ("no_write", fake_wpt)):
+        setattr(dec, "write_page_tokens", patch or real_wpt)
+        fn_jit = jax.jit(
+            lambda tk, po, ca, tb: dec.prefill.__wrapped__(
+                params, tk, po, ca, tb, c), donate_argnums=(2,))
+
+        def run3():
+            cache2 = dec.init_kv_pages(c, num_pages, page_size)
+            logits, _ = fn_jit(tokens, positions, cache2, ptables)
+            return logits
+
+        dt = timeit(run3, n=3)
+        flops = 2 * P * B * S
+        print(f"prefill  {name:9s}: {dt*1e3:8.1f} ms "
+              f"mfu={flops/dt/197e12:.3f}", flush=True)
+    setattr(dec, "write_page_tokens", real_wpt)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -465,6 +465,37 @@ def test_paged_attention_pallas_kernel_matches_reference(monkeypatch):
     assert float(np.abs(np.asarray(out)[2]).max()) == 0.0
 
 
+def test_paged_attention_pallas_kernel_multi_seq_block(monkeypatch):
+    """SB > 1 path: multiple sequences share one grid step (stacked
+    [SB*H, blk] softmax, bctx skip, dead-row-in-live-block zeroing).
+    B=3 rounds SB down to 1, so this pins the batched path explicitly
+    via the RAY_TPU_PA_SB override with an even B."""
+    import numpy as np
+
+    from ray_tpu.ops.paged_attention import (
+        paged_attention,
+        paged_attention_reference,
+    )
+
+    monkeypatch.setenv("RAY_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("RAY_TPU_PA_SB", "2")
+    rng = np.random.default_rng(1)
+    B, H, KVH, D, P, page, W = 4, 8, 4, 128, 32, 8, 4
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((P, page, KVH * D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((P, page, KVH * D)), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(P)[:B * W].reshape(B, W).astype(np.int32))
+    # ragged: a dead row INSIDE a live seq-block (row 2 with SB=2
+    # pairs it with live row 3), plus uneven live lengths.
+    ctx = jnp.asarray([1, 29, 0, 13], jnp.int32)
+    out = paged_attention(q, kp, vp, tables, ctx)
+    ref = paged_attention_reference(q, kp, vp, tables, ctx)
+    np.testing.assert_allclose(np.asarray(out, np.float64), ref,
+                               atol=2e-3)
+    assert float(np.abs(np.asarray(out)[2]).max()) == 0.0
+
+
 def test_mid_generation_admission(tiny, params):
     """Continuous batching with chunked multi-step dispatch: a request
     that arrives while another is mid-generation is admitted at the

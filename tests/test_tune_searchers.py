@@ -637,3 +637,104 @@ def test_external_adapters_missing_raise_with_guidance():
             continue  # library present: the adapter activates instead
         with pytest.raises(ImportError, match=hint):
             cls()
+
+
+def test_hyperopt_adapter_with_stub():
+    """Protocol-faithful hyperopt stub: Trials doc store,
+    algo(new_ids, domain, trials, seed) -> trial docs, completion by
+    in-place doc mutation + refresh (the real library's surface)."""
+    import math
+    import random
+    import types
+
+    from ray_tpu.tune import HyperOptSearch
+
+    rng = random.Random(0)
+
+    class _Trials:
+        def __init__(self):
+            self.trials = []
+            self._next = 0
+            self.refreshed = 0
+
+        def new_trial_ids(self, n):
+            out = list(range(self._next, self._next + n))
+            self._next += n
+            return out
+
+        def refresh(self):
+            self.refreshed += 1
+
+        def insert_trial_docs(self, docs):
+            # Real hyperopt stores SONify'd DEEP COPIES — mutating the
+            # caller's doc after insert must not reach the store.
+            import copy
+
+            self.trials.extend(copy.deepcopy(docs))
+
+    class _Domain:
+        def __init__(self, fn, expr):
+            self.fn, self.expr = fn, expr
+
+    def _suggest(new_ids, domain, trials, seed):
+        docs = []
+        for tid in new_ids:
+            vals = {}
+            for name, dim in domain.expr.items():
+                kind, args = dim
+                if kind == "choice":
+                    vals[name] = rng.randrange(len(args[0]))
+                elif kind == "loguniform":
+                    lo, hi = args
+                    vals[name] = math.exp(rng.uniform(lo, hi))
+                elif kind == "quniform":
+                    lo, hi, q = args
+                    vals[name] = round(rng.uniform(lo, hi) / q) * q
+                elif kind == "qloguniform":
+                    lo, hi, q = args
+                    vals[name] = round(
+                        math.exp(rng.uniform(lo, hi)) / q) * q
+                elif kind == "normal":
+                    mu, sd = args
+                    vals[name] = rng.gauss(mu, sd)
+                else:
+                    lo, hi = args
+                    vals[name] = rng.uniform(lo, hi)
+            docs.append({"tid": tid, "state": 0,
+                         "misc": {"vals": {k: [v]
+                                           for k, v in vals.items()}},
+                         "result": None})
+        return docs
+
+    hp = types.SimpleNamespace(
+        choice=lambda name, opts: ("choice", (opts,)),
+        uniform=lambda name, lo, hi: ("uniform", (lo, hi)),
+        loguniform=lambda name, lo, hi: ("loguniform", (lo, hi)),
+        quniform=lambda name, lo, hi, q: ("quniform", (lo, hi, q)),
+        qloguniform=lambda name, lo, hi, q: ("qloguniform",
+                                             (lo, hi, q)),
+        normal=lambda name, mu, sd: ("normal", (mu, sd)),
+    )
+    base = types.SimpleNamespace(
+        JOB_STATE_DONE=2, JOB_STATE_ERROR=3,
+        spec_from_misc=lambda misc: {k: v[0]
+                                     for k, v in misc["vals"].items()},
+    )
+    stub = types.SimpleNamespace(
+        hp=hp, base=base, Trials=_Trials, Domain=_Domain,
+        tpe=types.SimpleNamespace(suggest=_suggest))
+
+    s = HyperOptSearch(_module=stub)
+    s.set_search_properties("score", "max", _ext_space())
+    cfg = s.suggest("t1")
+    _check_cfg(cfg)
+    s.on_trial_complete("t1", {"score": 3.0})
+    doc = s._store.trials[0]
+    assert doc["state"] == 2
+    assert doc["result"] == {"loss": -3.0, "status": "ok"}
+
+    cfg2 = s.suggest("t2")
+    _check_cfg(cfg2)
+    s.on_trial_complete("t2", error=True)
+    assert s._store.trials[1]["state"] == 3
+    assert not s._live
