@@ -457,6 +457,8 @@ def packed_prefill_admit(params, tokens, positions, row_tables,
     (st_positions = next write position, -1 when the request is already
     finished by its first token — max_new == 1 or instant EOS)."""
     c = config
+    assert c.scan_layers, \
+        "decoding expects stacked [L, ...] block params (scan_layers=True)"
     R, S = tokens.shape
     nseg = (R * S) // seg_len
     x = params["tok_embed"].astype(c.dtype)[tokens]

@@ -569,6 +569,44 @@ def test_packed_admission_edges(tiny, params):
     assert results[c] == ref_out[2]
 
 
+def test_packed_admission_same_wave_shared_prefix(tiny, params):
+    """Two identical prompts (>= one full page, so their prefix pages
+    are cacheable) submitted together: the second defers one step on
+    the wave's pending_keys guard, then admits via the classic
+    cache-hit path against pages the FIRST registered while its wave
+    was still in flight on device.  Greedy outputs must match the
+    classic engine's token-for-token, and the cache must record reuse
+    (code-review r5: the ordering-sensitive wave-register -> cache-hit
+    handoff had no coverage)."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    rng = np.random.default_rng(17)
+    # 9 tokens at page_size=4: two full prefix pages + one partial.
+    prompt = rng.integers(0, tiny.vocab_size, 9).tolist()
+    other = rng.integers(0, tiny.vocab_size, 9).tolist()
+    ref = LLMEngine(tiny, params, page_size=4, num_pages=64,
+                    max_batch=4, multi_step=1,
+                    enable_prefix_caching=False)
+    ref_out = ref.generate([prompt, prompt, other], max_new_tokens=6)
+
+    eng = LLMEngine(tiny, params, page_size=4, num_pages=64,
+                    max_batch=4, multi_step=4)
+    assert eng.packed_admit
+    a = eng.add_request(prompt, max_new_tokens=6)
+    b = eng.add_request(prompt, max_new_tokens=6)   # same-wave twin
+    c = eng.add_request(other, max_new_tokens=6)
+    results = {}
+    while eng.has_work():
+        results.update(eng.step())
+    assert results[a] == ref_out[0]
+    assert results[b] == ref_out[1]
+    assert results[c] == ref_out[2]
+    # The twin must have REUSED the first request's registered prefix
+    # pages, not recomputed them.
+    assert eng.prefix_cache.hits >= 1
+    assert eng.prefix_cache.tokens_saved >= 8
+
+
 def test_packed_admission_mixed_with_sampling(tiny, params):
     """A sampling request in the queue routes through the classic path
     (host logits) while greedy requests keep the packed path; everyone
