@@ -44,6 +44,9 @@ class Autoscaler:
         self.provider = provider
         self.config = config
         self._idle_since: Dict[str, float] = {}
+        # Nodes we asked the head to drain (drain-before-terminate,
+        # reference autoscaler DrainNode): node_id -> node_type.
+        self._draining: Dict[str, Optional[str]] = {}
         self._stopped = threading.Event()
         self.last_infeasible: List[Dict[str, float]] = []
 
@@ -58,12 +61,20 @@ class Autoscaler:
             t = self.provider.node_type_of(nid)
             if t:
                 counts[t] = counts.get(t, 0) + 1
+        # Draining nodes are leaving: they hold no capacity for floor /
+        # max-worker accounting (their instances are still in the
+        # provider list until the drain completes).
+        for nid, t in self._draining.items():
+            if t and nid in managed:
+                counts[t] = counts.get(t, 0) - 1
 
         demands = list(load["demands"])
         for pg in load["pg_demands"]:
             demands.extend(pg["bundles"])
 
-        spare = [dict(n["available"]) for n in nodes]
+        # Draining nodes take no new work: their capacity is not spare.
+        spare = [dict(n["available"]) for n in nodes
+                 if not n.get("draining")]
         max_per_type = {t: c.max_workers
                         for t, c in self.config.node_types.items()}
         node_resources = {t: c.resources
@@ -101,9 +112,21 @@ class Autoscaler:
 
     def _scale_down(self, nodes, managed, counts):
         now = time.monotonic()
+        alive_ids = {n["node_id"] for n in nodes}
+        # Phase 2 of drain-before-terminate: a node we drained that has
+        # left the cluster (drain complete — work finished, sole-copy
+        # objects migrated, bundles rescheduled) releases its instance.
+        for nid in list(self._draining):
+            status = self._call({"op": "drain_status", "node_id": nid})
+            if (status or {}).get("state") == "gone" \
+                    or nid not in alive_ids:
+                self._draining.pop(nid)
+                self.provider.terminate_node(nid)
+                # counts already excludes draining nodes (step()).
         for n in nodes:
             nid = n["node_id"]
-            if n["is_head"] or nid not in managed:
+            if n["is_head"] or nid not in managed \
+                    or nid in self._draining:
                 continue
             idle = n["available"] == n["total"]
             if not idle:
@@ -115,9 +138,23 @@ class Autoscaler:
                 t, NodeTypeConfig({})).min_workers if t else 0
             if now - first >= self.config.idle_timeout_s and \
                     counts.get(t, 0) > min_workers:
-                self.provider.terminate_node(nid)
+                # Drain first (reference DrainNode): the head migrates
+                # state off the node and terminates it; the provider
+                # instance is released once the drain completes.
+                reply = self._call({"op": "drain_node", "node_id": nid,
+                                    "reason": "idle timeout"})
                 self._idle_since.pop(nid, None)
-                counts[t] = counts.get(t, 0) - 1
+                if (reply or {}).get("accepted"):
+                    self._draining[nid] = t
+                    # The floor check for LATER nodes in this same pass
+                    # must see this node as already leaving.
+                    if t:
+                        counts[t] = counts.get(t, 0) - 1
+                else:
+                    # Logical/unknown node the head refuses to drain:
+                    # fall back to direct termination (old behavior).
+                    self.provider.terminate_node(nid)
+                    counts[t] = counts.get(t, 0) - 1
 
     # -- monitor loop ----------------------------------------------------
     def run_forever(self):

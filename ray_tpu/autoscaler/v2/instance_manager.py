@@ -27,6 +27,7 @@ class InstanceState(str, enum.Enum):
     REQUESTED = "REQUESTED"            # provider request in flight
     ALLOCATED = "ALLOCATED"            # cloud granted; node not joined
     RUNNING = "RUNNING"                # node joined the cluster
+    DRAINING = "DRAINING"              # head asked to drain (DrainNode)
     TERMINATING = "TERMINATING"        # terminate requested
     TERMINATED = "TERMINATED"          # gone (terminal)
     ALLOCATION_FAILED = "ALLOCATION_FAILED"  # terminal for this record
@@ -42,8 +43,11 @@ _LEGAL_EDGES = {
                               InstanceState.TERMINATING,
                               InstanceState.TERMINATED,
                               InstanceState.ALLOCATION_FAILED},
-    InstanceState.RUNNING: {InstanceState.TERMINATING,
+    InstanceState.RUNNING: {InstanceState.DRAINING,
+                            InstanceState.TERMINATING,
                             InstanceState.TERMINATED},
+    InstanceState.DRAINING: {InstanceState.TERMINATING,
+                             InstanceState.TERMINATED},
     InstanceState.TERMINATING: {InstanceState.TERMINATED},
     InstanceState.TERMINATED: set(),
     InstanceState.ALLOCATION_FAILED: set(),
@@ -101,11 +105,15 @@ class InstanceManager:
             return dataclasses.replace(inst) if inst else None
 
     def count_active(self, node_type: Optional[str] = None) -> int:
-        """Instances that hold (or will hold) capacity."""
+        """Instances that hold (or will hold) capacity.  DRAINING
+        instances are leaving the cluster and hold none — counting them
+        would let every idle node past the min_workers floor drain at
+        once, and would suppress replacement launches."""
         with self._lock:
             return sum(
                 1 for i in self._instances.values()
                 if i.state not in TERMINAL_STATES
+                and i.state != InstanceState.DRAINING
                 and (node_type is None or i.node_type == node_type))
 
     # -- mutations ------------------------------------------------------

@@ -168,7 +168,10 @@ class Reconciler:
                 pending_spare.append(dict(
                     self.config.node_types[inst.node_type].resources))
 
-        spare = [dict(n["available"]) for n in alive_nodes.values()]
+        # Draining nodes take no new work (head rejects leases on
+        # them): their availability is not spare capacity.
+        spare = [dict(n["available"]) for n in alive_nodes.values()
+                 if not n.get("draining")]
         to_add, self.last_infeasible = fit_demands(
             demands, spare + pending_spare,
             {t: c.resources for t, c in self.config.node_types.items()},
@@ -200,6 +203,19 @@ class Reconciler:
     # -- step 4: scale down --------------------------------------------
     def _scale_down(self, alive_nodes: Dict[str, dict]):
         now = time.time()
+        # Drain-before-terminate, phase 2: instances in DRAINING whose
+        # node has left the cluster (drain complete) release the cloud
+        # resource.
+        for inst in self.im.list(InstanceState.DRAINING):
+            status = self._call({"op": "drain_status",
+                                 "node_id": inst.node_id})
+            if (status or {}).get("state") == "gone" \
+                    or inst.node_id not in alive_nodes:
+                self.im.transition(inst.instance_id,
+                                   InstanceState.TERMINATING)
+                self.provider.terminate(inst.cloud_id)
+                self.im.transition(inst.instance_id,
+                                   InstanceState.TERMINATED)
         for inst in self.im.list(InstanceState.RUNNING):
             node = alive_nodes.get(inst.node_id)
             if node is None:
@@ -216,11 +232,20 @@ class Reconciler:
             first = self._idle_since.setdefault(inst.instance_id, now)
             if now - first >= self.config.idle_timeout_s:
                 self._idle_since.pop(inst.instance_id, None)
-                self.im.transition(inst.instance_id,
-                                   InstanceState.TERMINATING)
-                self.provider.terminate(inst.cloud_id)
-                self.im.transition(inst.instance_id,
-                                   InstanceState.TERMINATED)
+                # Phase 1 (reference autoscaler DrainNode): ask the head
+                # to drain; termination happens once the drain finishes.
+                reply = self._call({"op": "drain_node",
+                                    "node_id": inst.node_id,
+                                    "reason": "idle timeout"})
+                if (reply or {}).get("accepted"):
+                    self.im.transition(inst.instance_id,
+                                       InstanceState.DRAINING)
+                else:
+                    self.im.transition(inst.instance_id,
+                                       InstanceState.TERMINATING)
+                    self.provider.terminate(inst.cloud_id)
+                    self.im.transition(inst.instance_id,
+                                       InstanceState.TERMINATED)
 
 
 class AutoscalerV2:

@@ -92,6 +92,13 @@ class NodeState:
     available: ResourceSet
     alive: bool = True
     is_head: bool = False
+    # Graceful drain (reference DrainRaylet, node_manager.proto:401 /
+    # autoscaler DrainNode, autoscaler.proto:334): a draining node is
+    # still alive but no longer schedulable; running work finishes,
+    # sole-copy objects migrate to a survivor, idle PG bundles
+    # reschedule, then the node terminates WITHOUT lineage re-execution.
+    draining: bool = False
+    drain_reason: str = ""
     labels: Dict[str, str] = field(default_factory=dict)
     # Real (remote-host) nodes: set by register_node.  Logical nodes
     # (fake-cluster partitions) leave these empty and share the head's
@@ -107,6 +114,12 @@ class NodeState:
     @property
     def is_remote(self) -> bool:
         return self.conn is not None or bool(self.store_key)
+
+    @property
+    def schedulable(self) -> bool:
+        """Scheduling eligibility: alive AND not draining (a draining
+        node stops accepting leases/placements immediately)."""
+        return self.alive and not self.draining
 
 
 @dataclass
@@ -315,6 +328,10 @@ class ControlServer:
         # (reference: GCS restart from Redis, redis_store_client.h:33).
         self._restored_actors: Set[str] = set()
         self._restore_from_journal()
+
+        # Drain bookkeeping: node_id -> object hexes whose migration to
+        # a survivor arena is in flight (cleared by objects_migrated).
+        self._drain_migrating: Dict[str, Set[str]] = {}
 
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -1798,7 +1815,7 @@ class ControlServer:
                 # deny the remainder fast — the owner pipelines onto
                 # what it has and retries after a backoff.
                 feasible = [n for n in self.nodes.values()
-                            if n.alive and need.is_subset_of(
+                            if n.schedulable and need.is_subset_of(
                                 virt(n.node_id))]
                 if not feasible:
                     if int(msg.get("have", 0)) > 0:
@@ -1952,7 +1969,7 @@ class ControlServer:
                 still.append(pl)
                 continue
             feasible = [n for n in self.nodes.values()
-                        if n.alive and need.is_subset_of(n.available)
+                        if n.schedulable and need.is_subset_of(n.available)
                         and node_workers.get(n.node_id, 0)
                         < self.config.max_workers_per_node]
             if feasible:
@@ -2291,6 +2308,164 @@ class ControlServer:
         self._wake.set()
         return node_id
 
+    # -- graceful node drain (reference DrainRaylet,
+    # src/ray/protobuf/node_manager.proto:401, and autoscaler DrainNode,
+    # autoscaler.proto:334) ---------------------------------------------
+    def _op_drain_node(self, conn, msg):
+        """Begin draining a node: it stops accepting leases/placements
+        NOW; the drain sweep migrates sole-copy objects, reschedules
+        idle PG bundles, waits for running work, then terminates it."""
+        node_id = msg["node_id"]
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return {"accepted": False, "reason": "no such alive node"}
+            if node.is_head:
+                return {"accepted": False, "reason": "cannot drain head"}
+            node.draining = True
+            node.drain_reason = msg.get("reason", "")
+            self._drain_migrating.setdefault(node_id, set())
+        self._wake.set()
+        return {"accepted": True}
+
+    def _op_drain_status(self, conn, msg):
+        node_id = msg["node_id"]
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                return {"state": "gone"}
+            if not node.draining:
+                return {"state": "alive"}
+            busy = sum(1 for w in self.workers.values()
+                       if self._drain_blocking_locked(w, node_id))
+            sole = sum(1 for e in self.objects.values()
+                       if e.node_id == node_id and e.in_shm
+                       and e.state == READY)
+            bundles = sum(1 for pg in self.placement_groups.values()
+                          if pg.state == "CREATED" and any(
+                              b.node_id == node_id for b in pg.bundles))
+            return {"state": "draining", "busy_workers": busy,
+                    "sole_objects": sole, "pg_bundles": bundles}
+
+    def _op_objects_migrated(self, conn, msg):
+        """A draining node finished pushing objects to a survivor: move
+        the primary-copy records so the upcoming node death triggers NO
+        reconstruction for them."""
+        node_id = msg["node_id"]
+        dest_node = msg["dest_node"]
+        with self.lock:
+            migr = self._drain_migrating.get(node_id, set())
+            dest = self.nodes.get(dest_node)
+            for obj_hex, status in (msg.get("results") or {}).items():
+                migr.discard(obj_hex)
+                if status in ("ok", "have") and dest is not None \
+                        and dest.alive:
+                    e = self.objects.get(obj_hex)
+                    if e is not None and e.node_id == node_id:
+                        e.node_id = dest_node
+        self._wake.set()
+        return True
+
+    @staticmethod
+    def _drain_blocking_locked(w, node_id: str) -> bool:
+        """Lock held.  Does this worker hold drain-blocking work on
+        node_id?  (Single definition shared by drain_status and the
+        drain sweep so the two can never disagree.)"""
+        return (w.node_id == node_id and w.state != "dead"
+                and bool(w.current_task or w.actor_hex
+                         or w.state in ("leased", "busy", "starting")))
+
+    def _reschedule_pg_locked(self, pg: "PlacementGroupEntry"):
+        """Lock held.  Release a CREATED-but-idle PG's bundles and send
+        it back to PENDING: the scheduler re-reserves it on schedulable
+        nodes (the drain path's bundle migration; reference reschedules
+        bundles off draining/dead nodes the same way)."""
+        for b in pg.bundles:
+            node = self.nodes.get(b.node_id)
+            if node is not None and node.alive:
+                node.available = node.available.add(b.available)
+        pg.bundles = []
+        pg.state = "PENDING"
+
+    def _check_drains(self):
+        """Drain sweep (called from the scheduler loop): advance every
+        draining node toward termination.  Order per node: wait for
+        running work -> migrate sole-copy objects to a survivor arena ->
+        reschedule idle PG bundles -> terminate via the normal removal
+        path (object records already point at the survivor, so the
+        death handler reconstructs nothing)."""
+        migrations = []  # (node_conn, objects, dest_addr, dest_node)
+        finished = []    # node_ids ready to terminate
+        with self.lock:
+            draining = [n for n in self.nodes.values()
+                        if n.alive and n.draining]
+            for node in draining:
+                nid = node.node_id
+                busy = any(self._drain_blocking_locked(w, nid)
+                           for w in self.workers.values())
+                if busy:
+                    continue
+                migr = self._drain_migrating.setdefault(nid, set())
+                sole = [(h, e) for h, e in self.objects.items()
+                        if e.node_id == nid and e.in_shm
+                        and e.state == READY]
+                pending = [x for x in sole if x[0] in migr]
+                fresh = [x for x in sole if x[0] not in migr]
+                if fresh and node.conn is not None:
+                    dest = next(
+                        (n for n in self.nodes.values()
+                         if n.schedulable and n.node_id != nid
+                         and n.conn is not None and n.address),
+                        None)
+                    if dest is not None:
+                        migr.update(h for h, _ in fresh)
+                        migrations.append((
+                            nid, node.conn,
+                            [{"obj": h, "size": e.size}
+                             for h, e in fresh],
+                            dest.address, dest.node_id))
+                        continue
+                    # No survivor arena exists: nothing to migrate to —
+                    # fall through and let lineage cover the loss.
+                elif pending:
+                    continue  # migration in flight; wait for the report
+                pgs = [pg for pg in self.placement_groups.values()
+                       if pg.state == "CREATED" and any(
+                           b.node_id == nid for b in pg.bundles)]
+                moved = False
+                for pg in pgs:
+                    in_use = any(
+                        w.charge and w.charge[0] == "pg"
+                        and w.charge[1] == pg.pg_hex
+                        and w.state != "dead"
+                        for w in self.workers.values())
+                    if not in_use:
+                        self._reschedule_pg_locked(pg)
+                        moved = True
+                if pgs and not moved:
+                    continue  # occupied bundles: wait for their workers
+                if moved:
+                    continue  # let the scheduler re-reserve first
+                finished.append(nid)
+        for nid, conn, objects, dest_addr, dest_node in migrations:
+            try:
+                conn.push({"op": "migrate_objects", "objects": objects,
+                           "dest": dest_addr, "dest_node": dest_node})
+            except Exception:
+                # Failed to even hand the node the migration list: take
+                # the hexes back out of the in-flight set so the next
+                # sweep retries instead of waiting forever on a report
+                # that can never come.
+                with self.lock:
+                    migr = self._drain_migrating.get(nid)
+                    if migr is not None:
+                        for item in objects:
+                            migr.discard(item["obj"])
+        for nid in finished:
+            with self.lock:
+                self._drain_migrating.pop(nid, None)
+            self._op_remove_node(None, {"node_id": nid})
+
     def _op_remove_node(self, conn, msg):
         """Simulated node failure: kill its workers, fail/retry their work.
 
@@ -2370,7 +2545,7 @@ class ControlServer:
             ]
             nodes = [
                 {"node_id": n.node_id, "is_head": n.is_head,
-                 "alive": n.alive,
+                 "alive": n.alive, "draining": n.draining,
                  "total": n.total.to_dict(),
                  "available": n.available.to_dict(),
                  "labels": dict(n.labels)}
@@ -2384,6 +2559,7 @@ class ControlServer:
         with self.lock:
             return [
                 {"node_id": n.node_id, "alive": n.alive,
+                 "draining": n.draining,
                  "is_head": n.is_head, "resources": n.total.to_dict(),
                  "available": n.available.to_dict(), "labels": n.labels,
                  "address": n.address, "stats": dict(n.stats)}
@@ -2423,7 +2599,7 @@ class ControlServer:
     def _try_reserve_pg(self, pg: PlacementGroupEntry) -> bool:
         """Lock held. Attempt to reserve all bundles atomically (the 2PC
         prepare/commit collapses to one step inside the control plane)."""
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values() if n.schedulable]
         needs = [ResourceSet(b) for b in pg.bundle_specs]
         placement: List[str] = []
         # virtual availability during placement
@@ -2673,6 +2849,12 @@ class ControlServer:
 
                 traceback.print_exc()
             try:
+                self._check_drains()
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+            try:
                 self._sync_resource_view()
             except Exception:
                 pass
@@ -2785,7 +2967,7 @@ class ControlServer:
                     return None
                 b = pg.bundles[i]
                 node = self.nodes.get(b.node_id)
-                if (node is not None and node.alive
+                if (node is not None and node.schedulable
                         and need.is_subset_of(avail_of(("pg", pg_hex, i)))):
                     return b.node_id, ("pg", pg_hex, i)
             return None
@@ -2794,10 +2976,10 @@ class ControlServer:
             return avail_of(("node", n.node_id))
 
         st = getattr(spec, "scheduling_strategy", None)
-        alive = [n for n in self.nodes.values() if n.alive]
+        alive = [n for n in self.nodes.values() if n.schedulable]
         if st is not None and type(st).__name__ == "NodeAffinitySchedulingStrategy":
             node = self.nodes.get(st.node_id)
-            if (node is not None and node.alive
+            if (node is not None and node.schedulable
                     and need.is_subset_of(node_avail(node))):
                 return node.node_id, ("node", node.node_id)
             if not st.soft:

@@ -277,8 +277,53 @@ class NodeManager:
                 self.store.delete(ObjectID.from_hex(msg["obj"]))
             except Exception:
                 pass
+        elif op == "migrate_objects":
+            # Drain protocol (gcs.py _check_drains): push the listed
+            # local arena objects to the survivor node's arena, then
+            # report per-object results so the head can move the
+            # primary-copy records before terminating this node.
+            threading.Thread(target=self._migrate_and_report,
+                             args=(msg,), daemon=True,
+                             name="drain-migrate").start()
         elif op == "exit":
             self._stopped.set()
+
+    def _migrate_and_report(self, msg: dict):
+        from ray_tpu.core.object_plane import PushManager
+
+        class _PushHost:
+            """Adapter giving PushManager the runtime surface it needs
+            (local store + cached peer connections + config)."""
+
+            def __init__(self, nm):
+                self.store = nm.store
+                self.config = nm.config
+                self._conns: Dict[str, rpc.Client] = {}
+
+            def _node_conn(self, addr: str) -> rpc.Client:
+                c = self._conns.get(addr)
+                if c is None or c._closed:
+                    c = self._conns[addr] = rpc.Client(
+                        addr, connect_timeout=5.0)
+                return c
+
+        dest = msg["dest"]
+        pm = PushManager(_PushHost(self))
+        results: Dict[str, str] = {}
+        for item in msg.get("objects", []):
+            obj_hex, size = item["obj"], item["size"]
+            try:
+                res = pm.broadcast(obj_hex, size, [dest], timeout=300.0)
+                results[obj_hex] = res.get(dest, "error: missing")
+            except Exception as e:  # noqa: BLE001 — report, don't die
+                results[obj_hex] = f"error: {type(e).__name__}: {e}"
+        try:
+            self.head.send({"op": "objects_migrated",
+                            "node_id": self.node_id,
+                            "dest_node": msg.get("dest_node", ""),
+                            "results": results})
+        except Exception:
+            pass
 
     # -- peer/head → node requests (object plane) ----------------------
     def _handle(self, conn: rpc.Connection, msg: dict):

@@ -104,6 +104,11 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     "list_placement_groups": {},
     "add_node": {"resources": "dict", "node_id": "str?", "labels": "dict?"},
     "remove_node": {"node_id": "str"},
+    # -- graceful drain (reference DrainRaylet / autoscaler DrainNode) --
+    "drain_node": {"node_id": "str", "reason": "str?"},
+    "drain_status": {"node_id": "str"},
+    "objects_migrated": {"node_id": "str", "dest_node": "str",
+                         "results": "dict"},
     "shutdown_cluster": {},
     "get_load": {},
     # -- placement groups ----------------------------------------------

@@ -137,7 +137,15 @@ def test_scale_down_idle_nodes(scaling_cluster):
     assert len(autoscaler.provider.non_terminated_nodes()) == 1
     autoscaler.step()  # records idle_since
     time.sleep(0.3)
-    autoscaler.step()  # past timeout: terminate
+    autoscaler.step()  # past timeout: DRAIN first (not terminate)
+    # Drain-before-terminate: the provider instance survives until the
+    # head reports the drain complete (node gone), then releases.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        autoscaler.step()
+        if autoscaler.provider.non_terminated_nodes() == []:
+            break
+        time.sleep(0.2)
     assert autoscaler.provider.non_terminated_nodes() == []
     alive = [n for n in cluster.list_nodes() if n["alive"]]
     assert all(n["node_id"] != nid for n in alive)

@@ -161,9 +161,10 @@ def test_idle_scale_down(cluster):
     _drive(rec, lambda: any(
         i.state == InstanceState.RUNNING for i in rec.im.list()))
     assert ray_tpu.get(ref, timeout=30) == 1
-    # Work done: node goes idle, then away.
-    _drive(rec, lambda: rec.im.count_active("cpu2") == 0, timeout=30)
-    assert not provider.non_terminated()
+    # Work done: node goes idle, drains (DRAINING holds no capacity),
+    # then the instance releases once the drain completes.
+    _drive(rec, lambda: not provider.non_terminated(), timeout=30)
+    assert rec.im.count_active("cpu2") == 0
 
 
 def test_autoscaler_v2_loop(cluster):
