@@ -326,12 +326,22 @@ class ControlServer:
         # nodes) BEFORE serving: a restarted head must know its actors
         # before their still-alive workers redial and re-announce
         # (reference: GCS restart from Redis, redis_store_client.h:33).
+        # Drain bookkeeping: node_id -> object hexes whose migration to
+        # a survivor arena is in flight (cleared by objects_migrated),
+        # plus when the last migrate_objects batch was issued — a lost
+        # report (node->head send failure) must not wedge the drain, so
+        # pending entries older than the retry window re-issue
+        # (completed objects answer "have" on the re-push: idempotent).
+        self._drain_migrating: Dict[str, Set[str]] = {}
+        self._drain_issued_at: Dict[str, float] = {}
+        self._drain_retry_s = 120.0
+
         self._restored_actors: Set[str] = set()
         self._restore_from_journal()
-
-        # Drain bookkeeping: node_id -> object hexes whose migration to
-        # a survivor arena is in flight (cleared by objects_migrated).
-        self._drain_migrating: Dict[str, Set[str]] = {}
+        for nid in getattr(self, "_restored_drains", set()):
+            node = self.nodes.get(nid)
+            if node is not None:
+                node.draining = True
 
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -395,6 +405,12 @@ class ControlServer:
                     # hitting the restart-grace lost error.
                     self.objects.setdefault(pg.ready_obj,
                                             ObjectEntry(refcount=0))
+            elif key.startswith("__meta__/drain/"):
+                node_id = key.rsplit("/", 1)[1]
+                self._drain_migrating.setdefault(node_id, set())
+                self._restored_drains = getattr(
+                    self, "_restored_drains", set())
+                self._restored_drains.add(node_id)
             elif key.startswith("__meta__/node/"):
                 d = self.kv[key]
                 node_id = key.rsplit("/", 1)[1]
@@ -2325,6 +2341,11 @@ class ControlServer:
             node.draining = True
             node.drain_reason = msg.get("reason", "")
             self._drain_migrating.setdefault(node_id, set())
+            # Journaled: a restarted head must keep draining (the
+            # autoscalers are waiting on drain_status == "gone"; losing
+            # the flag would wedge them in DRAINING forever).
+            self._journal_put(f"drain/{node_id}",
+                              {"reason": node.drain_reason})
         self._wake.set()
         return {"accepted": True}
 
@@ -2406,6 +2427,11 @@ class ControlServer:
                 if busy:
                     continue
                 migr = self._drain_migrating.setdefault(nid, set())
+                issued = self._drain_issued_at.get(nid, 0.0)
+                if migr and time.monotonic() - issued > self._drain_retry_s:
+                    # The report for this batch is presumed lost (or the
+                    # node restarted mid-migration): re-issue.
+                    migr.clear()
                 sole = [(h, e) for h, e in self.objects.items()
                         if e.node_id == nid and e.in_shm
                         and e.state == READY]
@@ -2419,6 +2445,7 @@ class ControlServer:
                         None)
                     if dest is not None:
                         migr.update(h for h, _ in fresh)
+                        self._drain_issued_at[nid] = time.monotonic()
                         migrations.append((
                             nid, node.conn,
                             [{"obj": h, "size": e.size}
@@ -2464,6 +2491,8 @@ class ControlServer:
         for nid in finished:
             with self.lock:
                 self._drain_migrating.pop(nid, None)
+                self._drain_issued_at.pop(nid, None)
+                self._journal_del(f"drain/{nid}")
             self._op_remove_node(None, {"node_id": nid})
 
     def _op_remove_node(self, conn, msg):

@@ -7,6 +7,15 @@ of JAX learners whose update is one jitted step (SURVEY.md §2.3 L5, §3.5).
 
 from ray_tpu.rl.algorithm import Algorithm
 from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.connectors import (
+    ClipContinuousActions,
+    ConnectorPipelineV2,
+    ConnectorV2,
+    EpsilonGreedy,
+    FlattenObservations,
+    FrameStackingConnector,
+    MeanStdObservationFilter,
+)
 from ray_tpu.rl.env_runner import SingleAgentEnvRunner
 from ray_tpu.rl.env_runner_group import EnvRunnerGroup
 from ray_tpu.rl.episode import SingleAgentEpisode, episodes_to_batch
@@ -33,6 +42,13 @@ from ray_tpu.rl.replay_buffer import (
 )
 
 __all__ = [
+    "ConnectorV2",
+    "ConnectorPipelineV2",
+    "FrameStackingConnector",
+    "MeanStdObservationFilter",
+    "FlattenObservations",
+    "EpsilonGreedy",
+    "ClipContinuousActions",
     "PrioritizedReplayBuffer",
     "SequenceReplayBuffer",
     "QNetworkSpec",

@@ -53,7 +53,9 @@ class Algorithm(Trainable):
             spec=self._make_runner_spec(),
             seed=config.seed,
             restart_failed=config.restart_failed_env_runners,
-            num_cpus_per_runner=config.num_cpus_per_env_runner)
+            num_cpus_per_runner=config.num_cpus_per_env_runner,
+            env_to_module=config.env_to_module_connector,
+            module_to_env=config.module_to_env_connector)
         self.learner_group = self._build_learner_group(config)
         # Runners start from the learner's weights.
         self.env_runner_group.sync_weights(self.learner_group.get_weights())

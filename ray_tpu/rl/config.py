@@ -25,6 +25,11 @@ class AlgorithmConfig:
         self.num_envs_per_env_runner: int = 1
         self.rollout_fragment_length: int = 200
         self.num_cpus_per_env_runner: float = 1.0
+        # ConnectorV2 factories (rl/connectors.py; reference
+        # config.env_to_module_connector / module_to_env_connector):
+        # callable -> ConnectorV2 | [ConnectorV2], built per runner.
+        self.env_to_module_connector = None
+        self.module_to_env_connector = None
         # training()
         self.lr: float = 3e-4
         self.gamma: float = 0.99
@@ -53,7 +58,9 @@ class AlgorithmConfig:
     def env_runners(self, *, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None,
-                    num_cpus_per_env_runner: Optional[float] = None
+                    num_cpus_per_env_runner: Optional[float] = None,
+                    env_to_module_connector=None,
+                    module_to_env_connector=None
                     ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
@@ -63,6 +70,10 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if num_cpus_per_env_runner is not None:
             self.num_cpus_per_env_runner = num_cpus_per_env_runner
+        if env_to_module_connector is not None:
+            self.env_to_module_connector = env_to_module_connector
+        if module_to_env_connector is not None:
+            self.module_to_env_connector = module_to_env_connector
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

@@ -1,0 +1,353 @@
+"""ConnectorV2: composable env↔module data-path pieces.
+
+Counterpart of the reference's rllib/connectors/connector_v2.py and the
+env-to-module / module-to-env pipelines (rllib/connectors/env_to_module/,
+module_to_env/) — the user-extensible observation/action processing
+surface.  Design here is TPU-shaped around this stack's env runner: the
+hot policy math stays ONE jitted function over the fixed [num_envs]
+batch (env_runner.py), and connectors transform the host-side numpy
+arrays entering and leaving it:
+
+  - env→module pipeline: called with batch {"obs": [n_envs, ...]}
+    every act step; may rewrite "obs" (frame stacking, normalization,
+    flattening).  `recompute_observation_space` lets the module spec be
+    inferred from the TRANSFORMED space (reference
+    ConnectorV2.recompute_output_observation_space).
+  - module→env pipeline: called with batch {"actions": [n_envs, ...],
+    "logp": ..., "values": ...} after the jitted act; may rewrite
+    "actions" (clipping, epsilon-greedy) before env.step.
+
+Stateful connectors (frame stacks, running filters) implement
+`on_episode_start(env_index)` (reset hooks at episode boundaries) and
+get_state/set_state (runner restarts / checkpointing).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Type, Union
+
+import numpy as np
+
+
+class ConnectorV2:
+    """One composable piece of the env↔module data path."""
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def recompute_observation_space(self, space):
+        """Observation space AFTER this connector (env→module only)."""
+        return space
+
+    def on_episode_start(self, env_index: int) -> None:
+        """Episode boundary for one vector-env slot (reset state rows)."""
+
+    def __call__(self, *, batch: Dict[str, Any], episodes=None,
+                 explore: bool = True, runner=None,
+                 shared: Optional[Dict[str, Any]] = None
+                 ) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def get_state(self) -> Dict[str, Any]:
+        return {}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        pass
+
+
+class ConnectorPipelineV2(ConnectorV2):
+    """Ordered list of connectors applied left to right.
+
+    Mirrors the reference pipeline's surgery surface: prepend/append and
+    insert_before/insert_after/remove addressed by connector class or
+    name (rllib ConnectorPipelineV2.insert_before/...).
+    """
+
+    def __init__(self, connectors: Optional[Sequence[ConnectorV2]] = None):
+        self.connectors: List[ConnectorV2] = list(connectors or [])
+
+    # -- surgery --------------------------------------------------------
+    def _index_of(self, key: Union[str, Type[ConnectorV2]]) -> int:
+        for i, c in enumerate(self.connectors):
+            if (isinstance(key, str) and c.name == key) or \
+                    (isinstance(key, type) and isinstance(c, key)):
+                return i
+        raise ValueError(f"no connector matching {key!r} in {self}")
+
+    def prepend(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.insert(0, connector)
+        return self
+
+    def append(self, connector: ConnectorV2) -> "ConnectorPipelineV2":
+        self.connectors.append(connector)
+        return self
+
+    def insert_before(self, key, connector) -> "ConnectorPipelineV2":
+        self.connectors.insert(self._index_of(key), connector)
+        return self
+
+    def insert_after(self, key, connector) -> "ConnectorPipelineV2":
+        self.connectors.insert(self._index_of(key) + 1, connector)
+        return self
+
+    def remove(self, key) -> "ConnectorPipelineV2":
+        self.connectors.pop(self._index_of(key))
+        return self
+
+    # -- ConnectorV2 protocol ------------------------------------------
+    def recompute_observation_space(self, space):
+        for c in self.connectors:
+            space = c.recompute_observation_space(space)
+        return space
+
+    def on_episode_start(self, env_index: int) -> None:
+        for c in self.connectors:
+            c.on_episode_start(env_index)
+
+    def __call__(self, *, batch, episodes=None, explore=True,
+                 runner=None, shared=None):
+        shared = shared if shared is not None else {}
+        for c in self.connectors:
+            batch = c(batch=batch, episodes=episodes, explore=explore,
+                      runner=runner, shared=shared)
+        return batch
+
+    def get_state(self) -> Dict[str, Any]:
+        return {c.name: c.get_state() for c in self.connectors}
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        for c in self.connectors:
+            if c.name in state:
+                c.set_state(state[c.name])
+
+    def __repr__(self):
+        return (f"ConnectorPipelineV2("
+                f"{[c.name for c in self.connectors]})")
+
+
+# ---------------------------------------------------------------------------
+# env → module connectors
+# ---------------------------------------------------------------------------
+
+class FrameStackingConnector(ConnectorV2):
+    """Stack the last `num_frames` observations along the trailing axis
+    (reference env_to_module/frame_stacking.py).  Pixels (H, W, C)
+    stack into (H, W, C*k) — the conv module's catalog dispatch keeps
+    working on the transformed space; flat obs (D,) become (D*k,).
+
+    Per-env ring state resets at episode boundaries so frames never
+    leak across episodes."""
+
+    def __init__(self, num_frames: int = 4):
+        assert num_frames >= 1
+        self.num_frames = num_frames
+        self._frames: Optional[np.ndarray] = None  # [n, k, *obs]
+        self._reset_rows: set = set()
+
+    def recompute_observation_space(self, space):
+        import gymnasium as gym
+
+        shape = list(space.shape)
+        shape[-1] *= self.num_frames
+        low = np.broadcast_to(space.low, space.shape).min() \
+            if hasattr(space, "low") else -np.inf
+        high = np.broadcast_to(space.high, space.shape).max() \
+            if hasattr(space, "high") else np.inf
+        return gym.spaces.Box(low=low, high=high, shape=tuple(shape),
+                              dtype=space.dtype)
+
+    def on_episode_start(self, env_index: int) -> None:
+        self._reset_rows.add(env_index)
+
+    def __call__(self, *, batch, episodes=None, explore=True,
+                 runner=None, shared=None):
+        obs = np.asarray(batch["obs"])
+        n = obs.shape[0]
+        if self._frames is None or self._frames.shape[0] != n:
+            self._frames = np.zeros((n, self.num_frames) + obs.shape[1:],
+                                    dtype=obs.dtype)
+            self._reset_rows = set(range(n))
+        for i in list(self._reset_rows):
+            # New episode: backfill the stack with the first obs
+            # (reference zero-pads; repeating avoids a fake black frame
+            # for modules normalizing over the stack).
+            self._frames[i] = obs[i]
+        self._reset_rows.clear()
+        self._frames = np.roll(self._frames, -1, axis=1)
+        self._frames[:, -1] = obs
+        # Frame-major concat along the trailing (channel) axis:
+        # [..., f_{t-k+1} channels | ... | f_t channels] — the standard
+        # stack-into-channel-dim layout.
+        stacked = np.concatenate(
+            [self._frames[:, j] for j in range(self.num_frames)],
+            axis=-1)
+        out = dict(batch)
+        out["obs"] = stacked
+        return out
+
+    def get_state(self):
+        return {"frames": None if self._frames is None
+                else self._frames.copy()}
+
+    def set_state(self, state):
+        f = state.get("frames")
+        self._frames = None if f is None else np.asarray(f).copy()
+
+
+class MeanStdObservationFilter(ConnectorV2):
+    """Running mean/std observation normalization (reference
+    env_to_module/mean_std_filter.py): Welford accumulation over every
+    observation seen, normalize to ~N(0, 1), clip to +-clip.  The
+    statistics are runner-local state (shipped through
+    get_state/set_state on restarts)."""
+
+    def __init__(self, clip: float = 10.0, update: bool = True,
+                 eps: float = 1e-8):
+        self.clip = clip
+        self.update = update
+        self.eps = eps
+        self._count = 0.0
+        self._mean: Optional[np.ndarray] = None
+        self._m2: Optional[np.ndarray] = None
+
+    def __call__(self, *, batch, episodes=None, explore=True,
+                 runner=None, shared=None):
+        obs = np.asarray(batch["obs"], dtype=np.float64)
+        flat = obs.reshape(obs.shape[0], -1)
+        if self._mean is None:
+            self._mean = np.zeros(flat.shape[1])
+            self._m2 = np.zeros(flat.shape[1])
+        if self.update:
+            for row in flat:  # small n_envs; clarity over vectorization
+                self._count += 1.0
+                delta = row - self._mean
+                self._mean += delta / self._count
+                self._m2 += delta * (row - self._mean)
+        var = self._m2 / max(self._count - 1.0, 1.0) \
+            if self._count > 1 else np.ones_like(self._mean)
+        norm = (flat - self._mean) / np.sqrt(var + self.eps)
+        norm = np.clip(norm, -self.clip, self.clip)
+        out = dict(batch)
+        out["obs"] = norm.reshape(obs.shape).astype(np.float32)
+        return out
+
+    def get_state(self):
+        return {"count": self._count,
+                "mean": None if self._mean is None else self._mean.copy(),
+                "m2": None if self._m2 is None else self._m2.copy()}
+
+    def set_state(self, state):
+        self._count = float(state.get("count", 0.0))
+        m, m2 = state.get("mean"), state.get("m2")
+        self._mean = None if m is None else np.asarray(m, np.float64)
+        self._m2 = None if m2 is None else np.asarray(m2, np.float64)
+
+
+class FlattenObservations(ConnectorV2):
+    """Flatten multi-dim observations to 1-D (reference
+    env_to_module/flatten_observations.py).  OPT-IN: the default
+    pipeline stays empty (the dense module flattens internally via
+    spec_for_env's prod(shape)); add this connector to make the
+    flattening explicit in the pipeline — e.g. to force a 3-D space
+    AWAY from the conv module — or to compose it before a filter that
+    wants 1-D input."""
+
+    def recompute_observation_space(self, space):
+        import gymnasium as gym
+
+        if len(space.shape) <= 1:
+            return space
+        n = int(np.prod(space.shape))
+        return gym.spaces.Box(low=-np.inf, high=np.inf, shape=(n,),
+                              dtype=np.float32)
+
+    def __call__(self, *, batch, episodes=None, explore=True,
+                 runner=None, shared=None):
+        obs = np.asarray(batch["obs"])
+        if obs.ndim <= 2:
+            return batch
+        out = dict(batch)
+        out["obs"] = obs.reshape(obs.shape[0], -1)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# module → env connectors
+# ---------------------------------------------------------------------------
+
+class EpsilonGreedy(ConnectorV2):
+    """Annealed epsilon-greedy over discrete module actions (the host
+    side of DQN-style exploration; reference module_to_env epsilon
+    handling).  The schedule is a pure function of the runner's
+    lifetime step counter, so restarted runners resume it."""
+
+    def __call__(self, *, batch, episodes=None, explore=True,
+                 runner=None, shared=None):
+        spec = runner.spec
+        eps_steps = getattr(spec, "epsilon_timesteps", 0)
+        if not explore or not eps_steps:
+            return batch
+        t = runner.metrics["num_env_steps_sampled_lifetime"] \
+            + (shared or {}).get("steps_this_sample", 0)
+        frac = min(1.0, t / eps_steps)
+        eps = (spec.epsilon_initial
+               + frac * (spec.epsilon_final - spec.epsilon_initial))
+        actions = np.asarray(batch["actions"])
+        take_random = runner._np_rng.random(actions.shape[0]) < eps
+        random_actions = runner._np_rng.integers(
+            0, spec.action_dim, actions.shape[0])
+        out = dict(batch)
+        out["actions"] = np.where(take_random, random_actions,
+                                  actions).astype(actions.dtype)
+        return out
+
+
+class ClipContinuousActions(ConnectorV2):
+    """Clip continuous actions into the env's action-space box
+    (reference module_to_env/..., unsquash/clip actions).
+
+    Writes "actions_for_env": the EXECUTED action is clipped but the
+    recorded/trained action stays the module's unclipped sample, whose
+    logp is the one the episode carries (clipping the trained action
+    would silently mismatch PPO's importance ratios)."""
+
+    def __call__(self, *, batch, episodes=None, explore=True,
+                 runner=None, shared=None):
+        if runner.spec.discrete:
+            return batch
+        space = runner.env.single_action_space
+        out = dict(batch)
+        out["actions_for_env"] = np.clip(np.asarray(batch["actions"]),
+                                         space.low, space.high)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# default pipelines
+# ---------------------------------------------------------------------------
+
+def default_env_to_module(user=None) -> ConnectorPipelineV2:
+    """User connectors run FIRST (on raw env observations), mirroring
+    the reference's ordering where custom env→module pieces precede the
+    built-in batching/numpy pieces."""
+    pipe = ConnectorPipelineV2(_as_list(user))
+    return pipe
+
+
+def default_module_to_env(user=None) -> ConnectorPipelineV2:
+    """Built-in action post-processing, then user pieces."""
+    pipe = ConnectorPipelineV2([EpsilonGreedy(), ClipContinuousActions()])
+    for c in _as_list(user):
+        pipe.append(c)
+    return pipe
+
+
+def _as_list(user) -> List[ConnectorV2]:
+    if user is None:
+        return []
+    if callable(user) and not isinstance(user, ConnectorV2):
+        user = user()  # factory (picklable across actor boundaries)
+    if isinstance(user, ConnectorV2):
+        return [user]
+    return list(user)

@@ -18,6 +18,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rl import module as rl_module
+from ray_tpu.rl.connectors import (
+    default_env_to_module,
+    default_module_to_env,
+)
 from ray_tpu.rl.episode import SingleAgentEpisode
 
 
@@ -32,7 +36,8 @@ class SingleAgentEnvRunner:
     def __init__(self, env_fn: Callable[[], Any], num_envs: int = 1,
                  spec: Optional[rl_module.RLModuleSpec] = None,
                  seed: int = 0, explore: bool = True,
-                 worker_index: int = 0):
+                 worker_index: int = 0,
+                 env_to_module=None, module_to_env=None):
         import gymnasium as gym
 
         self.num_envs = num_envs
@@ -43,7 +48,20 @@ class SingleAgentEnvRunner:
         self.env = gym.vector.SyncVectorEnv(
             [env_fn for _ in range(num_envs)],
             autoreset_mode=gym.vector.AutoresetMode.NEXT_STEP)
-        self.spec = spec or rl_module.spec_for_env(self.env)
+        # ConnectorV2 pipelines (connectors.py; reference
+        # connector_v2.py + env_to_module/, module_to_env/): user pieces
+        # transform raw observations before the jitted act and module
+        # actions before env.step.  When the spec is inferred, it is
+        # inferred from the pipeline's TRANSFORMED observation space
+        # (reference recompute_output_observation_space), so e.g. frame
+        # stacking changes the module's input shape automatically.
+        self.env_to_module = default_env_to_module(env_to_module)
+        self.module_to_env = default_module_to_env(module_to_env)
+        if spec is None:
+            obs_space = self.env_to_module.recompute_observation_space(
+                self.env.single_observation_space)
+            spec = rl_module.spec_for_env(self.env, obs_space=obs_space)
+        self.spec = spec
         self.explore = explore
         self.worker_index = worker_index
         self.seed = seed
@@ -51,6 +69,7 @@ class SingleAgentEnvRunner:
         self.params = rl_module.init_params(
             self.spec, jax.random.key(seed))
         self._obs: Optional[np.ndarray] = None
+        self._tobs: Optional[np.ndarray] = None  # module-view obs
         self._episodes: List[SingleAgentEpisode] = []
         self._pending_reset = np.zeros(num_envs, dtype=bool)
         self.metrics: Dict[str, Any] = {
@@ -104,6 +123,16 @@ class SingleAgentEnvRunner:
     def get_weights(self):
         return jax.device_get(self.params)
 
+    # -- connector state (reference: EnvRunner get_state/set_state carry
+    # connector states; filters merge across restarts) --------------------
+    def get_connector_state(self) -> Dict[str, Any]:
+        return {"env_to_module": self.env_to_module.get_state(),
+                "module_to_env": self.module_to_env.get_state()}
+
+    def set_connector_state(self, state: Dict[str, Any]) -> None:
+        self.env_to_module.set_state(state.get("env_to_module", {}))
+        self.module_to_env.set_state(state.get("module_to_env", {}))
+
     # -- sampling ----------------------------------------------------------
     def sample(self, *, num_env_steps: Optional[int] = None,
                num_episodes: Optional[int] = None,
@@ -119,11 +148,21 @@ class SingleAgentEnvRunner:
             obs, _ = self.env.reset(
                 seed=self.seed * 10007 + self.worker_index)
             self._obs = obs
+            for i in range(self.num_envs):
+                self.env_to_module.on_episode_start(i)
+            # ONE pipeline pass per arriving observation batch; episodes
+            # record the TRANSFORMED obs (what the module acts on), so
+            # the learner trains on the same view — recording raw obs
+            # would shape-mismatch stacked/normalized modules and
+            # corrupt PPO's logp ratios.
+            self._tobs = np.asarray(self.env_to_module(
+                batch={"obs": obs}, episodes=None,
+                explore=self.explore, runner=self)["obs"])
             self._episodes = [
                 SingleAgentEpisode(id=uuid.uuid4().hex)
                 for _ in range(self.num_envs)]
             for i in range(self.num_envs):
-                self._episodes[i].add_reset(obs[i])
+                self._episodes[i].add_reset(self._tobs[i])
             self._pending_reset[:] = False
             self._is_first[:] = True
 
@@ -135,42 +174,45 @@ class SingleAgentEnvRunner:
             if num_episodes is not None and len(done_episodes) >= num_episodes:
                 break
             self._rng, key = jax.random.split(self._rng)
+            shared = {"steps_this_sample": steps}
             if self._stateful:
                 action, logp, value, self._act_state = self._act(
-                    self.params, self._act_state, jnp.asarray(self._obs),
-                    key, self.explore, jnp.asarray(self._is_first))
+                    self.params, self._act_state,
+                    jnp.asarray(self._tobs), key, self.explore,
+                    jnp.asarray(self._is_first))
                 self._is_first[:] = False
             else:
                 action, logp, value = self._act(
-                    self.params, jnp.asarray(self._obs), key, self.explore)
-            action_np = np.asarray(action)
-            eps_steps = getattr(self.spec, "epsilon_timesteps", 0)
-            if self.explore and eps_steps:
-                t = self.metrics["num_env_steps_sampled_lifetime"] + steps
-                frac = min(1.0, t / eps_steps)
-                eps = (self.spec.epsilon_initial
-                       + frac * (self.spec.epsilon_final
-                                 - self.spec.epsilon_initial))
-                take_random = self._np_rng.random(self.num_envs) < eps
-                random_actions = self._np_rng.integers(
-                    0, self.spec.action_dim, self.num_envs)
-                action_np = np.where(take_random, random_actions,
-                                     action_np).astype(action_np.dtype)
-            env_action = action_np
-            if not self.spec.discrete:
-                env_action = np.clip(
-                    action_np,
-                    self.env.single_action_space.low,
-                    self.env.single_action_space.high)
+                    self.params, jnp.asarray(self._tobs), key,
+                    self.explore)
+            out_batch = self.module_to_env(
+                batch={"actions": np.asarray(action), "logp": logp,
+                       "values": value},
+                episodes=self._episodes, explore=self.explore,
+                runner=self, shared=shared)
+            # "actions" is what trains (post-epsilon, pre-clip — its
+            # logp is the module's); "actions_for_env" is what executes
+            # (reference keeps both columns the same way).
+            action_np = np.asarray(out_batch["actions"])
+            env_action = np.asarray(
+                out_batch.get("actions_for_env", out_batch["actions"]))
             next_obs, rewards, terms, truncs, infos = self.env.step(env_action)
             logp_np, value_np = np.asarray(logp), np.asarray(value)
+            # Episode boundaries FIRST (stateful connectors reset their
+            # rows), then ONE env_to_module pass over the arriving obs.
+            for i in range(self.num_envs):
+                if self._pending_reset[i]:
+                    self.env_to_module.on_episode_start(i)
+            tobs = np.asarray(self.env_to_module(
+                batch={"obs": next_obs}, episodes=self._episodes,
+                explore=self.explore, runner=self, shared=shared)["obs"])
             for i in range(self.num_envs):
                 if self._pending_reset[i]:
                     # NEXT_STEP autoreset: this step WAS the reset for env i
                     # (action ignored, reward 0) — record nothing; next_obs[i]
                     # is the new episode's first obs.
                     self._episodes[i] = SingleAgentEpisode(id=uuid.uuid4().hex)
-                    self._episodes[i].add_reset(next_obs[i])
+                    self._episodes[i].add_reset(tobs[i])
                     self._pending_reset[i] = False
                     # Recurrent state for env i resets on the next act.
                     self._is_first[i] = True
@@ -180,7 +222,7 @@ class SingleAgentEnvRunner:
                 # NEXT_STEP autoreset: on done, next_obs[i] IS the true
                 # final obs (the env resets on the following step call).
                 ep.add_step(
-                    next_obs[i], action_np[i], float(rewards[i]),
+                    tobs[i], action_np[i], float(rewards[i]),
                     terminated=bool(terms[i]), truncated=bool(truncs[i]),
                     logp=float(logp_np[i]),
                     extra={"values": float(value_np[i])})
@@ -194,6 +236,7 @@ class SingleAgentEnvRunner:
                     # tail-fragment loop below from re-shipping this episode.
                     self._episodes[i] = SingleAgentEpisode(id=uuid.uuid4().hex)
             self._obs = next_obs
+            self._tobs = tobs
 
         out = list(done_episodes)
         if num_env_steps is not None:
@@ -203,7 +246,7 @@ class SingleAgentEnvRunner:
                 if len(ep) > 0:
                     out.append(ep.finalize())
                     cont = SingleAgentEpisode(id=ep.id)
-                    cont.add_reset(self._obs[i])
+                    cont.add_reset(self._tobs[i])
                     self._episodes[i] = cont
         self.metrics["num_env_steps_sampled_lifetime"] += sum(
             len(e) for e in out)

@@ -23,21 +23,34 @@ class EnvRunnerGroup:
                  num_envs_per_runner: int = 1,
                  spec=None, seed: int = 0,
                  restart_failed: bool = True,
-                 num_cpus_per_runner: float = 1.0):
+                 num_cpus_per_runner: float = 1.0,
+                 env_to_module=None, module_to_env=None):
         self.env_fn = env_fn
         self.num_envs_per_runner = num_envs_per_runner
         self.seed = seed
         self.spec = spec
         self.restart_failed = restart_failed
         self.num_cpus_per_runner = num_cpus_per_runner
+        # ConnectorV2 factories (each runner builds its own pipeline:
+        # stateful connectors must not share frame/filter state across
+        # runners — pass a FACTORY, not an instance, when remote
+        # runners exist).
+        self.env_to_module = env_to_module
+        self.module_to_env = module_to_env
         # Local runner: source of truth for the module spec and a fallback
         # sampler when there are no remote runners.
         self.local_runner = SingleAgentEnvRunner(
             env_fn, num_envs=num_envs_per_runner, spec=spec, seed=seed,
-            worker_index=0)
+            worker_index=0, env_to_module=env_to_module,
+            module_to_env=module_to_env)
         self.spec = self.local_runner.spec
         self._actor_cls = ray_tpu.remote(SingleAgentEnvRunner)
         self.remote_runners: List[Any] = []
+        # Last-known connector states per remote runner (fetched
+        # opportunistically after sampling): a restarted runner reseeds
+        # its stateful connectors (running obs filters) from these
+        # instead of starting from zero statistics.
+        self._connector_states: Dict[int, Any] = {}
         # Per-runner lifetime env-step estimates (index 0 = local runner),
         # used to resume epsilon schedules on runner restarts.
         self._lifetime_steps: Dict[int, int] = {}
@@ -49,7 +62,8 @@ class EnvRunnerGroup:
             num_cpus=self.num_cpus_per_runner,
             name=f"env_runner_{worker_index}_{id(self)}",
         ).remote(self.env_fn, self.num_envs_per_runner, self.spec,
-                 self.seed, True, worker_index)
+                 self.seed, True, worker_index,
+                 self.env_to_module, self.module_to_env)
 
     @property
     def num_healthy(self) -> int:
@@ -82,12 +96,21 @@ class EnvRunnerGroup:
                 for r in self.remote_runners]
         results = self._gather(refs, restart_indices=True)
         episodes: List[Any] = []
+        state_refs = []
         for i, res in enumerate(results):
             if res is not None:
                 self._lifetime_steps[i + 1] = (
                     self._lifetime_steps.get(i + 1, 0)
                     + sum(len(e) for e in res))
                 episodes.extend(res)
+                state_refs.append(
+                    (i, self.remote_runners[i]
+                     .get_connector_state.remote()))
+        for i, ref in state_refs:
+            try:
+                self._connector_states[i] = ray_tpu.get(ref, timeout=10)
+            except Exception:
+                pass
         if not episodes:  # all runners died this round: fall back local
             episodes = self.local_runner.sample(
                 num_env_steps=num_env_steps, num_episodes=num_episodes)
@@ -127,6 +150,10 @@ class EnvRunnerGroup:
         self.remote_runners[i] = new
         try:
             new.set_lifetime_steps.remote(self._lifetime_steps.get(i + 1, 0))
+            if i in self._connector_states:
+                # Reseed stateful connectors (obs filters) from the
+                # dead runner's last reported statistics.
+                new.set_connector_state.remote(self._connector_states[i])
             if sync_weights:
                 ray_tpu.get(new.set_weights.remote(
                     self.local_runner.get_weights()), timeout=60)

@@ -365,16 +365,22 @@ class SACModuleSpec:
         return action, logp, jnp.zeros(logp.shape)
 
 
-def spec_for_env(env) -> RLModuleSpec:
+def spec_for_env(env, obs_space=None) -> RLModuleSpec:
     """Build a spec from a gymnasium env's spaces.  3-D Box observation
     spaces (H, W, C pixels) get the conv module automatically — the
     counterpart of the reference catalog's obs-shape dispatch
-    (rllib/core/models/catalog.py)."""
+    (rllib/core/models/catalog.py).
+
+    obs_space overrides the env's own observation space: the env runner
+    passes its ConnectorV2 pipeline's TRANSFORMED space (connectors.py)
+    so e.g. frame stacking resizes the module input automatically."""
     import gymnasium as gym
 
-    obs_space, act_space = env.observation_space, env.action_space
-    # Vector envs expose batched spaces; use the single-env ones.
-    obs_space = getattr(env, "single_observation_space", obs_space)
+    act_space = env.action_space
+    if obs_space is None:
+        obs_space = env.observation_space
+        # Vector envs expose batched spaces; use the single-env ones.
+        obs_space = getattr(env, "single_observation_space", obs_space)
     act_space = getattr(env, "single_action_space", act_space)
     obs_dim = int(np.prod(obs_space.shape))
     if isinstance(act_space, gym.spaces.Discrete):

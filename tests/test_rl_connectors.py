@@ -1,0 +1,193 @@
+"""ConnectorV2 pipelines (rl/connectors.py).
+
+Counterpart of the reference's rllib/connectors/ tests: pipeline
+surgery, frame stacking with episode-boundary resets, mean-std
+filtering, and — the VERDICT r5 item-4 done-criterion — a CUSTOM
+user connector injected into PPO on the pixel env that still learns.
+"""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl.connectors import (
+    ClipContinuousActions,
+    ConnectorPipelineV2,
+    ConnectorV2,
+    EpsilonGreedy,
+    FrameStackingConnector,
+    MeanStdObservationFilter,
+    default_module_to_env,
+)
+
+
+class _AddOne(ConnectorV2):
+    def __call__(self, *, batch, **kw):
+        out = dict(batch)
+        out["obs"] = np.asarray(batch["obs"]) + 1
+        return out
+
+
+class _Double(ConnectorV2):
+    def __call__(self, *, batch, **kw):
+        out = dict(batch)
+        out["obs"] = np.asarray(batch["obs"]) * 2
+        return out
+
+
+def test_pipeline_order_and_surgery():
+    pipe = ConnectorPipelineV2([_AddOne(), _Double()])
+    out = pipe(batch={"obs": np.zeros(2)})
+    assert out["obs"].tolist() == [2.0, 2.0]  # (0+1)*2
+
+    # insert_before by class, insert_after by name, remove.
+    pipe.insert_before(_Double, _AddOne())
+    out = pipe(batch={"obs": np.zeros(2)})
+    assert out["obs"].tolist() == [4.0, 4.0]  # (0+1+1)*2
+    pipe.insert_after("_Double", _AddOne())
+    out = pipe(batch={"obs": np.zeros(2)})
+    assert out["obs"].tolist() == [5.0, 5.0]
+    pipe.remove("_Double")
+    out = pipe(batch={"obs": np.zeros(2)})
+    assert out["obs"].tolist() == [3.0, 3.0]
+    with pytest.raises(ValueError):
+        pipe.remove("_Double")
+
+
+def test_frame_stacking_stacks_and_resets():
+    gym = pytest.importorskip("gymnasium")
+    fs = FrameStackingConnector(num_frames=3)
+    space = gym.spaces.Box(low=0, high=1, shape=(4, 4, 2),
+                           dtype=np.float32)
+    out_space = fs.recompute_observation_space(space)
+    assert out_space.shape == (4, 4, 6)
+
+    def obs(v):
+        return np.full((2, 4, 4, 2), v, dtype=np.float32)
+
+    o1 = fs(batch={"obs": obs(1.0)})["obs"]
+    # first frame backfills the whole stack
+    assert o1.shape == (2, 4, 4, 6)
+    assert np.all(o1 == 1.0)
+    o2 = fs(batch={"obs": obs(2.0)})["obs"]
+    # channel-wise: [f_{t-2}, f_{t-1}, f_t] = [1, 1, 2]
+    assert np.all(o2[..., :2] == 1.0) and np.all(o2[..., 4:] == 2.0)
+    # episode boundary on env 0 only: its stack backfills with the new
+    # obs; env 1 keeps history.
+    fs.on_episode_start(0)
+    o3 = fs(batch={"obs": obs(5.0)})["obs"]
+    assert np.all(o3[0] == 5.0)
+    assert np.all(o3[1, ..., :2] == 1.0) and np.all(o3[1, ..., 4:] == 5.0)
+
+    # state roundtrip
+    st = fs.get_state()
+    fs2 = FrameStackingConnector(num_frames=3)
+    fs2.set_state(st)
+    o4a = fs(batch={"obs": obs(7.0)})["obs"]
+    o4b = fs2(batch={"obs": obs(7.0)})["obs"]
+    np.testing.assert_array_equal(o4a, o4b)
+
+
+def test_mean_std_filter_normalizes():
+    rng = np.random.default_rng(0)
+    f = MeanStdObservationFilter()
+    data = rng.normal(5.0, 3.0, size=(50, 8, 4)).astype(np.float32)
+    for batch in data:
+        out = f(batch={"obs": batch})["obs"]
+    # After many updates the filtered output is ~N(0,1).
+    outs = [f(batch={"obs": b})["obs"] for b in data]
+    flat = np.concatenate([o.reshape(-1, 4) for o in outs])
+    assert abs(flat.mean()) < 0.3
+    assert 0.7 < flat.std() < 1.3
+    # frozen filter (update=False) applies but does not learn
+    st = f.get_state()
+    frozen = MeanStdObservationFilter(update=False)
+    frozen.set_state(st)
+    before = frozen.get_state()["count"]
+    frozen(batch={"obs": data[0]})
+    assert frozen.get_state()["count"] == before
+
+
+def test_default_module_to_env_keeps_epsilon_then_clip():
+    pipe = default_module_to_env()
+    names = [c.name for c in pipe.connectors]
+    assert names == ["EpsilonGreedy", "ClipContinuousActions"]
+    # user piece appends after the defaults
+    pipe2 = default_module_to_env(_AddOne)
+    assert [c.name for c in pipe2.connectors][-1] == "_AddOne"
+
+
+class _BinarizeObs(ConnectorV2):
+    """Custom user connector: threshold the pixels so the bright patch
+    is maximally salient (the kind of domain preprocessing users write
+    connectors FOR), counting invocations."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, *, batch, **kw):
+        self.calls += 1
+        out = dict(batch)
+        out["obs"] = (np.asarray(batch["obs"]) > 0.5).astype(np.float32)
+        return out
+
+
+def test_custom_connector_in_ppo_pixel_env_still_learns():
+    """VERDICT r5 item 4 done-criterion: inject a custom connector into
+    PPO on the pixel env; the module spec is inferred through the
+    pipeline and the algorithm still learns (>2x random)."""
+    from ray_tpu.rl.algorithms import PPOConfig
+    from ray_tpu.rl.envs import BrightQuadrantEnv
+    from ray_tpu.rl.module import ConvRLModuleSpec
+
+    config = (PPOConfig()
+              .environment(env_fn=lambda: BrightQuadrantEnv(size=10,
+                                                            length=8))
+              .env_runners(num_envs_per_env_runner=8,
+                           rollout_fragment_length=256,
+                           env_to_module_connector=_BinarizeObs)
+              .training(train_batch_size=256, minibatch_size=128,
+                        lr=1e-3, num_epochs=4, entropy_coeff=0.01,
+                        grad_clip=10.0)
+              .debugging(seed=0))
+    algo = config.build()
+    runner = algo.env_runner_group.local_runner
+    assert isinstance(algo.env_runner_group.spec, ConvRLModuleSpec)
+    custom = runner.env_to_module.connectors[0]
+    assert isinstance(custom, _BinarizeObs)
+    best = 0.0
+    for _ in range(14):
+        r = algo.step()
+        best = max(best, r.get("episode_return_mean", 0.0))
+        if best > 4.5:
+            break
+    algo.stop()
+    assert custom.calls > 0, "custom connector never ran"
+    assert best > 4.5, best
+
+
+def test_frame_stacking_connector_trains_end_to_end():
+    """A SHAPE-CHANGING connector through the full train loop: frame
+    stacking quadruples the module's input dim; episodes must carry the
+    TRANSFORMED obs (the learner trains on what the module acted on) or
+    the first update would shape-error (code-review r5 finding)."""
+    from ray_tpu.rl.algorithms import PPOConfig
+
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_envs_per_env_runner=2,
+                           rollout_fragment_length=64,
+                           env_to_module_connector=lambda:
+                           FrameStackingConnector(num_frames=4))
+              .training(train_batch_size=64, minibatch_size=32,
+                        num_epochs=1)
+              .debugging(seed=0))
+    algo = config.build()
+    spec = algo.env_runner_group.spec
+    assert spec.obs_dim == 16  # CartPole's 4 obs dims x 4 frames
+    for _ in range(2):
+        r = algo.step()
+    assert r["num_env_steps_sampled_lifetime"] > 0
+    # Sampled episodes carry stacked observations.
+    eps = algo.env_runner_group.local_runner.sample(num_env_steps=8)
+    assert all(np.asarray(e.obs).shape[-1] == 16 for e in eps)
+    algo.stop()
