@@ -541,6 +541,7 @@ class ControlServer:
             node.alive = False
             node.available = ResourceSet()
             node.conn = None
+            self._drop_drain_state_locked(node_id)
             for w in list(self.workers.values()):
                 if w.node_id == node_id and w.state != "dead":
                     self._mark_worker_dead(w, f"node {node_id} died")
@@ -2387,6 +2388,15 @@ class ControlServer:
         self._wake.set()
         return True
 
+    def _drop_drain_state_locked(self, node_id: str):
+        """Lock held.  A node leaving the cluster by ANY path (graceful
+        finish, crash, removal) must shed its drain bookkeeping and
+        journal record, or a head restart re-restores a phantom
+        drain."""
+        self._drain_migrating.pop(node_id, None)
+        self._drain_issued_at.pop(node_id, None)
+        self._journal_del(f"drain/{node_id}")
+
     @staticmethod
     def _drain_blocking_locked(w, node_id: str) -> bool:
         """Lock held.  Does this worker hold drain-blocking work on
@@ -2490,9 +2500,7 @@ class ControlServer:
                             migr.discard(item["obj"])
         for nid in finished:
             with self.lock:
-                self._drain_migrating.pop(nid, None)
-                self._drain_issued_at.pop(nid, None)
-                self._journal_del(f"drain/{nid}")
+                self._drop_drain_state_locked(nid)
             self._op_remove_node(None, {"node_id": nid})
 
     def _op_remove_node(self, conn, msg):
@@ -2525,6 +2533,7 @@ class ControlServer:
                 return False
             node.alive = False
             node.available = ResourceSet()
+            self._drop_drain_state_locked(node_id)
             self._journal_del(f"node/{node_id}")
             for w in list(self.workers.values()):
                 if w.node_id == node_id and w.state != "dead":

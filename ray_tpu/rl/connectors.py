@@ -114,12 +114,17 @@ class ConnectorPipelineV2(ConnectorV2):
         return batch
 
     def get_state(self) -> Dict[str, Any]:
-        return {c.name: c.get_state() for c in self.connectors}
+        # Keyed by position AND class name: two instances of the same
+        # stateful connector class must not collide (the reference
+        # indexes connector names the same way).
+        return {f"{i}:{c.name}": c.get_state()
+                for i, c in enumerate(self.connectors)}
 
     def set_state(self, state: Dict[str, Any]) -> None:
-        for c in self.connectors:
-            if c.name in state:
-                c.set_state(state[c.name])
+        for i, c in enumerate(self.connectors):
+            key = f"{i}:{c.name}"
+            if key in state:
+                c.set_state(state[key])
 
     def __repr__(self):
         return (f"ConnectorPipelineV2("

@@ -96,19 +96,27 @@ class EnvRunnerGroup:
                 for r in self.remote_runners]
         results = self._gather(refs, restart_indices=True)
         episodes: List[Any] = []
-        state_refs = []
+        ok_indices = []
         for i, res in enumerate(results):
             if res is not None:
                 self._lifetime_steps[i + 1] = (
                     self._lifetime_steps.get(i + 1, 0)
                     + sum(len(e) for e in res))
                 episodes.extend(res)
-                state_refs.append(
-                    (i, self.remote_runners[i]
-                     .get_connector_state.remote()))
-        for i, ref in state_refs:
+                ok_indices.append(i)
+        # Refresh cached connector states every few rounds, in ONE
+        # batched get with a short deadline — the states only matter on
+        # the (rare) restart-reseed path and must not add per-iteration
+        # latency proportional to runner count.
+        self._state_round = getattr(self, "_state_round", 0) + 1
+        if ok_indices and self._state_round % 5 == 1:
+            state_refs = [self.remote_runners[i]
+                          .get_connector_state.remote()
+                          for i in ok_indices]
             try:
-                self._connector_states[i] = ray_tpu.get(ref, timeout=10)
+                states = ray_tpu.get(state_refs, timeout=5)
+                for i, st in zip(ok_indices, states):
+                    self._connector_states[i] = st
             except Exception:
                 pass
         if not episodes:  # all runners died this round: fall back local

@@ -16,6 +16,7 @@ from ray_tpu.workflow.api import (
     WorkflowStatus,
     cancel,
     delete,
+    get_metadata,
     get_output,
     get_status,
     list_all,
@@ -24,6 +25,7 @@ from ray_tpu.workflow.api import (
     run,
     run_async,
 )
+from ray_tpu.workflow.executor import with_options
 from ray_tpu.workflow.event import (
     EventListener,
     KVEventListener,
@@ -34,6 +36,7 @@ from ray_tpu.workflow.event import (
 __all__ = [
     "WorkflowStatus", "run", "run_async", "resume", "resume_async",
     "get_status", "get_output", "list_all", "cancel", "delete",
+    "get_metadata", "with_options",
     "EventListener", "KVEventListener", "TimerListener", "wait_for_event",
 ]
 

@@ -41,12 +41,18 @@ class _WorkflowManager:
         self._lock = threading.Lock()
 
     def submit(self, workflow_id: str, dag, workflow_input,
-               root: Optional[str] = None) -> str:
+               root: Optional[str] = None,
+               metadata: Optional[Dict[str, Any]] = None) -> str:
         storage = WorkflowStorage(workflow_id, root)
         storage.save_dag((dag, workflow_input))
+        now = time.time()
         storage.save_meta({
             "status": WorkflowStatus.RUNNING.value,
-            "created_at": time.time(),
+            # created_at predates the metadata API and is kept for
+            # journal compatibility; start_time is the API field.
+            "created_at": now,
+            "start_time": now,
+            "user_metadata": dict(metadata or {}),
         })
         return self._start(workflow_id, dag, workflow_input, storage)
 
@@ -76,6 +82,7 @@ class _WorkflowManager:
             except BaseException:  # noqa: BLE001
                 meta["status"] = WorkflowStatus.FAILED.value
                 meta["error"] = traceback.format_exc()[-4000:]
+            meta["end_time"] = time.time()
             storage.save_meta(meta)
 
         t = threading.Thread(target=runner, daemon=True,
@@ -139,21 +146,24 @@ def _manager():
 # -- public API -------------------------------------------------------------
 
 def run_async(dag, workflow_id: Optional[str] = None,
-              workflow_input: Any = None) -> str:
-    """Start a workflow; returns its workflow_id immediately."""
+              workflow_input: Any = None,
+              metadata: Optional[Dict[str, Any]] = None) -> str:
+    """Start a workflow; returns its workflow_id immediately.
+    metadata: workflow-level user metadata (get_metadata returns it)."""
     import ray_tpu
 
     wid = workflow_id or f"workflow-{uuid.uuid4().hex[:12]}"
     mgr = _manager()
     ray_tpu.get([mgr.submit.remote(wid, dag, workflow_input,
-                                   storage_root())])
+                                   storage_root(), metadata)])
     return wid
 
 
 def run(dag, workflow_id: Optional[str] = None, workflow_input: Any = None,
-        timeout: Optional[float] = None) -> Any:
+        timeout: Optional[float] = None,
+        metadata: Optional[Dict[str, Any]] = None) -> Any:
     """Run a workflow to completion and return its result."""
-    wid = run_async(dag, workflow_id, workflow_input)
+    wid = run_async(dag, workflow_id, workflow_input, metadata)
     return get_output(wid, timeout=timeout)
 
 
@@ -225,3 +235,40 @@ def cancel(workflow_id: str):
 
 def delete(workflow_id: str):
     WorkflowStorage(workflow_id).delete()
+
+
+def get_metadata(workflow_id: str,
+                 task_id: Optional[str] = None) -> Dict[str, Any]:
+    """Metadata of a workflow, or of one of its steps (reference
+    python/ray/workflow/api.py get_metadata).
+
+    Workflow level: {"status", "user_metadata", "stats": {"start_time",
+    "end_time"?}}.  Step level (task_id = a key from list:
+    get_metadata(wid)["tasks"]): {"attempts", "succeeded",
+    "user_metadata", "stats": {...}}."""
+    storage = WorkflowStorage(workflow_id)
+    meta = storage.load_meta()
+    if meta is None:
+        raise ValueError(f"no workflow {workflow_id!r}")
+    if task_id is not None:
+        sm = storage.load_step_meta(task_id)
+        if sm is None:
+            raise ValueError(
+                f"no task {task_id!r} in workflow {workflow_id!r}")
+        return {
+            "attempts": sm.get("attempts"),
+            "succeeded": sm.get("succeeded"),
+            "user_metadata": sm.get("user_metadata", {}),
+            "stats": {"start_time": sm.get("start_time"),
+                      "end_time": sm.get("end_time")},
+        }
+    out: Dict[str, Any] = {
+        "status": get_status(workflow_id).value,
+        "user_metadata": meta.get("user_metadata", {}),
+        "stats": {"start_time": meta.get("start_time",
+                                         meta.get("created_at"))},
+        "tasks": storage.list_steps(),
+    }
+    if "end_time" in meta:
+        out["stats"]["end_time"] = meta["end_time"]
+    return out

@@ -11,6 +11,7 @@ a nested sub-workflow under a derived step key.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from ray_tpu.dag.dag_node import (
@@ -25,6 +26,31 @@ from ray_tpu.workflow.storage import WorkflowStorage
 
 class WorkflowCancelled(RuntimeError):
     pass
+
+
+def with_options(node: DAGNode, *, max_retries: int = 0,
+                 retry_delay_s: float = 0.2,
+                 catch_exceptions: bool = False,
+                 metadata: Optional[dict] = None) -> DAGNode:
+    """Attach per-step runtime options to a workflow DAG node
+    (reference workflow/common.py WorkflowStepRuntimeOptions, set via
+    fn.options(**workflow.options(...))):
+
+      - max_retries: re-execute a FAILED step up to n extra times with
+        exponential backoff (retry_delay_s * 2^attempt) before the
+        workflow fails;
+      - catch_exceptions: the step's checkpointed value becomes
+        (result, None) on success or (None, exception) on terminal
+        failure — downstream steps handle errors as data;
+      - metadata: user step metadata returned by workflow.get_metadata.
+    """
+    node._workflow_options = {
+        "max_retries": int(max_retries),
+        "retry_delay_s": float(retry_delay_s),
+        "catch_exceptions": bool(catch_exceptions),
+        "metadata": dict(metadata or {}),
+    }
+    return node
 
 
 def _step_key(node: DAGNode, idx: int, prefix: str) -> str:
@@ -113,7 +139,7 @@ class WorkflowExecutor:
                 event_threads.append((key, node, box, t))
             try:
                 for key, node, ref in refs:
-                    value = api.get([ref])[0]
+                    value = self._await_step(key, node, ref, results)
                     self.storage.save_step(key, value)
                     results[node._uid] = value
                 for key, node, box, t in event_threads:
@@ -139,6 +165,49 @@ class WorkflowExecutor:
                 raise
             pending = [n for n in pending if n._uid not in results]
         return results[dag._uid]
+
+    def _await_step(self, key: str, node: DAGNode, ref,
+                    results: Dict[int, Any]):
+        """Wait for one step, applying its runtime options: retry with
+        exponential backoff on failure; with catch_exceptions the value
+        becomes (result, None) / (None, error).  Step metadata
+        (attempts, wall times, user metadata) is recorded either way."""
+        from ray_tpu.core import api
+
+        opts = getattr(node, "_workflow_options", None) or {}
+        max_retries = opts.get("max_retries", 0)
+        delay = opts.get("retry_delay_s", 0.2)
+        catch = opts.get("catch_exceptions", False)
+        t0 = time.time()
+        attempts = 1
+        error: Optional[BaseException] = None
+        value = None
+        while True:
+            try:
+                value = api.get([ref])[0]
+                error = None
+                break
+            except Exception as e:  # noqa: BLE001 — step failure
+                error = e
+                if self.cancel_ev.is_set():
+                    raise WorkflowCancelled(self.workflow_id) from None
+                if attempts > max_retries:
+                    break
+                time.sleep(delay * (2 ** (attempts - 1)))
+                attempts += 1
+                ref = self._submit(node, results)
+        self.storage.save_step_meta(key, {
+            "attempts": attempts,
+            "start_time": t0,
+            "end_time": time.time(),
+            "succeeded": error is None,
+            "user_metadata": opts.get("metadata", {}),
+        })
+        if error is not None:
+            if catch:
+                return (None, error)
+            raise error
+        return (value, None) if catch else value
 
     def _submit(self, node: DAGNode, results: Dict[int, Any]):
         def resolve(v):

@@ -74,6 +74,33 @@ class WorkflowStorage:
         with open(self._step_path(key), "rb") as f:
             return cloudpickle.loads(f.read())
 
+    # -- step metadata (reference workflow_storage step metadata) -------
+    def save_step_meta(self, key: str, meta: dict):
+        self._write_atomic(
+            os.path.join(self.steps_dir, f"{key}.meta.json"),
+            json.dumps(meta).encode())
+
+    def load_step_meta(self, key: str) -> Optional[dict]:
+        try:
+            path = os.path.join(self.steps_dir, f"{key}.meta.json")
+            with open(path, "rb") as f:
+                return json.loads(f.read())
+        except FileNotFoundError:
+            return None
+
+    def list_steps(self) -> List[str]:
+        """Every step with a checkpoint OR recorded metadata: a step
+        that failed terminally has only {key}.meta.json (the raise
+        happens before the caller checkpoints), and failed steps are
+        exactly what get_metadata users need to find."""
+        try:
+            names = os.listdir(self.steps_dir)
+        except FileNotFoundError:
+            return []
+        keys = {f[:-4] for f in names if f.endswith(".pkl")}
+        keys |= {f[:-10] for f in names if f.endswith(".meta.json")}
+        return sorted(keys)
+
     # -- result ----------------------------------------------------------
     def save_result(self, value: Any):
         self._write_atomic(
