@@ -265,6 +265,15 @@ class WorkerRuntime:
         if fn is None:
             blob = spec.func_blob or self.core.fetch_func(func_id)
             if blob is None:
+                # The owner's put_func is a one-way send racing the
+                # owner-direct task spec (which travels straight to this
+                # worker): the blob may still be in flight to the GCS.
+                # Brief bounded retry before declaring it missing.
+                deadline = time.monotonic() + 5.0
+                while blob is None and time.monotonic() < deadline:
+                    time.sleep(0.05)
+                    blob = self.core.fetch_func(func_id)
+            if blob is None:
                 raise RuntimeError(f"function {func_id} not found in GCS")
             fn = cloudpickle.loads(blob)
             self._func_cache[func_id] = fn

@@ -103,6 +103,19 @@ class Dashboard:
             from ray_tpu.dashboard.ui import INDEX_HTML
 
             return ("__html__", INDEX_HTML)
+        if path.startswith("/view/"):
+            # Server-rendered table views (the SPA's no-JS fallback;
+            # also what the dashboard tests assert rendered content
+            # against) — same server-side filter/sort/page controls.
+            from urllib.parse import parse_qs as _pq
+            from urllib.parse import urlparse as _up
+
+            from ray_tpu.dashboard.ui import render_view
+
+            p = _up(path)
+            name = p.path[len("/view/"):]
+            vq = {k: v[0] for k, v in _pq(p.query).items()}
+            return ("__html__", render_view(name, vq))
         if path == "/api/grafana_dashboard":
             from ray_tpu.dashboard.ui import grafana_dashboard_json
 
@@ -126,20 +139,11 @@ class Dashboard:
             # table semantics): any other query key is an equality
             # filter ("key=!value" negates, "key=~value" = contains),
             # plus limit/offset/sort_by/descending controls.
+            from ray_tpu.dashboard.ui import parse_table_controls
             from ray_tpu.state import api as state_api
 
-            limit = int(qs.pop("limit", 10000))
-            offset = int(qs.pop("offset", 0))
-            sort_by = qs.pop("sort_by", None)
-            descending = qs.pop("descending", "0") in ("1", "true")
-            filters = []
-            for k, v in qs.items():
-                if v.startswith("!"):
-                    filters.append((k, "!=", v[1:]))
-                elif v.startswith("~"):
-                    filters.append((k, "contains", v[1:]))
-                else:
-                    filters.append((k, "=", v))
+            limit, offset, sort_by, descending, filters = \
+                parse_table_controls(qs, default_limit=10000)
             return state_api._list(
                 simple[parsed.path], filters or None, limit,
                 offset=offset, sort_by=sort_by, descending=descending)

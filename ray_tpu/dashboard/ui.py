@@ -1,77 +1,357 @@
-"""Dashboard web UI: a single self-contained HTML page over the JSON API.
+"""Dashboard web frontend: a dependency-free single-page app + server-
+rendered view pages over the JSON API.
 
 Counterpart of the reference's dashboard frontend (python/ray/dashboard/
-client — a React bundle); here one dependency-free page polls the same
-/api/* endpoints the CLI/state SDK consume and renders cluster
-resources, nodes, tasks, actors, objects and jobs.  Grafana users get a
-generated dashboard JSON for the Prometheus /metrics endpoint instead
-(grafana_dashboard_json below — the counterpart of
-dashboard/modules/metrics' shipped dashboards).
+client — a React bundle).  Here the browser app is ONE self-contained
+HTML document (hash-routed views; no build step, no CDN — works in an
+air-gapped cluster) and every view is ALSO server-rendered at
+/view/<name> so curl/tests see the same content without a JS engine:
+
+  - overview: resource cards, object-store usage, summaries
+  - nodes / tasks / actors / objects / workers / placement_groups:
+    tables driven by the API's SERVER-SIDE controls (filter box ->
+    equality/!=/~contains filters, column-click sort -> sort_by/
+    descending, prev/next -> limit/offset)
+  - node_stats: per-node host stats from the reporter agents
+  - jobs: list + submit form + stop buttons (POST /api/jobs[.../stop])
+  - workers: per-worker stack / jax-trace profile buttons
+  - timeline: chrome-trace download
+
+The column sets live in VIEW_COLUMNS, shared by the JS renderer and the
+server-side renderer, so the two cannot drift.
 """
 
 from __future__ import annotations
 
+import html as _html
+import json
+from typing import Any, Dict, List
+
+# One place for every table view's columns — consumed by BOTH the SPA's
+# JS (injected below) and render_view's server-side HTML.
+VIEW_COLUMNS: Dict[str, List[str]] = {
+    "nodes": ["node_id", "alive", "is_head", "resources", "available",
+              "labels"],
+    "tasks": ["task_id", "name", "state", "worker", "duration_s"],
+    "actors": ["actor_id", "class", "name", "state", "pid", "node_id"],
+    "objects": ["object_id", "state", "size", "refcount", "in_shm",
+                "node_id"],
+    "workers": ["worker_id", "kind", "state", "pid", "actor"],
+    "placement_groups": ["pg_id", "name", "strategy", "state",
+                         "bundles"],
+    "jobs": ["job_id", "status", "entrypoint", "submitted_at"],
+}
+
+def _esc(x: Any) -> str:
+    return _html.escape(str(x), quote=True)
+
+
+def parse_table_controls(qs: Dict[str, str], default_limit: int = 100):
+    """ONE definition of the table-control query grammar, shared by
+    the JSON API routes (http_head._route_get) and the server-rendered
+    views: limit/offset/sort_by/descending plus any other key as a
+    filter ("k=v" equality, "k=!v" negation, "k=~v" contains)."""
+    limit = int(qs.pop("limit", default_limit))
+    offset = int(qs.pop("offset", 0))
+    sort_by = qs.pop("sort_by", None)
+    descending = qs.pop("descending", "0") in ("1", "true")
+    filters = []
+    for k, v in qs.items():
+        if v.startswith("!"):
+            filters.append((k, "!=", v[1:]))
+        elif v.startswith("~"):
+            filters.append((k, "contains", v[1:]))
+        else:
+            filters.append((k, "=", v))
+    return limit, offset, sort_by, descending, filters
+
+
+def render_view(name: str, qs: Dict[str, str]) -> str:
+    """Server-side render of one table view (the no-JS fallback the
+    tests drive): same data path as the SPA — state API with
+    server-side filter/sort/page controls."""
+    if name not in VIEW_COLUMNS:
+        raise KeyError(name)
+    cols = VIEW_COLUMNS[name]
+    limit, offset, sort_by, descending, filters = \
+        parse_table_controls(qs)
+    if name == "jobs":
+        from ray_tpu.job import JobSubmissionClient
+
+        rows = JobSubmissionClient().list_jobs()
+        # Jobs come from the job manager, not the state API; apply the
+        # SAME control grammar here so /view/jobs?status=RUNNING etc.
+        # behave like every other view.
+        for k, op, v in filters:
+            if op == "=":
+                rows = [r for r in rows if str(r.get(k)) == v]
+            elif op == "!=":
+                rows = [r for r in rows if str(r.get(k)) != v]
+            else:
+                rows = [r for r in rows if v in str(r.get(k, ""))]
+        if sort_by:
+            rows.sort(key=lambda r: str(r.get(sort_by, "")),
+                      reverse=descending)
+        rows = rows[offset:offset + limit]
+    else:
+        from ray_tpu.state import api as state_api
+
+        rows = state_api._list(name, filters or None, limit,
+                               offset=offset, sort_by=sort_by,
+                               descending=descending)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{_esc(r.get(c, ''))}</td>" for c in cols)
+        + "</tr>" for r in rows)
+    head = "".join(f"<th>{_esc(c)}</th>" for c in cols)
+    return (f"<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>{_esc(name)}</title></head><body>"
+            f"<h1>{_esc(name)}</h1>"
+            f"<table id='view-{_esc(name)}' data-rows='{len(rows)}'>"
+            f"<tr>{head}</tr>{body}</table>"
+            f"<p><a href='/'>dashboard</a></p></body></html>")
+
+
 INDEX_HTML = """<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
 <style>
- body{font-family:system-ui,sans-serif;margin:1.2rem;background:#fafafa;color:#222}
- h1{font-size:1.3rem} h2{font-size:1.05rem;margin:1.2rem 0 .4rem}
+ body{font-family:system-ui,sans-serif;margin:0;background:#fafafa;color:#222}
+ header{display:flex;gap:.2rem;align-items:center;background:#1a237e;color:#fff;
+   padding:.4rem .8rem;flex-wrap:wrap}
+ header b{margin-right:1rem}
+ nav a{color:#c5cae9;text-decoration:none;padding:.25rem .6rem;border-radius:4px}
+ nav a.active{background:#3949ab;color:#fff}
+ main{padding:1rem}
+ h2{font-size:1.05rem;margin:1rem 0 .4rem}
  table{border-collapse:collapse;width:100%;background:#fff;font-size:.85rem}
- th,td{border:1px solid #ddd;padding:.3rem .5rem;text-align:left}
- th{background:#f0f0f0} .num{text-align:right}
- .pill{display:inline-block;padding:0 .5rem;border-radius:9px;background:#e8f0fe}
- #bar{display:flex;gap:1rem;flex-wrap:wrap}
- .card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:.6rem 1rem}
+ th,td{border:1px solid #ddd;padding:.3rem .5rem;text-align:left;
+   overflow-wrap:anywhere}
+ th{background:#f0f0f0;cursor:pointer;user-select:none}
+ th.sorted:after{content:' \\2193'} th.sorted.asc:after{content:' \\2191'}
+ .cards{display:flex;gap:1rem;flex-wrap:wrap;margin:.5rem 0}
+ .card{background:#fff;border:1px solid #ddd;border-radius:6px;
+   padding:.6rem 1rem;min-width:8rem}
  .muted{color:#888;font-size:.8rem}
+ .ctl{display:flex;gap:.5rem;margin:.4rem 0;flex-wrap:wrap;align-items:center}
+ input,select{padding:.25rem .4rem;border:1px solid #bbb;border-radius:4px}
+ button{padding:.25rem .7rem;border:1px solid #3949ab;background:#3949ab;
+   color:#fff;border-radius:4px;cursor:pointer}
+ button.ghost{background:#fff;color:#3949ab}
+ .err{color:#b71c1c}
+ pre{background:#fff;border:1px solid #ddd;padding:.6rem;overflow:auto;
+   max-height:24rem}
 </style></head><body>
-<h1>ray_tpu dashboard</h1>
-<div id="bar"></div>
-<h2>Nodes</h2><table id="nodes"></table>
-<h2>Tasks</h2><table id="tasks"></table>
-<h2>Actors</h2><table id="actors"></table>
-<h2>Jobs</h2><table id="jobs"></table>
-<h2>Objects (top by size)</h2><table id="objects"></table>
-<p class="muted">Auto-refreshes every 2s · JSON API under /api/* ·
-Prometheus at /metrics · chrome trace at /api/timeline</p>
+<header><b>ray_tpu</b><nav id="nav"></nav></header>
+<main id="main"></main>
 <script>
-async function j(p){const r=await fetch(p);return r.json()}
+"use strict";
+const COLS = __VIEW_COLUMNS__;
+const VIEWS = ["overview","nodes","tasks","actors","objects","workers",
+               "placement_groups","jobs","node_stats","tools"];
 // API strings (task names, job entrypoints) are user-controlled:
 // escape EVERYTHING interpolated into markup (stored-XSS guard).
-function esc(x){return String(x).replace(/[&<>"']/g,
+function esc(x){return String(x??'').replace(/[&<>"']/g,
   c=>({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;',"'":'&#39;'}[c]))}
-function table(el, rows, cols){
-  const t=document.getElementById(el);
-  if(!rows||!rows.length){t.innerHTML='<tr><td class="muted">(none)</td></tr>';return}
-  let h='<tr>'+cols.map(c=>'<th>'+esc(c)+'</th>').join('')+'</tr>';
-  for(const r of rows.slice(0,50))
-    h+='<tr>'+cols.map(c=>'<td>'+esc(r[c]??'')+'</td>').join('')+'</tr>';
-  t.innerHTML=h;
+async function j(p,opts){const r=await fetch(p,opts);return r.json()}
+const S = {};  // per-view table state: {filter, sort_by, desc, offset}
+function st(v){return S[v] ??= {filter:'',sort_by:null,desc:true,offset:0}}
+const PAGE = 50;
+
+function nav(){
+  const cur = (location.hash||'#overview').slice(1).split('?')[0];
+  document.getElementById('nav').innerHTML = VIEWS.map(v=>
+    `<a href="#${v}" class="${v===cur?'active':''}">${v.replace('_',' ')}</a>`
+  ).join('');
+  return cur;
 }
-async function tick(){
- try{
-  const [res,avail,store,nodes,tasks,actors,objects,jobs]=await Promise.all([
-    j('/api/cluster_resources'),j('/api/available_resources'),
-    j('/api/object_store_stats'),j('/api/nodes'),j('/api/tasks'),
-    j('/api/actors'),j('/api/objects'),j('/api/jobs')]);
-  let bar='';
-  for(const k of Object.keys(res))
-    bar+=`<div class="card"><b>${esc(k)}</b><br>${esc(avail[k]??0)} / ${esc(res[k])} free</div>`;
-  bar+=`<div class="card"><b>object store</b><br>`+
-       `${(store.used/1048576).toFixed(1)} / ${(store.capacity/1048576).toFixed(0)} MiB</div>`;
-  document.getElementById('bar').innerHTML=bar;
-  table('nodes',nodes,['node_id','alive','is_head','resources','available']);
-  table('tasks',tasks.filter(t=>t.state!=='FINISHED').concat(
-        tasks.filter(t=>t.state==='FINISHED')).slice(0,50),
-        ['task_id','name','state','duration_s']);
-  table('actors',actors,['actor_id','class','name','state','pid']);
-  table('jobs',jobs,['job_id','status','entrypoint']);
-  objects.sort((a,b)=>(b.size||0)-(a.size||0));
-  table('objects',objects,['object_id','state','size','refcount','in_shm']);
- }catch(e){console.log(e)}
+function qsOf(v){
+  const s = st(v);
+  let q = `limit=${PAGE}&offset=${s.offset}`;
+  if (s.sort_by) q += `&sort_by=${encodeURIComponent(s.sort_by)}`+
+                      `&descending=${s.desc?1:0}`;
+  if (s.filter){
+    const m = s.filter.match(/^\\s*([\\w.]+)\\s*=\\s*(.+)$/);
+    if (m) q += `&${encodeURIComponent(m[1])}=${encodeURIComponent(m[2])}`;
+  }
+  return q;
 }
-tick(); setInterval(tick, 2000);
+function controls(v){
+  const s = st(v);
+  return `<div class="ctl">
+    <input id="flt" placeholder="filter: key=value | key=!v | key=~v"
+      value="${esc(s.filter)}" size="30">
+    <button onclick="applyFilter('${v}')">apply</button>
+    <button class="ghost" onclick="pg('${v}',-1)">&laquo; prev</button>
+    <span class="muted">offset ${s.offset}</span>
+    <button class="ghost" onclick="pg('${v}',1)">next &raquo;</button>
+    <span class="muted">click a column header to sort (server-side)</span>
+  </div>`;
+}
+function applyFilter(v){
+  st(v).filter = document.getElementById('flt').value;
+  st(v).offset = 0; render();
+}
+function pg(v,d){
+  st(v).offset = Math.max(0, st(v).offset + d*PAGE); render();
+}
+function sortBy(v,c){
+  const s = st(v);
+  if (s.sort_by === c) s.desc = !s.desc; else {s.sort_by=c; s.desc=true}
+  render();
+}
+function tableHTML(v, rows, extra){
+  const cols = COLS[v], s = st(v);
+  let h = '<tr>'+cols.map(c=>
+    `<th class="${s.sort_by===c?('sorted'+(s.desc?'':' asc')):''}"
+       onclick="sortBy('${v}','${c}')">${esc(c)}</th>`).join('');
+  if (extra) h += '<th></th>';
+  h += '</tr>';
+  if (!rows.length) h += '<tr><td class="muted">(none)</td></tr>';
+  for (const r of rows){
+    h += '<tr>'+cols.map(c=>{
+      let val = r[c];
+      if (val && typeof val === 'object') val = JSON.stringify(val);
+      return '<td>'+esc(val)+'</td>'}).join('');
+    if (extra) h += '<td>'+extra(r)+'</td>';
+    h += '</tr>';
+  }
+  return `<table id="tbl-${v}">${h}</table>`;
+}
+
+async function viewOverview(m){
+  const [res,avail,store,ts,as_,os_] = await Promise.all([
+    j('/api/cluster_resources'), j('/api/available_resources'),
+    j('/api/object_store_stats'), j('/api/summary/tasks'),
+    j('/api/summary/actors'), j('/api/summary/objects')]);
+  let cards='';
+  for (const k of Object.keys(res))
+    cards += `<div class="card"><b>${esc(k)}</b><br>`+
+             `${esc(avail[k]??0)} / ${esc(res[k])} free</div>`;
+  cards += `<div class="card"><b>object store</b><br>`+
+    `${(store.used/1048576).toFixed(1)} / `+
+    `${(store.capacity/1048576).toFixed(0)} MiB<br>`+
+    `<span class="muted">${store.num_objects} objects</span></div>`;
+  const sum = (t,o)=>`<div class="card"><b>${t}</b><br>`+
+    Object.entries(o).map(([k,v])=>`${esc(k)}: ${esc(v)}`).join('<br>')+
+    '</div>';
+  m.innerHTML = `<h2>Cluster</h2><div class="cards">${cards}</div>
+    <h2>Summaries</h2><div class="cards" id="summaries">
+    ${sum('tasks', ts)}${sum('actors', as_)}${sum('objects', os_)}</div>`;
+}
+async function viewTable(m, v){
+  const rows = await j(`/api/${v}?`+qsOf(v));
+  m.innerHTML = `<h2>${esc(v)}</h2>`+controls(v)+tableHTML(v, rows);
+}
+async function viewWorkers(m){
+  const rows = await j('/api/workers?'+qsOf('workers'));
+  // data-* attributes + delegated listeners: entity-escaping is NOT a
+  // JS-string escape (the browser decodes attributes before inline
+  // handlers parse), so user-controlled ids must never be spliced
+  // into onclick strings.
+  m.innerHTML = '<h2>workers</h2>'+controls('workers')+
+    tableHTML('workers', rows, r=>
+      `<button class="ghost" data-act="prof" data-kind="stack" data-id="${esc(r.worker_id)}">stack</button>
+       <button class="ghost" data-act="prof" data-kind="jax_trace" data-id="${esc(r.worker_id)}">jax trace</button>`)+
+    '<pre id="profout" class="muted">profile output appears here</pre>';
+  m.onclick = e => {
+    const d = e.target.dataset;
+    if (d.act === 'prof') profile(d.id, d.kind);
+  };
+}
+async function profile(hex, kind){
+  const out = document.getElementById('profout');
+  out.textContent = `profiling ${hex} (${kind})...`;
+  try{
+    const r = await j(`/api/workers/${encodeURIComponent(hex)}`+
+                      `/profile?kind=${encodeURIComponent(kind)}&duration_s=2`);
+    out.textContent = typeof r.profile === 'string'
+      ? r.profile : JSON.stringify(r.profile, null, 1);
+  }catch(e){ out.textContent = 'profile failed: '+e }
+}
+async function viewNodeStats(m){
+  const stats = await j('/api/node_stats');
+  let h = '<h2>per-node host stats</h2><div class="cards">';
+  for (const [nid, s] of Object.entries(stats)){
+    h += `<div class="card"><b>${esc(nid)}</b><br>`+
+      `cpu ${esc(s.cpu_percent??'?')}% · load ${esc(s.load_avg_1m??'?')}<br>`+
+      `mem ${((s.mem_used_bytes??0)/1048576).toFixed(0)} MiB<br>`+
+      `arena ${((s.object_store_used_bytes??0)/1048576).toFixed(1)} MiB<br>`+
+      `<span class="muted">${esc(s.num_workers??0)} workers</span></div>`;
+  }
+  m.innerHTML = h + '</div>';
+}
+async function viewJobs(m){
+  const rows = await j('/api/jobs');
+  m.innerHTML = `<h2>jobs</h2>
+    <div class="ctl"><input id="entry" size="50"
+      placeholder="entrypoint, e.g. python -c 'print(42)'">
+     <button id="subbtn">submit</button>
+     <span id="jobmsg" class="muted"></span></div>`+
+    tableHTML('jobs', rows, r=>
+      `<button class="ghost" data-act="stop" data-id="${esc(r.job_id)}">stop</button>
+       <button class="ghost" data-act="logs" data-id="${esc(r.job_id)}">logs</button>`)+
+    '<pre id="joblogs" class="muted">job logs appear here</pre>';
+  document.getElementById('subbtn').onclick = submitJob;
+  m.onclick = e => {
+    const d = e.target.dataset;
+    if (d.act === 'stop') stopJob(d.id);
+    else if (d.act === 'logs') jobLogs(d.id);
+  };
+}
+async function submitJob(){
+  const entry = document.getElementById('entry').value;
+  if (!entry) return;
+  const r = await j('/api/jobs', {method:'POST',
+    headers:{'Content-Type':'application/json'},
+    body: JSON.stringify({entrypoint: entry})});
+  document.getElementById('jobmsg').textContent =
+    r.job_id ? 'submitted '+r.job_id : JSON.stringify(r);
+  setTimeout(render, 400);
+}
+async function stopJob(id){
+  await j(`/api/jobs/${encodeURIComponent(id)}/stop`, {method:'POST'});
+  render();
+}
+async function jobLogs(id){
+  const r = await fetch(`/api/jobs/${encodeURIComponent(id)}/logs`);
+  document.getElementById('joblogs').textContent = await r.text();
+}
+async function viewTools(m){
+  m.innerHTML = `<h2>tools</h2><div class="cards">
+   <div class="card"><b>timeline</b><br>
+     <a href="/api/timeline" download="timeline.json">download chrome trace</a><br>
+     <span class="muted">open in chrome://tracing or Perfetto</span></div>
+   <div class="card"><b>metrics</b><br><a href="/metrics">Prometheus</a> ·
+     <a href="/api/grafana_dashboard">Grafana JSON</a></div>
+   <div class="card"><b>server-rendered views</b><br>
+     ${Object.keys(COLS).map(v=>`<a href="/view/${v}">${v}</a>`).join(' · ')}
+     <br><span class="muted">no-JS fallback of every table</span></div>
+  </div>`;
+}
+
+async function render(){
+  const cur = nav();
+  const m = document.getElementById('main');
+  try{
+    if (cur === 'overview') await viewOverview(m);
+    else if (cur === 'workers') await viewWorkers(m);
+    else if (cur === 'node_stats') await viewNodeStats(m);
+    else if (cur === 'jobs') await viewJobs(m);
+    else if (cur === 'tools') await viewTools(m);
+    else if (COLS[cur]) await viewTable(m, cur);
+    else { location.hash = '#overview'; return }
+  }catch(e){
+    m.innerHTML = `<p class="err">view failed: ${esc(e)}</p>`;
+  }
+}
+window.addEventListener('hashchange', render);
+render();
+setInterval(()=>{const v=(location.hash||'#overview').slice(1);
+  if (['overview','node_stats'].includes(v)) render()}, 3000);
 </script></body></html>
 """
+
+INDEX_HTML = INDEX_HTML.replace("__VIEW_COLUMNS__",
+                                json.dumps(VIEW_COLUMNS))
 
 
 def grafana_dashboard_json(prometheus_job: str = "ray_tpu") -> dict:

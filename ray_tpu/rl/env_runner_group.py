@@ -110,15 +110,17 @@ class EnvRunnerGroup:
         # latency proportional to runner count.
         self._state_round = getattr(self, "_state_round", 0) + 1
         if ok_indices and self._state_round % 5 == 1:
-            state_refs = [self.remote_runners[i]
-                          .get_connector_state.remote()
+            state_refs = [(i, self.remote_runners[i]
+                           .get_connector_state.remote())
                           for i in ok_indices]
-            try:
-                states = ray_tpu.get(state_refs, timeout=5)
-                for i, st in zip(ok_indices, states):
-                    self._connector_states[i] = st
-            except Exception:
-                pass
+            for i, ref in state_refs:
+                # Per-ref isolation: one slow/dead runner must not
+                # discard every healthy runner's fresh state.
+                try:
+                    self._connector_states[i] = ray_tpu.get(
+                        ref, timeout=5)
+                except Exception:
+                    pass
         if not episodes:  # all runners died this round: fall back local
             episodes = self.local_runner.sample(
                 num_env_steps=num_env_steps, num_episodes=num_episodes)

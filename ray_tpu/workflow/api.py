@@ -66,7 +66,11 @@ class _WorkflowManager:
                     self._threads[workflow_id].is_alive():
                 return workflow_id  # already running
         dag, workflow_input = storage.load_dag()
-        storage.save_meta({**meta, "status": WorkflowStatus.RUNNING.value})
+        meta = {**meta, "status": WorkflowStatus.RUNNING.value}
+        # The prior run's end_time would read as "finished in the past"
+        # while the resumed run is RUNNING.
+        meta.pop("end_time", None)
+        storage.save_meta(meta)
         return self._start(workflow_id, dag, workflow_input, storage)
 
     def _start(self, workflow_id, dag, workflow_input, storage) -> str:

@@ -122,7 +122,7 @@ class WorkflowExecutor:
                 refs.append((key, node, ref))
             event_threads = []
             for key, node in event_waits:
-                box: Dict[str, Any] = {}
+                box: Dict[str, Any] = {"t0": time.time()}
 
                 def poll(node=node, box=box):
                     try:
@@ -147,6 +147,12 @@ class WorkflowExecutor:
                     if "error" in box:
                         raise box["error"]
                     self.storage.save_step(key, box["value"])
+                    # Event steps are steps too: get_metadata(wid, key)
+                    # must answer for every key list_steps returns.
+                    self.storage.save_step_meta(key, {
+                        "attempts": 1, "start_time": box["t0"],
+                        "end_time": time.time(), "succeeded": True,
+                        "user_metadata": {}})
                     results[node._uid] = box["value"]
                     # Consume the delivery record only now that the
                     # payload is durably checkpointed: a crash before

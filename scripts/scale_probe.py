@@ -34,6 +34,12 @@ def main(argv=None) -> int:
     ap.add_argument("--tasks", type=int, default=10_000)
     ap.add_argument("--actors", type=int, default=1_000)
     ap.add_argument("--pgs", type=int, default=100)
+    ap.add_argument("--real-nodes", type=int, default=0,
+                    help="also join N REAL node-manager processes so the "
+                         "head's resource-view sync (N8) is actively "
+                         "broadcasting the full node table while the "
+                         "logical nodes churn; the probe records the "
+                         "view size a manager serves back")
     ap.add_argument("--big-object-gb", type=float, default=0,
                     help="also put+get one N-GiB object through the shm "
                          "arena (BASELINE.md 'max ray.get numpy object' "
@@ -68,6 +74,29 @@ def main(argv=None) -> int:
         "num_cpus": 64, "log_to_driver": False,
         "_system_config": sysconf})
 
+    # -- 0. real node managers (resource-view sync receivers) -------------
+    real_procs = []
+    if args.real_nodes:
+        import subprocess
+
+        rt = cluster.runtime
+        for i in range(args.real_nodes):
+            real_procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu.core.node_manager",
+                 "--address", rt.address, "--node-id", f"real-{i}",
+                 "--num-cpus", "2", "--num-tpus", "0"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        deadline = time.time() + 60
+        want = {f"real-{i}" for i in range(args.real_nodes)}
+        while time.time() < deadline:
+            alive = {n["node_id"] for n in cluster.list_nodes()
+                     if n["alive"]}
+            if want <= alive:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("real node managers failed to join")
+
     # -- 1. logical nodes --------------------------------------------------
     t0 = time.perf_counter()
     for i in range(args.nodes - 1):
@@ -78,6 +107,33 @@ def main(argv=None) -> int:
                         "register_per_s": round((args.nodes - 1) / dt, 1)}
     print(f"nodes: {n_nodes} registered at "
           f"{results['nodes']['register_per_s']}/s", flush=True)
+
+    if args.real_nodes:
+        # Prove the synced view propagated the FULL node table to a
+        # real manager (debounced broadcast, gcs _sync_resource_view):
+        # ask the manager's own server for its cluster view.
+        from ray_tpu.core import rpc as _rpc
+
+        mgr_addr = next(n["address"] for n in cluster.list_nodes()
+                        if n["node_id"] == "real-0")
+        view = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            conn = _rpc.Client(mgr_addr, connect_timeout=5.0)
+            view = conn.call({"op": "cluster_view"}, timeout=10.0)
+            conn.close()
+            if view and len(view.get("nodes", view)) >= n_nodes:
+                break
+            time.sleep(0.5)
+        nodes_in_view = len(view.get("nodes", view)) if view else 0
+        results["resource_view_sync"] = {
+            "receivers": args.real_nodes,
+            "nodes_in_synced_view": nodes_in_view,
+            "full_table": nodes_in_view >= n_nodes,
+        }
+        print(f"view sync: manager serves {nodes_in_view} nodes "
+              f"(full={results['resource_view_sync']['full_table']})",
+              flush=True)
 
     # -- 2. queued tasks ---------------------------------------------------
     @ray_tpu.remote(num_cpus=1)
@@ -199,6 +255,8 @@ def main(argv=None) -> int:
               f"overhead), get in {get_dt:.3f}s", flush=True)
         del back, ref
 
+    for p_ in real_procs:
+        p_.terminate()
     cluster.shutdown()
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)
