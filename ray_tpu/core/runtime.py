@@ -217,6 +217,9 @@ class CoreClient:
         # :197).  One pool per task shape.
         self._lease_lock = threading.RLock()
         self._leases: Dict[tuple, "_LeasePool"] = {}
+        # Shapes with backlogged submissions awaiting a flusher-thread
+        # pump (split submit path, _submit_via_lease).
+        self._pump_shapes: set = set()
         self._lease_tokens: Dict[int, tuple] = {}  # token -> shape key
         self._lease_token_seq = 0
         self._lease_of_obj: Dict[str, tuple] = {}  # obj -> (shape, whex, task_hex)
@@ -699,14 +702,42 @@ class CoreClient:
         spec.direct = True
         self._register_direct(spec.return_ids[0].hex(), "")
         shape = self._shape_of(spec)
+        defer = False
         with self._lease_lock:
             pool = self._leases.get(shape)
             if pool is None:
                 pool = self._leases[shape] = _LeasePool(
                     spec.resources, spec.runtime_env)
+            was_backlogged = bool(pool.queue)
             pool.queue.append(spec)
             pool.idle_since = None
-            self._pump_lease_locked(shape, pool)
+            if was_backlogged:
+                # Burst in progress: the workers are saturated (an
+                # earlier pump left a backlog), so pumping again per
+                # submit only re-sorts the same full pipelines.  Append
+                # and let the flusher thread + completion backfills
+                # drive assignment — submission overlaps with dispatch
+                # and completion draining instead of serializing with
+                # them (r4's single_client_tasks_async gap).
+                self._pump_shapes.add(shape)
+                defer = True
+            else:
+                self._pump_lease_locked(shape, pool)
+        if defer:
+            self._ensure_flusher()
+            self._flush_ev.set()
+
+    def _pump_deferred_pools(self):
+        """Flusher-thread half of the split submit path: assign any
+        backlogged shapes' specs to workers (then the same flush cycle
+        carries the sends)."""
+        with self._lease_lock:
+            shapes = list(self._pump_shapes)
+            self._pump_shapes.clear()
+            for shape in shapes:
+                pool = self._leases.get(shape)
+                if pool is not None:
+                    self._pump_lease_locked(shape, pool)
 
     def _pump_lease_locked(self, shape: tuple, pool: "_LeasePool"):
         """Lease lock held.  Assign queued specs to granted workers with
@@ -1870,6 +1901,7 @@ class CoreClient:
             self._flush_ev.clear()
             time.sleep(0.002)
             try:
+                self._pump_deferred_pools()
                 self._flush_direct_sends()
                 self._send_lease_requests()
                 if self._leases:

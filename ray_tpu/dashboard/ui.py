@@ -77,22 +77,14 @@ def render_view(name: str, qs: Dict[str, str]) -> str:
         parse_table_controls(qs)
     if name == "jobs":
         from ray_tpu.job import JobSubmissionClient
+        from ray_tpu.state.api import filter_sort_page
 
-        rows = JobSubmissionClient().list_jobs()
-        # Jobs come from the job manager, not the state API; apply the
-        # SAME control grammar here so /view/jobs?status=RUNNING etc.
-        # behave like every other view.
-        for k, op, v in filters:
-            if op == "=":
-                rows = [r for r in rows if str(r.get(k)) == v]
-            elif op == "!=":
-                rows = [r for r in rows if str(r.get(k)) != v]
-            else:
-                rows = [r for r in rows if v in str(r.get(k, ""))]
-        if sort_by:
-            rows.sort(key=lambda r: str(r.get(sort_by, "")),
-                      reverse=descending)
-        rows = rows[offset:offset + limit]
+        # Jobs come from the job manager, not the state API; the SAME
+        # control pipeline (numeric-aware sort included) applies so
+        # /view/jobs?status=RUNNING etc. behave like every other view.
+        rows = filter_sort_page(
+            JobSubmissionClient().list_jobs(), filters or None, limit,
+            offset=offset, sort_by=sort_by, descending=descending)
     else:
         from ray_tpu.state import api as state_api
 

@@ -104,21 +104,27 @@ class EnvRunnerGroup:
                     + sum(len(e) for e in res))
                 episodes.extend(res)
                 ok_indices.append(i)
-        # Refresh cached connector states every few rounds, in ONE
-        # batched get with a short deadline — the states only matter on
-        # the (rare) restart-reseed path and must not add per-iteration
-        # latency proportional to runner count.
+        # Refresh cached connector states every few rounds under ONE
+        # shared 5 s deadline — the states only matter on the (rare)
+        # restart-reseed path and must not add per-iteration latency
+        # proportional to runner count.  Per-ref gets under the shared
+        # deadline keep failure isolation (one dead runner costs only
+        # the remaining budget, not everyone's states).
         self._state_round = getattr(self, "_state_round", 0) + 1
         if ok_indices and self._state_round % 5 == 1:
+            import time as _time
+
             state_refs = [(i, self.remote_runners[i]
                            .get_connector_state.remote())
                           for i in ok_indices]
+            deadline = _time.monotonic() + 5.0
             for i, ref in state_refs:
-                # Per-ref isolation: one slow/dead runner must not
-                # discard every healthy runner's fresh state.
+                budget = deadline - _time.monotonic()
+                if budget <= 0:
+                    break
                 try:
                     self._connector_states[i] = ray_tpu.get(
-                        ref, timeout=5)
+                        ref, timeout=budget)
                 except Exception:
                     pass
         if not episodes:  # all runners died this round: fall back local

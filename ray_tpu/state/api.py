@@ -54,13 +54,15 @@ def _apply_filters(rows: List[dict],
     return out
 
 
-def _list(kind: str, filters=None, limit: int = 10000, *,
-          offset: int = 0, sort_by: Optional[str] = None,
-          descending: bool = False) -> List[dict]:
+def filter_sort_page(rows: List[dict], filters=None,
+                     limit: int = 10000, *, offset: int = 0,
+                     sort_by: Optional[str] = None,
+                     descending: bool = False) -> List[dict]:
     """Filter -> sort -> paginate, in that order (the reference's state
     API contract: limit/offset apply to the FILTERED set so pages are
-    stable under unrelated churn)."""
-    rows = get_runtime().state_list(kind)
+    stable under unrelated churn).  Shared by the state API tables and
+    every other row source that honors the same controls (the
+    dashboard's jobs view) so the grammar cannot drift."""
     rows = _apply_filters(rows, filters)
     if sort_by is not None:
         def key(r):
@@ -75,6 +77,14 @@ def _list(kind: str, filters=None, limit: int = 10000, *,
 
         rows.sort(key=key, reverse=descending)
     return rows[offset:offset + limit]
+
+
+def _list(kind: str, filters=None, limit: int = 10000, *,
+          offset: int = 0, sort_by: Optional[str] = None,
+          descending: bool = False) -> List[dict]:
+    return filter_sort_page(
+        get_runtime().state_list(kind), filters, limit, offset=offset,
+        sort_by=sort_by, descending=descending)
 
 
 def list_tasks(filters=None, limit: int = 10000, **kw) -> List[dict]:

@@ -76,6 +76,21 @@ def main(argv=None) -> int:
 
     # -- 0. real node managers (resource-view sync receivers) -------------
     real_procs = []
+    try:
+        return _probe(args, results, cluster, real_procs)
+    finally:
+        for p_ in real_procs:
+            if p_.poll() is None:
+                p_.terminate()
+
+
+def _probe(args, results, cluster, real_procs) -> int:
+    import ray_tpu
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
     if args.real_nodes:
         import subprocess
 
@@ -255,8 +270,6 @@ def main(argv=None) -> int:
               f"overhead), get in {get_dt:.3f}s", flush=True)
         del back, ref
 
-    for p_ in real_procs:
-        p_.terminate()
     cluster.shutdown()
     with open(args.out, "w") as f:
         json.dump(results, f, indent=1)

@@ -377,13 +377,18 @@ def test_dashboard_spa_views_on_three_node_cluster():
             assert int(re.search(r"data-rows='(\d+)'", html).group(1)) == 3
 
             html = _get_text(f"{base}/view/tasks")
-            assert "work" in html
+            # row content, not the 'worker' column header: the task
+            # name cell (qualname ends in .work) and a real row count
+            assert "work</td>" in html
+            assert int(re.search(r"data-rows='(\d+)'",
+                                 html).group(1)) >= 3
             html = _get_text(f"{base}/view/actors")
             assert "Counter" in html and "dash-actor" in html
             html = _get_text(f"{base}/view/objects")
             assert ref.hex() in html  # the put object's row renders
             html = _get_text(f"{base}/view/workers")
-            assert "actor" in html
+            assert int(re.search(r"data-rows='(\d+)'",
+                                 html).group(1)) >= 1
             html = _get_text(f"{base}/view/placement_groups")
             assert "SPREAD" in html
             html = _get_text(f"{base}/view/jobs")
