@@ -182,6 +182,32 @@ struct CtorWrap<C, R(Args...)> {
 // ---------------------------------------------------------------------------
 // The worker loop: register names, then execute pushed calls.
 // ---------------------------------------------------------------------------
+
+// Resolve {"__ref__": "<hex>"} ObjectRef markers in a call's args by
+// fetching the referenced object's JSON value from the cluster object
+// directory (counterpart of the reference's cross-language ref args:
+// refs travel by id and resolve callee-side).  A pending producer is
+// awaited (bounded), so a C++ task can consume a Python task's result
+// submitted moments earlier.
+inline bool IsObjectHex(const Json& v) {
+  // Strict marker shape (28 lowercase hex chars — an ObjectID): an
+  // ordinary {"__ref__": <other>} payload must pass through verbatim,
+  // never be misread as a ref.
+  if (v.type != Json::kStr || v.str.size() != 28) return false;
+  for (char c : v.str)
+    if (!((c >= '0' && c <= '9') || (c >= 'a' && c <= 'f'))) return false;
+  return true;
+}
+
+inline void ResolveRefArgs(Client& client, std::vector<Json>* args) {
+  for (auto& a : *args) {
+    if (a.type != Json::kObj || a.obj.size() != 1) continue;
+    auto it = a.obj.find("__ref__");
+    if (it == a.obj.end() || !IsObjectHex(it->second)) continue;
+    a = client.GetBlocking(it->second.str, /*timeout_s=*/60.0);
+  }
+}
+
 inline void ServeWorker(Client& client) {
   std::string fns = "[";
   for (auto& kv : FunctionRegistry()) {
@@ -207,7 +233,8 @@ inline void ServeWorker(Client& client) {
     std::string error;
     Json result;
     try {
-      const std::vector<Json>& args = msg.at("args").arr;
+      std::vector<Json> args = msg.at("args").arr;
+      ResolveRefArgs(client, &args);
       if (!msg.at("fn").is_null()) {
         auto it = FunctionRegistry().find(msg.at("fn").str);
         if (it == FunctionRegistry().end())

@@ -1599,8 +1599,20 @@ class ControlServer:
         if func_id is None:
             raise ValueError(f"no function registered as {name!r}")
         func_id = func_id.decode() if isinstance(func_id, bytes) else func_id
-        args = [TaskArg(is_ref=False, data=serialize(a).to_bytes())
-                for a in msg.get("args", [])]
+        args = []
+        for a in msg.get("args", []):
+            if (isinstance(a, dict) and set(a) == {"__ref__"}
+                    and isinstance(a["__ref__"], str)
+                    and len(a["__ref__"]) == 28
+                    and all(c in "0123456789abcdef"
+                            for c in a["__ref__"])):
+                # Cross-language ObjectRef marker: a real ref arg, so
+                # the executing worker pulls the value from the object
+                # plane (zero JSON round-trip for plasma values).
+                args.append(TaskArg(is_ref=True, object_hex=a["__ref__"]))
+            else:
+                args.append(TaskArg(is_ref=False,
+                                    data=serialize(a).to_bytes()))
         return_id = OID.from_random()
         owner = conn.meta.get("worker_hex", "")
         spec = TaskSpec(

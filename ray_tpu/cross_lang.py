@@ -25,12 +25,87 @@ from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_ref import ObjectRef
 
 
+import threading as _threading
+
+_EXPORT_LOCK = _threading.Lock()
+_EXPORTED: set = set()
+
+
 def _client():
     return _runtime_mod.get_runtime().kv()
 
 
 def _ref_of(obj_hex: str) -> ObjectRef:
     return ObjectRef(ObjectID.from_hex(obj_hex))
+
+
+def export_ref(ref: ObjectRef) -> None:
+    """Make an ObjectRef's value resolvable through the cluster object
+    directory (get_object_json / cross-language ref args).
+
+    Owner-direct task results live with their owner, invisible to the
+    GCS directory; a ref crossing the language boundary must be
+    published there for the callee to resolve it.  Non-blocking: a
+    PENDING ref publishes from a background thread the moment the
+    local value materializes (the C++ side's bounded await covers the
+    gap).  cross_lang call wrappers do this automatically; raw
+    JSON-door users passing {"__ref__": hex} markers themselves must
+    call it explicitly."""
+    import threading
+
+    from ray_tpu.core import api as _api
+    from ray_tpu.core.serialization import serialize
+
+    obj_hex = ref.hex()
+    with _EXPORT_LOCK:
+        if obj_hex in _EXPORTED:
+            return  # idempotent: one publish per ref per driver
+        _EXPORTED.add(obj_hex)
+    # The directory entry must exist BEFORE the marker reaches the
+    # callee: get_object_json answers "pending" for a registered entry
+    # (callee awaits) but "object not found" for an unknown one
+    # (callee errors out).
+    _client().call({"op": "register_objects", "objs": [obj_hex]})
+
+    def _publish():
+        try:
+            value = _api.get(ref)
+            is_error = False
+        except Exception as e:  # noqa: BLE001 — failed producer
+            # The failure must reach the directory too, or the entry
+            # stays PENDING forever and the callee can only time out
+            # with the producer's error lost.
+            value, is_error = e, True
+        try:
+            data = serialize(value).to_bytes()
+            _client().call({"op": "put_object", "obj": obj_hex,
+                            "size": len(data), "inline": data,
+                            "is_error": is_error})
+        except Exception:
+            with _EXPORT_LOCK:
+                _EXPORTED.discard(obj_hex)  # allow a retry
+
+    threading.Thread(target=_publish, daemon=True,
+                     name=f"export-ref-{obj_hex[:8]}").start()
+
+
+def _wire_args(args) -> List[Any]:
+    """Wire form of cross-language call args: ObjectRefs become
+    {"__ref__": hex} markers (the reference passes refs across
+    languages the same way — by id, resolved callee-side), and each
+    ref is exported to the cluster directory (export_ref) so the
+    callee can resolve it.  The C++ worker resolves markers via
+    get_object_json before dispatch (worker.h ResolveRefArgs); the
+    Python named-function path turns them into real TaskArg refs
+    (gcs _op_submit_named_task)."""
+    out: List[Any] = []
+    for a in args:
+        if isinstance(a, ObjectRef):
+            export_ref(a)
+            out.append({"__ref__": a.hex()})
+        else:
+            out.append(a)
+    return out
 
 
 class CppFunction:
@@ -42,7 +117,7 @@ class CppFunction:
     def remote(self, *args: Any) -> ObjectRef:
         obj_hex = _client().call({
             "op": "submit_named_task", "name": self._name,
-            "args": list(args)})
+            "args": _wire_args(args)})
         return _ref_of(obj_hex)
 
 
@@ -58,7 +133,7 @@ class CppActorMethod:
     def remote(self, *args: Any) -> ObjectRef:
         obj_hex = _client().call({
             "op": "submit_cpp_actor_task", "instance": self._instance,
-            "method": self._method, "args": list(args)})
+            "method": self._method, "args": _wire_args(args)})
         return _ref_of(obj_hex)
 
 
@@ -82,7 +157,7 @@ class CppActorClass:
     def remote(self, *args: Any) -> CppActorHandle:
         reply = _client().call({
             "op": "create_cpp_actor", "actor_class": self._name,
-            "args": list(args)})
+            "args": _wire_args(args)})
         return CppActorHandle(reply["instance"],
                               _ref_of(reply["ready_obj"]))
 
@@ -98,4 +173,4 @@ def registered_cpp_functions() -> List[str]:
 
 
 __all__ = ["cpp_function", "cpp_actor_class", "CppFunction",
-           "CppActorClass", "registered_cpp_functions"]
+           "CppActorClass", "registered_cpp_functions", "export_ref"]

@@ -119,9 +119,11 @@ class EnvRunnerGroup:
                           for i in ok_indices]
             deadline = _time.monotonic() + 5.0
             for i, ref in state_refs:
-                budget = deadline - _time.monotonic()
-                if budget <= 0:
-                    break
+                # Past the shared deadline, still poll the remaining
+                # refs with a near-zero timeout: ready ones cost ~0 and
+                # must not be discarded because an EARLIER runner ate
+                # the budget (per-ref isolation).
+                budget = max(0.05, deadline - _time.monotonic())
                 try:
                     self._connector_states[i] = ray_tpu.get(
                         ref, timeout=budget)
