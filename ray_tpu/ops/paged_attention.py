@@ -397,20 +397,80 @@ def write_page_tokens(k_pages, v_pages, k_new, v_new, block_tables,
     return k_pages, v_pages
 
 
-def _row_write_kernel(pages_ref, strips_ref, rows_ref, kin_ref, vin_ref,
-                      knew_ref, vnew_ref, ok_ref, ov_ref):
-    """Read-modify-write one sublane strip: carry the strip through and
-    overwrite row rows[b] (the offset within the strip) with the new
-    token's fused-head K/V row."""
-    del pages_ref, strips_ref
-    b = pl.program_id(0)
-    row = rows_ref[b]
-    _, strip, kd = ok_ref.shape
-    strip_pos = jax.lax.broadcasted_iota(jnp.int32, (1, strip, kd), 1)
-    k_row = knew_ref[...]                      # [1, 1, KD] -> broadcast
-    v_row = vnew_ref[...]
-    ok_ref[...] = jnp.where(strip_pos == row, k_row, kin_ref[...])
-    ov_ref[...] = jnp.where(strip_pos == row, v_row, vin_ref[...])
+def _row_write_kernel(pages_ref, strips_ref, rows_ref, kf_ref, vf_ref,
+                      knew_ref, vnew_ref, ok_ref, ov_ref, k_buf, v_buf,
+                      sems, *, SB: int, strip: int, kd: int):
+    """SB-batched read-modify-write: each grid step streams SB
+    (page, strip) sublane strips in with manual DMAs, overwrites row
+    rows[b] of each with the new token's fused-head K/V row, and
+    streams them back.  One strip per grid step (the r4 shape) cost
+    ~0.35 us of grid overhead per strip — 2,816 steps per decode
+    iteration at B=128 x 22 layers ≈ 1 ms/iter; SB strips per step
+    amortize it and keep 2*SB DMAs in flight each way.
+
+    Aliased outputs (ok/ov are kf/vf) make the write genuinely in
+    place.  Concurrent write-back order is NOT defined, which is safe
+    because duplicate (page, strip) targets cannot carry different
+    live data: each decode slot writes its own private generation
+    page (shared prefix-cache pages are full, immutable prompt pages
+    no decode position maps to), the clamped tail duplicates rewrite
+    row B-1's identical strip, and dropped rows (position < 0) all
+    land in the reserved never-read scratch page."""
+    g = pl.program_id(0)
+
+    def row_at(s):
+        return g * SB + s  # SB divides the batch (wrapper guarantees)
+
+    # Phase 1: pull all SB strips into VMEM.
+    for s in range(SB):
+        b = row_at(s)
+        pltpu.make_async_copy(
+            kf_ref.at[pages_ref[b], pl.ds(strips_ref[b] * strip, strip)],
+            k_buf.at[s], sems.at[0]).start()
+        pltpu.make_async_copy(
+            vf_ref.at[pages_ref[b], pl.ds(strips_ref[b] * strip, strip)],
+            v_buf.at[s], sems.at[1]).start()
+    for s in range(SB):
+        b = row_at(s)
+        pltpu.make_async_copy(
+            kf_ref.at[pages_ref[b], pl.ds(strips_ref[b] * strip, strip)],
+            k_buf.at[s], sems.at[0]).wait()
+        pltpu.make_async_copy(
+            vf_ref.at[pages_ref[b], pl.ds(strips_ref[b] * strip, strip)],
+            v_buf.at[s], sems.at[1]).wait()
+    # Phase 2: overwrite each strip's target row.
+    strip_pos = jax.lax.broadcasted_iota(jnp.int32, (strip, kd), 0)
+    for s in range(SB):
+        b = row_at(s)
+        # knew/vnew arrive as this grid step's (SB, KD) block, so the
+        # row index is STATIC (Mosaic cannot prove alignment of a
+        # dynamic sublane load).
+        k_buf[s] = jnp.where(strip_pos == rows_ref[b],
+                             knew_ref[s], k_buf[s])
+        v_buf[s] = jnp.where(strip_pos == rows_ref[b],
+                             vnew_ref[s], v_buf[s])
+    # Phase 3: write back (order undefined; see docstring for why
+    # duplicate targets never carry different live data).
+    for s in range(SB):
+        b = row_at(s)
+        pltpu.make_async_copy(
+            k_buf.at[s],
+            ok_ref.at[pages_ref[b], pl.ds(strips_ref[b] * strip, strip)],
+            sems.at[0]).start()
+        pltpu.make_async_copy(
+            v_buf.at[s],
+            ov_ref.at[pages_ref[b], pl.ds(strips_ref[b] * strip, strip)],
+            sems.at[1]).start()
+    for s in range(SB):
+        b = row_at(s)
+        pltpu.make_async_copy(
+            k_buf.at[s],
+            ok_ref.at[pages_ref[b], pl.ds(strips_ref[b] * strip, strip)],
+            sems.at[0]).wait()
+        pltpu.make_async_copy(
+            v_buf.at[s],
+            ov_ref.at[pages_ref[b], pl.ds(strips_ref[b] * strip, strip)],
+            sems.at[1]).wait()
 
 
 def write_token_rows(k_pages, v_pages, k_new, v_new, block_tables,
@@ -454,22 +514,34 @@ def write_token_rows(k_pages, v_pages, k_new, v_new, block_tables,
     strips = (offs // strip).astype(jnp.int32)
     rows = (offs % strip).astype(jnp.int32)
 
-    cache_spec = pl.BlockSpec(
-        (1, strip, KD),
-        lambda b, pages, strips, rows: (pages[b], strips[b], 0))
-    # [B, 1, KD] with block (1, 1, KD): the singleton middle dim keeps
-    # the trailing two block dims equal to the array dims (a Mosaic
-    # tiling requirement a flat [B, KD] row block would violate).
-    new_spec = pl.BlockSpec((1, 1, KD),
-                            lambda b, pages, strips, rows: (b, 0, 0))
+    if B == 0:  # empty batch traces to an empty grid
+        return k_pages, v_pages
+    SB = min(16, B)
+    while B % SB:  # SB must divide B (static per-step knew blocks)
+        SB -= 1
+    grid = (B // SB,)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(B,),
-        in_specs=[cache_spec, cache_spec, new_spec, new_spec],
-        out_specs=[cache_spec, cache_spec],
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # k_pages (manual DMA)
+            pl.BlockSpec(memory_space=pl.ANY),  # v_pages
+            pl.BlockSpec((SB, KD),
+                         lambda g, pages, strips, rows: (g, 0)),
+            pl.BlockSpec((SB, KD),
+                         lambda g, pages, strips, rows: (g, 0)),
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY),
+                   pl.BlockSpec(memory_space=pl.ANY)],
+        scratch_shapes=[
+            pltpu.VMEM((SB, strip, KD), k_pages.dtype),
+            pltpu.VMEM((SB, strip, KD), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
     )
     kernel = pl.pallas_call(
-        _row_write_kernel,
+        functools.partial(_row_write_kernel, SB=SB, strip=strip,
+                          kd=KD),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
                    jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)],
@@ -479,7 +551,7 @@ def write_token_rows(k_pages, v_pages, k_new, v_new, block_tables,
         interpret=_platform() != "tpu",
     )
     return kernel(pages, strips, rows, k_pages, v_pages,
-                  k_new.reshape(B, 1, KD), v_new.reshape(B, 1, KD))
+                  k_new.reshape(B, KD), v_new.reshape(B, KD))
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables,
