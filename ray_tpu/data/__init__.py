@@ -10,11 +10,16 @@ from ray_tpu.data.block import Block, BlockAccessor, BlockMetadata
 from ray_tpu.data.dataset import (
     Dataset,
     from_arrow,
+    from_arrow_refs,
+    from_blocks,
     from_items,
     from_numpy,
+    from_numpy_refs,
     from_pandas,
+    from_pandas_refs,
     range,  # noqa: A004
     range_tensor,
+    read_avro,
     read_csv,
     read_datasource,
     read_json,
@@ -26,8 +31,23 @@ from ray_tpu.data.dataset import (
     read_sql,
     from_torch,
     read_parquet,
+    read_parquet_bulk,
+    read_webdataset,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.external import (
+    from_dask,
+    from_huggingface,
+    from_mars,
+    from_modin,
+    from_spark,
+    from_tf,
+    read_bigquery,
+    read_databricks_tables,
+    read_delta_sharing_tables,
+    read_lance,
+    read_mongo,
+)
 from ray_tpu.data.iterator import DataIterator
 from ray_tpu.data import preprocessors
 
@@ -41,14 +61,30 @@ __all__ = [
     "Datasource",
     "ReadTask",
     "from_arrow",
+    "from_arrow_refs",
+    "from_blocks",
+    "from_dask",
+    "from_huggingface",
     "from_items",
+    "from_mars",
+    "from_modin",
     "from_numpy",
+    "from_numpy_refs",
     "from_pandas",
+    "from_pandas_refs",
+    "from_spark",
+    "from_tf",
     "range",
     "range_tensor",
+    "read_avro",
+    "read_bigquery",
     "read_csv",
+    "read_databricks_tables",
     "read_datasource",
+    "read_delta_sharing_tables",
     "read_json",
+    "read_lance",
+    "read_mongo",
     "read_numpy",
     "read_text",
     "read_binary_files",
@@ -57,6 +93,8 @@ __all__ = [
     "read_sql",
     "from_torch",
     "read_parquet",
+    "read_parquet_bulk",
+    "read_webdataset",
 ]
 
 # Feature-usage tag (util/usage_stats.py; local-only, no egress).

@@ -1,0 +1,445 @@
+"""Avro Object Container File codec — pure Python, no avro/fastavro dep.
+
+Counterpart of the reference's read_api.read_avro +
+python/ray/data/_internal/datasource/avro_datasource.py, which delegate to
+the `avro` package.  The image is air-gapped, so (like data/tfrecords.py
+for tf.train.Example) the container format and binary encoding are
+implemented in-tree from the Avro 1.11 spec: zigzag-varint longs, the
+`Obj\\x01` container header with a metadata map carrying the writer
+schema JSON and codec, deflate (raw zlib) or null block compression, and
+16-byte sync markers between blocks.
+
+Supported schema types: null, boolean, int, long, float, double, bytes,
+string, fixed, enum, array, map, union, record (including named-type
+references and nesting).  Logical types decode as their base type, which
+matches what the reference hands to Arrow.
+
+The writer exists so tests and users can round-trip without the avro
+package; `infer_schema` derives a record schema from sample rows.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+_DEFAULT_BLOCK_ROWS = 4096
+
+
+# ---------------------------------------------------------------------------
+# Primitive binary encoding
+# ---------------------------------------------------------------------------
+
+
+def _write_long(out: io.BytesIO, n: int) -> None:
+    n = (n << 1) ^ (n >> 63)  # zigzag
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes([b | 0x80]))
+        else:
+            out.write(bytes([b]))
+            return
+
+
+def _read_long(buf: io.BytesIO) -> int:
+    shift, acc = 0, 0
+    while True:
+        raw = buf.read(1)
+        if not raw:
+            raise EOFError("truncated varint")
+        b = raw[0]
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1)  # un-zigzag
+
+
+def _write_bytes(out: io.BytesIO, data: bytes) -> None:
+    _write_long(out, len(data))
+    out.write(data)
+
+
+def _read_bytes(buf: io.BytesIO) -> bytes:
+    n = _read_long(buf)
+    data = buf.read(n)
+    if len(data) != n:
+        raise EOFError("truncated bytes")
+    return data
+
+
+# ---------------------------------------------------------------------------
+# Schema-driven datum codec
+# ---------------------------------------------------------------------------
+
+
+class _Names:
+    """Registry of named types (record/enum/fixed) for reference resolution."""
+
+    def __init__(self) -> None:
+        self.types: Dict[str, Any] = {}
+
+    def register(self, schema: Dict[str, Any]) -> None:
+        name = schema.get("name")
+        if name:
+            ns = schema.get("namespace")
+            full = f"{ns}.{name}" if ns and "." not in name else name
+            self.types[full] = schema
+            self.types[name.rsplit(".", 1)[-1]] = schema
+
+    def resolve(self, schema: Any) -> Any:
+        if isinstance(schema, str) and schema in self.types:
+            return self.types[schema]
+        return schema
+
+
+_PRIMITIVES = {"null", "boolean", "int", "long", "float", "double",
+               "bytes", "string"}
+
+
+def _decode(schema: Any, buf: io.BytesIO, names: _Names) -> Any:
+    schema = names.resolve(schema)
+    if isinstance(schema, list):  # union: long index then value
+        idx = _read_long(buf)
+        if not 0 <= idx < len(schema):
+            raise ValueError(f"union index {idx} out of range")
+        return _decode(schema[idx], buf, names)
+    if isinstance(schema, str):
+        t = schema
+    else:
+        t = schema["type"]
+        if isinstance(t, (list, dict)):  # e.g. {"type": [...]} wrapper
+            return _decode(t, buf, names)
+    if t == "null":
+        return None
+    if t == "boolean":
+        raw = buf.read(1)
+        if not raw:
+            raise EOFError("truncated boolean")
+        return raw[0] != 0
+    if t in ("int", "long"):
+        return _read_long(buf)
+    if t == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if t == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if t == "bytes":
+        return _read_bytes(buf)
+    if t == "string":
+        return _read_bytes(buf).decode("utf-8")
+    if t == "fixed":
+        names.register(schema)
+        data = buf.read(schema["size"])
+        if len(data) != schema["size"]:
+            raise EOFError("truncated fixed")
+        return data
+    if t == "enum":
+        names.register(schema)
+        return schema["symbols"][_read_long(buf)]
+    if t == "array":
+        out: List[Any] = []
+        while True:
+            count = _read_long(buf)
+            if count == 0:
+                return out
+            if count < 0:  # negative: byte size follows (skippable form)
+                count = -count
+                _read_long(buf)
+            for _ in range(count):
+                out.append(_decode(schema["items"], buf, names))
+    if t == "map":
+        m: Dict[str, Any] = {}
+        while True:
+            count = _read_long(buf)
+            if count == 0:
+                return m
+            if count < 0:
+                count = -count
+                _read_long(buf)
+            for _ in range(count):
+                key = _read_bytes(buf).decode("utf-8")
+                m[key] = _decode(schema["values"], buf, names)
+    if t == "record":
+        names.register(schema)
+        return {f["name"]: _decode(f["type"], buf, names)
+                for f in schema["fields"]}
+    raise ValueError(f"unsupported avro type {t!r}")
+
+
+def _encode(schema: Any, datum: Any, out: io.BytesIO, names: _Names) -> None:
+    schema = names.resolve(schema)
+    if isinstance(schema, list):  # union: first branch the datum fits
+        for idx, branch in enumerate(schema):
+            if _union_match(names.resolve(branch), datum):
+                _write_long(out, idx)
+                _encode(branch, datum, out, names)
+                return
+        raise TypeError(f"{datum!r} matches no union branch {schema!r}")
+    t = schema if isinstance(schema, str) else schema["type"]
+    if isinstance(t, (list, dict)):
+        _encode(t, datum, out, names)
+        return
+    if t == "null":
+        return
+    if t == "boolean":
+        out.write(b"\x01" if datum else b"\x00")
+    elif t in ("int", "long"):
+        _write_long(out, int(datum))
+    elif t == "float":
+        out.write(struct.pack("<f", float(datum)))
+    elif t == "double":
+        out.write(struct.pack("<d", float(datum)))
+    elif t == "bytes":
+        _write_bytes(out, bytes(datum))
+    elif t == "string":
+        _write_bytes(out, str(datum).encode("utf-8"))
+    elif t == "fixed":
+        names.register(schema)
+        if len(datum) != schema["size"]:
+            raise ValueError("fixed size mismatch")
+        out.write(bytes(datum))
+    elif t == "enum":
+        names.register(schema)
+        _write_long(out, schema["symbols"].index(datum))
+    elif t == "array":
+        if datum:
+            _write_long(out, len(datum))
+            for item in datum:
+                _encode(schema["items"], item, out, names)
+        _write_long(out, 0)
+    elif t == "map":
+        if datum:
+            _write_long(out, len(datum))
+            for key, val in datum.items():
+                _write_bytes(out, str(key).encode("utf-8"))
+                _encode(schema["values"], val, out, names)
+        _write_long(out, 0)
+    elif t == "record":
+        names.register(schema)
+        for f in schema["fields"]:
+            if f["name"] in datum:
+                _encode(f["type"], datum[f["name"]], out, names)
+            elif "default" in f:
+                _encode(f["type"], f["default"], out, names)
+            elif isinstance(f["type"], list) and "null" in f["type"]:
+                _encode(f["type"], None, out, names)  # nullable: null branch
+            else:
+                raise KeyError(f"record field {f['name']!r} missing")
+    else:
+        raise ValueError(f"unsupported avro type {t!r}")
+
+
+def _union_match(schema: Any, datum: Any) -> bool:
+    t = schema if isinstance(schema, str) else schema.get("type")
+    if t == "null":
+        return datum is None
+    if t == "boolean":
+        return isinstance(datum, bool)
+    if t in ("int", "long"):
+        return isinstance(datum, int) and not isinstance(datum, bool)
+    if t in ("float", "double"):
+        return isinstance(datum, (int, float)) and not isinstance(datum, bool)
+    if t in ("bytes", "fixed"):
+        return isinstance(datum, (bytes, bytearray))
+    if t in ("string", "enum"):
+        return isinstance(datum, str)
+    if t == "array":
+        return isinstance(datum, (list, tuple))
+    if t in ("map", "record"):
+        return isinstance(datum, dict)
+    return True  # named reference: optimistic
+
+
+# ---------------------------------------------------------------------------
+# Container file read/write
+# ---------------------------------------------------------------------------
+
+
+def read_file(path: str) -> Iterator[Dict[str, Any]]:
+    """Yield records (dicts for record schemas) from one .avro OCF.
+
+    Streams block by block from the open handle — only one
+    (decompressed) block lives in memory at a time, so multi-GB files
+    don't double-buffer through the read task."""
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path}: not an Avro object container file")
+        meta: Dict[str, bytes] = {}
+        while True:
+            count = _read_long(f)
+            if count == 0:
+                break
+            if count < 0:
+                count = -count
+                _read_long(f)
+            for _ in range(count):
+                key = _read_bytes(f).decode("utf-8")
+                meta[key] = _read_bytes(f)
+        schema = json.loads(meta["avro.schema"].decode("utf-8"))
+        codec = meta.get("avro.codec", b"null").decode("utf-8")
+        if codec not in ("null", "deflate"):
+            raise ValueError(f"{path}: unsupported avro codec {codec!r}")
+        sync = f.read(SYNC_SIZE)
+        names = _Names()
+        while True:
+            if not f.read(1):  # EOF probe
+                return
+            f.seek(-1, 1)
+            n_records = _read_long(f)
+            block = f.read(_read_long(f))
+            if codec == "deflate":
+                block = zlib.decompress(block, -15)
+            bbuf = io.BytesIO(block)
+            for _ in range(n_records):
+                yield _decode(schema, bbuf, names)
+            marker = f.read(SYNC_SIZE)
+            if marker != sync:
+                raise ValueError(
+                    f"{path}: sync marker mismatch (corrupt block)")
+
+
+def write_file(path: str, schema: Dict[str, Any],
+               records: Iterable[Any], *, codec: str = "null",
+               block_rows: int = _DEFAULT_BLOCK_ROWS) -> None:
+    """Write records under `schema` as one OCF (codec: null|deflate)."""
+    if codec not in ("null", "deflate"):
+        raise ValueError(f"unsupported avro codec {codec!r}")
+    # Deterministic sync marker derived from the schema: no RNG needed,
+    # uniqueness across files is irrelevant for single-file integrity.
+    sync = zlib.crc32(json.dumps(schema, sort_keys=True).encode())
+    sync = struct.pack("<IIII", sync, ~sync & 0xFFFFFFFF, 0x5A5A5A5A,
+                       sync ^ 0xFFFF0000)
+    names = _Names()
+    with open(path, "wb") as f:
+        head = io.BytesIO()
+        head.write(MAGIC)
+        meta = {"avro.schema": json.dumps(schema).encode(),
+                "avro.codec": codec.encode()}
+        _write_long(head, len(meta))
+        for key, val in meta.items():
+            _write_bytes(head, key.encode())
+            _write_bytes(head, val)
+        _write_long(head, 0)
+        head.write(sync)
+        f.write(head.getvalue())
+
+        batch: List[Any] = []
+
+        def flush() -> None:
+            if not batch:
+                return
+            body = io.BytesIO()
+            for rec in batch:
+                _encode(schema, rec, body, names)
+            payload = body.getvalue()
+            if codec == "deflate":
+                comp = zlib.compressobj(wbits=-15)
+                payload = comp.compress(payload) + comp.flush()
+            out = io.BytesIO()
+            _write_long(out, len(batch))
+            _write_bytes(out, payload)
+            out.write(sync)
+            f.write(out.getvalue())
+            batch.clear()
+
+        for rec in records:
+            batch.append(rec)
+            if len(batch) >= block_rows:
+                flush()
+        flush()
+
+
+def infer_schema(rows: Iterable[Dict[str, Any]],
+                 name: str = "row") -> Dict[str, Any]:
+    """Record schema from sample rows; fields missing in some rows become
+    nullable unions.  Matches the subset `_encode` can write."""
+    fields: Dict[str, Any] = {}
+    seen: Dict[str, int] = {}
+    nullable: set = set()
+    n = 0
+    for row in rows:
+        n += 1
+        for key, val in row.items():
+            seen[key] = seen.get(key, 0) + 1
+            t = _infer_type(val)
+            if t == "null":
+                nullable.add(key)
+                continue
+            prev = fields.get(key)
+            if prev is None:
+                fields[key] = t
+            elif prev != t:
+                fields[key] = _merge_types(prev, t)
+    out_fields = []
+    for key in seen:
+        t = fields.get(key, "string")  # all-null column
+        if seen[key] < n or key in nullable:
+            if not isinstance(t, list):
+                t = ["null", t]
+            elif "null" not in t:
+                t = ["null", *t]
+        out_fields.append({"name": key, "type": t})
+    return {"type": "record", "name": name, "fields": out_fields}
+
+
+def _s(t: Any) -> str:
+    """Canonical string key for union dedup/sort (NOT a schema value)."""
+    return t if isinstance(t, str) else json.dumps(t, sort_keys=True)
+
+
+def _merge_types(prev: Any, t: Any) -> Any:
+    """Union-merge two inferred types, keeping real schema values (dicts
+    stay dicts); int/long widen into double rather than forming a union."""
+    branches = list(prev) if isinstance(prev, list) else [prev]
+    if not isinstance(t, list):
+        for i, b in enumerate(branches):
+            if _s(b) == _s(t):
+                return prev
+            if b in ("int", "long") and t == "double":
+                branches[i] = "double"
+                return branches if len(branches) > 1 else "double"
+            if t in ("int", "long") and b == "double":
+                return prev
+        branches.append(t)
+    else:
+        seen = {_s(b) for b in branches}
+        branches.extend(b for b in t if _s(b) not in seen)
+    branches.sort(key=_s)
+    return branches
+
+
+def _infer_type(val: Any) -> Any:
+    import numpy as np
+
+    if val is None:
+        return "null"
+    if isinstance(val, (bool, np.bool_)):
+        return "boolean"
+    if isinstance(val, (int, np.integer)):
+        return "long"
+    if isinstance(val, (float, np.floating)):
+        return "double"
+    if isinstance(val, (bytes, bytearray)):
+        return "bytes"
+    if isinstance(val, str):
+        return "string"
+    if isinstance(val, np.ndarray):
+        item = ("long" if np.issubdtype(val.dtype, np.integer)
+                else "double")
+        return {"type": "array", "items": item}
+    if isinstance(val, (list, tuple)):
+        inner = _infer_type(val[0]) if len(val) else "string"
+        return {"type": "array", "items": inner}
+    if isinstance(val, dict):
+        inner = (_infer_type(next(iter(val.values())))
+                 if val else "string")
+        return {"type": "map", "values": inner}
+    raise TypeError(f"cannot infer avro type for {type(val).__name__}")

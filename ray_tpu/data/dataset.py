@@ -331,6 +331,20 @@ class Dataset:
 
         return self._write(write_block_tfrecords, path)
 
+    def write_avro(self, path: str) -> List[str]:
+        """Avro Object Container Files, deflate codec, schema inferred
+        per block; no avro package needed (data/avro.py)."""
+        from ray_tpu.data.datasource import write_block_avro
+
+        return self._write(write_block_avro, path)
+
+    def write_webdataset(self, path: str) -> List[str]:
+        """One WebDataset tar shard per block; column names become the
+        member suffixes (reference webdataset_datasink.py)."""
+        from ray_tpu.data.datasource import write_block_webdataset
+
+        return self._write(write_block_webdataset, path)
+
     def to_pandas(self):
         return concat_blocks(
             list(self.iter_internal_blocks())).to_pandas()
@@ -684,3 +698,68 @@ def from_torch(torch_dataset, *, column: str = "item",
     return read_datasource(
         TorchDatasource(torch_dataset, column=column),
         parallelism=parallelism)
+
+
+def read_parquet_bulk(paths, *, columns=None,
+                      parallelism: int = -1) -> Dataset:
+    """Many small parquet files without per-file metadata probing on the
+    driver (reference read_api.read_parquet_bulk /
+    parquet_bulk_datasource.py): identical read path to read_parquet —
+    our planner never probes footers driver-side — so this is the same
+    datasource with the bulk name kept for API parity."""
+    return read_parquet(paths, columns=columns, parallelism=parallelism)
+
+
+def read_avro(paths, *, parallelism: int = -1) -> Dataset:
+    """One row per Avro record, columns from the writer schema's record
+    fields; no avro package needed (data/avro.py; reference
+    read_api.read_avro)."""
+    from ray_tpu.data.datasource import AvroDatasource
+
+    return read_datasource(AvroDatasource(paths), parallelism=parallelism)
+
+
+def read_webdataset(paths, *, suffixes=None, decoder=True,
+                    parallelism: int = -1) -> Dataset:
+    """WebDataset tar shards → one row per sample with "__key__" plus a
+    column per member suffix (reference read_api.read_webdataset)."""
+    from ray_tpu.data.datasource import WebDatasetDatasource
+
+    return read_datasource(
+        WebDatasetDatasource(paths, suffixes=suffixes, decoder=decoder),
+        parallelism=parallelism)
+
+
+def from_blocks(blocks) -> Dataset:
+    """Dataset over already-built blocks (reference from_blocks)."""
+    from ray_tpu.data.datasource import BlocksDatasource
+
+    return read_datasource(BlocksDatasource(list(blocks)))
+
+
+def from_arrow_refs(refs) -> Dataset:
+    """Dataset over ObjectRefs of arrow Tables; refs resolve inside the
+    read tasks, not on the driver (reference from_arrow_refs)."""
+    from ray_tpu.data.datasource import RefBlocksDatasource
+
+    return read_datasource(RefBlocksDatasource(_listify(refs)))
+
+
+def from_pandas_refs(refs) -> Dataset:
+    """Dataset over ObjectRefs of pandas DataFrames (reference
+    from_pandas_refs)."""
+    from ray_tpu.data.datasource import RefBlocksDatasource
+
+    return read_datasource(RefBlocksDatasource(_listify(refs)))
+
+
+def from_numpy_refs(refs, column: str = "data") -> Dataset:
+    """Dataset over ObjectRefs of ndarrays (reference from_numpy_refs)."""
+    from ray_tpu.data.datasource import RefBlocksDatasource
+
+    return read_datasource(
+        RefBlocksDatasource(_listify(refs), column=column))
+
+
+def _listify(refs):
+    return list(refs) if isinstance(refs, (list, tuple)) else [refs]

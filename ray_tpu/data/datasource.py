@@ -491,6 +491,255 @@ def _rows_to_block(rows: List[dict]) -> Block:
     return batch_to_block(arrays)
 
 
+class AvroDatasource(FileDatasource):
+    """Avro Object Container Files without the avro package
+    (data/avro.py; reference read_api.read_avro +
+    _internal/datasource/avro_datasource.py)."""
+
+    def __init__(self, paths, *, batch_rows: int = 4096):
+        super().__init__(paths)
+        self._batch_rows = batch_rows
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        from ray_tpu.data import avro
+
+        rows: List[dict] = []
+        for rec in avro.read_file(path):
+            rows.append(rec if isinstance(rec, dict) else {"value": rec})
+            if len(rows) >= self._batch_rows:
+                yield _rows_to_block(rows)
+                rows = []
+        if rows:
+            yield _rows_to_block(rows)
+
+
+def write_block_avro(block: Block, path: str, index: int) -> str:
+    from ray_tpu.data import avro
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.avro")
+    rows = [_avro_safe(r) for r in BlockAccessor(block).iter_rows()]
+    avro.write_file(out, avro.infer_schema(rows), rows, codec="deflate")
+    return out
+
+
+def _avro_safe(row: dict) -> dict:
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, np.generic):
+            v = v.item()
+        elif isinstance(v, np.ndarray):
+            v = v.tolist()
+        out[k] = v
+    return out
+
+
+# ---------------------------------------------------------------------------
+# WebDataset (tar shards of grouped files)
+# ---------------------------------------------------------------------------
+
+# Suffix decoders, outermost match wins; mirrors the reference's
+# _default_decoder table (_internal/datasource/webdataset_datasource.py)
+# minus the imageio/torch branches (PIL covers images here).
+_WDS_TEXT = ("txt", "text", "transcript")
+_WDS_INT = ("cls", "cls2", "index", "count")
+_WDS_JSON = ("json", "jsn")
+_WDS_IMAGE = ("jpg", "jpeg", "png", "ppm", "pgm", "pbm", "bmp")
+
+
+def _wds_decode(suffix: str, data: bytes) -> Any:
+    ext = suffix.rsplit(".", 1)[-1].lower()
+    if ext in _WDS_TEXT:
+        return data.decode("utf-8")
+    if ext in _WDS_INT:
+        return int(data.decode("utf-8").strip())
+    if ext in _WDS_JSON:
+        import json
+
+        return json.loads(data)
+    if ext == "npy":
+        import io
+
+        return np.load(io.BytesIO(data), allow_pickle=False)
+    if ext in _WDS_IMAGE:
+        import io
+
+        from PIL import Image
+
+        with Image.open(io.BytesIO(data)) as im:
+            return np.asarray(im)
+    return data  # raw bytes for unknown suffixes
+
+
+def _wds_encode(suffix: str, value: Any) -> bytes:
+    ext = suffix.rsplit(".", 1)[-1].lower()
+    if isinstance(value, np.generic):
+        value = value.item()
+    if ext in _WDS_TEXT:
+        return str(value).encode("utf-8")
+    if ext in _WDS_INT:
+        return str(int(value)).encode("utf-8")
+    if ext in _WDS_JSON:
+        import json
+
+        return json.dumps(value).encode("utf-8")
+    if ext == "npy":
+        import io
+
+        bio = io.BytesIO()
+        np.save(bio, np.asarray(value), allow_pickle=False)
+        return bio.getvalue()
+    if ext in _WDS_IMAGE:
+        import io
+
+        from PIL import Image
+
+        bio = io.BytesIO()
+        Image.fromarray(np.asarray(value)).save(
+            bio, format="PNG" if ext == "png" else "JPEG")
+        return bio.getvalue()
+    if isinstance(value, (bytes, bytearray)):
+        return bytes(value)
+    return str(value).encode("utf-8")
+
+
+class WebDatasetDatasource(FileDatasource):
+    """WebDataset tar shards: members sharing a basename form one sample;
+    each extension becomes a column, plus "__key__" (reference
+    read_api.read_webdataset / webdataset_datasource.py).  `suffixes`
+    keeps only matching extensions (fnmatch patterns); `decoder=False`
+    leaves raw bytes."""
+
+    def __init__(self, paths, *, suffixes: Optional[Sequence[str]] = None,
+                 decoder: Any = True, batch_rows: int = 256):
+        super().__init__(paths)
+        self._suffixes = list(suffixes) if suffixes else None
+        self._decoder = decoder
+        self._batch_rows = batch_rows
+
+    def _keep(self, suffix: str) -> bool:
+        import fnmatch
+
+        if self._suffixes is None:
+            return True
+        return any(fnmatch.fnmatch(suffix, pat) or
+                   fnmatch.fnmatch(suffix.rsplit(".", 1)[-1], pat)
+                   for pat in self._suffixes)
+
+    def _read_file(self, path: str) -> Iterator[Block]:
+        import tarfile
+
+        rows: List[dict] = []
+        current_key: Optional[str] = None
+        sample: Dict[str, Any] = {}
+        with tarfile.open(path, "r|*") as tar:
+            for member in tar:
+                if not member.isfile():
+                    continue
+                dirname, basename = os.path.split(member.name)
+                if "." not in basename:
+                    continue
+                # Key/suffix split on the BASENAME's first dot (the
+                # reference's _base_plus_ext): dotted directory names
+                # stay in the key.
+                stem, suffix = basename.split(".", 1)
+                base = os.path.join(dirname, stem) if dirname else stem
+                if base != current_key:
+                    if sample:
+                        rows.append(sample)
+                        if len(rows) >= self._batch_rows:
+                            yield _rows_to_block(rows)
+                            rows = []
+                    current_key, sample = base, {"__key__": base}
+                if not self._keep(suffix):
+                    continue
+                data = tar.extractfile(member).read()
+                if callable(self._decoder):
+                    sample[suffix] = self._decoder(suffix, data)
+                elif self._decoder:
+                    sample[suffix] = _wds_decode(suffix, data)
+                else:
+                    sample[suffix] = data
+        if sample:
+            rows.append(sample)
+        if rows:
+            yield _rows_to_block(rows)
+
+
+def write_block_webdataset(block: Block, path: str, index: int) -> str:
+    """One tar shard per block; column names are the member suffixes and
+    "__key__" (or the row index) names the sample (reference
+    webdataset_datasink.py)."""
+    import io
+    import tarfile
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.tar")
+    with tarfile.open(out, "w") as tar:
+        for i, row in enumerate(BlockAccessor(block).iter_rows()):
+            key = str(row.get("__key__", f"{index:05d}{i:07d}"))
+            for suffix, value in row.items():
+                # None = column absent in this row (ragged samples are
+                # normal in WebDataset): skip the member entirely.
+                if suffix == "__key__" or value is None:
+                    continue
+                payload = _wds_encode(suffix, value)
+                info = tarfile.TarInfo(name=f"{key}.{suffix}")
+                info.size = len(payload)
+                tar.addfile(info, io.BytesIO(payload))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# ObjectRef-backed blocks (from_arrow_refs / from_pandas_refs / ...)
+# ---------------------------------------------------------------------------
+
+
+class RefBlocksDatasource(Datasource):
+    """Blocks already living in the object store: each ReadTask resolves
+    one ObjectRef inside the task, so bytes move worker→worker without a
+    driver hop (reference read_api.from_arrow_refs / from_pandas_refs /
+    from_numpy_refs)."""
+
+    def __init__(self, refs: Sequence[Any], *, column: str = "data"):
+        self._refs = list(refs)
+        self._column = column
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        column = self._column
+        tasks = []
+        for ref in self._refs:
+            def fn(ref=ref) -> Iterator[Block]:
+                import ray_tpu
+
+                obj = ray_tpu.get(ref)
+                yield _coerce_block(obj, column)
+
+            tasks.append(ReadTask(fn, BlockMetadata(
+                num_rows=0, size_bytes=0)))
+        return tasks
+
+
+def _coerce_block(obj: Any, column: str) -> Block:
+    if isinstance(obj, pa.Table):
+        return obj
+    if isinstance(obj, np.ndarray):
+        return batch_to_block({column: obj})
+    try:
+        import pandas as pd
+
+        if isinstance(obj, pd.DataFrame):
+            return pa.Table.from_pandas(obj, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(obj, dict):
+        return batch_to_block(obj)
+    return rows_to_block(list(obj))
+
+
 def write_block_tfrecords(block: Block, path: str, index: int) -> str:
     from ray_tpu.data import tfrecords as tfr
     from ray_tpu.data.block import BlockAccessor

@@ -1,0 +1,373 @@
+"""External-system connectors: Lance, BigQuery, MongoDB, Delta Sharing,
+Databricks, Hugging Face, Dask, Spark, Modin, Mars, TensorFlow.
+
+Counterpart of the reference's read_api.read_lance / read_bigquery /
+read_mongo / read_delta_sharing_tables / read_databricks_tables and
+from_huggingface / from_dask / from_spark / from_modin / from_mars /
+from_tf (python/ray/data/read_api.py + _internal/datasource/).  None of
+the client libraries ship in the air-gapped image, so — exactly like
+tune/external_searchers.py — every reader maps the library's own
+protocol onto ReadTasks, takes a `_module=` injection point, raises a
+guiding ImportError when the package is absent, and is exercised
+against protocol-faithful stubs in tests; where the real package
+exists the same code activates unchanged.
+
+The `from_*` bridges are duck-typed on the stable public surface of
+each dataframe library (partitions → pandas), so they need no import
+at all — any object with the right methods works.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ray_tpu.data.block import batch_to_block
+from ray_tpu.data.datasource import (
+    BlockMetadata,
+    Datasource,
+    ReadTask,
+    _rows_to_block,
+)
+
+
+def _missing(pkg: str, hint: str) -> ImportError:
+    return ImportError(
+        f"{pkg} is not installed (pip install {pkg}); {hint}")
+
+
+def _import(name: str, pkg: str, hint: str, module):
+    if module is not None:
+        return module
+    try:
+        import importlib
+
+        return importlib.import_module(name)
+    except ImportError:
+        raise _missing(pkg, hint) from None
+
+
+# ---------------------------------------------------------------------------
+# Lance
+# ---------------------------------------------------------------------------
+
+
+class LanceDatasource(Datasource):
+    """Lance columnar datasets: one ReadTask per fragment, each task
+    re-opens the dataset and scans only its fragment (reference
+    _internal/datasource/lance_datasource.py)."""
+
+    def __init__(self, uri: str, *, columns: Optional[Sequence[str]] = None,
+                 filter: Optional[str] = None, _module=None):
+        self._lance = _import(
+            "lance", "pylance",
+            "read the data with read_parquet if it is also stored as "
+            "parquet", _module)
+        self._uri = uri
+        self._columns = list(columns) if columns else None
+        self._filter = filter
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        ds = self._lance.dataset(self._uri)
+        lance, uri = self._lance, self._uri
+        columns, filt = self._columns, self._filter
+        tasks = []
+        for frag in ds.get_fragments():
+            frag_id = frag.fragment_id
+
+            def fn(frag_id=frag_id):
+                inner = lance.dataset(uri)
+                fragment = next(
+                    f for f in inner.get_fragments()
+                    if f.fragment_id == frag_id)
+                yield fragment.to_table(columns=columns, filter=filt)
+
+            tasks.append(ReadTask(fn, BlockMetadata(
+                num_rows=0, size_bytes=0)))
+        return tasks or [ReadTask(
+            lambda: iter([ds.to_table(columns=columns, filter=filt)]),
+            BlockMetadata(num_rows=0, size_bytes=0))]
+
+
+# ---------------------------------------------------------------------------
+# BigQuery
+# ---------------------------------------------------------------------------
+
+
+class BigQueryDatasource(Datasource):
+    """BigQuery tables or SQL results via google-cloud-bigquery's arrow
+    surface (reference _internal/datasource/bigquery_datasource.py).
+    `dataset` is "dataset.table"; `query` overrides it."""
+
+    def __init__(self, project_id: str, *, dataset: Optional[str] = None,
+                 query: Optional[str] = None, _module=None):
+        if bool(dataset) == bool(query):
+            raise ValueError("exactly one of dataset= or query= required")
+        self._bq = _import(
+            "google.cloud.bigquery", "google-cloud-bigquery",
+            "export the table to parquet/avro and use read_parquet / "
+            "read_avro", _module)
+        self._project = project_id
+        self._dataset = dataset
+        self._query = query
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        bq, project = self._bq, self._project
+        dataset, query = self._dataset, self._query
+
+        def fn():
+            client = bq.Client(project=project)
+            if query:
+                result = client.query(query).result()
+            else:
+                result = client.list_rows(f"{project}.{dataset}")
+            yield result.to_arrow()
+
+        return [ReadTask(fn, BlockMetadata(num_rows=0, size_bytes=0))]
+
+
+# ---------------------------------------------------------------------------
+# MongoDB
+# ---------------------------------------------------------------------------
+
+
+class MongoDatasource(Datasource):
+    """MongoDB collections via an aggregation pipeline; the client opens
+    inside the read task (reference
+    _internal/datasource/mongo_datasource.py)."""
+
+    def __init__(self, uri: str, database: str, collection: str, *,
+                 pipeline: Optional[List[Dict[str, Any]]] = None,
+                 _module=None):
+        self._pymongo = _import(
+            "pymongo", "pymongo",
+            "export the collection to JSON and use read_json", _module)
+        self._uri = uri
+        self._database = database
+        self._collection = collection
+        self._pipeline = pipeline or [{"$match": {}}]
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        pymongo, uri = self._pymongo, self._uri
+        db, coll, pipeline = self._database, self._collection, self._pipeline
+
+        def fn():
+            client = pymongo.MongoClient(uri)
+            try:
+                rows = [
+                    {k: v for k, v in doc.items() if k != "_id"}
+                    for doc in client[db][coll].aggregate(pipeline)
+                ]
+            finally:
+                client.close()
+            if rows:
+                yield _rows_to_block(rows)
+
+        return [ReadTask(fn, BlockMetadata(num_rows=0, size_bytes=0))]
+
+
+# ---------------------------------------------------------------------------
+# Delta Sharing / Databricks
+# ---------------------------------------------------------------------------
+
+
+class DeltaSharingDatasource(Datasource):
+    """Delta Sharing table via the provider's pandas loader; the
+    download runs INSIDE the read task so the bytes land on a worker,
+    not the driver (reference read_api.read_delta_sharing_tables)."""
+
+    def __init__(self, url: str, *, limit: Optional[int] = None,
+                 version: Optional[int] = None, _module=None):
+        self._ds = _import(
+            "delta_sharing", "delta-sharing",
+            "ask the provider for a parquet export and use read_parquet",
+            _module)
+        self._url = url
+        self._limit = limit
+        self._version = version
+
+    def get_read_tasks(self, parallelism: int) -> List[ReadTask]:
+        ds, url = self._ds, self._url
+        limit, version = self._limit, self._version
+
+        def fn():
+            import pyarrow as pa
+
+            df = ds.load_as_pandas(url, limit=limit, version=version)
+            yield pa.Table.from_pandas(df, preserve_index=False)
+
+        return [ReadTask(fn, BlockMetadata(num_rows=0, size_bytes=0))]
+
+
+def read_delta_sharing_tables(url: str, *, limit: Optional[int] = None,
+                              version: Optional[int] = None,
+                              parallelism: int = -1, _module=None):
+    from ray_tpu.data import dataset as _d
+
+    return _d.read_datasource(
+        DeltaSharingDatasource(url, limit=limit, version=version,
+                               _module=_module),
+        parallelism=parallelism)
+
+
+def read_databricks_tables(*, warehouse_id: str, table: Optional[str] = None,
+                           query: Optional[str] = None,
+                           catalog: Optional[str] = None,
+                           schema: Optional[str] = None, _module=None):
+    """Databricks SQL warehouse → Dataset over the databricks-sql
+    connector's DB-API surface (reference
+    read_api.read_databricks_tables, which wraps the same REST/SQL
+    warehouse; host/token come from DATABRICKS_HOST / DATABRICKS_TOKEN
+    like the reference)."""
+    import os
+
+    dbsql = _import(
+        "databricks.sql", "databricks-sql-connector",
+        "export the table to parquet and use read_parquet", _module)
+    if bool(table) == bool(query):
+        raise ValueError("exactly one of table= or query= required")
+    if table:
+        qualified = ".".join(x for x in (catalog, schema, table) if x)
+        query = f"SELECT * FROM {qualified}"
+    host = os.environ.get("DATABRICKS_HOST", "")
+    token = os.environ.get("DATABRICKS_TOKEN", "")
+    from ray_tpu.data import dataset as _d
+
+    def factory():
+        return dbsql.connect(
+            server_hostname=host,
+            http_path=f"/sql/1.0/warehouses/{warehouse_id}",
+            access_token=token)
+
+    return _d.read_sql(query, factory)
+
+
+# ---------------------------------------------------------------------------
+# Dataframe-library bridges (duck-typed; no import needed)
+# ---------------------------------------------------------------------------
+
+
+def from_huggingface(hf_dataset):
+    """datasets.Dataset → Dataset, zero-copy through its arrow table
+    when exposed (reference read_api.from_huggingface).
+
+    A select/filter/shuffle/train_test_split leaves an `_indices`
+    mapping on the HF dataset while `.data` still exposes the
+    UNDERLYING table; the zero-copy path is only taken when no indices
+    mapping exists (the reference materializes through
+    with_format("arrow") for the same reason)."""
+    from ray_tpu.data import dataset as _d
+
+    data = getattr(hf_dataset, "data", None)
+    table = getattr(data, "table", None)
+    if table is not None and getattr(hf_dataset, "_indices", None) is None:
+        return _d.from_arrow(table.combine_chunks())
+    if hasattr(hf_dataset, "to_pandas"):
+        return _d.from_pandas(hf_dataset.to_pandas())
+    raise TypeError(
+        "from_huggingface expects a datasets.Dataset (with .data.table "
+        "or .to_pandas); for an IterableDataset, materialize it first")
+
+
+def from_dask(df):
+    """dask.dataframe → Dataset, one block per partition (reference
+    read_api.from_dask)."""
+    from ray_tpu.data import dataset as _d
+
+    if hasattr(df, "to_delayed"):
+        delayed = df.to_delayed()
+        try:
+            import dask
+
+            # One scheduler pass for the whole graph: per-partition
+            # .compute() would re-execute shared upstream tasks once
+            # per partition.
+            parts = list(dask.compute(*delayed))
+        except ImportError:  # duck-typed stand-ins without dask itself
+            parts = [p.compute() for p in delayed]
+        return _d.from_pandas(parts)
+    raise TypeError("from_dask expects a dask DataFrame (.to_delayed)")
+
+
+def from_spark(df):
+    """pyspark DataFrame → Dataset via toPandas (reference
+    read_api.from_spark; arrow-backed collect when spark enables it)."""
+    from ray_tpu.data import dataset as _d
+
+    if hasattr(df, "toPandas"):
+        return _d.from_pandas(df.toPandas())
+    raise TypeError("from_spark expects a pyspark DataFrame (.toPandas)")
+
+
+def from_modin(df):
+    """modin DataFrame → Dataset (reference read_api.from_modin)."""
+    from ray_tpu.data import dataset as _d
+
+    if hasattr(df, "_to_pandas"):
+        return _d.from_pandas(df._to_pandas())
+    raise TypeError("from_modin expects a modin DataFrame (._to_pandas)")
+
+
+def from_mars(df):
+    """mars DataFrame → Dataset (reference read_api.from_mars)."""
+    from ray_tpu.data import dataset as _d
+
+    if hasattr(df, "execute"):
+        df = df.execute()
+    if hasattr(df, "to_pandas"):
+        return _d.from_pandas(df.to_pandas())
+    raise TypeError("from_mars expects a mars DataFrame (.to_pandas)")
+
+
+def from_tf(tf_dataset):
+    """tf.data.Dataset → Dataset via as_numpy_iterator (reference
+    read_api.from_tf; eager-materialized like the reference)."""
+    from ray_tpu.data import dataset as _d
+
+    it = getattr(tf_dataset, "as_numpy_iterator", None)
+    if it is None:
+        raise TypeError(
+            "from_tf expects a tf.data.Dataset (.as_numpy_iterator)")
+    rows = []
+    for item in it():
+        if isinstance(item, dict):
+            rows.append(item)
+        elif isinstance(item, (tuple, list)):
+            rows.append({f"col_{i}": v for i, v in enumerate(item)})
+        else:
+            rows.append({"item": item})
+    if not rows:
+        return _d.from_items([])
+    cols = {k: np.asarray([r[k] for r in rows]) for k in rows[0]}
+    return _d.from_blocks([batch_to_block(cols)])
+
+
+def read_lance(uri: str, *, columns=None, filter=None,  # noqa: A002
+               parallelism: int = -1, _module=None):
+    from ray_tpu.data import dataset as _d
+
+    return _d.read_datasource(
+        LanceDatasource(uri, columns=columns, filter=filter,
+                        _module=_module),
+        parallelism=parallelism)
+
+
+def read_bigquery(project_id: str, *, dataset=None, query=None,
+                  parallelism: int = -1, _module=None):
+    from ray_tpu.data import dataset as _d
+
+    return _d.read_datasource(
+        BigQueryDatasource(project_id, dataset=dataset, query=query,
+                           _module=_module),
+        parallelism=parallelism)
+
+
+def read_mongo(uri: str, database: str, collection: str, *,
+               pipeline=None, parallelism: int = -1, _module=None):
+    from ray_tpu.data import dataset as _d
+
+    return _d.read_datasource(
+        MongoDatasource(uri, database, collection, pipeline=pipeline,
+                        _module=_module),
+        parallelism=parallelism)

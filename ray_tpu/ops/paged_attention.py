@@ -323,8 +323,21 @@ def _paged_attention_pallas(q, k_pages, v_pages, block_tables,
     SB = 1 << (SB.bit_length() - 1)  # pow-2 for clean division
     if os.environ.get("RAY_TPU_PA_SB"):  # perf experiments only
         SB = max(1, min(B, int(os.environ["RAY_TPU_PA_SB"])))
-    while B % SB:
-        SB //= 2
+    # Pad the batch up to a multiple of SB instead of shrinking SB to a
+    # divisor (a prime B would degrade to SB=1, reinstating the per-row
+    # grid overhead the batching exists to remove).  Padded rows carry
+    # ctx 0: the skip logic never streams blocks for them beyond what
+    # their seq-block's live rows need, and _finalize zeroes dead rows.
+    B_in = B
+    B = -(-B // SB) * SB
+    if B != B_in:
+        pad = B - B_in
+        q = jnp.concatenate([q, jnp.zeros((pad, H, D), q.dtype)])
+        block_tables = jnp.concatenate(
+            [block_tables, jnp.zeros((pad, W), block_tables.dtype)])
+        context_lens = jnp.concatenate(
+            [jnp.asarray(context_lens, jnp.int32),
+             jnp.zeros((pad,), jnp.int32)])
 
     # Per-seq-block max context for the skip logic.
     bctx = jnp.max(context_lens.astype(jnp.int32).reshape(B // SB, SB),
@@ -360,9 +373,10 @@ def _paged_attention_pallas(q, k_pages, v_pages, block_tables,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=interpret,
     )
-    return kernel(block_tables.astype(jnp.int32),
-                  context_lens.astype(jnp.int32), bctx, q, k_pages,
-                  v_pages)
+    out = kernel(block_tables.astype(jnp.int32),
+                 context_lens.astype(jnp.int32), bctx, q, k_pages,
+                 v_pages)
+    return out[:B_in] if B != B_in else out
 
 
 def write_page_tokens(k_pages, v_pages, k_new, v_new, block_tables,
@@ -517,8 +531,23 @@ def write_token_rows(k_pages, v_pages, k_new, v_new, block_tables,
     if B == 0:  # empty batch traces to an empty grid
         return k_pages, v_pages
     SB = min(16, B)
-    while B % SB:  # SB must divide B (static per-step knew blocks)
-        SB -= 1
+    kn, vn = k_new.reshape(B, KD), v_new.reshape(B, KD)
+    # Pad to a multiple of SB by duplicating the last row rather than
+    # shrinking SB to a divisor (prime B would fall back to one strip
+    # per grid step).  The duplicates rewrite row B-1's strip with
+    # byte-identical data, which the kernel's duplicate-target
+    # invariant (see _row_write_kernel) already covers.
+    Bp = -(-B // SB) * SB
+    if Bp != B:
+        pad = Bp - B
+
+        def _dup_tail(a):
+            return jnp.concatenate(
+                [a, jnp.broadcast_to(a[-1:], (pad, *a.shape[1:]))])
+
+        pages, strips, rows = map(_dup_tail, (pages, strips, rows))
+        kn, vn = _dup_tail(kn), _dup_tail(vn)
+        B = Bp
     grid = (B // SB,)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
@@ -550,8 +579,7 @@ def write_token_rows(k_pages, v_pages, k_new, v_new, block_tables,
         input_output_aliases={3: 0, 4: 1},
         interpret=_platform() != "tpu",
     )
-    return kernel(pages, strips, rows, k_pages, v_pages,
-                  k_new.reshape(B, KD), v_new.reshape(B, KD))
+    return kernel(pages, strips, rows, k_pages, v_pages, kn, vn)
 
 
 def paged_attention_reference(q, k_pages, v_pages, block_tables,

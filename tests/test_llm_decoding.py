@@ -496,6 +496,73 @@ def test_paged_attention_pallas_kernel_multi_seq_block(monkeypatch):
     assert float(np.abs(np.asarray(out)[2]).max()) == 0.0
 
 
+def test_paged_attention_prime_batch_pads_not_degrades(monkeypatch):
+    """A batch size SB doesn't divide (prime B) must PAD up to a
+    multiple of SB — not silently fall back to SB=1 — and still match
+    the reference with the pad rows sliced away."""
+    import numpy as np
+
+    from ray_tpu.ops.paged_attention import (
+        paged_attention,
+        paged_attention_reference,
+    )
+
+    monkeypatch.setenv("RAY_TPU_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("RAY_TPU_PA_SB", "4")
+    rng = np.random.default_rng(2)
+    B, H, KVH, D, P, page, W = 7, 4, 2, 128, 32, 8, 4
+    q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((P, page, KVH * D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((P, page, KVH * D)), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(P)[:B * W].reshape(B, W).astype(np.int32))
+    ctx = jnp.asarray([5, 0, 31, 8, 1, 17, 3], jnp.int32)
+    out = paged_attention(q, kp, vp, tables, ctx)
+    assert out.shape == (B, H, D)  # pad rows sliced off
+    ref = paged_attention_reference(q, kp, vp, tables, ctx)
+    np.testing.assert_allclose(np.asarray(out, np.float64), ref,
+                               atol=2e-3)
+    assert float(np.abs(np.asarray(out)[1]).max()) == 0.0
+
+
+def test_write_token_rows_prime_batch(monkeypatch):
+    """write_token_rows pads a prime batch with clamped-tail duplicate
+    strips (byte-identical rewrites) instead of degrading to one strip
+    per grid step; every row's K/V lands where the scatter reference
+    says."""
+    import numpy as np
+
+    from ray_tpu.ops.paged_attention import write_token_rows
+
+    monkeypatch.setenv("RAY_TPU_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(3)
+    B, KVH, D, P, page, W = 19, 2, 8, 64, 8, 3
+    kp = jnp.asarray(rng.standard_normal((P, page, KVH * D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((P, page, KVH * D)), jnp.float32)
+    k_new = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.float32)
+    v_new = jnp.asarray(rng.standard_normal((B, KVH, D)), jnp.float32)
+    # Distinct private pages per row (the engine invariant), one drop.
+    tables = jnp.asarray(
+        rng.permutation(P - 1)[:B * W].reshape(B, W).astype(np.int32))
+    pos = np.asarray(rng.integers(0, page * W, B), np.int32)
+    pos[5] = -1  # dropped row -> scratch page P-1
+    kp2, vp2 = write_token_rows(kp, vp, k_new, v_new, tables,
+                                jnp.asarray(pos))
+    exp_k, exp_v = np.array(kp), np.array(vp)
+    for b in range(B):
+        if pos[b] < 0:
+            continue
+        pg = int(np.asarray(tables)[b, pos[b] // page])
+        exp_k[pg, pos[b] % page] = np.asarray(k_new[b]).reshape(-1)
+        exp_v[pg, pos[b] % page] = np.asarray(v_new[b]).reshape(-1)
+    # Untouched slots stay bit-identical; written rows match exactly
+    # (a pure RMW carries no arithmetic) — scratch page excluded.
+    np.testing.assert_array_equal(np.asarray(kp2)[:P - 1],
+                                  exp_k[:P - 1])
+    np.testing.assert_array_equal(np.asarray(vp2)[:P - 1],
+                                  exp_v[:P - 1])
+
+
 def test_mid_generation_admission(tiny, params):
     """Continuous batching with chunked multi-step dispatch: a request
     that arrives while another is mid-generation is admitted at the
