@@ -85,9 +85,14 @@ class LanceDatasource(Datasource):
 
             tasks.append(ReadTask(fn, BlockMetadata(
                 num_rows=0, size_bytes=0)))
-        return tasks or [ReadTask(
-            lambda: iter([ds.to_table(columns=columns, filter=filt)]),
-            BlockMetadata(num_rows=0, size_bytes=0))]
+        if not tasks:  # fragment-less dataset: one whole-table task,
+            def whole():  # re-opened inside the task like the others
+                inner = lance.dataset(uri)
+                yield inner.to_table(columns=columns, filter=filt)
+
+            tasks.append(ReadTask(whole, BlockMetadata(
+                num_rows=0, size_bytes=0)))
+        return tasks
 
 
 # ---------------------------------------------------------------------------

@@ -21,8 +21,10 @@ from ray_tpu.rl.env_runner_group import EnvRunnerGroup
 from ray_tpu.rl.episode import SingleAgentEpisode, episodes_to_batch
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
+from ray_tpu.rl.catalog import MODEL_DEFAULTS, Catalog
 from ray_tpu.rl.module import (ConvRLModuleSpec, QNetworkSpec,
-                               RLModuleSpec, SACModuleSpec)
+                               RecurrentRLModuleSpec, RLModuleSpec,
+                               SACModuleSpec)
 from ray_tpu.rl.offline import (
     dataset_to_episodes,
     episodes_to_dataset,
@@ -62,7 +64,10 @@ __all__ = [
     "episodes_to_batch",
     "JaxLearner",
     "LearnerGroup",
+    "Catalog",
+    "MODEL_DEFAULTS",
     "ConvRLModuleSpec",
+    "RecurrentRLModuleSpec",
     "RLModuleSpec",
     "dataset_to_episodes",
     "episodes_to_dataset",

@@ -55,16 +55,19 @@ class Algorithm(Trainable):
             restart_failed=config.restart_failed_env_runners,
             num_cpus_per_runner=config.num_cpus_per_env_runner,
             env_to_module=config.env_to_module_connector,
-            module_to_env=config.module_to_env_connector)
+            module_to_env=config.module_to_env_connector,
+            model_config=config.model_config,
+            catalog_class=config.catalog_class)
         self.learner_group = self._build_learner_group(config)
         # Runners start from the learner's weights.
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
         self._setup_done = True
 
     def _make_runner_spec(self):
-        """Module spec for env runners; None → infer actor-critic spec
-        from the env (module.spec_for_env). DQN/SAC override."""
-        return None
+        """Module spec for env runners; None → infer from the env via
+        the catalog / spec_for_env (config.rl_module(module_spec=...)
+        wins outright). DQN/SAC override."""
+        return self.config.module_spec
 
     def _build_learner_group(self, config: AlgorithmConfig):
         raise NotImplementedError

@@ -108,6 +108,26 @@ class DQNLearner(JaxLearner):
         }
 
 
+def _q_hiddens(config) -> tuple:
+    """Value-network hidden sizes for algorithms that build their own
+    spec (DQN/SAC): honors rl_module(model_config={"fcnet_hiddens": …})
+    and rejects model-config keys these modules cannot apply — silent
+    drops would masquerade as the requested architecture.  Full catalog
+    control needs rl_module(module_spec=<spec>)."""
+    mc = config.model_config or {}
+    unsupported = set(mc) - {"fcnet_hiddens"}
+    if unsupported:
+        raise ValueError(
+            f"{type(config).__name__} builds its own module spec; "
+            f"model_config keys {sorted(unsupported)} are not applied — "
+            "use rl_module(module_spec=...) for full control")
+    if config.catalog_class is not None:
+        raise ValueError(
+            f"{type(config).__name__} does not use catalog inference; "
+            "pass rl_module(module_spec=...) instead")
+    return tuple(mc.get("fcnet_hiddens", config.hidden_sizes))
+
+
 class DQN(Algorithm):
     config_class = DQNConfig
 
@@ -122,9 +142,9 @@ class DQN(Algorithm):
             n_actions = int(env.action_space.n)
         finally:
             env.close()
-        self._spec = rl_module.QNetworkSpec(
+        self._spec = config.module_spec or rl_module.QNetworkSpec(
             obs_dim=obs_dim, action_dim=n_actions,
-            hidden_sizes=tuple(config.hidden_sizes),
+            hidden_sizes=tuple(_q_hiddens(config)),
             dueling=config.dueling,
             epsilon_initial=config.epsilon_initial,
             epsilon_final=config.epsilon_final,

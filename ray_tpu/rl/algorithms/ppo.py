@@ -50,7 +50,25 @@ class PPOLearner(JaxLearner):
 
     def loss(self, params, batch: Dict[str, jnp.ndarray], rng
              ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-        dist_inputs, values = self.spec.forward(params, batch["obs"])
+        obs = batch["obs"]
+        if obs.ndim == 3:
+            # Sequence minibatch ([B, T, ·] + is_first) for recurrent
+            # specs: one forward_seq scan, then the SAME flat masked
+            # PPO tail (padded steps carry mask 0).
+            dist_inputs, values = self.spec.forward_seq(
+                params, obs, batch["is_first"])
+            dist_inputs = dist_inputs.reshape(-1, dist_inputs.shape[-1])
+            values = values.reshape(-1)
+            acts = batch["actions"]
+            actions = acts.reshape(-1, *acts.shape[2:])
+            batch = {**batch,
+                     "actions": actions,
+                     "logp": batch["logp"].reshape(-1),
+                     "advantages": batch["advantages"].reshape(-1),
+                     "value_targets": batch["value_targets"].reshape(-1),
+                     "mask": batch["mask"].reshape(-1)}
+        else:
+            dist_inputs, values = self.spec.forward(params, obs)
         dist = self.spec.dist(dist_inputs)
         logp = dist.logp(batch["actions"])
         mask = batch["mask"]
@@ -85,10 +103,32 @@ def compute_gae(episodes: List[SingleAgentEpisode], params,
     Values come from the rollout (`values` extra); the bootstrap value of
     each episode's final obs is evaluated in one batched forward pass.
     """
-    finals = np.stack([np.asarray(e.obs[-1]).reshape(-1) for e in episodes])
-    fwd = spec.forward if spec is not None else rl_module.forward
-    _, boot = fwd(params, jnp.asarray(finals))
-    boot = np.asarray(boot)
+    if spec is not None and getattr(spec, "recurrent", False):
+        # Recurrent bootstrap: V(s_T) needs the LSTM state built from
+        # the episode's own history — run forward_seq over each whole
+        # fragment (zero state at its start, matching training's
+        # truncated-BPTT view) and read the value at the final obs.
+        # Lengths pad to the next power of two so the scan compiles a
+        # bounded number of shapes across train steps.
+        lens = [len(e.obs) for e in episodes]
+        Lmax = 1 << (max(lens) - 1).bit_length()
+        obs_dim = int(np.prod(np.asarray(episodes[0].obs[0]).shape))
+        obs_pad = np.zeros((len(episodes), Lmax, obs_dim), np.float32)
+        isf = np.zeros((len(episodes), Lmax), np.float32)
+        isf[:, 0] = 1.0
+        for i, e in enumerate(episodes):
+            obs_pad[i, :lens[i]] = np.asarray(e.obs).reshape(lens[i], -1)
+        _, vals = spec.forward_seq(params, jnp.asarray(obs_pad),
+                                   jnp.asarray(isf))
+        vals = np.asarray(vals)
+        boot = np.array([vals[i, lens[i] - 1]
+                         for i in range(len(episodes))])
+    else:
+        finals = np.stack(
+            [np.asarray(e.obs[-1]).reshape(-1) for e in episodes])
+        fwd = spec.forward if spec is not None else rl_module.forward
+        _, boot = fwd(params, jnp.asarray(finals))
+        boot = np.asarray(boot)
     out: List[Dict[str, np.ndarray]] = []
     for i, ep in enumerate(episodes):
         T = len(ep)
@@ -114,6 +154,16 @@ def compute_gae(episodes: List[SingleAgentEpisode], params,
     return out
 
 
+def _normalize_advantages(batch: Dict[str, np.ndarray]) -> None:
+    """In-place masked advantage standardization (flat [N] or [N, T])."""
+    valid = batch["mask"] > 0
+    mean = batch["advantages"][valid].mean()
+    std = batch["advantages"][valid].std() + 1e-8
+    batch["advantages"] = np.where(
+        valid, (batch["advantages"] - mean) / std, 0.0
+    ).astype(np.float32)
+
+
 class PPO(Algorithm):
     config_class = PPOConfig
 
@@ -137,6 +187,8 @@ class PPO(Algorithm):
         weights = self.learner_group.get_weights()
         rows = compute_gae(episodes, weights, cfg.gamma, cfg.lambda_,
                            spec=self.env_runner_group.spec)
+        if getattr(self.env_runner_group.spec, "recurrent", False):
+            return self._training_step_sequences(cfg, rows)
         flat = {k: np.concatenate([r[k] for r in rows]) for k in rows[0]}
         n = flat["obs"].shape[0]
         # Pad/trim to exactly train_batch_size so every minibatch slice has
@@ -154,24 +206,73 @@ class PPO(Algorithm):
             mask = mask[:target]
         flat["mask"] = mask
         if cfg.normalize_advantages:
-            valid = mask > 0
-            mean = flat["advantages"][valid].mean()
-            std = flat["advantages"][valid].std() + 1e-8
-            flat["advantages"] = np.where(
-                valid, (flat["advantages"] - mean) / std, 0.0
-            ).astype(np.float32)
-
-        rng = np.random.default_rng(cfg.seed + self.iteration)
-        metrics: Dict[str, float] = {}
+            _normalize_advantages(flat)
         # Clamp so at least one SGD step always happens (a minibatch larger
         # than the batch would otherwise silently skip every update).
-        mb = min(cfg.minibatch_size, target)
+        metrics = self._sgd(cfg, flat, target,
+                            min(cfg.minibatch_size, target))
+        metrics["num_env_steps_trained"] = int(n)
+        return dict(metrics)
+
+    def _sgd(self, cfg: PPOConfig, batch: Dict[str, np.ndarray],
+             target: int, mb: int) -> Dict[str, float]:
+        """Epoch/minibatch SGD + weight sync, shared by the flat and
+        sequence batchers (one compiled update shape each)."""
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        metrics: Dict[str, float] = {}
         for _ in range(cfg.num_epochs):
             perm = rng.permutation(target)
             for start in range(0, target - mb + 1, mb):
                 idx = perm[start:start + mb]
                 metrics = self.learner_group.update_from_batch(
-                    {k: v[idx] for k, v in flat.items()})
+                    {k: v[idx] for k, v in batch.items()})
         self.env_runner_group.sync_weights(self.learner_group.get_weights())
-        metrics["num_env_steps_trained"] = int(n)
+        return dict(metrics)
+
+    def _training_step_sequences(self, cfg: PPOConfig,
+                                 rows: List[Dict[str, np.ndarray]]
+                                 ) -> Dict[str, Any]:
+        """SGD over [n_seqs, max_seq_len] segment batches for recurrent
+        specs (reference: Learner's max_seq_len padding in
+        rllib/policy/rnn_sequencing.py, new-stack episode slicing).
+        Each GAE row (one episode fragment) is cut into max_seq_len
+        segments with zero LSTM state at segment starts (truncated
+        BPTT); padded steps carry mask 0, and the whole run compiles
+        ONE [mb_seqs, T] update."""
+        spec = self.env_runner_group.spec
+        T = int(spec.max_seq_len)
+        segs: List[Dict[str, np.ndarray]] = []
+        for row in rows:
+            L = len(row["obs"])
+            for s in range(0, L, T):
+                seg = {k: v[s:s + T] for k, v in row.items()}
+                n = len(seg["obs"])
+                if n < T:
+                    seg = {k: np.concatenate(
+                        [v, np.zeros((T - n,) + v.shape[1:], v.dtype)])
+                        for k, v in seg.items()}
+                mask = np.zeros(T, np.float32)
+                mask[:n] = 1.0
+                isf = np.zeros(T, np.float32)
+                isf[0] = 1.0  # zero state at every segment start
+                seg["mask"], seg["is_first"] = mask, isf
+                segs.append(seg)
+        # Keep EVERY real segment (short episodes make segments carry
+        # fewer than T real steps, so train_batch_size // T would
+        # discard sampled data); pad up to a multiple of the minibatch
+        # seq count.  The jitted update's shape is [mb, T] regardless
+        # of how many minibatches an epoch runs, so a varying segment
+        # count costs no recompile.
+        mb = min(max(1, cfg.minibatch_size // T), len(segs))
+        target = -(-len(segs) // mb) * mb
+        if len(segs) < target:
+            zero = {k: np.zeros_like(v) for k, v in segs[0].items()}
+            zero["is_first"] = segs[0]["is_first"]  # defined scan resets
+            segs.extend([zero] * (target - len(segs)))
+        batch = {k: np.stack([s[k] for s in segs]) for k in segs[0]}
+        n_steps = int(batch["mask"].sum())
+        if cfg.normalize_advantages:
+            _normalize_advantages(batch)
+        metrics = self._sgd(cfg, batch, target, mb)
+        metrics["num_env_steps_trained"] = n_steps
         return dict(metrics)

@@ -124,10 +124,12 @@ class SAC(Algorithm):
             high = tuple(float(x) for x in env.action_space.high.ravel())
         finally:
             env.close()
-        self._spec = rl_module.SACModuleSpec(
+        from ray_tpu.rl.algorithms.dqn import _q_hiddens
+
+        self._spec = config.module_spec or rl_module.SACModuleSpec(
             obs_dim=obs_dim, action_dim=act_dim,
             action_low=low, action_high=high,
-            hidden_sizes=tuple(config.hidden_sizes))
+            hidden_sizes=tuple(_q_hiddens(config)))
         self._target_entropy = (
             -float(act_dim) if config.target_entropy == "auto"
             else float(config.target_entropy))

@@ -1,0 +1,132 @@
+"""Catalog: the hackable decision tree from gym spaces + model_config to
+a concrete RLModule spec.
+
+Counterpart of the reference's rllib/core/models/catalog.py (Catalog:
+_get_encoder_config's MLP/CNN/LSTM dispatch, get_action_dist_cls) and
+rllib/models/catalog.py MODEL_DEFAULTS.  Differences are deliberate and
+TPU-shaped: the reference catalog builds framework nn.Modules through
+config objects; here modules are frozen spec dataclasses of pure
+functions (module.py), so the catalog's job collapses to choosing and
+parameterizing the right spec — and stays fully jit-transparent.
+
+Extension surface mirrors the reference:
+  - subclass and override `build_module_spec` (the whole decision) or
+    one of the narrow hooks `_determine_spec_class` /
+    `get_action_dist_cls` / spec-kwarg builders;
+  - inject via `AlgorithmConfig.rl_module(catalog_class=MyCatalog)`
+    (reference config.rl_module(rl_module_spec=...)), reaching every
+    env runner and learner;
+  - or bypass it entirely with `rl_module(module_spec=<spec>)`.
+
+model_config keys follow the reference's MODEL_DEFAULTS names
+(fcnet_hiddens, conv_filters, use_lstm, ...) so configs port verbatim.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Type
+
+import numpy as np
+
+from ray_tpu.rl import module as rl_module
+
+# Subset of the reference's MODEL_DEFAULTS (rllib/models/catalog.py:53)
+# that this stack's modules consume; unknown keys are rejected loudly
+# rather than silently ignored.
+MODEL_DEFAULTS: Dict[str, Any] = {
+    "fcnet_hiddens": (256, 256),
+    "fcnet_activation": "tanh",
+    # None -> auto: the Atari stack for >=42px inputs, a small stack
+    # for tiny test envs (reference models/utils.py get_filter_config).
+    "conv_filters": None,
+    "use_lstm": False,
+    "lstm_cell_size": 256,
+    "max_seq_len": 20,
+}
+
+# (out_channels, kernel, stride) rows; SAME padding (module.py
+# ConvRLModuleSpec).  The 84px row is the classic Nature-DQN stack.
+_ATARI_FILTERS = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+_SMALL_FILTERS = ((16, 4, 2), (32, 4, 2))
+
+
+class Catalog:
+    def __init__(self, observation_space, action_space,
+                 model_config: Optional[Dict[str, Any]] = None):
+        unknown = set(model_config or {}) - set(MODEL_DEFAULTS)
+        if unknown:
+            raise ValueError(
+                f"unknown model_config keys {sorted(unknown)}; "
+                f"known: {sorted(MODEL_DEFAULTS)}")
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.model_config: Dict[str, Any] = {
+            **MODEL_DEFAULTS, **(model_config or {})}
+
+    # -- space introspection -------------------------------------------
+    @property
+    def obs_dim(self) -> int:
+        return int(np.prod(self.observation_space.shape))
+
+    def get_action_dist_cls(self) -> Tuple[Type, bool]:
+        """(dist_cls, discrete) for the action space (reference
+        Catalog._get_dist_cls_from_action_space)."""
+        import gymnasium as gym
+
+        if isinstance(self.action_space, gym.spaces.Discrete):
+            return rl_module.Categorical, True
+        if isinstance(self.action_space, gym.spaces.Box):
+            return rl_module.DiagGaussian, False
+        raise ValueError(
+            f"unsupported action space {type(self.action_space).__name__};"
+            " override Catalog.get_action_dist_cls")
+
+    @property
+    def action_dim(self) -> int:
+        import gymnasium as gym
+
+        if isinstance(self.action_space, gym.spaces.Discrete):
+            return int(self.action_space.n)
+        return int(np.prod(self.action_space.shape))
+
+    # -- decision tree --------------------------------------------------
+    def _determine_spec_class(self) -> Type:
+        """Which module spec family fits (obs space, model_config):
+        LSTM wins over conv/MLP encoders for now (a conv+LSTM combo is
+        a custom-catalog job, like the reference's tokenizer path)."""
+        if self.model_config["use_lstm"]:
+            return rl_module.RecurrentRLModuleSpec
+        if len(self.observation_space.shape) == 3:
+            return rl_module.ConvRLModuleSpec
+        return rl_module.RLModuleSpec
+
+    def conv_filters(self) -> Tuple[Tuple[int, int, int], ...]:
+        cf = self.model_config["conv_filters"]
+        if cf is not None:
+            return tuple(tuple(row) for row in cf)
+        H = self.observation_space.shape[0]
+        return _ATARI_FILTERS if H >= 42 else _SMALL_FILTERS
+
+    def build_module_spec(self):
+        """The catalog's product: a frozen module spec (module.py)."""
+        _, discrete = self.get_action_dist_cls()
+        cfg = self.model_config
+        common = dict(
+            obs_dim=self.obs_dim,
+            action_dim=self.action_dim,
+            discrete=discrete,
+            hidden_sizes=tuple(cfg["fcnet_hiddens"]),
+            activation=cfg["fcnet_activation"],
+        )
+        cls = self._determine_spec_class()
+        if cls is rl_module.RecurrentRLModuleSpec:
+            return rl_module.RecurrentRLModuleSpec(
+                **common,
+                cell_size=int(cfg["lstm_cell_size"]),
+                max_seq_len=int(cfg["max_seq_len"]))
+        if cls is rl_module.ConvRLModuleSpec:
+            return rl_module.ConvRLModuleSpec(
+                **common,
+                obs_shape=tuple(self.observation_space.shape),
+                conv_filters=self.conv_filters())
+        return rl_module.RLModuleSpec(**common)

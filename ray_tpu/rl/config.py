@@ -30,6 +30,13 @@ class AlgorithmConfig:
         # callable -> ConnectorV2 | [ConnectorV2], built per runner.
         self.env_to_module_connector = None
         self.module_to_env_connector = None
+        # rl_module() (reference config.rl_module(rl_module_spec=...)):
+        # model_config keys follow MODEL_DEFAULTS (rl/catalog.py);
+        # catalog_class injects a Catalog subclass; module_spec bypasses
+        # catalog inference entirely.
+        self.model_config: Optional[Dict[str, Any]] = None
+        self.catalog_class: Optional[Type] = None
+        self.module_spec: Optional[Any] = None
         # training()
         self.lr: float = 3e-4
         self.gamma: float = 0.99
@@ -74,6 +81,17 @@ class AlgorithmConfig:
             self.env_to_module_connector = env_to_module_connector
         if module_to_env_connector is not None:
             self.module_to_env_connector = module_to_env_connector
+        return self
+
+    def rl_module(self, *, model_config: Optional[Dict[str, Any]] = None,
+                  catalog_class: Optional[Type] = None,
+                  module_spec: Optional[Any] = None) -> "AlgorithmConfig":
+        if model_config is not None:
+            self.model_config = model_config
+        if catalog_class is not None:
+            self.catalog_class = catalog_class
+        if module_spec is not None:
+            self.module_spec = module_spec
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":

@@ -37,7 +37,9 @@ class SingleAgentEnvRunner:
                  spec: Optional[rl_module.RLModuleSpec] = None,
                  seed: int = 0, explore: bool = True,
                  worker_index: int = 0,
-                 env_to_module=None, module_to_env=None):
+                 env_to_module=None, module_to_env=None,
+                 model_config: Optional[Dict[str, Any]] = None,
+                 catalog_class=None):
         import gymnasium as gym
 
         self.num_envs = num_envs
@@ -60,7 +62,18 @@ class SingleAgentEnvRunner:
         if spec is None:
             obs_space = self.env_to_module.recompute_observation_space(
                 self.env.single_observation_space)
-            spec = rl_module.spec_for_env(self.env, obs_space=obs_space)
+            if model_config is not None or catalog_class is not None:
+                # Catalog inference (rl/catalog.py; reference
+                # rllib/core/models/catalog.py): model_config and
+                # custom-catalog hooks drive the spec decision over the
+                # pipeline's TRANSFORMED space.
+                from ray_tpu.rl.catalog import Catalog
+
+                spec = (catalog_class or Catalog)(
+                    obs_space, self.env.single_action_space,
+                    model_config).build_module_spec()
+            else:
+                spec = rl_module.spec_for_env(self.env, obs_space=obs_space)
         self.spec = spec
         self.explore = explore
         self.worker_index = worker_index

@@ -24,7 +24,9 @@ class EnvRunnerGroup:
                  spec=None, seed: int = 0,
                  restart_failed: bool = True,
                  num_cpus_per_runner: float = 1.0,
-                 env_to_module=None, module_to_env=None):
+                 env_to_module=None, module_to_env=None,
+                 model_config: Optional[Dict[str, Any]] = None,
+                 catalog_class=None):
         self.env_fn = env_fn
         self.num_envs_per_runner = num_envs_per_runner
         self.seed = seed
@@ -39,10 +41,14 @@ class EnvRunnerGroup:
         self.module_to_env = module_to_env
         # Local runner: source of truth for the module spec and a fallback
         # sampler when there are no remote runners.
+        # Catalog inputs feed ONLY the local runner's spec inference;
+        # remote runners receive the resolved concrete spec below, so
+        # custom catalog classes never need to be picklable.
         self.local_runner = SingleAgentEnvRunner(
             env_fn, num_envs=num_envs_per_runner, spec=spec, seed=seed,
             worker_index=0, env_to_module=env_to_module,
-            module_to_env=module_to_env)
+            module_to_env=module_to_env, model_config=model_config,
+            catalog_class=catalog_class)
         self.spec = self.local_runner.spec
         self._actor_cls = ray_tpu.remote(SingleAgentEnvRunner)
         self.remote_runners: List[Any] = []

@@ -1,5 +1,5 @@
 """Synthetic environments shipped with the RL library."""
 
-from ray_tpu.rl.envs.pixel import BrightQuadrantEnv
+from ray_tpu.rl.envs.pixel import BrightQuadrantEnv, RecallEnv
 
-__all__ = ["BrightQuadrantEnv"]
+__all__ = ["BrightQuadrantEnv", "RecallEnv"]

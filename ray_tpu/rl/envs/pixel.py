@@ -39,11 +39,12 @@ class BrightQuadrantEnv(_BASE):
     metadata: Dict[str, Any] = {}
 
     def __init__(self, size: int = 12, length: int = 16,
-                 seed: Optional[int] = None):
+                 patch: int = 3, seed: Optional[int] = None):
         import gymnasium as gym
 
         self.size = size
         self.length = length
+        self.patch = patch
         self._rng = np.random.default_rng(seed)
         self._t = 0
         self._target = 0
@@ -52,16 +53,16 @@ class BrightQuadrantEnv(_BASE):
         self.action_space = gym.spaces.Discrete(4)
 
     def _obs(self) -> np.ndarray:
-        s = self.size
+        s, p = self.size, self.patch
         img = self._rng.uniform(0.0, 0.2, (s, s, 1)).astype(np.float32)
         q = int(self._rng.integers(4))
         self._target = q
         h = s // 2
         r0 = 0 if q in (0, 1) else h
         c0 = 0 if q in (0, 2) else h
-        r = int(self._rng.integers(r0, r0 + h - 2))
-        c = int(self._rng.integers(c0, c0 + h - 2))
-        img[r:r + 3, c:c + 3, 0] = self._rng.uniform(0.8, 1.0)
+        r = int(self._rng.integers(r0, max(r0 + h - p, r0) + 1))
+        c = int(self._rng.integers(c0, max(c0 + h - p, c0) + 1))
+        img[r:r + p, c:c + p, 0] = self._rng.uniform(0.8, 1.0)
         return img
 
     def reset(self, *, seed: Optional[int] = None, options=None
@@ -76,4 +77,58 @@ class BrightQuadrantEnv(_BASE):
         reward = 1.0 if int(action) == self._target else 0.0
         self._t += 1
         terminated = self._t >= self.length
+        return self._obs(), reward, terminated, False, {}
+
+
+class RecallEnv(_BASE):
+    """Minimal memory task: recall a cue shown only at the FIRST step.
+
+    obs:    float32 [3] — [cue==0, cue==1, t/length]; the cue one-hot
+            appears only at t=0, later observations carry just the
+            clock.
+    action: Discrete(2); only the action at the LAST step scores.
+    reward: +1 at the final step iff action == cue, else 0.
+
+    A memoryless policy sees an uninformative final observation and
+    earns 0.5 in expectation no matter what; beating ~0.75 REQUIRES
+    carrying the cue across `length` steps — the proof task for the
+    catalog's use_lstm path (the role the reference's
+    StatelessCartPole plays for rllib's LSTM examples,
+    rllib/examples/envs/classes/stateless_cartpole.py).
+    """
+
+    metadata: Dict[str, Any] = {}
+
+    def __init__(self, length: int = 4, seed: Optional[int] = None):
+        import gymnasium as gym
+
+        self.length = length
+        self._rng = np.random.default_rng(seed)
+        self._cue = 0
+        self._t = 0
+        self.observation_space = gym.spaces.Box(
+            0.0, 1.0, shape=(3,), dtype=np.float32)
+        self.action_space = gym.spaces.Discrete(2)
+
+    def _obs(self) -> np.ndarray:
+        out = np.zeros(3, dtype=np.float32)
+        if self._t == 0:
+            out[self._cue] = 1.0
+        out[2] = self._t / self.length
+        return out
+
+    def reset(self, *, seed: Optional[int] = None, options=None
+              ) -> Tuple[np.ndarray, Dict[str, Any]]:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._cue = int(self._rng.integers(2))
+        self._t = 0
+        return self._obs(), {}
+
+    def step(self, action
+             ) -> Tuple[np.ndarray, float, bool, bool, Dict[str, Any]]:
+        self._t += 1
+        terminated = self._t >= self.length
+        reward = (1.0 if terminated and int(action) == self._cue
+                  else 0.0)
         return self._obs(), reward, terminated, False, {}

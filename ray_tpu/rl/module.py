@@ -90,6 +90,7 @@ class RLModuleSpec:
     action_dim: int
     discrete: bool = True
     hidden_sizes: Sequence[int] = (64, 64)
+    activation: str = "tanh"  # fcnet_activation (catalog.py MODEL_DEFAULTS)
 
     @property
     def dist_inputs_dim(self) -> int:
@@ -119,7 +120,9 @@ class RLModuleSpec:
         learners/GAE/V-trace paths all flatten observations before
         batching, so every spec's forward takes the FLAT layout and
         owns any structural reshape (see ConvRLModuleSpec)."""
-        return forward(params, obs)
+        obs = obs.astype(jnp.float32)
+        return (_mlp(params["pi"], obs, self.activation),
+                _mlp(params["vf"], obs, self.activation).squeeze(-1))
 
     def act(self, params, obs: jnp.ndarray, key, explore: jnp.ndarray
             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -146,12 +149,20 @@ def _init_mlp(key, sizes: Sequence[int], scale_last: float) -> Dict[str, Any]:
     return {"layers": layers}
 
 
-def _mlp(params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+_ACTIVATIONS = {"tanh": jnp.tanh, "relu": jax.nn.relu,
+                "elu": jax.nn.elu, "swish": jax.nn.swish,
+                "silu": jax.nn.swish, "linear": lambda x: x}
+
+
+def _mlp(params: Dict[str, Any], x: jnp.ndarray,
+         activation: str = "tanh", activate_last: bool = False
+         ) -> jnp.ndarray:
+    act = _ACTIVATIONS[activation]
     n = len(params["layers"])
     for i, layer in enumerate(params["layers"]):
         x = x @ layer["w"] + layer["b"]
-        if i < n - 1:
-            x = jnp.tanh(x)
+        if i < n - 1 or activate_last:
+            x = act(x)
     return x
 
 
@@ -221,7 +232,146 @@ class ConvRLModuleSpec(RLModuleSpec):
                 dimension_numbers=("NHWC", "HWIO", "NHWC"))
             x = jax.nn.relu(x + layer["b"])
         x = x.reshape(B, -1)
-        return _mlp(params["pi"], x), _mlp(params["vf"], x).squeeze(-1)
+        return (_mlp(params["pi"], x, self.activation),
+                _mlp(params["vf"], x, self.activation).squeeze(-1))
+
+
+# ---------------------------------------------------------------------------
+# Recurrent (LSTM) actor-critic module
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class RecurrentRLModuleSpec(RLModuleSpec):
+    """LSTM actor-critic for partially observable envs: MLP encoder →
+    one LSTM cell → separate policy/value heads.
+
+    Counterpart of the reference catalog's use_lstm path
+    (rllib/core/models/configs.py RecurrentEncoderConfig +
+    rllib/core/models/torch/encoder.py TorchLSTMEncoder), TPU-shaped:
+
+    - Acting uses the env runner's EXISTING stateful protocol
+      (init_runner_state / act_stateful — the one DreamerV3's RSSM
+      rides), so one jitted single-step program serves the rollout
+      hot loop with per-row `is_first` state resets.
+    - Training runs `forward_seq` — a lax.scan over the time axis with
+      in-scan state resets at episode starts — so the learner compiles
+      ONE [B, T] program instead of T chained steps (truncated BPTT at
+      `max_seq_len`, zero state at segment starts, like the
+      reference's max_seq_len batching).
+
+    `hidden_sizes` are the ENCODER MLP widths (the catalog maps
+    fcnet_hiddens here); heads read the LSTM output directly, matching
+    the reference's encoder→heads layout.
+    """
+
+    cell_size: int = 256
+    max_seq_len: int = 20
+
+    recurrent = True  # PPO's batcher keys sequence-mode off this
+
+    def init(self, key) -> Dict[str, Any]:
+        k_enc, k_lstm, k_pi, k_v = jax.random.split(key, 4)
+        enc_sizes = [self.obs_dim, *self.hidden_sizes]
+        embed = enc_sizes[-1]
+        k_wi, k_wh = jax.random.split(k_lstm)
+        return {
+            "enc": _init_mlp(k_enc, enc_sizes, scale_last=1.0)
+            if len(enc_sizes) > 1 else {"layers": []},
+            "lstm": {
+                "wi": jax.random.normal(
+                    k_wi, (embed, 4 * self.cell_size))
+                * jnp.sqrt(1.0 / embed),
+                "wh": jax.random.normal(
+                    k_wh, (self.cell_size, 4 * self.cell_size))
+                * jnp.sqrt(1.0 / self.cell_size),
+                "b": jnp.zeros((4 * self.cell_size,)),
+            },
+            "pi": _init_mlp(k_pi, [self.cell_size, self.dist_inputs_dim],
+                            scale_last=0.01),
+            "vf": _init_mlp(k_v, [self.cell_size, 1], scale_last=1.0),
+        }
+
+    def _encode(self, params, obs: jnp.ndarray) -> jnp.ndarray:
+        obs = obs.astype(jnp.float32)
+        if not params["enc"]["layers"]:
+            return obs
+        return _mlp(params["enc"], obs, self.activation,
+                    activate_last=True)  # trunk: activate every layer
+
+    def _cell(self, lstm, x, h, c):
+        z = x @ lstm["wi"] + h @ lstm["wh"] + lstm["b"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + 1.0)  # forget-gate bias 1: remember early
+        o = jax.nn.sigmoid(o)
+        c = f * c + i * jnp.tanh(g)
+        h = o * jnp.tanh(c)
+        return h, c
+
+    def _heads(self, params, h):
+        return (_mlp(params["pi"], h),
+                _mlp(params["vf"], h).squeeze(-1))
+
+    # -- stateful acting protocol (env_runner.py) ----------------------
+    def init_runner_state(self, n: int) -> Dict[str, jnp.ndarray]:
+        return {"h": jnp.zeros((n, self.cell_size)),
+                "c": jnp.zeros((n, self.cell_size))}
+
+    def act_stateful(self, params, state, obs, key, explore, is_first):
+        B = obs.shape[0]
+        keep = jnp.logical_not(is_first)[:, None]
+        h = state["h"] * keep
+        c = state["c"] * keep
+        x = self._encode(params, obs.reshape(B, -1))
+        h, c = self._cell(params["lstm"], x, h, c)
+        dist_inputs, value = self._heads(params, h)
+        dist = self.dist(dist_inputs)
+        action = jax.lax.cond(
+            explore,
+            lambda: dist.sample(key),
+            lambda: dist.deterministic())
+        return action, dist.logp(action), value, {"h": h, "c": c}
+
+    # -- sequence training path ----------------------------------------
+    def forward_seq(self, params, obs: jnp.ndarray, is_first: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """obs: [B, T, obs_dim] (flattened trailing dims), is_first:
+        [B, T] bool/float; returns (dist_inputs [B, T, ·], values
+        [B, T]).  One scan — XLA compiles a single program whose carry
+        is the [B, cell] LSTM state."""
+        B, T = obs.shape[0], obs.shape[1]
+        x = self._encode(params, obs.reshape(B * T, -1))
+        x = x.reshape(B, T, -1)
+        keep = 1.0 - is_first.astype(jnp.float32)
+
+        def step(carry, xt):
+            h, c = carry
+            x_t, keep_t = xt
+            h = h * keep_t[:, None]
+            c = c * keep_t[:, None]
+            h, c = self._cell(params["lstm"], x_t, h, c)
+            return (h, c), h
+
+        zeros = jnp.zeros((B, self.cell_size))
+        # scan over time: move T to the leading axis
+        (_, _), hs = jax.lax.scan(
+            step, (zeros, zeros),
+            (jnp.swapaxes(x, 0, 1), jnp.swapaxes(keep, 0, 1)))
+        hs = jnp.swapaxes(hs, 0, 1)              # [B, T, cell]
+        dist_inputs, values = self._heads(params, hs)
+        return dist_inputs, values
+
+    def forward(self, params, obs: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Zero-state single-step forward (flat [B, obs_dim]): the
+        bootstrap-value fallback for non-sequence callers; sequence
+        paths should use forward_seq."""
+        B = obs.shape[0]
+        x = self._encode(params, obs.reshape(B, -1))
+        h, _ = self._cell(params["lstm"], x,
+                          jnp.zeros((B, self.cell_size)),
+                          jnp.zeros((B, self.cell_size)))
+        return self._heads(params, h)
 
 
 # ---------------------------------------------------------------------------

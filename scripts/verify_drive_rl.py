@@ -214,5 +214,39 @@ def drive_multi_agent():
         algo.stop()
 
 
+def drive_catalog_lstm():
+    """Catalog model_config path (rl/catalog.py) + recurrent module:
+    use_lstm PPO beats the 0.5 memoryless ceiling on RecallEnv."""
+    from ray_tpu.rl import RecurrentRLModuleSpec
+    from ray_tpu.rl.algorithms import PPOConfig
+    from ray_tpu.rl.envs import RecallEnv
+
+    cfg = (PPOConfig()
+           .environment(env_fn=lambda: RecallEnv(length=4))
+           .env_runners(num_envs_per_env_runner=8)
+           .rl_module(model_config={"use_lstm": True,
+                                    "lstm_cell_size": 32,
+                                    "fcnet_hiddens": [32],
+                                    "max_seq_len": 8})
+           .training(train_batch_size=512, minibatch_size=256,
+                     lr=3e-3, num_epochs=6, entropy_coeff=0.01)
+           .debugging(seed=0))
+    algo = cfg.build()
+    try:
+        assert isinstance(algo.env_runner_group.spec,
+                          RecurrentRLModuleSpec)
+        best = 0.0
+        for _ in range(20):
+            best = max(best, algo.step().get("episode_return_mean", 0.0))
+            if best > 0.8:
+                break
+        assert best > 0.8, best
+        print(f"[LSTM] catalog use_lstm PPO: RecallEnv return {best:.2f} "
+              "(memoryless ceiling 0.5)")
+    finally:
+        algo.stop()
+
+
 drive_multi_agent()
+drive_catalog_lstm()
 print("RL DRIVE OK")
