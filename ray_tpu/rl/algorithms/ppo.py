@@ -110,11 +110,16 @@ def compute_gae(episodes: List[SingleAgentEpisode], params,
         # truncated-BPTT view) and read the value at the final obs.
         # Lengths pad to the next power of two so the scan compiles a
         # bounded number of shapes across train steps.
+        # BOTH axes pad to powers of two so the scan compiles a bounded
+        # number of shapes across a run (episode count varies with env
+        # termination; extra zero rows cost nothing — only
+        # vals[i, lens[i]-1] for real rows is read).
         lens = [len(e.obs) for e in episodes]
         Lmax = 1 << (max(lens) - 1).bit_length()
+        N = 1 << (len(episodes) - 1).bit_length()
         obs_dim = int(np.prod(np.asarray(episodes[0].obs[0]).shape))
-        obs_pad = np.zeros((len(episodes), Lmax, obs_dim), np.float32)
-        isf = np.zeros((len(episodes), Lmax), np.float32)
+        obs_pad = np.zeros((N, Lmax, obs_dim), np.float32)
+        isf = np.zeros((N, Lmax), np.float32)
         isf[:, 0] = 1.0
         for i, e in enumerate(episodes):
             obs_pad[i, :lens[i]] = np.asarray(e.obs).reshape(lens[i], -1)

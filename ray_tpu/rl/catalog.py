@@ -60,8 +60,16 @@ class Catalog:
                 f"known: {sorted(MODEL_DEFAULTS)}")
         self.observation_space = observation_space
         self.action_space = action_space
+        self._explicit = set(model_config or {})
         self.model_config: Dict[str, Any] = {
             **MODEL_DEFAULTS, **(model_config or {})}
+        act = self.model_config["fcnet_activation"]
+        if act not in rl_module._ACTIVATIONS:
+            # Catch at build time, not as a bare KeyError inside a
+            # jitted forward.
+            raise ValueError(
+                f"unknown fcnet_activation {act!r}; known: "
+                f"{sorted(rl_module._ACTIVATIONS)}")
 
     # -- space introspection -------------------------------------------
     @property
@@ -107,6 +115,30 @@ class Catalog:
         H = self.observation_space.shape[0]
         return _ATARI_FILTERS if H >= 42 else _SMALL_FILTERS
 
+    # Which explicitly-set keys each spec family can actually apply;
+    # dropping an explicit key silently would masquerade as the
+    # requested architecture (same contract as dqn.py _q_hiddens).
+    _COMMON_KEYS = {"fcnet_hiddens", "fcnet_activation", "use_lstm"}
+    _APPLICABLE = {
+        rl_module.RLModuleSpec: _COMMON_KEYS,
+        rl_module.ConvRLModuleSpec: _COMMON_KEYS | {"conv_filters"},
+        rl_module.RecurrentRLModuleSpec:
+            _COMMON_KEYS | {"lstm_cell_size", "max_seq_len"},
+    }
+
+    def _check_applicable(self, cls: Type) -> None:
+        applicable = self._APPLICABLE.get(cls)
+        if applicable is None:  # custom subclass spec: trust the hook
+            return
+        dropped = self._explicit - applicable
+        if dropped:
+            raise ValueError(
+                f"model_config keys {sorted(dropped)} do not apply to "
+                f"the selected module family {cls.__name__} (e.g. "
+                "conv_filters needs a 3-D obs space and use_lstm=False;"
+                " lstm_* needs use_lstm=True); override "
+                "Catalog._determine_spec_class or drop the keys")
+
     def build_module_spec(self):
         """The catalog's product: a frozen module spec (module.py)."""
         _, discrete = self.get_action_dist_cls()
@@ -119,6 +151,7 @@ class Catalog:
             activation=cfg["fcnet_activation"],
         )
         cls = self._determine_spec_class()
+        self._check_applicable(cls)
         if cls is rl_module.RecurrentRLModuleSpec:
             return rl_module.RecurrentRLModuleSpec(
                 **common,

@@ -61,3 +61,6 @@ class RunConfig:
     # Tune stop criterion: {"metric": threshold} (stop when >=) or a
     # callable (trial_id, metrics) -> bool (reference air.RunConfig.stop).
     stop: Optional[object] = None
+    # Experiment-loop callbacks (tune/callbacks.py Callback; reference
+    # air.RunConfig.callbacks).  JSON/CSV loggers are added by default.
+    callbacks: Optional[list] = None

@@ -49,9 +49,19 @@ from ray_tpu.tune.trainable import (
     get_trial_id,
     report,
 )
+from ray_tpu.tune.callbacks import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TBXLoggerCallback,
+)
 from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner
 
 __all__ = [
+    "Callback",
+    "CSVLoggerCallback",
+    "JsonLoggerCallback",
+    "TBXLoggerCallback",
     "Tuner",
     "TuneConfig",
     "ResultGrid",

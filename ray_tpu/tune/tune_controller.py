@@ -61,7 +61,11 @@ class TuneController:
                  max_concurrent: int, run_dir: str,
                  stop: Optional[Any] = None,
                  max_failures: int = 0,
-                 resources_per_trial: Optional[Dict[str, float]] = None):
+                 resources_per_trial: Optional[Dict[str, float]] = None,
+                 callbacks: Optional[List[Any]] = None):
+        from ray_tpu.tune.callbacks import default_callbacks
+
+        self._callbacks = default_callbacks(callbacks)
         self._trainable = trainable
         self._search = search_alg
         self._scheduler = scheduler
@@ -110,6 +114,7 @@ class TuneController:
 
     # ------------------------------------------------------------------
     def run(self) -> List[Trial]:
+        self._callbacks.setup(run_dir=self._run_dir, trials=self.trials)
         try:
             while (len(self.trials) < self._num_samples
                    or any(t.state in (PENDING, RUNNING, PAUSED)
@@ -123,6 +128,7 @@ class TuneController:
             for t in self.trials:
                 self._shutdown_runner(t)
             self._save_experiment_state()
+            self._callbacks.on_experiment_end(trials=self.trials)
         return self.trials
 
     # ------------------------------------------------------------------
@@ -149,6 +155,7 @@ class TuneController:
             self._trainable, t.config, t.trial_id, t.trial_dir,
             checkpoint_path or t.last_checkpoint)
         t.state = RUNNING
+        self._callbacks.on_trial_start(trial=t)
 
     def _shutdown_runner(self, t: Trial):
         if t.runner is not None:
@@ -191,8 +198,11 @@ class TuneController:
             t.last_checkpoint = item["checkpoint_path"]
             metrics = dict(metrics)
             metrics["checkpoint_path"] = item["checkpoint_path"]
+            self._callbacks.on_checkpoint(
+                trial=t, checkpoint_path=item["checkpoint_path"])
         t.last_result = metrics
         t.metrics_history.append(metrics)
+        self._callbacks.on_trial_result(trial=t, result=metrics)
 
         if self._should_stop(t.trial_id, metrics):
             self._complete(t)
@@ -263,6 +273,7 @@ class TuneController:
         self._search.on_trial_complete(t.trial_id, t.last_result,
                                        config=t.config)
         self._scheduler.on_trial_complete(t, t.last_result)
+        self._callbacks.on_trial_complete(trial=t)
 
     def _on_trial_error(self, t: Trial, tb: str):
         t.num_failures += 1
@@ -275,6 +286,7 @@ class TuneController:
         t.state = ERROR
         self._search.on_trial_complete(t.trial_id, None, error=True,
                                        config=t.config)
+        self._callbacks.on_trial_error(trial=t)
 
     def _exploit(self, t: Trial):
         """PBT: restart this trial from the donor's checkpoint with the

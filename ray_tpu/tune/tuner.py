@@ -178,6 +178,7 @@ class Tuner:
             stop=stop,
             max_failures=self.run_config.failure_config.max_failures,
             resources_per_trial=self.resources_per_trial,
+            callbacks=getattr(self.run_config, "callbacks", None),
         )
         if self._restore_path:
             self._seed_restored_trials(controller)

@@ -1,0 +1,220 @@
+"""Experiment-tracker integrations: Weights & Biases, MLflow, Comet.
+
+Counterpart of the reference's python/ray/air/integrations/{wandb,
+mlflow,comet}.py — logger callbacks that mirror every trial's reported
+metrics into an external tracker, plus the in-trainable setup helpers
+(setup_wandb / setup_mlflow).  None of the trackers ship in the
+air-gapped image, so (the tune/external_searchers.py pattern) each
+adapter maps the tracker's documented client surface, takes `_module=`
+for protocol-faithful stub tests, raises a guiding ImportError when
+absent, and activates unchanged where the real package exists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.tune.callbacks import Callback, _flatten
+
+
+def _missing(pkg: str) -> ImportError:
+    return ImportError(
+        f"{pkg} is not installed (pip install {pkg}); in the air-gapped "
+        "image use JsonLoggerCallback / CSVLoggerCallback "
+        "(ray_tpu.tune.callbacks) for local experiment logs")
+
+
+def _numeric(row: Dict[str, Any]) -> Dict[str, float]:
+    return {k: v for k, v in row.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)}
+
+
+class WandbLoggerCallback(Callback):
+    """One wandb run per trial (reference air/integrations/wandb.py
+    WandbLoggerCallback: run-per-trial with trial_id as run name,
+    config logged once, metrics per report)."""
+
+    def __init__(self, project: str, group: Optional[str] = None,
+                 _module=None, **init_kwargs):
+        if _module is None:
+            try:
+                import wandb as _module
+            except ImportError:
+                raise _missing("wandb") from None
+        self._wandb = _module
+        self._project = project
+        self._group = group
+        self._init_kwargs = init_kwargs
+        self._runs: Dict[str, Any] = {}
+
+    def on_trial_start(self, *, trial) -> None:
+        if trial.trial_id in self._runs:  # restart: keep the run
+            return
+        self._runs[trial.trial_id] = self._wandb.init(
+            project=self._project, group=self._group,
+            name=trial.trial_id, config=dict(trial.config),
+            reinit=True, **self._init_kwargs)
+
+    def on_trial_result(self, *, trial, result: Dict[str, Any]) -> None:
+        run = self._runs.get(trial.trial_id)
+        if run is not None:
+            run.log(_numeric(_flatten(result)))
+
+    def _finish(self, trial) -> None:
+        run = self._runs.pop(trial.trial_id, None)
+        if run is not None:
+            run.finish()
+
+    def on_trial_complete(self, *, trial) -> None:
+        self._finish(trial)
+
+    def on_trial_error(self, *, trial) -> None:
+        self._finish(trial)
+
+    def on_experiment_end(self, *, trials) -> None:
+        for trial_id in list(self._runs):
+            self._runs.pop(trial_id).finish()
+
+
+class MlflowLoggerCallback(Callback):
+    """One MLflow run per trial (reference air/integrations/mlflow.py
+    MLflowLoggerCallback over MlflowClient: experiment by name, params
+    once, metrics with step)."""
+
+    def __init__(self, experiment_name: str,
+                 tracking_uri: Optional[str] = None, _module=None):
+        if _module is None:
+            try:
+                import mlflow as _module
+            except ImportError:
+                raise _missing("mlflow") from None
+        self._client = _module.tracking.MlflowClient(
+            tracking_uri=tracking_uri)
+        exp = self._client.get_experiment_by_name(experiment_name)
+        self._experiment_id = (
+            exp.experiment_id if exp is not None
+            else self._client.create_experiment(experiment_name))
+        self._runs: Dict[str, str] = {}
+
+    def on_trial_start(self, *, trial) -> None:
+        if trial.trial_id in self._runs:
+            return
+        run = self._client.create_run(
+            self._experiment_id,
+            tags={"trial_id": trial.trial_id})
+        self._runs[trial.trial_id] = run.info.run_id
+        for k, v in _flatten(trial.config).items():
+            self._client.log_param(run.info.run_id, k, v)
+
+    def on_trial_result(self, *, trial, result: Dict[str, Any]) -> None:
+        run_id = self._runs.get(trial.trial_id)
+        if run_id is None:
+            return
+        step = int(result.get("training_iteration",
+                              len(trial.metrics_history)))
+        for k, v in _numeric(_flatten(result)).items():
+            self._client.log_metric(run_id, k, v, step=step)
+
+    def _finish(self, trial, status: str) -> None:
+        run_id = self._runs.pop(trial.trial_id, None)
+        if run_id is not None:
+            self._client.set_terminated(run_id, status=status)
+
+    def on_trial_complete(self, *, trial) -> None:
+        self._finish(trial, "FINISHED")
+
+    def on_trial_error(self, *, trial) -> None:
+        self._finish(trial, "FAILED")
+
+    def on_experiment_end(self, *, trials) -> None:
+        for trial_id in list(self._runs):
+            self._client.set_terminated(self._runs.pop(trial_id),
+                                        status="FINISHED")
+
+
+class CometLoggerCallback(Callback):
+    """One comet_ml Experiment per trial (reference
+    air/integrations/comet.py CometLoggerCallback)."""
+
+    def __init__(self, project_name: Optional[str] = None, _module=None,
+                 **experiment_kwargs):
+        if _module is None:
+            try:
+                import comet_ml as _module
+            except ImportError:
+                raise _missing("comet-ml") from None
+        self._comet = _module
+        self._project = project_name
+        self._kwargs = experiment_kwargs
+        self._experiments: Dict[str, Any] = {}
+
+    def on_trial_start(self, *, trial) -> None:
+        if trial.trial_id in self._experiments:
+            return
+        exp = self._comet.Experiment(project_name=self._project,
+                                     **self._kwargs)
+        exp.set_name(trial.trial_id)
+        exp.log_parameters(_flatten(trial.config))
+        self._experiments[trial.trial_id] = exp
+
+    def on_trial_result(self, *, trial, result: Dict[str, Any]) -> None:
+        exp = self._experiments.get(trial.trial_id)
+        if exp is not None:
+            step = int(result.get("training_iteration",
+                                  len(trial.metrics_history)))
+            exp.log_metrics(_numeric(_flatten(result)), step=step)
+
+    def _finish(self, trial) -> None:
+        exp = self._experiments.pop(trial.trial_id, None)
+        if exp is not None:
+            exp.end()
+
+    def on_trial_complete(self, *, trial) -> None:
+        self._finish(trial)
+
+    def on_trial_error(self, *, trial) -> None:
+        self._finish(trial)
+
+    def on_experiment_end(self, *, trials) -> None:
+        for trial_id in list(self._experiments):
+            self._experiments.pop(trial_id).end()
+
+
+# ---------------------------------------------------------------------------
+# In-trainable setup helpers
+# ---------------------------------------------------------------------------
+
+
+def setup_wandb(config: Optional[Dict[str, Any]] = None, *,
+                project: str, trial_id: Optional[str] = None,
+                _module=None, **init_kwargs):
+    """Start a wandb run INSIDE a trainable (reference
+    air/integrations/wandb.py setup_wandb): per-worker logging when the
+    callback's driver-side mirroring isn't enough."""
+    if _module is None:
+        try:
+            import wandb as _module
+        except ImportError:
+            raise _missing("wandb") from None
+    return _module.init(project=project, name=trial_id,
+                        config=dict(config or {}), reinit=True,
+                        **init_kwargs)
+
+
+def setup_mlflow(config: Optional[Dict[str, Any]] = None, *,
+                 experiment_name: str,
+                 tracking_uri: Optional[str] = None, _module=None):
+    """Configure the ACTIVE mlflow run inside a trainable (reference
+    air/integrations/mlflow.py setup_mlflow)."""
+    if _module is None:
+        try:
+            import mlflow as _module
+        except ImportError:
+            raise _missing("mlflow") from None
+    if tracking_uri:
+        _module.set_tracking_uri(tracking_uri)
+    _module.set_experiment(experiment_name)
+    run = _module.start_run(nested=True)
+    if config:
+        _module.log_params(_flatten(config))
+    return run

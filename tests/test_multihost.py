@@ -211,14 +211,37 @@ def test_evicted_copy_on_live_node_reconstructs(two_host_cluster):
 
 def test_task_spread_across_real_nodes(two_host_cluster):
     """With 1 head CPU and 2+2 node CPUs, 5 concurrent tasks need all
-    three hosts' worker pools."""
+    three hosts' worker pools.  Concurrency is forced with a rendezvous
+    (every task waits until all 5 have started) instead of a sleep — a
+    loaded CI host can stretch dispatch latency past any fixed sleep,
+    letting freed slots recycle and the assertion flake."""
+
+    @ray_tpu.remote(num_cpus=0)
+    class Barrier:
+        def __init__(self):
+            self.n = 0
+
+        def arrive(self):
+            self.n += 1
+            return self.n
+
+        def count(self):
+            return self.n
+
+    barrier = Barrier.remote()
 
     @ray_tpu.remote
-    def where():
-        time.sleep(0.5)
+    def where(barrier):
+        ray_tpu.get(barrier.arrive.remote())
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if ray_tpu.get(barrier.count.remote()) >= 5:
+                break
+            time.sleep(0.05)
         return os.environ.get("RAY_TPU_NODE_ID", "head")
 
-    spots = set(ray_tpu.get([where.remote() for _ in range(5)], timeout=60))
+    spots = set(ray_tpu.get([where.remote(barrier) for _ in range(5)],
+                            timeout=90))
     assert {"hostA", "hostB"} <= spots
 
 

@@ -59,6 +59,15 @@ def test_catalog_decision_tree():
 
     with pytest.raises(ValueError, match="unknown model_config"):
         Catalog(box4, disc, {"fcnet_hidden": [32]})
+    with pytest.raises(ValueError, match="fcnet_activation"):
+        Catalog(box4, disc, {"fcnet_activation": "gelu"})
+    # Explicit keys the chosen family cannot apply are rejected, not
+    # silently dropped (same contract as DQN/SAC's _q_hiddens).
+    with pytest.raises(ValueError, match="conv_filters"):
+        Catalog(box4, disc,
+                {"conv_filters": [[32, 8, 4]]}).build_module_spec()
+    with pytest.raises(ValueError, match="lstm_cell_size"):
+        Catalog(box4, disc, {"lstm_cell_size": 64}).build_module_spec()
 
 
 def test_custom_catalog_subclass_hooks():

@@ -1,0 +1,334 @@
+"""Tune callback system + logger callbacks + experiment-tracker
+integrations (SURVEY.md §2.3 L3/L6; reference tune/callback.py,
+tune/logger/, air/integrations/{wandb,mlflow,comet}.py)."""
+
+import csv
+import json
+import os
+import types
+
+import pytest
+
+import ray_tpu
+from ray_tpu.tune import (
+    Callback,
+    CSVLoggerCallback,
+    JsonLoggerCallback,
+    TBXLoggerCallback,
+    TuneConfig,
+    Tuner,
+)
+from ray_tpu.train.config import RunConfig
+from ray_tpu.util.integrations import (
+    CometLoggerCallback,
+    MlflowLoggerCallback,
+    WandbLoggerCallback,
+    setup_mlflow,
+    setup_wandb,
+)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _rt():
+    rt = ray_tpu.init(num_cpus=4)
+    yield rt
+    ray_tpu.shutdown()
+
+
+def _fit(tmp_path, callbacks, num_samples=2, trainable=None):
+    if trainable is None:
+        # Nested so cloudpickle ships it by value (workers cannot
+        # import this test module).
+        def trainable(config):
+            from ray_tpu.tune.trainable import report
+
+            for i in range(3):
+                report({"score": config["x"] * (i + 1),
+                        "training_iteration": i + 1})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"x": 1.0},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               num_samples=num_samples),
+        run_config=RunConfig(name="cb", storage_path=str(tmp_path),
+                             callbacks=callbacks))
+    return tuner.fit()
+
+
+class _Recorder(Callback):
+    def __init__(self):
+        self.events = []
+
+    def setup(self, *, run_dir, trials):
+        self.events.append(("setup", run_dir))
+
+    def on_trial_start(self, *, trial):
+        self.events.append(("start", trial.trial_id))
+
+    def on_trial_result(self, *, trial, result):
+        self.events.append(("result", trial.trial_id, result["score"]))
+
+    def on_trial_complete(self, *, trial):
+        self.events.append(("complete", trial.trial_id))
+
+    def on_trial_error(self, *, trial):
+        self.events.append(("error", trial.trial_id))
+
+    def on_experiment_end(self, *, trials):
+        self.events.append(("end", len(trials)))
+
+
+def test_callback_hook_ordering(tmp_path):
+    rec = _Recorder()
+    results = _fit(tmp_path, [rec], num_samples=1)
+    assert len(results) == 1
+    kinds = [e[0] for e in rec.events]
+    assert kinds[0] == "setup"
+    assert kinds[-1] == "end"
+    assert kinds.index("start") < kinds.index("result") < \
+        kinds.index("complete")
+    scores = [e[2] for e in rec.events if e[0] == "result"]
+    assert scores == [1.0, 2.0, 3.0]
+
+
+def test_error_hook_and_containment(tmp_path):
+    def failing(config):
+        raise RuntimeError("boom")
+
+    class Broken(Callback):
+        def on_trial_start(self, *, trial):
+            raise ValueError("bad callback")
+
+    rec = _Recorder()
+    results = _fit(tmp_path, [Broken(), rec], num_samples=1,
+                   trainable=failing)
+    # The broken callback is contained; the recorder still saw the run.
+    assert ("error", "trial_00000") in rec.events
+    assert len(results.errors) == 1
+
+
+def test_json_and_csv_loggers_default(tmp_path):
+    """JSON/CSV loggers are attached by DEFAULT (no callbacks arg)."""
+    results = _fit(tmp_path, None, num_samples=2)
+    assert len(results) == 2
+    for i in range(2):
+        tdir = os.path.join(str(tmp_path), "cb", f"trial_{i:05d}")
+        with open(os.path.join(tdir, "result.json")) as f:
+            rows = [json.loads(line) for line in f]
+        assert [r["score"] for r in rows] == [1.0, 2.0, 3.0]
+        assert rows[0]["trial_id"] == f"trial_{i:05d}"
+        with open(os.path.join(tdir, "progress.csv"), newline="") as f:
+            crows = list(csv.DictReader(f))
+        assert [float(r["score"]) for r in crows] == [1.0, 2.0, 3.0]
+
+
+def test_csv_logger_no_duplicate_header_after_restore(tmp_path):
+    """A fresh CSVLoggerCallback (experiment restore) appends rows under
+    the EXISTING header instead of writing a second one mid-file."""
+    import dataclasses
+
+    @dataclasses.dataclass
+    class _T:
+        trial_id: str
+        trial_dir: str
+        metrics_history: list
+
+    t = _T("trial_x", str(tmp_path / "trial_x"), [])
+    cb1 = CSVLoggerCallback()
+    cb1.on_trial_result(trial=t, result={"score": 1.0})
+    cb2 = CSVLoggerCallback()  # restored controller: fresh instance
+    cb2.on_trial_result(trial=t, result={"score": 2.0})
+    with open(os.path.join(t.trial_dir, "progress.csv"), newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert [float(r["score"]) for r in rows] == [1.0, 2.0]
+
+
+def test_default_loggers_respect_subclasses(tmp_path):
+    from ray_tpu.tune.callbacks import default_callbacks
+
+    class MyJson(JsonLoggerCallback):
+        pass
+
+    cbs = default_callbacks([MyJson()]).callbacks
+    assert sum(isinstance(c, JsonLoggerCallback) for c in cbs) == 1
+
+
+def test_tbx_logger_stub(tmp_path):
+    writes = []
+
+    class _Writer:
+        def __init__(self, logdir=None):
+            self.logdir = logdir
+
+        def add_scalar(self, tag, value, global_step=None):
+            writes.append((tag, value, global_step))
+
+        def flush(self):
+            pass
+
+        def close(self):
+            writes.append(("closed",))
+
+    mod = types.ModuleType("tensorboardX")
+    mod.SummaryWriter = _Writer
+    results = _fit(tmp_path, [TBXLoggerCallback(_module=mod)],
+                   num_samples=1)
+    assert len(results) == 1
+    scalars = [w for w in writes if w[0] == "score"]
+    assert [(v, s) for _, v, s in scalars] == [(1.0, 1), (2.0, 2), (3.0, 3)]
+    assert ("closed",) in writes
+
+
+def test_tbx_logger_real(tmp_path):
+    """tensorboardX ships in the image: the same adapter activates
+    unchanged and writes real event files."""
+    pytest.importorskip("tensorboardX")
+    results = _fit(tmp_path, [TBXLoggerCallback()], num_samples=1)
+    assert len(results) == 1
+    tdir = os.path.join(str(tmp_path), "cb", "trial_00000")
+    assert any(name.startswith("events.out.tfevents")
+               for name in os.listdir(tdir)), os.listdir(tdir)
+
+
+def test_wandb_logger_stub(tmp_path):
+    runs = []
+
+    class _Run:
+        def __init__(self, name, config):
+            self.name = name
+            self.config = config
+            self.logged = []
+            self.finished = False
+
+        def log(self, metrics):
+            self.logged.append(metrics)
+
+        def finish(self):
+            self.finished = True
+
+    mod = types.ModuleType("wandb")
+
+    def init(project=None, group=None, name=None, config=None,
+             reinit=None, **kw):
+        run = _Run(name, config)
+        runs.append((project, run))
+        return run
+
+    mod.init = init
+    cb = WandbLoggerCallback(project="proj", _module=mod)
+    results = _fit(tmp_path, [cb], num_samples=2)
+    assert len(results) == 2
+    assert all(p == "proj" for p, _ in runs)
+    assert sorted(r.name for _, r in runs) == ["trial_00000",
+                                               "trial_00001"]
+    for _, run in runs:
+        assert [m["score"] for m in run.logged] == [1.0, 2.0, 3.0]
+        assert run.finished
+    with pytest.raises(ImportError, match="CSVLoggerCallback"):
+        WandbLoggerCallback(project="p")
+
+
+def test_mlflow_logger_stub(tmp_path):
+    state = {"params": [], "metrics": [], "terminated": []}
+
+    class _Info:
+        def __init__(self, run_id):
+            self.run_id = run_id
+
+    class _MlRun:
+        def __init__(self, run_id):
+            self.info = _Info(run_id)
+
+    class _Client:
+        def __init__(self, tracking_uri=None):
+            self._n = 0
+
+        def get_experiment_by_name(self, name):
+            return None
+
+        def create_experiment(self, name):
+            return "exp1"
+
+        def create_run(self, experiment_id, tags=None):
+            self._n += 1
+            return _MlRun(f"run{self._n}")
+
+        def log_param(self, run_id, k, v):
+            state["params"].append((run_id, k, v))
+
+        def log_metric(self, run_id, k, v, step=None):
+            state["metrics"].append((run_id, k, v, step))
+
+        def set_terminated(self, run_id, status=None):
+            state["terminated"].append((run_id, status))
+
+    mod = types.ModuleType("mlflow")
+    mod.tracking = types.SimpleNamespace(MlflowClient=_Client)
+    cb = MlflowLoggerCallback("exp", _module=mod)
+    results = _fit(tmp_path, [cb], num_samples=1)
+    assert len(results) == 1
+    assert ("run1", "x", 1.0) in state["params"]
+    scores = [(v, s) for rid, k, v, s in state["metrics"] if k == "score"]
+    assert scores == [(1.0, 1), (2.0, 2), (3.0, 3)]
+    assert state["terminated"] == [("run1", "FINISHED")]
+
+
+def test_comet_logger_stub(tmp_path):
+    exps = []
+
+    class _Exp:
+        def __init__(self, project_name=None, **kw):
+            self.project = project_name
+            self.name = None
+            self.params = {}
+            self.metrics = []
+            self.ended = False
+            exps.append(self)
+
+        def set_name(self, name):
+            self.name = name
+
+        def log_parameters(self, params):
+            self.params.update(params)
+
+        def log_metrics(self, metrics, step=None):
+            self.metrics.append((metrics, step))
+
+        def end(self):
+            self.ended = True
+
+    mod = types.ModuleType("comet_ml")
+    mod.Experiment = _Exp
+    results = _fit(tmp_path,
+                   [CometLoggerCallback(project_name="p", _module=mod)],
+                   num_samples=1)
+    assert len(results) == 1
+    (exp,) = exps
+    assert exp.name == "trial_00000" and exp.params == {"x": 1.0}
+    assert [m["score"] for m, _ in exp.metrics] == [1.0, 2.0, 3.0]
+    assert exp.ended
+
+
+def test_setup_helpers_stubs():
+    mod = types.ModuleType("wandb")
+    captured = {}
+
+    def init(**kw):
+        captured.update(kw)
+        return "run"
+
+    mod.init = init
+    assert setup_wandb({"lr": 0.1}, project="p", trial_id="t1",
+                       _module=mod) == "run"
+    assert captured["config"] == {"lr": 0.1} and captured["name"] == "t1"
+
+    ml = types.ModuleType("mlflow")
+    calls = []
+    ml.set_tracking_uri = lambda uri: calls.append(("uri", uri))
+    ml.set_experiment = lambda name: calls.append(("exp", name))
+    ml.start_run = lambda nested=False: calls.append(("run", nested)) or "r"
+    ml.log_params = lambda params: calls.append(("params", params))
+    assert setup_mlflow({"lr": 0.1}, experiment_name="e",
+                        tracking_uri="file:///tmp/ml", _module=ml) == "r"
+    assert ("exp", "e") in calls and ("params", {"lr": 0.1}) in calls
