@@ -60,7 +60,12 @@ class Catalog:
                 f"known: {sorted(MODEL_DEFAULTS)}")
         self.observation_space = observation_space
         self.action_space = action_space
-        self._explicit = set(model_config or {})
+        # Keys the user actually asked for: presence alone doesn't
+        # count when the value IS the default (configs that spell out
+        # defaults, e.g. conv_filters=None on a 1-D env, request
+        # nothing and must not trip the applicability guard).
+        self._explicit = {k for k, v in (model_config or {}).items()
+                          if v != MODEL_DEFAULTS[k]}
         self.model_config: Dict[str, Any] = {
             **MODEL_DEFAULTS, **(model_config or {})}
         act = self.model_config["fcnet_activation"]

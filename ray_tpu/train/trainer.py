@@ -94,31 +94,54 @@ class DataParallelTrainer:
         latest_ckpt = self.resume_from_checkpoint
         history: List[Dict[str, Any]] = []
 
-        while True:
-            try:
-                metrics = self._run_attempt(storage, latest_ckpt, history)
-                return Result(
-                    metrics=metrics,
-                    checkpoint=storage.latest_checkpoint(),
-                    path=storage.run_dir,
-                    metrics_history=history)
-            except TrainingFailedError:
-                raise
-            except Exception as e:
-                failures += 1
-                if max_failures >= 0 and failures > max_failures:
-                    if isinstance(e, _UserLoopError):
-                        raise TrainingFailedError(str(e)) from e
-                    raise TrainingFailedError(
-                        f"training failed after {failures} failure(s): "
-                        f"{e}") from e
-                # restart from the latest persisted checkpoint
-                latest_ckpt = storage.latest_checkpoint() or latest_ckpt
+        # RunConfig.callbacks reach standalone fits too (reference:
+        # Train dispatches the same tune Callback surface; SURVEY L6
+        # AIR-shared config).  The run is exposed to callbacks as one
+        # trial-shaped handle.
+        from ray_tpu.tune.callbacks import default_callbacks
+
+        callbacks = default_callbacks(getattr(cfg, "callbacks", None))
+        handle = _RunHandle(
+            trial_id=storage.name or "train_run",
+            trial_dir=storage.run_dir,
+            config=dict(self.train_loop_config),
+            metrics_history=history)
+        callbacks.setup(run_dir=storage.run_dir, trials=[handle])
+        callbacks.on_trial_start(trial=handle)
+        try:
+            while True:
+                try:
+                    metrics = self._run_attempt(
+                        storage, latest_ckpt, history,
+                        callbacks=callbacks, handle=handle)
+                    callbacks.on_trial_complete(trial=handle)
+                    return Result(
+                        metrics=metrics,
+                        checkpoint=storage.latest_checkpoint(),
+                        path=storage.run_dir,
+                        metrics_history=history)
+                except TrainingFailedError:
+                    callbacks.on_trial_error(trial=handle)
+                    raise
+                except Exception as e:
+                    failures += 1
+                    if max_failures >= 0 and failures > max_failures:
+                        callbacks.on_trial_error(trial=handle)
+                        if isinstance(e, _UserLoopError):
+                            raise TrainingFailedError(str(e)) from e
+                        raise TrainingFailedError(
+                            f"training failed after {failures} "
+                            f"failure(s): {e}") from e
+                    # restart from the latest persisted checkpoint
+                    latest_ckpt = storage.latest_checkpoint() or latest_ckpt
+        finally:
+            callbacks.on_experiment_end(trials=[handle])
 
     # ------------------------------------------------------------------
     def _run_attempt(self, storage: StorageContext,
                      checkpoint: Optional[Checkpoint],
-                     history: List[Dict[str, Any]]) -> Optional[Dict]:
+                     history: List[Dict[str, Any]],
+                     callbacks=None, handle=None) -> Optional[Dict]:
         from ray_tpu.train.worker_group import WorkerGroup
         from ray_tpu.train.backend import _jax_env
 
@@ -148,14 +171,16 @@ class DataParallelTrainer:
                 for i, w in enumerate(group.workers)
             ], timeout=120)
 
-            return self._poll_results(group, history)
+            return self._poll_results(group, history,
+                                      callbacks=callbacks, handle=handle)
         finally:
             try:
                 backend.on_shutdown(group, self.backend_config)
             finally:
                 group.shutdown()
 
-    def _poll_results(self, group, history) -> Optional[Dict]:
+    def _poll_results(self, group, history,
+                      callbacks=None, handle=None) -> Optional[Dict]:
         finished = set()
         last_rank0: Optional[Dict] = None
         deadline_slack = 600.0  # no single poll may hang longer than this
@@ -179,9 +204,31 @@ class DataParallelTrainer:
                     entry = dict(item.get("metrics") or {})
                     if item.get("checkpoint_path"):
                         entry["checkpoint_path"] = item["checkpoint_path"]
+                        if callbacks is not None:
+                            handle.last_checkpoint = \
+                                item["checkpoint_path"]
+                            callbacks.on_checkpoint(
+                                trial=handle,
+                                checkpoint_path=item["checkpoint_path"])
                     history.append(entry)
+                    if callbacks is not None:
+                        callbacks.on_trial_result(trial=handle,
+                                                  result=entry)
             time.sleep(0.01)
         return last_rank0
+
+
+@dataclasses.dataclass
+class _RunHandle:
+    """Trial-shaped view of a standalone train run for tune callbacks
+    (same attribute surface loggers read: trial_id/trial_dir/config/
+    metrics_history)."""
+
+    trial_id: str
+    trial_dir: str
+    config: Dict[str, Any]
+    metrics_history: List[Dict[str, Any]]
+    last_checkpoint: Optional[str] = None
 
 
 class _UserLoopError(RuntimeError):

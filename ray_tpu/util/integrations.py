@@ -50,10 +50,15 @@ class WandbLoggerCallback(Callback):
     def on_trial_start(self, *, trial) -> None:
         if trial.trial_id in self._runs:  # restart: keep the run
             return
-        self._runs[trial.trial_id] = self._wandb.init(
+        # User init_kwargs OVERRIDE the computed ones (a duplicated
+        # name=/reinit= must not TypeError inside the contained hook,
+        # which would silently disable the whole mirror).
+        kwargs: Dict[str, Any] = dict(
             project=self._project, group=self._group,
             name=trial.trial_id, config=dict(trial.config),
-            reinit=True, **self._init_kwargs)
+            reinit=True)
+        kwargs.update(self._init_kwargs)
+        self._runs[trial.trial_id] = self._wandb.init(**kwargs)
 
     def on_trial_result(self, *, trial, result: Dict[str, Any]) -> None:
         run = self._runs.get(trial.trial_id)
@@ -196,9 +201,10 @@ def setup_wandb(config: Optional[Dict[str, Any]] = None, *,
             import wandb as _module
         except ImportError:
             raise _missing("wandb") from None
-    return _module.init(project=project, name=trial_id,
-                        config=dict(config or {}), reinit=True,
-                        **init_kwargs)
+    kwargs: Dict[str, Any] = dict(project=project, name=trial_id,
+                                  config=dict(config or {}), reinit=True)
+    kwargs.update(init_kwargs)
+    return _module.init(**kwargs)
 
 
 def setup_mlflow(config: Optional[Dict[str, Any]] = None, *,

@@ -68,6 +68,11 @@ def test_catalog_decision_tree():
                 {"conv_filters": [[32, 8, 4]]}).build_module_spec()
     with pytest.raises(ValueError, match="lstm_cell_size"):
         Catalog(box4, disc, {"lstm_cell_size": 64}).build_module_spec()
+    # ...but spelling out DEFAULT values requests nothing and is fine.
+    spec = Catalog(box4, disc, {"conv_filters": None,
+                                "lstm_cell_size": 256}
+                   ).build_module_spec()
+    assert type(spec) is RLModuleSpec
 
 
 def test_custom_catalog_subclass_hooks():

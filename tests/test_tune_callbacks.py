@@ -67,7 +67,8 @@ class _Recorder(Callback):
         self.events.append(("start", trial.trial_id))
 
     def on_trial_result(self, *, trial, result):
-        self.events.append(("result", trial.trial_id, result["score"]))
+        self.events.append(("result", trial.trial_id,
+                            result.get("score", result.get("loss"))))
 
     def on_trial_complete(self, *, trial):
         self.events.append(("complete", trial.trial_id))
@@ -228,6 +229,13 @@ def test_wandb_logger_stub(tmp_path):
     with pytest.raises(ImportError, match="CSVLoggerCallback"):
         WandbLoggerCallback(project="p")
 
+    # User init kwargs that collide with computed ones (name/reinit)
+    # override instead of raising TypeError inside the contained hook.
+    runs.clear()
+    cb = WandbLoggerCallback(project="proj", name="fixed", _module=mod)
+    _fit(tmp_path / "w2", [cb], num_samples=1)
+    assert [r.name for _, r in runs] == ["fixed"]
+
 
 def test_mlflow_logger_stub(tmp_path):
     state = {"params": [], "metrics": [], "terminated": []}
@@ -308,6 +316,35 @@ def test_comet_logger_stub(tmp_path):
     assert exp.name == "trial_00000" and exp.params == {"x": 1.0}
     assert [m["score"] for m, _ in exp.metrics] == [1.0, 2.0, 3.0]
     assert exp.ended
+
+
+def test_train_fit_dispatches_callbacks(tmp_path):
+    """Standalone JaxTrainer.fit runs the same callback surface
+    (reference: Train shares RunConfig.callbacks with Tune)."""
+    from ray_tpu import train
+    from ray_tpu.train import JaxTrainer, ScalingConfig
+
+    def loop(config):
+        for i in range(3):
+            train.report({"loss": 1.0 / (i + 1),
+                          "training_iteration": i + 1})
+
+    rec = _Recorder()
+    res = JaxTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(storage_path=str(tmp_path), name="cbtrain",
+                             callbacks=[rec]),
+    ).fit()
+    assert len(res.metrics_history) == 3
+    kinds = [e[0] for e in rec.events]
+    assert kinds[0] == "setup" and kinds[-1] == "end"
+    assert kinds.count("result") == 3
+    assert "complete" in kinds
+    # Default JSON logger wrote the run's result.json too.
+    with open(os.path.join(str(tmp_path), "cbtrain", "result.json")) as f:
+        rows = [json.loads(line) for line in f]
+    assert [r["loss"] for r in rows] == [1.0, 0.5, 1.0 / 3.0]
 
 
 def test_setup_helpers_stubs():
