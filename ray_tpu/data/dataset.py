@@ -331,6 +331,67 @@ class Dataset:
 
         return self._write(write_block_tfrecords, path)
 
+    def write_numpy(self, path: str, *, column: str = "data"
+                    ) -> List[str]:
+        """One .npy per block from `column` (reference
+        Dataset.write_numpy / numpy_datasink.py)."""
+        import functools
+
+        from ray_tpu.data.datasource import write_block_numpy
+
+        return self._write(
+            functools.partial(write_block_numpy, column=column), path)
+
+    def write_images(self, path: str, *, column: str = "image",
+                     file_format: str = "png") -> List[str]:
+        """One image file per row (reference Dataset.write_images)."""
+        import functools
+
+        from ray_tpu.data.datasource import write_block_images
+
+        return self._write(
+            functools.partial(write_block_images, column=column,
+                              file_format=file_format), path)
+
+    def write_sql(self, sql: str, connection_factory) -> List[str]:
+        """executemany `sql` (an INSERT with placeholders) over every
+        block; the factory opens connections inside the write tasks
+        (reference Dataset.write_sql / sql_datasink.py)."""
+        import functools
+
+        from ray_tpu.data.datasource import write_block_sql
+
+        return self._write(
+            functools.partial(write_block_sql, sql=sql,
+                              connection_factory=connection_factory),
+            "")
+
+    def write_mongo(self, uri: str, database: str, collection: str, *,
+                    _module=None) -> List[str]:
+        """insert_many every block's rows (reference
+        Dataset.write_mongo; gated on pymongo)."""
+        import functools
+
+        from ray_tpu.data.datasource import write_block_mongo
+
+        return self._write(
+            functools.partial(write_block_mongo, uri=uri,
+                              database=database, collection=collection,
+                              _module=_module), "")
+
+    def write_bigquery(self, project_id: str, dataset: str, *,
+                       _module=None) -> List[str]:
+        """Load every block into `project.dataset` (reference
+        Dataset.write_bigquery; gated on google-cloud-bigquery)."""
+        import functools
+
+        from ray_tpu.data.datasource import write_block_bigquery
+
+        return self._write(
+            functools.partial(write_block_bigquery,
+                              project_id=project_id, dataset=dataset,
+                              _module=_module), "")
+
     def write_avro(self, path: str) -> List[str]:
         """Avro Object Container Files, deflate codec, schema inferred
         per block; no avro package needed (data/avro.py)."""

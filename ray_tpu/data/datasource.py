@@ -693,6 +693,102 @@ def write_block_webdataset(block: Block, path: str, index: int) -> str:
     return out
 
 
+def write_block_numpy(block: Block, path: str, index: int,
+                      column: str = "data") -> str:
+    """One .npy per block from a single column (reference
+    _internal/datasource/numpy_datasink.py)."""
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    out = os.path.join(path, f"part-{index:05d}.npy")
+    acc = BlockAccessor(block)
+    rows = [np.asarray(row[column]) for row in acc.iter_rows()]
+    if len({r.shape for r in rows}) > 1:
+        # A ragged .npy needs a pickled object array, which read_numpy
+        # (np.load allow_pickle=False) rightly refuses — fail loudly
+        # instead of writing a file the read path cannot open.
+        raise ValueError(
+            f"write_numpy needs uniform-shaped rows in column "
+            f"{column!r}; use write_parquet for variable-shaped "
+            "tensor columns")
+    np.save(out, np.stack(rows) if rows else np.empty((0,)))
+    return out
+
+
+def write_block_images(block: Block, path: str, index: int,
+                       column: str = "image",
+                       file_format: str = "png") -> str:
+    """One image file per row (reference image_datasink.py)."""
+    from PIL import Image
+
+    from ray_tpu.data.block import BlockAccessor
+
+    os.makedirs(path, exist_ok=True)
+    last = ""
+    for i, row in enumerate(BlockAccessor(block).iter_rows()):
+        last = os.path.join(
+            path, f"part-{index:05d}-{i:06d}.{file_format}")
+        Image.fromarray(np.asarray(row[column])).save(last)
+    return last  # never empty: the write transform skips empty blocks
+
+
+def write_block_sql(block: Block, path: str, index: int, *,
+                    sql: str, connection_factory) -> str:
+    """executemany an INSERT statement with one parameter tuple per row,
+    column order = block schema order; the connection opens INSIDE the
+    write task (reference _internal/datasource/sql_datasink.py)."""
+    from ray_tpu.data.block import BlockAccessor
+
+    acc = BlockAccessor(block)
+    rows = [tuple(row.values()) for row in acc.iter_rows()]
+    conn = connection_factory()
+    try:
+        cur = conn.cursor()
+        cur.executemany(sql, rows)
+        conn.commit()
+    finally:
+        conn.close()
+    return f"sql-part-{index:05d}:{len(rows)}"
+
+
+def write_block_mongo(block: Block, path: str, index: int, *,
+                      uri: str, database: str, collection: str,
+                      _module=None) -> str:
+    """insert_many the block's rows (reference mongo_datasink.py);
+    gated on pymongo like data/external.py readers."""
+    import importlib
+
+    from ray_tpu.data.block import BlockAccessor
+
+    pymongo = _module or importlib.import_module("pymongo")
+    docs = [dict(row) for row in BlockAccessor(block).iter_rows()]
+    client = pymongo.MongoClient(uri)
+    try:
+        if docs:
+            client[database][collection].insert_many(docs)
+    finally:
+        client.close()
+    return f"mongo-part-{index:05d}:{len(docs)}"
+
+
+def write_block_bigquery(block: Block, path: str, index: int, *,
+                         project_id: str, dataset: str,
+                         _module=None) -> str:
+    """Load the block into a BigQuery table via the arrow/pandas loader
+    (reference bigquery_datasink.py)."""
+    import importlib
+
+    from ray_tpu.data.block import block_to_arrow
+
+    bq = _module or importlib.import_module("google.cloud.bigquery")
+    client = bq.Client(project=project_id)
+    table = block_to_arrow(block)
+    job = client.load_table_from_dataframe(
+        table.to_pandas(), f"{project_id}.{dataset}")
+    job.result()
+    return f"bigquery-part-{index:05d}:{table.num_rows}"
+
+
 # ---------------------------------------------------------------------------
 # ObjectRef-backed blocks (from_arrow_refs / from_pandas_refs / ...)
 # ---------------------------------------------------------------------------

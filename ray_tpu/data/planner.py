@@ -148,6 +148,11 @@ def _write_transform(op: L.Write) -> BlockTransform:
         import uuid
 
         for block in blocks:
+            if block.num_rows == 0:
+                # No writer should see an empty block (per-row sinks
+                # like write_images would otherwise have to fabricate
+                # a path for a file they never created).
+                continue
             # Part index must be globally unique across tasks (a worker
             # reused for two write tasks must not overwrite its own parts).
             idx = uuid.uuid4().int % 10**10

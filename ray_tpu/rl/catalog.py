@@ -64,8 +64,11 @@ class Catalog:
         # count when the value IS the default (configs that spell out
         # defaults, e.g. conv_filters=None on a 1-D env, request
         # nothing and must not trip the applicability guard).
-        self._explicit = {k for k, v in (model_config or {}).items()
-                          if v != MODEL_DEFAULTS[k]}
+        # np.array_equal, not !=: array-valued entries (fcnet_hiddens
+        # as an ndarray) must not raise ambiguous-truth errors.
+        self._explicit = {
+            k for k, v in (model_config or {}).items()
+            if not np.array_equal(v, MODEL_DEFAULTS[k])}
         self.model_config: Dict[str, Any] = {
             **MODEL_DEFAULTS, **(model_config or {})}
         act = self.model_config["fcnet_activation"]

@@ -511,6 +511,127 @@ def test_from_tf_duck():
     assert rd.from_tf(_TFTuples()).take_all()[0]["col_1"] == 2
 
 
+def test_write_numpy_roundtrip(tmp_path):
+    ds = rd.from_numpy(np.arange(12).reshape(12, 1), column="v")
+    files = ds.write_numpy(str(tmp_path / "np"), column="v")
+    back = rd.read_numpy(files, column="v")
+    got = np.sort(np.concatenate(
+        [np.asarray(r["v"]).ravel() for r in back.take_all()]))
+    np.testing.assert_array_equal(got, np.arange(12))
+
+
+def test_write_images_roundtrip(tmp_path):
+    imgs = (np.arange(4 * 5 * 3, dtype=np.uint8)
+            .reshape(1, 4, 5, 3).repeat(3, axis=0))
+    ds = rd.from_numpy(imgs, column="image")
+    files = ds.write_images(str(tmp_path / "imgs"))
+    assert all(f.endswith(".png") for f in files)
+    back = rd.read_images(str(tmp_path / "imgs")).take_all()
+    assert len(back) == 3
+    np.testing.assert_array_equal(np.asarray(back[0]["image"]), imgs[0])
+
+
+def test_write_sql_roundtrip(tmp_path):
+    import sqlite3
+
+    db = str(tmp_path / "w.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE t (a INTEGER, b TEXT)")
+    conn.commit()
+    conn.close()
+
+    def factory(db=db):
+        import sqlite3
+
+        return sqlite3.connect(db)
+
+    ds = rd.from_items([{"a": i, "b": f"s{i}"} for i in range(7)])
+    parts = ds.write_sql("INSERT INTO t VALUES (?, ?)", factory)
+    assert parts
+    back = rd.read_sql("SELECT a, b FROM t ORDER BY a", factory)
+    rows = back.take_all()
+    assert len(rows) == 7 and rows[3] == {"a": 3, "b": "s3"}
+
+
+def test_write_images_skips_empty_blocks(tmp_path):
+    """Blocks emptied by a filter must not fabricate paths to files
+    that were never written."""
+    imgs = np.zeros((4, 4, 5, 3), np.uint8)
+    ds = rd.from_numpy(imgs, column="image").filter(lambda r: False)
+    files = ds.write_images(str(tmp_path / "none"))
+    assert files == []
+
+
+def test_write_numpy_ragged_raises(tmp_path):
+    rows = [{"v": np.zeros(2)}, {"v": np.zeros(3)}]
+    ds = rd.from_items(rows, parallelism=1)  # one ragged block
+    with pytest.raises(Exception, match="write_parquet"):
+        ds.write_numpy(str(tmp_path / "rg"), column="v")
+
+
+def test_catalog_ndarray_model_config():
+    import gymnasium as gym
+
+    from ray_tpu.rl import Catalog
+
+    spec = Catalog(gym.spaces.Box(-1, 1, (4,), np.float32),
+                   gym.spaces.Discrete(2),
+                   {"fcnet_hiddens": np.array([32, 16])}
+                   ).build_module_spec()
+    assert tuple(spec.hidden_sizes) == (32, 16)
+
+
+def test_write_mongo_bigquery_stubs():
+    from ray_tpu.data.block import batch_to_block
+    from ray_tpu.data.datasource import (
+        write_block_bigquery,
+        write_block_mongo,
+    )
+
+    block = batch_to_block({"x": np.asarray([1, 2, 3])})
+    inserted = []
+
+    class _Coll:
+        def insert_many(self, docs):
+            inserted.extend(docs)
+
+    class _Mongo:
+        def __init__(self, uri):
+            pass
+
+        def __getitem__(self, name):
+            return {"c": _Coll()}
+
+        def close(self):
+            pass
+
+    mod = types.ModuleType("pymongo")
+    mod.MongoClient = _Mongo
+    out = write_block_mongo(block, "", 0, uri="mongodb://h",
+                            database="d", collection="c", _module=mod)
+    assert out.endswith(":3") and [d["x"] for d in inserted] == [1, 2, 3]
+
+    loaded = []
+
+    class _Job:
+        def result(self):
+            return None
+
+    class _BQClient:
+        def __init__(self, project=None):
+            pass
+
+        def load_table_from_dataframe(self, df, table):
+            loaded.append((table, len(df)))
+            return _Job()
+
+    bq = types.ModuleType("google.cloud.bigquery")
+    bq.Client = _BQClient
+    out = write_block_bigquery(block, "", 0, project_id="p",
+                               dataset="d.t", _module=bq)
+    assert out.endswith(":3") and loaded == [("p.d.t", 3)]
+
+
 def test_missing_module_guidance():
     with pytest.raises(ImportError, match="read_parquet"):
         rd.read_lance("mem://t")
