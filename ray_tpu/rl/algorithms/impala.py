@@ -28,6 +28,11 @@ from ray_tpu.rl.config import AlgorithmConfig
 from ray_tpu.rl.episode import SingleAgentEpisode
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
+from ray_tpu.rl.sequences import (
+    forward_episodes_seq,
+    segment_rows,
+    stack_segments,
+)
 
 
 class IMPALAConfig(AlgorithmConfig):
@@ -71,7 +76,9 @@ class IMPALALearner(JaxLearner):
         return -(logp * adv)
 
     def loss(self, params, batch: Dict[str, jnp.ndarray], rng):
-        dist_inputs, values = self.spec.forward(params, batch["obs"])
+        # Sequence batches (recurrent specs) flatten over time here;
+        # the masked tail below is layout-agnostic.
+        dist_inputs, values, batch = self.forward_flat(params, batch)
         dist = self.spec.dist(dist_inputs)
         logp = dist.logp(batch["actions"])
         mask = batch["mask"]
@@ -118,22 +125,38 @@ def compute_vtrace(episodes: List[SingleAgentEpisode], params, spec,
 
     One batched forward evaluates the CURRENT policy's values and logp on
     every step of every episode (behavior logp rides in the episodes);
-    the backward recursion is O(T) host numpy.
+    the backward recursion is O(T) host numpy.  Recurrent specs run one
+    forward_seq scan per episode batch instead (LSTM state built from
+    each episode's own history, zero at fragment start — the same
+    truncated-BPTT view the learner trains with).
     """
-    obs_all = np.concatenate(
-        [np.asarray(e.obs).reshape(len(e.obs), -1) for e in episodes])
-    dist_inputs, values_all = spec.forward(params, jnp.asarray(obs_all))
-    dist_inputs = np.asarray(dist_inputs)
-    values_all = np.asarray(values_all)
+    recurrent = getattr(spec, "recurrent", False)
+    if recurrent:
+        # State resets at every max_seq_len boundary: the learner will
+        # recompute logp/values from exactly this state trajectory
+        # (segment_rows), so rho and the vf targets stay consistent.
+        di_seq, v_seq, _lens = forward_episodes_seq(
+            spec, params, episodes,
+            reset_every=int(spec.max_seq_len))
+    else:
+        obs_all = np.concatenate(
+            [np.asarray(e.obs).reshape(len(e.obs), -1) for e in episodes])
+        dist_inputs, values_all = spec.forward(params, jnp.asarray(obs_all))
+        dist_inputs = np.asarray(dist_inputs)
+        values_all = np.asarray(values_all)
 
     out: List[Dict[str, np.ndarray]] = []
     off = 0
-    for ep in episodes:
+    for i, ep in enumerate(episodes):
         T = len(ep)
         n = T + 1
-        di = dist_inputs[off:off + n]
-        v = values_all[off:off + n].astype(np.float32)
-        off += n
+        if recurrent:
+            di = di_seq[i, :n]
+            v = v_seq[i, :n].astype(np.float32)
+        else:
+            di = dist_inputs[off:off + n]
+            v = values_all[off:off + n].astype(np.float32)
+            off += n
         actions = np.asarray(ep.actions)
         target_logp = np.asarray(
             spec.dist(jnp.asarray(di[:T])).logp(jnp.asarray(actions)),
@@ -259,30 +282,45 @@ class IMPALA(Algorithm):
         metrics: Dict[str, Any] = {}
         trained = 0
         params = self.learner_group.get_weights()
+        spec = self.env_runner_group.spec
+        recurrent = getattr(spec, "recurrent", False)
         for episodes in episode_lists:
             if not episodes:
                 continue
             rows = compute_vtrace(
-                episodes, params, self.env_runner_group.spec, cfg.gamma,
+                episodes, params, spec, cfg.gamma,
                 cfg.vtrace_clip_rho_threshold, cfg.vtrace_clip_c_threshold)
-            flat = {k: np.concatenate([r[k] for r in rows])
-                    for k in rows[0]}
-            n = flat["obs"].shape[0]
-            target = cfg.train_batch_size
-            mask = np.ones(n, dtype=np.float32)
-            if n < target:
-                pad = target - n
-                flat = {k: np.concatenate(
-                    [v, np.zeros((pad,) + v.shape[1:], dtype=v.dtype)])
-                    for k, v in flat.items()}
-                mask = np.concatenate([mask,
-                                       np.zeros(pad, dtype=np.float32)])
+            if recurrent:
+                T = int(spec.max_seq_len)
+                segs = segment_rows(rows, T)
+                # Pow-2 bucketed segment count: bounded compiled shapes
+                # (log many) without padding to the all-1-step-segments
+                # worst case.  train_batch_size intentionally plays no
+                # role here — IMPALA consumes each fragment as one
+                # batch (the reference's learner-queue semantics).
+                target = 1 << (len(segs) - 1).bit_length()
+                flat = stack_segments(segs, target)
+                n = int(flat["mask"].sum())
             else:
-                flat = {k: v[:target] for k, v in flat.items()}
-                mask = mask[:target]
-            flat["mask"] = mask
+                flat = {k: np.concatenate([r[k] for r in rows])
+                        for k in rows[0]}
+                n = flat["obs"].shape[0]
+                target = cfg.train_batch_size
+                mask = np.ones(n, dtype=np.float32)
+                if n < target:
+                    pad = target - n
+                    flat = {k: np.concatenate(
+                        [v, np.zeros((pad,) + v.shape[1:], dtype=v.dtype)])
+                        for k, v in flat.items()}
+                    mask = np.concatenate(
+                        [mask, np.zeros(pad, dtype=np.float32)])
+                else:
+                    flat = {k: v[:target] for k, v in flat.items()}
+                    mask = mask[:target]
+                flat["mask"] = mask
+                n = min(n, target)
             if cfg.normalize_advantages:
-                valid = mask > 0
+                valid = flat["mask"] > 0
                 mean = flat["advantages"][valid].mean()
                 std = flat["advantages"][valid].std() + 1e-8
                 flat["advantages"] = np.where(
@@ -290,7 +328,7 @@ class IMPALA(Algorithm):
                 ).astype(np.float32)
             for _ in range(cfg.num_sgd_iter):
                 metrics.update(self.learner_group.update_from_batch(flat))
-            trained += min(n, target)
+            trained += n
             self._batches_since_broadcast += 1
         if self._batches_since_broadcast >= cfg.broadcast_interval:
             w = self.learner_group.get_weights()

@@ -23,6 +23,11 @@ from ray_tpu.rl.config import AlgorithmConfig
 from ray_tpu.rl.episode import SingleAgentEpisode
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
+from ray_tpu.rl.sequences import (
+    forward_episodes_seq,
+    segment_rows,
+    stack_segments,
+)
 
 
 class PPOConfig(AlgorithmConfig):
@@ -50,25 +55,9 @@ class PPOLearner(JaxLearner):
 
     def loss(self, params, batch: Dict[str, jnp.ndarray], rng
              ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-        obs = batch["obs"]
-        if obs.ndim == 3:
-            # Sequence minibatch ([B, T, ·] + is_first) for recurrent
-            # specs: one forward_seq scan, then the SAME flat masked
-            # PPO tail (padded steps carry mask 0).
-            dist_inputs, values = self.spec.forward_seq(
-                params, obs, batch["is_first"])
-            dist_inputs = dist_inputs.reshape(-1, dist_inputs.shape[-1])
-            values = values.reshape(-1)
-            acts = batch["actions"]
-            actions = acts.reshape(-1, *acts.shape[2:])
-            batch = {**batch,
-                     "actions": actions,
-                     "logp": batch["logp"].reshape(-1),
-                     "advantages": batch["advantages"].reshape(-1),
-                     "value_targets": batch["value_targets"].reshape(-1),
-                     "mask": batch["mask"].reshape(-1)}
-        else:
-            dist_inputs, values = self.spec.forward(params, obs)
+        # Sequence minibatches (recurrent specs) flatten over time here;
+        # the masked PPO tail below is layout-agnostic.
+        dist_inputs, values, batch = self.forward_flat(params, batch)
         dist = self.spec.dist(dist_inputs)
         logp = dist.logp(batch["actions"])
         mask = batch["mask"]
@@ -108,24 +97,7 @@ def compute_gae(episodes: List[SingleAgentEpisode], params,
         # the episode's own history — run forward_seq over each whole
         # fragment (zero state at its start, matching training's
         # truncated-BPTT view) and read the value at the final obs.
-        # Lengths pad to the next power of two so the scan compiles a
-        # bounded number of shapes across train steps.
-        # BOTH axes pad to powers of two so the scan compiles a bounded
-        # number of shapes across a run (episode count varies with env
-        # termination; extra zero rows cost nothing — only
-        # vals[i, lens[i]-1] for real rows is read).
-        lens = [len(e.obs) for e in episodes]
-        Lmax = 1 << (max(lens) - 1).bit_length()
-        N = 1 << (len(episodes) - 1).bit_length()
-        obs_dim = int(np.prod(np.asarray(episodes[0].obs[0]).shape))
-        obs_pad = np.zeros((N, Lmax, obs_dim), np.float32)
-        isf = np.zeros((N, Lmax), np.float32)
-        isf[:, 0] = 1.0
-        for i, e in enumerate(episodes):
-            obs_pad[i, :lens[i]] = np.asarray(e.obs).reshape(lens[i], -1)
-        _, vals = spec.forward_seq(params, jnp.asarray(obs_pad),
-                                   jnp.asarray(isf))
-        vals = np.asarray(vals)
+        _, vals, lens = forward_episodes_seq(spec, params, episodes)
         boot = np.array([vals[i, lens[i] - 1]
                          for i in range(len(episodes))])
     else:
@@ -246,22 +218,7 @@ class PPO(Algorithm):
         ONE [mb_seqs, T] update."""
         spec = self.env_runner_group.spec
         T = int(spec.max_seq_len)
-        segs: List[Dict[str, np.ndarray]] = []
-        for row in rows:
-            L = len(row["obs"])
-            for s in range(0, L, T):
-                seg = {k: v[s:s + T] for k, v in row.items()}
-                n = len(seg["obs"])
-                if n < T:
-                    seg = {k: np.concatenate(
-                        [v, np.zeros((T - n,) + v.shape[1:], v.dtype)])
-                        for k, v in seg.items()}
-                mask = np.zeros(T, np.float32)
-                mask[:n] = 1.0
-                isf = np.zeros(T, np.float32)
-                isf[0] = 1.0  # zero state at every segment start
-                seg["mask"], seg["is_first"] = mask, isf
-                segs.append(seg)
+        segs = segment_rows(rows, T)
         # Keep EVERY real segment (short episodes make segments carry
         # fewer than T real steps, so train_batch_size // T would
         # discard sampled data); pad up to a multiple of the minibatch
@@ -270,11 +227,7 @@ class PPO(Algorithm):
         # count costs no recompile.
         mb = min(max(1, cfg.minibatch_size // T), len(segs))
         target = -(-len(segs) // mb) * mb
-        if len(segs) < target:
-            zero = {k: np.zeros_like(v) for k, v in segs[0].items()}
-            zero["is_first"] = segs[0]["is_first"]  # defined scan resets
-            segs.extend([zero] * (target - len(segs)))
-        batch = {k: np.stack([s[k] for s in segs]) for k in segs[0]}
+        batch = stack_segments(segs, target)
         n_steps = int(batch["mask"].sum())
         if cfg.normalize_advantages:
             _normalize_advantages(batch)

@@ -55,6 +55,31 @@ class JaxLearner:
              ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
         raise NotImplementedError
 
+    def forward_flat(self, params, batch: Dict[str, jnp.ndarray]
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                Dict[str, jnp.ndarray]]:
+        """(dist_inputs, values, batch) with the time axis flattened.
+
+        Sequence minibatches ([B, T, ·] obs + is_first, built by
+        rl/sequences.py for recurrent specs) run one forward_seq scan
+        and flatten to [B*T]; flat batches pass straight through the
+        spec's forward.  Lets one loss body serve both layouts (padded
+        steps carry mask 0 either way)."""
+        obs = batch["obs"]
+        if obs.ndim == 3:
+            dist_inputs, values = self.spec.forward_seq(
+                params, obs, batch["is_first"])
+            flat = {}
+            for k, x in batch.items():
+                if k in ("obs", "is_first"):
+                    continue
+                flat[k] = (x.reshape(-1, *x.shape[2:]) if x.ndim > 2
+                           else x.reshape(-1))
+            return (dist_inputs.reshape(-1, dist_inputs.shape[-1]),
+                    values.reshape(-1), flat)
+        dist_inputs, values = self.spec.forward(params, obs)
+        return dist_inputs, values, batch
+
     def post_apply(self, params):
         """Jittable hook run on params after every optimizer step (inside
         the compiled update). Default: identity. SAC overrides this with

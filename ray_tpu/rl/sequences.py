@@ -1,0 +1,85 @@
+"""Sequence batching shared by recurrent learners (PPO, IMPALA/APPO).
+
+Counterpart of the reference's rllib/policy/rnn_sequencing.py (max_seq_len
+padding) reframed for the new-stack episode rows this stack trains on:
+each GAE/V-trace row (one episode fragment) is cut into `max_seq_len`
+segments with zero LSTM state at segment starts (truncated BPTT); padded
+steps carry mask 0 and `is_first` marks the in-scan state resets.  The
+jitted update's shape is [mb, T], so a varying segment count costs no
+recompile — only the minibatch slice shape is compiled.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+def segment_rows(rows: List[Dict[str, np.ndarray]], T: int
+                 ) -> List[Dict[str, np.ndarray]]:
+    """Cut per-episode row dicts into [T]-step segments with mask and
+    is_first columns appended."""
+    segs: List[Dict[str, np.ndarray]] = []
+    for row in rows:
+        L = len(row["obs"])
+        for s in range(0, L, T):
+            seg = {k: v[s:s + T] for k, v in row.items()}
+            n = len(seg["obs"])
+            if n < T:
+                seg = {k: np.concatenate(
+                    [v, np.zeros((T - n,) + v.shape[1:], v.dtype)])
+                    for k, v in seg.items()}
+            mask = np.zeros(T, np.float32)
+            mask[:n] = 1.0
+            isf = np.zeros(T, np.float32)
+            isf[0] = 1.0  # zero state at every segment start
+            seg["mask"], seg["is_first"] = mask, isf
+            segs.append(seg)
+    return segs
+
+
+def stack_segments(segs: List[Dict[str, np.ndarray]], target: int
+                   ) -> Dict[str, np.ndarray]:
+    """Stack segments into [target, T, ...] arrays, padding with
+    all-zero segments (mask 0, is_first kept so scan resets stay
+    defined).  target must be >= len(segs)."""
+    assert segs and target >= len(segs)
+    if len(segs) < target:
+        zero = {k: np.zeros_like(v) for k, v in segs[0].items()}
+        zero["is_first"] = segs[0]["is_first"]
+        segs = segs + [zero] * (target - len(segs))
+    return {k: np.stack([s[k] for s in segs]) for k in segs[0]}
+
+
+def forward_episodes_seq(spec, params, episodes, *,
+                         reset_every: int = 0
+                         ) -> Tuple[np.ndarray, np.ndarray, List[int]]:
+    """(dist_inputs [N, Lmax, ·], values [N, Lmax], lens) for whole
+    episode obs sequences through spec.forward_seq — the recurrent
+    replacement for the flat concat+forward the on-policy target/value
+    computations (GAE bootstrap, V-trace) otherwise use.  Both axes pad
+    to powers of two so the scan compiles a bounded number of shapes.
+
+    reset_every > 0 zeroes the LSTM state at every that-many-step
+    boundary (per episode), matching the learner's truncated-BPTT
+    segment view — V-trace targets must be computed from the SAME state
+    trajectory the loss will recompute, or rho/vf regress against a
+    different value view.  0 = continuous state across the fragment
+    (GAE bootstrap, which extends the rollout's own value stream)."""
+    import jax.numpy as jnp
+
+    lens = [len(e.obs) for e in episodes]
+    Lmax = 1 << (max(lens) - 1).bit_length()
+    N = 1 << (len(episodes) - 1).bit_length()
+    obs_dim = int(np.prod(np.asarray(episodes[0].obs[0]).shape))
+    obs_pad = np.zeros((N, Lmax, obs_dim), np.float32)
+    isf = np.zeros((N, Lmax), np.float32)
+    isf[:, 0] = 1.0
+    if reset_every > 0:
+        isf[:, ::reset_every] = 1.0
+    for i, e in enumerate(episodes):
+        obs_pad[i, :lens[i]] = np.asarray(e.obs).reshape(lens[i], -1)
+    di, vals = spec.forward_seq(params, jnp.asarray(obs_pad),
+                                jnp.asarray(isf))
+    return np.asarray(di), np.asarray(vals), lens
