@@ -22,9 +22,9 @@ from ray_tpu.rl.episode import SingleAgentEpisode, episodes_to_batch
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
 from ray_tpu.rl.catalog import MODEL_DEFAULTS, Catalog
-from ray_tpu.rl.module import (ConvRLModuleSpec, QNetworkSpec,
-                               RecurrentRLModuleSpec, RLModuleSpec,
-                               SACModuleSpec)
+from ray_tpu.rl.module import (ConvQNetworkSpec, ConvRLModuleSpec,
+                               QNetworkSpec, RecurrentRLModuleSpec,
+                               RLModuleSpec, SACModuleSpec)
 from ray_tpu.rl.offline import (
     dataset_to_episodes,
     episodes_to_dataset,
@@ -66,6 +66,7 @@ __all__ = [
     "LearnerGroup",
     "Catalog",
     "MODEL_DEFAULTS",
+    "ConvQNetworkSpec",
     "ConvRLModuleSpec",
     "RecurrentRLModuleSpec",
     "RLModuleSpec",

@@ -29,7 +29,9 @@ from ray_tpu.rl.episode import SingleAgentEpisode
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
 from ray_tpu.rl.sequences import (
-    forward_episodes_seq,
+    episode_states,
+    forward_rows_seeded,
+    normalize_advantages,
     segment_rows,
     stack_segments,
 )
@@ -132,12 +134,16 @@ def compute_vtrace(episodes: List[SingleAgentEpisode], params, spec,
     """
     recurrent = getattr(spec, "recurrent", False)
     if recurrent:
-        # State resets at every max_seq_len boundary: the learner will
-        # recompute logp/values from exactly this state trajectory
-        # (segment_rows), so rho and the vf targets stay consistent.
-        di_seq, v_seq, _lens = forward_episodes_seq(
-            spec, params, episodes,
-            reset_every=int(spec.max_seq_len))
+        # Target logp/values computed from the RECORDED behavior state
+        # trajectory, segment-seeded exactly like the learner's
+        # recompute (sequences.py) — rho stays 1 under unchanged
+        # params instead of picking up state artifacts.
+        obs_rows = [np.asarray(e.obs).reshape(len(e.obs), -1)
+                    .astype(np.float32) for e in episodes]
+        states = [episode_states(e) for e in episodes]
+        seeded = forward_rows_seeded(
+            spec, params, obs_rows, [s[0] for s in states],
+            [s[1] for s in states], int(spec.max_seq_len))
     else:
         obs_all = np.concatenate(
             [np.asarray(e.obs).reshape(len(e.obs), -1) for e in episodes])
@@ -151,8 +157,8 @@ def compute_vtrace(episodes: List[SingleAgentEpisode], params, spec,
         T = len(ep)
         n = T + 1
         if recurrent:
-            di = di_seq[i, :n]
-            v = v_seq[i, :n].astype(np.float32)
+            di, v = seeded[i]
+            v = v.astype(np.float32)
         else:
             di = dist_inputs[off:off + n]
             v = values_all[off:off + n].astype(np.float32)
@@ -180,13 +186,17 @@ def compute_vtrace(episodes: List[SingleAgentEpisode], params, spec,
         vs_next[:-1] = vs[1:]
         vs_next[-1] = v_next[-1]
         pg_adv = rho * (rewards + gamma * vs_next - v_t)
-        out.append({
+        row = {
             "obs": np.asarray(ep.obs[:-1]).reshape(T, -1).astype(np.float32),
             "actions": actions,
             "logp": behavior_logp,
             "advantages": pg_adv,
             "value_targets": vs,
-        })
+        }
+        if recurrent:
+            row["state_h"] = np.asarray(ep.extra["state_h"], np.float32)
+            row["state_c"] = np.asarray(ep.extra["state_c"], np.float32)
+        out.append(row)
     return out
 
 
@@ -320,12 +330,7 @@ class IMPALA(Algorithm):
                 flat["mask"] = mask
                 n = min(n, target)
             if cfg.normalize_advantages:
-                valid = flat["mask"] > 0
-                mean = flat["advantages"][valid].mean()
-                std = flat["advantages"][valid].std() + 1e-8
-                flat["advantages"] = np.where(
-                    valid, (flat["advantages"] - mean) / std, 0.0
-                ).astype(np.float32)
+                normalize_advantages(flat)
             for _ in range(cfg.num_sgd_iter):
                 metrics.update(self.learner_group.update_from_batch(flat))
             trained += n

@@ -24,7 +24,7 @@ from ray_tpu.rl.episode import SingleAgentEpisode
 from ray_tpu.rl.learner import JaxLearner
 from ray_tpu.rl.learner_group import LearnerGroup
 from ray_tpu.rl.sequences import (
-    forward_episodes_seq,
+    normalize_advantages as _normalize_advantages,
     segment_rows,
     stack_segments,
 )
@@ -92,14 +92,26 @@ def compute_gae(episodes: List[SingleAgentEpisode], params,
     Values come from the rollout (`values` extra); the bootstrap value of
     each episode's final obs is evaluated in one batched forward pass.
     """
-    if spec is not None and getattr(spec, "recurrent", False):
-        # Recurrent bootstrap: V(s_T) needs the LSTM state built from
-        # the episode's own history — run forward_seq over each whole
-        # fragment (zero state at its start, matching training's
-        # truncated-BPTT view) and read the value at the final obs.
-        _, vals, lens = forward_episodes_seq(spec, params, episodes)
-        boot = np.array([vals[i, lens[i] - 1]
-                         for i in range(len(episodes))])
+    recurrent = spec is not None and getattr(spec, "recurrent", False)
+    if recurrent:
+        # Recurrent bootstrap: V(s_T) from the RECORDED entering state
+        # at the final obs — one batched cell step (a seeded full
+        # scan would recompute every rollout step to read one value).
+        finals = np.stack(
+            [np.asarray(e.obs[-1]).reshape(-1) for e in episodes]
+        ).astype(np.float32)
+        cell = int(spec.cell_size)
+        h = np.stack([
+            np.asarray(e.final_state["h"], np.float32)
+            if e.final_state is not None else np.zeros(cell, np.float32)
+            for e in episodes])
+        c = np.stack([
+            np.asarray(e.final_state["c"], np.float32)
+            if e.final_state is not None else np.zeros(cell, np.float32)
+            for e in episodes])
+        boot = np.asarray(spec.value_from_state(
+            params, jnp.asarray(finals), jnp.asarray(h),
+            jnp.asarray(c)))
     else:
         finals = np.stack(
             [np.asarray(e.obs[-1]).reshape(-1) for e in episodes])
@@ -121,24 +133,20 @@ def compute_gae(episodes: List[SingleAgentEpisode], params,
             acc = deltas[t] + gamma * lam * acc
             adv[t] = acc
         obs = np.asarray(ep.obs[:-1]).reshape(T, -1)
-        out.append({
+        row = {
             "obs": obs.astype(np.float32),
             "actions": np.asarray(ep.actions),
             "logp": np.asarray(ep.logp, dtype=np.float32),
             "advantages": adv,
             "value_targets": adv + values,
-        })
+        }
+        if recurrent:
+            # Per-step entering states ride to the sequence batcher,
+            # which seeds each training segment from them.
+            row["state_h"] = np.asarray(ep.extra["state_h"], np.float32)
+            row["state_c"] = np.asarray(ep.extra["state_c"], np.float32)
+        out.append(row)
     return out
-
-
-def _normalize_advantages(batch: Dict[str, np.ndarray]) -> None:
-    """In-place masked advantage standardization (flat [N] or [N, T])."""
-    valid = batch["mask"] > 0
-    mean = batch["advantages"][valid].mean()
-    std = batch["advantages"][valid].std() + 1e-8
-    batch["advantages"] = np.where(
-        valid, (batch["advantages"] - mean) / std, 0.0
-    ).astype(np.float32)
 
 
 class PPO(Algorithm):

@@ -103,6 +103,13 @@ class SingleAgentEnvRunner:
         self._act_state = (spec.init_runner_state(num_envs)
                            if self._stateful else None)
         self._is_first = np.ones(num_envs, dtype=bool)
+        # Recurrent TRAINING specs get their entering LSTM state
+        # recorded per step (the reference's state_in column): the
+        # learner seeds truncated-BPTT segments from the state the
+        # behavior policy actually carried, so recomputed logp/values
+        # match the rollout exactly under unchanged params.
+        self._record_states = (self._stateful
+                               and getattr(spec, "recurrent", False))
 
         if self._stateful:
             @jax.jit
@@ -189,6 +196,13 @@ class SingleAgentEnvRunner:
             self._rng, key = jax.random.split(self._rng)
             shared = {"steps_this_sample": steps}
             if self._stateful:
+                if self._record_states:
+                    # Entering state = what the cell will consume: the
+                    # carried state, zeroed for rows acting on a fresh
+                    # episode (act_stateful applies the same mask).
+                    keep = (~self._is_first).astype(np.float32)[:, None]
+                    enter_h = np.asarray(self._act_state["h"]) * keep
+                    enter_c = np.asarray(self._act_state["c"]) * keep
                 action, logp, value, self._act_state = self._act(
                     self.params, self._act_state,
                     jnp.asarray(self._tobs), key, self.explore,
@@ -232,17 +246,26 @@ class SingleAgentEnvRunner:
                     continue
                 ep = self._episodes[i]
                 done = bool(terms[i] or truncs[i])
+                extra = {"values": float(value_np[i])}
+                if self._record_states:
+                    extra["state_h"] = enter_h[i]
+                    extra["state_c"] = enter_c[i]
                 # NEXT_STEP autoreset: on done, next_obs[i] IS the true
                 # final obs (the env resets on the following step call).
                 ep.add_step(
                     tobs[i], action_np[i], float(rewards[i]),
                     terminated=bool(terms[i]), truncated=bool(truncs[i]),
-                    logp=float(logp_np[i]),
-                    extra={"values": float(value_np[i])})
+                    logp=float(logp_np[i]), extra=extra)
                 steps += 1
                 if done:
                     self.metrics["num_episodes_lifetime"] += 1
                     self.metrics["episode_returns"].append(ep.total_reward)
+                    if self._record_states:
+                        # Entering state for the FINAL obs position =
+                        # the post-act state of the last step.
+                        ep.final_state = {
+                            "h": np.asarray(self._act_state["h"])[i],
+                            "c": np.asarray(self._act_state["c"])[i]}
                     done_episodes.append(ep.finalize())
                     self._pending_reset[i] = True
                     # Placeholder until the reset step arrives — keeps the
@@ -257,6 +280,10 @@ class SingleAgentEnvRunner:
             # keep the tail obs so the learner can bootstrap the value.
             for i, ep in enumerate(self._episodes):
                 if len(ep) > 0:
+                    if self._record_states:
+                        ep.final_state = {
+                            "h": np.asarray(self._act_state["h"])[i],
+                            "c": np.asarray(self._act_state["c"])[i]}
                     out.append(ep.finalize())
                     cont = SingleAgentEpisode(id=ep.id)
                     cont.add_reset(self._tobs[i])

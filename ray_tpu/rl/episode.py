@@ -33,6 +33,9 @@ class SingleAgentEpisode:
     terminated: bool = False
     truncated: bool = False
     id: str = ""
+    # Entering LSTM state for the FINAL obs position (recurrent specs;
+    # per-step entering states ride in extra["state_h"/"state_c"]).
+    final_state: Optional[Dict[str, np.ndarray]] = None
 
     def __len__(self) -> int:
         return len(self.actions)

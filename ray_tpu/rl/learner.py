@@ -68,10 +68,11 @@ class JaxLearner:
         obs = batch["obs"]
         if obs.ndim == 3:
             dist_inputs, values = self.spec.forward_seq(
-                params, obs, batch["is_first"])
+                params, obs, batch["is_first"],
+                batch.get("h0"), batch.get("c0"))
             flat = {}
             for k, x in batch.items():
-                if k in ("obs", "is_first"):
+                if k in ("obs", "is_first", "h0", "c0"):
                     continue
                 flat[k] = (x.reshape(-1, *x.shape[2:]) if x.ndim > 2
                            else x.reshape(-1))

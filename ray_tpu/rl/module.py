@@ -154,6 +154,39 @@ _ACTIVATIONS = {"tanh": jnp.tanh, "relu": jax.nn.relu,
                 "silu": jax.nn.swish, "linear": lambda x: x}
 
 
+def _init_conv(key, obs_shape, conv_filters
+               ) -> Tuple[list, int]:
+    """(conv layer params, flattened feature dim) for an NHWC trunk;
+    rows are (out_channels, kernel, stride), SAME padding."""
+    H, W, C = obs_shape
+    keys = jax.random.split(key, max(len(conv_filters), 1))
+    convs = []
+    cin = C
+    for i, (cout, k, s) in enumerate(conv_filters):
+        fan_in = k * k * cin
+        convs.append({
+            "w": jax.random.normal(keys[i], (k, k, cin, cout))
+            * jnp.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((cout,)),
+        })
+        H, W, cin = -(-H // s), -(-W // s), cout  # ceil (SAME pad)
+    return convs, H * W * cin
+
+
+def _conv_forward(convs, conv_filters, obs_shape, obs: jnp.ndarray
+                  ) -> jnp.ndarray:
+    """Flat [B, H*W*C] obs → [B, feat] through the relu conv trunk
+    (lax.conv_general_dilated, the MXU-friendly NHWC layout)."""
+    B = obs.shape[0]
+    x = obs.astype(jnp.float32).reshape(B, *obs_shape)
+    for layer, (cout, k, s) in zip(convs, conv_filters):
+        x = jax.lax.conv_general_dilated(
+            x, layer["w"], window_strides=(s, s), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + layer["b"])
+    return x.reshape(B, -1)
+
+
 def _mlp(params: Dict[str, Any], x: jnp.ndarray,
          activation: str = "tanh", activate_last: bool = False
          ) -> jnp.ndarray:
@@ -201,37 +234,21 @@ class ConvRLModuleSpec(RLModuleSpec):
                                                       (32, 4, 2))
 
     def init(self, key) -> Dict[str, Any]:
-        H, W, C = self.obs_shape
-        keys = jax.random.split(key, len(self.conv_filters) + 2)
-        convs = []
-        cin = C
-        for i, (cout, k, s) in enumerate(self.conv_filters):
-            fan_in = k * k * cin
-            convs.append({
-                "w": jax.random.normal(keys[i], (k, k, cin, cout))
-                * jnp.sqrt(2.0 / fan_in),
-                "b": jnp.zeros((cout,)),
-            })
-            H, W, cin = -(-H // s), -(-W // s), cout  # ceil (SAME pad)
-        feat = H * W * cin
+        k_conv, k_pi, k_v = jax.random.split(key, 3)
+        convs, feat = _init_conv(k_conv, self.obs_shape,
+                                 self.conv_filters)
         pi_sizes = [feat, *self.hidden_sizes, self.dist_inputs_dim]
         v_sizes = [feat, *self.hidden_sizes, 1]
         return {
             "conv": convs,
-            "pi": _init_mlp(keys[-2], pi_sizes, scale_last=0.01),
-            "vf": _init_mlp(keys[-1], v_sizes, scale_last=1.0),
+            "pi": _init_mlp(k_pi, pi_sizes, scale_last=0.01),
+            "vf": _init_mlp(k_v, v_sizes, scale_last=1.0),
         }
 
     def forward(self, params: Dict[str, Any], obs: jnp.ndarray
                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        B = obs.shape[0]
-        x = obs.astype(jnp.float32).reshape(B, *self.obs_shape)
-        for layer, (cout, k, s) in zip(params["conv"], self.conv_filters):
-            x = jax.lax.conv_general_dilated(
-                x, layer["w"], window_strides=(s, s), padding="SAME",
-                dimension_numbers=("NHWC", "HWIO", "NHWC"))
-            x = jax.nn.relu(x + layer["b"])
-        x = x.reshape(B, -1)
+        x = _conv_forward(params["conv"], self.conv_filters,
+                          self.obs_shape, obs)
         return (_mlp(params["pi"], x, self.activation),
                 _mlp(params["vf"], x, self.activation).squeeze(-1))
 
@@ -333,12 +350,19 @@ class RecurrentRLModuleSpec(RLModuleSpec):
         return action, dist.logp(action), value, {"h": h, "c": c}
 
     # -- sequence training path ----------------------------------------
-    def forward_seq(self, params, obs: jnp.ndarray, is_first: jnp.ndarray
+    def forward_seq(self, params, obs: jnp.ndarray, is_first: jnp.ndarray,
+                    h0: jnp.ndarray = None, c0: jnp.ndarray = None
                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """obs: [B, T, obs_dim] (flattened trailing dims), is_first:
         [B, T] bool/float; returns (dist_inputs [B, T, ·], values
         [B, T]).  One scan — XLA compiles a single program whose carry
-        is the [B, cell] LSTM state."""
+        is the [B, cell] LSTM state.
+
+        h0/c0 [B, cell] seed the carry at t=0 (the env runner's
+        RECORDED entering state for segments cut mid-episode — the
+        reference's state_in column); without them sequences start from
+        zeros.  is_first still zero-resets mid-sequence episode
+        boundaries."""
         B, T = obs.shape[0], obs.shape[1]
         x = self._encode(params, obs.reshape(B * T, -1))
         x = x.reshape(B, T, -1)
@@ -353,13 +377,24 @@ class RecurrentRLModuleSpec(RLModuleSpec):
             return (h, c), h
 
         zeros = jnp.zeros((B, self.cell_size))
+        init = (h0 if h0 is not None else zeros,
+                c0 if c0 is not None else zeros)
         # scan over time: move T to the leading axis
         (_, _), hs = jax.lax.scan(
-            step, (zeros, zeros),
+            step, init,
             (jnp.swapaxes(x, 0, 1), jnp.swapaxes(keep, 0, 1)))
         hs = jnp.swapaxes(hs, 0, 1)              # [B, T, cell]
         dist_inputs, values = self._heads(params, hs)
         return dist_inputs, values
+
+    def value_from_state(self, params, obs: jnp.ndarray,
+                         h: jnp.ndarray, c: jnp.ndarray) -> jnp.ndarray:
+        """V(obs | entering state): ONE cell step from a recorded
+        state — the O(batch) bootstrap for GAE (a seeded full-sequence
+        scan would recompute every rollout step to read one value)."""
+        x = self._encode(params, obs.reshape(obs.shape[0], -1))
+        h2, _ = self._cell(params["lstm"], x, h, c)
+        return self._heads(params, h2)[1]
 
     def forward(self, params, obs: jnp.ndarray
                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -430,6 +465,40 @@ class QNetworkSpec:
         q = self.q_values(params["online"], obs)
         action = jnp.argmax(q, axis=-1)
         return action, jnp.zeros(q.shape[:-1]), jnp.max(q, axis=-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class ConvQNetworkSpec(QNetworkSpec):
+    """Pixel-input Q-network: shared conv trunk feeding the (dueling)
+    advantage/value heads — the reference DQN's Atari path
+    (rllib/algorithms/dqn/ + the catalog CNN encoder).  Selected by
+    DQN automatically for 3-D Box observation spaces."""
+
+    obs_shape: Tuple[int, int, int] = (16, 16, 1)   # H, W, C
+    conv_filters: Tuple[Tuple[int, int, int], ...] = ((16, 4, 2),
+                                                      (32, 4, 2))
+
+    def _init_one(self, key) -> Dict[str, Any]:
+        k_conv, k_a, k_v = jax.random.split(key, 3)
+        convs, feat = _init_conv(k_conv, self.obs_shape,
+                                 self.conv_filters)
+        adv_sizes = [feat, *self.hidden_sizes, self.action_dim]
+        net = {"conv": convs,
+               "adv": _init_mlp(k_a, adv_sizes, scale_last=0.01)}
+        if self.dueling:
+            v_sizes = [feat, *self.hidden_sizes, 1]
+            net["val"] = _init_mlp(k_v, v_sizes, scale_last=1.0)
+        return net
+
+    def q_values(self, net: Dict[str, Any], obs: jnp.ndarray
+                 ) -> jnp.ndarray:
+        x = _conv_forward(net["conv"], self.conv_filters,
+                          self.obs_shape, obs)
+        adv = _mlp(net["adv"], x)
+        if not self.dueling:
+            return adv
+        val = _mlp(net["val"], x)
+        return val + adv - adv.mean(axis=-1, keepdims=True)
 
 
 # ---------------------------------------------------------------------------

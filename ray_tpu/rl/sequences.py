@@ -16,15 +16,34 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 
+def normalize_advantages(batch: Dict[str, np.ndarray]) -> None:
+    """In-place masked advantage standardization (flat [N] or [N, T])."""
+    valid = batch["mask"] > 0
+    mean = batch["advantages"][valid].mean()
+    std = batch["advantages"][valid].std() + 1e-8
+    batch["advantages"] = np.where(
+        valid, (batch["advantages"] - mean) / std, 0.0
+    ).astype(np.float32)
+
+
 def segment_rows(rows: List[Dict[str, np.ndarray]], T: int
                  ) -> List[Dict[str, np.ndarray]]:
     """Cut per-episode row dicts into [T]-step segments with mask and
-    is_first columns appended."""
+    is_first columns appended.
+
+    Rows carrying per-step entering states ("state_h"/"state_c", the
+    env runner's recording) turn into "h0"/"c0" seed columns — each
+    segment starts from the state the behavior policy actually carried
+    there (the reference's state_in), so recomputed logp/values match
+    the rollout under unchanged params.  Without recorded states,
+    segments start from zeros (is_first reset at t=0)."""
     segs: List[Dict[str, np.ndarray]] = []
     for row in rows:
+        seeded = "state_h" in row
         L = len(row["obs"])
         for s in range(0, L, T):
-            seg = {k: v[s:s + T] for k, v in row.items()}
+            seg = {k: v[s:s + T] for k, v in row.items()
+                   if k not in ("state_h", "state_c")}
             n = len(seg["obs"])
             if n < T:
                 seg = {k: np.concatenate(
@@ -33,7 +52,11 @@ def segment_rows(rows: List[Dict[str, np.ndarray]], T: int
             mask = np.zeros(T, np.float32)
             mask[:n] = 1.0
             isf = np.zeros(T, np.float32)
-            isf[0] = 1.0  # zero state at every segment start
+            if seeded:
+                seg["h0"] = np.asarray(row["state_h"][s], np.float32)
+                seg["c0"] = np.asarray(row["state_c"][s], np.float32)
+            else:
+                isf[0] = 1.0  # zero state at every segment start
             seg["mask"], seg["is_first"] = mask, isf
             segs.append(seg)
     return segs
@@ -52,34 +75,55 @@ def stack_segments(segs: List[Dict[str, np.ndarray]], target: int
     return {k: np.stack([s[k] for s in segs]) for k in segs[0]}
 
 
-def forward_episodes_seq(spec, params, episodes, *,
-                         reset_every: int = 0
-                         ) -> Tuple[np.ndarray, np.ndarray, List[int]]:
-    """(dist_inputs [N, Lmax, ·], values [N, Lmax], lens) for whole
-    episode obs sequences through spec.forward_seq — the recurrent
-    replacement for the flat concat+forward the on-policy target/value
-    computations (GAE bootstrap, V-trace) otherwise use.  Both axes pad
-    to powers of two so the scan compiles a bounded number of shapes.
+def episode_states(ep) -> Tuple[np.ndarray, np.ndarray]:
+    """Entering states for every obs position 0..T of a finalized
+    episode: the per-step recording plus the final_state the runner
+    attached for the last obs.  [T+1, cell] each."""
+    h = np.asarray(ep.extra["state_h"], np.float32)
+    c = np.asarray(ep.extra["state_c"], np.float32)
+    fin = ep.final_state
+    fh = (np.asarray(fin["h"], np.float32) if fin is not None
+          else np.zeros_like(h[0]))
+    fc = (np.asarray(fin["c"], np.float32) if fin is not None
+          else np.zeros_like(c[0]))
+    return (np.concatenate([h, fh[None]]),
+            np.concatenate([c, fc[None]]))
 
-    reset_every > 0 zeroes the LSTM state at every that-many-step
-    boundary (per episode), matching the learner's truncated-BPTT
-    segment view — V-trace targets must be computed from the SAME state
-    trajectory the loss will recompute, or rho/vf regress against a
-    different value view.  0 = continuous state across the fragment
-    (GAE bootstrap, which extends the rollout's own value stream)."""
+
+def forward_rows_seeded(spec, params, obs_rows: List[np.ndarray],
+                        h_rows: List[np.ndarray],
+                        c_rows: List[np.ndarray], T: int
+                        ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """(dist_inputs [n_i, ·], values [n_i]) per row, computed by cutting
+    each row into [T]-step segments seeded with its RECORDED entering
+    states and running ONE forward_seq scan over the stacked segments —
+    the recurrent replacement for the flat concat+forward the on-policy
+    target computations (V-trace, GAE bootstrap) otherwise use.  The
+    segment count pads to a power of two (bounded compiled shapes)."""
     import jax.numpy as jnp
 
-    lens = [len(e.obs) for e in episodes]
-    Lmax = 1 << (max(lens) - 1).bit_length()
-    N = 1 << (len(episodes) - 1).bit_length()
-    obs_dim = int(np.prod(np.asarray(episodes[0].obs[0]).shape))
-    obs_pad = np.zeros((N, Lmax, obs_dim), np.float32)
-    isf = np.zeros((N, Lmax), np.float32)
-    isf[:, 0] = 1.0
-    if reset_every > 0:
-        isf[:, ::reset_every] = 1.0
-    for i, e in enumerate(episodes):
-        obs_pad[i, :lens[i]] = np.asarray(e.obs).reshape(lens[i], -1)
-    di, vals = spec.forward_seq(params, jnp.asarray(obs_pad),
-                                jnp.asarray(isf))
-    return np.asarray(di), np.asarray(vals), lens
+    obs_dim = obs_rows[0].shape[-1]
+    cell = h_rows[0].shape[-1]
+    chunks: List[Tuple[int, int, int]] = []  # (row, start, n)
+    for i, o in enumerate(obs_rows):
+        for s in range(0, len(o), T):
+            chunks.append((i, s, min(T, len(o) - s)))
+    S = 1 << (len(chunks) - 1).bit_length()
+    obs = np.zeros((S, T, obs_dim), np.float32)
+    h0 = np.zeros((S, cell), np.float32)
+    c0 = np.zeros((S, cell), np.float32)
+    for j, (i, s, n) in enumerate(chunks):
+        obs[j, :n] = obs_rows[i][s:s + n]
+        h0[j] = h_rows[i][s]
+        c0[j] = c_rows[i][s]
+    di, vals = spec.forward_seq(
+        params, jnp.asarray(obs), jnp.zeros((S, T), jnp.float32),
+        jnp.asarray(h0), jnp.asarray(c0))
+    di, vals = np.asarray(di), np.asarray(vals)
+    out: List[Tuple[np.ndarray, np.ndarray]] = [
+        (np.zeros((len(o), di.shape[-1]), np.float32),
+         np.zeros(len(o), np.float32)) for o in obs_rows]
+    for j, (i, s, n) in enumerate(chunks):
+        out[i][0][s:s + n] = di[j, :n]
+        out[i][1][s:s + n] = vals[j, :n]
+    return out

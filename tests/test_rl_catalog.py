@@ -213,6 +213,30 @@ def test_conv_heads_honor_activation():
     assert not np.allclose(np.asarray(out_t), np.asarray(out_r))
 
 
+def test_dqn_pixel_env_uses_conv_q_network():
+    """DQN auto-selects the conv Q-network for 3-D obs (the reference's
+    Atari DQN path) and trains with finite TD loss."""
+    from ray_tpu.rl import ConvQNetworkSpec
+    from ray_tpu.rl.algorithms import DQNConfig
+
+    config = (DQNConfig()
+              .environment(env_fn=lambda: BrightQuadrantEnv(size=10,
+                                                            length=8))
+              .training(num_steps_sampled_before_learning_starts=64,
+                        rollout_fragment_length=64, train_batch_size=32)
+              .debugging(seed=0))
+    algo = config.build()
+    spec = algo.env_runner_group.spec
+    assert isinstance(spec, ConvQNetworkSpec)
+    assert spec.obs_shape == (10, 10, 1)
+    assert spec.conv_filters == ((16, 4, 2), (32, 4, 2))
+    r = {}
+    for _ in range(3):
+        r = algo.step()
+    algo.stop()
+    assert np.isfinite(r["total_loss"])
+
+
 def test_dqn_sac_rl_module_config():
     """DQN honors rl_module fcnet_hiddens and rejects keys its module
     can't apply (silent drops would lie about the architecture)."""
@@ -229,6 +253,45 @@ def test_dqn_sac_rl_module_config():
            .rl_module(model_config={"use_lstm": True}))
     with pytest.raises(ValueError, match="module_spec"):
         bad.build()
+
+
+def test_recurrent_behavior_target_logp_parity():
+    """Under UNCHANGED params, logp/values recomputed on the training
+    segments (seeded with the runner's recorded entering states) equal
+    the rollout's behavior logp/values exactly — episodes longer than
+    max_seq_len included.  This is the property that keeps PPO ratios
+    at 1 and V-trace rho free of state artifacts (the reference's
+    state_in column)."""
+    import gymnasium as gym
+
+    from ray_tpu.rl import SingleAgentEnvRunner
+    from ray_tpu.rl.algorithms.ppo import compute_gae
+    from ray_tpu.rl.sequences import segment_rows, stack_segments
+
+    spec = RecurrentRLModuleSpec(obs_dim=4, action_dim=2, discrete=True,
+                                 hidden_sizes=(16,), cell_size=8,
+                                 max_seq_len=5)  # episodes run longer
+    runner = SingleAgentEnvRunner(
+        lambda: gym.make("CartPole-v1"), num_envs=2, spec=spec, seed=0)
+    episodes = runner.sample(num_env_steps=60)
+    assert any(len(e) > 5 for e in episodes), "need multi-segment eps"
+    params = runner.params
+    rows = compute_gae(episodes, params, 0.99, 0.95, spec=spec)
+    segs = segment_rows(rows, 5)
+    assert "h0" in segs[0]  # recorded-state seeding active
+    batch = stack_segments(segs, 1 << (len(segs) - 1).bit_length())
+
+    from ray_tpu.rl.algorithms.ppo import PPOLearner
+
+    learner = PPOLearner(spec, seed=0)
+    di, values, flat = learner.forward_flat(
+        params, {k: jnp.asarray(v) for k, v in batch.items()})
+    logp = np.asarray(spec.dist(di).logp(flat["actions"]))
+    mask = np.asarray(flat["mask"]) > 0
+    np.testing.assert_allclose(logp[mask],
+                               np.asarray(flat["logp"])[mask],
+                               rtol=1e-4, atol=1e-5)
+    runner.stop()
 
 
 def test_lstm_appo_learns_memory_task():
