@@ -215,17 +215,27 @@ class TuneController:
         elif decision == PAUSE:
             self._pause(t)
 
+    def _save_runner_checkpoint(self, t: Trial, timeout: float
+                                ) -> Optional[str]:
+        """Save a trial's runner (class trainables; function trainables
+        return None), record it, and fire on_checkpoint — the one
+        bookkeeping path for every controller-initiated save."""
+        if t.runner is None:
+            return None
+        try:
+            path = ray_tpu.get(t.runner.save.remote(), timeout=timeout)
+        except Exception:
+            return None
+        if path:
+            t.last_checkpoint = path
+            self._callbacks.on_checkpoint(trial=t, checkpoint_path=path)
+        return path
+
     def _pause(self, t: Trial):
         """Checkpoint + release the runner; the trial waits for the
         scheduler's unpause decision (synchronous HyperBand rungs —
         reference hyperband.py pauses trials at rung boundaries)."""
-        if t.runner is not None:
-            try:
-                path = ray_tpu.get(t.runner.save.remote(), timeout=60)
-                if path:
-                    t.last_checkpoint = path
-            except Exception:
-                pass
+        self._save_runner_checkpoint(t, timeout=60)
         self._shutdown_runner(t)
         t.state = PAUSED
 
@@ -261,13 +271,7 @@ class TuneController:
 
     def _complete(self, t: Trial):
         # Snapshot class trainables so the final state is recoverable.
-        if t.runner is not None:
-            try:
-                path = ray_tpu.get(t.runner.save.remote(), timeout=30)
-                if path:
-                    t.last_checkpoint = path
-            except Exception:
-                pass
+        self._save_runner_checkpoint(t, timeout=30)
         self._shutdown_runner(t)
         t.state = TERMINATED
         self._search.on_trial_complete(t.trial_id, t.last_result,
@@ -297,15 +301,8 @@ class TuneController:
                       if d.trial_id == directive.get("donor")), None)
         if donor is None:
             return
-        donor_ckpt = donor.last_checkpoint
-        if donor.runner is not None:
-            try:
-                path = ray_tpu.get(donor.runner.save.remote(), timeout=60)
-                if path:
-                    donor_ckpt = path
-                    donor.last_checkpoint = path
-            except Exception:
-                pass
+        donor_ckpt = (self._save_runner_checkpoint(donor, timeout=60)
+                      or donor.last_checkpoint)
         if donor_ckpt is None:
             return
         self._shutdown_runner(t)

@@ -70,6 +70,10 @@ class _Recorder(Callback):
         self.events.append(("result", trial.trial_id,
                             result.get("score", result.get("loss"))))
 
+    def on_checkpoint(self, *, trial, checkpoint_path):
+        self.events.append(("checkpoint", trial.trial_id,
+                            checkpoint_path))
+
     def on_trial_complete(self, *, trial):
         self.events.append(("complete", trial.trial_id))
 
@@ -107,6 +111,60 @@ def test_error_hook_and_containment(tmp_path):
     # The broken callback is contained; the recorder still saw the run.
     assert ("error", "trial_00000") in rec.events
     assert len(results.errors) == 1
+
+
+def test_on_checkpoint_fires_for_reported_and_final_saves(tmp_path):
+    """on_checkpoint dispatches both for checkpoints attached to
+    reports (function trainable) AND for the controller's
+    completion-time save of class trainables (_save_runner_checkpoint
+    — the path a function trainable never hits)."""
+    def ckpt_trainable(config):
+        import os as _os
+        import tempfile
+
+        from ray_tpu.train.checkpoint import Checkpoint
+        from ray_tpu.tune.trainable import report
+
+        d = tempfile.mkdtemp()
+        with open(_os.path.join(d, "w.txt"), "w") as f:
+            f.write("1")
+        report({"score": 1.0},
+               checkpoint=Checkpoint.from_directory(d))
+        report({"score": 2.0})
+
+    rec = _Recorder()
+    results = _fit(tmp_path, [rec], num_samples=1,
+                   trainable=ckpt_trainable)
+    assert len(results) == 1
+    ckpts = [e for e in rec.events if e[0] == "checkpoint"]
+    assert len(ckpts) >= 1 and all(e[2] for e in ckpts)
+
+    # Class trainable: NO report-attached checkpoint, so the only
+    # on_checkpoint can come from the completion-time runner save.
+    from ray_tpu.tune.trainable import Trainable as TuneTrainable
+
+    class Stepper(TuneTrainable):
+        def setup(self, config):
+            self.i = 0
+
+        def step(self):
+            self.i += 1
+            return {"score": float(self.i),
+                    "done": self.i >= 2}
+
+    rec2 = _Recorder()
+    tuner = Tuner(
+        Stepper,
+        param_space={},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               num_samples=1),
+        run_config=RunConfig(name="cls", storage_path=str(tmp_path),
+                             callbacks=[rec2],
+                             stop={"score": 2.0}))
+    results = tuner.fit()
+    assert len(results) == 1
+    ckpts2 = [e for e in rec2.events if e[0] == "checkpoint"]
+    assert len(ckpts2) >= 1 and all(e[2] for e in ckpts2)
 
 
 def test_json_and_csv_loggers_default(tmp_path):
