@@ -11,7 +11,17 @@ from __future__ import annotations
 
 import builtins
 import itertools
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Union,
+)
 
 import numpy as np
 import pyarrow as pa
@@ -263,25 +273,127 @@ class Dataset:
     # Splitting
     # ------------------------------------------------------------------
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
-        """Materializing split into n datasets (reference Dataset.split)."""
-        mat = self if self._materialized is not None else self.materialize()
-        bundles = mat._materialized or []
-        blocks = [b for bundle in bundles
-                  for b in ray_tpu.get(bundle.blocks_ref)]
-        combined = concat_blocks(blocks) if blocks else pa.table({})
+        """Materializing split into n datasets (reference Dataset.split);
+        equal=True truncates the remainder so every child has exactly
+        total // n rows."""
+        combined = self._combined_block()
         total = combined.num_rows
-        per = total // n if equal else -(-total // n)
+        if equal:
+            per = total // n
+            combined = BlockAccessor(combined).slice(0, per * n)
+            total = per * n
+        else:
+            per = -(-total // n)
+        bounds = [min(i * per, total) for i in builtins.range(1, n)]
+        return self._split_combined(combined, bounds)
+
+    def _split_combined(self, combined, bounds: List[int]
+                        ) -> List["Dataset"]:
+        """Children sliced from one combined block at `bounds` (sorted
+        row indices); len(bounds)+1 datasets."""
+        total = combined.num_rows
         acc = BlockAccessor(combined)
         out = []
-        for i in builtins.range(n):
-            start = min(i * per, total)
-            end = min(start + per, total)
+        for start, end in builtins.zip([0, *bounds], [*bounds, total]):
+            start, end = min(start, total), min(end, total)
             piece = acc.slice(start, end)
             child = Dataset(self._terminal)
             child._materialized = [RefBundle.from_blocks([piece])] \
                 if piece.num_rows else []
             out.append(child)
         return out
+
+    def _combined_block(self):
+        mat = self if self._materialized is not None else self.materialize()
+        blocks = [b for bundle in (mat._materialized or [])
+                  for b in ray_tpu.get(bundle.blocks_ref)]
+        return concat_blocks(blocks) if blocks else pa.table({})
+
+    def split_at_indices(self, indices: Sequence[int]) -> List["Dataset"]:
+        """Split at sorted row indices → len(indices)+1 datasets
+        (reference Dataset.split_at_indices)."""
+        bounds = list(indices)
+        if bounds != sorted(bounds) or any(i < 0 for i in bounds):
+            raise ValueError("indices must be sorted and non-negative")
+        return self._split_combined(self._combined_block(), bounds)
+
+    def split_proportionately(self, proportions: Sequence[float]
+                              ) -> List["Dataset"]:
+        """Split by fractions (must sum to < 1; the remainder forms the
+        final dataset — reference Dataset.split_proportionately)."""
+        if any(p <= 0 for p in proportions) or sum(proportions) >= 1:
+            raise ValueError(
+                "proportions must be positive and sum to less than 1")
+        combined = self._combined_block()
+        total = combined.num_rows
+        bounds, acc = [], 0.0
+        for p in proportions:
+            acc += p
+            bounds.append(int(total * acc))
+        return self._split_combined(combined, bounds)
+
+    def train_test_split(self, test_size: float, *,
+                         shuffle: bool = False,
+                         seed: Optional[int] = None
+                         ) -> Tuple["Dataset", "Dataset"]:
+        """(train, test) datasets (reference Dataset.train_test_split);
+        test_size is a fraction in (0, 1) or an absolute row count."""
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        combined = ds._combined_block()
+        total = combined.num_rows
+        if isinstance(test_size, float):
+            if not 0 < test_size < 1:
+                raise ValueError("test_size fraction must be in (0, 1)")
+            n_test = int(total * test_size)
+        else:
+            n_test = int(test_size)
+            if not 0 <= n_test <= total:
+                raise ValueError(f"test_size {n_test} out of range")
+        train, test = ds._split_combined(combined, [total - n_test])
+        return train, test
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of one column, in first-seen order with the
+        ORIGINAL values (lists stay lists; reference Dataset.unique)."""
+        from ray_tpu.data.block import block_to_arrow
+
+        def hashable(v):
+            if isinstance(v, list):
+                return tuple(hashable(x) for x in v)
+            if isinstance(v, dict):
+                return tuple(sorted(
+                    (k, hashable(x)) for k, x in v.items()))
+            return v
+
+        seen: Dict[Any, Any] = {}
+        for block in self.iter_internal_blocks():
+            col = block_to_arrow(block)[column]
+            for v in col.to_pylist():
+                seen.setdefault(hashable(v), v)
+        return list(seen.values())
+
+    def randomize_block_order(self, *, seed: Optional[int] = None
+                              ) -> "Dataset":
+        """Shuffle BLOCK order without touching rows — the cheap
+        epoch-level shuffle (reference Dataset.randomize_block_order)."""
+        mat = self if self._materialized is not None else self.materialize()
+        bundles = list(mat._materialized or [])
+        np.random.default_rng(seed).shuffle(bundles)
+        ds = Dataset(self._terminal)
+        ds._materialized = bundles
+        return ds
+
+    def size_bytes(self) -> int:
+        """In-memory byte estimate (reference Dataset.size_bytes)."""
+        from ray_tpu.data.block import block_to_arrow
+
+        return sum(block_to_arrow(b).nbytes
+                   for b in self.iter_internal_blocks())
+
+    def show(self, limit: int = 20) -> None:
+        """Print up to `limit` rows (reference Dataset.show)."""
+        for row in self.take(limit):
+            print(row)
 
     def streaming_split(self, n: int, *, equal: bool = False,
                         locality_hints=None) -> List[DataIterator]:
@@ -409,6 +521,81 @@ class Dataset:
     def to_pandas(self):
         return concat_blocks(
             list(self.iter_internal_blocks())).to_pandas()
+
+    def to_arrow_refs(self) -> List[Any]:
+        """One ObjectRef per block holding its arrow Table (reference
+        Dataset.to_arrow_refs); pairs with from_arrow_refs."""
+        from ray_tpu.data.block import block_to_arrow
+
+        return [ray_tpu.put(block_to_arrow(b))
+                for b in self.iter_internal_blocks()]
+
+    def to_pandas_refs(self) -> List[Any]:
+        """One ObjectRef per block as a pandas DataFrame (reference
+        Dataset.to_pandas_refs)."""
+        from ray_tpu.data.block import block_to_arrow
+
+        return [ray_tpu.put(block_to_arrow(b).to_pandas())
+                for b in self.iter_internal_blocks()]
+
+    def to_numpy_refs(self, *, column: Optional[str] = None
+                      ) -> List[Any]:
+        """One ObjectRef per block: a single column's ndarray, or a
+        dict of column ndarrays (reference Dataset.to_numpy_refs)."""
+        from ray_tpu.data.block import BlockAccessor
+
+        out = []
+        for b in self.iter_internal_blocks():
+            batch = BlockAccessor(b).to_batch()
+            out.append(ray_tpu.put(
+                batch[column] if column is not None else batch))
+        return out
+
+    def to_dask(self, *, _module=None):
+        """dask.dataframe over one partition per block (reference
+        Dataset.to_dask; gated like data/external.py)."""
+        from ray_tpu.data.block import block_to_arrow
+        from ray_tpu.data.external import _import
+
+        dd = _import("dask.dataframe", "dask[dataframe]",
+                     "use to_pandas / iter_batches", _module)
+        dfs = [block_to_arrow(b).to_pandas()
+               for b in self.iter_internal_blocks()]
+        if not dfs:
+            import pandas as pd
+
+            return dd.from_pandas(pd.DataFrame(), npartitions=1)
+        return dd.concat([dd.from_pandas(df, npartitions=1)
+                          for df in dfs])
+
+    def to_modin(self, *, _module=None):
+        """modin DataFrame (reference Dataset.to_modin; gated)."""
+        from ray_tpu.data.external import _import
+
+        mpd = _import("modin.pandas", "modin",
+                      "use to_pandas", _module)
+        return mpd.DataFrame(self.to_pandas())
+
+    def to_spark(self, spark_session):
+        """pyspark DataFrame via the session's createDataFrame
+        (reference Dataset.to_spark; duck-typed on the session)."""
+        if not hasattr(spark_session, "createDataFrame"):
+            raise TypeError(
+                "to_spark expects a SparkSession (.createDataFrame)")
+        return spark_session.createDataFrame(self.to_pandas())
+
+    def to_tf(self, *, _module=None):
+        """tf.data.Dataset over the rows via from_tensor_slices
+        (reference Dataset.to_tf; gated on tensorflow)."""
+        from ray_tpu.data.block import BlockAccessor
+        from ray_tpu.data.external import _import
+
+        tf = _import("tensorflow", "tensorflow",
+                     "use iter_batches / iter_torch_batches", _module)
+        blocks = list(self.iter_internal_blocks())
+        combined = concat_blocks(blocks) if blocks else pa.table({})
+        batch = BlockAccessor(combined).to_batch()
+        return tf.data.Dataset.from_tensor_slices(batch)
 
     def to_arrow(self) -> pa.Table:
         from ray_tpu.data.block import block_to_arrow

@@ -632,6 +632,98 @@ def test_write_mongo_bigquery_stubs():
     assert out.endswith(":3") and loaded == [("p.d.t", 3)]
 
 
+def test_split_at_indices_and_proportionately():
+    ds = rd.range(10)
+    a, b, c = ds.split_at_indices([3, 7])
+    assert [d.count() for d in (a, b, c)] == [3, 4, 3]
+    assert sorted(r["id"] for r in b.take_all()) == [3, 4, 5, 6]
+    with pytest.raises(ValueError, match="sorted"):
+        ds.split_at_indices([7, 3])
+
+    x, y, z = rd.range(20).split_proportionately([0.25, 0.5])
+    assert [d.count() for d in (x, y, z)] == [5, 10, 5]
+    with pytest.raises(ValueError, match="less than 1"):
+        rd.range(4).split_proportionately([0.5, 0.5])
+
+
+def test_train_test_split():
+    train, test = rd.range(100).train_test_split(0.2)
+    assert train.count() == 80 and test.count() == 20
+    # absolute count + shuffle covers the whole range exactly once
+    train, test = rd.range(10).train_test_split(3, shuffle=True, seed=0)
+    ids = sorted(r["id"] for r in train.take_all()) + \
+        sorted(r["id"] for r in test.take_all())
+    assert sorted(ids) == list(range(10)) and test.count() == 3
+
+
+def test_unique_and_size_and_block_order():
+    ds = rd.from_items([{"v": i % 3, "w": "x"} for i in range(12)])
+    assert sorted(ds.unique("v")) == [0, 1, 2]
+    assert ds.size_bytes() > 0
+    shuffled = rd.range(16).randomize_block_order(seed=1)
+    assert sorted(r["id"] for r in shuffled.take_all()) == \
+        list(range(16))
+    # List-valued columns come back as the ORIGINAL lists, and struct
+    # (dict) values dedupe instead of raising unhashable-type.
+    tags = rd.from_items([{"t": [1, 2]}, {"t": [1, 2]}, {"t": [3]}])
+    assert [1, 2] in tags.unique("t") and len(tags.unique("t")) == 2
+    structs = rd.from_items([{"s": {"a": 1}}, {"s": {"a": 1}},
+                             {"s": {"a": 2}}])
+    assert len(structs.unique("s")) == 2
+
+
+def test_split_equal_truncates_remainder():
+    parts = rd.range(10).split(3, equal=True)
+    assert [p.count() for p in parts] == [3, 3, 3]
+    parts = rd.range(10).split(3)
+    assert sum(p.count() for p in parts) == 10
+
+
+def test_to_refs_roundtrip():
+    ds = rd.from_items([{"a": i} for i in range(6)])
+    back = rd.from_arrow_refs(ds.to_arrow_refs())
+    assert sorted(r["a"] for r in back.take_all()) == list(range(6))
+    back = rd.from_pandas_refs(ds.to_pandas_refs())
+    assert back.count() == 6
+    refs = rd.from_numpy(np.arange(5), column="v").to_numpy_refs(
+        column="v")
+    vals = np.sort(np.concatenate([np.asarray(ray_tpu.get(r))
+                                   for r in refs]))
+    np.testing.assert_array_equal(vals, np.arange(5))
+
+
+def test_to_dataframe_bridges_stubs():
+    import pandas as pd
+
+    ds = rd.from_items([{"q": 1}, {"q": 2}])
+
+    concat_args = []
+    mod = types.ModuleType("dask.dataframe")
+    mod.from_pandas = lambda df, npartitions=1: ("part", len(df))
+    mod.concat = lambda parts: concat_args.append(parts) or "dask-df"
+    assert ds.to_dask(_module=mod) == "dask-df"
+    assert len(concat_args[0]) >= 1
+
+    mpd = types.ModuleType("modin.pandas")
+    mpd.DataFrame = lambda df: ("modin", len(df))
+    assert ds.to_modin(_module=mpd) == ("modin", 2)
+
+    class _Spark:
+        def createDataFrame(self, df):
+            return ("spark", len(df))
+
+    assert ds.to_spark(_Spark()) == ("spark", 2)
+    with pytest.raises(TypeError, match="SparkSession"):
+        ds.to_spark(object())
+
+    captured = {}
+    tf = types.ModuleType("tensorflow")
+    tf.data = types.SimpleNamespace(Dataset=types.SimpleNamespace(
+        from_tensor_slices=lambda batch: captured.update(batch) or "tfds"))
+    assert ds.to_tf(_module=tf) == "tfds"
+    np.testing.assert_array_equal(np.sort(captured["q"]), [1, 2])
+
+
 def test_missing_module_guidance():
     with pytest.raises(ImportError, match="read_parquet"):
         rd.read_lance("mem://t")
