@@ -344,12 +344,15 @@ class Dataset:
         if isinstance(test_size, float):
             if not 0 < test_size < 1:
                 raise ValueError("test_size fraction must be in (0, 1)")
-            n_test = int(total * test_size)
+            # Reference parity: split_proportionately([1 - test_size])
+            # puts int(total * (1 - test_size)) rows in train.
+            n_train = int(total * (1 - test_size))
         else:
             n_test = int(test_size)
             if not 0 <= n_test <= total:
                 raise ValueError(f"test_size {n_test} out of range")
-        train, test = ds._split_combined(combined, [total - n_test])
+            n_train = total - n_test
+        train, test = ds._split_combined(combined, [n_train])
         return train, test
 
     def unique(self, column: str) -> List[Any]:
@@ -384,11 +387,9 @@ class Dataset:
         return ds
 
     def size_bytes(self) -> int:
-        """In-memory byte estimate (reference Dataset.size_bytes)."""
-        from ray_tpu.data.block import block_to_arrow
-
-        return sum(block_to_arrow(b).nbytes
-                   for b in self.iter_internal_blocks())
+        """In-memory byte estimate (reference Dataset.size_bytes); both
+        block types expose .nbytes directly — no Arrow conversion."""
+        return sum(b.nbytes for b in self.iter_internal_blocks())
 
     def show(self, limit: int = 20) -> None:
         """Print up to `limit` rows (reference Dataset.show)."""
@@ -811,6 +812,20 @@ class GroupedData:
                 raise ValueError(f"unknown aggregate {kind!r}")
             aggs.append((kind, on, out_name))
         op = L.GroupByAggregate(key=self._key, aggs=tuple(aggs))
+        op.inputs = [self._ds._terminal]
+        return Dataset(op)
+
+    def map_groups(self, fn, *, batch_format: str = "pandas") -> Dataset:
+        """Apply `fn` once per key-group (reference
+        grouped_data.py map_groups): fn receives the whole group as a
+        pandas DataFrame ("pandas") or dict-of-ndarrays ("numpy") and
+        returns a batch, a DataFrame, a list of rows, or None."""
+        if self._key is None:
+            raise ValueError("map_groups() requires a groupby key")
+        if batch_format not in ("pandas", "numpy"):
+            raise ValueError("batch_format must be 'pandas' or 'numpy'")
+        op = L.GroupByMapGroups(key=self._key, fn=fn,
+                                batch_format=batch_format)
         op.inputs = [self._ds._terminal]
         return Dataset(op)
 

@@ -99,6 +99,13 @@ class GroupByAggregate(LogicalOp):
 
 
 @dataclasses.dataclass
+class GroupByMapGroups(LogicalOp):
+    key: Optional[str] = None
+    fn: Optional[Any] = None          # batch -> batch/rows, one group
+    batch_format: str = "pandas"
+
+
+@dataclasses.dataclass
 class Write(LogicalOp):
     write_fn: Optional[Callable] = None  # (block, path, index) -> path
     path: str = ""

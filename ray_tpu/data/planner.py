@@ -416,6 +416,37 @@ def _group_agg_task(index: int, key: Optional[str],
     return [block], {"num_rows": block.num_rows, "size_bytes": block.nbytes}
 
 
+def _map_groups_task(index: int, key: str, fn, batch_format: str,
+                     *piles: List[Block]) -> Tuple[List[Block], dict]:
+    """Apply `fn` once per key-group within this hash partition
+    (reference grouped_data.py map_groups: every group lands wholly in
+    one partition, so per-partition grouping is global grouping)."""
+    mine = [p[index] for p in piles if p[index].num_rows > 0]
+    if not mine:
+        return [], {"num_rows": 0, "size_bytes": 0}
+    df = concat_blocks(mine).to_pandas()
+    blocks: List[Block] = []
+    for _, group in df.groupby(key, sort=True, dropna=False):
+        if batch_format == "pandas":
+            out = fn(group.reset_index(drop=True))
+        else:  # numpy dict
+            out = fn({c: group[c].to_numpy() for c in group.columns})
+        if out is None:
+            continue
+        # batch_to_block normalizes dicts AND DataFrames, honoring
+        # DataContext.block_format (hand-rolled conversion here would
+        # inject arrow blocks into a pandas-format pipeline).
+        block = (rows_to_block(out) if isinstance(out, list)
+                 else batch_to_block(out))
+        if block.num_rows:
+            blocks.append(block)
+    if not blocks:
+        return [], {"num_rows": 0, "size_bytes": 0}
+    combined = concat_blocks(blocks)
+    return [combined], {"num_rows": combined.num_rows,
+                        "size_bytes": combined.nbytes}
+
+
 _AGG_FNS = {"sum": "sum", "min": "min", "max": "max",
             "mean": "mean", "count": "count", "std": "std"}
 
@@ -438,6 +469,32 @@ def _pandas_aggregate(df, key: Optional[str],
     return out
 
 
+def _hash_shuffle(bundles: List[RefBundle], key: str, reduce_task,
+                  *reduce_args) -> List[RefBundle]:
+    """Shared scaffold of the key-hashed all-to-all: partition every
+    bundle into k piles, barrier on the partition metas, then fan out
+    one reduce task per pile index.  reduce_task(idx, key, *args,
+    *pile_refs) -> (blocks, meta) with num_returns=2."""
+    k = max(1, min(len(bundles), 16))
+    part = ray_tpu.remote(num_returns=2)(_hash_partition_task)
+    reduce_remote = ray_tpu.remote(num_returns=2)(reduce_task)
+    pile_refs, metas = [], []
+    for b in bundles:
+        blocks_ref, meta_ref = part.remote(b.blocks_ref, key, k)
+        pile_refs.append(blocks_ref)
+        metas.append(meta_ref)
+    ray_tpu.get(metas)
+    pending = [reduce_remote.remote(idx, key, *reduce_args, *pile_refs)
+               for idx in range(k)]
+    out = []
+    for blocks_ref, meta_ref in pending:
+        summary = ray_tpu.get(meta_ref)
+        if summary["num_rows"] > 0:
+            out.append(RefBundle(
+                blocks_ref, summary["num_rows"], summary["size_bytes"]))
+    return out
+
+
 def plan_groupby(op: L.GroupByAggregate):
     key, aggs = op.key, list(op.aggs)
 
@@ -448,25 +505,21 @@ def plan_groupby(op: L.GroupByAggregate):
             df = concat_blocks(_fetch_all_blocks(bundles)).to_pandas()
             block = batch_to_block(_pandas_aggregate(df, None, aggs))
             return [RefBundle.from_blocks([block])]
-        k = max(1, min(len(bundles), 16))
-        part = ray_tpu.remote(num_returns=2)(_hash_partition_task)
-        agg = ray_tpu.remote(num_returns=2)(_group_agg_task)
-        pile_refs, metas = [], []
-        for b in bundles:
-            blocks_ref, meta_ref = part.remote(b.blocks_ref, key, k)
-            pile_refs.append(blocks_ref)
-            metas.append(meta_ref)
-        ray_tpu.get(metas)
-        pending = [agg.remote(idx, key, aggs, *pile_refs) for idx in range(k)]
-        out = []
-        for blocks_ref, meta_ref in pending:
-            summary = ray_tpu.get(meta_ref)
-            if summary["num_rows"] > 0:
-                out.append(RefBundle(
-                    blocks_ref, summary["num_rows"], summary["size_bytes"]))
-        return out
+        return _hash_shuffle(bundles, key, _group_agg_task, aggs)
 
     return AllToAllOperator(f"GroupBy[{key}]", bulk)
+
+
+def plan_map_groups(op: "L.GroupByMapGroups"):
+    key, fn, batch_format = op.key, op.fn, op.batch_format
+
+    def bulk(bundles: List[RefBundle]) -> List[RefBundle]:
+        if not bundles:
+            return []
+        return _hash_shuffle(bundles, key, _map_groups_task,
+                             fn, batch_format)
+
+    return AllToAllOperator(f"MapGroups[{key}]", bulk)
 
 
 # ---------------------------------------------------------------------------
@@ -579,6 +632,10 @@ def build_topology(plan: "L.LogicalPlan") -> List[PhysicalOperator]:
         elif isinstance(op, L.GroupByAggregate):
             up = lower(op.inputs[0])
             phys = emit(plan_groupby(op))
+            connect(up, phys)
+        elif isinstance(op, L.GroupByMapGroups):
+            up = lower(op.inputs[0])
+            phys = emit(plan_map_groups(op))
             connect(up, phys)
         else:
             raise NotImplementedError(f"cannot lower {op.name}")

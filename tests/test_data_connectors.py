@@ -649,6 +649,10 @@ def test_split_at_indices_and_proportionately():
 def test_train_test_split():
     train, test = rd.range(100).train_test_split(0.2)
     assert train.count() == 80 and test.count() == 20
+    # Non-exact fraction rounds like the reference's
+    # split_proportionately([1 - test_size]): train = int(10 * 0.75).
+    train, test = rd.range(10).train_test_split(0.25)
+    assert train.count() == 7 and test.count() == 3
     # absolute count + shuffle covers the whole range exactly once
     train, test = rd.range(10).train_test_split(3, shuffle=True, seed=0)
     ids = sorted(r["id"] for r in train.take_all()) + \
@@ -670,6 +674,38 @@ def test_unique_and_size_and_block_order():
     structs = rd.from_items([{"s": {"a": 1}}, {"s": {"a": 1}},
                              {"s": {"a": 2}}])
     assert len(structs.unique("s")) == 2
+
+
+def test_map_groups():
+    ds = rd.from_items([{"k": i % 3, "v": float(i)} for i in range(12)])
+
+    def top1(df):  # pandas group in, DataFrame out
+        return df.nlargest(1, "v")
+
+    rows = sorted(ds.groupby("k").map_groups(top1).take_all(),
+                  key=lambda r: r["k"])
+    assert [(r["k"], r["v"]) for r in rows] == [(0, 9.0), (1, 10.0),
+                                               (2, 11.0)]
+
+    def spread(batch):  # numpy group in, dict-batch out
+        return {"k": batch["k"][:1],
+                "spread": [float(batch["v"].max() - batch["v"].min())]}
+
+    rows = sorted(ds.groupby("k").map_groups(
+        spread, batch_format="numpy").take_all(), key=lambda r: r["k"])
+    assert all(r["spread"] == 9.0 for r in rows) and len(rows) == 3
+
+    # None drops a group; list-of-rows output works.
+    def keep_even(df):
+        if int(df["k"].iloc[0]) % 2:
+            return None
+        return [{"k": int(df["k"].iloc[0]), "n": len(df)}]
+
+    rows = ds.groupby("k").map_groups(keep_even).take_all()
+    assert sorted(r["k"] for r in rows) == [0, 2]
+
+    with pytest.raises(ValueError, match="groupby key"):
+        ds.groupby(None).map_groups(top1)
 
 
 def test_split_equal_truncates_remainder():
