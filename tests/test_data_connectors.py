@@ -708,6 +708,53 @@ def test_map_groups():
         ds.groupby(None).map_groups(top1)
 
 
+def test_show_and_empty_bridges(capsys):
+    rd.range(3).show()
+    out = capsys.readouterr().out
+    assert out.count("{") == 3 and "'id': 0" in out
+
+    # Empty dataset through the bridges: defined, not crashing.
+    empty = rd.from_items([{"a": 1}]).filter(lambda r: False)
+    refs = empty.to_arrow_refs()
+    assert all(ray_tpu.get(r).num_rows == 0 for r in refs)
+    assert empty.size_bytes() >= 0
+
+    made = []
+    mod = types.ModuleType("dask.dataframe")
+    mod.from_pandas = lambda df, npartitions=1: made.append(len(df)) or "p"
+    mod.concat = lambda parts: "df"
+    empty.to_dask(_module=mod)  # hits the no-blocks fallback
+    assert made == [0]
+
+
+def test_map_groups_under_pandas_block_format():
+    """map_groups DataFrame outputs normalize through batch_to_block,
+    so a pandas-format pipeline keeps pandas blocks."""
+    import subprocess
+    import sys
+
+    code = """
+import ray_tpu, ray_tpu.data as rd
+from ray_tpu.data.context import DataContext
+DataContext.get_current().block_format = "pandas"
+ray_tpu.init(num_cpus=2)
+ds = rd.from_items([{"k": i % 2, "v": i} for i in range(6)])
+rows = ds.groupby("k").map_groups(lambda df: df.nlargest(1, "v"))
+out = sorted((r["k"], r["v"]) for r in rows.take_all())
+assert out == [(0, 4), (1, 5)], out
+from ray_tpu.data.block import PandasBlock
+blocks = list(rows.iter_internal_blocks())
+assert blocks and all(isinstance(b, PandasBlock) for b in blocks), blocks
+print("OK")
+"""
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run([sys.executable, "-c", code], cwd=repo,
+                         capture_output=True, text=True, timeout=120)
+    assert "OK" in res.stdout, res.stdout + res.stderr
+
+
 def test_split_equal_truncates_remainder():
     parts = rd.range(10).split(3, equal=True)
     assert [p.count() for p in parts] == [3, 3, 3]
