@@ -11,6 +11,15 @@
 // (ray_tpu/core/rpc.py kind=3), so this header has zero dependencies
 // beyond POSIX sockets.
 //
+// Micro-batched frames (rpc.py kind=5 KIND_BATCH, pickled; kind=6
+// KIND_BATCH_JSON, a JSON array of [kind, req_id, msg] triples): the
+// server only coalesces frames toward peers that have sent pickle
+// frames themselves, so this client never RECEIVES either kind — the
+// `if (kind != 1) continue;` recv loops below stay correct as-is.  A
+// client MAY send one KIND_BATCH_JSON frame carrying several kind-3
+// sub-requests and will get one kind-1 JSON response per sub-request,
+// in order; this header keeps to plain frames for simplicity.
+//
 // Usage:
 //   ray::tpu::Client c("127.0.0.1:6123");
 //   std::string obj = c.SubmitTask("add", "[2, 3]");

@@ -97,6 +97,11 @@ class Config:
     lease_idle_timeout_s: float = 0.25
     # Cap on workers one lease request asks for.
     max_lease_workers_per_request: int = 16
+    # How long an unanswered lease ask holds pipeline depth at 1 (so
+    # early tasks spread across incoming workers).  Past this, the ask
+    # is treated as queued-for-capacity and full-depth pipelining
+    # resumes on the workers already held.
+    lease_scaleup_clamp_s: float = 1.0
 
     # -- fault tolerance ------------------------------------------------
     task_max_retries: int = 3

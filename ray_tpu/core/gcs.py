@@ -1299,6 +1299,24 @@ class ControlServer:
         for obj_hex in msg["objs"]:
             self._op_decref(conn, {"obj": obj_hex})
 
+    def _op_refcount_delta(self, conn, msg):
+        """Net per-object ref-count deltas, coalesced client-side from
+        an adjacent incref/decref run (runtime._head_frames): positive
+        entries are plain increfs, negative ones go through the decref
+        path so free-on-zero (shm/spill cleanup) still fires."""
+        decrefs = []
+        with self.lock:
+            for obj_hex, d in msg["deltas"].items():
+                d = int(d)
+                if d > 0:
+                    entry = self.objects.get(obj_hex)
+                    if entry is not None:
+                        entry.refcount += d
+                elif d < 0:
+                    decrefs.append((obj_hex, -d))
+        for obj_hex, n in decrefs:
+            self._op_decref(conn, {"obj": obj_hex, "n": n})
+
     def _op_decref(self, conn, msg):
         to_delete = []
         with self.lock:
@@ -1826,6 +1844,26 @@ class ControlServer:
             unclaimed = starting_total - sum(
                 1 for pl in self.pending_leases
                 if pl["env_key"] == env_key)
+            # Fair-share clamp under competition: with other owners
+            # holding leases or queued demand, one burst's ask must not
+            # swallow the whole free pool first-come-take-all — the
+            # losers would crawl on a single worker while the winner
+            # hoards, and concurrent-submitter throughput is gated by
+            # the slowest owner.  Denied remainders retry after backoff
+            # and pick up whatever share frees.
+            others = {w.leased_to for w in self.workers.values()
+                      if w.kind == "pool" and w.state == "leased"
+                      and w.leased_to and w.leased_to != owner_hex}
+            others.update(pl["owner"] for pl in self.pending_leases
+                          if pl["owner"] != owner_hex)
+            if others and count > 1:
+                free_fit = sum(virt(n.node_id).fit_count(need)
+                               for n in self.nodes.values()
+                               if n.schedulable)
+                share = max(1, free_fit // (len(others) + 1))
+                if count > share:
+                    denied += count - share
+                    count = share
             for i in range(count):
                 w = self._idle_lease_worker_locked(env_key, need, virt)
                 if w is not None:
@@ -1847,7 +1885,13 @@ class ControlServer:
                             if n.schedulable and need.is_subset_of(
                                 virt(n.node_id))]
                 if not feasible:
-                    if int(msg.get("have", 0)) > 0:
+                    # Workers granted THIS call count as "have": the
+                    # owner sent have= before any grant arrived, and an
+                    # infeasible remainder queued behind a partial grant
+                    # would pin the owner's requested counter (and its
+                    # pipeline depth) until capacity frees — which never
+                    # happens while the owner itself holds it.
+                    if int(msg.get("have", 0)) + len(granted) > 0:
                         # Owner has workers to pipeline onto: deny the
                         # excess fast (it backs off and retries).
                         denied += count - i
@@ -1954,12 +1998,16 @@ class ControlServer:
         # there, deduped against already-starting workers.
         node_workers: Dict[str, int] = {}
         starting: Dict[str, int] = {}
+        leased_by: Dict[tuple, int] = {}
         for w in self.workers.values():
             if w.kind == "pool" and w.state != "dead":
                 node_workers[w.node_id] = node_workers.get(
                     w.node_id, 0) + 1
                 if w.state == "starting":
                     starting[w.env_key] = starting.get(w.env_key, 0) + 1
+                if w.state == "leased":
+                    key = (w.leased_to, w.env_key)
+                    leased_by[key] = leased_by.get(key, 0) + 1
         for pl in self.pending_leases:
             owner = self.workers.get(pl["owner"])
             if owner is None or owner.state == "dead" or owner.conn is None:
@@ -2009,6 +2057,14 @@ class ControlServer:
                 node_workers[node.node_id] = node_workers.get(
                     node.node_id, 0) + 1
                 still.append(pl)
+            elif leased_by.get((pl["owner"], pl["env_key"]), 0) > 0:
+                # Cluster-infeasible remainder of a request whose owner
+                # now holds same-shaped workers: deny now, exactly as
+                # _op_request_lease does for have>0 askers.  Keeping it
+                # queued would pin the owner's requested counter — and
+                # with it the owner's pipeline depth — on capacity the
+                # owner itself occupies.
+                out.append((owner.conn, pl["token"], [], 1, ""))
             elif now - pl["created"] > (10.0 if pl.get("node_id")
                                         else 15.0):
                 # Spawn never materialized (10s), or cluster-infeasible

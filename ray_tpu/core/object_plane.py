@@ -115,10 +115,14 @@ class PushManager:
                 # blocking send applies receiver backpressure, and
                 # push_end's byte-count check catches any loss.  The
                 # budget bounds bytes handed to the kernel across all
-                # destinations.
+                # destinations — wait=True keeps the accounting honest
+                # under rpc coalescing (the budget slot must not be
+                # released while the chunk still sits in the send
+                # buffer).
                 conn.send({"op": "push_chunk", "obj": obj_hex,
                            "offset": off,
-                           "data": bytes(seg.buf[off:off + n])})
+                           "data": bytes(seg.buf[off:off + n])},
+                          wait=True)
             finally:
                 budget.release()
             off += n

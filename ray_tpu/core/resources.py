@@ -57,6 +57,13 @@ class ResourceSet:
     def is_subset_of(self, other: "ResourceSet") -> bool:
         return all(other._units.get(k, 0) >= v for k, v in self._units.items())
 
+    def fit_count(self, need: "ResourceSet") -> int:
+        """How many disjoint copies of `need` fit inside this set."""
+        if not need._units:
+            return 1 << 30
+        return min(self._units.get(k, 0) // v
+                   for k, v in need._units.items())
+
     def add(self, other: "ResourceSet") -> "ResourceSet":
         units = dict(self._units)
         for k, v in other._units.items():

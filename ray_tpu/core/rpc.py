@@ -7,12 +7,25 @@ protocol over TCP sockets:
     [1-byte kind][8-byte request id][4-byte len][pickle payload]
 
 kind: 0 = request (expects response), 1 = response, 2 = one-way,
-      3 = JSON request (payload is UTF-8 JSON; response is JSON too).
+      3 = JSON request (payload is UTF-8 JSON; response is JSON too),
+      5 = batch (payload is one pickle of [(kind, req_id, payload), ...]),
+      6 = JSON batch (payload is a JSON array of [kind, req_id, msg]).
 
 Kind 3 is the cross-language door (reference: the gRPC protos any
 language can speak): non-Python frontends (cpp/ client) call the same
 ops with JSON payloads and get `{"status": "ok"|"err", ...}` JSON back;
 bytes values are transported as {"__bytes_b64__": ...}.
+
+Kind 5/6 are the control-plane coalescing frames (reference: Ray's
+batched worker↔raylet traffic): senders buffer while a write is on the
+wire and flush whatever accumulated as ONE frame, so a burst of small
+control messages costs a handful of sendalls instead of thousands.  The
+receiver unpacks and dispatches sub-messages in order; semantics are
+identical to having received each sub-frame individually.  Batches are
+never nested, and the server only emits pickle batches to peers that
+have themselves spoken pickle — JSON-only peers (the C++ client) keep
+getting plain frames.  Set RAY_TPU_RPC_NO_BATCH=1 to disable coalescing
+entirely and restore the one-frame-per-message protocol byte for byte.
 
 Server: thread per connection, handler invoked per message; handler may
 return a value (sent back as response) or None for one-way messages.
@@ -23,6 +36,7 @@ from __future__ import annotations
 
 import base64
 import json
+import os
 import pickle
 import socket
 import struct
@@ -39,6 +53,39 @@ KIND_REQUEST_JSON = 3
 # One-way server→client push encoded as JSON — for non-Python peers
 # (the C++ worker's task delivery; cpp/include/ray_tpu/worker.h).
 KIND_ONEWAY_JSON = 4
+# Coalesced frame: payload pickles a list of (kind, req_id, payload)
+# sub-frames, dispatched in order on the receiving side.
+KIND_BATCH = 5
+# Cross-language form: payload is a JSON array of [kind, req_id, msg]
+# triples (kind 3 entries only; each gets its own KIND_RESPONSE).
+KIND_BATCH_JSON = 6
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def batching_enabled() -> bool:
+    """Master switch for wire-level coalescing.  Checked at Client /
+    Connection construction (not per send) so a process-wide
+    RAY_TPU_RPC_NO_BATCH=1 restores the legacy protocol exactly."""
+    return os.environ.get(
+        "RAY_TPU_RPC_NO_BATCH", "").strip().lower() not in _TRUTHY
+
+
+def _batch_caps() -> tuple[int, int]:
+    """(max messages, max payload bytes) folded into one KIND_BATCH
+    frame.  Oversized runs split into several frames within one drain
+    round; a single message larger than the byte cap still goes out
+    (as a plain frame) — the cap bounds coalescing, not message size."""
+    try:
+        msgs = int(os.environ.get("RAY_TPU_RPC_BATCH_MAX_MSGS", "512"))
+    except ValueError:
+        msgs = 512
+    try:
+        nbytes = int(os.environ.get(
+            "RAY_TPU_RPC_BATCH_MAX_BYTES", str(4 << 20)))
+    except ValueError:
+        nbytes = 4 << 20
+    return max(2, msgs), max(1 << 16, nbytes)
 
 
 def _to_jsonable(value: Any):
@@ -121,6 +168,118 @@ def _recv_frame(sock: socket.socket):
     return kind, req_id, payload
 
 
+class _CoalescingSender:
+    """Adaptive per-connection send coalescer — Nagle without the
+    latency cliff.  The first message on an idle link is flushed
+    IMMEDIATELY on the enqueuing thread (no timer, no added latency);
+    messages arriving while that write is still on the wire pile into
+    the buffer, and the draining thread flushes whatever accumulated as
+    ONE KIND_BATCH frame when the in-flight sendall returns.  An
+    uncontended link therefore produces byte-for-byte the unbatched
+    protocol (single-entry rounds keep the plain frame encoding), while
+    contended links amortize framing, syscalls, and lock handoffs.
+
+    Payloads are pre-encoded by the caller, so per-entry size is known
+    here and the receiver's sub-dispatch is identical to the plain
+    path.  One instance guards one socket; `wire_lock` is the owner's
+    existing socket write lock (JSON responses and legacy paths still
+    write under it directly, so batched and direct frames never
+    interleave mid-frame)."""
+
+    def __init__(self, sock: socket.socket, wire_lock: threading.Lock):
+        self._sock = sock
+        self._wire_lock = wire_lock
+        # RLock: appending can allocate → GC → __del__ hooks; a re-
+        # entrant enqueue from the same thread must not deadlock.
+        self._lock = threading.RLock()
+        self._cv = threading.Condition(self._lock)
+        self._buf: list[tuple[int, int, bytes]] = []
+        self._sending = False
+        self.max_msgs, self.max_bytes = _batch_caps()
+        # Telemetry for tests and the RPC microbench probe.
+        self.frames_sent = 0
+        self.msgs_sent = 0
+        self.batches_sent = 0
+
+    def send(self, kind: int, req_id: int, payload: bytes,
+             wait: bool = False):
+        """Enqueue one message.  If no write is in flight the calling
+        thread becomes the drainer (immediate flush); otherwise the
+        message rides the next coalesced frame.  wait=True blocks until
+        the message is on the socket — backpressure-sensitive paths
+        (object-plane chunk streaming) opt in to keep their in-flight
+        byte budget honest."""
+        with self._lock:
+            self._buf.append((kind, req_id, payload))
+            self.msgs_sent += 1
+            if self._sending:
+                if wait:
+                    while self._buf or self._sending:
+                        self._cv.wait()
+                return
+            self._sending = True
+        self._drain()
+
+    def flush(self):
+        """Block until every message enqueued before this call is on
+        the socket.  Ordering fences (worker oversized-result handoff,
+        shutdown) need the hard guarantee; on an idle link this returns
+        immediately."""
+        while True:
+            with self._lock:
+                if self._sending:
+                    self._cv.wait()
+                    continue
+                if not self._buf:
+                    return
+                self._sending = True
+            self._drain()
+
+    def _drain(self):
+        """Flush loop run by whichever thread claimed `_sending`: swap
+        the buffer out, encode, write, repeat until nothing new arrived
+        during the write."""
+        try:
+            while True:
+                with self._lock:
+                    if not self._buf:
+                        self._sending = False
+                        self._cv.notify_all()
+                        return
+                    batch, self._buf = self._buf, []
+                for frame in self._encode(batch):
+                    with self._wire_lock:
+                        self._sock.sendall(frame)
+        except BaseException:
+            with self._lock:
+                self._sending = False
+                self._cv.notify_all()
+            raise
+
+    def _encode(self, batch: list[tuple[int, int, bytes]]) -> list[bytes]:
+        frames = []
+        i, n = 0, len(batch)
+        while i < n:
+            # Greedy size/count-capped run starting at i.
+            run_bytes = len(batch[i][2])
+            j = i + 1
+            while (j < n and j - i < self.max_msgs
+                   and run_bytes + len(batch[j][2]) <= self.max_bytes):
+                run_bytes += len(batch[j][2])
+                j += 1
+            if j - i == 1:
+                kind, req_id, payload = batch[i]
+                frames.append(
+                    _FRAME.pack(kind, req_id, len(payload)) + payload)
+            else:
+                blob = pickle.dumps(batch[i:j], protocol=5)
+                frames.append(_FRAME.pack(KIND_BATCH, 0, len(blob)) + blob)
+                self.batches_sent += 1
+            self.frames_sent += 1
+            i = j
+        return frames
+
+
 class Connection:
     """Server-side handle to a connected peer; supports pushing messages."""
 
@@ -130,12 +289,23 @@ class Connection:
         self.send_lock = threading.Lock()
         self.meta: dict = {}
         self.alive = True
+        # Flips True the first time the peer sends a pickle frame: only
+        # peers that speak pickle can decode KIND_BATCH, so pushes and
+        # responses to JSON-only peers (the C++ client) stay plain.
+        self.peer_pickle = False
+        self._sender = (_CoalescingSender(sock, self.send_lock)
+                        if batching_enabled() else None)
+
+    def _post(self, kind: int, req_id: int, payload: bytes):
+        if self._sender is not None and self.peer_pickle:
+            self._sender.send(kind, req_id, payload)
+        else:
+            with self.send_lock:
+                _send_frame(self.sock, kind, req_id, payload)
 
     def push(self, msg: Any):
         """One-way server→client message."""
-        payload = pickle.dumps(msg, protocol=5)
-        with self.send_lock:
-            _send_frame(self.sock, KIND_ONEWAY, 0, payload)
+        self._post(KIND_ONEWAY, 0, pickle.dumps(msg, protocol=5))
 
     def push_json(self, msg: Any):
         """One-way push a non-Python peer can parse (KIND_ONEWAY_JSON)."""
@@ -144,12 +314,20 @@ class Connection:
             _send_frame(self.sock, KIND_ONEWAY_JSON, 0, payload)
 
     def respond(self, req_id: int, msg: Any):
-        payload = pickle.dumps(msg, protocol=5)
-        with self.send_lock:
-            _send_frame(self.sock, KIND_RESPONSE, req_id, payload)
+        self._post(KIND_RESPONSE, req_id, pickle.dumps(msg, protocol=5))
+
+    def flush_sends(self):
+        """Fence: block until buffered pushes/responses hit the socket."""
+        if self._sender is not None:
+            self._sender.flush()
 
     def close(self):
         self.alive = False
+        if self._sender is not None:
+            try:
+                self._sender.flush()
+            except (RpcError, OSError):
+                pass
         try:
             self.sock.shutdown(socket.SHUT_RDWR)
         except OSError:
@@ -271,47 +449,21 @@ class Server:
         try:
             while not self._stopped.is_set():
                 kind, req_id, payload = _recv_frame(conn.sock)
-                if kind == KIND_REQUEST_JSON:
-                    try:
-                        msg = _from_jsonable(json.loads(payload))
-                        if self._json_validator is not None:
-                            self._json_validator(msg)
-                        result = self._handler(conn, msg)
-                        # allow_nan=False: bare NaN/Infinity tokens are
-                        # invalid JSON for non-Python peers.
-                        out = json.dumps({"status": "ok",
-                                          "result": _to_jsonable(result)},
-                                         allow_nan=False)
-                    except Exception as e:  # noqa: BLE001
-                        out = json.dumps({
-                            "status": "err",
-                            "error": f"{type(e).__name__}: {e}"})
-                    with conn.send_lock:
-                        _send_frame(conn.sock, KIND_RESPONSE, req_id,
-                                    out.encode())
-                    continue
-                msg = pickle.loads(payload)
-                if kind == KIND_REQUEST:
-                    try:
-                        result = self._handler(conn, msg)
-                        if isinstance(result, Deferred):
-                            # Long-running op: the handler parks the
-                            # response; another thread resolves it later.
-                            # This connection's serve loop moves on so
-                            # the client's other in-flight calls aren't
-                            # head-of-line blocked.
-                            result.bind(conn, req_id)
+                if kind == KIND_BATCH:
+                    conn.peer_pickle = True
+                    for sub_kind, sub_id, sub_payload in \
+                            pickle.loads(payload):
+                        if sub_kind in (KIND_BATCH, KIND_BATCH_JSON):
+                            continue  # batches never nest
+                        self._dispatch(conn, sub_kind, sub_id, sub_payload)
+                elif kind == KIND_BATCH_JSON:
+                    for entry in json.loads(payload):
+                        sub_kind, sub_id, raw = entry
+                        if sub_kind != KIND_REQUEST_JSON:
                             continue
-                        conn.respond(req_id, ("ok", result))
-                    except Exception as e:  # noqa: BLE001
-                        conn.respond(req_id, ("err", e))
+                        self._handle_json(conn, sub_id, raw)
                 else:
-                    try:
-                        self._handler(conn, msg)
-                    except Exception:
-                        import traceback
-
-                        traceback.print_exc()
+                    self._dispatch(conn, kind, req_id, payload)
         except (RpcError, OSError, EOFError):
             pass
         finally:
@@ -325,6 +477,63 @@ class Server:
                     self._on_disconnect(conn)
                 except Exception:
                     pass
+
+    def _dispatch(self, conn: Connection, kind: int, req_id: int,
+                  payload: bytes):
+        """Handle one (possibly batch-unpacked) frame.  Semantics match
+        the pre-batching serve loop exactly — a failing sub-request in a
+        batch responds ("err", e) like any failing request."""
+        if kind == KIND_REQUEST_JSON:
+            self._handle_json(conn, req_id, payload)
+            return
+        conn.peer_pickle = True
+        msg = pickle.loads(payload)
+        if kind == KIND_REQUEST:
+            try:
+                result = self._handler(conn, msg)
+                if isinstance(result, Deferred):
+                    # Long-running op: the handler parks the response;
+                    # another thread resolves it later.  This
+                    # connection's serve loop moves on so the client's
+                    # other in-flight calls aren't head-of-line blocked.
+                    result.bind(conn, req_id)
+                    return
+                conn.respond(req_id, ("ok", result))
+            except Exception as e:  # noqa: BLE001
+                conn.respond(req_id, ("err", e))
+        else:
+            try:
+                self._handler(conn, msg)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def _handle_json(self, conn: Connection, req_id: int, raw: Any):
+        """One KIND_REQUEST_JSON message (standalone or from a JSON
+        batch): validate against the wire schema, dispatch, respond with
+        its own JSON KIND_RESPONSE frame.  `raw` is the undecoded
+        payload bytes for standalone frames (malformed JSON must come
+        back as an err response, not kill the connection) or the
+        already-parsed document for batch entries."""
+        try:
+            if isinstance(raw, (bytes, bytearray)):
+                raw = json.loads(raw)
+            msg = _from_jsonable(raw)
+            if self._json_validator is not None:
+                self._json_validator(msg)
+            result = self._handler(conn, msg)
+            # allow_nan=False: bare NaN/Infinity tokens are invalid
+            # JSON for non-Python peers.
+            out = json.dumps({"status": "ok",
+                              "result": _to_jsonable(result)},
+                             allow_nan=False)
+        except Exception as e:  # noqa: BLE001
+            out = json.dumps({
+                "status": "err",
+                "error": f"{type(e).__name__}: {e}"})
+        with conn.send_lock:
+            _send_frame(conn.sock, KIND_RESPONSE, req_id, out.encode())
 
     def stop(self):
         self._stopped.set()
@@ -376,6 +585,14 @@ class Client:
         # ops observe everything submitted before them (runtime.py).
         self._pre_call: Optional[Callable[[], None]] = None
         self._send_lock = threading.Lock()
+        # Wire coalescing (KIND_BATCH): requests AND one-ways share one
+        # FIFO buffer so total send order is preserved — the runtime
+        # relies on a call() observing every send() issued before it.
+        self._sender = (_CoalescingSender(self._sock, self._send_lock)
+                        if batching_enabled() else None)
+        # Legacy-path counters so frames_sent stays meaningful (and the
+        # burst-regression test stays expressible) under NO_BATCH.
+        self._plain_frames = 0
         self._pending: dict[int, threading.Event] = {}
         self._results: dict[int, Any] = {}
         self._next_id = 1
@@ -390,19 +607,14 @@ class Client:
         try:
             while True:
                 kind, req_id, payload = _recv_frame(self._sock)
-                msg = pickle.loads(payload)
-                if kind == KIND_RESPONSE:
-                    ev = self._pending.get(req_id)
-                    if ev is not None:
-                        self._results[req_id] = msg
-                        ev.set()
-                elif kind == KIND_ONEWAY and self._on_push is not None:
-                    try:
-                        self._on_push(msg)
-                    except Exception:
-                        import traceback
-
-                        traceback.print_exc()
+                if kind == KIND_BATCH:
+                    for sub_kind, sub_id, sub_payload in \
+                            pickle.loads(payload):
+                        if sub_kind in (KIND_BATCH, KIND_BATCH_JSON):
+                            continue  # batches never nest
+                        self._on_frame(sub_kind, sub_id, sub_payload)
+                else:
+                    self._on_frame(kind, req_id, payload)
         except (RpcError, OSError, EOFError):
             was_closed = self._closed
             self._closed = True
@@ -420,6 +632,53 @@ class Client:
 
                     traceback.print_exc()
 
+    def _on_frame(self, kind: int, req_id: int, payload: bytes):
+        msg = pickle.loads(payload)
+        if kind == KIND_RESPONSE:
+            ev = self._pending.get(req_id)
+            if ev is not None:
+                self._results[req_id] = msg
+                ev.set()
+        elif kind == KIND_ONEWAY and self._on_push is not None:
+            try:
+                self._on_push(msg)
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+
+    def _post(self, kind: int, req_id: int, payload: bytes,
+              wait: bool = False):
+        if self._sender is not None:
+            self._sender.send(kind, req_id, payload, wait=wait)
+        else:
+            with self._send_lock:
+                _send_frame(self._sock, kind, req_id, payload)
+                self._plain_frames += 1
+
+    @property
+    def frames_sent(self) -> int:
+        """Control-plane frames written to this socket (telemetry for
+        the burst-submission regression test and the RPC bench probe)."""
+        s = self._sender
+        return self._plain_frames if s is None else s.frames_sent
+
+    @property
+    def msgs_sent(self) -> int:
+        s = self._sender
+        return self._plain_frames if s is None else s.msgs_sent
+
+    @property
+    def batches_sent(self) -> int:
+        s = self._sender
+        return 0 if s is None else s.batches_sent
+
+    def flush_sends(self):
+        """Fence: block until every previously enqueued frame is on the
+        socket.  No-op without coalescing (sends are then synchronous)."""
+        if self._sender is not None:
+            self._sender.flush()
+
     def call(self, msg: Any, timeout: Optional[float] = None) -> Any:
         if self._closed:
             raise RpcError(f"connection to {self.address} closed")
@@ -431,8 +690,7 @@ class Client:
         ev = threading.Event()
         self._pending[req_id] = ev
         payload = pickle.dumps(msg, protocol=5)
-        with self._send_lock:
-            _send_frame(self._sock, KIND_REQUEST, req_id, payload)
+        self._post(KIND_REQUEST, req_id, payload)
         if not ev.wait(timeout):
             self._pending.pop(req_id, None)
             raise TimeoutError(f"rpc call timed out after {timeout}s")
@@ -442,16 +700,25 @@ class Client:
             raise result
         return result
 
-    def send(self, msg: Any):
-        """One-way message."""
+    def send(self, msg: Any, wait: bool = False):
+        """One-way message.  wait=True blocks until the bytes are on
+        the socket — callers whose flow control assumes a blocking send
+        (object-plane chunk streaming) keep their backpressure."""
         if self._closed:
             raise RpcError(f"connection to {self.address} closed")
         payload = pickle.dumps(msg, protocol=5)
-        with self._send_lock:
-            _send_frame(self._sock, KIND_ONEWAY, 0, payload)
+        self._post(KIND_ONEWAY, 0, payload, wait=wait)
 
     def close(self):
         self._closed = True
+        # Drain buffered frames before tearing the socket down: the
+        # legacy (synchronous-send) protocol never lost tail messages
+        # on a clean close, and final decref/task_done traffic matters.
+        if self._sender is not None:
+            try:
+                self._sender.flush()
+            except (RpcError, OSError):
+                pass
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

@@ -16,6 +16,7 @@ import os
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeoutError
 from typing import Any, Dict, List, Optional, Sequence
 
 import cloudpickle
@@ -85,7 +86,8 @@ class _LeasePool:
     (direct_task_transport.h:75)."""
 
     __slots__ = ("resources", "runtime_env", "workers", "inflight",
-                 "queue", "requested", "idle_since", "backoff_until")
+                 "queue", "requested", "requested_at", "idle_since",
+                 "backoff_until")
 
     def __init__(self, resources: Dict[str, float],
                  runtime_env: Optional[dict]):
@@ -99,6 +101,10 @@ class _LeasePool:
         # O(n^2) under the lease lock.
         self.queue = collections.deque()
         self.requested = 0  # workers asked for but not yet granted
+        # When the outstanding ask was last refreshed (request sent or
+        # grant received).  Pending demand the head queued indefinitely
+        # (cluster saturated) must not clamp pipeline depth forever.
+        self.requested_at = 0.0
         self.idle_since: Optional[float] = None
         # Set on denial (cluster saturated): no re-request until then —
         # pipeline onto what we have and retry for freed capacity.
@@ -743,12 +749,17 @@ class CoreClient:
         """Lease lock held.  Assign queued specs to granted workers with
         pipeline headroom; ask the head for workers for the rest."""
         depth = self.config.lease_pipeline_depth
-        # While more workers are expected (granted or spawning), hold
-        # pipelining at 1 so concurrent tasks land on distinct workers
-        # (parity with the reference's one-lease-per-running-task
-        # default); once the fleet is settled — grants exhausted or
-        # denied — pipeline to full depth to absorb the backlog.
+        # While more workers are expected IMMINENTLY (granted or
+        # spawning), hold pipelining at 1 so concurrent tasks land on
+        # distinct workers (parity with the reference's
+        # one-lease-per-running-task default); once the fleet is
+        # settled — grants exhausted, denied, or the ask has sat
+        # unanswered past the scale-up window (the head queued it for a
+        # saturated cluster) — pipeline to full depth to absorb the
+        # backlog on the workers we do hold.
         if pool.requested > 0 and \
+                time.monotonic() - pool.requested_at \
+                < self.config.lease_scaleup_clamp_s and \
                 len(pool.workers) < self.config.max_lease_workers_per_request:
             depth = 1
         assigns = []
@@ -816,6 +827,7 @@ class CoreClient:
                 token = self._lease_token_seq
                 self._lease_tokens[token] = [shape, ask]
                 pool.requested += ask
+                pool.requested_at = time.monotonic()
                 try:
                     self.client.send({
                         "op": "request_lease", "token": token,
@@ -854,9 +866,16 @@ class CoreClient:
                 else:
                     pool.requested = max(
                         0, pool.requested - len(workers) - denied)
-                    if denied and not workers:
+                    if workers and pool.requested:
+                        # Grants are flowing: keep the scale-up clamp
+                        # alive for the remainder of the ask.
+                        pool.requested_at = time.monotonic()
+                    if denied:
                         # Saturated (or broken env): back off before
                         # re-requesting; keep pipelining what we have.
+                        # Applies to partial grants too — immediately
+                        # re-asking for the denied remainder would churn
+                        # one request/denial per flusher cycle.
                         pool.backoff_until = time.monotonic() + 0.25
                     if error:
                         # Permanent denial (runtime_env setup failed):
@@ -1030,6 +1049,13 @@ class CoreClient:
                         if not peers:
                             self._lease_addr_workers.pop(addr, None)
                 del self._leases[shape]
+                # Outstanding request tokens for the released pool
+                # would otherwise linger forever (their late grants hit
+                # the pool-is-gone give-back path without consuming the
+                # token when partially filled).
+                for tok in [t for t, ent in self._lease_tokens.items()
+                            if ent[0] == shape]:
+                    self._lease_tokens.pop(tok, None)
         if to_release:
             try:
                 self.client.send({"op": "release_lease",
@@ -1252,7 +1278,7 @@ class CoreClient:
                     info2 = fut.result(
                         timeout=max(_deadline - time.monotonic(), 0.1)
                         if _deadline is not None else 300.0)
-                except TimeoutError:
+                except (TimeoutError, _FutureTimeoutError):
                     raise GetTimeoutError(
                         f"timed out refetching {obj_hex}") from None
                 return self._load_object(obj_hex, info2,
@@ -1381,7 +1407,9 @@ class CoreClient:
                 raise GetTimeoutError(f"get() timed out on {r}")
             try:
                 info = fut.result(timeout=remaining)
-            except TimeoutError:
+            except (TimeoutError, _FutureTimeoutError):
+                # Both spellings: concurrent.futures.TimeoutError only
+                # became the builtin TimeoutError in Python 3.11.
                 raise GetTimeoutError(f"get() timed out on {r}") from None
             remaining = None if deadline is None \
                 else max(deadline - time.monotonic(), 0.1)
@@ -2013,13 +2041,34 @@ class CoreClient:
         """Yield (end_index, frame_msg) for queued head messages,
         preserving enqueue order: runs of consecutive submits collapse
         into submit_task_batch frames, runs of increfs into
-        incref_batch frames."""
+        incref_batch frames.  When wire batching is on, adjacent
+        incref/decref runs additionally collapse into ONE refcount_delta
+        vector of net per-object counts — no other message can land
+        between entries of one run, so netting inside it is order-safe
+        (a transient +1/-1 pair can never drive a live object to zero
+        mid-run on the head)."""
+        merge_refs = rpc.batching_enabled()
         i, n = 0, len(items)
         while i < n:
             kind = items[i][0]
+            is_ref = kind in ("incref", "decref")
             j = i
-            while j < n and items[j][0] == kind:
+            while j < n and (items[j][0] == kind or
+                             (merge_refs and is_ref and
+                              items[j][0] in ("incref", "decref"))):
                 j += 1
+            if is_ref and merge_refs and j - i > 1:
+                deltas: Dict[str, int] = {}
+                for k, obj_hex in items[i:j]:
+                    deltas[obj_hex] = deltas.get(obj_hex, 0) + (
+                        1 if k == "incref" else -1)
+                deltas = {h: d for h, d in deltas.items() if d}
+                if deltas:
+                    yield j, {"op": "refcount_delta", "deltas": deltas}
+                # All-zero net: drop the frame entirely (re-processing
+                # on a retry is harmless — the net is still zero).
+                i = j
+                continue
             run = [it for _, it in items[i:j]]
             if kind == "submit":
                 msg = {"op": "submit_task", "spec": run[0]} \

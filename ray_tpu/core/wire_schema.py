@@ -46,6 +46,10 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     "incref_batch": {"objs": "list"},
     "decref": {"obj": "str", "n": "int?"},
     "decref_batch": {"objs": "list"},
+    # Coalesced net ref-count vector: {obj_hex: delta} with positive
+    # deltas increfs and negative deltas decrefs (control-plane
+    # micro-batching; runtime._head_frames → gcs._op_refcount_delta).
+    "refcount_delta": {"deltas": "dict"},
     "free_objects": {"objs": "list"},
     "forget_object": {"obj": "str"},
     "object_replica": {"obj": "str"},

@@ -295,8 +295,11 @@ class WorkerRuntime:
                 # Balance this temp ref's __del__ decref with an explicit
                 # incref: without it, concurrent tasks borrowing the same
                 # arg drove the owner's count negative and the object was
-                # freed under other tasks still resolving it.
-                self.core.client.send({"op": "incref", "obj": a.object_hex})
+                # freed under other tasks still resolving it.  Rides the
+                # coalescing queue (one frame per burst, not per arg);
+                # get() below flushes pending sends before subscribing,
+                # so the incref still reaches the head first.
+                self.core._queue_for_flush("incref", None, a.object_hex)
                 ref = ObjectRef(ObjectID.from_hex(a.object_hex))
                 args.append(self.core.get([ref])[0])
             else:
@@ -339,8 +342,11 @@ class WorkerRuntime:
                     # the coalescing queue, but a consumer is already
                     # waiting on this item — and a crash between yields
                     # (or user code calling os._exit) must not lose an
-                    # item the generator already produced.
+                    # item the generator already produced.  The wire
+                    # fence matters for the same reason: bytes buffered
+                    # in the rpc sender die with the process too.
                     self.core._flush_direct_sends()
+                    self.core.client.flush_sends()
                     count += 1
             except BaseException as e:  # noqa: BLE001
                 err = TaskError(spec.name or spec.method_name, e)
@@ -350,6 +356,7 @@ class WorkerRuntime:
                 count += 1
         self.core._store_value(stream_eos_id(spec.task_id), count)
         self.core._flush_direct_sends()
+        self.core.client.flush_sends()
 
     def _store_returns(self, spec: TaskSpec, value: Any, failed: bool):
         if spec.is_streaming:
@@ -420,8 +427,11 @@ class WorkerRuntime:
             # The put rides the coalescing queue; the owner reacts to the
             # push below INSTANTLY (subscribe, or a fire-and-forget
             # __del__ decref) — the head must learn of the object first
-            # or that decref lands on nothing and the entry leaks.
+            # or that decref lands on nothing and the entry leaks.  The
+            # wire fence makes the cross-connection ordering hold under
+            # rpc coalescing too (the push travels a different socket).
             self.core._flush_direct_sends()
+            self.core.client.flush_sends()
             try:
                 conn.push({"op": "direct_result_remote", "obj": obj_hex})
             except Exception:
@@ -523,8 +533,11 @@ class WorkerRuntime:
                 "decrefs": list(spec.borrows)})
             self._announce_pending = False  # task_done re-binds state
         else:
+            # Actor-method borrows: ride the coalescing queue so a burst
+            # of completions releases refs in delta vectors, not one
+            # frame per borrowed arg.
             for obj_hex in spec.borrows:
-                self.core.client.send({"op": "decref", "obj": obj_hex})
+                self.core._queue_for_flush("decref", None, obj_hex)
 
     def _buffer_task_event(self, spec: TaskSpec, failed: bool,
                            state: str = ""):

@@ -360,12 +360,20 @@ class Dataset:
         ORIGINAL values (lists stay lists; reference Dataset.unique)."""
         from ray_tpu.data.block import block_to_arrow
 
+        _NULL_SENTINEL = ("__ray_tpu_null__",)
+
         def hashable(v):
             if isinstance(v, list):
                 return tuple(hashable(x) for x in v)
             if isinstance(v, dict):
                 return tuple(sorted(
                     (k, hashable(x)) for k, x in v.items()))
+            if v is None:
+                return _NULL_SENTINEL
+            if isinstance(v, float) and v != v:
+                # NaN != NaN, so raw-value keys would keep every NaN
+                # row as "unique"; collapse all nulls to one sentinel.
+                return _NULL_SENTINEL
             return v
 
         seen: Dict[Any, Any] = {}

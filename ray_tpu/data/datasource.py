@@ -646,7 +646,11 @@ class WebDatasetDatasource(FileDatasource):
                 stem, suffix = basename.split(".", 1)
                 base = os.path.join(dirname, stem) if dirname else stem
                 if base != current_key:
-                    if sample:
+                    # A sample whose members were ALL filtered out by
+                    # `suffixes` holds only its "__key__" — emitting it
+                    # would fabricate key-only rows the reference
+                    # skips.
+                    if len(sample) > 1:
                         rows.append(sample)
                         if len(rows) >= self._batch_rows:
                             yield _rows_to_block(rows)
@@ -661,7 +665,7 @@ class WebDatasetDatasource(FileDatasource):
                     sample[suffix] = _wds_decode(suffix, data)
                 else:
                     sample[suffix] = data
-        if sample:
+        if len(sample) > 1:
             rows.append(sample)
         if rows:
             yield _rows_to_block(rows)

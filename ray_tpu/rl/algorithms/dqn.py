@@ -143,26 +143,31 @@ class DQN(Algorithm):
             n_actions = int(env.action_space.n)
         finally:
             env.close()
-        common = dict(
-            obs_dim=obs_dim, action_dim=n_actions,
-            hidden_sizes=tuple(_q_hiddens(config)),
-            dueling=config.dueling,
-            epsilon_initial=config.epsilon_initial,
-            epsilon_final=config.epsilon_final,
-            epsilon_timesteps=config.epsilon_timesteps)
         if config.module_spec is not None:
+            # Explicit spec wins outright (SAC's lazy path): building
+            # `common` here would run _q_hiddens and spuriously reject
+            # model_config/catalog_class knobs the user's own spec
+            # already embodies.
             self._spec = config.module_spec
-        elif len(obs_space.shape) == 3:
-            # Pixel obs: conv Q-network with the catalog's auto filter
-            # selection (Nature-DQN stack at Atari sizes).
-            from ray_tpu.rl.catalog import Catalog
-
-            cat = Catalog(obs_space, env.action_space)
-            self._spec = rl_module.ConvQNetworkSpec(
-                **common, obs_shape=tuple(obs_space.shape),
-                conv_filters=cat.conv_filters())
         else:
-            self._spec = rl_module.QNetworkSpec(**common)
+            common = dict(
+                obs_dim=obs_dim, action_dim=n_actions,
+                hidden_sizes=tuple(_q_hiddens(config)),
+                dueling=config.dueling,
+                epsilon_initial=config.epsilon_initial,
+                epsilon_final=config.epsilon_final,
+                epsilon_timesteps=config.epsilon_timesteps)
+            if len(obs_space.shape) == 3:
+                # Pixel obs: conv Q-network with the catalog's auto
+                # filter selection (Nature-DQN stack at Atari sizes).
+                from ray_tpu.rl.catalog import Catalog
+
+                cat = Catalog(obs_space, env.action_space)
+                self._spec = rl_module.ConvQNetworkSpec(
+                    **common, obs_shape=tuple(obs_space.shape),
+                    conv_filters=cat.conv_filters())
+            else:
+                self._spec = rl_module.QNetworkSpec(**common)
         prioritized = config.prioritized_replay
         if prioritized and config.num_learners > 0:
             # Remote learners return only scalar aux (the per-sample TD

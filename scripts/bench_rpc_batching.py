@@ -1,0 +1,109 @@
+"""Control-plane micro-batching probe: the two microbenchmark rows the
+coalesced-frame work targets, plus the wire counters that prove batching
+is load-bearing (frames_sent vs msgs_sent on every driver link).
+
+Replicates the `multi_client_tasks_async` and `single_client_wait_1k_refs`
+shapes from ray_tpu/scripts/microbenchmark.py (same init, same burst
+sizes, same timeit windows) so the numbers diff directly against the
+recorded rounds (MICROBENCH_r05.json).  Emits one MICROBENCH-style JSON
+document on stdout.
+
+Run:          python scripts/bench_rpc_batching.py
+A/B control:  RAY_TPU_RPC_NO_BATCH=1 python scripts/bench_rpc_batching.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+R05 = {  # MICROBENCH_r05.json "results" rows this probe re-measures
+    "multi_client_tasks_async": 3750.1,
+    "single_client_wait_1k_refs": 3.8,
+}
+
+
+def _wire_stats(rt):
+    clients = [rt.core.client] + list(rt.core._actor_conns.values())
+    return {
+        "frames_sent": sum(c.frames_sent for c in clients),
+        "msgs_sent": sum(c.msgs_sent for c in clients),
+        "batches_sent": sum(c.batches_sent for c in clients),
+    }
+
+
+def main() -> int:
+    import ray_tpu
+    from ray_tpu.scripts.microbenchmark import SCALE, timeit
+
+    rt = ray_tpu.init(num_cpus=16, log_to_driver=False)
+    rows = {}
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    ray_tpu.get([small_task.remote() for _ in range(16)])
+
+    class TaskClient:
+        def run_batch(self, n):
+            import ray_tpu as rt_
+
+            rt_.get([small_task.remote() for _ in range(n)])
+            return n
+
+    TC = ray_tpu.remote(TaskClient)
+    tclients = [TC.options(num_cpus=0).remote() for _ in range(4)]
+    ray_tpu.get([c.run_batch.remote(1) for c in tclients])
+    n = max(50, int(250 * SCALE))
+
+    def multi_tasks():
+        ray_tpu.get([c.run_batch.remote(n) for c in tclients])
+
+    w0 = _wire_stats(rt)
+    mean, std = timeit("multi_client_tasks_async", multi_tasks,
+                       multiplier=4 * n, trials=2)
+    w1 = _wire_stats(rt)
+    rows["multi_client_tasks_async"] = {
+        "ops_s": round(mean, 1), "std": round(std, 1),
+        "r5_ops_s": R05["multi_client_tasks_async"],
+        "vs_r5": round(mean / R05["multi_client_tasks_async"], 3),
+        "driver_wire": {k: w1[k] - w0[k] for k in w0},
+    }
+
+    n_wait = max(200, int(1000 * SCALE))
+
+    def wait_multiple_refs():
+        not_ready = [small_task.remote() for _ in range(n_wait)]
+        for _ in range(n_wait):
+            _ready, not_ready = ray_tpu.wait(not_ready)
+
+    w0 = _wire_stats(rt)
+    mean, std = timeit("single_client_wait_1k_refs", wait_multiple_refs,
+                       trials=2, window_s=0.5)
+    w1 = _wire_stats(rt)
+    rows["single_client_wait_1k_refs"] = {
+        "ops_s": round(mean, 1), "std": round(std, 1),
+        "r5_ops_s": R05["single_client_wait_1k_refs"],
+        "vs_r5": round(mean / R05["single_client_wait_1k_refs"], 3),
+        "driver_wire": {k: w1[k] - w0[k] for k in w0},
+    }
+
+    from ray_tpu.core import rpc
+
+    doc = {
+        "probe": "rpc_batching",
+        "batching_enabled": rpc.batching_enabled(),
+        "scale": SCALE,
+        "results": rows,
+    }
+    print("RPC_BATCHING_RESULTS " + json.dumps(doc), flush=True)
+    ray_tpu.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
