@@ -190,6 +190,14 @@ class TaskRecord:
     submitted_at: float = 0.0
     started_at: float = 0.0
     finished_at: float = 0.0
+    # Streamed-event extras (worker _buffer_task_event deltas): arrival
+    # time on the executing worker, retry ordinal, and the trace span
+    # this execution belongs to (util/tracing.py propagation).
+    received_at: float = 0.0
+    retry_count: int = 0
+    trace_id: str = ""
+    span_id: str = ""
+    parent_span_id: str = ""
 
 
 def _sum_bundles(bundle_specs: List[Dict[str, float]]) -> Dict[str, float]:
@@ -342,6 +350,33 @@ class ControlServer:
             node = self.nodes.get(nid)
             if node is not None:
                 node.draining = True
+
+        # Scheduler observability (util/metrics.py): lease decisions and
+        # task-event ingest volume export through the same /metrics
+        # pipeline as user metrics.  frames vs events makes the delta
+        # batching directly measurable (events ≫ frames under load).
+        try:
+            from ray_tpu.util import metrics as _m
+
+            self._m_lease_grants = _m.Counter(
+                "ray_tpu_lease_grants_total",
+                "Worker leases granted by the scheduler")
+            self._m_lease_denials = _m.Counter(
+                "ray_tpu_lease_denials_total",
+                "Lease slots requested but not granted")
+            self._m_lease_clamps = _m.Counter(
+                "ray_tpu_lease_fair_share_clamps_total",
+                "Lease requests clamped to the per-owner fair share")
+            self._m_task_events = _m.Counter(
+                "ray_tpu_task_events_total",
+                "Task lifecycle events ingested from workers")
+            self._m_task_event_frames = _m.Counter(
+                "ray_tpu_task_event_frames_total",
+                "task_events frames received (events arrive batched)")
+        except Exception:
+            self._m_lease_grants = self._m_lease_denials = None
+            self._m_lease_clamps = None
+            self._m_task_events = self._m_task_event_frames = None
 
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -1703,6 +1738,9 @@ class ControlServer:
             if rec is not None:
                 rec.state = "FAILED" if msg.get("failed") else "FINISHED"
                 rec.finished_at = time.time()
+                tr = msg.get("trace")
+                if tr:
+                    rec.trace_id, rec.span_id, rec.parent_span_id = tr
             claimed = None
             need_wake = True
             if w is not None and w.kind == "pool":
@@ -1863,7 +1901,21 @@ class ControlServer:
                 share = max(1, free_fit // (len(others) + 1))
                 if count > share:
                     denied += count - share
-                    count = share
+                    clamped_from, count = count, share
+                    if self._m_lease_clamps is not None:
+                        try:
+                            self._m_lease_clamps.inc()
+                        except Exception:
+                            pass
+                    try:
+                        from ray_tpu.util import flight_recorder
+
+                        flight_recorder.record(
+                            "scheduler", "fair_share_clamp",
+                            owner=owner_hex, asked=clamped_from,
+                            share=share, competitors=len(others))
+                    except Exception:
+                        pass
             for i in range(count):
                 w = self._idle_lease_worker_locked(env_key, need, virt)
                 if w is not None:
@@ -2082,6 +2134,26 @@ class ControlServer:
         for oconn, token, workers, denied, error in grants:
             if not workers and not denied:
                 continue
+            # Single choke point for both grant paths (request-time and
+            # scheduler-loop): count the decision and drop it in the
+            # flight-recorder ring for the timeline's scheduler lane.
+            try:
+                if workers and self._m_lease_grants is not None:
+                    self._m_lease_grants.inc(len(workers))
+                if denied and self._m_lease_denials is not None:
+                    self._m_lease_denials.inc(denied)
+            except Exception:
+                pass
+            try:
+                from ray_tpu.util import flight_recorder
+
+                flight_recorder.record(
+                    "scheduler", "lease_grant",
+                    granted=len(workers), denied=denied,
+                    workers=[wi["worker"][:8] for wi in workers],
+                    error=error or "")
+            except Exception:
+                pass
             try:
                 oconn.push({"op": "lease_granted", "token": token,
                             "workers": workers, "denied": denied,
@@ -2103,9 +2175,16 @@ class ControlServer:
         complete for tasks the head never scheduled."""
         now = time.time()
         worker_hex = conn.meta.get("worker_hex", "")
+        events = msg.get("events", ())
+        try:
+            if self._m_task_event_frames is not None:
+                self._m_task_event_frames.inc()
+                self._m_task_events.inc(len(events))
+        except Exception:
+            pass
         with self.lock:
             w = self.workers.get(worker_hex)
-            for ev in msg.get("events", ()):
+            for ev in events:
                 rec = self.tasks.get(ev["task_id"])
                 if rec is None:
                     spec = TaskSpec(
@@ -2116,7 +2195,8 @@ class ControlServer:
                         name=ev.get("name", ""),
                         owner=ev.get("owner", ""), direct=True)
                     rec = self.tasks[ev["task_id"]] = TaskRecord(
-                        spec=spec, submitted_at=ev.get("start") or now)
+                        spec=spec, submitted_at=ev.get("start")
+                        or ev.get("received") or now)
                 elif not rec.spec.direct and rec.state in ("PENDING",
                                                            "RUNNING"):
                     # A live head-path record (the task was fallback-
@@ -2126,10 +2206,20 @@ class ControlServer:
                     # its death-detection worker binding.
                     continue
                 state = ev.get("state", "FINISHED")
-                rec.state = state
+                # Arrival-only deltas map into the head's state
+                # vocabulary (PENDING|RUNNING|FINISHED|FAILED).
+                rec.state = "PENDING" if state == "RECEIVED" else state
                 rec.worker_hex = worker_hex
-                rec.started_at = ev.get("start", 0.0)
-                rec.finished_at = ev.get("end", 0.0)
+                # Deltas carry only what changed since the last event for
+                # this task (an arrival-only RECEIVED has no start/end):
+                # merge, never clobber with zeros.
+                rec.started_at = ev.get("start", 0.0) or rec.started_at
+                rec.finished_at = ev.get("end", 0.0) or rec.finished_at
+                rec.received_at = ev.get("received", 0.0) or rec.received_at
+                rec.retry_count = ev.get("retry_count", rec.retry_count)
+                tr = ev.get("trace")
+                if tr:
+                    rec.trace_id, rec.span_id, rec.parent_span_id = tr
                 # Track the leased worker's current task so the OOM
                 # victim policy can pick/kill it like a busy worker.
                 if w is not None and w.state == "leased":
@@ -2138,6 +2228,16 @@ class ControlServer:
                     elif w.current_task == ev["task_id"]:
                         w.current_task = None
             self._prune_lineage_locked()
+
+    def _op_flight_recorder(self, conn, msg):
+        """Dump the head's in-memory flight-recorder ring (recent wire
+        flushes + scheduler decisions) — the dashboard merges this with
+        the driver-side ring when the head is a separate process."""
+        from ray_tpu.util import flight_recorder
+
+        return {"events": flight_recorder.dump(
+                    int(msg.get("last", 0) or 0)),
+                "stats": flight_recorder.stats()}
 
     # ------------------------------------------------------------------
     # Actors
@@ -2333,6 +2433,11 @@ class ControlServer:
                  "submitted_at": r.submitted_at or None,
                  "started_at": r.started_at or None,
                  "finished_at": r.finished_at or None,
+                 "received_at": r.received_at or None,
+                 "retry_count": r.retry_count,
+                 "trace_id": r.trace_id or None,
+                 "span_id": r.span_id or None,
+                 "parent_span_id": r.parent_span_id or None,
                  "pid": (self.workers.get(r.worker_hex).pid
                          if r.worker_hex in self.workers else None),
                  "duration_s": (r.finished_at - r.started_at)

@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import functools
-import time
 from typing import Any, Dict, Optional
 
 import cloudpickle
@@ -64,7 +63,27 @@ class RemoteFunction:
         call_args = list(args)
         if kwargs:
             call_args.append(KwargsMarker(kwargs))
-        refs = get_runtime().submit_task(
+        # Opt-in tracing (util/tracing.py — reference tracing_helper wraps
+        # _remote the same way): the submission span stays OPEN across
+        # submit_task so the spec's trace_ctx names it as parent — the
+        # worker-side execution span links to it, stitching the
+        # driver→worker hop without extra wire traffic.
+        from ray_tpu.util import tracing
+        if tracing.is_tracing_enabled():
+            attrs: Dict[str, Any] = {}
+            with tracing.trace_span(f"submit:{self._name}", attrs):
+                refs = self._submit(func_id, blob, call_args)
+            attrs["object_ref"] = (refs.task_id.hex()
+                                   if self._num_returns == "streaming"
+                                   else refs[0].hex())
+        else:
+            refs = self._submit(func_id, blob, call_args)
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def _submit(self, func_id, blob, call_args):
+        return get_runtime().submit_task(
             func_id, blob, call_args,
             num_returns=self._num_returns,
             resources=self._resource_demand(),
@@ -73,22 +92,6 @@ class RemoteFunction:
             runtime_env=self._runtime_env,
             scheduling_strategy=self._scheduling_strategy,
         )
-        # Opt-in tracing (util/tracing.py — reference tracing_helper wraps
-        # _remote the same way): record the submission as a span; the
-        # execution slice is correlated later by task_id from the cluster
-        # task records.
-        from ray_tpu.util import tracing
-        if tracing.is_tracing_enabled():
-            now = time.time()
-            anchor = (refs.task_id.hex()
-                      if self._num_returns == "streaming"
-                      else refs[0].hex())
-            tracing.record_span(
-                f"submit:{self._name}", now, now,
-                attributes={"object_ref": anchor})
-        if self._num_returns == 1:
-            return refs[0]
-        return refs
 
     def bind(self, *args, **kwargs):
         """Author a DAG node for this task (reference function_node.py;

@@ -147,6 +147,7 @@ class _RemoteTraceback(Exception):
 def _send_frame(sock: socket.socket, kind: int, req_id: int, payload: bytes):
     header = _FRAME.pack(kind, req_id, len(payload))
     sock.sendall(header + payload)
+    WIRE.on_frame_sent(kind, len(header) + len(payload))
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -166,6 +167,156 @@ def _recv_frame(sock: socket.socket):
     kind, req_id, length = _FRAME.unpack(header)
     payload = _recv_exact(sock, length) if length else b""
     return kind, req_id, payload
+
+
+_KIND_NAMES = {
+    KIND_REQUEST: "request", KIND_RESPONSE: "response",
+    KIND_ONEWAY: "oneway", KIND_REQUEST_JSON: "request_json",
+    KIND_ONEWAY_JSON: "oneway_json", KIND_BATCH: "batch",
+    KIND_BATCH_JSON: "batch_json",
+}
+
+_flight = None  # lazily imported flight recorder module (or False)
+
+
+def _flight_recorder():
+    global _flight
+    if _flight is None:
+        try:
+            from ray_tpu.util import flight_recorder as fr
+
+            _flight = fr
+        except Exception:
+            _flight = False
+    return _flight
+
+
+class _WireStats:
+    """Process-wide wire telemetry, one lock update per FRAME (not per
+    message): frames/messages/batches/bytes in both directions, per-kind
+    sent counts, and a batch-size histogram whose le="1" bucket is the
+    plain-frame count — coalesced-vs-plain ratio falls out of the same
+    series.  Frames are syscall-bounded, so the lock is off the per-
+    message hot path; exported through util/metrics.py via
+    wire_metric_snapshots()."""
+
+    BATCH_BOUNDS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0,
+                    256.0, 512.0)
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.frames_sent = 0
+        self.msgs_sent = 0
+        self.batches_sent = 0
+        self.bytes_sent = 0
+        self.frames_received = 0
+        self.msgs_received = 0
+        self.batches_received = 0
+        self.bytes_received = 0
+        self.sent_by_kind: dict[int, int] = {}
+        self.batch_buckets = [0] * (len(self.BATCH_BOUNDS) + 1)
+        self.batch_sum = 0.0
+        self.batch_count = 0
+
+    def _observe_size_locked(self, nmsgs: int):
+        for i, b in enumerate(self.BATCH_BOUNDS):
+            if nmsgs <= b:
+                self.batch_buckets[i] += 1
+                break
+        else:
+            self.batch_buckets[-1] += 1
+        self.batch_sum += nmsgs
+        self.batch_count += 1
+
+    def on_frame_sent(self, kind: int, nbytes: int, nmsgs: int = 1):
+        with self.lock:
+            self.frames_sent += 1
+            self.msgs_sent += nmsgs
+            self.bytes_sent += nbytes
+            self.sent_by_kind[kind] = self.sent_by_kind.get(kind, 0) + 1
+            if nmsgs > 1:
+                self.batches_sent += 1
+            self._observe_size_locked(nmsgs)
+
+    def on_frames_sent(self, entries):
+        """Coalescing-sender drain round: one lock acquisition for the
+        whole round's (kind, nmsgs, nbytes) frames."""
+        with self.lock:
+            for kind, nmsgs, nbytes in entries:
+                self.frames_sent += 1
+                self.msgs_sent += nmsgs
+                self.bytes_sent += nbytes
+                self.sent_by_kind[kind] = \
+                    self.sent_by_kind.get(kind, 0) + 1
+                if nmsgs > 1:
+                    self.batches_sent += 1
+                self._observe_size_locked(nmsgs)
+        fr = _flight_recorder()
+        if fr:
+            for kind, nmsgs, nbytes in entries:
+                if nmsgs > 1:
+                    fr.record("wire", "batch_flush", msgs=nmsgs,
+                              bytes=nbytes)
+
+    def on_frame_received(self, kind: int, nbytes: int, nmsgs: int = 1):
+        with self.lock:
+            self.frames_received += 1
+            self.msgs_received += nmsgs
+            self.bytes_received += nbytes
+            if kind in (KIND_BATCH, KIND_BATCH_JSON):
+                self.batches_received += 1
+
+
+WIRE = _WireStats()
+
+
+def wire_metric_snapshots() -> list:
+    """This process's wire counters as metric-snapshot dicts in the
+    util/metrics.py exposition shape — merged into local_snapshots() so
+    they publish/aggregate through the standard __metrics__/ KV path
+    without rpc.py depending on the metrics registry."""
+    w = WIRE
+    with w.lock:
+        directions = {
+            "rpc_frames_total": (w.frames_sent, w.frames_received),
+            "rpc_msgs_total": (w.msgs_sent, w.msgs_received),
+            "rpc_batches_total": (w.batches_sent, w.batches_received),
+            "rpc_bytes_total": (w.bytes_sent, w.bytes_received),
+        }
+        by_kind = dict(w.sent_by_kind)
+        hist = [list(w.batch_buckets), w.batch_sum, w.batch_count]
+    descs = {
+        "rpc_frames_total": "Control-plane frames on the wire",
+        "rpc_msgs_total": "Control-plane messages (batch entries count "
+                          "individually)",
+        "rpc_batches_total": "Coalesced KIND_BATCH frames",
+        "rpc_bytes_total": "Control-plane payload bytes (incl. headers)",
+    }
+    snaps = []
+    for name, (sent, received) in directions.items():
+        snaps.append({
+            "name": name, "kind": "counter", "description": descs[name],
+            "series": {(("direction", "sent"),): float(sent),
+                       (("direction", "received"),): float(received)},
+        })
+    kind_series = {
+        (("direction", "sent"), ("kind", _KIND_NAMES.get(k, str(k)))):
+            float(v)
+        for k, v in by_kind.items() if v}
+    if kind_series:
+        snaps.append({
+            "name": "rpc_frames_by_kind_total", "kind": "counter",
+            "description": "Sent frames by wire kind",
+            "series": kind_series,
+        })
+    snaps.append({
+        "name": "rpc_batch_size", "kind": "histogram",
+        "description": "Messages per sent frame (le=1 bucket = plain "
+                       "frames; higher = coalesced)",
+        "boundaries": list(_WireStats.BATCH_BOUNDS),
+        "series": {(): hist},
+    })
+    return snaps
 
 
 class _CoalescingSender:
@@ -258,6 +409,7 @@ class _CoalescingSender:
 
     def _encode(self, batch: list[tuple[int, int, bytes]]) -> list[bytes]:
         frames = []
+        stats = []  # (kind, nmsgs, frame bytes) per frame, for WIRE
         i, n = 0, len(batch)
         while i < n:
             # Greedy size/count-capped run starting at i.
@@ -271,12 +423,15 @@ class _CoalescingSender:
                 kind, req_id, payload = batch[i]
                 frames.append(
                     _FRAME.pack(kind, req_id, len(payload)) + payload)
+                stats.append((kind, 1, len(frames[-1])))
             else:
                 blob = pickle.dumps(batch[i:j], protocol=5)
                 frames.append(_FRAME.pack(KIND_BATCH, 0, len(blob)) + blob)
                 self.batches_sent += 1
+                stats.append((KIND_BATCH, j - i, len(frames[-1])))
             self.frames_sent += 1
             i = j
+        WIRE.on_frames_sent(stats)
         return frames
 
 
@@ -449,20 +604,25 @@ class Server:
         try:
             while not self._stopped.is_set():
                 kind, req_id, payload = _recv_frame(conn.sock)
+                nbytes = _FRAME.size + len(payload)
                 if kind == KIND_BATCH:
                     conn.peer_pickle = True
-                    for sub_kind, sub_id, sub_payload in \
-                            pickle.loads(payload):
+                    entries = pickle.loads(payload)
+                    WIRE.on_frame_received(kind, nbytes, len(entries))
+                    for sub_kind, sub_id, sub_payload in entries:
                         if sub_kind in (KIND_BATCH, KIND_BATCH_JSON):
                             continue  # batches never nest
                         self._dispatch(conn, sub_kind, sub_id, sub_payload)
                 elif kind == KIND_BATCH_JSON:
-                    for entry in json.loads(payload):
+                    entries = json.loads(payload)
+                    WIRE.on_frame_received(kind, nbytes, len(entries))
+                    for entry in entries:
                         sub_kind, sub_id, raw = entry
                         if sub_kind != KIND_REQUEST_JSON:
                             continue
                         self._handle_json(conn, sub_id, raw)
                 else:
+                    WIRE.on_frame_received(kind, nbytes)
                     self._dispatch(conn, kind, req_id, payload)
         except (RpcError, OSError, EOFError):
             pass
@@ -607,13 +767,16 @@ class Client:
         try:
             while True:
                 kind, req_id, payload = _recv_frame(self._sock)
+                nbytes = _FRAME.size + len(payload)
                 if kind == KIND_BATCH:
-                    for sub_kind, sub_id, sub_payload in \
-                            pickle.loads(payload):
+                    entries = pickle.loads(payload)
+                    WIRE.on_frame_received(kind, nbytes, len(entries))
+                    for sub_kind, sub_id, sub_payload in entries:
                         if sub_kind in (KIND_BATCH, KIND_BATCH_JSON):
                             continue  # batches never nest
                         self._on_frame(sub_kind, sub_id, sub_payload)
                 else:
+                    WIRE.on_frame_received(kind, nbytes)
                     self._on_frame(kind, req_id, payload)
         except (RpcError, OSError, EOFError):
             was_closed = self._closed

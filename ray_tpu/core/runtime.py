@@ -36,6 +36,23 @@ from ray_tpu.core.task_spec import ActorCreationSpec, TaskArg, TaskSpec
 _global_runtime = None
 _runtime_lock = threading.Lock()
 
+# Cached lazy import: util.tracing pulls in util/__init__ → placement
+# groups → this module, so a top-level import here would cycle.
+_tracing = None
+
+
+def _make_trace_ctx():
+    """Current (trace_id, parent span_id) to ride the outgoing TaskSpec,
+    or None when nothing is being traced (nothing on the wire)."""
+    global _tracing
+    if _tracing is None:
+        try:
+            from ray_tpu.util import tracing
+        except Exception:
+            return None
+        _tracing = tracing
+    return _tracing.make_trace_ctx()
+
 
 def _is_missing_segment_error(e: Exception) -> bool:
     """True for attach failures meaning "no longer at that location"
@@ -1720,6 +1737,7 @@ class CoreClient:
             bundle_index=bundle_index,
             borrows=borrows,
             is_streaming=streaming,
+            trace_ctx=_make_trace_ctx(),
         )
         if self._lease_eligible(spec):
             # Owner-direct lease path: the head never sees this task
@@ -1844,6 +1862,7 @@ class CoreClient:
             borrows=borrows,
             is_streaming=streaming,
             direct=direct,
+            trace_ctx=_make_trace_ctx(),
         )
         self._route_actor_task(actor_hex, spec)
         if streaming:
@@ -2074,6 +2093,23 @@ class CoreClient:
                 msg = {"op": "submit_task", "spec": run[0]} \
                     if len(run) == 1 else \
                     {"op": "submit_task_batch", "specs": run}
+            elif kind == "task_event":
+                # Delta-compress the run: multiple lifecycle events for
+                # one task inside a flush window (RECEIVED+RUNNING+
+                # FINISHED of a fast task) merge into one dict — later
+                # events overlay earlier keys, first-seen order kept.
+                merged: Dict[str, dict] = {}
+                order: List[str] = []
+                for ev in run:
+                    tid = ev.get("task_id", "")
+                    cur = merged.get(tid)
+                    if cur is None:
+                        merged[tid] = dict(ev)
+                        order.append(tid)
+                    else:
+                        cur.update(ev)
+                msg = {"op": "task_events",
+                       "events": [merged[t] for t in order]}
             elif kind == "put":
                 msg = run[0] if len(run) == 1 else \
                     {"op": "put_object_batch", "items": run}
@@ -2142,6 +2178,12 @@ class CoreClient:
         # flusher parked in wait() forever leaked one thread per
         # init/shutdown cycle (hundreds across a long test session).
         self._flush_ev.set()
+        try:
+            from ray_tpu.util import metrics
+
+            metrics.unpublish(self.client.call, self.worker_hex)
+        except Exception:
+            pass
         for conn in self._actor_conns.values():
             conn.close()
         for conn in self._node_conns.values():

@@ -73,6 +73,11 @@ class TaskSpec:
     # (top-level ref args + refs captured inside inline args); the executor
     # decrefs them after the task finishes.
     borrows: List[str] = field(default_factory=list)
+    # Compact trace propagation context (util/tracing.py make_trace_ctx):
+    # (trace_id, parent span_id), or None when the submitter traces
+    # nothing — the reference's _DictPropagator context riding TaskSpec
+    # metadata, costing two short strings on the wire only when set.
+    trace_ctx: Optional[Tuple[str, str]] = None
 
     # Hot-path wire form (submit/actor_task ride this thousands of times
     # per second): IDs travel as raw bytes, fields as a flat tuple.
@@ -87,14 +92,14 @@ class TaskSpec:
             self.method_name, self.seq_no, self.is_streaming,
             self.placement_group_hex, self.bundle_index,
             self.scheduling_strategy, self.runtime_env, self.borrows,
-            self.direct))
+            self.direct, self.trace_ctx))
 
 
 def _mk_spec(task_id, func_id, func_blob, args, num_returns, return_ids,
              resources, max_retries, retry_count, name, owner, actor_id,
              method_name, seq_no, is_streaming, placement_group_hex,
              bundle_index, scheduling_strategy, runtime_env, borrows,
-             direct):
+             direct, trace_ctx=None):
     s = TaskSpec.__new__(TaskSpec)
     s.task_id = TaskID(task_id) if task_id is not None else None
     s.func_id = func_id
@@ -117,6 +122,7 @@ def _mk_spec(task_id, func_id, func_blob, args, num_returns, return_ids,
     s.runtime_env = runtime_env
     s.borrows = borrows
     s.direct = direct
+    s.trace_ctx = trace_ctx
     return s
 
 

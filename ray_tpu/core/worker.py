@@ -40,6 +40,19 @@ from ray_tpu.core.task_spec import ActorCreationSpec, KwargsMarker, TaskSpec
 _current_spec_ctx: contextvars.ContextVar = contextvars.ContextVar(
     "ray_tpu_current_task_spec", default=None)
 
+# Cached lazy import (ray_tpu.util eagerly pulls in the runtime; core
+# modules import util lazily to stay cycle-free).
+_tracing = None
+
+
+def _get_tracing():
+    global _tracing
+    if _tracing is None:
+        from ray_tpu.util import tracing
+
+        _tracing = tracing
+    return _tracing
+
 
 class WorkerRuntime:
     """The runtime facade inside a worker process (get/put/submit all work,
@@ -496,7 +509,6 @@ class WorkerRuntime:
             self._res_flush_ev.clear()
             time.sleep(0.001)
             self._flush_direct_results()
-            self._flush_task_events()
 
     def _finish(self, spec: TaskSpec, failed: bool,
                 puts: Optional[List[dict]] = None):
@@ -527,10 +539,14 @@ class WorkerRuntime:
             # One combined control message: result puts + borrow decrefs
             # + completion (was 1 put per return + 1 decref per borrow +
             # 1 done = the control plane's hottest path).
-            self.core.client.send({
+            msg = {
                 "op": "task_done", "task_id": spec.task_id.hex(),
                 "failed": failed, "puts": puts or [],
-                "decrefs": list(spec.borrows)})
+                "decrefs": list(spec.borrows)}
+            tr = getattr(spec, "_trace", None)
+            if tr is not None:
+                msg["trace"] = tr
+            self.core.client.send(msg)
             self._announce_pending = False  # task_done re-binds state
         else:
             # Actor-method borrows: ride the coalescing queue so a burst
@@ -541,46 +557,53 @@ class WorkerRuntime:
 
     def _buffer_task_event(self, spec: TaskSpec, failed: bool,
                            state: str = ""):
-        """Queue a compact task-state event; flushed in batches so the
-        state API / timeline / OOM victim policy still see lease-path
-        tasks the head never scheduled (reference GcsTaskManager
-        events + TaskEventBuffer)."""
+        """Queue a compact task-lifecycle delta; it rides the core
+        client's coalescing flusher (runtime.py _queue_for_flush /
+        _head_frames), where a run of events collapses into one
+        task_events frame and same-task deltas within a flush window
+        merge — so the state API / timeline / OOM victim policy still
+        see lease-path tasks the head never scheduled, at far fewer
+        frames than tasks (reference GcsTaskManager events +
+        TaskEventBuffer, task_event_buffer.h:206)."""
+        state = state or ("FAILED" if failed else "FINISHED")
         ev = {
             "task_id": spec.task_id.hex(),
             "name": spec.name or spec.func_id[:8],
             "owner": spec.owner,
-            "state": state or ("FAILED" if failed else "FINISHED"),
+            "state": state,
             "retries_left": max(0, spec.max_retries - spec.retry_count),
-            "start": getattr(spec, "_exec_started", 0.0),
-            "end": 0.0 if state == "RUNNING" else time.time(),
+            "retry_count": spec.retry_count,
         }
-        with self._res_lock:
-            buf = getattr(self, "_task_events", None)
-            if buf is None:
-                buf = self._task_events = []
-            buf.append(ev)
-            n = len(buf)
-        if n >= 100:
-            self._flush_task_events()
-        else:
-            self._res_flush_ev.set()
-
-    def _flush_task_events(self):
-        with self._res_lock:
-            buf = getattr(self, "_task_events", None)
-            if not buf:
-                return
-            self._task_events = []
-        try:
-            self.core.client.send({"op": "task_events", "events": buf})
-        except Exception:
-            pass
+        received = getattr(spec, "_received_at", 0.0)
+        if received:
+            ev["received"] = received
+        if state != "RECEIVED":
+            ev["start"] = getattr(spec, "_exec_started", 0.0)
+            if state != "RUNNING":
+                ev["end"] = time.time()
+                if ev["start"]:
+                    ev["duration"] = ev["end"] - ev["start"]
+        tr = getattr(spec, "_trace", None)
+        if tr is not None:
+            # One compact key, not trace_id/span_id/parent_span_id: the
+            # key names alone would add ~40 bytes to every event frame.
+            ev["trace"] = tr
+        self.core._queue_for_flush("task_event", None, ev)
 
     def _execute(self, spec: TaskSpec, target_fn=None):
         failed = False
         self._executing = True
         self._cur_tls.spec = spec
         spec._exec_started = time.time()
+        # Restore the submitter's trace context (util/tracing.py): the
+        # execution span parents everything this task does — nested
+        # submissions carry ITS span id, stitching the driver→worker→
+        # nested-task chain under one trace_id.
+        _ttok = _span_id = None
+        tctx = getattr(spec, "trace_ctx", None)
+        if tctx:
+            _ttok, _span_id = _get_tracing().begin_task_span(tctx)
+            spec._trace = (tctx[0], _span_id, tctx[1])
         if spec.actor_id is None and getattr(spec, "direct", False) and \
                 getattr(spec, "_arrival_conn", None) is not None:
             # Leased task: tell the head it is RUNNING here (batched) so
@@ -628,6 +651,11 @@ class WorkerRuntime:
             # Always release resources/borrows, even if storing returns
             # blew up — a wedged-busy worker starves the whole pool.
             self._finish(spec, failed, puts)
+            if _ttok is not None:
+                _get_tracing().end_task_span(
+                    _ttok,
+                    f"task:{spec.name or spec.method_name or spec.func_id[:8]}",
+                    spec._exec_started, time.time(), tctx, _span_id)
         return failed
 
     @property
@@ -642,6 +670,13 @@ class WorkerRuntime:
         # thread spawn per task costs ~100 us — the dominant per-task
         # overhead at small-task rates); the rpc receive thread stays
         # responsive because it only enqueues.
+        spec._received_at = time.time()
+        if getattr(spec, "direct", False) and \
+                getattr(spec, "_arrival_conn", None) is not None:
+            # Lease-path task: the head never saw the submission, so
+            # the arrival delta is its first sighting (it merges with
+            # RUNNING/FINISHED if the task drains fast).
+            self._buffer_task_event(spec, failed=False, state="RECEIVED")
         q = getattr(self, "_pool_queue", None)
         if q is None:
             with self._aio_lock:
@@ -801,6 +836,13 @@ class WorkerRuntime:
 
             async def _body():
                 _current_spec_ctx.set(spec)
+                tctx = getattr(spec, "trace_ctx", None)
+                if tctx:
+                    # Each asyncio task runs in its own contextvars copy:
+                    # install-without-reset is safe and nested submissions
+                    # from the body parent to this execution span.
+                    sid = _get_tracing().set_task_ctx(tctx)
+                    spec._trace = (tctx[0], sid, tctx[1])
                 if inspect.iscoroutinefunction(method):
                     return await method(*args, **kwargs)
                 # Sync method of an async actor: run its body ON the

@@ -11,6 +11,9 @@ Routes (all GET unless noted):
   /api/summary/tasks|actors|objects  -> aggregated counts
   /api/node_stats          -> per-node host stats (reporter agents)
   /api/timeline?max_tasks= -> chrome trace (uniformly sampled at scale)
+  /api/trace?max_tasks=    -> unified chrome trace (spans + tasks +
+                              wire/scheduler flight-recorder lanes)
+  /api/flight_recorder?last= -> recent wire/scheduler events + ring stats
   /api/workers/<hex>/profile?kind=stack|jax_trace&duration_s=
   /api/cluster_resources   /api/available_resources
   /api/object_store_stats  /metrics (Prometheus)
@@ -180,6 +183,28 @@ class Dashboard:
             from ray_tpu.util.timeline import timeline_events
             return timeline_events(
                 rt, max_tasks=int(qs.get("max_tasks", 0)))
+        if parsed.path == "/api/trace":
+            # The unified trace: driver spans + task/scheduling lanes +
+            # wire/scheduler flight-recorder lanes, one chrome-trace
+            # event list (util/tracing.py trace_events).
+            from ray_tpu.util.tracing import trace_events
+            return trace_events(rt, max_tasks=int(qs.get("max_tasks", 0)))
+        if parsed.path == "/api/flight_recorder":
+            from ray_tpu.util import flight_recorder
+            out = {"events": flight_recorder.dump(
+                       int(qs.get("last", 0) or 0)),
+                   "stats": flight_recorder.stats()}
+            if getattr(rt, "control", None) is None:
+                # Remote head: its ring is a different process — fetch
+                # and prepend so one endpoint shows both sides.
+                try:
+                    head = rt.core.client.call({"op": "flight_recorder"})
+                    out = {"events": head["events"] + out["events"],
+                           "stats": out["stats"],
+                           "head_stats": head["stats"]}
+                except Exception:
+                    pass
+            return out
         if parsed.path.startswith("/api/workers/") \
                 and parsed.path.endswith("/profile"):
             # On-demand live-worker profiling (reference: dashboard

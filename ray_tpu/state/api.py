@@ -91,6 +91,14 @@ def list_tasks(filters=None, limit: int = 10000, **kw) -> List[dict]:
     return _list("tasks", filters, limit, **kw)
 
 
+def get_task(task_id: str) -> Optional[dict]:
+    """One task's record by id (reference get_task), including the
+    streamed-event fields: received_at, retry_count and the
+    trace_id/span_id/parent_span_id its execution belongs to."""
+    rows = _list("tasks", [("task_id", "=", task_id)], 1)
+    return rows[0] if rows else None
+
+
 def list_actors(filters=None, limit: int = 10000, **kw) -> List[dict]:
     return _list("actors", filters, limit, **kw)
 

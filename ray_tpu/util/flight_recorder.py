@@ -1,0 +1,99 @@
+"""Cluster flight recorder: a bounded in-memory ring of recent
+control-plane events (wire batch flushes, lease-scheduler decisions),
+dumpable on demand.
+
+Counterpart of the reference's in-memory event buffers (GcsTaskManager's
+bounded task-event storage, the raylet's debug-state dumps): when a
+batching decision or a lease grant looks wrong, the last few thousand
+events are enough to reconstruct what the control plane actually did —
+without logging anything on the hot path.  Recording is a deque append
+behind a lock; the ring evicts oldest-first so memory stays constant
+for the life of the process.
+
+Env knobs:
+  RAY_TPU_FLIGHT_RECORDER            "0" disables recording entirely
+  RAY_TPU_FLIGHT_RECORDER_MAX_EVENTS ring capacity (default 4096)
+
+Each process (driver, head-in-driver, workers) holds its own ring; the
+dashboard's /api/flight_recorder merges the driver's with the head's.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List
+
+_FALSY = ("0", "false", "no", "off")
+
+_lock = threading.Lock()
+_dropped = 0
+_enabled = os.environ.get(
+    "RAY_TPU_FLIGHT_RECORDER", "1").strip().lower() not in _FALSY
+
+
+def _default_capacity() -> int:
+    try:
+        cap = int(os.environ.get(
+            "RAY_TPU_FLIGHT_RECORDER_MAX_EVENTS", "4096"))
+    except ValueError:
+        cap = 4096
+    return max(16, cap)
+
+
+_ring: "deque[Dict[str, Any]]" = deque(maxlen=_default_capacity())
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def configure(capacity: int = 0, enable: bool = True) -> None:
+    """Reconfigure the ring (tests / explicit opt-out at runtime).
+    capacity <= 0 re-reads the env default.  Existing events are kept
+    up to the new capacity (newest win)."""
+    global _ring, _enabled, _dropped
+    with _lock:
+        cap = capacity if capacity > 0 else _default_capacity()
+        _ring = deque(_ring, maxlen=max(16, cap))
+        _enabled = enable
+        _dropped = 0
+
+
+def record(category: str, event: str, **fields: Any) -> None:
+    """Append one event (no-op when disabled).  `category` picks the
+    timeline lane ("wire" | "scheduler"); `fields` are free-form and
+    must be JSON-representable (they ride the dashboard dump)."""
+    if not _enabled:
+        return
+    global _dropped
+    entry = {"ts": time.time(), "category": category, "event": event}
+    if fields:
+        entry.update(fields)
+    with _lock:
+        if len(_ring) == _ring.maxlen:
+            _dropped += 1
+        _ring.append(entry)
+
+
+def dump(last: int = 0) -> List[Dict[str, Any]]:
+    """Snapshot the ring, oldest first; `last` > 0 returns only the
+    newest N events."""
+    with _lock:
+        events = list(_ring)
+    return events[-last:] if last > 0 else events
+
+
+def stats() -> Dict[str, Any]:
+    with _lock:
+        return {"events": len(_ring), "capacity": _ring.maxlen,
+                "dropped": _dropped, "enabled": _enabled}
+
+
+def clear() -> None:
+    global _dropped
+    with _lock:
+        _ring.clear()
+        _dropped = 0

@@ -16,6 +16,7 @@ here touches the device path.
 
 from __future__ import annotations
 
+import os
 import pickle
 import threading
 import time
@@ -27,6 +28,21 @@ _PUBLISH_INTERVAL_S = 2.0
 _registry_lock = threading.Lock()
 _registry: Dict[str, "Metric"] = {}
 _publisher_started = False
+# Set once this process has successfully written its KV snapshot key, so
+# clean shutdown knows whether there is anything to unpublish.
+_published = False
+
+
+def _metrics_ttl_s() -> float:
+    """Snapshot freshness window (env RAY_TPU_METRICS_TTL_S, default 60):
+    snapshots stamped older than this are skipped — and garbage-collected
+    — during aggregation, so a crashed worker's last counters do not
+    haunt /metrics forever."""
+    try:
+        return max(1.0, float(os.environ.get("RAY_TPU_METRICS_TTL_S",
+                                             "60")))
+    except ValueError:
+        return 60.0
 
 
 def _tags_key(tags: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -242,7 +258,17 @@ def snapshots_to_prometheus_text(snapshots: List[dict]) -> str:
 def local_snapshots() -> List[dict]:
     with _registry_lock:
         metrics = list(_registry.values())
-    return [m.snapshot() for m in metrics]
+    snaps = [m.snapshot() for m in metrics]
+    # Wire-level telemetry (core/rpc.py) lives outside the registry —
+    # rpc.py must not import this module at the frame layer — but
+    # publishes through the same pipeline.
+    try:
+        from ray_tpu.core import rpc
+
+        snaps.extend(rpc.wire_metric_snapshots())
+    except Exception:
+        pass
+    return snaps
 
 
 # ---------------------------------------------------------------------------
@@ -251,6 +277,7 @@ def local_snapshots() -> List[dict]:
 
 def publish_now() -> bool:
     """Publish this process's snapshots to the cluster KV immediately."""
+    global _published
     try:
         from ray_tpu.core.runtime import get_runtime
         rt = get_runtime()
@@ -264,9 +291,24 @@ def publish_now() -> bool:
     try:
         rt.kv().call({"op": "kv_put", "key": _KV_PREFIX + ident,
                       "value": payload, "overwrite": True})
+        _published = True
         return True
     except Exception:
         return False
+
+
+def unpublish(kv_call, ident: str) -> None:
+    """Delete this process's snapshot key on clean shutdown so the
+    aggregator never serves a dead worker's counters during the TTL
+    window (no-op if this process never published)."""
+    global _published
+    if not _published:
+        return
+    _published = False
+    try:
+        kv_call({"op": "kv_del", "key": _KV_PREFIX + ident})
+    except Exception:
+        pass
 
 
 def _publisher_loop():
@@ -285,14 +327,24 @@ def _ensure_publisher():
                      name="metrics-publisher").start()
 
 
-def aggregate_snapshots(kv_call, max_age_s: float = 60.0) -> List[dict]:
-    """Merge all processes' published snapshots (driver-side)."""
+def aggregate_snapshots(kv_call, max_age_s: Optional[float] = None,
+                        skip_ident: Optional[str] = None) -> List[dict]:
+    """Merge all processes' published snapshots (driver-side).
+
+    `skip_ident` excludes one process's key — the aggregating process
+    reads its own registry live via local_snapshots(), so its published
+    copy would double-count.  Stale keys (older than the TTL) are
+    best-effort deleted, not just skipped."""
+    if max_age_s is None:
+        max_age_s = _metrics_ttl_s()
     out: List[dict] = []
     try:
         keys = kv_call({"op": "kv_keys", "prefix": _KV_PREFIX}) or []
     except Exception:
         return out
     for key in keys:
+        if skip_ident is not None and key == _KV_PREFIX + skip_ident:
+            continue
         # Per-key isolation: one corrupt/raced snapshot must not hide the
         # rest of the fleet's metrics.
         try:
@@ -301,6 +353,10 @@ def aggregate_snapshots(kv_call, max_age_s: float = 60.0) -> List[dict]:
                 continue
             payload = pickle.loads(raw)
             if time.time() - payload.get("ts", 0) > max_age_s:
+                try:
+                    kv_call({"op": "kv_del", "key": key})
+                except Exception:
+                    pass
                 continue
             out.extend(payload["snapshots"])
         except Exception:
@@ -385,8 +441,13 @@ def builtin_snapshots(runtime) -> List[dict]:
 
 
 def aggregate_prometheus_text(runtime) -> str:
-    """Everything the cluster knows, as one Prometheus exposition: built-in
-    state gauges + every process's user metrics."""
+    """Everything the cluster knows, as one Prometheus exposition:
+    built-in state gauges + this process's live registry (incl. wire
+    counters) + every other process's published snapshots."""
     snaps = builtin_snapshots(runtime)
-    snaps.extend(aggregate_snapshots(lambda msg: runtime.kv().call(msg)))
+    snaps.extend(local_snapshots())
+    ident = (runtime.core.worker_hex if hasattr(runtime, "core")
+             else "driver")
+    snaps.extend(aggregate_snapshots(lambda msg: runtime.kv().call(msg),
+                                     skip_ident=ident))
     return snapshots_to_prometheus_text(snaps)

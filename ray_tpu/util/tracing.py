@@ -10,31 +10,64 @@ metadata; here tracing is self-contained (zero extra deps, zero egress):
     `ray.init(_tracing_startup_hook=...)` opt-in).
   - `trace_span(name)` is a context manager recording a span on a
     thread-local stack (parent/child nesting within a process).
-  - The task layer records a `submit:<task>` span per submission when
-    tracing is on (hooked in core/remote_function.py); cross-process
-    correlation happens by task_id against the control server's task
-    records, so no context needs to ride the wire.
+  - Cross-process propagation (the reference's _DictPropagator): the
+    task layer captures a compact (trace_id, parent span_id) context at
+    submission — `make_trace_ctx()` — which rides the TaskSpec and is
+    restored around execution on the worker (`begin_task_span` /
+    `end_task_span`), so driver→worker→nested-task hops share one
+    trace_id with correct parent links and no extra wire round-trips.
+  - Spans live in a BOUNDED ring (env RAY_TPU_TRACE_MAX_SPANS, default
+    100k): long-running drivers evict oldest spans instead of leaking;
+    `dropped_span_count()` reports evictions.
   - `export_chrome_trace(path)` merges local spans with the cluster task
-    timeline (util/timeline.py) into one chrome-trace file.
+    timeline (util/timeline.py, including its wire/scheduler lanes)
+    into one chrome-trace file Perfetto can open.
 """
 
 from __future__ import annotations
 
+import contextvars
 import json
+import os
+import random
 import threading
 import time
 import uuid
+from collections import deque
 from contextlib import contextmanager
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 _enabled = False
-_spans: List[Dict[str, Any]] = []
 _spans_lock = threading.Lock()
+_dropped_spans = 0
 _local = threading.local()
+
+# Execution-side trace context restored from an incoming TaskSpec:
+# (trace_id, current span_id).  A contextvar (not thread-local) so async
+# actor tasks each see their own context on the shared event loop.
+_task_ctx: "contextvars.ContextVar[Optional[Tuple[str, str]]]" = \
+    contextvars.ContextVar("ray_tpu_trace_ctx", default=None)
+
+
+def _max_spans() -> int:
+    try:
+        cap = int(os.environ.get("RAY_TPU_TRACE_MAX_SPANS", "100000"))
+    except ValueError:
+        cap = 100000
+    return max(16, cap)
+
+
+_spans: "deque[tuple]" = deque(maxlen=_max_spans())
 
 
 def enable_tracing() -> None:
-    global _enabled
+    """Enable span recording in this process; re-reads
+    RAY_TPU_TRACE_MAX_SPANS so tests/apps can resize the ring."""
+    global _enabled, _spans
+    cap = _max_spans()
+    with _spans_lock:
+        if cap != _spans.maxlen:
+            _spans = deque(_spans, maxlen=cap)
     _enabled = True
 
 
@@ -53,60 +86,175 @@ def _stack() -> List[str]:
     return _local.stack
 
 
+_rand = random.Random(uuid.uuid4().int)
+_rand_pid = os.getpid()
+
+
+def _new_id() -> str:
+    # Not uuid4 per id: that is an os.urandom syscall on every task
+    # submit/execute, measurable on the control-plane hot path.  One
+    # urandom seed per process, then a process-local PRNG (reseeded
+    # after fork — a child inheriting the parent's PRNG state would
+    # mint the parent's exact id stream).
+    global _rand, _rand_pid
+    pid = os.getpid()
+    if pid != _rand_pid:
+        _rand = random.Random(uuid.uuid4().int)
+        _rand_pid = pid
+    return f"{_rand.getrandbits(64):016x}"
+
+
+def current_trace_id() -> str:
+    """The trace id new spans/submissions belong to: the restored task
+    context's id inside a traced task, else a lazily minted per-thread
+    id on the driver."""
+    ctx = _task_ctx.get()
+    if ctx is not None:
+        return ctx[0]
+    tid = getattr(_local, "trace_id", None)
+    if tid is None:
+        tid = _local.trace_id = _new_id()
+    return tid
+
+
 def current_span_id() -> Optional[str]:
     stack = _stack()
-    return stack[-1] if stack else None
+    if stack:
+        return stack[-1]
+    ctx = _task_ctx.get()
+    return ctx[1] if ctx is not None else None
+
+
+def make_trace_ctx() -> Optional[Tuple[str, str]]:
+    """Compact context injected into TaskSpecs at submission: (trace_id,
+    parent span_id).  Inside a traced task this returns the RESTORED
+    context even when local tracing is off — nested submissions stay
+    stitched to the driver's trace without enabling recording in
+    workers.  Returns None (nothing rides the wire) when there is no
+    trace to continue and tracing is off."""
+    ctx = _task_ctx.get()
+    if ctx is not None:
+        return (ctx[0], current_span_id() or ctx[1])
+    if not _enabled:
+        return None
+    return (current_trace_id(), current_span_id() or "")
+
+
+# Ring slots are TUPLES (span_id, parent_id, trace_id, name, start,
+# end, attributes-or-None), not dicts: a tuple of atomics is untracked
+# by the cyclic GC after its first collection, so a full 100k-span ring
+# adds nothing to gen2 scans — per-span dicts would tax every
+# allocation-heavy burst in the recording process.  get_spans()
+# materializes the dict view.
+def _append_span(span: tuple) -> None:
+    global _dropped_spans
+    with _spans_lock:
+        if len(_spans) == _spans.maxlen:
+            _dropped_spans += 1
+        _spans.append(span)
 
 
 def record_span(name: str, start: float, end: float,
                 attributes: Optional[Dict[str, Any]] = None,
-                parent_id: Optional[str] = None) -> Optional[str]:
-    """Record a completed span (no-op unless tracing is enabled)."""
-    if not _enabled:
+                parent_id: Optional[str] = None,
+                trace_id: Optional[str] = None,
+                span_id: Optional[str] = None,
+                force: bool = False) -> Optional[str]:
+    """Record a completed span (no-op unless tracing is enabled or
+    `force` — execution spans restored from a remote context record even
+    in non-traced worker processes, so a worker-side export still shows
+    them)."""
+    if not (_enabled or force):
         return None
-    span_id = uuid.uuid4().hex[:16]
-    with _spans_lock:
-        _spans.append({
-            "span_id": span_id,
-            "parent_id": parent_id or current_span_id(),
-            "name": name,
-            "start": start,
-            "end": end,
-            "attributes": attributes or {},
-        })
+    span_id = span_id or _new_id()
+    _append_span((span_id,
+                  parent_id or current_span_id(),
+                  trace_id or current_trace_id(),
+                  name, start, end, attributes))
     return span_id
 
 
 @contextmanager
 def trace_span(name: str, attributes: Optional[Dict[str, Any]] = None):
-    """Context manager for a nested span; cheap no-op when disabled."""
+    """Context manager for a nested span; cheap no-op when disabled.
+    A caller-provided `attributes` dict is kept by identity, so fields
+    added inside (or just after) the block land on the span."""
     if not _enabled:
         yield None
         return
-    span_id = uuid.uuid4().hex[:16]
+    span_id = _new_id()
     parent = current_span_id()
+    trace_id = current_trace_id()
     _stack().append(span_id)
     start = time.time()
     try:
         yield span_id
     finally:
         _stack().pop()
-        with _spans_lock:
-            _spans.append({
-                "span_id": span_id, "parent_id": parent, "name": name,
-                "start": start, "end": time.time(),
-                "attributes": attributes or {},
-            })
+        _append_span((span_id, parent, trace_id, name, start,
+                      time.time(), attributes))
 
+
+# ---------------------------------------------------------------------------
+# Execution-side propagation (worker.py): restore the spec's trace_ctx
+# around task execution so nested submissions parent correctly.
+# ---------------------------------------------------------------------------
+
+def begin_task_span(trace_ctx: Tuple[str, str]):
+    """Enter a task-execution span from a remote context; returns
+    (reset token, execution span_id).  The span id becomes the parent
+    of everything the task does — nested submissions, local
+    trace_span()s — and of the task's lifecycle events."""
+    span_id = _new_id()
+    token = _task_ctx.set((trace_ctx[0], span_id))
+    return token, span_id
+
+
+def end_task_span(token, name: str, start: float, end: float,
+                  trace_ctx: Tuple[str, str], span_id: str,
+                  attributes: Optional[Dict[str, Any]] = None) -> None:
+    """Close a task-execution span: restore the previous context and
+    record the span locally (forced — the executing process need not
+    have tracing enabled)."""
+    _task_ctx.reset(token)
+    record_span(name, start, end, attributes=attributes,
+                parent_id=trace_ctx[1] or None, trace_id=trace_ctx[0],
+                span_id=span_id, force=True)
+
+
+def set_task_ctx(trace_ctx: Tuple[str, str]) -> str:
+    """Async-task variant of begin_task_span: installs the context in
+    the CURRENT contextvars context (each asyncio task runs in its own
+    copy, so no reset is needed) and returns the execution span id."""
+    span_id = _new_id()
+    _task_ctx.set((trace_ctx[0], span_id))
+    return span_id
+
+
+# ---------------------------------------------------------------------------
+# Introspection / export
+# ---------------------------------------------------------------------------
 
 def get_spans() -> List[Dict[str, Any]]:
     with _spans_lock:
-        return list(_spans)
+        rows = list(_spans)
+    return [{"span_id": s, "parent_id": p, "trace_id": t, "name": n,
+             "start": st, "end": en,
+             "attributes": {} if a is None else a}
+            for s, p, t, n, st, en, a in rows]
 
 
 def clear_spans() -> None:
+    global _dropped_spans
     with _spans_lock:
         _spans.clear()
+        _dropped_spans = 0
+
+
+def dropped_span_count() -> int:
+    """Spans evicted from the bounded ring since the last clear."""
+    with _spans_lock:
+        return _dropped_spans
 
 
 def spans_to_chrome_events(spans: List[Dict[str, Any]]
@@ -119,24 +267,37 @@ def spans_to_chrome_events(spans: List[Dict[str, Any]]
             "ts": s["start"] * 1e6,
             "dur": max(0.0, s["end"] - s["start"]) * 1e6,
             "args": {**s["attributes"], "span_id": s["span_id"],
-                     "parent_id": s["parent_id"]},
+                     "parent_id": s["parent_id"],
+                     "trace_id": s.get("trace_id", "")},
         })
     if events:
         events.append({"ph": "M", "pid": 1, "name": "process_name",
                        "args": {"name": "driver spans"}})
+        events.append({"ph": "M", "pid": 1, "name": "process_sort_index",
+                       "args": {"sort_index": 1}})
+    return events
+
+
+def trace_events(runtime=None, max_tasks: int = 0
+                 ) -> List[Dict[str, Any]]:
+    """The unified trace: local spans + cluster task/scheduling lanes +
+    wire/scheduler flight-recorder lanes, as one chrome-trace event
+    list (the dashboard's /api/trace payload)."""
+    events = spans_to_chrome_events(get_spans())
+    try:
+        from ray_tpu.util.timeline import timeline_events
+
+        events.extend(timeline_events(runtime, max_tasks=max_tasks))
+    except Exception:
+        pass
     return events
 
 
 def export_chrome_trace(filename: str, include_tasks: bool = True) -> int:
-    """Write local spans (+ the cluster task timeline) as chrome-trace
-    JSON; returns the number of events written."""
-    events = spans_to_chrome_events(get_spans())
-    if include_tasks:
-        try:
-            from ray_tpu.util.timeline import timeline_events
-            events.extend(timeline_events())
-        except Exception:
-            pass
+    """Write local spans (+ the cluster task timeline and wire/scheduler
+    lanes) as chrome-trace JSON; returns the number of events written."""
+    events = (trace_events() if include_tasks
+              else spans_to_chrome_events(get_spans()))
     with open(filename, "w") as f:
         json.dump(events, f)
     return len(events)
