@@ -134,6 +134,10 @@ class Config:
     # Chunk size for cross-node object pulls (reference
     # object_manager_default_chunk_size, ray_config_def.h).
     transfer_chunk_bytes: int = 8 * 1024 * 1024
+    # In-flight fetch_chunk requests per object pull (reference
+    # object_manager_max_bytes_in_flight, as a chunk-count window).
+    # 1 restores the legacy one-chunk-at-a-time ping-pong.
+    pull_window: int = 4
     # A spawned worker that hasn't registered within this window is
     # presumed dead (its node crashed mid-spawn) and its work is retried.
     worker_register_timeout_s: float = 60.0

@@ -31,7 +31,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
 
-from ray_tpu.core import rpc
+from ray_tpu.core import object_plane, rpc
 from ray_tpu.core.config import Config
 from ray_tpu.core.exceptions import ObjectLostError
 from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
@@ -373,10 +373,14 @@ class ControlServer:
             self._m_task_event_frames = _m.Counter(
                 "ray_tpu_task_event_frames_total",
                 "task_events frames received (events arrive batched)")
+            self._m_locality_hits = _m.Counter(
+                "ray_tpu_locality_hits_total",
+                "Tasks placed on a node already holding >=1 shm arg")
         except Exception:
             self._m_lease_grants = self._m_lease_denials = None
             self._m_lease_clamps = None
             self._m_task_events = self._m_task_event_frames = None
+            self._m_locality_hits = None
 
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -3151,6 +3155,29 @@ class ControlServer:
         utils = [1.0 - av.get(k, 0.0) / v for k, v in tot.items() if v > 0]
         return max(utils, default=0.0)
 
+    def _locality_bytes(self, spec) -> Dict[str, int]:
+        """Lock held.  Bytes of the spec's shm ref args already resident
+        on each node — primary copy or pulled replica, straight from the
+        object directory (the reference's locality-aware lease policy
+        consults its object directory the same way,
+        locality_data_provider in lease_policy.cc).  Inline and
+        still-pending args contribute nothing."""
+        out: Dict[str, int] = {}
+        for arg in getattr(spec, "args", ()):
+            if not getattr(arg, "is_ref", False):
+                continue
+            entry = self.objects.get(arg.object_hex)
+            if entry is None or entry.state != READY or not entry.in_shm:
+                continue
+            for nid in {entry.node_id, *entry.replicas}:
+                out[nid] = out.get(nid, 0) + entry.size
+        return out
+
+    @staticmethod
+    def _locality_enabled() -> bool:
+        return os.environ.get("RAY_TPU_NO_LOCALITY", "").strip().lower() \
+            not in ("1", "true", "yes")
+
     def _pick_node(self, need: ResourceSet, spec,
                    avail_of=None) -> Optional[tuple]:
         """Lock held. Choose a node (or PG bundle) for this task/actor.
@@ -3239,13 +3266,28 @@ class ControlServer:
             node = ties[hash(tid.binary()) % len(ties)]
             return node.node_id, ("node", node.node_id)
         # hybrid default: pack onto the busiest node below the spread
-        # threshold; above it, spread to the least utilized.
+        # threshold; above it, spread to the least utilized.  Utilization
+        # ties break by bytes of this task's shm args already resident on
+        # the candidate (locality-aware placement, reference
+        # lease_policy.cc LocalityAwareLeasePolicy) — feasibility always
+        # dominates, so locality never overrides resources.  Env
+        # RAY_TPU_NO_LOCALITY=1 restores the legacy tie-break exactly
+        # (with no locality data both keys collapse to the old ones).
         threshold = 0.5
+        loc = (self._locality_bytes(spec) if self._locality_enabled()
+               else {})
         below = [n for n in feasible if util(n) < threshold]
         if below:
-            node = max(below, key=lambda n: (util(n), n.is_head))
+            node = max(below, key=lambda n: (util(n),
+                                             loc.get(n.node_id, 0),
+                                             n.is_head))
         else:
-            node = min(feasible, key=lambda n: (util(n), not n.is_head))
+            node = min(feasible, key=lambda n: (util(n),
+                                                -loc.get(n.node_id, 0),
+                                                not n.is_head))
+        if loc.get(node.node_id, 0) > 0:
+            if self._m_locality_hits is not None:
+                self._m_locality_hits.inc()
         return node.node_id, ("node", node.node_id)
 
     def _unschedulable_reason(self, spec) -> Optional[str]:
@@ -3583,13 +3625,15 @@ class ControlServer:
 
     def _pull_node_object(self, node_id: str, obj_hex: str,
                           size: int) -> Optional[bytes]:
-        """Pull a whole object's bytes from its holding node (chunked)."""
+        """Pull a whole object's bytes from its holding node (chunked,
+        windowed like every other puller)."""
         client = self._node_client(node_id)
         if client is None:
             return None
         try:
             return rpc.pull_object_chunked(
-                client, obj_hex, size, self.config.transfer_chunk_bytes)
+                client, obj_hex, size, self.config.transfer_chunk_bytes,
+                window=self.config.pull_window)
         except Exception:
             return None
 
@@ -3630,10 +3674,13 @@ class ControlServer:
                     if getattr(self, "_proxy_cache", None) is not None \
                             and self._proxy_cache[0] == obj_hex:
                         self._proxy_cache = None
+            object_plane.OBJ._inc("bytes_pushed", len(part))
             return part
         seg = self.store.attach(ObjectID.from_hex(obj_hex), msg["size"])
         off, n = msg["offset"], msg["length"]
-        return bytes(seg.buf[off:off + n])
+        part = bytes(seg.buf[off:off + n])
+        object_plane.OBJ._inc("bytes_pushed", len(part))
+        return part
 
     def _op_report_object_lost(self, conn, msg):
         """A client's pull from a remote node failed (the node's arena

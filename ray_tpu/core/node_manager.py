@@ -31,7 +31,7 @@ import time
 import uuid
 from typing import Dict, Optional
 
-from ray_tpu.core import rpc
+from ray_tpu.core import object_plane, rpc
 from ray_tpu.core.config import get_config, reset_config
 from ray_tpu.core.ids import ObjectID
 from ray_tpu.core.object_store import ShmObjectStore
@@ -336,7 +336,9 @@ class NodeManager:
             oid = ObjectID.from_hex(msg["obj"])
             seg = self.store.attach(oid, msg["size"])
             off, n = msg["offset"], msg["length"]
-            return bytes(seg.buf[off:off + n])
+            part = bytes(seg.buf[off:off + n])
+            object_plane.OBJ._inc("bytes_pushed", len(part))
+            return part
         if op == "has_object":
             return self.store.contains(ObjectID.from_hex(msg["obj"]))
         if op == "push_begin":

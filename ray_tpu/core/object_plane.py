@@ -1,11 +1,19 @@
-"""Push-based object broadcast with bounded in-flight admission.
+"""Object-plane transfer managers: demand pulls and push broadcast.
 
 Counterpart of the reference's PushManager/PullManager pair
 (src/ray/object_manager/object_manager.h:206 — push_manager chunk
-scheduling; pull_manager.h:52 — memory-bounded admission): the pull
-side of this stack's object plane (runtime._pull_remote_object →
-node_manager `fetch_chunk`) covers demand-driven transfer; this module
-adds the PUSH direction — one source fans an object's chunks out to N
+scheduling; pull_manager.h:52 — memory-bounded admission).
+
+PULL side (`PullManager` + `pull_into_store`): demand-driven transfer
+used by runtime._pull_remote_object against node_manager/head
+`fetch_chunk` servers.  Chunks are windowed (rpc.pull_object_chunked)
+and land DIRECTLY in a pre-created arena segment — no full-size
+intermediate buffer, no extra copy on the cache path — and concurrent
+pulls of one object are single-flighted: the first caller drives the
+wire, everyone else waits on its outcome and attaches to the sealed
+segment (reference pull_manager.h request coalescing).
+
+PUSH side (`PushManager`) — one source fans an object's chunks out to N
 node arenas concurrently, under a global in-flight byte budget, so a
 1-GiB broadcast to a cluster neither serializes per node nor floods
 memory/sockets.
@@ -27,11 +35,274 @@ destinations complete — pinned by tests/test_chaos.py.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core.ids import ObjectID
+
+logger = logging.getLogger(__name__)
+
+# Cached lazy import: util/__init__ pulls in placement groups → runtime
+# → this module, so a top-level flight-recorder import here would cycle
+# (same shape as rpc._flight_recorder).
+_flight = None
+
+
+def _flight_recorder():
+    global _flight
+    if _flight is None:
+        try:
+            from ray_tpu.util import flight_recorder as fr
+
+            _flight = fr
+        except Exception:
+            _flight = False
+    return _flight
+
+
+def _record(event: str, **fields) -> None:
+    fr = _flight_recorder()
+    if fr:
+        fr.record("object", event, **fields)
+
+
+class _ObjPlaneStats:
+    """Process-wide object-plane telemetry, exported through
+    util/metrics.py via object_metric_snapshots() — same pattern as
+    rpc._WireStats: a module-level singleton so the transfer hot path
+    never touches the metrics registry."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.bytes_pulled = 0
+        self.bytes_pushed = 0
+        self.pulls_started = 0
+        self.pulls_deduped = 0
+        self.pull_errors = 0
+        self.arena_cache_hits = 0
+        self.arena_cache_stores = 0
+        self.arena_cache_failures = 0
+
+    def _inc(self, field: str, n: int = 1):
+        with self.lock:
+            setattr(self, field, getattr(self, field) + n)
+
+
+OBJ = _ObjPlaneStats()
+
+
+def object_metric_snapshots() -> list:
+    """This process's object-plane counters as metric-snapshot dicts in
+    the util/metrics.py exposition shape (merged into local_snapshots()).
+    Locality-hit counting lives head-side in gcs.py as a registry
+    Counter — placement decisions only happen there."""
+    o = OBJ
+    with o.lock:
+        bytes_pulled, bytes_pushed = o.bytes_pulled, o.bytes_pushed
+        started, deduped, errors = (o.pulls_started, o.pulls_deduped,
+                                    o.pull_errors)
+        hits, stores, failures = (o.arena_cache_hits,
+                                  o.arena_cache_stores,
+                                  o.arena_cache_failures)
+    return [
+        {"name": "object_transfer_bytes_total", "kind": "counter",
+         "description": "Object-plane payload bytes moved between nodes",
+         "series": {(("direction", "pulled"),): float(bytes_pulled),
+                    (("direction", "pushed"),): float(bytes_pushed)}},
+        {"name": "object_pulls_total", "kind": "counter",
+         "description": "Object pulls by outcome (deduped = coalesced "
+                        "onto an in-flight pull of the same object)",
+         "series": {(("result", "started"),): float(started),
+                    (("result", "deduped"),): float(deduped),
+                    (("result", "error"),): float(errors)}},
+        {"name": "object_arena_cache_total", "kind": "counter",
+         "description": "Local-arena replica cache events for remote "
+                        "objects (hit = later read served from shm)",
+         "series": {(("event", "hit"),): float(hits),
+                    (("event", "store"),): float(stores),
+                    (("event", "failure"),): float(failures)}},
+    ]
+
+
+# -- rate-limited arena-cache diagnostics -----------------------------------
+# Caching a pulled object into the local arena is best-effort, but the
+# old bare `except Exception: pass` made a persistently full arena
+# undiagnosable (every read re-pulled over the wire, silently).  Warn
+# once per distinct cause per interval instead.
+_WARN_INTERVAL_S = 60.0
+_warn_lock = threading.Lock()
+_warned: Dict[str, float] = {}
+
+
+def _warn_arena_cache(exc: BaseException, obj_hex: str = "") -> None:
+    OBJ._inc("arena_cache_failures")
+    key = f"{type(exc).__name__}: {str(exc)[:120]}"
+    now = time.monotonic()
+    with _warn_lock:
+        last = _warned.get(key)
+        if last is not None and now - last < _WARN_INTERVAL_S:
+            return
+        _warned[key] = now
+    logger.warning(
+        "could not cache pulled object %s in the local arena "
+        "(reads will keep pulling over the wire): %s",
+        obj_hex or "<unknown>", key)
+
+
+class PullManager:
+    """Single-flight table for concurrent pulls of one object
+    (reference pull_manager.h request coalescing): the first caller
+    becomes the leader and drives the wire; callers arriving while that
+    pull is in flight wait on its event and share the outcome.  An
+    error propagates to every waiter, and the entry is cleared BEFORE
+    waiters wake so a retry re-pulls instead of joining the corpse."""
+
+    class _Flight:
+        __slots__ = ("done", "result", "error")
+
+        def __init__(self):
+            self.done = threading.Event()
+            self.result: Any = None
+            self.error: Optional[BaseException] = None
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, "PullManager._Flight"] = {}
+
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._inflight)
+
+    def pull(self, obj_hex: str, fn: Callable[[], Any],
+             timeout: float = 600.0) -> Any:
+        """Run `fn` single-flighted under `obj_hex`; concurrent callers
+        for the same key block on the leader and receive its result (or
+        its exception)."""
+        with self._lock:
+            fl = self._inflight.get(obj_hex)
+            if fl is None:
+                fl = self._Flight()
+                self._inflight[obj_hex] = fl
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            OBJ._inc("pulls_deduped")
+            _record("dedup_join", obj=obj_hex)
+            if not fl.done.wait(timeout):
+                raise TimeoutError(
+                    f"waited {timeout}s on an in-flight pull of "
+                    f"{obj_hex}")
+            if fl.error is not None:
+                raise fl.error
+            return fl.result
+        try:
+            fl.result = fn()
+            return fl.result
+        except BaseException as e:
+            fl.error = e
+            raise
+        finally:
+            # Clear the entry first, wake waiters second: a waiter that
+            # sees the error and retries must start a FRESH flight.
+            with self._lock:
+                self._inflight.pop(obj_hex, None)
+            fl.done.set()
+
+
+def pull_into_store(client, store, obj_hex: str, size: int, chunk: int,
+                    *, window: Optional[int] = None,
+                    timeout: float = 120.0) -> Tuple[Any, bool]:
+    """Pull an object's bytes from the peer behind `client`, landing
+    chunks directly in a pre-created local arena segment (reference
+    ObjectBufferPool: chunks write into the plasma allocation, not an
+    intermediate buffer).  Returns (data, cached): `data` is a buffer
+    of the payload (a zero-copy view of the sealed segment when caching
+    succeeded), `cached` says whether the local store now holds a
+    replica.
+
+    Failure model: a wire error mid-pull deletes the partial segment
+    (nothing half-written survives in the arena) and re-raises; arena
+    failures (full, race) degrade to an uncached in-memory pull with a
+    rate-limited warning.
+    """
+    OBJ._inc("pulls_started")
+    peer = getattr(client, "address", "")
+    _record("pull_begin", obj=obj_hex,
+                           peer=peer, bytes=size)
+    t0 = time.monotonic()
+    from ray_tpu.core import rpc
+
+    oid = ObjectID.from_hex(obj_hex)
+    seg = None
+    if store is not None:
+        try:
+            seg = store.create(oid, size)
+        except Exception as e:  # noqa: BLE001 — cache is best-effort
+            _warn_arena_cache(e, obj_hex)
+    try:
+        if seg is None:
+            data = rpc.pull_object_chunked(client, obj_hex, size, chunk,
+                                           timeout=timeout, window=window)
+            cached = False
+        else:
+            try:
+                rpc.pull_object_chunked(client, obj_hex, size, chunk,
+                                        timeout=timeout, window=window,
+                                        into=seg.buf)
+            except BaseException:
+                # Reap the partial segment: an aborted pull must not
+                # leave a half-written object for attach() to find.
+                try:
+                    store.delete(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+                raise
+            data, cached = _seal_and_reattach(store, oid, obj_hex, size,
+                                              seg)
+    except BaseException:
+        OBJ._inc("pull_errors")
+        _record("pull_end", obj=obj_hex,
+                               peer=peer, bytes=size, ok=False,
+                               duration_s=round(time.monotonic() - t0, 6))
+        raise
+    OBJ._inc("bytes_pulled", size)
+    if cached:
+        OBJ._inc("arena_cache_stores")
+    _record("pull_end", obj=obj_hex, peer=peer,
+                           bytes=size, ok=True, cached=cached,
+                           duration_s=round(time.monotonic() - t0, 6))
+    return data, cached
+
+
+def _seal_and_reattach(store, oid, obj_hex: str, size: int,
+                       seg) -> Tuple[Any, bool]:
+    """Seal a fully-written segment and return a fresh read view.
+    seal() evicts the creator's writable view in the native arena (its
+    block may be reused once the create pin drops), so the bytes MUST
+    be re-read through attach()."""
+    try:
+        store.seal(oid)
+    except Exception as e:  # noqa: BLE001
+        # Unsealed: the creator's view is still pinned and readable.
+        # Copy out, drop the segment, serve uncached.
+        _warn_arena_cache(e, obj_hex)
+        data = bytes(seg.buf[:size])
+        try:
+            store.delete(oid)
+        except Exception:  # noqa: BLE001
+            pass
+        return data, False
+    try:
+        view = store.attach(oid, size)
+        return view.buf[:size], True
+    except Exception as e:  # noqa: BLE001
+        # Sealed but unreadable here (pin race): the replica EXISTS —
+        # report cached=True — but these bytes must come from a copy.
+        _warn_arena_cache(e, obj_hex)
+        return bytes(seg.buf[:size]), True
 
 
 class PushManager:
@@ -93,6 +364,34 @@ class PushManager:
 
     def _push_one(self, addr: str, obj_hex: str, size: int, seg,
                   timeout: float, budget) -> str:
+        t0 = time.monotonic()
+        _record("push_begin", obj=obj_hex,
+                               peer=addr, bytes=size)
+        try:
+            status = self._push_one_inner(addr, obj_hex, size, seg,
+                                          timeout, budget)
+        except BaseException as e:
+            _record(
+                "push_end", obj=obj_hex, peer=addr, bytes=size,
+                ok=False, status=f"error: {type(e).__name__}",
+                duration_s=round(time.monotonic() - t0, 6))
+            raise
+        if status in ("ok", "have"):
+            if status == "ok":
+                OBJ._inc("bytes_pushed", size)
+            _record(
+                "push_end", obj=obj_hex, peer=addr, bytes=size,
+                ok=True, status=status,
+                duration_s=round(time.monotonic() - t0, 6))
+        else:
+            _record(
+                "push_end", obj=obj_hex, peer=addr, bytes=size,
+                ok=False, status=status,
+                duration_s=round(time.monotonic() - t0, 6))
+        return status
+
+    def _push_one_inner(self, addr: str, obj_hex: str, size: int, seg,
+                        timeout: float, budget) -> str:
         conn = self._rt._node_conn(addr)
         begin = conn.call({"op": "push_begin", "obj": obj_hex,
                            "size": size}, timeout=30.0)

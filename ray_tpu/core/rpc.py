@@ -42,6 +42,7 @@ import socket
 import struct
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Optional
 
 _FRAME = struct.Struct("<BQI")
@@ -113,31 +114,71 @@ class RpcError(ConnectionError):
     pass
 
 
+def pull_window() -> int:
+    """In-flight fetch_chunk requests per pull (RAY_TPU_PULL_WINDOW,
+    default 4).  1 restores the legacy one-chunk-at-a-time ping-pong
+    byte for byte."""
+    try:
+        w = int(os.environ.get("RAY_TPU_PULL_WINDOW", "4"))
+    except ValueError:
+        w = 4
+    return max(1, w)
+
+
 def pull_object_chunked(client: "Client", obj_hex: str, size: int,
-                        chunk: int, timeout: float = 60.0) -> bytes:
+                        chunk: int, timeout: float = 60.0, *,
+                        window: Optional[int] = None,
+                        into=None) -> Optional[bytes]:
     """Pull an object's bytes via fetch_chunk requests (the cross-node
     object plane's one wire loop — shared by workers pulling from peer
-    nodes and the head proxying for thin clients).  Raises on a short or
-    failed read."""
+    nodes and the head proxying for thin clients).
+
+    Keeps up to `window` requests in flight, multiplexed on the
+    client's request ids (reference ObjectManager chunked pull,
+    object_buffer_pool.h): the peer serves chunk k+1 while chunk k is
+    still on the wire, so the transfer runs at pipeline speed instead
+    of one round trip per chunk.  Chunks land at fixed offsets, so
+    out-of-window completion order never matters.  `into` (a writable
+    buffer of at least `size` bytes — typically a pre-created arena
+    segment) receives chunks directly as they arrive, skipping the
+    full-size intermediate copy; the return value is then None.
+    Raises on a short, oversized, or failed read."""
     chunk = max(1 << 20, chunk)
-    data = bytearray(size)
-    off = 0
-    while off < size:
-        n = min(chunk, size - off)
-        part = client.call({"op": "fetch_chunk", "obj": obj_hex,
-                            "size": size, "offset": off, "length": n},
-                           timeout=timeout)
-        if not part:
-            raise RpcError(f"peer no longer serves object {obj_hex}")
-        if len(part) > n:
-            # An oversized reply must not silently grow the payload past
-            # the declared object size.
-            raise RpcError(
-                f"peer returned {len(part)} bytes for a {n}-byte chunk "
-                f"of object {obj_hex}")
-        data[off:off + len(part)] = part
-        off += len(part)
-    return bytes(data)
+    if window is None:
+        window = pull_window()
+    window = max(1, int(window))
+    dest = bytearray(size) if into is None else into
+    inflight: deque = deque()  # (offset, length, pending call)
+    next_off = 0
+    try:
+        while inflight or next_off < size:
+            while next_off < size and len(inflight) < window:
+                n = min(chunk, size - next_off)
+                pending = client.call_async(
+                    {"op": "fetch_chunk", "obj": obj_hex, "size": size,
+                     "offset": next_off, "length": n})
+                inflight.append((next_off, n, pending))
+                next_off += n
+            off, n, pending = inflight.popleft()
+            part = pending.result(timeout=timeout)
+            if not part:
+                raise RpcError(f"peer no longer serves object {obj_hex}")
+            if len(part) != n:
+                # Offsets are fixed up front, so a short reply cannot be
+                # re-requested mid-window; an oversized one must not
+                # silently grow past the declared object size.  Both
+                # mean the peer's copy is not the directory's object.
+                raise RpcError(
+                    f"peer returned {len(part)} bytes for a {n}-byte "
+                    f"chunk of object {obj_hex}")
+            dest[off:off + n] = part
+    except BaseException:
+        # Abandon outstanding requests: late responses for popped ids
+        # are dropped by the recv loop instead of leaking table entries.
+        for _, _, pending in inflight:
+            pending.discard()
+        raise
+    return None if into is not None else bytes(dest)
 
 
 class _RemoteTraceback(Exception):
@@ -713,6 +754,34 @@ class Server:
             conn.close()
 
 
+class _PendingCall:
+    """Handle to one in-flight request: result() blocks for the reply,
+    discard() abandons it (a late reply for a forgotten id is dropped by
+    the recv loop).  The unit of request pipelining — callers keep
+    several outstanding on one connection (windowed object pulls)."""
+
+    __slots__ = ("_client", "_req_id", "_ev")
+
+    def __init__(self, client: "Client", req_id: int, ev: threading.Event):
+        self._client = client
+        self._req_id = req_id
+        self._ev = ev
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._ev.wait(timeout):
+            self.discard()
+            raise TimeoutError(f"rpc call timed out after {timeout}s")
+        self._client._pending.pop(self._req_id, None)
+        status, result = self._client._results.pop(self._req_id)
+        if status == "err":
+            raise result
+        return result
+
+    def discard(self):
+        self._client._pending.pop(self._req_id, None)
+        self._client._results.pop(self._req_id, None)
+
+
 class Client:
     """Thread-safe RPC client with request/response matching and push inbox."""
 
@@ -842,7 +911,11 @@ class Client:
         if self._sender is not None:
             self._sender.flush()
 
-    def call(self, msg: Any, timeout: Optional[float] = None) -> Any:
+    def call_async(self, msg: Any) -> _PendingCall:
+        """Post a request and return a handle without waiting for the
+        reply.  Multiple handles may be outstanding on one connection
+        (responses match by request id) — the windowed object pull keeps
+        a whole window of these in flight."""
         if self._closed:
             raise RpcError(f"connection to {self.address} closed")
         if self._pre_call is not None:
@@ -854,14 +927,10 @@ class Client:
         self._pending[req_id] = ev
         payload = pickle.dumps(msg, protocol=5)
         self._post(KIND_REQUEST, req_id, payload)
-        if not ev.wait(timeout):
-            self._pending.pop(req_id, None)
-            raise TimeoutError(f"rpc call timed out after {timeout}s")
-        self._pending.pop(req_id, None)
-        status, result = self._results.pop(req_id)
-        if status == "err":
-            raise result
-        return result
+        return _PendingCall(self, req_id, ev)
+
+    def call(self, msg: Any, timeout: Optional[float] = None) -> Any:
+        return self.call_async(msg).result(timeout)
 
     def send(self, msg: Any, wait: bool = False):
         """One-way message.  wait=True blocks until the bytes are on

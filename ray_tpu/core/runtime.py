@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import cloudpickle
 
-from ray_tpu.core import rpc, serialization
+from ray_tpu.core import object_plane, rpc, serialization
 from ray_tpu.core.config import Config, get_config
 from ray_tpu.core.exceptions import (
     ActorDiedError,
@@ -180,6 +180,10 @@ class CoreClient:
         self.store_node = reply.get("store_node", "head")
         self.store = None if thin else ShmObjectStore(
             reply.get("store_key") or self.session_id, reply["shm_dir"])
+        # Single-flight table for remote-object pulls: N concurrent
+        # consumers of one object in this process share ONE wire pull
+        # (reference pull_manager.h request coalescing).
+        self._pull_manager = object_plane.PullManager()
 
         # RLock: on_ref_deleted (GC __del__) takes it and can fire while
         # this same thread already holds it in a get()/put() section.
@@ -1301,6 +1305,10 @@ class CoreClient:
                 return self._load_object(obj_hex, info2,
                                          _attempt=_attempt + 1,
                                          _deadline=_deadline)
+            if info.get("node", "head") != self.store_node:
+                # Primary copy lives elsewhere but attach succeeded:
+                # a previously pulled replica served this read from shm.
+                object_plane.OBJ._inc("arena_cache_hits")
             data = seg.buf[: info["size"]]
         else:
             raise RuntimeError(f"object {obj_hex} ready but has no payload")
@@ -1345,30 +1353,32 @@ class CoreClient:
             self._node_conns[address] = conn
         return conn
 
-    def _pull_remote_object(self, obj_hex: str, info: dict) -> bytes:
-        """Chunked pull of an object living in another node's arena
-        (reference ObjectManager chunked transfer via object_buffer_pool).
-        addr == "" means the head arena: chunks ride the control client.
-        The bytes are cached into the local arena so later readers on
-        this node hit shm (the reference PullManager materializes pulled
-        chunks into local plasma the same way)."""
-        size = info["size"]
-        addr = info.get("addr", "")
-        client = self._node_conn(addr) if addr else self.client
-        payload = rpc.pull_object_chunked(
-            client, obj_hex, size, self.config.transfer_chunk_bytes,
-            timeout=120.0)
-        try:
-            oid = ObjectID.from_hex(obj_hex)
-            seg = self.store.create(oid, size)
-            seg.buf[:size] = payload
-            self.store.seal(oid)
-            # Tell the directory about the replica so a cluster-wide free
-            # deletes this arena's copy too (no leak on consumer nodes).
-            self.client.send({"op": "object_replica", "obj": obj_hex})
-        except Exception:  # cache is best-effort (arena full, race)
-            pass
-        return payload
+    def _pull_remote_object(self, obj_hex: str, info: dict):
+        """Windowed chunked pull of an object living in another node's
+        arena (reference ObjectManager chunked transfer via
+        object_buffer_pool).  addr == "" means the head arena: chunks
+        ride the control client.  Chunks land directly in a pre-created
+        local arena segment (no intermediate full-size buffer) so later
+        readers on this node hit shm, and concurrent pulls of the same
+        object in this process coalesce onto one wire transfer
+        (object_plane.PullManager)."""
+
+        def _do_pull():
+            size = info["size"]
+            addr = info.get("addr", "")
+            client = self._node_conn(addr) if addr else self.client
+            data, cached = object_plane.pull_into_store(
+                client, self.store, obj_hex, size,
+                self.config.transfer_chunk_bytes,
+                window=self.config.pull_window, timeout=120.0)
+            if cached:
+                # Tell the directory about the replica so a cluster-wide
+                # free deletes this arena's copy too (no leak on
+                # consumer nodes).
+                self.client.send({"op": "object_replica", "obj": obj_hex})
+            return data
+
+        return self._pull_manager.pull(obj_hex, _do_pull, timeout=150.0)
 
     def forget_object(self, obj_hex: str):
         """Retire a speculative subscription (a stream-item probe for an

@@ -42,6 +42,12 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     "fetch_object": {"obj": "str", "with_meta": "bool?"},
     "fetch_chunk": {"obj": "str", "size": "int", "offset": "int",
                     "length": "int"},
+    # Node-to-node object plane (node_manager._handle): pull probe +
+    # push-broadcast stream (core/object_plane.py PushManager).
+    "has_object": {"obj": "str"},
+    "push_begin": {"obj": "str", "size": "int"},
+    "push_chunk": {"obj": "str", "offset": "int", "data": "bytes"},
+    "push_end": {"obj": "str"},
     "incref": {"obj": "str", "n": "int?"},
     "incref_batch": {"objs": "list"},
     "decref": {"obj": "str", "n": "int?"},

@@ -1,6 +1,6 @@
 """Cluster flight recorder: a bounded in-memory ring of recent
-control-plane events (wire batch flushes, lease-scheduler decisions),
-dumpable on demand.
+control- and object-plane events (wire batch flushes, lease-scheduler
+decisions, object transfers), dumpable on demand.
 
 Counterpart of the reference's in-memory event buffers (GcsTaskManager's
 bounded task-event storage, the raylet's debug-state dumps): when a
@@ -64,8 +64,11 @@ def configure(capacity: int = 0, enable: bool = True) -> None:
 
 def record(category: str, event: str, **fields: Any) -> None:
     """Append one event (no-op when disabled).  `category` picks the
-    timeline lane ("wire" | "scheduler"); `fields` are free-form and
-    must be JSON-representable (they ride the dashboard dump)."""
+    timeline lane ("wire" | "scheduler" | "object" — object-plane
+    transfers: pull_begin/pull_end, push_begin/push_end, dedup_join,
+    each carrying obj/peer/bytes and, on *_end, duration_s); `fields`
+    are free-form and must be JSON-representable (they ride the
+    dashboard dump)."""
     if not _enabled:
         return
     global _dropped

@@ -268,6 +268,14 @@ def local_snapshots() -> List[dict]:
         snaps.extend(rpc.wire_metric_snapshots())
     except Exception:
         pass
+    # Object-plane telemetry (core/object_plane.py) publishes the same
+    # way: pulled/pushed bytes, dedup ratio, arena cache events.
+    try:
+        from ray_tpu.core import object_plane
+
+        snaps.extend(object_plane.object_metric_snapshots())
+    except Exception:
+        pass
     return snaps
 
 
