@@ -22,12 +22,14 @@ Design deviations from the reference, deliberate for the TPU-first rebuild:
 
 from __future__ import annotations
 
+import itertools
 import os
 import subprocess
 import sys
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Set
 
@@ -74,6 +76,12 @@ class ObjectEntry:
     # Nodes that cached a pulled replica (so freeing the object can
     # delete every arena copy, not just the primary's).
     replicas: Set[str] = field(default_factory=set)
+    # Nodes with an in-flight PullManager pull (object_pull_started
+    # announce): node_id -> announce time.  The locality tie-break
+    # credits these too — a task chasing an object already in transit
+    # to a node should land there, not trigger a second transfer.
+    # Entries expire (stale announce) and clear on replica landing.
+    pulling: Dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -110,6 +118,9 @@ class NodeState:
     # Last host-stats report from the node's reporter
     # (dashboard/reporter.py; reference reporter_agent.py).
     stats: Dict[str, Any] = field(default_factory=dict)
+    # When that report arrived (time.time()); the health watchdog
+    # flags remote nodes whose reporter has gone silent.
+    stats_at: float = 0.0
 
     @property
     def is_remote(self) -> bool:
@@ -236,6 +247,181 @@ def _site_packages() -> str:
         _SITE_PACKAGES = os.pathsep.join(
             p for p in paths if os.path.isdir(p))
     return _SITE_PACKAGES
+
+
+_FALSY = ("0", "false", "no", "off")
+
+
+def _env_int(name: str, default: int, floor: int) -> int:
+    try:
+        v = int(os.environ.get(name, str(default)))
+    except ValueError:
+        v = default
+    return max(floor, v)
+
+
+def _env_float(name: str, default: float, floor: float) -> float:
+    try:
+        v = float(os.environ.get(name, str(default)))
+    except ValueError:
+        v = default
+    return max(floor, v)
+
+
+def _watchdog_enabled() -> bool:
+    """RAY_TPU_WATCHDOG gate, read once at head construction: when off
+    the watchdog object is never built and the scheduler loop's only
+    trace of it is one `is not None` check."""
+    return os.environ.get(
+        "RAY_TPU_WATCHDOG", "1").strip().lower() not in _FALSY
+
+
+class _Watchdog:
+    """Straggler / node-health detector (head-side).
+
+    Counterpart of the operational watchdogs TPU-pod training stacks
+    grow by necessity: at scale the dominant failures are not crashes
+    but tasks that silently run 10x longer than their siblings and
+    hosts whose reporters go quiet.  The detector compares each RUNNING
+    task's age against the completed-duration distribution of its
+    same-name siblings (percentile x multiplier threshold), and each
+    remote node's last stats report against a heartbeat timeout.
+    Verdicts land on the flight recorder's "health" lane and the
+    ray_tpu_stragglers_total / ray_tpu_node_unhealthy_total counters —
+    detection only, no automatic kills (the OOM killer owns policy).
+
+    Knobs: RAY_TPU_WATCHDOG (off switch), _INTERVAL_S (tick period,
+    default 5), _MIN_SAMPLES (sibling completions required, default 5),
+    _PERCENTILE (default 95), _MULTIPLIER (threshold factor, default
+    3), _MIN_AGE_S (never flag younger than this, default 1),
+    _HEARTBEAT_TIMEOUT_S (stale-reporter cutoff, default 30)."""
+
+    def __init__(self, server: "ControlServer"):
+        self.server = server
+        self.interval_s = _env_float(
+            "RAY_TPU_WATCHDOG_INTERVAL_S", 5.0, 0.05)
+        self.min_samples = _env_int(
+            "RAY_TPU_WATCHDOG_MIN_SAMPLES", 5, 1)
+        self.percentile = min(100.0, _env_float(
+            "RAY_TPU_WATCHDOG_PERCENTILE", 95.0, 1.0))
+        self.multiplier = _env_float(
+            "RAY_TPU_WATCHDOG_MULTIPLIER", 3.0, 1.0)
+        self.min_age_s = _env_float(
+            "RAY_TPU_WATCHDOG_MIN_AGE_S", 1.0, 0.0)
+        self.heartbeat_timeout_s = _env_float(
+            "RAY_TPU_WATCHDOG_HEARTBEAT_TIMEOUT_S", 30.0, 1.0)
+        self._last_tick = 0.0
+        self._flagged_tasks: Set[str] = set()  # flag once per task
+        self._unhealthy_nodes: Set[str] = set()
+        # Totals for /api/profile and tests (counters may be None when
+        # metrics failed to import).
+        self.stragglers_flagged = 0
+        self.nodes_flagged = 0
+
+    @staticmethod
+    def _percentile_of(sorted_vals: List[float], pct: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = int(len(sorted_vals) * pct / 100.0)
+        return sorted_vals[min(idx, len(sorted_vals) - 1)]
+
+    def maybe_tick(self) -> None:
+        now = time.time()
+        if now - self._last_tick < self.interval_s:
+            return
+        self._last_tick = now
+        try:
+            self.tick(now)
+        except Exception:
+            pass  # detection must never take down the scheduler
+
+    def tick(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        self._check_stragglers(now)
+        self._check_nodes(now)
+
+    def _check_stragglers(self, now: float) -> None:
+        srv = self.server
+        durations: Dict[str, List[float]] = {}
+        running: List[tuple] = []
+        with srv.lock:
+            for th, rec in srv.tasks.items():
+                name = rec.spec.name or \
+                    getattr(rec.spec, "func_id", "")[:8]
+                if rec.state == "FINISHED":
+                    start = rec.started_at or rec.received_at
+                    if start and rec.finished_at > start:
+                        durations.setdefault(name, []).append(
+                            rec.finished_at - start)
+                elif rec.state == "RUNNING" and \
+                        th not in self._flagged_tasks:
+                    start = rec.started_at or rec.received_at or \
+                        rec.submitted_at
+                    if start:
+                        running.append(
+                            (th, name, now - start, rec.worker_hex))
+        for sibs in durations.values():
+            sibs.sort()
+        from ray_tpu.util import flight_recorder
+
+        for th, name, age, worker_hex in running:
+            sibs = durations.get(name)
+            if sibs is None or len(sibs) < self.min_samples:
+                continue
+            threshold = max(
+                self.min_age_s,
+                self._percentile_of(sibs, self.percentile)
+                * self.multiplier)
+            if age <= threshold:
+                continue
+            self._flagged_tasks.add(th)
+            self.stragglers_flagged += 1
+            if srv._m_stragglers is not None:
+                srv._m_stragglers.inc()
+            flight_recorder.record(
+                "health", "straggler", task=th, name=name,
+                age_s=round(age, 3), threshold_s=round(threshold, 3),
+                siblings=len(sibs), worker=worker_hex)
+
+    def _check_nodes(self, now: float) -> None:
+        srv = self.server
+        stale: List[tuple] = []
+        recovered: List[str] = []
+        with srv.lock:
+            for nid, node in srv.nodes.items():
+                # Only remote nodes report via the wire; the head and
+                # logical (fake-cluster) nodes share this process.
+                if node.is_head or node.conn is None or not node.alive:
+                    continue
+                seen = node.stats_at
+                if seen and now - seen > self.heartbeat_timeout_s:
+                    if nid not in self._unhealthy_nodes:
+                        stale.append((nid, now - seen))
+                elif nid in self._unhealthy_nodes:
+                    recovered.append(nid)
+        from ray_tpu.util import flight_recorder
+
+        for nid, silent_s in stale:
+            self._unhealthy_nodes.add(nid)
+            self.nodes_flagged += 1
+            if srv._m_node_unhealthy is not None:
+                srv._m_node_unhealthy.inc()
+            flight_recorder.record(
+                "health", "node_unhealthy", node=nid,
+                silent_s=round(silent_s, 1),
+                timeout_s=self.heartbeat_timeout_s)
+        for nid in recovered:
+            self._unhealthy_nodes.discard(nid)
+            flight_recorder.record("health", "node_recovered", node=nid)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "enabled": True,
+            "interval_s": self.interval_s,
+            "stragglers_flagged": self.stragglers_flagged,
+            "nodes_flagged": self.nodes_flagged,
+            "unhealthy_nodes": sorted(self._unhealthy_nodes),
+        }
 
 
 class ControlServer:
@@ -376,11 +562,38 @@ class ControlServer:
             self._m_locality_hits = _m.Counter(
                 "ray_tpu_locality_hits_total",
                 "Tasks placed on a node already holding >=1 shm arg")
+            self._m_stragglers = _m.Counter(
+                "ray_tpu_stragglers_total",
+                "RUNNING tasks flagged as stragglers by the watchdog")
+            self._m_node_unhealthy = _m.Counter(
+                "ray_tpu_node_unhealthy_total",
+                "Nodes flagged unhealthy (stale heartbeat) by the "
+                "watchdog")
         except Exception:
             self._m_lease_grants = self._m_lease_denials = None
             self._m_lease_clamps = None
             self._m_task_events = self._m_task_event_frames = None
             self._m_locality_hits = None
+            self._m_stragglers = self._m_node_unhealthy = None
+
+        # Cluster span harvest state (collect_spans wire op): per-worker
+        # ring cursors persist across harvests so each pull ships only
+        # new spans, and harvested spans accumulate in a bounded,
+        # trace_id-indexed store the dashboard queries.
+        self._span_waiters: Dict[str, tuple] = {}  # token -> (Event, slot)
+        self._span_cursors: Dict[str, int] = {}  # worker_hex -> cursor
+        self._span_store: "deque" = deque(
+            maxlen=_env_int("RAY_TPU_SPAN_STORE_MAX", 200000, 1000))
+        self._span_seen: Set[str] = set()  # span ids in _span_store
+        self._span_missed = 0  # ring evictions that beat the harvest
+        self._span_lock = threading.Lock()
+        self._harvest_lock = threading.Lock()  # one harvest at a time
+        # Latest per-worker resource samples (profile_report deltas).
+        self._profiles: Dict[str, dict] = {}
+        # Straggler/health watchdog: constructed ONLY when enabled, so
+        # with RAY_TPU_WATCHDOG off the scheduler loop's gate is a
+        # single `is not None` check — today's hot path byte-for-byte.
+        self._watchdog = _Watchdog(self) if _watchdog_enabled() else None
 
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -759,6 +972,7 @@ class ControlServer:
             for n in self.nodes.values():
                 if n.conn is conn:
                     n.stats = msg.get("stats") or {}
+                    n.stats_at = time.time()  # watchdog heartbeat
                     return
 
     def _op_register_node(self, conn, msg):
@@ -1404,8 +1618,25 @@ class ControlServer:
             if entry is None:
                 return
             node = self._store_node_for(conn)
+            entry.pulling.pop(node, None)  # in-flight pull landed
             if node != entry.node_id:
                 entry.replicas.add(node)
+
+    def _op_object_pull_started(self, conn, msg):
+        """One-way announce from a PullManager leader: this node is
+        pulling the object.  The locality tie-break credits in-flight
+        destinations too (ROADMAP PR 3 follow-up) so a task chasing the
+        object lands where it is about to be, instead of triggering a
+        second transfer.  Entries are timestamps — _locality_bytes
+        ignores announcements older than the pull timeout (the pull
+        failed or the announce outlived its object)."""
+        with self.lock:
+            entry = self.objects.get(msg["obj"])
+            if entry is None:
+                return
+            node = self._store_node_for(conn)
+            if node != entry.node_id and node not in entry.replicas:
+                entry.pulling[node] = time.time()
 
     def _op_register_objects(self, conn, msg):
         """Pre-register return objects of direct (actor) tasks with one ref
@@ -3073,6 +3304,11 @@ class ControlServer:
                 self._sync_resource_view()
             except Exception:
                 pass
+            # Health watchdog: when disabled the object is None and
+            # this gate is the hot path's ONLY trace of it; when
+            # enabled, maybe_tick self-rate-limits to its interval.
+            if self._watchdog is not None:
+                self._watchdog.maybe_tick()
 
     # -- resource-view sync (N8; reference common/ray_syncer/ -----------
     # ray_syncer.h:88 RESOURCE_VIEW stream).  The head is the view's
@@ -3163,13 +3399,24 @@ class ControlServer:
         locality_data_provider in lease_policy.cc).  Inline and
         still-pending args contribute nothing."""
         out: Dict[str, int] = {}
+        now = time.time()
         for arg in getattr(spec, "args", ()):
             if not getattr(arg, "is_ref", False):
                 continue
             entry = self.objects.get(arg.object_hex)
             if entry is None or entry.state != READY or not entry.in_shm:
                 continue
-            for nid in {entry.node_id, *entry.replicas}:
+            locs = {entry.node_id, *entry.replicas}
+            if entry.pulling:
+                # Credit in-flight pull destinations too (the transfer
+                # will land before or with the task); drop announces
+                # older than the pull deadline — that pull failed.
+                stale = [nid for nid, ts in entry.pulling.items()
+                         if now - ts > 150.0]
+                for nid in stale:
+                    del entry.pulling[nid]
+                locs.update(entry.pulling)
+            for nid in locs:
                 out[nid] = out.get(nid, 0) + entry.size
         return out
 
@@ -3895,6 +4142,188 @@ class ControlServer:
             deferred, timer = entry
             timer.cancel()  # don't park a thread for the full timeout
             deferred.resolve(msg.get("data"))
+
+    # ------------------------------------------------------------------
+    # Cluster-wide span harvest (collect_spans wire op): the head pulls
+    # each worker's bounded span ring incrementally — per-worker cursors
+    # persist across harvests, each reply is capped so a 100k ring
+    # streams out as many small frames — and accumulates the result in
+    # a bounded trace_id-queryable store (the /api/spans and /api/trace
+    # backing data).
+    def _op_harvest_spans(self, conn, msg):
+        """Harvest every live worker's ring, then return matching spans.
+        Runs on its own thread behind a Deferred: the multi-round
+        pull protocol must not park the caller's connection thread."""
+        deferred = rpc.Deferred()
+
+        def run():
+            try:
+                deferred.resolve(self._harvest_spans_sync(msg))
+            except Exception as e:  # noqa: BLE001
+                deferred.reject(e)
+
+        threading.Thread(target=run, name="span-harvest",
+                         daemon=True).start()
+        return deferred
+
+    def _harvest_spans_sync(self, msg) -> Dict[str, Any]:
+        timeout_s = float(msg.get("timeout_s", 0) or 10.0)
+        deadline = time.monotonic() + timeout_s
+        with self._harvest_lock:  # serialize: cursors are shared state
+            polled = self._harvest_all_workers(deadline)
+        trace_id = msg.get("trace_id") or ""
+        max_spans = int(msg.get("max_spans", 0) or 0)
+        with self._span_lock:
+            missed = self._span_missed
+            if not trace_id and max_spans > 0:
+                # Bounded tail without copying the whole store — the
+                # 1 Hz-poller shape, where reply size is the cost.
+                start = max(0, len(self._span_store) - max_spans)
+                rows = list(itertools.islice(
+                    self._span_store, start, len(self._span_store)))
+            else:
+                rows = list(self._span_store)
+        if trace_id:
+            rows = [r for r in rows if r[2] == trace_id]
+        if max_spans > 0:
+            rows = rows[-max_spans:]
+        # The store keeps compact collect_spans rows; only the reply —
+        # already bounded — pays for dict expansion.
+        from ray_tpu.util.tracing import span_row_to_dict
+
+        spans = [span_row_to_dict(r) for r in rows]
+        return {"spans": spans, "workers_polled": polled,
+                "missed": missed}
+
+    def _harvest_all_workers(self, deadline: float) -> int:
+        limit = _env_int("RAY_TPU_SPAN_HARVEST_CHUNK", 2048, 16)
+        with self.lock:
+            targets = [(wh, w.conn) for wh, w in self.workers.items()
+                       if w.conn is not None and w.state != "dead"]
+        polled = 0
+        for worker_hex, wconn in targets:
+            try:
+                if self._harvest_one_worker(worker_hex, wconn, limit,
+                                            deadline):
+                    polled += 1
+            except Exception:
+                continue  # worker died mid-harvest; others still count
+        return polled
+
+    def _harvest_one_worker(self, worker_hex: str, wconn, limit: int,
+                            deadline: float) -> bool:
+        cursor = self._span_cursors.get(worker_hex, 0)
+        replied = False
+        # Per-sweep work bound: a worker emitting spans faster than the
+        # sweep cadence can drain them must not turn one harvest into an
+        # unbounded pull — the cursor persists, the next sweep continues
+        # where this one stopped, and if the ring laps the cursor in the
+        # meantime the worker reports it as `missed` (graceful data loss
+        # over unbounded harvest CPU).
+        max_chunks = _env_int("RAY_TPU_SPAN_HARVEST_MAX_CHUNKS", 8, 1)
+        rounds = 0
+        while rounds < max_chunks:
+            rounds += 1
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            token = uuid.uuid4().hex
+            ev = threading.Event()
+            slot: Dict[str, Any] = {}
+            # Register BEFORE the push (profile-waiter discipline).
+            self._span_waiters[token] = (ev, slot)
+            try:
+                wconn.push({"op": "collect_spans", "token": token,
+                            "cursor": cursor, "limit": limit})
+            except Exception:
+                self._span_waiters.pop(token, None)
+                break
+            if not ev.wait(timeout=min(remaining, 5.0)):
+                self._span_waiters.pop(token, None)
+                break
+            reply = slot.get("msg") or {}
+            replied = True
+            cursor = int(reply.get("cursor", cursor) or 0)
+            rows = reply.get("rows") or []
+            self._ingest_spans(worker_hex, reply, rows)
+            if len(rows) < limit:
+                break  # ring drained
+        self._span_cursors[worker_hex] = cursor
+        return replied
+
+    def _ingest_spans(self, worker_hex: str, reply: dict,
+                      rows: List[list]) -> None:
+        """Fold one collect_spans reply into the store, keeping the
+        compact row form — (span_id, parent_id, trace_id, name, start,
+        end, attrs, worker, pid) — so a high-rate harvest costs list
+        appends, not 7-key dict builds per span (expansion is deferred
+        to the bounded _harvest_spans_sync reply)."""
+        pid = int(reply.get("pid") or 0)
+        missed = int(reply.get("missed") or 0)
+        with self._span_lock:
+            for r in rows:
+                sid = r[0]
+                if sid in self._span_seen:
+                    continue
+                r.append(worker_hex)
+                r.append(pid)
+                if len(self._span_store) == self._span_store.maxlen \
+                        and self._span_store:
+                    self._span_seen.discard(self._span_store[0][0])
+                self._span_seen.add(sid)
+                self._span_store.append(r)
+            if missed:
+                self._span_missed += missed
+
+    def _op_collect_spans_result(self, conn, msg):
+        """One-way reply from a worker's collect_spans push: hand the
+        payload to the waiting harvest round by token."""
+        entry = self._span_waiters.pop(msg.get("token"), None)
+        if entry is not None:
+            ev, slot = entry
+            slot["msg"] = msg
+            ev.set()
+
+    # ------------------------------------------------------------------
+    # Per-worker resource profiling (profile_report deltas riding the
+    # coalescing flusher) + watchdog introspection.
+    def _op_profile_report(self, conn, msg):
+        sample = msg.get("sample") or {}
+        whex = sample.get("worker") or \
+            getattr(conn, "meta", {}).get("worker_hex", "")
+        if whex:
+            with self.lock:
+                self._profiles[whex] = sample
+
+    def _op_get_profile(self, conn, msg):
+        with self.lock:
+            profiles = {wh: s for wh, s in self._profiles.items()
+                        if wh in self.workers
+                        and self.workers[wh].state != "dead"}
+        wd = (self._watchdog.snapshot() if self._watchdog is not None
+              else {"enabled": False})
+        return {"workers": profiles, "watchdog": wd}
+
+    def _op_set_profile_config(self, conn, msg):
+        """Retune every live worker's resource sampler at runtime (the
+        bench's A/B switch; also an operator knob for incident-time
+        high-frequency sampling)."""
+        cfg: Dict[str, Any] = {"op": "profile_config"}
+        if msg.get("enabled") is not None:
+            cfg["enabled"] = bool(msg["enabled"])
+        if msg.get("interval_s") is not None:
+            cfg["interval_s"] = float(msg["interval_s"])
+        with self.lock:
+            conns = [w.conn for w in self.workers.values()
+                     if w.conn is not None and w.state != "dead"]
+        notified = 0
+        for c in conns:
+            try:
+                c.push(dict(cfg))
+                notified += 1
+            except Exception:
+                pass
+        return {"notified": notified}
 
     def _op_get_runtime_env(self, conn, msg):
         with self.lock:

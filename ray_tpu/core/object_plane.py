@@ -38,6 +38,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core.ids import ObjectID
@@ -392,6 +393,8 @@ class PushManager:
 
     def _push_one_inner(self, addr: str, obj_hex: str, size: int, seg,
                         timeout: float, budget) -> str:
+        from ray_tpu.core import rpc
+
         conn = self._rt._node_conn(addr)
         begin = conn.call({"op": "push_begin", "obj": obj_hex,
                            "size": size}, timeout=30.0)
@@ -399,25 +402,36 @@ class PushManager:
             return "have"
         if begin.get("reject"):
             return f"reject: {begin['reject']}"
-        off = 0
         deadline = time.monotonic() + timeout
+        window = rpc.pull_window()
+        if window <= 1:
+            self._stream_legacy(conn, addr, obj_hex, size, seg, budget,
+                                deadline)
+        else:
+            self._stream_windowed(conn, addr, obj_hex, size, seg,
+                                  budget, deadline, timeout, window)
+        reply = conn.call({"op": "push_end", "obj": obj_hex},
+                          timeout=timeout)
+        if not (reply or {}).get("ok"):
+            return f"error: {(reply or {}).get('error', 'push_end failed')}"
+        return "ok"
+
+    def _stream_legacy(self, conn, addr: str, obj_hex: str, size: int,
+                       seg, budget, deadline: float) -> None:
+        """RAY_TPU_PULL_WINDOW=1: the legacy wire byte for byte —
+        ONE-WAY chunk frames, serialized by the blocking send.  The TCP
+        stream orders chunks, a blocking send applies receiver
+        backpressure, and push_end's byte-count check catches any
+        loss.  wait=True keeps the budget accounting honest under rpc
+        coalescing (the slot must not be released while the chunk still
+        sits in the send buffer)."""
+        off = 0
         while off < size:
             n = min(self.chunk_bytes, size - off)
             budget.acquire()
             try:
                 if time.monotonic() > deadline:
                     raise TimeoutError(f"broadcast to {addr} timed out")
-                # ONE-WAY chunk frames: a synchronous call per chunk
-                # costs two scheduler round trips, which on small hosts
-                # dominates the transfer (~130 ms per 8 MB measured
-                # single-core).  The TCP stream orders chunks, a
-                # blocking send applies receiver backpressure, and
-                # push_end's byte-count check catches any loss.  The
-                # budget bounds bytes handed to the kernel across all
-                # destinations — wait=True keeps the accounting honest
-                # under rpc coalescing (the budget slot must not be
-                # released while the chunk still sits in the send
-                # buffer).
                 conn.send({"op": "push_chunk", "obj": obj_hex,
                            "offset": off,
                            "data": bytes(seg.buf[off:off + n])},
@@ -425,11 +439,60 @@ class PushManager:
             finally:
                 budget.release()
             off += n
-        reply = conn.call({"op": "push_end", "obj": obj_hex},
-                          timeout=timeout)
-        if not (reply or {}).get("ok"):
-            return f"error: {(reply or {}).get('error', 'push_end failed')}"
-        return "ok"
+
+    def _stream_windowed(self, conn, addr: str, obj_hex: str,
+                         size: int, seg, budget, deadline: float,
+                         timeout: float, window: int) -> None:
+        """Windowed chunk pipeline, mirroring rpc.pull_object_chunked:
+        up to `window` push_chunk call_asyncs stay in flight, so the
+        peer writes chunk k while chunk k+1 rides the wire — one
+        round-trip TOTAL of pipeline fill instead of one serialized
+        send per chunk.  The per-destination budget still bounds
+        in-flight bytes: a slot is held from issue until the peer's
+        ack, and acquire(blocking=False) can only fail while our own
+        chunks hold slots, so popping the oldest ack always makes
+        progress (no deadlock)."""
+        inflight: deque = deque()  # (offset, pending call)
+        off = 0
+        try:
+            while inflight or off < size:
+                while off < size and len(inflight) < window \
+                        and budget.acquire(blocking=False):
+                    if time.monotonic() > deadline:
+                        budget.release()
+                        raise TimeoutError(
+                            f"broadcast to {addr} timed out")
+                    n = min(self.chunk_bytes, size - off)
+                    try:
+                        pending = conn.call_async(
+                            {"op": "push_chunk", "obj": obj_hex,
+                             "offset": off,
+                             "data": bytes(seg.buf[off:off + n])})
+                    except BaseException:
+                        budget.release()
+                        raise
+                    inflight.append((off, pending))
+                    off += n
+                _chunk_off, pending = inflight.popleft()
+                try:
+                    reply = pending.result(
+                        timeout=max(0.1, min(
+                            timeout, deadline - time.monotonic())))
+                finally:
+                    budget.release()
+                if reply is not None and reply.get("ok") is False:
+                    raise RuntimeError(
+                        f"peer rejected chunk at {_chunk_off}")
+        except BaseException:
+            # Abandon outstanding requests (late acks are dropped by
+            # the recv loop) and give their budget slots back.
+            while inflight:
+                _o, pending = inflight.popleft()
+                try:
+                    pending.discard()
+                finally:
+                    budget.release()
+            raise
 
 
 def broadcast_object(ref, node_ids: Optional[List[str]] = None, *,

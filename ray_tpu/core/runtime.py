@@ -41,17 +41,22 @@ _runtime_lock = threading.Lock()
 _tracing = None
 
 
+def _get_tracing():
+    global _tracing
+    if _tracing is None:
+        from ray_tpu.util import tracing
+
+        _tracing = tracing
+    return _tracing
+
+
 def _make_trace_ctx():
     """Current (trace_id, parent span_id) to ride the outgoing TaskSpec,
     or None when nothing is being traced (nothing on the wire)."""
-    global _tracing
-    if _tracing is None:
-        try:
-            from ray_tpu.util import tracing
-        except Exception:
-            return None
-        _tracing = tracing
-    return _tracing.make_trace_ctx()
+    try:
+        return _get_tracing().make_trace_ctx()
+    except Exception:
+        return None
 
 
 def _is_missing_segment_error(e: Exception) -> bool:
@@ -194,6 +199,13 @@ class CoreClient:
         self._tls = threading.local()
         self._object_futures: Dict[str, Future] = {}
         self._subscribed: set[str] = set()
+        # Worker resource-sampler config, shared with the sampler thread
+        # (worker.py _profile_sampler_loop) and retunable at runtime by
+        # a head "profile_config" push (set_profile_config op).  The
+        # event wakes the sampler out of its interval sleep so a toggle
+        # takes effect immediately (bench A/B windows).
+        self.profile_config: Dict[str, Any] = {}
+        self.profile_config_ev = threading.Event()
         # Hexes whose future has resolved — maintained by done-callbacks
         # so wait() is a set-membership check + condition wait instead
         # of an O(n) future-lock scan per call.
@@ -401,8 +413,48 @@ class CoreClient:
             # the push thread; the worker keeps executing its task.
             threading.Thread(target=self._run_profile, args=(msg,),
                              name="profile", daemon=True).start()
+        elif op == "collect_spans":
+            # Cluster span harvest (gcs._op_harvest_spans): serve off
+            # the push thread — serializing a 2048-span chunk inline
+            # would stall task dispatch/result traffic behind it on a
+            # busy process.  The reply is one-way; the head matches it
+            # to its waiter by token (profile_result pattern), and it
+            # never issues the next chunk request until this reply
+            # lands, so off-thread serving can't reorder chunks.
+            threading.Thread(target=self._serve_collect_spans,
+                             args=(msg,), name="collect-spans",
+                             daemon=True).start()
+        elif op == "profile_config":
+            # Head retuning every worker's resource sampler at runtime
+            # (set_profile_config): just update shared state — the
+            # sampler thread (worker.py) re-reads it each wakeup.
+            cfg = self.profile_config
+            if msg.get("enabled") is not None:
+                cfg["enabled"] = bool(msg["enabled"])
+            if msg.get("interval_s") is not None:
+                try:
+                    cfg["interval_s"] = max(0.05, float(msg["interval_s"]))
+                except (TypeError, ValueError):
+                    pass
+            self.profile_config_ev.set()
         elif op == "exit" and self.on_exit is not None:
             self.on_exit()
+
+    def _serve_collect_spans(self, msg: dict):
+        try:
+            out = _get_tracing().collect_spans_since(
+                int(msg.get("cursor", 0) or 0),
+                max_spans=int(msg.get("limit", 2048) or 2048))
+        except Exception:
+            out = {"rows": [], "cursor": 0, "missed": 0}
+        try:
+            self.client.send({
+                "op": "collect_spans_result", "token": msg.get("token"),
+                "cursor": out["cursor"], "rows": out["rows"],
+                "missed": out["missed"], "pid": os.getpid(),
+                "worker": self.worker_hex})
+        except Exception:
+            pass
 
     def _run_profile(self, msg: dict):
         kind = msg.get("kind", "stack")
@@ -1364,6 +1416,17 @@ class CoreClient:
         (object_plane.PullManager)."""
 
         def _do_pull():
+            # One-way announce BEFORE the transfer: the head credits
+            # this node in the locality tie-break while the pull is in
+            # flight (gcs._locality_bytes "pulling" credit), so a task
+            # chasing this object can land here instead of triggering a
+            # second transfer elsewhere.  Best-effort; the
+            # object_replica announce below supersedes it on landing.
+            try:
+                self.client.send(
+                    {"op": "object_pull_started", "obj": obj_hex})
+            except Exception:
+                pass
             size = info["size"]
             addr = info.get("addr", "")
             client = self._node_conn(addr) if addr else self.client
@@ -2120,6 +2183,12 @@ class CoreClient:
                         cur.update(ev)
                 msg = {"op": "task_events",
                        "events": [merged[t] for t in order]}
+            elif kind == "profile_report":
+                # Resource samples are point-in-time state, not deltas:
+                # a backlogged run collapses to the NEWEST sample (one
+                # flusher per worker, so within-run order is sample
+                # order and latest wins).
+                msg = {"op": "profile_report", "sample": run[-1]}
             elif kind == "put":
                 msg = run[0] if len(run) == 1 else \
                     {"op": "put_object_batch", "items": run}

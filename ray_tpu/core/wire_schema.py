@@ -86,6 +86,27 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     "release_lease": {"workers": "list"},
     "kill_worker": {"worker": "str"},
     "task_events": {"events": "list"},
+    # -- observability: span harvest / profiling / watchdog ------------
+    # Head→worker pull of the worker's bounded span ring, cursor-based
+    # and capped per reply (gcs._op_harvest_spans ↔ runtime._on_push).
+    "collect_spans": {"token": "str", "cursor": "int", "limit": "int"},
+    "collect_spans_result": {"token": "str", "cursor": "int",
+                             "rows": "list", "missed": "int?",
+                             "pid": "int?", "worker": "str?"},
+    # Client→head: harvest every worker's ring (incremental, merged by
+    # trace_id on the head) and return matching spans.
+    "harvest_spans": {"trace_id": "str?", "max_spans": "int?",
+                      "timeout_s": "float?"},
+    # Worker→head resource sample; rides the coalescing flusher
+    # (runtime._head_frames collapses a run to the newest sample).
+    "profile_report": {"sample": "dict"},
+    "get_profile": {},
+    # Client→head: retune/toggle every worker's sampler at runtime
+    # (bench_profiling.py's A/B switch).
+    "set_profile_config": {"enabled": "bool?", "interval_s": "float?"},
+    # One-way announce that a PullManager leader started pulling an
+    # object to this node (locality tie-break credit in gcs._pick_node).
+    "object_pull_started": {"obj": "str"},
     # -- functions -----------------------------------------------------
     "put_func": {"func_id": "str", "blob": "bytes"},
     "get_func": {"func_id": "str"},

@@ -122,6 +122,89 @@ class WorkerRuntime:
                 finally:
                     os._exit(1)
         self.core.client.send({"op": "worker_online"})
+        # Low-frequency resource sampler: CPU %, RSS, arena usage and
+        # queue depths, shipped as profile_report deltas on the
+        # coalescing flusher (runtime._head_frames keeps only the
+        # newest sample of a backlogged run).  Head-retunable via the
+        # profile_config push; RAY_TPU_PROFILE_SAMPLER=0 disables.
+        threading.Thread(target=self._profile_sampler_loop,
+                         name="profile-sampler", daemon=True).start()
+
+    # -- per-worker resource profiling ---------------------------------
+    def _profile_sampler_loop(self):
+        from ray_tpu.core.memory_monitor import system_memory
+
+        cfg = self.core.profile_config
+        cfg.setdefault("enabled", os.environ.get(
+            "RAY_TPU_PROFILE_SAMPLER", "1").strip().lower()
+            not in ("0", "false", "no", "off"))
+        try:
+            interval = float(os.environ.get(
+                "RAY_TPU_PROFILE_SAMPLE_INTERVAL_S", "5"))
+        except ValueError:
+            interval = 5.0
+        cfg.setdefault("interval_s", max(0.05, interval))
+        ev = self.core.profile_config_ev
+        try:
+            ticks = os.sysconf("SC_CLK_TCK") or 100
+            page = os.sysconf("SC_PAGE_SIZE") or 4096
+        except (ValueError, OSError, AttributeError):
+            ticks, page = 100, 4096
+        last_cpu_s = last_t = None
+        while not self._exit_ev.is_set():
+            ev.wait(timeout=float(cfg.get("interval_s", 5.0)))
+            ev.clear()
+            if self._exit_ev.is_set():
+                return
+            if not cfg.get("enabled", True):
+                last_cpu_s = last_t = None  # stale CPU deltas on resume
+                continue
+            try:
+                sample, last_cpu_s, last_t = self._profile_sample(
+                    ticks, page, system_memory, last_cpu_s, last_t)
+                self.core._queue_for_flush("profile_report", None, sample)
+            except Exception:
+                pass  # sampling must never hurt the worker
+
+    def _profile_sample(self, ticks, page, system_memory,
+                        last_cpu_s, last_t):
+        now = time.monotonic()
+        cpu_s = 0.0
+        rss = 0
+        try:
+            with open("/proc/self/stat") as f:
+                # utime/stime are fields 14/15; split after the ")" that
+                # closes comm (which may itself contain spaces).
+                parts = f.read().rsplit(")", 1)[1].split()
+            cpu_s = (int(parts[11]) + int(parts[12])) / ticks
+        except (OSError, ValueError, IndexError):
+            pass
+        try:
+            with open("/proc/self/statm") as f:
+                rss = int(f.read().split()[1]) * page
+        except (OSError, ValueError, IndexError):
+            pass
+        cpu_pct = 0.0
+        if last_t is not None and now > last_t:
+            cpu_pct = max(
+                0.0, 100.0 * (cpu_s - last_cpu_s) / (now - last_t))
+        cap, used, nobj, _evicted = self.core.store.stats()
+        avail, total = system_memory()
+        pool_q = getattr(self, "_pool_queue", None)
+        sample = {
+            "ts": time.time(), "pid": os.getpid(),
+            "worker": self.core.worker_hex,
+            "cpu_percent": round(cpu_pct, 2),
+            "rss_bytes": rss,
+            "mem_available_bytes": avail,
+            "mem_total_bytes": total,
+            "arena_used_bytes": used,
+            "arena_capacity_bytes": cap,
+            "arena_objects": nobj,
+            "queue_depth": self._task_queue.qsize() + (
+                pool_q.qsize() if pool_q is not None else 0),
+        }
+        return sample, cpu_s, now
 
     # -- runtime facade (same surface the driver runtime exposes) -------
     def get(self, refs, timeout=None):

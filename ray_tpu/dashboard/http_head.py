@@ -11,8 +11,13 @@ Routes (all GET unless noted):
   /api/summary/tasks|actors|objects  -> aggregated counts
   /api/node_stats          -> per-node host stats (reporter agents)
   /api/timeline?max_tasks= -> chrome trace (uniformly sampled at scale)
-  /api/trace?max_tasks=    -> unified chrome trace (spans + tasks +
-                              wire/scheduler flight-recorder lanes)
+  /api/trace?max_tasks=    -> unified chrome trace (driver + HARVESTED
+                              worker spans + tasks + wire/scheduler
+                              flight-recorder lanes); ?harvest=0 skips
+                              the cluster span harvest
+  /api/spans?trace_id=&max_spans= -> harvested cluster spans as JSON
+  /api/profile             -> latest per-worker resource samples +
+                              watchdog state
   /api/flight_recorder?last= -> recent wire/scheduler events + ring stats
   /api/workers/<hex>/profile?kind=stack|jax_trace&duration_s=
   /api/cluster_resources   /api/available_resources
@@ -186,9 +191,33 @@ class Dashboard:
         if parsed.path == "/api/trace":
             # The unified trace: driver spans + task/scheduling lanes +
             # wire/scheduler flight-recorder lanes, one chrome-trace
-            # event list (util/tracing.py trace_events).
+            # event list (util/tracing.py trace_events) — plus every
+            # worker's harvested spans folded onto the workers' own
+            # pid lanes, so ONE Perfetto file shows the driver→worker→
+            # nested-task chain stitched by trace ids.
             from ray_tpu.util.tracing import trace_events
-            return trace_events(rt, max_tasks=int(qs.get("max_tasks", 0)))
+            events = trace_events(
+                rt, max_tasks=int(qs.get("max_tasks", 0)))
+            if qs.get("harvest", "1").strip().lower() not in (
+                    "0", "false", "no", "off"):
+                events.extend(self._harvested_span_events(rt))
+            return events
+        if parsed.path == "/api/spans":
+            # Harvested cluster spans as queryable JSON (same data the
+            # /api/trace fold renders): pulls every worker's span ring
+            # through the head first, then filters by trace_id.
+            req = {"op": "harvest_spans"}
+            if qs.get("trace_id"):
+                req["trace_id"] = qs["trace_id"]
+            if qs.get("max_spans"):
+                req["max_spans"] = int(qs["max_spans"])
+            if qs.get("timeout_s"):
+                req["timeout_s"] = float(qs["timeout_s"])
+            return rt.core.client.call(req)
+        if parsed.path == "/api/profile":
+            # Latest per-worker resource samples (profile_report
+            # deltas) + watchdog verdict counters.
+            return rt.core.client.call({"op": "get_profile"})
         if parsed.path == "/api/flight_recorder":
             from ray_tpu.util import flight_recorder
             out = {"events": flight_recorder.dump(
@@ -249,6 +278,36 @@ class Dashboard:
                 "value": payload, "overwrite": True})
             return {"status": "ok", "key": key}
         raise KeyError(path)
+
+    @staticmethod
+    def _harvested_span_events(rt):
+        """Cluster span harvest folded into the unified trace: every
+        worker's spans render on that worker's OS-pid lane, lining up
+        with its execution slices (util/timeline.py pid convention).
+        This process's own spans are skipped — trace_events already
+        rendered them on the pid-1 driver lane."""
+        from ray_tpu.util.tracing import spans_to_chrome_events
+
+        try:
+            out = rt.core.client.call(
+                {"op": "harvest_spans", "timeout_s": 10.0}) or {}
+        except Exception:
+            return []
+        own = rt.core.worker_hex
+        by_lane: dict = {}
+        for s in out.get("spans", []):
+            if s.get("worker") == own:
+                continue
+            pid = int(s.get("pid") or 0)
+            by_lane.setdefault((pid, s.get("worker", "")),
+                               []).append(s)
+        events = []
+        for (pid, whex), spans in sorted(by_lane.items()):
+            events.extend(spans_to_chrome_events(
+                spans, pid=pid or 1,
+                process_name=f"worker spans {whex[:8]}",
+                sort_index=pid or 1))
+        return events
 
     def _jobs(self):
         from ray_tpu.job import JobSubmissionClient

@@ -276,6 +276,44 @@ def local_snapshots() -> List[dict]:
         snaps.extend(object_plane.object_metric_snapshots())
     except Exception:
         pass
+    # Silent-drop visibility: the tracing ring and flight recorder both
+    # evict oldest-first without logging — surface eviction counts and
+    # ring occupancy so a truncated trace is diagnosable from /metrics
+    # instead of a mystery.
+    try:
+        from ray_tpu.util import tracing as _tr
+
+        snaps.append({
+            "name": "ray_tpu_trace_dropped_spans_total",
+            "kind": "counter",
+            "description": "Spans evicted from this process's bounded "
+                           "trace ring",
+            "series": {(): float(_tr.dropped_span_count())}})
+    except Exception:
+        pass
+    try:
+        from ray_tpu.util import flight_recorder as _fr
+
+        st = _fr.stats()
+        snaps.append({
+            "name": "ray_tpu_flight_recorder_events",
+            "kind": "gauge",
+            "description": "Events currently in the flight-recorder "
+                           "ring",
+            "series": {(): float(st["events"])}})
+        snaps.append({
+            "name": "ray_tpu_flight_recorder_capacity",
+            "kind": "gauge",
+            "description": "Flight-recorder ring capacity",
+            "series": {(): float(st["capacity"])}})
+        snaps.append({
+            "name": "ray_tpu_flight_recorder_dropped_total",
+            "kind": "counter",
+            "description": "Events evicted from the flight-recorder "
+                           "ring",
+            "series": {(): float(st["dropped"])}})
+    except Exception:
+        pass
     return snaps
 
 

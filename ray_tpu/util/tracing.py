@@ -40,6 +40,12 @@ from typing import Any, Dict, List, Optional, Tuple
 _enabled = False
 _spans_lock = threading.Lock()
 _dropped_spans = 0
+# Monotonic count of spans EVER appended to the ring (never reset by
+# eviction).  Gives every ring slot an implicit sequence number —
+# slot i holds seq (_seq_end - len(_spans) + i) — which is what lets
+# the cluster span harvest (gcs._op_harvest_spans) pull incrementally
+# with a plain integer cursor instead of re-shipping the whole ring.
+_seq_end = 0
 _local = threading.local()
 
 # Execution-side trace context restored from an incoming TaskSpec:
@@ -147,11 +153,12 @@ def make_trace_ctx() -> Optional[Tuple[str, str]]:
 # allocation-heavy burst in the recording process.  get_spans()
 # materializes the dict view.
 def _append_span(span: tuple) -> None:
-    global _dropped_spans
+    global _dropped_spans, _seq_end
     with _spans_lock:
         if len(_spans) == _spans.maxlen:
             _dropped_spans += 1
         _spans.append(span)
+        _seq_end += 1
 
 
 def record_span(name: str, start: float, end: float,
@@ -245,10 +252,68 @@ def get_spans() -> List[Dict[str, Any]]:
 
 
 def clear_spans() -> None:
-    global _dropped_spans
+    global _dropped_spans, _seq_end
     with _spans_lock:
         _spans.clear()
         _dropped_spans = 0
+        _seq_end = 0
+
+
+def span_cursor() -> int:
+    """The cursor one past the newest recorded span (total spans ever
+    appended).  A harvester holding this value and calling
+    collect_spans_since(cursor) later gets exactly the spans recorded
+    in between."""
+    with _spans_lock:
+        return _seq_end
+
+
+def collect_spans_since(cursor: int, max_spans: int = 2048
+                        ) -> Dict[str, Any]:
+    """Incremental, bounded read of the span ring for the cluster-wide
+    harvest (the collect_spans wire op).
+
+    Returns {"rows": [...], "cursor": next_cursor, "missed": n} where
+    `missed` counts spans that were evicted from the ring before this
+    read could see them (cursor fell behind by more than the ring
+    capacity).  Rows are the raw ring tuples — (span_id, parent_id,
+    trace_id, name, start, end, attributes|None) — NOT expanded into
+    keyed dicts: at harvest rates the dict keys dominate the JSON frame
+    (7 key strings per span), so the wire carries the compact form and
+    only query replies (gcs._harvest_spans_sync) pay for dict
+    expansion.  At most `max_spans` rows are returned per call so a
+    full 100k-span ring streams out as many small frames, never one
+    giant reply; callers loop until len(rows) < max_spans."""
+    max_spans = max(1, int(max_spans))
+    with _spans_lock:
+        start_seq = _seq_end - len(_spans)
+        cursor = max(0, int(cursor))
+        missed = max(0, start_seq - cursor)
+        skip = max(0, cursor - start_seq)
+        avail = len(_spans) - skip
+        if avail <= 0:
+            return {"rows": [], "cursor": _seq_end, "missed": missed}
+        n = min(avail, max_spans)
+        # deque slicing via itertools-free index walk: islice would be
+        # O(skip) anyway; a list() copy of the window keeps the lock
+        # window short for typical (small) harvest chunks.
+        rows = [list(_spans[skip + i]) for i in range(n)]
+        new_cursor = start_seq + skip + n
+    return {"rows": rows, "cursor": new_cursor, "missed": missed}
+
+
+def span_row_to_dict(row) -> Dict[str, Any]:
+    """Expand a collect_spans_since row (optionally extended with
+    worker/pid by the head's ingest) into the keyed span dict the
+    /api/spans and /api/trace surfaces serve."""
+    s = {"span_id": row[0], "parent_id": row[1], "trace_id": row[2],
+         "name": row[3], "start": row[4], "end": row[5],
+         "attributes": {} if row[6] is None else row[6]}
+    if len(row) > 7 and row[7]:
+        s["worker"] = row[7]
+    if len(row) > 8 and row[8]:
+        s["pid"] = row[8]
+    return s
 
 
 def dropped_span_count() -> int:
@@ -257,13 +322,18 @@ def dropped_span_count() -> int:
         return _dropped_spans
 
 
-def spans_to_chrome_events(spans: List[Dict[str, Any]]
-                           ) -> List[Dict[str, Any]]:
+def spans_to_chrome_events(spans: List[Dict[str, Any]], pid: int = 1,
+                           process_name: str = "driver spans",
+                           sort_index: int = 1) -> List[Dict[str, Any]]:
+    """Spans as chrome-trace X slices on one process lane.  Defaults
+    keep the historical driver lane (pid 1); the dashboard passes each
+    harvested worker's real OS pid so its spans land on the same row as
+    that worker's execution slices (util/timeline.py convention)."""
     events = []
     for s in spans:
         events.append({
             "cat": "span", "name": s["name"], "ph": "X",
-            "pid": 1, "tid": 0,
+            "pid": pid, "tid": 0,
             "ts": s["start"] * 1e6,
             "dur": max(0.0, s["end"] - s["start"]) * 1e6,
             "args": {**s["attributes"], "span_id": s["span_id"],
@@ -271,10 +341,11 @@ def spans_to_chrome_events(spans: List[Dict[str, Any]]
                      "trace_id": s.get("trace_id", "")},
         })
     if events:
-        events.append({"ph": "M", "pid": 1, "name": "process_name",
-                       "args": {"name": "driver spans"}})
-        events.append({"ph": "M", "pid": 1, "name": "process_sort_index",
-                       "args": {"sort_index": 1}})
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": process_name}})
+        events.append({"ph": "M", "pid": pid,
+                       "name": "process_sort_index",
+                       "args": {"sort_index": sort_index}})
     return events
 
 
