@@ -1,0 +1,44 @@
+"""raylint — ray_tpu's AST-based static-analysis suite.
+
+Four passes over ray_tpu/, scripts/ and tests/, one runner
+(``python -m ray_tpu.analysis`` or ``scripts/raylint.py``):
+
+  knobs        every RAY_TPU_* env knob is registered in
+               core/knobs.py, documented in README, and actually read
+  except       no new silently-swallowed exceptions
+  blocking     nothing blocking reachable from the RPC receive path or
+               inside a ``with lock:`` body
+  conformance  wire ops <-> wire_schema and metric names <-> README,
+               both directions, plus golden-corpus freshness
+
+Violations predating a rule are frozen in ``analysis/baseline.json``;
+new ones fail the build unless the line carries
+``# raylint: allow-<family>(<reason>)``.  See README "Static analysis".
+"""
+
+from ray_tpu.analysis.core import (  # noqa: F401
+    Violation,
+    apply_filters,
+    build_baseline,
+    load_baseline,
+    save_baseline,
+)
+
+__all__ = [
+    "Violation",
+    "apply_filters",
+    "build_baseline",
+    "load_baseline",
+    "save_baseline",
+    "run_passes",
+]
+
+
+def run_passes(root, passes=None):
+    """Run the named passes (default: all) against a repo root; returns
+    the raw (unfiltered) violation list."""
+    from ray_tpu.analysis.__main__ import PASSES
+    out = []
+    for name in (passes or list(PASSES)):
+        out.extend(PASSES[name](root))
+    return out

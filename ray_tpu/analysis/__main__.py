@@ -1,0 +1,118 @@
+"""raylint runner: ``python -m ray_tpu.analysis`` (or the
+``scripts/raylint.py`` wrapper).
+
+Exit status is 0 iff no pass reports a violation that is neither
+suppressed in-source (``# raylint: allow-<family>(<reason>)``) nor
+frozen in ``analysis/baseline.json``.  ``--update-baseline`` rewrites
+the baseline from the current tree (do this only when introducing a
+rule — fixes should SHRINK the baseline, not refresh it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Callable, Dict, List
+
+from ray_tpu.analysis import core as _core
+from ray_tpu.analysis import (
+    blocking_pass,
+    conformance_pass,
+    except_pass,
+    knob_pass,
+)
+
+PASSES: Dict[str, Callable[[str], List[_core.Violation]]] = {
+    "knobs": knob_pass.run,
+    "except": except_pass.run,
+    "blocking": blocking_pass.run,
+    "conformance": conformance_pass.run,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="raylint",
+        description="ray_tpu AST-based static-analysis suite")
+    ap.add_argument("--root", default=_core.REPO_ROOT,
+                    help="repo root to analyze (default: this checkout)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of passes "
+                         f"(default: all of {','.join(PASSES)})")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: analysis/baseline.json;"
+                         " 'none' disables the baseline)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current tree "
+                         "instead of failing")
+    ap.add_argument("--show-baselined", action="store_true",
+                    help="also print baselined (non-failing) violations")
+    ap.add_argument("--regen-wire", action="store_true",
+                    help="regenerate WIRE_CONFORMANCE.json from "
+                         "wire_schema and exit")
+    ap.add_argument("--print-knob-table", action="store_true",
+                    help="print the README knob table rendered from "
+                         "core/knobs.py and exit")
+    ap.add_argument("--list-passes", action="store_true",
+                    help="list pass names and exit")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress the summary line")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for name in PASSES:
+            print(name)
+        return 0
+    if args.regen_wire:
+        conformance_pass.write_corpus(args.root)
+        return 0
+    if args.print_knob_table:
+        from ray_tpu.core import knobs
+        print(knobs.render_readme_table(), end="")
+        return 0
+
+    if args.passes:
+        names = [p.strip() for p in args.passes.split(",") if p.strip()]
+        unknown = [p for p in names if p not in PASSES]
+        if unknown:
+            ap.error(f"unknown pass(es): {', '.join(unknown)} "
+                     f"(have: {', '.join(PASSES)})")
+    else:
+        names = list(PASSES)
+
+    violations: List[_core.Violation] = []
+    for name in names:
+        violations.extend(PASSES[name](args.root))
+
+    if args.update_baseline:
+        path = args.baseline or _core.BASELINE_PATH
+        entries = _core.build_baseline(args.root, violations)
+        _core.save_baseline(entries, path)
+        if not args.quiet:
+            print(f"raylint: baseline rewritten: {len(entries)} "
+                  f"entries ({sum(entries.values())} occurrences) "
+                  f"-> {path}")
+        return 0
+
+    if args.baseline == "none":
+        baseline: Dict[str, int] = {}
+    else:
+        baseline = _core.load_baseline(args.baseline or
+                                       _core.BASELINE_PATH)
+    result = _core.apply_filters(args.root, violations, baseline)
+
+    if args.show_baselined:
+        for v in result.baselined:
+            print(f"{v.render()}  [baselined]")
+    for v in result.new:
+        print(v.render())
+    if not args.quiet:
+        print(f"raylint: {len(names)} pass(es): "
+              f"{len(result.new)} new, {len(result.baselined)} "
+              f"baselined, {len(result.suppressed)} suppressed",
+              file=sys.stderr)
+    return 1 if result.new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,307 @@
+"""Receive-loop / lock discipline pass.
+
+The RPC receive loop is the control plane's heartbeat: every response,
+push, and batched sub-message for a connection is dispatched from ONE
+thread (`rpc.Server._serve_conn` / `rpc.Client._recv_loop`).  A handler
+that blocks — sleeps, waits on an unbounded ``.result()``, dials a
+socket — stalls every other message behind it (PR 4 explicitly moved
+``collect_spans`` serving off-thread for exactly this reason).  The
+same applies to code holding a lock: a blocking call inside a
+``with lock:`` body turns one slow peer into a process-wide convoy.
+
+This pass walks the *intra-module* call graph from a declared set of
+hot entry points (the dispatch side of the receive loops, the gcs op
+handlers, the coalescing flusher) and flags blocking primitives
+reachable from them:
+
+  * ``time.sleep(...)``
+  * socket ``recv`` / ``recv_into`` / ``accept`` / ``connect`` /
+    ``create_connection``
+  * ``<lock>.acquire()`` with no timeout/blocking argument
+  * ``.result()`` with no timeout
+  * ``subprocess.run/call/check_output/check_call/Popen``
+
+It also scans, in the same modules, every ``with <lock>:`` body for the
+same primitives (directly, or one call away through a module-local
+function that transitively blocks).
+
+gcs dispatch is ``getattr(self, f"_op_{op}")`` — statically invisible —
+so every ``ControlServer._op_*`` method is an implied entry point.
+
+Pre-existing violations are frozen in the shared baseline; new ones
+fail the build unless annotated
+``# raylint: allow-blocking(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.analysis import core as _core
+
+RULE_REACH = "blocking-reachable"
+RULE_LOCK = "blocking-under-lock"
+
+# module (repo-relative) -> explicit entry points ("Class.method" or
+# bare function names).  A trailing "*" matches by prefix (the gcs
+# getattr dispatch).
+DEFAULT_ENTRY_POINTS: Dict[str, Tuple[str, ...]] = {
+    "ray_tpu/core/rpc.py": (
+        # Dispatch side of the receive loops (the loops' own framed
+        # socket read is their job; what they *dispatch to* must not
+        # block) + the coalescing flusher's drain.
+        "Server._dispatch", "Server._handle_json", "Client._on_frame",
+        "_CoalescingSender._drain",
+    ),
+    "ray_tpu/core/gcs.py": (
+        "ControlServer._handle", "ControlServer._op_*",
+        "ControlServer._on_disconnect",
+    ),
+    "ray_tpu/core/runtime.py": (
+        "CoreClient._on_push", "CoreClient._on_direct_push",
+        "CoreClient._head_frames",
+    ),
+    "ray_tpu/core/worker.py": ("WorkerRuntime._handle_direct",),
+    "ray_tpu/core/node_manager.py": (
+        "NodeManager._on_push", "NodeManager._handle",
+    ),
+}
+
+# Modules whose `with lock:` bodies are swept (the hot control plane).
+DEFAULT_LOCK_MODULES: Tuple[str, ...] = (
+    "ray_tpu/core/rpc.py",
+    "ray_tpu/core/gcs.py",
+    "ray_tpu/core/runtime.py",
+    "ray_tpu/core/worker.py",
+    "ray_tpu/core/node_manager.py",
+    "ray_tpu/core/object_plane.py",
+)
+
+_SOCKET_BLOCKERS = {"recv", "recv_into", "accept", "connect",
+                    "create_connection"}
+_SUBPROCESS_FNS = {"run", "call", "check_output", "check_call", "Popen"}
+
+
+def _call_name(node: ast.Call) -> Tuple[str, str]:
+    """(receiver, attr) — receiver is "" for bare names."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        if isinstance(base, ast.Name):
+            return base.id, fn.attr
+        if isinstance(base, ast.Attribute):
+            return base.attr, fn.attr
+        return "<expr>", fn.attr
+    if isinstance(fn, ast.Name):
+        return "", fn.id
+    return "", ""
+
+
+def _has_kwarg(node: ast.Call, *names: str) -> bool:
+    return any(kw.arg in names for kw in node.keywords)
+
+
+def blocking_reason(node: ast.Call) -> Optional[str]:
+    """Why this call is considered blocking, or None."""
+    recv, attr = _call_name(node)
+    if attr == "sleep" and recv == "time":
+        return "time.sleep"
+    if recv == "socket" and attr in _SOCKET_BLOCKERS:
+        return f"socket.{attr}"
+    if recv == "subprocess" and attr in _SUBPROCESS_FNS:
+        return f"subprocess.{attr}"
+    if attr in _SOCKET_BLOCKERS and recv not in ("", "self"):
+        # sock.recv(...), conn.accept(...) — socket methods by name.
+        # Skip obvious non-socket receivers the control plane uses.
+        if recv not in ("queue", "q", "os"):
+            return f"{recv}.{attr}"
+    if attr == "result" and not node.args and \
+            not _has_kwarg(node, "timeout"):
+        return ".result() with no timeout"
+    if attr == "acquire" and not node.args and \
+            not _has_kwarg(node, "timeout", "blocking"):
+        if "lock" in recv.lower() or "cv" in recv.lower() or \
+                "cond" in recv.lower() or recv == "<expr>":
+            return ".acquire() with no timeout"
+    return None
+
+
+def _is_lockish(expr) -> bool:
+    """`with <expr>:` context managers that look like locks."""
+    name = ""
+    if isinstance(expr, ast.Attribute):
+        name = expr.attr
+    elif isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Call):
+        return False  # with lock_factory(): — can't tell, skip
+    return "lock" in name.lower()
+
+
+class _ModuleGraph:
+    """Intra-module call graph + per-function blocking sites."""
+
+    def __init__(self, tree: ast.AST, path: str):
+        self.path = path
+        self.funcs: Dict[str, ast.AST] = {}
+        self.classes: Dict[str, Set[str]] = {}
+        for node in getattr(tree, "body", []):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                methods = set()
+                for item in node.body:
+                    if isinstance(item,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        self.funcs[f"{node.name}.{item.name}"] = item
+                        methods.add(item.name)
+                self.classes[node.name] = methods
+        self._edges: Dict[str, Set[str]] = {}
+        self._direct: Dict[str, List[Tuple[int, str]]] = {}
+        for qual, fn in self.funcs.items():
+            self._edges[qual] = self._find_edges(qual, fn)
+            self._direct[qual] = [
+                (n.lineno, reason)
+                for n, reason in self._iter_blocking(fn)]
+
+    def _iter_blocking(self, fn) -> Iterable[Tuple[ast.Call, str]]:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                reason = blocking_reason(node)
+                if reason:
+                    yield node, reason
+
+    def _find_edges(self, qual: str, fn) -> Set[str]:
+        cls = qual.split(".")[0] if "." in qual else None
+        edges: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            recv, attr = _call_name(node)
+            if recv in ("self", "cls") and cls is not None:
+                if f"{cls}.{attr}" in self.funcs:
+                    edges.add(f"{cls}.{attr}")
+            elif recv == "" and attr in self.funcs:
+                edges.add(attr)
+        return edges
+
+    def resolve_entries(self, patterns: Iterable[str]) -> List[str]:
+        out = []
+        for pat in patterns:
+            if pat.endswith("*"):
+                prefix = pat[:-1]
+                out.extend(q for q in self.funcs if q.startswith(prefix))
+            elif pat in self.funcs:
+                out.append(pat)
+        return sorted(set(out))
+
+    def reachable_blocking(self, entry: str
+                           ) -> List[Tuple[str, int, str, str]]:
+        """(func, lineno, reason, path-string) for every blocking site
+        reachable from `entry` through intra-module calls."""
+        seen = {entry}
+        stack = [(entry, (entry,))]
+        hits = []
+        while stack:
+            qual, chain = stack.pop()
+            for lineno, reason in self._direct.get(qual, ()):
+                hits.append((qual, lineno, reason, " -> ".join(chain)))
+            for nxt in sorted(self._edges.get(qual, ())):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, chain + (nxt,)))
+        return hits
+
+    def transitively_blocks(self, qual: str) -> Optional[str]:
+        """First blocking reason reachable from `qual` (or None)."""
+        hits = self.reachable_blocking(qual)
+        return hits[0][2] if hits else None
+
+
+def scan_module(tree: ast.AST, path: str,
+                entry_patterns: Iterable[str] = (),
+                check_locks: bool = True) -> List[_core.Violation]:
+    graph = _ModuleGraph(tree, path)
+    violations: List[_core.Violation] = []
+
+    for entry in graph.resolve_entries(entry_patterns):
+        for qual, lineno, reason, chain in graph.reachable_blocking(entry):
+            violations.append(_core.Violation(
+                rule=RULE_REACH, path=path, line=lineno,
+                message=(f"{reason} reachable from receive-path entry "
+                         f"{entry} (via {chain})")))
+
+    if check_locks:
+        for qual, fn in graph.funcs.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.With):
+                    continue
+                if not any(_is_lockish(item.context_expr)
+                           for item in node.items):
+                    continue
+                for sub in node.body:
+                    for call in ast.walk(sub):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        reason = blocking_reason(call)
+                        if reason:
+                            violations.append(_core.Violation(
+                                rule=RULE_LOCK, path=path,
+                                line=call.lineno,
+                                message=(f"{reason} inside a "
+                                         f"`with lock:` body "
+                                         f"({qual})")))
+                            continue
+                        recv, attr = _call_name(call)
+                        callee = None
+                        cls = qual.split(".")[0] if "." in qual else None
+                        if recv == "self" and cls and \
+                                f"{cls}.{attr}" in graph.funcs:
+                            callee = f"{cls}.{attr}"
+                        elif recv == "" and attr in graph.funcs:
+                            callee = attr
+                        if callee:
+                            why = graph.transitively_blocks(callee)
+                            if why:
+                                violations.append(_core.Violation(
+                                    rule=RULE_LOCK, path=path,
+                                    line=call.lineno,
+                                    message=(f"call to {callee} ({why}) "
+                                             f"inside a `with lock:` "
+                                             f"body ({qual})")))
+    # De-duplicate: one site can be reachable from many entries; report
+    # each (rule, line, leading-reason) once.
+    seen: Set[Tuple[str, int, str]] = set()
+    unique = []
+    for v in violations:
+        key = (v.rule, v.line, v.message.split(" (")[0])
+        if key not in seen:
+            seen.add(key)
+            unique.append(v)
+    return unique
+
+
+def run(root: str,
+        entry_points: Optional[Dict[str, Tuple[str, ...]]] = None,
+        lock_modules: Optional[Tuple[str, ...]] = None
+        ) -> List[_core.Violation]:
+    entry_points = (DEFAULT_ENTRY_POINTS if entry_points is None
+                    else entry_points)
+    lock_modules = (DEFAULT_LOCK_MODULES if lock_modules is None
+                    else lock_modules)
+    modules = sorted(set(entry_points) | set(lock_modules))
+    violations: List[_core.Violation] = []
+    for rel in modules:
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8",
+                      errors="replace") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        violations.extend(scan_module(
+            tree, rel,
+            entry_patterns=entry_points.get(rel, ()),
+            check_locks=rel in lock_modules))
+    return violations

@@ -1,0 +1,446 @@
+"""Wire/metrics conformance pass.
+
+Folds the repo's two ad-hoc checkers into the raylint framework so they
+share the runner, the suppression syntax, and the baseline:
+
+wire conformance
+  * ``wire-undeclared`` — an op the code HANDLES (a gcs
+    ``ControlServer._op_<name>`` method, or an ``op == "<name>"`` /
+    ``msg.get("op") == "<name>"`` dispatch compare in the runtime /
+    worker / node-manager / serve modules) that ``wire_schema.SCHEMA``
+    does not declare.  Undeclared ops bypass ingress validation on the
+    JSON door — exactly the drift the schema exists to prevent.
+  * ``wire-unhandled`` — a declared schema op no scanned module
+    handles: dead contract surface.
+  * ``wire-corpus-drift`` — the committed ``WIRE_CONFORMANCE.json``
+    golden corpus no longer matches the schema (regenerate with
+    ``python -m ray_tpu.analysis --regen-wire``).
+
+metrics conformance (ex ``scripts/check_metrics_conformance.py``)
+  * ``metric-unregistered`` — a ``ray_tpu_*`` metric token referenced
+    in tests/ or README.md that no source file registers.
+  * ``metric-undocumented`` — a registered metric absent from README's
+    Observability catalog.
+
+The corpus builder (``build_corpus`` / ``write_corpus``) lives here so
+``scripts/gen_wire_conformance.py`` is a thin delegate.  This pass is
+the one raylint module allowed to import from the analyzed package:
+``ray_tpu.core.wire_schema`` is dependency-free by design (the proto
+tier), and the corpus must be derived from the real table, not a
+parallel AST decode of it.
+"""
+
+from __future__ import annotations
+
+import ast
+import base64
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ray_tpu.analysis import core as _core
+from ray_tpu.core.wire_schema import SCHEMA, export_schema
+
+WIRE_SCHEMA_MODULE = "ray_tpu/core/wire_schema.py"
+CORPUS_FILE = "WIRE_CONFORMANCE.json"
+
+# Modules whose dispatch sites define the set of HANDLED ops.
+DEFAULT_HANDLER_MODULES: Tuple[str, ...] = (
+    "ray_tpu/core/gcs.py",
+    "ray_tpu/core/runtime.py",
+    "ray_tpu/core/worker.py",
+    "ray_tpu/core/node_manager.py",
+    "ray_tpu/serve/proxy.py",
+)
+
+_METRIC_NAME_RE = re.compile(r"\bray_tpu_[a-z0-9_]+\b")
+_METRIC_CALLS = {"Counter", "Gauge", "Histogram", "gauge"}
+
+# ray_tpu_* tokens in tests/ that are NOT metric names (shm file
+# prefixes, temp dirs, log paths) — keep this list short and literal.
+METRIC_ALLOWLIST = {
+    "ray_tpu_cpp_example",
+    "ray_tpu_cpp_worker_example",
+    "ray_tpu_shm_example",
+    "ray_tpu_test_watchdog",
+    "ray_tpu_train_",
+}
+
+
+# --------------------------------------------------------------------------
+# wire: handled-op extraction (pure AST)
+# --------------------------------------------------------------------------
+
+def _is_op_expr(node) -> bool:
+    """Expressions that denote the wire op of a message: a bare ``op``
+    name, ``<x>.get("op")``, or ``<x>["op"]``."""
+    if isinstance(node, ast.Name) and node.id == "op":
+        return True
+    if isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "get" and node.args and \
+            isinstance(node.args[0], ast.Constant) and \
+            node.args[0].value == "op":
+        return True
+    if isinstance(node, ast.Subscript) and \
+            isinstance(node.slice, ast.Constant) and \
+            node.slice.value == "op":
+        return True
+    return False
+
+
+def _str_consts(node) -> Iterable[Tuple[str, int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        yield node.value, node.lineno
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            yield from _str_consts(elt)
+
+
+def extract_handled_ops(tree: ast.AST) -> Dict[str, int]:
+    """{op: first lineno} for every op this module dispatches on."""
+    ops: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        # gcs-style: getattr(self, f"_op_{op}") dispatch makes every
+        # _op_* method a handler.
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name.startswith("_op_"):
+            ops.setdefault(node.name[len("_op_"):], node.lineno)
+        # compare-style: op == "x" / msg.get("op") in ("x", "y")
+        elif isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if not any(_is_op_expr(e) for e in sides):
+                continue
+            for e in sides:
+                for name, lineno in _str_consts(e):
+                    ops.setdefault(name, lineno)
+    return ops
+
+
+def extract_schema_linenos(tree: ast.AST) -> Dict[str, int]:
+    """{op: lineno} for the SCHEMA dict literal in wire_schema.py."""
+    out: Dict[str, int] = {}
+    for stmt in getattr(tree, "body", []):
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            target = stmt.targets[0].id
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name):
+            target = stmt.target.id
+        if target != "SCHEMA":
+            continue
+        value = getattr(stmt, "value", None)
+        if isinstance(value, ast.Dict):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str):
+                    out[k.value] = k.lineno
+    return out
+
+
+def run_wire(root: str,
+             handler_modules: Optional[Tuple[str, ...]] = None,
+             schema_ops: Optional[Set[str]] = None
+             ) -> List[_core.Violation]:
+    handler_modules = (DEFAULT_HANDLER_MODULES if handler_modules is None
+                       else handler_modules)
+    violations: List[_core.Violation] = []
+
+    schema_path = os.path.join(root, WIRE_SCHEMA_MODULE)
+    schema_linenos: Dict[str, int] = {}
+    try:
+        with open(schema_path, encoding="utf-8", errors="replace") as f:
+            schema_linenos = extract_schema_linenos(ast.parse(f.read()))
+    except (OSError, SyntaxError):
+        pass
+    if schema_ops is None:
+        schema_ops = set(schema_linenos) or set(SCHEMA)
+
+    handled: Dict[str, Tuple[str, int]] = {}
+    for rel in handler_modules:
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8",
+                      errors="replace") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        for op, lineno in sorted(extract_handled_ops(tree).items()):
+            handled.setdefault(op, (rel, lineno))
+
+    for op in sorted(set(handled) - schema_ops):
+        rel, lineno = handled[op]
+        violations.append(_core.Violation(
+            rule="wire-undeclared", path=rel, line=lineno,
+            message=(f"op {op!r} is handled here but not declared in "
+                     f"wire_schema.SCHEMA — it bypasses ingress "
+                     f"validation")))
+    for op in sorted(schema_ops - set(handled)):
+        violations.append(_core.Violation(
+            rule="wire-unhandled", path=WIRE_SCHEMA_MODULE,
+            line=schema_linenos.get(op, 1),
+            message=(f"schema declares op {op!r} but no scanned module "
+                     f"handles it (dead contract surface)")))
+
+    # Golden-corpus drift: the committed artifact must match the live
+    # schema table (only when checking the real repo — fixture roots
+    # have no corpus and no live schema to compare against).
+    corpus_path = os.path.join(root, CORPUS_FILE)
+    if os.path.exists(corpus_path) and \
+            os.path.abspath(root) == _core.REPO_ROOT:
+        try:
+            with open(corpus_path) as f:
+                committed = json.load(f)
+        except (OSError, ValueError):
+            committed = None
+        if committed != build_corpus():
+            violations.append(_core.Violation(
+                rule="wire-corpus-drift", path=CORPUS_FILE, line=1,
+                message=("golden corpus is stale vs wire_schema — "
+                         "regenerate: python -m ray_tpu.analysis "
+                         "--regen-wire")))
+    return violations
+
+
+# --------------------------------------------------------------------------
+# wire: golden corpus builder (ex scripts/gen_wire_conformance.py)
+# --------------------------------------------------------------------------
+
+# Deterministic example value per declared field type, in JSON WIRE
+# form (the form the JSON door transports; bytes ride b64 envelopes).
+_EXAMPLES = {
+    "str": "example",
+    "int": 7,
+    "float": 1.5,
+    "bool": True,
+    "bytes": {"__bytes_b64__": base64.b64encode(b"payload").decode()},
+    "list": ["item"],
+    "dict": {"k": "v"},
+    "any": {"nested": ["any", 1]},
+}
+
+# A value guaranteed NOT to satisfy the declared type (for the
+# wrong-type mutants).  "any" accepts everything -> no mutant.
+_WRONG = {
+    "str": 123, "int": "not-an-int", "float": "not-a-float",
+    "bool": "not-a-bool", "bytes": 3.5, "list": "not-a-list",
+    "dict": "not-a-dict",
+}
+
+
+def _example_for(spec: str):
+    base = spec.rstrip("?").split("|")[0]
+    return _EXAMPLES[base]
+
+
+def _wrong_for(spec: str):
+    tname = spec.rstrip("?")
+    if tname == "any":
+        return None
+    # Union types ("bytes|str"): a float satisfies neither arm.
+    if "|" in tname:
+        return 3.5
+    return _WRONG[tname]
+
+
+def build_corpus() -> dict:
+    golden = []
+    for op in sorted(SCHEMA):
+        fields = SCHEMA[op]
+        maximal = {"op": op}
+        minimal = {"op": op}
+        for name, spec in sorted(fields.items()):
+            maximal[name] = _example_for(spec)
+            if not spec.endswith("?"):
+                minimal[name] = _example_for(spec)
+        golden.append({"op": op, "case": "maximal", "valid": True,
+                       "frame": maximal})
+        if minimal != maximal:
+            golden.append({"op": op, "case": "minimal", "valid": True,
+                           "frame": minimal})
+        # invalid: first required field missing
+        required = [n for n, t in sorted(fields.items())
+                    if not t.endswith("?")]
+        if required:
+            broken = dict(minimal)
+            broken.pop(required[0])
+            golden.append({
+                "op": op, "case": f"missing-{required[0]}",
+                "valid": False,
+                "reason": f"required field {required[0]!r} absent",
+                "frame": broken})
+        # invalid: first typable field wrong type
+        for name, spec in sorted(fields.items()):
+            wrong = _wrong_for(spec)
+            if wrong is None:
+                continue
+            broken = dict(minimal)
+            broken[name] = wrong
+            golden.append({
+                "op": op, "case": f"wrong-type-{name}", "valid": False,
+                "reason": f"field {name!r} violates type {spec!r}",
+                "frame": broken})
+            break
+        # invalid: undeclared field
+        broken = dict(minimal)
+        broken["__undeclared__"] = 1
+        golden.append({
+            "op": op, "case": "undeclared-field", "valid": False,
+            "reason": "fields outside the contract are rejected",
+            "frame": broken})
+    golden.append({"op": "__unknown__", "case": "unknown-op",
+                   "valid": False,
+                   "reason": "unknown ops fail closed",
+                   "frame": {"op": "__unknown__"}})
+    return {
+        "format": "ray_tpu wire conformance v1",
+        "note": ("Golden corpus for non-Python clients (reference: the "
+                 "proto IDL contract every language compiles against, "
+                 "src/ray/protobuf/).  'frame' is the JSON WIRE form "
+                 "(bytes as {'__bytes_b64__': ...}); a conforming "
+                 "client encoder must produce frames the schema "
+                 "accepts and must not produce any frame it rejects."),
+        "schema": export_schema(),
+        "golden": golden,
+    }
+
+
+def write_corpus(root: str = _core.REPO_ROOT) -> str:
+    out = os.path.join(root, CORPUS_FILE)
+    doc = build_corpus()
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    n_valid = sum(1 for g in doc["golden"] if g["valid"])
+    print(f"wrote {out}: {len(doc['schema']['ops'])} ops, "
+          f"{len(doc['golden'])} frames ({n_valid} valid, "
+          f"{len(doc['golden']) - n_valid} invalid)")
+    return out
+
+
+# --------------------------------------------------------------------------
+# metrics (ex scripts/check_metrics_conformance.py)
+# --------------------------------------------------------------------------
+
+def registered_metrics(root: str) -> Dict[str, Tuple[str, int]]:
+    """{metric_name: (relpath, lineno)} the ray_tpu/ source registers:
+    Counter/Gauge/Histogram/gauge calls, {"name": ..., "kind": ...}
+    snapshot dict literals, and ("ray_tpu_*", "<desc>") 2-tuples."""
+    names: Dict[str, Tuple[str, int]] = {}
+
+    def _add(name: str, rel: str, lineno: int) -> None:
+        names.setdefault(name, (rel, lineno))
+
+    for path in _core.iter_py_files(root, roots=("ray_tpu",)):
+        rel = _core.relpath(root, path)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                fname = (fn.attr if isinstance(fn, ast.Attribute)
+                         else getattr(fn, "id", ""))
+                if fname in _METRIC_CALLS and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str) and \
+                        node.args[0].value.startswith("ray_tpu_"):
+                    _add(node.args[0].value, rel, node.lineno)
+            elif isinstance(node, ast.Dict):
+                keys = [k.value for k in node.keys
+                        if isinstance(k, ast.Constant)]
+                if "name" not in keys or "kind" not in keys:
+                    continue
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and \
+                            k.value == "name" and \
+                            isinstance(v, ast.Constant) and \
+                            isinstance(v.value, str) and \
+                            v.value.startswith("ray_tpu_"):
+                        _add(v.value, rel, v.lineno)
+            elif isinstance(node, ast.Tuple) and len(node.elts) == 2:
+                a, b = node.elts
+                if isinstance(a, ast.Constant) and \
+                        isinstance(a.value, str) and \
+                        a.value.startswith("ray_tpu_") and \
+                        isinstance(b, ast.Constant) and \
+                        isinstance(b.value, str):
+                    _add(a.value, rel, a.lineno)
+    return names
+
+
+def referenced_metrics(root: str) -> Dict[str, List[Tuple[str, int]]]:
+    """{token: [(relpath, lineno)]} for ray_tpu_* tokens in tests/ and
+    README.md."""
+    refs: Dict[str, List[Tuple[str, int]]] = {}
+    paths = list(_core.iter_py_files(root, roots=("tests",)))
+    paths.append(os.path.join(root, "README.md"))
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        rel = _core.relpath(root, path)
+        for lineno, line in enumerate(text.splitlines(), 1):
+            for tok in _METRIC_NAME_RE.findall(line):
+                if tok in METRIC_ALLOWLIST:
+                    continue
+                refs.setdefault(tok, []).append((rel, lineno))
+    return refs
+
+
+def run_metrics(root: str) -> List[_core.Violation]:
+    registered = registered_metrics(root)
+    refs = referenced_metrics(root)
+    violations: List[_core.Violation] = []
+    # Histogram expositions append _bucket/_sum/_count; a doc or test
+    # may legitimately reference those derived names.
+    derived: Set[str] = set()
+    for n in registered:
+        derived.update({n + "_bucket", n + "_sum", n + "_count"})
+    for tok in sorted(refs):
+        if tok not in registered and tok not in derived:
+            rel, lineno = refs[tok][0]
+            violations.append(_core.Violation(
+                rule="metric-unregistered", path=rel, line=lineno,
+                message=(f"{tok} is referenced but never registered "
+                         f"({len(refs[tok])} reference(s))")))
+    readme_toks: Set[str] = set()
+    try:
+        with open(os.path.join(root, "README.md"), encoding="utf-8",
+                  errors="replace") as f:
+            readme_toks = set(_METRIC_NAME_RE.findall(f.read()))
+    except OSError:
+        pass
+    for name in sorted(registered):
+        if name not in readme_toks:
+            rel, lineno = registered[name]
+            violations.append(_core.Violation(
+                rule="metric-undocumented", path=rel, line=lineno,
+                message=(f"{name} is registered but undocumented in "
+                         f"README.md")))
+    return violations
+
+
+def metrics_problems(root: str = _core.REPO_ROOT) -> List[str]:
+    """Problem strings in the legacy check_metrics_conformance.check()
+    shape (the back-compat shim and its loader test use this)."""
+    out = []
+    for v in run_metrics(root):
+        if v.rule == "metric-unregistered":
+            name = v.message.split(" ", 1)[0]
+            out.append(f"referenced but never registered: {name} "
+                       f"({v.path}:{v.line})")
+        else:
+            name = v.message.split(" ", 1)[0]
+            out.append(f"registered but undocumented in README.md: "
+                       f"{name}")
+    return out
+
+
+def run(root: str) -> List[_core.Violation]:
+    return run_wire(root) + run_metrics(root)

@@ -1,0 +1,192 @@
+"""Shared infrastructure for the raylint static-analysis passes.
+
+Everything passes have in common lives here so each pass is only its
+rule logic: repo file iteration, the suppression comment syntax, the
+checked-in violation baseline, and the report shape.
+
+Violations
+----------
+A pass returns `Violation` records anchored to a real file:line.  The
+runner (``__main__.py``) then applies, in order:
+
+  1. suppressions — a ``# raylint: allow-<family>(<reason>)`` comment on
+     the flagged line or the line directly above it silences the
+     violation.  The reason is mandatory (an empty ``allow-swallow()``
+     does not count) so every suppression documents itself.
+  2. the baseline — ``baseline.json`` (next to this module) freezes the
+     violations that existed when a rule was introduced.  Baselined
+     sites stay visible via ``--show-baselined`` but do not fail the
+     run; anything NOT in the baseline is a build-break.
+
+Baseline keys are ``rule::path::<normalized source line>`` rather than
+line numbers, so unrelated edits above a frozen site do not churn the
+baseline.  Identical lines in one file are counted: the baseline stores
+how many occurrences are frozen, and the runner fails once live
+occurrences exceed that count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+# Repo root = parent of the ray_tpu package directory.
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Directories swept by default (relative to the root).
+DEFAULT_ROOTS = ("ray_tpu", "scripts", "tests")
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+# Suppression comment: `# raylint: allow-<family>(<reason>)`.  Family is
+# the first dash-segment of the rule name ("swallow", "blocking",
+# "knob", "wire", "metric"); the reason must be non-empty.
+_SUPPRESS_RE = re.compile(
+    r"#\s*raylint:\s*allow-([a-z]+)\(([^)]+)\)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str      # e.g. "swallow", "blocking", "knob-unregistered"
+    path: str      # repo-relative, forward slashes
+    line: int      # 1-indexed
+    message: str
+
+    @property
+    def family(self) -> str:
+        return self.rule.split("-", 1)[0]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def iter_py_files(root: str, roots: Iterable[str] = DEFAULT_ROOTS
+                  ) -> Iterator[str]:
+    """Yield every .py file under the swept roots (absolute paths)."""
+    for sub in roots:
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def relpath(root: str, path: str) -> str:
+    return os.path.relpath(path, root).replace(os.sep, "/")
+
+
+class _SourceCache:
+    """Lazily loaded, per-file line lists for suppression and baseline
+    key lookups."""
+
+    def __init__(self, root: str):
+        self._root = root
+        self._lines: Dict[str, List[str]] = {}
+
+    def lines(self, path: str) -> List[str]:
+        cached = self._lines.get(path)
+        if cached is None:
+            try:
+                with open(os.path.join(self._root, path),
+                          encoding="utf-8", errors="replace") as f:
+                    cached = f.read().splitlines()
+            except OSError:
+                cached = []
+            self._lines[path] = cached
+        return cached
+
+    def line_text(self, path: str, lineno: int) -> str:
+        lines = self.lines(path)
+        if 1 <= lineno <= len(lines):
+            return lines[lineno - 1]
+        return ""
+
+
+def suppression_for(src: _SourceCache, v: Violation
+                    ) -> Optional[Tuple[str, str]]:
+    """(family, reason) if an allow-comment covers this violation."""
+    for lineno in (v.line, v.line - 1):
+        m = _SUPPRESS_RE.search(src.line_text(v.path, lineno))
+        if m and m.group(1) == v.family and m.group(2).strip():
+            return m.group(1), m.group(2).strip()
+    return None
+
+
+def baseline_key(src: _SourceCache, v: Violation) -> str:
+    """Stable identity for a baselined violation: rule + file + the
+    flagged source line with whitespace collapsed (line numbers drift;
+    line text rarely does)."""
+    text = re.sub(r"\s+", " ", src.line_text(v.path, v.line).strip())
+    return f"{v.rule}::{v.path}::{text}"
+
+
+def load_baseline(path: str = BASELINE_PATH) -> Dict[str, int]:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    entries = doc.get("entries", {})
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def save_baseline(entries: Dict[str, int], path: str = BASELINE_PATH
+                  ) -> None:
+    doc = {
+        "format": "raylint baseline v1",
+        "note": ("Frozen pre-existing violations; new ones fail the "
+                 "build.  Regenerate with: "
+                 "python -m ray_tpu.analysis --update-baseline"),
+        "entries": dict(sorted(entries.items())),
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+@dataclasses.dataclass
+class FilterResult:
+    new: List[Violation]
+    baselined: List[Violation]
+    suppressed: List[Tuple[Violation, str]]   # (violation, reason)
+
+
+def apply_filters(root: str, violations: List[Violation],
+                  baseline: Dict[str, int]) -> FilterResult:
+    """Split raw violations into new / baselined / suppressed."""
+    src = _SourceCache(root)
+    remaining = dict(baseline)
+    out = FilterResult([], [], [])
+    for v in sorted(violations, key=lambda v: (v.path, v.line, v.rule)):
+        sup = suppression_for(src, v)
+        if sup is not None:
+            out.suppressed.append((v, sup[1]))
+            continue
+        key = baseline_key(src, v)
+        if remaining.get(key, 0) > 0:
+            remaining[key] -= 1
+            out.baselined.append(v)
+            continue
+        out.new.append(v)
+    return out
+
+
+def build_baseline(root: str, violations: List[Violation]
+                   ) -> Dict[str, int]:
+    """Baseline entries covering every non-suppressed violation."""
+    src = _SourceCache(root)
+    entries: Dict[str, int] = {}
+    for v in violations:
+        if suppression_for(src, v) is not None:
+            continue
+        key = baseline_key(src, v)
+        entries[key] = entries.get(key, 0) + 1
+    return entries

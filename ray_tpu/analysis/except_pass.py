@@ -1,0 +1,81 @@
+"""Exception-hygiene pass: no new silently-swallowed exceptions.
+
+PR 3 shipped a fix for an ``except Exception: pass`` in the arena cache
+that had been eating every caching failure — reads silently re-pulled
+over the wire and nothing ever said why.  This pass makes that bug
+class a build-break: every ``except`` handler whose entire body is one
+of
+
+  * ``pass``
+  * a bare ``continue``
+  * a lone ``return`` / ``return None``
+
+is flagged as a swallow.  Pre-existing sites are frozen in the shared
+baseline; a NEW swallow must either be rewritten (the
+``core/log_once.py`` rate-limited once-per-cause warning is the house
+pattern) or carry an explicit
+``# raylint: allow-swallow(<reason>)`` on the ``except`` line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ray_tpu.analysis import core as _core
+
+RULE = "swallow"
+
+
+def _is_swallow_body(body: list) -> bool:
+    if len(body) != 1:
+        return False
+    stmt = body[0]
+    if isinstance(stmt, (ast.Pass, ast.Continue)):
+        return True
+    if isinstance(stmt, ast.Return):
+        v = stmt.value
+        return v is None or (isinstance(v, ast.Constant) and
+                             v.value is None)
+    return False
+
+
+def scan_source(source: str, path: str) -> List[_core.Violation]:
+    """Swallow violations for one file's source text."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_swallow_body(node.body):
+            continue
+        if node.type is None:
+            caught = "<bare except>"
+        else:
+            try:
+                caught = ast.unparse(node.type)
+            except Exception:
+                caught = "<?>"
+        body_kind = type(node.body[0]).__name__.lower()
+        out.append(_core.Violation(
+            rule=RULE, path=path, line=node.lineno,
+            message=(f"except {caught} swallowed by bare {body_kind} — "
+                     f"log it (core/log_once.py) or annotate "
+                     f"# raylint: allow-swallow(<reason>)")))
+    return out
+
+
+def run(root: str) -> List[_core.Violation]:
+    violations: List[_core.Violation] = []
+    for path in _core.iter_py_files(root):
+        rel = _core.relpath(root, path)
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                source = f.read()
+        except OSError:
+            continue
+        violations.extend(scan_source(source, rel))
+    return violations

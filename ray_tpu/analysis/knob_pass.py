@@ -1,0 +1,258 @@
+"""Knob-registry conformance pass: every ``RAY_TPU_*`` env knob is
+registered, documented, and alive.
+
+The registry is ``ray_tpu/core/knobs.py`` — one literal ``Knob(...)``
+entry per environment variable plus a ``_CONFIG_DOCS`` table for the
+``Config`` dataclass fields that become implicit ``RAY_TPU_<FIELD>``
+overrides via ``config._env_override``.  This pass is pure AST (it
+never imports the code under analysis) and enforces, bidirectionally:
+
+  * used-but-unregistered — any ``RAY_TPU_*`` string constant in
+    ray_tpu/, scripts/ or tests/ that names a knob absent from the
+    registry;
+  * registered-but-unread (dead) — a registered knob with no read site
+    anywhere (``os.environ.get`` / ``os.getenv`` / ``os.environ[...]``
+    loads, the gcs ``_env_int``/``_env_float`` helpers, a module-level
+    alias later passed to ``environ.get``, or a Config field read
+    through ``_env_override``);
+  * registered-but-undocumented — a registered knob whose name does not
+    appear in README.md;
+  * documented-but-unregistered — a ``RAY_TPU_*`` name in README's
+    "Configuration knobs" table that the registry does not declare;
+  * config-docs drift — ``_CONFIG_DOCS`` keys out of sync with the
+    ``Config`` dataclass fields (both directions).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from ray_tpu.analysis import core as _core
+
+_KNOB_RE = re.compile(r"^RAY_TPU_[A-Z][A-Z0-9_]*$")
+_README_KNOB_RE = re.compile(r"\bRAY_TPU_[A-Z][A-Z0-9_]*\b")
+
+# Functions whose constant first argument is an env-var READ.
+_READ_HELPERS = {"get", "getenv", "setdefault", "pop",
+                 "_env_int", "_env_float", "_env_flag"}
+
+KNOBS_MODULE = "ray_tpu/core/knobs.py"
+CONFIG_MODULE = "ray_tpu/core/config.py"
+README = "README.md"
+
+# README heading that opens the generated knob table; the table check
+# is scoped to this section (other RAY_TPU_* tokens in README — C++
+# macro names, shm file prefixes — are not knob claims).
+README_SECTION = "## Configuration knobs"
+
+
+def _const_str(node) -> str:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return ""
+
+
+def extract_uses(tree: ast.AST) -> List[Tuple[str, int]]:
+    """(name, lineno) for every RAY_TPU_* string constant in the file.
+    Any appearance counts as a *use* (reads, writes into child envs,
+    monkeypatch.setenv in tests): each must name a registered knob."""
+    uses = []
+    for node in ast.walk(tree):
+        name = _const_str(node)
+        if name and _KNOB_RE.match(name):
+            uses.append((name, node.lineno))
+    return uses
+
+
+def extract_reads(tree: ast.AST) -> Set[str]:
+    """Names this file actually READS from the environment."""
+    reads: Set[str] = set()
+    aliases: Dict[str, str] = {}
+    # Module-level `X = "RAY_TPU_..."` aliases (logging_config._ENV_KEY).
+    for stmt in getattr(tree, "body", []):
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            val = _const_str(stmt.value)
+            if val and _KNOB_RE.match(val):
+                aliases[stmt.targets[0].id] = val
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            fname = (fn.attr if isinstance(fn, ast.Attribute)
+                     else getattr(fn, "id", ""))
+            if fname in _READ_HELPERS and node.args:
+                arg = node.args[0]
+                name = _const_str(arg)
+                if not name and isinstance(arg, ast.Name):
+                    name = aliases.get(arg.id, "")
+                if name and _KNOB_RE.match(name):
+                    reads.add(name)
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.ctx, ast.Load):
+            name = _const_str(node.slice)
+            if not name and isinstance(node.slice, ast.Name):
+                name = aliases.get(node.slice.id, "")
+            if name and _KNOB_RE.match(name):
+                base = node.value
+                if isinstance(base, ast.Attribute) and \
+                        base.attr == "environ":
+                    reads.add(name)
+    return reads
+
+
+def extract_config_fields(tree: ast.AST) -> List[str]:
+    """Field names of the Config dataclass (core/config.py): each is an
+    implicit RAY_TPU_<FIELD> knob via _env_override."""
+    fields = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and \
+                        isinstance(stmt.target, ast.Name):
+                    fields.append(stmt.target.id)
+    return fields
+
+
+def extract_registry(tree: ast.AST) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """From knobs.py: ({knob_name: lineno} for Knob(...) literals,
+    {config_field: lineno} for _CONFIG_DOCS keys)."""
+    knobs: Dict[str, int] = {}
+    config_docs: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            fname = (fn.attr if isinstance(fn, ast.Attribute)
+                     else getattr(fn, "id", ""))
+            if fname in ("Knob", "K") and node.args:
+                name = _const_str(node.args[0])
+                if name:
+                    knobs[name] = node.lineno
+    for stmt in getattr(tree, "body", []):
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            target = stmt.targets[0].id
+        elif isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name):
+            target = stmt.target.id
+        if target == "_CONFIG_DOCS" and \
+                isinstance(getattr(stmt, "value", None), ast.Dict):
+            for k in stmt.value.keys:
+                field = _const_str(k)
+                if field:
+                    config_docs[field] = k.lineno
+    return knobs, config_docs
+
+
+def config_knob_name(field: str) -> str:
+    return "RAY_TPU_" + field.upper()
+
+
+def readme_table_names(readme_text: str) -> Set[str]:
+    """RAY_TPU_* names inside the README knob-table section only."""
+    start = readme_text.find(README_SECTION)
+    if start < 0:
+        return set()
+    rest = readme_text[start + len(README_SECTION):]
+    nxt = rest.find("\n## ")
+    section = rest if nxt < 0 else rest[:nxt]
+    return set(_README_KNOB_RE.findall(section))
+
+
+def run(root: str) -> List[_core.Violation]:
+    violations: List[_core.Violation] = []
+
+    def _parse(rel: str):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8",
+                      errors="replace") as f:
+                return ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return None
+
+    knobs_tree = _parse(KNOBS_MODULE)
+    if knobs_tree is None:
+        return [_core.Violation(
+            rule="knob-registry-missing", path=KNOBS_MODULE, line=1,
+            message="knob registry module missing or unparsable")]
+    registry, config_docs = extract_registry(knobs_tree)
+
+    config_tree = _parse(CONFIG_MODULE)
+    config_fields = (extract_config_fields(config_tree)
+                     if config_tree is not None else [])
+
+    # -- config-docs drift (both directions) ---------------------------
+    for field in config_fields:
+        if field not in config_docs:
+            violations.append(_core.Violation(
+                rule="knob-config-drift", path=CONFIG_MODULE, line=1,
+                message=(f"Config field {field!r} has no _CONFIG_DOCS "
+                         f"entry in {KNOBS_MODULE}")))
+    for field, lineno in config_docs.items():
+        if field not in config_fields:
+            violations.append(_core.Violation(
+                rule="knob-config-drift", path=KNOBS_MODULE, line=lineno,
+                message=(f"_CONFIG_DOCS names {field!r} which is not a "
+                         f"Config dataclass field")))
+
+    registered: Dict[str, Tuple[str, int]] = {
+        name: (KNOBS_MODULE, lineno) for name, lineno in registry.items()}
+    for field, lineno in config_docs.items():
+        registered.setdefault(config_knob_name(field),
+                              (KNOBS_MODULE, lineno))
+
+    # -- sweep uses and reads ------------------------------------------
+    uses: Dict[str, List[Tuple[str, int]]] = {}
+    reads: Set[str] = set()
+    for path in _core.iter_py_files(root):
+        rel = _core.relpath(root, path)
+        tree = _parse(rel)
+        if tree is None:
+            continue
+        if rel != KNOBS_MODULE:
+            for name, lineno in extract_uses(tree):
+                uses.setdefault(name, []).append((rel, lineno))
+        reads |= extract_reads(tree)
+    # Config fields are read through _env_override at Config() time.
+    reads |= {config_knob_name(f) for f in config_fields}
+
+    # -- used but unregistered -----------------------------------------
+    for name in sorted(uses):
+        if name not in registered:
+            rel, lineno = uses[name][0]
+            violations.append(_core.Violation(
+                rule="knob-unregistered", path=rel, line=lineno,
+                message=(f"{name} is used here but not registered in "
+                         f"{KNOBS_MODULE} ({len(uses[name])} use(s))")))
+
+    # -- registered but dead / undocumented ----------------------------
+    try:
+        with open(os.path.join(root, README), encoding="utf-8",
+                  errors="replace") as f:
+            readme_text = f.read()
+    except OSError:
+        readme_text = ""
+    table = readme_table_names(readme_text)
+    for name in sorted(registered):
+        rel, lineno = registered[name]
+        if name not in reads:
+            violations.append(_core.Violation(
+                rule="knob-dead", path=rel, line=lineno,
+                message=(f"{name} is registered but read nowhere — "
+                         f"delete it or wire it up")))
+        if name not in readme_text:
+            violations.append(_core.Violation(
+                rule="knob-undocumented", path=rel, line=lineno,
+                message=(f"{name} is registered but absent from "
+                         f"README.md's knob table")))
+
+    # -- documented (in the table) but unregistered --------------------
+    for name in sorted(table - set(registered)):
+        violations.append(_core.Violation(
+            rule="knob-stale-doc", path=README, line=1,
+            message=(f"README knob table documents {name} which the "
+                     f"registry does not declare")))
+    return violations

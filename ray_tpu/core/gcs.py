@@ -23,6 +23,7 @@ Design deviations from the reference, deliberate for the TPU-first rebuild:
 from __future__ import annotations
 
 import itertools
+import logging
 import os
 import subprocess
 import sys
@@ -40,6 +41,9 @@ from ray_tpu.core.ids import ActorID, ObjectID, TaskID, WorkerID
 from ray_tpu.core.object_store import ShmObjectStore
 from ray_tpu.core.resources import CPU, TPU, ResourceSet
 from ray_tpu.core.task_spec import ActorCreationSpec, TaskSpec
+from ray_tpu.core.log_once import warn_once
+
+logger = logging.getLogger(__name__)
 
 # Object states
 PENDING = "PENDING"
@@ -823,8 +827,11 @@ class ControlServer:
         alive.append(os.getpid())
         try:
             self.store.sweep(alive)
-        except Exception:
-            pass
+        except Exception as exc:
+            # A failing sweep leaks dead workers' arena pins until the
+            # store fills — keep it best-effort but never silent.
+            warn_once(logger, "store-sweep", exc,
+                      "shm-store sweep failed (dead-process pins leak)")
 
     def _mark_worker_dead(self, w: WorkerInfo, reason: str):
         """Called with lock held. Fail/retry its task, kill/restart its actor."""
@@ -842,8 +849,13 @@ class ControlServer:
                     owner.conn.push({"op": "lease_revoked",
                                      "worker": w.worker_hex,
                                      "reason": reason})
-                except Exception:
-                    pass
+                except Exception as exc:
+                    # The owner never learns its leased worker died; its
+                    # in-flight specs stall until lease timeout — log so
+                    # the stall has a visible cause.
+                    warn_once(logger, "lease-revoke-push", exc,
+                              "could not notify %s of dead leased "
+                              "worker %s", was_leased_to, w.worker_hex)
         # Leases this worker HELD as an owner die with it.
         for x in self.workers.values():
             if x.state == "leased" and x.leased_to == w.worker_hex:
@@ -1038,8 +1050,12 @@ class ControlServer:
         for c in subs:
             try:
                 c.push(push)
-            except Exception:
-                pass
+            except Exception as exc:
+                # A lost object_ready leaves that subscriber's get()
+                # blocked until timeout — worth a (rate-limited) trace.
+                warn_once(logger, "object-ready-push", exc,
+                          "could not push object_ready for %s to a "
+                          "subscriber", obj_hex)
         # A dropped generator's free may have arrived before this EOS
         # put: apply it now that the stream is provably finished.
         frees = getattr(self, "_pending_stream_frees", None)
@@ -1169,7 +1185,12 @@ class ControlServer:
                 # cleanup would unlink the winner's (only) copy.
                 uri = self.external_storage.spill(
                     f"{obj_hex}-{uuid.uuid4().hex[:8]}", data)
-            except Exception:
+            except Exception as exc:
+                # Spill failures loop forever against a full arena; the
+                # operator needs to see WHY eviction is making no room.
+                warn_once(logger, "spill", exc,
+                          "could not spill object %s (arena stays full)",
+                          obj_hex)
                 continue
             with self.lock:
                 entry = self.objects.get(obj_hex)
@@ -1184,8 +1205,10 @@ class ControlServer:
             if stale:
                 try:
                     self.external_storage.delete(uri)
-                except Exception:
-                    pass
+                except Exception as exc:
+                    warn_once(logger, "spill-cleanup", exc,
+                              "could not delete stale spill %s "
+                              "(external storage leaks)", uri)
                 continue
             # Readers that attached before this keep valid views (the
             # arena orphans pinned blocks); late readers restore.
@@ -1586,8 +1609,11 @@ class ControlServer:
                 if entry.spilled_uri:
                     try:
                         self.external_storage.delete(entry.spilled_uri)
-                    except Exception:
-                        pass
+                    except Exception as exc:
+                        warn_once(logger, "spill-cleanup", exc,
+                                  "could not delete spill %s for freed "
+                                  "object (external storage leaks)",
+                                  entry.spilled_uri)
         for obj_hex, node_loc in to_delete:
             self._delete_shm_copy(obj_hex, node_loc)
 
@@ -1607,8 +1633,12 @@ class ControlServer:
         if conn is not None:
             try:
                 conn.push({"op": "delete_object", "obj": obj_hex})
-            except Exception:
-                pass
+            except Exception as exc:
+                # The remote arena keeps the freed copy until that node
+                # restarts — a slow remote leak worth one warning.
+                warn_once(logger, "delete-push", exc,
+                          "could not push delete_object %s to node %s",
+                          obj_hex, node_loc)
 
     def _op_object_replica(self, conn, msg):
         """A client cached a pulled copy in its node's arena: record the

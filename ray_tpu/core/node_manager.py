@@ -104,7 +104,6 @@ def spawn_worker_process(*, control_addr: str, worker_hex: str, kind: str,
     env = dict(os.environ)
     env["RAY_TPU_CONTROL_ADDR"] = control_addr
     env["RAY_TPU_WORKER_ID"] = worker_hex
-    env["RAY_TPU_SESSION_ID"] = session_id
     env["RAY_TPU_WORKER_KIND"] = kind
     env["RAY_TPU_ENV_KEY"] = env_key
     env["RAY_TPU_NAMESPACE"] = namespace

@@ -42,6 +42,7 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ray_tpu.core.ids import ObjectID
+from ray_tpu.core.log_once import warn_once
 
 logger = logging.getLogger(__name__)
 
@@ -131,25 +132,15 @@ def object_metric_snapshots() -> list:
 # Caching a pulled object into the local arena is best-effort, but the
 # old bare `except Exception: pass` made a persistently full arena
 # undiagnosable (every read re-pulled over the wire, silently).  Warn
-# once per distinct cause per interval instead.
-_WARN_INTERVAL_S = 60.0
-_warn_lock = threading.Lock()
-_warned: Dict[str, float] = {}
+# once per distinct cause per interval (shared impl: core/log_once.py).
 
 
 def _warn_arena_cache(exc: BaseException, obj_hex: str = "") -> None:
     OBJ._inc("arena_cache_failures")
-    key = f"{type(exc).__name__}: {str(exc)[:120]}"
-    now = time.monotonic()
-    with _warn_lock:
-        last = _warned.get(key)
-        if last is not None and now - last < _WARN_INTERVAL_S:
-            return
-        _warned[key] = now
-    logger.warning(
-        "could not cache pulled object %s in the local arena "
-        "(reads will keep pulling over the wire): %s",
-        obj_hex or "<unknown>", key)
+    warn_once(logger, "arena-cache", exc,
+              "could not cache pulled object %s in the local arena "
+              "(reads will keep pulling over the wire)",
+              obj_hex or "<unknown>")
 
 
 class PullManager:
@@ -258,8 +249,12 @@ def pull_into_store(client, store, obj_hex: str, size: int, chunk: int,
                 # leave a half-written object for attach() to find.
                 try:
                     store.delete(oid)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    # A reap failure leaks an arena block per aborted
+                    # pull — that slow leak must be visible.
+                    warn_once(logger, "arena-reap", e,
+                              "could not reap partial segment for %s",
+                              obj_hex)
                 raise
             data, cached = _seal_and_reattach(store, oid, obj_hex, size,
                                               seg)
@@ -293,8 +288,9 @@ def _seal_and_reattach(store, oid, obj_hex: str, size: int,
         data = bytes(seg.buf[:size])
         try:
             store.delete(oid)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e2:  # noqa: BLE001
+            warn_once(logger, "arena-reap", e2,
+                      "could not drop unsealed segment for %s", obj_hex)
         return data, False
     try:
         view = store.attach(oid, size)

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
 import os
 import pickle
 import socket
@@ -44,6 +45,10 @@ import threading
 import time
 from collections import deque
 from typing import Any, Callable, Optional
+
+from ray_tpu.core.log_once import warn_once
+
+logger = logging.getLogger(__name__)
 
 _FRAME = struct.Struct("<BQI")
 
@@ -558,8 +563,12 @@ class Deferred:
         # concurrent second resolution can't double-send.
         try:
             conn.respond(req_id, outcome)
-        except Exception:
-            pass
+        except Exception as exc:
+            # The caller never gets its reply — surface it (rate-limited)
+            # so a hung client is diagnosable instead of a silent stall.
+            warn_once(logger, "deferred-respond", exc,
+                      "dropped deferred response req_id=%s (peer gone?)",
+                      req_id)
 
     def resolve(self, value: Any):
         self._finish(("ok", value))
@@ -577,8 +586,10 @@ class Deferred:
             self._conn = None  # double-resolve becomes a no-op
         try:
             conn.respond(req_id, outcome)
-        except Exception:
-            pass
+        except Exception as exc:
+            warn_once(logger, "deferred-respond", exc,
+                      "dropped deferred response req_id=%s (peer gone?)",
+                      req_id)
 
 
 class Server:
@@ -676,8 +687,13 @@ class Server:
             if self._on_disconnect is not None and not self._stopped.is_set():
                 try:
                     self._on_disconnect(conn)
-                except Exception:
-                    pass
+                except Exception as exc:
+                    # A failing disconnect hook silently breaks worker-death
+                    # detection (leases never revoked, actors never failed
+                    # over) — that must never be invisible.
+                    warn_once(logger, "disconnect-hook", exc,
+                              "on_disconnect hook raised for peer %s",
+                              getattr(conn, "peername", "?"))
 
     def _dispatch(self, conn: Connection, kind: int, req_id: int,
                   payload: bytes):

@@ -148,6 +148,62 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     "pg_state": {"pg": "str"},
     # -- serve frame ingress (proxy.py FrameIngress) -------------------
     "serve_request": {"route": "str", "payload": "any?", "headers": "dict?"},
+    # -- push / dispatch ops (head→client, head→node, owner→worker) ----
+    # These ride Python-internal pickled frames, so runtime ingress
+    # never validates them — but they are part of the wire contract all
+    # the same, and raylint's conformance pass requires every op a
+    # dispatch site handles to be declared here (and vice versa).
+    # Task execution pushed to workers (worker._handle_direct /
+    # runtime dispatch).
+    "execute_task": {"spec": "any"},
+    "pool_task": {"spec": "any"},
+    "pool_task_batch": {"specs": "list"},
+    "actor_task": {"spec": "any"},
+    "actor_task_batch": {"specs": "list"},
+    "cancel_pool_task": {"task": "str"},
+    "create_actor_instance": {"spec": "any"},
+    "exit": {},
+    # Owner-direct result return (worker → submitting owner).
+    "direct_result": {"obj": "str", "data": "bytes?", "is_error": "bool?"},
+    "direct_result_batch": {"results": "list"},
+    "direct_result_remote": {"obj": "str"},
+    # Head→client object/actor/cluster notifications.
+    "object_ready": {"obj": "str", "size": "int?", "inline": "bytes?",
+                     "in_shm": "bool?", "is_error": "bool?",
+                     "node": "str?", "addr": "str?"},
+    "actor_update": {"actor": "str", "state": "str?", "address": "str?",
+                     "reason": "str?", "max_task_retries": "int?"},
+    "resource_view": {"seq": "int", "epoch": "str", "nodes": "any"},
+    "cluster_view": {},
+    "node_stats": {"stats": "dict"},
+    # Head→owner lease protocol (the grant/revoke side of
+    # request_lease/release_lease above).
+    "lease_granted": {"token": "int", "workers": "list",
+                      "denied": "bool?", "error": "str?"},
+    "lease_revoked": {"worker": "str", "reason": "str?"},
+    # Head→node worker lifecycle.
+    "spawn_worker": {"worker_hex": "str", "kind": "str",
+                     "env_key": "str?", "namespace": "str?",
+                     "runtime_env": "dict?"},
+    "worker_alive": {"worker_hex": "str"},
+    "worker_spawn_failed": {"worker_hex": "str", "error": "str?"},
+    "worker_setup_failed": {"env_key": "str", "error": "str?"},
+    "get_runtime_env": {"env_key": "str"},
+    # Object plane maintenance (head→node).
+    "delete_object": {"obj": "str"},
+    "object_info": {"obj": "str"},
+    "migrate_objects": {"objects": "list", "dest": "str?",
+                        "dest_node": "str?"},
+    # Streaming generator consumer→head backpressure/free credit.
+    "free_stream": {"task": "str", "from_index": "int",
+                    "eos_consumed": "bool?", "count": "int?"},
+    # Profiling / diagnostics.
+    "profile": {"kind": "str", "token": "str?", "duration_s": "float?"},
+    "profile_worker": {"worker_hex": "str", "kind": "str?",
+                       "duration_s": "float?", "timeout_s": "float?"},
+    "profile_result": {"token": "str", "data": "any?"},
+    "profile_config": {"enabled": "bool?", "interval_s": "float?"},
+    "flight_recorder": {},
 }
 
 _TYPES = {

@@ -1,148 +1,44 @@
 """Static conformance check for the ray_tpu_* metric namespace.
 
-Docs and tests assert against metric names as plain strings; nothing at
-runtime ties those strings to the registration sites in ray_tpu/.  A
-renamed counter silently turns a README example stale and can leave a
-test asserting on a metric that no longer exists (or worse, passing
-because it only checks absence).  This script closes the loop
-statically, in both directions:
-
-  1. every `ray_tpu_*` metric token referenced in tests/ or README.md
-     must correspond to a metric the source actually registers, and
-  2. every metric the source registers must be documented in README.md
-     (the Observability section's catalog).
-
-Registrations are extracted from the AST, not regexed, so arbitrary
-string literals (file prefixes, contextvar names) don't count:
-  - Counter("ray_tpu_...") / Gauge(...) / Histogram(...) registry calls
-  - gauge("ray_tpu_...", ...) helper calls in builtin_snapshots
-  - {"name": "ray_tpu_...", "kind": ...} snapshot dict literals
-  - ("ray_tpu_...", "<description>") 2-tuples (builtin_snapshots'
-    node-stat table)
+Back-compat shim: the checker moved into the unified static-analysis
+suite as the ``conformance`` pass (ray_tpu/analysis/conformance_pass.py
+— rules ``metric-unregistered`` / ``metric-undocumented``); run it via
+``python -m ray_tpu.analysis --passes conformance``.  This wrapper
+keeps the historical CLI and the ``check()`` surface
+tests/test_profiling_watchdog.py loads by file path.
 
 Run: python scripts/check_metrics_conformance.py   (exit 0 = conformant)
-Wired into the suite via tests/test_profiling_watchdog.py.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
 
-_NAME_RE = re.compile(r"\bray_tpu_[a-z0-9_]+\b")
-_METRIC_CALLS = {"Counter", "Gauge", "Histogram", "gauge"}
-
-# ray_tpu_* tokens in tests/ that are NOT metric names (shm file
-# prefixes, temp dirs, log paths) — keep this list short and literal.
-_ALLOWLIST = {
-    "ray_tpu_cpp_example",
-    "ray_tpu_cpp_worker_example",
-    "ray_tpu_shm_example",
-    "ray_tpu_test_watchdog",
-    "ray_tpu_train_",
-}
-
-
-def _iter_py(root: str):
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
+from ray_tpu.analysis.conformance_pass import (  # noqa: E402
+    metrics_problems,
+    referenced_metrics,
+    registered_metrics,
+)
 
 
 def registered_names() -> set:
     """Metric names the ray_tpu/ source registers or synthesizes."""
-    names = set()
-    for path in _iter_py(os.path.join(_ROOT, "ray_tpu")):
-        try:
-            with open(path) as f:
-                tree = ast.parse(f.read())
-        except (OSError, SyntaxError):
-            continue
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Call):
-                fn = node.func
-                fname = (fn.attr if isinstance(fn, ast.Attribute)
-                         else getattr(fn, "id", ""))
-                if fname in _METRIC_CALLS and node.args and \
-                        isinstance(node.args[0], ast.Constant) and \
-                        isinstance(node.args[0].value, str) and \
-                        node.args[0].value.startswith("ray_tpu_"):
-                    names.add(node.args[0].value)
-            elif isinstance(node, ast.Dict):
-                keys = [k.value for k in node.keys
-                        if isinstance(k, ast.Constant)]
-                if "name" not in keys or "kind" not in keys:
-                    continue
-                for k, v in zip(node.keys, node.values):
-                    if isinstance(k, ast.Constant) and \
-                            k.value == "name" and \
-                            isinstance(v, ast.Constant) and \
-                            isinstance(v.value, str) and \
-                            v.value.startswith("ray_tpu_"):
-                        names.add(v.value)
-            elif isinstance(node, ast.Tuple) and len(node.elts) == 2:
-                a, b = node.elts
-                if isinstance(a, ast.Constant) and \
-                        isinstance(a.value, str) and \
-                        a.value.startswith("ray_tpu_") and \
-                        isinstance(b, ast.Constant) and \
-                        isinstance(b.value, str):
-                    names.add(a.value)
-    return names
+    return set(registered_metrics(_ROOT))
 
 
 def referenced_names() -> dict:
     """{token: [locations]} for ray_tpu_* tokens in tests/ + README."""
-    refs: dict = {}
-    paths = list(_iter_py(os.path.join(_ROOT, "tests")))
-    paths.append(os.path.join(_ROOT, "README.md"))
-    for path in paths:
-        try:
-            with open(path) as f:
-                text = f.read()
-        except OSError:
-            continue
-        rel = os.path.relpath(path, _ROOT)
-        for lineno, line in enumerate(text.splitlines(), 1):
-            for tok in _NAME_RE.findall(line):
-                if tok in _ALLOWLIST:
-                    continue
-                refs.setdefault(tok, []).append(f"{rel}:{lineno}")
-    return refs
+    return {tok: [f"{rel}:{lineno}" for rel, lineno in sites]
+            for tok, sites in referenced_metrics(_ROOT).items()}
 
 
 def check() -> list:
     """Return a list of problem strings (empty = conformant)."""
-    registered = registered_names()
-    refs = referenced_names()
-    problems = []
-    # Histogram expositions append _bucket/_sum/_count; a doc or test
-    # may legitimately reference those derived names.
-    derived = set()
-    for n in registered:
-        derived.update({n + "_bucket", n + "_sum", n + "_count"})
-    for tok in sorted(refs):
-        if tok not in registered and tok not in derived:
-            problems.append(
-                f"referenced but never registered: {tok} "
-                f"({', '.join(refs[tok][:3])})")
-    readme_toks = set()
-    try:
-        with open(os.path.join(_ROOT, "README.md")) as f:
-            readme_toks = set(_NAME_RE.findall(f.read()))
-    except OSError:
-        pass
-    for name in sorted(registered):
-        if name not in readme_toks:
-            problems.append(
-                f"registered but undocumented in README.md: {name}")
-    return problems
+    return metrics_problems(_ROOT)
 
 
 def main() -> int:

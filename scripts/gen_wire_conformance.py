@@ -1,150 +1,32 @@
-"""Generate the cross-language wire-conformance artifact.
+"""Regenerate the cross-language wire-conformance artifact.
 
-VERDICT r5 item 8: the wire schema (core/wire_schema.py — the stack's
-proto-IDL tier) needs a GOLDEN artifact a third-language client can be
-validated against without running Python.  This script derives, from
-the schema table alone:
-
-  - the schema document itself (export_schema), and
-  - a golden frame corpus: for every op, one maximal valid frame (all
-    fields), one minimal valid frame (required fields only), and
-    deterministic invalid mutants (missing required field, wrong field
-    type, undeclared field, unknown op) with machine-readable reasons.
-
-Frames are written in the JSON WIRE form the cross-language door
-speaks (bytes as {"__bytes_b64__": ...} envelopes, core/rpc.py).  The
-committed WIRE_CONFORMANCE.json is the contract: the in-tree test
-(tests/test_wire_conformance.py) regenerates and diffs it (schema
-drift fails CI until the corpus is regenerated), then replays every
-frame through the same decode+validate path the ingress runs; a C++ /
-Java / Go client generator replays the same file against its own
-encoder.
+Back-compat delegate: the corpus builder moved into the unified
+static-analysis suite (ray_tpu/analysis/conformance_pass.py), which
+also checks artifact freshness as the ``wire-corpus-drift`` lint rule.
+This wrapper keeps the historical entry point and import surface
+(tests/test_wire_conformance.py does ``from gen_wire_conformance
+import build_corpus``).
 
 Run: python scripts/gen_wire_conformance.py   (rewrites
-WIRE_CONFORMANCE.json at the repo root)
+WIRE_CONFORMANCE.json at the repo root), or equivalently
+``python -m ray_tpu.analysis --regen-wire``.
 """
 
 from __future__ import annotations
 
-import base64
-import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from ray_tpu.core.wire_schema import SCHEMA, export_schema  # noqa: E402
-
-# Deterministic example value per declared field type, in JSON WIRE
-# form (the form the JSON door transports; bytes ride b64 envelopes).
-_EXAMPLES = {
-    "str": "example",
-    "int": 7,
-    "float": 1.5,
-    "bool": True,
-    "bytes": {"__bytes_b64__": base64.b64encode(b"payload").decode()},
-    "list": ["item"],
-    "dict": {"k": "v"},
-    "any": {"nested": ["any", 1]},
-}
-
-# A value guaranteed NOT to satisfy the declared type (for the
-# wrong-type mutants).  "any" accepts everything -> no mutant.
-_WRONG = {
-    "str": 123, "int": "not-an-int", "float": "not-a-float",
-    "bool": "not-a-bool", "bytes": 3.5, "list": "not-a-list",
-    "dict": "not-a-dict",
-}
-
-
-def _example_for(spec: str):
-    base = spec.rstrip("?").split("|")[0]
-    return _EXAMPLES[base]
-
-
-def _wrong_for(spec: str):
-    tname = spec.rstrip("?")
-    if tname == "any":
-        return None
-    # Union types ("bytes|str"): a float satisfies neither arm.
-    if "|" in tname:
-        return 3.5
-    return _WRONG[tname]
-
-
-def build_corpus() -> dict:
-    golden = []
-    for op in sorted(SCHEMA):
-        fields = SCHEMA[op]
-        maximal = {"op": op}
-        minimal = {"op": op}
-        for name, spec in sorted(fields.items()):
-            maximal[name] = _example_for(spec)
-            if not spec.endswith("?"):
-                minimal[name] = _example_for(spec)
-        golden.append({"op": op, "case": "maximal", "valid": True,
-                       "frame": maximal})
-        if minimal != maximal:
-            golden.append({"op": op, "case": "minimal", "valid": True,
-                           "frame": minimal})
-        # invalid: first required field missing
-        required = [n for n, t in sorted(fields.items())
-                    if not t.endswith("?")]
-        if required:
-            broken = dict(minimal)
-            broken.pop(required[0])
-            golden.append({
-                "op": op, "case": f"missing-{required[0]}",
-                "valid": False,
-                "reason": f"required field {required[0]!r} absent",
-                "frame": broken})
-        # invalid: first typable field wrong type
-        for name, spec in sorted(fields.items()):
-            wrong = _wrong_for(spec)
-            if wrong is None:
-                continue
-            broken = dict(minimal)
-            broken[name] = wrong
-            golden.append({
-                "op": op, "case": f"wrong-type-{name}", "valid": False,
-                "reason": f"field {name!r} violates type {spec!r}",
-                "frame": broken})
-            break
-        # invalid: undeclared field
-        broken = dict(minimal)
-        broken["__undeclared__"] = 1
-        golden.append({
-            "op": op, "case": "undeclared-field", "valid": False,
-            "reason": "fields outside the contract are rejected",
-            "frame": broken})
-    golden.append({"op": "__unknown__", "case": "unknown-op",
-                   "valid": False,
-                   "reason": "unknown ops fail closed",
-                   "frame": {"op": "__unknown__"}})
-    return {
-        "format": "ray_tpu wire conformance v1",
-        "note": ("Golden corpus for non-Python clients (reference: the "
-                 "proto IDL contract every language compiles against, "
-                 "src/ray/protobuf/).  'frame' is the JSON WIRE form "
-                 "(bytes as {'__bytes_b64__': ...}); a conforming "
-                 "client encoder must produce frames the schema "
-                 "accepts and must not produce any frame it rejects."),
-        "schema": export_schema(),
-        "golden": golden,
-    }
+from ray_tpu.analysis.conformance_pass import (  # noqa: E402,F401
+    build_corpus,
+    write_corpus,
+)
 
 
 def main() -> int:
-    out = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "WIRE_CONFORMANCE.json")
-    doc = build_corpus()
-    with open(out, "w") as f:
-        json.dump(doc, f, indent=1, sort_keys=True)
-        f.write("\n")
-    n_valid = sum(1 for g in doc["golden"] if g["valid"])
-    print(f"wrote {out}: {len(doc['schema']['ops'])} ops, "
-          f"{len(doc['golden'])} frames ({n_valid} valid, "
-          f"{len(doc['golden']) - n_valid} invalid)")
+    write_corpus()
     return 0
 
 

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""raylint — thin wrapper so the suite runs as a script from anywhere:
+
+    python scripts/raylint.py [--passes knobs,except,...] [...]
+
+is exactly ``python -m ray_tpu.analysis`` with the repo on sys.path.
+See README "Static analysis" for the pass list, the suppression
+comment syntax, and when (not) to touch the baseline.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ray_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -388,7 +388,8 @@ def test_arena_cache_failure_warns_once_per_cause(store, caplog):
         def create(self, oid, size):
             raise MemoryError("arena full (test)")
 
-    object_plane._warned.clear()
+    from ray_tpu.core import log_once
+    log_once.reset()
     try:
         with caplog.at_level("WARNING", logger="ray_tpu.core.object_plane"):
             for hex_ in ("11" * 14, "22" * 14):

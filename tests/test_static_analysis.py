@@ -1,0 +1,437 @@
+"""raylint — the AST static-analysis suite (ray_tpu/analysis/).
+
+Each pass is exercised against small fixture snippets/trees (positive,
+negative, suppression, baseline), then the whole repo is run through
+the real runner and must exit 0: the suite at head is conformant by
+construction, and any regression (new swallow, undeclared wire op,
+unregistered knob, blocking call on the receive path) fails here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from ray_tpu.analysis import core as acore  # noqa: E402
+from ray_tpu.analysis import (  # noqa: E402
+    blocking_pass,
+    conformance_pass,
+    except_pass,
+    knob_pass,
+)
+from ray_tpu.analysis.__main__ import PASSES, main as raylint_main  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# exception hygiene
+# --------------------------------------------------------------------------
+
+def _swallow_lines(source):
+    return [v.line for v in
+            except_pass.scan_source(textwrap.dedent(source), "x.py")]
+
+
+def test_swallow_detects_pass_continue_and_return_none():
+    src = """
+    def f(items):
+        try:
+            risky()
+        except Exception:
+            pass
+        for it in items:
+            try:
+                risky(it)
+            except ValueError:
+                continue
+        try:
+            return risky()
+        except OSError:
+            return None
+    """
+    assert len(_swallow_lines(src)) == 3
+
+
+def test_swallow_ignores_handlers_that_do_something():
+    src = """
+    import logging
+    def f():
+        try:
+            risky()
+        except Exception as exc:
+            logging.warning("boom: %s", exc)
+        try:
+            risky()
+        except Exception:
+            cleanup()
+            return None
+        try:
+            return risky()
+        except OSError:
+            return 0
+    """
+    assert _swallow_lines(src) == []
+
+
+def test_swallow_suppression_and_baseline(tmp_path):
+    code = textwrap.dedent("""
+        def f():
+            try:
+                risky()
+            except Exception:  # raylint: allow-swallow(best-effort probe)
+                pass
+            try:
+                risky()
+            except Exception:
+                pass
+    """)
+    (tmp_path / "mod.py").write_text(code)
+    violations = except_pass.scan_source(code, "mod.py")
+    assert len(violations) == 2
+
+    # Suppression silences only the annotated site.
+    res = acore.apply_filters(str(tmp_path), violations, baseline={})
+    assert len(res.suppressed) == 1 and res.suppressed[0][1] == \
+        "best-effort probe"
+    assert len(res.new) == 1
+
+    # A reason-less allow-comment does NOT count.
+    bad = code.replace("(best-effort probe)", "()")
+    (tmp_path / "mod.py").write_text(bad)
+    res = acore.apply_filters(
+        str(tmp_path), except_pass.scan_source(bad, "mod.py"), baseline={})
+    assert len(res.new) == 2
+
+    # Baselining freezes the remaining site; a NEW swallow still fails.
+    (tmp_path / "mod.py").write_text(code)
+    baseline = acore.build_baseline(str(tmp_path), violations)
+    res = acore.apply_filters(str(tmp_path), violations, baseline)
+    assert len(res.new) == 0 and len(res.baselined) == 1
+
+    grown = code + textwrap.dedent("""
+        def g():
+            try:
+                risky()
+            except ValueError:
+                pass
+    """)
+    (tmp_path / "mod.py").write_text(grown)
+    res = acore.apply_filters(
+        str(tmp_path), except_pass.scan_source(grown, "mod.py"), baseline)
+    assert len(res.new) == 1 and res.new[0].line > 8
+
+
+def test_baseline_keys_survive_line_drift(tmp_path):
+    code = "def f():\n    try:\n        g()\n    except OSError:\n" \
+           "        pass\n"
+    (tmp_path / "m.py").write_text(code)
+    vs = except_pass.scan_source(code, "m.py")
+    baseline = acore.build_baseline(str(tmp_path), vs)
+    # Unrelated lines added ABOVE the frozen site: keys still match.
+    shifted = "import os\nimport sys\n\n" + code
+    (tmp_path / "m.py").write_text(shifted)
+    vs2 = except_pass.scan_source(shifted, "m.py")
+    res = acore.apply_filters(str(tmp_path), vs2, baseline)
+    assert res.new == [] and len(res.baselined) == 1
+
+
+# --------------------------------------------------------------------------
+# knob registry
+# --------------------------------------------------------------------------
+
+def _knob_fixture(tmp_path, *, register=True, document=True, read=True):
+    core_dir = tmp_path / "ray_tpu" / "core"
+    core_dir.mkdir(parents=True)
+    (tmp_path / "ray_tpu" / "__init__.py").write_text("")
+    (core_dir / "__init__.py").write_text("")
+    knob_decl = ('KNOBS = [Knob("RAY_TPU_DEMO_KNOB", "1", "bool", '
+                 '"user", "demo")]\n') if register else "KNOBS = []\n"
+    (core_dir / "knobs.py").write_text(knob_decl + "_CONFIG_DOCS = {}\n")
+    (core_dir / "config.py").write_text("class Config:\n    pass\n")
+    reader = ('import os\n'
+              'V = os.environ.get("RAY_TPU_DEMO_KNOB", "1")\n'
+              if read else "V = 1\n")
+    (core_dir / "app.py").write_text(reader)
+    table = ("# demo\n\n## Configuration knobs\n\n"
+             "| `RAY_TPU_DEMO_KNOB` | `1` | bool | demo |\n")
+    (tmp_path / "README.md").write_text(
+        table if document else "# demo\n")
+    return str(tmp_path)
+
+
+def test_knob_pass_clean_fixture(tmp_path):
+    root = _knob_fixture(tmp_path)
+    assert knob_pass.run(root) == []
+
+
+def test_knob_pass_unregistered(tmp_path):
+    root = _knob_fixture(tmp_path, register=False, document=False)
+    rules = {v.rule for v in knob_pass.run(root)}
+    assert "knob-unregistered" in rules
+
+
+def test_knob_pass_dead_and_undocumented(tmp_path):
+    root = _knob_fixture(tmp_path, read=False, document=False)
+    rules = {v.rule for v in knob_pass.run(root)}
+    assert {"knob-dead", "knob-undocumented"} <= rules
+
+
+def test_knob_pass_stale_doc(tmp_path):
+    root = _knob_fixture(tmp_path)
+    readme = tmp_path / "README.md"
+    readme.write_text(readme.read_text() +
+                      "| `RAY_TPU_GHOST_KNOB` | `x` | str | gone |\n")
+    rules = {v.rule for v in knob_pass.run(root)}
+    assert "knob-stale-doc" in rules
+
+
+def test_knob_pass_config_drift(tmp_path):
+    root = _knob_fixture(tmp_path)
+    core_dir = tmp_path / "ray_tpu" / "core"
+    (core_dir / "config.py").write_text(
+        "class Config:\n    new_field: int = 3\n")
+    rules = {v.rule for v in knob_pass.run(root)}
+    assert "knob-config-drift" in rules
+
+
+# --------------------------------------------------------------------------
+# receive-loop / lock discipline
+# --------------------------------------------------------------------------
+
+def _blocking_violations(source, entries=("Server._handle",),
+                         check_locks=False):
+    import ast
+    tree = ast.parse(textwrap.dedent(source))
+    return blocking_pass.scan_module(
+        tree, "mod.py", entry_patterns=entries, check_locks=check_locks)
+
+
+def test_blocking_flags_sleep_in_handler():
+    src = """
+    import time
+    class Server:
+        def _handle(self, msg):
+            self._slow_path()
+        def _slow_path(self):
+            time.sleep(1.0)
+    """
+    vs = _blocking_violations(src)
+    assert len(vs) == 1
+    assert vs[0].rule == "blocking-reachable"
+    assert "time.sleep" in vs[0].message
+    assert "_slow_path" in vs[0].message  # call chain is reported
+
+
+def test_blocking_flags_untimed_result_and_acquire():
+    src = """
+    class Server:
+        def _handle(self, msg):
+            fut.result()
+            self._lock.acquire()
+    """
+    reasons = {v.message.split(" reachable")[0]
+               for v in _blocking_violations(src)}
+    assert ".result() with no timeout" in reasons
+    assert ".acquire() with no timeout" in reasons
+
+
+def test_blocking_ok_with_timeouts_or_off_path():
+    src = """
+    import time
+    class Server:
+        def _handle(self, msg):
+            fut.result(timeout=5.0)
+            self._lock.acquire(timeout=1.0)
+        def unrelated(self):
+            time.sleep(9.9)
+    """
+    assert _blocking_violations(src) == []
+
+
+def test_blocking_wildcard_entry_matches_op_handlers():
+    src = """
+    import time
+    class Server:
+        def _op_slow(self, msg):
+            time.sleep(0.5)
+        def _op_fast(self, msg):
+            return 1
+    """
+    vs = _blocking_violations(src, entries=("Server._op_*",))
+    assert len(vs) == 1 and "_op_slow" in vs[0].message
+
+
+def test_blocking_under_lock():
+    src = """
+    import time
+    class Store:
+        def put(self, k, v):
+            with self._lock:
+                time.sleep(0.1)
+        def get(self, k):
+            with self._lock:
+                return self._d[k]
+    """
+    vs = _blocking_violations(src, entries=(), check_locks=True)
+    assert len(vs) == 1 and vs[0].rule == "blocking-under-lock"
+
+
+# --------------------------------------------------------------------------
+# wire / metrics conformance
+# --------------------------------------------------------------------------
+
+def test_wire_handled_op_extraction():
+    import ast
+    src = textwrap.dedent("""
+        class ControlServer:
+            def _op_ping(self, msg):
+                return {}
+        def dispatch(msg):
+            op = msg.get("op")
+            if op == "alpha":
+                return 1
+            if msg.get("op") in ("beta", "gamma"):
+                return 2
+            if msg["op"] != "delta":
+                return 3
+    """)
+    ops = conformance_pass.extract_handled_ops(ast.parse(src))
+    assert set(ops) == {"ping", "alpha", "beta", "gamma", "delta"}
+
+
+def test_wire_both_directions(tmp_path):
+    (tmp_path / "handlers.py").write_text(textwrap.dedent("""
+        def dispatch(op, msg):
+            if op == "declared_op":
+                return 1
+            if op == "rogue_op":
+                return 2
+    """))
+    vs = conformance_pass.run_wire(
+        str(tmp_path), handler_modules=("handlers.py",),
+        schema_ops={"declared_op", "ghost_op"})
+    by_rule = {}
+    for v in vs:
+        by_rule.setdefault(v.rule, []).append(v)
+    assert len(by_rule["wire-undeclared"]) == 1
+    assert "rogue_op" in by_rule["wire-undeclared"][0].message
+    assert len(by_rule["wire-unhandled"]) == 1
+    assert "ghost_op" in by_rule["wire-unhandled"][0].message
+
+
+def test_wire_repo_schema_covers_all_handled_ops():
+    vs = conformance_pass.run_wire(REPO_ROOT)
+    assert [v.render() for v in vs] == []
+
+
+def test_metrics_pass_matches_legacy_checker_shape(tmp_path):
+    # The shim's check() must return [] at head (it is loaded by path
+    # in test_profiling_watchdog.py).
+    assert conformance_pass.metrics_problems(REPO_ROOT) == []
+
+
+def test_wire_corpus_is_fresh():
+    with open(os.path.join(REPO_ROOT, "WIRE_CONFORMANCE.json")) as f:
+        committed = json.load(f)
+    assert committed == conformance_pass.build_corpus()
+
+
+# --------------------------------------------------------------------------
+# log_once (the swallow-fix utility)
+# --------------------------------------------------------------------------
+
+def test_log_once_rate_limits_per_cause():
+    import logging
+
+    from ray_tpu.core import log_once
+
+    log_once.reset()
+    records = []
+
+    class _H(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger("test_log_once")
+    logger.addHandler(_H())
+    logger.setLevel(logging.WARNING)
+    try:
+        exc = ValueError("boom")
+        assert log_once.warn_once(logger, "t", exc, "first")
+        assert not log_once.warn_once(logger, "t", exc, "second")
+        # distinct cause -> logs
+        assert log_once.warn_once(logger, "t", KeyError("k"), "third")
+        # zero interval -> window expired, suppressed count surfaces
+        assert log_once.warn_once(logger, "t", exc, "fourth",
+                                  interval_s=0.0)
+        assert len(records) == 3
+        assert "boom" in records[0]
+        assert "[1 similar suppressed]" in records[2]
+    finally:
+        log_once.reset()
+
+
+# --------------------------------------------------------------------------
+# the real repo, through the real runner
+# --------------------------------------------------------------------------
+
+def test_runner_whole_repo_exits_zero(capsys):
+    rc = raylint_main(["--root", REPO_ROOT, "-q"])
+    out = capsys.readouterr().out
+    assert rc == 0, f"raylint regressions:\n{out}"
+
+
+def test_runner_exits_nonzero_on_seeded_violations(tmp_path):
+    # One seeded violation per pass family, reported with file:line.
+    root = _knob_fixture(tmp_path)
+    bad = tmp_path / "ray_tpu" / "core" / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import os, time
+        UNREG = os.environ.get("RAY_TPU_NOT_A_KNOB", "")
+        class ControlServer:
+            def _op_rogue(self, msg):
+                time.sleep(1)
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    import ray_tpu.analysis.blocking_pass as bp
+    import ray_tpu.analysis.conformance_pass as cp
+    entry = {"ray_tpu/core/bad.py": ("ControlServer._op_*",)}
+    violations = []
+    violations += knob_pass.run(root)
+    violations += except_pass.run(root)
+    violations += bp.run(root, entry_points=entry, lock_modules=())
+    violations += cp.run_wire(root,
+                              handler_modules=("ray_tpu/core/bad.py",),
+                              schema_ops=set())
+    rules = {v.rule for v in violations}
+    assert {"knob-unregistered", "swallow", "blocking-reachable",
+            "wire-undeclared"} <= rules
+    for v in violations:
+        assert v.path and v.line >= 1 and ":" in v.render()
+
+
+def test_runner_cli_list_passes():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "raylint.py"),
+         "--list-passes"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0
+    assert set(out.stdout.split()) == set(PASSES)
+
+
+def test_baseline_file_is_loadable_and_nonempty():
+    entries = acore.load_baseline()
+    assert entries, "analysis/baseline.json missing or empty"
+    assert all(isinstance(n, int) and n >= 1 for n in entries.values())
+    families = {k.split("::", 1)[0].split("-")[0] for k in entries}
+    assert "swallow" in families

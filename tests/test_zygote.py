@@ -233,7 +233,6 @@ def test_stale_spawn_nonce_reaped(zcluster, tmp_path):
     env = dict(os.environ)
     env["RAY_TPU_CONTROL_ADDR"] = "127.0.0.1:%d" % lsock.getsockname()[1]
     env["RAY_TPU_WORKER_ID"] = "e" * 32
-    env["RAY_TPU_SESSION_ID"] = "stale-test"
     env["RAY_TPU_WORKER_KIND"] = "pool"
     env["RAY_TPU_ENV_KEY"] = ""
     env["RAY_TPU_NAMESPACE"] = ""
