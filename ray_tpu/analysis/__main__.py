@@ -3,9 +3,12 @@
 
 Exit status is 0 iff no pass reports a violation that is neither
 suppressed in-source (``# raylint: allow-<family>(<reason>)``) nor
-frozen in ``analysis/baseline.json``.  ``--update-baseline`` rewrites
-the baseline from the current tree (do this only when introducing a
-rule — fixes should SHRINK the baseline, not refresh it).
+frozen in ``analysis/baseline.json``, AND every baseline entry still
+matches a live violation.  The baseline is a ratchet: stale entries
+(fixed sites) fail the run until ``--update-baseline`` shrinks them
+out, and ``--update-baseline`` itself refuses to GROW the entry or
+occurrence totals unless ``--allow-baseline-growth`` is given — so
+frozen debt can only go down over time.
 """
 
 from __future__ import annotations
@@ -44,7 +47,12 @@ def main(argv=None) -> int:
                          " 'none' disables the baseline)")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from the current tree "
-                         "instead of failing")
+                         "instead of failing (ratcheted: refuses to "
+                         "grow the baseline)")
+    ap.add_argument("--allow-baseline-growth", action="store_true",
+                    help="let --update-baseline add entries / raise "
+                         "occurrence counts (only when introducing a "
+                         "new rule)")
     ap.add_argument("--show-baselined", action="store_true",
                     help="also print baselined (non-failing) violations")
     ap.add_argument("--regen-wire", action="store_true",
@@ -87,6 +95,23 @@ def main(argv=None) -> int:
     if args.update_baseline:
         path = args.baseline or _core.BASELINE_PATH
         entries = _core.build_baseline(args.root, violations)
+        old = _core.load_baseline(path)
+        grew_entries = [k for k in entries
+                        if entries[k] > old.get(k, 0)]
+        grew = (bool(grew_entries)
+                or sum(entries.values()) > sum(old.values()))
+        if grew and not args.allow_baseline_growth:
+            print("raylint: refusing to grow the baseline "
+                  f"({len(old)} entries / {sum(old.values())} occ "
+                  f"-> {len(entries)} / {sum(entries.values())}); "
+                  "fix or suppress the new sites, or pass "
+                  "--allow-baseline-growth when introducing a rule",
+                  file=sys.stderr)
+            for k in sorted(grew_entries)[:20]:
+                print(f"  would add/raise: {k} "
+                      f"({old.get(k, 0)} -> {entries[k]})",
+                      file=sys.stderr)
+            return 1
         _core.save_baseline(entries, path)
         if not args.quiet:
             print(f"raylint: baseline rewritten: {len(entries)} "
@@ -106,12 +131,36 @@ def main(argv=None) -> int:
             print(f"{v.render()}  [baselined]")
     for v in result.new:
         print(v.render())
+    # Ratchet: only flag stale entries for the passes that actually
+    # ran, so `--passes knobs` does not complain about swallow debt.
+    prefixes = tuple(f"{rule}" for rule in _stale_prefixes(names))
+    stale = {k: n for k, n in result.stale.items()
+             if k.startswith(prefixes)} if prefixes else {}
+    for key in sorted(stale):
+        print(f"stale baseline entry (site fixed or moved): {key} "
+              f"(x{stale[key]}); shrink with --update-baseline")
     if not args.quiet:
         print(f"raylint: {len(names)} pass(es): "
               f"{len(result.new)} new, {len(result.baselined)} "
-              f"baselined, {len(result.suppressed)} suppressed",
+              f"baselined, {len(result.suppressed)} suppressed, "
+              f"{len(stale)} stale",
               file=sys.stderr)
-    return 1 if result.new else 0
+    return 1 if (result.new or stale) else 0
+
+
+def _stale_prefixes(pass_names: List[str]) -> List[str]:
+    """Baseline-key rule prefixes owned by the given passes (keys are
+    ``rule::path::line``; rules are namespaced per pass family)."""
+    owned = {
+        "knobs": ["knob-"],
+        "except": ["swallow"],
+        "blocking": ["blocking-"],
+        "conformance": ["wire-", "metric-"],
+    }
+    out: List[str] = []
+    for name in pass_names:
+        out.extend(owned.get(name, []))
+    return out
 
 
 if __name__ == "__main__":

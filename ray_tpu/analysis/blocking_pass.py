@@ -9,17 +9,25 @@ socket — stalls every other message behind it (PR 4 explicitly moved
 same applies to code holding a lock: a blocking call inside a
 ``with lock:`` body turns one slow peer into a process-wide convoy.
 
-This pass walks the *intra-module* call graph from a declared set of
-hot entry points (the dispatch side of the receive loops, the gcs op
-handlers, the coalescing flusher) and flags blocking primitives
-reachable from them:
+This pass walks the call graph from a declared set of hot entry points
+(the dispatch side of the receive loops, the gcs op handlers, the
+coalescing flusher) and flags blocking primitives reachable from them:
 
   * ``time.sleep(...)``
   * socket ``recv`` / ``recv_into`` / ``accept`` / ``connect`` /
     ``create_connection``
+  * ``os.fsync`` / ``os.fdatasync`` (durable-write stalls)
   * ``<lock>.acquire()`` with no timeout/blocking argument
   * ``.result()`` with no timeout
   * ``subprocess.run/call/check_output/check_call/Popen``
+
+The graph is intra-module plus ONE import hop: a call through a
+``ray_tpu.*`` module alias (``mod.func(...)``) or an imported
+``ray_tpu`` function is followed into the target module's own
+intra-module graph (the target's imports are not followed further).
+This is what proves, e.g., that the ops journal's ``os.fsync`` lives
+only on its writer thread and is unreachable from any receive-loop
+entry point.
 
 It also scans, in the same modules, every ``with <lock>:`` body for the
 same primitives (directly, or one call away through a module-local
@@ -67,6 +75,14 @@ DEFAULT_ENTRY_POINTS: Dict[str, Tuple[str, ...]] = {
     "ray_tpu/core/node_manager.py": (
         "NodeManager._on_push", "NodeManager._handle",
     ),
+    # Ops-journal enqueue side: called from op handlers and the flight
+    # recorder on the receive path.  Disk IO (write + fsync) must stay
+    # on the journal's writer thread, so `append` and the `stream`
+    # accessor must never reach a blocking primitive.
+    "ray_tpu/util/journal.py": ("Journal.append", "stream"),
+    # Flight recorder record/dump run inside receive loops and op
+    # handlers respectively.
+    "ray_tpu/util/flight_recorder.py": ("record", "dump"),
 }
 
 # Modules whose `with lock:` bodies are swept (the hot control plane).
@@ -77,6 +93,7 @@ DEFAULT_LOCK_MODULES: Tuple[str, ...] = (
     "ray_tpu/core/worker.py",
     "ray_tpu/core/node_manager.py",
     "ray_tpu/core/object_plane.py",
+    "ray_tpu/util/journal.py",
 )
 
 _SOCKET_BLOCKERS = {"recv", "recv_into", "accept", "connect",
@@ -112,6 +129,8 @@ def blocking_reason(node: ast.Call) -> Optional[str]:
         return f"socket.{attr}"
     if recv == "subprocess" and attr in _SUBPROCESS_FNS:
         return f"subprocess.{attr}"
+    if recv == "os" and attr in ("fsync", "fdatasync"):
+        return f"os.{attr}"
     if attr in _SOCKET_BLOCKERS and recv not in ("", "self"):
         # sock.recv(...), conn.accept(...) — socket methods by name.
         # Skip obvious non-socket receivers the control plane uses.
@@ -160,11 +179,17 @@ class _ModuleGraph:
                 self.classes[node.name] = methods
         self._edges: Dict[str, Set[str]] = {}
         self._direct: Dict[str, List[Tuple[int, str]]] = {}
+        # Every (receiver, attr) call pair per function, for the
+        # cross-module hop (resolved against the caller's imports).
+        self._calls: Dict[str, Set[Tuple[str, str]]] = {}
         for qual, fn in self.funcs.items():
             self._edges[qual] = self._find_edges(qual, fn)
             self._direct[qual] = [
                 (n.lineno, reason)
                 for n, reason in self._iter_blocking(fn)]
+            self._calls[qual] = {
+                _call_name(node) for node in ast.walk(fn)
+                if isinstance(node, ast.Call)}
 
     def _iter_blocking(self, fn) -> Iterable[Tuple[ast.Call, str]]:
         for node in ast.walk(fn):
@@ -220,9 +245,83 @@ class _ModuleGraph:
         return hits[0][2] if hits else None
 
 
+def module_imports(tree: ast.AST, root: str) -> Dict[str, Tuple[str, str]]:
+    """``alias -> (repo-relative module path, imported function or "")``
+    for every ``ray_tpu.*`` import in the module, including
+    function-level imports.  ``from ray_tpu.util import journal as j``
+    maps ``j -> ("ray_tpu/util/journal.py", "")``; ``from
+    ray_tpu.core.log_once import warn_once`` maps ``warn_once ->
+    ("ray_tpu/core/log_once.py", "warn_once")``."""
+    out: Dict[str, Tuple[str, str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith("ray_tpu.") and a.asname:
+                    rel = a.name.replace(".", "/") + ".py"
+                    if os.path.isfile(os.path.join(root, rel)):
+                        out[a.asname] = (rel, "")
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 and \
+                node.module and node.module.startswith("ray_tpu"):
+            base = node.module.replace(".", "/")
+            for a in node.names:
+                alias = a.asname or a.name
+                mod_rel = f"{base}/{a.name}.py"
+                if os.path.isfile(os.path.join(root, mod_rel)):
+                    out[alias] = (mod_rel, "")
+                elif os.path.isfile(os.path.join(root, base + ".py")):
+                    out[alias] = (base + ".py", a.name)
+    return out
+
+
+def _cross_hits(graph: "_ModuleGraph", entry: str,
+                imports: Dict[str, Tuple[str, str]],
+                load_graph) -> List[Tuple[str, int, str, str]]:
+    """Blocking sites one import hop away from `entry`: calls through a
+    ray_tpu module alias (``mod.func(...)``) or an imported ray_tpu
+    function, traced through the TARGET module's intra-module graph
+    only (no second hop).  Returns (target_path, lineno, reason,
+    chain)."""
+    hits: List[Tuple[str, int, str, str]] = []
+    seen = {entry}
+    stack = [(entry, (entry,))]
+    visited: Set[Tuple[str, str]] = set()
+    while stack:
+        qual, chain = stack.pop()
+        for recv, attr in sorted(graph._calls.get(qual, ())):
+            if recv in imports and not imports[recv][1]:
+                rel, tqual = imports[recv][0], attr
+            elif recv == "" and attr in imports and imports[attr][1]:
+                rel, tqual = imports[attr]
+            else:
+                continue
+            if (rel, tqual) in visited or rel == graph.path:
+                continue
+            visited.add((rel, tqual))
+            tg = load_graph(rel)
+            if tg is None:
+                continue
+            if tqual not in tg.funcs:
+                if tqual in tg.classes and \
+                        f"{tqual}.__init__" in tg.funcs:
+                    tqual = f"{tqual}.__init__"
+                else:
+                    continue
+            mod = rel.rsplit("/", 1)[-1][:-3]
+            for _, lineno, reason, sub in tg.reachable_blocking(tqual):
+                hits.append((rel, lineno, reason,
+                             " -> ".join(chain) + f" => {mod}:{sub}"))
+        for nxt in sorted(graph._edges.get(qual, ())):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, chain + (nxt,)))
+    return hits
+
+
 def scan_module(tree: ast.AST, path: str,
                 entry_patterns: Iterable[str] = (),
-                check_locks: bool = True) -> List[_core.Violation]:
+                check_locks: bool = True,
+                imports: Optional[Dict[str, Tuple[str, str]]] = None,
+                load_graph=None) -> List[_core.Violation]:
     graph = _ModuleGraph(tree, path)
     violations: List[_core.Violation] = []
 
@@ -232,6 +331,14 @@ def scan_module(tree: ast.AST, path: str,
                 rule=RULE_REACH, path=path, line=lineno,
                 message=(f"{reason} reachable from receive-path entry "
                          f"{entry} (via {chain})")))
+        if imports and load_graph is not None:
+            for vpath, lineno, reason, chain in _cross_hits(
+                    graph, entry, imports, load_graph):
+                violations.append(_core.Violation(
+                    rule=RULE_REACH, path=vpath, line=lineno,
+                    message=(f"{reason} reachable from receive-path "
+                             f"entry {entry} in {path} "
+                             f"(via {chain})")))
 
     if check_locks:
         for qual, fn in graph.funcs.items():
@@ -272,11 +379,11 @@ def scan_module(tree: ast.AST, path: str,
                                              f"inside a `with lock:` "
                                              f"body ({qual})")))
     # De-duplicate: one site can be reachable from many entries; report
-    # each (rule, line, leading-reason) once.
-    seen: Set[Tuple[str, int, str]] = set()
+    # each (rule, path, line, leading-reason) once.
+    seen: Set[Tuple[str, str, int, str]] = set()
     unique = []
     for v in violations:
-        key = (v.rule, v.line, v.message.split(" (")[0])
+        key = (v.rule, v.path, v.line, v.message.split(" (")[0])
         if key not in seen:
             seen.add(key)
             unique.append(v)
@@ -292,16 +399,46 @@ def run(root: str,
     lock_modules = (DEFAULT_LOCK_MODULES if lock_modules is None
                     else lock_modules)
     modules = sorted(set(entry_points) | set(lock_modules))
+
+    trees: Dict[str, Optional[ast.AST]] = {}
+
+    def _load_tree(rel: str) -> Optional[ast.AST]:
+        if rel not in trees:
+            try:
+                with open(os.path.join(root, rel), encoding="utf-8",
+                          errors="replace") as f:
+                    trees[rel] = ast.parse(f.read())
+            except (OSError, SyntaxError):
+                trees[rel] = None
+        return trees[rel]
+
+    graphs: Dict[str, Optional[_ModuleGraph]] = {}
+
+    def _load_graph(rel: str) -> Optional[_ModuleGraph]:
+        if rel not in graphs:
+            tree = _load_tree(rel)
+            graphs[rel] = (_ModuleGraph(tree, rel)
+                           if tree is not None else None)
+        return graphs[rel]
+
     violations: List[_core.Violation] = []
     for rel in modules:
-        try:
-            with open(os.path.join(root, rel), encoding="utf-8",
-                      errors="replace") as f:
-                tree = ast.parse(f.read())
-        except (OSError, SyntaxError):
+        tree = _load_tree(rel)
+        if tree is None:
             continue
         violations.extend(scan_module(
             tree, rel,
             entry_patterns=entry_points.get(rel, ()),
-            check_locks=rel in lock_modules))
-    return violations
+            check_locks=rel in lock_modules,
+            imports=module_imports(tree, root),
+            load_graph=_load_graph))
+    # Cross-hop findings land on the TARGET module, so two scanning
+    # modules can report the same site: keep the first.
+    seen: Set[Tuple[str, str, int, str]] = set()
+    unique = []
+    for v in violations:
+        key = (v.rule, v.path, v.line, v.message.split(" (")[0])
+        if key not in seen:
+            seen.add(key)
+            unique.append(v)
+    return unique

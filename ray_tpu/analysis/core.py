@@ -157,11 +157,17 @@ class FilterResult:
     new: List[Violation]
     baselined: List[Violation]
     suppressed: List[Tuple[Violation, str]]   # (violation, reason)
+    # Ratchet: baseline entries (key -> unmatched count) that no live
+    # violation consumed this run.  A fixed site must leave the
+    # baseline (--update-baseline, which may only shrink it), so the
+    # frozen debt can never silently regrow to its old ceiling.
+    stale: Dict[str, int] = dataclasses.field(default_factory=dict)
 
 
 def apply_filters(root: str, violations: List[Violation],
                   baseline: Dict[str, int]) -> FilterResult:
-    """Split raw violations into new / baselined / suppressed."""
+    """Split raw violations into new / baselined / suppressed, and
+    surface stale (unconsumed) baseline capacity."""
     src = _SourceCache(root)
     remaining = dict(baseline)
     out = FilterResult([], [], [])
@@ -176,6 +182,7 @@ def apply_filters(root: str, violations: List[Violation],
             out.baselined.append(v)
             continue
         out.new.append(v)
+    out.stale = {k: n for k, n in remaining.items() if n > 0}
     return out
 
 

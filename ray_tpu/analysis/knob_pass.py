@@ -20,7 +20,12 @@ never imports the code under analysis) and enforces, bidirectionally:
   * documented-but-unregistered — a ``RAY_TPU_*`` name in README's
     "Configuration knobs" table that the registry does not declare;
   * config-docs drift — ``_CONFIG_DOCS`` keys out of sync with the
-    ``Config`` dataclass fields (both directions).
+    ``Config`` dataclass fields (both directions);
+  * default drift — the Default cell of a README table row disagrees
+    with the registry's literal default (``Knob(...)`` second argument,
+    or the ``Config`` field default for derived knobs).  The table
+    renders an empty default as ``*(unset)*``; both spellings compare
+    equal.
 """
 
 from __future__ import annotations
@@ -147,6 +152,72 @@ def extract_registry(tree: ast.AST) -> Tuple[Dict[str, int], Dict[str, int]]:
     return knobs, config_docs
 
 
+def extract_registry_defaults(tree: ast.AST) -> Dict[str, str]:
+    """{knob_name: default string} from Knob(...) literals (second
+    positional argument; entries with a non-literal default are
+    skipped rather than guessed)."""
+    defaults: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            fname = (fn.attr if isinstance(fn, ast.Attribute)
+                     else getattr(fn, "id", ""))
+            if fname in ("Knob", "K") and len(node.args) >= 2:
+                name = _const_str(node.args[0])
+                arg = node.args[1]
+                if name and isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str):
+                    defaults[name] = arg.value
+    return defaults
+
+
+def extract_config_defaults(tree: ast.AST) -> Dict[str, str]:
+    """{field: str(default)} for Config dataclass fields whose default
+    is a plain literal — matches how config_knobs() renders them."""
+    defaults: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and \
+                        isinstance(stmt.target, ast.Name) and \
+                        stmt.value is not None:
+                    try:
+                        val = ast.literal_eval(stmt.value)
+                    # raylint: allow-swallow(non-literal default: skip the drift check rather than guess)
+                    except (ValueError, SyntaxError):
+                        continue
+                    defaults[stmt.target.id] = str(val)
+    return defaults
+
+
+# One generated table row: `| \`NAME\` | \`DEFAULT\` | type | doc |`.
+_README_ROW_RE = re.compile(
+    r"^\|\s*`(RAY_TPU_[A-Z0-9_]+)`\s*\|\s*`([^`]*)`\s*\|")
+
+
+def readme_table_defaults(readme_text: str
+                          ) -> Dict[str, Tuple[str, int]]:
+    """{name: (default cell, 1-indexed line)} for rows of the README
+    knob-table section.  The rendered ``*(unset)*`` placeholder is
+    normalized back to the empty string."""
+    start = readme_text.find(README_SECTION)
+    if start < 0:
+        return {}
+    first_line = readme_text.count("\n", 0, start) + 1
+    rest = readme_text[start + len(README_SECTION):]
+    nxt = rest.find("\n## ")
+    section = rest if nxt < 0 else rest[:nxt]
+    out: Dict[str, Tuple[str, int]] = {}
+    for i, line in enumerate(section.splitlines()):
+        m = _README_ROW_RE.match(line.strip())
+        if m:
+            default = m.group(2)
+            if default == "*(unset)*":
+                default = ""
+            out.setdefault(m.group(1), (default, first_line + i))
+    return out
+
+
 def config_knob_name(field: str) -> str:
     return "RAY_TPU_" + field.upper()
 
@@ -255,4 +326,22 @@ def run(root: str) -> List[_core.Violation]:
             rule="knob-stale-doc", path=README, line=1,
             message=(f"README knob table documents {name} which the "
                      f"registry does not declare")))
+
+    # -- default drift: registry literal vs README table cell ----------
+    defaults = extract_registry_defaults(knobs_tree)
+    if config_tree is not None:
+        for field, val in extract_config_defaults(config_tree).items():
+            defaults.setdefault(config_knob_name(field), val)
+    for name, (cell, lineno) in sorted(
+            readme_table_defaults(readme_text).items()):
+        want = defaults.get(name)
+        if want is not None and cell != want:
+            shown = want if want else "*(unset)*"
+            violations.append(_core.Violation(
+                rule="knob-default-drift", path=README, line=lineno,
+                message=(f"README table says {name} defaults to "
+                         f"`{cell or '*(unset)*'}` but the registry "
+                         f"says `{shown}` — regenerate the table "
+                         f"(python -m ray_tpu.analysis "
+                         f"--print-knob-table)")))
     return violations

@@ -213,6 +213,11 @@ class TaskRecord:
     trace_id: str = ""
     span_id: str = ""
     parent_span_id: str = ""
+    # Total READY shm bytes of the spec's ref args, captured while the
+    # task (and therefore its args) is alive; -1 = not yet computed.
+    # The watchdog buckets straggler baselines by this so a 1 GiB-input
+    # sibling is never judged against 1 KiB-input completions.
+    arg_bytes: int = -1
 
 
 def _sum_bundles(bundle_specs: List[Dict[str, float]]) -> Dict[str, float]:
@@ -329,6 +334,16 @@ class _Watchdog:
         idx = int(len(sorted_vals) * pct / 100.0)
         return sorted_vals[min(idx, len(sorted_vals) - 1)]
 
+    @staticmethod
+    def _size_bucket(arg_bytes: int) -> int:
+        """Arg-size class: 0 for no/unknown args, then one bucket per
+        16x of total READY-arg bytes (1 KiB and 4 KiB share a bucket;
+        1 KiB and 1 GiB never do).  Coarse on purpose — buckets must
+        collect min_samples completions before they gate anything."""
+        if arg_bytes <= 0:
+            return 0
+        return max(1, int(arg_bytes).bit_length() // 4)
+
     def maybe_tick(self) -> None:
         now = time.time()
         if now - self._last_tick < self.interval_s:
@@ -346,7 +361,13 @@ class _Watchdog:
 
     def _check_stragglers(self, now: float) -> None:
         srv = self.server
+        # Completed-sibling durations, both pooled per task name and
+        # split per (name, arg-size bucket): heterogeneous batches
+        # (same function over 1 KiB vs 1 GiB inputs) threshold within
+        # their own size class when it has enough samples, falling back
+        # to the pooled distribution when it does not.
         durations: Dict[str, List[float]] = {}
+        bucketed: Dict[tuple, List[float]] = {}
         running: List[tuple] = []
         with srv.lock:
             for th, rec in srv.tasks.items():
@@ -355,21 +376,34 @@ class _Watchdog:
                 if rec.state == "FINISHED":
                     start = rec.started_at or rec.received_at
                     if start and rec.finished_at > start:
-                        durations.setdefault(name, []).append(
-                            rec.finished_at - start)
+                        dur = rec.finished_at - start
+                        durations.setdefault(name, []).append(dur)
+                        bucket = self._size_bucket(rec.arg_bytes)
+                        bucketed.setdefault((name, bucket),
+                                            []).append(dur)
                 elif rec.state == "RUNNING" and \
                         th not in self._flagged_tasks:
                     start = rec.started_at or rec.received_at or \
                         rec.submitted_at
                     if start:
+                        if rec.arg_bytes < 0:
+                            rec.arg_bytes = srv._task_arg_bytes(rec.spec)
                         running.append(
-                            (th, name, now - start, rec.worker_hex))
+                            (th, name, now - start, rec.worker_hex,
+                             rec.arg_bytes))
         for sibs in durations.values():
+            sibs.sort()
+        for sibs in bucketed.values():
             sibs.sort()
         from ray_tpu.util import flight_recorder
 
-        for th, name, age, worker_hex in running:
-            sibs = durations.get(name)
+        for th, name, age, worker_hex, arg_bytes in running:
+            bucket = self._size_bucket(arg_bytes)
+            sibs = bucketed.get((name, bucket))
+            pooled = False
+            if sibs is None or len(sibs) < self.min_samples:
+                sibs = durations.get(name)
+                pooled = True
             if sibs is None or len(sibs) < self.min_samples:
                 continue
             threshold = max(
@@ -385,7 +419,9 @@ class _Watchdog:
             flight_recorder.record(
                 "health", "straggler", task=th, name=name,
                 age_s=round(age, 3), threshold_s=round(threshold, 3),
-                siblings=len(sibs), worker=worker_hex)
+                siblings=len(sibs), worker=worker_hex,
+                arg_bytes=max(0, arg_bytes), size_bucket=bucket,
+                pooled_baseline=pooled)
 
     def _check_nodes(self, now: float) -> None:
         srv = self.server
@@ -418,6 +454,19 @@ class _Watchdog:
             self._unhealthy_nodes.discard(nid)
             flight_recorder.record("health", "node_recovered", node=nid)
 
+    def profile_distributions(self) -> Dict[str, Dict[str, Any]]:
+        """Per-worker percentile summaries over the head's profile
+        history rings — worker load as a distribution (p50/p95 across
+        the ring) instead of whichever sample arrived last."""
+        srv = self.server
+        with srv.lock:
+            rings = {wh: list(ring)
+                     for wh, ring in srv._profile_hist.items()
+                     if wh in srv.workers
+                     and srv.workers[wh].state != "dead"}
+        return {wh: _profile_history_summary(samples)
+                for wh, samples in rings.items()}
+
     def snapshot(self) -> Dict[str, Any]:
         return {
             "enabled": True,
@@ -425,7 +474,32 @@ class _Watchdog:
             "stragglers_flagged": self.stragglers_flagged,
             "nodes_flagged": self.nodes_flagged,
             "unhealthy_nodes": sorted(self._unhealthy_nodes),
+            "profile_distributions": self.profile_distributions(),
         }
+
+
+def _profile_history_summary(samples: List[dict]) -> Dict[str, Any]:
+    """p50/p95 per numeric field over one worker's history ring (the
+    /api/profile and watchdog distribution view; computed at query
+    time, never on the report path)."""
+    numeric: Dict[str, List[float]] = {}
+    for s in samples:
+        for k, v in s.items():
+            if k in ("ts", "pid") or isinstance(v, bool):
+                continue
+            if isinstance(v, (int, float)):
+                numeric.setdefault(k, []).append(float(v))
+    pcts: Dict[str, Dict[str, float]] = {}
+    for k, vals in numeric.items():
+        vals.sort()
+        pcts[k] = {"p50": _Watchdog._percentile_of(vals, 50.0),
+                   "p95": _Watchdog._percentile_of(vals, 95.0)}
+    return {
+        "samples": len(samples),
+        "first_ts": samples[0].get("ts", 0.0) if samples else 0.0,
+        "last_ts": samples[-1].get("ts", 0.0) if samples else 0.0,
+        "percentiles": pcts,
+    }
 
 
 class ControlServer:
@@ -592,12 +666,22 @@ class ControlServer:
         self._span_missed = 0  # ring evictions that beat the harvest
         self._span_lock = threading.Lock()
         self._harvest_lock = threading.Lock()  # one harvest at a time
-        # Latest per-worker resource samples (profile_report deltas).
+        # Latest per-worker resource samples (profile_report deltas)
+        # plus a bounded per-worker history ring so /api/profile and
+        # the watchdog see distributions, not just the newest sample.
         self._profiles: Dict[str, dict] = {}
+        self._profile_hist: Dict[str, "deque"] = {}
+        self._profile_hist_cap = _env_int("RAY_TPU_PROFILE_HISTORY",
+                                          120, 8)
         # Straggler/health watchdog: constructed ONLY when enabled, so
         # with RAY_TPU_WATCHDOG off the scheduler loop's gate is a
         # single `is not None` check — today's hot path byte-for-byte.
         self._watchdog = _Watchdog(self) if _watchdog_enabled() else None
+        # Durable ops plane: rehydrate the span store and flight
+        # recorder from the on-disk journal (util/journal.py) so a head
+        # restart still serves yesterday's trace.  No-op when
+        # RAY_TPU_OPS_JOURNAL_DIR is unset.
+        self._rehydrate_ops_journal()
 
         self._wake = threading.Event()
         self._stopped = threading.Event()
@@ -625,6 +709,47 @@ class ControlServer:
     def _journal_del(self, key: str):
         if self.config.gcs_store_path:
             self.kv.pop(f"__meta__/{key}", None)
+
+    def _rehydrate_ops_journal(self):
+        """Reload the span store and flight recorder from the durable
+        ops journal after a head restart (kill -9 included: replay
+        drops at most the one truncated tail record per stream).
+        Replayed spans enter _span_seen, so the first post-restart
+        harvest neither duplicates the store nor re-journals them."""
+        from ray_tpu.util import journal as ops_journal
+
+        directory = ops_journal.journal_dir()
+        if not directory:
+            return
+        try:
+            envs = ops_journal.replay(
+                directory, "spans",
+                max_records=self._span_store.maxlen or 0)
+        except Exception as e:
+            warn_once(logger, "ops-rehydrate", e,
+                      "span journal replay failed")
+            envs = []
+        restored = 0
+        with self._span_lock:
+            for env in envs:
+                row = env.get("d")
+                if not isinstance(row, list) or len(row) < 7:
+                    continue
+                sid = row[0]
+                if sid in self._span_seen:
+                    continue
+                if len(self._span_store) == self._span_store.maxlen \
+                        and self._span_store:
+                    self._span_seen.discard(self._span_store[0][0])
+                self._span_seen.add(sid)
+                self._span_store.append(row)
+                restored += 1
+        from ray_tpu.util import flight_recorder
+
+        flight = flight_recorder.rehydrate()
+        if restored or flight:
+            logger.info("ops journal rehydrated: %d spans, %d flight "
+                        "events (dir=%s)", restored, flight, directory)
 
     def _restore_from_journal(self):
         if not self.config.gcs_store_path:
@@ -2087,6 +2212,7 @@ class ControlServer:
                 rec.state = "RUNNING"
                 rec.worker_hex = w.worker_hex
                 rec.started_at = time.time()
+                rec.arg_bytes = self._task_arg_bytes(spec)
             return spec
         return None
 
@@ -2501,7 +2627,8 @@ class ControlServer:
         from ray_tpu.util import flight_recorder
 
         return {"events": flight_recorder.dump(
-                    int(msg.get("last", 0) or 0)),
+                    int(msg.get("last", 0) or 0),
+                    float(msg.get("since", 0) or 0.0)),
                 "stats": flight_recorder.stats()}
 
     # ------------------------------------------------------------------
@@ -3421,6 +3548,20 @@ class ControlServer:
         utils = [1.0 - av.get(k, 0.0) / v for k, v in tot.items() if v > 0]
         return max(utils, default=0.0)
 
+    def _task_arg_bytes(self, spec) -> int:
+        """Lock held.  Total READY bytes of the spec's ref args (the
+        watchdog's straggler size-bucket input).  Captured at dispatch
+        while the running task still pins its args; inline and
+        still-pending args contribute nothing."""
+        total = 0
+        for arg in getattr(spec, "args", ()):
+            if not getattr(arg, "is_ref", False):
+                continue
+            entry = self.objects.get(arg.object_hex)
+            if entry is not None and entry.state == READY:
+                total += entry.size or 0
+        return total
+
     def _locality_bytes(self, spec) -> Dict[str, int]:
         """Lock held.  Bytes of the spec's shm ref args already resident
         on each node — primary copy or pulled replica, straight from the
@@ -3768,6 +3909,7 @@ class ControlServer:
                     rec.state = "RUNNING"
                     rec.worker_hex = worker.worker_hex
                     rec.started_at = time.time()
+                    rec.arg_bytes = self._task_arg_bytes(spec)
                 dispatches.append((worker, spec))
                 progress += 1
             self.pending_tasks = still_pending
@@ -4199,13 +4341,22 @@ class ControlServer:
     def _harvest_spans_sync(self, msg) -> Dict[str, Any]:
         timeout_s = float(msg.get("timeout_s", 0) or 10.0)
         deadline = time.monotonic() + timeout_s
-        with self._harvest_lock:  # serialize: cursors are shared state
-            polled = self._harvest_all_workers(deadline)
+        since = float(msg.get("since", 0) or 0.0)
+        # poll=False answers from the store alone (no worker round
+        # trips) — the restart-replay read path, where the store was
+        # rehydrated from the journal and the old workers are gone.
+        do_poll = msg.get("poll")
+        do_poll = True if do_poll is None else bool(do_poll)
+        if do_poll:
+            with self._harvest_lock:  # serialize: cursors shared state
+                polled = self._harvest_all_workers(deadline)
+        else:
+            polled = 0
         trace_id = msg.get("trace_id") or ""
         max_spans = int(msg.get("max_spans", 0) or 0)
         with self._span_lock:
             missed = self._span_missed
-            if not trace_id and max_spans > 0:
+            if not trace_id and not since and max_spans > 0:
                 # Bounded tail without copying the whole store — the
                 # 1 Hz-poller shape, where reply size is the cost.
                 start = max(0, len(self._span_store) - max_spans)
@@ -4215,6 +4366,10 @@ class ControlServer:
                 rows = list(self._span_store)
         if trace_id:
             rows = [r for r in rows if r[2] == trace_id]
+        if since:
+            # Time window: keep spans still running at `since` or ended
+            # after it (row[5] is the span end timestamp).
+            rows = [r for r in rows if r[5] >= since]
         if max_spans > 0:
             rows = rows[-max_spans:]
         # The store keeps compact collect_spans rows; only the reply —
@@ -4290,6 +4445,7 @@ class ControlServer:
         to the bounded _harvest_spans_sync reply)."""
         pid = int(reply.get("pid") or 0)
         missed = int(reply.get("missed") or 0)
+        added: List[list] = []
         with self._span_lock:
             for r in rows:
                 sid = r[0]
@@ -4302,8 +4458,20 @@ class ControlServer:
                     self._span_seen.discard(self._span_store[0][0])
                 self._span_seen.add(sid)
                 self._span_store.append(r)
+                added.append(r)
             if missed:
                 self._span_missed += missed
+        # Durable spill (outside the lock; append is an enqueue — the
+        # journal's writer thread owns all disk IO).  The _span_seen
+        # dedup above also keeps a post-restart re-harvest from
+        # re-journaling rows the journal already holds.
+        if added:
+            from ray_tpu.util import journal as ops_journal
+
+            j = ops_journal.stream("spans")
+            if j is not None:
+                for r in added:
+                    j.append(r)
 
     def _op_collect_spans_result(self, conn, msg):
         """One-way reply from a worker's collect_spans push: hand the
@@ -4324,15 +4492,32 @@ class ControlServer:
         if whex:
             with self.lock:
                 self._profiles[whex] = sample
+                ring = self._profile_hist.get(whex)
+                if ring is None:
+                    ring = self._profile_hist[whex] = deque(
+                        maxlen=self._profile_hist_cap)
+                ring.append(sample)
 
     def _op_get_profile(self, conn, msg):
         with self.lock:
+            live = {wh for wh in self._profiles
+                    if wh in self.workers
+                    and self.workers[wh].state != "dead"}
             profiles = {wh: s for wh, s in self._profiles.items()
-                        if wh in self.workers
-                        and self.workers[wh].state != "dead"}
+                        if wh in live}
+            rings = {wh: list(ring)
+                     for wh, ring in self._profile_hist.items()
+                     if wh in live}
+        history = {wh: _profile_history_summary(samples)
+                   for wh, samples in rings.items()}
+        if msg.get("samples"):
+            for wh, summary in history.items():
+                summary["raw"] = rings[wh]
         wd = (self._watchdog.snapshot() if self._watchdog is not None
               else {"enabled": False})
-        return {"workers": profiles, "watchdog": wd}
+        return {"workers": profiles, "history": history,
+                "history_capacity": self._profile_hist_cap,
+                "watchdog": wd}
 
     def _op_set_profile_config(self, conn, msg):
         """Retune every live worker's resource sampler at runtime (the

@@ -128,6 +128,20 @@ KNOBS: List[Knob] = [
          "Max chunks pulled from one worker per harvest round."),
     Knob("RAY_TPU_SPAN_STORE_MAX", "200000", "int", "user",
          "Head-side cap on retained harvested spans."),
+    Knob("RAY_TPU_OPS_JOURNAL_DIR", "", "str", "user",
+         "Directory for the durable ops journal (spans/flight/metrics "
+         "streams); unset disables journaling."),
+    Knob("RAY_TPU_OPS_JOURNAL_MAX_BYTES", "67108864", "int", "user",
+         "Per-stream on-disk retention budget; oldest journal segments "
+         "are deleted past it."),
+    Knob("RAY_TPU_OPS_JOURNAL_ROTATE_S", "600", "float", "user",
+         "Max age of one journal segment before it rotates."),
+    Knob("RAY_TPU_OPS_JOURNAL_FSYNC_S", "0.2", "float", "user",
+         "Journal writer batch interval: queued records are written "
+         "and fsynced at most this often."),
+    Knob("RAY_TPU_PROFILE_HISTORY", "120", "int", "user",
+         "Per-worker profile samples retained in the head's history "
+         "ring for /api/profile percentiles."),
 
     # -- straggler / health watchdog (core/gcs.py) -----------------------
     Knob("RAY_TPU_WATCHDOG", "1", "bool", "user",

@@ -153,6 +153,7 @@ def spawn_worker_process(*, control_addr: str, worker_hex: str, kind: str,
             shm_dir=get_config().shm_dir)
     stdout = open(log_base + ".out", "ab")
     stderr = open(log_base + ".err", "ab")
+    # raylint: allow-blocking(fork+exec IS the lease-grant op's work; latency accepted by design)
     return subprocess.Popen(cmd, env=env, stdout=stdout, stderr=stderr,
                             cwd=os.getcwd())
 

@@ -814,12 +814,14 @@ class Client:
         last_err: Exception | None = None
         while True:
             try:
+                # raylint: allow-blocking(construction-time dial; op handlers build node/actor clients once and cache them)
                 self._sock = socket.create_connection((host, int(port)), timeout=5.0)
                 break
             except OSError as e:
                 last_err = e
                 if time.monotonic() >= deadline:
                     raise RpcError(f"cannot connect to {address}: {e}") from e
+                # raylint: allow-blocking(bounded redial backoff during construction only)
                 time.sleep(0.05)
         self._sock.settimeout(None)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

@@ -96,11 +96,12 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     # Client→head: harvest every worker's ring (incremental, merged by
     # trace_id on the head) and return matching spans.
     "harvest_spans": {"trace_id": "str?", "max_spans": "int?",
-                      "timeout_s": "float?"},
+                      "timeout_s": "float?", "since": "float?",
+                      "poll": "bool?"},
     # Worker→head resource sample; rides the coalescing flusher
     # (runtime._head_frames collapses a run to the newest sample).
     "profile_report": {"sample": "dict"},
-    "get_profile": {},
+    "get_profile": {"samples": "bool?"},
     # Client→head: retune/toggle every worker's sampler at runtime
     # (bench_profiling.py's A/B switch).
     "set_profile_config": {"enabled": "bool?", "interval_s": "float?"},
@@ -203,7 +204,7 @@ SCHEMA: Dict[str, Dict[str, str]] = {
                        "duration_s": "float?", "timeout_s": "float?"},
     "profile_result": {"token": "str", "data": "any?"},
     "profile_config": {"enabled": "bool?", "interval_s": "float?"},
-    "flight_recorder": {},
+    "flight_recorder": {"last": "int?", "since": "float?"},
 }
 
 _TYPES = {

@@ -11,14 +11,21 @@ Routes (all GET unless noted):
   /api/summary/tasks|actors|objects  -> aggregated counts
   /api/node_stats          -> per-node host stats (reporter agents)
   /api/timeline?max_tasks= -> chrome trace (uniformly sampled at scale)
-  /api/trace?max_tasks=    -> unified chrome trace (driver + HARVESTED
-                              worker spans + tasks + wire/scheduler
-                              flight-recorder lanes); ?harvest=0 skips
-                              the cluster span harvest
-  /api/spans?trace_id=&max_spans= -> harvested cluster spans as JSON
-  /api/profile             -> latest per-worker resource samples +
-                              watchdog state
-  /api/flight_recorder?last= -> recent wire/scheduler events + ring stats
+  /api/trace?max_tasks=&since= -> unified chrome trace (driver +
+                              HARVESTED worker spans + tasks +
+                              wire/scheduler flight-recorder lanes);
+                              ?harvest=0 skips the cluster span
+                              harvest, ?since=<epoch> time-windows it
+                              (incl. journal-rehydrated history),
+                              ?poll=0 answers from the head store only
+  /api/spans?trace_id=&max_spans=&since=&poll= -> harvested cluster
+                              spans as JSON
+  /api/profile?samples=    -> latest per-worker resource samples +
+                              bounded history-ring p50/p95 summaries +
+                              watchdog state (?samples=1 adds raw
+                              rings)
+  /api/flight_recorder?last=&since= -> recent wire/scheduler events +
+                              ring stats, time-windowed by ?since=
   /api/workers/<hex>/profile?kind=stack|jax_trace&duration_s=
   /api/cluster_resources   /api/available_resources
   /api/object_store_stats  /metrics (Prometheus)
@@ -196,16 +203,31 @@ class Dashboard:
             # pid lanes, so ONE Perfetto file shows the driver→worker→
             # nested-task chain stitched by trace ids.
             from ray_tpu.util.tracing import trace_events
+            since = float(qs.get("since", 0) or 0.0)
             events = trace_events(
                 rt, max_tasks=int(qs.get("max_tasks", 0)))
             if qs.get("harvest", "1").strip().lower() not in (
                     "0", "false", "no", "off"):
-                events.extend(self._harvested_span_events(rt))
+                events.extend(self._harvested_span_events(
+                    rt, since=since,
+                    poll=qs.get("poll", "1").strip().lower() not in (
+                        "0", "false", "no", "off")))
+            if since:
+                # Time-windowed history (epoch seconds → trace µs):
+                # keep metadata records and anything still live at or
+                # after the cut — including journal-rehydrated spans
+                # from before a head restart.
+                cut = since * 1e6
+                events = [e for e in events
+                          if e.get("ph") == "M"
+                          or e.get("ts", 0) + e.get("dur", 0) >= cut]
             return events
         if parsed.path == "/api/spans":
             # Harvested cluster spans as queryable JSON (same data the
             # /api/trace fold renders): pulls every worker's span ring
-            # through the head first, then filters by trace_id.
+            # through the head first, then filters by trace_id and the
+            # since= time window (which also reaches back into the
+            # journal-rehydrated store after a restart).
             req = {"op": "harvest_spans"}
             if qs.get("trace_id"):
                 req["trace_id"] = qs["trace_id"]
@@ -213,21 +235,37 @@ class Dashboard:
                 req["max_spans"] = int(qs["max_spans"])
             if qs.get("timeout_s"):
                 req["timeout_s"] = float(qs["timeout_s"])
+            if qs.get("since"):
+                req["since"] = float(qs["since"])
+            if qs.get("poll", "").strip().lower() in (
+                    "0", "false", "no", "off"):
+                req["poll"] = False
             return rt.core.client.call(req)
         if parsed.path == "/api/profile":
             # Latest per-worker resource samples (profile_report
-            # deltas) + watchdog verdict counters.
-            return rt.core.client.call({"op": "get_profile"})
+            # deltas) + bounded history-ring percentile summaries +
+            # watchdog verdict counters; ?samples=1 adds raw rings.
+            req = {"op": "get_profile"}
+            if qs.get("samples", "").strip().lower() not in (
+                    "", "0", "false", "no", "off"):
+                req["samples"] = True
+            return rt.core.client.call(req)
         if parsed.path == "/api/flight_recorder":
             from ray_tpu.util import flight_recorder
-            out = {"events": flight_recorder.dump(
-                       int(qs.get("last", 0) or 0)),
+            last = int(qs.get("last", 0) or 0)
+            since = float(qs.get("since", 0) or 0.0)
+            out = {"events": flight_recorder.dump(last, since),
                    "stats": flight_recorder.stats()}
             if getattr(rt, "control", None) is None:
                 # Remote head: its ring is a different process — fetch
                 # and prepend so one endpoint shows both sides.
                 try:
-                    head = rt.core.client.call({"op": "flight_recorder"})
+                    req = {"op": "flight_recorder"}
+                    if last:
+                        req["last"] = last
+                    if since:
+                        req["since"] = since
+                    head = rt.core.client.call(req)
                     out = {"events": head["events"] + out["events"],
                            "stats": out["stats"],
                            "head_stats": head["stats"]}
@@ -280,7 +318,8 @@ class Dashboard:
         raise KeyError(path)
 
     @staticmethod
-    def _harvested_span_events(rt):
+    def _harvested_span_events(rt, since: float = 0.0,
+                               poll: bool = True):
         """Cluster span harvest folded into the unified trace: every
         worker's spans render on that worker's OS-pid lane, lining up
         with its execution slices (util/timeline.py pid convention).
@@ -288,9 +327,13 @@ class Dashboard:
         rendered them on the pid-1 driver lane."""
         from ray_tpu.util.tracing import spans_to_chrome_events
 
+        req = {"op": "harvest_spans", "timeout_s": 10.0}
+        if since:
+            req["since"] = since
+        if not poll:
+            req["poll"] = False
         try:
-            out = rt.core.client.call(
-                {"op": "harvest_spans", "timeout_s": 10.0}) or {}
+            out = rt.core.client.call(req) or {}
         except Exception:
             return []
         own = rt.core.worker_hex

@@ -150,3 +150,33 @@ class NodeKiller(ResourceKiller):
 
     def kill(self, node_id: str) -> bool:
         return bool(self.cluster.remove_node(node_id))
+
+
+class PidfileKiller(ResourceKiller):
+    """Signal whatever pid a victim process wrote to `pidfile`
+    (default SIGKILL).  The victim opts in by writing its pid, so the
+    kill lands mid-work by construction — crash-recovery tests (e.g.
+    the ops journal's truncated-tail replay) use this to SIGKILL a
+    writer between appends without coordinating a precise moment."""
+
+    def __init__(self, pidfile: str, sig: int = signal.SIGKILL,
+                 interval_s: float = 0.05, **kw):
+        kw.setdefault("max_kills", 1)
+        super().__init__(interval_s, **kw)
+        self.pidfile = pidfile
+        self.sig = sig
+
+    def find_target(self) -> Optional[int]:
+        try:
+            with open(self.pidfile) as f:
+                return int(f.read().strip())
+        # raylint: allow-swallow(pidfile absent or torn = victim not ready; poll again)
+        except (OSError, ValueError):
+            return None
+
+    def kill(self, pid: int) -> bool:
+        try:
+            os.kill(pid, self.sig)
+            return True
+        except OSError:
+            return False

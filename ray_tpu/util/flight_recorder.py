@@ -16,6 +16,11 @@ Env knobs:
 
 Each process (driver, head-in-driver, workers) holds its own ring; the
 dashboard's /api/flight_recorder merges the driver's with the head's.
+
+When ``RAY_TPU_OPS_JOURNAL_DIR`` is set every recorded event also
+spills to the durable "flight" journal stream (util/journal.py —
+append is an enqueue; disk IO happens on the journal's writer thread),
+and ``rehydrate()`` reloads past events into the ring after a restart.
 """
 
 from __future__ import annotations
@@ -25,6 +30,8 @@ import threading
 import time
 from collections import deque
 from typing import Any, Dict, List
+
+from ray_tpu.util import journal as _journal
 
 _FALSY = ("0", "false", "no", "off")
 
@@ -80,14 +87,52 @@ def record(category: str, event: str, **fields: Any) -> None:
         if len(_ring) == _ring.maxlen:
             _dropped += 1
         _ring.append(entry)
+    j = _journal.stream("flight")
+    if j is not None:
+        j.append(entry)
 
 
-def dump(last: int = 0) -> List[Dict[str, Any]]:
+def dump(last: int = 0, since: float = 0.0) -> List[Dict[str, Any]]:
     """Snapshot the ring, oldest first; `last` > 0 returns only the
-    newest N events."""
+    newest N events; `since` > 0 drops events older than that epoch
+    timestamp."""
     with _lock:
         events = list(_ring)
+    if since > 0.0:
+        events = [e for e in events if e.get("ts", 0.0) >= since]
     return events[-last:] if last > 0 else events
+
+
+def rehydrate(since: float = 0.0) -> int:
+    """Reload past events from the "flight" journal stream into the
+    ring (head restart).  Events go straight into the ring — they are
+    NOT re-journaled.  Returns the number restored."""
+    global _ring
+    directory = _journal.journal_dir()
+    if not directory or not _enabled:
+        return 0
+    restored = 0
+    with _lock:
+        capacity = _ring.maxlen or 0
+    envs = _journal.replay(directory, "flight", since=since,
+                           max_records=capacity)
+    with _lock:
+        have = {(e.get("ts"), e.get("category"), e.get("event"))
+                for e in _ring}
+        merged = list(_ring)
+        for env in envs:
+            event = env.get("d")
+            if not isinstance(event, dict):
+                continue
+            key = (event.get("ts"), event.get("category"),
+                   event.get("event"))
+            if key in have:
+                continue
+            merged.append(event)
+            restored += 1
+        merged.sort(key=lambda e: e.get("ts", 0.0))
+        _ring = deque(merged, maxlen=_ring.maxlen)
+    return restored
 
 
 def stats() -> Dict[str, Any]:

@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ray_tpu.util import journal as _journal
+
 _KV_PREFIX = "__metrics__/"
 _PUBLISH_INTERVAL_S = 2.0
 
@@ -321,16 +323,50 @@ def local_snapshots() -> List[dict]:
 # Publishing (process → GCS KV) and aggregation (KV → Prometheus text)
 # ---------------------------------------------------------------------------
 
+def snapshots_json_safe(snapshots: List[dict]) -> List[dict]:
+    """Snapshots with tuple series keys flattened to lists so they can
+    ride a JSON journal record.  `series` becomes a list of
+    ``[[ [tag, value], ... ], sample]`` pairs (histogram samples are
+    already JSON-safe ``[buckets, sum, count]`` triples)."""
+    out = []
+    for snap in snapshots:
+        safe = {k: v for k, v in snap.items() if k != "series"}
+        safe["series"] = [[[list(kv) for kv in key], val]
+                          for key, val in snap.get("series", {}).items()]
+        out.append(safe)
+    return out
+
+
+def snapshots_from_json(objs: List[dict]) -> List[dict]:
+    """Inverse of snapshots_json_safe (journal replay → the shapes
+    merge_snapshots / snapshots_to_prometheus_text expect)."""
+    out = []
+    for obj in objs:
+        snap = {k: v for k, v in obj.items() if k != "series"}
+        snap["series"] = {
+            tuple(tuple(kv) for kv in key): val
+            for key, val in obj.get("series", [])}
+        out.append(snap)
+    return out
+
+
+def _journal_snapshots(snaps: List[dict]) -> None:
+    j = _journal.stream("metrics")
+    if j is not None:
+        j.append({"snapshots": snapshots_json_safe(snaps)})
+
+
 def publish_now() -> bool:
     """Publish this process's snapshots to the cluster KV immediately."""
     global _published
+    snaps = local_snapshots()
+    if not snaps:
+        return False
+    _journal_snapshots(snaps)
     try:
         from ray_tpu.core.runtime import get_runtime
         rt = get_runtime()
     except Exception:
-        return False
-    snaps = local_snapshots()
-    if not snaps:
         return False
     ident = rt.core.worker_hex if hasattr(rt, "core") else "driver"
     payload = pickle.dumps({"ts": time.time(), "snapshots": snaps})

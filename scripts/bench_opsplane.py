@@ -1,0 +1,232 @@
+"""Ops-journal overhead probe: with RAY_TPU_OPS_JOURNAL_DIR set, every
+flight-recorder event, harvested span row, and metrics snapshot also
+spills to the durable journal (util/journal.py).  The spill must cost
+< 5% on the control-plane hot path — append is an enqueue; JSON
+serialization, batching, rotation, and fsync all live on the journal's
+writer thread.
+
+Same paired-window methodology as scripts/bench_profiling.py (the
+`multi_client_tasks_async` shape, alternating A/B windows with order
+reversal, per-round ratios, median): BOTH arms run the full always-on
+ops plane — tracing, the 1 Hz per-worker resource sampler, a 0.5 Hz
+cluster-wide harvest_spans sweep, the watchdog ticking head-side — so
+the toggle isolates exactly the durable-journal spill (span rows on
+every harvest, flight events as the scheduler works, metrics
+snapshots) in the head/driver process.  Overhead is lost task
+throughput, not microbenchmark arithmetic; a secondary
+`per_event` section prices the raw enqueue itself (µs/event on the
+flight-recorder record path, journal on vs off).
+
+Writes OPSPLANE_BENCH.json at the repo root (tests/test_ops_journal
+.py's budget test reads it) and exits nonzero if the paired measurement
+shows >= 5% overhead.
+
+Run: python scripts/bench_opsplane.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import statistics
+import sys
+import tempfile
+import threading
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+OVERHEAD_BUDGET = 0.05
+SAMPLER_INTERVAL_S = 1.0
+WINDOW_S = 3.0
+ROUNDS = 10
+
+
+def _per_event_cost(jdir: str) -> dict:
+    """Secondary stat: raw µs/event on flight_recorder.record, journal
+    on vs off.  Bursts with drain gaps so the writer thread keeps up —
+    this prices enqueue + GIL competition, not queue-full drops."""
+    from ray_tpu.util import flight_recorder, journal
+
+    def arm(on: bool) -> float:
+        if on:
+            os.environ["RAY_TPU_OPS_JOURNAL_DIR"] = jdir
+        else:
+            os.environ.pop("RAY_TPU_OPS_JOURNAL_DIR", None)
+        journal.reset()
+        n = 0
+        t_rec = 0.0
+        deadline = time.perf_counter() + 0.5
+        while time.perf_counter() < deadline:
+            t0 = time.perf_counter()
+            for i in range(200):
+                flight_recorder.record("bench", "tick", seq=n + i,
+                                       obj_bytes=4096)
+            t_rec += time.perf_counter() - t0
+            n += 200
+            time.sleep(0.004)
+        if on:
+            journal.flush_all(timeout=10.0)
+        journal.reset()
+        return t_rec / n * 1e6
+
+    off_us = arm(False)
+    on_us = arm(True)
+    os.environ.pop("RAY_TPU_OPS_JOURNAL_DIR", None)
+    return {"off_us": round(off_us, 3), "on_us": round(on_us, 3),
+            "added_us": round(on_us - off_us, 3)}
+
+
+def main() -> int:
+    import ray_tpu
+    from ray_tpu.scripts.microbenchmark import SCALE
+    from ray_tpu.util import journal, tracing
+
+    jdir = tempfile.mkdtemp(prefix="opsplane-bench-")
+    os.environ["RAY_TPU_OPS_JOURNAL_FSYNC_S"] = "0.05"
+    os.environ["RAY_TPU_OPS_JOURNAL_MAX_BYTES"] = str(256 << 20)
+    os.environ.pop("RAY_TPU_OPS_JOURNAL_DIR", None)
+
+    rt = ray_tpu.init(num_cpus=16, log_to_driver=False)
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    ray_tpu.get([small_task.remote() for _ in range(16)])
+
+    class TaskClient:
+        def run_batch(self, n):
+            import ray_tpu as rt_
+
+            rt_.get([small_task.remote() for _ in range(n)])
+            return n
+
+    TC = ray_tpu.remote(TaskClient)
+    tclients = [TC.options(num_cpus=0).remote() for _ in range(4)]
+    ray_tpu.get([c.run_batch.remote(1) for c in tclients])
+    n = max(50, int(250 * SCALE))
+
+    def multi_tasks():
+        ray_tpu.get([c.run_batch.remote(n) for c in tclients])
+
+    head = rt.core.client
+
+    # Always-on ops plane in BOTH arms: sampler + harvest sweep +
+    # watchdog + tracing.  The harvest sweep is what feeds the span
+    # store — and therefore the "spans" journal stream — on the on arm.
+    head.call({"op": "set_profile_config", "enabled": True,
+               "interval_s": SAMPLER_INTERVAL_S})
+    harvester_exit = threading.Event()
+
+    def _harvester():
+        while not harvester_exit.is_set():
+            try:
+                head.call({"op": "harvest_spans", "max_spans": 256,
+                           "timeout_s": 10.0})
+            # raylint: allow-swallow(best-effort background poller; bench tears it down)
+            except Exception:
+                pass
+            harvester_exit.wait(2.0)
+
+    threading.Thread(target=_harvester, name="bench-harvester",
+                     daemon=True).start()
+
+    def set_arm(on: bool) -> None:
+        # The head runs in the driver process for an in-process
+        # cluster, so toggling the env here gates the head-side spill
+        # (span store, flight recorder, metrics) — the journaling
+        # surface this bench prices.
+        if on:
+            os.environ["RAY_TPU_OPS_JOURNAL_DIR"] = jdir
+        else:
+            os.environ.pop("RAY_TPU_OPS_JOURNAL_DIR", None)
+        journal.reset()
+
+    def one_window(window_s: float = WINDOW_S) -> float:
+        start = time.perf_counter()
+        count = 0
+        while time.perf_counter() - start < window_s:
+            multi_tasks()
+            count += 1
+        return count * 4 * n / (time.perf_counter() - start)
+
+    assert not tracing.is_tracing_enabled()
+    tracing.enable_tracing()
+    multi_tasks()  # warmup
+
+    off_rates, on_rates, ratios = [], [], []
+    for r in range(ROUNDS):
+        order = [(False, off_rates), (True, on_rates)]
+        if r % 2:
+            order.reverse()
+        for on, rates in order:
+            set_arm(on)
+            time.sleep(0.3)  # settle: straddling sweeps/windows
+            rates.append(one_window())
+        ratios.append(on_rates[-1] / off_rates[-1])
+
+    harvester_exit.set()
+    set_arm(True)
+    journal.flush_all(timeout=10.0)
+    journaled = sum(len(journal.replay(jdir, s))
+                    for s in ("flight", "spans", "metrics"))
+    disk_bytes = sum(size
+                     for s in ("flight", "spans", "metrics")
+                     for _, _, _, size in journal.list_segments(jdir, s))
+    dropped = 0
+    for s in ("flight", "spans", "metrics"):
+        j = journal.stream(s)
+        if j is not None:
+            dropped += j.stats()["dropped"]
+    set_arm(False)
+    tracing.disable_tracing()
+    tracing.clear_spans()
+    per_event = _per_event_cost(jdir)
+    ray_tpu.shutdown()
+    shutil.rmtree(jdir, ignore_errors=True)
+
+    off_med = statistics.median(off_rates)
+    on_med = statistics.median(on_rates)
+    overhead = 1.0 - statistics.median(ratios)
+    print(f"{'multi_client_tasks_async[journal off]':<45s} "
+          f"{off_med:>12.1f} ± {statistics.stdev(off_rates):.1f} /s",
+          flush=True)
+    print(f"{'multi_client_tasks_async[journal on]':<45s} "
+          f"{on_med:>12.1f} ± {statistics.stdev(on_rates):.1f} /s",
+          flush=True)
+
+    doc = {
+        "probe": "ops_journal_overhead",
+        "scale": SCALE,
+        "overhead_budget": OVERHEAD_BUDGET,
+        "journaling": {
+            "off_ops_s": round(off_med, 1),
+            "off_std": round(statistics.stdev(off_rates), 1),
+            "on_ops_s": round(on_med, 1),
+            "on_std": round(statistics.stdev(on_rates), 1),
+            "overhead": round(overhead, 4),
+            "records_journaled": journaled,
+            "records_dropped": dropped,
+            "disk_bytes": disk_bytes,
+            "streams": ["flight", "spans", "metrics"],
+        },
+        "per_event": per_event,
+    }
+    out_path = os.path.join(_ROOT, "OPSPLANE_BENCH.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print("OPSPLANE_BENCH_RESULTS " + json.dumps(doc), flush=True)
+    if overhead >= OVERHEAD_BUDGET:
+        print(f"FAIL: ops-journal overhead {overhead:.1%} >= "
+              f"{OVERHEAD_BUDGET:.0%} budget", file=sys.stderr)
+        return 1
+    print(f"ok: ops-journal overhead {overhead:.1%} "
+          f"({on_med:.0f} vs {off_med:.0f} ops/s)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""opsdump — export a past window of the durable ops journal as a
+Perfetto-loadable chrome trace.
+
+The live dashboard (`/api/trace`) can only show what the current head
+holds in memory; this reads the on-disk journal segments directly
+(no cluster required — works on a dead cluster's journal dir), merges
+the "spans", "flight" and "metrics" streams, and writes one chrome
+trace JSON:
+
+    python scripts/opsdump.py --dir /var/ray_tpu/ops \\
+        --last 3600 --out trace.json
+    python scripts/opsdump.py --dir $RAY_TPU_OPS_JOURNAL_DIR --stats
+
+Lanes follow the dashboard convention: harvested spans render on each
+worker's OS-pid lane, flight-recorder events are instant markers on a
+per-category lane, and scalar metrics become counter tracks.  `--since`
+/ `--until` take epoch seconds; `--last N` means "the last N seconds".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ray_tpu.util import journal  # noqa: E402
+from ray_tpu.util.tracing import (  # noqa: E402
+    span_row_to_dict,
+    spans_to_chrome_events,
+)
+
+STREAMS = ("spans", "flight", "metrics")
+# One synthetic chrome pid per flight-recorder category lane.
+_FLIGHT_PID = 0
+
+
+def span_events(envs: List[dict]) -> List[Dict[str, Any]]:
+    """Journal span rows → X slices, one lane per (pid, worker)."""
+    by_lane: Dict[tuple, List[dict]] = {}
+    for env in envs:
+        row = env.get("d")
+        if not isinstance(row, list) or len(row) < 7:
+            continue
+        s = span_row_to_dict(row)
+        key = (int(s.get("pid") or 0), s.get("worker", ""))
+        by_lane.setdefault(key, []).append(s)
+    events: List[Dict[str, Any]] = []
+    for (pid, whex), spans in sorted(by_lane.items()):
+        events.extend(spans_to_chrome_events(
+            spans, pid=pid or 1,
+            process_name=f"worker spans {whex[:8]}" if whex
+            else "driver spans",
+            sort_index=pid or 1))
+    return events
+
+
+def flight_events(envs: List[dict]) -> List[Dict[str, Any]]:
+    """Flight-recorder events → instant markers, one thread lane per
+    category (wire/scheduler/object/health)."""
+    events: List[Dict[str, Any]] = []
+    lanes: Dict[str, int] = {}
+    for env in envs:
+        ev = env.get("d")
+        if not isinstance(ev, dict) or "ts" not in ev:
+            continue
+        cat = str(ev.get("category", "?"))
+        tid = lanes.setdefault(cat, len(lanes))
+        args = {k: v for k, v in ev.items()
+                if k not in ("ts", "category", "event")}
+        events.append({
+            "cat": "flight", "name": str(ev.get("event", "?")),
+            "ph": "i", "s": "t", "pid": _FLIGHT_PID, "tid": tid,
+            "ts": float(ev["ts"]) * 1e6, "args": args})
+    if events:
+        events.append({"ph": "M", "pid": _FLIGHT_PID,
+                       "name": "process_name",
+                       "args": {"name": "flight recorder"}})
+        for cat, tid in lanes.items():
+            events.append({"ph": "M", "pid": _FLIGHT_PID, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": cat}})
+    return events
+
+
+def metric_events(envs: List[dict]) -> List[Dict[str, Any]]:
+    """Metrics snapshots → counter tracks (scalar series summed over
+    tags; histogram series plot their sample count)."""
+    events: List[Dict[str, Any]] = []
+    for env in envs:
+        rec = env.get("d")
+        if not isinstance(rec, dict):
+            continue
+        ts = float(env.get("t", 0.0)) * 1e6
+        pid = int(env.get("p", 0))
+        for snap in rec.get("snapshots", []):
+            total = 0.0
+            for _, val in snap.get("series", []):
+                if isinstance(val, (int, float)):
+                    total += float(val)
+                elif isinstance(val, list) and len(val) == 3:
+                    total += float(val[2])  # histogram count
+            events.append({
+                "cat": "metrics", "name": snap.get("name", "?"),
+                "ph": "C", "pid": pid, "tid": 0, "ts": ts,
+                "args": {"value": total}})
+    return events
+
+
+def dump_stats(directory: str) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"dir": directory}
+    for stream in STREAMS:
+        segs = journal.list_segments(directory, stream)
+        envs = journal.replay(directory, stream)
+        out[stream] = {
+            "segments": len(segs),
+            "bytes": sum(size for _, _, _, size in segs),
+            "records": len(envs),
+            "first_ts": envs[0]["t"] if envs else 0.0,
+            "last_ts": envs[-1]["t"] if envs else 0.0,
+        }
+    return out
+
+
+def build_trace(directory: str, since: float = 0.0,
+                until: float = 0.0,
+                streams=STREAMS) -> List[Dict[str, Any]]:
+    events: List[Dict[str, Any]] = []
+    if "spans" in streams:
+        events.extend(span_events(
+            journal.replay(directory, "spans", since=since,
+                           until=until)))
+    if "flight" in streams:
+        events.extend(flight_events(
+            journal.replay(directory, "flight", since=since,
+                           until=until)))
+    if "metrics" in streams:
+        events.extend(metric_events(
+            journal.replay(directory, "metrics", since=since,
+                           until=until)))
+    return events
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Export a window of the ops journal as a chrome "
+                    "trace (load in Perfetto / chrome://tracing).")
+    ap.add_argument("--dir", default=os.environ.get(
+        "RAY_TPU_OPS_JOURNAL_DIR", ""),
+        help="journal directory (default: $RAY_TPU_OPS_JOURNAL_DIR)")
+    ap.add_argument("--since", type=float, default=0.0,
+                    help="window start (epoch seconds)")
+    ap.add_argument("--until", type=float, default=0.0,
+                    help="window end (epoch seconds)")
+    ap.add_argument("--last", type=float, default=0.0,
+                    help="shorthand: window = the last N seconds")
+    ap.add_argument("--streams", default=",".join(STREAMS),
+                    help="comma list of streams to include "
+                         f"(default: {','.join(STREAMS)})")
+    ap.add_argument("--out", default="",
+                    help="output file (default: stdout)")
+    ap.add_argument("--stats", action="store_true",
+                    help="print per-stream segment/record counts "
+                         "instead of a trace")
+    args = ap.parse_args(argv)
+    if not args.dir:
+        ap.error("--dir required (or set RAY_TPU_OPS_JOURNAL_DIR)")
+    since = args.since
+    if args.last > 0:
+        since = max(since, time.time() - args.last)
+    if args.stats:
+        print(json.dumps(dump_stats(args.dir), indent=2))
+        return 0
+    streams = tuple(s.strip() for s in args.streams.split(",")
+                    if s.strip())
+    events = build_trace(args.dir, since=since, until=args.until,
+                         streams=streams)
+    payload = json.dumps({"traceEvents": events}, default=str)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(payload)
+        print(f"wrote {len(events)} events -> {args.out}",
+              file=sys.stderr)
+    else:
+        print(payload)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -200,6 +200,38 @@ def test_knob_pass_config_drift(tmp_path):
     assert "knob-config-drift" in rules
 
 
+def test_knob_pass_default_drift(tmp_path):
+    root = _knob_fixture(tmp_path)
+    readme = tmp_path / "README.md"
+    # Registry says "1", table claims "2" -> drift, anchored to the row.
+    readme.write_text(readme.read_text().replace("| `1` |", "| `2` |"))
+    vs = [v for v in knob_pass.run(root)
+          if v.rule == "knob-default-drift"]
+    assert len(vs) == 1
+    assert vs[0].path == "README.md" and vs[0].line > 1
+    # raylint: allow-knob(fixture knob name, not a real registry entry)
+    assert "RAY_TPU_DEMO_KNOB" in vs[0].message
+
+
+def test_knob_default_extraction_and_unset_normalization():
+    import ast
+    knobs_src = ('KNOBS = [Knob("RAY_TPU_A", "", "str", "user", "d"),\n'
+                 '         Knob("RAY_TPU_B", "0.2", "float", "user", "d")]\n')
+    defaults = knob_pass.extract_registry_defaults(ast.parse(knobs_src))
+    # raylint: allow-knob(fixture knob names, not real registry entries)
+    assert defaults == {"RAY_TPU_A": "", "RAY_TPU_B": "0.2"}
+    cfg = knob_pass.extract_config_defaults(ast.parse(
+        "class Config:\n    port: int = 0\n    flag: bool = True\n"
+        "    weird: object = some_call()\n"))
+    assert cfg == {"port": "0", "flag": "True"}
+    # The rendered *(unset)* placeholder compares equal to "".
+    table = ("## Configuration knobs\n\n"
+             "| `RAY_TPU_A` | `*(unset)*` | str | d |\n"
+             "| `RAY_TPU_B` | `0.2` | float | d |\n")
+    cells = knob_pass.readme_table_defaults(table)
+    assert cells["RAY_TPU_A"][0] == "" and cells["RAY_TPU_B"][0] == "0.2"
+
+
 # --------------------------------------------------------------------------
 # receive-loop / lock discipline
 # --------------------------------------------------------------------------
@@ -265,6 +297,82 @@ def test_blocking_wildcard_entry_matches_op_handlers():
     """
     vs = _blocking_violations(src, entries=("Server._op_*",))
     assert len(vs) == 1 and "_op_slow" in vs[0].message
+
+
+def test_blocking_flags_fsync():
+    src = """
+    import os
+    class Server:
+        def _handle(self, msg):
+            os.fsync(fd)
+    """
+    vs = _blocking_violations(src)
+    assert len(vs) == 1 and "os.fsync" in vs[0].message
+
+
+def _cross_fixture(tmp_path, helper_body):
+    for pkg in ("ray_tpu", "ray_tpu/core", "ray_tpu/util"):
+        (tmp_path / pkg).mkdir(exist_ok=True)
+        (tmp_path / pkg / "__init__.py").write_text("")
+    (tmp_path / "ray_tpu" / "core" / "srv.py").write_text(
+        textwrap.dedent("""
+            from ray_tpu.util import helper
+            from ray_tpu.util.helper import do_work
+            class Server:
+                def _handle(self, msg):
+                    helper.do_work()
+                def _handle2(self, msg):
+                    do_work()
+        """))
+    (tmp_path / "ray_tpu" / "util" / "helper.py").write_text(
+        textwrap.dedent(helper_body))
+    return blocking_pass.run(
+        str(tmp_path),
+        entry_points={"ray_tpu/core/srv.py": ("Server._handle",
+                                              "Server._handle2")},
+        lock_modules=())
+
+
+def test_blocking_cross_module_one_hop(tmp_path):
+    vs = _cross_fixture(tmp_path, """
+        import time
+        def do_work():
+            _inner()
+        def _inner():
+            time.sleep(1.0)
+    """)
+    # Found through BOTH import forms (module alias + imported func),
+    # anchored to the target module, deduped per entry.
+    assert vs and all(v.path == "ray_tpu/util/helper.py" for v in vs)
+    assert any("time.sleep" in v.message and "=> helper:" in v.message
+               for v in vs)
+
+
+def test_blocking_cross_module_stops_after_one_hop(tmp_path):
+    (tmp_path / "ray_tpu" / "util").mkdir(parents=True)
+    (tmp_path / "ray_tpu" / "util" / "deep.py").write_text(
+        "import time\ndef hidden():\n    time.sleep(5)\n")
+    vs = _cross_fixture(tmp_path, """
+        from ray_tpu.util import deep
+        def do_work():
+            deep.hidden()
+    """)
+    # helper itself has no blocking site; deep.hidden is two hops out
+    # and must NOT be followed.
+    assert vs == []
+
+
+def test_journal_fsync_unreachable_from_receive_entries():
+    # The ops journal DOES fsync (on its writer thread)...
+    src = open(os.path.join(REPO_ROOT, "ray_tpu", "util",
+                            "journal.py")).read()
+    assert "os.fsync" in src
+    # ...and journal.py's enqueue side is a declared entry-point set,
+    # so the pass proves the receive path can never reach it.
+    assert "ray_tpu/util/journal.py" in blocking_pass.DEFAULT_ENTRY_POINTS
+    vs = blocking_pass.run(REPO_ROOT)
+    fsync_hits = [v.render() for v in vs if "os.fsync" in v.message]
+    assert fsync_hits == []
 
 
 def test_blocking_under_lock():
@@ -418,6 +526,58 @@ def test_runner_exits_nonzero_on_seeded_violations(tmp_path):
             "wire-undeclared"} <= rules
     for v in violations:
         assert v.path and v.line >= 1 and ":" in v.render()
+
+
+def test_ratchet_stale_entry_fails_until_shrunk(tmp_path, capsys):
+    root = tmp_path / "r"
+    (root / "ray_tpu").mkdir(parents=True)
+    (root / "ray_tpu" / "__init__.py").write_text("")
+    (root / "ray_tpu" / "m.py").write_text("def f():\n    return 1\n")
+    bl = tmp_path / "baseline.json"
+    acore.save_baseline(
+        {"swallow::ray_tpu/m.py::except Exception:": 1}, str(bl))
+    # No live violation matches the frozen entry -> the run fails.
+    rc = raylint_main(["--root", str(root), "--passes", "except",
+                       "--baseline", str(bl), "-q"])
+    assert rc == 1
+    assert "stale baseline entry" in capsys.readouterr().out
+    # A pass that does not own the rule does not see the debt.
+    assert raylint_main(["--root", str(root), "--passes", "knobs",
+                         "--baseline", str(bl), "-q"]) in (0, 1)
+    # --update-baseline shrinks freely; the run is then clean.
+    assert raylint_main(["--root", str(root), "--passes", "except",
+                         "--baseline", str(bl), "-q",
+                         "--update-baseline"]) == 0
+    assert acore.load_baseline(str(bl)) == {}
+    assert raylint_main(["--root", str(root), "--passes", "except",
+                         "--baseline", str(bl), "-q"]) == 0
+
+
+def test_ratchet_update_refuses_growth(tmp_path, capsys):
+    root = tmp_path / "r"
+    (root / "ray_tpu").mkdir(parents=True)
+    (root / "ray_tpu" / "__init__.py").write_text("")
+    (root / "ray_tpu" / "m.py").write_text(textwrap.dedent("""
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """))
+    bl = tmp_path / "baseline.json"
+    acore.save_baseline({}, str(bl))
+    # Growing the baseline (0 -> 1 entries) is refused...
+    rc = raylint_main(["--root", str(root), "--passes", "except",
+                       "--baseline", str(bl), "-q", "--update-baseline"])
+    assert rc == 1
+    assert "refusing to grow" in capsys.readouterr().err
+    assert acore.load_baseline(str(bl)) == {}
+    # ...unless growth is explicitly allowed (new-rule bootstrap).
+    rc = raylint_main(["--root", str(root), "--passes", "except",
+                       "--baseline", str(bl), "-q", "--update-baseline",
+                       "--allow-baseline-growth"])
+    assert rc == 0
+    assert sum(acore.load_baseline(str(bl)).values()) == 1
 
 
 def test_runner_cli_list_passes():
