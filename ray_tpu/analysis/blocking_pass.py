@@ -83,6 +83,27 @@ DEFAULT_ENTRY_POINTS: Dict[str, Tuple[str, ...]] = {
     # Flight recorder record/dump run inside receive loops and op
     # handlers respectively.
     "ray_tpu/util/flight_recorder.py": ("record", "dump"),
+    # Serve data plane: the ingress dispatch chains (HTTP loop,
+    # framed-wire proxy, gRPC service methods), the router's poll loop
+    # and hot-path assignment, and the replica-side request/stream
+    # entry points.  Executor hops and bounded cv waits are the
+    # sanctioned boundaries; nothing here may park on an unbounded
+    # primitive while a client waits.
+    "ray_tpu/serve/proxy.py": (
+        "HTTPProxy._dispatch", "HTTPProxy._dispatch_streaming",
+        "HTTPProxy._dispatch_asgi", "_astream_values",
+        "FrameProxy._handle_msg",
+    ),
+    "ray_tpu/serve/grpc_proxy.py": (
+        "GrpcProxy._call", "GrpcProxy._call_stream",
+    ),
+    "ray_tpu/serve/router.py": (
+        "Router._poll_loop", "Router.assign_replica", "Router.release",
+    ),
+    "ray_tpu/serve/replica.py": (
+        "Replica.handle_request", "Replica.handle_request_streaming",
+        "Replica.load_report", "Replica.cancel_stream",
+    ),
 }
 
 # Modules whose `with lock:` bodies are swept (the hot control plane).
@@ -137,7 +158,7 @@ def blocking_reason(node: ast.Call) -> Optional[str]:
         if recv not in ("queue", "q", "os"):
             return f"{recv}.{attr}"
     if attr == "result" and not node.args and \
-            not _has_kwarg(node, "timeout"):
+            not _has_kwarg(node, "timeout", "timeout_s"):
         return ".result() with no timeout"
     if attr == "acquire" and not node.args and \
             not _has_kwarg(node, "timeout", "blocking"):

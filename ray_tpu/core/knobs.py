@@ -94,6 +94,28 @@ KNOBS: List[Knob] = [
     Knob("RAY_TPU_RPC_BATCH_MAX_BYTES", "4194304", "int", "user",
          "Flush threshold (bytes) for the control-plane coalescing "
          "buffer."),
+    Knob("RAY_TPU_RPC_FLUSH_US", "0", "int", "user",
+         "Microseconds the coalescing sender lingers before flushing so "
+         "ping-pong request/ack chains batch; 0 keeps first-message-"
+         "immediate."),
+
+    # -- serve -----------------------------------------------------------
+    Knob("RAY_TPU_SERVE_MAX_QUEUE", "1024", "int", "user",
+         "Engine admission cap: add_request raises QueueFull once this "
+         "many requests wait (0 = unbounded)."),
+    Knob("RAY_TPU_SERVE_QUEUE_TIMEOUT_S", "60", "float", "user",
+         "Default queueing deadline; requests still waiting past it are "
+         "shed at the next engine step (0 = never)."),
+    Knob("RAY_TPU_SERVE_PREFILL_BUDGET", "8192", "int", "user",
+         "Per-step prefill token budget the continuous-batching "
+         "scheduler may spend while decode slots are live (0 = "
+         "unlimited)."),
+    Knob("RAY_TPU_SERVE_FEEDBACK_STALE_S", "5", "float", "user",
+         "Age past which a replica's piggybacked load report is ignored "
+         "and routing falls back to local inflight counts."),
+    Knob("RAY_TPU_SERVE_LOAD_REPORT_S", "1", "float", "user",
+         "Interval between controller load-report probes of serve "
+         "replicas."),
 
     # -- scheduling / placement -----------------------------------------
     Knob("RAY_TPU_NO_LOCALITY", "", "flag", "user",

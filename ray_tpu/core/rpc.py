@@ -94,6 +94,18 @@ def _batch_caps() -> tuple[int, int]:
     return max(2, msgs), max(1 << 16, nbytes)
 
 
+def _flush_us() -> int:
+    """Microseconds the coalescing sender lingers before each flush.
+    0 (default) keeps the first message on an idle link immediate;
+    >0 trades that first-message latency for fuller batches when the
+    traffic is a ping-pong request/ack chain whose turns would
+    otherwise each ride their own frame."""
+    try:
+        return max(0, int(os.environ.get("RAY_TPU_RPC_FLUSH_US", "0")))
+    except ValueError:
+        return 0
+
+
 def _to_jsonable(value: Any):
     if isinstance(value, (bytes, bytearray, memoryview)):
         return {"__bytes_b64__":
@@ -393,6 +405,7 @@ class _CoalescingSender:
         self._buf: list[tuple[int, int, bytes]] = []
         self._sending = False
         self.max_msgs, self.max_bytes = _batch_caps()
+        self.linger_s = _flush_us() / 1e6
         # Telemetry for tests and the RPC microbench probe.
         self.frames_sent = 0
         self.msgs_sent = 0
@@ -430,12 +443,15 @@ class _CoalescingSender:
                 if not self._buf:
                     return
                 self._sending = True
-            self._drain()
+            self._drain(linger=False)
 
-    def _drain(self):
+    def _drain(self, linger: bool = True):
         """Flush loop run by whichever thread claimed `_sending`: swap
         the buffer out, encode, write, repeat until nothing new arrived
-        during the write."""
+        during the write.  With RAY_TPU_RPC_FLUSH_US > 0 each round
+        lingers that long before swapping so trailing messages from
+        ping-pong peers ride the same frame; flush() fences skip the
+        linger (linger=False) — a fence wants the bytes out now."""
         try:
             while True:
                 with self._lock:
@@ -443,6 +459,10 @@ class _CoalescingSender:
                         self._sending = False
                         self._cv.notify_all()
                         return
+                    if linger and self.linger_s > 0.0:
+                        # cv.wait drops the lock so enqueuers can pile
+                        # into the buffer during the linger window.
+                        self._cv.wait(timeout=self.linger_s)
                     batch, self._buf = self._buf, []
                 for frame in self._encode(batch):
                     with self._wire_lock:

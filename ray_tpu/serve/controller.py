@@ -11,6 +11,7 @@ routers/proxies over the long-poll host.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import traceback
@@ -46,6 +47,11 @@ class ReplicaInfo:
     drain_deadline: float = 0.0
     ongoing_ref: Any = None
     last_ongoing: int = 0
+    # Load-report probe (router feedback): issued on the reconcile
+    # cadence, published on the load:: long-poll key.
+    load_ref: Any = None
+    load_issued: float = 0.0
+    last_load: Any = None
 
 
 @dataclass
@@ -340,6 +346,7 @@ class ServeController:
         with self._lock:
             self._autoscale(tgt, now)
             self._advance_replica_states(tgt, now)
+            self._probe_load_reports(tgt, now)
             current = [r for r in tgt.replicas
                        if r.state in ("STARTING", "RUNNING")
                        and r.version == tgt.version]
@@ -532,6 +539,48 @@ class ServeController:
         else:
             tgt.over_target_since = None
             tgt.under_target_since = None
+
+    # -- load feedback ---------------------------------------------------
+    def _probe_load_reports(self, tgt: DeploymentTarget, now: float):
+        """Lock held.  Async-probe RUNNING replicas' load_report() on
+        the reconcile cadence (same non-blocking ref pattern as the
+        autoscaler's num_ongoing probes — the RPCs themselves ride the
+        coalescing flusher with the health-check traffic) and publish
+        the collected reports on the load:: long-poll key for routers.
+        """
+        try:
+            period = float(os.environ.get(
+                "RAY_TPU_SERVE_LOAD_REPORT_S", "") or 1.0)
+        except ValueError:
+            period = 1.0
+        changed = False
+        for r in tgt.replicas:
+            if r.state != "RUNNING":
+                continue
+            if r.load_ref is not None:
+                done, _ = ray_tpu.wait([r.load_ref], timeout=0)
+                if done:
+                    try:
+                        rep = ray_tpu.get(r.load_ref, timeout=1)
+                        if isinstance(rep, dict):
+                            r.last_load = rep
+                            changed = True
+                    except Exception:  # raylint: allow-swallow(replica death is the health check's call; a failed probe leaves the old report to age out router-side)
+                        pass
+                    r.load_ref = None
+            elif now - r.load_issued >= period:
+                try:
+                    r.load_ref = r.handle.load_report.remote()
+                    r.load_issued = now
+                except Exception:  # raylint: allow-swallow(probe reissues next reconcile; health check owns replica death)
+                    pass
+        if changed:
+            reports = {
+                r.handle._actor_hex: r.last_load
+                for r in tgt.replicas
+                if r.state == "RUNNING" and r.last_load is not None}
+            self._poll.set(
+                f"load::{tgt.app_name}::{tgt.name}", reports)
 
     # -- publication ----------------------------------------------------
     def _publish_replicas(self, tgt: DeploymentTarget):

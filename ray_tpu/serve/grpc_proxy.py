@@ -18,7 +18,8 @@ from __future__ import annotations
 import json
 from typing import Iterator
 
-from ray_tpu.serve.proxy import Request, _RouteTable
+from ray_tpu.serve.proxy import (Request, _RouteTable, _STREAM_DISCONNECTS,
+                                 _STREAM_TOKENS)
 
 _SERVICE = "ray_tpu.serve.ServeAPI"
 
@@ -102,7 +103,9 @@ class GrpcProxy(_RouteTable):
     def _call_stream(self, req, context) -> Iterator:
         """Unary-stream: each yielded item of a streaming deployment
         method becomes one ServeReply frame (token streams for the LLM
-        replicas ride this)."""
+        replicas ride this).  A client cancel surfaces here as
+        GeneratorExit at the yield; it propagates to the replica
+        (cancel_stream) so the engine aborts the generation."""
         pb = self._pb
         handle = self._resolve(req)
         if handle is None:
@@ -111,11 +114,21 @@ class GrpcProxy(_RouteTable):
             return
         handle = handle.options(stream=True,
                                 method_name=req.method or None)
+        it = None
         try:
             gen = handle.remote(self._request_of(req))
-            for item in gen:
+            it = iter(gen)
+            for item in it:
                 yield pb.ServeReply(status=200,
                                     payload=json.dumps(item).encode())
+                _STREAM_TOKENS.inc(tags={"proxy": "grpc"})
+        except GeneratorExit:
+            # Client cancelled the RPC mid-stream.
+            _STREAM_DISCONNECTS.inc(tags={"proxy": "grpc"})
+            gen.cancel()
+            if it is not None:
+                it.close()
+            raise
         except Exception as e:  # noqa: BLE001
             yield pb.ServeReply(status=500, is_final=True,
                                 error=f"{type(e).__name__}: {e}")

@@ -35,7 +35,8 @@ class DeploymentResponse:
     def _submit(self):
         h = self._handle
         hex_id, actor = h._router().assign_replica(
-            timeout_s=h._assign_timeout_s)
+            timeout_s=h._assign_timeout_s,
+            model_id=h._multiplexed_model_id)
         meta = {"multiplexed_model_id": h._multiplexed_model_id}
         ref = getattr(actor, "handle_request").remote(
             self._method, self._args, self._kwargs, meta)
@@ -94,13 +95,23 @@ class DeploymentResponseGenerator:
 
     def __init__(self, handle: "DeploymentHandle", method: str,
                  args: tuple, kwargs: dict):
+        import uuid
+
         h = handle
         self._handle = h
         hex_id, actor = h._router().assign_replica(
-            timeout_s=h._assign_timeout_s)
+            timeout_s=h._assign_timeout_s,
+            model_id=h._multiplexed_model_id)
         self._assigned_hex = hex_id
+        self._actor = actor
         self._released = False
-        meta = {"multiplexed_model_id": h._multiplexed_model_id}
+        self._cancelled = False
+        # Per-stream cancellation token: Replica.cancel_stream(stream_id)
+        # (via cancel() here, or a proxy that detected the client
+        # disconnect) flags the in-replica generator to stop.
+        self.stream_id = uuid.uuid4().hex
+        meta = {"multiplexed_model_id": h._multiplexed_model_id,
+                "stream_id": self.stream_id}
         self._gen = actor.handle_request_streaming.options(
             num_returns="streaming").remote(method, args, kwargs, meta)
 
@@ -108,10 +119,28 @@ class DeploymentResponseGenerator:
     def task_id(self):
         return self._gen.task_id
 
+    def cancel(self):
+        """Ask the replica to stop this stream (client went away).
+        Cooperative: the in-replica generator observes its cancel event
+        at the next yield and frees engine slots / KV pages.  Safe to
+        call more than once."""
+        if self._cancelled:
+            return
+        self._cancelled = True
+        try:
+            self._actor.cancel_stream.remote(self.stream_id)
+        except Exception:  # raylint: allow-swallow(replica already dead; nothing left to cancel)
+            pass
+
     def __iter__(self):
         try:
             for ref in self._gen:
                 yield ray_tpu.get(ref)
+        except GeneratorExit:
+            # Consumer dropped the stream mid-iteration: propagate the
+            # cancellation to the replica before releasing the slot.
+            self.cancel()
+            raise
         finally:
             self._release()
 

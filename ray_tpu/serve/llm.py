@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ray_tpu.models import transformer as tfm
 from ray_tpu.serve.deployment import deployment
+from ray_tpu.serve.llm_engine import RequestShed
 
 
 @deployment(name="llm_server")
@@ -61,6 +62,7 @@ class LLMServer:
             max_batch=max_batch, **engine_kwargs)
         self._cv = threading.Condition()
         self._results: Dict[int, List[int]] = {}
+        self._shed: Dict[int, str] = {}
         self._engine_error: Optional[BaseException] = None
         self._stopped = False
         self._thread = threading.Thread(
@@ -82,7 +84,11 @@ class LLMServer:
                     self._engine_error = e
                     self._cv.notify_all()
                     return
-                if done:
+                had_shed = bool(self.engine.shed)
+                if had_shed:
+                    self._shed.update(self.engine.shed)
+                    self.engine.shed.clear()
+                if done or had_shed:
                     self._results.update(done)
                     self._cv.notify_all()
 
@@ -101,6 +107,12 @@ class LLMServer:
                 if self._engine_error is not None:
                     raise RuntimeError(
                         f"LLM engine failed: {self._engine_error}")
+                for i in ids:
+                    if i in self._shed:
+                        reason = self._shed.pop(i)
+                        raise RequestShed(
+                            f"request {i} shed before completion "
+                            f"({reason})")
                 self._cv.wait()
             return [self._results.pop(i) for i in ids]
 
@@ -121,7 +133,17 @@ class LLMServer:
         """Generator: yields tokens AS the engine decodes them — call
         through handle.options(stream=True) (or the HTTP proxy's
         streaming mode) for streamed chat completions.  The request
-        still rides the shared continuous-batching engine loop."""
+        still rides the shared continuous-batching engine loop.
+
+        Cancellation: when called through a streaming proxy the request
+        context carries a cancel_event (replica.cancel_stream sets it
+        on client disconnect); the poll loop observes it and aborts the
+        engine request so its slot + KV pages free immediately.  The
+        same cleanup runs if the consumer close()s this generator."""
+        from ray_tpu.serve.replica import _live_request_context
+
+        ctx = _live_request_context()
+        cancel = ctx.cancel_event if ctx is not None else None
         with self._cv:
             if self._engine_error is not None:
                 raise RuntimeError(
@@ -133,24 +155,43 @@ class LLMServer:
                        if r.req_id == rid)
             self._cv.notify_all()
         sent = 0
-        while True:
-            with self._cv:
-                if self._engine_error is not None:
-                    raise RuntimeError(
-                        f"LLM engine failed: {self._engine_error}")
-                finished = rid in self._results
-                toks = (self._results[rid] if finished
-                        else list(req.generated))
-                if not finished and len(toks) == sent:
-                    self._cv.wait(timeout=1.0)
-                    continue
+        try:
+            while True:
+                with self._cv:
+                    if self._engine_error is not None:
+                        raise RuntimeError(
+                            f"LLM engine failed: {self._engine_error}")
+                    if cancel is not None and cancel.is_set():
+                        self.engine.abort(rid, "cancelled")
+                        self.engine.shed.pop(rid, None)
+                        self._shed.pop(rid, None)
+                        self._results.pop(rid, None)
+                        return
+                    if rid in self._shed:
+                        raise RequestShed(
+                            f"request {rid} shed before completion "
+                            f"({self._shed.pop(rid)})")
+                    finished = rid in self._results
+                    toks = (self._results[rid] if finished
+                            else list(req.generated))
+                    if not finished and len(toks) == sent:
+                        self._cv.wait(timeout=0.05)
+                        continue
+                    if finished:
+                        self._results.pop(rid, None)
+                for t in toks[sent:]:
+                    yield int(t)
+                sent = len(toks)
                 if finished:
-                    self._results.pop(rid, None)
-            for t in toks[sent:]:
-                yield int(t)
-            sent = len(toks)
-            if finished:
-                return
+                    return
+        except GeneratorExit:
+            # Consumer dropped the stream mid-generation.
+            with self._cv:
+                self.engine.abort(rid, "cancelled")
+                self.engine.shed.pop(rid, None)
+                self._shed.pop(rid, None)
+                self._results.pop(rid, None)
+            raise
 
     def stats(self) -> Dict[str, Any]:
         eng = self.engine
@@ -161,6 +202,9 @@ class LLMServer:
                 "free_pages": eng.allocator.num_free,
                 "num_pages": eng.allocator.num_pages,
                 "num_completed": eng.num_completed,
+                "num_shed": eng.num_shed,
+                "num_aborted": eng.num_aborted,
+                "max_queue": eng.max_queue,
             }
 
     def __del__(self):

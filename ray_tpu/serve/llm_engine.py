@@ -18,6 +18,8 @@ serve/llm.py wraps it as a deployment for scale-out across replicas.
 from __future__ import annotations
 
 import math
+import os
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -25,6 +27,46 @@ import numpy as np
 
 from ray_tpu.models import transformer as tfm
 from ray_tpu.models.decoding import decode_step, init_kv_pages, prefill
+from ray_tpu.util import flight_recorder
+from ray_tpu.util.metrics import Counter, Gauge
+
+_REQUESTS = Counter(
+    "ray_tpu_serve_requests_total",
+    "Requests admitted into an LLMEngine queue.")
+_SHED = Counter(
+    "ray_tpu_serve_shed_total",
+    "Requests shed by engine admission control.",
+    tag_keys=("reason",))
+_QUEUE_DEPTH = Gauge(
+    "ray_tpu_serve_queue_depth",
+    "Requests waiting in the engine admission queue.")
+
+
+class QueueFull(RuntimeError):
+    """Raised by add_request when the admission queue is at capacity.
+
+    Backpressure signal: callers (LLMServer, proxies) translate it to
+    HTTP 503 / retriable errors instead of letting the waiting queue —
+    and every queued request's deadline — grow without bound."""
+
+
+class RequestShed(RuntimeError):
+    """Raised to a waiter whose queued request was shed (queueing
+    deadline passed, or the request was aborted) before completing."""
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
 
 
 class PageAllocator:
@@ -167,6 +209,11 @@ class _Request:
     # incrementally so draft lookup is O(1) per decode step.
     ngram_index: Dict[tuple, int] = field(default_factory=dict)
     indexed_upto: int = 0
+    # Queueing deadline (time.monotonic(); 0 = none): still WAITING past
+    # it means the request is shed at the next step — admitted requests
+    # always run to completion.
+    deadline: float = 0.0
+    enqueued_at: float = 0.0
 
 
 class LLMEngine:
@@ -179,7 +226,10 @@ class LLMEngine:
                  multi_step: int = 1, pipeline_depth: int = 2,
                  packed_admit: bool = True,
                  prefill_wave_tokens: int = 8192,
-                 prefill_row_tokens: int = 1024):
+                 prefill_row_tokens: int = 1024,
+                 max_queue: Optional[int] = None,
+                 queue_timeout_s: Optional[float] = None,
+                 prefill_budget: Optional[int] = None):
         import jax
 
         c = config
@@ -264,11 +314,33 @@ class LLMEngine:
         self.waiting: List[_Request] = []
         self.num_completed = 0
 
+        # Admission control (serve data plane): a bounded waiting queue
+        # (add_request raises QueueFull past it), a queueing deadline
+        # past which still-waiting requests are shed at the next step,
+        # and a per-step prefill token budget so admission work can't
+        # starve in-flight decode slots (TPOT stays flat while prompts
+        # prefill).  0 disables each mechanism.
+        self.max_queue = (_env_int("RAY_TPU_SERVE_MAX_QUEUE", 1024)
+                          if max_queue is None else int(max_queue))
+        self.queue_timeout_s = (
+            _env_float("RAY_TPU_SERVE_QUEUE_TIMEOUT_S", 60.0)
+            if queue_timeout_s is None else float(queue_timeout_s))
+        self.prefill_budget = (
+            _env_int("RAY_TPU_SERVE_PREFILL_BUDGET", 8192)
+            if prefill_budget is None else int(prefill_budget))
+        self.num_shed = 0
+        self.num_aborted = 0
+        # Requests shed/aborted since the caller last drained this map
+        # ({req_id: reason}); serve/llm.py fails the matching waiters.
+        self.shed: Dict[int, str] = {}
+        self._step_prefill_left = 1 << 30
+
     # -- public API --------------------------------------------------------
     def add_request(self, prompt_tokens: Sequence[int],
                     max_new_tokens: int = 32, *,
                     temperature: float = 0.0,
-                    eos_token: Optional[int] = None) -> int:
+                    eos_token: Optional[int] = None,
+                    deadline_s: Optional[float] = None) -> int:
         if not prompt_tokens:
             raise ValueError("prompt must contain at least one token")
         if max_new_tokens < 1:
@@ -288,11 +360,93 @@ class LLMEngine:
                 f"request needs {need} KV pages but the pool only has "
                 f"{self.allocator.num_pages - 1} allocatable; raise "
                 "num_pages or shorten the request")
+        if self.max_queue > 0 and len(self.waiting) >= self.max_queue:
+            # Backpressure instead of unbounded queue growth: shedding
+            # at the door is the one point where the caller can still
+            # retry another replica.
+            self.num_shed += 1
+            _SHED.inc(tags={"reason": "queue_full"})
+            flight_recorder.record("serve", "queue_full",
+                                   waiting=len(self.waiting),
+                                   max_queue=self.max_queue)
+            raise QueueFull(
+                f"admission queue full ({len(self.waiting)} waiting, "
+                f"cap {self.max_queue})")
         req = _Request(self._next_id, list(prompt_tokens), max_new_tokens,
                        temperature, eos_token=eos_token)
+        req.enqueued_at = time.monotonic()
+        ttl = self.queue_timeout_s if deadline_s is None else deadline_s
+        if ttl and ttl > 0:
+            req.deadline = req.enqueued_at + ttl
         self._next_id += 1
         self.waiting.append(req)
+        _REQUESTS.inc()
+        _QUEUE_DEPTH.set(len(self.waiting))
         return req.req_id
+
+    def abort(self, req_id: int, reason: str = "aborted") -> bool:
+        """Cancel a request wherever it is (waiting or active) and
+        reclaim its slot + KV pages.  Mid-stream client disconnects land
+        here: the slot frees at the next device-state merge, so an
+        abandoned generation stops burning decode bandwidth.  Returns
+        False when the id is unknown (already finished or shed)."""
+        for i, req in enumerate(self.waiting):
+            if req.req_id == req_id:
+                self.waiting.pop(i)
+                self._retire_unstarted(req, reason)
+                _QUEUE_DEPTH.set(len(self.waiting))
+                return True
+        for slot, req in enumerate(self.slot_req):
+            if req is not None and req.req_id == req_id:
+                # Mirror _maybe_finish's retirement, minus completion
+                # accounting: free the slot + private pages, release
+                # prefix-cache refs, and mark the slot dirty so the
+                # next merge zeroes it device-side (in-flight chunks
+                # then skip it at reconcile: slot_req identity check).
+                self.slot_req[slot] = None
+                self.context_lens[slot] = 0
+                self.allocator.free(req.pages)
+                req.pages = []
+                if self.prefix_cache is not None and req.cache_keys:
+                    self.prefix_cache.release(req.cache_keys)
+                    req.cache_keys = []
+                self._dirty_slots.add(slot)
+                self.num_aborted += 1
+                self.shed[req_id] = reason
+                flight_recorder.record("serve", "abort", req_id=req_id,
+                                       reason=reason, slot=slot)
+                return True
+        return False
+
+    def _retire_unstarted(self, req: _Request, reason: str) -> None:
+        """Drop a request that never reached a slot (shed or aborted
+        while waiting).  Waiting requests hold no pages and no
+        prefix-cache refs (_admit releases them on backpressure), so
+        this is pure queue bookkeeping."""
+        self.num_shed += 1
+        self.shed[req.req_id] = reason
+        _SHED.inc(tags={"reason": reason})
+        flight_recorder.record(
+            "serve", "shed", req_id=req.req_id, reason=reason,
+            waited_s=round(time.monotonic() - req.enqueued_at, 3)
+            if req.enqueued_at else 0.0)
+
+    def _shed_expired(self) -> None:
+        """Deadline-based shedding: drop waiting requests whose
+        queueing deadline passed.  Runs at the top of every step —
+        between steps nothing could have admitted them anyway."""
+        if not self.waiting:
+            return
+        now = time.monotonic()
+        kept: List[_Request] = []
+        for req in self.waiting:
+            if req.deadline and now > req.deadline:
+                self._retire_unstarted(req, "deadline")
+            else:
+                kept.append(req)
+        if len(kept) != len(self.waiting):
+            self.waiting = kept
+        _QUEUE_DEPTH.set(len(self.waiting))
 
     @property
     def num_active(self) -> int:
@@ -310,6 +464,14 @@ class LLMEngine:
         tokens are reconciled (<= pipeline_depth steps after the chunk
         that produced them)."""
         done: Dict[int, List[int]] = {}
+        self._shed_expired()
+        # Per-step prefill token budget: admission (classic _admit and
+        # packed waves) may spend at most this many prompt tokens per
+        # step, so a prefill burst interleaves with decode in bounded
+        # chunks instead of stalling every live slot for a full wave.
+        self._step_prefill_left = (self.prefill_budget
+                                   if self.prefill_budget > 0
+                                   else (1 << 30))
         if self._pipelined_ok():
             # Completed in-flight work costs nothing to fold in.
             self._eager_reconcile(done)
@@ -427,6 +589,17 @@ class LLMEngine:
                     self.prefix_cache.release(req.cache_keys)
                     req.cache_keys = []
                 break
+            n_suffix = L - len(shared) * self.page_size
+            if (admitted or self.num_active or self._inflight) \
+                    and n_suffix > self._step_prefill_left:
+                # Step prefill budget spent: defer so live decode slots
+                # get their step; an idle engine admits regardless.
+                if self.prefix_cache is not None and req.cache_keys:
+                    self.prefix_cache.release(req.cache_keys)
+                    req.cache_keys = []
+                break
+            self._step_prefill_left = max(
+                0, self._step_prefill_left - n_suffix)
             self.waiting.pop(0)
             slot = free.pop(0)
             req.slot = slot
@@ -574,7 +747,8 @@ class LLMEngine:
 
         batch: List[_Request] = []
         head_sl = None
-        budget = self.prefill_wave_tokens
+        budget = min(self.prefill_wave_tokens, self._step_prefill_left)
+        budget0 = budget
         # Same-wave shared-prefix dedup (mirrors classic _admit's
         # pending_keys): a request whose prefix THIS wave will register
         # defers one step, then admits via the cache-hit classic path
@@ -592,7 +766,11 @@ class LLMEngine:
                 head_sl = sl
             elif sl != head_sl:
                 break  # next bucket gets its own wave next step
-            if batch and budget < sl:
+            if budget < sl and (batch or self.num_active
+                                or self._inflight):
+                # Budget spent this step (or too small for the bucket):
+                # live decode work keeps the device; an idle engine
+                # still admits the head so progress is never starved.
                 break
             total = math.ceil((L + req.max_new_tokens) / self.page_size)
             if total > self._available_pages():
@@ -607,6 +785,8 @@ class LLMEngine:
             budget -= sl
         if not batch:
             return 0
+        self._step_prefill_left = max(
+            0, self._step_prefill_left - (budget0 - budget))
         # Fold pending host-side slot changes in BEFORE the wave slots
         # become live: a freed-slot merge arriving after assignment
         # would overwrite the wave's device-computed rows.

@@ -8,8 +8,13 @@ application route prefixes (kept fresh via the controller's long-poll
 'routes' key), and forwards to the app's ingress deployment through a
 DeploymentHandle.  JSON in / JSON out; a request carrying
 ``Accept: text/event-stream`` or ``X-Serve-Stream: 1`` gets a CHUNKED
-response that flushes each item the deployment's generator yields (one
-JSON document per line) — the streaming-token path for LLM serving.
+response that flushes each item the deployment's generator yields — the
+streaming-token path for LLM serving.  ``X-Serve-Stream: 1`` renders
+one JSON document per line (application/jsonl); ``Accept:
+text/event-stream`` renders Server-Sent Events (``data: <json>``
+frames, terminated by ``data: [DONE]``).  A client disconnect
+mid-stream propagates to the replica (Replica.cancel_stream) so the
+engine aborts the generation instead of decoding for nobody.
 """
 
 from __future__ import annotations
@@ -23,9 +28,20 @@ from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 import ray_tpu
+from ray_tpu.util.metrics import Counter
 
 LISTEN_TIMEOUT_S = 10.0
 DATA_PLANE_TIMEOUT_S = 60.0
+
+_STREAM_TOKENS = Counter(
+    "ray_tpu_serve_stream_tokens_total",
+    "Items streamed to clients through the serve proxies.",
+    tag_keys=("proxy",))
+_STREAM_DISCONNECTS = Counter(
+    "ray_tpu_serve_stream_disconnects_total",
+    "Client disconnects observed mid-stream (each also cancels the "
+    "replica-side generator).",
+    tag_keys=("proxy",))
 
 
 def _hget(headers: Dict[str, str], name: str, default: str = "") -> str:
@@ -417,6 +433,10 @@ class HTTPProxy(_RouteTable):
                                  + b"\r\n")
                     await writer.drain()
         except (ConnectionError, OSError):
+            # Client went away mid-response: stop the replica-side
+            # generator too (frees engine slots / KV pages).
+            _STREAM_DISCONNECTS.inc(tags={"proxy": "http"})
+            gen.cancel()
             raise
         except Exception as e:  # noqa: BLE001
             if not started:
@@ -449,39 +469,52 @@ class HTTPProxy(_RouteTable):
 
     async def _dispatch_streaming(self, writer, handle, req,
                                   timeout_s: float = DATA_PLANE_TIMEOUT_S):
-        """Chunked transfer: one JSON document per line per yielded item,
-        flushed as it arrives (the reference's streaming ASGI responses;
-        token streaming for LLM chat).  Replica backpressure is an async
-        sleep/retry (assign_timeout_s=0), same as _call_async — a full
-        cluster must not park an executor thread per waiting stream."""
+        """Chunked transfer, flushed per yielded item (the reference's
+        streaming ASGI responses; token streaming for LLM chat):
+        ``Accept: text/event-stream`` gets SSE ``data:`` frames ending
+        with ``data: [DONE]``, anything else one JSON document per line.
+        Replica backpressure is an async sleep/retry (assign_timeout_s=
+        0), same as _call_async — a full cluster must not park an
+        executor thread per waiting stream.  A client disconnect cancels
+        the replica-side generator (engine abort) before cleanup."""
+        sse = "text/event-stream" in _hget(req.headers, "accept")
         gen = await self._acquire_stream(writer, handle, req, timeout_s)
         if gen is None:
             return
+        ctype = ("text/event-stream" if sse else "application/jsonl")
         writer.write(
             b"HTTP/1.1 200 OK\r\n"
-            b"Content-Type: application/jsonl\r\n"
+            b"Content-Type: " + ctype.encode() + b"\r\n"
             b"Transfer-Encoding: chunked\r\n"
             b"Connection: keep-alive\r\n\r\n")
         await writer.drain()
+
+        def _frame(doc: str) -> bytes:
+            text = (f"data: {doc}\n\n" if sse else doc + "\n").encode()
+            return f"{len(text):x}\r\n".encode() + text + b"\r\n"
+
         state = {"i": 0, "eos_consumed": False}
+        completed = False
         try:
             async for item in _astream_values(gen.task_id, state):
-                data = (json.dumps(item) + "\n").encode()
-                writer.write(f"{len(data):x}\r\n".encode() + data
-                             + b"\r\n")
+                writer.write(_frame(json.dumps(item)))
+                _STREAM_TOKENS.inc(tags={"proxy": "http"})
                 await writer.drain()
+            completed = True
         except (ConnectionError, OSError):
-            raise  # client went away; cleanup in finally
+            # Client went away: stop the replica-side generator so the
+            # engine frees the slot + KV pages; cleanup in finally.
+            _STREAM_DISCONNECTS.inc(tags={"proxy": "http"})
+            gen.cancel()
+            raise
         except Exception as e:  # noqa: BLE001 — mid-stream: emit an
             # error line (headers already sent, status is fixed)
-            data = (json.dumps({"error": str(e)}) + "\n").encode()
-            writer.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+            writer.write(_frame(json.dumps({"error": str(e)})))
         finally:
             gen._release()
             # Free whatever this consumer will never read (finished
-            # streams only — a still-running generator's items are
-            # reclaimed at session teardown; actor-task cancellation is
-            # a future capability).
+            # streams only — a cancelled generator winds down replica-
+            # side and its tail items are reclaimed at teardown).
             try:
                 from ray_tpu.core.runtime import get_runtime
 
@@ -493,6 +526,8 @@ class HTTPProxy(_RouteTable):
                 gen.disown_stream()
             except Exception:
                 pass
+        if completed and sse:
+            writer.write(_frame("[DONE]"))
         writer.write(b"0\r\n\r\n")
         await writer.drain()
 
@@ -527,7 +562,7 @@ async def _astream_values(task_id, state: Optional[dict] = None):
                     try:
                         count = await loop.run_in_executor(
                             None, core._load_object, eos_hex,
-                            eos_fut.result())
+                            eos_fut.result())  # raylint: allow-blocking(guarded by eos_fut.done() above; resolves immediately)
                     except BaseException:
                         core.forget_object(item_hex)
                         raise

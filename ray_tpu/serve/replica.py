@@ -33,14 +33,32 @@ class RequestContext:
     """Per-request metadata (thread-local inside the replica)."""
 
     def __init__(self, multiplexed_model_id: str = "",
-                 route: str = ""):
+                 route: str = "", stream_id: str = ""):
         self.multiplexed_model_id = multiplexed_model_id
         self.route = route
+        # Streaming cancellation: proxies mint a stream_id per streaming
+        # call; Replica.cancel_stream(stream_id) sets cancel_event, and
+        # cooperative generators (LLMServer.generate_stream) poll it to
+        # abort mid-generation when the client disconnects.
+        self.stream_id = stream_id
+        self.cancel_event: Optional[threading.Event] = None
+        # Multiplex pins held by this request ((cache, model_id) pairs,
+        # appended by @serve.multiplexed getters); released when the
+        # request finishes so the LRU never evicts an in-use model.
+        self.model_pins: list = []
 
 
 def get_request_context() -> RequestContext:
     ctx = getattr(_replica_context, "request", None)
     return ctx if ctx is not None else RequestContext()
+
+
+def _live_request_context() -> Optional[RequestContext]:
+    """The REAL per-request context, or None outside a replica request
+    (get_request_context fabricates an unbound default in that case —
+    unusable for anything that must survive until request end, like
+    multiplex pins or cancel events)."""
+    return getattr(_replica_context, "request", None)
 
 
 class Replica:
@@ -65,6 +83,11 @@ class Replica:
         self._ongoing = 0
         self._lock = threading.Lock()
         self._draining = False
+        # stream_id -> cancel Event.  setdefault semantics on both the
+        # register (streaming _prepare_call) and cancel sides, so a
+        # cancel racing ahead of registration still lands; bounded so
+        # cancels for already-finished streams can't grow it forever.
+        self._streams: Dict[str, threading.Event] = {}
         if user_config is not None:
             self.reconfigure(user_config)
 
@@ -92,8 +115,10 @@ class Replica:
                   for k, v in kwargs.items()}
         _replica_context.ctx = ReplicaContext(
             self._app_name, self._deployment_name, self._replica_id)
-        _replica_context.request = RequestContext(
-            **(request_meta or {}))
+        ctx = RequestContext(**(request_meta or {}))
+        if ctx.stream_id:
+            ctx.cancel_event = self._stream_event(ctx.stream_id)
+        _replica_context.request = ctx
         # Resolve the target BEFORE counting the request: a bad method
         # name must not inflate _ongoing with no matching decrement
         # (that would eventually read as a saturated replica).
@@ -101,20 +126,51 @@ class Replica:
                   else getattr(self._callable, method))
         with self._lock:
             self._ongoing += 1
-        return target, args, kwargs
+        return target, args, kwargs, ctx
 
-    def _finish_call(self):
+    def _finish_call(self, ctx: Optional[RequestContext] = None):
         with self._lock:
             self._ongoing -= 1
+            if ctx is not None and ctx.stream_id:
+                self._streams.pop(ctx.stream_id, None)
+        if ctx is not None:
+            for cache, model_id in ctx.model_pins:
+                cache.unpin(model_id)
+            ctx.model_pins = []
+
+    def _stream_event(self, stream_id: str) -> threading.Event:
+        with self._lock:
+            ev = self._streams.get(stream_id)
+            if ev is None:
+                if len(self._streams) >= 4096:
+                    # Oldest-first bound: stale entries are cancels for
+                    # streams that already finished.
+                    self._streams.pop(next(iter(self._streams)))
+                ev = self._streams[stream_id] = threading.Event()
+            return ev
+
+    def cancel_stream(self, stream_id: str) -> bool:
+        """Flag a streaming request cancelled (client went away).  The
+        request's generator observes cancel_event on its next yield and
+        stops — freeing engine slots / KV pages instead of decoding for
+        nobody.  Safe to call before the stream registers (the event is
+        created set-ready) or after it finished (no-op)."""
+        from ray_tpu.util import flight_recorder
+
+        self._stream_event(stream_id).set()
+        flight_recorder.record("serve", "stream_cancel",
+                               stream_id=stream_id,
+                               replica_id=self._replica_id)
+        return True
 
     def handle_request(self, method: str, args: tuple, kwargs: dict,
                        request_meta: Optional[dict] = None) -> Any:
-        target, args, kwargs = self._prepare_call(
+        target, args, kwargs, ctx = self._prepare_call(
             method, args, kwargs, request_meta)
         try:
             return target(*args, **kwargs)
         finally:
-            self._finish_call()
+            self._finish_call(ctx)
 
     def handle_request_streaming(self, method: str, args: tuple,
                                  kwargs: dict,
@@ -123,16 +179,60 @@ class Replica:
         yielded item by item; called with num_returns='streaming' so
         each item flows to the proxy/handle as its own object (the
         reference's streaming ASGI responses, proxy.py:761)."""
-        target, args, kwargs = self._prepare_call(
+        target, args, kwargs, ctx = self._prepare_call(
             method, args, kwargs, request_meta)
         try:
             yield from target(*args, **kwargs)
         finally:
-            self._finish_call()
+            self._finish_call(ctx)
 
     # -- control plane --------------------------------------------------
     def num_ongoing(self) -> int:
         return self._ongoing
+
+    def load_report(self) -> Dict[str, Any]:
+        """Load feedback for the router's P2C scoring: ongoing count,
+        loaded multiplex model ids, and — when the user callable exposes
+        stats()/load_report() (LLMServer does) — engine queue depth,
+        active slots, and free KV pages.  The controller probes this on
+        its reconcile cadence and publishes it on the replicas long-poll
+        key, so reports piggyback existing control-plane traffic (the
+        coalescing flusher batches them with health checks)."""
+        from ray_tpu.serve import multiplex
+
+        report: Dict[str, Any] = {
+            "replica_id": self._replica_id,
+            "ts": time.time(),
+            "ongoing": self._ongoing,
+            "models": multiplex.loaded_model_ids(),
+        }
+        user = getattr(self._callable, "load_report", None)
+        if not callable(user):
+            user = getattr(self._callable, "stats", None)
+        if callable(user):
+            try:
+                extra = user()
+            except Exception as e:  # noqa: BLE001
+                import logging
+
+                from ray_tpu.core.log_once import warn_once
+
+                warn_once(logging.getLogger(__name__),
+                          "replica-load-report", e,
+                          "user stats() failed in load_report: %r", e)
+                extra = None
+            if isinstance(extra, dict):
+                if "waiting" in extra:
+                    report["queue_depth"] = int(extra["waiting"])
+                if "queue_depth" in extra:
+                    report["queue_depth"] = int(extra["queue_depth"])
+                if "active" in extra:
+                    report["active_slots"] = int(extra["active"])
+                if "free_pages" in extra:
+                    report["free_kv_pages"] = int(extra["free_pages"])
+                if "free_kv_pages" in extra:
+                    report["free_kv_pages"] = int(extra["free_kv_pages"])
+        return report
 
     def health_check(self) -> str:
         user_check = getattr(self._callable, "check_health", None)

@@ -3,13 +3,21 @@
 Counterpart of python/ray/serve/_private/router.py (Router :312,
 assign_request :518) and the PowerOfTwoChoicesReplicaScheduler
 (replica_scheduler/pow_2_scheduler.py:49): pick two random replicas and
-send to the one with the smaller queue.  Queue size here is the router's
-own in-flight count per replica (locality-aware variant) — no per-request
-probe RTT on the hot path.
+send to the one with the smaller queue.  The base queue signal is the
+router's own in-flight count per replica (no per-request probe RTT on
+the hot path); on top of it ride the replicas' piggybacked load reports
+— engine queue depth, free KV pages, loaded multiplex model ids —
+published by the controller on the load:: long-poll key.  P2C scoring
+adds the reported queue depth while the report is fresh and prefers
+replicas that already hold the requested multiplexed model; a report
+older than RAY_TPU_SERVE_FEEDBACK_STALE_S falls back to the blind
+local-inflight signal (a wedged controller must not steer traffic with
+fossil data).
 """
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -17,8 +25,17 @@ from typing import Dict, List, Optional
 
 import ray_tpu
 from ray_tpu.core.actor import ActorHandle
+from ray_tpu.util import flight_recorder
 
 LISTEN_TIMEOUT_S = 10.0
+
+
+def _stale_s() -> float:
+    try:
+        return float(os.environ.get(
+            "RAY_TPU_SERVE_FEEDBACK_STALE_S", "") or 5.0)
+    except ValueError:
+        return 5.0
 
 
 class _ReplicaSet:
@@ -26,6 +43,9 @@ class _ReplicaSet:
         self.entries: List[dict] = []
         self.handles: Dict[str, ActorHandle] = {}
         self.inflight: Dict[str, int] = {}
+        # actor_hex -> latest load report; received_at (monotonic, local
+        # to this process) drives the staleness fallback.
+        self.reports: Dict[str, dict] = {}
         self.version = 0
         self.cv = threading.Condition()
 
@@ -38,11 +58,25 @@ class _ReplicaSet:
                 if hex_id not in live:
                     del self.handles[hex_id]
                     self.inflight.pop(hex_id, None)
+                    self.reports.pop(hex_id, None)
             for e in self.entries:
                 h = e["actor_hex"]
                 if h not in self.handles:
                     self.handles[h] = ActorHandle(h, "Replica")
                     self.inflight.setdefault(h, 0)
+            self.cv.notify_all()
+
+    def update_reports(self, reports: Optional[Dict[str, dict]]):
+        if not reports:
+            return
+        now = time.monotonic()
+        with self.cv:
+            for hex_id, rep in reports.items():
+                if not isinstance(rep, dict):
+                    continue
+                rep = dict(rep)
+                rep["received_at"] = now
+                self.reports[hex_id] = rep
             self.cv.notify_all()
 
 
@@ -58,6 +92,7 @@ class Router:
         self._controller = controller
         self._set = _ReplicaSet()
         self._key = f"replicas::{app_name}::{deployment}"
+        self._load_key = f"load::{app_name}::{deployment}"
         # seed synchronously so the first request doesn't always wait a
         # full long-poll round trip
         try:
@@ -90,7 +125,7 @@ class Router:
             cls._hub.clear()
 
     def _poll_loop(self):
-        known = {self._key: 0}
+        known = {self._key: 0, self._load_key: 0}
         while not self._stop.is_set():
             try:
                 ref = self._controller.listen_for_change.remote(
@@ -99,19 +134,53 @@ class Router:
             except Exception:
                 if self._stop.is_set():
                     return
-                time.sleep(0.5)
+                time.sleep(0.5)  # raylint: allow-blocking(reconnect backoff on the router's own poll thread; no request rides it)
                 continue
             for key, (version, value) in (changed or {}).items():
                 if key == self._key:
                     known[key] = version
                     self._set.update(value, version)
+                elif key == self._load_key:
+                    known[key] = version
+                    self._set.update_reports(value)
 
     # ------------------------------------------------------------------
-    def assign_replica(self, timeout_s: float = 30.0) -> tuple:
-        """Pick a replica (pow-2 by local in-flight), respecting
-        max_ongoing backpressure; returns (actor_hex, handle)."""
+    def _score(self, e: dict, now: float, stale_s: float) -> tuple:
+        """P2C score for one candidate: local in-flight plus the
+        replica's reported engine queue depth while the report is fresh
+        (stale reports are ignored — blind local signal only), with a
+        penalty when the report says the KV pool is exhausted (every
+        admission there would stall on pages).  Returns (score, fresh).
+        """
+        h = e["actor_hex"]
+        score = float(self._set.inflight.get(h, 0))
+        rep = self._set.reports.get(h)
+        fresh = (rep is not None
+                 and now - rep.get("received_at", 0.0) <= stale_s)
+        if fresh:
+            score += float(rep.get("queue_depth", 0))
+            free = rep.get("free_kv_pages")
+            if free is not None and free <= 0:
+                score += 4.0
+        return score, fresh
+
+    def _has_model(self, e: dict, model_id: str, now: float,
+                   stale_s: float) -> bool:
+        rep = self._set.reports.get(e["actor_hex"])
+        if rep is None or now - rep.get("received_at", 0.0) > stale_s:
+            return False
+        return model_id in (rep.get("models") or ())
+
+    def assign_replica(self, timeout_s: float = 30.0,
+                       model_id: str = "") -> tuple:
+        """Pick a replica (pow-2 by local in-flight + fresh load
+        feedback), respecting max_ongoing backpressure; returns
+        (actor_hex, handle).  model_id biases the choice toward
+        replicas that already hold that multiplexed model (skipping a
+        cold load) unless none report it."""
         s = self._set
         deadline = time.monotonic() + timeout_s
+        stale_s = _stale_s()
         with s.cv:
             while True:
                 candidates = []
@@ -120,14 +189,31 @@ class Router:
                     if s.inflight.get(h, 0) < e.get("max_ongoing", 8):
                         candidates.append(e)
                 if candidates:
-                    if len(candidates) >= 2:
-                        a, b = random.sample(candidates, 2)
-                        pick = (a if s.inflight.get(a["actor_hex"], 0)
-                                <= s.inflight.get(b["actor_hex"], 0) else b)
+                    now = time.monotonic()
+                    pool = candidates
+                    affine = False
+                    if model_id:
+                        with_model = [e for e in candidates
+                                      if self._has_model(
+                                          e, model_id, now, stale_s)]
+                        if with_model:
+                            pool = with_model
+                            affine = True
+                    if len(pool) >= 2:
+                        a, b = random.sample(pool, 2)
+                        sa, fa = self._score(a, now, stale_s)
+                        sb, fb = self._score(b, now, stale_s)
+                        pick, fresh = (a, fa) if sa <= sb else (b, fb)
                     else:
-                        pick = candidates[0]
+                        pick = pool[0]
+                        _, fresh = self._score(pick, now, stale_s)
                     hex_id = pick["actor_hex"]
                     s.inflight[hex_id] = s.inflight.get(hex_id, 0) + 1
+                    flight_recorder.record(
+                        "serve", "route", deployment=self.deployment,
+                        replica=hex_id[:12], feedback=bool(fresh),
+                        affinity=affine,
+                        inflight=s.inflight[hex_id])
                     return hex_id, s.handles[hex_id]
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:

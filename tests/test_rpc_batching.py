@@ -194,6 +194,69 @@ def test_sender_single_messages_stay_plain_frames():
             rpc.KIND_ONEWAY, 0, len(payload)) + payload
 
 
+def test_flush_us_knob_parsing(monkeypatch):
+    """RAY_TPU_RPC_FLUSH_US: microsecond linger before each coalesced
+    flush; 0 (default) keeps first-message latency at zero, garbage and
+    negatives fall back to 0."""
+    monkeypatch.delenv("RAY_TPU_RPC_FLUSH_US", raising=False)
+    assert rpc._flush_us() == 0
+    monkeypatch.setenv("RAY_TPU_RPC_FLUSH_US", "250")
+    assert rpc._flush_us() == 250
+    monkeypatch.setenv("RAY_TPU_RPC_FLUSH_US", "-7")
+    assert rpc._flush_us() == 0
+    monkeypatch.setenv("RAY_TPU_RPC_FLUSH_US", "bogus")
+    assert rpc._flush_us() == 0
+    sock = _StubSock()
+    monkeypatch.setenv("RAY_TPU_RPC_FLUSH_US", "40000")
+    assert rpc._CoalescingSender(sock, threading.Lock()).linger_s \
+        == pytest.approx(0.04)
+
+
+def test_flush_timer_coalesces_trailing_messages(monkeypatch):
+    """With a linger window the drainer waits before swapping the
+    buffer, so messages sent moments after the first ride the SAME
+    frame — a ping-pong burst becomes one KIND_BATCH even on an idle
+    wire (where the default would flush each message by itself)."""
+    monkeypatch.setenv("RAY_TPU_RPC_FLUSH_US", "200000")  # 200 ms
+    sock = _StubSock()
+    sender = rpc._CoalescingSender(sock, threading.Lock())
+    t = threading.Thread(
+        target=sender.send,
+        args=(rpc.KIND_ONEWAY, 0, pickle.dumps({"i": 0})))
+    t.start()
+    deadline = time.monotonic() + 2.0
+    while not sender._sending and time.monotonic() < deadline:
+        time.sleep(0.001)  # wait for the drainer to claim the flush
+    for i in range(1, 5):
+        sender.send(rpc.KIND_ONEWAY, 0, pickle.dumps({"i": i}))
+    t.join(5.0)
+    sender.flush()
+    assert sender.msgs_sent == 5
+    # All five coalesced into a single batch frame: the linger window
+    # held the first flush open while the trailing sends piled in.
+    assert len(sock.frames) == 1
+    kind, _, _ = rpc._FRAME.unpack(sock.frames[0][:rpc._FRAME.size])
+    assert kind == rpc.KIND_BATCH
+    entries = pickle.loads(sock.frames[0][rpc._FRAME.size:])
+    assert [pickle.loads(p)["i"] for _, _, p in entries] == [0, 1, 2, 3, 4]
+    assert sender.batches_sent == 1
+
+
+def test_flush_fence_skips_linger(monkeypatch):
+    """flush() is an ordering fence: it must not sit out the linger
+    window (shutdown and oversized-result handoffs want bytes out NOW)."""
+    monkeypatch.setenv("RAY_TPU_RPC_FLUSH_US", "400000")  # 400 ms
+    sock = _StubSock()
+    sender = rpc._CoalescingSender(sock, threading.Lock())
+    with sender._lock:  # enqueue without claiming the drainer role
+        sender._buf.append((rpc.KIND_ONEWAY, 0, pickle.dumps({"i": 0})))
+        sender.msgs_sent += 1
+    t0 = time.monotonic()
+    sender.flush()
+    assert time.monotonic() - t0 < 0.35  # no 400 ms linger on the fence
+    assert len(sock.frames) == 1
+
+
 def test_no_batch_env_disables_coalescing(monkeypatch, echo_server):
     srv, handler = echo_server
     monkeypatch.setenv("RAY_TPU_RPC_NO_BATCH", "1")

@@ -32,6 +32,13 @@ def grpc_serve():
             for i in range(n):
                 yield {"i": i}
 
+        def slow_gen(self, req):
+            n = json.loads(req.body)["n"]
+            for i in range(n):
+                if i:
+                    time.sleep(0.25)
+                yield {"i": i}
+
     serve.run(Echo.bind(), name="echo", route_prefix="/echo")
     addr = serve.start_grpc_ingress()
     assert addr == serve.start_grpc_ingress()  # idempotent
@@ -93,3 +100,42 @@ def test_grpc_stream_call(grpc_serve):
     items = [json.loads(f.payload) for f in frames if f.payload]
     assert items == [{"i": i} for i in range(4)]
     assert all(f.status == 200 for f in frames)
+
+
+def test_grpc_stream_first_frame_before_completion(grpc_serve):
+    """Server streaming flushes each yielded item as its own reply
+    frame: with the deployment pausing between yields, the first frame
+    arrives well before the stream finishes (TTFT != total latency)."""
+    stream = _stub(grpc_serve, "CallStream", serve_pb2.ServeRequest,
+                   serve_pb2.ServeReply, stream=True)
+    call = stream(serve_pb2.ServeRequest(
+        route="/echo", method="slow_gen",
+        payload=json.dumps({"n": 4}).encode()), timeout=60)
+    arrivals, items = [], []
+    for f in call:
+        if f.payload:
+            items.append(json.loads(f.payload))
+        arrivals.append(time.monotonic())
+    assert items == [{"i": i} for i in range(4)]
+    # 0.25 s between yields: first frame landed long before the last.
+    assert arrivals[-1] - arrivals[0] > 0.4
+
+
+def test_grpc_stream_client_cancel(grpc_serve):
+    """Cancelling a server stream mid-flight stops delivery: iteration
+    raises CANCELLED instead of hanging until the generator drains
+    (proxy-side the cancel propagates GeneratorExit -> handle.cancel,
+    same as an HTTP disconnect)."""
+    stream = _stub(grpc_serve, "CallStream", serve_pb2.ServeRequest,
+                   serve_pb2.ServeReply, stream=True)
+    call = stream(serve_pb2.ServeRequest(
+        route="/echo", method="slow_gen",
+        payload=json.dumps({"n": 50}).encode()), timeout=120)
+    it = iter(call)
+    first = next(it)
+    assert first.status == 200 and json.loads(first.payload) == {"i": 0}
+    call.cancel()
+    with pytest.raises(grpc.RpcError) as info:
+        for _ in it:
+            pass
+    assert info.value.code() == grpc.StatusCode.CANCELLED
