@@ -36,6 +36,9 @@ def init(num_cpus: Optional[float] = None,
     by workers this process spawns (core/logging_config.py).  In connect
     mode (address=...) remote workers are spawned by the cluster's own
     daemons and keep the config the cluster was started with."""
+    from ray_tpu.core import knobs as _knobs
+
+    _knobs.apply_interpreter_tuning()
     rt = _runtime_mod._global_runtime
     if rt is not None and getattr(rt, "is_initialized", False):
         if ignore_reinit_error:

@@ -502,6 +502,312 @@ def _profile_history_summary(samples: List[dict]) -> Dict[str, Any]:
     }
 
 
+# ---------------------------------------------------------------------------
+# Head scale-out structures (reference: the sharded GCS table layer,
+# gcs_table_storage.h — per-key-space partitions so hot paths stop
+# serializing on one store — and the raylet's bucketed
+# ClusterResourceManager view).
+
+
+def _gcs_shards() -> int:
+    """RAY_TPU_GCS_SHARDS: owner-keyed submit-ingress shards (0 =
+    legacy single-lock ingress, used by the paired benchmarks)."""
+    try:
+        return max(0, int(os.environ.get("RAY_TPU_GCS_SHARDS", "8")))
+    except ValueError:
+        return 8
+
+
+def _node_index_enabled() -> bool:
+    return os.environ.get("RAY_TPU_NODE_INDEX", "1").strip().lower() \
+        not in ("0", "false", "no")
+
+
+class ShardedTaskTable:
+    """Task-record table partitioned into N shards, each with its own
+    lock.  The dict protocol (get/[]/pop/len/items) is preserved so the
+    scheduler's global-lock call sites read through unchanged; the win
+    is `_op_task_events` — the highest-volume completion-drain op —
+    which merges event deltas under only the record's shard lock and
+    never touches the scheduler's global lock.
+
+    items()/values()/keys() return per-shard snapshots (safe to iterate
+    while other threads insert), so iteration order is shard-grouped
+    rather than global insertion order — lineage pruning becomes
+    approximate-oldest-first, which it already effectively was."""
+
+    __slots__ = ("_shards", "_locks", "_n")
+
+    def __init__(self, n: int = 8):
+        self._n = max(1, n)
+        self._shards: List[Dict[str, Any]] = [
+            {} for _ in range(self._n)]
+        self._locks = [threading.Lock() for _ in range(self._n)]
+
+    def _idx(self, key: str) -> int:
+        return hash(key) % self._n
+
+    def lock_for(self, key: str) -> threading.Lock:
+        return self._locks[self._idx(key)]
+
+    def get(self, key, default=None):
+        return self._shards[self._idx(key)].get(key, default)
+
+    def __getitem__(self, key):
+        return self._shards[self._idx(key)][key]
+
+    def __setitem__(self, key, value):
+        i = self._idx(key)
+        with self._locks[i]:
+            self._shards[i][key] = value
+
+    def __delitem__(self, key):
+        i = self._idx(key)
+        with self._locks[i]:
+            del self._shards[i][key]
+
+    def pop(self, key, *default):
+        i = self._idx(key)
+        with self._locks[i]:
+            return self._shards[i].pop(key, *default)
+
+    def __contains__(self, key) -> bool:
+        return key in self._shards[self._idx(key)]
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._shards)
+
+    def __bool__(self) -> bool:
+        return any(self._shards)
+
+    def items(self):
+        out = []
+        for i, s in enumerate(self._shards):
+            with self._locks[i]:
+                out.extend(s.items())
+        return out
+
+    def values(self):
+        return [v for _, v in self.items()]
+
+    def keys(self):
+        return [k for k, _ in self.items()]
+
+
+class PendingLeaseQueue:
+    """Queued worker-lease demand, sharded by owner with incremental
+    per-node / per-env / per-owner indexes.
+
+    `_op_request_lease`'s virtual-availability view used to subtract
+    queued demand by scanning EVERY pending entry per candidate node
+    (O(pending x nodes) per request); the node index makes that
+    O(demand actually targeting the node).  Appends are O(1); the grant
+    pass rebuilds via reset() exactly where it used to rebuild the flat
+    list."""
+
+    __slots__ = ("_items", "_by_node", "_by_env", "_by_owner")
+
+    def __init__(self):
+        self._items: List[dict] = []
+        self._by_node: Dict[str, List[dict]] = {}
+        self._by_env: Dict[str, int] = {}
+        self._by_owner: Dict[str, int] = {}
+
+    def _index(self, pl: dict):
+        nid = pl.get("node_id") or ""
+        if nid:
+            self._by_node.setdefault(nid, []).append(pl)
+        ek = pl.get("env_key", "")
+        self._by_env[ek] = self._by_env.get(ek, 0) + 1
+        ow = pl.get("owner", "")
+        self._by_owner[ow] = self._by_owner.get(ow, 0) + 1
+
+    def append(self, pl: dict):
+        self._items.append(pl)
+        self._index(pl)
+
+    def reset(self, items: List[dict]):
+        self._items = list(items)
+        self._by_node = {}
+        self._by_env = {}
+        self._by_owner = {}
+        for pl in self._items:
+            self._index(pl)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __bool__(self) -> bool:
+        return bool(self._items)
+
+    def node_demand(self, node_id: str) -> List[dict]:
+        return self._by_node.get(node_id, ())
+
+    def env_count(self, env_key: str) -> int:
+        return self._by_env.get(env_key, 0)
+
+    def owners_except(self, owner_hex: str):
+        return [o for o in self._by_owner if o != owner_hex]
+
+    def earliest_deadline(self) -> Optional[float]:
+        """Absolute time the soonest queued entry goes stale (spawned
+        demand expires at 10s, cluster-infeasible at 15s — the grant
+        pass's denial windows).  Drives the scheduler's timer-wheel arm
+        instead of a fixed 0.5 s poll."""
+        best = None
+        for pl in self._items:
+            d = pl["created"] + (10.0 if pl.get("node_id") else 15.0)
+            if best is None or d < best:
+                best = d
+        return best
+
+
+class _NodeIndex:
+    """Utilization-bucketed node index + per-resource free sets: the
+    O(1)-amortized candidate generator behind `_pick_node` and
+    SPREAD/STRICT_SPREAD bundle placement (replacing full node-table
+    scans, which made 1,000-PG create-ready collapse 3.6x on the
+    2,000-node sim).
+
+    Buckets partition [0, 1] utilization into NBUCKETS slices; each
+    bucket is a list with swap-pop removal so membership updates are
+    O(1) and positional probing (hash-rotated) is stable enough for
+    SPREAD tie fan-out.  The index is a *candidate generator*, not an
+    oracle: queries re-verify fit against the caller's (possibly
+    virtual) availability view before committing, so staleness can only
+    cost optimality, never correctness.  Callers `touch()` a node after
+    mutating its availability; `rebuild()` runs on join/death."""
+
+    NBUCKETS = 8
+
+    __slots__ = ("_server", "_buckets", "_pos", "_free", "rebuilds")
+
+    def __init__(self, server: "ControlServer"):
+        self._server = server
+        self._buckets: List[List[str]] = [
+            [] for _ in range(self.NBUCKETS + 1)]
+        # node_id -> (bucket index, position in bucket list)
+        self._pos: Dict[str, tuple] = {}
+        # Per-resource-class free sets: node ids with available[res]>0.
+        # A scarce resource's set (e.g. TPU on a mostly-CPU cluster) is
+        # tiny, so queries needing it iterate the set instead of the
+        # buckets.
+        self._free: Dict[str, Set[str]] = {}
+        self.rebuilds = 0
+
+    def _bucket_of(self, node) -> int:
+        u = self._server._utilization(node)
+        b = int(u * self.NBUCKETS)
+        return min(max(b, 0), self.NBUCKETS)
+
+    def _remove(self, node_id: str):
+        at = self._pos.pop(node_id, None)
+        if at is None:
+            return
+        b, i = at
+        bucket = self._buckets[b]
+        last = bucket.pop()
+        if last != node_id:
+            bucket[i] = last
+            self._pos[last] = (b, i)
+
+    def _insert(self, node_id: str, b: int):
+        bucket = self._buckets[b]
+        bucket.append(node_id)
+        self._pos[node_id] = (b, len(bucket) - 1)
+
+    def touch(self, node_id: str):
+        """Re-bucket one node after its availability changed (lock
+        held by the caller)."""
+        node = self._server.nodes.get(node_id)
+        if node is None or not node.schedulable:
+            self._remove(node_id)
+            for s in self._free.values():
+                s.discard(node_id)
+            return
+        avail = node.available.to_dict()
+        for res, s in self._free.items():
+            if avail.get(res, 0) <= 0:
+                s.discard(node_id)
+        for res, v in avail.items():
+            if v > 0:
+                self._free.setdefault(res, set()).add(node_id)
+        b = self._bucket_of(node)
+        at = self._pos.get(node_id)
+        if at is not None and at[0] == b:
+            return
+        self._remove(node_id)
+        self._insert(node_id, b)
+
+    def rebuild(self):
+        """Full re-index (node join/death/drain — rare)."""
+        self._buckets = [[] for _ in range(self.NBUCKETS + 1)]
+        self._pos = {}
+        self._free = {}
+        for nid, node in self._server.nodes.items():
+            if node.schedulable:
+                self._insert(nid, self._bucket_of(node))
+                for res, v in node.available.to_dict().items():
+                    if v > 0:
+                        self._free.setdefault(res, set()).add(nid)
+        self.rebuilds += 1
+        try:
+            from ray_tpu.util import flight_recorder
+
+            flight_recorder.record("sched", "index_rebuild",
+                                   nodes=len(self._pos))
+        except Exception:  # raylint: allow-swallow(telemetry only)
+            pass
+
+    def buckets_low_to_high(self):
+        for b in self._buckets:
+            if b:
+                yield b
+
+    def buckets_high_to_low(self, below: Optional[float] = None):
+        """Buckets from most- to least-utilized; `below` drops whole
+        buckets at/above that utilization (the hybrid policy's pack
+        threshold)."""
+        hi = len(self._buckets) - 1
+        if below is not None:
+            hi = min(hi, max(0, int(below * self.NBUCKETS) - 1))
+        for i in range(hi, -1, -1):
+            if self._buckets[i]:
+                yield self._buckets[i]
+
+    def scarce_set(self, res_names, cap: int = 16) -> Optional[Set[str]]:
+        """The smallest per-resource free set among `res_names`, when
+        it is small enough that iterating it beats the bucket walk;
+        None when every named resource is plentiful (or unknown —
+        unknown means no node has it free, returned as the empty
+        set)."""
+        best = None
+        for r in res_names:
+            s = self._free.get(r)
+            if s is None:
+                return set()
+            if best is None or len(s) < len(best):
+                best = s
+        return best if best is not None and len(best) <= cap else None
+
+    def probe(self, bucket: List[str], seed: int, accept) -> Optional[str]:
+        """Rotated linear probe over one bucket: start at seed %% len
+        so equal-utilization nodes fan out, return the first node
+        `accept` confirms.  O(1) expected when most nodes fit."""
+        n = len(bucket)
+        if n == 0:
+            return None
+        start = seed % n
+        for i in range(n):
+            nid = bucket[start + i - n if start + i >= n else start + i]
+            if accept(nid):
+                return nid
+        return None
+
+
 class ControlServer:
     def __init__(self, session_id: str, config: Config, resources: ResourceSet,
                  session_dir: str, namespace: str = ""):
@@ -515,6 +821,26 @@ class ControlServer:
         prewarm_zygote()  # worker template warms while the head boots
 
         self.lock = threading.RLock()
+        # Object-settle condition (shares self.lock): fetch-path waiters
+        # block here instead of sleep-polling; every READY/ERRORED
+        # transition and restore completion notifies.
+        self._obj_settled = threading.Condition(self.lock)
+        # Owner-keyed submit ingress: _op_submit_task(_batch) appends
+        # specs to a per-owner-shard deque WITHOUT the global lock; the
+        # scheduler (and any reader that could observe an undrained
+        # spec) drains them under the lock.  deque append/popleft are
+        # GIL-atomic, so the ingress itself is lock-free.  None = legacy
+        # single-lock ingress (RAY_TPU_GCS_SHARDS=0).
+        n_shards = _gcs_shards()
+        self._ingress: Optional[List[deque]] = (
+            [deque() for _ in range(n_shards)] if n_shards else None)
+        self._node_index = None  # built after journal restore
+        self._lease_timer = None  # timer-wheel handle for lease expiry
+        try:
+            self._idle_wait_s = float(os.environ.get(
+                "RAY_TPU_SCHED_IDLE_WAIT_S", "30.0"))
+        except ValueError:
+            self._idle_wait_s = 30.0
         self.objects: Dict[str, ObjectEntry] = {}
         self.workers: Dict[str, WorkerInfo] = {}
         self.actors: Dict[str, ActorEntry] = {}
@@ -532,7 +858,7 @@ class ControlServer:
         # DirectActorTaskSubmitter::DisconnectActor).
         self.actor_inflight: Dict[str, Set[str]] = {}
         self.obj_actor: Dict[str, str] = {}
-        self.tasks: Dict[str, TaskRecord] = {}
+        self.tasks = ShardedTaskTable(max(1, n_shards or 8))
         # Lineage: object hex -> producing task hex, kept even after the
         # object entry itself is freed so a lost dependency can be
         # re-created (reference lineage map, task_manager.h:208).
@@ -549,7 +875,7 @@ class ControlServer:
         # granted as workers come online / free up, or denied on expiry
         # so the owner re-requests (reference: queued lease requests in
         # NodeManager::HandleRequestWorkerLease, node_manager.cc:1794).
-        self.pending_leases: List[dict] = []
+        self.pending_leases = PendingLeaseQueue()
         # env_key -> runtime_env dict; workers fetch + apply their pool's
         # env at startup (runtime_env/plugin.py).
         self.runtime_envs: Dict[str, dict] = {}
@@ -615,6 +941,12 @@ class ControlServer:
             if node is not None:
                 node.draining = True
 
+        # O(1)-amortized node selection (RAY_TPU_NODE_INDEX=0 restores
+        # the legacy full-scan policies, byte-for-byte).
+        if _node_index_enabled():
+            self._node_index = _NodeIndex(self)
+            self._node_index.rebuild()
+
         # Scheduler observability (util/metrics.py): lease decisions and
         # task-event ingest volume export through the same /metrics
         # pipeline as user metrics.  frames vs events makes the delta
@@ -647,12 +979,17 @@ class ControlServer:
                 "ray_tpu_node_unhealthy_total",
                 "Nodes flagged unhealthy (stale heartbeat) by the "
                 "watchdog")
+            self._m_shard_ops = _m.Counter(
+                "ray_tpu_sched_shard_ops_total",
+                "Submissions accepted through the lock-free owner-"
+                "keyed ingress shards")
         except Exception:
             self._m_lease_grants = self._m_lease_denials = None
             self._m_lease_clamps = None
             self._m_task_events = self._m_task_event_frames = None
             self._m_locality_hits = None
             self._m_stragglers = self._m_node_unhealthy = None
+            self._m_shard_ops = None
 
         # Cluster span harvest state (collect_spans wire op): per-worker
         # ring cursors persist across harvests so each pull ships only
@@ -831,6 +1168,8 @@ class ControlServer:
     def stop(self):
         self._stopped.set()
         self._wake.set()
+        if self._lease_timer is not None:
+            self._lease_timer.cancel()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
         with self.lock:
@@ -854,11 +1193,19 @@ class ControlServer:
             except Exception:
                 pass
         procs = [w.proc for w in workers if w.proc is not None]
+        # Event-driven reap: block in each child's wait() against one
+        # shared deadline instead of poll()+sleep spinning — the kernel
+        # wakes us the instant a child exits.
         deadline = time.monotonic() + 1.0
-        while procs and time.monotonic() < deadline:
-            procs = [p for p in procs if p.poll() is None]
-            if procs:
-                time.sleep(0.02)
+        for p in procs:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                p.wait(max(remaining, 0.001))
+            except Exception:  # raylint: allow-swallow(timeout or reaped elsewhere; stragglers escalate below)
+                pass
+        procs = [p for p in procs if p.poll() is None]
         for p in procs:  # stragglers: escalate
             try:
                 p.kill()
@@ -922,6 +1269,7 @@ class ControlServer:
             node.alive = False
             node.available = ResourceSet()
             node.conn = None
+            self._index_touch(node_id)
             self._drop_drain_state_locked(node_id)
             for w in list(self.workers.values()):
                 if w.node_id == node_id and w.state != "dead":
@@ -988,9 +1336,9 @@ class ControlServer:
                 x.state = "idle"
                 x.leased_to = ""
         if self.pending_leases:
-            self.pending_leases = [
-                pl for pl in self.pending_leases
-                if pl["owner"] != w.worker_hex]
+            self.pending_leases.reset(
+                [pl for pl in self.pending_leases
+                 if pl["owner"] != w.worker_hex])
         if w.current_task:
             rec = self.tasks.get(w.current_task)
             if rec is not None and rec.state == "RUNNING":
@@ -1136,6 +1484,7 @@ class ControlServer:
                 address=msg.get("address", ""), conn=conn,
                 store_key=msg.get("store_key", ""),
                 shm_dir=msg.get("shm_dir", ""))
+            self._index_touch(node_id)
             conn.meta["node_id"] = node_id
         # Force a view broadcast so the (re)joining manager gets the
         # current resource view even when nothing else changed.
@@ -1167,6 +1516,9 @@ class ControlServer:
         entry.node_id = node_id if in_shm else "head"
         entry.is_error = is_error
         entry.stored_at = time.time()
+        # Wake fetch-path waiters parked in _await_object_settled (the
+        # condition shares self.lock, which is held here).
+        self._obj_settled.notify_all()
         actor_hex = self.obj_actor.pop(obj_hex, None)
         if actor_hex is not None:
             self.actor_inflight.get(actor_hex, set()).discard(obj_hex)
@@ -1232,7 +1584,8 @@ class ControlServer:
                 any_shm = any_shm or bool(item.get("in_shm"))
         if any_shm:
             self._maybe_spill()
-        if self.pending_tasks or self.pending_leases:
+        if self.pending_tasks or self.pending_leases \
+                or self._ingress_pending():
             self._wake.set()
 
     def _op_put_object(self, conn, msg):
@@ -1244,8 +1597,9 @@ class ControlServer:
             self._maybe_spill()
         # Wake the scheduler only when something could be waiting on the
         # arrival (a put with no queued work has nothing to unblock; the
-        # loop's 0.5 s timeout covers stragglers).
-        if self.pending_tasks or self.pending_leases:
+        # loop's timeout covers stragglers).
+        if self.pending_tasks or self.pending_leases \
+                or self._ingress_pending():
             self._wake.set()
 
     def _put_object_locked(self, conn, msg):
@@ -1369,6 +1723,7 @@ class ControlServer:
             if entry is None:
                 return
             entry.restoring = False
+            self._obj_settled.notify_all()
             if data is None:
                 # The spilled copy is gone: fall back to lineage
                 # reconstruction; queued subscribers stay on the entry and
@@ -1626,7 +1981,7 @@ class ControlServer:
     def _op_subscribe_object(self, conn, msg):
         obj_hex = msg["obj"]
         with self.lock:
-            entry = self.objects.get(obj_hex)
+            entry = self._object_entry_or_drain_locked(obj_hex)
             if entry is None:
                 entry = self.objects[obj_hex] = ObjectEntry(refcount=0)
                 if msg.get("grace"):
@@ -1685,14 +2040,14 @@ class ControlServer:
 
     def _op_incref(self, conn, msg):
         with self.lock:
-            entry = self.objects.get(msg["obj"])
+            entry = self._object_entry_or_drain_locked(msg["obj"])
             if entry is not None:
                 entry.refcount += msg.get("n", 1)
 
     def _op_incref_batch(self, conn, msg):
         with self.lock:
             for obj_hex in msg["objs"]:
-                entry = self.objects.get(obj_hex)
+                entry = self._object_entry_or_drain_locked(obj_hex)
                 if entry is not None:
                     entry.refcount += 1
 
@@ -1710,7 +2065,7 @@ class ControlServer:
             for obj_hex, d in msg["deltas"].items():
                 d = int(d)
                 if d > 0:
-                    entry = self.objects.get(obj_hex)
+                    entry = self._object_entry_or_drain_locked(obj_hex)
                     if entry is not None:
                         entry.refcount += d
                 elif d < 0:
@@ -1722,7 +2077,7 @@ class ControlServer:
         to_delete = []
         with self.lock:
             obj_hex = msg["obj"]
-            entry = self.objects.get(obj_hex)
+            entry = self._object_entry_or_drain_locked(obj_hex)
             if entry is None:
                 return
             entry.refcount -= msg.get("n", 1)
@@ -1901,18 +2256,93 @@ class ControlServer:
             spec=spec, submitted_at=now)
         self.pending_tasks.append(spec)
 
+    def _ingress_pending(self) -> bool:
+        """Any submitted-but-undrained specs in the ingress shards?
+        deque truthiness is GIL-atomic, so this is safe lock-free."""
+        ing = self._ingress
+        return ing is not None and any(ing)
+
+    def _ingress_shard_of(self, spec) -> int:
+        # Owner id keys the shard so one owner's submissions stay FIFO
+        # (a shard deque preserves per-producer order) while different
+        # owners never touch the same deque entry.
+        owner = getattr(spec, "owner", "") or ""
+        return hash(owner) % len(self._ingress)
+
+    def _drain_submit_ingress_locked(self):
+        """Lock held.  Move every staged spec into the real pending
+        queue/table.  Amortized O(1) per task (each spec is drained
+        exactly once); the empty check is a handful of GIL-atomic deque
+        reads."""
+        ing = self._ingress
+        if ing is None:
+            return
+        drained = 0
+        for shard in ing:
+            while True:
+                try:
+                    spec, ts = shard.popleft()
+                except IndexError:
+                    break
+                self._enqueue_task_locked(spec, ts)
+                drained += 1
+        if drained:
+            try:
+                from ray_tpu.util import flight_recorder
+
+                flight_recorder.record("sched", "shard_dispatch",
+                                       n=drained)
+            except Exception:  # raylint: allow-swallow(telemetry only)
+                pass
+
+    def _object_entry_or_drain_locked(self, obj_hex: str):
+        """Lock held.  Object-directory lookup that tolerates ingress
+        deferral: a ref-counting / subscribe op can arrive (from a
+        DIFFERENT owner's connection) before the submit that registers
+        the object's entry has drained — without the drain-on-miss an
+        incref would silently no-op and the ref later double-free."""
+        entry = self.objects.get(obj_hex)
+        if entry is None and self._ingress_pending():
+            self._drain_submit_ingress_locked()
+            entry = self.objects.get(obj_hex)
+        return entry
+
     def _op_submit_task(self, conn, msg):
-        with self.lock:
-            self._enqueue_task_locked(msg["spec"], time.time())
+        spec = msg["spec"]
+        if self._ingress is not None:
+            self._ingress[self._ingress_shard_of(spec)].append(
+                (spec, time.time()))
+            if self._m_shard_ops is not None:
+                try:
+                    self._m_shard_ops.inc()
+                except Exception:  # raylint: allow-swallow(telemetry only)
+                    pass
+        else:
+            with self.lock:
+                self._enqueue_task_locked(spec, time.time())
         self._wake.set()
 
     def _op_submit_task_batch(self, conn, msg):
         """Coalesced submission (runtime.py _queue_for_flush): one frame
-        and one lock acquisition for a whole burst of tasks."""
+        for a whole burst of tasks.  With ingress shards enabled the
+        burst is staged lock-free on the owner's shard and drained by
+        the scheduler; submission no longer contends with dispatch or
+        completion on the global lock."""
         now = time.time()
-        with self.lock:
-            for spec in msg["specs"]:
-                self._enqueue_task_locked(spec, now)
+        specs = msg["specs"]
+        if self._ingress is not None and specs:
+            shard = self._ingress[self._ingress_shard_of(specs[0])]
+            for spec in specs:
+                shard.append((spec, now))
+            if self._m_shard_ops is not None:
+                try:
+                    self._m_shard_ops.inc(len(specs))
+                except Exception:  # raylint: allow-swallow(telemetry only)
+                    pass
+        else:
+            with self.lock:
+                for spec in specs:
+                    self._enqueue_task_locked(spec, now)
         self._wake.set()
 
     # -- C++-defined tasks/actors ---------------------------------------
@@ -2111,6 +2541,7 @@ class ControlServer:
 
     def _op_task_done(self, conn, msg):
         with self.lock:
+            self._drain_submit_ingress_locked()
             # Batched result puts ride the done message (worker.py
             # _finish); store them BEFORE completing the task so
             # subscribers resolve before any retry bookkeeping.
@@ -2203,6 +2634,7 @@ class ControlServer:
                 continue
             del pending[i]
             node.available = node.available.subtract(need)
+            self._index_touch(w.node_id)
             w.acquired = need
             w.charge = ("node", w.node_id)
             w.state = "busy"
@@ -2252,11 +2684,13 @@ class ControlServer:
                           and node.alive else ResourceSet())
                     # Earlier queued lease demand already spoken for on
                     # this node reduces what THIS request can plan with.
-                    for pl in self.pending_leases:
-                        if pl.get("node_id") == nid:
-                            pneed = ResourceSet(pl["resources"])
-                            av = av.subtract(pneed) \
-                                if pneed.is_subset_of(av) else ResourceSet()
+                    # Indexed by node: O(demand on nid), not O(all
+                    # pending) — the scan that made lease admission
+                    # quadratic under many-owner contention.
+                    for pl in self.pending_leases.node_demand(nid):
+                        pneed = ResourceSet(pl["resources"])
+                        av = av.subtract(pneed) \
+                            if pneed.is_subset_of(av) else ResourceSet()
                     avail_virtual[nid] = av
                 return avail_virtual[nid]
 
@@ -2270,9 +2704,8 @@ class ControlServer:
                         starting_total += 1
             # Spawns already claimed by earlier queued lease requests
             # must not dedupe THIS request's spawns.
-            unclaimed = starting_total - sum(
-                1 for pl in self.pending_leases
-                if pl["env_key"] == env_key)
+            unclaimed = starting_total \
+                - self.pending_leases.env_count(env_key)
             # Fair-share clamp under competition: with other owners
             # holding leases or queued demand, one burst's ask must not
             # swallow the whole free pool first-come-take-all — the
@@ -2283,8 +2716,7 @@ class ControlServer:
             others = {w.leased_to for w in self.workers.values()
                       if w.kind == "pool" and w.state == "leased"
                       and w.leased_to and w.leased_to != owner_hex}
-            others.update(pl["owner"] for pl in self.pending_leases
-                          if pl["owner"] != owner_hex)
+            others.update(self.pending_leases.owners_except(owner_hex))
             if others and count > 1:
                 free_fit = sum(virt(n.node_id).fit_count(need)
                                for n in self.nodes.values()
@@ -2518,7 +2950,7 @@ class ControlServer:
                 out.append((owner.conn, pl["token"], [], 1, ""))
             else:
                 still.append(pl)
-        self.pending_leases = still
+        self.pending_leases.reset(still)
         return out
 
     def _push_lease_grants(self, grants: List[tuple]):
@@ -2573,52 +3005,62 @@ class ControlServer:
                 self._m_task_events.inc(len(events))
         except Exception:
             pass
-        with self.lock:
-            w = self.workers.get(worker_hex)
-            for ev in events:
-                rec = self.tasks.get(ev["task_id"])
-                if rec is None:
-                    spec = TaskSpec(
-                        task_id=TaskID.from_hex(ev["task_id"]),
-                        func_id="", func_blob=None, args=[],
-                        num_returns=1, return_ids=[], resources={},
-                        max_retries=int(ev.get("retries_left", 0)),
-                        name=ev.get("name", ""),
-                        owner=ev.get("owner", ""), direct=True)
-                    rec = self.tasks[ev["task_id"]] = TaskRecord(
-                        spec=spec, submitted_at=ev.get("start")
-                        or ev.get("received") or now)
-                elif not rec.spec.direct and rec.state in ("PENDING",
-                                                           "RUNNING"):
-                    # A live head-path record (the task was fallback-
-                    # resubmitted through the scheduler after its lease
-                    # worker was presumed lost): a stale event from the
-                    # old worker must not clobber the retry's state or
-                    # its death-detection worker binding.
-                    continue
-                state = ev.get("state", "FINISHED")
-                # Arrival-only deltas map into the head's state
-                # vocabulary (PENDING|RUNNING|FINISHED|FAILED).
-                rec.state = "PENDING" if state == "RECEIVED" else state
-                rec.worker_hex = worker_hex
-                # Deltas carry only what changed since the last event for
-                # this task (an arrival-only RECEIVED has no start/end):
-                # merge, never clobber with zeros.
-                rec.started_at = ev.get("start", 0.0) or rec.started_at
-                rec.finished_at = ev.get("end", 0.0) or rec.finished_at
-                rec.received_at = ev.get("received", 0.0) or rec.received_at
-                rec.retry_count = ev.get("retry_count", rec.retry_count)
-                tr = ev.get("trace")
-                if tr:
-                    rec.trace_id, rec.span_id, rec.parent_span_id = tr
-                # Track the leased worker's current task so the OOM
-                # victim policy can pick/kill it like a busy worker.
-                if w is not None and w.state == "leased":
-                    if state == "RUNNING":
-                        w.current_task = ev["task_id"]
-                    elif w.current_task == ev["task_id"]:
-                        w.current_task = None
-            self._prune_lineage_locked()
+        # GLOBAL-LOCK-FREE completion drain: task records live in the
+        # sharded table (insert/pop are shard-locked internally), each
+        # task's events come from its single executing worker, and the
+        # merged fields are telemetry the scheduler never branches on
+        # for head-path liveness (the direct/PENDING-RUNNING guard
+        # below keeps retry state authoritative).  The highest-volume
+        # op on a loaded head no longer serializes behind the
+        # scheduler's lock.
+        w = self.workers.get(worker_hex)
+        for ev in events:
+            rec = self.tasks.get(ev["task_id"])
+            if rec is None:
+                spec = TaskSpec(
+                    task_id=TaskID.from_hex(ev["task_id"]),
+                    func_id="", func_blob=None, args=[],
+                    num_returns=1, return_ids=[], resources={},
+                    max_retries=int(ev.get("retries_left", 0)),
+                    name=ev.get("name", ""),
+                    owner=ev.get("owner", ""), direct=True)
+                rec = self.tasks[ev["task_id"]] = TaskRecord(
+                    spec=spec, submitted_at=ev.get("start")
+                    or ev.get("received") or now)
+            elif not rec.spec.direct and rec.state in ("PENDING",
+                                                       "RUNNING"):
+                # A live head-path record (the task was fallback-
+                # resubmitted through the scheduler after its lease
+                # worker was presumed lost): a stale event from the
+                # old worker must not clobber the retry's state or
+                # its death-detection worker binding.
+                continue
+            state = ev.get("state", "FINISHED")
+            # Arrival-only deltas map into the head's state
+            # vocabulary (PENDING|RUNNING|FINISHED|FAILED).
+            rec.state = "PENDING" if state == "RECEIVED" else state
+            rec.worker_hex = worker_hex
+            # Deltas carry only what changed since the last event for
+            # this task (an arrival-only RECEIVED has no start/end):
+            # merge, never clobber with zeros.
+            rec.started_at = ev.get("start", 0.0) or rec.started_at
+            rec.finished_at = ev.get("end", 0.0) or rec.finished_at
+            rec.received_at = ev.get("received", 0.0) or rec.received_at
+            rec.retry_count = ev.get("retry_count", rec.retry_count)
+            tr = ev.get("trace")
+            if tr:
+                rec.trace_id, rec.span_id, rec.parent_span_id = tr
+            # Track the leased worker's current task so the OOM
+            # victim policy can pick/kill it like a busy worker.
+            if w is not None and w.state == "leased":
+                if state == "RUNNING":
+                    w.current_task = ev["task_id"]
+                elif w.current_task == ev["task_id"]:
+                    w.current_task = None
+        cap = self.config.max_lineage_entries
+        if cap > 0 and len(self.tasks) > cap:
+            with self.lock:
+                self._prune_lineage_locked()
 
     def _op_flight_recorder(self, conn, msg):
         """Dump the head's in-memory flight-recorder ring (recent wire
@@ -2819,6 +3261,7 @@ class ControlServer:
 
     def _op_list_tasks(self, conn, msg):
         with self.lock:
+            self._drain_submit_ingress_locked()
             return [
                 {"task_id": h, "name": r.spec.name, "state": r.state,
                  "worker": r.worker_hex,
@@ -2884,6 +3327,7 @@ class ControlServer:
             self.nodes[node_id] = NodeState(
                 node_id=node_id, total=res, available=res,
                 labels=msg.get("labels") or {})
+            self._index_touch(node_id)
             self._journal_put(f"node/{node_id}", {
                 "resources": res.to_dict(),
                 "labels": msg.get("labels") or {}})
@@ -2906,6 +3350,7 @@ class ControlServer:
                 return {"accepted": False, "reason": "cannot drain head"}
             node.draining = True
             node.drain_reason = msg.get("reason", "")
+            self._index_touch(node_id)
             self._drain_migrating.setdefault(node_id, set())
             # Journaled: a restarted head must keep draining (the
             # autoscalers are waiting on drain_status == "gone"; losing
@@ -2980,6 +3425,7 @@ class ControlServer:
             node = self.nodes.get(b.node_id)
             if node is not None and node.alive:
                 node.available = node.available.add(b.available)
+                self._index_touch(b.node_id)
         pg.bundles = []
         pg.state = "PENDING"
 
@@ -3098,6 +3544,7 @@ class ControlServer:
                 return False
             node.alive = False
             node.available = ResourceSet()
+            self._index_touch(node_id)
             self._drop_drain_state_locked(node_id)
             self._journal_del(f"node/{node_id}")
             for w in list(self.workers.values()):
@@ -3135,6 +3582,7 @@ class ControlServer:
         GCS AutoscalerStateService GetClusterResourceState,
         autoscaler.proto:315 / gcs_autoscaler_state_manager.cc)."""
         with self.lock:
+            self._drain_submit_ingress_locked()
             demands = [dict(s.resources) for s in self.pending_tasks]
             demands += [dict(s.resources) for s in self.pending_actors]
             # Unsatisfied worker-lease requests are task demand too
@@ -3201,18 +3649,31 @@ class ControlServer:
     # policies scheduling/policy/bundle_scheduling_policy.h)
     def _try_reserve_pg(self, pg: PlacementGroupEntry) -> bool:
         """Lock held. Attempt to reserve all bundles atomically (the 2PC
-        prepare/commit collapses to one step inside the control plane)."""
-        alive = [n for n in self.nodes.values() if n.schedulable]
+        prepare/commit collapses to one step inside the control plane).
+        SPREAD/STRICT_SPREAD walk the utilization-bucketed node index —
+        O(bundles) amortized instead of O(nodes x bundles), the scan
+        that collapsed create-ready throughput 3.6x at 1,000 PGs on the
+        2,000-node sim.  Virtual availability is seeded lazily so a
+        small PG on a huge cluster never materializes the full node
+        table."""
         needs = [ResourceSet(b) for b in pg.bundle_specs]
         placement: List[str] = []
-        # virtual availability during placement
-        virt = {n.node_id: n.available for n in alive}
+        # virtual availability during placement, seeded on first touch
+        virt: Dict[str, ResourceSet] = {}
+
+        def avail(node_id):
+            v = virt.get(node_id)
+            if v is None:
+                v = virt[node_id] = self.nodes[node_id].available
+            return v
 
         def fits(node_id, need):
-            return need.is_subset_of(virt[node_id])
+            return need.is_subset_of(avail(node_id))
 
         strategy = pg.strategy
+        idx = self._node_index
         if strategy in ("PACK", "STRICT_PACK"):
+            alive = [n for n in self.nodes.values() if n.schedulable]
             # try to put everything on one node (best = most utilized that
             # fits all); PACK falls back to spreading the remainder.
             total = ResourceSet(_sum_bundles(pg.bundle_specs))
@@ -3231,21 +3692,49 @@ class ControlServer:
                     if cand is None:
                         return False
                     placement.append(cand)
-                    virt[cand] = virt[cand].subtract(need)
+                    virt[cand] = avail(cand).subtract(need)
         elif strategy in ("SPREAD", "STRICT_SPREAD"):
             used_nodes: Set[str] = set()
             placement = []
-            for need in needs:
-                cands = [n for n in alive if fits(n.node_id, need)]
-                fresh = [n for n in cands if n.node_id not in used_nodes]
-                pool = fresh if fresh else (
-                    [] if strategy == "STRICT_SPREAD" else cands)
-                if not pool:
-                    return False
-                node = min(pool, key=self._utilization)
-                placement.append(node.node_id)
-                used_nodes.add(node.node_id)
-                virt[node.node_id] = virt[node.node_id].subtract(need)
+            if idx is not None:
+                for bi, need in enumerate(needs):
+                    def fresh_ok(nid, _n=need):
+                        return (nid not in used_nodes
+                                and _n.is_subset_of(avail(nid)))
+
+                    pick = None
+                    for bucket in idx.buckets_low_to_high():
+                        pick = idx.probe(bucket, bi, fresh_ok)
+                        if pick is not None:
+                            break
+                    if pick is None and strategy == "SPREAD":
+                        # SPREAD tolerates reuse once fresh nodes run out
+                        for bucket in idx.buckets_low_to_high():
+                            pick = idx.probe(
+                                bucket, bi,
+                                lambda nid, _n=need:
+                                _n.is_subset_of(avail(nid)))
+                            if pick is not None:
+                                break
+                    if pick is None:
+                        return False
+                    placement.append(pick)
+                    used_nodes.add(pick)
+                    virt[pick] = avail(pick).subtract(need)
+            else:
+                alive = [n for n in self.nodes.values() if n.schedulable]
+                for need in needs:
+                    cands = [n for n in alive if fits(n.node_id, need)]
+                    fresh = [n for n in cands
+                             if n.node_id not in used_nodes]
+                    pool = fresh if fresh else (
+                        [] if strategy == "STRICT_SPREAD" else cands)
+                    if not pool:
+                        return False
+                    node = min(pool, key=self._utilization)
+                    placement.append(node.node_id)
+                    used_nodes.add(node.node_id)
+                    virt[node.node_id] = avail(node.node_id).subtract(need)
         else:
             raise ValueError(f"unknown PG strategy {strategy}")
 
@@ -3254,6 +3743,7 @@ class ControlServer:
         for i, (need, node_id) in enumerate(zip(needs, placement)):
             node = self.nodes[node_id]
             node.available = node.available.subtract(need)
+            self._index_touch(node_id)
             pg.bundles.append(Bundle(index=i, node_id=node_id,
                                      reserved=need, available=need))
         pg.state = "CREATED"
@@ -3271,6 +3761,7 @@ class ControlServer:
             node = self.nodes.get(b.node_id)
             if node is not None and node.alive:
                 node.available = node.available.add(b.available)
+                self._index_touch(b.node_id)
         pg.state = "REMOVED"
         pg.bundles = []
         self._journal_del(f"pg/{pg.pg_hex}")
@@ -3351,7 +3842,7 @@ class ControlServer:
     def _op_cancel_object(self, conn, msg):
         """Cancel the task producing this object (ray.cancel(ref))."""
         with self.lock:
-            entry = self.objects.get(msg["obj"])
+            entry = self._object_entry_or_drain_locked(msg["obj"])
             task_hex = entry.producing_task if entry is not None else None
         if not task_hex:
             return False
@@ -3364,6 +3855,8 @@ class ControlServer:
         task_hex = msg["task_id"]
         force = msg.get("force", False)
         with self.lock:
+            if self._ingress_pending():
+                self._drain_submit_ingress_locked()
             rec = self.tasks.get(task_hex)
             if rec is None:
                 return False
@@ -3439,9 +3932,55 @@ class ControlServer:
 
     # ------------------------------------------------------------------
     # Scheduler (counterpart of ClusterTaskManager::ScheduleAndDispatchTasks)
+    def _next_wake_timeout(self) -> float:
+        """How long the scheduler may park with no explicit wake.
+        Short (0.5 s) only while time-driven state machines are live —
+        starting workers, node drains, queued actors/PGs, deferred
+        tasks; the watchdog interval when enabled; else the idle
+        ceiling (RAY_TPU_SCHED_IDLE_WAIT_S).  Queued-lease expiry is
+        armed on the timer wheel, so wakeups are O(pending timers)
+        rather than O(polls)."""
+        if self._ingress_pending():
+            return 0.0  # submissions already staged: pass immediately
+        with self.lock:
+            busy = bool(
+                self.pending_tasks
+                or self.pending_actors
+                or self._drain_migrating
+                or any(pg.state == "PENDING"
+                       for pg in self.placement_groups.values())
+                or any(w.state == "starting"
+                       for w in self.workers.values()))
+            lease_deadline = self.pending_leases.earliest_deadline()
+        if lease_deadline is not None:
+            self._arm_lease_timer(lease_deadline)
+        if busy:
+            return 0.5
+        if self._watchdog is not None:
+            return min(self._idle_wait_s,
+                       max(0.5, self._watchdog.interval_s))
+        return self._idle_wait_s
+
+    def _arm_lease_timer(self, deadline: float):
+        """One wheel timer covers the earliest queued-lease expiry;
+        re-armed only when the deadline moves earlier or the old timer
+        already fired."""
+        t = self._lease_timer
+        now = time.time()
+        if t is not None and not t.cancelled and t.deadline > now \
+                and t.deadline <= deadline + 0.05:
+            return
+        if t is not None:
+            t.cancel()
+        from ray_tpu.util import timer_wheel
+
+        self._lease_timer = timer_wheel.wheel().schedule(
+            max(0.0, deadline - now) + 0.01, self._wake.set,
+            label="lease_expiry")
+
     def _schedule_loop(self):
         while not self._stopped.is_set():
-            self._wake.wait(timeout=0.5)
+            self._wake.wait(timeout=self._next_wake_timeout())
             self._wake.clear()
             if self._stopped.is_set():
                 return
@@ -3540,6 +4079,7 @@ class ControlServer:
         node = self.nodes.get(w.node_id)
         if node is not None and node.alive:
             node.available = node.available.add(acquired)
+            self._index_touch(node.node_id)
 
     def _utilization(self, node: NodeState,
                      avail: Optional[ResourceSet] = None) -> float:
@@ -3632,7 +4172,6 @@ class ControlServer:
             return avail_of(("node", n.node_id))
 
         st = getattr(spec, "scheduling_strategy", None)
-        alive = [n for n in self.nodes.values() if n.schedulable]
         if st is not None and type(st).__name__ == "NodeAffinitySchedulingStrategy":
             node = self.nodes.get(st.node_id)
             if (node is not None and node.schedulable
@@ -3641,6 +4180,12 @@ class ControlServer:
             if not st.soft:
                 return None
             # soft: fall through to default policy
+        idx = getattr(self, "_node_index", None)
+        alive = [n for n in self.nodes.values() if n.schedulable] \
+            if (idx is None
+                or (st is not None
+                    and type(st).__name__
+                    == "NodeLabelSchedulingStrategy")) else []
         if st is not None and \
                 type(st).__name__ == "NodeLabelSchedulingStrategy":
             hard = st.hard or {}
@@ -3663,6 +4208,10 @@ class ControlServer:
             node = min(feasible, key=lambda n: (
                 self._utilization(n, node_avail(n)), n.node_id))
             return node.node_id, ("node", node.node_id)
+        if idx is not None:
+            # Utilization-bucketed candidate walk: O(1) amortized per
+            # pick instead of an O(nodes) feasibility prefilter + sort.
+            return self._pick_node_indexed(need, spec, st, node_avail)
         feasible = [n for n in alive if need.is_subset_of(node_avail(n))]
         if not feasible:
             return None
@@ -3708,6 +4257,86 @@ class ControlServer:
                 self._m_locality_hits.inc()
         return node.node_id, ("node", node.node_id)
 
+    def _pick_node_indexed(self, need: ResourceSet, spec, st,
+                           node_avail) -> Optional[tuple]:
+        """Lock held.  `_pick_node`'s SPREAD/hybrid tail over the
+        utilization-bucketed index.  Bucket membership is computed from
+        ACTUAL availability; feasibility is re-verified against the
+        caller's (possibly virtual) view on every candidate, so a stale
+        bucket can only cost placement optimality within one 1/8
+        utilization slice, never correctness.  The PR-3 locality
+        tie-break becomes an index consult: the nodes already holding
+        this task's shm args are checked directly (O(arg locations))
+        before the bucket walk."""
+        idx = self._node_index
+        nodes = self.nodes
+
+        def fits(nid):
+            n = nodes.get(nid)
+            return (n is not None and n.schedulable
+                    and need.is_subset_of(node_avail(n)))
+
+        # Scarce-resource shortcut: when the ask names a resource only
+        # a handful of nodes have free (TPU on a CPU-heavy cluster),
+        # iterate that free set directly.
+        res_names = [r for r, v in need.to_dict().items() if v > 0]
+        scarce = idx.scarce_set(res_names) if res_names else None
+        if scarce is not None:
+            best, best_u = None, None
+            for nid in scarce:
+                if not fits(nid):
+                    continue
+                u = self._utilization(nodes[nid], node_avail(nodes[nid]))
+                if best_u is None or u < best_u:
+                    best, best_u = nid, u
+            return (best, ("node", best)) if best is not None else None
+
+        if st == "SPREAD":
+            # Lowest non-empty utilization bucket = the tie set; the
+            # task-id hash seeds the probe so a waiting task's target
+            # is stable across passes while equal-utilization nodes
+            # still fan out.
+            tid = getattr(spec, "task_id", None) or spec.actor_id
+            seed = hash(tid.binary())
+            for bucket in idx.buckets_low_to_high():
+                nid = idx.probe(bucket, seed, fits)
+                if nid is not None:
+                    return nid, ("node", nid)
+            return None
+
+        # hybrid pack-then-spread (threshold 0.5), locality consult
+        # first: a fitting below-threshold node already holding the
+        # most arg bytes wins outright.
+        loc = (self._locality_bytes(spec) if self._locality_enabled()
+               else {})
+        if loc:
+            best, best_bytes = None, 0
+            for nid, nbytes in sorted(loc.items(),
+                                      key=lambda kv: -kv[1]):
+                if nbytes <= best_bytes or not fits(nid):
+                    continue
+                n = nodes[nid]
+                if self._utilization(n, node_avail(n)) < 0.5:
+                    best, best_bytes = nid, nbytes
+            if best is not None:
+                if self._m_locality_hits is not None:
+                    try:
+                        self._m_locality_hits.inc()
+                    except Exception:  # raylint: allow-swallow(telemetry only)
+                        pass
+                return best, ("node", best)
+        # pack: most-utilized bucket below the spread threshold first
+        for bucket in idx.buckets_high_to_low(below=0.5):
+            nid = idx.probe(bucket, 0, fits)
+            if nid is not None:
+                return nid, ("node", nid)
+        # nothing below threshold fits: spread to the least utilized
+        for bucket in idx.buckets_low_to_high():
+            nid = idx.probe(bucket, 0, fits)
+            if nid is not None:
+                return nid, ("node", nid)
+        return None
+
     def _unschedulable_reason(self, spec) -> Optional[str]:
         """Lock held. Non-None if the spec can NEVER schedule — removed PG,
         out-of-range bundle index, or hard node affinity to a dead/missing
@@ -3741,6 +4370,14 @@ class ControlServer:
                 del self.broken_envs[key]  # expired: allow a fresh try
         return None
 
+    def _index_touch(self, node_id: str):
+        if self._node_index is not None:
+            self._node_index.touch(node_id)
+
+    def _index_rebuild(self):
+        if self._node_index is not None:
+            self._node_index.rebuild()
+
     def _charge_avail(self, charge: tuple) -> ResourceSet:
         """Lock held. Resolve a charge tuple to its current availability."""
         if charge[0] == "pg":
@@ -3759,10 +4396,12 @@ class ControlServer:
         else:
             node = self.nodes[charge[1]]
             node.available = node.available.subtract(need)
+            self._index_touch(charge[1])
 
     def _schedule_once(self):
         self._reap_unregistered_workers()
         with self.lock:
+            self._drain_submit_ingress_locked()
             # 0. retry pending placement groups (resources may have freed or
             # nodes joined — reference GcsPlacementGroupManager retry loop)
             for pg in self.placement_groups.values():
@@ -4183,7 +4822,7 @@ class ControlServer:
         # the read; re-reading the entry makes the race benign.
         for attempt in range(4):
             with self.lock:
-                entry = self.objects.get(obj_hex)
+                entry = self._object_entry_or_drain_locked(obj_hex)
                 if entry is None or entry.state not in (READY, ERRORED):
                     return None
                 if entry.inline is not None:
@@ -4244,22 +4883,27 @@ class ControlServer:
                                 obj_hex, "shm copy gone and lineage "
                                 "reconstruction not possible")
                 self._await_object_settled(obj_hex, 30.0)
-                time.sleep(0.01)
         return None
 
     def _await_object_settled(self, obj_hex: str, timeout: float) -> None:
-        """Poll (off-lock) until an object is READY/ERRORED and not mid-
-        restore — i.e. until a kicked reconstruction/restore lands."""
+        """Block until an object is READY/ERRORED and not mid-restore —
+        i.e. until a kicked reconstruction/restore lands.  Event-driven:
+        _store_object_locked and restore completion notify the settle
+        condition, so waiters wake on the transition itself (the 1 s
+        re-check only guards entry deletion, which doesn't notify)."""
         deadline = time.time() + timeout
-        while time.time() < deadline:
-            with self.lock:
+        with self._obj_settled:
+            while True:
                 entry = self.objects.get(obj_hex)
                 if entry is None:
                     return
                 if entry.state in (READY, ERRORED) and \
                         not entry.restoring:
                     return
-            time.sleep(0.02)
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return
+                self._obj_settled.wait(min(remaining, 1.0))
 
     # ------------------------------------------------------------------
     # On-demand worker profiling (reference: dashboard reporter

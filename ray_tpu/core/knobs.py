@@ -120,6 +120,25 @@ KNOBS: List[Knob] = [
     # -- scheduling / placement -----------------------------------------
     Knob("RAY_TPU_NO_LOCALITY", "", "flag", "user",
          "Truthy disables locality-aware task placement on the head."),
+    Knob("RAY_TPU_GCS_SHARDS", "8", "int", "user",
+         "Owner-keyed submit-ingress shards on the head (0 = legacy "
+         "single-lock ingress)."),
+    Knob("RAY_TPU_NODE_INDEX", "1", "bool", "user",
+         "0 disables the utilization-bucketed node index and falls back "
+         "to full node-table scans in _pick_node/placement."),
+    Knob("RAY_TPU_SCHED_IDLE_WAIT_S", "30.0", "float", "user",
+         "Scheduler wakeup ceiling when no time-based work is pending "
+         "(timer-wheel deadlines cover lease expiry below this)."),
+    Knob("RAY_TPU_ZEROCOPY_MIN_BYTES", "524288", "int", "user",
+         "Payloads at/above this ride the scatter-gather wire path "
+         "(no header+payload concat copy); 0 disables."),
+    Knob("RAY_TPU_NM_PULL", "1", "bool", "user",
+         "0 disables node-manager-level single-flight object pulls; "
+         "workers pull remote objects directly."),
+    Knob("RAY_TPU_GIL_SWITCH_S", "0", "float", "user",
+         "sys.setswitchinterval applied at process start (0 = keep the "
+         "interpreter default, 5ms); opt-in tuning for hosts running "
+         "many ray_tpu processes per core."),
     Knob("RAY_TPU_DISABLE_ZYGOTE", "0", "bool", "user",
          "1 disables the zygote prefork path; workers spawn directly."),
     Knob("RAY_TPU_WHEEL_DIR", "", "str", "user",
@@ -200,6 +219,9 @@ KNOBS: List[Knob] = [
          "0 disables the profile sampler during bench_profiling runs."),
     Knob("RAY_TPU_BENCH_LATENCY_MS", "15", "float", "bench",
          "Simulated cross-node link latency in bench_object_plane."),
+    Knob("RAY_TPU_BENCH_PG_NODES", "2000", "int", "bench",
+         "Simulated-cluster node count for bench_head_scale's "
+         "placement-group section."),
 
     # -- test harness (tests/conftest.py) --------------------------------
     Knob("RAY_TPU_TEST_WATCHDOG", "420", "int", "test",
@@ -282,6 +304,27 @@ _CONFIG_DOCS: Dict[str, str] = {
         "restart.",
     "log_dir": "Per-session log directory ('' = session default).",
 }
+
+
+def apply_interpreter_tuning() -> None:
+    """Per-process interpreter tuning, called from every bootstrap path
+    (driver init, worker main, node-manager main).
+
+    RAY_TPU_GIL_SWITCH_S shortens the GIL switch interval: an op on the
+    hot path crosses several processes (owner -> head -> worker ->
+    owner), and on an oversubscribed host each hop's recv-thread wakeup
+    can wait out the full default 5 ms interval before the bytecode
+    holder yields — a latency tax that bounds end-to-end throughput
+    even when every process profiles as idle."""
+    import os
+    import sys
+
+    try:
+        si = float(os.environ.get("RAY_TPU_GIL_SWITCH_S", "0") or 0)
+    except ValueError:
+        si = 0.0
+    if si > 0:
+        sys.setswitchinterval(si)
 
 
 def config_knobs() -> List[Knob]:

@@ -216,6 +216,14 @@ class NodeManager:
             f"node-{self.node_id}")
         os.makedirs(os.path.join(self.session_dir, "logs"), exist_ok=True)
         self.store = ShmObjectStore(self.store_key, self.config.shm_dir)
+        # Node-level pull single-flight (op "pull_object"): all workers
+        # on this host route remote fetches here, so N co-located
+        # consumers of one object cost ONE wire transfer into the
+        # shared arena (reference PullManager request coalescing at the
+        # raylet, not the worker).
+        self._pull_mgr = object_plane.PullManager()
+        self._peer_conns: Dict[str, rpc.Client] = {}
+        self._peer_lock = threading.Lock()
         self._ready.set()
         self._sweeper = threading.Thread(target=self._sweep_loop,
                                          name="node-sweep", daemon=True)
@@ -429,6 +437,45 @@ class NodeManager:
             except Exception:
                 pass
             return {"ok": True}
+        if op == "pull_object":
+            # Single-flight remote fetch into this node's arena on
+            # behalf of a local worker ({obj, size, addr}).  Runs on a
+            # side thread via Deferred so slow transfers never
+            # head-of-line block this connection's other ops.
+            obj_hex, size = msg["obj"], msg["size"]
+            addr = msg.get("addr", "")
+            d = rpc.Deferred()
+
+            def _pull():
+                oid = ObjectID.from_hex(obj_hex)
+
+                def _do():
+                    if self.store.contains(oid):
+                        return True
+                    client = (self._peer_conn(addr) if addr
+                              else self.head)
+                    _, cached = object_plane.pull_into_store(
+                        client, self.store, obj_hex, size,
+                        self.config.transfer_chunk_bytes,
+                        window=self.config.pull_window, timeout=120.0)
+                    if cached:
+                        try:
+                            self.head.send({"op": "object_replica",
+                                            "obj": obj_hex})
+                        except Exception:  # raylint: allow-swallow(replica hint is advisory; head rediscovers on demand)
+                            pass
+                    return cached
+
+                try:
+                    cached = self._pull_mgr.pull(obj_hex, _do,
+                                                 timeout=150.0)
+                    d.resolve({"ok": True, "cached": bool(cached)})
+                except BaseException as e:  # noqa: BLE001
+                    d.reject(e)
+
+            threading.Thread(target=_pull, daemon=True,
+                             name="nm-pull").start()
+            return d
         if op == "cluster_view":
             with self._lock:
                 return {"seq": self._view_seq, "at": self._view_at,
@@ -459,6 +506,21 @@ class NodeManager:
         if op == "ping":
             return "pong"
         raise ValueError(f"unknown node op {op}")
+
+    def _peer_conn(self, addr: str) -> rpc.Client:
+        """Cached connection to another node's object server."""
+        with self._peer_lock:
+            c = self._peer_conns.get(addr)
+        if c is not None and not c._closed:
+            return c
+        c = rpc.Client(addr, connect_timeout=5.0)
+        with self._peer_lock:
+            existing = self._peer_conns.get(addr)
+            if existing is not None and not existing._closed:
+                c.close()
+                return existing
+            self._peer_conns[addr] = c
+        return c
 
     # -- lifecycle ------------------------------------------------------
     def _sweep_loop(self):
@@ -545,11 +607,17 @@ class NodeManager:
         with self._lock:
             procs = list(self._procs.values())
             self._procs.clear()
+        # Event-driven reap: each wait() blocks in the kernel until
+        # that child exits or the shared deadline budget runs out — no
+        # poll/sleep spin (late children are still killed below).
         deadline = time.monotonic() + 1.0
-        while procs and time.monotonic() < deadline:
-            procs = [p for p in procs if p.poll() is None]
-            if procs:
-                time.sleep(0.02)
+        still = []
+        for p in procs:
+            try:
+                p.wait(timeout=max(deadline - time.monotonic(), 0.001))
+            except subprocess.TimeoutExpired:
+                still.append(p)
+        procs = still
         for p in procs:
             try:
                 p.kill()
@@ -569,6 +637,9 @@ class NodeManager:
 def main(argv=None) -> int:
     import argparse
 
+    from ray_tpu.core import knobs
+
+    knobs.apply_interpreter_tuning()
     p = argparse.ArgumentParser("ray_tpu.core.node_manager")
     p.add_argument("--address", required=True, help="head control address")
     p.add_argument("--num-cpus", type=float, default=None)

@@ -9,7 +9,9 @@ protocol over TCP sockets:
 kind: 0 = request (expects response), 1 = response, 2 = one-way,
       3 = JSON request (payload is UTF-8 JSON; response is JSON too),
       5 = batch (payload is one pickle of [(kind, req_id, payload), ...]),
-      6 = JSON batch (payload is a JSON array of [kind, req_id, msg]).
+      6 = JSON batch (payload is a JSON array of [kind, req_id, msg]),
+      7 = zero-copy envelope (pickle5 stream + out-of-band buffers,
+          scatter-gathered onto the socket; see KIND_OOB below).
 
 Kind 3 is the cross-language door (reference: the gRPC protos any
 language can speak): non-Python frontends (cpp/ client) call the same
@@ -65,8 +67,150 @@ KIND_BATCH = 5
 # Cross-language form: payload is a JSON array of [kind, req_id, msg]
 # triples (kind 3 entries only; each gets its own KIND_RESPONSE).
 KIND_BATCH_JSON = 6
+# Zero-copy envelope: payload is [<B inner_kind><I pkl_len><I nbufs>
+# <Q buf_len>*nbufs][pickle5 stream][buf0][buf1]... — large buffers
+# (numpy arrays, inline object bytes) ride OUT-OF-BAND after the pickle
+# stream and are scatter-gathered onto the socket with sendmsg, so a
+# 64 MiB arg is never memcpy'd through the wire encoder.  Pickle-
+# speaking peers only (the JSON path never emits it).
+KIND_OOB = 7
+
+_OOB_INDEX = struct.Struct("<BII")
 
 _TRUTHY = ("1", "true", "yes", "on")
+
+
+_ZC_MIN: int | None = None
+
+
+def _zerocopy_min() -> int:
+    """Payload size from which frames switch to scatter-gather writes
+    and pickle5 buffers go out-of-band.  <= 0 disables the path
+    (RAY_TPU_ZEROCOPY_MIN_BYTES; cached after first read)."""
+    global _ZC_MIN
+    v = _ZC_MIN
+    if v is None:
+        try:
+            v = int(os.environ.get(
+                "RAY_TPU_ZEROCOPY_MIN_BYTES", str(512 << 10)))
+        except ValueError:
+            v = 512 << 10
+        if v <= 0:
+            v = 1 << 62
+        _ZC_MIN = v
+    return v
+
+
+def _sendmsg_all(sock: socket.socket, parts) -> None:
+    """Write a scatter-gather list fully, advancing views on partial
+    sends.  Equivalent to sendall(b"".join(parts)) without building the
+    joined copy."""
+    views = [memoryview(p).cast("B") for p in parts if len(p)]
+    while views:
+        n = sock.sendmsg(views)
+        while n > 0 and views:
+            head = views[0]
+            if n >= len(head):
+                n -= len(head)
+                views.pop(0)
+            else:
+                views[0] = head[n:]
+                n = 0
+
+
+def _part_len(payload) -> int:
+    """Wire length of a payload that is either bytes or a tuple of
+    scatter-gather parts (KIND_OOB)."""
+    if isinstance(payload, tuple):
+        return sum(len(p) for p in payload)
+    return len(payload)
+
+
+def _wrap_big_bytes(msg, zc: int):
+    """Shallow rewrite of a message dict: top-level bytes values (and
+    bytes values one level down inside list-of-dict batches) at or over
+    the zero-copy threshold are wrapped in PickleBuffer so the protocol-5
+    encoder hands them to the buffer callback instead of copying them
+    into the pickle stream.  Returns msg unchanged when nothing is big."""
+    if not isinstance(msg, dict):
+        return msg
+    out = None
+    for k, v in msg.items():
+        if isinstance(v, (bytes, bytearray)) and len(v) >= zc:
+            if out is None:
+                out = dict(msg)
+            out[k] = pickle.PickleBuffer(v)
+        elif isinstance(v, list) and v and isinstance(v[0], dict):
+            new_list = None
+            for i, item in enumerate(v):
+                if not isinstance(item, dict):
+                    continue
+                rew = _wrap_big_bytes(item, zc)
+                if rew is not item:
+                    if new_list is None:
+                        new_list = list(v)
+                    new_list[i] = rew
+            if new_list is not None:
+                if out is None:
+                    out = dict(msg)
+                out[k] = new_list
+    return msg if out is None else out
+
+
+def _encode_payload(msg) -> tuple[int | None, "bytes | tuple"]:
+    """Encode a message for the wire.  Returns (None, pickle_bytes)
+    for ordinary messages, or (KIND_OOB, parts_tuple) when at least one
+    buffer crossed the zero-copy threshold — the parts are
+    (index, pickle_stream, buf0, ...) and the caller's frame kind is
+    folded into the index as inner_kind at send time."""
+    zc = _zerocopy_min()
+    bufs: list[memoryview] = []
+
+    def _cb(pb):
+        raw = pb.raw()
+        if raw.nbytes >= zc:
+            bufs.append(raw.cast("B"))
+            return False  # take out-of-band
+        return True  # small buffers stay in the pickle stream
+
+    pkl = pickle.dumps(_wrap_big_bytes(msg, zc), protocol=5,
+                       buffer_callback=_cb)
+    if not bufs:
+        return None, pkl
+    WIRE.on_zerocopy(sum(b.nbytes for b in bufs))
+    return KIND_OOB, (pkl, *bufs)
+
+
+def _oob_parts(inner_kind: int, parts: tuple) -> tuple:
+    """Prefix the (pickle, bufs...) parts with the KIND_OOB index."""
+    pkl = parts[0]
+    bufs = parts[1:]
+    index = _OOB_INDEX.pack(inner_kind, len(pkl), len(bufs))
+    if bufs:
+        index += struct.pack("<%dQ" % len(bufs),
+                             *(len(b) for b in bufs))
+    return (index, *parts)
+
+
+def _decode_oob(payload) -> tuple[int, Any]:
+    """Inverse of _encode_payload/_oob_parts: returns
+    (inner_kind, message).  Out-of-band buffers are materialized as
+    bytes sliced straight from the received payload (one copy, same as
+    the in-band path) so downstream consumers keep bytes semantics."""
+    mv = memoryview(payload)
+    inner_kind, pkl_len, nbufs = _OOB_INDEX.unpack_from(mv, 0)
+    off = _OOB_INDEX.size
+    lens = ()
+    if nbufs:
+        lens = struct.unpack_from("<%dQ" % nbufs, mv, off)
+        off += 8 * nbufs
+    pkl = mv[off:off + pkl_len]
+    off += pkl_len
+    bufs = []
+    for n in lens:
+        bufs.append(bytes(mv[off:off + n]))
+        off += n
+    return inner_kind, pickle.loads(pkl, buffers=bufs)
 
 
 def batching_enabled() -> bool:
@@ -202,9 +346,20 @@ class _RemoteTraceback(Exception):
     pass
 
 
-def _send_frame(sock: socket.socket, kind: int, req_id: int, payload: bytes):
-    header = _FRAME.pack(kind, req_id, len(payload))
-    sock.sendall(header + payload)
+def _send_frame(sock: socket.socket, kind: int, req_id: int, payload):
+    """payload: bytes, or a tuple of scatter-gather parts (KIND_OOB /
+    any frame whose payload crossed the zero-copy threshold).  Large
+    payloads go out via sendmsg so the header+payload join — a full
+    copy of the payload — never happens."""
+    n = _part_len(payload)
+    header = _FRAME.pack(kind, req_id, n)
+    if isinstance(payload, tuple):
+        _sendmsg_all(sock, (header, *payload))
+    elif n >= _zerocopy_min():
+        WIRE.on_zerocopy(n)
+        _sendmsg_all(sock, (header, payload))
+    else:
+        sock.sendall(header + payload)
     WIRE.on_frame_sent(kind, len(header) + len(payload))
 
 
@@ -275,6 +430,7 @@ class _WireStats:
         self.batch_buckets = [0] * (len(self.BATCH_BOUNDS) + 1)
         self.batch_sum = 0.0
         self.batch_count = 0
+        self.zerocopy_bytes = 0
 
     def _observe_size_locked(self, nmsgs: int):
         for i, b in enumerate(self.BATCH_BOUNDS):
@@ -316,6 +472,12 @@ class _WireStats:
                     fr.record("wire", "batch_flush", msgs=nmsgs,
                               bytes=nbytes)
 
+    def on_zerocopy(self, nbytes: int):
+        """Payload bytes that reached the socket via scatter-gather
+        (sendmsg) instead of being memcpy'd through the encoder."""
+        with self.lock:
+            self.zerocopy_bytes += nbytes
+
     def on_frame_received(self, kind: int, nbytes: int, nmsgs: int = 1):
         with self.lock:
             self.frames_received += 1
@@ -343,6 +505,7 @@ def wire_metric_snapshots() -> list:
         }
         by_kind = dict(w.sent_by_kind)
         hist = [list(w.batch_buckets), w.batch_sum, w.batch_count]
+        zc_bytes = w.zerocopy_bytes
     descs = {
         "rpc_frames_total": "Control-plane frames on the wire",
         "rpc_msgs_total": "Control-plane messages (batch entries count "
@@ -367,6 +530,12 @@ def wire_metric_snapshots() -> list:
             "description": "Sent frames by wire kind",
             "series": kind_series,
         })
+    snaps.append({
+        "name": "ray_tpu_zerocopy_bytes_total", "kind": "counter",
+        "description": "Payload bytes sent out-of-band via scatter-"
+                       "gather (never copied through the wire encoder)",
+        "series": {(): float(zc_bytes)},
+    })
     snaps.append({
         "name": "rpc_batch_size", "kind": "histogram",
         "description": "Messages per sent frame (le=1 bucket = plain "
@@ -466,30 +635,45 @@ class _CoalescingSender:
                     batch, self._buf = self._buf, []
                 for frame in self._encode(batch):
                     with self._wire_lock:
-                        self._sock.sendall(frame)
+                        if isinstance(frame, tuple):
+                            _sendmsg_all(self._sock, frame)
+                        else:
+                            self._sock.sendall(frame)
         except BaseException:
             with self._lock:
                 self._sending = False
                 self._cv.notify_all()
             raise
 
-    def _encode(self, batch: list[tuple[int, int, bytes]]) -> list[bytes]:
-        frames = []
+    def _encode(self, batch: list) -> list:
+        frames = []  # bytes, or tuple of scatter-gather parts
         stats = []  # (kind, nmsgs, frame bytes) per frame, for WIRE
         i, n = 0, len(batch)
         while i < n:
-            # Greedy size/count-capped run starting at i.
-            run_bytes = len(batch[i][2])
+            # Greedy size/count-capped run starting at i.  Multi-part
+            # (KIND_OOB) payloads can't ride a pickled KIND_BATCH —
+            # they always form solo frames, and break runs.
+            run_bytes = _part_len(batch[i][2])
             j = i + 1
-            while (j < n and j - i < self.max_msgs
-                   and run_bytes + len(batch[j][2]) <= self.max_bytes):
-                run_bytes += len(batch[j][2])
-                j += 1
+            if not isinstance(batch[i][2], tuple):
+                while (j < n and j - i < self.max_msgs
+                       and not isinstance(batch[j][2], tuple)
+                       and run_bytes + len(batch[j][2])
+                       <= self.max_bytes):
+                    run_bytes += len(batch[j][2])
+                    j += 1
             if j - i == 1:
                 kind, req_id, payload = batch[i]
-                frames.append(
-                    _FRAME.pack(kind, req_id, len(payload)) + payload)
-                stats.append((kind, 1, len(frames[-1])))
+                plen = _part_len(payload)
+                header = _FRAME.pack(kind, req_id, plen)
+                if isinstance(payload, tuple):
+                    frames.append((header, *payload))
+                elif plen >= _zerocopy_min():
+                    WIRE.on_zerocopy(plen)
+                    frames.append((header, payload))
+                else:
+                    frames.append(header + payload)
+                stats.append((kind, 1, _FRAME.size + plen))
             else:
                 blob = pickle.dumps(batch[i:j], protocol=5)
                 frames.append(_FRAME.pack(KIND_BATCH, 0, len(blob)) + blob)
@@ -526,7 +710,11 @@ class Connection:
 
     def push(self, msg: Any):
         """One-way server→client message."""
-        self._post(KIND_ONEWAY, 0, pickle.dumps(msg, protocol=5))
+        oob, payload = _encode_payload(msg)
+        if oob is not None:
+            self._post(KIND_OOB, 0, _oob_parts(KIND_ONEWAY, payload))
+        else:
+            self._post(KIND_ONEWAY, 0, payload)
 
     def push_json(self, msg: Any):
         """One-way push a non-Python peer can parse (KIND_ONEWAY_JSON)."""
@@ -535,7 +723,11 @@ class Connection:
             _send_frame(self.sock, KIND_ONEWAY_JSON, 0, payload)
 
     def respond(self, req_id: int, msg: Any):
-        self._post(KIND_RESPONSE, req_id, pickle.dumps(msg, protocol=5))
+        oob, payload = _encode_payload(msg)
+        if oob is not None:
+            self._post(KIND_OOB, req_id, _oob_parts(KIND_RESPONSE, payload))
+        else:
+            self._post(KIND_RESPONSE, req_id, payload)
 
     def flush_sends(self):
         """Fence: block until buffered pushes/responses hit the socket."""
@@ -693,6 +885,12 @@ class Server:
                         if sub_kind != KIND_REQUEST_JSON:
                             continue
                         self._handle_json(conn, sub_id, raw)
+                elif kind == KIND_OOB:
+                    conn.peer_pickle = True
+                    WIRE.on_frame_received(kind, nbytes)
+                    inner_kind, msg = _decode_oob(payload)
+                    self._dispatch(conn, inner_kind, req_id, None,
+                                   msg=msg)
                 else:
                     WIRE.on_frame_received(kind, nbytes)
                     self._dispatch(conn, kind, req_id, payload)
@@ -716,15 +914,17 @@ class Server:
                               getattr(conn, "peername", "?"))
 
     def _dispatch(self, conn: Connection, kind: int, req_id: int,
-                  payload: bytes):
+                  payload, msg=None):
         """Handle one (possibly batch-unpacked) frame.  Semantics match
         the pre-batching serve loop exactly — a failing sub-request in a
-        batch responds ("err", e) like any failing request."""
+        batch responds ("err", e) like any failing request.  KIND_OOB
+        frames arrive pre-decoded (payload None, msg set)."""
         if kind == KIND_REQUEST_JSON:
             self._handle_json(conn, req_id, payload)
             return
         conn.peer_pickle = True
-        msg = pickle.loads(payload)
+        if payload is not None:
+            msg = pickle.loads(payload)
         if kind == KIND_REQUEST:
             try:
                 result = self._handler(conn, msg)
@@ -882,6 +1082,10 @@ class Client:
                         if sub_kind in (KIND_BATCH, KIND_BATCH_JSON):
                             continue  # batches never nest
                         self._on_frame(sub_kind, sub_id, sub_payload)
+                elif kind == KIND_OOB:
+                    WIRE.on_frame_received(kind, nbytes)
+                    inner_kind, msg = _decode_oob(payload)
+                    self._on_msg(inner_kind, req_id, msg)
                 else:
                     WIRE.on_frame_received(kind, nbytes)
                     self._on_frame(kind, req_id, payload)
@@ -903,7 +1107,9 @@ class Client:
                     traceback.print_exc()
 
     def _on_frame(self, kind: int, req_id: int, payload: bytes):
-        msg = pickle.loads(payload)
+        self._on_msg(kind, req_id, pickle.loads(payload))
+
+    def _on_msg(self, kind: int, req_id: int, msg: Any):
         if kind == KIND_RESPONSE:
             ev = self._pending.get(req_id)
             if ev is not None:
@@ -963,8 +1169,11 @@ class Client:
             self._next_id += 1
         ev = threading.Event()
         self._pending[req_id] = ev
-        payload = pickle.dumps(msg, protocol=5)
-        self._post(KIND_REQUEST, req_id, payload)
+        oob, payload = _encode_payload(msg)
+        if oob is not None:
+            self._post(KIND_OOB, req_id, _oob_parts(KIND_REQUEST, payload))
+        else:
+            self._post(KIND_REQUEST, req_id, payload)
         return _PendingCall(self, req_id, ev)
 
     def call(self, msg: Any, timeout: Optional[float] = None) -> Any:
@@ -976,8 +1185,12 @@ class Client:
         (object-plane chunk streaming) keep their backpressure."""
         if self._closed:
             raise RpcError(f"connection to {self.address} closed")
-        payload = pickle.dumps(msg, protocol=5)
-        self._post(KIND_ONEWAY, 0, payload, wait=wait)
+        oob, payload = _encode_payload(msg)
+        if oob is not None:
+            self._post(KIND_OOB, 0, _oob_parts(KIND_ONEWAY, payload),
+                       wait=wait)
+        else:
+            self._post(KIND_ONEWAY, 0, payload, wait=wait)
 
     def close(self):
         self._closed = True

@@ -618,7 +618,7 @@ class CoreClient:
                 if fut.done():
                     return
                 try:
-                    fut.set_result(hf.result())
+                    fut.set_result(hf.result(timeout=0))
                 except BaseException as e:  # noqa: BLE001
                     fut.set_exception(e)
 
@@ -717,7 +717,7 @@ class CoreClient:
         self.client.send({"op": "register_objects", "objs": [obj_hex],
                           "actor": actor_hex})
         if fut.done():
-            info = fut.result()
+            info = fut.result(timeout=0)
             if info.get("direct"):
                 try:
                     self.client.send({
@@ -1088,7 +1088,7 @@ class CoreClient:
             if fut.done():
                 return
             try:
-                fut.set_result(hf.result())
+                fut.set_result(hf.result(timeout=0))
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
 
@@ -1405,6 +1405,33 @@ class CoreClient:
             self._node_conns[address] = conn
         return conn
 
+    def _nm_pull(self, obj_hex: str, size: int, addr: str):
+        """Route a remote fetch through this host's node manager
+        (RAY_TPU_LOCAL_NM, set for spawned workers): the NM single-
+        flights per object at NODE level, so two workers on one host
+        never pull the same object over the wire twice — the bytes land
+        once in the shared arena and both read it via attach().
+        Returns the payload view on success, None to fall back to the
+        direct per-process pull (driver processes, RAY_TPU_NM_PULL=0,
+        arena-full degradation, NM errors)."""
+        if self.store is None:
+            return None
+        nm_addr = os.environ.get("RAY_TPU_LOCAL_NM", "")
+        if not nm_addr or os.environ.get(
+                "RAY_TPU_NM_PULL", "1").strip().lower() in (
+                "0", "false", "no", "off"):
+            return None
+        try:
+            nm = self._node_conn(nm_addr)
+            r = nm.call({"op": "pull_object", "obj": obj_hex,
+                         "size": size, "addr": addr}, timeout=150.0)
+            if not (r and r.get("cached")):
+                return None  # NM degraded to uncached — pull directly
+            view = self.store.attach(ObjectID.from_hex(obj_hex), size)
+            return view.buf[:size]
+        except Exception:  # raylint: allow-swallow(NM pull is best-effort; caller falls back to a direct pull)
+            return None
+
     def _pull_remote_object(self, obj_hex: str, info: dict):
         """Windowed chunked pull of an object living in another node's
         arena (reference ObjectManager chunked transfer via
@@ -1429,6 +1456,9 @@ class CoreClient:
                 pass
             size = info["size"]
             addr = info.get("addr", "")
+            nm_data = self._nm_pull(obj_hex, size, addr)
+            if nm_data is not None:
+                return nm_data
             client = self._node_conn(addr) if addr else self.client
             data, cached = object_plane.pull_into_store(
                 client, self.store, obj_hex, size,
@@ -1510,6 +1540,20 @@ class CoreClient:
     def put(self, value: Any) -> ObjectRef:
         oid = ObjectID.from_random()
         self._store_value(oid, value)
+        return ObjectRef(oid, owner=self.worker_hex)
+
+    def put_serialized(self, ser) -> "ObjectRef":
+        """Store an already-serialized value without re-pickling it.
+
+        The big-arg submit path serializes once to measure size; routing
+        the resulting ``Serialized`` here (instead of ``put(value)``,
+        which re-serializes from scratch) halves the CPU cost of every
+        over-inline-threshold argument and lets pickle5 out-of-band
+        buffers flow straight into the arena segment."""
+        oid = ObjectID.from_random()
+        for hex_id in ser.contained_refs:
+            self._maybe_promote_direct(hex_id)
+        self._store_serialized(oid, ser)
         return ObjectRef(oid, owner=self.worker_hex)
 
     def _serialize_for_ship(self, value: Any):
@@ -1726,7 +1770,10 @@ class CoreClient:
                     borrows.append(hex_id)
                     self._queue_for_flush("incref", None, hex_id)
                 if ser.total_bytes > self.config.max_inline_object_size:
-                    ref = self.put(a)
+                    # Reuse the serialization we just produced: put(a)
+                    # would pickle the arg a second time (and memcpy its
+                    # buffers twice for a 64 MiB array).
+                    ref = self.put_serialized(ser)
                     borrows.append(ref.hex())
                     # Same ordered queue as the put itself: a direct send
                     # would reach the head BEFORE the buffered put_object

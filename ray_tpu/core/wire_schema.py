@@ -45,6 +45,9 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     # Node-to-node object plane (node_manager._handle): pull probe +
     # push-broadcast stream (core/object_plane.py PushManager).
     "has_object": {"obj": "str"},
+    # Worker -> local node manager: single-flight a remote fetch into
+    # this node's shared arena ({addr: ""} means the head's store).
+    "pull_object": {"obj": "str", "size": "int", "addr": "str?"},
     "push_begin": {"obj": "str", "size": "int"},
     "push_chunk": {"obj": "str", "offset": "int", "data": "bytes"},
     "push_end": {"obj": "str"},

@@ -72,11 +72,15 @@ def configure(capacity: int = 0, enable: bool = True) -> None:
 def record(category: str, event: str, **fields: Any) -> None:
     """Append one event (no-op when disabled).  `category` picks the
     timeline lane ("wire" | "scheduler" | "object" | "health" |
-    "serve" — object-plane transfers: pull_begin/pull_end,
+    "serve" | "sched" — object-plane transfers: pull_begin/pull_end,
     push_begin/push_end, dedup_join, each carrying obj/peer/bytes and,
     on *_end, duration_s; health: the gcs watchdog's straggler /
     node_unhealthy / node_recovered verdicts; serve: the data plane's
-    route / queue_full / shed / abort / stream_cancel decisions);
+    route / queue_full / shed / abort / stream_cancel decisions;
+    sched: the head-scale-out fast paths — shard_dispatch [n drained
+    from a submit-ingress shard], timer_fire [timer-wheel callback ran,
+    with its deadline], index_rebuild [utilization-bucketed node index
+    rebuilt, with node count]);
     `fields` are free-form and must be JSON-representable (they ride
     the dashboard dump)."""
     if not _enabled:

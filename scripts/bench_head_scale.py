@@ -1,0 +1,304 @@
+"""Head scale-out benchmark: paired before/after rows -> HEAD_BENCH.json.
+
+ISSUE 13 measurement harness for the sharded-GCS / timer-wheel /
+node-index / zero-copy work.  Three sections, each run twice in fresh
+subprocesses of this script so the variants never share interpreter
+state:
+
+  - ``before``: current code with the new subsystems disabled via their
+    knobs (RAY_TPU_GCS_SHARDS=0, RAY_TPU_NODE_INDEX=0,
+    RAY_TPU_ZEROCOPY_MIN_BYTES=0, RAY_TPU_NM_PULL=0) — the legacy
+    single-lock ingress, full node-table scans, and copying wire path.
+  - ``after``: defaults (everything on).
+
+Pairing both variants on the SAME host minutes apart is the same
+methodology SCALE_r05 used for its control-vs-at-scale rows: absolute
+rates move with host load/speed, the paired ratio isolates the code.
+RPC_BENCH.json's recorded multi_client_tasks_async row is carried into
+the output for reference, with ``host_factor`` = before/recorded so a
+reader can see how the current host compares to the one that recorded
+the baseline (the acceptance thresholds pinned in
+tests/test_head_scale.py read this file).
+
+Sections:
+  multi_client_tasks_async  exact RPC_BENCH shape: 4 TaskClient actors
+                            draining async no-op task batches.
+  pg_create_ready           SPREAD placement groups (2 bundles x CPU:1)
+                            created-to-ready on a 2,000-node simulated
+                            cluster at 100/500/1,000 PGs.  The node
+                            index makes this flat; the legacy scan is
+                            O(nodes) per bundle.
+  large_arg_submit          bytes memcpy'd through the wire encoder for
+                            a 4 MiB task-arg payload (p50/p99 across
+                            submits), measured from WIRE.bytes_sent
+                            minus WIRE.zerocopy_bytes deltas.
+
+Usage: python scripts/bench_head_scale.py            # full run
+       python scripts/bench_head_scale.py --section pg --variant after
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "HEAD_BENCH.json")
+
+# Knob values that turn the ISSUE-13 subsystems off (the "before" leg).
+BEFORE_ENV = {
+    "RAY_TPU_GCS_SHARDS": "0",
+    "RAY_TPU_NODE_INDEX": "0",
+    "RAY_TPU_ZEROCOPY_MIN_BYTES": "0",
+    "RAY_TPU_NM_PULL": "0",
+}
+
+PG_NODES = int(os.environ.get("RAY_TPU_BENCH_PG_NODES", "2000"))
+PG_COUNTS = (100, 500, 1000)
+ARG_BYTES = 4 * 1024 * 1024
+
+
+# ---------------------------------------------------------------------------
+# sections (each runs inside its own subprocess; prints one JSON line)
+# ---------------------------------------------------------------------------
+
+def _section_multi_client() -> dict:
+    import ray_tpu
+    from ray_tpu.scripts.microbenchmark import SCALE, timeit
+
+    rt = ray_tpu.init(num_cpus=16, log_to_driver=False)
+
+    @ray_tpu.remote
+    def small_task():
+        return b"ok"
+
+    ray_tpu.get([small_task.remote() for _ in range(16)])
+
+    class TaskClient:
+        def run_batch(self, n):
+            import ray_tpu as rt_
+
+            rt_.get([small_task.remote() for _ in range(n)])
+            return n
+
+    TC = ray_tpu.remote(TaskClient)
+    tclients = [TC.options(num_cpus=0).remote() for _ in range(4)]
+    ray_tpu.get([c.run_batch.remote(1) for c in tclients])
+    n = max(50, int(250 * SCALE))
+
+    def burst():
+        ray_tpu.get([c.run_batch.remote(n) for c in tclients])
+
+    mean, std = timeit("multi_client_tasks_async", burst, multiplier=4 * n,
+                       trials=3)
+    ray_tpu.shutdown()
+    return {"ops_per_s": round(mean, 1), "std": round(std, 1),
+            "clients": 4, "batch": n}
+
+
+def _section_pg() -> dict:
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+
+    cluster = Cluster(head_node_args={
+        "num_cpus": 64, "log_to_driver": False,
+        "_system_config": {"max_workers_per_node": 2}})
+    t0 = time.perf_counter()
+    for i in range(PG_NODES - 1):
+        cluster.add_node(num_cpus=64, node_id=f"hb-{i}")
+    reg_dt = time.perf_counter() - t0
+    rows = []
+    for count in PG_COUNTS:
+        t0 = time.perf_counter()
+        pgs = [placement_group([{"CPU": 1}] * 2, strategy="SPREAD")
+               for _ in range(count)]
+        ray_tpu.get([pg.ready() for pg in pgs], timeout=900)
+        create_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for pg in pgs:
+            remove_placement_group(pg)
+        remove_dt = time.perf_counter() - t0
+        rows.append({"pgs": count,
+                     "create_ready_per_s": round(count / create_dt, 1),
+                     "remove_per_s": round(count / remove_dt, 1)})
+        print(f"# pg {count}: {rows[-1]['create_ready_per_s']}/s create, "
+              f"{rows[-1]['remove_per_s']}/s remove", file=sys.stderr,
+              flush=True)
+    cluster.shutdown()
+    return {"nodes": PG_NODES,
+            "register_per_s": round((PG_NODES - 1) / reg_dt, 1),
+            "rows": rows}
+
+
+def _section_large_arg() -> dict:
+    # The wire leg of a multi-host large-arg submit: the serialized
+    # spec (4 MiB ndarray arg) crossing one rpc hop.  bytes_copied is
+    # what the encoder memcpy'd (header+payload concats and in-band
+    # pickle bytes); the out-of-band path ships the arg buffer via
+    # scatter-gather sendmsg instead.
+    import numpy as np
+
+    from ray_tpu.core import rpc
+
+    def handler(conn, msg):
+        return {"n": len(msg.get("args", ((),))[0][0])
+                if msg.get("op") == "submit" else 0}
+
+    srv = rpc.Server(host="127.0.0.1", port=0, handler=handler)
+    cli = rpc.Client(srv.address)
+    arg = np.random.default_rng(0).integers(
+        0, 255, size=ARG_BYTES, dtype=np.uint8)
+    payload = arg.tobytes()
+    copied = []
+    reps = 30
+    for _ in range(reps):
+        with rpc.WIRE.lock:
+            sent0 = rpc.WIRE.bytes_sent
+            zc0 = rpc.WIRE.zerocopy_bytes
+        cli.call({"op": "submit", "args": ((payload,),)})
+        with rpc.WIRE.lock:
+            sent1 = rpc.WIRE.bytes_sent
+            zc1 = rpc.WIRE.zerocopy_bytes
+        copied.append((sent1 - sent0) - (zc1 - zc0))
+    cli.close()
+    srv.stop()
+    copied.sort()
+    return {"arg_bytes": ARG_BYTES, "reps": reps,
+            "p50_bytes_copied": copied[reps // 2],
+            "p99_bytes_copied": copied[min(reps - 1,
+                                           int(reps * 0.99))]}
+
+
+SECTIONS = {
+    "multi_client": _section_multi_client,
+    "pg": _section_pg,
+    "large_arg": _section_large_arg,
+}
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _run_variant(section: str, variant: str) -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if variant == "before":
+        env.update(BEFORE_ENV)
+    cmd = [sys.executable, os.path.abspath(__file__),
+           "--section", section, "--variant", variant]
+    t0 = time.perf_counter()
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=1800)
+    dt = time.perf_counter() - t0
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"{section}/{variant} failed rc={proc.returncode}:\n"
+            f"{proc.stderr[-2000:]}")
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("{")][-1]
+    out = json.loads(line)
+    print(f"{section}/{variant}: {out} ({dt:.1f}s)", flush=True)
+    return out
+
+
+def _recorded_rpc_bench() -> float:
+    path = os.path.join(os.path.dirname(OUT), "RPC_BENCH.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        return float(
+            doc["results"]["multi_client_tasks_async"]["ops_s"])
+    except Exception:
+        return 0.0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--section", choices=sorted(SECTIONS), default="")
+    ap.add_argument("--variant", choices=("before", "after"),
+                    default="after")
+    ap.add_argument("--out", default=OUT)
+    args = ap.parse_args(argv)
+
+    if args.section:
+        # child mode: run one section under the caller-set knobs and
+        # print its row as the last stdout line.
+        print(json.dumps(SECTIONS[args.section]()), flush=True)
+        return 0
+
+    recorded = _recorded_rpc_bench()
+    mc_before = _run_variant("multi_client", "before")
+    mc_after = _run_variant("multi_client", "after")
+    pg_before = _run_variant("pg", "before")
+    pg_after = _run_variant("pg", "after")
+    la_before = _run_variant("large_arg", "before")
+    la_after = _run_variant("large_arg", "after")
+
+    pg_rows = []
+    before_rows = {r["pgs"]: r for r in pg_before["rows"]}
+    for r in pg_after["rows"]:
+        b = before_rows.get(r["pgs"], {})
+        pg_rows.append({
+            "pgs": r["pgs"],
+            "before_per_s": b.get("create_ready_per_s", 0.0),
+            "after_per_s": r["create_ready_per_s"],
+            "before_remove_per_s": b.get("remove_per_s", 0.0),
+            "after_remove_per_s": r["remove_per_s"],
+        })
+
+    before_ops = mc_before["ops_per_s"]
+    doc = {
+        "generated": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "host_cpus": len(os.sched_getaffinity(0)),
+        "note": (
+            "before = same build with RAY_TPU_GCS_SHARDS=0 "
+            "RAY_TPU_NODE_INDEX=0 RAY_TPU_ZEROCOPY_MIN_BYTES=0 "
+            "RAY_TPU_NM_PULL=0; after = defaults.  Both legs run "
+            "back-to-back on this host (SCALE_r05 pairing "
+            "methodology).  host_factor compares this host's paired "
+            "'before' leg to the ops/s the RPC_BENCH row recorded on "
+            "the host that produced it; absolute rates are not "
+            "comparable across hosts."),
+        "multi_client_tasks_async": {
+            "recorded_rpc_bench_ops_per_s": recorded,
+            "host_factor": round(before_ops / recorded, 3)
+            if recorded else None,
+            "before_ops_per_s": before_ops,
+            "before_std": mc_before["std"],
+            "after_ops_per_s": mc_after["ops_per_s"],
+            "after_std": mc_after["std"],
+            "clients": mc_after["clients"],
+            "batch": mc_after["batch"],
+        },
+        "pg_create_ready": pg_rows,
+        "pg_sim": {"nodes": pg_after["nodes"],
+                   "register_per_s": pg_after["register_per_s"]},
+        "large_arg_submit": {
+            "arg_bytes": la_after["arg_bytes"],
+            "p50_bytes_copied": la_after["p50_bytes_copied"],
+            "p99_bytes_copied": la_after["p99_bytes_copied"],
+            "before_p50_bytes_copied": la_before["p50_bytes_copied"],
+            "before_p99_bytes_copied": la_before["p99_bytes_copied"],
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
