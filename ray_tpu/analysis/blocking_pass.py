@@ -80,6 +80,14 @@ DEFAULT_ENTRY_POINTS: Dict[str, Tuple[str, ...]] = {
     # on the journal's writer thread, so `append` and the `stream`
     # accessor must never reach a blocking primitive.
     "ray_tpu/util/journal.py": ("Journal.append", "stream"),
+    # Disaggregated-serving receive paths: the handoff legs run on
+    # replica handler threads, so every wait they reach must carry a
+    # timeout (object-plane pull, handle .result) — an unbounded wait
+    # here wedges a replica slot, not just one caller.
+    "ray_tpu/serve/llm.py": (
+        "LLMServer.prefill_only", "LLMServer.decode_from",
+        "DisaggLLMClient.generate",
+    ),
     # Flight recorder record/dump run inside receive loops and op
     # handlers respectively.
     "ray_tpu/util/flight_recorder.py": ("record", "dump"),

@@ -52,6 +52,11 @@ DEFAULT_HANDLER_MODULES: Tuple[str, ...] = (
     "ray_tpu/core/worker.py",
     "ray_tpu/core/node_manager.py",
     "ray_tpu/serve/proxy.py",
+    # Disaggregated serving: the KV-handoff bundle/pointer ops and the
+    # router's prefix-digest op are dispatched in these modules.
+    "ray_tpu/serve/llm.py",
+    "ray_tpu/serve/llm_engine.py",
+    "ray_tpu/serve/router.py",
 )
 
 _METRIC_NAME_RE = re.compile(r"\bray_tpu_[a-z0-9_]+\b")

@@ -116,6 +116,18 @@ KNOBS: List[Knob] = [
     Knob("RAY_TPU_SERVE_LOAD_REPORT_S", "1", "float", "user",
          "Interval between controller load-report probes of serve "
          "replicas."),
+    Knob("RAY_TPU_GRPC_WORKERS", "16", "int", "user",
+         "Thread-pool size of the serve gRPC proxy's request executor."),
+    Knob("RAY_TPU_SERVE_ROLE_STRICT", "0", "bool", "user",
+         "1 makes phase-tagged requests WAIT for a replica of their "
+         "role instead of degrading to mixed routing on an empty pool."),
+    Knob("RAY_TPU_SERVE_HANDOFF_TIMEOUT_S", "30", "float", "user",
+         "Timeout for pulling a prefill->decode KV bundle off the "
+         "object plane (and the disagg client's per-leg timeout) "
+         "before falling back to re-prefill."),
+    Knob("RAY_TPU_SERVE_DIGEST_K", "16", "int", "user",
+         "Top-K hot prefix keys a serve replica advertises in its "
+         "load-report digest for prefix-locality routing."),
 
     # -- scheduling / placement -----------------------------------------
     Knob("RAY_TPU_NO_LOCALITY", "", "flag", "user",

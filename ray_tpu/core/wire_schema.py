@@ -152,6 +152,19 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     "pg_state": {"pg": "str"},
     # -- serve frame ingress (proxy.py FrameIngress) -------------------
     "serve_request": {"route": "str", "payload": "any?", "headers": "dict?"},
+    # -- serve disaggregation (llm.py / llm_engine.py handoff) ---------
+    # Prefill→decode KV handoff: the exported page bundle (k/v are
+    # [L, n_ctx, page, KD] tensors; "done" short-circuits requests that
+    # finished at prefill), the object-plane pointer it rides as, and
+    # the hot-prefix digest replicas advertise for locality routing.
+    "serve_kv_export": {"req": "int", "prompt": "list",
+                        "generated": "list", "context_len": "int",
+                        "page_size": "int", "num_layers": "int",
+                        "kd": "int", "dtype": "str",
+                        "chain_keys": "list?", "done": "list?",
+                        "k": "any?", "v": "any?"},
+    "serve_kv_import": {"obj": "str", "size": "int"},
+    "serve_prefix_digest": {"keys": "list"},
     # -- push / dispatch ops (head→client, head→node, owner→worker) ----
     # These ride Python-internal pickled frames, so runtime ingress
     # never validates them — but they are part of the wire contract all

@@ -534,3 +534,43 @@ def merge_slot_state(tokens, positions, context_lens, limits, eos,
     return (sel(new_tokens, tokens), sel(new_positions, positions),
             sel(new_context_lens, context_lens), sel(new_limits, limits),
             sel(new_eos, eos))
+
+
+@jax.jit
+def gather_kv_pages(cache, page_ids
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Read one request's KV pages out of the paged cache for handoff
+    (serve disaggregation: the prefill replica exports these and the
+    decode replica splices them in with splice_kv_pages).
+
+    page_ids: [N] int32 physical page indices, pow-2 padded by the
+    caller (pad rows gather an arbitrary live page; the caller slices
+    them off host-side).  Returns (k, v) each [L, N, page, KD] — the
+    all-layer column of those pages, one contiguous gather per array.
+    """
+    return cache["k"][:, page_ids], cache["v"][:, page_ids]
+
+
+@partial(jax.jit, donate_argnames=("cache",))
+def splice_kv_pages(cache, k_pages, v_pages, page_ids
+                    ) -> Dict[str, jax.Array]:
+    """Write imported KV pages into the paged cache (the decode side of
+    the prefill→decode handoff): ONE scatter into the flat [L*P, ...]
+    view per array, the same in-place layout the decode step's
+    write_token_rows uses, so XLA updates the donated cache without
+    copying it.
+
+    k_pages/v_pages: [L, N, page, KD]; page_ids: [N] int32 physical
+    destination pages, -1 for pad rows.  Pad rows route to flat index
+    L*P — one past the end, dropped by the scatter — NOT to a per-layer
+    sentinel, which would alias the next layer's page 0.
+    """
+    kf, vf, L, P = _flat_cache(cache)
+    valid = page_ids >= 0
+    idx = jnp.where(valid[None, :],
+                    jnp.arange(L)[:, None] * P + page_ids[None, :],
+                    L * P).reshape(-1)
+    rest = k_pages.shape[2:]
+    kf = kf.at[idx].set(k_pages.reshape(-1, *rest), mode="drop")
+    vf = vf.at[idx].set(v_pages.reshape(-1, *rest), mode="drop")
+    return _unflat_cache(kf, vf, L, P)

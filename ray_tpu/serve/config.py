@@ -46,6 +46,17 @@ class DeploymentConfig:
     autoscaling_config: Optional[AutoscalingConfig] = None
     # resources for each replica actor
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    # Disaggregated serving role: "prefill" and "decode" pools split the
+    # two LLM phases across replica sets (KV pages handed off over the
+    # object plane); "mixed" — the default — is today's
+    # everything-everywhere behavior and changes nothing.
+    role: str = "mixed"
+
+    def __post_init__(self):
+        if self.role not in ("mixed", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'mixed', 'prefill' or 'decode', "
+                f"got {self.role!r}")
 
     def to_dict(self) -> Dict[str, Any]:
         d = asdict(self)

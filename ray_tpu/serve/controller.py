@@ -392,7 +392,7 @@ class ServeController:
             handle = ray_tpu.remote(Replica).options(
                 max_concurrency=max_conc, **actor_opts).remote(
                 tgt.blob, app.name, tgt.name, rid,
-                cfg.get("user_config"))
+                cfg.get("user_config"), cfg.get("role", "mixed"))
         except Exception as e:
             with self._lock:
                 tgt.message = f"failed to create replica: {e}"
@@ -586,7 +586,8 @@ class ServeController:
     def _publish_replicas(self, tgt: DeploymentTarget):
         entries = [
             {"replica_id": r.replica_id, "actor_hex": r.handle._actor_hex,
-             "max_ongoing": int(tgt.config.get("max_ongoing_requests", 8))}
+             "max_ongoing": int(tgt.config.get("max_ongoing_requests", 8)),
+             "role": tgt.config.get("role", "mixed")}
             for r in tgt.replicas if r.state == "RUNNING"
         ]
         self._poll.set(f"replicas::{tgt.app_name}::{tgt.name}", entries)

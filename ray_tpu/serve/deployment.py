@@ -86,6 +86,7 @@ class Deployment:
                 health_check_period_s: Optional[float] = None,
                 health_check_timeout_s: Optional[float] = None,
                 graceful_shutdown_timeout_s: Optional[float] = None,
+                role: Optional[str] = None,
                 name: Optional[str] = None,
                 version: Optional[str] = None,
                 route_prefix: Optional[str] = "__unset__") -> "Deployment":
@@ -113,6 +114,8 @@ class Deployment:
             cfg.health_check_timeout_s = health_check_timeout_s
         if graceful_shutdown_timeout_s is not None:
             cfg.graceful_shutdown_timeout_s = graceful_shutdown_timeout_s
+        if role is not None:
+            cfg.role = role
         return Deployment(
             self._func_or_class,
             name if name is not None else self.name,
@@ -132,6 +135,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                health_check_period_s: float = 2.0,
                health_check_timeout_s: float = 10.0,
                graceful_shutdown_timeout_s: float = 5.0,
+               role: str = "mixed",
                version: str = ""):
     """Decorator: turn a class or function into a servable Deployment."""
 
@@ -144,6 +148,7 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
             health_check_period_s=health_check_period_s,
             health_check_timeout_s=health_check_timeout_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            role=role,
         )
         if num_replicas == "auto":
             cfg.autoscaling_config = (autoscaling_config

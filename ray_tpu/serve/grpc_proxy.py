@@ -29,9 +29,16 @@ class GrpcProxy(_RouteTable):
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         import grpc
+        import os
         from concurrent import futures
 
         from ray_tpu.serve.protos import serve_pb2
+
+        try:
+            workers = int(os.environ.get(
+                "RAY_TPU_GRPC_WORKERS", "") or 16)
+        except ValueError:
+            workers = 16
 
         self._pb = serve_pb2
         self._init_routes()
@@ -55,7 +62,8 @@ class GrpcProxy(_RouteTable):
         }
         self._server = grpc.server(
             futures.ThreadPoolExecutor(
-                max_workers=16, thread_name_prefix="grpc-proxy"))
+                max_workers=max(1, workers),
+                thread_name_prefix="grpc-proxy"))
         self._server.add_generic_rpc_handlers(
             (grpc.method_handlers_generic_handler(_SERVICE, handlers),))
         self._port = self._server.add_insecure_port(f"{host}:{port}")

@@ -36,7 +36,8 @@ class DeploymentResponse:
         h = self._handle
         hex_id, actor = h._router().assign_replica(
             timeout_s=h._assign_timeout_s,
-            model_id=h._multiplexed_model_id)
+            model_id=h._multiplexed_model_id,
+            phase=h._phase, prefix_keys=h._prefix_hint)
         meta = {"multiplexed_model_id": h._multiplexed_model_id}
         ref = getattr(actor, "handle_request").remote(
             self._method, self._args, self._kwargs, meta)
@@ -101,7 +102,8 @@ class DeploymentResponseGenerator:
         self._handle = h
         hex_id, actor = h._router().assign_replica(
             timeout_s=h._assign_timeout_s,
-            model_id=h._multiplexed_model_id)
+            model_id=h._multiplexed_model_id,
+            phase=h._phase, prefix_keys=h._prefix_hint)
         self._assigned_hex = hex_id
         self._actor = actor
         self._released = False
@@ -171,6 +173,11 @@ class DeploymentHandle:
         self._multiplexed_model_id = ""
         self._assign_timeout_s = 30.0
         self._stream = False
+        # Disaggregated routing: phase ("prefill"|"decode") selects the
+        # role pool; prefix_hint (truncated-hex page-chain keys) steers
+        # prefill by prefix locality.  Empty = today's routing.
+        self._phase = ""
+        self._prefix_hint: Optional[list] = None
 
     def _router(self) -> Router:
         from ray_tpu.serve.api import _get_controller
@@ -181,16 +188,22 @@ class DeploymentHandle:
     def options(self, *, method_name: Optional[str] = None,
                 multiplexed_model_id: Optional[str] = None,
                 assign_timeout_s: Optional[float] = None,
-                stream: Optional[bool] = None
+                stream: Optional[bool] = None,
+                phase: Optional[str] = None,
+                prefix_hint: Optional[list] = None
                 ) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self.app_name,
                              method_name or self._method_name)
         h._multiplexed_model_id = (
             multiplexed_model_id if multiplexed_model_id is not None
             else self._multiplexed_model_id)
-        if assign_timeout_s is not None:
-            h._assign_timeout_s = assign_timeout_s
+        h._assign_timeout_s = (self._assign_timeout_s
+                               if assign_timeout_s is None
+                               else assign_timeout_s)
         h._stream = self._stream if stream is None else stream
+        h._phase = self._phase if phase is None else phase
+        h._prefix_hint = (self._prefix_hint if prefix_hint is None
+                          else list(prefix_hint))
         return h
 
     def remote(self, *args, **kwargs):

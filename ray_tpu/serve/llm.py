@@ -18,7 +18,10 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from ray_tpu.models import transformer as tfm
 from ray_tpu.serve.deployment import deployment
-from ray_tpu.serve.llm_engine import RequestShed
+from ray_tpu.serve import llm_engine as _eng
+from ray_tpu.serve.llm_engine import (PrefixCache,
+                                      RequestShed, _env_float, _env_int)
+from ray_tpu.util import flight_recorder
 
 
 @deployment(name="llm_server")
@@ -64,6 +67,13 @@ class LLMServer:
         self._results: Dict[int, List[int]] = {}
         self._shed: Dict[int, str] = {}
         self._engine_error: Optional[BaseException] = None
+        # Exported KV bundles ride the object plane; pinning the refs
+        # here keeps them alive until the decode replica has pulled them
+        # (bounded ring: old exports age out).
+        import collections
+
+        self._export_ring = collections.deque(maxlen=64)
+        self.handoff_fallbacks = 0
         self._stopped = False
         self._thread = threading.Thread(
             target=self._engine_loop, daemon=True, name="llm-engine")
@@ -92,6 +102,22 @@ class LLMServer:
                     self._results.update(done)
                     self._cv.notify_all()
 
+    def _wait_locked(self, ids: Sequence[int]) -> List[List[int]]:
+        """Wait (self._cv held) until every id finishes; raises on shed
+        requests and engine death."""
+        while not all(i in self._results for i in ids):
+            if self._engine_error is not None:
+                raise RuntimeError(
+                    f"LLM engine failed: {self._engine_error}")
+            for i in ids:
+                if i in self._shed:
+                    reason = self._shed.pop(i)
+                    raise RequestShed(
+                        f"request {i} shed before completion "
+                        f"({reason})")
+            self._cv.wait()
+        return [self._results.pop(i) for i in ids]
+
     def _submit_and_wait(self, prompts: Sequence[Sequence[int]],
                          max_new_tokens: int, temperature: float
                          ) -> List[List[int]]:
@@ -103,18 +129,7 @@ class LLMServer:
                 list(p), max_new_tokens, temperature=temperature)
                 for p in prompts]
             self._cv.notify_all()
-            while not all(i in self._results for i in ids):
-                if self._engine_error is not None:
-                    raise RuntimeError(
-                        f"LLM engine failed: {self._engine_error}")
-                for i in ids:
-                    if i in self._shed:
-                        reason = self._shed.pop(i)
-                        raise RequestShed(
-                            f"request {i} shed before completion "
-                            f"({reason})")
-                self._cv.wait()
-            return [self._results.pop(i) for i in ids]
+            return self._wait_locked(ids)
 
     def generate(self, prompt_tokens: Sequence[int],
                  max_new_tokens: int = 32,
@@ -126,6 +141,121 @@ class LLMServer:
                        max_new_tokens: int = 32,
                        temperature: float = 0.0) -> List[List[int]]:
         return self._submit_and_wait(prompts, max_new_tokens, temperature)
+
+    # -- prefill/decode disaggregation ------------------------------------
+    def _done_bundle(self, rid: int, prompt: List[int],
+                     toks: List[int]) -> Dict[str, Any]:
+        """serve_kv_export-shaped message for a generation that is
+        already complete: "done" carries the tokens, no pages ride."""
+        return {"op": "serve_kv_export", "req": rid,
+                "prompt": prompt, "generated": list(toks),
+                "context_len": 0,
+                "page_size": self.engine.page_size,
+                "num_layers": self.engine.config.num_layers,
+                "kd": 0, "dtype": "", "done": list(toks)}
+
+    def prefill_only(self, prompt_tokens: Sequence[int],
+                     max_new_tokens: int = 32,
+                     temperature: float = 0.0) -> Dict[str, Any]:
+        """Run admission + prefill for a request here, then EXPORT its
+        KV pages instead of decoding (the prefill leg of disaggregated
+        serving).  The request is submitted with a 1-token budget and
+        export_on_finish: the engine captures the KV bundle at finish
+        time, before the pages are freed, so the capture cannot race
+        the engine thread (a polled export could miss fast requests
+        that complete within one multi-token step).  Returns a
+        `serve_kv_import` pointer message — the bundle itself rides the
+        object plane, pinned in a bounded ring until the decode replica
+        pulls it — or the inline `serve_kv_export` bundle when no
+        cluster runtime is up (unit tests, benchmarks).  A request
+        whose full budget is a single token returns a bundle with
+        "done" set: the caller skips the decode leg entirely."""
+        import ray_tpu
+
+        prompt = list(prompt_tokens)
+        with self._cv:
+            if self._engine_error is not None:
+                raise RuntimeError(
+                    f"LLM engine failed: {self._engine_error}")
+            rid = self.engine.add_request(
+                prompt, 1, temperature=temperature,
+                export_on_finish=True)
+            self._cv.notify_all()
+            toks = self._wait_locked([rid])[0]
+            bundle = self.engine.kv_ready.pop(rid, None)
+        if bundle is None or max_new_tokens <= 1:
+            # Generation complete (1-token budget), or the bundle was
+            # evicted from kv_ready before we got here: return the
+            # finished tokens inline; the caller skips the decode leg.
+            # (On eviction with budget > 1 the DONE tokens are still
+            # only the prefill token — resume via re-prefill.)
+            if bundle is None and max_new_tokens > 1:
+                return self._done_bundle(rid, prompt,
+                                         self._submit_and_wait(
+                                             [prompt], max_new_tokens,
+                                             temperature)[0])
+            return self._done_bundle(rid, prompt, toks)
+        if not ray_tpu.is_initialized():
+            return bundle
+        ref = ray_tpu.put(bundle)
+        self._export_ring.append(ref)
+        size = int(bundle["k"].nbytes + bundle["v"].nbytes)
+        return {"op": "serve_kv_import", "obj": ref._hex, "size": size}
+
+    def decode_from(self, prompt_tokens: Sequence[int],
+                    kv: Dict[str, Any],
+                    max_new_tokens: int = 32,
+                    temperature: float = 0.0) -> List[int]:
+        """Resume generation from an exported KV bundle (the decode leg
+        of disaggregated serving).  `kv` is either the serve_kv_import
+        pointer from prefill_only (pulled off the object plane here) or
+        an inline serve_kv_export bundle.  A failed pull or an
+        incompatible bundle falls back to re-prefilling locally — the
+        request is NEVER lost, just slower (counted in
+        ray_tpu_serve_handoff_fallback_total)."""
+        from ray_tpu.core import wire_schema
+
+        prompt = list(prompt_tokens)
+        bundle: Any = kv
+        reason: Optional[str] = None
+        if isinstance(kv, dict) and kv.get("op") == "serve_kv_import":
+            try:
+                import ray_tpu
+                from ray_tpu.core.ids import ObjectID
+                from ray_tpu.core.object_ref import ObjectRef
+
+                wire_schema.validate(kv)
+                ref = ObjectRef(ObjectID.from_hex(kv["obj"]))
+                bundle = ray_tpu.get(ref, timeout=_env_float(
+                    "RAY_TPU_SERVE_HANDOFF_TIMEOUT_S", 30.0))
+            except Exception:  # noqa: BLE001
+                bundle, reason = None, "pull_failed"
+        if isinstance(bundle, dict) and bundle.get("done") is not None:
+            return list(bundle["done"])
+        rid = None
+        if reason is None:
+            try:
+                with self._cv:
+                    if self._engine_error is not None:
+                        raise RuntimeError(
+                            f"LLM engine failed: {self._engine_error}")
+                    rid = self.engine.import_kv(
+                        bundle, max_new_tokens, temperature=temperature)
+                    self._cv.notify_all()
+            except (ValueError, TypeError, KeyError):
+                # Malformed/incompatible bundle (SchemaError is a
+                # ValueError).  QueueFull and engine death propagate:
+                # re-prefilling HERE couldn't admit either.
+                reason = "import_failed"
+        if reason is not None:
+            self.handoff_fallbacks += 1
+            _eng._HANDOFF_FALLBACK.inc(tags={"reason": reason})
+            flight_recorder.record("serve", "handoff_fallback",
+                                   reason=reason, req=-1)
+            return self._submit_and_wait(
+                [prompt], max_new_tokens, temperature)[0]
+        with self._cv:
+            return self._wait_locked([rid])[0]
 
     def generate_stream(self, prompt_tokens: Sequence[int],
                         max_new_tokens: int = 32,
@@ -196,7 +326,7 @@ class LLMServer:
     def stats(self) -> Dict[str, Any]:
         eng = self.engine
         with self._cv:
-            return {
+            out = {
                 "active": eng.num_active,
                 "waiting": len(eng.waiting),
                 "free_pages": eng.allocator.num_free,
@@ -205,7 +335,86 @@ class LLMServer:
                 "num_shed": eng.num_shed,
                 "num_aborted": eng.num_aborted,
                 "max_queue": eng.max_queue,
+                "kv_exports": eng.kv_exports,
+                "kv_imports": eng.kv_imports,
+                "handoff_fallbacks": self.handoff_fallbacks,
             }
+            if eng.prefix_cache is not None:
+                # Compact hot-prefix digest: rides the load report so
+                # the router can prefix-match incoming prompts against
+                # what this replica already has cached.
+                out["prefix_digest"] = {
+                    "op": "serve_prefix_digest",
+                    "keys": eng.prefix_cache.digest(
+                        _env_int("RAY_TPU_SERVE_DIGEST_K", 16)),
+                }
+            return out
 
     def __del__(self):
         self._stopped = True
+
+
+class DisaggLLMClient:
+    """Client-side orchestration of disaggregated serving: prefill on
+    the prefill pool (routed by prefix locality), decode on the decode
+    pool (routed by free KV pages), the KV pages riding the object
+    plane between them.  Either leg failing degrades to plain mixed
+    serving on the decode handle — a request is never lost.
+
+    Usage:
+        pre = serve.get_deployment_handle("prefill", app_name="llm")
+        dec = serve.get_deployment_handle("decode", app_name="llm")
+        client = DisaggLLMClient(pre, dec, page_size=16)
+        tokens = client.generate([1, 2, 3], max_new_tokens=8)
+    """
+
+    def __init__(self, prefill_handle, decode_handle, *,
+                 page_size: int = 16,
+                 timeout_s: Optional[float] = None):
+        self.prefill = prefill_handle
+        self.decode = decode_handle
+        self.page_size = page_size
+        self.timeout_s = (timeout_s if timeout_s is not None
+                          else _env_float(
+                              "RAY_TPU_SERVE_HANDOFF_TIMEOUT_S", 30.0))
+        self.handoffs = 0
+        self.fallbacks = 0
+
+    def _prefix_hint(self, prompt: List[int]) -> List[str]:
+        """Truncated-hex chain keys of the prompt's full pages — the
+        same form replicas publish in their load-report digest, so the
+        router can longest-prefix match them."""
+        full = len(prompt) // self.page_size
+        if full <= 0:
+            return []
+        keys = PrefixCache.chain_hashes(prompt, self.page_size, full)
+        return [k.hex()[:16] for k in keys]
+
+    def generate(self, prompt_tokens: Sequence[int],
+                 max_new_tokens: int = 32,
+                 temperature: float = 0.0) -> List[int]:
+        prompt = list(prompt_tokens)
+        kv = None
+        try:
+            h = self.prefill.options(
+                phase="prefill", prefix_hint=self._prefix_hint(prompt))
+            kv = h.prefill_only.remote(
+                prompt, max_new_tokens, temperature).result(
+                    timeout_s=self.timeout_s)
+        except Exception:  # noqa: BLE001
+            # No prefill pool / replica died mid-prefill: mixed-mode
+            # degradation on the decode pool.  The request survives.
+            self.fallbacks += 1
+            _eng._HANDOFF_FALLBACK.inc(tags={"reason": "prefill_failed"})
+            flight_recorder.record("serve", "handoff_fallback",
+                                   reason="prefill_failed", req=-1)
+        if kv is None:
+            return self.decode.generate.remote(
+                prompt, max_new_tokens, temperature).result(
+                    timeout_s=self.timeout_s)
+        if isinstance(kv, dict) and kv.get("done") is not None:
+            return list(kv["done"])
+        self.handoffs += 1
+        return self.decode.options(phase="decode").decode_from.remote(
+            prompt, kv, max_new_tokens, temperature).result(
+                timeout_s=self.timeout_s)

@@ -40,6 +40,18 @@ _SHED = Counter(
 _QUEUE_DEPTH = Gauge(
     "ray_tpu_serve_queue_depth",
     "Requests waiting in the engine admission queue.")
+_KV_HANDOFF = Counter(
+    "ray_tpu_serve_kv_handoff_total",
+    "KV-page handoffs between prefill and decode replicas.",
+    tag_keys=("direction",))
+_KV_HANDOFF_BYTES = Counter(
+    "ray_tpu_serve_kv_handoff_bytes_total",
+    "KV page bytes moved by prefill->decode handoffs.",
+    tag_keys=("direction",))
+_HANDOFF_FALLBACK = Counter(
+    "ray_tpu_serve_handoff_fallback_total",
+    "Handoffs that fell back to re-prefill on the decode replica.",
+    tag_keys=("reason",))
 
 
 class QueueFull(RuntimeError):
@@ -188,6 +200,17 @@ class PrefixCache:
     def num_idle(self) -> int:
         return sum(e.refcount == 0 for e in self._entries.values())
 
+    def digest(self, k: int = 16) -> List[str]:
+        """Top-k hot prefix keys (most-referenced first, shallower pages
+        breaking ties) as truncated hex strings — the compact digest a
+        replica's load_report carries so the router can prefix-match
+        incoming prompts against what each replica already has cached."""
+        keys = sorted(
+            self._entries,
+            key=lambda key: (-self._entries[key].refcount,
+                             self._entries[key].depth))[:max(0, k)]
+        return [key.hex()[:16] for key in keys]
+
 
 @dataclass
 class _Request:
@@ -214,6 +237,14 @@ class _Request:
     # always run to completion.
     deadline: float = 0.0
     enqueued_at: float = 0.0
+    # Prefill->decode handoff: a serve_kv_export bundle whose pages this
+    # request splices into the local cache at admission instead of
+    # re-running prefill (import_kv / _admit_import).
+    kv_bundle: Optional[Dict[str, Any]] = None
+    # Prefill-specialized replicas set this: when the request finishes,
+    # its KV pages are exported into kv_ready BEFORE the pages are
+    # freed, so the bundle capture cannot race the engine thread.
+    export_on_finish: bool = False
 
 
 class LLMEngine:
@@ -313,6 +344,16 @@ class LLMEngine:
         self._next_id = 0
         self.waiting: List[_Request] = []
         self.num_completed = 0
+        # Prefill/decode disaggregation counters (serve observability).
+        self.kv_exports = 0
+        self.kv_imports = 0
+        # Completions surfaced by an out-of-band pipeline flush (e.g.
+        # export_kv draining in-flight chunks); merged into the next
+        # step()'s done map so no finish is ever dropped.
+        self._pending_done: Dict[int, List[int]] = {}
+        # req_id -> serve_kv_export bundle captured at finish for
+        # export_on_finish requests (bounded; oldest evicted first).
+        self.kv_ready: Dict[int, Dict[str, Any]] = {}
 
         # Admission control (serve data plane): a bounded waiting queue
         # (add_request raises QueueFull past it), a queueing deadline
@@ -340,7 +381,8 @@ class LLMEngine:
                     max_new_tokens: int = 32, *,
                     temperature: float = 0.0,
                     eos_token: Optional[int] = None,
-                    deadline_s: Optional[float] = None) -> int:
+                    deadline_s: Optional[float] = None,
+                    export_on_finish: bool = False) -> int:
         if not prompt_tokens:
             raise ValueError("prompt must contain at least one token")
         if max_new_tokens < 1:
@@ -373,7 +415,8 @@ class LLMEngine:
                 f"admission queue full ({len(self.waiting)} waiting, "
                 f"cap {self.max_queue})")
         req = _Request(self._next_id, list(prompt_tokens), max_new_tokens,
-                       temperature, eos_token=eos_token)
+                       temperature, eos_token=eos_token,
+                       export_on_finish=export_on_finish)
         req.enqueued_at = time.monotonic()
         ttl = self.queue_timeout_s if deadline_s is None else deadline_s
         if ttl and ttl > 0:
@@ -418,6 +461,164 @@ class LLMEngine:
                 return True
         return False
 
+    def export_kv(self, req_id: int) -> Dict[str, Any]:
+        """Export an ACTIVE request's KV pages + resume state as a
+        `serve_kv_export` wire message — the prefill side of the
+        prefill->decode handoff.  The bundle carries everything a decode
+        engine needs to resume generation without re-running prefill:
+        the prompt, tokens generated so far, the context length, the
+        prefix-cache chain keys, and the [L, n_ctx, page, KD] K/V page
+        tensors read out of the paged cache in one gather
+        (models/decoding.py gather_kv_pages).  The request stays active
+        here; the caller aborts it once the bundle is shipped."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import gather_kv_pages
+
+        slot, req = -1, None
+        for s, r in enumerate(self.slot_req):
+            if r is not None and r.req_id == req_id:
+                slot, req = s, r
+                break
+        if req is None:
+            raise KeyError(f"request {req_id} is not active")
+        if self._inflight:
+            # Host mirrors (context_lens, generated) must be
+            # authoritative before reading them: drain the pipeline.
+            # Completions it surfaces merge into the next step()'s done
+            # map, so no finish is dropped.
+            self._flush_pipeline(self._pending_done)
+            if self.slot_req[slot] is not req:
+                raise KeyError(f"request {req_id} finished before export")
+        if not req.generated:
+            raise RuntimeError(
+                f"request {req_id} has no generated token yet")
+        return self._kv_bundle(req, slot, int(self.context_lens[slot]))
+
+    def _kv_bundle(self, req: _Request, slot: int,
+                   ctx: int) -> Dict[str, Any]:
+        """Gather slot's first ceil(ctx/page_size) KV pages into a
+        serve_kv_export bundle.  Caller guarantees the device cache
+        holds KV for positions [0, ctx) of this slot."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import gather_kv_pages
+
+        n_ctx = max(1, math.ceil(ctx / self.page_size))
+        # Pow-2 pad the gather (compile reuse); pad rows read an
+        # arbitrary live page and are sliced off host-side.
+        N = 1 << (n_ctx - 1).bit_length()
+        ids = np.zeros(N, dtype=np.int32)
+        ids[:n_ctx] = self.block_tables[slot][:n_ctx]
+        k, v = gather_kv_pages(self.cache, jnp.asarray(ids))
+        k = np.asarray(k)[:, :n_ctx]
+        v = np.asarray(v)[:, :n_ctx]
+        bundle: Dict[str, Any] = {
+            "op": "serve_kv_export",
+            "req": req.req_id,
+            "prompt": list(req.prompt),
+            "generated": list(req.generated),
+            "context_len": ctx,
+            "page_size": self.page_size,
+            "num_layers": int(k.shape[0]),
+            "kd": int(k.shape[-1]),
+            "dtype": str(k.dtype),
+            "chain_keys": list(req.chain_keys or []),
+            "k": k,
+            "v": v,
+        }
+        self.kv_exports += 1
+        nbytes = k.nbytes + v.nbytes
+        _KV_HANDOFF.inc(tags={"direction": "export"})
+        _KV_HANDOFF_BYTES.inc(nbytes, tags={"direction": "export"})
+        flight_recorder.record("serve", "kv_export", req_id=req.req_id,
+                               pages=n_ctx, bytes=nbytes)
+        return bundle
+
+    def import_kv(self, bundle: Dict[str, Any],
+                  max_new_tokens: int = 32, *,
+                  temperature: float = 0.0,
+                  eos_token: Optional[int] = None,
+                  deadline_s: Optional[float] = None) -> int:
+        """Enqueue a request resuming from an exported KV bundle — the
+        decode side of the prefill->decode handoff.  Mirrors
+        add_request's admission contract (bounds checks, QueueFull
+        backpressure, deadlines); the actual page splice happens at
+        admission time (_admit_import), where slot + pages exist.
+        max_new_tokens is the request's TOTAL decode budget, counting
+        tokens the prefill replica already generated."""
+        from ray_tpu.core import wire_schema
+
+        wire_schema.validate(bundle)
+        if bundle.get("op") != "serve_kv_export":
+            raise ValueError(
+                f"expected serve_kv_export bundle, got {bundle.get('op')}")
+        for key, want in (("page_size", self.page_size),
+                          ("num_layers", self.config.num_layers)):
+            if int(bundle[key]) != want:
+                raise ValueError(
+                    f"KV bundle {key}={bundle[key]} incompatible with "
+                    f"engine {key}={want}")
+        if str(np.asarray(bundle["k"]).dtype) != \
+                str(np.asarray(self.cache["k"]).dtype):
+            raise ValueError(
+                f"KV bundle dtype {bundle['dtype']} incompatible with "
+                f"cache dtype {np.asarray(self.cache['k']).dtype}")
+        prompt = list(bundle["prompt"])
+        generated = list(bundle["generated"])
+        if not prompt:
+            raise ValueError("bundle prompt must contain at least one token")
+        if not generated:
+            raise ValueError("bundle carries no generated token to resume")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        if len(generated) >= max_new_tokens:
+            raise ValueError(
+                f"bundle already has {len(generated)} generated tokens; "
+                f"nothing left of a {max_new_tokens}-token budget")
+        if (len(prompt) + max_new_tokens) > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt+generation ({len(prompt)}+{max_new_tokens})"
+                f" exceeds max_seq_len={self.config.max_seq_len}")
+        need = math.ceil((len(prompt) + max_new_tokens) / self.page_size)
+        if need > self.allocator.num_pages - 1:
+            raise ValueError(
+                f"request needs {need} KV pages but the pool only has "
+                f"{self.allocator.num_pages - 1} allocatable; raise "
+                "num_pages or shorten the request")
+        if int(bundle["context_len"]) != \
+                len(prompt) + len(generated) - 1:
+            raise ValueError(
+                f"bundle context_len {bundle['context_len']} does not "
+                f"match prompt+generated-1 "
+                f"({len(prompt)}+{len(generated)}-1)")
+        if self.max_queue > 0 and len(self.waiting) >= self.max_queue:
+            self.num_shed += 1
+            _SHED.inc(tags={"reason": "queue_full"})
+            flight_recorder.record("serve", "queue_full",
+                                   waiting=len(self.waiting),
+                                   max_queue=self.max_queue)
+            raise QueueFull(
+                f"admission queue full ({len(self.waiting)} waiting, "
+                f"cap {self.max_queue})")
+        req = _Request(self._next_id, prompt, max_new_tokens,
+                       temperature, generated=generated,
+                       eos_token=eos_token)
+        req.kv_bundle = bundle
+        keys = bundle.get("chain_keys")
+        if keys:
+            req.chain_keys = [bytes(k) for k in keys]
+        req.enqueued_at = time.monotonic()
+        ttl = self.queue_timeout_s if deadline_s is None else deadline_s
+        if ttl and ttl > 0:
+            req.deadline = req.enqueued_at + ttl
+        self._next_id += 1
+        self.waiting.append(req)
+        _REQUESTS.inc()
+        _QUEUE_DEPTH.set(len(self.waiting))
+        return req.req_id
+
     def _retire_unstarted(self, req: _Request, reason: str) -> None:
         """Drop a request that never reached a slot (shed or aborted
         while waiting).  Waiting requests hold no pages and no
@@ -454,7 +655,7 @@ class LLMEngine:
 
     def has_work(self) -> bool:
         return bool(self.waiting) or self.num_active > 0 \
-            or bool(self._inflight)
+            or bool(self._inflight) or bool(self._pending_done)
 
     def step(self) -> Dict[int, List[int]]:
         """Admit waiting requests (prefill), then one batched decode step
@@ -464,6 +665,9 @@ class LLMEngine:
         tokens are reconciled (<= pipeline_depth steps after the chunk
         that produced them)."""
         done: Dict[int, List[int]] = {}
+        if self._pending_done:
+            done.update(self._pending_done)
+            self._pending_done.clear()
         self._shed_expired()
         # Per-step prefill token budget: admission (classic _admit and
         # packed waves) may spend at most this many prompt tokens per
@@ -565,6 +769,12 @@ class LLMEngine:
         pending_keys: set = set()
         while self.waiting and free:
             req = self.waiting[0]
+            if req.kv_bundle is not None:
+                # Imported KV needs no prefill (budget-exempt): splice
+                # its pages in and arm the decode slot directly.
+                if not self._admit_import(req, free, done):
+                    break
+                continue
             L = len(req.prompt)
             total = math.ceil((L + req.max_new_tokens) / self.page_size)
 
@@ -705,6 +915,104 @@ class LLMEngine:
         if fin is not None:  # e.g. max_new_tokens == 1
             done[req.req_id] = fin
 
+    def _admit_import(self, req: _Request, free: List[int],
+                      done: Dict[int, List[int]]) -> bool:
+        """Seat one KV-import request: match shared prompt pages against
+        the LOCAL prefix cache (cross-replica reuse — only the
+        non-shared context pages are spliced), allocate the rest, write
+        the imported pages into the paged cache in one scatter
+        (models/decoding.py splice_kv_pages), and arm the decode slot at
+        the exported context.  Returns False on page backpressure (the
+        request stays at the head of the queue)."""
+        import jax.numpy as jnp
+
+        from ray_tpu.models.decoding import splice_kv_pages
+
+        bundle = req.kv_bundle
+        L = len(req.prompt)
+        ps = self.page_size
+        ctx = int(bundle["context_len"])
+        total = math.ceil((L + req.max_new_tokens) / ps)
+        n_ctx = max(1, math.ceil(ctx / ps))
+        full = L // ps
+        shared: List[int] = []
+        if self.prefix_cache is not None:
+            if req.chain_keys is None:
+                req.chain_keys = PrefixCache.chain_hashes(
+                    req.prompt, ps, full)
+            # Unlike fresh admission there is no (L-1) sampling cap:
+            # the first token is already generated, so ALL full prompt
+            # pages are reusable.
+            shared = self.prefix_cache.match(req.chain_keys[:full])
+            req.cache_keys = req.chain_keys[:len(shared)]
+        n_shared = len(shared)
+        n_private = total - n_shared
+        if n_private > self._available_pages():
+            if self.prefix_cache is not None and req.cache_keys:
+                self.prefix_cache.release(req.cache_keys)
+                req.cache_keys = []
+            return False
+        self.waiting.pop(0)
+        slot = free.pop(0)
+        req.slot = slot
+        req.pages = self._alloc_evicting(n_private)
+        pages = shared + req.pages
+        table = np.zeros(self.max_pages_per_seq, dtype=np.int32)
+        table[:len(pages)] = pages
+        self.block_tables[slot] = table
+
+        # Splice the non-shared context pages (pow-2 padded; -1 rows
+        # drop in the scatter).  Pages 0..n_shared-1 already hold the
+        # same KV locally via the prefix cache.
+        n_splice = n_ctx - n_shared
+        nbytes = 0
+        if n_splice > 0:
+            k = np.asarray(bundle["k"])[:, n_shared:n_ctx]
+            v = np.asarray(bundle["v"])[:, n_shared:n_ctx]
+            nbytes = k.nbytes + v.nbytes
+            N = 1 << (n_splice - 1).bit_length()
+            ids = np.full(N, -1, dtype=np.int32)
+            ids[:n_splice] = pages[n_shared:n_ctx]
+            kp = np.zeros((k.shape[0], N) + k.shape[2:], dtype=k.dtype)
+            vp = np.zeros_like(kp)
+            kp[:, :n_splice] = k
+            vp[:, :n_splice] = v
+            self.cache = splice_kv_pages(
+                self.cache, jnp.asarray(kp), jnp.asarray(vp),
+                jnp.asarray(ids))
+
+        # Adopt the request's full prompt pages into the local prefix
+        # cache (now valid post-splice) so later requests sharing the
+        # prefix hit locally — this is what makes prefix reuse survive
+        # the replica boundary.
+        if self.prefix_cache is not None and req.chain_keys:
+            if shared:
+                self.prefix_cache.hits += 1
+                self.prefix_cache.tokens_saved += n_shared * ps
+            own = []
+            for i in range(n_shared, full):
+                page = pages[i]
+                if self.prefix_cache.register(req.chain_keys[i], page, i):
+                    req.cache_keys.append(req.chain_keys[i])
+                    own.append(page)
+            req.pages = [p for p in req.pages if p not in own]
+
+        self.context_lens[slot] = ctx
+        self.last_tokens[slot] = req.generated[-1]
+        self._just_admitted.add(slot)
+        self.kv_imports += 1
+        _KV_HANDOFF.inc(tags={"direction": "import"})
+        _KV_HANDOFF_BYTES.inc(nbytes, tags={"direction": "import"})
+        flight_recorder.record(
+            "serve", "kv_import", req_id=req.req_id, pages=n_splice,
+            shared_pages=n_shared, bytes=nbytes)
+        req.kv_bundle = None  # release the page tensors
+        _QUEUE_DEPTH.set(len(self.waiting))
+        fin = self._maybe_finish(req)
+        if fin is not None:
+            done[req.req_id] = fin
+        return True
+
     # -- packed async admission (greedy pipelined path) --------------------
     def _seg_len(self, prompt_len: int) -> int:
         """Pow-2 page-multiple bucket a prompt pads to inside a packed
@@ -717,6 +1025,8 @@ class LLMEngine:
         chunked program — both stay on the classic path."""
         if not self.packed_admit or req.temperature > 0.0:
             return False
+        if req.kv_bundle is not None:
+            return False  # imported KV splices in via the classic path
         if self.prefix_cache is not None:
             L = len(req.prompt)
             if req.chain_keys is None:
@@ -1190,6 +1500,19 @@ class LLMEngine:
                    and req.generated[-1] == req.eos_token)
         if len(req.generated) >= req.max_new_tokens or hit_eos:
             if req.slot >= 0:
+                if req.export_on_finish:
+                    # Capture the KV pages before they are freed below:
+                    # the prefill half of a disaggregated handoff.  ctx
+                    # is derived from the invariant (KV written for the
+                    # prompt + all generated tokens but the last) rather
+                    # than context_lens, which can run ahead when a
+                    # speculative block finishes early and discards its
+                    # tail tokens.
+                    ctx = len(req.prompt) + len(req.generated) - 1
+                    self.kv_ready[req.req_id] = self._kv_bundle(
+                        req, req.slot, ctx)
+                    while len(self.kv_ready) > 32:
+                        self.kv_ready.pop(next(iter(self.kv_ready)))
                 self.slot_req[req.slot] = None
                 self.context_lens[req.slot] = 0
                 self.allocator.free(req.pages)

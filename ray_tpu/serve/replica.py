@@ -69,7 +69,8 @@ class Replica:
     """
 
     def __init__(self, blob: bytes, app_name: str, deployment_name: str,
-                 replica_id: str, user_config: Any = None):
+                 replica_id: str, user_config: Any = None,
+                 role: str = "mixed"):
         func_or_class, init_args, init_kwargs = cloudpickle.loads(blob)
         init_args = tuple(self._resolve_marker(a) for a in init_args)
         init_kwargs = {k: self._resolve_marker(v)
@@ -79,6 +80,10 @@ class Replica:
         self._app_name = app_name
         self._deployment_name = deployment_name
         self._replica_id = replica_id
+        # Disaggregated-serving role (prefill|decode|mixed): advertised
+        # in load_report so the router's phase-aware pools stay correct
+        # even if the published entry lags a config change.
+        self._role = role
         self._callable = make_callable(func_or_class, init_args, init_kwargs)
         self._ongoing = 0
         self._lock = threading.Lock()
@@ -205,6 +210,7 @@ class Replica:
             "ts": time.time(),
             "ongoing": self._ongoing,
             "models": multiplex.loaded_model_ids(),
+            "role": self._role,
         }
         user = getattr(self._callable, "load_report", None)
         if not callable(user):
@@ -232,6 +238,11 @@ class Replica:
                     report["free_kv_pages"] = int(extra["free_pages"])
                 if "free_kv_pages" in extra:
                     report["free_kv_pages"] = int(extra["free_kv_pages"])
+                if "prefix_digest" in extra:
+                    # Hot-prefix digest (serve_prefix_digest message):
+                    # the router prefix-matches request hints against
+                    # it for prefill locality.
+                    report["prefix_digest"] = extra["prefix_digest"]
         return report
 
     def health_check(self) -> str:

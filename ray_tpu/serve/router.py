@@ -38,6 +38,14 @@ def _stale_s() -> float:
         return 5.0
 
 
+def _role_strict() -> bool:
+    """Strict role pools: a phase-tagged request WAITS for a replica of
+    its role instead of degrading to mixed routing when the pool is
+    empty (default off — graceful degradation)."""
+    return os.environ.get("RAY_TPU_SERVE_ROLE_STRICT", "0").lower() \
+        in ("1", "true", "yes")
+
+
 class _ReplicaSet:
     def __init__(self):
         self.entries: List[dict] = []
@@ -145,13 +153,16 @@ class Router:
                     self._set.update_reports(value)
 
     # ------------------------------------------------------------------
-    def _score(self, e: dict, now: float, stale_s: float) -> tuple:
+    def _score(self, e: dict, now: float, stale_s: float,
+               phase: str = "") -> tuple:
         """P2C score for one candidate: local in-flight plus the
         replica's reported engine queue depth while the report is fresh
         (stale reports are ignored — blind local signal only), with a
         penalty when the report says the KV pool is exhausted (every
-        admission there would stall on pages).  Returns (score, fresh).
-        """
+        admission there would stall on pages).  Decode-phase requests
+        additionally prefer KV-page headroom (a tiny tie-break bonus:
+        the imported context + remaining generation must fit).  Returns
+        (score, fresh)."""
         h = e["actor_hex"]
         score = float(self._set.inflight.get(h, 0))
         rep = self._set.reports.get(h)
@@ -162,7 +173,32 @@ class Router:
             free = rep.get("free_kv_pages")
             if free is not None and free <= 0:
                 score += 4.0
+            elif phase == "decode" and free is not None:
+                # < 0.5 total so headroom never outvotes a whole queued
+                # request — it breaks ties between equally loaded
+                # replicas.
+                score -= min(float(free), 4096.0) * 1e-4
         return score, fresh
+
+    def _prefix_match(self, e: dict, prefix_keys, now: float,
+                      stale_s: float) -> int:
+        """Longest-prefix match of the request's page-chain hint against
+        the replica's advertised hot-prefix digest (stale digests are
+        worthless — the cache has moved on)."""
+        rep = self._set.reports.get(e["actor_hex"])
+        if rep is None or now - rep.get("received_at", 0.0) > stale_s:
+            return 0
+        digest = rep.get("prefix_digest")
+        if not isinstance(digest, dict) \
+                or digest.get("op") != "serve_prefix_digest":
+            return 0
+        have = set(digest.get("keys") or ())
+        n = 0
+        for k in prefix_keys:
+            if k not in have:
+                break
+            n += 1
+        return n
 
     def _has_model(self, e: dict, model_id: str, now: float,
                    stale_s: float) -> bool:
@@ -172,12 +208,21 @@ class Router:
         return model_id in (rep.get("models") or ())
 
     def assign_replica(self, timeout_s: float = 30.0,
-                       model_id: str = "") -> tuple:
+                       model_id: str = "", phase: str = "",
+                       prefix_keys: Optional[List[str]] = None) -> tuple:
         """Pick a replica (pow-2 by local in-flight + fresh load
         feedback), respecting max_ongoing backpressure; returns
         (actor_hex, handle).  model_id biases the choice toward
         replicas that already hold that multiplexed model (skipping a
-        cold load) unless none report it."""
+        cold load) unless none report it.
+
+        Disaggregated serving: phase ("prefill"|"decode") restricts the
+        pool to replicas of that role (mixed replicas always qualify),
+        degrading to ALL candidates when the phase pool is empty unless
+        RAY_TPU_SERVE_ROLE_STRICT.  prefix_keys (the request's
+        page-chain hint) steers prefill to the replica whose hot-prefix
+        digest longest-matches it — cached pages there mean less
+        recompute — falling back to pure load scoring on no match."""
         s = self._set
         deadline = time.monotonic() + timeout_s
         stale_s = _stale_s()
@@ -188,10 +233,22 @@ class Router:
                     h = e["actor_hex"]
                     if s.inflight.get(h, 0) < e.get("max_ongoing", 8):
                         candidates.append(e)
+                degraded = False
+                if phase and candidates:
+                    rolepool = [e for e in candidates
+                                if e.get("role", "mixed")
+                                in (phase, "mixed")]
+                    if rolepool:
+                        candidates = rolepool
+                    elif _role_strict():
+                        candidates = []  # wait for the phase pool
+                    else:
+                        degraded = True  # graceful: mixed routing
                 if candidates:
                     now = time.monotonic()
                     pool = candidates
                     affine = False
+                    locality = 0
                     if model_id:
                         with_model = [e for e in candidates
                                       if self._has_model(
@@ -199,20 +256,29 @@ class Router:
                         if with_model:
                             pool = with_model
                             affine = True
+                    if phase == "prefill" and prefix_keys:
+                        matches = [(self._prefix_match(
+                            e, prefix_keys, now, stale_s), e)
+                            for e in pool]
+                        best = max(m for m, _ in matches)
+                        if best > 0:
+                            pool = [e for m, e in matches if m == best]
+                            locality = best
                     if len(pool) >= 2:
                         a, b = random.sample(pool, 2)
-                        sa, fa = self._score(a, now, stale_s)
-                        sb, fb = self._score(b, now, stale_s)
+                        sa, fa = self._score(a, now, stale_s, phase)
+                        sb, fb = self._score(b, now, stale_s, phase)
                         pick, fresh = (a, fa) if sa <= sb else (b, fb)
                     else:
                         pick = pool[0]
-                        _, fresh = self._score(pick, now, stale_s)
+                        _, fresh = self._score(pick, now, stale_s, phase)
                     hex_id = pick["actor_hex"]
                     s.inflight[hex_id] = s.inflight.get(hex_id, 0) + 1
                     flight_recorder.record(
                         "serve", "route", deployment=self.deployment,
                         replica=hex_id[:12], feedback=bool(fresh),
-                        affinity=affine,
+                        affinity=affine, phase=phase,
+                        locality=locality, degraded=degraded,
                         inflight=s.inflight[hex_id])
                     return hex_id, s.handles[hex_id]
                 remaining = deadline - time.monotonic()

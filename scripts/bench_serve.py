@@ -29,6 +29,13 @@ replicas run (serve/llm_engine.py):
                    the ratio near 1: admission work interleaves in
                    bounded chunks instead of stalling live slots for a
                    full wave.
+  disaggregated    (--disagg) paired mixed-vs-disaggregated rows: the
+                   same interference workload with the prefill stream
+                   on a separate engine (decode TPOT on the decode
+                   engine's busy clock), plus a cross-replica
+                   prefix-cache phase that hands KV bundles from a
+                   prefill server to a decode server and reports the
+                   decode side's prefix hit rate + token-exactness.
 
 Honesty rules (bench_decode's): TPU shapes only run on a real TPU
 (devices[0].platform == "tpu"); elsewhere the tiny-config CPU fallback
@@ -127,6 +134,12 @@ def run_sustained(config, shape, hbm_gb_s):
     roofline_tok_s = hbm_gb_s / (weight_bytes + kv_bytes) \
         * shape["max_batch"]
     tok_s = gen_tokens / dt
+    frac = tok_s / roofline_tok_s
+    # Full precision: on the tiny CPU shape the fraction is ~1e-5 and
+    # round(_, 3) flattened it to 0.0 — a meaningless artifact row.
+    print(f"sustained: {tok_s:.3e} tok/s vs roofline "
+          f"{roofline_tok_s:.3e} tok/s (fraction {frac:.3e})",
+          file=sys.stderr)
     ttft = [t_first[i] - t_add[i] for i in ids]
     tpot = [(t_done[i] - t_first[i]) / (len(results[i]) - 1)
             for i in ids if len(results[i]) > 1]
@@ -134,7 +147,8 @@ def run_sustained(config, shape, hbm_gb_s):
         "concurrent_clients": n,
         "tokens_per_sec": round(tok_s, 1),
         "roofline_tokens_per_sec": round(roofline_tok_s, 1),
-        "roofline_fraction": round(tok_s / roofline_tok_s, 3),
+        "roofline_fraction": frac,
+        "roofline_fraction_pct": frac * 100.0,
         "ttft_p50_s": round(_pct(ttft, 50), 4),
         "ttft_p99_s": round(_pct(ttft, 99), 4),
         "tpot_p50_ms": round(_pct(tpot, 50) * 1e3, 3),
@@ -254,6 +268,118 @@ def run_prefill_interference(config, shape):
     }
 
 
+def run_disaggregated(config, shape):
+    """Paired mixed-vs-disaggregated rows for the prefill/decode split.
+
+    Interference pair: the same long decoders + continuous prefill
+    stream measured twice — MIXED (one engine runs both, prefill
+    admission waves interleave with the decoders' steps) and
+    DISAGGREGATED (the prefill stream runs on a separate engine, as a
+    prefill-role replica would).  Decode TPOT is measured on the decode
+    engine's BUSY clock (time inside its own step() calls), so the
+    prefill engine's host time doesn't bleed into the disaggregated row
+    — on a real deployment the pools are separate chips.
+
+    Prefix pair: N requests sharing a system prompt flow
+    prefill_only -> KV handoff -> decode_from across two LLMServer
+    instances; the decode side's cross-replica prefix-cache hit rate
+    and token-exactness vs a single mixed server are the row."""
+    rng = np.random.default_rng(3)
+    n_dec = max(2, shape["max_batch"] // 2)
+    dec_prompts = [rng.integers(1, config.vocab_size,
+                                shape["prompt_len"]).tolist()
+                   for _ in range(n_dec)]
+
+    def _measure(mode):
+        eng_d = _mk_engine(config, shape)
+        eng_p = eng_d if mode != "disaggregated" \
+            else _mk_engine(config, shape)
+        for eng in {id(eng_d): eng_d, id(eng_p): eng_p}.values():
+            eng.generate(dec_prompts,
+                         max_new_tokens=shape["interf_max_new"])
+            eng.generate([rng.integers(
+                1, config.vocab_size,
+                shape["interf_prompt_len"]).tolist()], max_new_tokens=4)
+            _warmup(eng, config, shape, rng)
+        ids = [eng_d.add_request(p,
+                                 max_new_tokens=shape["interf_max_new"])
+               for p in dec_prompts]
+        busy = 0.0  # decode engine's attributed clock
+        t_first, t_done, results = {}, {}, {}
+        fill = []
+        while any(i not in results for i in ids):
+            if mode != "alone" and len(eng_p.waiting) < 2:
+                for _ in range(2):
+                    fill.append(eng_p.add_request(
+                        rng.integers(
+                            1, config.vocab_size,
+                            shape["interf_prompt_len"]).tolist(),
+                        max_new_tokens=4))
+            t0 = time.perf_counter()
+            done = eng_d.step()
+            busy += time.perf_counter() - t0
+            results.update(done)
+            for r in eng_d.slot_req:
+                if r is not None and r.generated \
+                        and r.req_id not in t_first:
+                    t_first[r.req_id] = busy
+            for rid in done:
+                t_first.setdefault(rid, busy)
+                t_done[rid] = busy
+            if eng_p is not eng_d and eng_p.has_work():
+                eng_p.step()  # prefill pool: not on the decode clock
+        while eng_p.has_work():
+            eng_p.step()  # drain stragglers (not measured)
+        tpot = [(t_done[i] - t_first[i]) / (len(results[i]) - 1)
+                for i in ids if len(results.get(i, [])) > 1]
+        return _pct(tpot, 99) * 1e3, len(fill)
+
+    alone_p99, _ = _measure("alone")
+    rows = {}
+    for mode in ("mixed", "disaggregated"):
+        p99, n_fill = _measure(mode)
+        rows[mode] = {
+            "decode_tpot_p99_ms_alone": round(alone_p99, 3),
+            "decode_tpot_p99_ms_with_prefill": round(p99, 3),
+            "tpot_ratio": round(p99 / alone_p99, 3),
+            "prefill_requests_injected": n_fill,
+        }
+
+    # -- cross-replica prefix pair -------------------------------------
+    from ray_tpu.serve import llm as llm_mod
+
+    LLMServer = llm_mod.LLMServer.func_or_class
+    kw = dict(config=config, page_size=shape["page_size"],
+              num_pages=shape["num_pages"], max_batch=shape["max_batch"],
+              multi_step=shape["multi_step"],
+              prefill_budget=shape["prefill_budget"])
+    pre, dec, ref = LLMServer(**kw), LLMServer(**kw), LLMServer(**kw)
+    sys_prompt = rng.integers(
+        1, config.vocab_size, 2 * shape["page_size"]).tolist()
+    n_req, max_new, matched = 6, 2 * shape["multi_step"], 0
+    for _ in range(n_req):
+        prompt = sys_prompt + rng.integers(
+            1, config.vocab_size, 3).tolist()
+        kv = pre.prefill_only(prompt, max_new_tokens=max_new)
+        got = dec.decode_from(prompt, kv, max_new_tokens=max_new)
+        want = ref._submit_and_wait([prompt], max_new, 0.0)[0]
+        matched += int(got == want)
+    hits = dec.engine.prefix_cache.hits
+    rows["cross_replica_prefix"] = {
+        "requests": n_req,
+        "kv_handoffs": dec.engine.kv_imports,
+        "handoff_fallbacks": dec.handoff_fallbacks,
+        "prefix_hits": hits,
+        "prefix_hit_rate": hits / n_req,
+        "tokens_saved": dec.engine.prefix_cache.tokens_saved,
+        "tokens_match_mixed_reference": matched == n_req,
+    }
+    print(f"disagg: tpot_ratio mixed={rows['mixed']['tpot_ratio']} "
+          f"disaggregated={rows['disaggregated']['tpot_ratio']} "
+          f"prefix_hit_rate={hits / n_req:.3f}", file=sys.stderr)
+    return rows
+
+
 def main():
     import jax
 
@@ -288,6 +414,8 @@ def main():
     sustained = run_sustained(config, shape, hbm_gb_s)
     burst = run_burst_shed(config, shape)
     interference = run_prefill_interference(config, shape)
+    disagg = run_disaggregated(config, shape) \
+        if "--disagg" in sys.argv[1:] else None
     print(json.dumps({
         "metric": "serve_tokens_per_sec",
         "value": sustained["tokens_per_sec"],
@@ -301,6 +429,7 @@ def main():
         "sustained_load": sustained,
         "burst_shed": burst,
         "prefill_interference": interference,
+        **({"disaggregated": disagg} if disagg is not None else {}),
         "model_params": tfm.num_params(config),
         "device": getattr(devices[0], "device_kind", devices[0].platform),
         "on_tpu": on_tpu,
