@@ -128,6 +128,19 @@ KNOBS: List[Knob] = [
     Knob("RAY_TPU_SERVE_DIGEST_K", "16", "int", "user",
          "Top-K hot prefix keys a serve replica advertises in its "
          "load-report digest for prefix-locality routing."),
+    Knob("RAY_TPU_SERVE_TRACE", "1", "bool", "user",
+         "0 disables request-journey tracing at the serve ingress "
+         "proxies (no trace minting, no per-request phase spans)."),
+    Knob("RAY_TPU_SERVE_SLO_SAMPLES", "256", "int", "user",
+         "Capacity of the per-engine SLO sample ring (TTFT/TPOT/queue-"
+         "wait) drained by load reports between controller probes."),
+    Knob("RAY_TPU_SERVE_STEP_SAMPLE_EVERY", "8", "int", "user",
+         "Engine step-sampler cadence: every Nth step snapshots batch "
+         "occupancy, queue depth, free KV pages and prefill token "
+         "spend (0 disables)."),
+    Knob("RAY_TPU_SERVE_SLO_WINDOW_S", "300", "float", "user",
+         "Sliding-window width of the controller's per-deployment SLO "
+         "percentiles (serve_slo / /api/serve_slo)."),
 
     # -- scheduling / placement -----------------------------------------
     Knob("RAY_TPU_NO_LOCALITY", "", "flag", "user",

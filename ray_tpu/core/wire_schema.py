@@ -157,13 +157,17 @@ SCHEMA: Dict[str, Dict[str, str]] = {
     # [L, n_ctx, page, KD] tensors; "done" short-circuits requests that
     # finished at prefill), the object-plane pointer it rides as, and
     # the hot-prefix digest replicas advertise for locality routing.
+    # "trace" is the request-journey linkage [trace_id, span_id]: the
+    # decode leg parents its spans under the prefill leg's replica
+    # span, so a disaggregated request renders as ONE connected trace.
     "serve_kv_export": {"req": "int", "prompt": "list",
                         "generated": "list", "context_len": "int",
                         "page_size": "int", "num_layers": "int",
                         "kd": "int", "dtype": "str",
                         "chain_keys": "list?", "done": "list?",
-                        "k": "any?", "v": "any?"},
-    "serve_kv_import": {"obj": "str", "size": "int"},
+                        "k": "any?", "v": "any?", "trace": "list?"},
+    "serve_kv_import": {"obj": "str", "size": "int",
+                        "trace": "list?"},
     "serve_prefix_digest": {"keys": "list"},
     # -- push / dispatch ops (head→client, head→node, owner→worker) ----
     # These ride Python-internal pickled frames, so runtime ingress

@@ -20,6 +20,10 @@ Routes (all GET unless noted):
                               ?poll=0 answers from the head store only
   /api/spans?trace_id=&max_spans=&since=&poll= -> harvested cluster
                               spans as JSON
+  /api/serve_slo           -> per-deployment serve SLO attribution:
+                              sliding-window TTFT/TPOT/queue-wait
+                              p50/p95/p99 + engine sampler snapshots
+                              (empty when serve is not running)
   /api/profile?samples=    -> latest per-worker resource samples +
                               bounded history-ring p50/p95 summaries +
                               watchdog state (?samples=1 adds raw
@@ -241,6 +245,21 @@ class Dashboard:
                     "0", "false", "no", "off"):
                 req["poll"] = False
             return rt.core.client.call(req)
+        if parsed.path == "/api/serve_slo":
+            # Per-deployment SLO attribution (serve plane): sliding-
+            # window TTFT/TPOT/queue-wait percentiles + engine sampler
+            # snapshots, aggregated by the serve controller from the
+            # samples replicas piggyback on load reports.  Empty when
+            # serve is not running.
+            import ray_tpu
+            from ray_tpu.serve.controller import (CONTROLLER_NAME,
+                                                  SERVE_NAMESPACE)
+            try:
+                ctrl = ray_tpu.get_actor(CONTROLLER_NAME,
+                                         namespace=SERVE_NAMESPACE)
+                return ray_tpu.get(ctrl.serve_slo.remote(), timeout=10)
+            except Exception:  # noqa: BLE001 -> no controller yet
+                return {}
         if parsed.path == "/api/profile":
             # Latest per-worker resource samples (profile_report
             # deltas) + bounded history-ring percentile summaries +

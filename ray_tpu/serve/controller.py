@@ -11,6 +11,7 @@ routers/proxies over the long-poll host.
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -31,6 +32,15 @@ from ray_tpu.serve.long_poll import LongPollHost
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 SERVE_NAMESPACE = "serve"
 RECONCILE_PERIOD_S = 0.1
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an ascending list."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(math.ceil(q * len(sorted_vals))) - 1))
+    return float(sorted_vals[i])
 
 
 @dataclass
@@ -96,6 +106,11 @@ class ServeController:
         self._stopped = threading.Event()
         self._http = (http_host, http_port)
         self._proxy_handle = None
+        # Per-deployment SLO state, fed by the samples replicas
+        # piggyback on their load reports: (app, deployment) ->
+        # {"samples": deque of per-request dicts, "engine":
+        #  {replica_id: latest engine sampler snapshot}}.
+        self._slo: Dict[tuple, Dict[str, Any]] = {}
         self._loop = threading.Thread(
             target=self._reconcile_loop, name="serve-reconcile", daemon=True)
         self._loop.start()
@@ -564,6 +579,7 @@ class ServeController:
                         rep = ray_tpu.get(r.load_ref, timeout=1)
                         if isinstance(rep, dict):
                             r.last_load = rep
+                            self._fold_slo(tgt, rep)
                             changed = True
                     except Exception:  # raylint: allow-swallow(replica death is the health check's call; a failed probe leaves the old report to age out router-side)
                         pass
@@ -581,6 +597,66 @@ class ServeController:
                 if r.state == "RUNNING" and r.last_load is not None}
             self._poll.set(
                 f"load::{tgt.app_name}::{tgt.name}", reports)
+
+    def _fold_slo(self, tgt: DeploymentTarget, rep: dict):
+        """Lock held.  Fold a load report's piggybacked per-request SLO
+        samples into the deployment's sliding window and retain the
+        latest engine sampler snapshot per replica — the aggregation
+        side of /api/serve_slo, riding the existing probe (zero new
+        transport)."""
+        from collections import deque
+
+        key = (tgt.app_name, tgt.name)
+        st = self._slo.get(key)
+        if st is None:
+            st = self._slo[key] = {"samples": deque(maxlen=4096),
+                                   "engine": {}}
+        for s in rep.get("slo_samples") or ():
+            if isinstance(s, dict):
+                st["samples"].append(s)
+        es = rep.get("engine_sample")
+        if isinstance(es, dict):
+            st["engine"][str(rep.get("replica_id", "?"))] = es
+
+    def serve_slo(self) -> Dict[str, Any]:
+        """Per-deployment SLO attribution: sliding-window percentiles
+        (p50/p95/p99) of TTFT, TPOT and queue wait derived from the
+        samples replicas piggyback on their load reports, plus each
+        replica's latest engine sampler snapshot (batch occupancy,
+        prefill token spend, free KV pages).  The window is
+        RAY_TPU_SERVE_SLO_WINDOW_S seconds of wall clock."""
+        try:
+            window = float(os.environ.get(
+                "RAY_TPU_SERVE_SLO_WINDOW_S", "") or 300.0)
+        except ValueError:
+            window = 300.0
+        cutoff = time.time() - window
+        out: Dict[str, Any] = {}
+        with self._lock:
+            for (app, dep), st in self._slo.items():
+                samples = st["samples"]
+                # Samples arrive roughly time-ordered (probe cadence);
+                # age the window from the left.
+                while samples and samples[0].get("ts", 0.0) < cutoff:
+                    samples.popleft()
+                entry: Dict[str, Any] = {
+                    "window_s": window,
+                    "completed": sum(1 for s in samples if "ttft" in s),
+                    "shed": sum(1 for s in samples if "shed" in s),
+                    "engine": dict(st["engine"]),
+                }
+                for metric in ("ttft", "tpot", "queue_wait"):
+                    vals = sorted(s[metric] for s in samples
+                                  if metric in s)
+                    if vals:
+                        entry[metric] = {
+                            "p50": _pct(vals, 0.50),
+                            "p95": _pct(vals, 0.95),
+                            "p99": _pct(vals, 0.99),
+                            "mean": sum(vals) / len(vals),
+                            "count": len(vals)}
+                out[f"{app}/{dep}"] = entry
+        return out
 
     # -- publication ----------------------------------------------------
     def _publish_replicas(self, tgt: DeploymentTarget):

@@ -16,10 +16,13 @@ all three ingresses.
 from __future__ import annotations
 
 import json
+import time
 from typing import Iterator
 
 from ray_tpu.serve.proxy import (Request, _RouteTable, _STREAM_DISCONNECTS,
-                                 _STREAM_TOKENS)
+                                 _STREAM_TOKENS, mint_request_trace,
+                                 record_request_span)
+from ray_tpu.util import tracing
 
 _SERVICE = "ray_tpu.serve.ServeAPI"
 
@@ -99,12 +102,21 @@ class GrpcProxy(_RouteTable):
                                  error=f"no application at {req.route!r}")
         if req.method:
             handle = handle.options(method_name=req.method)
+        trace = mint_request_trace(dict(req.headers))
+        t0 = time.time()
+        if trace is not None:
+            handle = handle.options(trace_ctx=(trace[0], trace[2]))
         try:
             result = handle.remote(self._request_of(req)).result(
                 timeout_s=req.timeout_s or 60.0)
+            record_request_span(trace, t0, proxy="grpc",
+                                route=req.route or "/", method="GRPC")
             return pb.ServeReply(status=200, is_final=True,
                                  payload=json.dumps(result).encode())
         except Exception as e:  # noqa: BLE001 -> typed error frame
+            record_request_span(trace, t0, proxy="grpc",
+                                route=req.route or "/", method="GRPC",
+                                status="error")
             return pb.ServeReply(status=500,
                                  error=f"{type(e).__name__}: {e}")
 
@@ -122,25 +134,46 @@ class GrpcProxy(_RouteTable):
             return
         handle = handle.options(stream=True,
                                 method_name=req.method or None)
+        trace = mint_request_trace(dict(req.headers))
+        t0 = time.time()
+        if trace is not None:
+            handle = handle.options(trace_ctx=(trace[0], trace[2]))
         it = None
+        items = 0
+        status = "ok"
         try:
             gen = handle.remote(self._request_of(req))
             it = iter(gen)
+            t_deliver = time.time()
             for item in it:
                 yield pb.ServeReply(status=200,
                                     payload=json.dumps(item).encode())
                 _STREAM_TOKENS.inc(tags={"proxy": "grpc"})
+                items += 1
         except GeneratorExit:
             # Client cancelled the RPC mid-stream.
             _STREAM_DISCONNECTS.inc(tags={"proxy": "grpc"})
+            status = "cancelled"
             gen.cancel()
             if it is not None:
                 it.close()
             raise
         except Exception as e:  # noqa: BLE001
+            status = "error"
             yield pb.ServeReply(status=500, is_final=True,
                                 error=f"{type(e).__name__}: {e}")
             return
+        finally:
+            if trace is not None:
+                tracing.record_span(
+                    "serve.stream", t_deliver if it is not None else t0,
+                    time.time(),
+                    attributes={"items": items,
+                                "completed": status == "ok"},
+                    parent_id=trace[2], trace_id=trace[0], force=True)
+            record_request_span(trace, t0, proxy="grpc",
+                                route=req.route or "/", method="GRPC",
+                                status=status, items=items)
         yield pb.ServeReply(status=200, is_final=True)
 
     def _list_routes(self, req, context):

@@ -37,8 +37,14 @@ class DeploymentResponse:
         hex_id, actor = h._router().assign_replica(
             timeout_s=h._assign_timeout_s,
             model_id=h._multiplexed_model_id,
-            phase=h._phase, prefix_keys=h._prefix_hint)
+            phase=h._phase, prefix_keys=h._prefix_hint,
+            trace_id=h._trace_ctx[0] if h._trace_ctx else "")
         meta = {"multiplexed_model_id": h._multiplexed_model_id}
+        if h._trace_ctx:
+            # Request-journey context (trace_id, parent_span_id): rides
+            # the request meta so replica-side spans parent under the
+            # proxy's root span with zero extra wire traffic.
+            meta["trace_ctx"] = list(h._trace_ctx)
         ref = getattr(actor, "handle_request").remote(
             self._method, self._args, self._kwargs, meta)
         with self._lock:
@@ -103,7 +109,8 @@ class DeploymentResponseGenerator:
         hex_id, actor = h._router().assign_replica(
             timeout_s=h._assign_timeout_s,
             model_id=h._multiplexed_model_id,
-            phase=h._phase, prefix_keys=h._prefix_hint)
+            phase=h._phase, prefix_keys=h._prefix_hint,
+            trace_id=h._trace_ctx[0] if h._trace_ctx else "")
         self._assigned_hex = hex_id
         self._actor = actor
         self._released = False
@@ -114,6 +121,8 @@ class DeploymentResponseGenerator:
         self.stream_id = uuid.uuid4().hex
         meta = {"multiplexed_model_id": h._multiplexed_model_id,
                 "stream_id": self.stream_id}
+        if h._trace_ctx:
+            meta["trace_ctx"] = list(h._trace_ctx)
         self._gen = actor.handle_request_streaming.options(
             num_returns="streaming").remote(method, args, kwargs, meta)
 
@@ -178,6 +187,10 @@ class DeploymentHandle:
         # prefill by prefix locality.  Empty = today's routing.
         self._phase = ""
         self._prefix_hint: Optional[list] = None
+        # Request-journey trace context (trace_id, parent_span_id) set
+        # by the ingress proxies (or user code continuing a trace);
+        # None = untraced call, nothing extra rides the meta.
+        self._trace_ctx: Optional[tuple] = None
 
     def _router(self) -> Router:
         from ray_tpu.serve.api import _get_controller
@@ -190,7 +203,8 @@ class DeploymentHandle:
                 assign_timeout_s: Optional[float] = None,
                 stream: Optional[bool] = None,
                 phase: Optional[str] = None,
-                prefix_hint: Optional[list] = None
+                prefix_hint: Optional[list] = None,
+                trace_ctx: Optional[tuple] = None
                 ) -> "DeploymentHandle":
         h = DeploymentHandle(self.deployment_name, self.app_name,
                              method_name or self._method_name)
@@ -204,6 +218,8 @@ class DeploymentHandle:
         h._phase = self._phase if phase is None else phase
         h._prefix_hint = (self._prefix_hint if prefix_hint is None
                           else list(prefix_hint))
+        h._trace_ctx = (self._trace_ctx if trace_ctx is None
+                        else tuple(trace_ctx))
         return h
 
     def remote(self, *args, **kwargs):

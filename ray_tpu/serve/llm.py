@@ -14,6 +14,7 @@ Usage:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from ray_tpu.models import transformer as tfm
@@ -21,7 +22,21 @@ from ray_tpu.serve.deployment import deployment
 from ray_tpu.serve import llm_engine as _eng
 from ray_tpu.serve.llm_engine import (PrefixCache,
                                       RequestShed, _env_float, _env_int)
-from ray_tpu.util import flight_recorder
+from ray_tpu.util import flight_recorder, tracing
+
+
+def _request_trace() -> Optional[tuple]:
+    """(trace_id, parent_span_id) for the CURRENT replica call: the
+    request-journey context the ingress proxy minted, parented under
+    this replica call's pre-allocated span (replica.py _prepare_call),
+    so engine phase spans nest inside the replica leg.  None outside a
+    replica request, or when the call is untraced."""
+    from ray_tpu.serve.replica import _live_request_context
+
+    ctx = _live_request_context()
+    if ctx is None or ctx.trace_ctx is None:
+        return None
+    return (ctx.trace_ctx[0], ctx.span_id or ctx.trace_ctx[1])
 
 
 @deployment(name="llm_server")
@@ -121,12 +136,14 @@ class LLMServer:
     def _submit_and_wait(self, prompts: Sequence[Sequence[int]],
                          max_new_tokens: int, temperature: float
                          ) -> List[List[int]]:
+        trace = _request_trace()
         with self._cv:
             if self._engine_error is not None:
                 raise RuntimeError(
                     f"LLM engine failed: {self._engine_error}")
             ids = [self.engine.add_request(
-                list(p), max_new_tokens, temperature=temperature)
+                list(p), max_new_tokens, temperature=temperature,
+                trace_ctx=trace)
                 for p in prompts]
             self._cv.notify_all()
             return self._wait_locked(ids)
@@ -173,13 +190,14 @@ class LLMServer:
         import ray_tpu
 
         prompt = list(prompt_tokens)
+        trace = _request_trace()
         with self._cv:
             if self._engine_error is not None:
                 raise RuntimeError(
                     f"LLM engine failed: {self._engine_error}")
             rid = self.engine.add_request(
                 prompt, 1, temperature=temperature,
-                export_on_finish=True)
+                export_on_finish=True, trace_ctx=trace)
             self._cv.notify_all()
             toks = self._wait_locked([rid])[0]
             bundle = self.engine.kv_ready.pop(rid, None)
@@ -195,12 +213,20 @@ class LLMServer:
                                              [prompt], max_new_tokens,
                                              temperature)[0])
             return self._done_bundle(rid, prompt, toks)
+        if trace is not None:
+            # Cross-replica linkage: the decode replica parents its
+            # handoff-pull span under THIS prefill leg's replica span,
+            # stitching the two legs into one request-journey trace.
+            bundle["trace"] = [trace[0], trace[1]]
         if not ray_tpu.is_initialized():
             return bundle
         ref = ray_tpu.put(bundle)
         self._export_ring.append(ref)
         size = int(bundle["k"].nbytes + bundle["v"].nbytes)
-        return {"op": "serve_kv_import", "obj": ref._hex, "size": size}
+        out = {"op": "serve_kv_import", "obj": ref._hex, "size": size}
+        if trace is not None:
+            out["trace"] = [trace[0], trace[1]]
+        return out
 
     def decode_from(self, prompt_tokens: Sequence[int],
                     kv: Dict[str, Any],
@@ -218,7 +244,15 @@ class LLMServer:
         prompt = list(prompt_tokens)
         bundle: Any = kv
         reason: Optional[str] = None
+        trace = _request_trace()
+        # Trace linkage carried IN the handoff payload: [trace_id,
+        # prefill_replica_span_id].  The pull span parents under the
+        # prefill leg, so the two replicas' spans stitch into one
+        # request journey with no side-channel.
+        link = (list(kv["trace"]) if isinstance(kv, dict)
+                and kv.get("trace") else None)
         if isinstance(kv, dict) and kv.get("op") == "serve_kv_import":
+            t_pull = time.time()
             try:
                 import ray_tpu
                 from ray_tpu.core.ids import ObjectID
@@ -230,6 +264,20 @@ class LLMServer:
                     "RAY_TPU_SERVE_HANDOFF_TIMEOUT_S", 30.0))
             except Exception:  # noqa: BLE001
                 bundle, reason = None, "pull_failed"
+            if isinstance(bundle, dict) and bundle.get("trace"):
+                link = list(bundle["trace"])
+            if link or trace:
+                anchor = link or [trace[0], trace[1]]
+                tracing.record_span(
+                    "serve.handoff_pull", t_pull, time.time(),
+                    attributes={"bytes": int(kv.get("size") or 0),
+                                "ok": reason is None,
+                                "clock_off": round(
+                                    tracing.clock_offset(), 6)},
+                    parent_id=anchor[1] or None, trace_id=anchor[0],
+                    force=True)
+        elif isinstance(bundle, dict) and bundle.get("trace"):
+            link = list(bundle["trace"])
         if isinstance(bundle, dict) and bundle.get("done") is not None:
             return list(bundle["done"])
         rid = None
@@ -240,7 +288,9 @@ class LLMServer:
                         raise RuntimeError(
                             f"LLM engine failed: {self._engine_error}")
                     rid = self.engine.import_kv(
-                        bundle, max_new_tokens, temperature=temperature)
+                        bundle, max_new_tokens, temperature=temperature,
+                        trace_ctx=trace or (tuple(link) if link
+                                            else None))
                     self._cv.notify_all()
             except (ValueError, TypeError, KeyError):
                 # Malformed/incompatible bundle (SchemaError is a
@@ -274,13 +324,16 @@ class LLMServer:
 
         ctx = _live_request_context()
         cancel = ctx.cancel_event if ctx is not None else None
+        trace = None
+        if ctx is not None and ctx.trace_ctx is not None:
+            trace = (ctx.trace_ctx[0], ctx.span_id or ctx.trace_ctx[1])
         with self._cv:
             if self._engine_error is not None:
                 raise RuntimeError(
                     f"LLM engine failed: {self._engine_error}")
             rid = self.engine.add_request(
                 list(prompt_tokens), max_new_tokens,
-                temperature=temperature)
+                temperature=temperature, trace_ctx=trace)
             req = next(r for r in self.engine.waiting
                        if r.req_id == rid)
             self._cv.notify_all()
@@ -348,6 +401,15 @@ class LLMServer:
                     "keys": eng.prefix_cache.digest(
                         _env_int("RAY_TPU_SERVE_DIGEST_K", 16)),
                 }
+            if eng.slo_samples:
+                # Drain the per-request SLO ring: samples ride the load
+                # report exactly once, to the controller's sliding
+                # windows (serve_slo / /api/serve_slo).
+                samples = list(eng.slo_samples)
+                eng.slo_samples.clear()
+                out["slo_samples"] = samples
+            if eng.engine_sample is not None:
+                out["engine_sample"] = eng.engine_sample
             return out
 
     def __del__(self):
@@ -392,12 +454,20 @@ class DisaggLLMClient:
 
     def generate(self, prompt_tokens: Sequence[int],
                  max_new_tokens: int = 32,
-                 temperature: float = 0.0) -> List[int]:
+                 temperature: float = 0.0, *,
+                 trace_ctx: Optional[tuple] = None) -> List[int]:
         prompt = list(prompt_tokens)
+        # Request-journey threading across BOTH legs: explicit
+        # trace_ctx wins; otherwise inherit the live replica request's
+        # context (composition: an ingress deployment driving this
+        # client), so prefill and decode replica spans share one trace.
+        trace = trace_ctx or _request_trace()
         kv = None
         try:
             h = self.prefill.options(
                 phase="prefill", prefix_hint=self._prefix_hint(prompt))
+            if trace is not None:
+                h = h.options(trace_ctx=trace)
             kv = h.prefill_only.remote(
                 prompt, max_new_tokens, temperature).result(
                     timeout_s=self.timeout_s)
@@ -409,12 +479,18 @@ class DisaggLLMClient:
             flight_recorder.record("serve", "handoff_fallback",
                                    reason="prefill_failed", req=-1)
         if kv is None:
-            return self.decode.generate.remote(
+            h = self.decode
+            if trace is not None:
+                h = h.options(trace_ctx=trace)
+            return h.generate.remote(
                 prompt, max_new_tokens, temperature).result(
                     timeout_s=self.timeout_s)
         if isinstance(kv, dict) and kv.get("done") is not None:
             return list(kv["done"])
         self.handoffs += 1
-        return self.decode.options(phase="decode").decode_from.remote(
+        h = self.decode.options(phase="decode")
+        if trace is not None:
+            h = h.options(trace_ctx=trace)
+        return h.decode_from.remote(
             prompt, kv, max_new_tokens, temperature).result(
                 timeout_s=self.timeout_s)

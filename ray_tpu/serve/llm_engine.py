@@ -27,8 +27,8 @@ import numpy as np
 
 from ray_tpu.models import transformer as tfm
 from ray_tpu.models.decoding import decode_step, init_kv_pages, prefill
-from ray_tpu.util import flight_recorder
-from ray_tpu.util.metrics import Counter, Gauge
+from ray_tpu.util import flight_recorder, tracing
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 _REQUESTS = Counter(
     "ray_tpu_serve_requests_total",
@@ -52,6 +52,18 @@ _HANDOFF_FALLBACK = Counter(
     "ray_tpu_serve_handoff_fallback_total",
     "Handoffs that fell back to re-prefill on the decode replica.",
     tag_keys=("reason",))
+_QUEUE_WAIT = Histogram(
+    "ray_tpu_serve_queue_wait_seconds",
+    "Time a request spent in the engine admission queue, observed on "
+    "EVERY outcome: admitted into a slot, or shed while waiting.",
+    tag_keys=("outcome",))
+_TTFT = Histogram(
+    "ray_tpu_serve_ttft_seconds",
+    "Time to first generated token (enqueue to first token).")
+_TPOT = Histogram(
+    "ray_tpu_serve_tpot_seconds",
+    "Mean inter-token time after the first generated token.",
+    boundaries=(0.0001, 0.001, 0.01, 0.1, 1.0, 10.0))
 
 
 class QueueFull(RuntimeError):
@@ -245,6 +257,20 @@ class _Request:
     # its KV pages are exported into kv_ready BEFORE the pages are
     # freed, so the bundle capture cannot race the engine thread.
     export_on_finish: bool = False
+    # Request-journey trace context (trace_id, parent_span_id) threaded
+    # from the ingress proxy via the replica call; phase spans
+    # (serve.queue/prefill/decode) parent under it.  None = untraced.
+    trace_ctx: Optional[tuple] = None
+    # Phase timeline, epoch seconds (0.0 = not reached): enqueue into
+    # the waiting queue, seated into a slot, first generated token.
+    # The derived SLO sample (TTFT/TPOT/queue-wait) folds into
+    # slo_samples at finish.
+    t_enqueue: float = 0.0
+    t_admit: float = 0.0
+    t_first: float = 0.0
+    # Whether the request resumed from an imported KV bundle (its
+    # admission phase is a page splice, not a prefill).
+    imported: bool = False
 
 
 class LLMEngine:
@@ -375,6 +401,21 @@ class LLMEngine:
         # ({req_id: reason}); serve/llm.py fails the matching waiters.
         self.shed: Dict[int, str] = {}
         self._step_prefill_left = 1 << 30
+        # Per-request SLO samples (TTFT/TPOT/queue-wait), appended at
+        # finish (queue-wait-only at shed) and drained by stats() ->
+        # load_report -> controller sliding windows (/api/serve_slo).
+        from collections import deque
+
+        self.slo_samples: deque = deque(maxlen=max(
+            1, _env_int("RAY_TPU_SERVE_SLO_SAMPLES", 256)))
+        # Low-overhead per-step sampler: every Nth step snapshots batch
+        # occupancy, queue depth, free KV pages and the previous step's
+        # prefill-token spend into engine_sample (0 disables).  One
+        # small dict assignment — no device sync, no allocation scan.
+        self._sample_every = _env_int(
+            "RAY_TPU_SERVE_STEP_SAMPLE_EVERY", 8)
+        self._step_count = 0
+        self.engine_sample: Optional[Dict[str, Any]] = None
 
     # -- public API --------------------------------------------------------
     def add_request(self, prompt_tokens: Sequence[int],
@@ -382,7 +423,8 @@ class LLMEngine:
                     temperature: float = 0.0,
                     eos_token: Optional[int] = None,
                     deadline_s: Optional[float] = None,
-                    export_on_finish: bool = False) -> int:
+                    export_on_finish: bool = False,
+                    trace_ctx: Optional[tuple] = None) -> int:
         if not prompt_tokens:
             raise ValueError("prompt must contain at least one token")
         if max_new_tokens < 1:
@@ -417,6 +459,9 @@ class LLMEngine:
         req = _Request(self._next_id, list(prompt_tokens), max_new_tokens,
                        temperature, eos_token=eos_token,
                        export_on_finish=export_on_finish)
+        if trace_ctx:
+            req.trace_ctx = tuple(trace_ctx)
+        req.t_enqueue = time.time()
         req.enqueued_at = time.monotonic()
         ttl = self.queue_timeout_s if deadline_s is None else deadline_s
         if ttl and ttl > 0:
@@ -539,7 +584,8 @@ class LLMEngine:
                   max_new_tokens: int = 32, *,
                   temperature: float = 0.0,
                   eos_token: Optional[int] = None,
-                  deadline_s: Optional[float] = None) -> int:
+                  deadline_s: Optional[float] = None,
+                  trace_ctx: Optional[tuple] = None) -> int:
         """Enqueue a request resuming from an exported KV bundle — the
         decode side of the prefill->decode handoff.  Mirrors
         add_request's admission contract (bounds checks, QueueFull
@@ -606,9 +652,13 @@ class LLMEngine:
                        temperature, generated=generated,
                        eos_token=eos_token)
         req.kv_bundle = bundle
+        req.imported = True
+        if trace_ctx:
+            req.trace_ctx = tuple(trace_ctx)
         keys = bundle.get("chain_keys")
         if keys:
             req.chain_keys = [bytes(k) for k in keys]
+        req.t_enqueue = time.time()
         req.enqueued_at = time.monotonic()
         ttl = self.queue_timeout_s if deadline_s is None else deadline_s
         if ttl and ttl > 0:
@@ -627,10 +677,91 @@ class LLMEngine:
         self.num_shed += 1
         self.shed[req.req_id] = reason
         _SHED.inc(tags={"reason": reason})
+        now = time.time()
+        waited = (time.monotonic() - req.enqueued_at
+                  if req.enqueued_at else 0.0)
+        # Queue wait is observed on EVERY outcome — sheds included —
+        # so the histogram reflects what waiting requests experienced,
+        # not just the survivors.
+        _QUEUE_WAIT.observe(max(0.0, waited), tags={"outcome": "shed"})
+        self.slo_samples.append({
+            "queue_wait": round(max(0.0, waited), 6),
+            "shed": reason, "ts": now})
+        if req.trace_ctx is not None:
+            # Partial timeline: a shed request still leaves its queue
+            # phase in the trace (end attribute says why it ended).
+            tracing.record_span(
+                "serve.queue", req.t_enqueue or now - waited, now,
+                attributes={"req": req.req_id, "shed": reason,
+                            "clock_off": round(tracing.clock_offset(),
+                                               6)},
+                parent_id=req.trace_ctx[1] or None,
+                trace_id=req.trace_ctx[0], force=True)
         flight_recorder.record(
             "serve", "shed", req_id=req.req_id, reason=reason,
-            waited_s=round(time.monotonic() - req.enqueued_at, 3)
-            if req.enqueued_at else 0.0)
+            waited_s=round(waited, 3) if req.enqueued_at else 0.0)
+
+    def _note_admitted(self, req: _Request) -> None:
+        """Seat-time bookkeeping shared by every admission path
+        (classic _admit, KV import, packed wave): the queue-wait
+        histogram plus the serve.queue phase span of traced requests."""
+        now = time.time()
+        req.t_admit = now
+        waited = (time.monotonic() - req.enqueued_at
+                  if req.enqueued_at else 0.0)
+        _QUEUE_WAIT.observe(max(0.0, waited),
+                            tags={"outcome": "admitted"})
+        if req.trace_ctx is not None:
+            tracing.record_span(
+                "serve.queue", req.t_enqueue or now - waited, now,
+                attributes={"req": req.req_id,
+                            "clock_off": round(tracing.clock_offset(),
+                                               6)},
+                parent_id=req.trace_ctx[1] or None,
+                trace_id=req.trace_ctx[0], force=True)
+
+    def _stamp_first(self, req: _Request) -> None:
+        """First generated token (or KV splice done): closes the
+        prefill/import phase.  Idempotent — every path that appends a
+        first token calls it."""
+        if req.t_first:
+            return
+        req.t_first = time.time()
+        if req.trace_ctx is not None and req.t_admit:
+            tracing.record_span(
+                "serve.import" if req.imported else "serve.prefill",
+                req.t_admit, req.t_first,
+                attributes={"req": req.req_id,
+                            "prompt_tokens": len(req.prompt)},
+                parent_id=req.trace_ctx[1] or None,
+                trace_id=req.trace_ctx[0], force=True)
+
+    def _note_finished(self, req: _Request) -> None:
+        """Finish-time SLO accounting: TTFT/TPOT histograms, the SLO
+        sample ring (controller sliding windows fold it), and the
+        decode phase span of traced requests."""
+        now = time.time()
+        if not req.t_first:
+            req.t_first = now
+        ttft = (max(0.0, req.t_first - req.t_enqueue)
+                if req.t_enqueue else 0.0)
+        n_out = len(req.generated)
+        tpot = (max(0.0, now - req.t_first) / (n_out - 1)
+                if n_out > 1 else 0.0)
+        qwait = (max(0.0, (req.t_admit or req.t_first) - req.t_enqueue)
+                 if req.t_enqueue else 0.0)
+        _TTFT.observe(ttft)
+        _TPOT.observe(tpot)
+        self.slo_samples.append({
+            "ttft": round(ttft, 6), "tpot": round(tpot, 6),
+            "queue_wait": round(qwait, 6), "tokens": n_out, "ts": now})
+        if req.trace_ctx is not None:
+            tracing.record_span(
+                "serve.decode", req.t_first, now,
+                attributes={"req": req.req_id, "tokens": n_out,
+                            "tpot": round(tpot, 6)},
+                parent_id=req.trace_ctx[1] or None,
+                trace_id=req.trace_ctx[0], force=True)
 
     def _shed_expired(self) -> None:
         """Deadline-based shedding: drop waiting requests whose
@@ -668,6 +799,26 @@ class LLMEngine:
         if self._pending_done:
             done.update(self._pending_done)
             self._pending_done.clear()
+        self._step_count += 1
+        if self._sample_every > 0 \
+                and self._step_count % self._sample_every == 0:
+            # Snapshot BEFORE this step's work: _step_prefill_left still
+            # holds the previous step's remainder, so prefill_tokens is
+            # that step's actual prompt-token spend.
+            budget = (self.prefill_budget
+                      if self.prefill_budget > 0 else 0)
+            self.engine_sample = {
+                "ts": time.time(),
+                "step": self._step_count,
+                "active": self.num_active,
+                "waiting": len(self.waiting),
+                "free_pages": self.allocator.num_free,
+                "inflight_chunks": len(self._inflight),
+                "prefill_tokens": (
+                    max(0, budget - min(self._step_prefill_left,
+                                        budget)) if budget else 0),
+                "completed": self.num_completed,
+            }
         self._shed_expired()
         # Per-step prefill token budget: admission (classic _admit and
         # packed waves) may spend at most this many prompt tokens per
@@ -811,6 +962,7 @@ class LLMEngine:
             self._step_prefill_left = max(
                 0, self._step_prefill_left - n_suffix)
             self.waiting.pop(0)
+            self._note_admitted(req)
             slot = free.pop(0)
             req.slot = slot
             req.pages = self._alloc_evicting(n_private)
@@ -910,6 +1062,7 @@ class LLMEngine:
         self.context_lens[req.slot] = L
         self.last_tokens[req.slot] = next_tok
         req.generated.append(int(next_tok))
+        self._stamp_first(req)
         self._just_admitted.add(req.slot)  # pipelined path merges it in
         fin = self._maybe_finish(req)
         if fin is not None:  # e.g. max_new_tokens == 1
@@ -953,6 +1106,7 @@ class LLMEngine:
                 req.cache_keys = []
             return False
         self.waiting.pop(0)
+        self._note_admitted(req)
         slot = free.pop(0)
         req.slot = slot
         req.pages = self._alloc_evicting(n_private)
@@ -999,6 +1153,7 @@ class LLMEngine:
 
         self.context_lens[slot] = ctx
         self.last_tokens[slot] = req.generated[-1]
+        self._stamp_first(req)  # splice done; tokens already exist
         self._just_admitted.add(slot)
         self.kv_imports += 1
         _KV_HANDOFF.inc(tags={"direction": "import"})
@@ -1086,6 +1241,7 @@ class LLMEngine:
             if total > self._available_pages():
                 break  # backpressure: wait for pages
             self.waiting.pop(0)
+            self._note_admitted(req)
             req.slot = free.pop(0)
             req.pages = self._alloc_evicting(total)
             if self.prefix_cache is not None and req.chain_keys:
@@ -1289,6 +1445,7 @@ class LLMEngine:
                 # decoding from last_tokens.
                 self.last_tokens[req.slot] = tok
                 req.generated.append(tok)
+                self._stamp_first(req)
                 fin = self._maybe_finish(req)
                 if fin is not None:
                     done[req.req_id] = fin
@@ -1520,6 +1677,7 @@ class LLMEngine:
                     # Shared/registered prompt pages stay cached
                     # (evictable once unreferenced).
                     self.prefix_cache.release(req.cache_keys)
+            self._note_finished(req)
             self.num_completed += 1
             return req.generated
         self.slot_req[req.slot] = req

@@ -28,6 +28,7 @@ from typing import Dict, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 import ray_tpu
+from ray_tpu.util import tracing
 from ray_tpu.util.metrics import Counter
 
 LISTEN_TIMEOUT_S = 10.0
@@ -50,6 +51,43 @@ def _hget(headers: Dict[str, str], name: str, default: str = "") -> str:
         if k.lower() == name:
             return v
     return default
+
+
+def mint_request_trace(headers: Dict[str, str]):
+    """Request-journey trace context for one ingress request: adopt the
+    incoming ``X-Serve-Trace`` header (``<trace_id>[:<span_id>]``) or
+    mint a fresh trace.  Returns (trace_id, parent_span_id,
+    root_span_id) — the root span id is pre-allocated so every
+    downstream span (replica, engine phases) can parent under it before
+    the root itself is recorded at request end — or None when
+    RAY_TPU_SERVE_TRACE is off.  Shared by the HTTP, gRPC and frame
+    ingresses so all three speak the same header."""
+    if not tracing.serve_trace_enabled():
+        return None
+    trace_id, parent = tracing.mint_serve_trace(
+        _hget(headers, "x-serve-trace"))
+    return (trace_id, parent, tracing.new_span_id())
+
+
+def record_request_span(trace, start: float, *, proxy: str, route: str,
+                        method: str, status: str = "ok",
+                        items: int = 0) -> None:
+    """Record the root ``serve.request`` span for one ingress request
+    (forced: the proxy process need not have global tracing enabled —
+    the serve gate already said yes).  The per-process clock offset
+    rides along so offline reassembly can align monotonic-stamped
+    engine data with these wall-clock spans."""
+    if trace is None:
+        return
+    trace_id, parent, root_id = trace
+    attrs = {"proxy": proxy, "route": route, "method": method,
+             "status": status,
+             "clock_off": round(tracing.clock_offset(), 6)}
+    if items:
+        attrs["items"] = items
+    tracing.record_span("serve.request", start, time.time(),
+                        attributes=attrs, parent_id=parent or None,
+                        trace_id=trace_id, span_id=root_id, force=True)
 
 
 class Request:
@@ -313,25 +351,36 @@ class HTTPProxy(_RouteTable):
 
         handle = DeploymentHandle(ingress, app)
         req = Request(method, path, parse_qs(parsed.query), body, headers)
-        if is_asgi:
-            # ASGI ingress: the replica streams response events
-            # (serve/asgi.py); render them as real HTTP, chunked so
-            # streaming responses flush as the app sends.
-            return await self._dispatch_asgi(writer, handle, req)
-        if self._wants_stream(headers):
-            return await self._dispatch_streaming(writer, handle, req)
+        trace = mint_request_trace(headers)
+        t0 = time.time()
+        if trace is not None:
+            handle = handle.options(trace_ctx=(trace[0], trace[2]))
+        status = "ok"
         try:
-            result = await self._call_async(handle, req)
-        except Exception as e:  # noqa: BLE001
-            self._write_response(writer, 500, json.dumps(
-                {"error": str(e)}).encode())
-            return await writer.drain()
-        try:
-            payload = json.dumps(result).encode()
-        except (TypeError, ValueError):  # unserializable / circular
-            payload = json.dumps(str(result)).encode()
-        self._write_response(writer, 200, payload)
-        await writer.drain()
+            if is_asgi:
+                # ASGI ingress: the replica streams response events
+                # (serve/asgi.py); render them as real HTTP, chunked so
+                # streaming responses flush as the app sends.
+                return await self._dispatch_asgi(writer, handle, req)
+            if self._wants_stream(headers):
+                return await self._dispatch_streaming(
+                    writer, handle, req, trace=trace)
+            try:
+                result = await self._call_async(handle, req)
+            except Exception as e:  # noqa: BLE001
+                status = "error"
+                self._write_response(writer, 500, json.dumps(
+                    {"error": str(e)}).encode())
+                return await writer.drain()
+            try:
+                payload = json.dumps(result).encode()
+            except (TypeError, ValueError):  # unserializable / circular
+                payload = json.dumps(str(result)).encode()
+            self._write_response(writer, 200, payload)
+            await writer.drain()
+        finally:
+            record_request_span(trace, t0, proxy="http", route=path,
+                                method=method, status=status)
 
     async def _call_async(self, handle, req,
                           timeout_s: float = DATA_PLANE_TIMEOUT_S):
@@ -468,7 +517,8 @@ class HTTPProxy(_RouteTable):
         await writer.drain()
 
     async def _dispatch_streaming(self, writer, handle, req,
-                                  timeout_s: float = DATA_PLANE_TIMEOUT_S):
+                                  timeout_s: float = DATA_PLANE_TIMEOUT_S,
+                                  trace=None):
         """Chunked transfer, flushed per yielded item (the reference's
         streaming ASGI responses; token streaming for LLM chat):
         ``Accept: text/event-stream`` gets SSE ``data:`` frames ending
@@ -495,6 +545,7 @@ class HTTPProxy(_RouteTable):
 
         state = {"i": 0, "eos_consumed": False}
         completed = False
+        t_deliver = time.time()
         try:
             async for item in _astream_values(gen.task_id, state):
                 writer.write(_frame(json.dumps(item)))
@@ -512,6 +563,14 @@ class HTTPProxy(_RouteTable):
             writer.write(_frame(json.dumps({"error": str(e)})))
         finally:
             gen._release()
+            if trace is not None:
+                # Delivery phase of the request journey: first flushed
+                # frame to stream end (parented under serve.request).
+                tracing.record_span(
+                    "serve.stream", t_deliver, time.time(),
+                    attributes={"items": state["i"],
+                                "completed": completed, "sse": sse},
+                    parent_id=trace[2], trace_id=trace[0], force=True)
             # Free whatever this consumer will never read (finished
             # streams only — a cancelled generator winds down replica-
             # side and its tail items are reclaimed at teardown).
@@ -631,8 +690,20 @@ class FrameProxy(_RouteTable):
         from ray_tpu.serve.handle import DeploymentHandle
 
         handle = DeploymentHandle(ingress, app)
+        headers = dict(msg.get("headers") or {})
         req = Request("FRAME", route, {},
-                      json.dumps(msg.get("payload")).encode(),
-                      dict(msg.get("headers") or {}))
-        return handle.remote(req).result(
-            timeout_s=float(msg.get("timeout_s", 60)))
+                      json.dumps(msg.get("payload")).encode(), headers)
+        trace = mint_request_trace(headers)
+        t0 = time.time()
+        if trace is not None:
+            handle = handle.options(trace_ctx=(trace[0], trace[2]))
+        status = "ok"
+        try:
+            return handle.remote(req).result(
+                timeout_s=float(msg.get("timeout_s", 60)))
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            record_request_span(trace, t0, proxy="frame", route=route,
+                                method="FRAME", status=status)

@@ -14,6 +14,7 @@ from typing import Any, Dict, Optional
 import cloudpickle
 
 from ray_tpu.serve.deployment import HandleMarker, make_callable
+from ray_tpu.util import tracing
 
 _replica_context = threading.local()
 
@@ -33,7 +34,8 @@ class RequestContext:
     """Per-request metadata (thread-local inside the replica)."""
 
     def __init__(self, multiplexed_model_id: str = "",
-                 route: str = "", stream_id: str = ""):
+                 route: str = "", stream_id: str = "",
+                 trace_ctx=None):
         self.multiplexed_model_id = multiplexed_model_id
         self.route = route
         # Streaming cancellation: proxies mint a stream_id per streaming
@@ -46,6 +48,13 @@ class RequestContext:
         # appended by @serve.multiplexed getters); released when the
         # request finishes so the LRU never evicts an in-use model.
         self.model_pins: list = []
+        # Request-journey trace context (trace_id, parent_span_id) from
+        # the ingress proxy (handle meta); span_id is this replica
+        # call's own pre-allocated span so user code (LLMServer) can
+        # parent engine phase spans under it before it is recorded.
+        self.trace_ctx: Optional[tuple] = (
+            tuple(trace_ctx) if trace_ctx else None)
+        self.span_id: str = ""
 
 
 def get_request_context() -> RequestContext:
@@ -123,6 +132,10 @@ class Replica:
         ctx = RequestContext(**(request_meta or {}))
         if ctx.stream_id:
             ctx.cancel_event = self._stream_event(ctx.stream_id)
+        if ctx.trace_ctx is not None:
+            ctx.span_id = tracing.new_span_id()
+            ctx._span_start = time.time()
+            ctx._span_method = method
         _replica_context.request = ctx
         # Resolve the target BEFORE counting the request: a bad method
         # name must not inflate _ongoing with no matching decrement
@@ -142,6 +155,20 @@ class Replica:
             for cache, model_id in ctx.model_pins:
                 cache.unpin(model_id)
             ctx.model_pins = []
+            if ctx.trace_ctx is not None and ctx.span_id:
+                # The replica leg of the request journey: recorded into
+                # this process's span ring (forced — the cluster harvest
+                # carries it off regardless of the local tracing flag).
+                tracing.record_span(
+                    "serve.replica", ctx._span_start, time.time(),
+                    attributes={
+                        "deployment": self._deployment_name,
+                        "replica": self._replica_id,
+                        "method": ctx._span_method,
+                        "clock_off": round(tracing.clock_offset(), 6)},
+                    parent_id=ctx.trace_ctx[1] or None,
+                    trace_id=ctx.trace_ctx[0],
+                    span_id=ctx.span_id, force=True)
 
     def _stream_event(self, stream_id: str) -> threading.Event:
         with self._lock:
@@ -243,6 +270,18 @@ class Replica:
                     # the router prefix-matches request hints against
                     # it for prefill locality.
                     report["prefix_digest"] = extra["prefix_digest"]
+                if extra.get("slo_samples"):
+                    # Per-request SLO samples (TTFT/TPOT/queue-wait),
+                    # drained from the engine's ring: the controller
+                    # folds them into per-deployment sliding windows
+                    # (serve_slo / /api/serve_slo).  Piggybacks the
+                    # existing probe — zero new transport.
+                    report["slo_samples"] = extra["slo_samples"]
+                if "engine_sample" in extra:
+                    # Latest per-step engine sampler aggregate (batch
+                    # occupancy, prefill/decode token split, free KV
+                    # pages).
+                    report["engine_sample"] = extra["engine_sample"]
         return report
 
     def health_check(self) -> str:

@@ -209,7 +209,8 @@ class Router:
 
     def assign_replica(self, timeout_s: float = 30.0,
                        model_id: str = "", phase: str = "",
-                       prefix_keys: Optional[List[str]] = None) -> tuple:
+                       prefix_keys: Optional[List[str]] = None,
+                       trace_id: str = "") -> tuple:
         """Pick a replica (pow-2 by local in-flight + fresh load
         feedback), respecting max_ongoing backpressure; returns
         (actor_hex, handle).  model_id biases the choice toward
@@ -279,7 +280,11 @@ class Router:
                         replica=hex_id[:12], feedback=bool(fresh),
                         affinity=affine, phase=phase,
                         locality=locality, degraded=degraded,
-                        inflight=s.inflight[hex_id])
+                        inflight=s.inflight[hex_id],
+                        # Request-journey correlation: the routing
+                        # decision joins the trace's span timeline
+                        # through the flight lane (empty = untraced).
+                        trace=trace_id)
                     return hex_id, s.handles[hex_id]
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:

@@ -239,6 +239,83 @@ def set_task_ctx(trace_ctx: Tuple[str, str]) -> str:
 
 
 # ---------------------------------------------------------------------------
+# Serve request-journey support: wall/monotonic alignment + trace gate
+# ---------------------------------------------------------------------------
+
+# Captured ONCE at import: adding this to a time.monotonic() reading
+# yields the epoch time the reading corresponds to in THIS process.
+# Recomputing per call would jitter by scheduler noise; a fixed offset
+# keeps one request's spans self-consistent even if NTP steps the wall
+# clock mid-run.
+_CLOCK_OFFSET = time.time() - time.monotonic()
+
+
+def clock_offset() -> float:
+    """This process's monotonic→epoch offset (epoch = monotonic +
+    offset).  Stamped into serve span/timeline records so lanes from
+    two replicas (two processes, two monotonic origins) line up when a
+    trace is reassembled offline (scripts/opsdump.py, Perfetto)."""
+    return _CLOCK_OFFSET
+
+
+def mono_to_epoch(t_mono: float) -> float:
+    """Convert a time.monotonic() reading from THIS process to epoch
+    seconds (comparable across processes, same basis as span times)."""
+    return t_mono + _CLOCK_OFFSET
+
+
+def serve_trace_enabled() -> bool:
+    """Request-journey tracing gate for the serve data plane
+    (RAY_TPU_SERVE_TRACE, default on).  Read per request — an env read
+    is nanoseconds next to a model step — so the paired overhead bench
+    (scripts/bench_serve.py tracing phase) can flip it between arms
+    without rebuilding the serving stack."""
+    return os.environ.get("RAY_TPU_SERVE_TRACE", "1").strip().lower() \
+        not in ("0", "false", "no", "off")
+
+
+def parse_serve_trace(header: str) -> Optional[Tuple[str, str]]:
+    """Parse an X-Serve-Trace header value — ``<trace_id>`` or
+    ``<trace_id>:<span_id>`` (16 hex chars each) — into a
+    (trace_id, parent_span_id) context; malformed values are ignored
+    (the proxy mints a fresh trace instead of propagating garbage)."""
+    if not header or not isinstance(header, str):
+        return None
+    trace_id, _, span_id = header.strip().partition(":")
+    if not _is_hex_id(trace_id):
+        return None
+    if span_id and not _is_hex_id(span_id):
+        span_id = ""
+    return (trace_id.lower(), span_id.lower())
+
+
+def _is_hex_id(s: str) -> bool:
+    if len(s) != 16:
+        return False
+    try:
+        int(s, 16)
+        return True
+    except ValueError:
+        return False
+
+
+def mint_serve_trace(header: str = "") -> Tuple[str, str]:
+    """Adopt the incoming X-Serve-Trace context or mint a fresh one.
+    Returns (trace_id, parent_span_id); parent is "" for a new trace."""
+    ctx = parse_serve_trace(header)
+    if ctx is not None:
+        return ctx
+    return (_new_id(), "")
+
+
+def new_span_id() -> str:
+    """A fresh 16-hex span id (public alias of the internal minting —
+    serve layers pre-allocate ids so children can parent under a span
+    that is recorded later, when it completes)."""
+    return _new_id()
+
+
+# ---------------------------------------------------------------------------
 # Introspection / export
 # ---------------------------------------------------------------------------
 

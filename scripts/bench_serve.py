@@ -29,6 +29,11 @@ replicas run (serve/llm_engine.py):
                    the ratio near 1: admission work interleaves in
                    bounded chunks instead of stalling live slots for a
                    full wave.
+  tracing_overhead paired tracing-on/off rows: the same workload with
+                   and without a request-journey trace context on every
+                   request (queue/prefill/decode phase spans recorded
+                   into the in-process ring).  The tok/s delta is the
+                   cost of the observability path; tests pin it small.
   disaggregated    (--disagg) paired mixed-vs-disaggregated rows: the
                    same interference workload with the prefill stream
                    on a separate engine (decode TPOT on the decode
@@ -268,6 +273,57 @@ def run_prefill_interference(config, shape):
     }
 
 
+def run_tracing_overhead(config, shape):
+    """Paired tracing-on/off rows: the identical workload driven twice
+    on fresh engines, once with a request-journey trace context on
+    every request (phase spans recorded into the in-process ring) and
+    once without.  Best-of-3 per arm to shave scheduler noise; the
+    journey instrumentation is a handful of ring appends per request
+    plus a sampled per-step snapshot, so the tok/s delta must stay
+    small (the committed threshold is pinned by tests)."""
+    from ray_tpu.util import tracing
+
+    rng = np.random.default_rng(4)
+    n = max(64, 4 * shape["max_batch"])
+    prompts = [rng.integers(1, config.vocab_size,
+                            shape["prompt_len"]).tolist()
+               for _ in range(n)]
+
+    def _arm(traced):
+        eng = _mk_engine(config, shape)
+        _warmup(eng, config, shape, rng)
+        tracing.clear_spans()
+        t0 = time.perf_counter()
+        ids = []
+        for i, p in enumerate(prompts):
+            ctx = (f"{i:016x}", f"{i:016x}") if traced else None
+            ids.append(eng.add_request(
+                p, max_new_tokens=shape["max_new"], trace_ctx=ctx))
+        results, _, _, _ = _drive(eng, ids, {})
+        dt = time.perf_counter() - t0
+        toks = sum(len(results[i]) for i in ids)
+        spans = len(tracing.get_spans()) + tracing.dropped_span_count()
+        tracing.clear_spans()
+        return toks / dt, spans
+
+    tps_on, tps_off, spans_on = 0.0, 0.0, 0
+    for _ in range(3):  # alternate arms so drift hits both equally
+        on, n_spans = _arm(True)
+        off, _ = _arm(False)
+        tps_on, tps_off = max(tps_on, on), max(tps_off, off)
+        spans_on = max(spans_on, n_spans)
+    overhead = (tps_off - tps_on) / tps_off * 100.0 if tps_off else 0.0
+    print(f"tracing overhead: on={tps_on:.1f} off={tps_off:.1f} tok/s "
+          f"({overhead:+.2f}%)", file=sys.stderr)
+    return {
+        "requests_per_arm": n,
+        "tokens_per_sec_traced": round(tps_on, 1),
+        "tokens_per_sec_untraced": round(tps_off, 1),
+        "overhead_pct": round(overhead, 3),
+        "spans_per_run": spans_on,
+    }
+
+
 def run_disaggregated(config, shape):
     """Paired mixed-vs-disaggregated rows for the prefill/decode split.
 
@@ -414,6 +470,7 @@ def main():
     sustained = run_sustained(config, shape, hbm_gb_s)
     burst = run_burst_shed(config, shape)
     interference = run_prefill_interference(config, shape)
+    tracing_overhead = run_tracing_overhead(config, shape)
     disagg = run_disaggregated(config, shape) \
         if "--disagg" in sys.argv[1:] else None
     print(json.dumps({
@@ -429,6 +486,7 @@ def main():
         "sustained_load": sustained,
         "burst_shed": burst,
         "prefill_interference": interference,
+        "tracing_overhead": tracing_overhead,
         **({"disaggregated": disagg} if disagg is not None else {}),
         "model_params": tfm.num_params(config),
         "device": getattr(devices[0], "device_kind", devices[0].platform),

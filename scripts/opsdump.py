@@ -14,8 +14,11 @@ trace JSON:
 
 Lanes follow the dashboard convention: harvested spans render on each
 worker's OS-pid lane, flight-recorder events are instant markers on a
-per-category lane, and scalar metrics become counter tracks.  `--since`
-/ `--until` take epoch seconds; `--last N` means "the last N seconds".
+per-category lane, and scalar metrics become counter tracks.  Serve
+request-journey spans (`serve.*`, tagged with a trace id) get their
+own process with one named lane per request, so each journey's phases
+read as nested slices on a single row.  `--since` / `--until` take
+epoch seconds; `--last N` means "the last N seconds".
 """
 
 from __future__ import annotations
@@ -39,16 +42,55 @@ from ray_tpu.util.tracing import (  # noqa: E402
 STREAMS = ("spans", "flight", "metrics")
 # One synthetic chrome pid per flight-recorder category lane.
 _FLIGHT_PID = 0
+# Synthetic process holding the per-request serve lanes: one named
+# thread per trace id, so each request's journey (queue → prefill →
+# handoff_pull → decode → stream) reads as nested slices on its own
+# row even when the phases ran in different OS processes.
+_SERVE_PID = 1 << 22
+
+
+def serve_request_events(spans: List[dict]) -> List[Dict[str, Any]]:
+    """serve.* spans grouped by trace id → one named lane per request."""
+    by_req: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_req.setdefault(s.get("trace_id", ""), []).append(s)
+    events: List[Dict[str, Any]] = []
+    lanes = sorted(by_req.items(),
+                   key=lambda kv: min(x["start"] for x in kv[1]))
+    for tid, (trace_id, group) in enumerate(lanes):
+        for s in group:
+            events.append({
+                "cat": "serve", "name": s["name"], "ph": "X",
+                "pid": _SERVE_PID, "tid": tid,
+                "ts": s["start"] * 1e6,
+                "dur": max(0.0, s["end"] - s["start"]) * 1e6,
+                "args": {**s["attributes"], "span_id": s["span_id"],
+                         "parent_id": s["parent_id"],
+                         "trace_id": trace_id},
+            })
+        events.append({"ph": "M", "pid": _SERVE_PID, "tid": tid,
+                       "name": "thread_name",
+                       "args": {"name": f"req {trace_id[:8] or '?'}"}})
+    if events:
+        events.append({"ph": "M", "pid": _SERVE_PID,
+                       "name": "process_name",
+                       "args": {"name": "serve requests"}})
+    return events
 
 
 def span_events(envs: List[dict]) -> List[Dict[str, Any]]:
-    """Journal span rows → X slices, one lane per (pid, worker)."""
+    """Journal span rows → X slices, one lane per (pid, worker);
+    serve-plane request spans additionally fan out by trace id."""
     by_lane: Dict[tuple, List[dict]] = {}
+    serve_spans: List[dict] = []
     for env in envs:
         row = env.get("d")
         if not isinstance(row, list) or len(row) < 7:
             continue
         s = span_row_to_dict(row)
+        if s["name"].startswith("serve.") and s.get("trace_id"):
+            serve_spans.append(s)
+            continue
         key = (int(s.get("pid") or 0), s.get("worker", ""))
         by_lane.setdefault(key, []).append(s)
     events: List[Dict[str, Any]] = []
@@ -58,6 +100,7 @@ def span_events(envs: List[dict]) -> List[Dict[str, Any]]:
             process_name=f"worker spans {whex[:8]}" if whex
             else "driver spans",
             sort_index=pid or 1))
+    events.extend(serve_request_events(serve_spans))
     return events
 
 

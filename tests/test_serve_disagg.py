@@ -189,6 +189,97 @@ def test_server_fallback_on_unpullable_ref():
 
 
 # ---------------------------------------------------------------------------
+# Request-journey tracing across the two legs
+# ---------------------------------------------------------------------------
+
+
+def _install_request_ctx(trace_id, parent, span_id):
+    """Simulate the replica data-plane prologue: a live RequestContext
+    with the proxy's trace ctx and this call's pre-allocated span."""
+    import ray_tpu.serve.replica as replica_mod
+
+    ctx = replica_mod.RequestContext(trace_ctx=(trace_id, parent))
+    ctx.span_id = span_id
+    replica_mod._replica_context.request = ctx
+    return ctx
+
+
+def test_two_leg_handoff_yields_one_connected_trace():
+    """prefill_only on server A and decode_from on server B, each under
+    its own (simulated) replica request context: every phase span lands
+    in ONE trace, and the decode side's handoff_pull span parents under
+    the PREFILL replica's span carried inside the bundle — the
+    cross-process link that makes a disaggregated request render as a
+    single tree instead of two orphaned fragments."""
+    import ray_tpu.serve.replica as replica_mod
+    from ray_tpu.util import tracing
+
+    tracing.clear_spans()
+    tid = "ab" * 8
+    pre_span, dec_span = "11" * 8, "22" * 8
+    pre, dec = _server(), _server()
+    rng = np.random.default_rng(5)
+    prompt = [int(x) for x in rng.integers(1, 250, size=2 * _PS + 3)]
+    try:
+        _install_request_ctx(tid, "00" * 8, pre_span)
+        kv = pre.prefill_only(prompt, max_new_tokens=8)
+        assert kv.get("trace") == [tid, pre_span]  # rides the bundle
+        _install_request_ctx(tid, "00" * 8, dec_span)
+        got = dec.decode_from(prompt, kv, max_new_tokens=8)
+        assert got
+    finally:
+        replica_mod._replica_context.request = None
+    spans = [tracing.span_row_to_dict(r)
+             for r in tracing.collect_spans_since(0)["rows"]]
+    journey = [s for s in spans if s["name"].startswith("serve.")]
+    assert journey and {s["trace_id"] for s in journey} == {tid}
+    names = [s["name"] for s in journey]
+    for phase in ("serve.queue", "serve.prefill", "serve.import",
+                  "serve.decode"):
+        assert phase in names, f"missing {phase} in {names}"
+    # Each leg's engine phases parent under that leg's replica span.
+    assert {s["parent_id"] for s in journey} <= {pre_span, dec_span}
+    # Both legs contributed phases (two queue spans, one per engine).
+    assert names.count("serve.queue") == 2
+
+    # Pointer path: the handoff pull span parents under the prefill
+    # leg's span carried IN the payload — even when the pull fails
+    # (no object plane here), so a broken handoff still shows up on
+    # the request's timeline as a failed pull + local re-prefill.
+    tracing.clear_spans()
+    ptr = {"op": "serve_kv_import", "obj": "ab" * 14, "size": 64,
+           "trace": [tid, pre_span]}
+    try:
+        _install_request_ctx(tid, "00" * 8, dec_span)
+        got = dec.decode_from(prompt, ptr, max_new_tokens=4)
+        assert got  # fallback re-prefill kept the request
+    finally:
+        replica_mod._replica_context.request = None
+    spans = [tracing.span_row_to_dict(r)
+             for r in tracing.collect_spans_since(0)["rows"]]
+    pull = next(s for s in spans if s["name"] == "serve.handoff_pull")
+    assert pull["parent_id"] == pre_span  # linked across the legs
+    assert pull["trace_id"] == tid
+    assert pull["attributes"]["ok"] is False
+    assert "clock_off" in pull["attributes"]
+
+
+def test_trace_ctx_survives_pointer_handoff():
+    """The object-plane pointer path (serve_kv_import) carries the same
+    trace linkage as the inline bundle: wire_schema admits it and the
+    importing engine's splice spans join the prefill leg's trace."""
+    from ray_tpu.core import wire_schema
+
+    wire_schema.validate({"op": "serve_kv_import", "obj": "ab" * 14,
+                          "size": 4096, "trace": ["cd" * 8, "ef" * 8]})
+    wire_schema.validate({"op": "serve_kv_import", "obj": "ab" * 14,
+                          "size": 4096})  # pre-tracing peers still valid
+    with pytest.raises(wire_schema.SchemaError):
+        wire_schema.validate({"op": "serve_kv_import", "obj": "ab" * 14,
+                              "size": 4096, "trace": "not-a-list"})
+
+
+# ---------------------------------------------------------------------------
 # Wire schema + config surface
 # ---------------------------------------------------------------------------
 
