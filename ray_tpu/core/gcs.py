@@ -322,10 +322,21 @@ class _Watchdog:
         self._last_tick = 0.0
         self._flagged_tasks: Set[str] = set()  # flag once per task
         self._unhealthy_nodes: Set[str] = set()
+        # Device-plane rules (PR 19): recompile storms flag once per
+        # (worker, function); HBM watermark alerts re-arm when the
+        # occupancy drops back under the threshold.
+        self.recompile_max = _env_int(
+            "RAY_TPU_DEVICE_RECOMPILE_MAX", 8, 1)
+        self.hbm_watermark = _env_float(
+            "RAY_TPU_DEVICE_HBM_WATERMARK", 0.9, 0.01)
+        self._flagged_recompiles: Set[tuple] = set()
+        self._hbm_alerted: Set[str] = set()
         # Totals for /api/profile and tests (counters may be None when
         # metrics failed to import).
         self.stragglers_flagged = 0
         self.nodes_flagged = 0
+        self.recompile_storms_flagged = 0
+        self.hbm_alerts = 0
 
     @staticmethod
     def _percentile_of(sorted_vals: List[float], pct: float) -> float:
@@ -358,6 +369,7 @@ class _Watchdog:
         now = time.time() if now is None else now
         self._check_stragglers(now)
         self._check_nodes(now)
+        self._check_device(now)
 
     def _check_stragglers(self, now: float) -> None:
         srv = self.server
@@ -454,6 +466,64 @@ class _Watchdog:
             self._unhealthy_nodes.discard(nid)
             flight_recorder.record("health", "node_recovered", node=nid)
 
+    def _check_device(self, now: float) -> None:
+        """Device-plane rules over the latest profile samples (the
+        recompile counts and HBM ledger piggybacked by the worker
+        sampler): a recompile storm — post-warmup compiles of one
+        function past RAY_TPU_DEVICE_RECOMPILE_MAX — flags once per
+        (worker, function); an HBM watermark at/over
+        RAY_TPU_DEVICE_HBM_WATERMARK alerts and re-arms when the
+        reported watermark drops back under."""
+        srv = self.server
+        with srv.lock:
+            latest = {wh: dict(s) for wh, s in srv._profiles.items()}
+        storms: List[tuple] = []
+        hbm_hits: List[tuple] = []
+        hbm_clear: List[str] = []
+        for wh, sample in latest.items():
+            rec = sample.get("recompiles")
+            if isinstance(rec, dict):
+                for fn, n in rec.items():
+                    try:
+                        n = int(n)
+                    except (TypeError, ValueError):  # raylint: allow-swallow(a malformed count in one report must not kill the sweep)
+                        continue
+                    if n > self.recompile_max and \
+                            (wh, fn) not in self._flagged_recompiles:
+                        self._flagged_recompiles.add((wh, fn))
+                        storms.append((wh, fn, n))
+            dev = sample.get("device")
+            frac = (dev or {}).get("watermark_fraction") \
+                if isinstance(dev, dict) else None
+            if frac is None:
+                frac = sample.get("hbm_watermark_fraction")
+            if isinstance(frac, (int, float)) and \
+                    not isinstance(frac, bool):
+                if frac >= self.hbm_watermark:
+                    if wh not in self._hbm_alerted:
+                        self._hbm_alerted.add(wh)
+                        hbm_hits.append((wh, float(frac)))
+                elif wh in self._hbm_alerted:
+                    hbm_clear.append(wh)
+        from ray_tpu.util import flight_recorder
+
+        for wh, fn, n in storms:
+            self.recompile_storms_flagged += 1
+            flight_recorder.record(
+                "health", "recompile_storm", worker=wh, function=fn,
+                recompiles_after_warmup=n,
+                threshold=self.recompile_max)
+        for wh, frac in hbm_hits:
+            self.hbm_alerts += 1
+            flight_recorder.record(
+                "health", "hbm_watermark", worker=wh,
+                watermark_fraction=round(frac, 4),
+                threshold=self.hbm_watermark)
+        for wh in hbm_clear:
+            self._hbm_alerted.discard(wh)
+            flight_recorder.record(
+                "health", "hbm_watermark_cleared", worker=wh)
+
     def profile_distributions(self) -> Dict[str, Dict[str, Any]]:
         """Per-worker percentile summaries over the head's profile
         history rings — worker load as a distribution (p50/p95 across
@@ -474,6 +544,10 @@ class _Watchdog:
             "stragglers_flagged": self.stragglers_flagged,
             "nodes_flagged": self.nodes_flagged,
             "unhealthy_nodes": sorted(self._unhealthy_nodes),
+            "recompile_storms_flagged": self.recompile_storms_flagged,
+            "recompile_max": self.recompile_max,
+            "hbm_alerts": self.hbm_alerts,
+            "hbm_watermark": self.hbm_watermark,
             "profile_distributions": self.profile_distributions(),
         }
 

@@ -225,6 +225,29 @@ KNOBS: List[Knob] = [
     Knob("RAY_TPU_WATCHDOG_HEARTBEAT_TIMEOUT_S", "30.0", "float", "user",
          "Worker heartbeat silence before it is marked unhealthy."),
 
+    # -- device-plane telemetry (util/device_stats.py) -------------------
+    Knob("RAY_TPU_DEVICE_STATS", "1", "bool", "user",
+         "0 disables device-plane telemetry (compile-event hook, "
+         "roofline/MFU step accounting)."),
+    Knob("RAY_TPU_DEVICE_RECOMPILE_WARMUP", "2", "int", "user",
+         "Compilations of one jitted function tolerated as warmup "
+         "before counting toward recompile churn."),
+    Knob("RAY_TPU_DEVICE_RECOMPILE_MAX", "8", "int", "user",
+         "Post-warmup compiles of one function on one worker past "
+         "which the watchdog flags a recompile storm."),
+    Knob("RAY_TPU_DEVICE_HBM_WATERMARK", "0.9", "float", "user",
+         "Device-memory occupancy watermark fraction at/over which "
+         "the watchdog raises an HBM health alert."),
+    Knob("RAY_TPU_DEVICE_HBM_GBPS", "0", "float", "user",
+         "HBM bandwidth override (GB/s) for the roofline model; 0 "
+         "selects the built-in per-device-kind table."),
+    Knob("RAY_TPU_DEVICE_PEAK_TFLOPS", "0", "float", "user",
+         "Peak dense TFLOP/s override for MFU; 0 selects the built-in "
+         "per-device-kind table."),
+    Knob("RAY_TPU_DEVICE_HBM_BYTES", "0", "int", "user",
+         "Device-memory capacity override (bytes) for the HBM ledger "
+         "on backends without memory_stats (e.g. CPU)."),
+
     # -- libraries -------------------------------------------------------
     Knob("RAY_TPU_DATA_BLOCK_FORMAT", "arrow", "str", "user",
          "Default block format for ray_tpu.data datasets."),

@@ -204,6 +204,16 @@ class WorkerRuntime:
             "queue_depth": self._task_queue.qsize() + (
                 pool_q.qsize() if pool_q is not None else 0),
         }
+        # Device-plane piggyback: "device" is None on hosts without an
+        # accelerator (JAX_PLATFORMS=cpu emits device: null — the probe
+        # never raises and never imports jax itself); recompile counts
+        # and the last roofline/MFU window ride along when the process
+        # produced them, so the head's history rings grow percentiles
+        # for them for free.
+        from ray_tpu.util import device_stats
+
+        device_stats.attribute("arena", used)
+        sample.update(device_stats.profile_fields())
         return sample, cpu_s, now
 
     # -- runtime facade (same surface the driver runtime exposes) -------

@@ -28,6 +28,11 @@ Routes (all GET unless noted):
                               bounded history-ring p50/p95 summaries +
                               watchdog state (?samples=1 adds raw
                               rings)
+  /api/device              -> device-plane view: local HBM ledger +
+                              recompile table, per-worker device
+                              fields, rolling roofline/MFU
+                              percentiles from the profile history
+                              rings, device watchdog state
   /api/flight_recorder?last=&since= -> recent wire/scheduler events +
                               ring stats, time-windowed by ?since=
   /api/workers/<hex>/profile?kind=stack|jax_trace&duration_s=
@@ -45,7 +50,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Any, Dict, Optional
 
 from ray_tpu._version import __version__
 
@@ -269,6 +274,52 @@ class Dashboard:
                     "", "0", "false", "no", "off"):
                 req["samples"] = True
             return rt.core.client.call(req)
+        if parsed.path == "/api/device":
+            # Device-plane view, assembled entirely from existing
+            # transports: this process's HBM ledger + compile table
+            # (probe=True may import jax — the dashboard can afford
+            # it) and the head's get_profile op for per-worker device
+            # fields and rolling roofline/MFU percentiles.
+            from ray_tpu.util import device_stats
+
+            out: Dict[str, Any] = {
+                "local": {
+                    "ledger": device_stats.ledger(probe=True),
+                    "recompiles": device_stats.compile_counts(),
+                    "last_step": device_stats.last_step(),
+                },
+                "workers": {},
+                "history": {},
+                "watchdog": {},
+            }
+            try:
+                prof = rt.core.client.call({"op": "get_profile"})
+            except Exception as exc:
+                out["error"] = f"{type(exc).__name__}: {exc}"
+                return out
+            device_keys = ("roofline_fraction", "mfu", "tokens_per_s",
+                           "hbm_watermark_fraction")
+            for wh, sample in (prof.get("workers") or {}).items():
+                out["workers"][wh] = {
+                    "device": sample.get("device"),
+                    "recompiles": sample.get("recompiles"),
+                    **{k: sample[k] for k in device_keys
+                       if k in sample},
+                }
+            for wh, summ in (prof.get("history") or {}).items():
+                pcts = (summ or {}).get("percentiles") or {}
+                kept = {k: v for k, v in pcts.items()
+                        if k in device_keys}
+                if kept:
+                    out["history"][wh] = {
+                        "samples": summ.get("samples"),
+                        "percentiles": kept,
+                    }
+            wd = prof.get("watchdog") or {}
+            out["watchdog"] = {k: wd.get(k) for k in (
+                "recompile_storms_flagged", "recompile_max",
+                "hbm_alerts", "hbm_watermark") if k in wd}
+            return out
         if parsed.path == "/api/flight_recorder":
             from ray_tpu.util import flight_recorder
             last = int(qs.get("last", 0) or 0)

@@ -32,6 +32,7 @@ from ray_tpu.models.transformer import (
     rope_freqs,
 )
 from ray_tpu.ops.attention import flash_attention
+from ray_tpu.util import device_stats
 from ray_tpu.ops.paged_attention import (
     paged_attention,
     write_page_tokens,
@@ -574,3 +575,27 @@ def splice_kv_pages(cache, k_pages, v_pages, page_ids
     kf = kf.at[idx].set(k_pages.reshape(-1, *rest), mode="drop")
     vf = vf.at[idx].set(v_pages.reshape(-1, *rest), mode="drop")
     return _unflat_cache(kf, vf, L, P)
+
+
+# Device-plane observability: every jit entry point is wrapped so each
+# compilation after warmup is counted per function with shapes + wall
+# time (the recompile-storm watchdog reads these via the profile
+# sampler).  The wrapper forwards attribute access (.lower, AOT APIs)
+# and costs one tracing-cache-size probe per call.
+prefill = device_stats.count_compiles(prefill, "decoding.prefill")
+prefill_with_context = device_stats.count_compiles(
+    prefill_with_context, "decoding.prefill_with_context")
+verify_step = device_stats.count_compiles(
+    verify_step, "decoding.verify_step")
+decode_step = device_stats.count_compiles(
+    decode_step, "decoding.decode_step")
+decode_multi_step = device_stats.count_compiles(
+    decode_multi_step, "decoding.decode_multi_step")
+packed_prefill_admit = device_stats.count_compiles(
+    packed_prefill_admit, "decoding.packed_prefill_admit")
+merge_slot_state = device_stats.count_compiles(
+    merge_slot_state, "decoding.merge_slot_state")
+gather_kv_pages = device_stats.count_compiles(
+    gather_kv_pages, "decoding.gather_kv_pages")
+splice_kv_pages = device_stats.count_compiles(
+    splice_kv_pages, "decoding.splice_kv_pages")

@@ -27,7 +27,7 @@ import numpy as np
 
 from ray_tpu.models import transformer as tfm
 from ray_tpu.models.decoding import decode_step, init_kv_pages, prefill
-from ray_tpu.util import flight_recorder, tracing
+from ray_tpu.util import device_stats, flight_recorder, tracing
 from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
 _REQUESTS = Counter(
@@ -416,6 +416,24 @@ class LLMEngine:
             "RAY_TPU_SERVE_STEP_SAMPLE_EVERY", 8)
         self._step_count = 0
         self.engine_sample: Optional[Dict[str, Any]] = None
+        # Device-plane attribution: modeled per-token traffic/compute
+        # terms (the same ones bench_decode uses offline) so the step
+        # sampler can emit continuous roofline/MFU, plus HBM ledger
+        # entries for the two big resident pools.
+        self._weight_bytes = int(sum(
+            x.size * x.dtype.itemsize
+            for x in jax.tree.leaves(self.params)
+            if hasattr(x, "dtype")))
+        self._kv_per_token_bytes = int(
+            2 * c.num_layers * c.num_kv_heads * c.head_dim_
+            * jnp.dtype(c.dtype).itemsize)
+        self._flops_per_token = 2 * tfm.num_params(c)
+        device_stats.attribute("weights", self._weight_bytes)
+        device_stats.attribute("kv_pages", int(sum(
+            v.size * v.dtype.itemsize for v in self.cache.values())))
+        self._finished_tokens = 0
+        self._last_sample_t: Optional[float] = None
+        self._last_sample_tokens = 0
 
     # -- public API --------------------------------------------------------
     def add_request(self, prompt_tokens: Sequence[int],
@@ -752,6 +770,7 @@ class LLMEngine:
                  if req.t_enqueue else 0.0)
         _TTFT.observe(ttft)
         _TPOT.observe(tpot)
+        self._finished_tokens += n_out
         self.slo_samples.append({
             "ttft": round(ttft, 6), "tpot": round(tpot, 6),
             "queue_wait": round(qwait, 6), "tokens": n_out, "ts": now})
@@ -779,6 +798,49 @@ class LLMEngine:
         if len(kept) != len(self.waiting):
             self.waiting = kept
         _QUEUE_DEPTH.set(len(self.waiting))
+
+    def _sample_device(self, sample: Dict[str, Any]) -> None:
+        """Device-plane extension of the every-Nth-step sampler: fold
+        modeled bytes+flops over the tokens emitted since the last
+        sampled step into continuous roofline/MFU gauges, a periodic
+        `device.step` span, and the engine_sample itself (which rides
+        load_report to the controller unchanged).  Host math on values
+        the engine already tracks — no device sync."""
+        now = sample["ts"]
+        total = self._finished_tokens + sum(
+            len(r.generated) for r in self.slot_req if r is not None)
+        prev_t, prev_tok = self._last_sample_t, self._last_sample_tokens
+        self._last_sample_t, self._last_sample_tokens = now, total
+        if not device_stats.enabled() or prev_t is None \
+                or now <= prev_t:
+            return
+        try:
+            tok_s = max(0, total - prev_tok) / (now - prev_t)
+            # Every decode iteration streams the full weights plus the
+            # live KV context; amortize per token over the batch.
+            active = max(1, self.num_active)
+            live_ctx = int(self.context_lens.sum())
+            bytes_per_token = (
+                self._weight_bytes
+                + live_ctx * self._kv_per_token_bytes) / active
+            frac, mfu = device_stats.note_step(
+                tokens_per_s=tok_s, bytes_per_token=bytes_per_token,
+                flops_per_token=self._flops_per_token, plane="serve",
+                extra={"active": sample["active"],
+                       "step": sample["step"]})
+            sample["tokens_per_s"] = round(tok_s, 2)
+            sample["roofline_fraction"] = round(frac, 5)
+            sample["mfu"] = round(mfu, 5)
+            sample["modeled_bytes_per_token"] = int(bytes_per_token)
+            tracing.record_span(
+                "device.step", prev_t, now,
+                attributes={"plane": "serve",
+                            "tokens_per_s": round(tok_s, 2),
+                            "roofline_fraction": round(frac, 5),
+                            "mfu": round(mfu, 5),
+                            "active": sample["active"]})
+        except Exception:  # raylint: allow-swallow(telemetry must never fail an engine step)
+            pass
 
     @property
     def num_active(self) -> int:
@@ -819,6 +881,7 @@ class LLMEngine:
                                         budget)) if budget else 0),
                 "completed": self.num_completed,
             }
+            self._sample_device(self.engine_sample)
         self._shed_expired()
         # Per-step prefill token budget: admission (classic _admit and
         # packed waves) may spend at most this many prompt tokens per

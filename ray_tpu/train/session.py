@@ -14,6 +14,7 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 from typing import Any, Dict, Iterable, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
@@ -58,11 +59,57 @@ class _TrainSession:
         self.result_queue: "queue.Queue" = queue.Queue(maxsize=1)
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
+        self._last_report_t: Optional[float] = None
 
     def report(self, metrics: Dict[str, Any],
                checkpoint: Optional[Checkpoint] = None) -> None:
+        self._note_device_step(metrics)
         self.result_queue.put({"metrics": dict(metrics),
                                "checkpoint": checkpoint})
+
+    def _note_device_step(self, metrics: Dict[str, Any]) -> None:
+        """Device-plane step hook (same accounting the serve engine's
+        step sampler does): when the loop reports modeled per-step
+        work — "step_flops" and/or "step_bytes", or a ready-made
+        "tokens_per_sec" with "flops_per_token" — fold it into the
+        continuous roofline/MFU gauges tagged plane="train".  Loops
+        that report neither pay one dict lookup."""
+        now = time.time()
+        prev, self._last_report_t = self._last_report_t, now
+        flops = metrics.get("step_flops")
+        nbytes = metrics.get("step_bytes")
+        tok_s = metrics.get("tokens_per_sec")
+        if flops is None and nbytes is None and tok_s is None:
+            return
+        try:
+            from ray_tpu.util import device_stats, tracing
+
+            if tok_s is not None:
+                frac, mfu = device_stats.note_step(
+                    tokens_per_s=float(tok_s),
+                    bytes_per_token=float(
+                        metrics.get("bytes_per_token", 0.0)),
+                    flops_per_token=float(
+                        metrics.get("flops_per_token", 0.0)),
+                    plane="train")
+            elif prev is not None and now > prev:
+                # One report == one step: per-"token" terms collapse to
+                # per-step terms at 1/dt steps per second.
+                frac, mfu = device_stats.note_step(
+                    tokens_per_s=1.0 / (now - prev),
+                    bytes_per_token=float(nbytes or 0.0),
+                    flops_per_token=float(flops or 0.0),
+                    plane="train")
+            else:
+                return
+            if prev is not None and now > prev:
+                tracing.record_span(
+                    "device.step", prev, now,
+                    attributes={"plane": "train",
+                                "roofline_fraction": round(frac, 5),
+                                "mfu": round(mfu, 5)})
+        except Exception:  # raylint: allow-swallow(telemetry must never fail a train step report)
+            pass
 
 
 def _set_session(s: Optional[_TrainSession]):

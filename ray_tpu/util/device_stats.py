@@ -1,0 +1,486 @@
+"""Device-plane telemetry: backend probe, compile-event accounting,
+HBM ledger, and continuous roofline/MFU attribution.
+
+Everything here rides the existing observability transports — metric
+registry snapshots, the span ring, the durable ops journal ("device"
+stream), and the worker profile sampler — no new wire ops.
+
+Design rules:
+  - Never import jax on behalf of a process that has not already
+    loaded it: ``device_sample()`` and ``backend_info()`` return the
+    CPU/none fallback unless ``sys.modules`` already holds jax (the
+    dashboard can opt into a forced probe with ``probe=True``).
+  - Sampling must never hurt the caller: every probe is wrapped and
+    degrades to None / empty on any backend quirk.
+  - The compile hook detects recompiles by diffing the jitted
+    callable's tracing-cache size around each call (``_cache_size()``
+    where jax provides it, an argument-signature set otherwise), so
+    it works identically under JAX_PLATFORMS=cpu — shape churn on a
+    CPU host is the same bug as on a TPU host.
+"""
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.core.log_once import warn_once
+
+logger = logging.getLogger(__name__)
+
+_FALSY = ("0", "false", "no", "off", "")
+
+_lock = threading.Lock()
+
+# name -> {"count", "after_warmup", "total_wall_s", "last_wall_s",
+#          "last_shapes", "first_ts", "last_ts"}
+_compiles: Dict[str, Dict[str, Any]] = {}
+
+# component -> absolute device bytes attributed by the owning
+# subsystem (weights / kv_pages / arena / ...).
+_components: Dict[str, int] = {}
+
+_watermark_bytes = 0
+_watermark_fraction = 0.0
+_last_step: Optional[Dict[str, Any]] = None
+
+_metrics_cache: Optional[Tuple[Any, Any, Any, Any]] = None
+
+
+def _env_flag(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default).strip().lower() not in _FALSY
+
+
+def _env_int(name: str, default: int, floor: int = 0) -> int:
+    try:
+        return max(floor, int(os.environ.get(name, str(default))))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float, floor: float = 0.0) -> float:
+    try:
+        return max(floor, float(os.environ.get(name, str(default))))
+    except ValueError:
+        return default
+
+
+_enabled = _env_flag("RAY_TPU_DEVICE_STATS", "1")
+_warmup = _env_int("RAY_TPU_DEVICE_RECOMPILE_WARMUP", 2, 0)
+
+
+def set_enabled(on: bool) -> None:
+    """Runtime switch for the compile hook + step accounting (the
+    bench A/B phase and tests flip this without re-importing)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Test hook: drop all per-process accumulated state."""
+    global _watermark_bytes, _watermark_fraction, _last_step
+    with _lock:
+        _compiles.clear()
+        _components.clear()
+        _watermark_bytes = 0
+        _watermark_fraction = 0.0
+        _last_step = None
+
+
+# ---------------------------------------------------------------------------
+# backend probe
+
+
+def _jax():
+    """The already-imported jax module, or None.  Deliberately does
+    NOT import jax: a plain task worker that never touched jax must
+    not pay a multi-second import inside its profile sampler."""
+    return sys.modules.get("jax")
+
+
+def backend_info(probe: bool = False) -> Dict[str, Any]:
+    """{"backend", "device_kind", "num_devices"}.  backend is
+    "unloaded" when jax was never imported here (unless probe=True,
+    which imports it), and falls back to "cpu"/"none" on error."""
+    jax = _jax()
+    if jax is None and probe:
+        try:
+            import jax  # noqa: F811
+        except Exception:
+            return {"backend": "none", "device_kind": "", "num_devices": 0}
+    if jax is None:
+        return {"backend": "unloaded", "device_kind": "", "num_devices": 0}
+    try:
+        devs = jax.devices()
+        d0 = devs[0]
+        return {
+            "backend": d0.platform,
+            "device_kind": getattr(d0, "device_kind", d0.platform),
+            "num_devices": len(devs),
+        }
+    except Exception:
+        return {"backend": "none", "device_kind": "", "num_devices": 0}
+
+
+def has_accelerator() -> bool:
+    return backend_info().get("backend") not in (
+        "cpu", "none", "unloaded", "")
+
+
+def memory_stats() -> Optional[Dict[str, Any]]:
+    """device.memory_stats() for device 0, or None (CPU backends and
+    older runtimes return None or raise — both degrade to None)."""
+    jax = _jax()
+    if jax is None:
+        return None
+    try:
+        stats = jax.devices()[0].memory_stats()
+        return dict(stats) if stats else None
+    except Exception:  # raylint: allow-swallow(cpu/older runtimes raise here; None is the documented fallback)
+        return None
+
+
+# Per-device-kind peak specs: (HBM bytes/s, dense peak FLOP/s).  The
+# bandwidth column matches scripts/bench_decode.py's roofline table;
+# RAY_TPU_DEVICE_HBM_GBPS / RAY_TPU_DEVICE_PEAK_TFLOPS override both
+# (required for meaningful numbers on CPU hosts).
+_PEAK_SPECS = {
+    "TPU v5 lite": (819e9, 197e12),
+    "TPU v5": (2765e9, 459e12),
+    "TPU v4": (1228e9, 275e12),
+}
+_DEFAULT_SPECS = (819e9, 197e12)
+
+
+def peak_specs() -> Tuple[float, float]:
+    """(hbm_bytes_per_s, peak_flops_per_s) for the local backend."""
+    hbm = _env_float("RAY_TPU_DEVICE_HBM_GBPS", 0.0) * 1e9
+    tf = _env_float("RAY_TPU_DEVICE_PEAK_TFLOPS", 0.0) * 1e12
+    if hbm and tf:
+        return hbm, tf
+    kind = backend_info().get("device_kind", "")
+    spec = _PEAK_SPECS.get(kind, _DEFAULT_SPECS)
+    return (hbm or spec[0], tf or spec[1])
+
+
+# ---------------------------------------------------------------------------
+# metrics / journal (both lazy so importing this module stays free)
+
+
+def _metrics():
+    global _metrics_cache
+    if _metrics_cache is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+        _metrics_cache = (
+            Counter("ray_tpu_recompiles_total",
+                    "XLA compilations observed after per-function "
+                    "warmup (recompile churn)", tag_keys=("function",)),
+            Gauge("ray_tpu_device_roofline_fraction",
+                  "Achieved / roofline HBM-bandwidth fraction of the "
+                  "last sampled step window", tag_keys=("plane",)),
+            Gauge("ray_tpu_device_mfu",
+                  "Model FLOPs utilization of the last sampled step "
+                  "window", tag_keys=("plane",)),
+            Gauge("ray_tpu_device_hbm_watermark_fraction",
+                  "Peak observed device-memory occupancy fraction "
+                  "since process start"),
+        )
+    return _metrics_cache
+
+
+def _journal(record: Dict[str, Any]) -> None:
+    try:
+        from ray_tpu.util import journal
+        js = journal.stream("device")
+        if js is not None:
+            js.append(record)
+    except Exception as exc:
+        warn_once(logger, "device-journal", exc,
+                  "could not append to the device journal stream")
+
+
+# ---------------------------------------------------------------------------
+# compile-event hook
+
+
+def _arg_shapes(args: tuple, kwargs: dict) -> list:
+    out = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is not None:
+            out.append([list(shape), str(getattr(a, "dtype", ""))])
+        elif isinstance(a, (int, float, bool)):
+            out.append(a)
+        else:
+            out.append(type(a).__name__)
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        shape = getattr(v, "shape", None)
+        out.append([k, list(shape) if shape is not None
+                    else type(v).__name__])
+    return out
+
+
+def note_compile(name: str, wall_s: float, shapes: list) -> None:
+    """Record one observed compilation of `name`.  Past the warmup
+    allowance the recompile counter increments and the event lands in
+    the durable "device" journal stream."""
+    now = time.time()
+    with _lock:
+        ent = _compiles.setdefault(name, {
+            "count": 0, "after_warmup": 0, "total_wall_s": 0.0,
+            "last_wall_s": 0.0, "last_shapes": None,
+            "first_ts": now, "last_ts": now,
+        })
+        ent["count"] += 1
+        ent["total_wall_s"] += wall_s
+        ent["last_wall_s"] = wall_s
+        ent["last_shapes"] = shapes
+        ent["last_ts"] = now
+        post_warmup = ent["count"] > _warmup
+        if post_warmup:
+            ent["after_warmup"] += 1
+        count, after_warmup = ent["count"], ent["after_warmup"]
+    if post_warmup:
+        try:
+            _metrics()[0].inc(tags={"function": name})
+        except Exception as exc:
+            warn_once(logger, "device-metrics", exc,
+                      "could not update device metrics")
+    _journal({"kind": "compile", "ts": now, "function": name,
+              "wall_s": round(wall_s, 4), "shapes": shapes,
+              "count": count, "after_warmup": after_warmup})
+
+
+class _CompileTracked:
+    """Wrapper around a jitted callable that counts compilations by
+    diffing the tracing-cache size around each call.  Attribute access
+    forwards to the wrapped function (``.lower``, AOT APIs, etc.)."""
+
+    def __init__(self, fn, name: str):
+        self._fn = fn
+        self._name = name
+        self._seen_sigs = None  # fallback when _cache_size is absent
+        self.__wrapped__ = fn
+
+    def _cache_size(self) -> int:
+        try:
+            return self._fn._cache_size()
+        except Exception:
+            return -1
+
+    def __call__(self, *args, **kwargs):
+        if not _enabled:
+            return self._fn(*args, **kwargs)
+        before = self._cache_size()
+        if before < 0:
+            # No tracing-cache introspection: fall back to tracking
+            # coarse argument signatures (top-level shapes/dtypes).
+            sig = tuple(
+                (tuple(getattr(a, "shape", ())), str(getattr(a, "dtype", "")))
+                if hasattr(a, "shape") else repr(a)[:64]
+                for a in args)
+            if self._seen_sigs is None:
+                self._seen_sigs = set()
+            miss = sig not in self._seen_sigs
+            self._seen_sigs.add(sig)
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            if miss:
+                note_compile(self._name, time.perf_counter() - t0,
+                             _arg_shapes(args, kwargs))
+            return out
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        if self._cache_size() > before:
+            note_compile(self._name, time.perf_counter() - t0,
+                         _arg_shapes(args, kwargs))
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+def count_compiles(fn, name: Optional[str] = None):
+    """Wrap a jitted callable so every (re)compilation is counted per
+    function with shapes + wall time.  Transparent to callers."""
+    label = name or getattr(fn, "__name__", None) or repr(fn)
+    return _CompileTracked(fn, label)
+
+
+def compile_counts() -> Dict[str, Dict[str, Any]]:
+    """Per-function compile table (copies, json-safe)."""
+    with _lock:
+        return {k: dict(v) for k, v in _compiles.items()}
+
+
+def recompiles_after_warmup() -> Dict[str, int]:
+    """{function: compiles beyond the warmup allowance} — the compact
+    form piggybacked on profile samples for the head-side watchdog."""
+    with _lock:
+        return {k: v["after_warmup"] for k, v in _compiles.items()
+                if v["after_warmup"]}
+
+
+# ---------------------------------------------------------------------------
+# HBM ledger
+
+
+def attribute(component: str, nbytes: int) -> None:
+    """Set the absolute device bytes attributed to `component`
+    (weights / kv_pages / arena / ...).  Owners call this once at
+    allocation time or per sampler tick; idempotent."""
+    with _lock:
+        _components[component] = int(nbytes)
+
+
+def ledger(probe: bool = False) -> Dict[str, Any]:
+    """The per-process HBM ledger.  ALWAYS returns a dict (CPU hosts
+    get backend="cpu" with capacity from the attribution sum), so the
+    dashboard renders the same shape everywhere."""
+    global _watermark_bytes, _watermark_fraction
+    info = backend_info(probe=probe)
+    stats = memory_stats()
+    with _lock:
+        components = dict(_components)
+    attributed = sum(components.values())
+    if stats:
+        used = int(stats.get("bytes_in_use", attributed))
+        capacity = int(stats.get("bytes_limit", 0)) or used
+        peak = int(stats.get("peak_bytes_in_use", used))
+    else:
+        used = attributed
+        capacity = _env_int("RAY_TPU_DEVICE_HBM_BYTES", 0) or used
+        peak = used
+    workspace = max(0, used - attributed)
+    with _lock:
+        if peak > _watermark_bytes:
+            _watermark_bytes = peak
+        if capacity:
+            frac = _watermark_bytes / capacity
+            if frac > _watermark_fraction:
+                _watermark_fraction = frac
+        wm_bytes, wm_frac = _watermark_bytes, _watermark_fraction
+    try:
+        _metrics()[3].set(wm_frac)
+    except Exception as exc:
+        warn_once(logger, "device-metrics", exc,
+                  "could not update device metrics")
+    return {
+        "backend": info["backend"],
+        "device_kind": info["device_kind"],
+        "num_devices": info["num_devices"],
+        "capacity_bytes": capacity,
+        "used_bytes": used,
+        "watermark_bytes": wm_bytes,
+        "watermark_fraction": round(wm_frac, 4),
+        "components": components,
+        "workspace_bytes": workspace,
+        "memory_stats": stats,
+        "ts": time.time(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# continuous roofline / MFU step hook
+
+
+def note_step(*, tokens_per_s: float, bytes_per_token: float,
+              flops_per_token: float, plane: str = "serve",
+              extra: Optional[Dict[str, Any]] = None,
+              ) -> Tuple[float, float]:
+    """Fold one sampled step window into the continuous gauges.
+
+    `bytes_per_token` / `flops_per_token` are the MODELED per-token
+    traffic and compute (same terms bench_decode uses offline:
+    weights + live KV for bytes, 2*params for flops).  Returns
+    (roofline_fraction, mfu)."""
+    global _last_step
+    if not _enabled:
+        return 0.0, 0.0
+    peak_bw, peak_flops = peak_specs()
+    achieved_bytes_s = tokens_per_s * max(0.0, bytes_per_token)
+    achieved_flops_s = tokens_per_s * max(0.0, flops_per_token)
+    frac = achieved_bytes_s / peak_bw if peak_bw else 0.0
+    mfu = achieved_flops_s / peak_flops if peak_flops else 0.0
+    step = {
+        "kind": "step", "ts": time.time(), "plane": plane,
+        "tokens_per_s": round(tokens_per_s, 2),
+        "bytes_per_token": int(bytes_per_token),
+        "flops_per_token": int(flops_per_token),
+        "roofline_fraction": round(frac, 5),
+        "mfu": round(mfu, 5),
+    }
+    if extra:
+        step.update(extra)
+    with _lock:
+        _last_step = step
+    try:
+        m = _metrics()
+        m[1].set(frac, tags={"plane": plane})
+        m[2].set(mfu, tags={"plane": plane})
+    except Exception as exc:
+        warn_once(logger, "device-metrics", exc,
+                  "could not update device metrics")
+    _journal(step)
+    return frac, mfu
+
+
+def last_step() -> Optional[Dict[str, Any]]:
+    with _lock:
+        return dict(_last_step) if _last_step else None
+
+
+# ---------------------------------------------------------------------------
+# profile-sampler piggyback
+
+
+def device_sample() -> Optional[Dict[str, Any]]:
+    """Device fields for the worker profile sampler.  None on hosts
+    without an accelerator (JAX_PLATFORMS=cpu emits device: null —
+    never raises), a compact ledger view otherwise."""
+    try:
+        if not has_accelerator():
+            return None
+        led = ledger()
+        return {
+            "backend": led["backend"],
+            "device_kind": led["device_kind"],
+            "capacity_bytes": led["capacity_bytes"],
+            "used_bytes": led["used_bytes"],
+            "watermark_fraction": led["watermark_fraction"],
+            "components": led["components"],
+            "workspace_bytes": led["workspace_bytes"],
+        }
+    except Exception:  # raylint: allow-swallow(sampling must never hurt the worker; None is the cpu/no-device value)
+        return None
+
+
+def profile_fields() -> Dict[str, Any]:
+    """Top-level sample fields the worker sampler merges in: always
+    includes "device" (possibly None); recompile counts and the last
+    roofline/MFU window only when present, so the PR-6 history rings
+    grow percentiles for them for free."""
+    out: Dict[str, Any] = {"device": device_sample()}
+    try:
+        rec = recompiles_after_warmup()
+        if rec:
+            out["recompiles"] = rec
+        ls = last_step()
+        if ls:
+            out["roofline_fraction"] = ls["roofline_fraction"]
+            out["mfu"] = ls["mfu"]
+            out["tokens_per_s"] = ls["tokens_per_s"]
+        led_frac = _watermark_fraction
+        if led_frac:
+            out["hbm_watermark_fraction"] = round(led_frac, 4)
+    except Exception as exc:
+        warn_once(logger, "device-profile-fields", exc,
+                  "could not build device profile fields")
+    return out

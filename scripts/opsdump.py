@@ -5,8 +5,8 @@ Perfetto-loadable chrome trace.
 The live dashboard (`/api/trace`) can only show what the current head
 holds in memory; this reads the on-disk journal segments directly
 (no cluster required — works on a dead cluster's journal dir), merges
-the "spans", "flight" and "metrics" streams, and writes one chrome
-trace JSON:
+the "spans", "flight", "metrics" and "device" streams, and writes one
+chrome trace JSON:
 
     python scripts/opsdump.py --dir /var/ray_tpu/ops \\
         --last 3600 --out trace.json
@@ -17,8 +17,10 @@ worker's OS-pid lane, flight-recorder events are instant markers on a
 per-category lane, and scalar metrics become counter tracks.  Serve
 request-journey spans (`serve.*`, tagged with a trace id) get their
 own process with one named lane per request, so each journey's phases
-read as nested slices on a single row.  `--since` / `--until` take
-epoch seconds; `--last N` means "the last N seconds".
+read as nested slices on a single row.  Device-plane records become
+roofline/MFU counter tracks plus instant recompile markers on a
+"device plane" process.  `--since` / `--until` take epoch seconds;
+`--last N` means "the last N seconds".
 """
 
 from __future__ import annotations
@@ -39,7 +41,7 @@ from ray_tpu.util.tracing import (  # noqa: E402
     spans_to_chrome_events,
 )
 
-STREAMS = ("spans", "flight", "metrics")
+STREAMS = ("spans", "flight", "metrics", "device")
 # One synthetic chrome pid per flight-recorder category lane.
 _FLIGHT_PID = 0
 # Synthetic process holding the per-request serve lanes: one named
@@ -47,6 +49,9 @@ _FLIGHT_PID = 0
 # handoff_pull → decode → stream) reads as nested slices on its own
 # row even when the phases ran in different OS processes.
 _SERVE_PID = 1 << 22
+# Synthetic process for device-plane telemetry (roofline/MFU counter
+# tracks + recompile instant markers), one thread lane per OS pid.
+_DEVICE_PID = (1 << 22) + 1
 
 
 def serve_request_events(spans: List[dict]) -> List[Dict[str, Any]]:
@@ -156,6 +161,56 @@ def metric_events(envs: List[dict]) -> List[Dict[str, Any]]:
     return events
 
 
+def device_events(envs: List[dict]) -> List[Dict[str, Any]]:
+    """Device journal records → counter tracks for the continuous
+    roofline/MFU step windows and instant markers for compile events
+    (a recompile storm reads as a burst of markers over a sagging
+    roofline track)."""
+    events: List[Dict[str, Any]] = []
+    lanes: Dict[int, int] = {}
+    for env in envs:
+        rec = env.get("d")
+        if not isinstance(rec, dict):
+            continue
+        pid = int(env.get("p", 0))
+        tid = lanes.setdefault(pid, len(lanes))
+        ts = float(rec.get("ts") or env.get("t", 0.0)) * 1e6
+        kind = rec.get("kind")
+        if kind == "step":
+            plane = rec.get("plane", "?")
+            for field in ("roofline_fraction", "mfu"):
+                val = rec.get(field)
+                if isinstance(val, (int, float)):
+                    events.append({
+                        "cat": "device",
+                        "name": f"{field}[{plane}]",
+                        "ph": "C", "pid": _DEVICE_PID, "tid": tid,
+                        "ts": ts, "args": {"value": float(val)}})
+            tok_s = rec.get("tokens_per_s")
+            if isinstance(tok_s, (int, float)):
+                events.append({
+                    "cat": "device", "name": f"tokens_per_s[{plane}]",
+                    "ph": "C", "pid": _DEVICE_PID, "tid": tid,
+                    "ts": ts, "args": {"value": float(tok_s)}})
+        elif kind == "compile":
+            args = {k: rec.get(k) for k in (
+                "wall_s", "shapes", "count", "after_warmup")}
+            events.append({
+                "cat": "device",
+                "name": f"compile {rec.get('function', '?')}",
+                "ph": "i", "s": "t", "pid": _DEVICE_PID, "tid": tid,
+                "ts": ts, "args": args})
+    if events:
+        events.append({"ph": "M", "pid": _DEVICE_PID,
+                       "name": "process_name",
+                       "args": {"name": "device plane"}})
+        for pid, tid in lanes.items():
+            events.append({"ph": "M", "pid": _DEVICE_PID, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": f"pid {pid}"}})
+    return events
+
+
 def dump_stats(directory: str) -> Dict[str, Any]:
     out: Dict[str, Any] = {"dir": directory}
     for stream in STREAMS:
@@ -186,6 +241,10 @@ def build_trace(directory: str, since: float = 0.0,
     if "metrics" in streams:
         events.extend(metric_events(
             journal.replay(directory, "metrics", since=since,
+                           until=until)))
+    if "device" in streams:
+        events.extend(device_events(
+            journal.replay(directory, "device", since=since,
                            until=until)))
     return events
 

@@ -1,0 +1,151 @@
+"""End-to-end drive of the device-plane observability surface (PR 19):
+a real multi-process cluster, a remote task churning XLA shapes, the
+profile sampler carrying device fields + recompile counts to the head,
+the watchdog flagging the storm and an injected HBM watermark, the
+dashboard answering /api/device, the serve engine emitting continuous
+roofline/MFU, and opsdump rendering the journal's device stream.
+
+Run: JAX_PLATFORMS=cpu python scripts/verify_drive_device.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("RAY_TPU_CHIPS", "none")
+os.environ["RAY_TPU_WATCHDOG_INTERVAL_S"] = "0.3"
+os.environ["RAY_TPU_DEVICE_RECOMPILE_MAX"] = "2"
+_journal_dir = tempfile.mkdtemp(prefix="rt-device-drive-")
+os.environ["RAY_TPU_OPS_JOURNAL_DIR"] = _journal_dir
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import ray_tpu  # noqa: E402
+from ray_tpu.util import device_stats, flight_recorder, journal  # noqa: E402
+
+
+def main() -> int:
+    t0 = time.time()
+    rt = ray_tpu.init(num_cpus=2)
+    try:
+        wd = rt.control._watchdog
+        assert wd is not None and wd.recompile_max == 2
+
+        # [1] remote shape churn -> recompile counts ride the sampler.
+        @ray_tpu.remote
+        def churn():
+            import jax
+            import numpy as np
+            from ray_tpu.util import device_stats as ds
+
+            f = ds.count_compiles(jax.jit(lambda x: x + 1), "churn")
+            for n in range(1, 9):
+                f(np.ones(n, dtype=np.float32))
+            return ds.recompiles_after_warmup().get("churn", 0)
+
+        after = ray_tpu.get(churn.remote(), timeout=180)
+        assert after > 2, after
+        print(f"[1] remote shape churn: {after} post-warmup recompiles")
+
+        rt.core.client.call({"op": "set_profile_config",
+                             "enabled": True, "interval_s": 0.2})
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            prof = rt.core.client.call({"op": "get_profile"})
+            hits = [s for s in prof.get("workers", {}).values()
+                    if isinstance(s.get("recompiles"), dict)]
+            if hits:
+                break
+            time.sleep(0.2)
+        assert hits, "recompile counts never reached the head"
+        assert all("device" in s and s["device"] is None
+                   for s in prof["workers"].values())
+        print(f"[2] sampler carried device fields for "
+              f"{len(prof['workers'])} workers (device: null on cpu)")
+
+        # [3] watchdog: recompile storm + injected HBM watermark.
+        deadline = time.time() + 30
+        while time.time() < deadline and not wd.recompile_storms_flagged:
+            time.sleep(0.2)
+        assert wd.recompile_storms_flagged >= 1
+        rt.core.client.send({"op": "profile_report", "sample": {
+            "ts": time.time(), "pid": 1, "worker": "f" * 8,
+            "device": {"backend": "tpu", "watermark_fraction": 0.97}}})
+        deadline = time.time() + 30
+        while time.time() < deadline and not wd.hbm_alerts:
+            time.sleep(0.2)
+        assert wd.hbm_alerts >= 1
+        events = {e["event"] for e in flight_recorder.dump()
+                  if e.get("category") == "health"}
+        assert {"recompile_storm", "hbm_watermark"} <= events, events
+        print(f"[3] watchdog: storms={wd.recompile_storms_flagged} "
+              f"hbm_alerts={wd.hbm_alerts}")
+
+        # [4] serve engine -> continuous roofline/MFU.
+        os.environ["RAY_TPU_SERVE_STEP_SAMPLE_EVERY"] = "2"
+        import numpy as np
+        from ray_tpu.models import transformer as tfm
+        from ray_tpu.serve.llm_engine import LLMEngine
+
+        eng = LLMEngine(tfm.TransformerConfig.tiny(), page_size=4,
+                        num_pages=64, max_batch=4, multi_step=1)
+        rng = np.random.default_rng(0)
+        for _ in range(4):
+            eng.add_request(rng.integers(1, 255, 8).tolist(),
+                            max_new_tokens=8)
+        while eng.has_work():
+            eng.step()
+        samp = eng.engine_sample
+        assert samp and "roofline_fraction" in samp and "mfu" in samp
+        ls = device_stats.last_step()
+        assert ls and ls["plane"] == "serve"
+        led = device_stats.ledger()
+        assert led["components"].get("weights", 0) > 0
+        print(f"[4] engine: tok/s={samp['tokens_per_s']} "
+              f"roofline={samp['roofline_fraction']} mfu={samp['mfu']} "
+              f"weights={led['components']['weights']}B")
+
+        # [5] /api/device end-to-end.
+        from ray_tpu.dashboard.http_head import Dashboard
+
+        dash = Dashboard(rt)
+        try:
+            with urllib.request.urlopen(dash.url + "/api/device",
+                                        timeout=30) as r:
+                dev = json.loads(r.read())
+        finally:
+            dash.stop()
+        assert dev["local"]["ledger"]["backend"] == "cpu"
+        assert dev["watchdog"]["recompile_storms_flagged"] >= 1
+        assert any(isinstance(w.get("recompiles"), dict)
+                   for w in dev["workers"].values())
+        print(f"[5] /api/device: backend=cpu, "
+              f"{len(dev['workers'])} workers, watchdog surfaced")
+
+        # [6] journal device stream -> opsdump lanes.
+        journal.flush_all(timeout=10)
+        envs = journal.replay(_journal_dir, "device")
+        kinds = {e["d"]["kind"] for e in envs}
+        assert "step" in kinds, kinds
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "opsdump", os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "opsdump.py"))
+        opsdump = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(opsdump)
+        events = opsdump.build_trace(_journal_dir, streams=("device",))
+        assert any(e.get("ph") == "C" for e in events)
+        print(f"[6] opsdump device lanes: {len(events)} events "
+              f"from {len(envs)} journal records")
+    finally:
+        ray_tpu.shutdown()
+    print(f"DEVICE DRIVE OK in {time.time() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
